@@ -1,0 +1,17 @@
+from opentenbase_tpu.catalog.nodes import NodeManager, NodeDef, NodeRole, NodeGroup
+from opentenbase_tpu.catalog.shardmap import ShardMap, SHARD_GROUPS
+from opentenbase_tpu.catalog.distribution import DistStrategy, DistributionSpec
+from opentenbase_tpu.catalog.catalog import Catalog, TableMeta
+
+__all__ = [
+    "NodeManager",
+    "NodeDef",
+    "NodeRole",
+    "NodeGroup",
+    "ShardMap",
+    "SHARD_GROUPS",
+    "DistStrategy",
+    "DistributionSpec",
+    "Catalog",
+    "TableMeta",
+]

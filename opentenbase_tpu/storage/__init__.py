@@ -1,0 +1,4 @@
+from opentenbase_tpu.storage.column import Column, Dictionary
+from opentenbase_tpu.storage.table import ColumnBatch, ShardStore
+
+__all__ = ["Column", "Dictionary", "ColumnBatch", "ShardStore"]

@@ -1,0 +1,221 @@
+"""Columnar batches and the per-datanode shard store (heap equivalent).
+
+The reference stores rows in 8KB heap pages with per-tuple MVCC headers and a
+shard id in the tuple header (src/include/access/htup_details.h:170 t_shardid,
+heap_form_tuple_shard src/backend/access/heap/heaptuple.c). Here a table
+shard is a set of append-only columns plus two hidden MVCC timestamp columns:
+
+- ``xmin_ts``: commit timestamp (GTS) of the inserting transaction.
+- ``xmax_ts``: commit timestamp of the deleting transaction, or INF_TS.
+
+Visibility is a vectorized predicate over these columns evaluated on device
+(see txn/mvcc.py — the direct analog of HeapTupleSatisfiesMVCC,
+src/backend/utils/time/tqual.c:2274). Uncommitted (prepared but not yet
+committed) inserts carry xmin_ts = PENDING_TS, which is > any snapshot
+timestamp, so they are invisible until the 2PC coordinator stamps the commit
+timestamp — the same "stamp at commit-prepared" flow the reference drives
+from pgxc_node_remote_commit (src/backend/pgxc/pool/execRemote.c:4862).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.storage.column import Column, Dictionary, column_from_python
+
+# Timestamp sentinels (int64). Real GTS values are positive and far below.
+INF_TS = np.int64(2**62)  # "never deleted" / "not yet committed"
+PENDING_TS = np.int64(2**62)
+
+
+@dataclass
+class ColumnBatch:
+    """An immutable batch of named columns with equal length."""
+
+    columns: dict[str, Column]
+    nrows: int
+
+    @staticmethod
+    def from_columns(columns: dict[str, Column]) -> "ColumnBatch":
+        n = len(next(iter(columns.values()))) if columns else 0
+        for name, col in columns.items():
+            if len(col) != n:
+                raise ValueError(f"column {name} length {len(col)} != {n}")
+        return ColumnBatch(columns, n)
+
+    @staticmethod
+    def from_pydict(
+        data: dict[str, list],
+        schema: dict[str, t.SqlType],
+        dictionaries: dict[str, Dictionary] | None = None,
+    ) -> "ColumnBatch":
+        cols = {}
+        for name, ty in schema.items():
+            d = dictionaries.get(name) if dictionaries else None
+            cols[name] = column_from_python(data[name], ty, d)
+        return ColumnBatch.from_columns(cols)
+
+    def take(self, idx: np.ndarray) -> "ColumnBatch":
+        return ColumnBatch({k: c.take(idx) for k, c in self.columns.items()}, len(idx))
+
+    def column_names(self) -> list[str]:
+        return list(self.columns.keys())
+
+    def to_pydict(self) -> dict[str, list]:
+        return {k: c.to_python() for k, c in self.columns.items()}
+
+    def to_rows(self) -> list[tuple]:
+        cols = [c.to_python() for c in self.columns.values()]
+        return list(zip(*cols)) if cols else []
+
+
+class ShardStore:
+    """Mutable storage for one shard of one table on one datanode.
+
+    Append-only columns + MVCC timestamp columns, with amortized growth.
+    A monotonically increasing ``version`` invalidates device-side caches
+    (the buffer-manager analog: instead of evicting 8KB pages we re-upload
+    whole columns when the shard mutates).
+    """
+
+    def __init__(self, schema: dict[str, t.SqlType], dictionaries: dict[str, Dictionary]):
+        self.schema = dict(schema)
+        self.dictionaries = dictionaries
+        self._cols: dict[str, np.ndarray] = {
+            name: np.empty(0, ty.np_dtype) for name, ty in schema.items()
+        }
+        self._validity: dict[str, np.ndarray | None] = {name: None for name in schema}
+        self.xmin_ts = np.empty(0, np.int64)
+        self.xmax_ts = np.empty(0, np.int64)
+        self.nrows = 0
+        self._capacity = 0
+        self.version = 0
+        # Prepared-but-undecided 2PC transactions hold (start, end) row
+        # ranges / index arrays into this store for later stamping. Vacuum
+        # compaction would invalidate them, so such transactions pin the
+        # store (the moral equivalent of the reference's shard barrier,
+        # src/backend/pgxc/shard/shardbarrier.c).
+        self._pins = 0
+
+    # -- growth ---------------------------------------------------------
+    def _ensure_capacity(self, extra: int) -> None:
+        need = self.nrows + extra
+        if need <= self._capacity:
+            return
+        new_cap = max(need, max(64, self._capacity * 2))
+        for name, arr in self._cols.items():
+            grown = np.zeros(new_cap, dtype=arr.dtype)
+            grown[: self.nrows] = arr[: self.nrows]
+            self._cols[name] = grown
+            vm = self._validity[name]
+            if vm is not None:
+                gvm = np.ones(new_cap, dtype=np.bool_)
+                gvm[: self.nrows] = vm[: self.nrows]
+                self._validity[name] = gvm
+        for attr in ("xmin_ts", "xmax_ts"):
+            arr = getattr(self, attr)
+            grown = np.zeros(new_cap, dtype=np.int64)
+            grown[: self.nrows] = arr[: self.nrows]
+            setattr(self, attr, grown)
+        self._capacity = new_cap
+
+    # -- writes ---------------------------------------------------------
+    def append_batch(self, batch: ColumnBatch, xmin_ts: int) -> tuple[int, int]:
+        """Append rows with the given xmin timestamp (PENDING_TS for 2PC
+        prepare). Returns the (start, end) row range for later stamping."""
+        n = batch.nrows
+        self._ensure_capacity(n)
+        start = self.nrows
+        for name in self.schema:
+            col = batch.columns[name]
+            self._cols[name][start : start + n] = col.data
+            if col.validity is not None:
+                if self._validity[name] is None:
+                    vm = np.ones(self._capacity, dtype=np.bool_)
+                    self._validity[name] = vm
+                self._validity[name][start : start + n] = col.validity
+            elif self._validity[name] is not None:
+                self._validity[name][start : start + n] = True
+        self.xmin_ts[start : start + n] = xmin_ts
+        self.xmax_ts[start : start + n] = INF_TS
+        self.nrows += n
+        self.version += 1
+        return start, start + n
+
+    def stamp_xmin(self, start: int, end: int, commit_ts: int) -> None:
+        self.xmin_ts[start:end] = commit_ts
+        self.version += 1
+
+    def truncate_range(self, start: int, end: int) -> None:
+        """Abort path for a prepared insert: mark the range dead forever."""
+        self.xmin_ts[start:end] = INF_TS
+        self.xmax_ts[start:end] = 0  # dead: xmax <= every snapshot
+        self.version += 1
+
+    def stamp_xmax(self, idx: np.ndarray, commit_ts: int) -> None:
+        self.xmax_ts[idx] = commit_ts
+        self.version += 1
+
+    def unstamp_xmax(self, idx: np.ndarray) -> None:
+        self.xmax_ts[idx] = INF_TS
+        self.version += 1
+
+    # -- reads ----------------------------------------------------------
+    def column_array(self, name: str) -> np.ndarray:
+        return self._cols[name][: self.nrows]
+
+    def column(self, name: str) -> Column:
+        vm = self._validity[name]
+        return Column(
+            self.schema[name],
+            self._cols[name][: self.nrows],
+            None if vm is None else vm[: self.nrows],
+            self.dictionaries.get(name),
+        )
+
+    def snapshot_arrays(self) -> dict[str, np.ndarray]:
+        """All columns + MVCC columns as contiguous arrays (for device upload)."""
+        out = {name: self._cols[name][: self.nrows] for name in self.schema}
+        out["__xmin_ts"] = self.xmin_ts[: self.nrows]
+        out["__xmax_ts"] = self.xmax_ts[: self.nrows]
+        return out
+
+    def to_batch(self) -> ColumnBatch:
+        return ColumnBatch({name: self.column(name) for name in self.schema}, self.nrows)
+
+    # -- pinning --------------------------------------------------------
+    def pin(self) -> None:
+        self._pins += 1
+
+    def unpin(self) -> None:
+        assert self._pins > 0
+        self._pins -= 1
+
+    # -- vacuum ---------------------------------------------------------
+    def vacuum(self, oldest_ts: int) -> int:
+        """Reclaim rows deleted before every live snapshot (shard_vacuum.c
+        equivalent, src/backend/pgxc/shard/shard_vacuum.c). Returns rows
+        removed. No-op while any prepared transaction pins the store: row
+        positions are stable identifiers for pending stamp/abort calls."""
+        if self._pins > 0:
+            return 0
+        n = self.nrows
+        dead = self.xmax_ts[:n] <= oldest_ts
+        ndead = int(dead.sum())
+        if ndead == 0:
+            return 0
+        keep = ~dead
+        for name in self.schema:
+            self._cols[name] = self._cols[name][:n][keep].copy()
+            vm = self._validity[name]
+            if vm is not None:
+                self._validity[name] = vm[:n][keep].copy()
+        self.xmin_ts = self.xmin_ts[:n][keep].copy()
+        self.xmax_ts = self.xmax_ts[:n][keep].copy()
+        self.nrows = n - ndead
+        self._capacity = self.nrows
+        self.version += 1
+        return ndead

@@ -1,0 +1,24 @@
+"""Test harness: simulate a multi-datanode TPU mesh on CPU.
+
+The reference tests multi-node behavior by bootstrapping a real mini cluster
+of processes on localhost (src/test/regress/pg_regress.c:121-141 builds
+1 GTM + 2 CN + 2 DN). Our equivalent: force XLA to expose 8 virtual CPU
+devices so every sharding/collective path runs exactly as it would on an
+8-chip TPU slice. Must be set before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def jax8():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {devices}"
+    return jax

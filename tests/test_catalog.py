@@ -1,0 +1,165 @@
+import numpy as np
+import pytest
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.catalog import (
+    Catalog,
+    DistStrategy,
+    DistributionSpec,
+    NodeDef,
+    NodeManager,
+    NodeRole,
+    ShardMap,
+)
+from opentenbase_tpu.catalog.locator import Locator
+from opentenbase_tpu.storage.column import column_from_python
+
+
+def mkcluster(ndn=4):
+    nm = NodeManager()
+    nm.create_node(NodeDef("cn1", NodeRole.COORDINATOR))
+    nm.create_node(NodeDef("gtm1", NodeRole.GTM))
+    for i in range(ndn):
+        nm.create_node(NodeDef(f"dn{i+1}", NodeRole.DATANODE))
+    sm = ShardMap()
+    sm.initialize(nm.datanode_indices())
+    return nm, sm
+
+
+def test_node_manager_roles():
+    nm, sm = mkcluster()
+    assert nm.num_datanodes == 4
+    assert [n.mesh_index for n in nm.datanodes] == [0, 1, 2, 3]
+    nm.create_group("grp_a", ["dn1", "dn3"])
+    assert nm.datanode_indices("grp_a") == [0, 2]
+    # datanode drop requires the rebalance path (stale shardmap guard)
+    with pytest.raises(ValueError):
+        nm.drop_node("dn2")
+    nm.drop_node("dn2", force=True)
+    # mesh indices are stable (no renumbering), and never reused
+    assert [n.mesh_index for n in nm.datanodes] == [0, 2, 3]
+    nm.create_node(NodeDef("dn9", NodeRole.DATANODE))
+    assert nm.get("dn9").mesh_index == 4
+
+
+def test_shardmap_balance_and_move():
+    nm, sm = mkcluster(4)
+    counts = [len(sm.shards_on_node(i)) for i in range(4)]
+    assert sum(counts) == sm.num_shards
+    assert max(counts) - min(counts) <= 1
+    prev = sm.move_shard(0, 3)
+    assert sm.map[0] == 3 and prev == 0
+
+
+def test_locator_shard_routing_deterministic():
+    nm, sm = mkcluster(4)
+    spec = DistributionSpec(DistStrategy.SHARD, ("id",))
+    loc = Locator(spec, nm.datanode_indices(), sm)
+    col = column_from_python(list(range(1000)), t.INT8)
+    nodes = loc.route_insert({"id": col}, 1000)
+    assert nodes.min() >= 0 and nodes.max() <= 3
+    # deterministic
+    nodes2 = loc.route_insert({"id": col}, 1000)
+    assert (nodes == nodes2).all()
+    # reasonably balanced
+    _, c = np.unique(nodes, return_counts=True)
+    assert c.min() > 100
+
+
+def test_locator_prune_matches_batch_routing():
+    nm, sm = mkcluster(4)
+    spec = DistributionSpec(DistStrategy.SHARD, ("id",))
+    loc = Locator(spec, nm.datanode_indices(), sm)
+    col = column_from_python([42], t.INT8)
+    batch_node = loc.route_insert({"id": col}, 1)[0]
+    pruned = loc.prune_by_key_equal({"id": 42})
+    assert pruned == [int(batch_node)]
+
+
+def test_locator_text_key_cross_table_agreement():
+    nm, sm = mkcluster(4)
+    spec = DistributionSpec(DistStrategy.SHARD, ("k",))
+    loc = Locator(spec, nm.datanode_indices(), sm)
+    c1 = column_from_python(["apple", "pear"], t.TEXT)
+    c2 = column_from_python(["zebra", "pear", "apple"], t.TEXT)  # different dict
+    n1 = loc.route_insert({"k": c1}, 2)
+    n2 = loc.route_insert({"k": c2}, 3)
+    assert n1[0] == n2[2]  # "apple" routes identically
+    assert n1[1] == n2[1]  # "pear" routes identically
+    assert loc.prune_by_key_equal({"k": "apple"}) == [int(n1[0])]
+
+
+def test_locator_roundrobin_spreads():
+    nm, sm = mkcluster(3)
+    spec = DistributionSpec(DistStrategy.ROUNDROBIN)
+    loc = Locator(spec, nm.datanode_indices())
+    nodes = loc.route_insert({}, 9)
+    _, c = np.unique(nodes, return_counts=True)
+    assert c.tolist() == [3, 3, 3]
+
+
+def test_locator_range():
+    nm, sm = mkcluster(3)
+    spec = DistributionSpec(DistStrategy.RANGE, ("id",), range_bounds=(100, 200))
+    loc = Locator(spec, nm.datanode_indices())
+    col = column_from_python([50, 150, 250], t.INT8)
+    assert loc.route_insert({"id": col}, 3).tolist() == [0, 1, 2]
+    assert loc.prune_by_key_equal({"id": 150}) == [1]
+
+
+def test_catalog_create_get_drop():
+    nm, sm = mkcluster(2)
+    cat = Catalog(nm, sm)
+    meta = cat.create_table(
+        "t1",
+        {"id": t.INT8, "name": t.TEXT},
+        DistributionSpec(DistStrategy.SHARD, ("id",)),
+    )
+    assert meta.locator is not None
+    assert cat.get("t1").column_names == ["id", "name"]
+    assert "name" in meta.dictionaries
+    with pytest.raises(ValueError):
+        cat.create_table("t1", {"x": t.INT4}, DistributionSpec(DistStrategy.REPLICATED))
+    with pytest.raises(ValueError):
+        cat.create_table("t2", {"x": t.INT4}, DistributionSpec(DistStrategy.SHARD, ("nope",)))
+    cat.drop_table("t1")
+    assert not cat.has("t1")
+
+
+def test_prune_typed_keys_match_insert_routing():
+    """DECIMAL/DATE/TEXT distribution keys: qual-constant pruning must pick
+    the same node the insert path chose (regression: prune used to hash the
+    python value instead of the physical representation)."""
+    nm, sm = mkcluster(4)
+    cat = Catalog(nm, sm)
+    for name, ty, rows, qual in [
+        ("td", t.decimal(10, 2), [1.50, 99.25], 1.50),
+        ("tdate", t.DATE, ["1995-01-01", "2001-06-30"], "1995-01-01"),
+        ("tts", t.TIMESTAMP, ["1995-01-01T00:00:01", "2001-06-30T12:00:00"],
+         "1995-01-01T00:00:01"),
+        ("ti", t.INT4, [-7, 1234], -7),
+    ]:
+        meta = cat.create_table(
+            name, {"k": ty, "v": t.INT4},
+            DistributionSpec(DistStrategy.SHARD, ("k",)),
+        )
+        batch_col = column_from_python(rows, ty)
+        routed = meta.locator.route_insert({"k": batch_col}, len(rows))
+        assert meta.locator.prune_by_key_equal({"k": qual}) == [int(routed[0])], name
+
+
+def test_float_negative_zero_colocates():
+    from opentenbase_tpu.utils.hashing import hash32_np
+
+    h = hash32_np(np.asarray([0.0, -0.0], dtype=np.float64))
+    assert h[0] == h[1]
+
+
+def test_shardmap_rebalance_plan():
+    nm, sm = mkcluster(3)
+    moves = sm.add_node_rebalance_plan(3, [0, 1, 2])
+    assert len(moves) == sm.num_shards // 4
+    for sid in moves:
+        sm.move_shard(sid, 3)
+    counts = [len(sm.shards_on_node(i)) for i in range(4)]
+    assert max(counts) - min(counts) <= len(moves)  # roughly leveled
