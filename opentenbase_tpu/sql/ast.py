@@ -1,0 +1,481 @@
+"""SQL abstract syntax tree.
+
+Compact analog of the reference's parse nodes (src/include/nodes/
+parsenodes.h). Statement nodes cover the surface in SURVEY.md §2.1's DDL
+table plus standard DML/queries; expression nodes are the scalar language
+the expression compiler (exec/expr.py) lowers to jax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+
+# ---------------------------------------------------------------------------
+# Expressions
+# ---------------------------------------------------------------------------
+
+class Expr:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: object  # python int/float/str/bool/None
+
+    def __str__(self):
+        if self.value is None:
+            return "NULL"
+        if isinstance(self.value, str):
+            return "'" + self.value.replace("'", "''") + "'"
+        return str(self.value)
+
+
+@dataclass(frozen=True)
+class ColumnRef(Expr):
+    name: str
+    table: Optional[str] = None  # qualifier
+
+    def __str__(self):
+        return f"{self.table}.{self.name}" if self.table else self.name
+
+
+@dataclass(frozen=True)
+class Param(Expr):
+    index: int  # 1-based, $1
+
+    def __str__(self):
+        return f"${self.index}"
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    table: Optional[str] = None
+
+    def __str__(self):
+        return f"{self.table}.*" if self.table else "*"
+
+
+@dataclass(frozen=True)
+class BinOp(Expr):
+    op: str  # + - * / % = <> < <= > >= and or || like
+    left: Expr
+    right: Expr
+
+    def __str__(self):
+        return f"({self.left} {self.op.upper()} {self.right})"
+
+
+@dataclass(frozen=True)
+class UnaryOp(Expr):
+    op: str  # - not
+    operand: Expr
+
+    def __str__(self):
+        return f"({self.op.upper()} {self.operand})"
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    operand: Expr
+    negated: bool = False
+
+    def __str__(self):
+        return f"({self.operand} IS {'NOT ' if self.negated else ''}NULL)"
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    operand: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+    def __str__(self):
+        n = "NOT " if self.negated else ""
+        return f"({self.operand} {n}BETWEEN {self.low} AND {self.high})"
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    operand: Expr
+    items: tuple[Expr, ...]
+    negated: bool = False
+
+    def __str__(self):
+        n = "NOT " if self.negated else ""
+        return f"({self.operand} {n}IN ({', '.join(map(str, self.items))}))"
+
+
+@dataclass(frozen=True)
+class InSubquery(Expr):
+    operand: Expr
+    query: "Select"
+    negated: bool = False
+
+    def __str__(self):
+        n = "NOT " if self.negated else ""
+        return f"({self.operand} {n}IN (<subquery>))"
+
+
+@dataclass(frozen=True)
+class ExistsSubquery(Expr):
+    query: "Select"
+    negated: bool = False
+
+    def __str__(self):
+        return f"({'NOT ' if self.negated else ''}EXISTS (<subquery>))"
+
+
+@dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    query: "Select"
+
+    def __str__(self):
+        return "(<scalar subquery>)"
+
+
+@dataclass(frozen=True)
+class FuncCall(Expr):
+    name: str
+    args: tuple[Expr, ...]
+    distinct: bool = False  # COUNT(DISTINCT x)
+    star: bool = False  # COUNT(*)
+
+    def __str__(self):
+        if self.star:
+            return f"{self.name}(*)"
+        d = "DISTINCT " if self.distinct else ""
+        return f"{self.name}({d}{', '.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    operand: Expr
+    type_name: str
+    type_args: tuple[int, ...] = ()
+
+    def __str__(self):
+        return f"CAST({self.operand} AS {self.type_name})"
+
+
+@dataclass(frozen=True)
+class CaseExpr(Expr):
+    # CASE [operand] WHEN cond THEN val ... [ELSE default] END
+    operand: Optional[Expr]
+    whens: tuple[tuple[Expr, Expr], ...]
+    default: Optional[Expr]
+
+    def __str__(self):
+        parts = ["CASE"]
+        if self.operand is not None:
+            parts.append(str(self.operand))
+        for c, v in self.whens:
+            parts.append(f"WHEN {c} THEN {v}")
+        if self.default is not None:
+            parts.append(f"ELSE {self.default}")
+        parts.append("END")
+        return " ".join(parts)
+
+
+@dataclass(frozen=True)
+class Extract(Expr):
+    field_name: str  # year month day hour ...
+    operand: Expr
+
+    def __str__(self):
+        return f"EXTRACT({self.field_name.upper()} FROM {self.operand})"
+
+
+# ---------------------------------------------------------------------------
+# Table references (FROM clause)
+# ---------------------------------------------------------------------------
+
+class TableRef:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class RelRef(TableRef):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SubqueryRef(TableRef):
+    query: "Select"
+    alias: str
+
+
+@dataclass(frozen=True)
+class JoinRef(TableRef):
+    join_type: str  # inner | left | right | full | cross
+    left: TableRef
+    right: TableRef
+    condition: Optional[Expr] = None  # ON ...; None for CROSS
+    using: tuple[str, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# Statements
+# ---------------------------------------------------------------------------
+
+class Statement:
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class SortItem:
+    expr: Expr
+    descending: bool = False
+    nulls_first: Optional[bool] = None  # None = default (last for ASC)
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass
+class Select(Statement):
+    items: list[SelectItem]
+    from_clause: Optional[TableRef] = None
+    where: Optional[Expr] = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: list[SortItem] = field(default_factory=list)
+    limit: Optional[Expr] = None
+    offset: Optional[Expr] = None
+    distinct: bool = False
+    # set operation chain: ("union"|"union all"|"intersect"|"except", Select)
+    set_ops: list[tuple[str, "Select"]] = field(default_factory=list)
+
+
+@dataclass
+class Insert(Statement):
+    table: str
+    columns: list[str]  # empty = all, in table order
+    values: list[list[Expr]]  # VALUES rows
+    query: Optional[Select] = None  # INSERT ... SELECT
+    returning: list[SelectItem] = field(default_factory=list)
+
+
+@dataclass
+class Update(Statement):
+    table: str
+    assignments: list[tuple[str, Expr]]
+    where: Optional[Expr] = None
+    returning: list[SelectItem] = field(default_factory=list)
+
+
+@dataclass
+class Delete(Statement):
+    table: str
+    where: Optional[Expr] = None
+    returning: list[SelectItem] = field(default_factory=list)
+
+
+@dataclass(frozen=True)
+class ColumnDef:
+    name: str
+    type_name: str
+    type_args: tuple[int, ...] = ()
+    not_null: bool = False
+    primary_key: bool = False
+    default: Optional[Expr] = None
+
+
+@dataclass
+class CreateTable(Statement):
+    name: str
+    columns: list[ColumnDef]
+    # DISTRIBUTE BY {SHARD(col) | HASH(col) | MODULO(col) | REPLICATION | ROUNDROBIN}
+    distribute_strategy: Optional[str] = None
+    distribute_keys: list[str] = field(default_factory=list)
+    to_group: Optional[str] = None  # TO GROUP name
+    if_not_exists: bool = False
+    # PARTITION BY RANGE (col) BEGIN (ts) STEP (interval) PARTITIONS (n) — the
+    # reference's interval partitioning (gram.y:4172)
+    partition_by: Optional[dict] = None
+
+
+@dataclass
+class DropTable(Statement):
+    names: list[str]
+    if_exists: bool = False
+
+
+@dataclass
+class TruncateTable(Statement):
+    names: list[str]
+
+
+@dataclass
+class CreateIndex(Statement):
+    name: str
+    table: str
+    columns: list[str]
+    unique: bool = False
+
+
+@dataclass
+class CopyStmt(Statement):
+    table: str
+    columns: list[str]
+    direction: str  # 'from' | 'to'
+    target: str  # filename or STDIN/STDOUT
+    options: dict = field(default_factory=dict)  # csv, delimiter, header...
+
+
+# -- transactions -----------------------------------------------------------
+
+@dataclass
+class BeginStmt(Statement):
+    isolation: Optional[str] = None
+
+
+@dataclass
+class CommitStmt(Statement):
+    pass
+
+
+@dataclass
+class RollbackStmt(Statement):
+    pass
+
+
+@dataclass
+class PrepareTransaction(Statement):
+    gid: str
+
+
+@dataclass
+class CommitPrepared(Statement):
+    gid: str
+
+
+@dataclass
+class RollbackPrepared(Statement):
+    gid: str
+
+
+# -- cluster DDL (the XL grammar surface, gram.y:307-313 etc.) --------------
+
+@dataclass
+class CreateNode(Statement):
+    name: str
+    node_type: str  # coordinator | datanode | gtm
+    host: str = "localhost"
+    port: int = 0
+    is_primary: bool = False
+    is_preferred: bool = False
+
+
+@dataclass
+class AlterNode(Statement):
+    name: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class DropNode(Statement):
+    name: str
+
+
+@dataclass
+class CreateNodeGroup(Statement):
+    name: str
+    members: list[str] = field(default_factory=list)
+
+
+@dataclass
+class DropNodeGroup(Statement):
+    name: str
+
+
+@dataclass
+class CreateShardingGroup(Statement):
+    members: list[str] = field(default_factory=list)  # node names; empty = all
+
+
+@dataclass
+class CleanSharding(Statement):
+    pass
+
+
+@dataclass
+class MoveData(Statement):
+    # MOVE DATA FROM node TO node [SHARDS (...)]
+    from_node: str = ""
+    to_node: str = ""
+    shard_ids: list[int] = field(default_factory=list)
+
+
+@dataclass
+class CreateBarrier(Statement):
+    barrier_id: Optional[str] = None
+
+
+@dataclass
+class PauseCluster(Statement):
+    pass
+
+
+@dataclass
+class UnpauseCluster(Statement):
+    pass
+
+
+@dataclass
+class ExecuteDirect(Statement):
+    nodes: list[str]
+    query: Statement
+
+
+@dataclass
+class CreateSequence(Statement):
+    name: str
+    start: int = 1
+    increment: int = 1
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequence(Statement):
+    name: str
+    if_exists: bool = False
+
+
+# -- misc -------------------------------------------------------------------
+
+@dataclass
+class ExplainStmt(Statement):
+    query: Statement
+    analyze: bool = False
+    verbose: bool = False
+
+
+@dataclass
+class VacuumStmt(Statement):
+    table: Optional[str] = None
+
+
+@dataclass
+class SetStmt(Statement):
+    name: str
+    value: object
+
+
+@dataclass
+class ShowStmt(Statement):
+    name: str
+
+
+@dataclass
+class AnalyzeStmt(Statement):
+    table: Optional[str] = None
+
+
+AnyExpr = Union[Expr]

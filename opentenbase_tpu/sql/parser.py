@@ -1,0 +1,1075 @@
+"""Recursive-descent SQL parser.
+
+Hand-written equivalent of the slice of src/backend/parser/gram.y the
+framework supports, including the XL cluster DDL productions
+(gram.y:307-313 CREATE NODE..., :2694 DISTRIBUTE BY, :4275 interval
+partitioning, :11589 MOVE DATA, :11601 CREATE BARRIER). Expressions use
+precedence climbing (c_expr/a_expr equivalent).
+"""
+
+from __future__ import annotations
+
+from opentenbase_tpu.sql import ast as A
+from opentenbase_tpu.sql.lexer import LexError, Tok, Token, tokenize
+
+
+class ParseError(ValueError):
+    pass
+
+
+# binary operator precedence (higher binds tighter)
+_PRECEDENCE = {
+    "or": 1,
+    "and": 2,
+    # NOT handled as prefix at level 3
+    "=": 4, "<>": 4, "!=": 4, "<": 4, "<=": 4, ">": 4, ">=": 4,
+    "like": 4, "ilike": 4, "in": 4, "between": 4, "is": 4, "not": 4,
+    "||": 5,
+    "+": 6, "-": 6,
+    "*": 7, "/": 7, "%": 7,
+    "^": 8,
+}
+
+_COMPARISON = {"=", "<>", "!=", "<", "<=", ">", ">="}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        try:
+            self.tokens = tokenize(sql)
+        except LexError as e:
+            raise ParseError(str(e)) from None
+        self.pos = 0
+
+    # -- token helpers --------------------------------------------------
+    @property
+    def cur(self) -> Token:
+        return self.tokens[self.pos]
+
+    def peek(self, offset: int = 0) -> Token:
+        i = min(self.pos + offset, len(self.tokens) - 1)
+        return self.tokens[i]
+
+    def advance(self) -> Token:
+        tok = self.cur
+        if tok.kind != Tok.EOF:
+            self.pos += 1
+        return tok
+
+    def at_kw(self, *words: str) -> bool:
+        """True if the next tokens are these keywords (case-folded idents)."""
+        for i, w in enumerate(words):
+            t = self.peek(i)
+            if t.kind != Tok.IDENT or t.value != w:
+                return False
+        return True
+
+    def eat_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.pos += len(words)
+            return True
+        return False
+
+    def expect_kw(self, *words: str) -> None:
+        if not self.eat_kw(*words):
+            self.error(f"expected {' '.join(words).upper()}")
+
+    def at_op(self, op: str) -> bool:
+        return self.cur.kind == Tok.OP and self.cur.value == op
+
+    def eat_op(self, op: str) -> bool:
+        if self.at_op(op):
+            self.pos += 1
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.eat_op(op):
+            self.error(f"expected {op!r}")
+
+    def ident(self, what: str = "identifier") -> str:
+        if self.cur.kind != Tok.IDENT:
+            self.error(f"expected {what}")
+        return self.advance().value
+
+    def error(self, msg: str):
+        tok = self.cur
+        got = tok.value if tok.kind != Tok.EOF else "end of input"
+        line = self.sql.count("\n", 0, tok.pos) + 1
+        raise ParseError(f"syntax error: {msg}, got {got!r} (line {line})")
+
+    # -- entry ----------------------------------------------------------
+    def parse_statements(self) -> list[A.Statement]:
+        out = []
+        while self.cur.kind != Tok.EOF:
+            if self.eat_op(";"):
+                continue
+            out.append(self.parse_statement())
+            if self.cur.kind != Tok.EOF and not self.eat_op(";"):
+                self.error("expected ';' between statements")
+        return out
+
+    def parse_statement(self) -> A.Statement:
+        t = self.cur
+        if t.kind == Tok.OP and t.value == "(":
+            return self.parse_select()
+        if t.kind != Tok.IDENT:
+            self.error("expected statement")
+        kw = t.value
+        if kw in ("select", "values", "with"):
+            return self.parse_select()
+        if kw == "insert":
+            return self.parse_insert()
+        if kw == "update":
+            return self.parse_update()
+        if kw == "delete":
+            return self.parse_delete()
+        if kw == "create":
+            return self.parse_create()
+        if kw == "drop":
+            return self.parse_drop()
+        if kw == "truncate":
+            return self.parse_truncate()
+        if kw == "copy":
+            return self.parse_copy()
+        if kw in ("begin", "start"):
+            return self.parse_begin()
+        if kw == "commit":
+            self.advance()
+            self.eat_kw("transaction") or self.eat_kw("work")
+            if self.eat_kw("prepared"):
+                return A.CommitPrepared(self._string_lit())
+            return A.CommitStmt()
+        if kw in ("rollback", "abort"):
+            self.advance()
+            self.eat_kw("transaction") or self.eat_kw("work")
+            if self.eat_kw("prepared"):
+                return A.RollbackPrepared(self._string_lit())
+            return A.RollbackStmt()
+        if kw == "prepare":
+            self.advance()
+            self.expect_kw("transaction")
+            return A.PrepareTransaction(self._string_lit())
+        if kw == "explain":
+            return self.parse_explain()
+        if kw == "vacuum":
+            self.advance()
+            name = self.ident("table name") if self.cur.kind == Tok.IDENT else None
+            return A.VacuumStmt(name)
+        if kw == "analyze":
+            self.advance()
+            name = self.ident("table name") if self.cur.kind == Tok.IDENT else None
+            return A.AnalyzeStmt(name)
+        if kw == "set":
+            return self.parse_set()
+        if kw == "show":
+            self.advance()
+            return A.ShowStmt(self.ident("setting name"))
+        if kw == "alter":
+            return self.parse_alter()
+        if kw == "move":
+            return self.parse_move_data()
+        if kw == "clean":
+            self.advance()
+            self.expect_kw("sharding")
+            return A.CleanSharding()
+        if kw == "pause":
+            self.advance()
+            self.expect_kw("cluster")
+            return A.PauseCluster()
+        if kw == "unpause":
+            self.advance()
+            self.expect_kw("cluster")
+            return A.UnpauseCluster()
+        if kw == "execute":
+            return self.parse_execute_direct()
+        self.error(f"unsupported statement {kw.upper()}")
+
+    # -- SELECT ---------------------------------------------------------
+    def parse_select(self) -> A.Select:
+        sel = self._select_core()
+        while True:
+            if self.at_kw("union"):
+                self.advance()
+                op = "union all" if self.eat_kw("all") else "union"
+            elif self.at_kw("intersect"):
+                self.advance()
+                op = "intersect"
+            elif self.at_kw("except"):
+                self.advance()
+                op = "except"
+            else:
+                break
+            sel.set_ops.append((op, self._select_core()))
+        if sel.set_ops:
+            # ORDER BY / LIMIT after a set op bind to the whole chain; the
+            # last branch's _order_limit grabbed them, so hoist.
+            last = sel.set_ops[-1][1]
+            if last.order_by and not sel.order_by:
+                sel.order_by, last.order_by = last.order_by, []
+            if last.limit is not None and sel.limit is None:
+                sel.limit, last.limit = last.limit, None
+            if last.offset is not None and sel.offset is None:
+                sel.offset, last.offset = last.offset, None
+        # trailing ORDER BY / LIMIT on the outer chain
+        self._order_limit(sel)
+        return sel
+
+    def _select_core(self) -> A.Select:
+        if self.eat_op("("):
+            sel = self.parse_select()
+            self.expect_op(")")
+            return sel
+        self.expect_kw("select")
+        distinct = False
+        if self.eat_kw("distinct"):
+            distinct = True
+        else:
+            self.eat_kw("all")
+        items = [self._select_item()]
+        while self.eat_op(","):
+            items.append(self._select_item())
+        sel = A.Select(items=items, distinct=distinct)
+        if self.eat_kw("from"):
+            sel.from_clause = self._from_clause()
+        if self.eat_kw("where"):
+            sel.where = self.parse_expr()
+        if self.eat_kw("group", "by"):
+            sel.group_by.append(self.parse_expr())
+            while self.eat_op(","):
+                sel.group_by.append(self.parse_expr())
+        if self.eat_kw("having"):
+            sel.having = self.parse_expr()
+        self._order_limit(sel)
+        return sel
+
+    def _order_limit(self, sel: A.Select) -> None:
+        if self.eat_kw("order", "by"):
+            sel.order_by = [self._sort_item()]
+            while self.eat_op(","):
+                sel.order_by.append(self._sort_item())
+        while True:
+            if self.eat_kw("limit"):
+                sel.limit = None if self.eat_kw("all") else self.parse_expr()
+            elif self.eat_kw("offset"):
+                sel.offset = self.parse_expr()
+            else:
+                break
+
+    def _select_item(self) -> A.SelectItem:
+        if self.at_op("*"):
+            self.advance()
+            return A.SelectItem(A.Star())
+        # qualified star: t.*
+        if (
+            self.cur.kind == Tok.IDENT
+            and self.peek(1).kind == Tok.OP
+            and self.peek(1).value == "."
+            and self.peek(2).kind == Tok.OP
+            and self.peek(2).value == "*"
+        ):
+            table = self.advance().value
+            self.advance()
+            self.advance()
+            return A.SelectItem(A.Star(table))
+        expr = self.parse_expr()
+        alias = None
+        if self.eat_kw("as"):
+            alias = self.ident("alias")
+        elif self.cur.kind == Tok.IDENT and self.cur.value not in _CLAUSE_KEYWORDS:
+            alias = self.advance().value
+        return A.SelectItem(expr, alias)
+
+    def _sort_item(self) -> A.SortItem:
+        expr = self.parse_expr()
+        desc = False
+        if self.eat_kw("desc"):
+            desc = True
+        else:
+            self.eat_kw("asc")
+        nulls_first = None
+        if self.eat_kw("nulls", "first"):
+            nulls_first = True
+        elif self.eat_kw("nulls", "last"):
+            nulls_first = False
+        return A.SortItem(expr, desc, nulls_first)
+
+    def _from_clause(self) -> A.TableRef:
+        ref = self._table_ref()
+        while True:
+            if self.eat_op(","):
+                right = self._table_ref()
+                ref = A.JoinRef("cross", ref, right)
+            elif self._at_join():
+                ref = self._join_tail(ref)
+            else:
+                return ref
+
+    def _at_join(self) -> bool:
+        return (
+            self.at_kw("join")
+            or self.at_kw("inner")
+            or self.at_kw("left")
+            or self.at_kw("right")
+            or self.at_kw("full")
+            or self.at_kw("cross")
+        )
+
+    def _join_tail(self, left: A.TableRef) -> A.TableRef:
+        jt = "inner"
+        if self.eat_kw("cross"):
+            jt = "cross"
+        elif self.eat_kw("inner"):
+            jt = "inner"
+        elif self.eat_kw("left"):
+            jt = "left"
+            self.eat_kw("outer")
+        elif self.eat_kw("right"):
+            jt = "right"
+            self.eat_kw("outer")
+        elif self.eat_kw("full"):
+            jt = "full"
+            self.eat_kw("outer")
+        self.expect_kw("join")
+        right = self._table_ref()
+        cond = None
+        using: tuple[str, ...] = ()
+        if jt != "cross":
+            if self.eat_kw("on"):
+                cond = self.parse_expr()
+            elif self.eat_kw("using"):
+                self.expect_op("(")
+                names = [self.ident("column")]
+                while self.eat_op(","):
+                    names.append(self.ident("column"))
+                self.expect_op(")")
+                using = tuple(names)
+            else:
+                self.error("expected ON or USING after JOIN")
+        return A.JoinRef(jt, left, right, cond, using)
+
+    def _table_ref(self) -> A.TableRef:
+        if self.eat_op("("):
+            if self.at_kw("select") or self.at_op("("):
+                query = self.parse_select()
+                self.expect_op(")")
+                alias = self._opt_alias()
+                if alias is None:
+                    raise ParseError("subquery in FROM must have an alias")
+                return A.SubqueryRef(query, alias)
+            ref = self._from_clause()
+            self.expect_op(")")
+            return ref
+        name = self.ident("table name")
+        alias = self._opt_alias()
+        return A.RelRef(name, alias)
+
+    def _opt_alias(self) -> str | None:
+        if self.eat_kw("as"):
+            return self.ident("alias")
+        if self.cur.kind == Tok.IDENT and self.cur.value not in _CLAUSE_KEYWORDS:
+            return self.advance().value
+        return None
+
+    # -- DML ------------------------------------------------------------
+    def parse_insert(self) -> A.Insert:
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        table = self.ident("table name")
+        columns: list[str] = []
+        if self.at_op("(") :
+            self.expect_op("(")
+            columns.append(self.ident("column"))
+            while self.eat_op(","):
+                columns.append(self.ident("column"))
+            self.expect_op(")")
+        if self.eat_kw("values"):
+            rows = [self._values_row()]
+            while self.eat_op(","):
+                rows.append(self._values_row())
+            stmt = A.Insert(table, columns, rows)
+        else:
+            stmt = A.Insert(table, columns, [], query=self.parse_select())
+        if self.eat_kw("returning"):
+            stmt.returning = [self._select_item()]
+            while self.eat_op(","):
+                stmt.returning.append(self._select_item())
+        return stmt
+
+    def _values_row(self) -> list[A.Expr]:
+        self.expect_op("(")
+        row = [self.parse_expr()]
+        while self.eat_op(","):
+            row.append(self.parse_expr())
+        self.expect_op(")")
+        return row
+
+    def parse_update(self) -> A.Update:
+        self.expect_kw("update")
+        table = self.ident("table name")
+        self.expect_kw("set")
+        assignments = [self._assignment()]
+        while self.eat_op(","):
+            assignments.append(self._assignment())
+        where = self.parse_expr() if self.eat_kw("where") else None
+        stmt = A.Update(table, assignments, where)
+        if self.eat_kw("returning"):
+            stmt.returning = [self._select_item()]
+            while self.eat_op(","):
+                stmt.returning.append(self._select_item())
+        return stmt
+
+    def _assignment(self) -> tuple[str, A.Expr]:
+        name = self.ident("column")
+        self.expect_op("=")
+        return name, self.parse_expr()
+
+    def parse_delete(self) -> A.Delete:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self.ident("table name")
+        where = self.parse_expr() if self.eat_kw("where") else None
+        stmt = A.Delete(table, where)
+        if self.eat_kw("returning"):
+            stmt.returning = [self._select_item()]
+            while self.eat_op(","):
+                stmt.returning.append(self._select_item())
+        return stmt
+
+    # -- CREATE ... -----------------------------------------------------
+    def parse_create(self) -> A.Statement:
+        self.expect_kw("create")
+        if self.eat_kw("table"):
+            return self._create_table()
+        if self.at_kw("unique", "index") or self.at_kw("index"):
+            unique = self.eat_kw("unique")
+            self.expect_kw("index")
+            name = self.ident("index name")
+            self.expect_kw("on")
+            table = self.ident("table name")
+            self.expect_op("(")
+            cols = [self.ident("column")]
+            while self.eat_op(","):
+                cols.append(self.ident("column"))
+            self.expect_op(")")
+            return A.CreateIndex(name, table, cols, unique)
+        if self.eat_kw("node"):
+            if self.eat_kw("group"):
+                name = self.ident("group name")
+                self.expect_kw("with")
+                self.expect_op("(")
+                members = [self.ident("node name")]
+                while self.eat_op(","):
+                    members.append(self.ident("node name"))
+                self.expect_op(")")
+                return A.CreateNodeGroup(name, members)
+            return self._create_node()
+        if self.eat_kw("sharding", "group"):
+            members: list[str] = []
+            if self.eat_kw("to", "group"):
+                members.append(self.ident("group name"))
+            elif self.eat_op("("):
+                members.append(self.ident("node name"))
+                while self.eat_op(","):
+                    members.append(self.ident("node name"))
+                self.expect_op(")")
+            return A.CreateShardingGroup(members)
+        if self.eat_kw("barrier"):
+            bid = self._string_lit() if self.cur.kind == Tok.STRING else None
+            return A.CreateBarrier(bid)
+        if self.eat_kw("sequence"):
+            ine = bool(self.eat_kw("if", "not", "exists"))
+            name = self.ident("sequence name")
+            start, increment = 1, 1
+            while True:
+                if self.eat_kw("start"):
+                    self.eat_kw("with")
+                    start = self._int_lit()
+                elif self.eat_kw("increment"):
+                    self.eat_kw("by")
+                    increment = self._int_lit()
+                else:
+                    break
+            return A.CreateSequence(name, start, increment, ine)
+        self.error("unsupported CREATE")
+
+    def _create_table(self) -> A.CreateTable:
+        if_not_exists = bool(self.eat_kw("if", "not", "exists"))
+        name = self.ident("table name")
+        self.expect_op("(")
+        columns = [self._column_def()]
+        while self.eat_op(","):
+            columns.append(self._column_def())
+        self.expect_op(")")
+        stmt = A.CreateTable(name, columns, if_not_exists=if_not_exists)
+        while True:
+            if self.eat_kw("distribute", "by"):
+                strat = self.ident("distribution strategy")
+                stmt.distribute_strategy = strat
+                if strat in ("shard", "hash", "modulo", "range"):
+                    self.expect_op("(")
+                    stmt.distribute_keys.append(self.ident("column"))
+                    while self.eat_op(","):
+                        stmt.distribute_keys.append(self.ident("column"))
+                    self.expect_op(")")
+            elif self.eat_kw("to", "group"):
+                stmt.to_group = self.ident("group name")
+            elif self.eat_kw("partition", "by"):
+                stmt.partition_by = self._partition_spec()
+            else:
+                break
+        return stmt
+
+    def _partition_spec(self) -> dict:
+        # PARTITION BY RANGE (col) [BEGIN (literal) STEP (literal unit)
+        # PARTITIONS (n)] — interval partitioning, gram.y:4172
+        self.expect_kw("range")
+        self.expect_op("(")
+        col = self.ident("column")
+        self.expect_op(")")
+        spec: dict = {"strategy": "range", "column": col}
+        if self.eat_kw("begin"):
+            self.expect_op("(")
+            spec["begin"] = self._literal_value()
+            self.expect_op(")")
+            self.expect_kw("step")
+            self.expect_op("(")
+            spec["step"] = self._literal_value()
+            if self.cur.kind == Tok.IDENT:
+                spec["step_unit"] = self.advance().value  # month / day / ...
+            self.expect_op(")")
+            self.expect_kw("partitions")
+            self.expect_op("(")
+            spec["partitions"] = self._int_lit()
+            self.expect_op(")")
+        return spec
+
+    def _column_def(self) -> A.ColumnDef:
+        name = self.ident("column name")
+        type_name = self.ident("type name")
+        # multi-word types: double precision, character varying
+        if type_name == "double" and self.eat_kw("precision"):
+            type_name = "float8"
+        elif type_name == "character":
+            type_name = "varchar" if self.eat_kw("varying") else "char"
+        type_args: tuple[int, ...] = ()
+        if self.eat_op("("):
+            args = [self._int_lit()]
+            while self.eat_op(","):
+                args.append(self._int_lit())
+            self.expect_op(")")
+            type_args = tuple(args)
+        not_null = False
+        primary_key = False
+        default = None
+        while True:
+            if self.eat_kw("not", "null"):
+                not_null = True
+            elif self.eat_kw("null"):
+                pass
+            elif self.eat_kw("primary", "key"):
+                primary_key = True
+                not_null = True
+            elif self.eat_kw("default"):
+                default = self.parse_expr()
+            else:
+                break
+        return A.ColumnDef(name, type_name, type_args, not_null, primary_key, default)
+
+    def _create_node(self) -> A.CreateNode:
+        name = self.ident("node name")
+        self.expect_kw("with")
+        self.expect_op("(")
+        node_type, host, port = "datanode", "localhost", 0
+        primary = preferred = False
+        while not self.at_op(")"):
+            opt = self.ident("node option")
+            if opt == "type":
+                self.eat_op("=")
+                node_type = (
+                    self._string_lit() if self.cur.kind == Tok.STRING else self.ident("type")
+                )
+            elif opt == "host":
+                self.eat_op("=")
+                host = self._string_lit() if self.cur.kind == Tok.STRING else self.ident("host")
+            elif opt == "port":
+                self.eat_op("=")
+                port = self._int_lit()
+            elif opt == "primary":
+                primary = True
+            elif opt == "preferred":
+                preferred = True
+            else:
+                self.error(f"unknown node option {opt!r}")
+            self.eat_op(",")
+        self.expect_op(")")
+        return A.CreateNode(name, node_type, host, port, primary, preferred)
+
+    def parse_alter(self) -> A.Statement:
+        self.expect_kw("alter")
+        if self.eat_kw("node"):
+            name = self.ident("node name")
+            self.expect_kw("with")
+            self.expect_op("(")
+            options: dict = {}
+            while not self.at_op(")"):
+                opt = self.ident("option")
+                self.eat_op("=")
+                if self.cur.kind == Tok.STRING:
+                    options[opt] = self._string_lit()
+                elif self.cur.kind == Tok.NUMBER:
+                    options[opt] = self._int_lit()
+                else:
+                    options[opt] = True
+                self.eat_op(",")
+            self.expect_op(")")
+            return A.AlterNode(name, options)
+        self.error("unsupported ALTER")
+
+    def parse_drop(self) -> A.Statement:
+        self.expect_kw("drop")
+        if self.eat_kw("table"):
+            if_exists = bool(self.eat_kw("if", "exists"))
+            names = [self.ident("table name")]
+            while self.eat_op(","):
+                names.append(self.ident("table name"))
+            return A.DropTable(names, if_exists)
+        if self.eat_kw("node"):
+            if self.eat_kw("group"):
+                return A.DropNodeGroup(self.ident("group name"))
+            return A.DropNode(self.ident("node name"))
+        if self.eat_kw("sequence"):
+            if_exists = bool(self.eat_kw("if", "exists"))
+            return A.DropSequence(self.ident("sequence name"), if_exists)
+        self.error("unsupported DROP")
+
+    def parse_truncate(self) -> A.TruncateTable:
+        self.expect_kw("truncate")
+        self.eat_kw("table")
+        names = [self.ident("table name")]
+        while self.eat_op(","):
+            names.append(self.ident("table name"))
+        return A.TruncateTable(names)
+
+    # -- COPY -----------------------------------------------------------
+    def parse_copy(self) -> A.CopyStmt:
+        self.expect_kw("copy")
+        table = self.ident("table name")
+        columns: list[str] = []
+        if self.eat_op("("):
+            columns.append(self.ident("column"))
+            while self.eat_op(","):
+                columns.append(self.ident("column"))
+            self.expect_op(")")
+        if self.eat_kw("from"):
+            direction = "from"
+        elif self.eat_kw("to"):
+            direction = "to"
+        else:
+            self.error("expected FROM or TO")
+        if self.cur.kind == Tok.STRING:
+            target = self._string_lit()
+        elif self.eat_kw("stdin"):
+            target = "STDIN"
+        elif self.eat_kw("stdout"):
+            target = "STDOUT"
+        else:
+            self.error("expected filename, STDIN, or STDOUT")
+        options: dict = {}
+        self.eat_kw("with")
+        if self.eat_op("("):
+            while not self.at_op(")"):
+                opt = self.ident("copy option")
+                if self.cur.kind == Tok.STRING:
+                    options[opt] = self._string_lit()
+                elif self.cur.kind == Tok.NUMBER:
+                    options[opt] = self._literal_value()
+                elif self.cur.kind == Tok.IDENT and self.cur.value not in (",",):
+                    options[opt] = self.advance().value
+                else:
+                    options[opt] = True
+                self.eat_op(",")
+            self.expect_op(")")
+        else:
+            while self.cur.kind == Tok.IDENT:
+                opt = self.advance().value
+                if opt == "csv":
+                    options["format"] = "csv"
+                elif opt == "header":
+                    options["header"] = True
+                elif opt == "delimiter":
+                    options["delimiter"] = self._string_lit()
+                elif opt == "null":
+                    options["null"] = self._string_lit()
+                else:
+                    self.error(f"unknown COPY option {opt!r}")
+        return A.CopyStmt(table, columns, direction, target, options)
+
+    # -- txn ------------------------------------------------------------
+    def parse_begin(self) -> A.BeginStmt:
+        self.advance()  # begin | start
+        self.eat_kw("transaction") or self.eat_kw("work")
+        isolation = None
+        if self.eat_kw("isolation", "level"):
+            if self.eat_kw("repeatable", "read"):
+                isolation = "repeatable read"
+            elif self.eat_kw("read", "committed"):
+                isolation = "read committed"
+            elif self.eat_kw("serializable"):
+                isolation = "serializable"
+            else:
+                self.error("unknown isolation level")
+        return A.BeginStmt(isolation)
+
+    # -- EXPLAIN / SET / cluster ops ------------------------------------
+    def parse_explain(self) -> A.ExplainStmt:
+        self.expect_kw("explain")
+        analyze = verbose = False
+        if self.eat_op("("):
+            while not self.at_op(")"):
+                opt = self.ident("explain option")
+                if opt == "analyze":
+                    analyze = not self.at_kw("off")
+                elif opt == "verbose":
+                    verbose = not self.at_kw("off")
+                self.eat_kw("on") or self.eat_kw("off") or self.eat_kw("true") or self.eat_kw(
+                    "false"
+                )
+                self.eat_op(",")
+            self.expect_op(")")
+        else:
+            while True:
+                if self.eat_kw("analyze"):
+                    analyze = True
+                elif self.eat_kw("verbose"):
+                    verbose = True
+                else:
+                    break
+        return A.ExplainStmt(self.parse_statement(), analyze, verbose)
+
+    def parse_set(self) -> A.SetStmt:
+        self.expect_kw("set")
+        self.eat_kw("local") or self.eat_kw("session")
+        name = self.ident("setting name")
+        if not (self.eat_op("=") or self.eat_kw("to")):
+            self.error("expected = or TO")
+        if self.cur.kind == Tok.STRING:
+            value: object = self._string_lit()
+        elif self.cur.kind == Tok.NUMBER:
+            value = self._literal_value()
+        else:
+            value = self.ident("value")
+        return A.SetStmt(name, value)
+
+    def parse_move_data(self) -> A.MoveData:
+        self.expect_kw("move")
+        self.expect_kw("data")
+        self.expect_kw("from")
+        from_node = self.ident("node name")
+        self.expect_kw("to")
+        to_node = self.ident("node name")
+        shard_ids: list[int] = []
+        if self.eat_kw("shards"):
+            self.expect_op("(")
+            shard_ids.append(self._int_lit())
+            while self.eat_op(","):
+                shard_ids.append(self._int_lit())
+            self.expect_op(")")
+        return A.MoveData(from_node, to_node, shard_ids)
+
+    def parse_execute_direct(self) -> A.ExecuteDirect:
+        self.expect_kw("execute")
+        self.expect_kw("direct")
+        self.expect_kw("on")
+        self.expect_op("(")
+        nodes = [self.ident("node name")]
+        while self.eat_op(","):
+            nodes.append(self.ident("node name"))
+        self.expect_op(")")
+        query = A.Select([A.SelectItem(A.Literal(self._string_lit()))])
+        # EXECUTE DIRECT ON (node) 'sql' — re-parse the inner SQL
+        inner_sql = query.items[0].expr.value  # type: ignore[union-attr]
+        inner = Parser(str(inner_sql)).parse_statement()
+        return A.ExecuteDirect(nodes, inner)
+
+    # -- literal helpers ------------------------------------------------
+    def _string_lit(self) -> str:
+        if self.cur.kind != Tok.STRING:
+            self.error("expected string literal")
+        return self.advance().value
+
+    def _int_lit(self) -> int:
+        neg = self.eat_op("-")
+        if self.cur.kind != Tok.NUMBER:
+            self.error("expected integer")
+        v = self.advance().value
+        iv = int(float(v)) if ("." in v or "e" in v.lower()) else int(v)
+        return -iv if neg else iv
+
+    def _literal_value(self) -> object:
+        if self.cur.kind == Tok.STRING:
+            return self._string_lit()
+        neg = self.eat_op("-")
+        if self.cur.kind != Tok.NUMBER:
+            self.error("expected literal")
+        v = self.advance().value
+        num: object = float(v) if ("." in v or "e" in v.lower()) else int(v)
+        return -num if neg else num  # type: ignore[operator]
+
+    # ==================================================================
+    # Expressions: precedence climbing
+    # ==================================================================
+    def parse_expr(self, min_prec: int = 0) -> A.Expr:
+        left = self._unary()
+        while True:
+            op = self._peek_binop()
+            if op is None or _PRECEDENCE[op] < min_prec:
+                return left
+            left = self._binop_tail(left, op)
+
+    def _peek_binop(self) -> str | None:
+        t = self.cur
+        if t.kind == Tok.OP and t.value in _PRECEDENCE:
+            return t.value
+        if t.kind == Tok.IDENT:
+            v = t.value
+            if v in ("and", "or", "like", "ilike", "is", "in", "between"):
+                return v
+            if v == "not" and self.peek(1).kind == Tok.IDENT and self.peek(1).value in (
+                "like",
+                "ilike",
+                "in",
+                "between",
+            ):
+                return "not"
+        return None
+
+    def _binop_tail(self, left: A.Expr, op: str) -> A.Expr:
+        if op == "not":
+            self.advance()  # not
+            inner = self._peek_binop()
+            assert inner in ("like", "ilike", "in", "between")
+            expr = self._binop_tail(left, inner)
+            if isinstance(expr, A.BinOp):  # LIKE
+                return A.UnaryOp("not", expr)
+            if isinstance(expr, (A.InList, A.InSubquery)):
+                return type(expr)(expr.operand, expr.items, True) if isinstance(
+                    expr, A.InList
+                ) else A.InSubquery(expr.operand, expr.query, True)
+            if isinstance(expr, A.Between):
+                return A.Between(expr.operand, expr.low, expr.high, True)
+            return A.UnaryOp("not", expr)
+        self.advance()
+        prec = _PRECEDENCE[op]
+        if op == "is":
+            negated = bool(self.eat_kw("not"))
+            if self.eat_kw("null"):
+                return A.IsNull(left, negated)
+            if self.eat_kw("true"):
+                cmp = A.BinOp("=", left, A.Literal(True))
+                return A.UnaryOp("not", cmp) if negated else cmp
+            if self.eat_kw("false"):
+                cmp = A.BinOp("=", left, A.Literal(False))
+                return A.UnaryOp("not", cmp) if negated else cmp
+            if self.eat_kw("distinct", "from"):
+                right = self.parse_expr(prec + 1)
+                return A.BinOp("is distinct from" if not negated else "is not distinct from", left, right)
+            self.error("expected NULL/TRUE/FALSE after IS")
+        if op == "between":
+            low = self.parse_expr(_PRECEDENCE["between"] + 1)
+            self.expect_kw("and")
+            high = self.parse_expr(_PRECEDENCE["between"] + 1)
+            return A.Between(left, low, high)
+        if op == "in":
+            self.expect_op("(")
+            if self.at_kw("select") or self.at_kw("values"):
+                q = self.parse_select()
+                self.expect_op(")")
+                return A.InSubquery(left, q)
+            items = [self.parse_expr()]
+            while self.eat_op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+            return A.InList(left, tuple(items))
+        if op in ("like", "ilike"):
+            right = self.parse_expr(prec + 1)
+            return A.BinOp(op, left, right)
+        if op == "!=":
+            op = "<>"
+        right = self.parse_expr(prec + 1)
+        return A.BinOp(op, left, right)
+
+    def _unary(self) -> A.Expr:
+        if self.eat_kw("not"):
+            return A.UnaryOp("not", self.parse_expr(3))
+        if self.eat_op("-"):
+            operand = self._unary_postfix()
+            if isinstance(operand, A.Literal) and isinstance(operand.value, (int, float)):
+                return A.Literal(-operand.value)
+            return A.UnaryOp("-", operand)
+        if self.eat_op("+"):
+            return self._unary_postfix()
+        return self._unary_postfix()
+
+    def _unary_postfix(self) -> A.Expr:
+        expr = self._primary()
+        while self.eat_op("::"):
+            type_name = self.ident("type name")
+            type_args: tuple[int, ...] = ()
+            if self.eat_op("("):
+                args = [self._int_lit()]
+                while self.eat_op(","):
+                    args.append(self._int_lit())
+                self.expect_op(")")
+                type_args = tuple(args)
+            expr = A.Cast(expr, type_name, type_args)
+        return expr
+
+    def _primary(self) -> A.Expr:
+        t = self.cur
+        if t.kind == Tok.NUMBER:
+            self.advance()
+            v = t.value
+            if "." in v or "e" in v.lower():
+                return A.Literal(float(v))
+            return A.Literal(int(v))
+        if t.kind == Tok.STRING:
+            self.advance()
+            return A.Literal(t.value)
+        if t.kind == Tok.PARAM:
+            self.advance()
+            return A.Param(int(t.value))
+        if t.kind == Tok.OP and t.value == "(":
+            self.advance()
+            if self.at_kw("select"):
+                q = self.parse_select()
+                self.expect_op(")")
+                return A.ScalarSubquery(q)
+            expr = self.parse_expr()
+            self.expect_op(")")
+            return expr
+        if t.kind != Tok.IDENT:
+            self.error("expected expression")
+        kw = t.value
+        if kw in _RESERVED:
+            self.error("expected expression")
+        if kw == "null":
+            self.advance()
+            return A.Literal(None)
+        if kw == "true":
+            self.advance()
+            return A.Literal(True)
+        if kw == "false":
+            self.advance()
+            return A.Literal(False)
+        if kw == "case":
+            return self._case_expr()
+        if kw == "cast":
+            self.advance()
+            self.expect_op("(")
+            operand = self.parse_expr()
+            self.expect_kw("as")
+            type_name = self.ident("type name")
+            if type_name == "double" and self.eat_kw("precision"):
+                type_name = "float8"
+            elif type_name == "character" and self.eat_kw("varying"):
+                type_name = "varchar"
+            type_args: tuple[int, ...] = ()
+            if self.eat_op("("):
+                args = [self._int_lit()]
+                while self.eat_op(","):
+                    args.append(self._int_lit())
+                self.expect_op(")")
+                type_args = tuple(args)
+            self.expect_op(")")
+            return A.Cast(operand, type_name, type_args)
+        if kw == "extract":
+            self.advance()
+            self.expect_op("(")
+            field_name = self.ident("field")
+            self.expect_kw("from")
+            operand = self.parse_expr()
+            self.expect_op(")")
+            return A.Extract(field_name, operand)
+        if kw == "exists":
+            self.advance()
+            self.expect_op("(")
+            q = self.parse_select()
+            self.expect_op(")")
+            return A.ExistsSubquery(q)
+        if kw == "interval":
+            self.advance()
+            text = self._string_lit()
+            return A.FuncCall("interval", (A.Literal(text),))
+        if kw in ("date", "timestamp") and self.peek(1).kind == Tok.STRING:
+            self.advance()
+            text = self._string_lit()
+            return A.Cast(A.Literal(text), kw)
+        # function call?
+        if self.peek(1).kind == Tok.OP and self.peek(1).value == "(":
+            name = self.advance().value
+            self.advance()  # (
+            if self.eat_op("*"):
+                self.expect_op(")")
+                return A.FuncCall(name, (), star=True)
+            if self.at_op(")"):
+                self.advance()
+                return A.FuncCall(name, ())
+            distinct = bool(self.eat_kw("distinct"))
+            args = [self.parse_expr()]
+            while self.eat_op(","):
+                args.append(self.parse_expr())
+            self.expect_op(")")
+            return A.FuncCall(name, tuple(args), distinct=distinct)
+        # column ref, possibly qualified
+        name = self.advance().value
+        if self.at_op(".") and self.peek(1).kind == Tok.IDENT:
+            self.advance()
+            col = self.advance().value
+            return A.ColumnRef(col, name)
+        return A.ColumnRef(name)
+
+    def _case_expr(self) -> A.CaseExpr:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.parse_expr()
+        whens = []
+        while self.eat_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            val = self.parse_expr()
+            whens.append((cond, val))
+        default = self.parse_expr() if self.eat_kw("else") else None
+        self.expect_kw("end")
+        return A.CaseExpr(operand, tuple(whens), default)
+
+
+# fully reserved words: never valid as a bare column reference
+_RESERVED = {
+    "select", "from", "where", "group", "having", "order", "limit", "offset",
+    "union", "intersect", "except", "join", "on", "when", "then", "else",
+    "end", "and", "or", "insert", "update", "delete", "into", "values",
+}
+
+# keywords that terminate an implicit alias position
+_CLAUSE_KEYWORDS = {
+    "from", "where", "group", "having", "order", "limit", "offset", "union",
+    "intersect", "except", "on", "using", "join", "inner", "left", "right",
+    "full", "cross", "as", "and", "or", "not", "in", "like", "ilike", "is",
+    "between", "when", "then", "else", "end", "asc", "desc", "nulls",
+    "returning", "set", "values", "distribute", "to", "partition",
+}
+
+
+def parse(sql: str) -> list[A.Statement]:
+    """Parse a semicolon-separated script into statements."""
+    return Parser(sql).parse_statements()
+
+
+def parse_one(sql: str) -> A.Statement:
+    stmts = parse(sql)
+    if len(stmts) != 1:
+        raise ParseError(f"expected exactly one statement, got {len(stmts)}")
+    return stmts[0]
