@@ -1,0 +1,1207 @@
+"""Analyzer: AST -> typed logical plan.
+
+The analog of src/backend/parser/analyze.c + parse_expr.c + parse_agg.c:
+binds names against the catalog, resolves types with implicit coercions,
+extracts aggregates, rewrites IN-subqueries to semi-joins, and lowers
+literals to physical representation (decimal = scaled int64, date = epoch
+days, text patterns kept as python strings for dictionary resolution).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.catalog.catalog import Catalog
+from opentenbase_tpu.plan import texpr as E
+from opentenbase_tpu.plan import logical as L
+from opentenbase_tpu.sql import ast as A
+
+AGG_FUNCS = {"sum", "count", "avg", "min", "max"}
+
+
+class AnalyzeError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Scopes
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ScopeCol:
+    qualifier: Optional[str]
+    name: str
+    type: t.SqlType
+    dict_id: Optional[str] = None
+
+
+class Scope:
+    def __init__(self, cols: list[ScopeCol]):
+        self.cols = cols
+
+    def resolve(self, name: str, qualifier: Optional[str]) -> tuple[int, ScopeCol]:
+        matches = [
+            (i, c)
+            for i, c in enumerate(self.cols)
+            if c.name == name and (qualifier is None or c.qualifier == qualifier)
+        ]
+        if not matches:
+            q = f"{qualifier}." if qualifier else ""
+            raise AnalyzeError(f'column "{q}{name}" does not exist')
+        if len(matches) > 1:
+            raise AnalyzeError(f'column reference "{name}" is ambiguous')
+        return matches[0]
+
+    def concat(self, other: "Scope") -> "Scope":
+        return Scope(self.cols + other.cols)
+
+    def out_schema(self) -> tuple[L.OutCol, ...]:
+        return tuple(L.OutCol(c.name, c.type, c.dict_id) for c in self.cols)
+
+
+def scope_from_schema(schema: tuple[L.OutCol, ...], qualifier: Optional[str]) -> Scope:
+    return Scope([ScopeCol(qualifier, c.name, c.type, c.dict_id) for c in schema])
+
+
+# ---------------------------------------------------------------------------
+# Literal -> physical conversion
+# ---------------------------------------------------------------------------
+
+def _date_days(s: str) -> int:
+    try:
+        return int(np.datetime64(s, "D").astype("int64"))
+    except Exception:
+        raise AnalyzeError(f"invalid date literal {s!r}") from None
+
+
+def _timestamp_us(s: str) -> int:
+    try:
+        return int(np.datetime64(s, "us").astype("int64"))
+    except Exception:
+        raise AnalyzeError(f"invalid timestamp literal {s!r}") from None
+
+
+def literal_to_physical(value: object, ty: t.SqlType) -> object:
+    """Convert a python literal to ``ty``'s physical representation."""
+    if value is None:
+        return None
+    tid = ty.id
+    if tid == t.TypeId.DECIMAL:
+        return round(float(value) * ty.decimal_factor)
+    if tid == t.TypeId.DATE:
+        return _date_days(str(value)) if isinstance(value, str) else int(value)
+    if tid == t.TypeId.TIMESTAMP:
+        return _timestamp_us(str(value)) if isinstance(value, str) else int(value)
+    if tid in (t.TypeId.INT4, t.TypeId.INT8):
+        iv = int(value)  # type: ignore[arg-type]
+        if isinstance(value, float) and value != iv:
+            raise AnalyzeError(f"invalid integer literal {value!r}")
+        return iv
+    if tid in (t.TypeId.FLOAT4, t.TypeId.FLOAT8):
+        return float(value)  # type: ignore[arg-type]
+    if tid == t.TypeId.BOOL:
+        return bool(value)
+    if tid == t.TypeId.TEXT:
+        return str(value)
+    raise AnalyzeError(f"cannot convert literal to {ty}")
+
+
+@dataclass
+class _Interval:
+    """Analysis-time interval value (never reaches execution unfolded)."""
+
+    months: int = 0
+    days: int = 0
+    usecs: int = 0
+
+
+_INTERVAL_UNITS = {
+    "year": ("months", 12), "years": ("months", 12),
+    "month": ("months", 1), "months": ("months", 1), "mon": ("months", 1),
+    "week": ("days", 7), "weeks": ("days", 7),
+    "day": ("days", 1), "days": ("days", 1),
+    "hour": ("usecs", 3_600_000_000), "hours": ("usecs", 3_600_000_000),
+    "minute": ("usecs", 60_000_000), "minutes": ("usecs", 60_000_000),
+    "second": ("usecs", 1_000_000), "seconds": ("usecs", 1_000_000),
+}
+
+
+def _parse_interval(text: str) -> _Interval:
+    iv = _Interval()
+    parts = text.split()
+    if len(parts) % 2 != 0:
+        raise AnalyzeError(f"cannot parse interval {text!r}")
+    for i in range(0, len(parts), 2):
+        try:
+            qty = int(parts[i])
+        except ValueError:
+            raise AnalyzeError(f"cannot parse interval {text!r}") from None
+        unit = parts[i + 1].lower()
+        if unit not in _INTERVAL_UNITS:
+            raise AnalyzeError(f"unknown interval unit {unit!r}")
+        field_name, mult = _INTERVAL_UNITS[unit]
+        setattr(iv, field_name, getattr(iv, field_name) + qty * mult)
+    return iv
+
+
+def _add_interval_to_days(days: int, iv: _Interval, sign: int) -> int:
+    d = np.datetime64(int(days), "D")
+    if iv.months:
+        m = d.astype("datetime64[M]")
+        day_of_month = (d - m.astype("datetime64[D]")).astype(int)
+        m2 = m + np.timedelta64(sign * iv.months, "M")
+        d = m2.astype("datetime64[D]") + np.timedelta64(int(day_of_month), "D")
+    d = d + np.timedelta64(sign * iv.days, "D")
+    return int(d.astype("int64"))
+
+
+# ---------------------------------------------------------------------------
+# Expression analysis
+# ---------------------------------------------------------------------------
+
+class ExprContext:
+    """Controls leaf resolution. ``grouped`` carries (input_ctx, group key
+    map, aggs list, agg offset fn) when analyzing above an Aggregate."""
+
+    def __init__(
+        self,
+        scope: Scope,
+        analyzer: "Analyzer",
+        allow_aggs: bool = False,
+        grouped: Optional["GroupedContext"] = None,
+    ):
+        self.scope = scope
+        self.analyzer = analyzer
+        self.allow_aggs = allow_aggs
+        self.grouped = grouped
+
+
+class GroupedContext:
+    def __init__(self, input_ctx: ExprContext, group_texprs: list[E.TExpr]):
+        self.input_ctx = input_ctx
+        self.group_keys = {g.key(): i for i, g in enumerate(group_texprs)}
+        self.group_texprs = group_texprs
+        self.aggs: list[E.AggCall] = []
+
+    def agg_col(self, call: E.AggCall) -> E.Col:
+        k = call.key()
+        for i, existing in enumerate(self.aggs):
+            if existing.key() == k:
+                return E.Col(len(self.group_keys) + i, existing.type)
+        self.aggs.append(call)
+        return E.Col(len(self.group_keys) + len(self.aggs) - 1, call.type)
+
+
+def _bool_type(e: E.TExpr) -> E.TExpr:
+    if e.type.id != t.TypeId.BOOL:
+        raise AnalyzeError(f"expected boolean expression, got {e.type}")
+    return e
+
+
+_ARITH = {"+", "-", "*", "/", "%"}
+_CMP = {"=", "<>", "<", "<=", ">", ">="}
+
+
+def _coerce_const_to(e: E.TExpr, ty: t.SqlType) -> Optional[E.TExpr]:
+    """If ``e`` is a Const convertible to ``ty``, return the converted
+    Const (constants fold through coercion, parse_coerce.c style)."""
+    if not isinstance(e, E.Const):
+        return None
+    try:
+        return E.Const(literal_to_physical(
+            _unphysical(e), ty), ty)
+    except AnalyzeError:
+        return None
+
+
+def _unphysical(c: E.Const) -> object:
+    """Recover a python-level value from a physical Const (for re-coercion)."""
+    if c.value is None:
+        return None
+    if c.type.id == t.TypeId.DECIMAL:
+        return c.value / c.type.decimal_factor  # type: ignore[operator]
+    return c.value
+
+
+def _cast(e: E.TExpr, ty: t.SqlType) -> E.TExpr:
+    if e.type == ty:
+        return e
+    folded = _coerce_const_to(e, ty)
+    if folded is not None:
+        return folded
+    return E.CastE(e, ty)
+
+
+def _common_input_type(lt: t.SqlType, rt: t.SqlType, op: str) -> t.SqlType:
+    if lt == rt:
+        return lt
+    if lt.is_numeric and rt.is_numeric:
+        return t.common_numeric_type(lt, rt)
+    # date/timestamp mixing: promote date to timestamp
+    ids = {lt.id, rt.id}
+    if ids == {t.TypeId.DATE, t.TypeId.TIMESTAMP}:
+        return t.TIMESTAMP
+    raise AnalyzeError(f"operator {op} has incompatible types {lt} and {rt}")
+
+
+class Analyzer:
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+        self.subplans: list[L.LogicalPlan] = []
+
+    # ------------------------------------------------------------------
+    # Statements
+    # ------------------------------------------------------------------
+    def _table(self, name: str):
+        try:
+            return self.catalog.get(name)
+        except ValueError as e:
+            raise AnalyzeError(str(e)) from None
+
+    def statement(self, stmt: A.Statement) -> L.StatementPlan:
+        if isinstance(stmt, A.Select):
+            root = self.select(stmt)
+        elif isinstance(stmt, A.Insert):
+            root = self._insert(stmt)
+        elif isinstance(stmt, A.Update):
+            root = self._update(stmt)
+        elif isinstance(stmt, A.Delete):
+            root = self._delete(stmt)
+        else:
+            raise AnalyzeError(f"cannot analyze {type(stmt).__name__}")
+        return L.StatementPlan(root, self.subplans)
+
+    # ------------------------------------------------------------------
+    # SELECT
+    # ------------------------------------------------------------------
+    def select(self, sel: A.Select) -> L.LogicalPlan:
+        if sel.set_ops:
+            return self._set_ops(sel)
+        return self._select_core(sel)
+
+    def _set_ops(self, sel: A.Select) -> L.LogicalPlan:
+        base = A.Select(
+            items=sel.items, from_clause=sel.from_clause, where=sel.where,
+            group_by=sel.group_by, having=sel.having, distinct=sel.distinct,
+        )
+        plan = self._select_core(base)
+        for op, branch_ast in sel.set_ops:
+            branch = self.select(branch_ast)
+            if len(branch.schema) != len(plan.schema):
+                raise AnalyzeError("each UNION query must have the same number of columns")
+            plan_c, branch_c = self._align_schemas(plan, branch)
+            if op in ("union", "union all"):
+                u = L.Union((plan_c, branch_c), plan_c.schema)
+                plan = u if op == "union all" else L.Distinct(u, u.schema)
+            elif op == "intersect":
+                plan = self._setop_join(plan_c, branch_c, "semi")
+            else:  # except
+                plan = self._setop_join(plan_c, branch_c, "anti")
+        plan = self._order_limit_over(plan, sel)
+        return plan
+
+    def _align_schemas(
+        self, a: L.LogicalPlan, b: L.LogicalPlan
+    ) -> tuple[L.LogicalPlan, L.LogicalPlan]:
+        """Coerce two set-op branches to a common schema."""
+        types = []
+        for ca, cb in zip(a.schema, b.schema):
+            types.append(ca.type if ca.type == cb.type else _common_input_type(ca.type, cb.type, "UNION"))
+
+        def project_to(p: L.LogicalPlan) -> L.LogicalPlan:
+            if all(c.type == ty for c, ty in zip(p.schema, types)):
+                return p
+            exprs = tuple(
+                _cast(E.Col(i, c.type, c.name), ty)
+                for i, (c, ty) in enumerate(zip(p.schema, types))
+            )
+            schema = tuple(
+                L.OutCol(c.name, ty, c.dict_id if ty.id == t.TypeId.TEXT else None)
+                for c, ty in zip(p.schema, types)
+            )
+            return L.Project(p, exprs, schema)
+
+        return project_to(a), project_to(b)
+
+    def _setop_join(self, left: L.LogicalPlan, right: L.LogicalPlan, jt: str) -> L.LogicalPlan:
+        keys_l = tuple(E.Col(i, c.type, c.name) for i, c in enumerate(left.schema))
+        keys_r = tuple(E.Col(i, c.type, c.name) for i, c in enumerate(right.schema))
+        d = L.Distinct(left, left.schema)
+        return L.Join(d, right, jt, keys_l, keys_r, None, d.schema)
+
+    def _select_core(self, sel: A.Select) -> L.LogicalPlan:
+        # FROM
+        if sel.from_clause is not None:
+            plan, scope = self._from(sel.from_clause)
+        else:
+            plan, scope = self._no_from(sel)
+        ctx = ExprContext(scope, self)
+
+        # WHERE — IN/EXISTS subquery conjuncts become semi/anti joins
+        # (the pull-up that PG does in pull_up_sublinks); the rest is a
+        # vectorized Filter.
+        if sel.where is not None:
+            plain: list[A.Expr] = []
+            for c in _split_and(sel.where):
+                if isinstance(c, A.InSubquery):
+                    plan = self._in_subquery_join(plan, scope, c)
+                elif isinstance(c, A.ExistsSubquery):
+                    # uncorrelated EXISTS -> scalar count subquery > 0
+                    counted = A.Select(
+                        items=[A.SelectItem(A.FuncCall("count", (), star=True))],
+                        from_clause=A.SubqueryRef(c.query, "__exists"),
+                    )
+                    cmp = A.BinOp("=" if c.negated else ">", A.ScalarSubquery(counted), A.Literal(0))
+                    plain.append(cmp)
+                else:
+                    plain.append(c)
+            if plain:
+                pred: Optional[E.TExpr] = None
+                for c in plain:
+                    te = _bool_type(self.expr(c, ctx))
+                    pred = te if pred is None else E.BinE("and", pred, te, t.BOOL)
+                assert pred is not None
+                plan = L.Filter(plan, pred, plan.schema)
+
+        has_aggs = any(
+            self._contains_agg(item.expr) for item in sel.items
+        ) or (sel.having is not None) or bool(sel.group_by)
+
+        order_hidden: list[E.TExpr] = []
+        if has_aggs:
+            plan, out_exprs, out_schema, gctx = self._grouped(sel, plan, ctx)
+            post_scope = scope_from_schema(plan.schema, None)
+        else:
+            out_exprs, out_schema = self._select_items(sel.items, ctx, scope)
+            gctx = None
+            post_scope = scope
+
+        # ORDER BY: resolve against output aliases/positions first, else
+        # against the pre-projection scope (hidden junk columns).
+        sort_keys: list[L.SortKey] = []
+        if sel.order_by:
+            for si in sel.order_by:
+                keyexpr = self._resolve_order_expr(
+                    si.expr, sel, out_exprs, out_schema, ctx, gctx, order_hidden, post_scope
+                )
+                sort_keys.append(L.SortKey(keyexpr, si.descending, si.nulls_first))
+
+        nvisible = len(out_exprs)
+        proj_exprs = tuple(out_exprs) + tuple(order_hidden)
+        proj_schema = tuple(out_schema) + tuple(
+            L.OutCol(f"__sort{i}", e.type, _expr_dict_id(e, plan.schema))
+            for i, e in enumerate(order_hidden)
+        )
+        plan = L.Project(plan, proj_exprs, proj_schema)
+
+        if sel.distinct:
+            if order_hidden:
+                raise AnalyzeError(
+                    "for SELECT DISTINCT, ORDER BY expressions must appear in select list"
+                )
+            plan = L.Distinct(plan, plan.schema)
+
+        if sort_keys:
+            plan = L.Sort(plan, tuple(sort_keys), plan.schema)
+        if order_hidden:
+            exprs = tuple(
+                E.Col(i, c.type, c.name) for i, c in enumerate(plan.schema[:nvisible])
+            )
+            plan = L.Project(plan, exprs, plan.schema[:nvisible])
+
+        plan = self._limit_over(plan, sel)
+        return plan
+
+    def _order_limit_over(self, plan: L.LogicalPlan, sel: A.Select) -> L.LogicalPlan:
+        """ORDER BY/LIMIT applied over a set-op result (output scope only)."""
+        if sel.order_by:
+            out_scope = scope_from_schema(plan.schema, None)
+            keys = []
+            for si in sel.order_by:
+                if isinstance(si.expr, A.Literal) and isinstance(si.expr.value, int):
+                    pos = si.expr.value
+                    if not 1 <= pos <= len(plan.schema):
+                        raise AnalyzeError(f"ORDER BY position {pos} is out of range")
+                    c = plan.schema[pos - 1]
+                    te: E.TExpr = E.Col(pos - 1, c.type, c.name)
+                else:
+                    te = self.expr(si.expr, ExprContext(out_scope, self))
+                keys.append(L.SortKey(te, si.descending, si.nulls_first))
+            plan = L.Sort(plan, tuple(keys), plan.schema)
+        return self._limit_over(plan, sel)
+
+    def _limit_over(self, plan: L.LogicalPlan, sel: A.Select) -> L.LogicalPlan:
+        if sel.limit is None and sel.offset is None:
+            return plan
+        limit = self._const_int(sel.limit) if sel.limit is not None else None
+        offset = self._const_int(sel.offset) if sel.offset is not None else 0
+        return L.Limit(plan, limit, offset, plan.schema)
+
+    def _const_int(self, e: A.Expr) -> int:
+        if isinstance(e, A.Literal) and isinstance(e.value, int):
+            return e.value
+        raise AnalyzeError("LIMIT/OFFSET must be an integer constant")
+
+    def _no_from(self, sel: A.Select) -> tuple[L.LogicalPlan, Scope]:
+        """SELECT without FROM: one-row ValuesScan."""
+        plan = L.ValuesScan(((),), ())
+        return plan, Scope([])
+
+    # ------------------------------------------------------------------
+    # FROM clause
+    # ------------------------------------------------------------------
+    def _from(self, ref: A.TableRef) -> tuple[L.LogicalPlan, Scope]:
+        if isinstance(ref, A.RelRef):
+            meta = self._table(ref.name)
+            qualifier = ref.alias or ref.name
+            schema = tuple(
+                L.OutCol(
+                    name, ty,
+                    f"{ref.name}.{name}" if ty.id == t.TypeId.TEXT else None,
+                )
+                for name, ty in meta.schema.items()
+            )
+            plan = L.Scan(ref.name, tuple(meta.schema.keys()), schema)
+            return plan, scope_from_schema(schema, qualifier)
+        if isinstance(ref, A.SubqueryRef):
+            sub = self.select(ref.query)
+            return sub, scope_from_schema(sub.schema, ref.alias)
+        if isinstance(ref, A.JoinRef):
+            return self._join(ref)
+        raise AnalyzeError(f"unsupported FROM item {type(ref).__name__}")
+
+    def _join(self, ref: A.JoinRef) -> tuple[L.LogicalPlan, Scope]:
+        lp, ls = self._from(ref.left)
+        rp, rs = self._from(ref.right)
+        scope = ls.concat(rs)
+        jt = ref.join_type
+        if jt == "cross":
+            plan = L.Join(lp, rp, "inner", (), (), None, scope.out_schema())
+            return plan, scope
+        left_keys: list[E.TExpr] = []
+        right_keys: list[E.TExpr] = []
+        residual: Optional[E.TExpr] = None
+        if ref.using:
+            for name in ref.using:
+                li, lc = ls.resolve(name, None)
+                ri, rc = rs.resolve(name, None)
+                ct = lc.type if lc.type == rc.type else _common_input_type(lc.type, rc.type, "USING")
+                left_keys.append(_cast(E.Col(li, lc.type, name), ct))
+                right_keys.append(_cast(E.Col(ri, rc.type, name), ct))
+        elif ref.condition is not None:
+            conjuncts = _split_and(ref.condition)
+            nleft = len(ls.cols)
+            for c in conjuncts:
+                pair = self._equi_key(c, ls, rs)
+                if pair is not None:
+                    left_keys.append(pair[0])
+                    right_keys.append(pair[1])
+                else:
+                    ctx = ExprContext(scope, self)
+                    te = _bool_type(self.expr(c, ctx))
+                    residual = te if residual is None else E.BinE("and", residual, te, t.BOOL)
+            if not left_keys:
+                # pure theta-join: run as cross join + residual filter
+                pass
+            del nleft
+        plan = L.Join(lp, rp, jt, tuple(left_keys), tuple(right_keys), residual, scope.out_schema())
+        return plan, scope
+
+    def _equi_key(
+        self, cond: A.Expr, ls: Scope, rs: Scope
+    ) -> Optional[tuple[E.TExpr, E.TExpr]]:
+        """If cond is `left_expr = right_expr` with sides cleanly split
+        across the two inputs, return the coerced key pair."""
+        if not (isinstance(cond, A.BinOp) and cond.op == "="):
+            return None
+        for a, b in ((cond.left, cond.right), (cond.right, cond.left)):
+            try:
+                te_l = self.expr(a, ExprContext(ls, self))
+                te_r = self.expr(b, ExprContext(rs, self))
+            except AnalyzeError:
+                continue
+            ct = (
+                te_l.type
+                if te_l.type == te_r.type
+                else _common_input_type(te_l.type, te_r.type, "=")
+            )
+            return _cast(te_l, ct), _cast(te_r, ct)
+        return None
+
+    # ------------------------------------------------------------------
+    # Select items / aggregation
+    # ------------------------------------------------------------------
+    def _select_items(
+        self, items: list[A.SelectItem], ctx: ExprContext, scope: Scope
+    ) -> tuple[list[E.TExpr], list[L.OutCol]]:
+        out_exprs: list[E.TExpr] = []
+        out_schema: list[L.OutCol] = []
+        for item in items:
+            if isinstance(item.expr, A.Star):
+                for i, c in enumerate(scope.cols):
+                    if item.expr.table is not None and c.qualifier != item.expr.table:
+                        continue
+                    out_exprs.append(E.Col(i, c.type, c.name))
+                    out_schema.append(L.OutCol(c.name, c.type, c.dict_id))
+                if not out_exprs:
+                    raise AnalyzeError("SELECT * with no columns in scope")
+                continue
+            te = self.expr(item.expr, ctx)
+            name = item.alias or _default_name(item.expr)
+            out_exprs.append(te)
+            out_schema.append(L.OutCol(name, te.type, _texpr_dict_id(te, scope)))
+        return out_exprs, out_schema
+
+    def _grouped(
+        self, sel: A.Select, plan: L.LogicalPlan, ctx: ExprContext
+    ) -> tuple[L.LogicalPlan, list[E.TExpr], list[L.OutCol], GroupedContext]:
+        group_texprs = [self.expr(g, ctx) for g in sel.group_by]
+        gctx = GroupedContext(ctx, group_texprs)
+        agg_ctx = ExprContext(ctx.scope, self, allow_aggs=True, grouped=gctx)
+
+        out_exprs: list[E.TExpr] = []
+        out_schema: list[L.OutCol] = []
+        for item in sel.items:
+            if isinstance(item.expr, A.Star):
+                raise AnalyzeError("SELECT * is not allowed with GROUP BY")
+            te = self.expr(item.expr, agg_ctx)
+            name = item.alias or _default_name(item.expr)
+            out_exprs.append(te)
+            out_schema.append(L.OutCol(name, te.type, _texpr_dict_id_grouped(te, gctx)))
+        having_te = None
+        if sel.having is not None:
+            having_te = _bool_type(self.expr(sel.having, agg_ctx))
+
+        agg_schema = tuple(
+            [
+                L.OutCol(f"__g{i}", g.type, _texpr_dict_id(g, ctx.scope))
+                for i, g in enumerate(group_texprs)
+            ]
+            + [L.OutCol(f"__a{i}", a.type) for i, a in enumerate(gctx.aggs)]
+        )
+        agg_plan = L.Aggregate(
+            plan, tuple(group_texprs), tuple(gctx.aggs), agg_schema
+        )
+        result: L.LogicalPlan = agg_plan
+        if having_te is not None:
+            result = L.Filter(result, having_te, result.schema)
+        return result, out_exprs, out_schema, gctx
+
+    def _contains_agg(self, e: A.Expr) -> bool:
+        if isinstance(e, A.FuncCall) and e.name in AGG_FUNCS:
+            return True
+        for attr in ("left", "right", "operand", "low", "high", "default"):
+            child = getattr(e, attr, None)
+            if isinstance(child, A.Expr) and self._contains_agg(child):
+                return True
+        if isinstance(e, A.FuncCall):
+            return any(self._contains_agg(a) for a in e.args)
+        if isinstance(e, A.CaseExpr):
+            return any(
+                self._contains_agg(c) or self._contains_agg(v) for c, v in e.whens
+            ) or (e.default is not None and self._contains_agg(e.default))
+        if isinstance(e, A.InList):
+            return any(self._contains_agg(i) for i in e.items)
+        return False
+
+    def _resolve_order_expr(
+        self,
+        e: A.Expr,
+        sel: A.Select,
+        out_exprs: list[E.TExpr],
+        out_schema: list[L.OutCol],
+        ctx: ExprContext,
+        gctx: Optional[GroupedContext],
+        hidden: list[E.TExpr],
+        post_scope: Scope,
+    ) -> E.TExpr:
+        # 1. ORDER BY <position>
+        if isinstance(e, A.Literal) and isinstance(e.value, int):
+            pos = e.value
+            if not 1 <= pos <= len(out_exprs):
+                raise AnalyzeError(f"ORDER BY position {pos} is out of range")
+            c = out_schema[pos - 1]
+            return E.Col(pos - 1, c.type, c.name)
+        # 2. ORDER BY <output alias / output column name>
+        if isinstance(e, A.ColumnRef) and e.table is None:
+            for i, c in enumerate(out_schema):
+                if c.name == e.name:
+                    return E.Col(i, c.type, c.name)
+        # 3. Arbitrary expression over the input — matched against an
+        #    existing output expr if identical, else appended as hidden col.
+        ectx = (
+            ExprContext(ctx.scope, self, allow_aggs=True, grouped=gctx)
+            if gctx is not None
+            else ctx
+        )
+        te = self.expr(e, ectx)
+        for i, oe in enumerate(out_exprs):
+            if oe.key() == te.key():
+                return E.Col(i, out_schema[i].type, out_schema[i].name)
+        for j, he in enumerate(hidden):
+            if he.key() == te.key():
+                return E.Col(len(out_exprs) + j, he.type)
+        hidden.append(te)
+        return E.Col(len(out_exprs) + len(hidden) - 1, te.type)
+
+    # ------------------------------------------------------------------
+    # DML
+    # ------------------------------------------------------------------
+    def _insert(self, stmt: A.Insert) -> L.LogicalPlan:
+        meta = self._table(stmt.table)
+        columns = stmt.columns or list(meta.schema.keys())
+        for c in columns:
+            meta.column_type(c)  # existence check
+        target_types = [meta.schema[c] for c in columns]
+        if stmt.query is not None:
+            src = self.select(stmt.query)
+            if len(src.schema) != len(columns):
+                raise AnalyzeError("INSERT has a different number of columns than expressions")
+            exprs = tuple(
+                _cast(E.Col(i, c.type, c.name), ty)
+                for i, (c, ty) in enumerate(zip(src.schema, target_types))
+            )
+            schema = tuple(L.OutCol(c, ty) for c, ty in zip(columns, target_types))
+            src = L.Project(src, exprs, schema)
+        else:
+            rows = []
+            for row in stmt.values:
+                if len(row) != len(columns):
+                    raise AnalyzeError("INSERT has a different number of columns than values")
+                trow = []
+                for v, ty in zip(row, target_types):
+                    te = self.expr(v, ExprContext(Scope([]), self))
+                    trow.append(_cast(te, ty))
+                rows.append(tuple(trow))
+            schema = tuple(L.OutCol(c, ty) for c, ty in zip(columns, target_types))
+            src = L.ValuesScan(tuple(rows), schema)
+        return L.InsertPlan(stmt.table, src, tuple(columns))
+
+    def _table_scope(self, table: str) -> Scope:
+        meta = self._table(table)
+        return Scope(
+            [
+                ScopeCol(
+                    table, name, ty,
+                    f"{table}.{name}" if ty.id == t.TypeId.TEXT else None,
+                )
+                for name, ty in meta.schema.items()
+            ]
+        )
+
+    def _update(self, stmt: A.Update) -> L.LogicalPlan:
+        meta = self._table(stmt.table)
+        scope = self._table_scope(stmt.table)
+        ctx = ExprContext(scope, self)
+        pred = _bool_type(self.expr(stmt.where, ctx)) if stmt.where is not None else None
+        assignments = []
+        for name, ve in stmt.assignments:
+            ty = meta.column_type(name)
+            assignments.append((name, _cast(self.expr(ve, ctx), ty)))
+        return L.UpdatePlan(stmt.table, pred, tuple(assignments))
+
+    def _delete(self, stmt: A.Delete) -> L.LogicalPlan:
+        scope = self._table_scope(stmt.table)
+        ctx = ExprContext(scope, self)
+        pred = _bool_type(self.expr(stmt.where, ctx)) if stmt.where is not None else None
+        return L.DeletePlan(stmt.table, pred)
+
+    # ==================================================================
+    # Expressions
+    # ==================================================================
+    def expr(self, e: A.Expr, ctx: ExprContext) -> E.TExpr:
+        # Grouped context: whole-expression match against GROUP BY items
+        if ctx.grouped is not None and not isinstance(e, A.Literal):
+            g = ctx.grouped
+            try:
+                te = self.expr(e, g.input_ctx)
+            except AnalyzeError:
+                te = None
+            if te is not None and te.key() in g.group_keys:
+                i = g.group_keys[te.key()]
+                return E.Col(i, te.type)
+            if isinstance(te, E.Const):
+                return te
+        result = self._expr_inner(e, ctx)
+        if isinstance(result, _Interval):
+            raise AnalyzeError("interval value not allowed here")
+        return result
+
+    def _expr_inner(self, e: A.Expr, ctx: ExprContext):
+        if isinstance(e, A.Literal):
+            return self._literal(e.value)
+        if isinstance(e, A.ColumnRef):
+            if ctx.grouped is not None:
+                raise AnalyzeError(
+                    f'column "{e.name}" must appear in the GROUP BY clause '
+                    "or be used in an aggregate function"
+                )
+            i, c = ctx.scope.resolve(e.name, e.table)
+            return E.Col(i, c.type, c.name)
+        if isinstance(e, A.Param):
+            raise AnalyzeError("parameters require a prepared statement (unbound $n)")
+        if isinstance(e, A.BinOp):
+            return self._binop(e, ctx)
+        if isinstance(e, A.UnaryOp):
+            return self._unary(e, ctx)
+        if isinstance(e, A.IsNull):
+            return E.IsNullE(self.expr(e.operand, ctx), e.negated)
+        if isinstance(e, A.Between):
+            operand = self.expr(e.operand, ctx)
+            low = self.expr(e.low, ctx)
+            high = self.expr(e.high, ctx)
+            ge = self._make_cmp(">=", operand, low)
+            le = self._make_cmp("<=", operand, high)
+            both = E.BinE("and", ge, le, t.BOOL)
+            return E.UnaryE("not", both, t.BOOL) if e.negated else both
+        if isinstance(e, A.InList):
+            operand = self.expr(e.operand, ctx)
+            items = []
+            for item in e.items:
+                it = self.expr(item, ctx)
+                if not isinstance(it, E.Const):
+                    # general fallback: OR of equalities
+                    ors: Optional[E.TExpr] = None
+                    for item2 in e.items:
+                        eq = self._make_cmp("=", operand, self.expr(item2, ctx))
+                        ors = eq if ors is None else E.BinE("or", ors, eq, t.BOOL)
+                    assert ors is not None
+                    return E.UnaryE("not", ors, t.BOOL) if e.negated else ors
+                coerced = _coerce_const_to(it, operand.type)
+                if coerced is None:
+                    raise AnalyzeError(f"IN list item {it} does not match {operand.type}")
+                items.append(coerced)
+            return E.InListE(operand, tuple(items), e.negated)
+        if isinstance(e, A.InSubquery) or isinstance(e, A.ExistsSubquery):
+            raise AnalyzeError(
+                "IN/EXISTS subqueries are only supported in WHERE as semi-joins"
+            )
+        if isinstance(e, A.ScalarSubquery):
+            sub = Analyzer(self.catalog)
+            sub.subplans = self.subplans  # share subplan list
+            plan = sub.select(e.query)
+            if len(plan.schema) != 1:
+                raise AnalyzeError("scalar subquery must return one column")
+            self.subplans.append(plan)
+            return E.SubqueryParam(len(self.subplans) - 1, plan.schema[0].type)
+        if isinstance(e, A.FuncCall):
+            return self._func(e, ctx)
+        if isinstance(e, A.Cast):
+            return self._cast_expr(e, ctx)
+        if isinstance(e, A.CaseExpr):
+            return self._case(e, ctx)
+        if isinstance(e, A.Extract):
+            operand = self.expr(e.operand, ctx)
+            if operand.type.id not in (t.TypeId.DATE, t.TypeId.TIMESTAMP):
+                raise AnalyzeError("EXTRACT requires a date/timestamp")
+            fld = e.field_name.lower()
+            if fld not in ("year", "month", "day", "quarter", "dow", "doy"):
+                raise AnalyzeError(f"unsupported EXTRACT field {fld}")
+            return E.FuncE(f"extract_{fld}", (operand,), t.INT4)
+        raise AnalyzeError(f"unsupported expression {type(e).__name__}")
+
+    def _literal(self, v: object) -> E.TExpr:
+        if v is None:
+            return E.Const(None, t.INT4)  # NULL: type refined by context
+        if isinstance(v, bool):
+            return E.Const(v, t.BOOL)
+        if isinstance(v, int):
+            return E.Const(v, t.INT4 if -(2**31) <= v < 2**31 else t.INT8)
+        if isinstance(v, float):
+            # numeric literal: analyze as decimal to keep exactness
+            s = f"{v}"
+            if "e" in s or "E" in s:
+                return E.Const(v, t.FLOAT8)
+            scale = len(s.split(".")[1]) if "." in s else 0
+            ty = t.decimal(18, scale)
+            return E.Const(round(v * ty.decimal_factor), ty)
+        if isinstance(v, str):
+            return E.Const(v, t.TEXT)
+        raise AnalyzeError(f"unsupported literal {v!r}")
+
+    def _binop(self, e: A.BinOp, ctx: ExprContext) -> E.TExpr:
+        op = e.op
+        if op in ("and", "or"):
+            l = _bool_type(self.expr(e.left, ctx))
+            r = _bool_type(self.expr(e.right, ctx))
+            return E.BinE(op, l, r, t.BOOL)
+        if op in ("like", "ilike"):
+            operand = self.expr(e.left, ctx)
+            pat = self.expr(e.right, ctx)
+            if operand.type.id != t.TypeId.TEXT:
+                raise AnalyzeError("LIKE requires a text operand")
+            if not (isinstance(pat, E.Const) and isinstance(pat.value, str)):
+                raise AnalyzeError("LIKE pattern must be a string constant")
+            return E.LikeE(operand, pat.value, op == "ilike", False)
+        if op == "||":
+            raise AnalyzeError("string concatenation must be computed host-side (unsupported)")
+        # interval arithmetic
+        li = self._maybe_interval(e.left, ctx)
+        ri = self._maybe_interval(e.right, ctx)
+        if isinstance(li, _Interval) or isinstance(ri, _Interval):
+            return self._interval_arith(op, e, li, ri, ctx)
+        l = self.expr(e.left, ctx)
+        r = self.expr(e.right, ctx)
+        if op in _CMP:
+            return self._make_cmp(op, l, r)
+        if op in _ARITH:
+            return self._make_arith(op, l, r)
+        if op in ("is distinct from", "is not distinct from"):
+            eq = E.FuncE("null_safe_eq", (l, r), t.BOOL)
+            return E.UnaryE("not", eq, t.BOOL) if op == "is distinct from" else eq
+        raise AnalyzeError(f"unsupported operator {op}")
+
+    def _maybe_interval(self, e: A.Expr, ctx: ExprContext):
+        if isinstance(e, A.FuncCall) and e.name == "interval" and len(e.args) == 1:
+            arg = e.args[0]
+            if isinstance(arg, A.Literal) and isinstance(arg.value, str):
+                return _parse_interval(arg.value)
+        return None
+
+    def _interval_arith(self, op, e: A.BinOp, li, ri, ctx: ExprContext) -> E.TExpr:
+        if op not in ("+", "-"):
+            raise AnalyzeError("intervals support only + and -")
+        if isinstance(li, _Interval) and isinstance(ri, _Interval):
+            raise AnalyzeError("interval +/- interval is unsupported")
+        if isinstance(li, _Interval):
+            if op == "-":
+                raise AnalyzeError("interval - date is not defined")
+            date_side, iv, sign = self.expr(e.right, ctx), li, 1
+        else:
+            date_side, iv, sign = self.expr(e.left, ctx), ri, (1 if op == "+" else -1)
+        if date_side.type.id == t.TypeId.DATE:
+            if isinstance(date_side, E.Const) and date_side.value is not None:
+                return E.Const(
+                    _add_interval_to_days(int(date_side.value), iv, sign), t.DATE
+                )
+            if iv.months == 0 and iv.usecs == 0:
+                return E.FuncE(
+                    "date_add_days", (date_side, E.Const(sign * iv.days, t.INT4)), t.DATE
+                )
+            raise AnalyzeError("month-granularity interval needs a constant date operand")
+        if date_side.type.id == t.TypeId.TIMESTAMP:
+            if iv.months == 0:
+                delta = sign * (iv.days * 86_400_000_000 + iv.usecs)
+                return E.FuncE(
+                    "ts_add_usecs", (date_side, E.Const(delta, t.INT8)), t.TIMESTAMP
+                )
+            if isinstance(date_side, E.Const) and date_side.value is not None:
+                us = int(date_side.value)
+                days = us // 86_400_000_000
+                rem = us % 86_400_000_000
+                days2 = _add_interval_to_days(days, iv, sign)
+                rem2 = rem + sign * iv.usecs
+                return E.Const(days2 * 86_400_000_000 + rem2, t.TIMESTAMP)
+            raise AnalyzeError("month-granularity interval needs a constant timestamp")
+        raise AnalyzeError("interval arithmetic requires a date/timestamp operand")
+
+    def _make_cmp(self, op: str, l: E.TExpr, r: E.TExpr) -> E.TExpr:
+        # NULL literal propagates type from the other side
+        if isinstance(l, E.Const) and l.value is None:
+            l = E.Const(None, r.type)
+        if isinstance(r, E.Const) and r.value is None:
+            r = E.Const(None, l.type)
+        lt, rt = l.type, r.type
+        if lt.id == t.TypeId.TEXT and rt.id == t.TypeId.TEXT:
+            return E.BinE(op, l, r, t.BOOL)
+        if lt.id == t.TypeId.TEXT and isinstance(l, E.Const):
+            coerced = _coerce_const_to(l, rt)
+            if coerced is not None:
+                return self._make_cmp(op, coerced, r)
+        if rt.id == t.TypeId.TEXT and isinstance(r, E.Const):
+            coerced = _coerce_const_to(r, lt)
+            if coerced is not None:
+                return self._make_cmp(op, l, coerced)
+        if lt == rt:
+            return E.BinE(op, l, r, t.BOOL)
+        ct = _common_input_type(lt, rt, op)
+        return E.BinE(op, _cast(l, ct), _cast(r, ct), t.BOOL)
+
+    def _make_arith(self, op: str, l: E.TExpr, r: E.TExpr) -> E.TExpr:
+        if not (l.type.is_numeric and r.type.is_numeric):
+            # date +/- int = date
+            if (
+                l.type.id == t.TypeId.DATE
+                and r.type.is_integer
+                and op in ("+", "-")
+            ):
+                neg = E.UnaryE("-", _cast(r, t.INT4), t.INT4) if op == "-" else _cast(r, t.INT4)
+                return E.FuncE("date_add_days", (l, neg), t.DATE)
+            if l.type.id == t.TypeId.DATE and r.type.id == t.TypeId.DATE and op == "-":
+                return E.BinE("-", E.CastE(l, t.INT4), E.CastE(r, t.INT4), t.INT4)
+            raise AnalyzeError(f"operator {op} has non-numeric operand {l.type} / {r.type}")
+        lt, rt = l.type, r.type
+        # decimal arithmetic keeps exact integer representation
+        if t.TypeId.DECIMAL in (lt.id, rt.id) and not (
+            lt.id in (t.TypeId.FLOAT4, t.TypeId.FLOAT8)
+            or rt.id in (t.TypeId.FLOAT4, t.TypeId.FLOAT8)
+        ):
+            ld = lt if lt.id == t.TypeId.DECIMAL else t.decimal(18, 0)
+            rd = rt if rt.id == t.TypeId.DECIMAL else t.decimal(18, 0)
+            l2 = _cast(l, ld) if lt.id != t.TypeId.DECIMAL else l
+            r2 = _cast(r, rd) if rt.id != t.TypeId.DECIMAL else r
+            if op in ("+", "-"):
+                scale = max(ld.scale, rd.scale)
+                ty = t.decimal(38, scale)
+                return E.BinE(op, _cast(l2, ty), _cast(r2, ty), ty)
+            if op == "*":
+                ty = t.decimal(38, ld.scale + rd.scale)
+                return E.BinE("*", l2, r2, ty)
+            if op == "/":
+                # decimal division produces float8 (documented delta from
+                # PG numeric division)
+                return E.BinE("/", _cast(l2, t.FLOAT8), _cast(r2, t.FLOAT8), t.FLOAT8)
+            if op == "%":
+                ty = t.decimal(38, max(ld.scale, rd.scale))
+                return E.BinE("%", _cast(l2, ty), _cast(r2, ty), ty)
+        ct = t.common_numeric_type(lt, rt)
+        if op == "/" and ct.is_integer:
+            # integer division truncates, like PG int4div
+            return E.BinE("//", _cast(l, ct), _cast(r, ct), ct)
+        out_ty = ct
+        return E.BinE(op, _cast(l, ct), _cast(r, ct), out_ty)
+
+    def _unary(self, e: A.UnaryOp, ctx: ExprContext) -> E.TExpr:
+        operand = self.expr(e.operand, ctx)
+        if e.op == "not":
+            return E.UnaryE("not", _bool_type(operand), t.BOOL)
+        if e.op == "-":
+            if not operand.type.is_numeric:
+                raise AnalyzeError("unary minus requires numeric operand")
+            if isinstance(operand, E.Const) and operand.value is not None:
+                return E.Const(-operand.value, operand.type)  # type: ignore[operator]
+            return E.UnaryE("-", operand, operand.type)
+        raise AnalyzeError(f"unsupported unary {e.op}")
+
+    def _func(self, e: A.FuncCall, ctx: ExprContext) -> E.TExpr:
+        name = e.name
+        if name in AGG_FUNCS:
+            return self._agg_call(e, ctx)
+        args = tuple(self.expr(a, ctx) for a in e.args)
+        return self._scalar_func(name, args)
+
+    def _scalar_func(self, name: str, args: tuple[E.TExpr, ...]) -> E.TExpr:
+        # Oracle-compat aliases (src/backend/oracle in the reference)
+        if name == "nvl":
+            name = "coalesce"
+        if name == "abs":
+            _need(args, 1, name)
+            return E.FuncE("abs", args, args[0].type)
+        if name in ("floor", "ceil", "ceiling"):
+            _need(args, 1, name)
+            n = "ceil" if name == "ceiling" else name
+            return E.FuncE(n, (_cast(args[0], t.FLOAT8),), t.FLOAT8)
+        if name == "round":
+            if len(args) == 1:
+                return E.FuncE("round", (_cast(args[0], t.FLOAT8), E.Const(0, t.INT4)), t.FLOAT8)
+            if args[0].type.id == t.TypeId.DECIMAL and isinstance(args[1], E.Const):
+                return E.FuncE("round_dec", args, args[0].type)
+            return E.FuncE("round", (_cast(args[0], t.FLOAT8), args[1]), t.FLOAT8)
+        if name == "sqrt":
+            _need(args, 1, name)
+            return E.FuncE("sqrt", (_cast(args[0], t.FLOAT8),), t.FLOAT8)
+        if name == "power" or name == "pow":
+            _need(args, 2, name)
+            return E.FuncE(
+                "power", (_cast(args[0], t.FLOAT8), _cast(args[1], t.FLOAT8)), t.FLOAT8
+            )
+        if name == "mod":
+            _need(args, 2, name)
+            return self._make_arith("%", args[0], args[1])
+        if name == "coalesce":
+            if not args:
+                raise AnalyzeError("coalesce requires arguments")
+            ty = args[0].type
+            for a in args[1:]:
+                if a.type != ty:
+                    if a.type.is_numeric and ty.is_numeric:
+                        ty = t.common_numeric_type(ty, a.type)
+                    elif isinstance(a, E.Const) and a.value is None:
+                        continue
+                    else:
+                        raise AnalyzeError("coalesce arguments must share a type")
+            cast_args = tuple(_cast(a, ty) for a in args)
+            return E.FuncE("coalesce", cast_args, ty)
+        if name == "nullif":
+            _need(args, 2, name)
+            return E.FuncE("nullif", args, args[0].type)
+        if name == "greatest" or name == "least":
+            ty = args[0].type
+            for a in args[1:]:
+                ty = t.common_numeric_type(ty, a.type) if a.type != ty else ty
+            return E.FuncE(name, tuple(_cast(a, ty) for a in args), ty)
+        if name in ("length", "char_length"):
+            _need(args, 1, name)
+            if args[0].type.id != t.TypeId.TEXT:
+                raise AnalyzeError("length requires text")
+            return E.FuncE("length", args, t.INT4)
+        if name in ("upper", "lower", "substr", "substring", "trim", "ltrim", "rtrim", "replace"):
+            # host-evaluated dictionary transforms
+            if args[0].type.id != t.TypeId.TEXT:
+                raise AnalyzeError(f"{name} requires text")
+            return E.FuncE(name, args, t.TEXT)
+        if name == "date_trunc":
+            _need(args, 2, name)
+            if not (isinstance(args[0], E.Const) and isinstance(args[0].value, str)):
+                raise AnalyzeError("date_trunc unit must be a string constant")
+            return E.FuncE("date_trunc", args, args[1].type)
+        if name == "now" or name == "current_timestamp":
+            return E.FuncE("now", (), t.TIMESTAMP)
+        if name == "interval":
+            raise AnalyzeError("interval only valid in +/- arithmetic")
+        raise AnalyzeError(f"unknown function {name}")
+
+    def _agg_call(self, e: A.FuncCall, ctx: ExprContext) -> E.TExpr:
+        if ctx.grouped is None:
+            raise AnalyzeError(
+                f"aggregate function {e.name}() not allowed here"
+            )
+        g = ctx.grouped
+        if e.star:
+            if e.name != "count":
+                raise AnalyzeError(f"{e.name}(*) is not defined")
+            return g.agg_col(E.AggCall("count", None, False, t.INT8))
+        _need_ast(e.args, 1, e.name)
+        arg = self.expr(e.args[0], g.input_ctx)
+        name = e.name
+        if name == "count":
+            return g.agg_col(E.AggCall("count", arg, e.distinct, t.INT8))
+        if name == "sum":
+            at = arg.type
+            if at.is_integer:
+                rty = t.INT8
+            elif at.id == t.TypeId.DECIMAL:
+                rty = t.decimal(38, at.scale)
+            elif at.id in (t.TypeId.FLOAT4, t.TypeId.FLOAT8):
+                rty = t.FLOAT8
+            else:
+                raise AnalyzeError(f"sum over {at} is not defined")
+            return g.agg_col(E.AggCall("sum", arg, e.distinct, rty))
+        if name == "avg":
+            if not arg.type.is_numeric:
+                raise AnalyzeError(f"avg over {arg.type} is not defined")
+            return g.agg_col(E.AggCall("avg", arg, e.distinct, t.FLOAT8))
+        if name in ("min", "max"):
+            return g.agg_col(E.AggCall(name, arg, False, arg.type))
+        raise AnalyzeError(f"unknown aggregate {name}")
+
+    def _cast_expr(self, e: A.Cast, ctx: ExprContext) -> E.TExpr:
+        ty = t.type_from_name(e.type_name, e.type_args)
+        operand = self.expr(e.operand, ctx)
+        return _cast(operand, ty)
+
+    def _case(self, e: A.CaseExpr, ctx: ExprContext) -> E.TExpr:
+        whens = []
+        for cond_ast, val_ast in e.whens:
+            if e.operand is not None:
+                cond = self._make_cmp(
+                    "=", self.expr(e.operand, ctx), self.expr(cond_ast, ctx)
+                )
+            else:
+                cond = _bool_type(self.expr(cond_ast, ctx))
+            whens.append((cond, self.expr(val_ast, ctx)))
+        default = self.expr(e.default, ctx) if e.default is not None else None
+        # result type: common across branches
+        vals = [v for _, v in whens] + ([default] if default is not None else [])
+        ty = vals[0].type
+        for v in vals[1:]:
+            if v.type != ty:
+                if v.type.is_numeric and ty.is_numeric:
+                    ty = t.common_numeric_type(ty, v.type)
+                elif isinstance(v, E.Const) and v.value is None:
+                    continue
+                else:
+                    raise AnalyzeError("CASE branches must share a type")
+        whens2 = tuple((c, _cast(v, ty)) for c, v in whens)
+        default2 = _cast(default, ty) if default is not None else None
+        return E.CaseE(whens2, default2, ty)
+
+    # ------------------------------------------------------------------
+    # WHERE-clause subquery rewrites (semi/anti joins)
+    # ------------------------------------------------------------------
+    def _in_subquery_join(
+        self, plan: L.LogicalPlan, scope: Scope, c: A.InSubquery
+    ) -> L.LogicalPlan:
+        sub = self.select(c.query)
+        if len(sub.schema) != 1:
+            raise AnalyzeError("IN subquery must return exactly one column")
+        lk = self.expr(c.operand, ExprContext(scope, self))
+        rk: E.TExpr = E.Col(0, sub.schema[0].type, sub.schema[0].name)
+        if lk.type != rk.type:
+            ct = _common_input_type(lk.type, rk.type, "IN")
+            lk, rk = _cast(lk, ct), _cast(rk, ct)
+        jt = "anti" if c.negated else "semi"
+        return L.Join(plan, sub, jt, (lk,), (rk,), None, plan.schema)
+
+
+def _split_and(e: A.Expr) -> list[A.Expr]:
+    if isinstance(e, A.BinOp) and e.op == "and":
+        return _split_and(e.left) + _split_and(e.right)
+    return [e]
+
+
+def _need(args, n: int, name: str) -> None:
+    if len(args) != n:
+        raise AnalyzeError(f"{name} requires {n} argument(s)")
+
+
+def _need_ast(args, n: int, name: str) -> None:
+    if len(args) != n:
+        raise AnalyzeError(f"{name} requires {n} argument(s)")
+
+
+def _default_name(e: A.Expr) -> str:
+    if isinstance(e, A.ColumnRef):
+        return e.name
+    if isinstance(e, A.FuncCall):
+        return e.name
+    if isinstance(e, A.Extract):
+        return "extract"
+    if isinstance(e, A.Cast):
+        return _default_name(e.operand)
+    return "?column?"
+
+
+def _texpr_dict_id(te: E.TExpr, scope: Scope) -> Optional[str]:
+    if te.type.id != t.TypeId.TEXT:
+        return None
+    if isinstance(te, E.Col) and te.index < len(scope.cols):
+        return scope.cols[te.index].dict_id
+    return None
+
+
+def _texpr_dict_id_grouped(te: E.TExpr, gctx: GroupedContext) -> Optional[str]:
+    if te.type.id != t.TypeId.TEXT:
+        return None
+    if isinstance(te, E.Col) and te.index < len(gctx.group_texprs):
+        inner = gctx.group_texprs[te.index]
+        return _texpr_dict_id(inner, gctx.input_ctx.scope)
+    return None
+
+
+def _expr_dict_id(te: E.TExpr, schema: tuple[L.OutCol, ...]) -> Optional[str]:
+    if te.type.id != t.TypeId.TEXT:
+        return None
+    if isinstance(te, E.Col) and te.index < len(schema):
+        return schema[te.index].dict_id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Public helpers
+# ---------------------------------------------------------------------------
+
+def analyze_statement(stmt: A.Statement, catalog: Catalog) -> L.StatementPlan:
+    return Analyzer(catalog).statement(stmt)
+
+
+def analyze_select(sql_or_ast, catalog: Catalog) -> L.StatementPlan:
+    if isinstance(sql_or_ast, str):
+        from opentenbase_tpu.sql.parser import parse_one
+
+        sql_or_ast = parse_one(sql_or_ast)
+    return Analyzer(catalog).statement(sql_or_ast)

@@ -1,0 +1,223 @@
+"""Logical plan optimization passes.
+
+The slice of src/backend/optimizer we need for a columnar engine where
+scans dominate: projection (column) pruning so Scans only materialize
+referenced columns — the columnar equivalent of PG's physical-tlist
+optimization (use_physical_tlist, createplan.c). Cost-based join ordering
+is left to the statement author for now (joins execute in FROM order).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from opentenbase_tpu.plan import logical as L
+from opentenbase_tpu.plan import texpr as E
+
+
+def prune_columns(plan: L.StatementPlan) -> L.StatementPlan:
+    root = _prune(plan.root, None)
+    subplans = [_prune(s, None) for s in plan.subplans]
+    return L.StatementPlan(root, subplans)
+
+
+def _remap_expr(e: E.TExpr, mapping: dict[int, int]) -> E.TExpr:
+    if isinstance(e, E.Col):
+        return E.Col(mapping[e.index], e.type, e.name)
+    if isinstance(e, E.BinE):
+        return E.BinE(e.op, _remap_expr(e.left, mapping), _remap_expr(e.right, mapping), e.type)
+    if isinstance(e, E.UnaryE):
+        return E.UnaryE(e.op, _remap_expr(e.operand, mapping), e.type)
+    if isinstance(e, E.FuncE):
+        return E.FuncE(e.name, tuple(_remap_expr(a, mapping) for a in e.args), e.type)
+    if isinstance(e, E.CaseE):
+        whens = tuple(
+            (_remap_expr(c, mapping), _remap_expr(v, mapping)) for c, v in e.whens
+        )
+        default = _remap_expr(e.default, mapping) if e.default is not None else None
+        return E.CaseE(whens, default, e.type)
+    if isinstance(e, E.CastE):
+        return E.CastE(_remap_expr(e.operand, mapping), e.type)
+    if isinstance(e, E.IsNullE):
+        return E.IsNullE(_remap_expr(e.operand, mapping), e.negated)
+    if isinstance(e, E.InListE):
+        return E.InListE(_remap_expr(e.operand, mapping), e.items, e.negated)
+    if isinstance(e, E.LikeE):
+        return E.LikeE(_remap_expr(e.operand, mapping), e.pattern, e.ilike, e.negated)
+    return e  # Const, SubqueryParam
+
+
+def _used_cols(e: E.TExpr, acc: set[int]) -> None:
+    for n in E.walk(e):
+        if isinstance(n, E.Col):
+            acc.add(n.index)
+
+
+def _prune(plan: L.LogicalPlan, required: Optional[set[int]]):
+    """Rewrite ``plan`` so it outputs only ``required`` columns (None = all),
+    pruning unused Scan columns underneath. Returns (new_plan, mapping)
+    where mapping maps old output index -> new output index."""
+    new_plan, mapping = _prune_node(plan, required)
+    return new_plan if required is None else new_plan
+
+
+def _identity(n: int) -> dict[int, int]:
+    return {i: i for i in range(n)}
+
+
+def _prune_node(plan: L.LogicalPlan, required: Optional[set[int]]):
+    n_out = len(plan.schema)
+    req = set(range(n_out)) if required is None else set(required)
+
+    if isinstance(plan, L.Scan):
+        keep = sorted(req)
+        if len(keep) == n_out:
+            return plan, _identity(n_out)
+        if not keep:
+            keep = [0] if n_out else []  # keep one column for row count
+        columns = tuple(plan.columns[i] for i in keep)
+        schema = tuple(plan.schema[i] for i in keep)
+        mapping = {old: new for new, old in enumerate(keep)}
+        return L.Scan(plan.table, columns, schema), mapping
+
+    if isinstance(plan, L.ValuesScan):
+        keep = sorted(req)
+        if len(keep) == n_out:
+            return plan, _identity(n_out)
+        rows = tuple(tuple(row[i] for i in keep) for row in plan.rows)
+        schema = tuple(plan.schema[i] for i in keep)
+        mapping = {old: new for new, old in enumerate(keep)}
+        return L.ValuesScan(rows, schema), mapping
+
+    if isinstance(plan, L.Filter):
+        child_req = set(req)
+        _used_cols(plan.predicate, child_req)
+        child, cmap = _prune_node(plan.child, child_req)
+        pred = _remap_expr(plan.predicate, cmap)
+        # Filter passes through child columns; output = child output
+        schema = child.schema
+        newp = L.Filter(child, pred, schema)
+        return newp, cmap
+
+    if isinstance(plan, L.Project):
+        keep = sorted(req)
+        child_req: set[int] = set()
+        for i in keep:
+            _used_cols(plan.exprs[i], child_req)
+        child, cmap = _prune_node(plan.child, child_req)
+        exprs = tuple(_remap_expr(plan.exprs[i], cmap) for i in keep)
+        schema = tuple(plan.schema[i] for i in keep)
+        mapping = {old: new for new, old in enumerate(keep)}
+        return L.Project(child, exprs, schema), mapping
+
+    if isinstance(plan, L.Aggregate):
+        # Always keep all group cols (grouping semantics); prune agg results.
+        ngroups = len(plan.group_exprs)
+        keep_aggs = sorted(i - ngroups for i in req if i >= ngroups)
+        child_req: set[int] = set()
+        for g in plan.group_exprs:
+            _used_cols(g, child_req)
+        for ai in keep_aggs:
+            a = plan.aggs[ai]
+            if a.arg is not None:
+                _used_cols(a.arg, child_req)
+        child, cmap = _prune_node(plan.child, child_req)
+        group_exprs = tuple(_remap_expr(g, cmap) for g in plan.group_exprs)
+        aggs = tuple(
+            E.AggCall(
+                plan.aggs[ai].func,
+                _remap_expr(plan.aggs[ai].arg, cmap) if plan.aggs[ai].arg is not None else None,
+                plan.aggs[ai].distinct,
+                plan.aggs[ai].type,
+            )
+            for ai in keep_aggs
+        )
+        schema = tuple(plan.schema[:ngroups]) + tuple(
+            plan.schema[ngroups + ai] for ai in keep_aggs
+        )
+        mapping = {i: i for i in range(ngroups)}
+        for new, ai in enumerate(keep_aggs):
+            mapping[ngroups + ai] = ngroups + new
+        return L.Aggregate(child, group_exprs, aggs, schema), mapping
+
+    if isinstance(plan, L.Join):
+        nleft = len(plan.left.schema)
+        semi = plan.join_type in ("semi", "anti")
+        left_req: set[int] = set()
+        right_req: set[int] = set()
+        for i in req:
+            if i < nleft:
+                left_req.add(i)
+            else:
+                right_req.add(i - nleft)
+        for k in plan.left_keys:
+            _used_cols(k, left_req)
+        for k in plan.right_keys:
+            _used_cols(k, right_req)
+        if plan.residual is not None:
+            res_cols: set[int] = set()
+            _used_cols(plan.residual, res_cols)
+            for i in res_cols:
+                if i < nleft:
+                    left_req.add(i)
+                else:
+                    right_req.add(i - nleft)
+        left, lmap = _prune_node(plan.left, left_req)
+        right, rmap = _prune_node(plan.right, right_req)
+        nleft_new = len(left.schema)
+        left_keys = tuple(_remap_expr(k, lmap) for k in plan.left_keys)
+        right_keys = tuple(_remap_expr(k, rmap) for k in plan.right_keys)
+        combo_map: dict[int, int] = {}
+        for old, new in lmap.items():
+            combo_map[old] = new
+        if not semi:
+            for old, new in rmap.items():
+                combo_map[nleft + old] = nleft_new + new
+        residual = (
+            _remap_expr(plan.residual, combo_map) if plan.residual is not None else None
+        )
+        if semi:
+            schema = left.schema
+        else:
+            schema = tuple(left.schema) + tuple(right.schema)
+        newp = L.Join(
+            left, right, plan.join_type, left_keys, right_keys, residual, schema
+        )
+        return newp, combo_map
+
+    if isinstance(plan, (L.Sort, L.Limit, L.Distinct)):
+        # These pass through all child columns; keep them all (Distinct's
+        # semantics depend on the full column set anyway).
+        if isinstance(plan, L.Sort):
+            child_req = set(range(len(plan.child.schema)))
+            child, cmap = _prune_node(plan.child, child_req)
+            keys = tuple(
+                L.SortKey(_remap_expr(k.expr, cmap), k.descending, k.nulls_first)
+                for k in plan.keys
+            )
+            return L.Sort(child, keys, child.schema), _identity(len(child.schema))
+        child, cmap = _prune_node(plan.child, set(range(len(plan.child.schema))))
+        if isinstance(plan, L.Limit):
+            return L.Limit(child, plan.limit, plan.offset, child.schema), cmap
+        return L.Distinct(child, child.schema), cmap
+
+    if isinstance(plan, L.Union):
+        inputs = []
+        mapping: dict[int, int] = {}
+        keep = sorted(req)
+        for inp in plan.inputs:
+            ni, _ = _prune_node(inp, set(keep))
+            inputs.append(ni)
+        # children were pruned to `keep` in order
+        mapping = {old: new for new, old in enumerate(keep)}
+        schema = tuple(plan.schema[i] for i in keep)
+        return L.Union(tuple(inputs), schema), mapping
+
+    if isinstance(plan, L.InsertPlan):
+        src, _ = _prune_node(plan.source, None)
+        return L.InsertPlan(plan.table, src, plan.columns), {}
+
+    if isinstance(plan, (L.UpdatePlan, L.DeletePlan)):
+        return plan, {}
+
+    raise TypeError(f"prune: unhandled node {type(plan).__name__}")

@@ -1,0 +1,329 @@
+"""Analyzer tests: AST -> typed logical plans."""
+
+import pytest
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.catalog.catalog import Catalog
+from opentenbase_tpu.catalog.distribution import DistributionSpec, DistStrategy
+from opentenbase_tpu.catalog.nodes import NodeDef, NodeManager, NodeRole
+from opentenbase_tpu.catalog.shardmap import ShardMap
+from opentenbase_tpu.plan import analyze_select
+from opentenbase_tpu.plan import logical as L
+from opentenbase_tpu.plan import texpr as E
+from opentenbase_tpu.plan.analyze import AnalyzeError
+from opentenbase_tpu.plan.optimize import prune_columns
+
+
+@pytest.fixture()
+def catalog():
+    nm = NodeManager()
+    for i in range(2):
+        nm.create_node(NodeDef(f"dn{i}", NodeRole.DATANODE))
+    sm = ShardMap(64)
+    sm.initialize(nm.datanode_indices())
+    cat = Catalog(nm, sm)
+    cat.create_table(
+        "items",
+        {
+            "id": t.INT8,
+            "qty": t.decimal(12, 2),
+            "price": t.decimal(12, 2),
+            "flag": t.TEXT,
+            "ship": t.DATE,
+        },
+        DistributionSpec(DistStrategy.SHARD, ("id",)),
+    )
+    cat.create_table(
+        "orders",
+        {"o_id": t.INT8, "cust": t.INT8, "total": t.decimal(12, 2)},
+        DistributionSpec(DistStrategy.SHARD, ("o_id",)),
+    )
+    return cat
+
+
+def test_simple_select(catalog):
+    sp = analyze_select("SELECT id, qty FROM items WHERE id > 5", catalog)
+    root = sp.root
+    assert isinstance(root, L.Project)
+    assert [c.name for c in root.schema] == ["id", "qty"]
+    assert isinstance(root.child, L.Filter)
+    pred = root.child.predicate
+    assert isinstance(pred, E.BinE) and pred.op == ">"
+    # int literal coerced to int8 to match column
+    assert pred.right.type == t.INT8 or pred.left.type == t.INT8
+
+
+def test_select_star(catalog):
+    sp = analyze_select("SELECT * FROM items", catalog)
+    assert [c.name for c in sp.root.schema] == ["id", "qty", "price", "flag", "ship"]
+
+
+def test_unknown_column(catalog):
+    with pytest.raises(AnalyzeError, match="does not exist"):
+        analyze_select("SELECT nope FROM items", catalog)
+    with pytest.raises(AnalyzeError, match="does not exist"):
+        analyze_select("SELECT id FROM missing_table", catalog)
+
+
+def test_decimal_arithmetic_types(catalog):
+    sp = analyze_select("SELECT price * qty FROM items", catalog)
+    e = sp.root.exprs[0]
+    assert e.type.id == t.TypeId.DECIMAL
+    assert e.type.scale == 4  # 2 + 2
+
+
+def test_date_literal_coercion(catalog):
+    sp = analyze_select("SELECT id FROM items WHERE ship >= date '1994-01-01'", catalog)
+    f = sp.root.child
+    assert isinstance(f, L.Filter)
+    rhs = f.predicate.right
+    assert isinstance(rhs, E.Const) and rhs.type == t.DATE
+    assert rhs.value == 8766  # days from epoch to 1994-01-01
+
+
+def test_interval_folding(catalog):
+    sp = analyze_select(
+        "SELECT id FROM items WHERE ship < date '1998-12-01' - interval '90 day'", catalog
+    )
+    rhs = sp.root.child.predicate.right
+    assert isinstance(rhs, E.Const) and rhs.type == t.DATE
+    import numpy as np
+
+    expected = int(
+        (np.datetime64("1998-12-01", "D") - np.timedelta64(90, "D")).astype("int64")
+    )
+    assert rhs.value == expected
+
+
+def test_interval_month_folding(catalog):
+    sp = analyze_select(
+        "SELECT id FROM items WHERE ship < date '1995-01-31' + interval '1 month'", catalog
+    )
+    rhs = sp.root.child.predicate.right
+    import numpy as np
+
+    # Feb 1995: day-of-month clamps forward like numpy month arithmetic
+    assert rhs.value == int(np.datetime64("1995-03-03", "D").astype("int64"))
+
+
+def test_aggregate_extraction(catalog):
+    sp = analyze_select(
+        "SELECT flag, sum(price * (1 - qty)) AS rev, count(*) FROM items "
+        "GROUP BY flag HAVING count(*) > 2 ORDER BY flag",
+        catalog,
+    )
+    # plan: Project(Sort?) over Filter(having) over Aggregate
+    root = sp.root
+    assert isinstance(root, L.Sort)
+    proj = root.child
+    assert isinstance(proj, L.Project)
+    filt = proj.child
+    assert isinstance(filt, L.Filter)
+    agg = filt.child
+    assert isinstance(agg, L.Aggregate)
+    assert len(agg.group_exprs) == 1
+    # sum + count shared between select and having: count deduped
+    assert len(agg.aggs) == 2
+    assert agg.aggs[0].func == "sum"
+    assert agg.aggs[1].func == "count"
+
+
+def test_ungrouped_aggregate(catalog):
+    sp = analyze_select("SELECT sum(price), avg(qty) FROM items", catalog)
+    proj = sp.root
+    agg = proj.child
+    assert isinstance(agg, L.Aggregate)
+    assert agg.group_exprs == ()
+    assert agg.aggs[0].type.id == t.TypeId.DECIMAL
+    assert agg.aggs[1].type == t.FLOAT8
+
+
+def test_group_by_expression_match(catalog):
+    sp = analyze_select(
+        "SELECT id % 10, count(*) FROM items GROUP BY id % 10", catalog
+    )
+    agg = sp.root.child
+    assert isinstance(agg, L.Aggregate)
+    # select item resolved to group key position, not re-analyzed
+    assert isinstance(sp.root.exprs[0], E.Col) and sp.root.exprs[0].index == 0
+
+
+def test_bare_column_outside_group_by_rejected(catalog):
+    with pytest.raises(AnalyzeError, match="GROUP BY"):
+        analyze_select("SELECT price, count(*) FROM items GROUP BY flag", catalog)
+
+
+def test_join_keys_extracted(catalog):
+    sp = analyze_select(
+        "SELECT items.id, orders.total FROM items JOIN orders ON items.id = orders.cust "
+        "AND items.qty > orders.total",
+        catalog,
+    )
+    proj = sp.root
+    j = proj.child
+    assert isinstance(j, L.Join)
+    assert len(j.left_keys) == 1 and len(j.right_keys) == 1
+    assert j.residual is not None
+
+
+def test_join_using(catalog):
+    sp = analyze_select(
+        "SELECT a.id FROM items a JOIN items b USING (id)", catalog
+    )
+    j = sp.root.child
+    assert isinstance(j, L.Join) and len(j.left_keys) == 1
+
+
+def test_ambiguous_column(catalog):
+    with pytest.raises(AnalyzeError, match="ambiguous"):
+        analyze_select("SELECT id FROM items a, items b", catalog)
+
+
+def test_order_by_position_and_alias(catalog):
+    sp = analyze_select("SELECT id AS k, qty FROM items ORDER BY 2, k DESC", catalog)
+    assert isinstance(sp.root, L.Sort)
+    keys = sp.root.keys
+    assert keys[0].expr.index == 1
+    assert keys[1].expr.index == 0 and keys[1].descending
+
+
+def test_order_by_hidden_column(catalog):
+    sp = analyze_select("SELECT id FROM items ORDER BY qty", catalog)
+    # final projection drops the hidden sort column
+    assert [c.name for c in sp.root.schema] == ["id"]
+    assert isinstance(sp.root, L.Project)
+    assert isinstance(sp.root.child, L.Sort)
+
+
+def test_in_subquery_becomes_semi_join(catalog):
+    sp = analyze_select(
+        "SELECT id FROM items WHERE id IN (SELECT cust FROM orders)", catalog
+    )
+    j = sp.root.child
+    assert isinstance(j, L.Join) and j.join_type == "semi"
+    assert [c.name for c in j.schema] == ["id", "qty", "price", "flag", "ship"]
+    sp2 = analyze_select(
+        "SELECT id FROM items WHERE id NOT IN (SELECT cust FROM orders)", catalog
+    )
+    assert sp2.root.child.join_type == "anti"
+
+
+def test_scalar_subquery(catalog):
+    sp = analyze_select(
+        "SELECT id FROM items WHERE qty > (SELECT avg(qty) FROM items)", catalog
+    )
+    assert len(sp.subplans) == 1
+    found = [
+        n
+        for n in E.walk(sp.root.child.predicate)
+        if isinstance(n, E.SubqueryParam)
+    ]
+    assert len(found) == 1
+
+
+def test_case_and_like(catalog):
+    sp = analyze_select(
+        "SELECT CASE WHEN flag LIKE 'A%' THEN 1 ELSE 0 END FROM items", catalog
+    )
+    ce = sp.root.exprs[0]
+    assert isinstance(ce, E.CaseE)
+    like = ce.whens[0][0]
+    assert isinstance(like, E.LikeE) and like.pattern == "A%"
+
+
+def test_union_all(catalog):
+    sp = analyze_select(
+        "SELECT id FROM items UNION ALL SELECT o_id FROM orders", catalog
+    )
+    assert isinstance(sp.root, L.Union)
+    sp2 = analyze_select("SELECT id FROM items UNION SELECT o_id FROM orders", catalog)
+    assert isinstance(sp2.root, L.Distinct)
+
+
+def test_distinct(catalog):
+    sp = analyze_select("SELECT DISTINCT flag FROM items", catalog)
+    assert isinstance(sp.root, L.Distinct)
+
+
+def test_limit_offset(catalog):
+    sp = analyze_select("SELECT id FROM items LIMIT 10 OFFSET 5", catalog)
+    assert isinstance(sp.root, L.Limit)
+    assert sp.root.limit == 10 and sp.root.offset == 5
+
+
+def test_prune_columns(catalog):
+    sp = analyze_select("SELECT sum(price) FROM items WHERE id > 0", catalog)
+    pruned = prune_columns(sp)
+
+    def find_scan(p):
+        if isinstance(p, L.Scan):
+            return p
+        for c in p.children():
+            s = find_scan(c)
+            if s:
+                return s
+        return None
+
+    scan = find_scan(pruned.root)
+    assert set(scan.columns) == {"id", "price"}
+
+
+def test_prune_join(catalog):
+    sp = analyze_select(
+        "SELECT orders.total FROM items JOIN orders ON items.id = orders.cust",
+        catalog,
+    )
+    pruned = prune_columns(sp)
+
+    scans = []
+
+    def walk_plan(p):
+        if isinstance(p, L.Scan):
+            scans.append(p)
+        for c in p.children():
+            walk_plan(c)
+
+    walk_plan(pruned.root)
+    by_table = {s.table: set(s.columns) for s in scans}
+    assert by_table["items"] == {"id"}
+    assert by_table["orders"] == {"cust", "total"}
+
+
+def test_insert_values_typed(catalog):
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.sql.parser import parse_one
+
+    sp = analyze_statement(
+        parse_one("INSERT INTO orders VALUES (1, 2, 3.5)"), catalog
+    )
+    ins = sp.root
+    assert isinstance(ins, L.InsertPlan)
+    vs = ins.source
+    assert isinstance(vs, L.ValuesScan)
+    # 3.5 -> decimal(12,2) physical 350
+    assert vs.rows[0][2].value == 350
+
+
+def test_update_delete_analysis(catalog):
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.sql.parser import parse_one
+
+    up = analyze_statement(
+        parse_one("UPDATE orders SET total = total + 1 WHERE o_id = 3"), catalog
+    ).root
+    assert isinstance(up, L.UpdatePlan)
+    assert up.assignments[0][0] == "total"
+    assert up.assignments[0][1].type.id == t.TypeId.DECIMAL
+    de = analyze_statement(parse_one("DELETE FROM orders"), catalog).root
+    assert isinstance(de, L.DeletePlan) and de.predicate is None
+
+
+def test_explain_tree_renders(catalog):
+    from opentenbase_tpu.plan.logical import explain_tree
+
+    sp = analyze_select(
+        "SELECT flag, count(*) FROM items WHERE id > 1 GROUP BY flag ORDER BY 2 DESC LIMIT 3",
+        catalog,
+    )
+    text = explain_tree(sp.root)
+    assert "Aggregate" in text and "Scan" in text and "Limit" in text
