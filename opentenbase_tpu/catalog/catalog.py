@@ -43,6 +43,9 @@ class Catalog:
         self.nodes = nodes
         self.shardmap = shardmap
         self._tables: dict[str, TableMeta] = {}
+        # Session-wide dictionary for expression-produced TEXT values
+        # (CASE/COALESCE literals etc.) — dict_id "__lit__" (ops/expr.py).
+        self.literals = Dictionary()
 
     def create_table(
         self,

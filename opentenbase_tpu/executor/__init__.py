@@ -1,0 +1,2 @@
+"""Execution engine: per-datanode vectorized plan evaluation (local.py)
+and the distributed fragment executor over a device mesh (dist.py)."""

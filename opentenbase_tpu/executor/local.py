@@ -1,0 +1,673 @@
+"""Per-datanode plan evaluation: the DN executor.
+
+The reference DN runs the Volcano interpreter over heap tuples
+(src/backend/executor/execMain.c, execProcnode.c). Here a "datanode" is a
+LocalExecutor bound to one shard of every table: plans evaluate bottom-up
+over whole padded columns on device, with a boolean visibility mask in
+place of tuple-at-a-time qual checks. Operators that need dense input
+(sort gathers, join encodes) consume the mask via the kernels in ops/.
+
+Batches are static-shape: every intermediate is padded to a power-of-two
+bucket so XLA compilations are reused across runs (the plan-cache analog
+of src/backend/utils/cache/plancache.c is the jit cache keyed on shapes).
+
+MVCC: scans receive a snapshot timestamp and start from the vectorized
+visibility predicate xmin_ts <= snap < xmax_ts — the device-side analog of
+HeapTupleSatisfiesMVCC (src/backend/utils/time/tqual.c:2274).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import opentenbase_tpu.ops  # noqa: F401  (enables x64)
+import jax.numpy as jnp
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.catalog.catalog import Catalog
+from opentenbase_tpu.ops import agg as agg_ops
+from opentenbase_tpu.ops import filter as filt_ops
+from opentenbase_tpu.ops import join as join_ops
+from opentenbase_tpu.ops import sort as sort_ops
+from opentenbase_tpu.ops.expr import (
+    LITERAL_DICT,
+    ExprCompiler,
+    resolve_param,
+)
+from opentenbase_tpu.plan import logical as L
+from opentenbase_tpu.plan import texpr as E
+from opentenbase_tpu.storage.column import Column, Dictionary
+from opentenbase_tpu.storage.table import INF_TS, ColumnBatch, ShardStore
+
+
+@dataclass
+class DevBatch:
+    """A device-resident batch: padded columns + visibility mask."""
+
+    schema: tuple[L.OutCol, ...]
+    cols: list  # list[(data, valid_or_None)]
+    mask: Optional[object]  # bool array or None (= all live)
+    n: int  # padded row count (static)
+
+    def live_count(self) -> int:
+        if self.mask is None:
+            return self.n
+        return int(filt_ops.mask_count(self.mask))
+
+
+class ExecError(RuntimeError):
+    pass
+
+
+class LocalExecutor:
+    """Executes logical plans against one shard of every table."""
+
+    def __init__(
+        self,
+        catalog: Catalog,
+        stores: dict[str, ShardStore],
+        snapshot_ts: Optional[int] = None,
+    ):
+        self.catalog = catalog
+        self.stores = stores
+        self.snapshot_ts = snapshot_ts
+
+    # -- dictionary access ----------------------------------------------
+    def _dict(self, dict_id: str) -> Dictionary:
+        if dict_id == LITERAL_DICT:
+            return self.catalog.literals
+        table, _, col = dict_id.partition(".")
+        return self.catalog.get(table).dictionaries[col]
+
+    def _dicts_view(self):
+        class _View:
+            def __init__(v, ex):
+                v.ex = ex
+
+            def __getitem__(v, key):
+                return v.ex._dict(key)
+
+        return _View(self)
+
+    # -- expression binding ---------------------------------------------
+    def _bind(self, exprs, schema, subquery_values=None, want_dids=None):
+        comp = ExprCompiler()
+        dids = [c.dict_id for c in schema]
+        fns = []
+        for i, e in enumerate(exprs):
+            want = None
+            if want_dids is not None and e.type.is_text:
+                want = want_dids[i] or LITERAL_DICT
+            fns.append(comp.compile(e, dids, want))
+        params = tuple(
+            resolve_param(s, self._dicts_view(), subquery_values)
+            for s in comp.params
+        )
+        return fns, params
+
+    # -- statement entry -------------------------------------------------
+    def execute(self, splan: L.StatementPlan) -> ColumnBatch:
+        self._subquery_values = self._run_subplans(splan.subplans)
+        batch = self.eval(splan.root)
+        return self.to_host(batch)
+
+    def _run_subplans(self, subplans):
+        vals = []
+        for sp in subplans:
+            b = self.to_host(self.eval(sp))
+            if b.nrows > 1:
+                raise ExecError("more than one row returned by a subquery used as an expression")
+            col0 = next(iter(b.columns.values())) if b.columns else None
+            if b.nrows == 0 or col0 is None:
+                vals.append((None, sp.schema[0].type))
+            else:
+                v = col0.data[0] if col0.valid_mask[0] else None
+                vals.append((v, sp.schema[0].type))
+        return vals
+
+    # -- host materialization --------------------------------------------
+    def to_host(self, b: DevBatch) -> ColumnBatch:
+        if b.mask is None:
+            keep = np.ones(b.n, dtype=np.bool_)
+        else:
+            keep = np.asarray(b.mask)
+        cols: dict[str, Column] = {}
+        used: dict[str, int] = {}
+        for oc, (data, valid) in zip(b.schema, b.cols):
+            name = oc.name
+            if name in cols:
+                used[name] = used.get(name, 0) + 1
+                name = f"{name}_{used[oc.name]}"
+            d = np.asarray(data)[keep]
+            v = None if valid is None else np.asarray(valid)[keep]
+            ty = oc.type
+            if ty.id == t.TypeId.FLOAT8 and d.dtype != np.float64:
+                d = d.astype(np.float64)
+            if oc.dict_id:
+                dic = self._dict(oc.dict_id)
+            elif ty.id == t.TypeId.TEXT:
+                dic = self.catalog.literals
+            else:
+                dic = None
+            cols[name] = Column(ty, d.astype(ty.np_dtype), v, dic)
+        n = int(keep.sum())
+        return ColumnBatch(cols, n)
+
+    # -- plan dispatch ----------------------------------------------------
+    def eval(self, plan: L.LogicalPlan) -> DevBatch:
+        m = getattr(self, f"_eval_{type(plan).__name__.lower()}", None)
+        if m is None:
+            raise ExecError(f"no executor for {type(plan).__name__}")
+        return m(plan)
+
+    # -- leaves -----------------------------------------------------------
+    def _eval_scan(self, plan: L.Scan) -> DevBatch:
+        store = self.stores.get(plan.table)
+        if store is None:
+            raise ExecError(f"no shard for table {plan.table} on this node")
+        nrows = store.nrows
+        padded = filt_ops.bucket_size(max(nrows, 1))
+        cols = []
+        for name, oc in zip(plan.columns, plan.schema):
+            arr = store.column_array(name)
+            d = _pad_to(arr, padded)
+            vm = store._validity.get(name)
+            v = None if vm is None else _pad_to(vm[:nrows], padded, fill=False)
+            cols.append((jnp.asarray(d), None if v is None else jnp.asarray(v)))
+        live = np.zeros(padded, dtype=np.bool_)
+        live[:nrows] = True
+        if self.snapshot_ts is not None:
+            snap = np.int64(self.snapshot_ts)
+            xmin = _pad_to(store.xmin_ts[:nrows], padded, fill=INF_TS)
+            xmax = _pad_to(store.xmax_ts[:nrows], padded, fill=0)
+            live[:nrows] &= (xmin[:nrows] <= snap) & (snap < xmax[:nrows])
+        mask = jnp.asarray(live)
+        return DevBatch(plan.schema, cols, mask, padded)
+
+    def _eval_valuesscan(self, plan: L.ValuesScan) -> DevBatch:
+        nrows = len(plan.rows)
+        padded = filt_ops.bucket_size(max(nrows, 1))
+        ncols = len(plan.schema)
+        cols = []
+        for ci in range(ncols):
+            oc = plan.schema[ci]
+            data = np.zeros(padded, dtype=oc.type.np_dtype)
+            valid = np.zeros(padded, dtype=np.bool_)
+            for ri, row in enumerate(plan.rows):
+                e = row[ci]
+                if not isinstance(e, E.Const):
+                    raise ExecError("VALUES rows must be constants")
+                if e.value is None:
+                    continue
+                v = e.value
+                if oc.type.is_text:
+                    assert oc.dict_id is not None
+                    v = self._dict(oc.dict_id).encode_one(str(v))
+                data[ri] = v
+                valid[ri] = True
+            all_valid = bool(valid[:nrows].all()) and nrows > 0
+            cols.append(
+                (jnp.asarray(data), None if all_valid else jnp.asarray(valid))
+            )
+        live = np.zeros(padded, dtype=np.bool_)
+        live[:nrows] = True
+        return DevBatch(plan.schema, cols, jnp.asarray(live), padded)
+
+    # -- filter / project --------------------------------------------------
+    def _eval_filter(self, plan: L.Filter) -> DevBatch:
+        child = self.eval(plan.child)
+        fns, params = self._bind(
+            [plan.predicate], plan.child.schema, self._subq()
+        )
+        d, v = fns[0](child.cols, params)
+        keep = d if v is None else (d & v)
+        keep = jnp.broadcast_to(keep, (child.n,))
+        mask = keep if child.mask is None else (child.mask & keep)
+        return DevBatch(plan.schema, child.cols, mask, child.n)
+
+    def _eval_project(self, plan: L.Project) -> DevBatch:
+        child = self.eval(plan.child)
+        fns, params = self._bind(
+            plan.exprs,
+            plan.child.schema,
+            self._subq(),
+            want_dids=[c.dict_id for c in plan.schema],
+        )
+        cols = []
+        for fn in fns:
+            d, v = fn(child.cols, params)
+            d = jnp.broadcast_to(d, (child.n,) + jnp.shape(d)[1:]) if jnp.ndim(d) == 0 else d
+            if v is not None and jnp.ndim(v) == 0:
+                v = jnp.broadcast_to(v, (child.n,))
+            cols.append((d, v))
+        return DevBatch(plan.schema, cols, child.mask, child.n)
+
+    def _subq(self):
+        return getattr(self, "_subquery_values", None)
+
+    # -- aggregate ---------------------------------------------------------
+    def _eval_aggregate(self, plan: L.Aggregate) -> DevBatch:
+        child = self.eval(plan.child)
+        gfns, gparams = self._bind(
+            plan.group_exprs, plan.child.schema, self._subq()
+        )
+        keys = [fn(child.cols, gparams) for fn in gfns]
+        keys = [self._broadcast(kv, child.n) for kv in keys]
+
+        specs, vals = self._agg_inputs(plan.aggs, child)
+
+        if not plan.group_exprs:
+            distinct = [a for a in plan.aggs if a.distinct]
+            if distinct:
+                return self._eval_distinct_agg(plan, child, keys, specs, vals)
+            outs = agg_ops.scalar_reduce(vals, child.mask, tuple(specs))
+            cols = self._finalize_aggs(plan.aggs, specs, outs, scalar=True)
+            return DevBatch(plan.schema, _as_rows(cols), None, 1)
+
+        if any(a.distinct for a in plan.aggs):
+            return self._eval_distinct_agg(plan, child, keys, specs, vals)
+
+        perm, seg, ngroups = agg_ops.group_ids(keys, child.mask)
+        ng = max(int(ngroups), 1)
+        cap = filt_ops.bucket_size(ng)
+        out_keys, out_vals, gvalid = agg_ops.group_reduce(
+            keys, vals, perm, seg, cap, tuple(specs)
+        )
+        agg_cols = self._finalize_aggs(plan.aggs, specs, out_vals, scalar=False)
+        cols = list(out_keys) + agg_cols
+        return DevBatch(plan.schema, cols, gvalid, cap)
+
+    def _broadcast(self, kv, n):
+        d, v = kv
+        if jnp.ndim(d) == 0:
+            d = jnp.broadcast_to(d, (n,))
+        if v is not None and jnp.ndim(v) == 0:
+            v = jnp.broadcast_to(v, (n,))
+        return (d, v)
+
+    def _agg_inputs(self, aggs, child: DevBatch):
+        """Lower AggCalls to kernel specs + input value columns. avg(x)
+        becomes sum+count (merged in _finalize_aggs) — the same transition
+        split the reference's 2-phase aggregation uses."""
+        specs: list[str] = []
+        vals: list = []
+        afns = []
+        comp = ExprCompiler()
+        dids = [c.dict_id for c in child.schema]
+        for a in aggs:
+            afns.append(
+                comp.compile(a.arg, dids) if a.arg is not None else None
+            )
+        params = tuple(
+            resolve_param(s, self._dicts_view(), self._subq())
+            for s in comp.params
+        )
+        for a, fn in zip(aggs, afns):
+            if a.func == "count" and a.arg is None:
+                specs.append("count_star")
+                vals.append(None)
+                continue
+            d, v = fn(child.cols, params)
+            d, v = self._broadcast((d, v), child.n)
+            if a.func == "avg":
+                specs.append("sum")
+                vals.append((d, v))
+                specs.append("count")
+                vals.append((d, v))
+            elif a.func in ("sum", "count", "min", "max"):
+                specs.append(a.func)
+                vals.append((d, v))
+            else:
+                raise ExecError(f"aggregate {a.func} not supported")
+        return specs, vals
+
+    def _finalize_aggs(self, aggs, specs, outs, scalar: bool):
+        """Map kernel outputs back to one column per AggCall (avg = sum/count)."""
+        cols = []
+        i = 0
+        for a in aggs:
+            if a.func == "avg":
+                s_d, s_v = outs[i]
+                c_d, _ = outs[i + 1]
+                i += 2
+                denom = jnp.maximum(c_d, 1)
+                arg_t = a.arg.type
+                if arg_t.id == t.TypeId.DECIMAL:
+                    num = s_d / arg_t.decimal_factor
+                else:
+                    num = s_d
+                d = num / denom
+                v = s_v if s_v is not None else None
+                cols.append((d, v))
+            else:
+                d, v = outs[i]
+                i += 1
+                if a.func == "sum" and a.type.id == t.TypeId.INT8:
+                    d = d.astype(jnp.int64)
+                cols.append((d, v))
+        return cols
+
+    def _eval_distinct_agg(self, plan, child, keys, specs, vals):
+        """DISTINCT aggregates via two-level grouping: first dedup on
+        (group keys, arg), then aggregate the deduped level. Mixing
+        DISTINCT and plain aggs over different args is not yet supported."""
+        dargs = {a.arg.key() for a in plan.aggs if a.distinct}
+        if len(dargs) > 1:
+            raise ExecError("multiple DISTINCT aggregate arguments")
+        plain = [a for a in plan.aggs if not a.distinct and a.func != "count"]
+        if plain and {a.arg.key() for a in plain if a.arg} - dargs:
+            raise ExecError("mix of DISTINCT and non-DISTINCT aggregates")
+        # level 1: dedup (keys + arg)
+        arg_val = None
+        for s, vv in zip(specs, vals):
+            if vv is not None:
+                arg_val = vv
+                break
+        lvl1_keys = keys + [arg_val]
+        perm, seg, ngroups = agg_ops.group_ids(lvl1_keys, child.mask)
+        cap1 = filt_ops.bucket_size(max(int(ngroups), 1))
+        out_keys, out_vals, gvalid = agg_ops.group_reduce(
+            lvl1_keys, [arg_val], perm, seg, cap1, ("any",)
+        )
+        ded_keys = out_keys[:-1]
+        ded_arg = out_vals[0]
+        # level 2: aggregate over deduped rows
+        specs2 = []
+        vals2 = []
+        for a in plan.aggs:
+            if a.func == "count" and a.arg is None:
+                specs2.append("count_star")
+                vals2.append(None)
+            else:
+                specs2.append(a.func if a.func != "avg" else "sum")
+                vals2.append(ded_arg)
+                if a.func == "avg":
+                    specs2.append("count")
+                    vals2.append(ded_arg)
+        if not plan.group_exprs:
+            outs = agg_ops.scalar_reduce(vals2, gvalid, tuple(specs2))
+            cols = self._finalize_aggs(plan.aggs, specs2, outs, scalar=True)
+            return DevBatch(plan.schema, _as_rows(cols), None, 1)
+        perm2, seg2, ng2 = agg_ops.group_ids(ded_keys, gvalid)
+        cap2 = filt_ops.bucket_size(max(int(ng2), 1))
+        out_keys2, out_vals2, gvalid2 = agg_ops.group_reduce(
+            ded_keys, vals2, perm2, seg2, cap2, tuple(specs2)
+        )
+        agg_cols = self._finalize_aggs(plan.aggs, specs2, out_vals2, scalar=False)
+        cols = list(out_keys2) + agg_cols
+        return DevBatch(plan.schema, cols, gvalid2, cap2)
+
+    # -- distinct ----------------------------------------------------------
+    def _eval_distinct(self, plan: L.Distinct) -> DevBatch:
+        child = self.eval(plan.child)
+        keys = [self._broadcast(c, child.n) for c in child.cols]
+        perm, seg, ngroups = agg_ops.group_ids(keys, child.mask)
+        cap = filt_ops.bucket_size(max(int(ngroups), 1))
+        out_keys, _, gvalid = agg_ops.group_reduce(
+            keys, [], perm, seg, cap, ()
+        )
+        return DevBatch(plan.schema, list(out_keys), gvalid, cap)
+
+    # -- sort / limit ------------------------------------------------------
+    def _sort_key_arrays(self, plan_keys, schema, cols, n):
+        fns, params = self._bind(
+            [k.expr for k in plan_keys], schema, self._subq()
+        )
+        keys = []
+        for k, fn in zip(plan_keys, fns):
+            d, v = self._broadcast(fn(cols, params), n)
+            if k.expr.type.is_text:
+                did = _texpr_did(k.expr, schema)
+                if did is None:
+                    raise ExecError("ORDER BY on TEXT without dictionary")
+                ranks = self._dict_ranks(did)
+                d = ranks[jnp.clip(d, 0, ranks.shape[0] - 1)]
+            keys.append((d, v, k.descending, k.nulls_first))
+        return keys
+
+    def _dict_ranks(self, dict_id: str):
+        dic = self._dict(dict_id)
+        vals = dic.values
+        order = np.argsort(np.asarray(vals, dtype=object))
+        ranks = np.empty(max(len(vals), 1), dtype=np.int32)
+        ranks[order if len(vals) else slice(0, 0)] = np.arange(
+            len(vals), dtype=np.int32
+        )
+        padded = filt_ops.bucket_size(max(len(vals), 1))
+        out = np.zeros(padded, dtype=np.int32)
+        out[: len(vals)] = ranks[: len(vals)]
+        return jnp.asarray(out)
+
+    def _eval_sort(self, plan: L.Sort) -> DevBatch:
+        child = self.eval(plan.child)
+        keys = self._sort_key_arrays(
+            plan.keys, plan.child.schema, child.cols, child.n
+        )
+        perm = sort_ops.order_indices(keys, child.mask)
+        cols = filt_ops.gather_cols(
+            child.cols, perm, jnp.ones(child.n, jnp.bool_)
+        )
+        cols = [
+            (d, None if v is None else v)
+            for (d, v) in cols
+        ]
+        mask = (
+            None
+            if child.mask is None
+            else jnp.take(child.mask, perm, axis=0)
+        )
+        return DevBatch(plan.schema, cols, mask, child.n)
+
+    def _eval_limit(self, plan: L.Limit) -> DevBatch:
+        child = self.eval(plan.child)
+        mask = (
+            child.mask
+            if child.mask is not None
+            else jnp.ones(child.n, jnp.bool_)
+        )
+        rank = jnp.cumsum(mask.astype(jnp.int32))  # 1-based among live rows
+        keep = mask & (rank > plan.offset)
+        if plan.limit is not None:
+            keep = keep & (rank <= plan.offset + plan.limit)
+        return DevBatch(plan.schema, child.cols, keep, child.n)
+
+    # -- join --------------------------------------------------------------
+    def _eval_join(self, plan: L.Join) -> DevBatch:
+        left = self.eval(plan.left)
+        right = self.eval(plan.right)
+        jt = plan.join_type
+
+        if jt == "right":
+            # plan flipped: build on left of the flip
+            return self._join_impl(plan, right, left, "left", flipped=True)
+        return self._join_impl(plan, left, right, jt, flipped=False)
+
+    def _join_impl(self, plan, probe, build, jt, flipped):
+        lk = plan.right_keys if flipped else plan.left_keys
+        rk = plan.left_keys if flipped else plan.right_keys
+        pf, pp = self._bind(
+            lk, plan.right.schema if flipped else plan.left.schema, self._subq()
+        )
+        bf, bp = self._bind(
+            rk, plan.left.schema if flipped else plan.right.schema, self._subq()
+        )
+        probe_keys = [
+            self._broadcast(fn(probe.cols, pp), probe.n) for fn in pf
+        ]
+        build_keys = [
+            self._broadcast(fn(build.cols, bp), build.n) for fn in bf
+        ]
+        probe_keys, build_keys = _align_key_dtypes(probe_keys, build_keys)
+
+        build_ids, probe_ids = join_ops.encode_keys(
+            build_keys, probe_keys, build.mask, probe.mask
+        )
+        build_order, lo, counts, total = join_ops.match_counts(
+            build_ids, probe_ids
+        )
+
+        if jt in ("semi", "anti"):
+            has = counts > 0
+            keep = has if jt == "semi" else ~has
+            if probe.mask is not None:
+                keep = keep & probe.mask
+            schema = plan.schema
+            return DevBatch(schema, probe.cols, keep, probe.n)
+
+        outer = jt in ("left", "full")
+        if jt == "full":
+            raise ExecError("FULL OUTER JOIN not yet supported")
+        tot = int(total)
+        if outer:
+            # every zero-count probe lane emits one null-extended row on
+            # device (invisible ones are masked after the gather), so size
+            # for exactly that
+            tot = tot + int(jnp.sum(counts == 0))
+        out_size = filt_ops.bucket_size(max(tot, 1))
+        probe_idx, build_idx, matched, valid = join_ops.emit_pairs(
+            build_order, lo, counts, out_size, outer
+        )
+        # Padding lanes of emit_pairs count unmatched probe rows once for
+        # outer joins; for inner joins valid already excludes them.
+        if probe.mask is not None:
+            valid = valid & jnp.take(probe.mask, probe_idx, axis=0)
+
+        pcols = filt_ops.gather_cols(
+            probe.cols, probe_idx, jnp.ones(out_size, jnp.bool_)
+        )
+        bvalid = matched
+        bcols = []
+        for data, v in build.cols:
+            d = jnp.take(data, build_idx, axis=0)
+            vv = bvalid if v is None else (jnp.take(v, build_idx, axis=0) & bvalid)
+            bcols.append((d, vv))
+
+        if flipped:
+            cols = bcols + pcols  # original left = build side
+        else:
+            cols = pcols + bcols
+        out = DevBatch(plan.schema, cols, valid, out_size)
+
+        if plan.residual is not None:
+            fns, params = self._bind(
+                [plan.residual], plan.schema, self._subq()
+            )
+            d, v = fns[0](out.cols, params)
+            keep = d if v is None else (d & v)
+            if jt == "left":
+                # residual only filters matched rows; unmatched stay
+                keep = keep | ~matched
+            out = DevBatch(
+                plan.schema, out.cols, out.mask & keep, out.n
+            )
+        return out
+
+    # -- union -------------------------------------------------------------
+    def _eval_union(self, plan: L.Union) -> DevBatch:
+        parts = [self.eval(c) for c in plan.inputs]
+        total = sum(p.n for p in parts)
+        padded = filt_ops.bucket_size(max(total, 1))
+        ncols = len(plan.schema)
+        cols = []
+        for ci in range(ncols):
+            datas = []
+            valids = []
+            any_valid = any(p.cols[ci][1] is not None for p in parts)
+            for p in parts:
+                d, v = p.cols[ci]
+                datas.append(d)
+                if any_valid:
+                    valids.append(
+                        jnp.ones(p.n, jnp.bool_) if v is None else v
+                    )
+            d = jnp.concatenate(datas)
+            d = _pad_dev(d, padded)
+            v = None
+            if any_valid:
+                v = _pad_dev(jnp.concatenate(valids), padded, fill=False)
+            cols.append((d, v))
+        masks = []
+        for p in parts:
+            masks.append(
+                jnp.ones(p.n, jnp.bool_) if p.mask is None else p.mask
+            )
+        mask = _pad_dev(jnp.concatenate(masks), padded, fill=False)
+        return DevBatch(plan.schema, cols, mask, padded)
+
+    # -- DML helper --------------------------------------------------------
+    def predicate_rows(self, table: str, predicate: Optional[E.TExpr]) -> np.ndarray:
+        """Row indices in this node's shard store matching the predicate
+        under the current snapshot (UPDATE/DELETE target selection)."""
+        meta = self.catalog.get(table)
+        schema = tuple(
+            L.OutCol(
+                name,
+                ty,
+                f"{table}.{name}" if ty.id == t.TypeId.TEXT else None,
+            )
+            for name, ty in meta.schema.items()
+        )
+        scan = L.Scan(table, tuple(meta.schema.keys()), schema)
+        batch = self._eval_scan(scan)
+        store = self.stores[table]
+        if predicate is not None:
+            fns, params = self._bind([predicate], schema, self._subq())
+            d, v = fns[0](batch.cols, params)
+            keep = d if v is None else (d & v)
+            mask = batch.mask & keep
+        else:
+            mask = batch.mask
+        m = np.asarray(mask)[: store.nrows]
+        return np.nonzero(m)[0]
+
+
+def _align_key_dtypes(probe_keys, build_keys):
+    """Promote paired join-key columns to a common dtype so joint encoding
+    compares equal values equal (int4 key vs int8 key, float4 vs float8)."""
+    pk, bk = [], []
+    for (pd, pv), (bd, bv) in zip(probe_keys, build_keys):
+        if pd.dtype != bd.dtype:
+            target = jnp.promote_types(pd.dtype, bd.dtype)
+            pd = pd.astype(target)
+            bd = bd.astype(target)
+        pk.append((pd, pv))
+        bk.append((bd, bv))
+    return pk, bk
+
+
+def _as_rows(cols):
+    """Reshape 0-d scalar-agg outputs to 1-row columns."""
+    out = []
+    for d, v in cols:
+        d = jnp.reshape(d, (1,))
+        if v is not None:
+            v = jnp.reshape(v, (1,))
+        out.append((d, v))
+    return out
+
+
+def _texpr_did(e: E.TExpr, schema) -> Optional[str]:
+    if isinstance(e, E.Col):
+        return schema[e.index].dict_id
+    if isinstance(e, E.CastE):
+        return _texpr_did(e.operand, schema)
+    return None
+
+
+def _pad_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
+    if len(arr) == n:
+        return np.ascontiguousarray(arr)
+    out = np.full(n, fill, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+def _pad_dev(arr, n: int, fill=0):
+    cur = arr.shape[0]
+    if cur == n:
+        return arr
+    pad = jnp.full((n - cur,), fill, dtype=arr.dtype)
+    return jnp.concatenate([arr, pad])

@@ -1,0 +1,13 @@
+"""Device kernels: vectorized, static-shape JAX implementations of the
+executor operators (the reference's src/backend/executor node set, rebuilt
+batch-at-a-time for the MXU/VPU instead of tuple-at-a-time Volcano C).
+
+x64 is enabled at import: SQL int8/decimal/timestamp columns are 64-bit and
+aggregate sums overflow 32-bit accumulators at TPC-H scale. On TPU, XLA
+emulates i64 with i32 pairs; the perf-critical reductions get specialized
+narrower paths in the Pallas kernels, not here.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,232 @@
+"""Grouped and scalar aggregation kernels.
+
+The reference's nodeAgg.c (6,331 LoC) builds a per-group hash table and
+advances transition states tuple-by-tuple. The TPU-native formulation is
+sort-based: stable-sort rows by the group keys, detect segment boundaries,
+then compute every aggregate as a segment reduction (`jax.ops.segment_*`) —
+one fused scatter-reduce per aggregate, no serial hash probing.
+
+Two-stage shape handling (SURVEY.md §7 "two-pass size estimation"):
+``group_ids`` sorts + labels and returns the group count; the executor
+buckets that count to a static ``num_groups`` and calls ``group_reduce``.
+Both stages are jitted; the intermediate stays on device.
+
+Distributed 2-phase aggregation maps exactly onto this: each shard runs
+group_reduce (partial), the coordinator (or a psum/all_gather collective)
+re-runs group_reduce over concatenated partials with merge ops — the
+equivalent of make_remotesubplan's agg split
+(src/backend/optimizer/plan/createplan.c:1852).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_I64_MAX = np.int64(2**62)  # sentinels safely inside int64
+_I64_MIN = np.int64(-(2**62))
+
+
+def _key_parts(keys):
+    """Flatten (data, valid) group keys into comparable integer parts.
+    Floats are bitcast so exact equality grouping matches SQL GROUP BY."""
+    parts = []
+    for data, valid in keys:
+        d = data
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            d = jax.lax.bitcast_convert_type(
+                d.astype(jnp.float32), jnp.int32
+            )
+        elif jnp.issubdtype(d.dtype, jnp.bool_):
+            d = d.astype(jnp.int32)
+        if valid is not None:
+            d = jnp.where(valid, d, 0)  # canonicalize NULL payloads
+            parts.append((d, valid))
+        else:
+            parts.append((d, None))
+    return parts
+
+
+@partial(jax.jit)
+def group_ids(keys, mask):
+    """Sort rows by keys (+validity), label segments.
+
+    keys: list of (data, valid_or_None); mask: visible-row bool mask or None.
+    Returns (perm, seg, ngroups): ``perm`` the sort permutation,
+    ``seg[i]`` the group id of sorted row i (== ngroups for invisible rows),
+    ``ngroups`` the number of distinct visible groups (0-d int32).
+    """
+    parts = _key_parts(keys)
+    n = parts[0][0].shape[0] if parts else (mask.shape[0] if mask is not None else 0)
+    perm = jnp.arange(n, dtype=jnp.int32)
+    for d, v in reversed(parts):
+        order = jnp.argsort(jnp.take(d, perm, axis=0), stable=True)
+        perm = jnp.take(perm, order, axis=0)
+        if v is not None:
+            order = jnp.argsort(~jnp.take(v, perm, axis=0), stable=True)
+            perm = jnp.take(perm, order, axis=0)
+    if mask is not None:
+        dead = ~jnp.take(mask, perm, axis=0)
+        order = jnp.argsort(dead.astype(jnp.int32), stable=True)
+        perm = jnp.take(perm, order, axis=0)
+        vis = jnp.take(mask, perm, axis=0)
+    else:
+        vis = jnp.ones(n, dtype=jnp.bool_)
+
+    boundary = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
+    for d, v in parts:
+        ds = jnp.take(d, perm, axis=0)
+        diff = jnp.concatenate([jnp.ones(1, jnp.bool_), ds[1:] != ds[:-1]])
+        boundary = boundary | diff
+        if v is not None:
+            vs = jnp.take(v, perm, axis=0)
+            vdiff = jnp.concatenate([jnp.ones(1, jnp.bool_), vs[1:] != vs[:-1]])
+            boundary = boundary | vdiff
+    boundary = boundary & vis
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    ngroups = jnp.sum(boundary, dtype=jnp.int32)
+    # Invisible rows get a sentinel far above any real group id so that
+    # group_reduce's clamp routes them to its overflow bin no matter how
+    # the caller buckets num_groups.
+    seg = jnp.where(vis, seg, jnp.int32(2**30))
+    return perm, seg, ngroups
+
+
+@partial(jax.jit, static_argnames=("num_groups", "specs"))
+def group_reduce(keys, vals, perm, seg, num_groups: int, specs: tuple):
+    """Segment reductions with static group capacity.
+
+    keys/vals: lists of (data, valid_or_None) in *unsorted* row order.
+    specs: per-val tuple of op strings: 'sum' | 'count' | 'min' | 'max' |
+    'count_star' (val entry may be None) | 'any' (first value — used to
+    carry grouped expressions). Rows whose seg == num_groups-overflow bin
+    are dropped via clamping to an extra scratch segment.
+
+    Returns (out_keys, out_vals, group_valid) where each out is a list of
+    (data, valid) arrays of length num_groups, and group_valid[g] marks
+    groups < ngroups.
+    """
+    nseg = num_groups + 1  # +1 overflow bin for invisible rows
+    seg = jnp.minimum(seg, nseg - 1)
+
+    # representative row per group (first sorted row = segment start)
+    n = perm.shape[0]
+    first_sorted = jax.ops.segment_min(
+        jnp.arange(n, dtype=jnp.int32), seg, num_segments=nseg
+    )
+    got = first_sorted < n
+    first_row = jnp.take(perm, jnp.minimum(first_sorted, n - 1), axis=0)
+
+    out_keys = []
+    for data, valid in keys:
+        d = jnp.take(data, first_row, axis=0)[:num_groups]
+        if valid is None:
+            v = got[:num_groups]
+        else:
+            v = (jnp.take(valid, first_row, axis=0) & got)[:num_groups]
+        out_keys.append((d, v))
+
+    # segment id per *unsorted* row
+    seg_unsorted = jnp.zeros(n, dtype=jnp.int32).at[perm].set(seg)
+
+    out_vals = []
+    for spec, val in zip(specs, vals):
+        if spec == "count_star":
+            ones = jnp.where(seg_unsorted < num_groups, 1, 0)
+            c = jax.ops.segment_sum(ones, seg_unsorted, num_segments=nseg)
+            out_vals.append((c[:num_groups].astype(jnp.int64), got[:num_groups]))
+            continue
+        data, valid = val
+        live = seg_unsorted < num_groups
+        vvalid = live if valid is None else (live & valid)
+        if spec == "count":
+            c = jax.ops.segment_sum(
+                vvalid.astype(jnp.int64), seg_unsorted, num_segments=nseg
+            )
+            out_vals.append((c[:num_groups], got[:num_groups]))
+            continue
+        if spec == "sum":
+            zero = jnp.zeros((), dtype=data.dtype)
+            d = jnp.where(vvalid, data, zero)
+            s = jax.ops.segment_sum(d, seg_unsorted, num_segments=nseg)
+            c = jax.ops.segment_sum(
+                vvalid.astype(jnp.int32), seg_unsorted, num_segments=nseg
+            )
+            out_vals.append((s[:num_groups], (c > 0)[:num_groups]))
+            continue
+        if spec in ("min", "max"):
+            if jnp.issubdtype(data.dtype, jnp.floating):
+                sent = jnp.inf if spec == "min" else -jnp.inf
+            elif data.dtype == jnp.bool_:
+                data = data.astype(jnp.int32)
+                sent = 2 if spec == "min" else -1
+            else:
+                sent = _I64_MAX if spec == "min" else _I64_MIN
+            d = jnp.where(vvalid, data, jnp.asarray(sent, dtype=data.dtype))
+            red = jax.ops.segment_min if spec == "min" else jax.ops.segment_max
+            m = red(d, seg_unsorted, num_segments=nseg)
+            c = jax.ops.segment_sum(
+                vvalid.astype(jnp.int32), seg_unsorted, num_segments=nseg
+            )
+            out_vals.append((m[:num_groups], (c > 0)[:num_groups]))
+            continue
+        if spec == "any":
+            d = jnp.take(data, first_row, axis=0)[:num_groups]
+            if valid is None:
+                v = got[:num_groups]
+            else:
+                v = (jnp.take(valid, first_row, axis=0) & got)[:num_groups]
+            out_vals.append((d, v))
+            continue
+        raise ValueError(f"unknown agg spec {spec}")
+
+    return out_keys, out_vals, got[:num_groups]
+
+
+@partial(jax.jit, static_argnames=("specs",))
+def scalar_reduce(vals, mask, specs: tuple):
+    """Ungrouped aggregation over one batch (returns per-agg (0-d, valid)).
+    Same specs as group_reduce. sum keeps a (sum, count) pair internally so
+    partials merge correctly."""
+    out = []
+    for spec, val in zip(specs, vals):
+        if spec == "count_star":
+            c = (
+                jnp.sum(mask, dtype=jnp.int64)
+                if mask is not None
+                else jnp.asarray(0, jnp.int64)
+            )
+            out.append((c, jnp.asarray(True)))
+            continue
+        data, valid = val
+        vvalid = valid
+        if mask is not None:
+            vvalid = mask if valid is None else (mask & valid)
+        n = data.shape[0]
+        if vvalid is None:
+            vvalid = jnp.ones(n, dtype=jnp.bool_)
+        cnt = jnp.sum(vvalid, dtype=jnp.int64)
+        if spec == "count":
+            out.append((cnt, jnp.asarray(True)))
+        elif spec == "sum":
+            zero = jnp.zeros((), dtype=data.dtype)
+            s = jnp.sum(jnp.where(vvalid, data, zero))
+            out.append((s, cnt > 0))
+        elif spec in ("min", "max"):
+            d = data
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                sent = jnp.inf if spec == "min" else -jnp.inf
+            elif d.dtype == jnp.bool_:
+                d = d.astype(jnp.int32)
+                sent = 2 if spec == "min" else -1
+            else:
+                sent = _I64_MAX if spec == "min" else _I64_MIN
+            dd = jnp.where(vvalid, d, jnp.asarray(sent, dtype=d.dtype))
+            r = jnp.min(dd) if spec == "min" else jnp.max(dd)
+            out.append((r, cnt > 0))
+        else:
+            raise ValueError(f"unknown scalar agg {spec}")
+    return out

@@ -1,0 +1,868 @@
+"""Compile typed expressions (plan/texpr.py) to jittable JAX functions.
+
+The analog of PG's expression interpreter (src/backend/executor/
+execExprInterp.c) — but instead of an opcode dispatch loop per tuple, each
+TExpr tree compiles once into a pure function over whole columns; XLA fuses
+the resulting elementwise graph into the surrounding fragment.
+
+Representation
+--------------
+A column value is a pair ``(data, valid)`` where ``data`` is a jnp array and
+``valid`` is a bool array or ``None`` (statically all-valid — the common
+case, which lets XLA skip the mask lanes entirely).
+
+NULL semantics follow SQL three-valued logic: comparisons/arithmetic are
+NULL if any operand is NULL; AND/OR use Kleene logic; division by zero
+yields NULL (PG raises an error; we degrade to NULL and surface the event
+via the executor's error-check pass).
+
+Host-resolved parameters
+------------------------
+Some leaves need host-side resolution against table dictionaries (TEXT
+constants → int32 codes; LIKE patterns → per-code boolean membership masks,
+the device-side form of the "evaluate the predicate once against the
+dictionary" strategy in types.py) or prior subplan results (SubqueryParam).
+The compiler emits ``ParamSpec``s; the executor computes the concrete
+arrays at bind time and passes them as runtime arguments, so jitted
+fragments stay cached while dictionaries grow (masks are padded to a power
+of two) and across subquery re-binds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import reduce
+from typing import Callable, Optional
+
+import numpy as np
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.plan import texpr as E
+
+# ---------------------------------------------------------------------------
+# Param specs (host-side bind-time values)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TextCodeParam:
+    """Scalar int32 code of a TEXT constant in dictionary ``dict_id``
+    (-1 when the string is absent: equality then matches nothing)."""
+
+    dict_id: str
+    value: str
+
+
+LITERAL_DICT = "__lit__"  # session-wide dictionary for expression-produced text
+
+
+@dataclass(frozen=True)
+class TextEncodeParam:
+    """Scalar int32 code of a TEXT constant *inserted* into ``dict_id``
+    (value-producing position: the string must exist so results decode)."""
+
+    dict_id: str
+    value: str
+
+
+@dataclass(frozen=True)
+class DictTranslateParam:
+    """int32 array mapping codes of ``src`` dictionary to codes of ``dst``
+    (inserting missing values into dst), padded to a power of two. Used to
+    align TEXT columns from different dictionaries under one output column
+    (e.g. CASE mixing a table column with literals)."""
+
+    src: str
+    dst: str
+
+
+@dataclass(frozen=True)
+class CodeMaskParam:
+    """Per-code bool membership mask over dictionary ``dict_id``, padded to
+    a power of two. ``patterns`` are LIKE patterns (ORed); ``values`` exact
+    strings; exactly one of the two is set."""
+
+    dict_id: str
+    patterns: tuple[str, ...] = ()
+    values: tuple[str, ...] = ()
+    ilike: bool = False
+
+
+@dataclass(frozen=True)
+class SubqueryScalarParam:
+    """Result of uncorrelated subplan ``index`` bound as a 0-d array
+    (value) plus validity flag."""
+
+    index: int
+    type: t.SqlType
+
+
+ParamSpec = object  # union of the three above
+
+ColVal = tuple  # (data: jnp.ndarray, valid: jnp.ndarray | None)
+CompiledExpr = Callable  # (cols: tuple[ColVal, ...], params: tuple) -> ColVal
+
+
+def _and_valid(*valids):
+    """Combine optional validity masks (None = all valid)."""
+    vs = [v for v in valids if v is not None]
+    if not vs:
+        return None
+    return reduce(lambda a, b: a & b, vs)
+
+
+def _np_cast_const(value, ty: t.SqlType):
+    if value is None:
+        return None
+    return np.asarray(value, dtype=ty.np_dtype)
+
+
+class ExprCompiler:
+    """Compiles one or more TExprs sharing a single param list."""
+
+    def __init__(self) -> None:
+        self.params: list[ParamSpec] = []
+
+    def _param(self, spec: ParamSpec) -> int:
+        # Dedup identical specs so repeated predicates share one bind.
+        for i, p in enumerate(self.params):
+            if p == spec:
+                return i
+        self.params.append(spec)
+        return len(self.params) - 1
+
+    # -- entry ----------------------------------------------------------
+    def compile(
+        self,
+        expr: E.TExpr,
+        dict_ids: list[Optional[str]],
+        want_did: Optional[str] = None,
+    ) -> CompiledExpr:
+        """``dict_ids[i]`` is the dictionary id of input column i (None for
+        non-TEXT), used to resolve TEXT consts/patterns in comparisons.
+        ``want_did``: for TEXT-valued expressions, the dictionary the output
+        codes must index (the plan's OutCol.dict_id; None = literal dict)."""
+        return self._c(expr, dict_ids, want_did)
+
+    # -- dispatch -------------------------------------------------------
+    def _c(self, e: E.TExpr, dids, want=None) -> CompiledExpr:
+        import jax.numpy as jnp  # deferred so host-only paths never import jax
+
+        if isinstance(e, E.Col):
+            idx = e.index
+            if e.type.is_text and want is not None:
+                src = dids[idx] if idx < len(dids) else None
+                src = src or LITERAL_DICT
+                if src != want:
+                    pi = self._param(DictTranslateParam(src, want))
+
+                    def run_xlate(cols, params):
+                        d, v = cols[idx]
+                        tbl = params[pi]
+                        return (tbl[jnp.clip(d, 0, tbl.shape[0] - 1)], v)
+
+                    return run_xlate
+            return lambda cols, params: cols[idx]
+
+        if isinstance(e, E.Const):
+            return self._const(e, dids, want)
+
+        if isinstance(e, E.BinE):
+            return self._bin(e, dids)
+
+        if isinstance(e, E.UnaryE):
+            cf = self._c(e.operand, dids)
+            if e.op == "-":
+                def run_neg(cols, params):
+                    d, v = cf(cols, params)
+                    return (-d, v)
+                return run_neg
+            if e.op == "not":
+                def run_not(cols, params):
+                    d, v = cf(cols, params)
+                    return (~d, v)
+                return run_not
+            raise NotImplementedError(f"unary op {e.op}")
+
+        if isinstance(e, E.FuncE):
+            return self._func(e, dids, want)
+
+        if isinstance(e, E.CaseE):
+            return self._case(e, dids, want)
+
+        if isinstance(e, E.CastE):
+            return self._cast(e, dids, want)
+
+        if isinstance(e, E.IsNullE):
+            cf = self._c(e.operand, dids)
+
+            def run_isnull(cols, params):
+                d, v = cf(cols, params)
+                if v is None:
+                    out = jnp.zeros(jnp.shape(d), dtype=jnp.bool_)
+                else:
+                    out = ~v
+                if e.negated:
+                    out = ~out
+                return (out, None)
+
+            return run_isnull
+
+        if isinstance(e, E.InListE):
+            return self._in_list(e, dids)
+
+        if isinstance(e, E.LikeE):
+            return self._like(e, dids)
+
+        if isinstance(e, E.SubqueryParam):
+            pi = self._param(SubqueryScalarParam(e.index, e.type))
+
+            def run_subq(cols, params):
+                data, valid_scalar = params[pi]
+                return (data, valid_scalar)
+
+            return run_subq
+
+        raise NotImplementedError(f"cannot compile {type(e).__name__}")
+
+    # -- leaves ---------------------------------------------------------
+    def _const(self, e: E.Const, dids, want=None) -> CompiledExpr:
+        import jax.numpy as jnp
+
+        if e.value is None:
+            zero = np.zeros((), dtype=e.type.np_dtype)
+
+            def run_null(cols, params):
+                return (jnp.asarray(zero), jnp.zeros((), dtype=jnp.bool_))
+
+            return run_null
+        if e.type.is_text and isinstance(e.value, str):
+            # Value-producing TEXT constant: encode into the target (or
+            # the session literal) dictionary so the result decodes.
+            pi = self._param(TextEncodeParam(want or LITERAL_DICT, e.value))
+            return lambda cols, params: (params[pi], None)
+        val = _np_cast_const(e.value, e.type)
+        return lambda cols, params: (jnp.asarray(val), None)
+
+    # -- binary ops -----------------------------------------------------
+    def _bin(self, e: E.BinE, dids) -> CompiledExpr:
+        import jax.numpy as jnp
+
+        op = e.op
+        if op in ("and", "or"):
+            lf, rf = self._c(e.left, dids), self._c(e.right, dids)
+            if op == "and":
+                def run_and(cols, params):
+                    ld, lv = lf(cols, params)
+                    rd, rv = rf(cols, params)
+                    if lv is None and rv is None:
+                        return (ld & rd, None)
+                    lF = ld == False if lv is None else (lv & ~ld)  # noqa: E712
+                    rF = rd == False if rv is None else (rv & ~rd)  # noqa: E712
+                    valid = _and_valid(lv, rv)
+                    defl = lF | rF
+                    valid = defl if valid is None else (valid | defl)
+                    data = jnp.where(defl, False, ld & rd)
+                    return (data, valid)
+                return run_and
+
+            def run_or(cols, params):
+                ld, lv = lf(cols, params)
+                rd, rv = rf(cols, params)
+                if lv is None and rv is None:
+                    return (ld | rd, None)
+                lT = ld if lv is None else (lv & ld)
+                rT = rd if rv is None else (rv & rd)
+                valid = _and_valid(lv, rv)
+                deft = lT | rT
+                valid = deft if valid is None else (valid | deft)
+                data = jnp.where(deft, True, ld | rd)
+                return (data, valid)
+            return run_or
+
+        # TEXT comparisons: operate on dictionary codes. Equality works on
+        # codes directly; ordering (<,>) works on codes only if we sorted
+        # the dictionary — we don't, so ordered TEXT comparisons against a
+        # constant use a CodeMaskParam computed host-side.
+        if e.left.type.is_text or e.right.type.is_text:
+            return self._text_cmp(e, dids)
+
+        lf, rf = self._c(e.left, dids), self._c(e.right, dids)
+
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            fn = {
+                "=": jnp.equal,
+                "<>": jnp.not_equal,
+                "<": jnp.less,
+                "<=": jnp.less_equal,
+                ">": jnp.greater,
+                ">=": jnp.greater_equal,
+            }[op]
+
+            def run_cmp(cols, params):
+                ld, lv = lf(cols, params)
+                rd, rv = rf(cols, params)
+                return (fn(ld, rd), _and_valid(lv, rv))
+
+            return run_cmp
+
+        # arithmetic
+        res_t = e.type
+        if res_t.id == t.TypeId.DECIMAL:
+            factor = np.int64(res_t.decimal_factor)
+
+            def run_dec(cols, params):
+                ld, lv = lf(cols, params)
+                rd, rv = rf(cols, params)
+                valid = _and_valid(lv, rv)
+                if op == "+":
+                    return (ld + rd, valid)
+                if op == "-":
+                    return (ld - rd, valid)
+                if op == "*":
+                    # analyzer types the product at scale s1+s2: raw multiply
+                    return (ld * rd, valid)
+                if op == "/":
+                    nz = rd != 0
+                    safe = jnp.where(nz, rd, 1)
+                    out = _div_round(ld * factor, safe, jnp)
+                    valid = nz if valid is None else (valid & nz)
+                    return (out, valid)
+                if op == "%":
+                    nz = rd != 0
+                    safe = jnp.where(nz, rd, 1)
+                    valid = nz if valid is None else (valid & nz)
+                    return (ld % safe, valid)
+                raise NotImplementedError(op)
+
+            return run_dec
+
+        def run_arith(cols, params):
+            ld, lv = lf(cols, params)
+            rd, rv = rf(cols, params)
+            valid = _and_valid(lv, rv)
+            if op == "+":
+                return (ld + rd, valid)
+            if op == "-":
+                return (ld - rd, valid)
+            if op == "*":
+                return (ld * rd, valid)
+            if op in ("/", "//"):
+                nz = rd != 0
+                safe = jnp.where(nz, rd, 1)
+                valid = nz if valid is None else (valid & nz)
+                if op == "//" or res_t.is_integer:
+                    # PG integer division truncates toward zero.
+                    q = jnp.sign(ld) * jnp.sign(safe) * (abs(ld) // abs(safe))
+                    return (q.astype(ld.dtype), valid)
+                return (ld / safe, valid)
+            if op == "%":
+                nz = rd != 0
+                safe = jnp.where(nz, rd, 1)
+                valid = nz if valid is None else (valid & nz)
+                # PG: result takes the sign of the dividend.
+                m = jnp.sign(ld) * (abs(ld) % abs(safe))
+                return (m.astype(ld.dtype), valid)
+            raise NotImplementedError(op)
+
+        return run_arith
+
+    # -- TEXT comparisons ------------------------------------------------
+    def _expr_dict_id(self, e: E.TExpr, dids) -> Optional[str]:
+        if isinstance(e, E.Col):
+            return dids[e.index] if e.index < len(dids) else None
+        if isinstance(e, (E.CastE,)):
+            return self._expr_dict_id(e.operand, dids)
+        if isinstance(e, E.CaseE):
+            for _, v in e.whens:
+                d = self._expr_dict_id(v, dids)
+                if d:
+                    return d
+            if e.default is not None:
+                return self._expr_dict_id(e.default, dids)
+        if isinstance(e, E.FuncE) and e.name == "coalesce":
+            for a in e.args:
+                d = self._expr_dict_id(a, dids)
+                if d:
+                    return d
+        return None
+
+    def _text_cmp(self, e: E.BinE, dids) -> CompiledExpr:
+        import jax.numpy as jnp
+
+        op = e.op
+        # Normalize: column side / const side.
+        if isinstance(e.right, E.Const):
+            col_e, const_e, flip = e.left, e.right, False
+        elif isinstance(e.left, E.Const):
+            col_e, const_e, flip = e.right, e.left, True
+        else:
+            # col-to-col TEXT comparison: only equality is sound on codes
+            # when both sides share a dictionary; cross-dictionary equality
+            # goes through translated codes (executor aligns dictionaries
+            # for join keys; here we require same dict).
+            if op not in ("=", "<>"):
+                raise NotImplementedError("ordered TEXT col-col comparison")
+            lf, rf = self._c(e.left, dids), self._c(e.right, dids)
+            ldid = self._expr_dict_id(e.left, dids)
+            rdid = self._expr_dict_id(e.right, dids)
+            if ldid != rdid:
+                raise NotImplementedError(
+                    "TEXT equality across different dictionaries"
+                )
+
+            def run_cc(cols, params):
+                ld, lv = lf(cols, params)
+                rd, rv = rf(cols, params)
+                d = (ld == rd) if op == "=" else (ld != rd)
+                return (d, _and_valid(lv, rv))
+
+            return run_cc
+
+        did = self._expr_dict_id(col_e, dids)
+        if did is None:
+            raise NotImplementedError("TEXT comparison without dictionary")
+        cf = self._c(col_e, dids)
+        value = const_e.value
+        if value is None:
+            def run_nullcmp(cols, params):
+                d, v = cf(cols, params)
+                return (jnp.zeros(jnp.shape(d), jnp.bool_),
+                        jnp.zeros(jnp.shape(d), jnp.bool_))
+            return run_nullcmp
+
+        if op in ("=", "<>"):
+            pi = self._param(TextCodeParam(did, str(value)))
+
+            def run_eq(cols, params):
+                d, v = cf(cols, params)
+                code = params[pi]
+                out = d == code if op == "=" else d != code
+                return (out, v)
+
+            return run_eq
+
+        # Ordered comparison vs a string constant: host computes the mask
+        # of codes whose string satisfies the comparison.
+        cmp_op = op
+        if flip:
+            cmp_op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}[op]
+        pi = self._param(
+            CodeMaskParam(did, values=(f"__cmp__{cmp_op}__{value}",))
+        )
+
+        def run_ord(cols, params):
+            d, v = cf(cols, params)
+            mask = params[pi]
+            out = mask[jnp.clip(d, 0, mask.shape[0] - 1)]
+            return (out, v)
+
+        return run_ord
+
+    def _in_list(self, e: E.InListE, dids) -> CompiledExpr:
+        import jax.numpy as jnp
+
+        cf = self._c(e.operand, dids)
+        if e.operand.type.is_text:
+            did = self._expr_dict_id(e.operand, dids)
+            if did is None:
+                raise NotImplementedError("TEXT IN without dictionary")
+            vals = tuple(str(i.value) for i in e.items if i.value is not None)
+            pi = self._param(CodeMaskParam(did, values=vals))
+
+            def run_tin(cols, params):
+                d, v = cf(cols, params)
+                mask = params[pi]
+                out = mask[jnp.clip(d, 0, mask.shape[0] - 1)]
+                if e.negated:
+                    out = ~out
+                return (out, v)
+
+            return run_tin
+
+        items = np.asarray(
+            [i.value for i in e.items if i.value is not None],
+            dtype=e.operand.type.np_dtype,
+        )
+
+        def run_in(cols, params):
+            d, v = cf(cols, params)
+            out = jnp.isin(d, jnp.asarray(items))
+            if e.negated:
+                out = ~out
+            return (out, v)
+
+        return run_in
+
+    def _like(self, e: E.LikeE, dids) -> CompiledExpr:
+        import jax.numpy as jnp
+
+        did = self._expr_dict_id(e.operand, dids)
+        if did is None:
+            raise NotImplementedError("LIKE without dictionary")
+        cf = self._c(e.operand, dids)
+        pi = self._param(CodeMaskParam(did, patterns=(e.pattern,), ilike=e.ilike))
+
+        def run_like(cols, params):
+            d, v = cf(cols, params)
+            mask = params[pi]
+            out = mask[jnp.clip(d, 0, mask.shape[0] - 1)]
+            if e.negated:
+                out = ~out
+            return (out, v)
+
+        return run_like
+
+    # -- functions ------------------------------------------------------
+    def _func(self, e: E.FuncE, dids, want=None) -> CompiledExpr:
+        import jax.numpy as jnp
+
+        name = e.name
+        # propagate the target dictionary through value-passing functions
+        vwant = (want or LITERAL_DICT) if e.type.is_text else None
+        argfs = [self._c(a, dids, vwant) for a in e.args]
+
+        if name == "coalesce":
+            def run_coalesce(cols, params):
+                d, v = argfs[0](cols, params)
+                for f in argfs[1:]:
+                    nd, nv = f(cols, params)
+                    if v is None:
+                        return (d, None)
+                    d = jnp.where(v, d, nd)
+                    v = v | (jnp.ones_like(v) if nv is None else nv)
+                return (d, v)
+            return run_coalesce
+
+        if name == "nullif":
+            def run_nullif(cols, params):
+                ad, av = argfs[0](cols, params)
+                bd, bv = argfs[1](cols, params)
+                eq = ad == bd
+                if bv is not None:
+                    eq = eq & bv
+                v = ~eq if av is None else (av & ~eq)
+                return (ad, v)
+            return run_nullif
+
+        simple = {
+            "abs": jnp.abs,
+            "floor": jnp.floor,
+            "ceil": jnp.ceil,
+            "ceiling": jnp.ceil,
+            "sqrt": jnp.sqrt,
+            "exp": jnp.exp,
+            "ln": jnp.log,
+            "sign": jnp.sign,
+        }
+        if name in simple:
+            fn = simple[name]
+            if e.type.id == t.TypeId.DECIMAL and name == "abs":
+                fn = jnp.abs
+
+            def run_simple(cols, params):
+                d, v = argfs[0](cols, params)
+                return (fn(d), v)
+            return run_simple
+
+        if name == "round":
+            arg_t = e.args[0].type
+            if arg_t.id == t.TypeId.DECIMAL:
+                digits = 0
+                if len(e.args) > 1 and isinstance(e.args[1], E.Const):
+                    digits = int(e.args[1].value)
+                shift = 10 ** max(arg_t.scale - digits, 0)
+
+                def run_round_dec(cols, params):
+                    d, v = argfs[0](cols, params)
+                    if shift == 1:
+                        return (d, v)
+                    return (_div_round(d, np.int64(shift), jnp) * shift, v)
+                return run_round_dec
+
+            def run_round(cols, params):
+                d, v = argfs[0](cols, params)
+                if len(argfs) > 1:
+                    nd, _ = argfs[1](cols, params)
+                    f = 10.0 ** nd
+                    return (jnp.round(d * f) / f, v)
+                return (jnp.round(d), v)
+            return run_round
+
+        if name in ("extract_year", "extract_month", "extract_day"):
+            part = name.split("_")[1]
+
+            def run_extract(cols, params):
+                d, v = argfs[0](cols, params)
+                if e.args[0].type.id == t.TypeId.TIMESTAMP:
+                    days = (d // np.int64(86_400_000_000)).astype(jnp.int32)
+                else:
+                    days = d.astype(jnp.int32)
+                y, m, dd = _civil_from_days(days, jnp)
+                out = {"year": y, "month": m, "day": dd}[part]
+                return (out.astype(jnp.int32), v)
+            return run_extract
+
+        if name == "date_trunc_year":
+            def run_trunc_year(cols, params):
+                d, v = argfs[0](cols, params)
+                days = d.astype(jnp.int32)
+                y, _, _ = _civil_from_days(days, jnp)
+                jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y), jnp)
+                return (jan1.astype(jnp.int32), v)
+            return run_trunc_year
+
+        if name in ("greatest", "least"):
+            red = jnp.maximum if name == "greatest" else jnp.minimum
+
+            def run_gl(cols, params):
+                d, v = argfs[0](cols, params)
+                for f in argfs[1:]:
+                    nd, nv = f(cols, params)
+                    d = red(d, nd)
+                    v = _and_valid(v, nv)
+                return (d, v)
+            return run_gl
+
+        if name == "date_add_days":
+            def run_dad(cols, params):
+                d, v = argfs[0](cols, params)
+                nd, nv = argfs[1](cols, params)
+                return ((d + nd).astype(jnp.int32), _and_valid(v, nv))
+            return run_dad
+
+        if name == "power":
+            def run_pow(cols, params):
+                ad, av = argfs[0](cols, params)
+                bd, bv = argfs[1](cols, params)
+                return (jnp.power(ad, bd), _and_valid(av, bv))
+            return run_pow
+
+        raise NotImplementedError(f"function {name}")
+
+    def _case(self, e: E.CaseE, dids, want=None) -> CompiledExpr:
+        import jax.numpy as jnp
+
+        vwant = (want or LITERAL_DICT) if e.type.is_text else None
+        whenfs = [
+            (self._c(c, dids), self._c(v, dids, vwant)) for c, v in e.whens
+        ]
+        deff = self._c(e.default, dids, vwant) if e.default is not None else None
+
+        def run_case(cols, params):
+            if deff is not None:
+                out, outv = deff(cols, params)
+            else:
+                out = jnp.zeros((), dtype=e.type.np_dtype)
+                outv = jnp.zeros((), dtype=jnp.bool_)
+            # evaluate in reverse: earlier WHENs override later ones
+            for cf, vf in reversed(whenfs):
+                cd, cv = cf(cols, params)
+                hit = cd if cv is None else (cd & cv)
+                vd, vv = vf(cols, params)
+                out = jnp.where(hit, vd, out)
+                if outv is None and vv is None:
+                    outv = None
+                else:
+                    o = jnp.ones_like(hit) if outv is None else outv
+                    nv = jnp.ones_like(hit) if vv is None else vv
+                    outv = jnp.where(hit, nv, o)
+            return (out, outv)
+
+        return run_case
+
+    def _cast(self, e: E.CastE, dids, want=None) -> CompiledExpr:
+        import jax.numpy as jnp
+
+        cf = self._c(
+            e.operand, dids, want if e.operand.type.is_text else None
+        )
+        src, dst = e.operand.type, e.type
+
+        def run_cast(cols, params):
+            d, v = cf(cols, params)
+            return (_cast_data(d, src, dst, jnp), v)
+
+        return run_cast
+
+
+# ---------------------------------------------------------------------------
+# helpers shared with kernels
+# ---------------------------------------------------------------------------
+
+
+def _div_round(num, den, xp):
+    """Round-half-away-from-zero integer division (PG numeric semantics)."""
+    half = den // 2
+    adj = xp.where(num >= 0, half, -half)
+    return (num + adj) // den
+
+
+def _cast_data(d, src: t.SqlType, dst: t.SqlType, xp):
+    if src.id == dst.id and src.scale == dst.scale:
+        return d
+    if dst.id == t.TypeId.DECIMAL:
+        if src.id == t.TypeId.DECIMAL:
+            if dst.scale >= src.scale:
+                return d * np.int64(10 ** (dst.scale - src.scale))
+            return _div_round(d, np.int64(10 ** (src.scale - dst.scale)), xp)
+        if src.is_integer or src.id == t.TypeId.BOOL:
+            return d.astype(xp.int64) * np.int64(dst.decimal_factor)
+        # float -> decimal
+        return xp.round(d.astype(xp.float64) * dst.decimal_factor).astype(xp.int64)
+    if src.id == t.TypeId.DECIMAL:
+        if dst.is_integer:
+            return _div_round(d, np.int64(src.decimal_factor), xp).astype(
+                dst.np_dtype
+            )
+        return (d / src.decimal_factor).astype(_dev_dtype(dst, xp))
+    if src.id == t.TypeId.DATE and dst.id == t.TypeId.TIMESTAMP:
+        return d.astype(xp.int64) * np.int64(86_400_000_000)
+    if src.id == t.TypeId.TIMESTAMP and dst.id == t.TypeId.DATE:
+        return (d // np.int64(86_400_000_000)).astype(xp.int32)
+    if dst.is_integer and src.id in (t.TypeId.FLOAT4, t.TypeId.FLOAT8):
+        return xp.trunc(d).astype(dst.np_dtype)
+    return d.astype(_dev_dtype(dst, xp))
+
+
+def _dev_dtype(ty: t.SqlType, xp):
+    """Device dtype: FLOAT8 computes as f32 on TPU (types.py rationale)."""
+    import jax.numpy as jnp
+
+    if xp is jnp and ty.id == t.TypeId.FLOAT8:
+        return jnp.float32
+    return ty.np_dtype
+
+
+# Howard Hinnant's civil-from-days algorithm, vectorized (date_part analog).
+def _civil_from_days(z, xp):
+    z = z.astype(xp.int32) + 719468
+    era = xp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = xp.where(mp < 10, mp + 3, mp - 9)
+    y = xp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d, xp):
+    y = y - (m <= 2)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+# ---------------------------------------------------------------------------
+# Host-side param resolution
+# ---------------------------------------------------------------------------
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _like_to_regex(pattern: str) -> str:
+    import re
+
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == "\\" and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+def resolve_param(spec: ParamSpec, dictionaries, subquery_values=None):
+    """Compute the runtime value of a ParamSpec.
+
+    ``dictionaries``: dict_id -> Dictionary.  ``subquery_values``: list of
+    (python value, SqlType) per subplan index.
+    """
+    import re
+
+    import jax.numpy as jnp
+
+    if isinstance(spec, TextCodeParam):
+        d = dictionaries[spec.dict_id]
+        code = d.get_code(spec.value)
+        return jnp.int32(-1 if code is None else code)
+
+    if isinstance(spec, TextEncodeParam):
+        d = dictionaries[spec.dict_id]
+        return jnp.int32(d.encode_one(spec.value))
+
+    if isinstance(spec, DictTranslateParam):
+        src = dictionaries[spec.src]
+        dst = dictionaries[spec.dst]
+        n = max(_next_pow2(len(src.values)), 1)
+        table = np.zeros(n, dtype=np.int32)
+        if src.values:
+            table[: len(src.values)] = dst.encode(list(src.values))
+        return jnp.asarray(table)
+
+    if isinstance(spec, CodeMaskParam):
+        d = dictionaries[spec.dict_id]
+        vals = d.values
+        n = max(_next_pow2(len(vals)), 1)
+        mask = np.zeros(n, dtype=np.bool_)
+        if spec.patterns:
+            for p in spec.patterns:
+                flags = re.IGNORECASE if spec.ilike else 0
+                rx = re.compile(_like_to_regex(p), flags)
+                for i, s in enumerate(vals):
+                    if rx.match(s):
+                        mask[i] = True
+        else:
+            for v in spec.values:
+                if v.startswith("__cmp__"):
+                    _, _, rest = v.partition("__cmp__")
+                    op, _, ref = rest.partition("__")
+                    cmpf = {
+                        "<": lambda s: s < ref,
+                        "<=": lambda s: s <= ref,
+                        ">": lambda s: s > ref,
+                        ">=": lambda s: s >= ref,
+                    }[op]
+                    for i, s in enumerate(vals):
+                        if cmpf(s):
+                            mask[i] = True
+                else:
+                    code = d.get_code(v)
+                    if code is not None:
+                        mask[code] = True
+        return jnp.asarray(mask)
+
+    if isinstance(spec, SubqueryScalarParam):
+        assert subquery_values is not None, "subquery params not bound"
+        value, ty = subquery_values[spec.index]
+        if value is None:
+            return (
+                jnp.zeros((), dtype=ty.np_dtype),
+                jnp.zeros((), dtype=jnp.bool_),
+            )
+        return (
+            jnp.asarray(np.asarray(value, dtype=ty.np_dtype)),
+            jnp.ones((), dtype=jnp.bool_),
+        )
+
+    raise TypeError(f"unknown param spec {spec}")

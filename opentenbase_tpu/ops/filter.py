@@ -1,0 +1,55 @@
+"""Selection + compaction kernels.
+
+The reference's qual evaluation drops tuples one at a time inside ExecScan
+(src/backend/executor/execScan.c). Vectorized equivalent: predicates produce
+a boolean mask; operators that tolerate masks (aggregate, redistribute)
+consume it directly, and operators that need dense inputs (sort, join build)
+compact via a static-size ``nonzero`` gather — the two-pass "count then
+materialize" strategy SURVEY.md §7 prescribes for dynamic cardinalities.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def bucket_size(n: int, floor: int = 16) -> int:
+    """Static-shape bucket: next power of two ≥ n (bounds recompiles)."""
+    p = floor
+    while p < n:
+        p <<= 1
+    return p
+
+
+@partial(jax.jit)
+def mask_count(mask) -> jax.Array:
+    return jnp.sum(mask, dtype=jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("out_size",))
+def compact_indices(mask, out_size: int):
+    """Indices of True lanes, padded to ``out_size``; returns (idx, valid).
+
+    Padded lanes point at row 0 with valid=False, so downstream gathers
+    stay in-bounds without branching.
+    """
+    (idx,) = jnp.nonzero(mask, size=out_size, fill_value=0)
+    valid = jnp.arange(out_size, dtype=jnp.int32) < jnp.sum(mask, dtype=jnp.int32)
+    return idx, valid
+
+
+def gather_cols(cols, idx, row_valid):
+    """Gather (data, valid) column pairs by row indices; padded rows are
+    NULL (their validity is forced off by ``row_valid``)."""
+    out = []
+    for data, valid in cols:
+        d = jnp.take(data, idx, axis=0)
+        if valid is None:
+            v = row_valid
+        else:
+            v = jnp.take(valid, idx, axis=0) & row_valid
+        out.append((d, v))
+    return out

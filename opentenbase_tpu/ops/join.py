@@ -1,0 +1,136 @@
+"""Equi-join kernels.
+
+The reference's hash join (src/backend/executor/nodeHash.c +
+nodeHashjoin.c) builds a bucketed hash table and probes tuple-at-a-time.
+A serial-probe hash table is hostile to the TPU's vector units, so the
+device formulation is sort + binary search:
+
+1. ``encode_keys``: both sides' key tuples are jointly sorted and replaced
+   by dense int32 *group ids* — equal tuples (across sides) get equal ids,
+   NULLs get non-matching sentinels. This removes multi-key/width issues
+   entirely; a single int32 id is what searchsorted sees.
+2. ``match_counts``: sort build ids; per probe row, searchsorted left/right
+   gives the contiguous match range [lo, hi). (= hash-bucket lookup, but
+   branch-free and O(log n) vectorized.)
+3. ``emit_pairs(out_size)``: expand ranges into (probe_idx, build_idx)
+   pairs at a static padded size — the host rounds total match count up to
+   a bucket, the two-pass sizing strategy of SURVEY.md §7.
+
+Outer/semi/anti variants derive from the same counts: LEFT emits one
+null-extended row when count==0; SEMI keeps probe rows with count>0; ANTI
+keeps count==0. (RIGHT joins are planned as flipped LEFT joins.)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_NO_MATCH_A = jnp.int32(-2)  # build-side NULL key
+_NO_MATCH_B = jnp.int32(-3)  # probe-side NULL key
+
+
+@partial(jax.jit)
+def encode_keys(build_keys, probe_keys, build_mask, probe_mask):
+    """Jointly encode key tuples as dense int32 ids.
+
+    build_keys/probe_keys: lists of (data, valid_or_None), equal arity and
+    compatible dtypes pairwise. masks: visible-row masks or None.
+    Returns (build_ids, probe_ids) where invisible/NULL rows get distinct
+    negative sentinels that can never match.
+    """
+    nb = build_keys[0][0].shape[0]
+    npr = probe_keys[0][0].shape[0]
+    parts = []
+    for (bd, bv), (pd, pv) in zip(build_keys, probe_keys):
+        if jnp.issubdtype(bd.dtype, jnp.floating) or jnp.issubdtype(
+            pd.dtype, jnp.floating
+        ):
+            bd = jax.lax.bitcast_convert_type(bd.astype(jnp.float32), jnp.int32)
+            pd = jax.lax.bitcast_convert_type(pd.astype(jnp.float32), jnp.int32)
+        d = jnp.concatenate([bd.astype(jnp.int64), pd.astype(jnp.int64)])
+        if bv is None and pv is None:
+            v = None
+        else:
+            bvv = jnp.ones(nb, jnp.bool_) if bv is None else bv
+            pvv = jnp.ones(npr, jnp.bool_) if pv is None else pv
+            v = jnp.concatenate([bvv, pvv])
+        parts.append((d, v))
+
+    n = nb + npr
+    perm = jnp.arange(n, dtype=jnp.int32)
+    for d, v in reversed(parts):
+        order = jnp.argsort(jnp.take(d, perm, axis=0), stable=True)
+        perm = jnp.take(perm, order, axis=0)
+    boundary = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
+    for d, v in parts:
+        ds = jnp.take(d, perm, axis=0)
+        boundary = boundary | jnp.concatenate(
+            [jnp.ones(1, jnp.bool_), ds[1:] != ds[:-1]]
+        )
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    ids = jnp.zeros(n, dtype=jnp.int32).at[perm].set(seg)
+
+    build_ids, probe_ids = ids[:nb], ids[nb:]
+    # NULL in any key column -> never matches
+    bnull = jnp.zeros(nb, jnp.bool_)
+    pnull = jnp.zeros(npr, jnp.bool_)
+    for (bd, bv), (pd, pv) in zip(build_keys, probe_keys):
+        if bv is not None:
+            bnull = bnull | ~bv
+        if pv is not None:
+            pnull = pnull | ~pv
+    if build_mask is not None:
+        bnull = bnull | ~build_mask
+    if probe_mask is not None:
+        pnull = pnull | ~probe_mask
+    build_ids = jnp.where(bnull, _NO_MATCH_A, build_ids)
+    probe_ids = jnp.where(pnull, _NO_MATCH_B, probe_ids)
+    return build_ids, probe_ids
+
+
+@partial(jax.jit)
+def match_counts(build_ids, probe_ids):
+    """Sort build ids; per probe row compute [lo, hi) match range.
+    Returns (build_order, lo, counts, total)."""
+    build_order = jnp.argsort(build_ids, stable=True).astype(jnp.int32)
+    sorted_ids = jnp.take(build_ids, build_order, axis=0)
+    lo = jnp.searchsorted(sorted_ids, probe_ids, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(sorted_ids, probe_ids, side="right").astype(jnp.int32)
+    counts = hi - lo
+    total = jnp.sum(counts.astype(jnp.int64))
+    return build_order, lo, counts, total
+
+
+@partial(jax.jit, static_argnames=("out_size", "outer"))
+def emit_pairs(build_order, lo, counts, out_size: int, outer: bool = False):
+    """Expand match ranges to row-index pairs at static ``out_size``.
+
+    Returns (probe_idx, build_idx, matched, valid):
+      - probe_idx/build_idx: gather indices into the original (uncompacted)
+        probe/build batches; build_idx is 0 where matched is False.
+      - matched[j]: the pair is a real key match (False for the
+        null-extended rows LEFT join emits when outer=True).
+      - valid[j]: lane j is a real output row (False = padding).
+    """
+    eff = jnp.maximum(counts, 1) if outer else counts
+    offsets = jnp.cumsum(eff) - eff  # exclusive prefix sum
+    total = offsets[-1] + eff[-1] if counts.shape[0] > 0 else jnp.int32(0)
+
+    j = jnp.arange(out_size, dtype=jnp.int32)
+    # probe row for output lane j: last i with offsets[i] <= j
+    probe_idx = (
+        jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
+    )
+    probe_idx = jnp.clip(probe_idx, 0, counts.shape[0] - 1)
+    k = j - jnp.take(offsets, probe_idx, axis=0)
+    cnt_j = jnp.take(counts, probe_idx, axis=0)
+    matched = k < cnt_j
+    pos = jnp.take(lo, probe_idx, axis=0) + jnp.minimum(k, jnp.maximum(cnt_j - 1, 0))
+    pos = jnp.clip(pos, 0, build_order.shape[0] - 1)
+    build_idx = jnp.take(build_order, pos, axis=0)
+    build_idx = jnp.where(matched, build_idx, 0)
+    valid = j < total
+    return probe_idx, build_idx, matched, valid

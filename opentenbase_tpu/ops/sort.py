@@ -1,0 +1,57 @@
+"""Multi-key stable sort on device.
+
+The reference's tuplesort (src/backend/utils/sort/tuplesort.c) is a
+comparator-driven quicksort/merge with spill-to-disk. On TPU the analog is
+iterated stable argsort passes from least- to most-significant key —
+each pass is an XLA sort over the whole column, fully parallel on the VPU.
+
+NULL placement follows PG defaults (NULLS LAST for ASC, NULLS FIRST for
+DESC) via a dedicated stable pass on the null flag, so sentinel collisions
+with real extreme values are impossible.
+
+TEXT keys sort by dictionary *rank* (host-computed order-preserving int32
+per code, see executor bind step), never by raw code.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def order_indices(keys, nrows_mask=None):
+    """Stable lexicographic order over ``keys``.
+
+    keys: list of (data, valid_or_None, descending, nulls_first) in
+    major-to-minor significance order. ``nrows_mask``: optional bool mask;
+    masked-out (invisible) rows sort to the very end.
+    Returns an int32 permutation.
+    """
+    n = keys[0][0].shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    # least-significant first
+    for data, valid, desc, nulls_first in reversed(keys):
+        k = jnp.take(data, perm, axis=0)
+        if desc:
+            order = jnp.argsort(-_rankable(k), stable=True)
+        else:
+            order = jnp.argsort(_rankable(k), stable=True)
+        perm = jnp.take(perm, order, axis=0)
+        if valid is not None:
+            nf = nulls_first if nulls_first is not None else desc
+            nullflag = ~jnp.take(valid, perm, axis=0)
+            key = jnp.where(nullflag, 0, 1) if nf else jnp.where(nullflag, 1, 0)
+            order = jnp.argsort(key, stable=True)
+            perm = jnp.take(perm, order, axis=0)
+    if nrows_mask is not None:
+        dead = ~jnp.take(nrows_mask, perm, axis=0)
+        order = jnp.argsort(dead.astype(jnp.int32), stable=True)
+        perm = jnp.take(perm, order, axis=0)
+    return perm
+
+
+def _rankable(k):
+    """Map to a totally ordered key of the same order. Floats: push NaNs
+    last (argsort already does); ints/bools pass through."""
+    if jnp.issubdtype(k.dtype, jnp.bool_):
+        return k.astype(jnp.int32)
+    return k

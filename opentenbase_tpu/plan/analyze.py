@@ -86,27 +86,36 @@ def _timestamp_us(s: str) -> int:
 
 
 def literal_to_physical(value: object, ty: t.SqlType) -> object:
-    """Convert a python literal to ``ty``'s physical representation."""
+    """Convert a python literal to ``ty``'s physical representation.
+    Raises AnalyzeError (never a bare ValueError) on malformed input so
+    callers' coercion fallbacks work."""
     if value is None:
         return None
     tid = ty.id
-    if tid == t.TypeId.DECIMAL:
-        return round(float(value) * ty.decimal_factor)
-    if tid == t.TypeId.DATE:
-        return _date_days(str(value)) if isinstance(value, str) else int(value)
-    if tid == t.TypeId.TIMESTAMP:
-        return _timestamp_us(str(value)) if isinstance(value, str) else int(value)
-    if tid in (t.TypeId.INT4, t.TypeId.INT8):
-        iv = int(value)  # type: ignore[arg-type]
-        if isinstance(value, float) and value != iv:
-            raise AnalyzeError(f"invalid integer literal {value!r}")
-        return iv
-    if tid in (t.TypeId.FLOAT4, t.TypeId.FLOAT8):
-        return float(value)  # type: ignore[arg-type]
-    if tid == t.TypeId.BOOL:
-        return bool(value)
-    if tid == t.TypeId.TEXT:
-        return str(value)
+    try:
+        if tid == t.TypeId.DECIMAL:
+            return round(float(value) * ty.decimal_factor)
+        if tid == t.TypeId.DATE:
+            return _date_days(str(value)) if isinstance(value, str) else int(value)
+        if tid == t.TypeId.TIMESTAMP:
+            return _timestamp_us(str(value)) if isinstance(value, str) else int(value)
+        if tid in (t.TypeId.INT4, t.TypeId.INT8):
+            iv = int(value)  # type: ignore[arg-type]
+            if isinstance(value, float) and value != iv:
+                raise AnalyzeError(f"invalid integer literal {value!r}")
+            return iv
+        if tid in (t.TypeId.FLOAT4, t.TypeId.FLOAT8):
+            return float(value)  # type: ignore[arg-type]
+        if tid == t.TypeId.BOOL:
+            return bool(value)
+        if tid == t.TypeId.TEXT:
+            return str(value)
+    except AnalyzeError:
+        raise
+    except (TypeError, ValueError):
+        raise AnalyzeError(
+            f"invalid literal {value!r} for type {ty}"
+        ) from None
     raise AnalyzeError(f"cannot convert literal to {ty}")
 
 
@@ -188,12 +197,15 @@ class GroupedContext:
         self.aggs: list[E.AggCall] = []
 
     def agg_col(self, call: E.AggCall) -> E.Col:
+        # Offset by len(group_texprs), not the deduped key dict: the
+        # Aggregate node outputs one __g column per group expression entry.
+        base = len(self.group_texprs)
         k = call.key()
         for i, existing in enumerate(self.aggs):
             if existing.key() == k:
-                return E.Col(len(self.group_keys) + i, existing.type)
+                return E.Col(base + i, existing.type)
         self.aggs.append(call)
-        return E.Col(len(self.group_keys) + len(self.aggs) - 1, call.type)
+        return E.Col(base + len(self.aggs) - 1, call.type)
 
 
 def _bool_type(e: E.TExpr) -> E.TExpr:
@@ -373,15 +385,19 @@ class Analyzer:
 
         order_hidden: list[E.TExpr] = []
         if has_aggs:
-            plan, out_exprs, out_schema, gctx = self._grouped(sel, plan, ctx)
-            post_scope = scope_from_schema(plan.schema, None)
+            inplan, group_texprs, having_te, out_exprs, out_schema, gctx = (
+                self._grouped(sel, plan, ctx)
+            )
+            post_scope = scope
         else:
             out_exprs, out_schema = self._select_items(sel.items, ctx, scope)
             gctx = None
             post_scope = scope
 
         # ORDER BY: resolve against output aliases/positions first, else
-        # against the pre-projection scope (hidden junk columns).
+        # against the pre-projection scope (hidden junk columns). For
+        # grouped queries this may append new aggregates to gctx.aggs, so
+        # the Aggregate node is only built afterwards.
         sort_keys: list[L.SortKey] = []
         if sel.order_by:
             for si in sel.order_by:
@@ -389,6 +405,11 @@ class Analyzer:
                     si.expr, sel, out_exprs, out_schema, ctx, gctx, order_hidden, post_scope
                 )
                 sort_keys.append(L.SortKey(keyexpr, si.descending, si.nulls_first))
+
+        if has_aggs:
+            plan = self._build_aggregate(
+                inplan, group_texprs, gctx, having_te, ctx
+            )
 
         nvisible = len(out_exprs)
         proj_exprs = tuple(out_exprs) + tuple(order_hidden)
@@ -494,7 +515,6 @@ class Analyzer:
                 right_keys.append(_cast(E.Col(ri, rc.type, name), ct))
         elif ref.condition is not None:
             conjuncts = _split_and(ref.condition)
-            nleft = len(ls.cols)
             for c in conjuncts:
                 pair = self._equi_key(c, ls, rs)
                 if pair is not None:
@@ -504,10 +524,7 @@ class Analyzer:
                     ctx = ExprContext(scope, self)
                     te = _bool_type(self.expr(c, ctx))
                     residual = te if residual is None else E.BinE("and", residual, te, t.BOOL)
-            if not left_keys:
-                # pure theta-join: run as cross join + residual filter
-                pass
-            del nleft
+        # empty key tuples = pure theta-join: cross join + residual filter
         plan = L.Join(lp, rp, jt, tuple(left_keys), tuple(right_keys), residual, scope.out_schema())
         return plan, scope
 
@@ -519,10 +536,12 @@ class Analyzer:
         if not (isinstance(cond, A.BinOp) and cond.op == "="):
             return None
         for a, b in ((cond.left, cond.right), (cond.right, cond.left)):
+            mark = len(self.subplans)
             try:
                 te_l = self.expr(a, ExprContext(ls, self))
                 te_r = self.expr(b, ExprContext(rs, self))
             except AnalyzeError:
+                del self.subplans[mark:]  # drop orphans of the failed try
                 continue
             ct = (
                 te_l.type
@@ -542,12 +561,18 @@ class Analyzer:
         out_schema: list[L.OutCol] = []
         for item in items:
             if isinstance(item.expr, A.Star):
+                matched = 0
                 for i, c in enumerate(scope.cols):
                     if item.expr.table is not None and c.qualifier != item.expr.table:
                         continue
                     out_exprs.append(E.Col(i, c.type, c.name))
                     out_schema.append(L.OutCol(c.name, c.type, c.dict_id))
-                if not out_exprs:
+                    matched += 1
+                if not matched:
+                    if item.expr.table is not None:
+                        raise AnalyzeError(
+                            f'missing FROM-clause entry for table "{item.expr.table}"'
+                        )
                     raise AnalyzeError("SELECT * with no columns in scope")
                 continue
             te = self.expr(item.expr, ctx)
@@ -576,6 +601,18 @@ class Analyzer:
         if sel.having is not None:
             having_te = _bool_type(self.expr(sel.having, agg_ctx))
 
+        # NB: the Aggregate node itself is built by the caller (after ORDER
+        # BY resolution, which may append further aggregates to gctx.aggs).
+        return plan, group_texprs, having_te, out_exprs, out_schema, gctx
+
+    def _build_aggregate(
+        self,
+        plan: L.LogicalPlan,
+        group_texprs: list[E.TExpr],
+        gctx: GroupedContext,
+        having_te: Optional[E.TExpr],
+        ctx: ExprContext,
+    ) -> L.LogicalPlan:
         agg_schema = tuple(
             [
                 L.OutCol(f"__g{i}", g.type, _texpr_dict_id(g, ctx.scope))
@@ -583,13 +620,12 @@ class Analyzer:
             ]
             + [L.OutCol(f"__a{i}", a.type) for i, a in enumerate(gctx.aggs)]
         )
-        agg_plan = L.Aggregate(
+        result: L.LogicalPlan = L.Aggregate(
             plan, tuple(group_texprs), tuple(gctx.aggs), agg_schema
         )
-        result: L.LogicalPlan = agg_plan
         if having_te is not None:
             result = L.Filter(result, having_te, result.schema)
-        return result, out_exprs, out_schema, gctx
+        return result
 
     def _contains_agg(self, e: A.Expr) -> bool:
         if isinstance(e, A.FuncCall) and e.name in AGG_FUNCS:
@@ -714,9 +750,12 @@ class Analyzer:
     # Expressions
     # ==================================================================
     def expr(self, e: A.Expr, ctx: ExprContext) -> E.TExpr:
-        # Grouped context: whole-expression match against GROUP BY items
+        # Grouped context: whole-expression match against GROUP BY items.
+        # The speculative analysis may register scalar subplans; roll them
+        # back if the attempt is discarded, else the orphans execute twice.
         if ctx.grouped is not None and not isinstance(e, A.Literal):
             g = ctx.grouped
+            mark = len(self.subplans)
             try:
                 te = self.expr(e, g.input_ctx)
             except AnalyzeError:
@@ -726,6 +765,7 @@ class Analyzer:
                 return E.Col(i, te.type)
             if isinstance(te, E.Const):
                 return te
+            del self.subplans[mark:]
         result = self._expr_inner(e, ctx)
         if isinstance(result, _Interval):
             raise AnalyzeError("interval value not allowed here")

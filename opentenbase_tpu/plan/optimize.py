@@ -53,12 +53,11 @@ def _used_cols(e: E.TExpr, acc: set[int]) -> None:
             acc.add(n.index)
 
 
-def _prune(plan: L.LogicalPlan, required: Optional[set[int]]):
-    """Rewrite ``plan`` so it outputs only ``required`` columns (None = all),
-    pruning unused Scan columns underneath. Returns (new_plan, mapping)
-    where mapping maps old output index -> new output index."""
-    new_plan, mapping = _prune_node(plan, required)
-    return new_plan if required is None else new_plan
+def _prune(plan: L.LogicalPlan, required: Optional[set[int]]) -> L.LogicalPlan:
+    """Rewrite ``plan`` so unused Scan columns underneath are pruned
+    (``required`` = output columns the caller needs, None = all)."""
+    new_plan, _ = _prune_node(plan, required)
+    return new_plan
 
 
 def _identity(n: int) -> dict[int, int]:
@@ -195,7 +194,7 @@ def _prune_node(plan: L.LogicalPlan, required: Optional[set[int]]):
                 L.SortKey(_remap_expr(k.expr, cmap), k.descending, k.nulls_first)
                 for k in plan.keys
             )
-            return L.Sort(child, keys, child.schema), _identity(len(child.schema))
+            return L.Sort(child, keys, child.schema), cmap
         child, cmap = _prune_node(plan.child, set(range(len(plan.child.schema))))
         if isinstance(plan, L.Limit):
             return L.Limit(child, plan.limit, plan.offset, child.schema), cmap
@@ -203,12 +202,20 @@ def _prune_node(plan: L.LogicalPlan, required: Optional[set[int]]):
 
     if isinstance(plan, L.Union):
         inputs = []
-        mapping: dict[int, int] = {}
         keep = sorted(req)
         for inp in plan.inputs:
-            ni, _ = _prune_node(inp, set(keep))
+            ni, imap = _prune_node(inp, set(keep))
+            # A child is free to ignore the hint (Sort/Limit/Distinct keep
+            # everything); align it to exactly `keep` in order via its
+            # returned mapping, adding a Project when it doesn't line up.
+            want = [imap[i] for i in keep]
+            if want != list(range(len(ni.schema))):
+                exprs = tuple(
+                    E.Col(j, ni.schema[j].type, ni.schema[j].name) for j in want
+                )
+                schema_i = tuple(ni.schema[j] for j in want)
+                ni = L.Project(ni, exprs, schema_i)
             inputs.append(ni)
-        # children were pruned to `keep` in order
         mapping = {old: new for new, old in enumerate(keep)}
         schema = tuple(plan.schema[i] for i in keep)
         return L.Union(tuple(inputs), schema), mapping

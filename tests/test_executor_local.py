@@ -1,0 +1,292 @@
+"""Local (single-shard) executor tests: SQL -> logical plan -> device
+kernels -> host results, mirroring the per-DN slice of the reference's
+regression suite (src/test/regress/sql — the single-node subset)."""
+
+import pytest
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.catalog.catalog import Catalog
+from opentenbase_tpu.catalog.distribution import DistributionSpec, DistStrategy
+from opentenbase_tpu.catalog.nodes import NodeDef, NodeManager, NodeRole
+from opentenbase_tpu.catalog.shardmap import ShardMap
+from opentenbase_tpu.executor.local import LocalExecutor
+from opentenbase_tpu.plan import analyze_statement
+from opentenbase_tpu.plan.optimize import prune_columns
+from opentenbase_tpu.sql import parse_one
+from opentenbase_tpu.storage.table import ColumnBatch, ShardStore
+
+
+@pytest.fixture(scope="module")
+def db():
+    nm = NodeManager()
+    nm.create_node(NodeDef("dn0", NodeRole.DATANODE))
+    sm = ShardMap(64)
+    sm.initialize(nm.datanode_indices())
+    cat = Catalog(nm, sm)
+    stores = {}
+
+    def make_table(name, schema, rows):
+        meta = cat.create_table(
+            name, schema, DistributionSpec(DistStrategy.ROUNDROBIN)
+        )
+        store = ShardStore(meta.schema, meta.dictionaries)
+        data = {c: [r[i] for r in rows] for i, c in enumerate(schema)}
+        batch = ColumnBatch.from_pydict(data, meta.schema, meta.dictionaries)
+        store.append_batch(batch, xmin_ts=1)
+        stores[name] = store
+
+    make_table(
+        "item",
+        {
+            "id": t.INT8,
+            "qty": t.decimal(12, 2),
+            "price": t.decimal(12, 2),
+            "flag": t.TEXT,
+            "ship": t.DATE,
+        },
+        [
+            (1, 10.00, 5.50, "A", "2024-01-05"),
+            (2, 3.25, 2.00, "B", "2024-02-10"),
+            (3, 7.00, 1.25, "A", "2024-01-20"),
+            (4, None, 9.99, "C", "2024-03-01"),
+            (5, 2.50, None, "B", "2024-02-28"),
+            (6, 4.00, 3.00, None, "2024-03-15"),
+        ],
+    )
+    make_table(
+        "customer",
+        {"c_id": t.INT8, "c_name": t.TEXT, "c_nation": t.TEXT},
+        [
+            (1, "alice", "FR"),
+            (2, "bob", "DE"),
+            (3, "carol", "FR"),
+            (4, "dave", None),
+        ],
+    )
+    make_table(
+        "orders",
+        {"o_id": t.INT8, "o_cust": t.INT8, "o_total": t.decimal(12, 2)},
+        [
+            (100, 1, 10.00),
+            (101, 1, 20.00),
+            (102, 2, 5.00),
+            (103, 3, 7.50),
+            (104, None, 1.00),
+            (105, 9, 2.00),
+        ],
+    )
+    return cat, stores
+
+
+def run(db, sql):
+    cat, stores = db
+    stmt = parse_one(sql)
+    plan = prune_columns(analyze_statement(stmt, cat))
+    ex = LocalExecutor(cat, stores)
+    return ex.execute(plan).to_rows()
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_scan_all(db):
+    rows = run(db, "select id from item")
+    assert sorted(r[0] for r in rows) == [1, 2, 3, 4, 5, 6]
+
+
+def test_filter_arith(db):
+    rows = run(db, "select id, qty * price from item where qty * price > 8")
+    got = {r[0]: r[1] for r in rows}
+    assert got == {1: 55.0, 3: 8.75, 6: 12.0}
+
+
+def test_filter_nulls_excluded(db):
+    # NULL qty/price rows must not pass the predicate (3-valued logic)
+    rows = run(db, "select id from item where qty > 0 and price > 0")
+    assert sorted(r[0] for r in rows) == [1, 2, 3, 6]
+
+
+def test_is_null(db):
+    rows = run(db, "select id from item where qty is null")
+    assert [r[0] for r in rows] == [4]
+    rows = run(db, "select id from item where flag is not null order by id")
+    assert [r[0] for r in rows] == [1, 2, 3, 4, 5]
+
+
+def test_text_equality_and_in(db):
+    rows = run(db, "select id from item where flag = 'A' order by id")
+    assert [r[0] for r in rows] == [1, 3]
+    rows = run(db, "select id from item where flag in ('A','C') order by id")
+    assert [r[0] for r in rows] == [1, 3, 4]
+
+
+def test_like(db):
+    rows = run(db, "select c_id from customer where c_name like '%a%' order by c_id")
+    assert [r[0] for r in rows] == [1, 3, 4]
+
+
+def test_date_compare(db):
+    rows = run(
+        db, "select id from item where ship >= date '2024-02-01' order by id"
+    )
+    assert [r[0] for r in rows] == [2, 4, 5, 6]
+
+
+def test_scalar_aggs(db):
+    rows = run(
+        db,
+        "select count(*), count(qty), sum(qty), min(price), max(price), avg(price) from item",
+    )
+    (cstar, cq, sq, mn, mx, av), = rows
+    assert cstar == 6 and cq == 5
+    assert sq == pytest.approx(26.75)
+    assert mn == pytest.approx(1.25) and mx == pytest.approx(9.99)
+    assert av == pytest.approx((5.50 + 2.00 + 1.25 + 9.99 + 3.00) / 5)
+
+
+def test_group_by(db):
+    rows = run(
+        db,
+        "select flag, count(*), sum(qty) from item group by flag order by flag",
+    )
+    # NULLS LAST in ASC order
+    assert rows[0][0] == "A" and rows[0][1] == 2 and rows[0][2] == pytest.approx(17.0)
+    assert rows[1][0] == "B" and rows[1][1] == 2 and rows[1][2] == pytest.approx(5.75)
+    assert rows[2][0] == "C" and rows[2][1] == 1 and rows[2][2] is None
+    assert rows[3][0] is None and rows[3][1] == 1
+
+
+def test_group_by_having(db):
+    rows = run(
+        db,
+        "select flag, count(*) from item group by flag having count(*) > 1 order by flag",
+    )
+    assert [(r[0], r[1]) for r in rows] == [("A", 2), ("B", 2)]
+
+
+def test_order_by_desc_limit(db):
+    # PG default: NULLS FIRST on DESC, so the NULL-price row leads
+    rows = run(db, "select id, price from item order by price desc limit 2")
+    assert [r[0] for r in rows] == [5, 4]
+    rows = run(
+        db,
+        "select id, price from item where price is not null "
+        "order by price desc limit 2",
+    )
+    assert [r[0] for r in rows] == [4, 1]
+
+
+def test_order_by_nulls(db):
+    rows = run(db, "select id from item order by price")
+    assert rows[-1][0] == 5  # NULL price last on ASC
+    rows = run(db, "select id from item order by price desc")
+    assert rows[0][0] == 5  # NULL price first on DESC (PG default)
+
+
+def test_limit_offset(db):
+    rows = run(db, "select id from item order by id limit 2 offset 3")
+    assert [r[0] for r in rows] == [4, 5]
+
+
+def test_inner_join(db):
+    rows = run(
+        db,
+        "select c_name, o_total from customer join orders on c_id = o_cust "
+        "order by c_name, o_total",
+    )
+    assert rows == [
+        ("alice", 10.0),
+        ("alice", 20.0),
+        ("bob", 5.0),
+        ("carol", 7.5),
+    ]
+
+
+def test_left_join(db):
+    rows = run(
+        db,
+        "select c_name, o_id from customer left join orders on c_id = o_cust "
+        "order by c_name, o_id",
+    )
+    names = [r[0] for r in rows]
+    assert names == ["alice", "alice", "bob", "carol", "dave"]
+    assert rows[-1][1] is None  # dave unmatched
+
+
+def test_join_group(db):
+    rows = run(
+        db,
+        "select c_nation, sum(o_total) from customer join orders on c_id = o_cust "
+        "group by c_nation order by c_nation",
+    )
+    assert rows == [("DE", 5.0), ("FR", 37.5)]
+
+
+def test_semi_join_in_subquery(db):
+    rows = run(
+        db,
+        "select c_id from customer where c_id in (select o_cust from orders) order by c_id",
+    )
+    assert [r[0] for r in rows] == [1, 2, 3]
+
+
+def test_scalar_subquery(db):
+    rows = run(
+        db,
+        "select id from item where price > (select avg(price) from item) order by id",
+    )
+    assert [r[0] for r in rows] == [1, 4]
+
+
+def test_case_expr(db):
+    rows = run(
+        db,
+        "select id, case when qty > 5 then 'big' when qty > 3 then 'mid' else 'small' end "
+        "from item order by id",
+    )
+    got = {r[0]: r[1] for r in rows}
+    assert got[1] == "big" and got[3] == "big" and got[6] == "mid"
+    assert got[2] == "mid" and got[5] == "small"  # 3.25 > 3 -> mid
+
+
+def test_distinct(db):
+    rows = run(db, "select distinct c_nation from customer order by c_nation")
+    assert [r[0] for r in rows] == ["DE", "FR", None]
+
+
+def test_count_distinct(db):
+    rows = run(db, "select count(distinct c_nation) from customer")
+    assert rows[0][0] == 2
+
+
+def test_union_all(db):
+    rows = run(
+        db,
+        "select c_id from customer union all select o_cust from orders order by 1",
+    )
+    vals = [r[0] for r in rows]
+    assert len(vals) == 10
+
+
+def test_no_from(db):
+    rows = run(db, "select 1 + 2")
+    assert rows == [(3,)]
+
+
+def test_decimal_division(db):
+    rows = run(db, "select id, price / qty from item where id = 1")
+    assert rows[0][1] == pytest.approx(0.55)
+
+
+def test_coalesce(db):
+    rows = run(db, "select id, coalesce(qty, 0) from item order by id")
+    got = {r[0]: r[1] for r in rows}
+    assert got[4] == 0
+
+
+def test_extract_year(db):
+    rows = run(
+        db,
+        "select extract(year from ship), count(*) from item group by extract(year from ship)",
+    )
+    assert rows == [(2024, 6)]

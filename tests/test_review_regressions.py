@@ -1,0 +1,150 @@
+"""Regression tests for analyzer/optimizer defects found in review:
+ORDER BY-only aggregates, duplicate GROUP BY keys, Union prune alignment,
+literal coercion errors, qualified-star validation, orphaned subplans."""
+
+import pytest
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.catalog.catalog import Catalog
+from opentenbase_tpu.catalog.distribution import DistributionSpec, DistStrategy
+from opentenbase_tpu.catalog.nodes import NodeDef, NodeManager, NodeRole
+from opentenbase_tpu.catalog.shardmap import ShardMap
+from opentenbase_tpu.executor.local import LocalExecutor
+from opentenbase_tpu.plan import analyze_statement
+from opentenbase_tpu.plan.analyze import AnalyzeError
+from opentenbase_tpu.plan.optimize import prune_columns
+from opentenbase_tpu.sql import parse_one
+from opentenbase_tpu.storage.table import ColumnBatch, ShardStore
+
+
+@pytest.fixture(scope="module")
+def db():
+    nm = NodeManager()
+    nm.create_node(NodeDef("dn0", NodeRole.DATANODE))
+    sm = ShardMap(64)
+    sm.initialize(nm.datanode_indices())
+    cat = Catalog(nm, sm)
+    stores = {}
+    meta = cat.create_table(
+        "items",
+        {"id": t.INT8, "flag": t.TEXT, "price": t.decimal(10, 2)},
+        DistributionSpec(DistStrategy.ROUNDROBIN),
+    )
+    store = ShardStore(meta.schema, meta.dictionaries)
+    store.append_batch(
+        ColumnBatch.from_pydict(
+            {
+                "id": [1, 2, 3, 4],
+                "flag": ["a", "b", "a", "b"],
+                "price": [1.0, 2.0, 3.0, 10.0],
+            },
+            meta.schema,
+            meta.dictionaries,
+        ),
+        xmin_ts=1,
+    )
+    stores["items"] = store
+    meta2 = cat.create_table(
+        "orders",
+        {"o_id": t.INT8, "total": t.decimal(10, 2)},
+        DistributionSpec(DistStrategy.ROUNDROBIN),
+    )
+    store2 = ShardStore(meta2.schema, meta2.dictionaries)
+    store2.append_batch(
+        ColumnBatch.from_pydict(
+            {"o_id": [7, 8], "total": [5.0, 6.0]},
+            meta2.schema,
+            meta2.dictionaries,
+        ),
+        xmin_ts=1,
+    )
+    stores["orders"] = store2
+    return cat, stores
+
+
+def run(db, sql):
+    cat, stores = db
+    plan = prune_columns(analyze_statement(parse_one(sql), cat))
+    return LocalExecutor(cat, stores).execute(plan).to_rows()
+
+
+def test_order_by_unselected_aggregate(db):
+    rows = run(
+        db,
+        "select flag, count(*) from items group by flag order by sum(price) desc",
+    )
+    assert rows == [("b", 2), ("a", 2)]  # b: 12.0 > a: 4.0
+
+
+def test_duplicate_group_by_exprs(db):
+    rows = run(
+        db,
+        "select count(*) from items group by flag, flag order by 1",
+    )
+    assert rows == [(2,), (2,)]
+
+
+def test_union_prune_through_distinct():
+    # prune through a Union whose branch ignores the column hint
+    nm = NodeManager()
+    nm.create_node(NodeDef("dn0", NodeRole.DATANODE))
+    sm = ShardMap(64)
+    sm.initialize(nm.datanode_indices())
+    cat = Catalog(nm, sm)
+    cat.create_table(
+        "a", {"x": t.INT8, "y": t.INT8}, DistributionSpec(DistStrategy.ROUNDROBIN)
+    )
+    cat.create_table(
+        "b", {"p": t.INT8, "q": t.INT8}, DistributionSpec(DistStrategy.ROUNDROBIN)
+    )
+    sql = (
+        "select x from (select distinct x, y from a union all "
+        "select p, q from b) s"
+    )
+    plan = prune_columns(analyze_statement(parse_one(sql), cat))
+
+    def check(p):
+        for c in p.children():
+            check(c)
+        from opentenbase_tpu.plan import logical as L
+
+        if isinstance(p, L.Union):
+            for inp in p.inputs:
+                assert len(inp.schema) == len(p.schema), (
+                    inp.schema,
+                    p.schema,
+                )
+
+    check(plan.root)
+
+
+def test_bad_literal_raises_analyze_error(db):
+    cat, _ = db
+    with pytest.raises(AnalyzeError):
+        analyze_statement(parse_one("select id from items where id = 'abc'"), cat)
+
+
+def test_unknown_qualified_star(db):
+    cat, _ = db
+    with pytest.raises(AnalyzeError):
+        analyze_statement(parse_one("select id, x.* from items"), cat)
+
+
+def test_no_orphan_subplans(db):
+    cat, _ = db
+    plan = analyze_statement(
+        parse_one(
+            "select flag, (select max(o_id) from orders) from items group by flag"
+        ),
+        cat,
+    )
+    # exactly one scalar subplan, and it is referenced
+    assert len(plan.subplans) == 1
+
+
+def test_scalar_subquery_in_group_query_executes(db):
+    rows = run(
+        db,
+        "select flag, (select max(o_id) from orders) from items group by flag order by flag",
+    )
+    assert rows == [("a", 8), ("b", 8)]
