@@ -1,0 +1,857 @@
+"""Cluster engine: coordinator sessions over datanode executors + GTS.
+
+The top of the stack — the analog of the coordinator's tcop loop
+(exec_simple_query, src/backend/tcop/postgres.c:1197) plus the pieces it
+drives: parse → analyze → distribute → remote-execute, implicit 2PC commit
+(PrePrepare_Remote/PreCommit_Remote, src/backend/pgxc/pool/execRemote.c:7964,
+:7525), DDL dispatch (commands/), and the cluster admin surface
+(CREATE NODE, MOVE DATA, EXECUTE DIRECT, barriers, pause).
+
+A ``Cluster`` is one process-space deployment: topology + catalog + GTS +
+one ShardStore per (datanode, table) — exactly the shape of the reference's
+pg_regress mini-cluster (1 GTM + CNs + DNs on localhost,
+src/test/regress/pg_regress.c:121-141). ``Session`` is a client connection
+with transaction state; DistExecutor/LocalExecutor do the heavy lifting.
+
+MVCC/txn model (tqual.c + xact.c, device edition):
+- every statement runs under a snapshot timestamp from the GTS;
+- writes append/stamp PENDING rows, registered in the Transaction;
+- the transaction's own writes overlay the snapshot via own_writes masks;
+- COMMIT takes one commit timestamp from the GTS and stamps every touched
+  shard (2-phase when >1 node participated: GTS prepare record first, so
+  an operator — or tests — can observe/resolve in-doubt transactions the
+  way contrib/pg_clean does).
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.catalog.catalog import Catalog, TableMeta
+from opentenbase_tpu.catalog.distribution import DistributionSpec, DistStrategy
+from opentenbase_tpu.catalog.nodes import NodeDef, NodeManager, NodeRole
+from opentenbase_tpu.catalog.shardmap import ShardMap
+from opentenbase_tpu.executor.dist import DistExecutor
+from opentenbase_tpu.executor.local import LocalExecutor
+from opentenbase_tpu.gtm import GTSServer
+from opentenbase_tpu.plan import analyze_statement
+from opentenbase_tpu.plan import logical as L
+from opentenbase_tpu.plan.analyze import Analyzer
+from opentenbase_tpu.plan.distribute import distribute_statement
+from opentenbase_tpu.plan.optimize import prune_columns
+from opentenbase_tpu.sql import ast as A
+from opentenbase_tpu.sql import parse
+from opentenbase_tpu.storage.column import Column, column_from_python
+from opentenbase_tpu.storage.table import ColumnBatch, ShardStore
+
+
+@dataclass
+class Result:
+    command: str
+    rows: list[tuple] = field(default_factory=list)
+    columns: list[str] = field(default_factory=list)
+    rowcount: int = 0
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    @property
+    def scalar(self):
+        return self.rows[0][0] if self.rows else None
+
+
+class SQLError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Transaction
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _TableWrites:
+    ins_ranges: list[tuple[int, int]] = field(default_factory=list)
+    del_idx: list[int] = field(default_factory=list)
+
+
+class Transaction:
+    def __init__(self, gxid: int, snapshot_ts: int):
+        self.gxid = gxid
+        self.snapshot_ts = snapshot_ts
+        # node index -> table -> writes
+        self.writes: dict[int, dict[str, _TableWrites]] = {}
+        self.pinned: list[ShardStore] = []
+        self.prepared_gid: Optional[str] = None
+
+    def w(self, node: int, table: str) -> _TableWrites:
+        return self.writes.setdefault(node, {}).setdefault(table, _TableWrites())
+
+    def touched_nodes(self) -> list[int]:
+        return [n for n, tabs in self.writes.items() if tabs]
+
+    def own_writes_view(self) -> dict[int, dict[str, tuple]]:
+        return {
+            n: {
+                tb: (tw.ins_ranges, np.asarray(tw.del_idx, dtype=np.int64))
+                for tb, tw in tabs.items()
+            }
+            for n, tabs in self.writes.items()
+        }
+
+    def pin(self, store: ShardStore) -> None:
+        if store not in self.pinned:
+            store.pin()
+            self.pinned.append(store)
+
+    def unpin_all(self) -> None:
+        for s in self.pinned:
+            s.unpin()
+        self.pinned.clear()
+
+
+# ---------------------------------------------------------------------------
+# Cluster
+# ---------------------------------------------------------------------------
+
+
+class Cluster:
+    """One deployment: topology, catalog, GTS, per-DN stores."""
+
+    def __init__(
+        self,
+        num_datanodes: int = 2,
+        shard_groups: int = 256,
+        data_dir: Optional[str] = None,
+    ):
+        self.nodes = NodeManager()
+        self.nodes.create_node(NodeDef("cn0", NodeRole.COORDINATOR))
+        self.nodes.create_node(NodeDef("gtm0", NodeRole.GTM))
+        for i in range(num_datanodes):
+            self.nodes.create_node(NodeDef(f"dn{i}", NodeRole.DATANODE))
+        self.shardmap = ShardMap(shard_groups)
+        self.shardmap.initialize(self.nodes.datanode_indices())
+        self.catalog = Catalog(self.nodes, self.shardmap)
+        gts_store = os.path.join(data_dir, "gts.json") if data_dir else None
+        self.gts = GTSServer(gts_store)
+        # node mesh index -> table name -> ShardStore
+        self.stores: dict[int, dict[str, ShardStore]] = {
+            i: {} for i in self.nodes.datanode_indices()
+        }
+        self.paused = False
+        self.barriers: list[tuple[str, int]] = []
+        self.indexes: dict[str, A.CreateIndex] = {}
+
+    # -- table lifecycle -------------------------------------------------
+    def create_table_stores(self, meta: TableMeta) -> None:
+        for n in meta.node_indices:
+            self.stores[n][meta.name] = ShardStore(meta.schema, meta.dictionaries)
+
+    def drop_table_stores(self, name: str) -> None:
+        for tabs in self.stores.values():
+            tabs.pop(name, None)
+
+    def session(self) -> "Session":
+        return Session(self)
+
+
+# ---------------------------------------------------------------------------
+# Session
+# ---------------------------------------------------------------------------
+
+
+class Session:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.txn: Optional[Transaction] = None
+        self.gucs: dict[str, object] = {}
+
+    # -- public ----------------------------------------------------------
+    def execute(self, sql: str) -> Result:
+        results = [self._execute_one(s) for s in parse(sql)]
+        return results[-1] if results else Result("EMPTY")
+
+    def query(self, sql: str) -> list[tuple]:
+        return self.execute(sql).rows
+
+    # -- txn helpers -----------------------------------------------------
+    def _begin_implicit(self) -> tuple[Transaction, bool]:
+        if self.txn is not None:
+            return self.txn, False
+        info = self.cluster.gts.begin()
+        return Transaction(info.gxid, info.start_ts), True
+
+    def _snapshot(self) -> int:
+        if self.txn is not None:
+            return self.txn.snapshot_ts
+        return self.cluster.gts.snapshot_ts()
+
+    def _commit_txn(self, txn: Transaction) -> None:
+        gts = self.cluster.gts
+        nodes = txn.touched_nodes()
+        if len(nodes) > 1 and txn.prepared_gid is None:
+            # implicit 2PC: record the prepare (with participants) before
+            # the irrevocable commit-ts stamp (PrePrepare_Remote analog)
+            gts.prepare(txn.gxid, f"__implicit_{txn.gxid}", tuple(nodes))
+        commit_ts = gts.commit(txn.gxid)
+        self._stamp_commit(txn, commit_ts)
+        gts.forget(txn.gxid)
+
+    def _stamp_commit(self, txn: Transaction, commit_ts: int) -> None:
+        for node, tabs in txn.writes.items():
+            for table, tw in tabs.items():
+                store = self.cluster.stores[node][table]
+                for s, e in tw.ins_ranges:
+                    store.stamp_xmin(s, e, commit_ts)
+                if tw.del_idx:
+                    store.stamp_xmax(
+                        np.asarray(tw.del_idx, dtype=np.int64), commit_ts
+                    )
+        txn.unpin_all()
+
+    def _abort_txn(self, txn: Transaction) -> None:
+        for node, tabs in txn.writes.items():
+            for table, tw in tabs.items():
+                store = self.cluster.stores[node][table]
+                for s, e in tw.ins_ranges:
+                    store.truncate_range(s, e)
+                # deletes were never stamped; nothing to undo
+        txn.unpin_all()
+        self.cluster.gts.abort(txn.gxid)
+        self.cluster.gts.forget(txn.gxid)
+
+    # -- dispatch --------------------------------------------------------
+    def _execute_one(self, stmt: A.Statement) -> Result:
+        if self.cluster.paused and not isinstance(stmt, A.UnpauseCluster):
+            raise SQLError("cluster is paused")
+        h = getattr(self, f"_x_{type(stmt).__name__.lower()}", None)
+        if h is None:
+            raise SQLError(f"unsupported statement {type(stmt).__name__}")
+        return h(stmt)
+
+    # -- SELECT ----------------------------------------------------------
+    def _x_select(self, stmt: A.Select) -> Result:
+        batch = self._run_select(stmt)
+        return Result(
+            "SELECT",
+            batch.to_rows(),
+            batch.column_names(),
+            batch.nrows,
+        )
+
+    def _run_select(self, stmt: A.Select) -> ColumnBatch:
+        splan = prune_columns(analyze_statement(stmt, self.cluster.catalog))
+        return self._run_statement_plan(splan)
+
+    def _run_statement_plan(self, splan: L.StatementPlan) -> ColumnBatch:
+        dplan = distribute_statement(splan, self.cluster.catalog)
+        ex = DistExecutor(
+            self.cluster.catalog,
+            self.cluster.stores,
+            self._snapshot(),
+            own_writes=self.txn.own_writes_view() if self.txn else None,
+        )
+        return ex.run(dplan)
+
+    # -- INSERT ----------------------------------------------------------
+    def _x_insert(self, stmt: A.Insert) -> Result:
+        if stmt.returning:
+            raise SQLError("RETURNING is not yet supported")
+        splan = analyze_statement(stmt, self.cluster.catalog)
+        iplan = splan.root
+        assert isinstance(iplan, L.InsertPlan)
+        meta = self.cluster.catalog.get(iplan.table)
+        src_batch = self._run_statement_plan(
+            L.StatementPlan(iplan.source, splan.subplans)
+        )
+        full = self._complete_insert_batch(meta, iplan.columns, src_batch)
+        txn, implicit = self._begin_implicit()
+        try:
+            n = self._route_and_append(meta, full, txn)
+        except Exception:
+            if implicit:
+                self._abort_txn(txn)
+            raise
+        if implicit:
+            self._commit_txn(txn)
+        else:
+            self.txn = txn
+        return Result("INSERT", rowcount=n)
+
+    def _complete_insert_batch(
+        self, meta: TableMeta, columns, src: ColumnBatch
+    ) -> ColumnBatch:
+        """Expand to full table-column order, NULL-filling absent columns."""
+        given = {c: col for c, col in zip(columns, src.columns.values())}
+        out: dict[str, Column] = {}
+        n = src.nrows
+        for name, ty in meta.schema.items():
+            if name in given:
+                col = given[name]
+                out[name] = Column(ty, col.data, col.validity, col.dictionary)
+            else:
+                out[name] = column_from_python(
+                    [None] * n, ty, meta.dictionaries.get(name)
+                )
+        return ColumnBatch(out, n)
+
+    def _route_and_append(
+        self, meta: TableMeta, batch: ColumnBatch, txn: Transaction
+    ) -> int:
+        if batch.nrows == 0:
+            return 0
+        if meta.dist.is_replicated:
+            for node in meta.node_indices:
+                self._append_one(meta, node, batch, txn)
+            return batch.nrows
+        key_cols = {k: batch.columns[k] for k in meta.dist.key_columns}
+        routes = meta.locator.route_insert(key_cols, batch.nrows)
+        for node in np.unique(routes):
+            idx = np.nonzero(routes == node)[0]
+            self._append_one(meta, int(node), batch.take(idx), txn)
+        return batch.nrows
+
+    def _append_one(self, meta, node: int, batch: ColumnBatch, txn) -> None:
+        from opentenbase_tpu.storage.table import PENDING_TS
+
+        store = self.cluster.stores[node][meta.name]
+        txn.pin(store)
+        s, e = store.append_batch(batch, PENDING_TS)
+        txn.w(node, meta.name).ins_ranges.append((s, e))
+
+    # -- UPDATE / DELETE -------------------------------------------------
+    def _x_delete(self, stmt: A.Delete) -> Result:
+        if stmt.returning:
+            raise SQLError("RETURNING is not yet supported")
+        splan = analyze_statement(stmt, self.cluster.catalog)
+        dplan = splan.root
+        assert isinstance(dplan, L.DeletePlan)
+        meta = self.cluster.catalog.get(dplan.table)
+        txn, implicit = self._begin_implicit()
+        subq = self._subquery_values(splan)
+        total = 0
+        for node in meta.node_indices:
+            store = self.cluster.stores[node][dplan.table]
+            ex = LocalExecutor(
+                self.cluster.catalog,
+                {dplan.table: store},
+                txn.snapshot_ts,
+                subquery_values=subq,
+                own_writes=txn.own_writes_view().get(node),
+            )
+            idx = ex.predicate_rows(dplan.table, dplan.predicate)
+            if len(idx):
+                txn.pin(store)
+                txn.w(node, dplan.table).del_idx.extend(idx.tolist())
+                total += len(idx)
+        if meta.dist.is_replicated and meta.node_indices:
+            total //= len(meta.node_indices)
+        if implicit:
+            self._commit_txn(txn)
+        else:
+            self.txn = txn
+        return Result("DELETE", rowcount=total)
+
+    def _x_update(self, stmt: A.Update) -> Result:
+        if stmt.returning:
+            raise SQLError("RETURNING is not yet supported")
+        splan = analyze_statement(stmt, self.cluster.catalog)
+        uplan = splan.root
+        assert isinstance(uplan, L.UpdatePlan)
+        meta = self.cluster.catalog.get(uplan.table)
+        txn, implicit = self._begin_implicit()
+        subq = self._subquery_values(splan)
+        assigned = dict(uplan.assignments)
+        total = 0
+        new_batches: list[ColumnBatch] = []
+        try:
+            for node in meta.node_indices:
+                store = self.cluster.stores[node][uplan.table]
+                ex = LocalExecutor(
+                    self.cluster.catalog,
+                    {uplan.table: store},
+                    txn.snapshot_ts,
+                    subquery_values=subq,
+                    own_writes=txn.own_writes_view().get(node),
+                )
+                idx = ex.predicate_rows(uplan.table, uplan.predicate)
+                if not len(idx):
+                    continue
+                old = store.to_batch().take(idx)
+                new_batches.append(self._apply_assignments(meta, old, assigned, subq))
+                txn.pin(store)
+                txn.w(node, uplan.table).del_idx.extend(idx.tolist())
+                total += len(idx)
+                if meta.dist.is_replicated:
+                    # one representative copy; re-insert fans back out
+                    new_batches = new_batches[:1]
+            for nb in new_batches:
+                self._route_and_append(meta, nb, txn)
+        except Exception:
+            if implicit:
+                self._abort_txn(txn)
+            raise
+        if meta.dist.is_replicated and meta.node_indices:
+            total //= len(meta.node_indices)
+        if implicit:
+            self._commit_txn(txn)
+        else:
+            self.txn = txn
+        return Result("UPDATE", rowcount=total)
+
+    def _apply_assignments(
+        self, meta: TableMeta, old: ColumnBatch, assigned, subq
+    ) -> ColumnBatch:
+        """Evaluate SET expressions over the affected rows."""
+        schema = tuple(
+            L.OutCol(
+                name,
+                ty,
+                f"{meta.name}.{name}" if ty.id == t.TypeId.TEXT else None,
+            )
+            for name, ty in meta.schema.items()
+        )
+        ex = LocalExecutor(
+            self.cluster.catalog, {}, None, subquery_values=subq
+        )
+        dev = ex._batch_to_dev(old, schema)
+        out: dict[str, Column] = {}
+        for i, (name, ty) in enumerate(meta.schema.items()):
+            if name in assigned:
+                fns, params = ex._bind(
+                    [assigned[name]],
+                    schema,
+                    subq,
+                    want_dids=[schema[i].dict_id],
+                )
+                d, v = fns[0](dev.cols, params)
+                d = np.asarray(d)
+                if d.ndim == 0:
+                    d = np.broadcast_to(d, (old.nrows,)).copy()
+                else:
+                    d = d[: old.nrows]
+                if v is None:
+                    vv = None
+                else:
+                    v = np.asarray(v)
+                    vv = (
+                        np.broadcast_to(v, (old.nrows,)).copy()
+                        if v.ndim == 0
+                        else v[: old.nrows]
+                    )
+                out[name] = Column(
+                    ty, d.astype(ty.np_dtype), vv, meta.dictionaries.get(name)
+                )
+            else:
+                out[name] = list(old.columns.values())[i]
+        return ColumnBatch(out, old.nrows)
+
+    def _subquery_values(self, splan: L.StatementPlan):
+        vals = []
+        for sp in splan.subplans:
+            b = self._run_statement_plan(L.StatementPlan(sp, []))
+            ty = sp.schema[0].type
+            if b.nrows > 1:
+                raise SQLError(
+                    "more than one row returned by a subquery used as an expression"
+                )
+            if b.nrows == 0:
+                vals.append((None, ty))
+            else:
+                col = next(iter(b.columns.values()))
+                vals.append((col.data[0] if col.valid_mask[0] else None, ty))
+        return vals
+
+    # -- transactions ----------------------------------------------------
+    def _x_beginstmt(self, stmt: A.BeginStmt) -> Result:
+        if self.txn is not None:
+            raise SQLError("there is already a transaction in progress")
+        info = self.cluster.gts.begin()
+        self.txn = Transaction(info.gxid, info.start_ts)
+        return Result("BEGIN")
+
+    def _x_commitstmt(self, stmt: A.CommitStmt) -> Result:
+        if self.txn is None:
+            raise SQLError("there is no transaction in progress")
+        self._commit_txn(self.txn)
+        self.txn = None
+        return Result("COMMIT")
+
+    def _x_rollbackstmt(self, stmt: A.RollbackStmt) -> Result:
+        if self.txn is None:
+            raise SQLError("there is no transaction in progress")
+        self._abort_txn(self.txn)
+        self.txn = None
+        return Result("ROLLBACK")
+
+    def _x_preparetransaction(self, stmt: A.PrepareTransaction) -> Result:
+        if self.txn is None:
+            raise SQLError("there is no transaction in progress")
+        txn = self.txn
+        txn.prepared_gid = stmt.gid
+        self.cluster.gts.prepare(
+            txn.gxid, stmt.gid, tuple(txn.touched_nodes())
+        )
+        # session detaches; txn parks as in-doubt until COMMIT/ROLLBACK
+        # PREPARED (twophase.c's on-disk state, held in the GTS registry)
+        self.cluster.__dict__.setdefault("_prepared", {})[stmt.gid] = txn
+        self.txn = None
+        return Result("PREPARE TRANSACTION")
+
+    def _x_commitprepared(self, stmt: A.CommitPrepared) -> Result:
+        txn = self.cluster.__dict__.get("_prepared", {}).pop(stmt.gid, None)
+        if txn is None:
+            raise SQLError(f'prepared transaction "{stmt.gid}" does not exist')
+        commit_ts = self.cluster.gts.commit(txn.gxid)
+        self._stamp_commit(txn, commit_ts)
+        self.cluster.gts.forget(txn.gxid)
+        return Result("COMMIT PREPARED")
+
+    def _x_rollbackprepared(self, stmt: A.RollbackPrepared) -> Result:
+        txn = self.cluster.__dict__.get("_prepared", {}).pop(stmt.gid, None)
+        if txn is None:
+            raise SQLError(f'prepared transaction "{stmt.gid}" does not exist')
+        self._abort_txn(txn)
+        return Result("ROLLBACK PREPARED")
+
+    # -- DDL: tables -----------------------------------------------------
+    def _x_createtable(self, stmt: A.CreateTable) -> Result:
+        cat = self.cluster.catalog
+        if cat.has(stmt.name):
+            if stmt.if_not_exists:
+                return Result("CREATE TABLE")
+            raise SQLError(f'relation "{stmt.name}" already exists')
+        schema: dict[str, t.SqlType] = {}
+        for cd in stmt.columns:
+            schema[cd.name] = t.type_from_name(cd.type_name, cd.type_args)
+        dist = self._dist_spec(stmt, schema)
+        meta = cat.create_table(stmt.name, schema, dist)
+        self.cluster.create_table_stores(meta)
+        return Result("CREATE TABLE")
+
+    def _dist_spec(self, stmt: A.CreateTable, schema) -> DistributionSpec:
+        s = (stmt.distribute_strategy or "").lower()
+        if s in ("replication", "replicated"):
+            return DistributionSpec(DistStrategy.REPLICATED, group=stmt.to_group)
+        if s == "roundrobin":
+            return DistributionSpec(DistStrategy.ROUNDROBIN, group=stmt.to_group)
+        if s in ("shard", "hash", "modulo"):
+            strat = {
+                "shard": DistStrategy.SHARD,
+                "hash": DistStrategy.HASH,
+                "modulo": DistStrategy.MODULO,
+            }[s]
+            return DistributionSpec(
+                strat, tuple(stmt.distribute_keys), group=stmt.to_group
+            )
+        if s:
+            raise SQLError(f"unknown distribution strategy {s!r}")
+        # default: SHARD on the primary key, else the first column
+        # (the reference defaults new tables to shard distribution)
+        key = None
+        for cd in stmt.columns:
+            if cd.primary_key:
+                key = cd.name
+                break
+        if key is None:
+            key = stmt.columns[0].name
+        return DistributionSpec(DistStrategy.SHARD, (key,), group=stmt.to_group)
+
+    def _x_droptable(self, stmt: A.DropTable) -> Result:
+        for name in stmt.names:
+            if not self.cluster.catalog.has(name):
+                if stmt.if_exists:
+                    continue
+                raise SQLError(f'relation "{name}" does not exist')
+            self.cluster.catalog.drop_table(name)
+            self.cluster.drop_table_stores(name)
+        return Result("DROP TABLE")
+
+    def _x_truncatetable(self, stmt: A.TruncateTable) -> Result:
+        for name in stmt.names:
+            meta = self.cluster.catalog.get(name)
+            for n in meta.node_indices:
+                self.cluster.stores[n][name] = ShardStore(
+                    meta.schema, meta.dictionaries
+                )
+        return Result("TRUNCATE TABLE")
+
+    def _x_createindex(self, stmt: A.CreateIndex) -> Result:
+        # columnar engine: scans + zone maps replace btrees; the index is
+        # recorded for catalog compatibility (SURVEY.md §7 out-of-scope AMs)
+        self.cluster.catalog.get(stmt.table)
+        self.cluster.indexes[stmt.name] = stmt
+        return Result("CREATE INDEX")
+
+    # -- DDL: cluster ----------------------------------------------------
+    def _x_createnode(self, stmt: A.CreateNode) -> Result:
+        role = NodeRole(stmt.node_type)
+        node = NodeDef(
+            stmt.name, role, stmt.host, stmt.port, stmt.is_primary, stmt.is_preferred
+        )
+        self.cluster.nodes.create_node(node)
+        if role == NodeRole.DATANODE:
+            self.cluster.stores[node.mesh_index] = {}
+        return Result("CREATE NODE")
+
+    def _x_dropnode(self, stmt: A.DropNode) -> Result:
+        node = self.cluster.nodes.get(stmt.name)
+        if node.role == NodeRole.DATANODE:
+            held = {
+                tb: s.nrows
+                for tb, s in self.cluster.stores.get(node.mesh_index, {}).items()
+                if s.nrows
+            }
+            if held:
+                raise SQLError(
+                    f'node "{stmt.name}" still holds table shards '
+                    f"({', '.join(held)}); MOVE DATA first"
+                )
+            self.cluster.nodes.drop_node(stmt.name, force=True)
+            self.cluster.stores.pop(node.mesh_index, None)
+        else:
+            self.cluster.nodes.drop_node(stmt.name)
+        return Result("DROP NODE")
+
+    def _x_alternode(self, stmt: A.AlterNode) -> Result:
+        self.cluster.nodes.alter_node(stmt.name, **stmt.options)
+        return Result("ALTER NODE")
+
+    def _x_createnodegroup(self, stmt: A.CreateNodeGroup) -> Result:
+        self.cluster.nodes.create_group(stmt.name, stmt.members)
+        return Result("CREATE NODE GROUP")
+
+    def _x_dropnodegroup(self, stmt: A.DropNodeGroup) -> Result:
+        self.cluster.nodes.drop_group(stmt.name)
+        return Result("DROP NODE GROUP")
+
+    def _x_createshardinggroup(self, stmt: A.CreateShardingGroup) -> Result:
+        if stmt.members:
+            idxs = [
+                self.cluster.nodes.get(m).mesh_index for m in stmt.members
+            ]
+        else:
+            idxs = self.cluster.nodes.datanode_indices()
+        self.cluster.shardmap.initialize(idxs)
+        return Result("CREATE SHARDING GROUP")
+
+    def _x_cleansharding(self, stmt: A.CleanSharding) -> Result:
+        return Result("CLEAN SHARDING")
+
+    def _x_movedata(self, stmt: A.MoveData) -> Result:
+        return self._move_data(stmt)
+
+    def _move_data(self, stmt: A.MoveData) -> Result:
+        """Shard rebalancing: reassign shard groups to a new node and move
+        the affected rows (PgxcMoveData_* + shard_vacuum, shardmap.c)."""
+        to_node = self.cluster.nodes.get(stmt.to_node).mesh_index
+        from_node = self.cluster.nodes.get(stmt.from_node).mesh_index
+        sm = self.cluster.shardmap
+        if stmt.shard_ids:
+            moved_set = set(stmt.shard_ids)
+        else:
+            # hand over everything the source node owns
+            moved_set = set(int(s) for s in sm.shards_on_node(from_node))
+        nmoved = 0
+        snapshot = self.cluster.gts.snapshot_ts()
+        for meta in [
+            self.cluster.catalog.get(n)
+            for n in self.cluster.catalog.table_names()
+        ]:
+            if meta.dist.strategy != DistStrategy.SHARD:
+                continue
+            src = self.cluster.stores[from_node].get(meta.name)
+            if src is None or src.nrows == 0:
+                self.cluster.stores.setdefault(to_node, {}).setdefault(
+                    meta.name, ShardStore(meta.schema, meta.dictionaries)
+                )
+                continue
+            key_cols = {
+                k: src.column(k) for k in meta.dist.key_columns
+            }
+            h = meta.locator.key_hash(key_cols)
+            sid = sm.shard_ids(h)
+            live = (src.xmin_ts[: src.nrows] <= snapshot) & (
+                snapshot < src.xmax_ts[: src.nrows]
+            )
+            mask = np.isin(sid, list(moved_set)) & live
+            idx = np.nonzero(mask)[0]
+            if not len(idx):
+                continue
+            batch = src.to_batch().take(idx)
+            dst = self.cluster.stores.setdefault(to_node, {}).setdefault(
+                meta.name, ShardStore(meta.schema, meta.dictionaries)
+            )
+            commit_ts = self.cluster.gts.get_gts()
+            dst.append_batch(batch, commit_ts)
+            src.stamp_xmax(idx, commit_ts)
+            src.vacuum(self.cluster.gts.get_gts())
+            if to_node not in meta.node_indices:
+                meta.node_indices.append(to_node)
+                meta.locator.node_indices.append(to_node)
+            nmoved += len(idx)
+        # flip ownership only after the rows landed (shard barrier order,
+        # src/backend/pgxc/shard/shardbarrier.c)
+        for sid in moved_set:
+            sm.move_shard(sid, to_node)
+        return Result("MOVE DATA", rowcount=nmoved)
+
+    # -- sequences -------------------------------------------------------
+    def _x_createsequence(self, stmt: A.CreateSequence) -> Result:
+        try:
+            self.cluster.gts.create_sequence(
+                stmt.name, stmt.start, stmt.increment
+            )
+        except ValueError:
+            if not stmt.if_not_exists:
+                raise SQLError(f'sequence "{stmt.name}" already exists')
+        return Result("CREATE SEQUENCE")
+
+    def _x_dropsequence(self, stmt: A.DropSequence) -> Result:
+        self.cluster.gts.drop_sequence(stmt.name)
+        return Result("DROP SEQUENCE")
+
+    # -- utility ---------------------------------------------------------
+    def _x_explainstmt(self, stmt: A.ExplainStmt) -> Result:
+        inner = stmt.query
+        splan = prune_columns(
+            analyze_statement(inner, self.cluster.catalog)
+        )
+        dplan = distribute_statement(splan, self.cluster.catalog)
+        text = dplan.explain()
+        rows = [(line,) for line in text.splitlines()]
+        return Result("EXPLAIN", rows, ["QUERY PLAN"], len(rows))
+
+    def _x_setstmt(self, stmt: A.SetStmt) -> Result:
+        self.gucs[stmt.name] = stmt.value
+        return Result("SET")
+
+    def _x_showstmt(self, stmt: A.ShowStmt) -> Result:
+        v = self.gucs.get(stmt.name)
+        return Result("SHOW", [(v,)], [stmt.name], 1)
+
+    def _x_vacuumstmt(self, stmt: A.VacuumStmt) -> Result:
+        oldest = self.cluster.gts.snapshot_ts()
+        names = [stmt.table] if stmt.table else self.cluster.catalog.table_names()
+        removed = 0
+        for name in names:
+            meta = self.cluster.catalog.get(name)
+            for n in meta.node_indices:
+                store = self.cluster.stores[n].get(name)
+                if store is not None:
+                    removed += store.vacuum(oldest)
+        return Result("VACUUM", rowcount=removed)
+
+    def _x_analyzestmt(self, stmt: A.AnalyzeStmt) -> Result:
+        return Result("ANALYZE")
+
+    def _x_createbarrier(self, stmt: A.CreateBarrier) -> Result:
+        ts = self.cluster.gts.get_gts()
+        self.cluster.barriers.append((stmt.barrier_id or f"barrier_{ts}", ts))
+        return Result("CREATE BARRIER")
+
+    def _x_pausecluster(self, stmt: A.PauseCluster) -> Result:
+        self.cluster.paused = True
+        return Result("PAUSE CLUSTER")
+
+    def _x_unpausecluster(self, stmt: A.UnpauseCluster) -> Result:
+        self.cluster.paused = False
+        return Result("UNPAUSE CLUSTER")
+
+    def _x_executedirect(self, stmt: A.ExecuteDirect) -> Result:
+        """EXECUTE DIRECT ON (node) 'query' — run on one datanode only."""
+        if not isinstance(stmt.query, A.Select):
+            raise SQLError("EXECUTE DIRECT supports only SELECT")
+        splan = prune_columns(
+            analyze_statement(stmt.query, self.cluster.catalog)
+        )
+        rows: list[tuple] = []
+        cols: list[str] = []
+        for name in stmt.nodes:
+            node = self.cluster.nodes.get(name)
+            ex = LocalExecutor(
+                self.cluster.catalog,
+                self.cluster.stores.get(node.mesh_index, {}),
+                self._snapshot(),
+                subquery_values=[],
+            )
+            b = ex.execute(splan)
+            rows.extend(b.to_rows())
+            cols = b.column_names()
+        return Result("EXECUTE DIRECT", rows, cols, len(rows))
+
+    # -- COPY ------------------------------------------------------------
+    def _x_copystmt(self, stmt: A.CopyStmt) -> Result:
+        meta = self.cluster.catalog.get(stmt.table)
+        columns = stmt.columns or list(meta.schema.keys())
+        if stmt.direction == "to":
+            batch = self._run_select(
+                A.Select(
+                    items=[
+                        A.SelectItem(A.ColumnRef(c, None)) for c in columns
+                    ],
+                    from_clause=A.RelRef(stmt.table, None),
+                )
+            )
+            with open(stmt.target, "w", newline="") as f:
+                w = _csv.writer(f, delimiter=stmt.options.get("delimiter", ","))
+                if stmt.options.get("header"):
+                    w.writerow(columns)
+                for row in batch.to_rows():
+                    w.writerow(["\\N" if v is None else v for v in row])
+            return Result("COPY", rowcount=batch.nrows)
+
+        # COPY FROM: split the stream by the locator and bulk-append —
+        # the distributed COPY path (src/backend/pgxc/copy/remotecopy.c)
+        with open(stmt.target, newline="") as f:
+            r = _csv.reader(f, delimiter=stmt.options.get("delimiter", ","))
+            rows = list(r)
+        if stmt.options.get("header") and rows:
+            rows = rows[1:]
+        data: dict[str, list] = {c: [] for c in columns}
+        types = [meta.schema[c] for c in columns]
+        for row in rows:
+            for c, ty, v in zip(columns, types, row):
+                if v == "\\N" or v == "":
+                    data[c].append(None)
+                elif ty.is_numeric and ty.id != t.TypeId.DECIMAL:
+                    data[c].append(
+                        float(v)
+                        if ty.id in (t.TypeId.FLOAT4, t.TypeId.FLOAT8)
+                        else int(v)
+                    )
+                elif ty.id == t.TypeId.DECIMAL:
+                    data[c].append(float(v))
+                elif ty.id == t.TypeId.BOOL:
+                    data[c].append(v.lower() in ("t", "true", "1"))
+                else:
+                    data[c].append(v)
+        batch = ColumnBatch.from_pydict(
+            data,
+            {c: meta.schema[c] for c in columns},
+            meta.dictionaries,
+        )
+        full = self._complete_insert_batch(meta, tuple(columns), batch)
+        txn, implicit = self._begin_implicit()
+        try:
+            n = self._route_and_append(meta, full, txn)
+        except Exception:
+            if implicit:
+                self._abort_txn(txn)
+            raise
+        if implicit:
+            self._commit_txn(txn)
+        else:
+            self.txn = txn
+        return Result("COPY", rowcount=n)
+
+
+def connect(cluster: Optional[Cluster] = None, **kw) -> Session:
+    """Open a session (the libpq PQconnectdb analog for in-process use)."""
+    return (cluster or Cluster(**kw)).session()

@@ -1,0 +1,195 @@
+"""Distributed fragment executor: the coordinator's remote-execution loop.
+
+The reference coordinator drives RemoteSubplan fragments over pooled libpq
+connections, combining per-node streams (ExecRemoteSubplan + ResponseCombiner,
+src/backend/pgxc/pool/execRemote.c:10883, :116), while DN↔DN redistribution
+flows through squeue/DataPump sockets (squeue.c). Here fragments execute
+per-datanode via LocalExecutor and motions move host batches between them:
+
+- gather       -> concatenate producer outputs at the coordinator
+- broadcast    -> every consumer gets the concatenated output
+- redistribute -> hash-split each producer's rows to consumers (all-to-all)
+
+This is the correctness path; the fused device path (executor/fused.py)
+compiles an entire sharded pipeline into one shard_map program where the
+same motions become lax collectives (psum / all_to_all) on the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.catalog.catalog import Catalog
+from opentenbase_tpu.executor.local import LocalExecutor
+from opentenbase_tpu.plan.distribute import (
+    COORDINATOR,
+    DistributedPlan,
+    Fragment,
+)
+from opentenbase_tpu.storage.column import Column
+from opentenbase_tpu.storage.table import ColumnBatch
+from opentenbase_tpu.utils.hashing import combine_hashes, hash32_np
+
+
+def concat_batches(batches: list[ColumnBatch]) -> ColumnBatch:
+    batches = [b for b in batches if b is not None]
+    if not batches:
+        raise ValueError("no batches to concatenate")
+    if len(batches) == 1:
+        return batches[0]
+    first = batches[0]
+    names = list(first.columns.keys())
+    cols: dict[str, Column] = {}
+    for i, name in enumerate(names):
+        parts = [list(b.columns.values())[i] for b in batches]
+        data = np.concatenate([p.data for p in parts])
+        if any(p.validity is not None for p in parts):
+            validity = np.concatenate(
+                [
+                    (
+                        np.ones(len(p.data), np.bool_)
+                        if p.validity is None
+                        else p.validity
+                    )
+                    for p in parts
+                ]
+            )
+        else:
+            validity = None
+        ref = parts[0]
+        cols[name] = Column(ref.type, data, validity, ref.dictionary)
+    return ColumnBatch(cols, sum(b.nrows for b in batches))
+
+
+def hash_batch_columns(batch: ColumnBatch, positions: list[int]) -> np.ndarray:
+    """uint32 placement hash over key columns — must agree with the
+    locator's routing (utils/hashing.py shared formula)."""
+    cols = list(batch.columns.values())
+    hashes = []
+    for p in positions:
+        col = cols[p]
+        data = col.data
+        if col.type.id == t.TypeId.TEXT and col.dictionary is not None:
+            codes = np.clip(data, 0, max(len(col.dictionary) - 1, 0))
+            data = (
+                col.dictionary.hash_array()[codes]
+                if len(col.dictionary)
+                else np.zeros(len(data), np.uint32)
+            )
+            h = hash32_np(data.astype(np.int64))
+        else:
+            h = hash32_np(data)
+        if col.validity is not None:
+            h = np.where(col.validity, h, np.uint32(0))
+        hashes.append(h)
+    return combine_hashes(hashes, np)
+
+
+class DistExecutor:
+    """Runs a DistributedPlan over per-node shard stores."""
+
+    def __init__(
+        self,
+        catalog: Catalog,
+        node_stores: dict[int, dict],  # node index -> {table -> ShardStore}
+        snapshot_ts: Optional[int] = None,
+        own_writes: Optional[dict[int, dict]] = None,  # node -> table -> writes
+    ):
+        self.catalog = catalog
+        self.node_stores = node_stores
+        self.snapshot_ts = snapshot_ts
+        self.own_writes = own_writes or {}
+
+    def _stores(self, node: int) -> dict:
+        if node == COORDINATOR:
+            return {}
+        return self.node_stores.get(node, {})
+
+    def run(self, dplan: DistributedPlan) -> ColumnBatch:
+        subquery_values = []
+        for sub in dplan.subplans:
+            b = self._run_one(sub, subquery_values=[])
+            ty = (
+                next(iter(b.columns.values())).type
+                if b.columns
+                else t.INT8
+            )
+            if b.nrows > 1:
+                raise RuntimeError(
+                    "more than one row returned by a subquery used as an expression"
+                )
+            if b.nrows == 0 or not b.columns:
+                subquery_values.append((None, ty))
+            else:
+                col = next(iter(b.columns.values()))
+                v = col.data[0] if col.valid_mask[0] else None
+                subquery_values.append((v, ty))
+        return self._run_one(dplan, subquery_values)
+
+    def _run_one(self, dplan: DistributedPlan, subquery_values) -> ColumnBatch:
+        # fragment -> consumer node -> input batch
+        motioned: dict[int, dict[int, ColumnBatch]] = {}
+        for frag in dplan.fragments:
+            outs: dict[int, ColumnBatch] = {}
+            for node in frag.nodes:
+                ex = LocalExecutor(
+                    self.catalog,
+                    self._stores(node),
+                    self.snapshot_ts,
+                    remote_inputs={
+                        j: per_node[node]
+                        for j, per_node in motioned.items()
+                        if node in per_node
+                    },
+                    subquery_values=subquery_values,
+                    own_writes=self.own_writes.get(node),
+                )
+                outs[node] = ex.run_plan(frag.root)
+            motioned[frag.index] = self._apply_motion(frag, outs)
+        ex = LocalExecutor(
+            self.catalog,
+            {},
+            self.snapshot_ts,
+            remote_inputs={
+                j: per_node[COORDINATOR]
+                for j, per_node in motioned.items()
+                if COORDINATOR in per_node
+            },
+            subquery_values=subquery_values,
+        )
+        return ex.run_plan(dplan.root)
+
+    def _apply_motion(
+        self, frag: Fragment, outs: dict[int, ColumnBatch]
+    ) -> dict[int, ColumnBatch]:
+        ordered = [outs[n] for n in frag.nodes]
+        if frag.motion == "gather":
+            return {COORDINATOR: concat_batches(ordered)}
+        if frag.motion == "broadcast":
+            merged = concat_batches(ordered)
+            return {n: merged for n in frag.dest_nodes}
+        if frag.motion == "redistribute":
+            dest = list(frag.dest_nodes)
+            shards: dict[int, list[ColumnBatch]] = {n: [] for n in dest}
+            for b in ordered:
+                if b.nrows == 0:
+                    continue
+                h = hash_batch_columns(b, list(frag.hash_positions))
+                route = (h % np.uint32(len(dest))).astype(np.int64)
+                for di, n in enumerate(dest):
+                    idx = np.nonzero(route == di)[0]
+                    shards[n].append(b.take(idx))
+            out = {}
+            for n in dest:
+                parts = shards[n] or [self._empty_like(ordered)]
+                out[n] = concat_batches(parts)
+            return out
+        raise ValueError(f"unknown motion {frag.motion}")
+
+    @staticmethod
+    def _empty_like(batches: list[ColumnBatch]) -> ColumnBatch:
+        ref = batches[0]
+        return ref.take(np.empty(0, dtype=np.int64))

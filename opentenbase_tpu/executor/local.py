@@ -34,6 +34,7 @@ from opentenbase_tpu.ops import join as join_ops
 from opentenbase_tpu.ops import sort as sort_ops
 from opentenbase_tpu.ops.expr import (
     LITERAL_DICT,
+    DictTranslateParam,
     ExprCompiler,
     resolve_param,
 )
@@ -70,10 +71,23 @@ class LocalExecutor:
         catalog: Catalog,
         stores: dict[str, ShardStore],
         snapshot_ts: Optional[int] = None,
+        remote_inputs: Optional[dict[int, ColumnBatch]] = None,
+        subquery_values: Optional[list] = None,
+        own_writes: Optional[dict] = None,
     ):
         self.catalog = catalog
         self.stores = stores
         self.snapshot_ts = snapshot_ts
+        # fragment index -> motioned input batch (distributed execution;
+        # the squeue consumer side of the reference)
+        self.remote_inputs = remote_inputs or {}
+        if subquery_values is not None:
+            self._subquery_values = subquery_values
+        # table -> (ins_ranges, del_idx): the executing transaction's own
+        # uncommitted writes, made visible/invisible on top of the snapshot
+        # (the reference's "xmin is my own xid" branch of
+        # HeapTupleSatisfiesMVCC, tqual.c)
+        self.own_writes = own_writes or {}
 
     # -- dictionary access ----------------------------------------------
     def _dict(self, dict_id: str) -> Dictionary:
@@ -156,12 +170,38 @@ class LocalExecutor:
         n = int(keep.sum())
         return ColumnBatch(cols, n)
 
+    def run_plan(self, root: L.LogicalPlan) -> ColumnBatch:
+        """Evaluate one plan tree (no subplan handling) to a host batch."""
+        return self.to_host(self.eval(root))
+
     # -- plan dispatch ----------------------------------------------------
     def eval(self, plan: L.LogicalPlan) -> DevBatch:
         m = getattr(self, f"_eval_{type(plan).__name__.lower()}", None)
         if m is None:
             raise ExecError(f"no executor for {type(plan).__name__}")
         return m(plan)
+
+    def _eval_remotesource(self, plan) -> DevBatch:
+        batch = self.remote_inputs.get(plan.fragment)
+        if batch is None:
+            raise ExecError(f"no input for fragment {plan.fragment}")
+        return self._batch_to_dev(batch, plan.schema)
+
+    def _batch_to_dev(self, batch: ColumnBatch, schema) -> DevBatch:
+        nrows = batch.nrows
+        padded = filt_ops.bucket_size(max(nrows, 1))
+        cols = []
+        for col in batch.columns.values():
+            d = _pad_to(np.asarray(col.data), padded)
+            v = (
+                None
+                if col.validity is None
+                else _pad_to(col.validity, padded, fill=False)
+            )
+            cols.append((jnp.asarray(d), None if v is None else jnp.asarray(v)))
+        live = np.zeros(padded, dtype=np.bool_)
+        live[:nrows] = True
+        return DevBatch(tuple(schema), cols, jnp.asarray(live), padded)
 
     # -- leaves -----------------------------------------------------------
     def _eval_scan(self, plan: L.Scan) -> DevBatch:
@@ -181,9 +221,16 @@ class LocalExecutor:
         live[:nrows] = True
         if self.snapshot_ts is not None:
             snap = np.int64(self.snapshot_ts)
-            xmin = _pad_to(store.xmin_ts[:nrows], padded, fill=INF_TS)
-            xmax = _pad_to(store.xmax_ts[:nrows], padded, fill=0)
-            live[:nrows] &= (xmin[:nrows] <= snap) & (snap < xmax[:nrows])
+            xmin = store.xmin_ts[:nrows]
+            xmax = store.xmax_ts[:nrows]
+            live[:nrows] &= (xmin <= snap) & (snap < xmax)
+        own = self.own_writes.get(plan.table)
+        if own is not None:
+            ins_ranges, del_idx = own
+            for s, e in ins_ranges:
+                live[s:min(e, nrows)] = True
+            if len(del_idx):
+                live[np.asarray(del_idx)] = False
         mask = jnp.asarray(live)
         return DevBatch(plan.schema, cols, mask, padded)
 
@@ -204,8 +251,8 @@ class LocalExecutor:
                     continue
                 v = e.value
                 if oc.type.is_text:
-                    assert oc.dict_id is not None
-                    v = self._dict(oc.dict_id).encode_one(str(v))
+                    d = self._dict(oc.dict_id or LITERAL_DICT)
+                    v = d.encode_one(str(v))
                 data[ri] = v
                 valid[ri] = True
             all_valid = bool(valid[:nrows].all()) and nrows > 0
@@ -263,7 +310,12 @@ class LocalExecutor:
             distinct = [a for a in plan.aggs if a.distinct]
             if distinct:
                 return self._eval_distinct_agg(plan, child, keys, specs, vals)
-            outs = agg_ops.scalar_reduce(vals, child.mask, tuple(specs))
+            mask = (
+                child.mask
+                if child.mask is not None
+                else jnp.ones(child.n, jnp.bool_)
+            )
+            outs = agg_ops.scalar_reduce(vals, mask, tuple(specs))
             cols = self._finalize_aggs(plan.aggs, specs, outs, scalar=True)
             return DevBatch(plan.schema, _as_rows(cols), None, 1)
 
@@ -388,7 +440,8 @@ class LocalExecutor:
                     specs2.append("count")
                     vals2.append(ded_arg)
         if not plan.group_exprs:
-            outs = agg_ops.scalar_reduce(vals2, gvalid, tuple(specs2))
+            gv = gvalid if gvalid is not None else jnp.ones(cap1, jnp.bool_)
+            outs = agg_ops.scalar_reduce(vals2, gv, tuple(specs2))
             cols = self._finalize_aggs(plan.aggs, specs2, outs, scalar=True)
             return DevBatch(plan.schema, _as_rows(cols), None, 1)
         perm2, seg2, ng2 = agg_ops.group_ids(ded_keys, gvalid)
@@ -500,6 +553,22 @@ class LocalExecutor:
         build_keys = [
             self._broadcast(fn(build.cols, bp), build.n) for fn in bf
         ]
+        # TEXT keys: dictionary codes only compare within one dictionary.
+        # Translate the probe side's codes into the build side's dictionary
+        # (inserting unseen values) so equality on codes is equality on
+        # strings — the cross-table alignment the reference never needs
+        # because it ships raw datums (squeue.c).
+        pschema = plan.right.schema if flipped else plan.left.schema
+        bschema = plan.left.schema if flipped else plan.right.schema
+        for i, (lk_e, rk_e) in enumerate(zip(lk, rk)):
+            if not lk_e.type.is_text:
+                continue
+            pdid = _texpr_did(lk_e, pschema) or LITERAL_DICT
+            bdid = _texpr_did(rk_e, bschema) or LITERAL_DICT
+            if pdid == bdid:
+                continue
+            d, v = probe_keys[i]
+            probe_keys[i] = (self._translate_codes(d, pdid, bdid), v)
         probe_keys, build_keys = _align_key_dtypes(probe_keys, build_keys)
 
         build_ids, probe_ids = join_ops.encode_keys(
@@ -566,6 +635,13 @@ class LocalExecutor:
         return out
 
     # -- union -------------------------------------------------------------
+    def _translate_codes(self, d, src_did: str, dst_did: str):
+        """Map TEXT codes from one dictionary into another on device."""
+        tbl = resolve_param(
+            DictTranslateParam(src_did, dst_did), self._dicts_view()
+        )
+        return tbl[jnp.clip(d, 0, tbl.shape[0] - 1)]
+
     def _eval_union(self, plan: L.Union) -> DevBatch:
         parts = [self.eval(c) for c in plan.inputs]
         total = sum(p.n for p in parts)
@@ -576,8 +652,21 @@ class LocalExecutor:
             datas = []
             valids = []
             any_valid = any(p.cols[ci][1] is not None for p in parts)
-            for p in parts:
+            out_did = (
+                (plan.schema[ci].dict_id or LITERAL_DICT)
+                if plan.schema[ci].type.is_text
+                else None
+            )
+            for pi, p in enumerate(parts):
                 d, v = p.cols[ci]
+                if out_did is not None:
+                    src_did = (
+                        plan.inputs[pi].schema[ci].dict_id or LITERAL_DICT
+                    )
+                    if src_did != out_did:
+                        # branches carry codes of different dictionaries;
+                        # align them or the decode step reads garbage
+                        d = self._translate_codes(d, src_did, out_did)
                 datas.append(d)
                 if any_valid:
                     valids.append(

@@ -1,0 +1,11 @@
+"""GTS service: the Global Transaction Manager rebuilt as a timestamp
+oracle (the reference's src/gtm — a 70k-LoC mini-postgres — reduced to its
+essential contract: monotonic global timestamps, a transaction/prepared-GID
+registry, cluster sequences, and durable state with standby replication)."""
+
+from opentenbase_tpu.gtm.gts import (  # noqa: F401
+    GlobalTimestamp,
+    GTSClock,
+    GTSServer,
+    TxnState,
+)

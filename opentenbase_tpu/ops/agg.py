@@ -30,6 +30,26 @@ _I64_MAX = np.int64(2**62)  # sentinels safely inside int64
 _I64_MIN = np.int64(-(2**62))
 
 
+def float_key_parts(d) -> list:
+    """Equality-preserving int32 views of a float column for grouping and
+    join keys. -0.0 folds into +0.0 and every NaN collapses to one bit
+    pattern (SQL groups NaNs together). float64 cannot be bitcast on TPU
+    (the x64 rewrite lacks 64-bit bitcast), so it is split double-float
+    style into hi+lo f32 parts — exact discrimination down to ~2^-48
+    relative difference, far below SQL-visible precision."""
+    d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
+    d = jnp.where(jnp.isnan(d), jnp.full((), jnp.nan, d.dtype), d)
+    if d.dtype == jnp.float64:
+        hi = d.astype(jnp.float32)
+        lo = (d - hi.astype(jnp.float64)).astype(jnp.float32)
+        lo = jnp.where(jnp.isfinite(d), lo, jnp.zeros((), jnp.float32))
+        return [
+            jax.lax.bitcast_convert_type(hi, jnp.int32),
+            jax.lax.bitcast_convert_type(lo, jnp.int32),
+        ]
+    return [jax.lax.bitcast_convert_type(d.astype(jnp.float32), jnp.int32)]
+
+
 def _key_parts(keys):
     """Flatten (data, valid) group keys into comparable integer parts.
     Floats are bitcast so exact equality grouping matches SQL GROUP BY."""
@@ -37,10 +57,12 @@ def _key_parts(keys):
     for data, valid in keys:
         d = data
         if jnp.issubdtype(d.dtype, jnp.floating):
-            d = jax.lax.bitcast_convert_type(
-                d.astype(jnp.float32), jnp.int32
-            )
-        elif jnp.issubdtype(d.dtype, jnp.bool_):
+            for piece in float_key_parts(d):
+                if valid is not None:
+                    piece = jnp.where(valid, piece, 0)
+                parts.append((piece, valid))
+            continue
+        if jnp.issubdtype(d.dtype, jnp.bool_):
             d = d.astype(jnp.int32)
         if valid is not None:
             d = jnp.where(valid, d, 0)  # canonicalize NULL payloads
@@ -149,6 +171,10 @@ def group_reduce(keys, vals, perm, seg, num_groups: int, specs: tuple):
             out_vals.append((c[:num_groups], got[:num_groups]))
             continue
         if spec == "sum":
+            # segment_sum preserves dtype: widen narrow ints so TPC-H
+            # scale sums don't wrap in int32
+            if jnp.issubdtype(data.dtype, jnp.integer):
+                data = data.astype(jnp.int64)
             zero = jnp.zeros((), dtype=data.dtype)
             d = jnp.where(vvalid, data, zero)
             s = jax.ops.segment_sum(d, seg_unsorted, num_segments=nseg)
@@ -194,11 +220,9 @@ def scalar_reduce(vals, mask, specs: tuple):
     out = []
     for spec, val in zip(specs, vals):
         if spec == "count_star":
-            c = (
-                jnp.sum(mask, dtype=jnp.int64)
-                if mask is not None
-                else jnp.asarray(0, jnp.int64)
-            )
+            # callers materialize the mask (a None mask would lose the
+            # batch's row count here)
+            c = jnp.sum(mask, dtype=jnp.int64)
             out.append((c, jnp.asarray(True)))
             continue
         data, valid = val
@@ -212,6 +236,8 @@ def scalar_reduce(vals, mask, specs: tuple):
         if spec == "count":
             out.append((cnt, jnp.asarray(True)))
         elif spec == "sum":
+            if jnp.issubdtype(data.dtype, jnp.integer):
+                data = data.astype(jnp.int64)
             zero = jnp.zeros((), dtype=data.dtype)
             s = jnp.sum(jnp.where(vvalid, data, zero))
             out.append((s, cnt > 0))

@@ -80,12 +80,14 @@ class DictTranslateParam:
 class CodeMaskParam:
     """Per-code bool membership mask over dictionary ``dict_id``, padded to
     a power of two. ``patterns`` are LIKE patterns (ORed); ``values`` exact
-    strings; exactly one of the two is set."""
+    strings; ``cmp`` an ordered comparison (op, reference-string). Exactly
+    one of the three is set."""
 
     dict_id: str
     patterns: tuple[str, ...] = ()
     values: tuple[str, ...] = ()
     ilike: bool = False
+    cmp: tuple[str, ...] = ()  # (op, ref) for ordered TEXT comparison
 
 
 @dataclass(frozen=True)
@@ -332,7 +334,9 @@ class ExprCompiler:
                     nz = rd != 0
                     safe = jnp.where(nz, rd, 1)
                     valid = nz if valid is None else (valid & nz)
-                    return (ld % safe, valid)
+                    # PG numeric modulo takes the dividend's sign
+                    m = jnp.sign(ld) * (abs(ld) % abs(safe))
+                    return (m.astype(ld.dtype), valid)
                 raise NotImplementedError(op)
 
             return run_dec
@@ -447,9 +451,7 @@ class ExprCompiler:
         cmp_op = op
         if flip:
             cmp_op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}[op]
-        pi = self._param(
-            CodeMaskParam(did, values=(f"__cmp__{cmp_op}__{value}",))
-        )
+        pi = self._param(CodeMaskParam(did, cmp=(cmp_op, str(value))))
 
         def run_ord(cols, params):
             d, v = cf(cols, params)
@@ -463,6 +465,9 @@ class ExprCompiler:
         import jax.numpy as jnp
 
         cf = self._c(e.operand, dids)
+        # SQL 3-valued logic: a NULL in the list makes non-matches NULL
+        # (so `x NOT IN (.., NULL)` filters every row)
+        has_null = any(i.value is None for i in e.items)
         if e.operand.type.is_text:
             did = self._expr_dict_id(e.operand, dids)
             if did is None:
@@ -473,9 +478,10 @@ class ExprCompiler:
             def run_tin(cols, params):
                 d, v = cf(cols, params)
                 mask = params[pi]
-                out = mask[jnp.clip(d, 0, mask.shape[0] - 1)]
-                if e.negated:
-                    out = ~out
+                match = mask[jnp.clip(d, 0, mask.shape[0] - 1)]
+                out = ~match if e.negated else match
+                if has_null:
+                    v = match if v is None else (v & match)
                 return (out, v)
 
             return run_tin
@@ -487,9 +493,10 @@ class ExprCompiler:
 
         def run_in(cols, params):
             d, v = cf(cols, params)
-            out = jnp.isin(d, jnp.asarray(items))
-            if e.negated:
-                out = ~out
+            match = jnp.isin(d, jnp.asarray(items))
+            out = ~match if e.negated else match
+            if has_null:
+                v = match if v is None else (v & match)
             return (out, v)
 
         return run_in
@@ -832,24 +839,22 @@ def resolve_param(spec: ParamSpec, dictionaries, subquery_values=None):
                 for i, s in enumerate(vals):
                     if rx.match(s):
                         mask[i] = True
+        elif spec.cmp:
+            op, ref = spec.cmp
+            cmpf = {
+                "<": lambda s: s < ref,
+                "<=": lambda s: s <= ref,
+                ">": lambda s: s > ref,
+                ">=": lambda s: s >= ref,
+            }[op]
+            for i, s in enumerate(vals):
+                if cmpf(s):
+                    mask[i] = True
         else:
             for v in spec.values:
-                if v.startswith("__cmp__"):
-                    _, _, rest = v.partition("__cmp__")
-                    op, _, ref = rest.partition("__")
-                    cmpf = {
-                        "<": lambda s: s < ref,
-                        "<=": lambda s: s <= ref,
-                        ">": lambda s: s > ref,
-                        ">=": lambda s: s >= ref,
-                    }[op]
-                    for i, s in enumerate(vals):
-                        if cmpf(s):
-                            mask[i] = True
-                else:
-                    code = d.get_code(v)
-                    if code is not None:
-                        mask[code] = True
+                code = d.get_code(v)
+                if code is not None:
+                    mask[code] = True
         return jnp.asarray(mask)
 
     if isinstance(spec, SubqueryScalarParam):

@@ -43,21 +43,30 @@ def encode_keys(build_keys, probe_keys, build_mask, probe_mask):
     """
     nb = build_keys[0][0].shape[0]
     npr = probe_keys[0][0].shape[0]
+    from opentenbase_tpu.ops.agg import float_key_parts
+
     parts = []
     for (bd, bv), (pd, pv) in zip(build_keys, probe_keys):
         if jnp.issubdtype(bd.dtype, jnp.floating) or jnp.issubdtype(
             pd.dtype, jnp.floating
         ):
-            bd = jax.lax.bitcast_convert_type(bd.astype(jnp.float32), jnp.int32)
-            pd = jax.lax.bitcast_convert_type(pd.astype(jnp.float32), jnp.int32)
-        d = jnp.concatenate([bd.astype(jnp.int64), pd.astype(jnp.int64)])
+            # exact float views without 64-bit bitcasts (TPU-safe)
+            target = jnp.promote_types(bd.dtype, pd.dtype)
+            bparts = float_key_parts(bd.astype(target))
+            pparts = float_key_parts(pd.astype(target))
+        else:
+            bparts, pparts = [bd], [pd]
         if bv is None and pv is None:
             v = None
         else:
             bvv = jnp.ones(nb, jnp.bool_) if bv is None else bv
             pvv = jnp.ones(npr, jnp.bool_) if pv is None else pv
             v = jnp.concatenate([bvv, pvv])
-        parts.append((d, v))
+        for bpart, ppart in zip(bparts, pparts):
+            d = jnp.concatenate(
+                [bpart.astype(jnp.int64), ppart.astype(jnp.int64)]
+            )
+            parts.append((d, v))
 
     n = nb + npr
     perm = jnp.arange(n, dtype=jnp.int32)

@@ -693,6 +693,9 @@ class Analyzer:
         for c in columns:
             meta.column_type(c)  # existence check
         target_types = [meta.schema[c] for c in columns]
+        def target_dict_id(col: str, ty: t.SqlType):
+            return f"{stmt.table}.{col}" if ty.id == t.TypeId.TEXT else None
+
         if stmt.query is not None:
             src = self.select(stmt.query)
             if len(src.schema) != len(columns):
@@ -701,7 +704,10 @@ class Analyzer:
                 _cast(E.Col(i, c.type, c.name), ty)
                 for i, (c, ty) in enumerate(zip(src.schema, target_types))
             )
-            schema = tuple(L.OutCol(c, ty) for c, ty in zip(columns, target_types))
+            schema = tuple(
+                L.OutCol(c, ty, target_dict_id(c, ty))
+                for c, ty in zip(columns, target_types)
+            )
             src = L.Project(src, exprs, schema)
         else:
             rows = []
@@ -713,7 +719,10 @@ class Analyzer:
                     te = self.expr(v, ExprContext(Scope([]), self))
                     trow.append(_cast(te, ty))
                 rows.append(tuple(trow))
-            schema = tuple(L.OutCol(c, ty) for c, ty in zip(columns, target_types))
+            schema = tuple(
+                L.OutCol(c, ty, target_dict_id(c, ty))
+                for c, ty in zip(columns, target_types)
+            )
             src = L.ValuesScan(tuple(rows), schema)
         return L.InsertPlan(stmt.table, src, tuple(columns))
 

@@ -1,24 +1,29 @@
-"""Test harness: simulate a multi-datanode TPU mesh on CPU.
+"""Test harness: mini-cluster in one process space.
 
-The reference tests multi-node behavior by bootstrapping a real mini cluster
-of processes on localhost (src/test/regress/pg_regress.c:121-141 builds
-1 GTM + 2 CN + 2 DN). Our equivalent: force XLA to expose 8 virtual CPU
-devices so every sharding/collective path runs exactly as it would on an
-8-chip TPU slice. Must be set before jax initializes.
+The reference tests multi-node behavior by bootstrapping a real cluster of
+processes on localhost (src/test/regress/pg_regress.c:121-141 builds
+1 GTM + 2 CN + 2 DN). Our equivalent runs everything in-process.
+
+Backend note: under the axon harness, JAX's default backend is the real
+TPU chip regardless of JAX_PLATFORMS — single-device kernels in these
+tests therefore exercise actual TPU compilation. Multi-device mesh tests
+use the 8 virtual CPU devices (``jax.devices("cpu")``), which exist thanks
+to the XLA_FLAGS below; on a plain CPU box the same flags make everything
+run on the virtual mesh.
 """
 
 import os
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ["JAX_PLATFORMS"] = "cpu"
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def jax8():
+    """8-device mesh for sharding tests (virtual CPU devices)."""
     import jax
 
-    devices = jax.devices()
-    assert len(devices) >= 8, f"expected 8 virtual devices, got {devices}"
-    return jax
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, f"expected 8 virtual cpu devices, got {devices}"
+    return jax, devices

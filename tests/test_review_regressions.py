@@ -148,3 +148,69 @@ def test_scalar_subquery_in_group_query_executes(db):
         "select flag, (select max(o_id) from orders) from items group by flag order by flag",
     )
     assert rows == [("a", 8), ("b", 8)]
+
+
+# --- second-round review findings -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster_sess():
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=32).session()
+    s.execute("create table ta (s text) distribute by roundrobin")
+    s.execute("create table tb (s text) distribute by roundrobin")
+    s.execute("insert into ta values ('x'),('y')")
+    s.execute("insert into tb values ('z'),('x')")
+    s.execute("create table big (g int, x int) distribute by roundrobin")
+    s.execute("insert into big values (1, 2000000000), (1, 2000000000)")
+    s.execute("create table f8 (x double) distribute by roundrobin")
+    s.execute("insert into f8 values (1.0000000001), (1.0000000002)")
+    s.execute("create table ti (id int) distribute by roundrobin")
+    s.execute("insert into ti values (1),(2),(3)")
+    return s
+
+
+def test_union_all_cross_dictionary_text(cluster_sess):
+    rows = cluster_sess.query(
+        "select s from ta union all select s from tb order by s"
+    )
+    assert [r[0] for r in rows] == ["x", "x", "y", "z"]
+
+
+def test_grouped_int4_sum_no_overflow(cluster_sess):
+    rows = cluster_sess.query("select g, sum(x) from big group by g")
+    assert rows == [(1, 4000000000)]
+
+
+def test_not_in_with_null_returns_nothing(cluster_sess):
+    rows = cluster_sess.query("select id from ti where id not in (2, null)")
+    assert rows == []
+    rows = cluster_sess.query("select id from ti where id in (2, null)")
+    assert rows == [(2,)]
+
+
+def test_float8_group_keys_full_precision(cluster_sess):
+    rows = cluster_sess.query("select x, count(*) from f8 group by x")
+    assert len(rows) == 2 and all(r[1] == 1 for r in rows)
+
+
+def test_decimal_modulo_dividend_sign(cluster_sess):
+    rows = cluster_sess.query("select (0 - 7.5) % 2.0")
+    assert rows[0][0] == pytest.approx(-1.5)
+
+
+def test_text_in_literal_cmp_prefix_not_special(cluster_sess):
+    cluster_sess.execute("create table tw (s text) distribute by roundrobin")
+    cluster_sess.execute(
+        "insert into tw values ('a'),('b'),('__cmp__<__z')"
+    )
+    rows = cluster_sess.query("select s from tw where s in ('__cmp__<__z')")
+    assert rows == [("__cmp__<__z",)]
+
+
+def test_count_star_over_scalar_agg_subquery(cluster_sess):
+    rows = cluster_sess.query(
+        "select count(*) from (select max(g) from big) s"
+    )
+    assert rows == [(1,)]
