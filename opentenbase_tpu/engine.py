@@ -147,6 +147,20 @@ class Cluster:
         self.paused = False
         self.barriers: list[tuple[str, int]] = []
         self.indexes: dict[str, A.CreateIndex] = {}
+        self._fused = None
+        self._fused_failed = False
+
+    def fused_executor(self):
+        """Lazily built FusedExecutor over the default device mesh (the
+        real TPU under axon; virtual CPU devices elsewhere)."""
+        if self._fused is None and not self._fused_failed:
+            try:
+                from opentenbase_tpu.executor.fused import FusedExecutor
+
+                self._fused = FusedExecutor(self.catalog, self.stores)
+            except Exception:
+                self._fused_failed = True
+        return self._fused
 
     # -- table lifecycle -------------------------------------------------
     def create_table_stores(self, meta: TableMeta) -> None:
@@ -251,13 +265,80 @@ class Session:
 
     def _run_statement_plan(self, splan: L.StatementPlan) -> ColumnBatch:
         dplan = distribute_statement(splan, self.cluster.catalog)
+        snapshot = self._snapshot()
+        fused = self._try_fused(dplan, snapshot)
+        if fused is not None:
+            return fused
         ex = DistExecutor(
             self.cluster.catalog,
             self.cluster.stores,
-            self._snapshot(),
+            snapshot,
             own_writes=self.txn.own_writes_view() if self.txn else None,
         )
         return ex.run(dplan)
+
+    def _try_fused(self, dplan, snapshot) -> Optional[ColumnBatch]:
+        """Route eligible single-fragment aggregations through the fused
+        shard_map program (executor/fused.py). Falls back on any
+        unsupported shape; never used inside a writing transaction (the
+        device cache has no own-write overlay)."""
+        if self.gucs.get("enable_fused_execution", True) is False:
+            return None
+        if self.txn is not None and self.txn.writes:
+            return None
+        if len(dplan.fragments) != 1 or dplan.subplans:
+            return None
+        fx = self.cluster.fused_executor()
+        if fx is None:
+            return None
+        from opentenbase_tpu.executor.fused import FusedUnsupported
+
+        try:
+            out = fx.fragment_output(
+                dplan.fragments[0],
+                snapshot,
+                self._dicts_view(),
+                [],
+            )
+        except FusedUnsupported:
+            return None
+        except Exception:
+            # fused path is an optimization: never let it break a query
+            return None
+        if out is None:
+            return None
+        ex = LocalExecutor(
+            self.cluster.catalog,
+            {},
+            snapshot,
+            remote_inputs={0: out},
+            subquery_values=[],
+        )
+        # the merge input is tiny (S * group-cap rows at most): run the
+        # coordinator ops on host CPU devices — eager dispatch of tiny ops
+        # to a remote TPU costs a network round-trip each
+        import jax
+
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            return ex.run_plan(dplan.root)
+        with jax.default_device(cpu):
+            return ex.run_plan(dplan.root)
+
+    def _dicts_view(self):
+        session = self
+
+        class _View:
+            def __getitem__(self, key):
+                from opentenbase_tpu.ops.expr import LITERAL_DICT
+
+                if key == LITERAL_DICT:
+                    return session.cluster.catalog.literals
+                table, _, col = key.partition(".")
+                return session.cluster.catalog.get(table).dictionaries[col]
+
+        return _View()
 
     # -- INSERT ----------------------------------------------------------
     def _x_insert(self, stmt: A.Insert) -> Result:
@@ -729,7 +810,17 @@ class Session:
         return Result("EXPLAIN", rows, ["QUERY PLAN"], len(rows))
 
     def _x_setstmt(self, stmt: A.SetStmt) -> Result:
-        self.gucs[stmt.name] = stmt.value
+        # normalize boolean/int GUC spellings (guc.c's parse_bool analog)
+        v = stmt.value
+        if isinstance(v, str):
+            low = v.lower()
+            if low in ("true", "on", "yes", "1"):
+                v = True
+            elif low in ("false", "off", "no", "0"):
+                v = False
+            elif low.lstrip("-").isdigit():
+                v = int(low)
+        self.gucs[stmt.name] = v
         return Result("SET")
 
     def _x_showstmt(self, stmt: A.ShowStmt) -> Result:

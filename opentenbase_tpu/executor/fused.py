@@ -1,0 +1,404 @@
+"""Fused mesh executor: whole plan fragments as ONE shard_map program.
+
+The general path (executor/dist.py) runs each datanode's fragment as a
+separate LocalExecutor call with host-mediated motions — correct, but it
+round-trips HBM per operator and serializes datanodes. This module is the
+TPU-native fast path the SURVEY §7 design calls for: all shards of a table
+live stacked on the device mesh ([S, Rmax] per column, sharded over the
+'dn' axis), and an eligible fragment (scan → filter → project → partial
+aggregate) compiles to a single jitted shard_map program. XLA fuses the
+filter/projection into the aggregation scatter; the only inter-device
+traffic is the gather of [S, cap] partials (an all_gather when merged
+in-program), riding ICI instead of the reference's DataPump sockets
+(src/backend/pgxc/squeue/squeue.c).
+
+Eligibility (v1): single sharded/roundrobin/replicated base table, chain of
+Filter/Project between Scan and one Aggregate, no DISTINCT aggs. Everything
+else falls back to the general executor. Grouped results use a static group
+capacity; overflow is detected post-hoc and falls back too.
+
+The same machinery drives the multichip dry-run: a Mesh over N devices,
+one shard per device, partial aggregation + all_gather + an all_to_all
+hash redistribution — the dp/sp collective pattern of the scaling-book
+recipe (mesh → shardings → XLA inserts collectives).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+import opentenbase_tpu.ops  # noqa: F401  (x64)
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from opentenbase_tpu.ops import agg as agg_ops
+from opentenbase_tpu.ops import filter as filt_ops
+from opentenbase_tpu.ops.expr import ExprCompiler, resolve_param
+from opentenbase_tpu.plan import logical as L
+from opentenbase_tpu.plan.distribute import Fragment
+from opentenbase_tpu.plan.skey import plan_skey
+from opentenbase_tpu.storage.column import Column
+from opentenbase_tpu.storage.table import ColumnBatch
+
+DEFAULT_GROUP_CAP = 1024
+
+
+# ---------------------------------------------------------------------------
+# Device table cache: stacked shards on the mesh
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeviceTable:
+    """All shards of one table stacked: column name -> [S, Rmax] array
+    (sharded over the mesh 'dn' axis), plus validity and MVCC columns."""
+
+    columns: dict[str, jax.Array]
+    validity: dict[str, Optional[jax.Array]]
+    xmin: jax.Array  # [S, Rmax]
+    xmax: jax.Array
+    nrows: np.ndarray  # [S] live row count per shard (host)
+    rmax: int
+    versions: tuple[int, ...]
+    node_order: tuple[int, ...]
+
+
+class DeviceCache:
+    """Uploads/refreshes stacked shard columns; keyed by store versions.
+
+    The buffer-manager analog: instead of 8KB page I/O we re-upload a
+    table's columns when any shard's version changed (storage/table.py).
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._tables: dict[str, DeviceTable] = {}
+
+    def get(self, name: str, meta, node_stores: dict[int, dict]) -> DeviceTable:
+        nodes = tuple(meta.node_indices)
+        stores = [node_stores[n][name] for n in nodes]
+        versions = tuple(s.version for s in stores)
+        cached = self._tables.get(name)
+        if cached is not None and cached.versions == versions:
+            return cached
+        S = _pad_shards(len(stores), self.mesh.shape["dn"])
+        rmax = filt_ops.bucket_size(max(max((s.nrows for s in stores), default=0), 1))
+        sharding = NamedSharding(self.mesh, P("dn"))
+        columns = {}
+        validity = {}
+        for cname, ty in meta.schema.items():
+            stack = np.zeros((S, rmax), dtype=ty.np_dtype)
+            vstack = None
+            for i, s in enumerate(stores):
+                stack[i, : s.nrows] = s.column_array(cname)
+                vm = s._validity.get(cname)
+                if vm is not None:
+                    if vstack is None:
+                        vstack = np.ones((S, rmax), dtype=np.bool_)
+                    vstack[i, : s.nrows] = vm[: s.nrows]
+            columns[cname] = jax.device_put(stack, sharding)
+            validity[cname] = (
+                None if vstack is None else jax.device_put(vstack, sharding)
+            )
+        xmin = np.full((S, rmax), 2**62, dtype=np.int64)
+        xmax = np.zeros((S, rmax), dtype=np.int64)
+        nrows = np.zeros(S, dtype=np.int64)
+        for i, s in enumerate(stores):
+            xmin[i, : s.nrows] = s.xmin_ts[: s.nrows]
+            xmax[i, : s.nrows] = s.xmax_ts[: s.nrows]
+            nrows[i] = s.nrows
+        dt = DeviceTable(
+            columns,
+            validity,
+            jax.device_put(xmin, sharding),
+            jax.device_put(xmax, sharding),
+            nrows,
+            rmax,
+            versions,
+            nodes,
+        )
+        self._tables[name] = dt
+        return dt
+
+
+def _pad_shards(s: int, d: int) -> int:
+    """Shard count padded up to a multiple of the mesh axis size."""
+    return ((s + d - 1) // d) * d
+
+
+def build_mesh(devices=None) -> Mesh:
+    """1-D 'dn' mesh over the given (or default) devices."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), ("dn",))
+
+
+# ---------------------------------------------------------------------------
+# Fragment pattern matching
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _FusablePartial:
+    scan: L.Scan
+    steps: list  # Filter/Project chain bottom-up (excluding scan/agg)
+    agg: L.Aggregate
+
+
+def _match_partial_fragment(root: L.LogicalPlan) -> Optional[_FusablePartial]:
+    if not isinstance(root, L.Aggregate):
+        return None
+    if any(a.distinct for a in root.aggs):
+        return None
+    steps = []
+    node = root.child
+    while isinstance(node, (L.Filter, L.Project)):
+        steps.append(node)
+        node = node.child
+    if not isinstance(node, L.Scan):
+        return None
+    return _FusablePartial(node, list(reversed(steps)), root)
+
+
+class FusedUnsupported(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Fused executor
+# ---------------------------------------------------------------------------
+
+
+class FusedExecutor:
+    """Compiles eligible partial-agg fragments to one shard_map program."""
+
+    def __init__(self, catalog, node_stores, mesh: Optional[Mesh] = None):
+        self.catalog = catalog
+        self.node_stores = node_stores
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.cache = DeviceCache(self.mesh)
+        self._programs: dict = {}
+
+    # -- eligibility -----------------------------------------------------
+    def fragment_output(
+        self,
+        frag: Fragment,
+        snapshot_ts: Optional[int],
+        dicts_view,
+        subquery_values,
+        group_cap: int = DEFAULT_GROUP_CAP,
+    ) -> Optional[ColumnBatch]:
+        """If the fragment is fusable, compute its gathered output batch
+        (what the motion would deliver to the coordinator). Returns None
+        when not eligible; raises FusedUnsupported mid-way only for
+        overflow (caller falls back)."""
+        if frag.motion != "gather":
+            return None
+        m = _match_partial_fragment(frag.root)
+        if m is None:
+            return None
+        meta = self.catalog.get(m.scan.table)
+        if tuple(meta.node_indices) != tuple(frag.nodes):
+            return None
+        for n in frag.nodes:
+            if m.scan.table not in self.node_stores.get(n, {}):
+                return None
+        dtab = self.cache.get(m.scan.table, meta, self.node_stores)
+
+        has_valid = tuple(
+            dtab.validity[c] is not None for c in m.scan.columns
+        )
+        # structural key: literals are lifted to params, so queries
+        # differing only in constants reuse the compiled program
+        try:
+            skey = plan_skey(frag.root)
+        except NotImplementedError:
+            skey = frag.root.key()
+        key = (skey, dtab.rmax, len(dtab.nrows), group_cap, has_valid)
+        program, param_specs, out_info = self._programs.get(key, (None, None, None))
+        if program is None:
+            program, param_specs, out_info = self._compile(
+                m, meta, dtab, group_cap, has_valid
+            )
+            self._programs[key] = (program, param_specs, out_info)
+
+        params = tuple(
+            resolve_param(s, dicts_view, subquery_values) for s in param_specs
+        )
+        snap = jnp.int64(snapshot_ts if snapshot_ts is not None else 2**61)
+        col_args = tuple(dtab.columns[c] for c in m.scan.columns)
+        # only pass validity arrays that exist; presence is static in the
+        # compiled program (materializing all-ones masks for every
+        # all-valid column would stream megabytes per call)
+        val_args = tuple(
+            dtab.validity[c]
+            for c in m.scan.columns
+            if dtab.validity[c] is not None
+        )
+        nrows_dev = jnp.asarray(dtab.nrows)
+        outs = program(col_args, val_args, dtab.xmin, dtab.xmax, nrows_dev, snap, params)
+        return self._collect(m, outs, out_info, group_cap, dtab)
+
+    # -- compilation -----------------------------------------------------
+    def _compile(
+        self, m: _FusablePartial, meta, dtab: DeviceTable, group_cap, has_valid
+    ):
+        comp = ExprCompiler(lift_consts=True)
+        scan_dids = [c.dict_id for c in m.scan.schema]
+
+        # compile the filter/project chain
+        step_fns = []
+        cur_schema = m.scan.schema
+        for step in m.steps:
+            dids = [c.dict_id for c in cur_schema]
+            if isinstance(step, L.Filter):
+                step_fns.append(("filter", comp.compile(step.predicate, dids)))
+            else:
+                want = [c.dict_id for c in step.schema]
+                fns = [
+                    comp.compile(
+                        e, dids, (w or None) if e.type.is_text else None
+                    )
+                    for e, w in zip(step.exprs, want)
+                ]
+                step_fns.append(("project", fns))
+            cur_schema = step.schema
+
+        dids = [c.dict_id for c in cur_schema]
+        gfns = [comp.compile(g, dids) for g in m.agg.group_exprs]
+        specs: list[str] = []
+        afns: list = []
+        for a in m.agg.aggs:
+            if a.func == "count" and a.arg is None:
+                specs.append("count_star")
+                afns.append(None)
+            elif a.func in ("sum", "count", "min", "max"):
+                specs.append(a.func)
+                afns.append(comp.compile(a.arg, dids))
+            else:
+                raise FusedUnsupported(a.func)
+        grouped = bool(m.agg.group_exprs)
+        nkeys = len(m.agg.group_exprs)
+
+        def per_shard(cols, valids, xmin, xmax, nrows, snap, params):
+            # one shard: cols [Rmax] each; ``valids`` holds arrays only for
+            # columns whose has_valid flag is set (static structure)
+            n = xmin.shape[0]
+            live = jnp.arange(n) < nrows
+            live = live & (xmin <= snap) & (snap < xmax)
+            env = []
+            vi = 0
+            for ci, d in enumerate(cols):
+                if has_valid[ci]:
+                    env.append((d, valids[vi]))
+                    vi += 1
+                else:
+                    env.append((d, None))
+            mask = live
+            for kind, fn in step_fns:
+                if kind == "filter":
+                    d, v = fn(env, params)
+                    keep = d if v is None else (d & v)
+                    mask = mask & jnp.broadcast_to(keep, (n,))
+                else:
+                    env = [
+                        _bcast(f(env, params), n) for f in fn
+                    ]
+            keys = [_bcast(fn(env, params), n) for fn in gfns]
+            vals = [
+                None if fn is None else _bcast(fn(env, params), n)
+                for fn in afns
+            ]
+            if not grouped:
+                outs = agg_ops._scalar_reduce_impl(vals, mask, tuple(specs))
+                return (
+                    [],
+                    [(jnp.reshape(d, (1,)), jnp.reshape(v, (1,))) for d, v in outs],
+                    jnp.ones(1, jnp.bool_),
+                    jnp.int32(1),
+                )
+            perm, seg, ngroups = agg_ops._group_ids_impl(keys, mask)
+            out_keys, out_vals, gvalid = agg_ops._group_reduce_impl(
+                keys, vals, perm, seg, group_cap, tuple(specs)
+            )
+            return out_keys, out_vals, gvalid, ngroups
+
+        mesh = self.mesh
+
+        @partial(jax.jit, static_argnums=())
+        def program(cols, valids, xmin, xmax, nrows, snap, params):
+            try:
+                from jax import shard_map
+            except ImportError:  # older jax
+                from jax.experimental.shard_map import shard_map
+
+            def block(cols, valids, xmin, xmax, nrows):
+                # block: [S/D, Rmax] — vmap the per-shard pipeline
+                f = jax.vmap(
+                    lambda c, v, a, b, r: per_shard(
+                        c, v, a, b, r, snap, params
+                    )
+                )
+                return f(cols, valids, xmin, xmax, nrows)
+
+            out = shard_map(
+                block,
+                mesh=mesh,
+                in_specs=(
+                    tuple(P("dn") for _ in cols),
+                    tuple(P("dn") for _ in valids),
+                    P("dn"),
+                    P("dn"),
+                    P("dn"),
+                ),
+                out_specs=P("dn"),
+            )(cols, valids, xmin, xmax, nrows)
+            return out
+
+        out_info = {"grouped": grouped, "nkeys": nkeys, "specs": specs}
+        return program, comp.params, out_info
+
+    # -- output collection ------------------------------------------------
+    def _collect(self, m, outs, out_info, group_cap, dtab) -> ColumnBatch:
+        # ONE batched device->host fetch: per-array np.asarray pays the
+        # transfer round-trip each time (expensive over the axon tunnel)
+        outs = jax.device_get(outs)
+        out_keys, out_vals, gvalid, ngroups = outs
+        grouped = out_info["grouped"]
+        ng = np.asarray(ngroups)
+        if grouped and int(ng.max()) >= group_cap:
+            raise FusedUnsupported("group capacity overflow")
+        # flatten [S, cap] -> rows, keeping only valid groups
+        gv = np.asarray(gvalid).reshape(-1)
+        agg_plan = m.agg
+        cols: dict[str, Column] = {}
+        keep = np.nonzero(gv)[0]
+        for i, oc in enumerate(agg_plan.schema):
+            if i < out_info["nkeys"]:
+                d, v = out_keys[i]
+            else:
+                d, v = out_vals[i - out_info["nkeys"]]
+            dd = np.asarray(d).reshape(-1)[keep]
+            vv = None if v is None else np.asarray(v).reshape(-1)[keep]
+            dic = None
+            if oc.dict_id:
+                table, _, col = oc.dict_id.partition(".")
+                dic = self.catalog.get(table).dictionaries[col]
+            ty = oc.type
+            if dd.dtype != ty.np_dtype:
+                dd = dd.astype(ty.np_dtype)
+            cols[oc.name] = Column(ty, dd, vv, dic)
+        return ColumnBatch(cols, len(keep))
+
+
+def _bcast(kv, n):
+    d, v = kv
+    if jnp.ndim(d) == 0:
+        d = jnp.broadcast_to(d, (n,))
+    if v is not None and jnp.ndim(v) == 0:
+        v = jnp.broadcast_to(v, (n,))
+    return (d, v)

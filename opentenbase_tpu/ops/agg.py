@@ -72,8 +72,7 @@ def _key_parts(keys):
     return parts
 
 
-@partial(jax.jit)
-def group_ids(keys, mask):
+def _group_ids_impl(keys, mask):
     """Sort rows by keys (+validity), label segments.
 
     keys: list of (data, valid_or_None); mask: visible-row bool mask or None.
@@ -117,8 +116,7 @@ def group_ids(keys, mask):
     return perm, seg, ngroups
 
 
-@partial(jax.jit, static_argnames=("num_groups", "specs"))
-def group_reduce(keys, vals, perm, seg, num_groups: int, specs: tuple):
+def _group_reduce_impl(keys, vals, perm, seg, num_groups: int, specs: tuple):
     """Segment reductions with static group capacity.
 
     keys/vals: lists of (data, valid_or_None) in *unsorted* row order.
@@ -212,8 +210,7 @@ def group_reduce(keys, vals, perm, seg, num_groups: int, specs: tuple):
     return out_keys, out_vals, got[:num_groups]
 
 
-@partial(jax.jit, static_argnames=("specs",))
-def scalar_reduce(vals, mask, specs: tuple):
+def _scalar_reduce_impl(vals, mask, specs: tuple):
     """Ungrouped aggregation over one batch (returns per-agg (0-d, valid)).
     Same specs as group_reduce. sum keeps a (sum, count) pair internally so
     partials merge correctly."""
@@ -256,3 +253,14 @@ def scalar_reduce(vals, mask, specs: tuple):
         else:
             raise ValueError(f"unknown scalar agg {spec}")
     return out
+
+
+# Jitted entry points for operator-at-a-time execution (executor/local.py).
+# The fused mesh executor calls the _impl functions directly instead —
+# nesting jit inside a traced shard_map program defeats XLA fusion and
+# adds per-call dispatch overhead.
+group_ids = partial(jax.jit)(_group_ids_impl)
+group_reduce = partial(jax.jit, static_argnames=("num_groups", "specs"))(
+    _group_reduce_impl
+)
+scalar_reduce = partial(jax.jit, static_argnames=("specs",))(_scalar_reduce_impl)

@@ -91,6 +91,27 @@ class CodeMaskParam:
 
 
 @dataclass(frozen=True)
+class ScalarConstParam:
+    """A lifted numeric/date literal bound at call time instead of baked
+    into the trace — lets one compiled program serve every query that
+    differs only in literal values (plan-cache friendliness; the
+    reference's generic-plan Params, plancache.c)."""
+
+    value: object
+    type: t.SqlType
+
+
+@dataclass(frozen=True)
+class ArrayConstParam:
+    """A lifted IN-list: values padded to a power of two (repeating the
+    first element — harmless for membership tests) so list length doesn't
+    change the compiled shape."""
+
+    values: tuple
+    type: t.SqlType
+
+
+@dataclass(frozen=True)
 class SubqueryScalarParam:
     """Result of uncorrelated subplan ``index`` bound as a 0-d array
     (value) plus validity flag."""
@@ -120,10 +141,17 @@ def _np_cast_const(value, ty: t.SqlType):
 
 
 class ExprCompiler:
-    """Compiles one or more TExprs sharing a single param list."""
+    """Compiles one or more TExprs sharing a single param list.
 
-    def __init__(self) -> None:
+    ``lift_consts=True`` turns numeric/date literals and IN-lists into
+    runtime params so the compiled function (and its XLA executable) is
+    reusable across literal changes — the fused executor's program cache
+    keys on the structural plan shape (plan/skey.py).
+    """
+
+    def __init__(self, lift_consts: bool = False) -> None:
         self.params: list[ParamSpec] = []
+        self.lift_consts = lift_consts
 
     def _param(self, spec: ParamSpec) -> int:
         # Dedup identical specs so repeated predicates share one bind.
@@ -242,6 +270,9 @@ class ExprCompiler:
             # Value-producing TEXT constant: encode into the target (or
             # the session literal) dictionary so the result decodes.
             pi = self._param(TextEncodeParam(want or LITERAL_DICT, e.value))
+            return lambda cols, params: (params[pi], None)
+        if self.lift_consts:
+            pi = self._param(ScalarConstParam(e.value, e.type))
             return lambda cols, params: (params[pi], None)
         val = _np_cast_const(e.value, e.type)
         return lambda cols, params: (jnp.asarray(val), None)
@@ -486,10 +517,23 @@ class ExprCompiler:
 
             return run_tin
 
-        items = np.asarray(
-            [i.value for i in e.items if i.value is not None],
-            dtype=e.operand.type.np_dtype,
-        )
+        item_vals = [i.value for i in e.items if i.value is not None]
+        if self.lift_consts and item_vals:
+            pi = self._param(
+                ArrayConstParam(tuple(item_vals), e.operand.type)
+            )
+
+            def run_in_lifted(cols, params):
+                d, v = cf(cols, params)
+                match = jnp.isin(d, params[pi])
+                out = ~match if e.negated else match
+                if has_null:
+                    v = match if v is None else (v & match)
+                return (out, v)
+
+            return run_in_lifted
+
+        items = np.asarray(item_vals, dtype=e.operand.type.np_dtype)
 
         def run_in(cols, params):
             d, v = cf(cols, params)
@@ -856,6 +900,15 @@ def resolve_param(spec: ParamSpec, dictionaries, subquery_values=None):
                 if code is not None:
                     mask[code] = True
         return jnp.asarray(mask)
+
+    if isinstance(spec, ScalarConstParam):
+        return jnp.asarray(np.asarray(spec.value, dtype=spec.type.np_dtype))
+
+    if isinstance(spec, ArrayConstParam):
+        vals = list(spec.values)
+        n = max(_next_pow2(len(vals)), 1)
+        vals = vals + [vals[0]] * (n - len(vals))
+        return jnp.asarray(np.asarray(vals, dtype=spec.type.np_dtype))
 
     if isinstance(spec, SubqueryScalarParam):
         assert subquery_values is not None, "subquery params not bound"

@@ -1,0 +1,77 @@
+"""Structural plan keys: plan identity with literal values masked.
+
+``LogicalPlan.key()`` embeds literal constant values, which is right for
+result caching but wrong for *program* caching: the fused executor lifts
+literals into runtime params (ops/expr.py lift_consts), so two queries that
+differ only in constants compile to the SAME XLA program. These helpers
+produce the matching cache key — the analog of the reference's generic
+plan + Params in plancache.c (choose_custom_plan).
+
+Structure that changes the traced program stays in the key: operator
+shapes, column positions, types, negation/ilike flags, whether an IN-list
+contains NULL (changes validity logic), and DISTINCT flags.
+"""
+
+from __future__ import annotations
+
+from opentenbase_tpu.plan import logical as L
+from opentenbase_tpu.plan import texpr as E
+
+
+def texpr_skey(e: E.TExpr) -> str:
+    if isinstance(e, E.Col):
+        return f"c{e.index}"
+    if isinstance(e, E.Const):
+        null = "N" if e.value is None else "?"
+        return f"k({null}:{e.type})"
+    if isinstance(e, E.BinE):
+        return f"({texpr_skey(e.left)}{e.op}{texpr_skey(e.right)})"
+    if isinstance(e, E.UnaryE):
+        return f"({e.op}{texpr_skey(e.operand)})"
+    if isinstance(e, E.FuncE):
+        # round() on decimals reads its digits argument statically — keep
+        # the literal in the key for that one case
+        if e.name == "round" and len(e.args) > 1 and isinstance(e.args[1], E.Const):
+            return f"round({texpr_skey(e.args[0])},{e.args[1].value})"
+        return f"{e.name}({','.join(texpr_skey(a) for a in e.args)})"
+    if isinstance(e, E.CaseE):
+        w = ";".join(
+            f"{texpr_skey(c)}:{texpr_skey(v)}" for c, v in e.whens
+        )
+        d = texpr_skey(e.default) if e.default is not None else ""
+        return f"case({w}|{d})"
+    if isinstance(e, E.CastE):
+        return f"cast({texpr_skey(e.operand)}:{e.type})"
+    if isinstance(e, E.IsNullE):
+        return f"isnull({texpr_skey(e.operand)},{e.negated})"
+    if isinstance(e, E.InListE):
+        has_null = any(i.value is None for i in e.items)
+        return f"in({texpr_skey(e.operand)},?,{e.negated},{has_null})"
+    if isinstance(e, E.LikeE):
+        return f"like({texpr_skey(e.operand)},?,{e.ilike},{e.negated})"
+    if isinstance(e, E.SubqueryParam):
+        return f"subq({e.index})"
+    raise NotImplementedError(f"skey for {type(e).__name__}")
+
+
+def _agg_skey(a: E.AggCall) -> str:
+    arg = texpr_skey(a.arg) if a.arg is not None else "*"
+    return f"{a.func}({'D' if a.distinct else ''}{arg})"
+
+
+def plan_skey(plan: L.LogicalPlan) -> str:
+    """Structural key for the fragment shapes the fused executor handles
+    (Scan / Filter / Project / Aggregate). Raises for other nodes —
+    callers fall back to plan.key()."""
+    if isinstance(plan, L.Scan):
+        return f"scan({plan.table}:{','.join(plan.columns)})"
+    if isinstance(plan, L.Filter):
+        return f"filter({plan_skey(plan.child)},{texpr_skey(plan.predicate)})"
+    if isinstance(plan, L.Project):
+        exprs = ",".join(texpr_skey(x) for x in plan.exprs)
+        return f"proj({plan_skey(plan.child)},{exprs})"
+    if isinstance(plan, L.Aggregate):
+        g = ",".join(texpr_skey(x) for x in plan.group_exprs)
+        a = ",".join(_agg_skey(x) for x in plan.aggs)
+        return f"agg({plan_skey(plan.child)},[{g}],[{a}])"
+    raise NotImplementedError(f"plan_skey for {type(plan).__name__}")

@@ -1,0 +1,102 @@
+"""Fused mesh executor: results must match the general fragment executor
+exactly, and the multichip dry-run must validate on a virtual mesh."""
+
+import numpy as np
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Cluster(num_datanodes=2, shard_groups=32).session()
+    s.execute(
+        "create table li (flag text, status text, qty numeric(10,2), "
+        "price numeric(12,2), disc numeric(4,2), ship date) "
+        "distribute by roundrobin"
+    )
+    rng = np.random.default_rng(3)
+    n = 4000
+    flags = rng.choice(["A", "N", "R"], n)
+    statuses = rng.choice(["F", "O"], n)
+    rows = ",".join(
+        f"('{f}','{st}',{q:.2f},{p:.2f},{d:.2f},'{dt}')"
+        for f, st, q, p, d, dt in zip(
+            flags,
+            statuses,
+            rng.uniform(1, 50, n).round(2),
+            rng.uniform(9, 1000, n).round(2),
+            rng.uniform(0, 0.1, n).round(2),
+            np.datetime64("1994-01-01") + rng.integers(0, 1000, n),
+        )
+    )
+    s.execute("insert into li values " + rows)
+    return s
+
+
+QUERIES = [
+    # Q6 shape: filter + scalar agg
+    "select sum(price * disc), count(*) from li "
+    "where ship >= date '1994-06-01' and ship < date '1995-06-01' "
+    "and disc between 0.02 and 0.08 and qty < 30",
+    # Q1 shape: grouped aggregation with several aggs
+    "select flag, status, count(*), sum(qty), avg(price), min(disc), max(disc) "
+    "from li where ship <= date '1996-09-01' group by flag, status "
+    "order by flag, status",
+    # text-filtered grouped agg
+    "select status, count(*) from li where flag = 'A' group by status order by status",
+    # empty result
+    "select sum(qty) from li where qty < 0",
+]
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_fused_matches_general(sess, qi):
+    q = QUERIES[qi]
+    sess.execute("set enable_fused_execution to false")
+    expected = sess.query(q)
+    sess.execute("set enable_fused_execution to true")
+    got = sess.query(q)
+    assert len(got) == len(expected)
+    for g, e in zip(got, expected):
+        for gv, ev in zip(g, e):
+            if isinstance(ev, float):
+                assert gv == pytest.approx(ev), (q, got, expected)
+            else:
+                assert gv == ev, (q, got, expected)
+
+
+def test_fused_actually_engaged(sess):
+    fx = sess.cluster.fused_executor()
+    assert fx is not None
+    sess.execute("set enable_fused_execution to true")
+    sess.query("select count(*) from li")
+    assert len(fx._programs) > 0
+
+
+def test_fused_sees_new_writes(sess):
+    sess.execute("set enable_fused_execution to true")
+    before = sess.query("select count(*) from li")[0][0]
+    sess.execute(
+        "insert into li values ('Z','F',1.00,2.00,0.01,'1994-01-01')"
+    )
+    after = sess.query("select count(*) from li")[0][0]
+    assert after == before + 1
+    sess.execute("delete from li where flag = 'Z'")
+    assert sess.query("select count(*) from li")[0][0] == before
+
+
+def test_dryrun_multichip_virtual():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(4)
+
+
+def test_entry_compiles():
+    import __graft_entry__ as g
+    import jax
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    rev, cnt = [np.asarray(o) for o in out]
+    assert cnt > 0 and rev > 0
