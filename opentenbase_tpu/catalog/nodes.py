@@ -51,6 +51,19 @@ class NodeManager:
         self._dn_order: list[str] = []
         self._next_mesh_index = 0  # never reused: mesh indices are stable
 
+    def has(self, name: str) -> bool:
+        return name in self._nodes
+
+    def restore_datanode(self, name: str, mesh_index: int) -> NodeDef:
+        """Recreate a datanode at its original stable mesh index (crash
+        recovery only — normal DDL goes through create_node)."""
+        node = NodeDef(name, NodeRole.DATANODE)
+        node.mesh_index = mesh_index
+        self._nodes[name] = node
+        self._dn_order.append(name)
+        self._next_mesh_index = max(self._next_mesh_index, mesh_index + 1)
+        return node
+
     # -- DDL surface ----------------------------------------------------
     def create_node(self, node: NodeDef) -> None:
         if node.name in self._nodes:

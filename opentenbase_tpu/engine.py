@@ -129,6 +129,7 @@ class Cluster:
         num_datanodes: int = 2,
         shard_groups: int = 256,
         data_dir: Optional[str] = None,
+        gts_backend: str = "python",
     ):
         self.nodes = NodeManager()
         self.nodes.create_node(NodeDef("cn0", NodeRole.COORDINATOR))
@@ -138,8 +139,26 @@ class Cluster:
         self.shardmap = ShardMap(shard_groups)
         self.shardmap.initialize(self.nodes.datanode_indices())
         self.catalog = Catalog(self.nodes, self.shardmap)
-        gts_store = os.path.join(data_dir, "gts.json") if data_dir else None
-        self.gts = GTSServer(gts_store)
+        if data_dir is not None:
+            os.makedirs(data_dir, exist_ok=True)
+        if gts_backend == "native":
+            # spawn the C++ GTS service (gtm/native/gts_server.cpp) — a
+            # real separate process, as the reference's GTM is
+            from opentenbase_tpu.gtm.client import NativeGTS
+
+            if data_dir is not None:
+                state = data_dir
+            else:
+                import tempfile
+
+                # unique per Cluster: a shared pid-keyed dir would let two
+                # clusters in one process replay each other's GTS journals
+                state = tempfile.mkdtemp(prefix="gts_")
+                self._gts_tmpdir = state
+            self.gts = NativeGTS.spawn(state)
+        else:
+            gts_store = os.path.join(data_dir, "gts.json") if data_dir else None
+            self.gts = GTSServer(gts_store)
         # node mesh index -> table name -> ShardStore
         self.stores: dict[int, dict[str, ShardStore]] = {
             i: {} for i in self.nodes.datanode_indices()
@@ -147,8 +166,37 @@ class Cluster:
         self.paused = False
         self.barriers: list[tuple[str, int]] = []
         self.indexes: dict[str, A.CreateIndex] = {}
+        # observability (SURVEY §5): session registry + per-statement stats.
+        # Sessions register weakly so short-lived connections don't pin
+        # memory or linger forever in pg_stat_cluster_activity.
+        import weakref
+
+        self.sessions: "weakref.WeakSet[Session]" = weakref.WeakSet()
+        self.stat_statements: dict[str, list] = {}  # text -> [calls, ms, rows]
         self._fused = None
         self._fused_failed = False
+        # durability: WAL + checkpoints when a data_dir is given
+        self.persistence = None
+        if data_dir is not None:
+            from opentenbase_tpu.storage.persist import ClusterPersistence
+
+            self.persistence = ClusterPersistence(self, data_dir)
+
+    @classmethod
+    def recover(
+        cls,
+        data_dir: str,
+        num_datanodes: int = 2,
+        shard_groups: int = 256,
+        until_barrier: Optional[str] = None,
+        gts_backend: str = "python",
+    ) -> "Cluster":
+        """Crash recovery: rebuild a cluster from its checkpoint + WAL
+        (startup.c's redo loop; ``until_barrier`` = PITR to a CREATE
+        BARRIER point, barrier.c)."""
+        c = cls(num_datanodes, shard_groups, data_dir, gts_backend)
+        c.persistence.recover(until_barrier=until_barrier)
+        return c
 
     def fused_executor(self):
         """Lazily built FusedExecutor over the default device mesh (the
@@ -172,7 +220,30 @@ class Cluster:
             tabs.pop(name, None)
 
     def session(self) -> "Session":
-        return Session(self)
+        s = Session(self)
+        self.sessions.add(s)
+        return s
+
+    def close(self) -> None:
+        """Release external resources: the native GTS subprocess (if any)
+        and the WAL file handle. Idempotent."""
+        close_gts = getattr(self.gts, "close", None)
+        if close_gts is not None:
+            close_gts()
+        if self.persistence is not None:
+            self.persistence.wal.close()
+        tmpdir = getattr(self, "_gts_tmpdir", None)
+        if tmpdir is not None:
+            import shutil
+
+            shutil.rmtree(tmpdir, ignore_errors=True)
+            self._gts_tmpdir = None
+
+    def __enter__(self) -> "Cluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 # ---------------------------------------------------------------------------
@@ -181,15 +252,54 @@ class Cluster:
 
 
 class Session:
+    _next_id = 1
+
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
         self.txn: Optional[Transaction] = None
         self.gucs: dict[str, object] = {}
+        self.session_id = Session._next_id
+        Session._next_id += 1
+        self.last_query: str = ""
+        self.state: str = "idle"
 
     # -- public ----------------------------------------------------------
     def execute(self, sql: str) -> Result:
-        results = [self._execute_one(s) for s in parse(sql)]
-        return results[-1] if results else Result("EMPTY")
+        import time as _time
+
+        self.last_query = sql.strip()
+        self.state = "active"
+        try:
+            results = []
+            stmts = parse(sql)
+            for i, s in enumerate(stmts):
+                t0 = _time.perf_counter()
+                r = self._execute_one(s)
+                ms = (_time.perf_counter() - t0) * 1000
+                if isinstance(s, (A.Select, A.Insert, A.Update, A.Delete)):
+                    # pg_stat_statements analog (contrib/stormstats);
+                    # statements of a multi-statement string are bucketed
+                    # by their position so they don't share one entry
+                    pos = "" if len(stmts) == 1 else f"[{i}] "
+                    key = type(s).__name__ + ":" + pos + self.last_query[:200]
+                    ent = self.cluster.stat_statements.setdefault(
+                        key, [0, 0.0, 0]
+                    )
+                    ent[0] += 1
+                    ent[1] += ms
+                    ent[2] += r.rowcount
+                    # bounded like pg_stat_statements.max: evict the
+                    # least-called entries when the table overflows
+                    ss = self.cluster.stat_statements
+                    if len(ss) > 1000:
+                        for k, _ in sorted(
+                            ss.items(), key=lambda kv: kv[1][0]
+                        )[: len(ss) - 900]:
+                            del ss[k]
+                results.append(r)
+            return results[-1] if results else Result("EMPTY")
+        finally:
+            self.state = "idle" if self.txn is None else "idle in transaction"
 
     def query(self, sql: str) -> list[tuple]:
         return self.execute(sql).rows
@@ -217,16 +327,33 @@ class Session:
         self._stamp_commit(txn, commit_ts)
         gts.forget(txn.gxid)
 
-    def _stamp_commit(self, txn: Transaction, commit_ts: int) -> None:
+    def _stamp_commit(
+        self, txn: Transaction, commit_ts: int, wal_log: bool = True
+    ) -> None:
+        # wal_log=False for explicitly-prepared txns: their writes are
+        # already durable as a 'T' record, so the decision is logged as a
+        # compact 'C' record instead of re-logging the rows
+        p = self.cluster.persistence if wal_log else None
         for node, tabs in txn.writes.items():
             for table, tw in tabs.items():
                 store = self.cluster.stores[node][table]
                 for s, e in tw.ins_ranges:
                     store.stamp_xmin(s, e, commit_ts)
                 if tw.del_idx:
-                    store.stamp_xmax(
-                        np.asarray(tw.del_idx, dtype=np.int64), commit_ts
-                    )
+                    idx = np.asarray(tw.del_idx, dtype=np.int64)
+                    store.stamp_xmax(idx, commit_ts)
+        if p is not None:
+            # the whole commit goes out as ONE WAL frame so a crash can
+            # never replay a half-applied multi-table transaction
+            p.log_commit_group(
+                [
+                    (node, table, tw.ins_ranges, tw.del_idx)
+                    for node, tabs in txn.writes.items()
+                    for table, tw in tabs.items()
+                ],
+                self.cluster.stores,
+                commit_ts,
+            )
         txn.unpin_all()
 
     def _abort_txn(self, txn: Transaction) -> None:
@@ -251,6 +378,7 @@ class Session:
 
     # -- SELECT ----------------------------------------------------------
     def _x_select(self, stmt: A.Select) -> Result:
+        self._refresh_system_views(stmt)
         batch = self._run_select(stmt)
         return Result(
             "SELECT",
@@ -258,6 +386,55 @@ class Session:
             batch.column_names(),
             batch.nrows,
         )
+
+    # -- system views (pg_stat_* / pgxc_* observability surface) ---------
+    def _referenced_tables(self, sel: A.Select, acc: set) -> None:
+        def from_ref(r):
+            if isinstance(r, A.RelRef):
+                acc.add(r.name)
+            elif isinstance(r, A.JoinRef):
+                from_ref(r.left)
+                from_ref(r.right)
+            elif isinstance(r, A.SubqueryRef):
+                self._referenced_tables(r.query, acc)
+
+        if sel.from_clause is not None:
+            from_ref(sel.from_clause)
+        for _op, sub in sel.set_ops:
+            self._referenced_tables(sub, acc)
+
+    def _refresh_system_views(self, sel: A.Select) -> None:
+        """Materialize referenced system views as replicated tables so
+        arbitrary SQL (joins, filters, aggs) works over them — the
+        reference exposes the same data as catalog/stat views
+        (contrib/pg_stat_cluster_activity, opentenbase_pooler_stat)."""
+        refs: set = set()
+        try:
+            self._referenced_tables(sel, refs)
+        except Exception:
+            return
+        for name in refs & set(_SYSTEM_VIEWS):
+            schema, provider = _SYSTEM_VIEWS[name]
+            cat = self.cluster.catalog
+            if not cat.has(name):
+                meta = cat.create_table(
+                    name,
+                    dict(schema),
+                    DistributionSpec(DistStrategy.REPLICATED),
+                )
+                self.cluster.create_table_stores(meta)
+            meta = cat.get(name)
+            rows = provider(self.cluster)
+            data = {
+                c: [r[i] for r in rows] for i, c in enumerate(meta.schema)
+            }
+            batch = ColumnBatch.from_pydict(
+                data, meta.schema, meta.dictionaries
+            )
+            for n in meta.node_indices:
+                store = ShardStore(meta.schema, meta.dictionaries)
+                store.append_batch(batch, 1)
+                self.cluster.stores[n][name] = store
 
     def _run_select(self, stmt: A.Select) -> ColumnBatch:
         splan = prune_columns(analyze_statement(stmt, self.cluster.catalog))
@@ -582,6 +759,8 @@ class Session:
         # session detaches; txn parks as in-doubt until COMMIT/ROLLBACK
         # PREPARED (twophase.c's on-disk state, held in the GTS registry)
         self.cluster.__dict__.setdefault("_prepared", {})[stmt.gid] = txn
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_prepare(txn, self.cluster.stores)
         self.txn = None
         return Result("PREPARE TRANSACTION")
 
@@ -590,7 +769,9 @@ class Session:
         if txn is None:
             raise SQLError(f'prepared transaction "{stmt.gid}" does not exist')
         commit_ts = self.cluster.gts.commit(txn.gxid)
-        self._stamp_commit(txn, commit_ts)
+        self._stamp_commit(txn, commit_ts, wal_log=False)
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_commit_prepared(stmt.gid, commit_ts)
         self.cluster.gts.forget(txn.gxid)
         return Result("COMMIT PREPARED")
 
@@ -599,11 +780,20 @@ class Session:
         if txn is None:
             raise SQLError(f'prepared transaction "{stmt.gid}" does not exist')
         self._abort_txn(txn)
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_rollback_prepared(stmt.gid)
         return Result("ROLLBACK PREPARED")
 
     # -- DDL: tables -----------------------------------------------------
     def _x_createtable(self, stmt: A.CreateTable) -> Result:
         cat = self.cluster.catalog
+        if stmt.name in _SYSTEM_VIEWS:
+            # system view names are reserved (as pg_* catalogs are in the
+            # reference): a user table here would be silently clobbered by
+            # the next view refresh
+            raise SQLError(
+                f'relation name "{stmt.name}" is reserved for a system view'
+            )
         if cat.has(stmt.name):
             if stmt.if_not_exists:
                 return Result("CREATE TABLE")
@@ -614,6 +804,19 @@ class Session:
         dist = self._dist_spec(stmt, schema)
         meta = cat.create_table(stmt.name, schema, dist)
         self.cluster.create_table_stores(meta)
+        p = self.cluster.persistence
+        if p is not None:
+            from opentenbase_tpu.storage.persist import _type_to_str
+
+            p.log_ddl(
+                {
+                    "op": "create_table",
+                    "name": stmt.name,
+                    "schema": {k: _type_to_str(v) for k, v in schema.items()},
+                    "strategy": dist.strategy.value,
+                    "key_columns": list(dist.key_columns),
+                }
+            )
         return Result("CREATE TABLE")
 
     def _dist_spec(self, stmt: A.CreateTable, schema) -> DistributionSpec:
@@ -652,6 +855,10 @@ class Session:
                 raise SQLError(f'relation "{name}" does not exist')
             self.cluster.catalog.drop_table(name)
             self.cluster.drop_table_stores(name)
+            if self.cluster.persistence is not None:
+                self.cluster.persistence.log_ddl(
+                    {"op": "drop_table", "name": name}
+                )
         return Result("DROP TABLE")
 
     def _x_truncatetable(self, stmt: A.TruncateTable) -> Result:
@@ -660,6 +867,10 @@ class Session:
             for n in meta.node_indices:
                 self.cluster.stores[n][name] = ShardStore(
                     meta.schema, meta.dictionaries
+                )
+            if self.cluster.persistence is not None:
+                self.cluster.persistence.log_ddl(
+                    {"op": "truncate", "name": name}
                 )
         return Result("TRUNCATE TABLE")
 
@@ -679,6 +890,11 @@ class Session:
         self.cluster.nodes.create_node(node)
         if role == NodeRole.DATANODE:
             self.cluster.stores[node.mesh_index] = {}
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "create_node", "name": node.name,
+                 "role": role.value, "mesh_index": node.mesh_index}
+            )
         return Result("CREATE NODE")
 
     def _x_dropnode(self, stmt: A.DropNode) -> Result:
@@ -698,6 +914,10 @@ class Session:
             self.cluster.stores.pop(node.mesh_index, None)
         else:
             self.cluster.nodes.drop_node(stmt.name)
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "drop_node", "name": stmt.name}
+            )
         return Result("DROP NODE")
 
     def _x_alternode(self, stmt: A.AlterNode) -> Result:
@@ -770,8 +990,19 @@ class Session:
                 meta.name, ShardStore(meta.schema, meta.dictionaries)
             )
             commit_ts = self.cluster.gts.get_gts()
-            dst.append_batch(batch, commit_ts)
+            ds, de = dst.append_batch(batch, commit_ts)
             src.stamp_xmax(idx, commit_ts)
+            p = self.cluster.persistence
+            if p is not None:
+                # log the move as one delete+insert frame so PITR redo
+                # from before the post-move checkpoint reproduces row
+                # placement atomically
+                p.log_commit_group(
+                    [(from_node, meta.name, [], idx),
+                     (to_node, meta.name, [(ds, de)], [])],
+                    self.cluster.stores,
+                    commit_ts,
+                )
             src.vacuum(self.cluster.gts.get_gts())
             if to_node not in meta.node_indices:
                 meta.node_indices.append(to_node)
@@ -781,6 +1012,12 @@ class Session:
         # src/backend/pgxc/shard/shardbarrier.c)
         for sid in moved_set:
             sm.move_shard(sid, to_node)
+        # rebalance rewrites stores wholesale; checkpoint the result
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "shardmap", "map": sm.map.tolist()}
+            )
+            self.cluster.persistence.checkpoint()
         return Result("MOVE DATA", rowcount=nmoved)
 
     # -- sequences -------------------------------------------------------
@@ -801,12 +1038,38 @@ class Session:
     # -- utility ---------------------------------------------------------
     def _x_explainstmt(self, stmt: A.ExplainStmt) -> Result:
         inner = stmt.query
+        if isinstance(inner, A.Select):
+            self._refresh_system_views(inner)
         splan = prune_columns(
             analyze_statement(inner, self.cluster.catalog)
         )
         dplan = distribute_statement(splan, self.cluster.catalog)
-        text = dplan.explain()
-        rows = [(line,) for line in text.splitlines()]
+        lines = dplan.explain().splitlines()
+        if stmt.analyze:
+            # run for real via the general executor and gather per-node
+            # instrumentation (distributed EXPLAIN ANALYZE,
+            # src/backend/commands/explain_dist.c)
+            import time as _time
+
+            ex = DistExecutor(
+                self.cluster.catalog,
+                self.cluster.stores,
+                self._snapshot(),
+                own_writes=self.txn.own_writes_view() if self.txn else None,
+            )
+            t0 = _time.perf_counter()
+            out = ex.run(dplan)
+            total_ms = (_time.perf_counter() - t0) * 1000
+            lines.append("")
+            for i in getattr(ex, "instrumentation", []):
+                lines.append(
+                    f"Fragment {i['fragment']} on dn{i['node']}: "
+                    f"rows={i['rows']} time={i['ms']:.3f} ms"
+                )
+            lines.append(
+                f"Total: rows={out.nrows} time={total_ms:.3f} ms"
+            )
+        rows = [(line,) for line in lines]
         return Result("EXPLAIN", rows, ["QUERY PLAN"], len(rows))
 
     def _x_setstmt(self, stmt: A.SetStmt) -> Result:
@@ -837,6 +1100,10 @@ class Session:
                 store = self.cluster.stores[n].get(name)
                 if store is not None:
                     removed += store.vacuum(oldest)
+        # vacuum compaction renumbers rows, invalidating WAL row indices:
+        # take a checkpoint so redo starts from the compacted state
+        if removed and self.cluster.persistence is not None:
+            self.cluster.persistence.checkpoint()
         return Result("VACUUM", rowcount=removed)
 
     def _x_analyzestmt(self, stmt: A.AnalyzeStmt) -> Result:
@@ -844,7 +1111,10 @@ class Session:
 
     def _x_createbarrier(self, stmt: A.CreateBarrier) -> Result:
         ts = self.cluster.gts.get_gts()
-        self.cluster.barriers.append((stmt.barrier_id or f"barrier_{ts}", ts))
+        name = stmt.barrier_id or f"barrier_{ts}"
+        self.cluster.barriers.append((name, ts))
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_barrier(name, ts)
         return Result("CREATE BARRIER")
 
     def _x_pausecluster(self, stmt: A.PauseCluster) -> Result:
@@ -941,6 +1211,116 @@ class Session:
         else:
             self.txn = txn
         return Result("COPY", rowcount=n)
+
+
+# ---------------------------------------------------------------------------
+# System views: name -> (schema, provider(cluster) -> rows)
+# The observability surface of SURVEY §5: node catalog, in-doubt 2PC list
+# (pg_clean's scan), cluster-wide session activity, per-statement stats,
+# shard map, per-table per-node storage stats.
+# ---------------------------------------------------------------------------
+
+
+def _sv_pgxc_node(c: Cluster):
+    return [
+        (
+            n.name,
+            n.role.value,
+            n.host,
+            n.port,
+            n.is_primary,
+            n.is_preferred,
+            getattr(n, "mesh_index", -1),
+        )
+        for n in c.nodes.all_nodes()
+    ]
+
+
+def _sv_prepared_xacts(c: Cluster):
+    return [
+        (p.gxid, p.gid or "", ",".join(map(str, p.partnodes)))
+        for p in c.gts.prepared_txns()
+    ]
+
+
+def _sv_cluster_activity(c: Cluster):
+    return [
+        (s.session_id, s.state, s.last_query[:100])
+        for s in sorted(c.sessions, key=lambda s: s.session_id)
+    ]
+
+
+def _sv_stat_statements(c: Cluster):
+    return [
+        (q, ent[0], round(ent[1], 3), ent[2])
+        for q, ent in c.stat_statements.items()
+    ]
+
+
+def _sv_shard_map(c: Cluster):
+    return [(i, int(n)) for i, n in enumerate(c.shardmap.map)]
+
+
+def _sv_stat_tables(c: Cluster):
+    rows = []
+    snap = c.gts.snapshot_ts()
+    for name in c.catalog.table_names():
+        if name in _SYSTEM_VIEWS:
+            continue
+        meta = c.catalog.get(name)
+        for n in meta.node_indices:
+            store = c.stores.get(n, {}).get(name)
+            if store is None:
+                continue
+            live = int(
+                (
+                    (store.xmin_ts[: store.nrows] <= snap)
+                    & (snap < store.xmax_ts[: store.nrows])
+                ).sum()
+            )
+            rows.append((name, n, live, store.nrows))
+    return rows
+
+
+_SYSTEM_VIEWS: dict[str, tuple] = {
+    "pgxc_node": (
+        {
+            "node_name": t.TEXT,
+            "node_type": t.TEXT,
+            "node_host": t.TEXT,
+            "node_port": t.INT4,
+            "nodeis_primary": t.BOOL,
+            "nodeis_preferred": t.BOOL,
+            "mesh_index": t.INT4,
+        },
+        _sv_pgxc_node,
+    ),
+    "pg_prepared_xacts": (
+        {"gxid": t.INT8, "gid": t.TEXT, "partnodes": t.TEXT},
+        _sv_prepared_xacts,
+    ),
+    "pg_stat_cluster_activity": (
+        {"session_id": t.INT4, "state": t.TEXT, "query": t.TEXT},
+        _sv_cluster_activity,
+    ),
+    "pg_stat_statements": (
+        {"query": t.TEXT, "calls": t.INT8, "total_ms": t.FLOAT8, "rows": t.INT8},
+        _sv_stat_statements,
+    ),
+    "pgxc_shard_map": (
+        {"shard_id": t.INT4, "node_index": t.INT4},
+        _sv_shard_map,
+    ),
+    "pg_stat_user_tables": (
+        {
+            "relname": t.TEXT,
+            "node_index": t.INT4,
+            "n_live_tup": t.INT8,
+            "n_total_tup": t.INT8,
+        },
+        _sv_stat_tables,
+    ),
+}
 
 
 def connect(cluster: Optional[Cluster] = None, **kw) -> Session:

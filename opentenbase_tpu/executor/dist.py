@@ -109,6 +109,9 @@ class DistExecutor:
         return self.node_stores.get(node, {})
 
     def run(self, dplan: DistributedPlan) -> ColumnBatch:
+        # one instrumentation list per top-level run so subplan (InitPlan)
+        # fragment timings survive into the EXPLAIN ANALYZE report
+        self.instrumentation: list[dict] = []
         subquery_values = []
         for sub in dplan.subplans:
             b = self._run_one(sub, subquery_values=[])
@@ -130,11 +133,16 @@ class DistExecutor:
         return self._run_one(dplan, subquery_values)
 
     def _run_one(self, dplan: DistributedPlan, subquery_values) -> ColumnBatch:
+        import time as _time
+
         # fragment -> consumer node -> input batch
         motioned: dict[int, dict[int, ColumnBatch]] = {}
+        if not hasattr(self, "instrumentation"):
+            self.instrumentation = []
         for frag in dplan.fragments:
             outs: dict[int, ColumnBatch] = {}
             for node in frag.nodes:
+                t0 = _time.perf_counter()
                 ex = LocalExecutor(
                     self.catalog,
                     self._stores(node),
@@ -148,6 +156,17 @@ class DistExecutor:
                     own_writes=self.own_writes.get(node),
                 )
                 outs[node] = ex.run_plan(frag.root)
+                # per-(fragment, node) instrumentation gathered back to
+                # the coordinator — the distributed EXPLAIN ANALYZE flow
+                # (src/backend/commands/explain_dist.c, recv_instr_htbl)
+                self.instrumentation.append(
+                    {
+                        "fragment": frag.index,
+                        "node": node,
+                        "rows": outs[node].nrows,
+                        "ms": (_time.perf_counter() - t0) * 1000,
+                    }
+                )
             motioned[frag.index] = self._apply_motion(frag, outs)
         ex = LocalExecutor(
             self.catalog,

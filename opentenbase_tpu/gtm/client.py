@@ -1,0 +1,269 @@
+"""Client for the native GTS server (gtm/native/gts_server.cpp).
+
+The backend↔GTM client library analog (src/backend/access/transam/gtm.c +
+src/gtm/client/gtm_client.c — the reference ships its own mini-libpq for
+this). Speaks the length-prefixed binary protocol documented in the server
+source, and duck-types gtm/gts.py's GTSServer so the engine can use either
+backend (`Cluster(gts_backend="native")`).
+
+``NativeGTS.spawn()`` builds the server binary on demand (g++, cached by
+source mtime) and launches it as a subprocess — the pg_regress-style
+"real processes on localhost" harness from SURVEY.md §4.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import subprocess
+import threading
+import time
+import weakref
+from typing import Optional
+
+from opentenbase_tpu.gtm.gts import GlobalTimestamp, TxnInfo, TxnState
+
+_SRC = os.path.join(os.path.dirname(__file__), "native", "gts_server.cpp")
+
+OP_GET_GTS = 0x01
+OP_BEGIN = 0x02
+OP_COMMIT = 0x03
+OP_ABORT = 0x04
+OP_PREPARE = 0x05
+OP_LIST_PREPARED = 0x06
+OP_FORGET = 0x07
+OP_SEQ_CREATE = 0x08
+OP_SEQ_NEXT = 0x09
+OP_SEQ_DROP = 0x0A
+OP_SEQ_SET = 0x0B
+OP_SNAPSHOT = 0x0C
+OP_PING = 0x0D
+
+
+def build_server(build_dir: str) -> str:
+    """Compile the server if the cached binary is stale; returns its path."""
+    os.makedirs(build_dir, exist_ok=True)
+    binary = os.path.join(build_dir, "gts_server")
+    if (
+        os.path.exists(binary)
+        and os.path.getmtime(binary) >= os.path.getmtime(_SRC)
+    ):
+        return binary
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-o", binary, _SRC],
+        check=True,
+        capture_output=True,
+    )
+    return binary
+
+
+class GTSProtocolError(RuntimeError):
+    pass
+
+
+def _reap(proc: subprocess.Popen) -> None:
+    if proc.poll() is not None:
+        return
+    proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
+class NativeGTS:
+    """Socket client to a running native GTS server. Thread-safe (one
+    socket, request/response under a lock — the per-backend connection
+    model of the reference; the pooler/proxy batching layer can multiplex
+    later exactly as src/gtm/proxy does)."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._sock = socket.create_connection((host, port), timeout=10)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        # local mirror of txn state for TxnInfo compatibility
+        self._txns: dict[int, TxnInfo] = {}
+
+    # -- lifecycle -------------------------------------------------------
+    @staticmethod
+    def spawn(state_dir: str, port: int = 0) -> "NativeGTS":
+        binary = build_server(os.path.join(state_dir, "build"))
+        if port == 0:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+        proc = subprocess.Popen(
+            [binary, str(port), state_dir],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+        )
+        # wait for the READY line
+        line = proc.stdout.readline().decode()
+        if "GTS READY" not in line:
+            proc.kill()
+            raise GTSProtocolError(f"server failed to start: {line!r}")
+        client = NativeGTS("127.0.0.1", port)
+        client._proc = proc
+        # reap the server even if close() is never called (GC / interpreter
+        # exit) — otherwise every Cluster(gts_backend="native") leaks a
+        # gts_server process holding its port and state dir
+        client._finalizer = weakref.finalize(client, _reap, proc)
+        return client
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        finally:
+            if self._proc is not None:
+                _reap(self._proc)
+            fin = getattr(self, "_finalizer", None)
+            if fin is not None:
+                fin.detach()
+
+    def kill_server(self) -> None:
+        """Hard-kill (crash test); reconnect() after a respawn."""
+        if self._proc is not None:
+            self._proc.kill()
+            self._proc.wait()
+
+    # -- wire ------------------------------------------------------------
+    def _rpc(self, op: int, payload: bytes = b"") -> bytes:
+        msg = struct.pack("<IB", 1 + len(payload), op) + payload
+        with self._lock:
+            self._sock.sendall(msg)
+            hdr = self._recv_exact(4)
+            (length,) = struct.unpack("<I", hdr)
+            body = self._recv_exact(length)
+        status = body[0]
+        if status != 0:
+            raise GTSProtocolError(f"op {op:#x} failed")
+        return body[1:]
+
+    def _recv_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise GTSProtocolError("connection closed")
+            out += chunk
+        return out
+
+    # -- GTSServer-compatible API ----------------------------------------
+    def get_gts(self) -> GlobalTimestamp:
+        return struct.unpack("<q", self._rpc(OP_GET_GTS))[0]
+
+    def snapshot_ts(self) -> GlobalTimestamp:
+        return struct.unpack("<q", self._rpc(OP_SNAPSHOT))[0]
+
+    def ping(self) -> bool:
+        try:
+            return self._rpc(OP_PING) == b"\x01"
+        except (OSError, GTSProtocolError):
+            return False
+
+    def begin(self) -> TxnInfo:
+        gxid, start_ts = struct.unpack("<qq", self._rpc(OP_BEGIN))
+        info = TxnInfo(gxid, TxnState.ACTIVE, start_ts)
+        self._txns[gxid] = info
+        return info
+
+    def commit(self, gxid: int) -> GlobalTimestamp:
+        ts = struct.unpack(
+            "<q", self._rpc(OP_COMMIT, struct.pack("<q", gxid))
+        )[0]
+        info = self._txns.get(gxid)
+        if info is not None:
+            info.state = TxnState.COMMITTED
+            info.commit_ts = ts
+        return ts
+
+    def abort(self, gxid: int) -> None:
+        self._rpc(OP_ABORT, struct.pack("<q", gxid))
+        info = self._txns.get(gxid)
+        if info is not None:
+            info.state = TxnState.ABORTED
+
+    def prepare(self, gxid: int, gid: str, partnodes: tuple[int, ...]) -> None:
+        g = gid.encode()
+        payload = struct.pack("<qH", gxid, len(g)) + g
+        payload += struct.pack("<H", len(partnodes))
+        for n in partnodes:
+            payload += struct.pack("<i", n)
+        self._rpc(OP_PREPARE, payload)
+        info = self._txns.get(gxid)
+        if info is not None:
+            info.state = TxnState.PREPARED
+            info.gid = gid
+            info.partnodes = tuple(partnodes)
+
+    def prepared_txns(self) -> list[TxnInfo]:
+        body = self._rpc(OP_LIST_PREPARED)
+        (n,) = struct.unpack_from("<H", body, 0)
+        off = 2
+        out = []
+        for _ in range(n):
+            (gxid,) = struct.unpack_from("<q", body, off)
+            off += 8
+            (gl,) = struct.unpack_from("<H", body, off)
+            off += 2
+            gid = body[off : off + gl].decode()
+            off += gl
+            (m,) = struct.unpack_from("<H", body, off)
+            off += 2
+            nodes = struct.unpack_from(f"<{m}i", body, off) if m else ()
+            off += 4 * m
+            out.append(
+                TxnInfo(gxid, TxnState.PREPARED, 0, None, gid, tuple(nodes))
+            )
+        return out
+
+    def forget(self, gxid: int) -> None:
+        self._rpc(OP_FORGET, struct.pack("<q", gxid))
+        self._txns.pop(gxid, None)
+
+    def txn(self, gxid: int) -> Optional[TxnInfo]:
+        return self._txns.get(gxid)
+
+    # -- sequences -------------------------------------------------------
+    def create_sequence(self, name: str, start: int = 1, increment: int = 1,
+                        min_value: int = 1, max_value: int = 2**62,
+                        cycle: bool = False) -> None:
+        nm = name.encode()
+        try:
+            self._rpc(
+                OP_SEQ_CREATE,
+                struct.pack("<H", len(nm)) + nm + struct.pack("<qq", start, increment),
+            )
+        except GTSProtocolError:
+            raise ValueError(f"sequence {name!r} already exists")
+
+    def drop_sequence(self, name: str) -> None:
+        nm = name.encode()
+        self._rpc(OP_SEQ_DROP, struct.pack("<H", len(nm)) + nm)
+
+    def nextval(self, name: str, cache: int = 1) -> tuple[int, int]:
+        nm = name.encode()
+        try:
+            body = self._rpc(
+                OP_SEQ_NEXT,
+                struct.pack("<H", len(nm)) + nm + struct.pack("<q", cache),
+            )
+        except GTSProtocolError:
+            raise KeyError(f"sequence {name!r} does not exist")
+        return struct.unpack("<qq", body)
+
+    def setval(self, name: str, value: int) -> None:
+        nm = name.encode()
+        try:
+            self._rpc(
+                OP_SEQ_SET,
+                struct.pack("<H", len(nm)) + nm + struct.pack("<q", value),
+            )
+        except GTSProtocolError:
+            raise KeyError(f"sequence {name!r} does not exist")

@@ -134,6 +134,39 @@ class GTSServer:
         self._seqs: dict[str, _Sequence] = {}
         self._next_gxid = 1
         self._on_replicate = on_replicate
+        # sequence durability (gtm_store.c): state file beside the clock
+        # store, written log-ahead (SEQ_LOG_VALS-style: the persisted
+        # next_value runs ahead of the issued one, so a crash skips at
+        # most one reserve window but never reissues a value)
+        self._seq_path = store_path + ".seq" if store_path else None
+        self._seq_durable: dict[str, int] = {}
+        if self._seq_path and os.path.exists(self._seq_path):
+            with open(self._seq_path) as f:
+                for name, st in json.load(f).items():
+                    self._seqs[name] = _Sequence(
+                        name, st["increment"], st["next_value"],
+                        st["min_value"], st["max_value"], st["cycle"],
+                    )
+                    self._seq_durable[name] = st["next_value"]
+
+    def _persist_seqs(self) -> None:
+        if self._seq_path is None:
+            return
+        state = {}
+        for name, s in self._seqs.items():
+            state[name] = {
+                "increment": s.increment,
+                "next_value": self._seq_durable.get(name, s.next_value),
+                "min_value": s.min_value,
+                "max_value": s.max_value,
+                "cycle": s.cycle,
+            }
+        tmp = self._seq_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._seq_path)
 
     # -- timestamps -----------------------------------------------------
     def get_gts(self) -> GlobalTimestamp:
@@ -156,7 +189,13 @@ class GTSServer:
 
     def prepare(self, gxid: int, gid: str, partnodes: tuple[int, ...]) -> None:
         with self._lock:
-            info = self._txns[gxid]
+            info = self._txns.get(gxid)
+            if info is None:
+                # re-registration of an in-doubt txn recovered from the
+                # cluster WAL (the registry itself died with the process)
+                info = TxnInfo(gxid, TxnState.ACTIVE, 0)
+                self._txns[gxid] = info
+                self._next_gxid = max(self._next_gxid, gxid + 1)
             info.state = TxnState.PREPARED
             info.gid = gid
             info.partnodes = partnodes
@@ -165,7 +204,10 @@ class GTSServer:
 
     def commit(self, gxid: int) -> GlobalTimestamp:
         with self._lock:
-            info = self._txns[gxid]
+            info = self._txns.get(gxid)
+            if info is None:
+                info = TxnInfo(gxid, TxnState.ACTIVE, 0)
+                self._txns[gxid] = info
             info.commit_ts = self.clock.next()
             info.state = TxnState.COMMITTED
             if info.gid:
@@ -215,11 +257,15 @@ class GTSServer:
             self._seqs[name] = _Sequence(
                 name, increment, start, min_value, max_value, cycle
             )
+            self._seq_durable[name] = start
+            self._persist_seqs()
             self._rep("seq_create", {"name": name, "start": start})
 
     def drop_sequence(self, name: str) -> None:
         with self._lock:
             self._seqs.pop(name, None)
+            self._seq_durable.pop(name, None)
+            self._persist_seqs()
             self._rep("seq_drop", {"name": name})
 
     def nextval(self, name: str, cache: int = 1) -> tuple[int, int]:
@@ -244,6 +290,17 @@ class GTSServer:
             s.next_value = last + s.increment
             if s.cycle and s.next_value > s.max_value:
                 s.next_value = s.min_value
+            durable = self._seq_durable.get(name, first)
+            # durability runs ahead in the direction of travel, so both
+            # ascending and descending sequences never reissue after crash
+            passed = (
+                s.next_value > durable
+                if s.increment >= 0
+                else s.next_value < durable
+            )
+            if passed:
+                self._seq_durable[name] = s.next_value + 32 * s.increment
+                self._persist_seqs()
             self._rep("seq_next", {"name": name, "next": s.next_value})
             return first, last
 
@@ -253,6 +310,8 @@ class GTSServer:
             if s is None:
                 raise KeyError(f"sequence {name!r} does not exist")
             s.next_value = value
+            self._seq_durable[name] = value
+            self._persist_seqs()
             self._rep("seq_set", {"name": name, "value": value})
 
     # -- standby feed ---------------------------------------------------

@@ -1,0 +1,538 @@
+// GTS server: native timestamp oracle for the cluster.
+//
+// The reference's GTM is a multithreaded C server speaking a custom
+// protocol (src/gtm/main/main.c GTM_ThreadMain/ProcessCommand over ~100
+// message types, mmap'd store in gtm_store.c, own WAL in gtm_xlog.c).
+// This is the TPU-build equivalent reduced to the essential contract:
+// monotonic hybrid timestamps with a durable reserve-ahead watermark,
+// GXID issuance, a prepared-transaction (in-doubt) journal that survives
+// restart, and cluster sequences with range reservation.
+//
+// Protocol (little-endian, length-prefixed):
+//   request:  u32 len | u8 op | payload
+//   response: u32 len | u8 status(0=ok,1=err) | payload
+// ops:
+//   0x01 GET_GTS            -> i64 ts
+//   0x02 BEGIN              -> i64 gxid, i64 start_ts
+//   0x03 COMMIT   i64 gxid  -> i64 commit_ts
+//   0x04 ABORT    i64 gxid  -> -
+//   0x05 PREPARE  i64 gxid, u16 gid_len, gid, u16 n, i32 nodes[n] -> -
+//   0x06 LIST_PREPARED      -> u16 n { i64 gxid, u16 gid_len, gid,
+//                                      u16 m, i32 nodes[m] }
+//   0x07 FORGET   i64 gxid  -> -
+//   0x08 SEQ_CREATE u16 name_len, name, i64 start, i64 inc -> -
+//   0x09 SEQ_NEXT  u16 name_len, name, i64 cache -> i64 first, i64 last
+//   0x0A SEQ_DROP  u16 name_len, name -> -
+//   0x0B SEQ_SET   u16 name_len, name, i64 value -> -
+//   0x0C SNAPSHOT           -> i64 ts   (alias of GET_GTS, kept distinct
+//                              for wire-level tracing)
+//   0x0D PING               -> u8 1
+//
+// Build: g++ -O2 -std=c++17 -o gts_server gts_server.cpp
+// Run:   gts_server <port> <state_dir>
+
+#include <algorithm>
+#include <cerrno>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int64_t kLogicalBits = 20;
+constexpr int64_t kReserve = 1LL << 30;  // watermark slack
+
+int64_t wall_ms() {
+  using namespace std::chrono;
+  return duration_cast<milliseconds>(system_clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// Durable monotonic clock (GTM_StoreSyncHeader reserve-ahead analog)
+// ---------------------------------------------------------------------------
+class Clock {
+ public:
+  explicit Clock(const std::string& dir) : path_(dir + "/gts_watermark") {
+    FILE* f = fopen(path_.c_str(), "rb");
+    int64_t wm = 0;
+    if (f) {
+      if (fread(&wm, sizeof wm, 1, f) == 1) last_ = std::max(last_, wm);
+      fclose(f);
+    }
+    advance_watermark();
+  }
+
+  int64_t next() {
+    std::lock_guard<std::mutex> g(mu_);
+    int64_t wall = wall_ms() << kLogicalBits;
+    int64_t ts = wall > last_ ? wall : last_ + 1;
+    last_ = ts;
+    if (ts >= watermark_ - (kReserve >> 1)) advance_watermark();
+    return ts;
+  }
+
+  int64_t current() {
+    std::lock_guard<std::mutex> g(mu_);
+    return last_;
+  }
+
+ private:
+  void advance_watermark() {
+    watermark_ = last_ + kReserve;
+    std::string tmp = path_ + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (f) {
+      fwrite(&watermark_, sizeof watermark_, 1, f);
+      fflush(f);
+      fsync(fileno(f));
+      fclose(f);
+      rename(tmp.c_str(), path_.c_str());
+    }
+  }
+
+  std::mutex mu_;
+  std::string path_;
+  int64_t last_ = 1LL << kLogicalBits;
+  int64_t watermark_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Prepared-transaction journal (in-doubt survival: twophase.c's on-disk
+// state + gtm_txn.c prepared registry)
+// ---------------------------------------------------------------------------
+struct Prepared {
+  int64_t gxid;
+  std::string gid;
+  std::vector<int32_t> nodes;
+};
+
+class PreparedLog {
+ public:
+  explicit PreparedLog(const std::string& dir)
+      : path_(dir + "/gts_prepared.log") {
+    replay();
+    log_ = fopen(path_.c_str(), "ab");
+  }
+
+  void prepare(const Prepared& p) {
+    std::lock_guard<std::mutex> g(mu_);
+    live_[p.gid] = p;
+    if (p.gxid > max_gxid_) max_gxid_ = p.gxid;
+    append('P', p);
+  }
+
+  // resolve ('C'ommit / 'A'bort) removes from the in-doubt set
+  void resolve(int64_t gxid) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto it = live_.begin(); it != live_.end(); ++it) {
+      if (it->second.gxid == gxid) {
+        Prepared p = it->second;
+        live_.erase(it);
+        append('R', p);
+        break;
+      }
+    }
+  }
+
+  std::vector<Prepared> list() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<Prepared> out;
+    for (auto& kv : live_) out.push_back(kv.second);
+    return out;
+  }
+
+  // highest gxid ever journaled; the server resumes issuance above it so
+  // a restart can never hand out a gxid colliding with a surviving
+  // in-doubt entry (resolve() matches by gxid)
+  int64_t max_gxid() {
+    std::lock_guard<std::mutex> g(mu_);
+    return max_gxid_;
+  }
+
+ private:
+  void append(char tag, const Prepared& p) {
+    if (!log_) return;
+    uint16_t gl = (uint16_t)p.gid.size();
+    uint16_t nn = (uint16_t)p.nodes.size();
+    fwrite(&tag, 1, 1, log_);
+    fwrite(&p.gxid, sizeof p.gxid, 1, log_);
+    fwrite(&gl, sizeof gl, 1, log_);
+    fwrite(p.gid.data(), 1, gl, log_);
+    fwrite(&nn, sizeof nn, 1, log_);
+    fwrite(p.nodes.data(), sizeof(int32_t), nn, log_);
+    fflush(log_);
+    fsync(fileno(log_));
+  }
+
+  void replay() {
+    FILE* f = fopen(path_.c_str(), "rb");
+    if (!f) return;
+    for (;;) {
+      char tag;
+      Prepared p;
+      uint16_t gl, nn;
+      if (fread(&tag, 1, 1, f) != 1) break;
+      if (fread(&p.gxid, sizeof p.gxid, 1, f) != 1) break;
+      if (fread(&gl, sizeof gl, 1, f) != 1) break;
+      p.gid.resize(gl);
+      if (gl && fread(&p.gid[0], 1, gl, f) != gl) break;
+      if (fread(&nn, sizeof nn, 1, f) != 1) break;
+      p.nodes.resize(nn);
+      if (nn && fread(p.nodes.data(), sizeof(int32_t), nn, f) != nn) break;
+      if (p.gxid > max_gxid_) max_gxid_ = p.gxid;
+      if (tag == 'P')
+        live_[p.gid] = p;
+      else
+        live_.erase(p.gid);
+    }
+    fclose(f);
+  }
+
+  std::mutex mu_;
+  std::string path_;
+  std::map<std::string, Prepared> live_;
+  int64_t max_gxid_ = 0;
+  FILE* log_ = nullptr;
+};
+
+struct Sequence {
+  int64_t next = 1;
+  int64_t inc = 1;
+};
+
+// Durable sequence state (gtm_store.c's sequence slots). Written
+// log-ahead: the persisted next_value runs up to 32 increments past the
+// last issued one, so a restart skips a short window but never reissues.
+class SeqStore {
+ public:
+  explicit SeqStore(const std::string& dir) : path_(dir + "/gts_seqs") {
+    FILE* f = fopen(path_.c_str(), "r");
+    if (!f) return;
+    char name[1024];
+    long long inc, next;
+    while (fscanf(f, "%1023s %lld %lld", name, &inc, &next) == 3) {
+      seqs_[name] = Sequence{next, inc};
+      durable_[name] = next;
+    }
+    fclose(f);
+  }
+
+  std::map<std::string, Sequence>& live() { return seqs_; }
+
+  void mark(const std::string& name, int64_t durable_next) {
+    durable_[name] = durable_next;
+    persist();
+  }
+
+  void erase(const std::string& name) {
+    seqs_.erase(name);
+    durable_.erase(name);
+    persist();
+  }
+
+  // true if issuance moved past the durable mark in the direction of
+  // travel (handles descending sequences: inc < 0)
+  bool needs_mark(const std::string& name, int64_t issued_next, int64_t inc) {
+    auto it = durable_.find(name);
+    if (it == durable_.end()) return true;
+    return inc >= 0 ? issued_next > it->second : issued_next < it->second;
+  }
+
+ private:
+  void persist() {
+    std::string tmp = path_ + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "w");
+    if (!f) return;
+    for (auto& kv : seqs_) {
+      auto d = durable_.find(kv.first);
+      long long next = d != durable_.end() ? d->second : kv.second.next;
+      fprintf(f, "%s %lld %lld\n", kv.first.c_str(),
+              (long long)kv.second.inc, next);
+    }
+    fflush(f);
+    fsync(fileno(f));
+    fclose(f);
+    rename(tmp.c_str(), path_.c_str());
+  }
+
+  std::string path_;
+  std::map<std::string, Sequence> seqs_;
+  std::map<std::string, int64_t> durable_;
+};
+
+// ---------------------------------------------------------------------------
+// Wire helpers
+// ---------------------------------------------------------------------------
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  template <typename T>
+  T get() {
+    if (p + sizeof(T) > end) {
+      ok = false;
+      return T{};
+    }
+    T v;
+    memcpy(&v, p, sizeof(T));
+    p += sizeof(T);
+    return v;
+  }
+
+  std::string get_str() {
+    uint16_t n = get<uint16_t>();
+    if (!ok || p + n > end) {
+      ok = false;
+      return {};
+    }
+    std::string s((const char*)p, n);
+    p += n;
+    return s;
+  }
+};
+
+struct Writer {
+  std::vector<uint8_t> buf;
+
+  template <typename T>
+  void put(T v) {
+    const uint8_t* b = (const uint8_t*)&v;
+    buf.insert(buf.end(), b, b + sizeof(T));
+  }
+
+  void put_str(const std::string& s) {
+    put<uint16_t>((uint16_t)s.size());
+    buf.insert(buf.end(), s.begin(), s.end());
+  }
+};
+
+class Server {
+ public:
+  Server(int port, const std::string& dir)
+      : clock_(dir), plog_(dir), seqstore_(dir), port_(port) {
+    next_gxid_ = plog_.max_gxid() + 1;
+  }
+
+  int run() {
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = htons((uint16_t)port_);
+    if (bind(lfd, (sockaddr*)&addr, sizeof addr) != 0) {
+      perror("bind");
+      return 1;
+    }
+    if (listen(lfd, 64) != 0) {
+      perror("listen");
+      return 1;
+    }
+    // announce readiness (the spawner waits for this line)
+    printf("GTS READY port=%d\n", port_);
+    fflush(stdout);
+
+    std::vector<pollfd> fds{{lfd, POLLIN, 0}};
+    std::map<int, std::vector<uint8_t>> inbuf;
+    for (;;) {
+      if (poll(fds.data(), fds.size(), -1) < 0) {
+        if (errno == EINTR) continue;
+        return 1;
+      }
+      for (size_t i = 0; i < fds.size(); i++) {
+        if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+        if (fds[i].fd == lfd) {
+          int cfd = accept(lfd, nullptr, nullptr);
+          if (cfd >= 0) {
+            setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+            fds.push_back({cfd, POLLIN, 0});
+          }
+          continue;
+        }
+        int fd = fds[i].fd;
+        uint8_t tmp[16384];
+        ssize_t n = read(fd, tmp, sizeof tmp);
+        if (n <= 0) {
+          close(fd);
+          inbuf.erase(fd);
+          fds.erase(fds.begin() + i);
+          i--;
+          continue;
+        }
+        auto& b = inbuf[fd];
+        b.insert(b.end(), tmp, tmp + n);
+        // drain complete frames
+        size_t off = 0;
+        while (b.size() - off >= 4) {
+          uint32_t len;
+          memcpy(&len, b.data() + off, 4);
+          if (b.size() - off - 4 < len) break;
+          handle(fd, b.data() + off + 4, len);
+          off += 4 + len;
+        }
+        b.erase(b.begin(), b.begin() + off);
+      }
+    }
+  }
+
+ private:
+  void reply(int fd, uint8_t status, const Writer& w) {
+    uint32_t len = (uint32_t)(1 + w.buf.size());
+    std::vector<uint8_t> out;
+    out.reserve(4 + len);
+    const uint8_t* lp = (const uint8_t*)&len;
+    out.insert(out.end(), lp, lp + 4);
+    out.push_back(status);
+    out.insert(out.end(), w.buf.begin(), w.buf.end());
+    size_t sent = 0;
+    while (sent < out.size()) {
+      ssize_t n = write(fd, out.data() + sent, out.size() - sent);
+      if (n <= 0) return;
+      sent += (size_t)n;
+    }
+  }
+
+  void handle(int fd, const uint8_t* data, uint32_t len) {
+    Reader r{data, data + len};
+    uint8_t op = r.get<uint8_t>();
+    Writer w;
+    if (!r.ok) return reply(fd, 1, w);
+    switch (op) {
+      case 0x01:  // GET_GTS
+      case 0x0C:  // SNAPSHOT
+        w.put<int64_t>(clock_.next());
+        return reply(fd, 0, w);
+      case 0x02: {  // BEGIN
+        std::lock_guard<std::mutex> g(mu_);
+        int64_t gxid = next_gxid_++;
+        w.put<int64_t>(gxid);
+        w.put<int64_t>(clock_.next());
+        return reply(fd, 0, w);
+      }
+      case 0x03: {  // COMMIT
+        int64_t gxid = r.get<int64_t>();
+        plog_.resolve(gxid);
+        w.put<int64_t>(clock_.next());
+        return reply(fd, 0, w);
+      }
+      case 0x04: {  // ABORT
+        int64_t gxid = r.get<int64_t>();
+        plog_.resolve(gxid);
+        return reply(fd, 0, w);
+      }
+      case 0x05: {  // PREPARE
+        Prepared p;
+        p.gxid = r.get<int64_t>();
+        p.gid = r.get_str();
+        uint16_t n = r.get<uint16_t>();
+        for (uint16_t i = 0; r.ok && i < n; i++)
+          p.nodes.push_back(r.get<int32_t>());
+        if (!r.ok) return reply(fd, 1, w);
+        plog_.prepare(p);
+        return reply(fd, 0, w);
+      }
+      case 0x06: {  // LIST_PREPARED
+        auto list = plog_.list();
+        w.put<uint16_t>((uint16_t)list.size());
+        for (auto& p : list) {
+          w.put<int64_t>(p.gxid);
+          w.put_str(p.gid);
+          w.put<uint16_t>((uint16_t)p.nodes.size());
+          for (int32_t nd : p.nodes) w.put<int32_t>(nd);
+        }
+        return reply(fd, 0, w);
+      }
+      case 0x07:  // FORGET (registry trim; journal already resolved)
+        r.get<int64_t>();
+        return reply(fd, 0, w);
+      case 0x08: {  // SEQ_CREATE
+        std::string name = r.get_str();
+        int64_t start = r.get<int64_t>();
+        int64_t inc = r.get<int64_t>();
+        std::lock_guard<std::mutex> g(mu_);
+        auto& seqs = seqstore_.live();
+        if (seqs.count(name)) return reply(fd, 1, w);
+        seqs[name] = Sequence{start, inc};
+        seqstore_.mark(name, start);
+        return reply(fd, 0, w);
+      }
+      case 0x09: {  // SEQ_NEXT (range reservation, gtm_seq.c get_rangemax)
+        std::string name = r.get_str();
+        int64_t cache = r.get<int64_t>();
+        std::lock_guard<std::mutex> g(mu_);
+        auto& seqs = seqstore_.live();
+        auto it = seqs.find(name);
+        if (it == seqs.end()) return reply(fd, 1, w);
+        int64_t first = it->second.next;
+        int64_t last = first + (cache - 1) * it->second.inc;
+        it->second.next = last + it->second.inc;
+        if (seqstore_.needs_mark(name, it->second.next, it->second.inc)) {
+          seqstore_.mark(name, it->second.next + 32 * it->second.inc);
+        }
+        w.put<int64_t>(first);
+        w.put<int64_t>(last);
+        return reply(fd, 0, w);
+      }
+      case 0x0A: {  // SEQ_DROP
+        std::string name = r.get_str();
+        std::lock_guard<std::mutex> g(mu_);
+        seqstore_.erase(name);
+        return reply(fd, 0, w);
+      }
+      case 0x0B: {  // SEQ_SET
+        std::string name = r.get_str();
+        int64_t value = r.get<int64_t>();
+        std::lock_guard<std::mutex> g(mu_);
+        auto& seqs = seqstore_.live();
+        auto it = seqs.find(name);
+        if (it == seqs.end()) return reply(fd, 1, w);
+        it->second.next = value;
+        seqstore_.mark(name, value);
+        return reply(fd, 0, w);
+      }
+      case 0x0D:  // PING
+        w.put<uint8_t>(1);
+        return reply(fd, 0, w);
+      default:
+        return reply(fd, 1, w);
+    }
+  }
+
+  Clock clock_;
+  PreparedLog plog_;
+  SeqStore seqstore_;
+  std::mutex mu_;
+  int64_t next_gxid_ = 1;
+  int port_;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <port> <state_dir>\n", argv[0]);
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  mkdir(argv[2], 0755);
+  Server s(atoi(argv[1]), argv[2]);
+  return s.run();
+}

@@ -28,8 +28,11 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-_NO_MATCH_A = jnp.int32(-2)  # build-side NULL key
-_NO_MATCH_B = jnp.int32(-3)  # probe-side NULL key
+# plain ints, not jnp constants: module import must never dispatch to a
+# backend (an eager jnp op here would stall import whenever the remote
+# TPU tunnel is slow); they become traced int32 inside the jitted fns
+_NO_MATCH_A = -2  # build-side NULL key
+_NO_MATCH_B = -3  # probe-side NULL key
 
 
 @partial(jax.jit)

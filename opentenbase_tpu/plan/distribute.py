@@ -405,6 +405,13 @@ class Distributor:
             rc = self._to_single(right, rdist)
             return rebuild(lc, rc), Dist.single(COORDINATOR)
 
+        # both replicated: every node holds both inputs entirely — run on
+        # exactly one (preferred-node read, locator.c REPLICATED select)
+        if ldist.kind == "replicated" and rdist.kind == "replicated":
+            common = [n for n in ldist.nodes if n in rdist.nodes]
+            if common:
+                return rebuild(left, right), Dist.single(common[0])
+
         out_key_positions = self._join_out_keys(plan, ldist, jt)
 
         # replicated inner side: join runs where the outer side lives

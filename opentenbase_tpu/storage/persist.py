@@ -1,0 +1,730 @@
+"""Durability: write-ahead log, checkpoints, recovery, barrier PITR.
+
+The reference's per-node durability is WAL (src/backend/access/transam/
+xlog.c) + checkpoints (src/backend/postmaster/checkpointer.c) + archive
+recovery, and its cluster-consistent recovery points are CREATE BARRIER
+records WAL-logged on every node (src/backend/pgxc/barrier/barrier.c).
+
+Here the whole mini-cluster lives in one process space, so the cluster
+WAL is a single ordered log of *committed* changes (commit timestamps
+provide the order — redo is idempotent replay in commit order, which is
+exactly what the reference's coordinator-consistent recovery achieves via
+barrier alignment):
+
+  record := u32 len | u8 tag | payload        (framed like the GTS wire)
+  tags: 'D' DDL (json), 'I' insert (json hdr + npz columns),
+        'X' delete (json hdr + npy indices), 'B' barrier (json)
+
+Checkpoint = full npz snapshot of every shard store + catalog/shardmap
+JSON + the WAL position it covers; recovery = load latest checkpoint,
+replay the WAL tail (optionally stopping at a named barrier — PITR).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.storage.table import ShardStore
+
+
+def _type_to_str(ty: t.SqlType) -> str:
+    if ty.id == t.TypeId.DECIMAL:
+        return f"decimal({ty.precision},{ty.scale})"
+    return ty.id.value
+
+
+def _type_from_str(s: str) -> t.SqlType:
+    if s.startswith("decimal("):
+        p, sc = s[8:-1].split(",")
+        return t.decimal(int(p), int(sc))
+    return t.SqlType(t.TypeId(s))
+
+
+class WAL:
+    """Append-only framed log with fsync on every commit record."""
+
+    def __init__(self, path: str):
+        self.path = path
+        # A crash mid-append leaves a torn record at the tail; recovery
+        # stops there, so anything appended after it would be unreachable
+        # forever. Truncate the torn tail before reopening for append
+        # (xlog.c does the same by zero-filling from the last valid
+        # record on recovery).
+        if os.path.exists(path):
+            end = WAL.scan_end(path)
+            if os.path.getsize(path) > end:
+                with open(path, "r+b") as f:
+                    f.truncate(end)
+        self._f = open(path, "ab")
+
+    def append(self, tag: bytes, header: dict, arrays: Optional[dict] = None) -> int:
+        hdr = json.dumps(header).encode()
+        payload = struct.pack("<I", len(hdr)) + hdr
+        if arrays is not None:
+            buf = io.BytesIO()
+            np.savez(buf, **arrays)
+            payload += buf.getvalue()
+        rec = struct.pack("<IB", 1 + len(payload), tag[0]) + payload
+        self._f.write(rec)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        return self._f.tell()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def truncate_to(self, offset: int) -> None:
+        """Discard everything after ``offset`` (abandoning a timeline
+        after PITR) and continue appending from there."""
+        self._f.close()
+        with open(self.path, "r+b") as f:
+            f.truncate(offset)
+        self._f = open(self.path, "ab")
+
+    @property
+    def position(self) -> int:
+        return self._f.tell()
+
+    @staticmethod
+    def scan_end(path: str) -> int:
+        """Offset just past the last intact record — frame headers only,
+        seeking past bodies, so opening a multi-GB WAL stays O(records)
+        not O(bytes parsed)."""
+        end = 0
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            while True:
+                head = f.read(5)
+                if len(head) < 5:
+                    return end
+                (length, _tag) = struct.unpack("<IB", head)
+                # minimum frame: tag + header-length word; a zero-filled
+                # tail would otherwise parse as endless length-0 frames
+                if length < 5:
+                    return end
+                nxt = end + 4 + length
+                if nxt > size:
+                    return end
+                f.seek(nxt)
+                end = nxt
+
+    @staticmethod
+    def read_records(path: str, start: int = 0, decode_arrays: bool = True):
+        """Yield (tag, header, arrays_or_None, end_offset).
+        ``decode_arrays=False`` skips np.load of record payloads — for
+        scans that only need headers (e.g. locating a barrier)."""
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            f.seek(start)
+            while True:
+                head = f.read(5)
+                if len(head) < 5:
+                    return
+                length, tag = struct.unpack("<IB", head)
+                if length < 5:
+                    return  # torn/zero-filled tail
+                body = f.read(length - 1)
+                if len(body) < length - 1:
+                    return  # torn tail: ignore (crash mid-append)
+                (hlen,) = struct.unpack_from("<I", body, 0)
+                header = json.loads(body[4 : 4 + hlen].decode())
+                arrays = None
+                rest = body[4 + hlen :]
+                if rest and decode_arrays:
+                    with np.load(io.BytesIO(rest), allow_pickle=False) as z:
+                        arrays = {k: z[k] for k in z.files}
+                yield chr(tag), header, arrays, f.tell()
+
+
+class ClusterPersistence:
+    """Checkpoint + WAL manager bound to one Cluster."""
+
+    def __init__(self, cluster, data_dir: str):
+        self.cluster = cluster
+        self.dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.wal = WAL(os.path.join(data_dir, "wal.log"))
+        # per-dictionary count of values already WAL-logged: replaying
+        # inserts needs the dictionary to contain the codes they carry,
+        # so dictionary growth is logged as dict_extend records first
+        self._dict_synced: dict[str, int] = {}
+        # gid -> {"gxid", "writes": [...]} of replayed-but-undecided 2PC
+        # transactions (populated during recover, drained by C/R records)
+        self._pending: dict[str, dict] = {}
+
+    def sync_dicts(self, table: str) -> None:
+        tm = self.cluster.catalog.get(table)
+        for col, d in tm.dictionaries.items():
+            key = f"{table}.{col}"
+            synced = self._dict_synced.get(key, 0)
+            if len(d) > synced:
+                self.log_ddl(
+                    {
+                        "op": "dict_extend",
+                        "table": table,
+                        "column": col,
+                        "values": d.values[synced:],
+                    }
+                )
+                self._dict_synced[key] = len(d)
+
+    # -- WAL hooks (called by the engine at commit time) ------------------
+    def log_ddl(self, op: dict) -> None:
+        self.wal.append(b"D", op)
+
+    def log_commit_group(
+        self, writes, stores, commit_ts: int
+    ) -> None:
+        """Log one committed transaction as ONE frame ('G'): a commit that
+        touches many tables/nodes must be atomic under the torn-tail rule,
+        which holds per frame — per-table records would replay a torn,
+        half-applied transaction after a crash mid-commit.
+
+        ``writes``: iterable of (node, table, ins_ranges, del_idx).
+        Deletes are logged by stable row id, not position: replayed stores
+        omit aborted rows and may order interleaved commits differently,
+        so positions drift while row ids never do.
+        """
+        sub = []
+        arrays: dict = {}
+        for table in {w[1] for w in writes}:
+            self.sync_dicts(table)
+        for node, table, ins_ranges, del_idx in writes:
+            store = stores[node][table]
+            for s, e in ins_ranges:
+                i = len(sub)
+                for name in store.schema:
+                    arrays[f"w{i}_{name}"] = store._cols[name][s:e]
+                    vm = store._validity.get(name)
+                    if vm is not None:
+                        arrays[f"w{i}__v_{name}"] = vm[s:e]
+                sub.append(
+                    {"node": node, "table": table, "kind": "ins",
+                     "nrows": e - s,
+                     "row_id_start": int(store.row_id[s]) if e > s else 0}
+                )
+            if len(del_idx):
+                i = len(sub)
+                idx = np.asarray(del_idx, dtype=np.int64)
+                arrays[f"w{i}_del"] = store.row_id[idx]
+                sub.append({"node": node, "table": table, "kind": "del"})
+        if sub:
+            self.wal.append(
+                b"G", {"commit_ts": commit_ts, "writes": sub}, arrays or None
+            )
+
+    def log_barrier(self, name: str, ts: int) -> None:
+        self.wal.append(b"B", {"name": name, "ts": ts})
+
+    # -- 2PC records (twophase.c's on-disk prepared-transaction state) ----
+    def log_prepare(self, txn, stores) -> None:
+        """Persist an explicitly PREPAREd transaction's pending writes so
+        the in-doubt txn survives a crash and can still be COMMIT/ROLLBACK
+        PREPARED after recovery."""
+        writes = []
+        arrays: dict = {}
+        for table in {tb for tabs in txn.writes.values() for tb in tabs}:
+            self.sync_dicts(table)
+        for node, tabs in txn.writes.items():
+            for table, tw in tabs.items():
+                store = stores[node][table]
+                for s, e in tw.ins_ranges:
+                    i = len(writes)
+                    for name in store.schema:
+                        arrays[f"w{i}_{name}"] = store._cols[name][s:e]
+                        vm = store._validity.get(name)
+                        if vm is not None:
+                            arrays[f"w{i}__v_{name}"] = vm[s:e]
+                    writes.append(
+                        {"node": node, "table": table, "kind": "ins",
+                         "nrows": e - s,
+                         "row_id_start": int(store.row_id[s]) if e > s else 0}
+                    )
+                if tw.del_idx:
+                    i = len(writes)
+                    idx = np.asarray(tw.del_idx, dtype=np.int64)
+                    arrays[f"w{i}_del"] = store.row_id[idx]
+                    writes.append(
+                        {"node": node, "table": table, "kind": "del"}
+                    )
+        self.wal.append(
+            b"T",
+            {"gid": txn.prepared_gid, "gxid": txn.gxid, "writes": writes},
+            arrays or None,
+        )
+
+    def log_commit_prepared(self, gid: str, commit_ts: int) -> None:
+        self.wal.append(b"C", {"gid": gid, "commit_ts": commit_ts})
+
+    def log_rollback_prepared(self, gid: str) -> None:
+        self.wal.append(b"R", {"gid": gid})
+
+    # -- checkpoint -------------------------------------------------------
+    def checkpoint(self) -> None:
+        """Snapshot catalog + all shard stores; records the WAL position
+        so recovery replays only the tail.
+
+        Crash-safety: store snapshots are written under a fresh generation
+        number and checkpoint.json (the atomic rename) names that
+        generation — a crash mid-checkpoint leaves the previous json
+        pointing at the previous generation's untouched files, never at a
+        mixed set. Rows of in-flight *unprepared* transactions
+        (xmin=PENDING, no 'T'/'prepared' record to decide them) are
+        excluded: if they later commit, their 'G' record replays them; if
+        not, they must not exist after recovery."""
+        c = self.cluster
+        gen = self._next_ckpt_gen()
+        prep_ranges: dict[tuple[int, str], list[tuple[int, int]]] = {}
+        for txn in getattr(c, "_prepared", {}).values():
+            for node, tabs in txn.writes.items():
+                for table, tw in tabs.items():
+                    prep_ranges.setdefault((node, table), []).extend(
+                        tw.ins_ranges
+                    )
+        meta = {
+            "gen": gen,
+            "wal_position": self.wal.position,
+            "tables": {},
+            "shardmap": c.shardmap.map.tolist(),
+            "num_shards": c.shardmap.num_shards,
+            "barriers": c.barriers,
+            "literals": c.catalog.literals.values,
+            "datanodes": [
+                {"name": n.name, "mesh_index": n.mesh_index}
+                for n in c.nodes.datanodes
+            ],
+            # in-doubt 2PC txns: their pending rows are inside the store
+            # snapshots (xmin=PENDING); record which rows belong to which
+            # gid so recovery can still decide them (twophase.c state files)
+            "prepared": {
+                gid: {
+                    "gxid": txn.gxid,
+                    "writes": self._prepared_writes_meta(txn),
+                }
+                for gid, txn in getattr(c, "_prepared", {}).items()
+            },
+        }
+        for name in c.catalog.table_names():
+            tm = c.catalog.get(name)
+            meta["tables"][name] = {
+                "schema": {k: _type_to_str(v) for k, v in tm.schema.items()},
+                "strategy": tm.dist.strategy.value,
+                "key_columns": list(tm.dist.key_columns),
+                "nodes": list(tm.node_indices),
+                "dictionaries": {
+                    col: d.values for col, d in tm.dictionaries.items()
+                },
+            }
+            for node in tm.node_indices:
+                store = c.stores[node].get(name)
+                if store is None:
+                    continue
+                from opentenbase_tpu.storage.table import PENDING_TS
+
+                n = store.nrows
+                keep = store.xmin_ts[:n] != PENDING_TS
+                for s, e in prep_ranges.get((node, name), []):
+                    keep[s:e] = True  # prepared rows are decidable: keep
+                arrays = {"__xmin": store.xmin_ts[:n][keep],
+                          "__xmax": store.xmax_ts[:n][keep],
+                          "__rowid": store.row_id[:n][keep]}
+                for col in store.schema:
+                    arrays[col] = store.column_array(col)[keep]
+                    vm = store._validity.get(col)
+                    if vm is not None:
+                        arrays[f"__v_{col}"] = vm[:n][keep]
+                path = os.path.join(
+                    self.dir, f"ckpt{gen}_dn{node}_{name}.npz"
+                )
+                with open(path + ".tmp", "wb") as f:
+                    np.savez(f, **arrays)
+                os.replace(path + ".tmp", path)
+        tmp = os.path.join(self.dir, "checkpoint.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.dir, "checkpoint.json"))
+        self._gc_checkpoints(gen)
+        # checkpoint covers all dictionary state up to now
+        for name in c.catalog.table_names():
+            tm = c.catalog.get(name)
+            for col, d in tm.dictionaries.items():
+                self._dict_synced[f"{name}.{col}"] = len(d)
+
+    def _next_ckpt_gen(self) -> int:
+        ckpt_path = os.path.join(self.dir, "checkpoint.json")
+        if os.path.exists(ckpt_path):
+            try:
+                with open(ckpt_path) as f:
+                    return int(json.load(f).get("gen", 0)) + 1
+            except Exception:
+                pass
+        return 1
+
+    def _gc_checkpoints(self, live_gen: int) -> None:
+        """Remove snapshot files of superseded generations."""
+        prefix = f"ckpt{live_gen}_"
+        for fn in os.listdir(self.dir):
+            if fn.startswith("ckpt") and fn.split("_", 1)[0] != prefix[:-1]:
+                if fn.endswith(".npz") or fn.endswith(".npz.tmp"):
+                    try:
+                        os.remove(os.path.join(self.dir, fn))
+                    except OSError:
+                        pass
+
+    def _prepared_writes_meta(self, txn) -> list[dict]:
+        c = self.cluster
+        ws = []
+        for node, tabs in txn.writes.items():
+            for table, tw in tabs.items():
+                store = c.stores[node][table]
+                for s, e in tw.ins_ranges:
+                    ws.append(
+                        {"node": node, "table": table, "kind": "ins",
+                         "nrows": e - s,
+                         "row_id_start": int(store.row_id[s]) if e > s else 0}
+                    )
+                if tw.del_idx:
+                    idx = np.asarray(tw.del_idx, dtype=np.int64)
+                    ws.append(
+                        {"node": node, "table": table, "kind": "del",
+                         "rowids": store.row_id[idx].tolist()}
+                    )
+        return ws
+
+    # -- recovery ---------------------------------------------------------
+    def recover(self, until_barrier: Optional[str] = None) -> int:
+        """Rebuild cluster state: checkpoint restore + WAL tail replay.
+        ``until_barrier`` stops redo at a named barrier (PITR,
+        recovery_target_barrier in the reference). Returns the number of
+        WAL records applied."""
+        c = self.cluster
+        ckpt_path = os.path.join(self.dir, "checkpoint.json")
+        wal_path = os.path.join(self.dir, "wal.log")
+        meta = None
+        if os.path.exists(ckpt_path):
+            with open(ckpt_path) as f:
+                meta = json.load(f)
+        barrier_end = None
+        if until_barrier is not None:
+            # locate the target barrier record first: a checkpoint taken
+            # *after* the barrier covers state PITR must rewind, so it can
+            # only be used when its WAL position precedes the barrier
+            prev = 0
+            for tag, header, _a, off in WAL.read_records(
+                wal_path, decode_arrays=False
+            ):
+                if tag == "B" and header["name"] == until_barrier:
+                    barrier_end = off
+                    break
+                prev = off
+            if barrier_end is None:
+                raise ValueError(
+                    f"recovery target barrier {until_barrier!r} not in WAL"
+                )
+            if meta is not None and meta["wal_position"] > prev:
+                meta = None  # checkpoint is past the barrier: replay from 0
+        start = 0
+        if meta is not None:
+            start = meta["wal_position"]
+            self._restore_checkpoint(meta)
+        applied = 0
+        for tag, header, arrays, off in WAL.read_records(wal_path, start):
+            if tag == "B":
+                c.barriers.append((header["name"], header["ts"]))
+                if barrier_end is not None and off >= barrier_end:
+                    break
+                continue
+            self._apply(tag, header, arrays)
+            applied += 1
+        if barrier_end is not None:
+            # abandon the old timeline: discard post-barrier WAL and
+            # re-checkpoint the rewound state so the next recovery cannot
+            # merge divergent histories (timeline switch, xlog.c)
+            self.wal.truncate_to(barrier_end)
+            self.checkpoint()
+        self._finish_recovery()
+        return applied
+
+    def _finish_recovery(self) -> None:
+        """Post-redo fixups: re-park still-undecided prepared transactions
+        so COMMIT/ROLLBACK PREPARED work after a crash (the RecoverPrepared
+        startup pass of twophase.c), and prime the dictionary sync state so
+        the next commit doesn't re-log whole dictionaries."""
+        from opentenbase_tpu.engine import Transaction
+
+        c = self.cluster
+        for gid, pend in self._pending.items():
+            txn = Transaction(pend["gxid"], 0)
+            txn.prepared_gid = gid
+            for wm in pend["writes"]:
+                store = c.stores[wm["node"]][wm["table"]]
+                tw = txn.w(wm["node"], wm["table"])
+                if wm["kind"] == "ins":
+                    tw.ins_ranges.append(tuple(wm["range"]))
+                else:
+                    pos = np.nonzero(
+                        np.isin(store.row_id[: store.nrows], wm["rowids"])
+                    )[0]
+                    tw.del_idx.extend(int(i) for i in pos)
+                txn.pin(store)
+            c.__dict__.setdefault("_prepared", {})[gid] = txn
+            # the GTS must also know the in-doubt txn (native backend
+            # journals it itself; the in-process backend lost it)
+            try:
+                known = {p.gid for p in c.gts.prepared_txns()}
+            except Exception:
+                known = set()
+            if gid not in known:
+                c.gts.prepare(pend["gxid"], gid, tuple(txn.touched_nodes()))
+            nx = getattr(c.gts, "_next_gxid", None)
+            if nx is not None and pend["gxid"] >= nx:
+                c.gts._next_gxid = pend["gxid"] + 1
+        self._pending = {}
+        for name in c.catalog.table_names():
+            tm = c.catalog.get(name)
+            for col, d in tm.dictionaries.items():
+                self._dict_synced[f"{name}.{col}"] = len(d)
+
+    def _restore_checkpoint(self, meta: dict) -> None:
+        import numpy as np
+
+        from opentenbase_tpu.catalog.distribution import (
+            DistStrategy,
+            DistributionSpec,
+        )
+        from opentenbase_tpu.storage.column import Dictionary
+
+        c = self.cluster
+        c.shardmap.map = np.asarray(meta["shardmap"], dtype=np.int32)
+        c.shardmap.num_shards = int(
+            meta.get("num_shards", len(c.shardmap.map))
+        )
+        c.shardmap.row_stats = np.zeros(c.shardmap.num_shards, dtype=np.int64)
+        # dynamically created datanodes must come back at their original
+        # (stable) mesh indices before table/store restore references them
+        for nd in meta.get("datanodes", []):
+            if not c.nodes.has(nd["name"]):
+                c.nodes.restore_datanode(nd["name"], nd["mesh_index"])
+            c.stores.setdefault(nd["mesh_index"], {})
+        c.barriers = [tuple(b) for b in meta["barriers"]]
+        c.catalog.literals = Dictionary(meta.get("literals", []))
+        for name, tmeta in meta["tables"].items():
+            schema = {
+                k: _type_from_str(v) for k, v in tmeta["schema"].items()
+            }
+            strategy = DistStrategy(tmeta["strategy"])
+            spec = DistributionSpec(
+                strategy, tuple(tmeta["key_columns"])
+            )
+            if not c.catalog.has(name):
+                c.catalog.create_table(name, schema, spec)
+            tm = c.catalog.get(name)
+            tm.node_indices = list(tmeta["nodes"])
+            for col, values in tmeta["dictionaries"].items():
+                tm.dictionaries[col] = Dictionary(values)
+            tm.locator.key_types = {
+                k: schema[k] for k in spec.key_columns
+            }
+            gen = meta.get("gen", 0)
+            for node in tm.node_indices:
+                store = ShardStore(tm.schema, tm.dictionaries)
+                path = os.path.join(
+                    self.dir, f"ckpt{gen}_dn{node}_{name}.npz"
+                )
+                if os.path.exists(path):
+                    with np.load(path, allow_pickle=False) as z:
+                        n = len(z["__xmin"])
+                        if n:
+                            from opentenbase_tpu.storage.column import Column
+                            from opentenbase_tpu.storage.table import ColumnBatch
+
+                            cols = {}
+                            for colname, ty in tm.schema.items():
+                                vm = (
+                                    z[f"__v_{colname}"]
+                                    if f"__v_{colname}" in z.files
+                                    else None
+                                )
+                                cols[colname] = Column(
+                                    ty, z[colname], vm,
+                                    tm.dictionaries.get(colname),
+                                )
+                            store.append_batch(ColumnBatch(cols, n), 0)
+                            store.xmin_ts[:n] = z["__xmin"]
+                            store.xmax_ts[:n] = z["__xmax"]
+                            if "__rowid" in z.files:
+                                store.row_id[:n] = z["__rowid"]
+                                store.next_row_id = int(z["__rowid"].max()) + 1
+                c.stores.setdefault(node, {})[name] = store
+        # in-doubt txns captured by this checkpoint become pending again;
+        # map their stable row ids back to restored positions
+        for gid, p in meta.get("prepared", {}).items():
+            ws = []
+            for wm in p["writes"]:
+                store = c.stores[wm["node"]][wm["table"]]
+                rid = store.row_id[: store.nrows]
+                if wm["kind"] == "ins":
+                    rid0, n = wm["row_id_start"], wm["nrows"]
+                    pos = np.nonzero((rid >= rid0) & (rid < rid0 + n))[0]
+                    rng = (int(pos[0]), int(pos[-1]) + 1) if len(pos) else (0, 0)
+                    ws.append({**wm, "range": rng})
+                else:
+                    ws.append(
+                        {**wm,
+                         "rowids": np.asarray(wm["rowids"], dtype=np.int64)}
+                    )
+            self._pending[gid] = {"gxid": p["gxid"], "writes": ws}
+
+    def _apply(self, tag: str, header: dict, arrays) -> None:
+        from opentenbase_tpu.catalog.distribution import (
+            DistStrategy,
+            DistributionSpec,
+        )
+        from opentenbase_tpu.storage.column import Column
+        from opentenbase_tpu.storage.table import ColumnBatch
+
+        c = self.cluster
+        if tag == "D":
+            op = header["op"]
+            if op == "create_table":
+                if c.catalog.has(header["name"]):
+                    return
+                schema = {
+                    k: _type_from_str(v) for k, v in header["schema"].items()
+                }
+                spec = DistributionSpec(
+                    DistStrategy(header["strategy"]),
+                    tuple(header["key_columns"]),
+                )
+                meta = c.catalog.create_table(header["name"], schema, spec)
+                c.create_table_stores(meta)
+            elif op == "drop_table":
+                if c.catalog.has(header["name"]):
+                    c.catalog.drop_table(header["name"])
+                    c.drop_table_stores(header["name"])
+            elif op == "truncate":
+                if c.catalog.has(header["name"]):
+                    meta = c.catalog.get(header["name"])
+                    for n in meta.node_indices:
+                        c.stores[n][header["name"]] = ShardStore(
+                            meta.schema, meta.dictionaries
+                        )
+            elif op == "shardmap":
+                c.shardmap.map = np.asarray(header["map"], dtype=np.int32)
+            elif op == "create_node":
+                from opentenbase_tpu.catalog.nodes import NodeDef, NodeRole
+
+                if not c.nodes.has(header["name"]):
+                    role = NodeRole(header["role"])
+                    if role == NodeRole.DATANODE:
+                        c.nodes.restore_datanode(
+                            header["name"], header["mesh_index"]
+                        )
+                        c.stores.setdefault(header["mesh_index"], {})
+                    else:
+                        c.nodes.create_node(NodeDef(header["name"], role))
+            elif op == "drop_node":
+                if c.nodes.has(header["name"]):
+                    node = c.nodes.get(header["name"])
+                    c.nodes.drop_node(header["name"], force=True)
+                    c.stores.pop(getattr(node, "mesh_index", -1), None)
+            elif op == "dict_extend":
+                tm = c.catalog.get(header["table"])
+                d = tm.dictionaries[header["column"]]
+                for v in header["values"]:
+                    d.encode_one(v)
+            return
+        if tag == "G":  # one committed transaction, atomically framed
+            writes = self._materialize_writes(
+                header["writes"], arrays, header["commit_ts"]
+            )
+            for wm in writes:
+                if wm["kind"] == "del":
+                    store = c.stores[wm["node"]][wm["table"]]
+                    pos = np.nonzero(
+                        np.isin(store.row_id[: store.nrows], wm["rowids"])
+                    )[0]
+                    store.stamp_xmax(pos, header["commit_ts"])
+            return
+        if tag == "T":  # PREPARE TRANSACTION: materialize pending writes
+            from opentenbase_tpu.storage.table import PENDING_TS
+
+            self._pending[header["gid"]] = {
+                "gxid": header["gxid"],
+                "writes": self._materialize_writes(
+                    header["writes"], arrays, PENDING_TS
+                ),
+            }
+            return
+        if tag in ("C", "R"):  # COMMIT / ROLLBACK PREPARED
+            pend = self._pending.pop(header["gid"], None)
+            if pend is None:
+                return
+            for wm in pend["writes"]:
+                store = c.stores[wm["node"]][wm["table"]]
+                if wm["kind"] == "ins":
+                    s, e = wm["range"]
+                    if tag == "C":
+                        store.stamp_xmin(s, e, header["commit_ts"])
+                    else:
+                        store.truncate_range(s, e)
+                elif tag == "C":
+                    pos = np.nonzero(
+                        np.isin(store.row_id[: store.nrows], wm["rowids"])
+                    )[0]
+                    store.stamp_xmax(pos, header["commit_ts"])
+            return
+
+    def _materialize_writes(
+        self, writes: list[dict], arrays, xmin_ts: int
+    ) -> list[dict]:
+        """Apply the insert sub-records of a 'G'/'T' frame (with the given
+        xmin stamp) and return the write list annotated with replayed
+        positions; delete sub-records pass through with their rowids."""
+        from opentenbase_tpu.storage.table import ColumnBatch
+
+        c = self.cluster
+        out = []
+        for i, wm in enumerate(writes):
+            if not c.catalog.has(wm["table"]):
+                continue
+            tm = c.catalog.get(wm["table"])
+            node = wm["node"]
+            store = c.stores.setdefault(node, {}).get(wm["table"])
+            if store is None:
+                store = ShardStore(tm.schema, tm.dictionaries)
+                c.stores[node][wm["table"]] = store
+            if wm["kind"] == "ins":
+                from opentenbase_tpu.storage.column import Column
+
+                n = wm["nrows"]
+                cols = {}
+                for colname, ty in tm.schema.items():
+                    vm = arrays.get(f"w{i}__v_{colname}")
+                    cols[colname] = Column(
+                        ty, arrays[f"w{i}_{colname}"], vm,
+                        tm.dictionaries.get(colname),
+                    )
+                s, e = store.append_batch(ColumnBatch(cols, n), xmin_ts)
+                rid0 = wm["row_id_start"]
+                store.row_id[s:e] = np.arange(rid0, rid0 + n, dtype=np.int64)
+                store.next_row_id = max(store.next_row_id, rid0 + n)
+                # redo of a MOVE DATA insert may land on a node the table
+                # didn't cover at create time
+                if node not in tm.node_indices:
+                    tm.node_indices.append(node)
+                    tm.locator.node_indices.append(node)
+                out.append({**wm, "range": (s, e)})
+            else:
+                out.append({**wm, "rowids": arrays[f"w{i}_del"]})
+        return out

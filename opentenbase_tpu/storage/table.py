@@ -90,6 +90,12 @@ class ShardStore:
         self._validity: dict[str, np.ndarray | None] = {name: None for name in schema}
         self.xmin_ts = np.empty(0, np.int64)
         self.xmax_ts = np.empty(0, np.int64)
+        # Stable per-row identity, monotonic per store: the WAL refers to
+        # rows by id (not position) so redo stays correct across aborted
+        # inserts, interleaved commits, and vacuum compaction — the ctid
+        # vs. logical-identity distinction of the reference's heap.
+        self.row_id = np.empty(0, np.int64)
+        self.next_row_id = 0
         self.nrows = 0
         self._capacity = 0
         self.version = 0
@@ -115,7 +121,7 @@ class ShardStore:
                 gvm = np.ones(new_cap, dtype=np.bool_)
                 gvm[: self.nrows] = vm[: self.nrows]
                 self._validity[name] = gvm
-        for attr in ("xmin_ts", "xmax_ts"):
+        for attr in ("xmin_ts", "xmax_ts", "row_id"):
             arr = getattr(self, attr)
             grown = np.zeros(new_cap, dtype=np.int64)
             grown[: self.nrows] = arr[: self.nrows]
@@ -141,6 +147,10 @@ class ShardStore:
                 self._validity[name][start : start + n] = True
         self.xmin_ts[start : start + n] = xmin_ts
         self.xmax_ts[start : start + n] = INF_TS
+        self.row_id[start : start + n] = np.arange(
+            self.next_row_id, self.next_row_id + n, dtype=np.int64
+        )
+        self.next_row_id += n
         self.nrows += n
         self.version += 1
         return start, start + n
@@ -215,6 +225,7 @@ class ShardStore:
                 self._validity[name] = vm[:n][keep].copy()
         self.xmin_ts = self.xmin_ts[:n][keep].copy()
         self.xmax_ts = self.xmax_ts[:n][keep].copy()
+        self.row_id = self.row_id[:n][keep].copy()
         self.nrows = n - ndead
         self._capacity = self.nrows
         self.version += 1

@@ -1,0 +1,93 @@
+"""Observability surface: system views, pg_stat_statements, distributed
+EXPLAIN ANALYZE (SURVEY §5 — pg_stat_cluster_activity, stormstats,
+explain_dist.c equivalents)."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+
+
+@pytest.fixture()
+def sess():
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute("create table t (k bigint, v text) distribute by shard(k)")
+    s.execute("insert into t values (1,'a'),(2,'b'),(3,'c'),(4,'d')")
+    return s
+
+
+def test_pgxc_node_view(sess):
+    rows = sess.query(
+        "select node_name, node_type from pgxc_node order by node_name"
+    )
+    names = [r[0] for r in rows]
+    assert "cn0" in names and "dn0" in names and "gtm0" in names
+    dn = [r for r in rows if r[1] == "datanode"]
+    assert len(dn) == 2
+
+
+def test_prepared_xacts_view(sess):
+    sess.execute("begin")
+    sess.execute("insert into t values (9,'z')")
+    sess.execute("prepare transaction 'viewgid'")
+    rows = sess.query("select gid from pg_prepared_xacts")
+    assert rows == [("viewgid",)]
+    sess.execute("commit prepared 'viewgid'")
+    assert sess.query("select count(*) from pg_prepared_xacts")[0][0] == 0
+
+
+def test_cluster_activity(sess):
+    rows = sess.query(
+        "select session_id, state from pg_stat_cluster_activity"
+    )
+    assert any(r[1] == "active" for r in rows)  # this very session
+
+
+def test_stat_statements(sess):
+    sess.query("select count(*) from t")
+    sess.query("select count(*) from t")
+    rows = sess.query(
+        "select query, calls from pg_stat_statements where calls >= 2"
+    )
+    assert any("count(*) from t" in r[0] for r in rows)
+
+
+def test_shard_map_view(sess):
+    rows = sess.query(
+        "select node_index, count(*) from pgxc_shard_map group by node_index "
+        "order by node_index"
+    )
+    assert [r[0] for r in rows] == [0, 1]
+    assert sum(r[1] for r in rows) == 16
+
+
+def test_stat_user_tables(sess):
+    rows = sess.query(
+        "select relname, sum(n_live_tup) from pg_stat_user_tables "
+        "where relname = 't' group by relname"
+    )
+    assert rows == [("t", 4)]
+    sess.execute("delete from t where k = 1")
+    rows = sess.query(
+        "select sum(n_live_tup), sum(n_total_tup) from pg_stat_user_tables "
+        "where relname = 't'"
+    )
+    assert rows[0] == (3, 4)  # dead tuple retained until vacuum
+
+
+def test_explain_analyze(sess):
+    res = sess.execute(
+        "explain analyze select v, count(*) from t group by v"
+    )
+    text = "\n".join(r[0] for r in res.rows)
+    assert "Fragment 0 on dn0" in text and "Fragment 0 on dn1" in text
+    assert "Total: rows=4" in text and "ms" in text
+
+
+def test_join_system_view_with_user_table(sess):
+    # arbitrary SQL over system views: join against shard ownership
+    rows = sess.query(
+        "select n.node_name, t3.n_live_tup from pg_stat_user_tables t3 "
+        "join pgxc_node n on t3.node_index = n.mesh_index "
+        "where t3.relname = 't' order by n.node_name"
+    )
+    assert len(rows) == 2 and sum(r[1] for r in rows) == 4

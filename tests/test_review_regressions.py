@@ -214,3 +214,120 @@ def test_count_star_over_scalar_agg_subquery(cluster_sess):
         "select count(*) from (select max(g) from big) s"
     )
     assert rows == [(1,)]
+
+
+# ---------------------------------------------------------------------------
+# durability-review regressions: WAL row identity, torn tails, PITR
+# timelines, sequence recovery, reserved names (persist.py / engine.py)
+# ---------------------------------------------------------------------------
+
+
+def _mini(tmp_path):
+    from opentenbase_tpu.engine import Cluster
+
+    return Cluster(num_datanodes=2, shard_groups=32, data_dir=str(tmp_path))
+
+
+def test_delete_after_aborted_insert_replays_correctly(tmp_path):
+    """Aborted rows occupy live-store positions but are absent from the
+    replayed store; deletes must still land on the right rows."""
+    from opentenbase_tpu.engine import Cluster
+
+    c = _mini(tmp_path)
+    s = c.session()
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("insert into t values (1),(2),(3),(4),(5)")
+    s.execute("begin")
+    s.execute("insert into t values (100),(101),(102),(103),(104)")
+    s.execute("rollback")
+    s.execute("insert into t values (10),(11),(12)")
+    s.execute("delete from t where k >= 10")  # positions past replay nrows
+
+    r = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=32)
+    ks = [x[0] for x in r.session().query("select k from t order by k")]
+    assert ks == [1, 2, 3, 4, 5]
+
+
+def test_torn_wal_tail_truncated_on_reopen(tmp_path):
+    """Garbage after the last valid record must not orphan later commits."""
+    from opentenbase_tpu.engine import Cluster
+
+    c = _mini(tmp_path)
+    s = c.session()
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("insert into t values (1)")
+    c.persistence.wal.close()
+    with open(tmp_path / "wal.log", "ab") as f:
+        f.write(b"\xff\xff\xff\x7f\x42partial-record-torn-by-crash")
+
+    r = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=32)
+    rs = r.session()
+    rs.execute("insert into t values (2)")  # appended after the torn point
+
+    r2 = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=32)
+    ks = [x[0] for x in r2.session().query("select k from t order by k")]
+    assert ks == [1, 2]
+
+
+def test_pitr_abandons_old_timeline(tmp_path):
+    """After PITR, the discarded post-barrier history must never be merged
+    into the new timeline by a subsequent recovery."""
+    from opentenbase_tpu.engine import Cluster
+
+    c = _mini(tmp_path)
+    s = c.session()
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("insert into t values (1),(2)")
+    s.execute("create barrier 'b'")
+    s.execute("delete from t where k = 1")
+    s.execute("insert into t values (9)")
+
+    r = Cluster.recover(
+        str(tmp_path), num_datanodes=2, shard_groups=32, until_barrier="b"
+    )
+    rs = r.session()
+    assert [x[0] for x in rs.query("select k from t order by k")] == [1, 2]
+    rs.execute("insert into t values (3)")  # new timeline diverges
+
+    r2 = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=32)
+    ks = [x[0] for x in r2.session().query("select k from t order by k")]
+    assert ks == [1, 2, 3]  # old timeline's delete/insert stayed dead
+
+
+def test_sequences_survive_recovery(tmp_path):
+    from opentenbase_tpu.engine import Cluster
+
+    c = _mini(tmp_path)
+    s = c.session()
+    s.execute("create sequence seq1")
+    first, _ = c.gts.nextval("seq1")
+
+    r = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=32)
+    nxt, _ = r.gts.nextval("seq1")
+    assert nxt > first  # exists, and never reissues a value
+
+
+def test_system_view_names_reserved(tmp_path):
+    import pytest as _pytest
+
+    from opentenbase_tpu.engine import Cluster, SQLError
+
+    c = Cluster(num_datanodes=2, shard_groups=32)
+    with _pytest.raises(SQLError, match="reserved"):
+        c.session().execute("create table pgxc_shard_map (a int)")
+
+
+def test_subquery_instrumentation_survives(tmp_path):
+    """EXPLAIN ANALYZE keeps InitPlan fragment stats (dist.py reset bug)."""
+    from opentenbase_tpu.engine import Cluster
+
+    c = Cluster(num_datanodes=2, shard_groups=32)
+    s = c.session()
+    s.execute("create table t (k bigint, v bigint) distribute by shard(k)")
+    s.execute("insert into t values (1,10),(2,20),(3,30)")
+    rows = s.query(
+        "explain analyze select k from t where v = (select max(v) from t)"
+    )
+    frag_lines = [r[0] for r in rows if r[0].startswith("Fragment ") and "rows=" in r[0]]
+    # 2 datanodes x (subplan fragment + main fragment) = 4 instrumented runs
+    assert len(frag_lines) == 4
