@@ -1,0 +1,7 @@
+"""Operator CLI tools: the src/bin analog (psql, pgbench, pg_ctl-ish).
+
+- ``python -m opentenbase_tpu.cli.otb_psql`` — interactive SQL shell
+- ``python -m opentenbase_tpu.cli.otb_bench`` — TPC-B-flavored load driver
+- ``python -m opentenbase_tpu.cli.otb_server`` — start a coordinator
+  front end over a (new or recovered) cluster
+"""

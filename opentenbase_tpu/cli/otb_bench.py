@@ -1,0 +1,164 @@
+"""Load driver — the pgbench analog (src/bin/pgbench).
+
+TPC-B-flavored workload over the wire protocol:
+
+    python -m opentenbase_tpu.cli.otb_bench --port 5433 -i -s 1   # init
+    python -m opentenbase_tpu.cli.otb_bench --port 5433 -c 4 -t 50
+
+Per transaction (pgbench's default script):
+  UPDATE accounts SET abalance = abalance + :delta WHERE aid = :aid
+  SELECT abalance FROM accounts WHERE aid = :aid
+  INSERT INTO history VALUES (:aid, :delta)
+Reports tps including connection establishing, like pgbench.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import threading
+import time
+
+NACCOUNTS = 1000  # per scale unit (pgbench uses 100k; columnar batches
+                  # favor a smaller default for quick smoke runs)
+
+
+def initialize(sess, scale: int) -> None:
+    sess.execute("drop table if exists accounts")
+    sess.execute("drop table if exists history")
+    sess.execute(
+        "create table accounts (aid bigint, abalance bigint)"
+        " distribute by shard(aid)"
+    )
+    sess.execute(
+        "create table history (aid bigint, delta bigint)"
+        " distribute by roundrobin"
+    )
+    n = NACCOUNTS * scale
+    chunk = 500
+    for lo in range(0, n, chunk):
+        vals = ",".join(f"({aid},0)" for aid in range(lo, min(lo + chunk, n)))
+        sess.execute(f"insert into accounts values {vals}")
+
+
+MAX_TRIES = 10  # pgbench --max-tries analog
+
+
+def run_client(make_session, scale: int, ntxn: int, stats: list, idx: int) -> None:
+    rng = random.Random(1000 + idx)
+    n = NACCOUNTS * scale
+    sess = make_session()
+    done = retried = 0
+    try:
+        for _ in range(ntxn):
+            aid = rng.randrange(n)
+            delta = rng.randint(-5000, 5000)
+            for attempt in range(MAX_TRIES):
+                try:
+                    sess.execute("begin")
+                    sess.execute(
+                        f"update accounts set abalance = abalance + {delta}"
+                        f" where aid = {aid}"
+                    )
+                    sess.execute(
+                        f"select abalance from accounts where aid = {aid}"
+                    )
+                    sess.execute(
+                        f"insert into history values ({aid}, {delta})"
+                    )
+                    sess.execute("commit")
+                    done += 1
+                    break
+                except Exception as e:
+                    # serialization failure under contention: roll back
+                    # and retry, as pgbench does with --max-tries
+                    if "serialize" not in str(e) or attempt == MAX_TRIES - 1:
+                        raise
+                    retried += 1
+                    try:
+                        sess.execute("rollback")
+                    except Exception:
+                        pass
+    finally:
+        stats[idx] = (done, retried)
+        close = getattr(sess, "close", None)
+        if close:
+            close()
+
+
+def bench(make_session, clients: int, ntxn: int, scale: int) -> dict:
+    stats = [(0, 0)] * clients
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(
+            target=run_client, args=(make_session, scale, ntxn, stats, i)
+        )
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    total = sum(s[0] for s in stats)
+    return {
+        "clients": clients,
+        "transactions": total,
+        "retries": sum(s[1] for s in stats),
+        "elapsed_s": round(elapsed, 3),
+        "tps": round(total / elapsed, 2) if elapsed else 0.0,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=5433)
+    ap.add_argument("--local", action="store_true")
+    ap.add_argument("-i", "--initialize", action="store_true")
+    ap.add_argument("-s", "--scale", type=int, default=1)
+    ap.add_argument("-c", "--clients", type=int, default=1)
+    ap.add_argument("-t", "--transactions", type=int, default=10)
+    args = ap.parse_args(argv)
+
+    if args.local:
+        from opentenbase_tpu.engine import Cluster
+
+        cluster = Cluster()
+        import threading as _t
+
+        lock = _t.RLock()
+
+        class _Locked:
+            def __init__(self):
+                self._s = cluster.session()
+
+            def execute(self, sql):
+                with lock:
+                    return self._s.execute(sql)
+
+        def make_session():
+            return _Locked()
+    else:
+        from opentenbase_tpu.net.client import connect_tcp
+
+        def make_session():
+            return connect_tcp(args.host, args.port)
+
+    if args.initialize:
+        s = make_session()
+        initialize(s, args.scale)
+        print(f"initialized: {NACCOUNTS * args.scale} accounts")
+        return 0
+
+    r = bench(make_session, args.clients, args.transactions, args.scale)
+    print(
+        f"scale={args.scale} clients={r['clients']}"
+        f" transactions={r['transactions']} retries={r['retries']}"
+        f" elapsed={r['elapsed_s']}s tps={r['tps']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

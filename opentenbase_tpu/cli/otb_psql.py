@@ -1,0 +1,140 @@
+"""Interactive SQL shell — the psql analog (src/bin/psql).
+
+    python -m opentenbase_tpu.cli.otb_psql --port 5433
+    python -m opentenbase_tpu.cli.otb_psql --local [--data-dir DIR]
+
+Backslash commands (psql's \\-command surface):
+  \\d            list tables        \\d NAME   describe a table
+  \\dn           list cluster nodes \\ds       shard map summary
+  \\timing       toggle per-statement timing
+  \\q            quit
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _fmt_table(columns, rows) -> str:
+    if not columns:
+        return ""
+    cols = [str(c) for c in columns]
+    cells = [[("" if v is None else str(v)) for v in r] for r in rows]
+    widths = [
+        max(len(cols[i]), *(len(r[i]) for r in cells)) if cells else len(cols[i])
+        for i in range(len(cols))
+    ]
+    def line(vals):
+        return " | ".join(v.ljust(w) for v, w in zip(vals, widths))
+    out = [line(cols), "-+-".join("-" * w for w in widths)]
+    out += [line(r) for r in cells]
+    out.append(f"({len(rows)} row{'s' if len(rows) != 1 else ''})")
+    return "\n".join(out)
+
+
+def _backslash(sess, cmd: str) -> bool:
+    """Handle a backslash command; returns False to quit."""
+    parts = cmd.split()
+    if parts[0] in ("\\q", "\\quit"):
+        return False
+    if parts[0] == "\\d" and len(parts) == 1:
+        res = sess.execute(
+            "select relname, node_index, n_live_tup from pg_stat_user_tables"
+        )
+        print(_fmt_table(res.columns, res.rows))
+    elif parts[0] == "\\d":
+        # describe: run a zero-row select to surface column names
+        res = sess.execute(f"select * from {parts[1]} limit 0")
+        print("\n".join(f"  {c}" for c in res.columns) or "  (no columns)")
+    elif parts[0] == "\\dn":
+        res = sess.execute("select * from pgxc_node")
+        print(_fmt_table(res.columns, res.rows))
+    elif parts[0] == "\\ds":
+        res = sess.execute(
+            "select node_index, count(*) from pgxc_shard_map group by node_index"
+            " order by node_index"
+        )
+        print(_fmt_table(["node_index", "shard_groups"], res.rows))
+    else:
+        print(f"unknown command {parts[0]}")
+    return True
+
+
+def repl(sess, inp=sys.stdin, echo: bool = False) -> None:
+    timing = False
+    buf = ""
+    prompt = "otb=# "
+    while True:
+        if inp is sys.stdin and sys.stdin.isatty():
+            try:
+                line = input(prompt if not buf else "otb-# ")
+            except EOFError:
+                break
+        else:
+            line = inp.readline()
+            if not line:
+                break
+            line = line.rstrip("\n")
+            if echo:
+                print((prompt if not buf else "otb-# ") + line)
+        stripped = line.strip()
+        if not buf and stripped.startswith("\\"):
+            if stripped == "\\timing":
+                timing = not timing
+                print(f"Timing is {'on' if timing else 'off'}.")
+                continue
+            if not _backslash(sess, stripped):
+                break
+            continue
+        buf += line + "\n"
+        if not stripped.endswith(";"):
+            continue
+        sql, buf = buf, ""
+        t0 = time.perf_counter()
+        try:
+            res = sess.execute(sql)
+        except Exception as e:
+            print(f"ERROR:  {e}")
+            continue
+        if res.columns:
+            print(_fmt_table(res.columns, res.rows))
+        else:
+            print(res.command)
+        if timing:
+            print(f"Time: {(time.perf_counter() - t0) * 1000:.3f} ms")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=5433)
+    ap.add_argument("--local", action="store_true",
+                    help="embed a cluster in-process instead of TCP")
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("-c", "--command", default=None,
+                    help="run one command and exit")
+    args = ap.parse_args(argv)
+
+    if args.local:
+        from opentenbase_tpu.engine import Cluster
+
+        sess = Cluster(data_dir=args.data_dir).session()
+    else:
+        from opentenbase_tpu.net.client import connect_tcp
+
+        sess = connect_tcp(args.host, args.port)
+    if args.command:
+        res = sess.execute(args.command)
+        if res.columns:
+            print(_fmt_table(res.columns, res.rows))
+        else:
+            print(res.command)
+        return 0
+    repl(sess)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,56 @@
+"""Start a coordinator TCP front end — the postmaster + pg_ctl analog.
+
+    python -m opentenbase_tpu.cli.otb_server --port 5433 \
+        [--data-dir DIR] [--recover] [--datanodes N] [--gts native]
+
+Runs until SIGINT. With --data-dir the cluster is durable (WAL +
+checkpoints); --recover replays existing state first (crash restart).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=5433)
+    ap.add_argument("--data-dir", default=None)
+    ap.add_argument("--recover", action="store_true")
+    ap.add_argument("--datanodes", type=int, default=2)
+    ap.add_argument("--shard-groups", type=int, default=256)
+    ap.add_argument("--gts", choices=["python", "native"], default="python")
+    args = ap.parse_args(argv)
+
+    from opentenbase_tpu.engine import Cluster
+    from opentenbase_tpu.net.server import ClusterServer
+
+    if args.recover:
+        if args.data_dir is None:
+            ap.error("--recover requires --data-dir")
+        cluster = Cluster.recover(
+            args.data_dir, args.datanodes, args.shard_groups,
+            gts_backend=args.gts,
+        )
+    else:
+        cluster = Cluster(
+            args.datanodes, args.shard_groups, args.data_dir,
+            gts_backend=args.gts,
+        )
+    server = ClusterServer(cluster, args.host, args.port).start()
+    print(f"opentenbase_tpu coordinator listening on {server.host}:{server.port}")
+
+    done = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: done.set())
+    signal.signal(signal.SIGTERM, lambda *a: done.set())
+    done.wait()
+    server.stop()
+    cluster.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

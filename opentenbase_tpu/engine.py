@@ -316,7 +316,28 @@ class Session:
             return self.txn.snapshot_ts
         return self.cluster.gts.snapshot_ts()
 
+    def _check_write_conflicts(self, txn: Transaction) -> None:
+        """First-committer-wins: if another transaction already stamped an
+        xmax on a row this one deletes/updates, committing would double-
+        apply (both would insert replacement rows). The reference gets
+        this from row locks + HeapTupleSatisfiesUpdate; a batch engine
+        checks at decision time instead."""
+        from opentenbase_tpu.storage.table import INF_TS
+
+        for node, tabs in txn.writes.items():
+            for table, tw in tabs.items():
+                if not tw.del_idx:
+                    continue
+                store = self.cluster.stores[node][table]
+                idx = np.asarray(tw.del_idx, dtype=np.int64)
+                if (store.xmax_ts[idx] != INF_TS).any():
+                    self._abort_txn(txn)
+                    raise SQLError(
+                        "could not serialize access due to concurrent update"
+                    )
+
     def _commit_txn(self, txn: Transaction) -> None:
+        self._check_write_conflicts(txn)
         gts = self.cluster.gts
         nodes = txn.touched_nodes()
         if len(nodes) > 1 and txn.prepared_gid is None:
@@ -324,7 +345,14 @@ class Session:
             # the irrevocable commit-ts stamp (PrePrepare_Remote analog)
             gts.prepare(txn.gxid, f"__implicit_{txn.gxid}", tuple(nodes))
         commit_ts = gts.commit(txn.gxid)
-        self._stamp_commit(txn, commit_ts)
+        try:
+            self._stamp_commit(txn, commit_ts)
+        except Exception:
+            # half-applied stamp (WAL I/O failure, ...): roll back our own
+            # commit_ts stamps so the in-memory state matches the WAL,
+            # which never got the atomic 'G' record
+            self._abort_txn(txn, failed_commit_ts=commit_ts)
+            raise
         gts.forget(txn.gxid)
 
     def _stamp_commit(
@@ -356,13 +384,27 @@ class Session:
             )
         txn.unpin_all()
 
-    def _abort_txn(self, txn: Transaction) -> None:
+    def _abort_txn(
+        self, txn: Transaction, failed_commit_ts: Optional[int] = None
+    ) -> None:
+        from opentenbase_tpu.storage.table import RESERVED_TS
+
         for node, tabs in txn.writes.items():
             for table, tw in tabs.items():
                 store = self.cluster.stores[node][table]
                 for s, e in tw.ins_ranges:
                     store.truncate_range(s, e)
-                # deletes were never stamped; nothing to undo
+                if tw.del_idx:
+                    # undo only OUR xmax stamps: a PREPARE reservation
+                    # (RESERVED_TS) or a half-applied failed commit. Rows
+                    # another txn deleted meanwhile must stay deleted.
+                    idx = np.asarray(tw.del_idx, dtype=np.int64)
+                    cur = store.xmax_ts[idx]
+                    mask = cur == RESERVED_TS
+                    if failed_commit_ts is not None:
+                        mask |= cur == failed_commit_ts
+                    if mask.any():
+                        store.unstamp_xmax(idx[mask])
         txn.unpin_all()
         self.cluster.gts.abort(txn.gxid)
         self.cluster.gts.forget(txn.gxid)
@@ -737,8 +779,19 @@ class Session:
     def _x_commitstmt(self, stmt: A.CommitStmt) -> Result:
         if self.txn is None:
             raise SQLError("there is no transaction in progress")
-        self._commit_txn(self.txn)
-        self.txn = None
+        txn, self.txn = self.txn, None
+        try:
+            self._commit_txn(txn)
+        except SQLError:
+            raise  # serialization failure: _commit_txn already aborted
+        except Exception:
+            # infrastructure failure mid-commit (GTS drop, WAL I/O):
+            # undo what was applied so no pins/PENDING rows leak
+            try:
+                self._abort_txn(txn)
+            except Exception:
+                pass
+            raise
         return Result("COMMIT")
 
     def _x_rollbackstmt(self, stmt: A.RollbackStmt) -> Result:
@@ -752,10 +805,27 @@ class Session:
         if self.txn is None:
             raise SQLError("there is no transaction in progress")
         txn = self.txn
+        try:
+            self._check_write_conflicts(txn)
+        except SQLError:
+            self.txn = None
+            raise
         txn.prepared_gid = stmt.gid
         self.cluster.gts.prepare(
             txn.gxid, stmt.gid, tuple(txn.touched_nodes())
         )
+        # reserve delete targets: a successful PREPARE is a commit vote, so
+        # no later writer may invalidate it — COMMIT PREPARED must never
+        # fail with a serialization error (the row locks the reference
+        # holds across PREPARE, as RESERVED_TS xmax stamps)
+        from opentenbase_tpu.storage.table import RESERVED_TS
+
+        for node, tabs in txn.writes.items():
+            for table, tw in tabs.items():
+                if tw.del_idx:
+                    self.cluster.stores[node][table].stamp_xmax(
+                        np.asarray(tw.del_idx, dtype=np.int64), RESERVED_TS
+                    )
         # session detaches; txn parks as in-doubt until COMMIT/ROLLBACK
         # PREPARED (twophase.c's on-disk state, held in the GTS registry)
         self.cluster.__dict__.setdefault("_prepared", {})[stmt.gid] = txn
@@ -768,6 +838,8 @@ class Session:
         txn = self.cluster.__dict__.get("_prepared", {}).pop(stmt.gid, None)
         if txn is None:
             raise SQLError(f'prepared transaction "{stmt.gid}" does not exist')
+        # no conflict check here: PREPARE reserved the delete targets, so
+        # the commit vote cannot be invalidated after the fact
         commit_ts = self.cluster.gts.commit(txn.gxid)
         self._stamp_commit(txn, commit_ts, wal_log=False)
         if self.cluster.persistence is not None:

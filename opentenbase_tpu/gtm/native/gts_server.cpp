@@ -350,10 +350,19 @@ class Server {
     printf("GTS READY port=%d\n", port_);
     fflush(stdout);
 
+    // Orphan watch: if the spawning backend dies (even SIGKILL, which
+    // gives it no chance to reap us) we are reparented — exit instead of
+    // holding the port and state dir forever. Polled here rather than
+    // PR_SET_PDEATHSIG because the death signal fires when the spawning
+    // *thread* exits, which kills us under a live multi-threaded parent.
+    pid_t initial_ppid = getppid();
     std::vector<pollfd> fds{{lfd, POLLIN, 0}};
     std::map<int, std::vector<uint8_t>> inbuf;
     for (;;) {
-      if (poll(fds.data(), fds.size(), -1) < 0) {
+      int rc = poll(fds.data(), fds.size(), 5000);
+      if (getppid() != initial_ppid) return 0;  // parent gone
+      if (rc == 0) continue;                    // idle heartbeat
+      if (rc < 0) {
         if (errno == EINTR) continue;
         return 1;
       }

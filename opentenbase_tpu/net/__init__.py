@@ -1,0 +1,11 @@
+"""Client/server wire layer: the libpq + tcop analog.
+
+The reference exposes the cluster over the PostgreSQL wire protocol
+(src/interfaces/libpq, src/backend/tcop/postgres.c); here the coordinator
+front end is a length-prefixed JSON protocol over TCP — simple enough to
+speak from any language, structured enough to carry result metadata,
+errors, and notices.
+"""
+
+from opentenbase_tpu.net.client import ClientSession, connect_tcp  # noqa: F401
+from opentenbase_tpu.net.server import ClusterServer  # noqa: F401

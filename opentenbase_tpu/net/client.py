@@ -1,0 +1,70 @@
+"""Client library — the libpq analog (PQconnectdb/PQexec surface).
+
+``connect_tcp(host, port)`` opens a wire session against a
+``ClusterServer``; the returned object mirrors the in-process ``Session``
+API (execute/query) so application code is agnostic to transport, the
+way the reference's psql and pgbench both sit on PQexec.
+"""
+
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass, field
+
+from opentenbase_tpu.net.protocol import recv_frame, send_frame
+
+
+class WireError(RuntimeError):
+    """Server-reported statement error (the 'E' message analog)."""
+
+
+@dataclass
+class WireResult:
+    """Mirrors engine.Result so callers are transport-agnostic."""
+
+    command: str
+    rows: list = field(default_factory=list)
+    columns: list = field(default_factory=list)
+    rowcount: int = 0
+
+
+class ClientSession:
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def execute(self, sql: str) -> WireResult:
+        send_frame(self._sock, {"q": sql})
+        resp = recv_frame(self._sock)
+        if resp is None:
+            raise WireError("connection closed by server")
+        if "error" in resp:
+            raise WireError(resp["error"])
+        return WireResult(
+            resp["tag"],
+            [tuple(r) for r in resp["rows"]],
+            resp["columns"],
+            resp["rowcount"],
+        )
+
+    def query(self, sql: str) -> list[tuple]:
+        return self.execute(sql).rows
+
+    def close(self) -> None:
+        try:
+            send_frame(self._sock, {"op": "close"})
+            recv_frame(self._sock)
+        except OSError:
+            pass
+        finally:
+            self._sock.close()
+
+    def __enter__(self) -> "ClientSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def connect_tcp(host: str = "127.0.0.1", port: int = 5433, **kw) -> ClientSession:
+    return ClientSession(host, port, **kw)

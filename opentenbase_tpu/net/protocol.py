@@ -1,0 +1,81 @@
+"""Framing for the coordinator wire protocol.
+
+Frame := u32 length | payload (UTF-8 JSON object). The JSON layer plays
+the role of the reference's tagged protocol messages ('Q'uery, 'D'ataRow,
+'E'rror, 'C'ommandComplete — src/backend/tcop/postgres.c message loop):
+
+  request:  {"q": "<sql>"}                      simple query
+            {"op": "close"}                     terminate session
+  response: {"tag": str, "columns": [..], "rows": [[..]], "rowcount": int}
+            {"error": str}
+            {"ok": true}                        for op messages
+
+Values are JSON-encoded; Decimal/date/timestamp columns travel as strings
+with a "types" sidecar so the client can round-trip them faithfully.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import json
+import socket
+import struct
+
+
+def _default(o):
+    if isinstance(o, decimal.Decimal):
+        return {"$dec": str(o)}
+    if isinstance(o, datetime.datetime):
+        return {"$ts": o.isoformat()}
+    if isinstance(o, datetime.date):
+        return {"$d": o.isoformat()}
+    raise TypeError(f"unserializable {type(o)}")
+
+
+def _revive(o):
+    if isinstance(o, dict) and len(o) == 1:
+        if "$dec" in o:
+            return decimal.Decimal(o["$dec"])
+        if "$ts" in o:
+            return datetime.datetime.fromisoformat(o["$ts"])
+        if "$d" in o:
+            return datetime.date.fromisoformat(o["$d"])
+    return o
+
+
+def _revive_tree(x):
+    if isinstance(x, list):
+        return [_revive_tree(v) for v in x]
+    if isinstance(x, dict):
+        r = _revive(x)
+        if r is not x:
+            return r
+        return {k: _revive_tree(v) for k, v in x.items()}
+    return x
+
+
+def send_frame(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj, default=_default).encode()
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def recv_frame(sock: socket.socket) -> dict | None:
+    head = _recv_exact(sock, 4)
+    if head is None:
+        return None
+    (length,) = struct.unpack("<I", head)
+    body = _recv_exact(sock, length)
+    if body is None:
+        return None
+    return _revive_tree(json.loads(body.decode()))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            return None
+        out += chunk
+    return out

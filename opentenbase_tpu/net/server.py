@@ -1,0 +1,128 @@
+"""Coordinator TCP front end — the tcop/postmaster analog.
+
+The reference's postmaster forks a backend per connection, each running
+the tcop message loop (src/backend/tcop/postgres.c:4792 PostgresMain).
+Here the coordinator runs one thread per connection, each owning a
+``Session`` against the shared in-process cluster — same session
+semantics (GUCs, open transaction) per connection, same single shared
+data plane underneath.
+
+Statement execution from concurrent connections is serialized through the
+cluster's executor lock: the engine's store mutation paths assume one
+writer at a time (the reference gets this from per-tuple locking +
+MVCC; a columnar batch engine takes the coarser lock and relies on
+snapshot isolation for readers).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from opentenbase_tpu.net.protocol import recv_frame, send_frame
+
+
+class ClusterServer:
+    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0):
+        self.cluster = cluster
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(64)
+        self.host, self.port = self._lsock.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conn_threads: list[threading.Thread] = []
+        # engine-wide statement lock (see module docstring)
+        self._exec_lock = getattr(cluster, "_exec_lock", None)
+        if self._exec_lock is None:
+            self._exec_lock = threading.RLock()
+            cluster._exec_lock = self._exec_lock
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "ClusterServer":
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._accept_thread = t
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        # the accept loop exits on the listener close; join it first so
+        # _conn_threads cannot grow while we iterate a snapshot of it
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+        for t in list(self._conn_threads):
+            t.join(timeout=5)
+
+    def __enter__(self) -> "ClusterServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- loops -----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._lsock.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            # prune finished backends so a long-lived coordinator doesn't
+            # accumulate one dead Thread per connection ever served
+            self._conn_threads = [
+                x for x in self._conn_threads if x.is_alive()
+            ]
+            self._conn_threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        session = self.cluster.session()
+        try:
+            while not self._stop.is_set():
+                msg = recv_frame(conn)
+                if msg is None:
+                    break
+                if msg.get("op") == "close":
+                    send_frame(conn, {"ok": True})
+                    break
+                sql = msg.get("q")
+                if sql is None:
+                    send_frame(conn, {"error": "malformed request"})
+                    continue
+                try:
+                    with self._exec_lock:
+                        res = session.execute(sql)
+                    send_frame(
+                        conn,
+                        {
+                            "tag": res.command,
+                            "columns": res.columns,
+                            "rows": [list(r) for r in res.rows],
+                            "rowcount": res.rowcount,
+                        },
+                    )
+                except Exception as e:  # engine errors go to the client
+                    send_frame(conn, {"error": f"{type(e).__name__}: {e}"})
+        finally:
+            # abort any transaction left open by a dropped connection
+            # (the backend-exit cleanup of the reference's tcop loop)
+            if session.txn is not None:
+                try:
+                    with self._exec_lock:
+                        session.execute("rollback")
+                except Exception:
+                    pass
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -463,6 +463,8 @@ class ClusterPersistence:
         from opentenbase_tpu.engine import Transaction
 
         c = self.cluster
+        from opentenbase_tpu.storage.table import RESERVED_TS
+
         for gid, pend in self._pending.items():
             txn = Transaction(pend["gxid"], 0)
             txn.prepared_gid = gid
@@ -476,6 +478,9 @@ class ClusterPersistence:
                         np.isin(store.row_id[: store.nrows], wm["rowids"])
                     )[0]
                     tw.del_idx.extend(int(i) for i in pos)
+                    # re-assert the PREPARE reservation so new writers
+                    # conflict against the in-doubt delete
+                    store.stamp_xmax(pos, RESERVED_TS)
                 txn.pin(store)
             c.__dict__.setdefault("_prepared", {})[gid] = txn
             # the GTS must also know the in-doubt txn (native backend
@@ -670,6 +675,8 @@ class ClusterPersistence:
             pend = self._pending.pop(header["gid"], None)
             if pend is None:
                 return
+            from opentenbase_tpu.storage.table import RESERVED_TS
+
             for wm in pend["writes"]:
                 store = c.stores[wm["node"]][wm["table"]]
                 if wm["kind"] == "ins":
@@ -678,11 +685,18 @@ class ClusterPersistence:
                         store.stamp_xmin(s, e, header["commit_ts"])
                     else:
                         store.truncate_range(s, e)
-                elif tag == "C":
+                else:
                     pos = np.nonzero(
                         np.isin(store.row_id[: store.nrows], wm["rowids"])
                     )[0]
-                    store.stamp_xmax(pos, header["commit_ts"])
+                    if tag == "C":
+                        store.stamp_xmax(pos, header["commit_ts"])
+                    else:
+                        # release a checkpoint-persisted PREPARE
+                        # reservation on rollback
+                        res = pos[store.xmax_ts[pos] == RESERVED_TS]
+                        if len(res):
+                            store.unstamp_xmax(res)
             return
 
     def _materialize_writes(

@@ -29,6 +29,11 @@ from opentenbase_tpu.storage.column import Column, Dictionary, column_from_pytho
 # Timestamp sentinels (int64). Real GTS values are positive and far below.
 INF_TS = np.int64(2**62)  # "never deleted" / "not yet committed"
 PENDING_TS = np.int64(2**62)
+# xmax reservation by a PREPAREd transaction: still above every snapshot
+# (row stays visible — the delete is undecided) but distinct from INF so
+# concurrent writers conflict against it. The row-lock-held-across-PREPARE
+# of the reference, as a timestamp (heap_lock_tuple + twophase.c).
+RESERVED_TS = np.int64(2**62 - 1)
 
 
 @dataclass

@@ -1,0 +1,234 @@
+"""Wire-protocol tests: coordinator TCP server + client library + CLI —
+the libpq/psql/pgbench surface (src/interfaces/libpq, src/bin/psql,
+src/bin/pgbench)."""
+
+import io
+import threading
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.net.client import WireError, connect_tcp
+from opentenbase_tpu.net.server import ClusterServer
+
+
+@pytest.fixture()
+def server():
+    cluster = Cluster(num_datanodes=2, shard_groups=32)
+    srv = ClusterServer(cluster).start()
+    yield srv
+    srv.stop()
+
+
+def test_roundtrip_types(server):
+    with connect_tcp(server.host, server.port) as s:
+        s.execute(
+            "create table t (k bigint, v text, amount decimal(10,2))"
+            " distribute by shard(k)"
+        )
+        s.execute("insert into t values (1,'héllo',12.34),(2,null,null)")
+        rows = s.query("select k, v, amount from t order by k")
+        assert rows[0][0] == 1 and rows[0][1] == "héllo"
+        assert str(rows[0][2]) == "12.34"
+        assert rows[1][1] is None and rows[1][2] is None
+
+
+def test_error_propagates_and_session_survives(server):
+    with connect_tcp(server.host, server.port) as s:
+        with pytest.raises(WireError, match="does not exist|unknown|SQLError"):
+            s.query("select * from nope")
+        s.execute("create table ok (k bigint) distribute by shard(k)")
+        assert s.execute("insert into ok values (1)").rowcount == 1
+
+
+def test_dropped_connection_aborts_txn(server):
+    s1 = connect_tcp(server.host, server.port)
+    s1.execute("create table t (k bigint) distribute by shard(k)")
+    s1.execute("begin")
+    s1.execute("insert into t values (1)")
+    s1._sock.close()  # vanish without COMMIT (client crash)
+    import time
+
+    with connect_tcp(server.host, server.port) as s2:
+        for _ in range(50):  # server-side cleanup is async
+            if s2.query("select k from t") == []:
+                break
+            time.sleep(0.1)
+        assert s2.query("select k from t") == []  # rolled back
+
+
+def test_concurrent_sessions_isolated(server):
+    with connect_tcp(server.host, server.port) as a, connect_tcp(
+        server.host, server.port
+    ) as b:
+        a.execute("create table t (k bigint) distribute by shard(k)")
+        a.execute("begin")
+        a.execute("insert into t values (1)")
+        assert b.query("select k from t") == []  # not visible pre-commit
+        a.execute("commit")
+        assert b.query("select k from t") == [(1,)]
+
+
+def test_first_committer_wins(server):
+    with connect_tcp(server.host, server.port) as a, connect_tcp(
+        server.host, server.port
+    ) as b:
+        a.execute("create table t (k bigint, v bigint) distribute by shard(k)")
+        a.execute("insert into t values (1, 0)")
+        a.execute("begin")
+        a.execute("update t set v = 10 where k = 1")
+        b.execute("begin")
+        b.execute("update t set v = 20 where k = 1")
+        a.execute("commit")
+        with pytest.raises(WireError, match="serialize"):
+            b.execute("commit")
+        assert a.query("select v from t where k = 1") == [(10,)]
+
+
+def test_wire_bench_smoke(server):
+    from opentenbase_tpu.cli import otb_bench
+
+    s = connect_tcp(server.host, server.port)
+    otb_bench.initialize(s, scale=1)
+    s.close()
+
+    def make_session():
+        return connect_tcp(server.host, server.port)
+
+    r = otb_bench.bench(make_session, clients=2, ntxn=5, scale=1)
+    assert r["transactions"] == 10 and r["tps"] > 0
+    with connect_tcp(server.host, server.port) as s:
+        assert s.query("select count(*) from history") == [(10,)]
+
+
+def test_psql_repl_pipe(server):
+    from opentenbase_tpu.cli.otb_psql import repl
+
+    sess = connect_tcp(server.host, server.port)
+    script = io.StringIO(
+        "create table t (k bigint, v text) distribute by shard(k);\n"
+        "insert into t values (1,'a'),(2,'b');\n"
+        "select k, v from t\n"
+        "order by k;\n"
+        "\\d\n"
+        "\\dn\n"
+        "\\q\n"
+    )
+    import contextlib
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        repl(sess, inp=script)
+    text = out.getvalue()
+    assert "CREATE TABLE" in text
+    assert "(2 rows)" in text and "| b" in text
+    assert "cn0" in text  # \dn shows nodes
+    sess.close()
+
+
+def test_server_parallel_clients_no_corruption(server):
+    with connect_tcp(server.host, server.port) as s:
+        s.execute("create table t (k bigint) distribute by shard(k)")
+
+    errs = []
+
+    def worker(base):
+        try:
+            with connect_tcp(server.host, server.port) as c:
+                for i in range(10):
+                    c.execute(f"insert into t values ({base + i})")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(w * 100,)) for w in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    with connect_tcp(server.host, server.port) as s:
+        assert s.query("select count(*) from t") == [(40,)]
+
+
+def test_server_subprocess_end_to_end(tmp_path):
+    """Real separate coordinator process + TCP client + durable restart —
+    the pg_regress 'real processes on localhost' harness."""
+    import os
+    import re
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # hermetic CPU in the child
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def spawn(extra):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "opentenbase_tpu.cli.otb_server",
+             "--port", "0", "--data-dir", str(tmp_path / "data")] + extra,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd="/root/repo", text=True,
+        )
+        line = proc.stdout.readline()
+        m = re.search(r"listening on 127\.0\.0\.1:(\d+)", line)
+        assert m, f"bad banner: {line!r}"
+        return proc, int(m.group(1))
+
+    proc, port = spawn([])
+    try:
+        with connect_tcp("127.0.0.1", port, timeout=60) as s:
+            s.execute("create table t (k bigint, v text) distribute by shard(k)")
+            s.execute("insert into t values (1,'x'),(2,'y')")
+            assert s.query("select count(*) from t") == [(2,)]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+    # crash-restart the coordinator process: data must survive
+    proc, port = spawn(["--recover"])
+    try:
+        with connect_tcp("127.0.0.1", port, timeout=60) as s:
+            assert s.query("select v from t order by k") == [("x",), ("y",)]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_prepare_reserves_rows_commit_prepared_never_fails(server):
+    """A successful PREPARE is a commit vote: later writers must conflict
+    against the reservation, and COMMIT PREPARED must always succeed."""
+    with connect_tcp(server.host, server.port) as a, connect_tcp(
+        server.host, server.port
+    ) as b:
+        a.execute("create table t (k bigint, v bigint) distribute by shard(k)")
+        a.execute("insert into t values (1, 0)")
+        a.execute("begin")
+        a.execute("update t set v = 10 where k = 1")
+        a.execute("prepare transaction 'vote1'")
+        # the row is still visible (delete undecided)...
+        assert b.query("select v from t where k = 1") == [(0,)]
+        # ...but a competing writer loses against the reservation
+        b.execute("begin")
+        b.execute("update t set v = 20 where k = 1")
+        with pytest.raises(WireError, match="serialize"):
+            b.execute("commit")
+        a.execute("commit prepared 'vote1'")  # never raises
+        assert b.query("select v from t where k = 1") == [(10,)]
+
+
+def test_rollback_prepared_releases_reservation(server):
+    with connect_tcp(server.host, server.port) as a, connect_tcp(
+        server.host, server.port
+    ) as b:
+        a.execute("create table t (k bigint, v bigint) distribute by shard(k)")
+        a.execute("insert into t values (1, 0)")
+        a.execute("begin")
+        a.execute("update t set v = 10 where k = 1")
+        a.execute("prepare transaction 'vote2'")
+        a.execute("rollback prepared 'vote2'")
+        b.execute("begin")
+        b.execute("update t set v = 20 where k = 1")
+        b.execute("commit")  # reservation released: no conflict
+        assert b.query("select v from t where k = 1") == [(20,)]
