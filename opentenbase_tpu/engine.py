@@ -224,6 +224,71 @@ class Cluster:
         self.sessions.add(s)
         return s
 
+    # -- in-doubt 2PC repair (clean2pc.c bgworker + contrib/pg_clean) -----
+    def clean_2pc(self, max_age_s: float = 300.0) -> list[str]:
+        """Resolve stale in-doubt transactions: parked prepared txns older
+        than ``max_age_s`` are rolled back (no commit decision was ever
+        logged, so abort is the safe side — pg_clean's rule), and GTS
+        registry entries with no backing state are forgotten."""
+        import time as _time
+
+        resolved = []
+        now = _time.time()
+        prepared = self.__dict__.get("_prepared", {})
+        for gid, txn in list(prepared.items()):
+            # unknown prepare time (shouldn't happen; recovery stamps it)
+            # counts as infinitely old — never as brand new
+            age = now - getattr(txn, "prepared_at", 0.0)
+            if age < max_age_s:
+                continue
+            if prepared.pop(gid, None) is None:
+                continue  # a session decided it concurrently: not ours
+            # roll back through the session machinery so WAL +
+            # reservations are handled uniformly
+            Session(self)._abort_txn(txn)
+            if self.persistence is not None:
+                self.persistence.log_rollback_prepared(gid)
+            resolved.append(gid)
+        # registry-only leftovers (e.g. implicit-2PC gids from a backend
+        # that died between prepare and commit)
+        try:
+            for info in self.gts.prepared_txns():
+                if info.gid and info.gid not in prepared and (
+                    info.gid not in resolved
+                ):
+                    if info.gid.startswith("__implicit_"):
+                        self.gts.abort(info.gxid)
+                        self.gts.forget(info.gxid)
+                        resolved.append(info.gid)
+        except Exception:
+            pass
+        return resolved
+
+    def start_clean2pc(
+        self, interval_s: float = 60.0, max_age_s: float = 300.0
+    ):
+        """Background auto-cleaner (the clean2pc postmaster child).
+        Returns a stop() callable."""
+        import threading as _threading
+
+        stop = _threading.Event()
+
+        def loop() -> None:
+            while not stop.wait(interval_s):
+                try:
+                    self.clean_2pc(max_age_s)
+                except Exception:
+                    pass
+
+        t = _threading.Thread(target=loop, daemon=True)
+        t.start()
+
+        def stopper() -> None:
+            stop.set()
+            t.join(timeout=5)
+
+        return stopper
+
     def close(self) -> None:
         """Release external resources: the native GTS subprocess (if any)
         and the WAL file handle. Idempotent."""
@@ -827,7 +892,11 @@ class Session:
                         np.asarray(tw.del_idx, dtype=np.int64), RESERVED_TS
                     )
         # session detaches; txn parks as in-doubt until COMMIT/ROLLBACK
-        # PREPARED (twophase.c's on-disk state, held in the GTS registry)
+        # PREPARED (twophase.c's on-disk state, held in the GTS registry);
+        # prepared_at feeds the clean2pc staleness rule
+        import time as _time
+
+        txn.prepared_at = _time.time()
         self.cluster.__dict__.setdefault("_prepared", {})[stmt.gid] = txn
         if self.cluster.persistence is not None:
             self.cluster.persistence.log_prepare(txn, self.cluster.stores)

@@ -51,6 +51,10 @@ class GTSClock:
         self._store_path = store_path
         self._last: GlobalTimestamp = FIRST_GTS
         self._watermark: GlobalTimestamp = 0
+        # standby feed hook: every durable watermark advance is replicated
+        # so a promoted standby knows the ceiling of what the old primary
+        # could have issued (incl. never-replicated read snapshots)
+        self.on_advance: Optional[Callable[[int], None]] = None
         if store_path and os.path.exists(store_path):
             with open(store_path) as f:
                 state = json.load(f)
@@ -69,6 +73,7 @@ class GTSClock:
             os.replace(tmp, self._store_path)
 
     def next(self) -> GlobalTimestamp:
+        advanced: Optional[int] = None
         with self._lock:
             wall = int(time.time() * 1000) << _LOGICAL_BITS
             ts = wall if wall > self._last else self._last + 1
@@ -77,7 +82,13 @@ class GTSClock:
             self._last = ts
             if ts >= self._watermark - (self.RESERVE >> 1):
                 self._advance_watermark()
-            return ts
+                advanced = self._watermark
+        # replicate OUTSIDE the clock lock: the fanout takes the
+        # replication-link lock, and holding this lock across it would
+        # close a lock cycle with standby attach (which snapshots state)
+        if advanced is not None and self.on_advance is not None:
+            self.on_advance(advanced)
+        return ts
 
     def current(self) -> GlobalTimestamp:
         with self._lock:
@@ -138,6 +149,10 @@ class GTSServer:
         # store, written log-ahead (SEQ_LOG_VALS-style: the persisted
         # next_value runs ahead of the issued one, so a crash skips at
         # most one reserve window but never reissues a value)
+        self.clock.on_advance = lambda wm: self._rep(
+            "watermark", {"value": int(wm)}
+        )
+        self._rep("watermark", {"value": int(self.clock._watermark)})
         self._seq_path = store_path + ".seq" if store_path else None
         self._seq_durable: dict[str, int] = {}
         if self._seq_path and os.path.exists(self._seq_path):
@@ -185,6 +200,9 @@ class GTSServer:
             self._next_gxid += 1
             info = TxnInfo(gxid, TxnState.ACTIVE, self.clock.next())
             self._txns[gxid] = info
+            # MSG_BKUP_TXN_BEGIN: the standby must not reissue this gxid
+            # after promote even if the txn never prepares/commits
+            self._rep("begin", {"gxid": gxid})
             return info
 
     def prepare(self, gxid: int, gid: str, partnodes: tuple[int, ...]) -> None:
@@ -259,7 +277,11 @@ class GTSServer:
             )
             self._seq_durable[name] = start
             self._persist_seqs()
-            self._rep("seq_create", {"name": name, "start": start})
+            self._rep(
+                "seq_create",
+                {"name": name, "start": start, "increment": increment,
+                 "min": min_value, "max": max_value, "cycle": cycle},
+            )
 
     def drop_sequence(self, name: str) -> None:
         with self._lock:
@@ -326,6 +348,7 @@ class GTSServer:
             return {
                 "next_gxid": self._next_gxid,
                 "last_ts": self.clock.current(),
+                "watermark": int(self.clock._watermark),
                 "prepared": [
                     {
                         "gxid": i.gxid,

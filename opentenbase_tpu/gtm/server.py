@@ -1,0 +1,161 @@
+"""TCP front end for a GTSServer — the GTM service process surface.
+
+Speaks the exact wire protocol of gtm/native/gts_server.cpp (opcodes in
+gtm/client.py), so ``NativeGTS`` connects to either implementation
+interchangeably: the C++ server for a standalone deployment, this wrapper
+to expose an in-process GTSServer (e.g. a just-promoted standby) to
+remote backends — the dual the reference gets from one gtm binary used
+as primary, standby, or proxy (src/gtm/main, src/gtm/proxy).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional
+
+from opentenbase_tpu.gtm import client as C
+from opentenbase_tpu.gtm.gts import GTSServer
+
+
+class GTSFrontend:
+    """Thread-per-connection TCP server over a GTSServer (GTM_ThreadMain
+    analog, src/gtm/main/main.c:3383)."""
+
+    def __init__(self, gts: GTSServer, host: str = "127.0.0.1", port: int = 0):
+        self.gts = gts
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(64)
+        self.host, self.port = self._lsock.getsockname()
+        self._accept: Optional[threading.Thread] = None
+
+    def start(self) -> "GTSFrontend":
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept.start()
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    # -- one backend connection ------------------------------------------
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                head = self._recv_exact(conn, 4)
+                if head is None:
+                    return
+                (length,) = struct.unpack("<I", head)
+                body = self._recv_exact(conn, length)
+                if body is None:
+                    return
+                op, payload = body[0], body[1:]
+                try:
+                    out = self._dispatch(op, payload)
+                    conn.sendall(
+                        struct.pack("<I", 1 + len(out)) + b"\x00" + out
+                    )
+                except Exception:
+                    conn.sendall(struct.pack("<I", 1) + b"\x01")
+        except OSError:
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, op: int, p: bytes) -> bytes:
+        g = self.gts
+        if op in (C.OP_GET_GTS, C.OP_SNAPSHOT):
+            fn = g.get_gts if op == C.OP_GET_GTS else g.snapshot_ts
+            return struct.pack("<q", fn())
+        if op == C.OP_PING:
+            return b"\x01"
+        if op == C.OP_BEGIN:
+            info = g.begin()
+            return struct.pack("<qq", info.gxid, info.start_ts)
+        if op == C.OP_COMMIT:
+            (gxid,) = struct.unpack_from("<q", p, 0)
+            return struct.pack("<q", g.commit(gxid))
+        if op == C.OP_ABORT:
+            (gxid,) = struct.unpack_from("<q", p, 0)
+            g.abort(gxid)
+            return b""
+        if op == C.OP_FORGET:
+            (gxid,) = struct.unpack_from("<q", p, 0)
+            g.forget(gxid)
+            return b""
+        if op == C.OP_PREPARE:
+            (gxid,) = struct.unpack_from("<q", p, 0)
+            off = 8
+            (gl,) = struct.unpack_from("<H", p, off)
+            off += 2
+            gid = p[off : off + gl].decode()
+            off += gl
+            (m,) = struct.unpack_from("<H", p, off)
+            off += 2
+            nodes = struct.unpack_from(f"<{m}i", p, off) if m else ()
+            g.prepare(gxid, gid, tuple(nodes))
+            return b""
+        if op == C.OP_LIST_PREPARED:
+            out = b""
+            txns = g.prepared_txns()
+            out += struct.pack("<H", len(txns))
+            for t in txns:
+                gid = (t.gid or "").encode()
+                out += struct.pack("<q", t.gxid)
+                out += struct.pack("<H", len(gid)) + gid
+                out += struct.pack("<H", len(t.partnodes))
+                for n in t.partnodes:
+                    out += struct.pack("<i", n)
+            return out
+        if op == C.OP_SEQ_CREATE:
+            (nl,) = struct.unpack_from("<H", p, 0)
+            name = p[2 : 2 + nl].decode()
+            start, inc = struct.unpack_from("<qq", p, 2 + nl)
+            g.create_sequence(name, start, inc)
+            return b""
+        if op == C.OP_SEQ_NEXT:
+            (nl,) = struct.unpack_from("<H", p, 0)
+            name = p[2 : 2 + nl].decode()
+            (cache,) = struct.unpack_from("<q", p, 2 + nl)
+            first, last = g.nextval(name, cache)
+            return struct.pack("<qq", first, last)
+        if op == C.OP_SEQ_DROP:
+            (nl,) = struct.unpack_from("<H", p, 0)
+            g.drop_sequence(p[2 : 2 + nl].decode())
+            return b""
+        if op == C.OP_SEQ_SET:
+            (nl,) = struct.unpack_from("<H", p, 0)
+            name = p[2 : 2 + nl].decode()
+            (value,) = struct.unpack_from("<q", p, 2 + nl)
+            g.setval(name, value)
+            return b""
+        raise ValueError(f"unknown op {op:#x}")
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        out = b""
+        while len(out) < n:
+            chunk = conn.recv(n - len(out))
+            if not chunk:
+                return None
+            out += chunk
+        return out

@@ -1,0 +1,275 @@
+"""GTM standby replication and failover.
+
+The reference runs a GTM standby fed by log shipping from the primary
+(src/gtm/main/gtm_standby.c, replication.c, MSG_BKUP_* message family in
+main.c) and promotes it with ``gtm_ctl promote``. The analog here:
+
+- ``GTSStandby``: bootstraps from the primary's full ``state_snapshot()``
+  (node_get_local_gtm-style backup) then applies the ``on_replicate``
+  event stream. Each applied event advances ``applied_lsn`` so lag is
+  observable (pg_stat_replication's sent/replay lsn).
+- ``promote()``: turns the accumulated state into a live ``GTSServer``
+  whose clock starts ABOVE everything the primary may have issued
+  (watermark jump — timestamps never regress or repeat across failover,
+  the same guarantee the primary's own reserve-ahead restart gives).
+- ``ReplicationLink``: in-process feed wiring, with an optional TCP
+  transport (``serve_feed``/``connect_feed``) for a standby in another
+  process, framed like the GTS native protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Optional
+
+from opentenbase_tpu.gtm.gts import (
+    GTSClock,
+    GTSServer,
+    TxnInfo,
+    TxnState,
+    _Sequence,
+)
+
+
+class GTSStandby:
+    """Receives the primary's replication feed and can be promoted."""
+
+    def __init__(self, snapshot: dict):
+        self._lock = threading.Lock()
+        self.applied_lsn = 0
+        self._last_ts = int(snapshot["last_ts"])
+        # ceiling of everything the primary can issue without another
+        # (replicated) watermark advance — covers read snapshots and
+        # begins that are themselves never replicated as timestamps
+        self._watermark = int(snapshot.get("watermark", 0))
+        self._next_gxid = int(snapshot["next_gxid"])
+        self._prepared: dict[str, dict] = {
+            p["gid"]: p for p in snapshot["prepared"]
+        }
+        self._seqs: dict[str, dict] = dict(snapshot["sequences"])
+        self.promoted: Optional[GTSServer] = None
+
+    # -- feed ------------------------------------------------------------
+    def apply(self, event: str, payload: dict) -> None:
+        """One replication record (a MSG_BKUP_* message)."""
+        with self._lock:
+            self.applied_lsn += 1
+            if event == "watermark":
+                self._watermark = max(self._watermark, payload["value"])
+            elif event == "begin":
+                self._next_gxid = max(self._next_gxid, payload["gxid"] + 1)
+            elif event == "prepare":
+                self._prepared[payload["gid"]] = payload
+                self._next_gxid = max(self._next_gxid, payload["gxid"] + 1)
+            elif event == "commit":
+                self._last_ts = max(self._last_ts, payload["commit_ts"])
+                for gid, p in list(self._prepared.items()):
+                    if p["gxid"] == payload["gxid"]:
+                        del self._prepared[gid]
+                self._next_gxid = max(self._next_gxid, payload["gxid"] + 1)
+            elif event == "abort":
+                for gid, p in list(self._prepared.items()):
+                    if p["gxid"] == payload["gxid"]:
+                        del self._prepared[gid]
+                self._next_gxid = max(self._next_gxid, payload["gxid"] + 1)
+            elif event == "seq_create":
+                self._seqs[payload["name"]] = {
+                    "next_value": payload["start"],
+                    "increment": payload.get("increment", 1),
+                    "min": payload.get("min", 1),
+                    "max": payload.get("max", 2**62),
+                    "cycle": payload.get("cycle", False),
+                }
+            elif event == "seq_drop":
+                self._seqs.pop(payload["name"], None)
+            elif event in ("seq_next", "seq_set"):
+                s = self._seqs.get(payload["name"])
+                if s is not None:
+                    s["next_value"] = payload.get(
+                        "next", payload.get("value")
+                    )
+
+    # -- failover --------------------------------------------------------
+    def promote(self, store_path: Optional[str] = None) -> GTSServer:
+        """gtm_ctl promote: become the primary. The new clock starts above
+        the old primary's durable watermark reserve so no timestamp is
+        ever reissued, even for commits replicated moments before the
+        crash."""
+        with self._lock:
+            srv = GTSServer(store_path)
+            # jump past everything the old primary could have issued: its
+            # replicated watermark is the ceiling for ALL its timestamps
+            # (commits, read snapshots, begins); last_ts + RESERVE covers
+            # a standby attached before watermark events existed
+            srv.clock._last = max(
+                srv.clock._last,
+                self._last_ts + GTSClock.RESERVE,
+                self._watermark,
+            )
+            srv.clock._advance_watermark()
+            srv._next_gxid = self._next_gxid
+            for gid, p in self._prepared.items():
+                info = TxnInfo(
+                    p["gxid"], TxnState.PREPARED, 0, None, gid,
+                    tuple(p["partnodes"]),
+                )
+                srv._txns[p["gxid"]] = info
+                srv._prepared[gid] = info
+            for name, s in self._seqs.items():
+                srv._seqs[name] = _Sequence(
+                    name, s["increment"], s["next_value"],
+                    s.get("min", 1), s.get("max", 2**62),
+                    s.get("cycle", False),
+                )
+                srv._seq_durable[name] = s["next_value"]
+            srv._persist_seqs()
+            self.promoted = srv
+            return srv
+
+
+class ReplicationLink:
+    """Wires a primary GTSServer to one or more standbys (synchronous
+    apply, the default for GTM standby in the reference)."""
+
+    def __init__(self, primary: GTSServer):
+        self.primary = primary
+        self.standbys: list = []
+        self.sent_lsn = 0
+        self._lock = threading.Lock()
+        primary._on_replicate = self._fanout
+
+    def attach(self, sink) -> tuple[dict, int]:
+        """Atomically snapshot the primary and subscribe ``sink`` (any
+        object with .apply(event, payload)): no event can fall between
+        the snapshot and the subscription.
+
+        Lock order matches the fanout path (GTS lock -> link lock): every
+        replicated mutation holds the primary's lock when it reaches
+        _fanout, so freezing the primary first guarantees no _rep is in
+        flight while we snapshot+subscribe — and cannot deadlock."""
+        with self.primary._lock:
+            with self._lock:
+                snap = self.primary.state_snapshot()  # RLock: re-entrant
+                self.standbys.append(sink)
+                return snap, self.sent_lsn
+
+    def detach(self, sink) -> None:
+        with self._lock:
+            if sink in self.standbys:
+                self.standbys.remove(sink)
+
+    def add_standby(self) -> GTSStandby:
+        # same lock order as attach(); the standby must be fully built
+        # before it becomes visible to _fanout
+        with self.primary._lock:
+            with self._lock:
+                sb = GTSStandby(self.primary.state_snapshot())
+                sb.applied_lsn = self.sent_lsn
+                self.standbys.append(sb)
+                return sb
+
+    def _fanout(self, event: str, payload: dict) -> None:
+        with self._lock:
+            self.sent_lsn += 1
+            for sb in self.standbys:
+                sb.apply(event, payload)
+
+    def lag(self, sb: GTSStandby) -> int:
+        with self._lock:
+            return self.sent_lsn - sb.applied_lsn
+
+
+# -- TCP transport (standby in another process) ---------------------------
+
+
+def serve_feed(link: ReplicationLink, host: str = "127.0.0.1",
+               port: int = 0) -> tuple[socket.socket, int, threading.Thread]:
+    """Stream snapshot + events to remote standbys (walsender analog).
+    Returns (listener, port, accept_thread)."""
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind((host, port))
+    lsock.listen(8)
+
+    def pump(conn: socket.socket) -> None:
+        import queue
+
+        q: "queue.Queue[tuple[str, dict]]" = queue.Queue()
+
+        class _QStandby:
+            applied_lsn = 0
+
+            def apply(self, event, payload):  # feed -> socket queue
+                q.put((event, payload))
+
+        qsb = _QStandby()
+        snap, lsn = link.attach(qsb)  # atomic: no event lost in between
+        _send(conn, {"snapshot": snap, "lsn": lsn})
+        try:
+            while True:
+                event, payload = q.get()
+                _send(conn, {"event": event, "payload": payload})
+        except OSError:
+            pass
+        finally:
+            link.detach(qsb)
+
+    def accept_loop() -> None:
+        while True:
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            threading.Thread(target=pump, args=(conn,), daemon=True).start()
+
+    t = threading.Thread(target=accept_loop, daemon=True)
+    t.start()
+    return lsock, lsock.getsockname()[1], t
+
+
+def connect_feed(host: str, port: int) -> tuple["GTSStandby", threading.Thread]:
+    """Remote standby: bootstrap from the streamed snapshot and keep
+    applying events (walreceiver analog)."""
+    sock = socket.create_connection((host, port), timeout=10)
+    first = _recv(sock)
+    sb = GTSStandby(first["snapshot"])
+    sb.applied_lsn = first["lsn"]
+
+    def recv_loop() -> None:
+        try:
+            while True:
+                msg = _recv(sock)
+                if msg is None:
+                    return
+                sb.apply(msg["event"], msg["payload"])
+        except OSError:
+            return
+
+    t = threading.Thread(target=recv_loop, daemon=True)
+    t.start()
+    return sb, t
+
+
+def _send(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv(sock: socket.socket):
+    head = b""
+    while len(head) < 4:
+        chunk = sock.recv(4 - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    (n,) = struct.unpack("<I", head)
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(n - len(body))
+        if not chunk:
+            return None
+        body += chunk
+    return json.loads(body.decode())

@@ -465,9 +465,15 @@ class ClusterPersistence:
         c = self.cluster
         from opentenbase_tpu.storage.table import RESERVED_TS
 
+        import time as _time
+
         for gid, pend in self._pending.items():
             txn = Transaction(pend["gxid"], 0)
             txn.prepared_gid = gid
+            # fresh grace period after recovery: clean2pc must neither
+            # insta-kill recovered in-doubt txns nor treat them as new
+            # forever
+            txn.prepared_at = _time.time()
             for wm in pend["writes"]:
                 store = c.stores[wm["node"]][wm["table"]]
                 tw = txn.w(wm["node"], wm["table"])

@@ -1,0 +1,213 @@
+"""GTM high availability: standby replication + promote + TCP service —
+the gtm_standby.c / replication.c / gtm_ctl-promote surface."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.gtm.client import NativeGTS
+from opentenbase_tpu.gtm.gts import GTSServer
+from opentenbase_tpu.gtm.server import GTSFrontend
+from opentenbase_tpu.gtm.standby import ReplicationLink, connect_feed, serve_feed
+
+
+def test_standby_applies_feed_and_promotes():
+    primary = GTSServer()
+    link = ReplicationLink(primary)
+    sb = link.add_standby()
+
+    info = primary.begin()
+    primary.prepare(info.gxid, "g1", (0, 1))
+    info2 = primary.begin()
+    commit_ts = primary.commit(info2.gxid)
+    primary.create_sequence("s", start=10)
+    primary.nextval("s")
+    assert link.lag(sb) == 0  # synchronous apply
+
+    new_primary = sb.promote()
+    # in-doubt txn survives failover
+    assert [p.gid for p in new_primary.prepared_txns()] == ["g1"]
+    # timestamps never regress across failover
+    assert new_primary.get_gts() > commit_ts
+    # gxids keep ascending
+    assert new_primary.begin().gxid > info2.gxid
+    # sequence continues, never reissues
+    assert new_primary.nextval("s")[0] > 10
+
+
+def test_promoted_clock_jumps_reserve_window():
+    primary = GTSServer()
+    link = ReplicationLink(primary)
+    sb = link.add_standby()
+    ts = primary.commit(primary.begin().gxid)
+    # the old primary may still issue up to RESERVE past its last known
+    # position; the promoted clock must start above that whole window
+    from opentenbase_tpu.gtm.gts import GTSClock
+
+    promoted = sb.promote()
+    assert promoted.get_gts() > ts + GTSClock.RESERVE - 1
+
+
+def test_tcp_feed_remote_standby():
+    primary = GTSServer()
+    link = ReplicationLink(primary)
+    lsock, port, _t = serve_feed(link)
+    try:
+        sb, _rt = connect_feed("127.0.0.1", port)
+        info = primary.begin()
+        primary.prepare(info.gxid, "remote_g", (0,))
+        primary.create_sequence("rs", start=5)
+        import time
+
+        for _ in range(100):  # stream apply is async over TCP
+            if sb.applied_lsn >= link.sent_lsn:
+                break
+            time.sleep(0.02)
+        promoted = sb.promote()
+        assert [p.gid for p in promoted.prepared_txns()] == ["remote_g"]
+        assert promoted.nextval("rs")[0] >= 5
+    finally:
+        lsock.close()
+
+
+def test_frontend_serves_native_wire_protocol():
+    gts = GTSServer()
+    fe = GTSFrontend(gts).start()
+    try:
+        cli = NativeGTS(fe.host, fe.port)
+        assert cli.ping()
+        info = cli.begin()
+        cli.prepare(info.gxid, "wire_g", (0, 2))
+        assert [p.gid for p in cli.prepared_txns()] == ["wire_g"]
+        ts = cli.commit(info.gxid)
+        assert cli.get_gts() > ts
+        cli.create_sequence("ws", start=3)
+        assert cli.nextval("ws") == (3, 3)
+        cli.setval("ws", 100)
+        assert cli.nextval("ws")[0] == 100
+        cli.drop_sequence("ws")
+        with pytest.raises(KeyError):
+            cli.nextval("ws")
+        # duplicate create reports the error across the wire
+        cli.create_sequence("dup")
+        with pytest.raises(ValueError):
+            cli.create_sequence("dup")
+    finally:
+        fe.stop()
+
+
+def test_cluster_failover_to_promoted_standby():
+    """End-to-end failover: cluster keeps serving transactions after the
+    GTM 'crashes' and a standby is promoted in its place."""
+    c = Cluster(num_datanodes=2, shard_groups=32)
+    link = ReplicationLink(c.gts)
+    sb = link.add_standby()
+
+    s = c.session()
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("insert into t values (1),(2)")
+    old_ts = c.gts.clock.current()
+
+    # primary GTM dies; promote the standby and repoint the cluster
+    c.gts = sb.promote()
+    s.execute("insert into t values (3)")
+    assert [x[0] for x in s.query("select k from t order by k")] == [1, 2, 3]
+    # MVCC ordering held: post-failover commits stamped above old ones
+    assert c.gts.clock.current() > old_ts
+
+
+def test_clean2pc_resolves_stale_indoubt(tmp_path):
+    """clean2pc.c / pg_clean: stale prepared txns are rolled back, fresh
+    ones left alone, and the decision is durable."""
+    c = Cluster(num_datanodes=2, shard_groups=32, data_dir=str(tmp_path))
+    s = c.session()
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("begin")
+    s.execute("insert into t values (1)")
+    s.execute("prepare transaction 'stale'")
+    s.execute("begin")
+    s.execute("insert into t values (2)")
+    s.execute("prepare transaction 'fresh'")
+
+    c._prepared["stale"].prepared_at -= 1000  # age it past the threshold
+    resolved = c.clean_2pc(max_age_s=300)
+    assert resolved == ["stale"]
+    assert [p.gid for p in c.gts.prepared_txns()] == ["fresh"]
+
+    s.execute("commit prepared 'fresh'")
+    assert [x[0] for x in s.query("select k from t")] == [2]
+    # the auto-rollback survives recovery
+    r = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=32)
+    assert [x[0] for x in r.session().query("select k from t")] == [2]
+
+
+def test_clean2pc_background_worker():
+    import time
+
+    c = Cluster(num_datanodes=2, shard_groups=32)
+    s = c.session()
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("begin")
+    s.execute("insert into t values (1)")
+    s.execute("prepare transaction 'bg'")
+    c._prepared["bg"].prepared_at -= 1000
+
+    stop = c.start_clean2pc(interval_s=0.05, max_age_s=300)
+    try:
+        for _ in range(100):
+            if not c.gts.prepared_txns():
+                break
+            time.sleep(0.02)
+        assert c.gts.prepared_txns() == []
+        assert s.query("select k from t") == []
+    finally:
+        stop()
+
+
+def test_descending_sequence_replicates_increment():
+    primary = GTSServer()
+    link = ReplicationLink(primary)
+    sb = link.add_standby()
+    primary.create_sequence("down", start=100, increment=-1, min_value=-10**6)
+    issued = [primary.nextval("down")[0] for _ in range(3)]  # 100,99,98
+    promoted = sb.promote()
+    assert promoted.nextval("down")[0] < min(issued)
+
+
+def test_unprepared_gxid_not_reissued_after_promote():
+    primary = GTSServer()
+    link = ReplicationLink(primary)
+    sb = link.add_standby()
+    info = primary.begin()  # ACTIVE, never prepared/committed
+    promoted = sb.promote()
+    assert promoted.begin().gxid > info.gxid
+
+
+def test_concurrent_attach_under_load_no_deadlock_no_loss():
+    """Standbys attaching while txns commit: no deadlock (lock order) and
+    no event falls between snapshot and subscription."""
+    import threading
+
+    primary = GTSServer()
+    link = ReplicationLink(primary)
+    stop = threading.Event()
+    gids = []
+
+    def load():
+        i = 0
+        while not stop.is_set():
+            info = primary.begin()
+            primary.prepare(info.gxid, f"load_{i}", (0,))
+            gids.append(f"load_{i}")
+            i += 1
+
+    t = threading.Thread(target=load)
+    t.start()
+    try:
+        standbys = [link.add_standby() for _ in range(5)]
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not t.is_alive()
+    expected = {p.gid for p in primary.prepared_txns()}
+    for sb in standbys:
+        assert {p.gid for p in sb.promote().prepared_txns()} == expected
