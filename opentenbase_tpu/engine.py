@@ -166,6 +166,9 @@ class Cluster:
         self.paused = False
         self.barriers: list[tuple[str, int]] = []
         self.indexes: dict[str, A.CreateIndex] = {}
+        # interval/range partitioning: parent name -> PartitionSpec
+        # (children are real catalog tables named parent$pK)
+        self.partitions: dict[str, "PartitionSpec"] = {}
         # observability (SURVEY §5): session registry + per-statement stats.
         # Sessions register weakly so short-lived connections don't pin
         # memory or linger forever in pg_stat_cluster_activity.
@@ -478,10 +481,101 @@ class Session:
     def _execute_one(self, stmt: A.Statement) -> Result:
         if self.cluster.paused and not isinstance(stmt, A.UnpauseCluster):
             raise SQLError("cluster is paused")
+        stmt = self._expand_partitions(stmt)
+        if isinstance(stmt, Result):  # fully handled by partition fanout
+            return stmt
         h = getattr(self, f"_x_{type(stmt).__name__.lower()}", None)
         if h is None:
             raise SQLError(f"unsupported statement {type(stmt).__name__}")
         return h(stmt)
+
+    # -- partitioned-table routing/rewrite --------------------------------
+    def _expand_partitions(self, stmt: A.Statement):
+        parts = self.cluster.partitions
+        if not parts:
+            return stmt
+        from opentenbase_tpu.plan.partition import rewrite_select
+
+        if isinstance(stmt, A.Select):
+            return rewrite_select(stmt, parts)
+        if isinstance(stmt, A.ExplainStmt) and isinstance(
+            stmt.query, A.Select
+        ):
+            rewrite_select(stmt.query, parts)
+            return stmt
+        if isinstance(stmt, (A.Update, A.Delete)):
+            # subqueries in the WHERE clause may scan a partitioned parent
+            # regardless of which table the DML targets
+            if stmt.where is not None:
+                from opentenbase_tpu.plan.partition import (
+                    _rewrite_expr_subqueries,
+                )
+
+                _rewrite_expr_subqueries(stmt.where, parts)
+            if stmt.table in parts:
+                if isinstance(stmt, A.Update):
+                    pcol = parts[stmt.table].column
+                    if any(c == pcol for c, _e in stmt.assignments):
+                        raise SQLError(
+                            "updating the partition key (moving rows "
+                            "between partitions) is not supported"
+                        )
+                return self._fanout_dml(stmt, parts[stmt.table])
+            return stmt
+        if isinstance(stmt, A.Insert) and stmt.query is not None:
+            rewrite_select(stmt.query, parts)
+            return stmt
+        if isinstance(stmt, (A.TruncateTable, A.DropTable)):
+            child_names = {
+                ch: p for p, ps in parts.items() for ch in ps.children()
+            }
+            names: list[str] = []
+            for n in stmt.names:
+                if isinstance(stmt, A.DropTable) and n in child_names:
+                    raise SQLError(
+                        f'cannot drop "{n}": it is a partition of '
+                        f'"{child_names[n]}" (drop the parent instead)'
+                    )
+                if n in parts:
+                    names.extend(parts[n].children())
+                    if isinstance(stmt, A.DropTable):
+                        spec = parts.pop(n)
+                        self.cluster.catalog.drop_table(n)
+                        if self.cluster.persistence is not None:
+                            self.cluster.persistence.log_ddl(
+                                {"op": "drop_parent", "name": n}
+                            )
+                else:
+                    names.append(n)
+            import dataclasses
+
+            return dataclasses.replace(stmt, names=names)
+        return stmt
+
+    def _fanout_dml(self, stmt, spec) -> Result:
+        """UPDATE/DELETE on a partitioned parent: run against surviving
+        children inside one transaction (the per-partition ModifyTable
+        expansion of the reference's planner)."""
+        import dataclasses
+
+        keep = spec.prune(stmt.where, {spec.parent})
+        txn, implicit = self._begin_implicit()
+        self.txn = txn
+        total = 0
+        tag = "UPDATE" if isinstance(stmt, A.Update) else "DELETE"
+        try:
+            for i in keep:
+                child = dataclasses.replace(stmt, table=spec.child(i))
+                total += self._execute_one(child).rowcount
+        except Exception:
+            if implicit:
+                self._abort_txn(txn)
+                self.txn = None
+            raise
+        if implicit:
+            self.txn = None
+            self._commit_txn(txn)
+        return Result(tag, rowcount=total)
 
     # -- SELECT ----------------------------------------------------------
     def _x_select(self, stmt: A.Select) -> Result:
@@ -638,7 +732,11 @@ class Session:
         full = self._complete_insert_batch(meta, iplan.columns, src_batch)
         txn, implicit = self._begin_implicit()
         try:
-            n = self._route_and_append(meta, full, txn)
+            spec = self.cluster.partitions.get(iplan.table)
+            if spec is not None:
+                n = self._partition_and_append(spec, full, txn)
+            else:
+                n = self._route_and_append(meta, full, txn)
         except Exception:
             if implicit:
                 self._abort_txn(txn)
@@ -648,6 +746,23 @@ class Session:
         else:
             self.txn = txn
         return Result("INSERT", rowcount=n)
+
+    def _partition_and_append(self, spec, full: ColumnBatch, txn) -> int:
+        """Split the batch by partition boundaries, then shard-route each
+        slice into its child table (locate_shard_insert per partition)."""
+        from opentenbase_tpu.plan.partition import PartitionError
+
+        key = full.columns[spec.column]
+        try:
+            pidx = spec.route(key.data, key.validity)
+        except PartitionError as e:
+            raise SQLError(str(e))
+        n = 0
+        for i in np.unique(pidx):
+            child_meta = self.cluster.catalog.get(spec.child(int(i)))
+            sub = full.take(np.nonzero(pidx == i)[0])
+            n += self._route_and_append(child_meta, sub, txn)
+        return n
 
     def _complete_insert_batch(
         self, meta: TableMeta, columns, src: ColumnBatch
@@ -943,8 +1058,14 @@ class Session:
         for cd in stmt.columns:
             schema[cd.name] = t.type_from_name(cd.type_name, cd.type_args)
         dist = self._dist_spec(stmt, schema)
+        if stmt.partition_by is not None:
+            return self._create_partitioned(stmt, schema, dist)
         meta = cat.create_table(stmt.name, schema, dist)
         self.cluster.create_table_stores(meta)
+        self._log_create_table(stmt.name, schema, dist)
+        return Result("CREATE TABLE")
+
+    def _log_create_table(self, name, schema, dist) -> None:
         p = self.cluster.persistence
         if p is not None:
             from opentenbase_tpu.storage.persist import _type_to_str
@@ -952,12 +1073,53 @@ class Session:
             p.log_ddl(
                 {
                     "op": "create_table",
-                    "name": stmt.name,
+                    "name": name,
                     "schema": {k: _type_to_str(v) for k, v in schema.items()},
                     "strategy": dist.strategy.value,
                     "key_columns": list(dist.key_columns),
                 }
             )
+
+    def _create_partitioned(self, stmt: A.CreateTable, schema, dist) -> Result:
+        """Interval/range partitioning (gram.y:4172): the parent is a
+        catalog-only shell, each partition a real child table."""
+        from opentenbase_tpu.plan.partition import PartitionError, PartitionSpec
+
+        clause = stmt.partition_by
+        col = clause.get("column")
+        if col not in schema:
+            raise SQLError(f'partition column "{col}" does not exist')
+        try:
+            spec = PartitionSpec.build(stmt.name, clause, schema[col])
+        except PartitionError as e:
+            raise SQLError(str(e))
+        cat = self.cluster.catalog
+        parent_meta = cat.create_table(stmt.name, schema, dist)  # shell
+        self.cluster.partitions[stmt.name] = spec
+        p = self.cluster.persistence
+        if p is not None:
+            from opentenbase_tpu.storage.persist import _type_to_str
+
+            # parent first: child replay needs the spec to share dicts
+            p.log_ddl(
+                {
+                    "op": "create_parent",
+                    "name": stmt.name,
+                    "schema": {
+                        k: _type_to_str(v) for k, v in schema.items()
+                    },
+                    "strategy": dist.strategy.value,
+                    "key_columns": list(dist.key_columns),
+                    "partition": spec.spec,
+                }
+            )
+        for child in spec.children():
+            meta = cat.create_table(child, schema, dist)
+            # one logical table: all partitions share the parent's
+            # dictionaries so encoded batches route freely between them
+            meta.dictionaries = parent_meta.dictionaries
+            self.cluster.create_table_stores(meta)
+            self._log_create_table(child, schema, dist)
         return Result("CREATE TABLE")
 
     def _dist_spec(self, stmt: A.CreateTable, schema) -> DistributionSpec:
@@ -1293,12 +1455,18 @@ class Session:
         meta = self.cluster.catalog.get(stmt.table)
         columns = stmt.columns or list(meta.schema.keys())
         if stmt.direction == "to":
+            from opentenbase_tpu.plan.partition import rewrite_select
+
             batch = self._run_select(
-                A.Select(
-                    items=[
-                        A.SelectItem(A.ColumnRef(c, None)) for c in columns
-                    ],
-                    from_clause=A.RelRef(stmt.table, None),
+                rewrite_select(
+                    A.Select(
+                        items=[
+                            A.SelectItem(A.ColumnRef(c, None))
+                            for c in columns
+                        ],
+                        from_clause=A.RelRef(stmt.table, None),
+                    ),
+                    self.cluster.partitions,
                 )
             )
             with open(stmt.target, "w", newline="") as f:
@@ -1342,7 +1510,11 @@ class Session:
         full = self._complete_insert_batch(meta, tuple(columns), batch)
         txn, implicit = self._begin_implicit()
         try:
-            n = self._route_and_append(meta, full, txn)
+            spec = self.cluster.partitions.get(stmt.table)
+            if spec is not None:
+                n = self._partition_and_append(spec, full, txn)
+            else:
+                n = self._route_and_append(meta, full, txn)
         except Exception:
             if implicit:
                 self._abort_txn(txn)
@@ -1423,7 +1595,42 @@ def _sv_stat_tables(c: Cluster):
     return rows
 
 
+def _sv_partitions(c: Cluster):
+    rows = []
+    snap = c.gts.snapshot_ts()
+    for name, ps in c.partitions.items():
+        for i in range(ps.nparts):
+            live = 0
+            child = ps.child(i)
+            for n in c.catalog.get(child).node_indices:
+                store = c.stores.get(n, {}).get(child)
+                if store is None:
+                    continue
+                live += int(
+                    (
+                        (store.xmin_ts[: store.nrows] <= snap)
+                        & (snap < store.xmax_ts[: store.nrows])
+                    ).sum()
+                )
+            rows.append(
+                (name, child, i, int(ps.boundaries[i]),
+                 int(ps.boundaries[i + 1]), live)
+            )
+    return rows
+
+
 _SYSTEM_VIEWS: dict[str, tuple] = {
+    "pg_partitions": (
+        {
+            "parent": t.TEXT,
+            "partition": t.TEXT,
+            "index": t.INT4,
+            "range_lo": t.INT8,
+            "range_hi": t.INT8,
+            "n_live_tup": t.INT8,
+        },
+        _sv_partitions,
+    ),
     "pgxc_node": (
         {
             "node_name": t.TEXT,

@@ -124,7 +124,9 @@ def tokenize(sql: str) -> list[Token]:
             continue
         if c.isalpha() or c == "_":
             j = i
-            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+            # '$' is a valid identifier char after the first (PG scan.l's
+            # ident_cont); partition children are named parent$pK
+            while j < n and (sql[j].isalnum() or sql[j] in "_$"):
                 j += 1
             # Unquoted identifiers fold to lowercase (PG downcase_identifier).
             out.append(Token(Tok.IDENT, sql[i:j].lower(), i))

@@ -311,6 +311,9 @@ class ClusterPersistence:
                 }
                 for gid, txn in getattr(c, "_prepared", {}).items()
             },
+            "partitions": {
+                name: ps.spec for name, ps in c.partitions.items()
+            },
         }
         for name in c.catalog.table_names():
             tm = c.catalog.get(name)
@@ -577,6 +580,27 @@ class ClusterPersistence:
                                 store.row_id[:n] = z["__rowid"]
                                 store.next_row_id = int(z["__rowid"].max()) + 1
                 c.stores.setdefault(node, {})[name] = store
+        from opentenbase_tpu.plan.partition import PartitionSpec
+
+        for name, pclause in meta.get("partitions", {}).items():
+            if c.catalog.has(name):
+                tm = c.catalog.get(name)
+                ps = PartitionSpec.build(
+                    name, pclause, tm.schema[pclause["column"]]
+                )
+                c.partitions[name] = ps
+                # re-share dictionaries: the snapshot restored each child
+                # with its own (equal) copy, but future inserts encode
+                # against the parent's
+                for child in ps.children():
+                    if not c.catalog.has(child):
+                        continue
+                    cm = c.catalog.get(child)
+                    cm.dictionaries = tm.dictionaries
+                    for node in cm.node_indices:
+                        store = c.stores.get(node, {}).get(child)
+                        if store is not None:
+                            store.dictionaries = tm.dictionaries
         # in-doubt txns captured by this checkpoint become pending again;
         # map their stable row ids back to restored positions
         for gid, p in meta.get("prepared", {}).items():
@@ -618,6 +642,17 @@ class ClusterPersistence:
                     tuple(header["key_columns"]),
                 )
                 meta = c.catalog.create_table(header["name"], schema, spec)
+                # partition children share the parent's dictionaries (the
+                # create_parent record replays first and registers it);
+                # exact membership check — a user table merely containing
+                # "$p" must keep its own dictionaries
+                parent = header["name"].split("$p")[0]
+                if (
+                    parent != header["name"]
+                    and parent in c.partitions
+                    and header["name"] in c.partitions[parent].children()
+                ):
+                    meta.dictionaries = c.catalog.get(parent).dictionaries
                 c.create_table_stores(meta)
             elif op == "drop_table":
                 if c.catalog.has(header["name"]):
@@ -630,6 +665,27 @@ class ClusterPersistence:
                         c.stores[n][header["name"]] = ShardStore(
                             meta.schema, meta.dictionaries
                         )
+            elif op == "create_parent":
+                from opentenbase_tpu.plan.partition import PartitionSpec
+
+                if not c.catalog.has(header["name"]):
+                    schema = {
+                        k: _type_from_str(v)
+                        for k, v in header["schema"].items()
+                    }
+                    spec = DistributionSpec(
+                        DistStrategy(header["strategy"]),
+                        tuple(header["key_columns"]),
+                    )
+                    c.catalog.create_table(header["name"], schema, spec)
+                    pclause = header["partition"]
+                    c.partitions[header["name"]] = PartitionSpec.build(
+                        header["name"], pclause, schema[pclause["column"]]
+                    )
+            elif op == "drop_parent":
+                c.partitions.pop(header["name"], None)
+                if c.catalog.has(header["name"]):
+                    c.catalog.drop_table(header["name"])
             elif op == "shardmap":
                 c.shardmap.map = np.asarray(header["map"], dtype=np.int32)
             elif op == "create_node":
