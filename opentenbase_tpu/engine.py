@@ -164,6 +164,12 @@ class Cluster:
             i: {} for i in self.nodes.datanode_indices()
         }
         self.paused = False
+        self.read_only = False  # True on hot standbys (replication.py)
+        # engine-wide statement lock: store mutation assumes one writer at
+        # a time; the net server and standby WAL-apply serialize on it
+        import threading as _threading
+
+        self._exec_lock = _threading.RLock()
         self.barriers: list[tuple[str, int]] = []
         self.indexes: dict[str, A.CreateIndex] = {}
         # interval/range partitioning: parent name -> PartitionSpec
@@ -184,6 +190,20 @@ class Cluster:
             from opentenbase_tpu.storage.persist import ClusterPersistence
 
             self.persistence = ClusterPersistence(self, data_dir)
+            # bridge GTM sequence events into the cluster WAL so hot
+            # standbys (storage/replication.py) replicate sequence state —
+            # the GTM-xlog stream folded into the one cluster log
+            if isinstance(self.gts, GTSServer):
+                p = self.persistence
+
+                def _seq_feed(event: str, payload: dict) -> None:
+                    if event.startswith("seq_") and not p._in_recovery:
+                        p.log_ddl(
+                            {"op": "seq_event", "event": event,
+                             "payload": payload}
+                        )
+
+                self.gts._on_replicate = _seq_feed
 
     @classmethod
     def recover(
@@ -478,9 +498,30 @@ class Session:
         self.cluster.gts.forget(txn.gxid)
 
     # -- dispatch --------------------------------------------------------
+    _READONLY_OK = (
+        A.Select, A.ExplainStmt, A.ShowStmt, A.SetStmt,
+        A.BeginStmt, A.CommitStmt, A.RollbackStmt,
+    )
+
+    def _is_readonly_stmt(self, stmt: A.Statement) -> bool:
+        if isinstance(stmt, self._READONLY_OK):
+            return True
+        # pure reads that live in write-shaped statement classes
+        if isinstance(stmt, A.CopyStmt):
+            return stmt.direction == "to"
+        if isinstance(stmt, A.ExecuteDirect):
+            return True  # _x_executedirect enforces SELECT-only payloads
+        return False
+
     def _execute_one(self, stmt: A.Statement) -> Result:
         if self.cluster.paused and not isinstance(stmt, A.UnpauseCluster):
             raise SQLError("cluster is paused")
+        if self.cluster.read_only and not self._is_readonly_stmt(stmt):
+            # hot standby: queries yes, writes no (errcode 25006)
+            raise SQLError(
+                f"cannot execute {type(stmt).__name__} in a read-only "
+                "(hot standby) cluster"
+            )
         stmt = self._expand_partitions(stmt)
         if isinstance(stmt, Result):  # fully handled by partition fanout
             return stmt

@@ -139,6 +139,9 @@ class ReplicationLink:
         self.standbys: list = []
         self.sent_lsn = 0
         self._lock = threading.Lock()
+        # chain rather than clobber: the engine may already feed sequence
+        # events into the cluster WAL (engine.py's _seq_feed)
+        self._chained = primary._on_replicate
         primary._on_replicate = self._fanout
 
     def attach(self, sink) -> tuple[dict, int]:
@@ -172,6 +175,8 @@ class ReplicationLink:
                 return sb
 
     def _fanout(self, event: str, payload: dict) -> None:
+        if self._chained is not None:
+            self._chained(event, payload)
         with self._lock:
             self.sent_lsn += 1
             for sb in self.standbys:

@@ -34,11 +34,8 @@ class ClusterServer:
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._conn_threads: list[threading.Thread] = []
-        # engine-wide statement lock (see module docstring)
-        self._exec_lock = getattr(cluster, "_exec_lock", None)
-        if self._exec_lock is None:
-            self._exec_lock = threading.RLock()
-            cluster._exec_lock = self._exec_lock
+        # engine-wide statement lock (owned by the Cluster; see docstring)
+        self._exec_lock = cluster._exec_lock
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ClusterServer":

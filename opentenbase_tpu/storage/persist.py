@@ -116,32 +116,39 @@ class WAL:
                 end = nxt
 
     @staticmethod
+    def read_stream(f, decode_arrays: bool = True):
+        """Yield (tag, header, arrays_or_None, end_offset) from any
+        binary file-like positioned at a record boundary. THE one parser
+        of the record format — recovery and streaming replication both
+        sit on it."""
+        while True:
+            head = f.read(5)
+            if len(head) < 5:
+                return
+            length, tag = struct.unpack("<IB", head)
+            if length < 5:
+                return  # torn/zero-filled tail
+            body = f.read(length - 1)
+            if len(body) < length - 1:
+                return  # torn tail: ignore (crash mid-append)
+            (hlen,) = struct.unpack_from("<I", body, 0)
+            header = json.loads(body[4 : 4 + hlen].decode())
+            arrays = None
+            rest = body[4 + hlen :]
+            if rest and decode_arrays:
+                with np.load(io.BytesIO(rest), allow_pickle=False) as z:
+                    arrays = {k: z[k] for k in z.files}
+            yield chr(tag), header, arrays, f.tell()
+
+    @staticmethod
     def read_records(path: str, start: int = 0, decode_arrays: bool = True):
-        """Yield (tag, header, arrays_or_None, end_offset).
-        ``decode_arrays=False`` skips np.load of record payloads — for
-        scans that only need headers (e.g. locating a barrier)."""
+        """Yield (tag, header, arrays_or_None, end_offset) from a WAL
+        file; see read_stream."""
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
             f.seek(start)
-            while True:
-                head = f.read(5)
-                if len(head) < 5:
-                    return
-                length, tag = struct.unpack("<IB", head)
-                if length < 5:
-                    return  # torn/zero-filled tail
-                body = f.read(length - 1)
-                if len(body) < length - 1:
-                    return  # torn tail: ignore (crash mid-append)
-                (hlen,) = struct.unpack_from("<I", body, 0)
-                header = json.loads(body[4 : 4 + hlen].decode())
-                arrays = None
-                rest = body[4 + hlen :]
-                if rest and decode_arrays:
-                    with np.load(io.BytesIO(rest), allow_pickle=False) as z:
-                        arrays = {k: z[k] for k in z.files}
-                yield chr(tag), header, arrays, f.tell()
+            yield from WAL.read_stream(f, decode_arrays)
 
 
 class ClusterPersistence:
@@ -159,6 +166,9 @@ class ClusterPersistence:
         # gid -> {"gxid", "writes": [...]} of replayed-but-undecided 2PC
         # transactions (populated during recover, drained by C/R records)
         self._pending: dict[str, dict] = {}
+        # True while redo is applying records: side-effect feeds (e.g. the
+        # GTM sequence-event bridge) must not re-log what they replay
+        self._in_recovery = False
 
     def sync_dicts(self, table: str) -> None:
         tm = self.cluster.catalog.get(table)
@@ -441,14 +451,18 @@ class ClusterPersistence:
             start = meta["wal_position"]
             self._restore_checkpoint(meta)
         applied = 0
-        for tag, header, arrays, off in WAL.read_records(wal_path, start):
-            if tag == "B":
-                c.barriers.append((header["name"], header["ts"]))
-                if barrier_end is not None and off >= barrier_end:
-                    break
-                continue
-            self._apply(tag, header, arrays)
-            applied += 1
+        self._in_recovery = True
+        try:
+            for tag, header, arrays, off in WAL.read_records(wal_path, start):
+                if tag == "B":
+                    c.barriers.append((header["name"], header["ts"]))
+                    if barrier_end is not None and off >= barrier_end:
+                        break
+                    continue
+                self._apply(tag, header, arrays)
+                applied += 1
+        finally:
+            self._in_recovery = False
         if barrier_end is not None:
             # abandon the old timeline: discard post-barrier WAL and
             # re-checkpoint the rewound state so the next recovery cannot
@@ -665,6 +679,35 @@ class ClusterPersistence:
                         c.stores[n][header["name"]] = ShardStore(
                             meta.schema, meta.dictionaries
                         )
+            elif op == "seq_event":
+                ev, pl = header["event"], header["payload"]
+                g = c.gts
+                try:
+                    if ev == "seq_create":
+                        g.create_sequence(
+                            pl["name"], pl.get("start", 1),
+                            pl.get("increment", 1), pl.get("min", 1),
+                            pl.get("max", 2**62), pl.get("cycle", False),
+                        )
+                    elif ev == "seq_drop":
+                        g.drop_sequence(pl["name"])
+                    elif ev in ("seq_next", "seq_set"):
+                        name = pl["name"]
+                        target = pl.get("next", pl.get("value"))
+                        s = g._seqs.get(name)
+                        if s is not None and target is not None:
+                            advances = (
+                                target > s.next_value
+                                if s.increment >= 0
+                                else target < s.next_value
+                            )
+                            # explicit setval always applies; replayed
+                            # reservations only move forward so redo never
+                            # regresses below gts.json.seq's durable mark
+                            if ev == "seq_set" or advances:
+                                g.setval(name, target)
+                except ValueError:
+                    pass  # create-of-existing on overlap with seq store
             elif op == "create_parent":
                 from opentenbase_tpu.plan.partition import PartitionSpec
 

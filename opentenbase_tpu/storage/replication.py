@@ -1,0 +1,225 @@
+"""Streaming replication: WAL shipping to a hot-standby cluster.
+
+The reference replicates datanodes with walsender/walreceiver streaming
+(src/backend/replication/walsender.c, walreceiver.c) into a hot standby
+that serves read-only queries and can be promoted. The cluster WAL here
+is one ordered file of self-framed records, so the analog is direct:
+
+- ``WalSender``: serves the primary's wal.log over TCP. A connecting
+  standby reports its current end offset; the sender streams every byte
+  from there and keeps tailing the file (poll-based, like the archiver's
+  file watching) until the standby disconnects.
+- ``StandbyCluster``: an empty cluster + walreceiver thread. Incoming
+  bytes append to its own wal.log (durable: the standby can crash and
+  resync) and complete records are applied incrementally — the startup
+  process's continuous redo loop. Read-only sessions see replicated
+  commits immediately (hot standby).
+- ``promote()``: stop the receiver, finish recovery (re-park in-doubt
+  2PC txns), drop read-only — pg_ctl promote.
+
+The standby requests from ITS OWN offset, so restart/resync is just
+reconnecting (the streaming-replication restart_lsn contract).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from opentenbase_tpu.storage.persist import WAL
+
+
+class WalSender:
+    """Primary-side WAL streamer (walsender.c)."""
+
+    def __init__(self, persistence, host: str = "127.0.0.1", port: int = 0,
+                 poll_s: float = 0.05):
+        self.persistence = persistence
+        self.poll_s = poll_s
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(8)
+        self.host, self.port = self._lsock.getsockname()
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._stream, args=(conn,), daemon=True
+            ).start()
+
+    def _stream(self, conn: socket.socket) -> None:
+        path = self.persistence.wal.path
+        try:
+            head = b""
+            while len(head) < 8:  # short TCP reads are normal
+                chunk = conn.recv(8 - len(head))
+                if not chunk:
+                    return
+                head += chunk
+            (offset,) = struct.unpack("<q", head)
+            with open(path, "rb") as f:
+                f.seek(offset)
+                while not self._stop.is_set():
+                    chunk = f.read(1 << 20)
+                    if chunk:
+                        conn.sendall(chunk)
+                    else:
+                        time.sleep(self.poll_s)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class StandbyCluster:
+    """Hot standby: replicated cluster serving read-only queries."""
+
+    def __init__(self, data_dir: str, num_datanodes: int = 2,
+                 shard_groups: int = 256):
+        from opentenbase_tpu.engine import Cluster
+
+        os.makedirs(data_dir, exist_ok=True)
+        self.cluster = Cluster(num_datanodes, shard_groups, data_dir)
+        self.cluster.read_only = True
+        p = self.cluster.persistence
+        # standby redo must not re-log replayed side effects (sequence
+        # events); cleared on promote
+        p._in_recovery = True
+        # replay whatever WAL already exists locally (crash-restart of the
+        # standby itself), but keep in-doubt txns pending until promote
+        self.applied = 0
+        for tag, header, arrays, off in WAL.read_records(p.wal.path):
+            self._apply_one(tag, header, arrays)
+            self.applied = off
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.promoted = False
+
+    # -- walreceiver ------------------------------------------------------
+    def start_replication(self, host: str, port: int) -> "StandbyCluster":
+        self._sock = socket.create_connection((host, port), timeout=10)
+        self._sock.sendall(struct.pack("<q", self.applied))
+        self._thread = threading.Thread(target=self._recv_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _recv_loop(self) -> None:
+        p = self.cluster.persistence
+        buf = b""
+        while not self._stop.is_set():
+            try:
+                chunk = self._sock.recv(1 << 20)
+            except OSError:
+                return
+            if not chunk:
+                return
+            # durable first (walreceiver fsyncs before reporting flush),
+            # then apply complete records
+            p.wal._f.write(chunk)
+            p.wal._f.flush()
+            buf += chunk
+            buf = self._drain(buf)
+
+    def _drain(self, buf: bytes) -> bytes:
+        """Apply every complete record in ``buf``; return the unconsumed
+        tail. ``applied`` tracks the absolute WAL offset, which is the
+        buffer's start plus whatever we consume here."""
+        import io
+
+        consumed = 0
+        for tag, header, arrays, off in WAL.read_stream(io.BytesIO(buf)):
+            # apply under the cluster's statement lock so hot-standby
+            # readers never observe a half-applied atomic frame
+            with self.cluster._exec_lock:
+                self._apply_one(tag, header, arrays)
+            consumed = off
+        self.applied += consumed
+        return buf[consumed:]
+
+    def _apply_one(self, tag, header, arrays) -> None:
+        c = self.cluster
+        p = c.persistence
+        if tag == "B":
+            c.barriers.append((header["name"], header["ts"]))
+        else:
+            p._apply(tag, header, arrays)
+
+    # -- client surface ---------------------------------------------------
+    def session(self):
+        """Read-only session whose statements run under the cluster's
+        statement lock, excluding in-flight WAL apply (hot-standby query
+        vs. redo interlock, standby.c's recovery conflict handling made
+        simple)."""
+        inner = self.cluster.session()
+        lock = self.cluster._exec_lock
+
+        class _LockedSession:
+            def execute(self, sql):
+                with lock:
+                    return inner.execute(sql)
+
+            def query(self, sql):
+                return self.execute(sql).rows
+
+        return _LockedSession()
+
+    def lag_bytes(self, primary_persistence) -> int:
+        return primary_persistence.wal.position - self.applied
+
+    def wait_caught_up(self, primary_persistence, timeout_s: float = 10.0) -> bool:
+        t0 = time.time()
+        while time.time() - t0 < timeout_s:
+            if self.lag_bytes(primary_persistence) <= 0:
+                return True
+            time.sleep(0.02)
+        return False
+
+    # -- failover ---------------------------------------------------------
+    def promote(self):
+        """pg_ctl promote: finish recovery and go read-write."""
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        p = self.cluster.persistence
+        p._finish_recovery()  # re-park in-doubt 2PC txns, prime dict sync
+        p._in_recovery = False
+        self.cluster.read_only = False
+        self.promoted = True
+        return self.cluster
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
