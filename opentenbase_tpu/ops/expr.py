@@ -91,6 +91,24 @@ class CodeMaskParam:
 
 
 @dataclass(frozen=True)
+class StrTransformParam:
+    """Per-code table applying a host string function over dictionary
+    ``src``'s values, padded to a power of two. For TEXT-valued functions
+    (upper/substr/lpad/...) ``dst`` names the dictionary the results are
+    encoded into (int32 codes); for scalar-valued ones (length/instr/
+    to_date/...) ``dst`` is None and ``out_dtype`` names the numpy dtype.
+    This is how string compute stays off the device entirely: the TPU
+    only gathers through the table (ruleutils-style host eval fused as a
+    lookup — SURVEY §7 'keep raw-string ops on host')."""
+
+    src: str
+    dst: object  # str | None
+    fn: str
+    args: tuple = ()
+    out_dtype: str = "int32"
+
+
+@dataclass(frozen=True)
 class ScalarConstParam:
     """A lifted numeric/date literal bound at call time instead of baked
     into the trace — lets one compiled program serve every query that
@@ -456,8 +474,12 @@ class ExprCompiler:
 
         did = self._expr_dict_id(col_e, dids)
         if did is None:
-            raise NotImplementedError("TEXT comparison without dictionary")
-        cf = self._c(col_e, dids)
+            # computed text (e.g. upper(col)): canonicalize its codes
+            # through the literal dictionary, then compare codes there
+            did = LITERAL_DICT
+            cf = self._c(col_e, dids, LITERAL_DICT)
+        else:
+            cf = self._c(col_e, dids)
         value = const_e.value
         if value is None:
             def run_nullcmp(cols, params):
@@ -569,6 +591,10 @@ class ExprCompiler:
         import jax.numpy as jnp
 
         name = e.name
+        if name in _HOST_TEXT_FNS:
+            # compiled separately: argument compilation differs (codes in
+            # the SOURCE dictionary, not the output one)
+            return self._text_func(e, dids, want)
         # propagate the target dictionary through value-passing functions
         vwant = (want or LITERAL_DICT) if e.type.is_text else None
         argfs = [self._c(a, dids, vwant) for a in e.args]
@@ -689,7 +715,170 @@ class ExprCompiler:
                 return (jnp.power(ad, bd), _and_valid(av, bv))
             return run_pow
 
+        if name == "trunc_num":
+            digits = 0
+            if len(e.args) > 1 and isinstance(e.args[1], E.Const):
+                digits = int(e.args[1].value)
+            factor = 10.0 ** digits
+
+            def run_trunc(cols, params):
+                d, v = argfs[0](cols, params)
+                if digits == 0:
+                    return (jnp.trunc(d), v)
+                return (jnp.trunc(d * factor) / factor, v)
+            return run_trunc
+
+        if name == "bitand":
+            def run_bitand(cols, params):
+                ad, av = argfs[0](cols, params)
+                bd, bv = argfs[1](cols, params)
+                return (ad & bd, _and_valid(av, bv))
+            return run_bitand
+
+        if name == "nanvl":
+            def run_nanvl(cols, params):
+                ad, av = argfs[0](cols, params)
+                bd, bv = argfs[1](cols, params)
+                nan = jnp.isnan(ad)
+                return (
+                    jnp.where(nan, bd, ad),
+                    av if bv is None else jnp.where(nan, bv, av if av is not None else jnp.ones_like(nan)),
+                )
+            return run_nanvl
+
+        if name == "add_months":
+            is_ts = e.args[0].type.id == t.TypeId.TIMESTAMP
+            US_DAY = np.int64(86_400_000_000)
+
+            def run_add_months(cols, params):
+                d, v = argfs[0](cols, params)
+                nd, nv = argfs[1](cols, params)
+                days = (d // US_DAY).astype(jnp.int32) if is_ts else d.astype(jnp.int32)
+                rem = (d - days.astype(jnp.int64) * US_DAY) if is_ts else None
+                y, m, dd = _civil_from_days(days, jnp)
+                total = y * 12 + (m - 1) + nd.astype(jnp.int32)
+                ny, nm = total // 12, total % 12 + 1
+                # clamp to the target month's length (Oracle semantics)
+                nxt = jnp.where(nm == 12, ny + 1, ny)
+                nxm = jnp.where(nm == 12, 1, nm + 1)
+                month_len = (
+                    _days_from_civil(nxt, nxm, jnp.ones_like(nm), jnp)
+                    - _days_from_civil(ny, nm, jnp.ones_like(nm), jnp)
+                )
+                cd = jnp.minimum(dd, month_len)
+                out = _days_from_civil(ny, nm, cd, jnp)
+                if is_ts:
+                    out = out.astype(jnp.int64) * US_DAY + rem
+                else:
+                    out = out.astype(jnp.int32)
+                return (out, _and_valid(v, nv))
+            return run_add_months
+
+        if name == "months_between":
+            def run_mb(cols, params):
+                ad, av = argfs[0](cols, params)
+                bd, bv = argfs[1](cols, params)
+                days1, days2 = ad.astype(jnp.int32), bd.astype(jnp.int32)
+                y1, m1, d1 = _civil_from_days(days1, jnp)
+                y2, m2, d2 = _civil_from_days(days2, jnp)
+
+                def month_len(y, m):
+                    ny = jnp.where(m == 12, y + 1, y)
+                    nm = jnp.where(m == 12, 1, m + 1)
+                    one = jnp.ones_like(m)
+                    return _days_from_civil(ny, nm, one, jnp) - _days_from_civil(
+                        y, m, one, jnp
+                    )
+
+                # Oracle: whole number when same day-of-month OR both are
+                # the last days of their months
+                whole = (d1 == d2) | (
+                    (d1 == month_len(y1, m1)) & (d2 == month_len(y2, m2))
+                )
+                frac = jnp.where(whole, 0.0, (d1 - d2) / 31.0)
+                out = ((y1 - y2) * 12.0 + (m1 - m2) + frac).astype(
+                    jnp.float32
+                )
+                return (out, _and_valid(av, bv))
+            return run_mb
+
+        if name == "last_day":
+            def run_last_day(cols, params):
+                d, v = argfs[0](cols, params)
+                y, m, _dd = _civil_from_days(d.astype(jnp.int32), jnp)
+                ny = jnp.where(m == 12, y + 1, y)
+                nm = jnp.where(m == 12, 1, m + 1)
+                out = _days_from_civil(ny, nm, jnp.ones_like(nm), jnp) - 1
+                return (out.astype(jnp.int32), v)
+            return run_last_day
+
+        if name in ("trunc_date_day", "trunc_date_month", "trunc_date_year"):
+            unit = name.rsplit("_", 1)[1]
+
+            def run_trunc_date(cols, params):
+                d, v = argfs[0](cols, params)
+                days = d.astype(jnp.int32)
+                if unit == "day":
+                    return (days, v)
+                y, m, _dd = _civil_from_days(days, jnp)
+                if unit == "month":
+                    out = _days_from_civil(y, m, jnp.ones_like(m), jnp)
+                else:
+                    one = jnp.ones_like(y)
+                    out = _days_from_civil(y, one, one, jnp)
+                return (out.astype(jnp.int32), v)
+            return run_trunc_date
+
         raise NotImplementedError(f"function {name}")
+
+    # -- host-evaluated text functions (dictionary transforms) -----------
+    def _text_func(self, e: E.FuncE, dids, want) -> CompiledExpr:
+        import jax.numpy as jnp
+
+        name = e.name
+        textual = e.type.is_text
+        # the transform table is built over the codes the first argument
+        # actually carries: a bare column keeps its own dictionary, any
+        # composed text expression is canonicalized through the target
+        src = self._text_src_did(e.args[0], dids)
+        if src is None:
+            src = want or LITERAL_DICT
+            argf = self._c(e.args[0], dids, src)
+        else:
+            argf = self._c(e.args[0], dids, None)
+        extra = []
+        for a in e.args[1:]:
+            if not isinstance(a, E.Const):
+                raise NotImplementedError(
+                    f"{name}: non-constant arguments beyond the first"
+                )
+            extra.append(a.value)
+        dst = (want or LITERAL_DICT) if textual else None
+        out_dtype = "int32"
+        if not textual:
+            out_dtype = {
+                t.TypeId.TIMESTAMP: "int64", t.TypeId.FLOAT8: "float64",
+            }.get(e.type.id, "int32")
+        pi = self._param(
+            StrTransformParam(src, dst, name, tuple(extra), out_dtype)
+        )
+
+        def run_text(cols, params):
+            d, v = argf(cols, params)
+            tbl, tvalid = params[pi]
+            idx = jnp.clip(d, 0, tbl.shape[0] - 1)
+            return (tbl[idx], _and_valid(v, tvalid[idx]))
+
+        return run_text
+
+    @staticmethod
+    def _text_src_did(a: E.TExpr, dids):
+        if isinstance(a, E.Col):
+            did = dids[a.index] if a.index < len(dids) else None
+            return did or LITERAL_DICT
+        if isinstance(a, E.Const):
+            return LITERAL_DICT
+        return None
 
     def _case(self, e: E.CaseE, dids, want=None) -> CompiledExpr:
         import jax.numpy as jnp
@@ -843,6 +1032,80 @@ def _like_to_regex(pattern: str) -> str:
     return "^" + "".join(out) + "$"
 
 
+def _py_pad(s: str, n, fill=" ", left=True):
+    n = int(n)
+    if n <= 0:
+        return None  # Oracle: NULL for non-positive target length
+    fill = str(fill) or " "
+    if len(s) >= n:
+        return s[:n]
+    pad = (fill * ((n - len(s)) // len(fill) + 1))[: n - len(s)]
+    return pad + s if left else s + pad
+
+
+def _py_substr(s: str, start, length=None) -> str:
+    start = int(start)
+    if start > 0:
+        i = start - 1
+    elif start == 0:
+        i = 0
+    else:
+        i = max(len(s) + start, 0)
+    if length is None:
+        return s[i:]
+    return s[i : i + max(int(length), 0)]
+
+
+def _py_instr(s: str, sub, start=1) -> int:
+    sub, start = str(sub), int(start)
+    if start < 0:
+        # Oracle: negative position searches backward; the match must
+        # START at or before len(s)+start
+        return s.rfind(sub, 0, len(s) + start + 1) + 1
+    return s.find(sub, max(start - 1, 0)) + 1
+
+
+def _py_to_date(s: str) -> int:
+    import datetime as _dt
+
+    d = _dt.date.fromisoformat(s.strip()[:10])
+    return (d - _dt.date(1970, 1, 1)).days
+
+
+def _py_to_timestamp(s: str) -> int:
+    import datetime as _dt
+
+    dt = _dt.datetime.fromisoformat(s.strip())
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+    return int((dt - epoch).total_seconds() * 1_000_000)
+
+
+# Host implementations of dictionary-transform functions. Each takes the
+# string value plus the (constant) extra args and returns the new value.
+_HOST_TEXT_FNS = {
+    "upper": lambda s: s.upper(),
+    "lower": lambda s: s.lower(),
+    "initcap": lambda s: s.title(),
+    "reverse": lambda s: s[::-1],
+    "trim": lambda s, ch=None: s.strip(ch),
+    "ltrim": lambda s, ch=None: s.lstrip(ch),
+    "rtrim": lambda s, ch=None: s.rstrip(ch),
+    "replace": lambda s, a, b: s.replace(str(a), str(b)),
+    "substr": _py_substr,
+    "substring": _py_substr,
+    "lpad": lambda s, n, fill=" ": _py_pad(s, n, fill, left=True),
+    "rpad": lambda s, n, fill=" ": _py_pad(s, n, fill, left=False),
+    "length": len,
+    "char_length": len,
+    "instr": _py_instr,
+    "to_number": lambda s: float(s),
+    "to_date": _py_to_date,
+    "to_timestamp": _py_to_timestamp,
+}
+
+
 def resolve_param(spec: ParamSpec, dictionaries, subquery_values=None):
     """Compute the runtime value of a ParamSpec.
 
@@ -870,6 +1133,39 @@ def resolve_param(spec: ParamSpec, dictionaries, subquery_values=None):
         if src.values:
             table[: len(src.values)] = dst.encode(list(src.values))
         return jnp.asarray(table)
+
+    if isinstance(spec, StrTransformParam):
+        src = dictionaries[spec.src]
+        fn = _HOST_TEXT_FNS[spec.fn]
+        # per-value evaluation with try_cast semantics: the table covers
+        # EVERY dictionary entry, including '' NULL placeholders and
+        # values belonging to rows a WHERE clause would filter out —
+        # failing the whole query on those would be wrong, so failures
+        # become NULL (validity table ANDed in by the kernel)
+        outs, ok = [], []
+        for sv in src.values:
+            try:
+                r = fn(sv, *spec.args)
+            except (ValueError, TypeError, OverflowError):
+                r = None
+            outs.append(r)
+            ok.append(r is not None)
+        n = max(_next_pow2(len(src.values)), 1)
+        valid = np.zeros(n, dtype=np.bool_)
+        valid[: len(ok)] = ok
+        if spec.dst is not None:  # TEXT output: encode into dst
+            dst = dictionaries[spec.dst]
+            table = np.zeros(n, dtype=np.int32)
+            if outs:
+                table[: len(outs)] = dst.encode(
+                    [str(o) if o is not None else "" for o in outs]
+                )
+        else:
+            table = np.zeros(n, dtype=np.dtype(spec.out_dtype))
+            for i, o in enumerate(outs):
+                if o is not None:
+                    table[i] = o
+        return (jnp.asarray(table), jnp.asarray(valid))
 
     if isinstance(spec, CodeMaskParam):
         d = dictionaries[spec.dict_id]

@@ -1053,6 +1053,9 @@ class Analyzer:
         if name == "sqrt":
             _need(args, 1, name)
             return E.FuncE("sqrt", (_cast(args[0], t.FLOAT8),), t.FLOAT8)
+        if name == "sign":
+            _need(args, 1, name)
+            return E.FuncE("sign", (_cast(args[0], t.FLOAT8),), t.FLOAT8)
         if name == "power" or name == "pow":
             _need(args, 2, name)
             return E.FuncE(
@@ -1102,7 +1105,151 @@ class Analyzer:
             return E.FuncE("now", (), t.TIMESTAMP)
         if name == "interval":
             raise AnalyzeError("interval only valid in +/- arithmetic")
+        out = self._oracle_func(name, args)
+        if out is not None:
+            return out
         raise AnalyzeError(f"unknown function {name}")
+
+    def _oracle_func(
+        self, name: str, args: tuple[E.TExpr, ...]
+    ) -> Optional[E.TExpr]:
+        """Oracle-compatibility shims (src/backend/oracle: others.c nvl2/
+        decode/bitand/lnnvl/nanvl, datefce.c add_months/months_between/
+        last_day, plvstr.c instr/lpad/rpad ...). Each lowers to existing
+        typed-expression machinery, so kernels stay generic."""
+        if name == "nvl2":
+            _need(args, 3, name)
+            a, b, c = args
+            ty = b.type
+            if c.type != ty:
+                if c.type.is_numeric and ty.is_numeric:
+                    ty = t.common_numeric_type(ty, c.type)
+                elif not (isinstance(c, E.Const) and c.value is None):
+                    raise AnalyzeError("nvl2 branches must share a type")
+            return E.CaseE(
+                ((E.IsNullE(a, True), _cast(b, ty)),), _cast(c, ty), ty
+            )
+        if name == "decode":
+            if len(args) < 3:
+                raise AnalyzeError("decode needs expr, search, result, ...")
+            expr0, rest = args[0], list(args[1:])
+            default = rest.pop() if len(rest) % 2 == 1 else None
+            results = rest[1::2]
+            ty = results[0].type
+            for r in results[1:]:
+                if r.type != ty and r.type.is_numeric and ty.is_numeric:
+                    ty = t.common_numeric_type(ty, r.type)
+            if default is not None and default.type != ty:
+                if default.type.is_numeric and ty.is_numeric:
+                    ty = t.common_numeric_type(ty, default.type)
+            def decode_cond(search: E.TExpr) -> E.TExpr:
+                # Oracle decode: NULL search matches NULL expr (others.c),
+                # unlike SQL 3-valued '='
+                if isinstance(search, E.Const) and search.value is None:
+                    return E.IsNullE(expr0, False)
+                return self._make_cmp("=", expr0, search)
+
+            whens = tuple(
+                (decode_cond(rest[i]), _cast(rest[i + 1], ty))
+                for i in range(0, len(rest), 2)
+            )
+            return E.CaseE(
+                whens, _cast(default, ty) if default is not None else None, ty
+            )
+        if name == "instr":
+            if len(args) not in (2, 3):
+                raise AnalyzeError("instr(text, text [, start])")
+            if args[0].type.id != t.TypeId.TEXT:
+                raise AnalyzeError("instr requires text")
+            return E.FuncE("instr", args, t.INT4)
+        if name in ("lpad", "rpad", "initcap", "reverse"):
+            if name in ("initcap", "reverse"):
+                _need(args, 1, name)
+            elif len(args) not in (2, 3):
+                raise AnalyzeError(f"{name}(text, length [, fill])")
+            if args[0].type.id != t.TypeId.TEXT:
+                raise AnalyzeError(f"{name} requires text")
+            return E.FuncE(name, args, t.TEXT)
+        if name == "add_months":
+            _need(args, 2, name)
+            if args[0].type.id not in (t.TypeId.DATE, t.TypeId.TIMESTAMP):
+                raise AnalyzeError("add_months requires date/timestamp")
+            return E.FuncE(
+                "add_months", (args[0], _cast(args[1], t.INT4)), args[0].type
+            )
+        if name == "months_between":
+            _need(args, 2, name)
+            return E.FuncE(
+                "months_between",
+                (_cast(args[0], t.DATE), _cast(args[1], t.DATE)),
+                t.FLOAT8,
+            )
+        if name == "last_day":
+            _need(args, 1, name)
+            return E.FuncE("last_day", (_cast(args[0], t.DATE),), t.DATE)
+        if name == "trunc":
+            if not args or len(args) > 2:
+                raise AnalyzeError("trunc(value [, unit_or_digits])")
+            if args[0].type.is_numeric:
+                extra = ()
+                if len(args) == 2:
+                    if not isinstance(args[1], E.Const):
+                        raise AnalyzeError("trunc digits must be a constant")
+                    extra = (args[1],)
+                return E.FuncE(
+                    "trunc_num", (_cast(args[0], t.FLOAT8),) + extra, t.FLOAT8
+                )
+            unit = "day"
+            if len(args) == 2:
+                if not (isinstance(args[1], E.Const)
+                        and isinstance(args[1].value, (str, int))):
+                    raise AnalyzeError("trunc unit must be a constant")
+                u = str(args[1].value).lower()
+                unit = {"mm": "month", "month": "month", "mon": "month",
+                        "yyyy": "year", "yy": "year", "year": "year",
+                        "dd": "day", "day": "day", "ddd": "day"}.get(u)
+                if unit is None:
+                    raise AnalyzeError(f"unknown trunc unit {u!r}")
+            return E.FuncE(
+                f"trunc_date_{unit}", (_cast(args[0], t.DATE),), t.DATE
+            )
+        if name == "bitand":
+            _need(args, 2, name)
+            return E.FuncE(
+                "bitand",
+                (_cast(args[0], t.INT8), _cast(args[1], t.INT8)),
+                t.INT8,
+            )
+        if name == "lnnvl":
+            _need(args, 1, name)
+            cond = _bool_type(args[0])
+            return E.BinE(
+                "or", E.UnaryE("not", cond, t.BOOL),
+                E.IsNullE(args[0], False), t.BOOL,
+            )
+        if name == "nanvl":
+            _need(args, 2, name)
+            return E.FuncE(
+                "nanvl",
+                (_cast(args[0], t.FLOAT8), _cast(args[1], t.FLOAT8)),
+                t.FLOAT8,
+            )
+        if name in ("to_date", "to_timestamp"):
+            _need(args, 1, name)
+            ty = t.DATE if name == "to_date" else t.TIMESTAMP
+            if isinstance(args[0], E.Const):
+                return _cast(args[0], ty)
+            if args[0].type.id != t.TypeId.TEXT:
+                raise AnalyzeError(f"{name} requires text")
+            return E.FuncE(name, (args[0],), ty)
+        if name == "to_number":
+            _need(args, 1, name)
+            if isinstance(args[0], E.Const):
+                return _cast(args[0], t.FLOAT8)
+            if args[0].type.id != t.TypeId.TEXT:
+                raise AnalyzeError("to_number requires text")
+            return E.FuncE("to_number", (args[0],), t.FLOAT8)
+        return None
 
     def _agg_call(self, e: A.FuncCall, ctx: ExprContext) -> E.TExpr:
         if ctx.grouped is None:

@@ -1,0 +1,148 @@
+"""Scalar-function surface: text functions as dictionary transforms and
+the Oracle-compatibility shims (src/backend/oracle: others.c, datefce.c,
+plvstr.c)."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+
+
+@pytest.fixture(scope="module")
+def s():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    sess = c.session()
+    sess.execute(
+        "create table t (k bigint, v text, x float8, d date)"
+        " distribute by shard(k)"
+    )
+    sess.execute(
+        "insert into t values"
+        " (1,'héllo world',1.5,'2024-01-31'),"
+        " (2,null,-2.75,'2024-02-29'),"
+        " (3,'Abc',0.0,'2023-12-15')"
+    )
+    return sess
+
+
+def test_text_functions(s):
+    rows = s.query(
+        "select upper(v), lower(v), substr(v, 1, 5), length(v),"
+        " replace(v, 'o', '0'), reverse(v), initcap(v)"
+        " from t where k = 1"
+    )
+    assert rows == [(
+        "HÉLLO WORLD", "héllo world", "héllo", 11,
+        "héll0 w0rld", "dlrow olléh", "Héllo World",
+    )]
+    # NULL propagates
+    assert s.query("select upper(v) from t where k = 2") == [(None,)]
+
+
+def test_pad_trim_instr(s):
+    rows = s.query(
+        "select lpad(v, 5, '*'), rpad(v, 5, '.'), instr(v, 'b'),"
+        " trim(v) from t where k = 3"
+    )
+    assert rows == [("**Abc", "Abc..", 2, "Abc")]
+    assert s.query("select instr(v, 'zz') from t where k = 3") == [(0,)]
+
+
+def test_nvl_nvl2_decode(s):
+    assert s.query("select nvl(v, 'missing') from t where k = 2") == [("missing",)]
+    rows = s.query(
+        "select nvl2(v, 'has', 'none') from t order by k"
+    )
+    assert [r[0] for r in rows] == ["has", "none", "has"]
+    rows = s.query(
+        "select decode(k, 1, 'one', 2, 'two', 'other') from t order by k"
+    )
+    assert [r[0] for r in rows] == ["one", "two", "other"]
+    assert s.query("select decode(k, 9, 'x') from t where k = 1") == [(None,)]
+
+
+def test_numeric_oracle_funcs(s):
+    assert s.query("select trunc(x) from t where k = 2") == [(-2.0,)]
+    assert s.query("select sign(x) from t where k = 2") == [(-1.0,)]
+    assert s.query("select bitand(12, 10) from t where k = 1") == [(8,)]
+    assert s.query("select nanvl(x, 99.0) from t where k = 1") == [(1.5,)]
+    assert s.query("select to_number('42.5') from t where k = 1") == [(42.5,)]
+
+
+def test_date_oracle_funcs(s):
+    rows = s.query(
+        "select add_months(d, 1), last_day(d), trunc(d, 'MM'),"
+        " months_between(d, date '2023-12-31') from t where k = 1"
+    )
+    am, ld, tr, mb = rows[0]
+    # dates deliver as ISO strings (Column.to_python convention)
+    assert am == "2024-02-29"  # day-clamped (Oracle)
+    assert ld == "2024-01-31"
+    assert tr == "2024-01-01"
+    assert mb == pytest.approx(1.0, abs=0.01)
+    assert s.query(
+        "select to_date('2024-03-05') from t where k = 1"
+    ) == [("2024-03-05",)]
+
+
+def test_text_fn_in_where_and_group_by(s):
+    assert s.query(
+        "select k from t where upper(v) = 'ABC'"
+    ) == [(3,)]
+    rows = s.query(
+        "select length(v), count(*) from t where v is not null"
+        " group by length(v) order by length(v)"
+    )
+    assert rows == [(3, 1), (11, 1)]
+
+
+def test_lnnvl(s):
+    # lnnvl(cond): true when cond is false OR null (others.c)
+    rows = s.query("select k from t where lnnvl(v = 'Abc') order by k")
+    assert [r[0] for r in rows] == [1, 2]
+
+
+def test_try_cast_semantics_on_bad_values(s):
+    """to_date/to_number over a column NULL out unparseable entries
+    instead of failing the query (the table covers every dictionary
+    value, including rows a WHERE clause filters out)."""
+    s.execute(
+        "create table raw (k bigint, sv text) distribute by shard(k)"
+    )
+    s.execute(
+        "insert into raw values (1,'2024-03-05'),(2,'not-a-date'),(3,null)"
+    )
+    rows = s.query("select k, to_date(sv) from raw order by k")
+    assert rows == [(1, "2024-03-05"), (2, None), (3, None)]
+    rows = s.query("select to_number(sv) from raw order by k")
+    assert [r[0] for r in rows] == [None, None, None]
+
+
+def test_decode_null_matches_null(s):
+    rows = s.query(
+        "select decode(v, null, 'is_null', 'has') from t order by k"
+    )
+    assert [r[0] for r in rows] == ["has", "is_null", "has"]
+
+
+def test_trunc_digits_and_instr_negative(s):
+    assert s.query("select trunc(123.456, 2) from t where k = 1") == [
+        (pytest.approx(123.45, abs=1e-6),)
+    ]
+    s.execute("create table s6 (k bigint, sv text) distribute by shard(k)")
+    s.execute("insert into s6 values (1,'abcabc')")
+    assert s.query("select instr(sv, 'a', -1) from s6") == [(4,)]
+
+
+def test_pad_oracle_semantics(s):
+    s.execute("create table p1 (k bigint, sv text) distribute by shard(k)")
+    s.execute("insert into p1 values (1,'x')")
+    assert s.query("select lpad(sv, 5, 'ab') from p1") == [("ababx",)]
+    assert s.query("select lpad(sv, -1) from p1") == [(None,)]
+
+
+def test_months_between_whole_month_rule(s):
+    # both operands are the last days of their months -> whole number
+    assert s.query(
+        "select months_between(date '2020-03-31', date '2020-02-29')"
+        " from t where k = 1"
+    ) == [(1.0,)]
