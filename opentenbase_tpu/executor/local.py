@@ -514,6 +514,243 @@ class LocalExecutor:
         )
         return DevBatch(plan.schema, cols, mask, child.n)
 
+    def _eval_window(self, plan: L.Window) -> DevBatch:
+        """nodeWindowAgg: host-vectorized (numpy lexsort + segmented
+        scans) over the padded batch — window shapes are inherently
+        data-dependent, so this stays on the coordinator/DN host; results
+        are written back in the original row order."""
+        child = self.eval(plan.child)
+        n = child.n
+        mask = (
+            np.ones(n, dtype=bool)
+            if child.mask is None
+            else np.asarray(child.mask)
+        )
+        live = np.nonzero(mask)[0]
+        host_cols = [
+            (np.asarray(d), None if v is None else np.asarray(v))
+            for d, v in child.cols
+        ]
+        out_cols = list(child.cols)
+        for spec in plan.specs:
+            data, valid = self._window_one(
+                spec, host_cols, live, n, plan.child.schema
+            )
+            out_cols.append((jnp.asarray(data), jnp.asarray(valid)))
+        return DevBatch(plan.schema, out_cols, child.mask, n)
+
+    def _window_key(self, col: int, schema, host_cols, rows):
+        """(comparable values, isnull) for a key column over ``rows`` —
+        TEXT keys compare by sorted-dictionary rank, exactly as
+        _sort_key_arrays does for ORDER BY."""
+        d, v = host_cols[col]
+        vals = d[rows]
+        isnull = (
+            np.zeros(len(rows), dtype=bool) if v is None else ~v[rows]
+        )
+        oc = schema[col]
+        if oc.type.is_text and oc.dict_id is not None:
+            ranks = np.asarray(self._dict_ranks(oc.dict_id))
+            vals = ranks[np.clip(vals, 0, len(ranks) - 1)]
+        return vals, isnull
+
+    def _window_one(self, spec: L.WinSpec, host_cols, live, n, schema):
+        """Compute one window column over the live rows."""
+        m = len(live)
+        oty = spec.out.type
+        out = np.zeros(n, dtype=oty.np_dtype)
+        outv = np.zeros(n, dtype=bool)
+        if m == 0:
+            return out, outv
+        # sort live rows by (partition, order keys); numpy lexsort is
+        # stable, takes keys least-significant first, and NULL keys sort
+        # via an explicit flag (PG: NULLS LAST asc / FIRST desc), never by
+        # their padded storage value
+        lex: list[np.ndarray] = []
+        for col, desc in reversed(spec.order):
+            k, isnull = self._window_key(col, schema, host_cols, live)
+            if desc:
+                k = -k.astype(np.int64) if k.dtype.kind in "iu" else -k.astype(np.float64)
+                flag = ~isnull  # NULLS FIRST
+            else:
+                flag = isnull  # NULLS LAST
+            lex.append(k)
+            lex.append(flag)
+        for col in reversed(spec.partition):
+            k, isnull = self._window_key(col, schema, host_cols, live)
+            lex.append(k)
+            lex.append(isnull)
+        perm = np.lexsort(lex) if lex else np.arange(m)
+        srows = live[perm]
+
+        def boundary(cols_idx, base):
+            nb = base.copy()
+            nb[0] = True
+            for c in cols_idx:
+                k, isnull = self._window_key(c, schema, host_cols, srows)
+                nb[1:] |= (k[1:] != k[:-1]) & ~(isnull[1:] & isnull[:-1])
+                nb[1:] |= isnull[1:] != isnull[:-1]
+            return nb
+
+        newpart = boundary(spec.partition, np.zeros(m, dtype=bool))
+        part_id = np.cumsum(newpart) - 1
+        part_start = np.maximum.accumulate(
+            np.where(newpart, np.arange(m), 0)
+        )
+        pos = np.arange(m) - part_start  # 0-based position in partition
+
+        # peer groups: same partition AND same order-key values
+        newpeer = (
+            boundary([c for c, _d in spec.order], newpart)
+            if spec.order
+            else newpart.copy()
+        )
+
+        kind = spec.kind
+        if kind == "row_number":
+            vals = pos + 1
+            valid = np.ones(m, dtype=bool)
+        elif kind in ("rank", "dense_rank"):
+            if kind == "rank":
+                vals = self._rank_from(newpeer, pos)
+            else:
+                # dense_rank: count of peer-group heads so far in partition
+                cums = np.cumsum(newpeer.astype(np.int64))
+                base = np.where(newpart, cums - 1, 0)
+                vals = cums - np.maximum.accumulate(base)
+            valid = np.ones(m, dtype=bool)
+        elif kind in ("lag", "lead"):
+            off = spec.offset if kind == "lag" else -spec.offset
+            src_idx = np.arange(m) - off
+            ok_range = (src_idx >= 0) & (src_idx < m)
+            src_clip = np.clip(src_idx, 0, m - 1)
+            same_part = ok_range & (
+                part_id[src_clip] == part_id
+            )
+            ad, av = host_cols[spec.arg]
+            vals = np.where(same_part, ad[srows][src_clip], 0)
+            srcv = (
+                np.ones(m, dtype=bool) if av is None else av[srows][src_clip]
+            )
+            valid = same_part & srcv
+        else:  # count / sum / avg / min / max
+            postmap = None
+            if spec.arg is not None:
+                ad, av = host_cols[spec.arg]
+                a = ad[srows]
+                avm = np.ones(m, dtype=bool) if av is None else av[srows]
+                aty = schema[spec.arg]
+                if aty.type.is_text and aty.dict_id is not None:
+                    # min/max over text: compare by rank, map the winning
+                    # rank back to its code afterwards
+                    ranks = np.asarray(self._dict_ranks(aty.dict_id))
+                    nvals = len(self._dict(aty.dict_id).values)
+                    inv = np.zeros(max(len(ranks), 1), dtype=np.int64)
+                    inv[ranks[:nvals]] = np.arange(nvals)
+                    a = ranks[np.clip(a, 0, len(ranks) - 1)]
+                    postmap = lambda r: inv[  # noqa: E731
+                        np.clip(r.astype(np.int64), 0, len(inv) - 1)
+                    ]
+                scale = (
+                    aty.type.decimal_factor
+                    if aty.type.id == t.TypeId.DECIMAL
+                    else 1
+                )
+            else:
+                a = np.ones(m, dtype=np.int64)
+                avm = np.ones(m, dtype=bool)
+                scale = 1
+            vals, valid = self._window_agg(
+                kind, a, avm, newpart, newpeer, bool(spec.order)
+            )
+            if kind == "avg" and scale != 1:
+                vals = vals / scale  # unscale DECIMAL averages (agg parity)
+            if postmap is not None:
+                vals = postmap(vals)
+        out[srows] = vals.astype(oty.np_dtype, copy=False)
+        outv[srows] = valid
+        return out, outv
+
+    @staticmethod
+    def _rank_from(newpeer, pos):
+        """rank(): 1 + partition-relative position of each row's
+        peer-group head (ties share the head's position; every partition
+        head is a peer head, so partitions reset naturally)."""
+        m = len(pos)
+        have = np.where(newpeer, np.arange(m), -1)
+        ff = np.maximum.accumulate(have)  # index of the current peer head
+        return pos[ff] + 1
+
+    @staticmethod
+    def _window_agg(kind, a, avm, newpart, newpeer, running: bool):
+        m = len(a)
+        part_id = np.cumsum(newpart) - 1
+        nparts = int(part_id[-1]) + 1
+        af = a.astype(np.float64)
+        contrib = np.where(avm, af, 0.0)
+        cnt_contrib = avm.astype(np.int64)
+        if not running:
+            # whole-partition value broadcast to every member
+            sums = np.bincount(part_id, weights=contrib, minlength=nparts)
+            cnts = np.bincount(part_id, weights=cnt_contrib, minlength=nparts)
+            if kind == "count":
+                return cnts[part_id], np.ones(m, dtype=bool)
+            if kind == "sum":
+                return sums[part_id], cnts[part_id] > 0
+            if kind == "avg":
+                safe = np.maximum(cnts, 1)
+                return sums[part_id] / safe[part_id], cnts[part_id] > 0
+            # min / max via reduceat over partition starts
+            starts = np.nonzero(newpart)[0]
+            big = np.float64(np.inf if kind == "min" else -np.inf)
+            masked = np.where(avm, af, big)
+            red = (
+                np.minimum.reduceat(masked, starts)
+                if kind == "min"
+                else np.maximum.reduceat(masked, starts)
+            )
+            return red[part_id], cnts[part_id] > 0
+        # running (cumulative, peers share values): global cumsum minus
+        # the value just before each partition head — the head INDEX is
+        # forward-filled (monotonic), never the head value, so negative
+        # partial sums stay exact
+        csum = np.cumsum(contrib)
+        ccnt = np.cumsum(cnt_contrib)
+        head_idx = np.maximum.accumulate(np.where(newpart, np.arange(m), 0))
+        base_sum = csum[head_idx] - contrib[head_idx]
+        base_cnt = ccnt[head_idx] - cnt_contrib[head_idx]
+        run_sum = csum - base_sum
+        run_cnt = ccnt - base_cnt
+        if kind in ("min", "max"):
+            big = np.float64(np.inf if kind == "min" else -np.inf)
+            masked = np.where(avm, af, big)
+            acc = (
+                np.minimum.accumulate
+                if kind == "min"
+                else np.maximum.accumulate
+            )
+            # segmented accumulate: reset at partition heads by replacing
+            # the head with +-inf baseline then re-accumulating per block
+            starts = np.nonzero(newpart)[0]
+            run_mm = masked.copy()
+            for s, e in zip(starts, list(starts[1:]) + [m]):
+                run_mm[s:e] = acc(masked[s:e])
+            run_val = run_mm
+        # peers share the frame end: take the value at each peer group's
+        # last row
+        grp = np.cumsum(newpeer) - 1
+        last_of_group = np.zeros(grp[-1] + 1, dtype=np.int64)
+        last_of_group[grp] = np.arange(m)  # later rows overwrite
+        take = last_of_group[grp]
+        if kind == "count":
+            return run_cnt[take], np.ones(m, dtype=bool)
+        if kind == "sum":
+            return run_sum[take], run_cnt[take] > 0
+        if kind == "avg":
+            safe = np.maximum(run_cnt[take], 1)
+            return run_sum[take] / safe, run_cnt[take] > 0
+        return run_val[take], run_cnt[take] > 0
+
     def _eval_limit(self, plan: L.Limit) -> DevBatch:
         child = self.eval(plan.child)
         mask = (

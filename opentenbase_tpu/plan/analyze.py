@@ -218,6 +218,24 @@ _ARITH = {"+", "-", "*", "/", "%"}
 _CMP = {"=", "<>", "<", "<=", ">", ">="}
 
 
+def _contains_window_nested(e: A.Expr) -> bool:
+    """True if a WindowCall appears BELOW the top level of ``e``."""
+    def inner(x, top: bool) -> bool:
+        if isinstance(x, A.WindowCall):
+            if not top:
+                return True
+            return any(inner(a, False) for a in x.func.args)
+        for f in getattr(x, "__dataclass_fields__", {}):
+            v = getattr(x, f)
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for y in vs:
+                if isinstance(y, A.Expr) and inner(y, False):
+                    return True
+        return False
+
+    return inner(e, True)
+
+
 def _coerce_const_to(e: E.TExpr, ty: t.SqlType) -> Optional[E.TExpr]:
     """If ``e`` is a Const convertible to ``ty``, return the converted
     Const (constants fold through coercion, parse_coerce.c style)."""
@@ -382,12 +400,31 @@ class Analyzer:
         has_aggs = any(
             self._contains_agg(item.expr) for item in sel.items
         ) or (sel.having is not None) or bool(sel.group_by)
+        has_windows = any(
+            isinstance(item.expr, A.WindowCall) for item in sel.items
+        )
+        if has_windows and has_aggs:
+            raise AnalyzeError(
+                "window functions over grouped/aggregated queries are not"
+                " yet supported"
+            )
+        if any(
+            _contains_window_nested(item.expr) for item in sel.items
+        ):
+            raise AnalyzeError(
+                "window functions are only supported as top-level SELECT"
+                " expressions"
+            )
 
         order_hidden: list[E.TExpr] = []
         if has_aggs:
             inplan, group_texprs, having_te, out_exprs, out_schema, gctx = (
                 self._grouped(sel, plan, ctx)
             )
+            post_scope = scope
+        elif has_windows:
+            plan, out_exprs, out_schema = self._windowed(sel, plan, ctx, scope)
+            gctx = None
             post_scope = scope
         else:
             out_exprs, out_schema = self._select_items(sel.items, ctx, scope)
@@ -580,6 +617,146 @@ class Analyzer:
             out_exprs.append(te)
             out_schema.append(L.OutCol(name, te.type, _texpr_dict_id(te, scope)))
         return out_exprs, out_schema
+
+    _WINDOW_FUNCS = {
+        "row_number", "rank", "dense_rank", "count", "sum", "avg",
+        "min", "max", "lag", "lead",
+    }
+
+    def _windowed(
+        self, sel: A.Select, plan: L.LogicalPlan, ctx: ExprContext, scope
+    ) -> tuple[L.LogicalPlan, list[E.TExpr], list[L.OutCol]]:
+        """Plan window functions: a prep projection appends every window
+        input (arg, partition keys, order keys) AFTER a passthrough of the
+        child schema — so pre-existing scope column indexes stay valid —
+        then one Window node computes the window columns, and the final
+        select list reads them by position (nodeWindowAgg planning,
+        planner.c's WindowClause targetlist juggling reduced to columnar
+        positions)."""
+        base_cols = [
+            E.Col(i, c.type, c.name) for i, c in enumerate(plan.schema)
+        ]
+        extra: list[E.TExpr] = []
+        extra_schema: list[L.OutCol] = []
+
+        def appended(te: E.TExpr) -> int:
+            # plain column refs are already in the passthrough prefix
+            if isinstance(te, E.Col) and te.index < len(base_cols):
+                return te.index
+            # reuse an identical appended input otherwise
+            for j, prev in enumerate(extra):
+                if prev.key() == te.key():
+                    return len(base_cols) + j
+            extra.append(te)
+            extra_schema.append(
+                L.OutCol(
+                    f"__w{len(extra) - 1}", te.type,
+                    _texpr_dict_id(te, scope),
+                )
+            )
+            return len(base_cols) + len(extra) - 1
+
+        specs: list[L.WinSpec] = []
+        out_exprs: list[E.TExpr] = []
+        out_schema: list[L.OutCol] = []
+        win_slots: list[Optional[int]] = []  # per select item: spec index
+        for item in sel.items:
+            if not isinstance(item.expr, A.WindowCall):
+                tes, schemas = self._select_items([item], ctx, scope)
+                out_exprs.extend(tes)
+                out_schema.extend(schemas)
+                win_slots.extend([None] * len(tes))
+                continue
+            wc = item.expr
+            fn = wc.func
+            kind = fn.name
+            if kind not in self._WINDOW_FUNCS:
+                raise AnalyzeError(f"unknown window function {kind}")
+            arg_idx: Optional[int] = None
+            offset = 1
+            if kind in ("row_number", "rank", "dense_rank"):
+                if fn.args or fn.star:
+                    raise AnalyzeError(f"{kind}() takes no arguments")
+                if kind in ("rank", "dense_rank") and not wc.order_by:
+                    raise AnalyzeError(f"{kind}() requires ORDER BY")
+                rty = t.INT8
+            elif kind == "count":
+                if fn.args:
+                    arg_idx = appended(self.expr(fn.args[0], ctx))
+                rty = t.INT8
+            else:
+                if not fn.args:
+                    raise AnalyzeError(f"{kind}() requires an argument")
+                arg_te = self.expr(fn.args[0], ctx)
+                arg_idx = appended(arg_te)
+                if kind in ("lag", "lead"):
+                    if not wc.order_by:
+                        raise AnalyzeError(f"{kind}() requires ORDER BY")
+                    if len(fn.args) > 1:
+                        off = self.expr(fn.args[1], ctx)
+                        if not isinstance(off, E.Const) or not isinstance(
+                            off.value, int
+                        ):
+                            raise AnalyzeError(
+                                f"{kind} offset must be an integer constant"
+                            )
+                        offset = off.value
+                    rty = arg_te.type
+                elif kind == "avg":
+                    if not arg_te.type.is_numeric:
+                        raise AnalyzeError(
+                            f"avg over {arg_te.type} is not defined"
+                        )
+                    rty = t.FLOAT8
+                elif kind == "sum":
+                    if not arg_te.type.is_numeric:
+                        raise AnalyzeError(
+                            f"sum over {arg_te.type} is not defined"
+                        )
+                    rty = (
+                        t.INT8 if arg_te.type.is_integer else
+                        t.decimal(38, arg_te.type.scale)
+                        if arg_te.type.id == t.TypeId.DECIMAL
+                        else t.FLOAT8
+                    )
+                else:  # min / max
+                    rty = arg_te.type
+            part = tuple(
+                appended(self.expr(p, ctx)) for p in wc.partition_by
+            )
+            order = tuple(
+                (appended(self.expr(si.expr, ctx)), si.descending)
+                for si in wc.order_by
+            )
+            name = item.alias or kind
+            dict_id = None
+            if rty.is_text and arg_idx is not None:
+                if arg_idx < len(base_cols):
+                    dict_id = plan.schema[arg_idx].dict_id
+                else:
+                    dict_id = extra_schema[arg_idx - len(base_cols)].dict_id
+            spec = L.WinSpec(
+                kind, arg_idx, part, order,
+                L.OutCol(name, rty, dict_id), offset,
+            )
+            win_slots.append(len(specs))
+            specs.append(spec)
+            out_exprs.append(E.Col(-1, rty, name))  # patched below
+            out_schema.append(L.OutCol(name, rty, dict_id))
+
+        prep_schema = tuple(plan.schema) + tuple(extra_schema)
+        prep = L.Project(
+            plan, tuple(base_cols) + tuple(extra), prep_schema
+        )
+        win_schema = prep_schema + tuple(s.out for s in specs)
+        wplan = L.Window(prep, tuple(specs), win_schema)
+        # patch window output references now positions are known
+        for i, slot in enumerate(win_slots):
+            if slot is not None:
+                pos = len(prep_schema) + slot
+                oc = out_schema[i]
+                out_exprs[i] = E.Col(pos, oc.type, oc.name)
+        return wplan, out_exprs, out_schema
 
     def _grouped(
         self, sel: A.Select, plan: L.LogicalPlan, ctx: ExprContext

@@ -549,6 +549,26 @@ class Distributor:
             return plan
         return self._cut(plan, dist.nodes, "broadcast", dest_nodes=tuple(dest))
 
+    def _d_window(self, plan: L.Window):
+        """Window functions need every row of a partition in one place;
+        gather to the coordinator and evaluate there (the reference plans
+        WindowAgg above the remote gather the same way unless the
+        distribution happens to match the PARTITION BY — a colocation
+        optimization left for later)."""
+        child, dist = self._walk(plan.child)
+        if dist.is_single:
+            return L.Window(child, plan.specs, plan.schema), dist
+        if dist.kind == "replicated":
+            return (
+                L.Window(child, plan.specs, plan.schema),
+                Dist.single(dist.nodes[0]),
+            )
+        src = self._cut(child, dist.nodes, "gather")
+        return (
+            L.Window(src, plan.specs, plan.schema),
+            Dist.single(COORDINATOR),
+        )
+
     # -- sort / limit ------------------------------------------------------
     def _d_sort(self, plan: L.Sort):
         child, dist = self._walk(plan.child)

@@ -162,6 +162,48 @@ class Sort(LogicalPlan):
 
 
 @dataclass(frozen=True)
+class WinSpec:
+    """One window column (a WindowFunc with its WindowClause resolved to
+    physical child column positions)."""
+
+    kind: str  # row_number|rank|dense_rank|count|sum|avg|min|max|lag|lead
+    arg: Optional[int]  # child column position of the argument (or None)
+    partition: tuple[int, ...]
+    order: tuple[tuple[int, bool], ...]  # (child col, descending)
+    out: OutCol = OutCol("", None)  # type: ignore[arg-type]
+    offset: int = 1  # lag/lead distance
+
+    def key(self) -> str:
+        o = ",".join(f"{c}{'D' if d else 'A'}" for c, d in self.order)
+        return (
+            f"{self.kind}({self.arg})p[{','.join(map(str, self.partition))}]"
+            f"o[{o}]+{self.offset}"
+        )
+
+
+@dataclass(frozen=True)
+class Window(LogicalPlan):
+    """Window-function evaluation (nodeWindowAgg): child columns pass
+    through, one appended column per spec. Aggregate kinds use the whole
+    partition when the spec has no ORDER BY and the cumulative
+    peers-inclusive running frame (PG's default RANGE UNBOUNDED
+    PRECEDING) when it does."""
+
+    child: LogicalPlan
+    specs: tuple[WinSpec, ...]
+    schema: tuple[OutCol, ...]
+
+    def children(self):
+        return (self.child,)
+
+    def key(self) -> str:
+        return (
+            f"window({self.child.key()};"
+            f"{';'.join(s.key() for s in self.specs)})"
+        )
+
+
+@dataclass(frozen=True)
 class Limit(LogicalPlan):
     child: LogicalPlan
     limit: Optional[int]

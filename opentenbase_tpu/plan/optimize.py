@@ -200,6 +200,25 @@ def _prune_node(plan: L.LogicalPlan, required: Optional[set[int]]):
             return L.Limit(child, plan.limit, plan.offset, child.schema), cmap
         return L.Distinct(child, child.schema), cmap
 
+    if isinstance(plan, L.Window):
+        # window specs address child columns positionally; keep the whole
+        # child (the prep projection already narrowed the inputs)
+        child, cmap = _prune_node(
+            plan.child, set(range(len(plan.child.schema)))
+        )
+        ident = all(cmap.get(i) == i for i in range(len(plan.child.schema)))
+        if not ident:
+            # child refused the identity layout: restore it explicitly
+            exprs = tuple(
+                E.Col(cmap[i], c.type, c.name)
+                for i, c in enumerate(plan.child.schema)
+            )
+            child = L.Project(child, exprs, plan.child.schema)
+        return (
+            L.Window(child, plan.specs, plan.schema),
+            {i: i for i in range(len(plan.schema))},
+        )
+
     if isinstance(plan, L.Union):
         inputs = []
         keep = sorted(req)

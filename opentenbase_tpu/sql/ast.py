@@ -129,6 +129,19 @@ class ExistsSubquery(Expr):
 
 
 @dataclass(frozen=True)
+class WindowCall(Expr):
+    """f(args) OVER (PARTITION BY ... ORDER BY ...) — nodeWindowAgg's
+    input shape (parsenodes.h WindowFunc + WindowClause)."""
+
+    func: "FuncCall"
+    partition_by: tuple = ()
+    order_by: tuple = ()  # tuple[SortItem, ...]
+
+    def __str__(self):
+        return f"{self.func} OVER (...)"
+
+
+@dataclass(frozen=True)
 class ScalarSubquery(Expr):
     query: "Select"
 

@@ -521,6 +521,25 @@ class Parser:
                 break
         return stmt
 
+    def _maybe_over(self, fn: A.FuncCall) -> A.Expr:
+        """``f(...) OVER ([PARTITION BY ...] [ORDER BY ...])`` — window
+        function invocation (gram.y's over_clause)."""
+        if not self.eat_kw("over"):
+            return fn
+        self.expect_op("(")
+        partition: list[A.Expr] = []
+        order: list[A.SortItem] = []
+        if self.eat_kw("partition", "by"):
+            partition.append(self.parse_expr())
+            while self.eat_op(","):
+                partition.append(self.parse_expr())
+        if self.eat_kw("order", "by"):
+            order.append(self._sort_item())
+            while self.eat_op(","):
+                order.append(self._sort_item())
+        self.expect_op(")")
+        return A.WindowCall(fn, tuple(partition), tuple(order))
+
     def _partition_spec(self) -> dict:
         # PARTITION BY RANGE (col) [BEGIN (literal) STEP (literal unit)
         # PARTITIONS (n)] — interval partitioning, gram.y:4172
@@ -1012,16 +1031,18 @@ class Parser:
             self.advance()  # (
             if self.eat_op("*"):
                 self.expect_op(")")
-                return A.FuncCall(name, (), star=True)
+                return self._maybe_over(A.FuncCall(name, (), star=True))
             if self.at_op(")"):
                 self.advance()
-                return A.FuncCall(name, ())
+                return self._maybe_over(A.FuncCall(name, ()))
             distinct = bool(self.eat_kw("distinct"))
             args = [self.parse_expr()]
             while self.eat_op(","):
                 args.append(self.parse_expr())
             self.expect_op(")")
-            return A.FuncCall(name, tuple(args), distinct=distinct)
+            return self._maybe_over(
+                A.FuncCall(name, tuple(args), distinct=distinct)
+            )
         # column ref, possibly qualified
         name = self.advance().value
         if self.at_op(".") and self.peek(1).kind == Tok.IDENT:

@@ -1,0 +1,207 @@
+"""Window function tests (nodeWindowAgg surface): ranking, partitioned
+aggregates, running frames, lag/lead — cross-checked against PG semantics."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+
+
+@pytest.fixture(scope="module")
+def s():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    sess = c.session()
+    sess.execute(
+        "create table emp (id bigint, dept text, sal bigint)"
+        " distribute by shard(id)"
+    )
+    sess.execute(
+        "insert into emp values"
+        " (1,'eng',100),(2,'eng',200),(3,'eng',200),(4,'eng',300),"
+        " (5,'ops',50),(6,'ops',70),(7,'sales',90)"
+    )
+    return sess
+
+
+def test_row_number(s):
+    rows = s.query(
+        "select id, row_number() over (partition by dept order by sal, id)"
+        " from emp order by id"
+    )
+    assert rows == [(1, 1), (2, 2), (3, 3), (4, 4), (5, 1), (6, 2), (7, 1)]
+
+
+def test_rank_and_dense_rank(s):
+    rows = s.query(
+        "select id, rank() over (partition by dept order by sal),"
+        " dense_rank() over (partition by dept order by sal)"
+        " from emp order by id"
+    )
+    # eng sals: 100,200,200,300 -> rank 1,2,2,4; dense 1,2,2,3
+    assert rows == [
+        (1, 1, 1), (2, 2, 2), (3, 2, 2), (4, 4, 3),
+        (5, 1, 1), (6, 2, 2), (7, 1, 1),
+    ]
+
+
+def test_partition_aggregates_whole(s):
+    rows = s.query(
+        "select id, sum(sal) over (partition by dept),"
+        " count(*) over (partition by dept),"
+        " avg(sal) over (partition by dept)"
+        " from emp order by id"
+    )
+    assert rows[0] == (1, 800, 4, 200.0)
+    assert rows[4] == (5, 120, 2, 60.0)
+    assert rows[6] == (7, 90, 1, 90.0)
+
+
+def test_running_sum_with_peers(s):
+    rows = s.query(
+        "select id, sum(sal) over (partition by dept order by sal)"
+        " from emp order by id"
+    )
+    # eng running by sal with peers sharing the frame end:
+    # 100 -> 100; 200,200 (peers) -> 500; 300 -> 800
+    assert rows == [
+        (1, 100), (2, 500), (3, 500), (4, 800),
+        (5, 50), (6, 120), (7, 90),
+    ]
+
+
+def test_global_window_no_partition(s):
+    rows = s.query(
+        "select id, sum(sal) over (), row_number() over (order by id)"
+        " from emp order by id"
+    )
+    assert all(r[1] == 1010 for r in rows)
+    assert [r[2] for r in rows] == [1, 2, 3, 4, 5, 6, 7]
+
+
+def test_min_max_running(s):
+    rows = s.query(
+        "select id, min(sal) over (partition by dept order by id),"
+        " max(sal) over (partition by dept order by id) from emp"
+        " order by id"
+    )
+    assert rows == [
+        (1, 100, 100), (2, 100, 200), (3, 100, 200), (4, 100, 300),
+        (5, 50, 50), (6, 50, 70), (7, 90, 90),
+    ]
+
+
+def test_lag_lead(s):
+    rows = s.query(
+        "select id, lag(sal) over (partition by dept order by id),"
+        " lead(sal) over (partition by dept order by id) from emp"
+        " order by id"
+    )
+    assert rows == [
+        (1, None, 200), (2, 100, 200), (3, 200, 300), (4, 200, None),
+        (5, None, 70), (6, 50, None), (7, None, None),
+    ]
+    rows = s.query(
+        "select id, lag(sal, 2) over (order by id) from emp order by id"
+    )
+    assert [r[1] for r in rows] == [None, None, 100, 200, 200, 300, 50]
+
+
+def test_window_over_text_arg(s):
+    rows = s.query(
+        "select id, lag(dept) over (order by id) from emp where id <= 5"
+        " order by id"
+    )
+    assert [r[1] for r in rows] == [None, "eng", "eng", "eng", "eng"]
+
+
+def test_window_with_where_and_mixed_items(s):
+    rows = s.query(
+        "select dept, sal * 2, rank() over (order by sal desc)"
+        " from emp where dept = 'eng' order by sal desc, id"
+    )
+    assert rows == [
+        ("eng", 600, 1), ("eng", 400, 2), ("eng", 400, 2), ("eng", 200, 4),
+    ]
+
+
+def test_window_errors(s):
+    from opentenbase_tpu.plan.analyze import AnalyzeError
+
+    with pytest.raises(AnalyzeError, match="ORDER BY"):
+        s.query("select rank() over () from emp")
+    with pytest.raises(AnalyzeError, match="top-level"):
+        s.query("select 1 + row_number() over () from emp")
+    with pytest.raises(AnalyzeError, match="grouped"):
+        s.query(
+            "select dept, sum(count(*)) over () from emp group by dept"
+        )
+
+
+def test_window_over_partitioned_table():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s2 = c.session()
+    s2.execute(
+        "create table m (id bigint, ts bigint) partition by range (ts)"
+        " begin (0) step (100) partitions (3) distribute by shard(id)"
+    )
+    s2.execute("insert into m values (1,10),(2,110),(3,210),(4,20)")
+    rows = s2.query(
+        "select id, row_number() over (order by ts, id) from m order by id"
+    )
+    assert rows == [(1, 1), (2, 3), (3, 4), (4, 2)]
+
+
+def test_running_sum_negative_values(s):
+    """The segmented running-sum baseline must be exact for negative
+    partition sums (index-forward-fill, not value-accumulate)."""
+    s.execute("create table w1 (p bigint, o bigint, x bigint) distribute by shard(p)")
+    s.execute("insert into w1 values (1,1,-5),(2,1,3),(2,2,-10),(2,3,4)")
+    rows = s.query(
+        "select p, o, sum(x) over (partition by p order by o) from w1"
+        " order by p, o"
+    )
+    assert rows == [(1, 1, -5), (2, 1, 3), (2, 2, -7), (2, 3, -3)]
+
+
+def test_window_avg_decimal_unscaled(s):
+    s.execute(
+        "create table w2 (k bigint, price decimal(10,2)) distribute by shard(k)"
+    )
+    s.execute("insert into w2 values (1,1.50),(2,2.50)")
+    rows = s.query("select avg(price) over () from w2")
+    assert all(r[0] == 2.0 for r in rows)
+
+
+def test_window_order_by_text_uses_collation(s):
+    s.execute("create table w3 (k bigint, nm text) distribute by shard(k)")
+    # insert in anti-alphabetical order so dict codes disagree with collation
+    s.execute("insert into w3 values (1,'zeta'),(2,'alpha'),(3,'mid')")
+    rows = s.query(
+        "select nm, row_number() over (order by nm) from w3 order by k"
+    )
+    assert rows == [("zeta", 3), ("alpha", 1), ("mid", 2)]
+    rows = s.query("select min(nm) over (), max(nm) over () from w3")
+    assert rows[0] == ("alpha", "zeta")
+
+
+def test_window_null_keys_partition_and_order(s):
+    s.execute("create table w4 (k bigint, g bigint, x bigint) distribute by shard(k)")
+    s.execute("insert into w4 values (1,0,10),(2,null,20),(3,0,30),(4,null,40)")
+    rows = s.query(
+        "select k, count(*) over (partition by g) from w4 order by k"
+    )
+    # NULLs form their own partition, distinct from g = 0
+    assert rows == [(1, 2), (2, 2), (3, 2), (4, 2)]
+    rows = s.query(
+        "select k, row_number() over (order by g, k) from w4 order by k"
+    )
+    # ASC: NULLs last (PG default)
+    assert rows == [(1, 1), (2, 3), (3, 2), (4, 4)]
+
+
+def test_window_sum_text_rejected(s):
+    from opentenbase_tpu.plan.analyze import AnalyzeError
+
+    with pytest.raises(AnalyzeError, match="not defined"):
+        s.query("select sum(dept) over () from emp")
+    with pytest.raises(AnalyzeError, match="integer constant"):
+        s.query("select lag(sal, null) over (order by id) from emp")
