@@ -247,6 +247,149 @@ class Cluster:
         self.sessions.add(s)
         return s
 
+    # -- ALTER TABLE surface (tablecmds.c + redistrib.c), shared between
+    # the DDL handler and WAL redo so both sides perform the identical op
+    def _alter_targets(self, name: str) -> list[str]:
+        spec = self.partitions.get(name)
+        return spec.children() if spec is not None else [name]
+
+    def alter_add_column(self, name: str, col: str, ty) -> None:
+        from opentenbase_tpu.storage.column import Dictionary
+
+        metas = [self.catalog.get(name)] + [
+            self.catalog.get(ch) for ch in self._alter_targets(name)
+            if ch != name
+        ]
+        for meta in metas:
+            if col in meta.schema:
+                raise SQLError(f'column "{col}" already exists')
+        for meta in metas:
+            meta.schema[col] = ty
+            if ty.id == t.TypeId.TEXT and col not in meta.dictionaries:
+                meta.dictionaries[col] = Dictionary()
+        for child in self._alter_targets(name):
+            cm = self.catalog.get(child)
+            for node in cm.node_indices:
+                store = self.stores.get(node, {}).get(child)
+                if store is not None:
+                    store.add_column(col, ty)
+
+    def alter_drop_column(self, name: str, col: str) -> None:
+        meta = self.catalog.get(name)
+        if col in meta.dist.key_columns:
+            raise SQLError(f'cannot drop distribution key "{col}"')
+        spec = self.partitions.get(name)
+        if spec is not None and col == spec.column:
+            raise SQLError(f'cannot drop partition key "{col}"')
+        if col not in meta.schema:
+            raise SQLError(f'column "{col}" does not exist')
+        for target in {name, *self._alter_targets(name)}:
+            tm = self.catalog.get(target)
+            tm.schema.pop(col, None)
+            tm.dictionaries.pop(col, None)
+            # a later re-added TEXT column starts a fresh dictionary: the
+            # WAL sync watermark must restart at zero with it
+            if self.persistence is not None:
+                self.persistence._dict_synced.pop(f"{target}.{col}", None)
+            for node in tm.node_indices:
+                store = self.stores.get(node, {}).get(target)
+                if store is not None:
+                    store.drop_column(col)
+
+    def redistribute_table(self, name: str, dist: DistributionSpec) -> int:
+        """Online redistribution (ALTER TABLE ... DISTRIBUTE BY,
+        src/backend/pgxc/locator/redistrib.c): rewrite every live row
+        through the new locator. Dead versions are dropped (the rewrite
+        is a vacuum, as PG table rewrites are)."""
+        from opentenbase_tpu.catalog.locator import Locator
+
+        # the rewrite renumbers every row position; any open transaction
+        # (prepared or in flight) holds positional ranges into the old
+        # stores — PG's AccessExclusiveLock would block here, we refuse
+        for target in self._alter_targets(name):
+            tm = self.catalog.get(target)
+            for node in tm.node_indices:
+                store = self.stores.get(node, {}).get(target)
+                if store is not None and store._pins > 0:
+                    raise SQLError(
+                        f'cannot redistribute "{name}": open or prepared '
+                        "transactions still reference it"
+                    )
+        snapshot = self.gts.snapshot_ts()
+        commit_ts = self.gts.get_gts()
+        moved = 0
+        for target in self._alter_targets(name):
+            meta = self.catalog.get(target)
+            batches = []
+            src_nodes = (
+                meta.node_indices[:1]  # replicated: one copy is the truth
+                if meta.dist.strategy == DistStrategy.REPLICATED
+                else meta.node_indices
+            )
+            for node in src_nodes:
+                store = self.stores.get(node, {}).get(target)
+                if store is None or store.nrows == 0:
+                    continue
+                live = (store.xmin_ts[: store.nrows] <= snapshot) & (
+                    snapshot < store.xmax_ts[: store.nrows]
+                )
+                idx = np.nonzero(live)[0]
+                if len(idx):
+                    batches.append(store.to_batch().take(idx))
+            meta.dist = dist
+            meta.locator = Locator(
+                dist,
+                meta.node_indices,
+                self.shardmap
+                if dist.strategy == DistStrategy.SHARD
+                else None,
+                key_types={k: meta.schema[k] for k in dist.key_columns},
+            )
+            for node in meta.node_indices:
+                self.stores.setdefault(node, {})[target] = ShardStore(
+                    meta.schema, meta.dictionaries
+                )
+            for batch in batches:
+                if meta.dist.strategy == DistStrategy.REPLICATED:
+                    for node in meta.node_indices:
+                        self.stores[node][target].append_batch(
+                            batch, commit_ts
+                        )
+                    moved += batch.nrows
+                    continue
+                key_cols = {
+                    k: batch.columns[k] for k in dist.key_columns
+                }
+                routes = meta.locator.route_insert(key_cols, batch.nrows)
+                for node in np.unique(routes):
+                    sub = batch.take(np.nonzero(routes == node)[0])
+                    self.stores[int(node)][target].append_batch(
+                        sub, commit_ts
+                    )
+                    moved += sub.nrows
+        if name in self.partitions:  # parent shell keeps matching metadata
+            self.catalog.get(name).dist = dist
+        return moved
+
+    def extend_partitions(self, name: str, count: int) -> None:
+        from opentenbase_tpu.plan.partition import PartitionSpec
+
+        spec = self.partitions.get(name)
+        if spec is None:
+            raise SQLError(f'"{name}" is not a partitioned table')
+        parent = self.catalog.get(name)
+        clause = dict(spec.spec)
+        clause["partitions"] = spec.nparts + count
+        new_spec = PartitionSpec.build(name, clause, spec.key_type)
+        for i in range(spec.nparts, new_spec.nparts):
+            child = new_spec.child(i)
+            meta = self.catalog.create_table(
+                child, parent.schema, parent.dist
+            )
+            meta.dictionaries = parent.dictionaries
+            self.create_table_stores(meta)
+        self.partitions[name] = new_spec
+
     # -- in-doubt 2PC repair (clean2pc.c bgworker + contrib/pg_clean) -----
     def clean_2pc(self, max_age_s: float = 300.0) -> list[str]:
         """Resolve stale in-doubt transactions: parked prepared txns older
@@ -1165,21 +1308,10 @@ class Session:
 
     def _dist_spec(self, stmt: A.CreateTable, schema) -> DistributionSpec:
         s = (stmt.distribute_strategy or "").lower()
-        if s in ("replication", "replicated"):
-            return DistributionSpec(DistStrategy.REPLICATED, group=stmt.to_group)
-        if s == "roundrobin":
-            return DistributionSpec(DistStrategy.ROUNDROBIN, group=stmt.to_group)
-        if s in ("shard", "hash", "modulo"):
-            strat = {
-                "shard": DistStrategy.SHARD,
-                "hash": DistStrategy.HASH,
-                "modulo": DistStrategy.MODULO,
-            }[s]
-            return DistributionSpec(
-                strat, tuple(stmt.distribute_keys), group=stmt.to_group
-            )
         if s:
-            raise SQLError(f"unknown distribution strategy {s!r}")
+            return self._dist_spec_named(
+                s, stmt.distribute_keys, stmt.to_group
+            )
         # default: SHARD on the primary key, else the first column
         # (the reference defaults new tables to shard distribution)
         key = None
@@ -1263,6 +1395,86 @@ class Session:
                 {"op": "drop_node", "name": stmt.name}
             )
         return Result("DROP NODE")
+
+    def _x_altertable(self, stmt: A.AlterTable) -> Result:
+        c = self.cluster
+        if not c.catalog.has(stmt.table):
+            raise SQLError(f'relation "{stmt.table}" does not exist')
+        child_parents = {
+            ch: p for p, ps in c.partitions.items() for ch in ps.children()
+        }
+        if stmt.table in child_parents:
+            raise SQLError(
+                f'cannot alter "{stmt.table}": it is a partition of '
+                f'"{child_parents[stmt.table]}" (alter the parent)'
+            )
+        p = c.persistence
+        if stmt.action == "add_column":
+            cd = stmt.column
+            ty = t.type_from_name(cd.type_name, cd.type_args)
+            c.alter_add_column(stmt.table, cd.name, ty)
+            if p is not None:
+                from opentenbase_tpu.storage.persist import _type_to_str
+
+                p.log_ddl(
+                    {"op": "add_column", "name": stmt.table,
+                     "column": cd.name, "type": _type_to_str(ty)}
+                )
+            return Result("ALTER TABLE")
+        if stmt.action == "drop_column":
+            c.alter_drop_column(stmt.table, stmt.column_name)
+            if p is not None:
+                p.log_ddl(
+                    {"op": "drop_column", "name": stmt.table,
+                     "column": stmt.column_name}
+                )
+            return Result("ALTER TABLE")
+        if stmt.action == "distribute":
+            meta = c.catalog.get(stmt.table)
+            for k in stmt.keys:
+                if k not in meta.schema:
+                    raise SQLError(
+                        f'distribution key "{k}" is not a column'
+                    )
+            dist = self._dist_spec_named(
+                stmt.strategy, stmt.keys, meta.dist.group
+            )
+            n = c.redistribute_table(stmt.table, dist)
+            if p is not None:
+                p.log_ddl(
+                    {"op": "redistribute", "name": stmt.table,
+                     "strategy": dist.strategy.value,
+                     "key_columns": list(dist.key_columns)}
+                )
+                p.checkpoint()  # stores rewritten wholesale (MOVE DATA rule)
+            return Result("ALTER TABLE", rowcount=n)
+        if stmt.action == "add_partitions":
+            c.extend_partitions(stmt.table, stmt.count)
+            if p is not None:
+                p.log_ddl(
+                    {"op": "add_partitions", "name": stmt.table,
+                     "count": stmt.count}
+                )
+            return Result("ALTER TABLE")
+        raise SQLError(f"unsupported ALTER TABLE action {stmt.action}")
+
+    def _dist_spec_named(
+        self, strategy: str, keys, group: Optional[str] = None
+    ) -> DistributionSpec:
+        """The one strategy-name -> DistributionSpec mapper (CREATE TABLE
+        and ALTER TABLE ... DISTRIBUTE BY share it)."""
+        s = (strategy or "").lower()
+        if s in ("replication", "replicated"):
+            return DistributionSpec(DistStrategy.REPLICATED, group=group)
+        if s == "roundrobin":
+            return DistributionSpec(DistStrategy.ROUNDROBIN, group=group)
+        if s in ("shard", "hash", "modulo"):
+            if not keys:
+                raise SQLError(f"{s} distribution requires a key column")
+            strat = {"shard": DistStrategy.SHARD, "hash": DistStrategy.HASH,
+                     "modulo": DistStrategy.MODULO}[s]
+            return DistributionSpec(strat, tuple(keys), group=group)
+        raise SQLError(f"unknown distribution strategy {strategy!r}")
 
     def _x_alternode(self, stmt: A.AlterNode) -> Result:
         self.cluster.nodes.alter_node(stmt.name, **stmt.options)

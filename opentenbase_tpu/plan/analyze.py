@@ -867,6 +867,15 @@ class Analyzer:
     def _insert(self, stmt: A.Insert) -> L.LogicalPlan:
         meta = self._table(stmt.table)
         columns = stmt.columns or list(meta.schema.keys())
+        if not stmt.columns and stmt.values:
+            # PG: VALUES shorter than the table maps to the LEADING
+            # columns; the rest take defaults (NULL here) — what keeps
+            # old INSERTs valid after ALTER TABLE ADD COLUMN
+            arity = len(stmt.values[0])
+            if arity < len(columns) and all(
+                len(r) == arity for r in stmt.values
+            ):
+                columns = columns[:arity]
         for c in columns:
             meta.column_type(c)  # existence check
         target_types = [meta.schema[c] for c in columns]

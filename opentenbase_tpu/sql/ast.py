@@ -315,6 +315,21 @@ class CreateTable(Statement):
 
 
 @dataclass
+class AlterTable(Statement):
+    """ALTER TABLE: schema evolution + online redistribution (the XL
+    ALTER TABLE ... DISTRIBUTE BY path, redistrib.c) + interval-partition
+    extension."""
+
+    table: str
+    action: str  # distribute | add_partitions | add_column | drop_column
+    strategy: Optional[str] = None
+    keys: list = field(default_factory=list)
+    count: int = 0
+    column: Optional[ColumnDef] = None
+    column_name: Optional[str] = None
+
+
+@dataclass
 class DropTable(Statement):
     names: list[str]
     if_exists: bool = False

@@ -644,7 +644,38 @@ class Parser:
                 self.eat_op(",")
             self.expect_op(")")
             return A.AlterNode(name, options)
+        if self.eat_kw("table"):
+            return self._alter_table()
         self.error("unsupported ALTER")
+
+    def _alter_table(self) -> A.Statement:
+        # ALTER TABLE name {ADD [COLUMN] def | DROP [COLUMN] name |
+        #   DISTRIBUTE BY ... | ADD PARTITIONS (n)}  (tablecmds.c +
+        #   the XL redistribution grammar, gram.y:2694)
+        name = self.ident("table name")
+        if self.eat_kw("distribute", "by"):
+            strat = self.ident("distribution strategy")
+            keys: list[str] = []
+            if self.eat_op("("):
+                keys.append(self.ident("column"))
+                while self.eat_op(","):
+                    keys.append(self.ident("column"))
+                self.expect_op(")")
+            return A.AlterTable(name, "distribute", strategy=strat, keys=keys)
+        if self.eat_kw("add", "partitions"):
+            self.expect_op("(")
+            n = self._int_lit()
+            self.expect_op(")")
+            return A.AlterTable(name, "add_partitions", count=n)
+        if self.eat_kw("add"):
+            self.eat_kw("column")
+            return A.AlterTable(name, "add_column", column=self._column_def())
+        if self.eat_kw("drop"):
+            self.eat_kw("column")
+            return A.AlterTable(
+                name, "drop_column", column_name=self.ident("column")
+            )
+        self.error("unsupported ALTER TABLE action")
 
     def parse_drop(self) -> A.Statement:
         self.expect_kw("drop")

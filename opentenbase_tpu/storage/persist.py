@@ -679,6 +679,27 @@ class ClusterPersistence:
                         c.stores[n][header["name"]] = ShardStore(
                             meta.schema, meta.dictionaries
                         )
+            elif op == "add_column":
+                if c.catalog.has(header["name"]):
+                    c.alter_add_column(
+                        header["name"], header["column"],
+                        _type_from_str(header["type"]),
+                    )
+            elif op == "drop_column":
+                if c.catalog.has(header["name"]):
+                    c.alter_drop_column(header["name"], header["column"])
+            elif op == "redistribute":
+                if c.catalog.has(header["name"]):
+                    c.redistribute_table(
+                        header["name"],
+                        DistributionSpec(
+                            DistStrategy(header["strategy"]),
+                            tuple(header["key_columns"]),
+                        ),
+                    )
+            elif op == "add_partitions":
+                if header["name"] in c.partitions:
+                    c.extend_partitions(header["name"], header["count"])
             elif op == "seq_event":
                 ev, pl = header["event"], header["payload"]
                 g = c.gts

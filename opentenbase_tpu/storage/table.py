@@ -178,6 +178,21 @@ class ShardStore:
         self.xmax_ts[idx] = INF_TS
         self.version += 1
 
+    # -- schema evolution (ALTER TABLE, tablecmds.c) ---------------------
+    def add_column(self, name: str, ty: t.SqlType) -> None:
+        """Append a column; existing rows read NULL (PG's fast default-
+        less ADD COLUMN: no rewrite, just metadata + NULL fill)."""
+        self.schema[name] = ty
+        self._cols[name] = np.zeros(self._capacity, dtype=ty.np_dtype)
+        self._validity[name] = np.zeros(self._capacity, dtype=np.bool_)
+        self.version += 1
+
+    def drop_column(self, name: str) -> None:
+        self.schema.pop(name, None)
+        self._cols.pop(name, None)
+        self._validity.pop(name, None)
+        self.version += 1
+
     # -- reads ----------------------------------------------------------
     def column_array(self, name: str) -> np.ndarray:
         return self._cols[name][: self.nrows]

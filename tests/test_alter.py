@@ -1,0 +1,164 @@
+"""ALTER TABLE tests: schema evolution (tablecmds.c) and online
+redistribution (the XL ALTER TABLE ... DISTRIBUTE BY path, redistrib.c),
+plus interval-partition extension."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster, SQLError
+
+
+@pytest.fixture()
+def c():
+    return Cluster(num_datanodes=2, shard_groups=32)
+
+
+def test_add_column_null_fill_and_use(c):
+    s = c.session()
+    s.execute("create table t (k bigint, v text) distribute by shard(k)")
+    s.execute("insert into t values (1,'a'),(2,'b')")
+    s.execute("alter table t add column score float8")
+    assert s.query("select k, score from t order by k") == [(1, None), (2, None)]
+    s.execute("insert into t values (3,'c')")
+    s.execute("update t set score = 9.5 where k = 3")
+    assert s.query("select k from t where score is not null") == [(3,)]
+    s.execute("alter table t add column tag text")
+    s.execute("update t set tag = 'new' where k = 1")
+    assert s.query("select tag from t order by k") == [("new",), (None,), (None,)]
+    with pytest.raises(SQLError, match="already exists"):
+        s.execute("alter table t add column tag text")
+
+
+def test_drop_column_and_guards(c):
+    s = c.session()
+    s.execute("create table t (k bigint, v text, x bigint) distribute by shard(k)")
+    s.execute("insert into t values (1,'a',10)")
+    s.execute("alter table t drop column x")
+    assert s.query("select * from t") == [(1, "a")]
+    with pytest.raises(SQLError, match="distribution key"):
+        s.execute("alter table t drop column k")
+    with pytest.raises(SQLError, match="does not exist"):
+        s.execute("alter table t drop column nope")
+
+
+def test_redistribute_shard_to_replicated_and_back(c):
+    s = c.session()
+    s.execute("create table t (k bigint, v text) distribute by shard(k)")
+    s.execute("insert into t values (1,'a'),(2,'b'),(3,'c'),(4,'d')")
+    s.execute("alter table t distribute by replication")
+    # replicated: every datanode holds every row
+    for n in c.catalog.get("t").node_indices:
+        assert c.stores[n]["t"].nrows == 4
+    assert s.query("select count(*) from t") == [(4,)]
+    s.execute("alter table t distribute by hash(k)")
+    total = sum(c.stores[n]["t"].nrows for n in c.catalog.get("t").node_indices)
+    assert total == 4  # back to one copy, rows rerouted
+    assert [x[0] for x in s.query("select k from t order by k")] == [1, 2, 3, 4]
+    s.execute("insert into t values (5,'e')")  # new locator routes fine
+    assert s.query("select count(*) from t") == [(5,)]
+
+
+def test_redistribute_drops_dead_versions(c):
+    s = c.session()
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("insert into t values (1),(2),(3)")
+    s.execute("delete from t where k = 2")
+    s.execute("alter table t distribute by roundrobin")
+    assert [x[0] for x in s.query("select k from t order by k")] == [1, 3]
+    # the rewrite vacuumed: no dead rows remain anywhere
+    total = sum(
+        c.stores[n]["t"].nrows for n in c.catalog.get("t").node_indices
+    )
+    assert total == 2
+
+
+def test_add_partitions_extends_range(c):
+    s = c.session()
+    s.execute(
+        "create table m (id bigint, ts bigint) partition by range (ts)"
+        " begin (0) step (100) partitions (2) distribute by shard(id)"
+    )
+    s.execute("insert into m values (1, 50),(2, 150)")
+    with pytest.raises(SQLError, match="out of range"):
+        s.execute("insert into m values (3, 250)")
+    s.execute("alter table m add partitions (2)")
+    s.execute("insert into m values (3, 250),(4, 399)")
+    assert s.query("select count(*) from m") == [(4,)]
+    assert s.query("select count(*) from m$p2") == [(1,)]
+    with pytest.raises(SQLError, match="partition of"):
+        s.execute("alter table m$p0 add column x bigint")
+
+
+def test_alter_partitioned_parent_column(c):
+    s = c.session()
+    s.execute(
+        "create table m (id bigint, ts bigint) partition by range (ts)"
+        " begin (0) step (100) partitions (2) distribute by shard(id)"
+    )
+    s.execute("insert into m values (1, 50),(2, 150)")
+    s.execute("alter table m add column note text")
+    s.execute("update m set note = 'x' where ts < 100")
+    assert s.query("select id, note from m order by id") == [(1, "x"), (2, None)]
+
+
+def test_alter_survives_recovery(tmp_path):
+    c = Cluster(num_datanodes=2, shard_groups=32, data_dir=str(tmp_path))
+    s = c.session()
+    s.execute("create table t (k bigint, v text) distribute by shard(k)")
+    s.execute("insert into t values (1,'a'),(2,'b')")
+    s.execute("alter table t add column score float8")
+    s.execute("update t set score = 1.5 where k = 1")
+    s.execute("alter table t distribute by replication")
+    s.execute("insert into t values (3,'c')")
+
+    r = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=32)
+    rs = r.session()
+    assert rs.query("select k, score from t order by k") == [
+        (1, 1.5), (2, None), (3, None),
+    ]
+    from opentenbase_tpu.catalog.distribution import DistStrategy
+
+    assert r.catalog.get("t").dist.strategy == DistStrategy.REPLICATED
+
+
+def test_add_partitions_survives_recovery(tmp_path):
+    c = Cluster(num_datanodes=2, shard_groups=32, data_dir=str(tmp_path))
+    s = c.session()
+    s.execute(
+        "create table m (id bigint, ts bigint) partition by range (ts)"
+        " begin (0) step (100) partitions (2) distribute by shard(id)"
+    )
+    s.execute("alter table m add partitions (1)")
+    s.execute("insert into m values (1, 250)")
+    r = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=32)
+    assert r.partitions["m"].nparts == 3
+    assert r.session().query("select count(*) from m$p2") == [(1,)]
+
+
+def test_redistribute_blocked_by_prepared_txn(c):
+    s = c.session()
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("insert into t values (1),(2)")
+    s.execute("begin")
+    s.execute("insert into t values (99)")
+    s.execute("prepare transaction 'hold'")
+    with pytest.raises(SQLError, match="prepared"):
+        s.execute("alter table t distribute by roundrobin")
+    s.execute("commit prepared 'hold'")
+    s.execute("alter table t distribute by roundrobin")  # now fine
+    assert s.query("select count(*) from t") == [(3,)]
+
+
+def test_drop_readd_text_column_recovery(tmp_path):
+    """Re-added TEXT columns restart the WAL dictionary watermark."""
+    c = Cluster(num_datanodes=2, shard_groups=32, data_dir=str(tmp_path))
+    s = c.session()
+    s.execute("create table t (k bigint, v text) distribute by shard(k)")
+    s.execute("insert into t values (1,'x'),(2,'y')")
+    s.execute("alter table t drop column v")
+    s.execute("alter table t add column v text")
+    s.execute("insert into t values (3,'p')")
+    s.execute("update t set v = 'q' where k = 1")
+
+    r = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=32)
+    rows = r.session().query("select k, v from t order by k")
+    assert rows == [(1, "q"), (2, None), (3, "p")]
