@@ -493,6 +493,8 @@ class Session:
         Session._next_id += 1
         self.last_query: str = ""
         self.state: str = "idle"
+        # PREPARE name AS ... statements (prepare.c's per-session cache)
+        self.prepared_statements: dict[str, A.Statement] = {}
 
     # -- public ----------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -507,7 +509,9 @@ class Session:
                 t0 = _time.perf_counter()
                 r = self._execute_one(s)
                 ms = (_time.perf_counter() - t0) * 1000
-                if isinstance(s, (A.Select, A.Insert, A.Update, A.Delete)):
+                if isinstance(
+                    s, (A.Select, A.Insert, A.Update, A.Delete, A.ExecuteStmt)
+                ):
                     # pg_stat_statements analog (contrib/stormstats);
                     # statements of a multi-statement string are bucketed
                     # by their position so they don't share one entry
@@ -644,6 +648,9 @@ class Session:
     _READONLY_OK = (
         A.Select, A.ExplainStmt, A.ShowStmt, A.SetStmt,
         A.BeginStmt, A.CommitStmt, A.RollbackStmt,
+        # session-local; EXECUTE's bound statement re-enters
+        # _execute_one and is gated on its own class there
+        A.PrepareStmt, A.ExecuteStmt, A.DeallocateStmt,
     )
 
     def _is_readonly_stmt(self, stmt: A.Statement) -> bool:
@@ -1592,6 +1599,75 @@ class Session:
         return Result("DROP SEQUENCE")
 
     # -- utility ---------------------------------------------------------
+    # -- prepared statements (PREPARE/EXECUTE/DEALLOCATE, prepare.c) ------
+    def _x_preparestmt(self, stmt: A.PrepareStmt) -> Result:
+        if stmt.name in self.prepared_statements:
+            raise SQLError(
+                f'prepared statement "{stmt.name}" already exists'
+            )
+        if isinstance(stmt.statement, (A.PrepareStmt, A.ExecuteStmt)):
+            raise SQLError("cannot prepare a PREPARE/EXECUTE statement")
+        self.prepared_statements[stmt.name] = stmt.statement
+        return Result("PREPARE")
+
+    @staticmethod
+    def _count_params(node) -> int:
+        import dataclasses
+
+        if isinstance(node, A.Param):
+            return node.index
+        mx = 0
+        if isinstance(node, (list, tuple)):
+            for x in node:
+                mx = max(mx, Session._count_params(x))
+        elif dataclasses.is_dataclass(node) and not isinstance(node, type):
+            for f in dataclasses.fields(node):
+                mx = max(mx, Session._count_params(getattr(node, f.name)))
+        return mx
+
+    def _x_executestmt(self, stmt: A.ExecuteStmt) -> Result:
+        import copy
+
+        tmpl = self.prepared_statements.get(stmt.name)
+        if tmpl is None:
+            raise SQLError(
+                f'prepared statement "{stmt.name}" does not exist'
+            )
+        values = [self._const_arg(a) for a in stmt.args]
+        nparams = self._count_params(tmpl)
+        if len(values) != nparams:
+            raise SQLError(
+                f'wrong number of parameters for prepared statement '
+                f'"{stmt.name}": expected {nparams}, got {len(values)}'
+            )
+        # fresh tree per execution: downstream rewrites (partition
+        # expansion) mutate ASTs in place and must never touch the cached
+        # template
+        bound = _subst_params(copy.deepcopy(tmpl), values)
+        return self._execute_one(bound)
+
+    def _const_arg(self, e: A.Expr):
+        if isinstance(e, A.Literal):
+            return e.value
+        if (
+            isinstance(e, A.UnaryOp)
+            and e.op == "-"
+            and isinstance(e.operand, A.Literal)
+            and isinstance(e.operand.value, (int, float))
+            and not isinstance(e.operand.value, bool)
+        ):
+            return -e.operand.value
+        raise SQLError("EXECUTE arguments must be constants")
+
+    def _x_deallocatestmt(self, stmt: A.DeallocateStmt) -> Result:
+        if stmt.name is None:
+            self.prepared_statements.clear()
+        elif self.prepared_statements.pop(stmt.name, None) is None:
+            raise SQLError(
+                f'prepared statement "{stmt.name}" does not exist'
+            )
+        return Result("DEALLOCATE")
+
     def _x_explainstmt(self, stmt: A.ExplainStmt) -> Result:
         inner = stmt.query
         if isinstance(inner, A.Select):
@@ -1922,6 +1998,41 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
         _sv_stat_tables,
     ),
 }
+
+
+def _subst_params(node, values):
+    """Replace $n Param nodes with literal argument values throughout a
+    (copied) statement tree — the Bind step of the extended protocol."""
+    import dataclasses
+
+    if isinstance(node, A.Param):
+        if not 1 <= node.index <= len(values):
+            raise SQLError(
+                f"there is no parameter ${node.index}"
+            )
+        return A.Literal(values[node.index - 1])
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        changes = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            nv = _subst_field(v, values)
+            if nv is not v:
+                changes[f.name] = nv
+        return dataclasses.replace(node, **changes) if changes else node
+    return node
+
+
+def _subst_field(v, values):
+    import dataclasses
+
+    if isinstance(v, (list, tuple)):
+        out = [_subst_field(x, values) for x in v]
+        if any(a is not b for a, b in zip(out, v)):
+            return type(v)(out)
+        return v
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return _subst_params(v, values)
+    return v
 
 
 def connect(cluster: Optional[Cluster] = None, **kw) -> Session:

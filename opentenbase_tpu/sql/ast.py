@@ -315,6 +315,26 @@ class CreateTable(Statement):
 
 
 @dataclass
+class PrepareStmt(Statement):
+    """PREPARE name AS statement (prepare.c / the extended-protocol Parse
+    message)."""
+
+    name: str
+    statement: Statement
+
+
+@dataclass
+class ExecuteStmt(Statement):
+    name: str
+    args: list = field(default_factory=list)  # list[Expr]
+
+
+@dataclass
+class DeallocateStmt(Statement):
+    name: Optional[str] = None  # None = ALL
+
+
+@dataclass
 class AlterTable(Statement):
     """ALTER TABLE: schema evolution + online redistribution (the XL
     ALTER TABLE ... DISTRIBUTE BY path, redistrib.c) + interval-partition

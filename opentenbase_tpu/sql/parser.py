@@ -149,8 +149,31 @@ class Parser:
             return A.RollbackStmt()
         if kw == "prepare":
             self.advance()
-            self.expect_kw("transaction")
-            return A.PrepareTransaction(self._string_lit())
+            if self.eat_kw("transaction"):
+                return A.PrepareTransaction(self._string_lit())
+            # PREPARE name [(types)] AS statement (prepare.c)
+            name = self.ident("statement name")
+            if self.eat_op("("):
+                # parameter types are accepted and inferred; skip with
+                # paren-depth tracking (numeric(10,2) nests) and an EOF
+                # guard (a truncated PREPARE must error, not spin)
+                depth = 1
+                while depth:
+                    if self.cur.kind == Tok.EOF:
+                        self.error("unterminated parameter type list")
+                    if self.at_op("("):
+                        depth += 1
+                    elif self.at_op(")"):
+                        depth -= 1
+                    self.advance()
+            self.expect_kw("as")
+            return A.PrepareStmt(name, self.parse_statement())
+        if kw == "deallocate":
+            self.advance()
+            self.eat_kw("prepare")
+            if self.eat_kw("all"):
+                return A.DeallocateStmt(None)
+            return A.DeallocateStmt(self.ident("statement name"))
         if kw == "explain":
             return self.parse_explain()
         if kw == "vacuum":
@@ -828,8 +851,19 @@ class Parser:
             self.expect_op(")")
         return A.MoveData(from_node, to_node, shard_ids)
 
-    def parse_execute_direct(self) -> A.ExecuteDirect:
+    def parse_execute_direct(self):
         self.expect_kw("execute")
+        if not self.at_kw("direct"):
+            # EXECUTE name [(args)] — run a prepared statement
+            name = self.ident("statement name")
+            args: list[A.Expr] = []
+            if self.eat_op("("):
+                if not self.at_op(")"):
+                    args.append(self.parse_expr())
+                    while self.eat_op(","):
+                        args.append(self.parse_expr())
+                self.expect_op(")")
+            return A.ExecuteStmt(name, args)
         self.expect_kw("direct")
         self.expect_kw("on")
         self.expect_op("(")

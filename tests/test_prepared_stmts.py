@@ -1,0 +1,117 @@
+"""PREPARE / EXECUTE / DEALLOCATE (prepare.c, the extended-protocol
+Parse/Bind surface)."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster, SQLError
+
+
+@pytest.fixture()
+def s():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    sess = c.session()
+    sess.execute("create table t (k bigint, v text) distribute by shard(k)")
+    sess.execute("insert into t values (1,'a'),(2,'b'),(3,'c')")
+    return sess
+
+
+def test_prepare_execute_roundtrip(s):
+    s.execute("prepare q1 as select v from t where k = $1")
+    assert s.query("execute q1(2)") == [("b",)]
+    assert s.query("execute q1(3)") == [("c",)]
+    assert s.query("execute q1(99)") == []
+
+
+def test_prepared_insert_and_negative_args(s):
+    s.execute("prepare ins as insert into t values ($1, $2)")
+    assert s.execute("execute ins(-5, 'neg')").rowcount == 1
+    assert s.query("select v from t where k = -5") == [("neg",)]
+
+
+def test_prepare_lifecycle_errors(s):
+    s.execute("prepare q as select count(*) from t")
+    with pytest.raises(SQLError, match="already exists"):
+        s.execute("prepare q as select 1 is not null")
+    assert s.query("execute q") == [(3,)]
+    s.execute("deallocate q")
+    with pytest.raises(SQLError, match="does not exist"):
+        s.query("execute q")
+    with pytest.raises(SQLError, match="does not exist"):
+        s.execute("deallocate q")
+    s.execute("prepare q2 as select 1 is not null")
+    s.execute("deallocate all")
+    with pytest.raises(SQLError, match="does not exist"):
+        s.query("execute q2")
+
+
+def test_missing_and_nonconst_params(s):
+    s.execute("prepare q as select v from t where k = $1")
+    with pytest.raises(SQLError, match="parameter"):
+        s.query("execute q")
+    with pytest.raises(SQLError, match="constants"):
+        s.query("execute q(k)")
+
+
+def test_prepared_over_partitioned_table():
+    """Repeated EXECUTE must not corrupt the cached template through the
+    in-place partition rewrite."""
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table m (id bigint, ts bigint) partition by range (ts)"
+        " begin (0) step (100) partitions (3) distribute by shard(id)"
+    )
+    s.execute("insert into m values (1,50),(2,150),(3,250)")
+    s.execute("prepare pq as select id from m where ts = $1")
+    assert s.query("execute pq(150)") == [(2,)]
+    assert s.query("execute pq(250)") == [(3,)]  # different pruning target
+    assert s.query("execute pq(50)") == [(1,)]
+
+
+def test_prepared_statement_per_session(s):
+    s.execute("prepare mine as select 1 is not null")
+    other = s.cluster.session()
+    with pytest.raises(SQLError, match="does not exist"):
+        other.query("execute mine")
+
+
+def test_review_regressions(s):
+    from opentenbase_tpu.sql.parser import ParseError
+
+    # unterminated / nested type lists must error cleanly, never hang
+    with pytest.raises(ParseError, match="unterminated"):
+        s.execute("prepare bad (bigint")
+    s.execute("prepare typed (numeric(10,2)) as select v from t where k = $1")
+    assert s.query("execute typed(1)") == [("a",)]
+    # argument count is validated both ways
+    with pytest.raises(SQLError, match="wrong number"):
+        s.query("execute typed(1, 2)")
+    # non-numeric unary minus is a clean error
+    with pytest.raises(SQLError, match="constants"):
+        s.query("execute typed(-'a')")
+    # EXECUTE shows up in pg_stat_statements
+    found = s.query(
+        "select calls from pg_stat_statements where query like '%execute typed%'"
+    )
+    assert found and found[0][0] >= 1
+
+
+def test_prepared_select_on_hot_standby(tmp_path):
+    from opentenbase_tpu.storage.replication import StandbyCluster, WalSender
+
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=str(tmp_path))
+    s = c.session()
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("insert into t values (1),(2)")
+    sender = WalSender(c.persistence)
+    sb = StandbyCluster(str(tmp_path) + "_sb", num_datanodes=2, shard_groups=16)
+    sb.start_replication(sender.host, sender.port)
+    assert sb.wait_caught_up(c.persistence)
+    rs = sb.session()
+    rs.execute("prepare q as select count(*) from t where k >= $1")
+    assert rs.query("execute q(1)") == [(2,)]
+    with pytest.raises(SQLError, match="read-only"):
+        rs.execute("prepare w as insert into t values ($1)")
+        rs.query("execute w(9)")
+    sender.stop()
+    sb.stop()
