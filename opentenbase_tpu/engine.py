@@ -89,12 +89,54 @@ class Transaction:
         self.writes: dict[int, dict[str, _TableWrites]] = {}
         self.pinned: list[ShardStore] = []
         self.prepared_gid: Optional[str] = None
+        # (name, write-position marks) stack — see mark_savepoint
+        self.savepoints: list[tuple[str, dict]] = []
 
     def w(self, node: int, table: str) -> _TableWrites:
         return self.writes.setdefault(node, {}).setdefault(table, _TableWrites())
 
+    # -- savepoints (subtransactions; xact.c's subxact stack reduced to
+    # write-position marks over the batch write-sets) -------------------
+    def mark_savepoint(self, name: str) -> None:
+        snap = {
+            (node, table): (len(tw.ins_ranges), len(tw.del_idx))
+            for node, tabs in self.writes.items()
+            for table, tw in tabs.items()
+        }
+        self.savepoints.append((name, snap))
+
+    def _find_savepoint(self, name: str) -> int:
+        for i in range(len(self.savepoints) - 1, -1, -1):
+            if self.savepoints[i][0] == name:
+                return i
+        raise SQLError(f'savepoint "{name}" does not exist')
+
+    def rollback_to_savepoint(self, name: str, stores) -> None:
+        idx = self._find_savepoint(name)
+        _n, snap = self.savepoints[idx]
+        for node, tabs in self.writes.items():
+            for table, tw in tabs.items():
+                n_ins, n_del = snap.get((node, table), (0, 0))
+                store = stores[node][table]
+                for s, e in tw.ins_ranges[n_ins:]:
+                    store.truncate_range(s, e)
+                del tw.ins_ranges[n_ins:]
+                del tw.del_idx[n_del:]
+        # the savepoint survives the rollback (PG semantics); later
+        # savepoints are destroyed
+        del self.savepoints[idx + 1 :]
+
+    def release_savepoint(self, name: str) -> None:
+        del self.savepoints[self._find_savepoint(name):]
+
     def touched_nodes(self) -> list[int]:
-        return [n for n, tabs in self.writes.items() if tabs]
+        # write-sets can become empty after ROLLBACK TO SAVEPOINT: only
+        # nodes with surviving writes count as 2PC participants
+        return [
+            n
+            for n, tabs in self.writes.items()
+            if any(tw.ins_ranges or tw.del_idx for tw in tabs.values())
+        ]
 
     def own_writes_view(self) -> dict[int, dict[str, tuple]]:
         return {
@@ -651,6 +693,8 @@ class Session:
         # session-local; EXECUTE's bound statement re-enters
         # _execute_one and is gated on its own class there
         A.PrepareStmt, A.ExecuteStmt, A.DeallocateStmt,
+        # txn-local marks, permitted in hot-standby read-only txns
+        A.SavepointStmt, A.RollbackToSavepoint, A.ReleaseSavepoint,
     )
 
     def _is_readonly_stmt(self, stmt: A.Statement) -> bool:
@@ -1146,6 +1190,28 @@ class Session:
         info = self.cluster.gts.begin()
         self.txn = Transaction(info.gxid, info.start_ts)
         return Result("BEGIN")
+
+    def _x_savepointstmt(self, stmt: A.SavepointStmt) -> Result:
+        if self.txn is None:
+            raise SQLError("SAVEPOINT can only be used in transaction blocks")
+        self.txn.mark_savepoint(stmt.name)
+        return Result("SAVEPOINT")
+
+    def _x_rollbacktosavepoint(self, stmt: A.RollbackToSavepoint) -> Result:
+        if self.txn is None:
+            raise SQLError(
+                "ROLLBACK TO SAVEPOINT can only be used in transaction blocks"
+            )
+        self.txn.rollback_to_savepoint(stmt.name, self.cluster.stores)
+        return Result("ROLLBACK")
+
+    def _x_releasesavepoint(self, stmt: A.ReleaseSavepoint) -> Result:
+        if self.txn is None:
+            raise SQLError(
+                "RELEASE SAVEPOINT can only be used in transaction blocks"
+            )
+        self.txn.release_savepoint(stmt.name)
+        return Result("RELEASE")
 
     def _x_commitstmt(self, stmt: A.CommitStmt) -> Result:
         if self.txn is None:

@@ -315,6 +315,21 @@ class CreateTable(Statement):
 
 
 @dataclass
+class SavepointStmt(Statement):
+    name: str
+
+
+@dataclass
+class RollbackToSavepoint(Statement):
+    name: str
+
+
+@dataclass
+class ReleaseSavepoint(Statement):
+    name: str
+
+
+@dataclass
 class PrepareStmt(Statement):
     """PREPARE name AS statement (prepare.c / the extended-protocol Parse
     message)."""

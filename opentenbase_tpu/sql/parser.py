@@ -146,7 +146,17 @@ class Parser:
             self.eat_kw("transaction") or self.eat_kw("work")
             if self.eat_kw("prepared"):
                 return A.RollbackPrepared(self._string_lit())
+            if self.eat_kw("to"):
+                self.eat_kw("savepoint")
+                return A.RollbackToSavepoint(self.ident("savepoint name"))
             return A.RollbackStmt()
+        if kw == "savepoint":
+            self.advance()
+            return A.SavepointStmt(self.ident("savepoint name"))
+        if kw == "release":
+            self.advance()
+            self.eat_kw("savepoint")
+            return A.ReleaseSavepoint(self.ident("savepoint name"))
         if kw == "prepare":
             self.advance()
             if self.eat_kw("transaction"):

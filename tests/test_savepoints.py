@@ -1,0 +1,98 @@
+"""Savepoint (subtransaction) tests — xact.c's subxact surface."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster, SQLError
+
+
+@pytest.fixture()
+def s():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    sess = c.session()
+    sess.execute("create table t (k bigint, v text) distribute by shard(k)")
+    sess.execute("insert into t values (1,'base')")
+    return sess
+
+
+def test_rollback_to_savepoint_undoes_partially(s):
+    s.execute("begin")
+    s.execute("insert into t values (2,'keep')")
+    s.execute("savepoint sp1")
+    s.execute("insert into t values (3,'drop')")
+    s.execute("delete from t where k = 1")
+    assert s.query("select count(*) from t") == [(2,)]  # 2,3 live; 1 deleted
+    s.execute("rollback to savepoint sp1")
+    assert [x[0] for x in s.query("select k from t order by k")] == [1, 2]
+    s.execute("commit")
+    assert [x[0] for x in s.query("select k from t order by k")] == [1, 2]
+
+
+def test_savepoint_reusable_after_rollback(s):
+    s.execute("begin")
+    s.execute("savepoint a")
+    s.execute("insert into t values (10,'x')")
+    s.execute("rollback to savepoint a")
+    s.execute("insert into t values (11,'y')")
+    s.execute("rollback to savepoint a")  # survives; undoes 11 too
+    s.execute("insert into t values (12,'z')")
+    s.execute("commit")
+    assert [x[0] for x in s.query("select k from t order by k")] == [1, 12]
+
+
+def test_nested_savepoints_and_release(s):
+    s.execute("begin")
+    s.execute("savepoint outer1")
+    s.execute("insert into t values (20,'a')")
+    s.execute("savepoint inner1")
+    s.execute("insert into t values (21,'b')")
+    s.execute("rollback to savepoint inner1")
+    s.execute("release savepoint outer1")  # destroys outer1 AND inner1
+    with pytest.raises(SQLError, match="does not exist"):
+        s.execute("rollback to savepoint inner1")
+    s.execute("commit")
+    assert [x[0] for x in s.query("select k from t order by k")] == [1, 20]
+
+
+def test_savepoint_outside_txn_rejected(s):
+    with pytest.raises(SQLError, match="transaction blocks"):
+        s.execute("savepoint nope")
+    with pytest.raises(SQLError, match="transaction blocks"):
+        s.execute("rollback to savepoint nope")
+
+
+def test_full_rollback_discards_savepoint_work(s):
+    s.execute("begin")
+    s.execute("savepoint sp")
+    s.execute("insert into t values (30,'gone')")
+    s.execute("rollback")
+    assert s.query("select count(*) from t") == [(1,)]
+
+
+def test_update_rolled_back_to_savepoint(s):
+    s.execute("begin")
+    s.execute("savepoint sp")
+    s.execute("update t set v = 'changed' where k = 1")
+    assert s.query("select v from t where k = 1") == [("changed",)]
+    s.execute("rollback to savepoint sp")
+    assert s.query("select v from t where k = 1") == [("base",)]
+    s.execute("commit")
+    assert s.query("select v from t where k = 1") == [("base",)]
+
+
+def test_rollback_to_savepoint_clears_2pc_participation(s):
+    """A node whose writes were all undone must not count as a 2PC
+    participant at commit."""
+    c = s.cluster
+    s.execute("begin")
+    s.execute("savepoint before_all")
+    # this batch spans both datanodes; roll ALL of it back
+    s.execute("insert into t values (100,'a'),(101,'b'),(102,'c'),(103,'d')")
+    txn = s.txn
+    assert len(txn.touched_nodes()) == 2
+    s.execute("rollback to savepoint before_all")
+    assert txn.touched_nodes() == []
+    s.execute("insert into t values (200,'z')")  # exactly one node again
+    assert len(txn.touched_nodes()) == 1
+    s.execute("commit")
+    assert [p.gid for p in c.gts.prepared_txns()] == []  # no implicit 2PC
+    assert s.query("select v from t where k = 200") == [("z",)]
