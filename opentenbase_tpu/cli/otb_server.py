@@ -23,14 +23,18 @@ def main(argv=None) -> int:
     ap.add_argument("--datanodes", type=int, default=2)
     ap.add_argument("--shard-groups", type=int, default=256)
     ap.add_argument("--gts", choices=["python", "native"], default="python")
+    ap.add_argument("--wal-port", type=int, default=None,
+                    help="serve the WAL stream for standbys (walsender)")
     args = ap.parse_args(argv)
 
     from opentenbase_tpu.engine import Cluster
     from opentenbase_tpu.net.server import ClusterServer
 
+    if args.recover and args.data_dir is None:
+        ap.error("--recover requires --data-dir")
+    if args.wal_port is not None and args.data_dir is None:
+        ap.error("--wal-port requires --data-dir")
     if args.recover:
-        if args.data_dir is None:
-            ap.error("--recover requires --data-dir")
         cluster = Cluster.recover(
             args.data_dir, args.datanodes, args.shard_groups,
             gts_backend=args.gts,
@@ -41,12 +45,25 @@ def main(argv=None) -> int:
             gts_backend=args.gts,
         )
     server = ClusterServer(cluster, args.host, args.port).start()
-    print(f"opentenbase_tpu coordinator listening on {server.host}:{server.port}")
+    sender = None
+    if args.wal_port is not None:
+        from opentenbase_tpu.storage.replication import WalSender
+
+        sender = WalSender(cluster.persistence, args.host, args.wal_port)
+        print(f"walsender on {sender.host}:{sender.port}", flush=True)
+    # flush: otb_ctl tails the redirected log for this ready marker, and a
+    # block-buffered banner would never reach the file
+    print(
+        f"opentenbase_tpu coordinator listening on {server.host}:{server.port}",
+        flush=True,
+    )
 
     done = threading.Event()
     signal.signal(signal.SIGINT, lambda *a: done.set())
     signal.signal(signal.SIGTERM, lambda *a: done.set())
     done.wait()
+    if sender is not None:
+        sender.stop()
     server.stop()
     cluster.close()
     return 0
