@@ -2014,7 +2014,74 @@ def _sv_partitions(c: Cluster):
     return rows
 
 
+def _sv_memory(c: Cluster):
+    """Per-shard memory accounting (contrib/opentenbase_memory_tools)."""
+    rows = []
+    for node, tabs in c.stores.items():
+        for name, store in tabs.items():
+            if name in _SYSTEM_VIEWS:
+                continue
+            col_bytes = sum(a.nbytes for a in store._cols.values())
+            vm_bytes = sum(
+                v.nbytes for v in store._validity.values() if v is not None
+            )
+            mvcc_bytes = (
+                store.xmin_ts.nbytes + store.xmax_ts.nbytes
+                + store.row_id.nbytes
+            )
+            dict_bytes = sum(
+                sum(len(s.encode()) for s in d.values)
+                for d in store.dictionaries.values()
+            )
+            rows.append(
+                (name, node, store.nrows, store._capacity,
+                 col_bytes + vm_bytes + mvcc_bytes, dict_bytes)
+            )
+    return rows
+
+
+def _sv_node_health(c: Cluster):
+    """Cluster liveness (clustermon.c + contrib/pgxc_monitor): every node
+    plus the GTM, with a live probe."""
+    rows = []
+    try:
+        gts_ok = (
+            c.gts.ping() if hasattr(c.gts, "ping")
+            else c.gts.get_gts() > 0
+        )
+    except Exception:
+        gts_ok = False
+    rows.append(("gtm", "gtm", bool(gts_ok), 0))
+    for n in c.nodes.all_nodes():
+        if n.role == NodeRole.DATANODE:
+            ntables = len(c.stores.get(n.mesh_index, {}))
+            rows.append((n.name, "datanode", True, ntables))
+        else:
+            rows.append((n.name, n.role.value, True, 0))
+    return rows
+
+
 _SYSTEM_VIEWS: dict[str, tuple] = {
+    "pg_stat_memory": (
+        {
+            "relname": t.TEXT,
+            "node_index": t.INT4,
+            "n_rows": t.INT8,
+            "capacity": t.INT8,
+            "store_bytes": t.INT8,
+            "dict_bytes": t.INT8,
+        },
+        _sv_memory,
+    ),
+    "pgxc_node_health": (
+        {
+            "node_name": t.TEXT,
+            "role": t.TEXT,
+            "alive": t.BOOL,
+            "n_tables": t.INT4,
+        },
+        _sv_node_health,
+    ),
     "pg_partitions": (
         {
             "parent": t.TEXT,

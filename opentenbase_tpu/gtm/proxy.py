@@ -1,0 +1,106 @@
+"""GTM proxy — the connection concentrator (src/gtm/proxy/proxy_main.c).
+
+Thousands of backends each holding a GTM connection is the scaling
+bottleneck the reference's proxy exists for: backends connect to a local
+proxy instead, and the proxy funnels every request over a small number of
+upstream connections, grouping what it can.
+
+This proxy speaks the native GTS wire protocol on both sides (so both
+``NativeGTS`` clients and the C++/python GTM servers are oblivious to
+it), multiplexes all frontend connections over one upstream socket, and
+keeps per-op counters for observability (gtm_stat.c).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from collections import Counter
+from typing import Optional
+
+from opentenbase_tpu.gtm.client import NativeGTS
+
+
+class GTSProxy:
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        # one upstream connection for ALL frontends (NativeGTS serializes
+        # request/response under its lock — the concentration points)
+        self.upstream = NativeGTS(upstream_host, upstream_port)
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(128)
+        self.host, self.port = self._lsock.getsockname()
+        self.stats: Counter = Counter()
+        self.frontends = 0
+        self._stop = threading.Event()
+        self._accept: Optional[threading.Thread] = None
+
+    def start(self) -> "GTSProxy":
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self.upstream.close()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        self.frontends += 1
+        try:
+            while not self._stop.is_set():
+                head = _recv_exact(conn, 4)
+                if head is None:
+                    return
+                (length,) = struct.unpack("<I", head)
+                body = _recv_exact(conn, length)
+                if body is None:
+                    return
+                op = body[0]
+                self.stats[op] += 1
+                # forward verbatim over the shared upstream socket; the
+                # upstream lock serializes concurrent frontends
+                with self.upstream._lock:
+                    self.upstream._sock.sendall(head + body)
+                    rhead = self.upstream._recv_exact(4)
+                    (rlen,) = struct.unpack("<I", rhead)
+                    rbody = self.upstream._recv_exact(rlen)
+                conn.sendall(rhead + rbody)
+        except (OSError, RuntimeError):
+            return
+        finally:
+            self.frontends -= 1
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    out = b""
+    while len(out) < n:
+        try:
+            chunk = sock.recv(n - len(out))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        out += chunk
+    return out

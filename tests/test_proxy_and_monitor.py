@@ -1,0 +1,80 @@
+"""GTM proxy (connection concentrator, src/gtm/proxy) and the
+memory/health observability views (opentenbase_memory_tools,
+clustermon/pgxc_monitor)."""
+
+import threading
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.gtm.client import NativeGTS
+from opentenbase_tpu.gtm.gts import GTSServer
+from opentenbase_tpu.gtm.proxy import GTSProxy
+from opentenbase_tpu.gtm.server import GTSFrontend
+
+
+@pytest.fixture()
+def proxied():
+    gtm = GTSServer()
+    fe = GTSFrontend(gtm).start()
+    proxy = GTSProxy(fe.host, fe.port).start()
+    yield gtm, proxy
+    proxy.stop()
+    fe.stop()
+
+
+def test_proxy_forwards_full_protocol(proxied):
+    gtm, proxy = proxied
+    cli = NativeGTS(proxy.host, proxy.port)
+    assert cli.ping()
+    info = cli.begin()
+    cli.prepare(info.gxid, "via_proxy", (0,))
+    assert [p.gid for p in cli.prepared_txns()] == ["via_proxy"]
+    ts = cli.commit(info.gxid)
+    assert cli.get_gts() > ts
+    cli.create_sequence("ps", start=7)
+    assert cli.nextval("ps") == (7, 7)
+    assert proxy.stats  # per-op counters populated
+
+
+def test_proxy_concentrates_many_frontends(proxied):
+    gtm, proxy = proxied
+    results: list[int] = []
+    lock = threading.Lock()
+
+    def worker():
+        cli = NativeGTS(proxy.host, proxy.port)
+        got = [cli.get_gts() for _ in range(25)]
+        with lock:
+            results.extend(got)
+        cli.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # 200 timestamps through ONE upstream socket: all unique, monotonic
+    assert len(results) == 200
+    assert len(set(results)) == 200
+
+
+def test_memory_and_health_views():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute("create table t (k bigint, v text) distribute by shard(k)")
+    s.execute("insert into t values (1,'aaaa'),(2,'bbbb'),(3,'cccc')")
+    rows = s.query(
+        "select relname, n_rows, store_bytes, dict_bytes from pg_stat_memory"
+        " where relname = 't' order by node_index"
+    )
+    assert rows and sum(r[1] for r in rows) == 3
+    assert all(r[2] > 0 for r in rows)
+    assert sum(r[3] for r in rows) > 0  # dictionary bytes accounted
+
+    health = s.query(
+        "select node_name, role, alive from pgxc_node_health order by node_name"
+    )
+    names = {r[0] for r in health}
+    assert {"gtm", "cn0", "dn0", "dn1"} <= names
+    assert all(r[2] for r in health)  # everything alive in-process
