@@ -2017,6 +2017,7 @@ def _sv_partitions(c: Cluster):
 def _sv_memory(c: Cluster):
     """Per-shard memory accounting (contrib/opentenbase_memory_tools)."""
     rows = []
+    seen_dicts: set[int] = set()
     for node, tabs in c.stores.items():
         for name, store in tabs.items():
             if name in _SYSTEM_VIEWS:
@@ -2029,10 +2030,13 @@ def _sv_memory(c: Cluster):
                 store.xmin_ts.nbytes + store.xmax_ts.nbytes
                 + store.row_id.nbytes
             )
-            dict_bytes = sum(
-                sum(len(s.encode()) for s in d.values)
-                for d in store.dictionaries.values()
-            )
+            # dictionaries are SHARED across a table's node stores (and a
+            # partitioned table's children): attribute each object once
+            dict_bytes = 0
+            for d in store.dictionaries.values():
+                if id(d) not in seen_dicts:
+                    seen_dicts.add(id(d))
+                    dict_bytes += sum(len(s.encode()) for s in d.values)
             rows.append(
                 (name, node, store.nrows, store._capacity,
                  col_bytes + vm_bytes + mvcc_bytes, dict_bytes)
@@ -2054,7 +2058,11 @@ def _sv_node_health(c: Cluster):
     rows.append(("gtm", "gtm", bool(gts_ok), 0))
     for n in c.nodes.all_nodes():
         if n.role == NodeRole.DATANODE:
-            ntables = len(c.stores.get(n.mesh_index, {}))
+            ntables = sum(
+                1
+                for name in c.stores.get(n.mesh_index, {})
+                if name not in _SYSTEM_VIEWS
+            )
             rows.append((n.name, "datanode", True, ntables))
         else:
             rows.append((n.name, n.role.value, True, 0))

@@ -25,6 +25,7 @@ from opentenbase_tpu.gtm.client import NativeGTS
 class GTSProxy:
     def __init__(self, upstream_host: str, upstream_port: int,
                  host: str = "127.0.0.1", port: int = 0):
+        self.upstream_host, self.upstream_port = upstream_host, upstream_port
         # one upstream connection for ALL frontends (NativeGTS serializes
         # request/response under its lock — the concentration points)
         self.upstream = NativeGTS(upstream_host, upstream_port)
@@ -70,19 +71,16 @@ class GTSProxy:
                 if head is None:
                     return
                 (length,) = struct.unpack("<I", head)
+                if length == 0:  # malformed frame: drop the client
+                    return
                 body = _recv_exact(conn, length)
                 if body is None:
                     return
-                op = body[0]
-                self.stats[op] += 1
-                # forward verbatim over the shared upstream socket; the
-                # upstream lock serializes concurrent frontends
-                with self.upstream._lock:
-                    self.upstream._sock.sendall(head + body)
-                    rhead = self.upstream._recv_exact(4)
-                    (rlen,) = struct.unpack("<I", rhead)
-                    rbody = self.upstream._recv_exact(rlen)
-                conn.sendall(rhead + rbody)
+                self.stats[body[0]] += 1
+                reply = self._exchange(head + body)
+                if reply is None:
+                    return  # upstream failed mid-exchange: see _exchange
+                conn.sendall(reply)
         except (OSError, RuntimeError):
             return
         finally:
@@ -91,6 +89,35 @@ class GTSProxy:
                 conn.close()
             except OSError:
                 pass
+
+    def _exchange(self, frame: bytes) -> Optional[bytes]:
+        """One request/response over the shared upstream socket. A failed
+        exchange (timeout, reset) leaves the stream in an unknown framing
+        state, so the connection is REPLACED before any other frontend
+        can read a stale response as its own — and this request is NOT
+        retried (ops like BEGIN are not idempotent)."""
+        with self.upstream._lock:
+            try:
+                self.upstream._sock.sendall(frame)
+                rhead = self.upstream._recv_exact(4)
+                (rlen,) = struct.unpack("<I", rhead)
+                rbody = self.upstream._recv_exact(rlen)
+                return rhead + rbody
+            except (OSError, RuntimeError):
+                try:
+                    self.upstream._sock.close()
+                except OSError:
+                    pass
+                try:
+                    self.upstream._sock = socket.create_connection(
+                        (self.upstream_host, self.upstream_port), timeout=10
+                    )
+                    self.upstream._sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+                except OSError:
+                    pass  # next exchange will fail fast and retry anew
+                return None
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

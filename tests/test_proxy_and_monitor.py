@@ -78,3 +78,34 @@ def test_memory_and_health_views():
     names = {r[0] for r in health}
     assert {"gtm", "cn0", "dn0", "dn1"} <= names
     assert all(r[2] for r in health)  # everything alive in-process
+
+
+def test_proxy_survives_upstream_restart(proxied):
+    """A failed upstream exchange replaces the connection instead of
+    leaving other frontends reading desynced responses."""
+    gtm, proxy = proxied
+    cli = NativeGTS(proxy.host, proxy.port)
+    assert cli.ping()
+    # kill the upstream socket out from under the proxy
+    proxy.upstream._sock.close()
+    try:
+        cli.ping()  # this exchange fails; frontend conn is dropped
+    except Exception:
+        pass
+    # a NEW frontend gets correct service over the replaced upstream
+    cli2 = NativeGTS(proxy.host, proxy.port)
+    a = cli2.get_gts()
+    b = cli2.get_gts()
+    assert b > a
+    cli2.close()
+
+
+def test_health_counts_exclude_system_views():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute("create table only1 (k bigint) distribute by shard(k)")
+    s.query("select count(*) from pg_stat_memory")  # materializes a view
+    rows = s.query(
+        "select n_tables from pgxc_node_health where role = 'datanode'"
+    )
+    assert all(r[0] == 1 for r in rows)
