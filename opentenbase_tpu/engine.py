@@ -217,6 +217,8 @@ class Cluster:
         # interval/range partitioning: parent name -> PartitionSpec
         # (children are real catalog tables named parent$pK)
         self.partitions: dict[str, "PartitionSpec"] = {}
+        # views: name -> (query AST template, verbatim body text)
+        self.views: dict[str, tuple] = {}
         # observability (SURVEY §5): session registry + per-statement stats.
         # Sessions register weakly so short-lived connections don't pin
         # memory or linger forever in pg_stat_cluster_activity.
@@ -724,12 +726,60 @@ class Session:
             raise SQLError(f"unsupported statement {type(stmt).__name__}")
         return h(stmt)
 
-    # -- partitioned-table routing/rewrite --------------------------------
+    # -- view + partitioned-table rewrite ---------------------------------
+    def _expand_views(self, stmt: A.Statement):
+        views = self.cluster.views
+        if not views:
+            return stmt
+        from opentenbase_tpu.plan.views import (
+            ViewRecursionError,
+            rewrite_views,
+        )
+
+        try:
+            if isinstance(stmt, A.Select):
+                rewrite_views(stmt, views)
+            elif isinstance(stmt, A.ExplainStmt) and isinstance(
+                stmt.query, A.Select
+            ):
+                rewrite_views(stmt.query, views)
+            elif isinstance(stmt, A.Insert):
+                if stmt.table in views:
+                    raise SQLError(
+                        f'cannot insert into view "{stmt.table}"'
+                    )
+                if stmt.query is not None:
+                    rewrite_views(stmt.query, views)
+            elif isinstance(stmt, (A.Update, A.Delete)):
+                if stmt.table in views:
+                    verb = "update" if isinstance(stmt, A.Update) else "delete from"
+                    raise SQLError(f'cannot {verb} view "{stmt.table}"')
+                if stmt.where is not None:
+                    from opentenbase_tpu.plan.views import _expr_subqueries
+
+                    _expr_subqueries(stmt.where, views, 0)
+            elif isinstance(stmt, (A.DropTable, A.TruncateTable)):
+                for n in stmt.names:
+                    if n in views:
+                        raise SQLError(
+                            f'"{n}" is a view (use DROP VIEW)'
+                        )
+            elif isinstance(stmt, A.CreateTableAs):
+                rewrite_views(stmt.query, views)
+        except ViewRecursionError as e:
+            raise SQLError(str(e))
+        return stmt
+
     def _expand_partitions(self, stmt: A.Statement):
+        stmt = self._expand_views(stmt)
         parts = self.cluster.partitions
         if not parts:
             return stmt
         from opentenbase_tpu.plan.partition import rewrite_select
+
+        if isinstance(stmt, A.CreateTableAs):
+            rewrite_select(stmt.query, parts)
+            return stmt
 
         if isinstance(stmt, A.Select):
             return rewrite_select(stmt, parts)
@@ -772,6 +822,13 @@ class Session:
                         f'"{child_names[n]}" (drop the parent instead)'
                     )
                 if n in parts:
+                    if isinstance(stmt, A.DropTable):
+                        deps = self._dependent_views(n)
+                        if deps:
+                            raise SQLError(
+                                f'cannot drop table "{n}": view(s) '
+                                f"{', '.join(sorted(deps))} depend on it"
+                            )
                     names.extend(parts[n].children())
                     if isinstance(stmt, A.DropTable):
                         spec = parts.pop(n)
@@ -1396,8 +1453,110 @@ class Session:
             key = stmt.columns[0].name
         return DistributionSpec(DistStrategy.SHARD, (key,), group=stmt.to_group)
 
+    # -- views ------------------------------------------------------------
+    def _x_createview(self, stmt: A.CreateView) -> Result:
+        c = self.cluster
+        if stmt.name in _SYSTEM_VIEWS:
+            raise SQLError(
+                f'relation name "{stmt.name}" is reserved for a system view'
+            )
+        if c.catalog.has(stmt.name) or stmt.name in c.partitions:
+            raise SQLError(f'"{stmt.name}" already exists as a table')
+        if stmt.name in c.views and not stmt.replace:
+            raise SQLError(f'view "{stmt.name}" already exists')
+        # validate now: the fully-expanded body must analyze (view.c
+        # checks the definition at CREATE time, not first use)
+        import copy
+
+        from opentenbase_tpu.plan.views import rewrite_views
+
+        probe = rewrite_views(copy.deepcopy(stmt.query), c.views)
+        self._expand_partitions(probe)
+        prune_columns(analyze_statement(probe, c.catalog))
+        c.views[stmt.name] = (stmt.query, stmt.text)
+        if c.persistence is not None:
+            c.persistence.log_ddl(
+                {"op": "create_view", "name": stmt.name, "text": stmt.text}
+            )
+        return Result("CREATE VIEW")
+
+    def _dependent_views(self, relname: str) -> list[str]:
+        """Views whose definitions reference ``relname`` (pg_depend)."""
+        from opentenbase_tpu.plan.astwalk import relation_names
+
+        return [
+            vname
+            for vname, (q, _text) in self.cluster.views.items()
+            if vname != relname and relname in relation_names(q)
+        ]
+
+    def _x_dropview(self, stmt: A.DropView) -> Result:
+        c = self.cluster
+        if stmt.name not in c.views:
+            if stmt.if_exists:
+                return Result("DROP VIEW")
+            raise SQLError(f'view "{stmt.name}" does not exist')
+        deps = self._dependent_views(stmt.name)
+        if deps:
+            raise SQLError(
+                f'cannot drop view "{stmt.name}": view(s) '
+                f"{', '.join(sorted(deps))} depend on it"
+            )
+        del c.views[stmt.name]
+        if c.persistence is not None:
+            c.persistence.log_ddl({"op": "drop_view", "name": stmt.name})
+        return Result("DROP VIEW")
+
+    def _x_createtableas(self, stmt: A.CreateTableAs) -> Result:
+        c = self.cluster
+        if stmt.name in _SYSTEM_VIEWS:
+            raise SQLError(
+                f'relation name "{stmt.name}" is reserved for a system view'
+            )
+        if c.catalog.has(stmt.name) or stmt.name in c.views:
+            if stmt.if_not_exists:
+                return Result("CREATE TABLE")
+            raise SQLError(f'relation "{stmt.name}" already exists')
+        batch = self._run_select(stmt.query)
+        schema: dict[str, t.SqlType] = {}
+        for name, col in batch.columns.items():
+            if name in schema or not name:
+                raise SQLError(
+                    "CREATE TABLE AS needs unique, named output columns"
+                )
+            schema[name] = col.type
+        if not schema:
+            raise SQLError("CREATE TABLE AS needs at least one column")
+        dist = DistributionSpec(DistStrategy.ROUNDROBIN)
+        meta = c.catalog.create_table(stmt.name, schema, dist)
+        c.create_table_stores(meta)
+        self._log_create_table(stmt.name, schema, dist)
+        # re-encode through the new table's dictionaries
+        data = {
+            name: col.to_python() for name, col in batch.columns.items()
+        }
+        full = ColumnBatch.from_pydict(data, meta.schema, meta.dictionaries)
+        txn, implicit = self._begin_implicit()
+        try:
+            n = self._route_and_append(meta, full, txn)
+        except Exception:
+            if implicit:
+                self._abort_txn(txn)
+            raise
+        if implicit:
+            self._commit_txn(txn)
+        else:
+            self.txn = txn
+        return Result("CREATE TABLE AS", rowcount=n)
+
     def _x_droptable(self, stmt: A.DropTable) -> Result:
         for name in stmt.names:
+            deps = self._dependent_views(name)
+            if deps:
+                raise SQLError(
+                    f'cannot drop table "{name}": view(s) '
+                    f"{', '.join(sorted(deps))} depend on it"
+                )
             if not self.cluster.catalog.has(name):
                 if stmt.if_exists:
                     continue
@@ -2069,7 +2228,15 @@ def _sv_node_health(c: Cluster):
     return rows
 
 
+def _sv_views(c: Cluster):
+    return [(name, text) for name, (_q, text) in c.views.items()]
+
+
 _SYSTEM_VIEWS: dict[str, tuple] = {
+    "pg_views": (
+        {"viewname": t.TEXT, "definition": t.TEXT},
+        _sv_views,
+    ),
     "pg_stat_memory": (
         {
             "relname": t.TEXT,

@@ -254,31 +254,16 @@ def rewrite_select(sel: A.Select, partitions: dict) -> A.Select:
         sel.from_clause = expand_ref(sel.from_clause, sel.where)
     for _op, sub in sel.set_ops:
         rewrite_select(sub, partitions)
-    for e in _select_exprs(sel):
-        _rewrite_expr_subqueries(e, partitions)
+    from opentenbase_tpu.plan.astwalk import select_exprs, walk_expr_subqueries
+
+    for e in select_exprs(sel):
+        walk_expr_subqueries(e, lambda q: rewrite_select(q, partitions))
     return sel
 
 
-def _select_exprs(sel: A.Select):
-    for it in sel.items:
-        yield it.expr
-    if sel.where is not None:
-        yield sel.where
-    if sel.having is not None:
-        yield sel.having
-    yield from sel.group_by
-    for si in sel.order_by:
-        yield si.expr
-
-
 def _rewrite_expr_subqueries(e: A.Expr, partitions: dict) -> None:
-    if isinstance(e, (A.InSubquery, A.ExistsSubquery, A.ScalarSubquery)):
-        rewrite_select(e.query, partitions)
-    for f in getattr(e, "__dataclass_fields__", {}):
-        v = getattr(e, f)
-        if isinstance(v, A.Expr):
-            _rewrite_expr_subqueries(v, partitions)
-        elif isinstance(v, (list, tuple)):
-            for x in v:
-                if isinstance(x, A.Expr):
-                    _rewrite_expr_subqueries(x, partitions)
+    """Expand partitioned parents inside the subqueries of one bare
+    expression tree (DML WHERE clauses)."""
+    from opentenbase_tpu.plan.astwalk import walk_expr_subqueries
+
+    walk_expr_subqueries(e, lambda q: rewrite_select(q, partitions))

@@ -1,0 +1,66 @@
+"""View expansion — the rewriter (src/backend/rewrite/rewriteHandler.c).
+
+A view is a named, durable SELECT; references expand to derived tables
+before analysis, exactly like the reference's rule-based rewrite. The
+stored AST template is never handed out directly: every expansion deep-
+copies it, because downstream rewrites (partition expansion) mutate
+trees in place.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from opentenbase_tpu.sql import ast as A
+
+MAX_DEPTH = 32
+
+
+class ViewRecursionError(ValueError):
+    pass
+
+
+def rewrite_views(sel: A.Select, views: dict, depth: int = 0) -> A.Select:
+    """Mutates ``sel`` in place, replacing view references with derived
+    tables (SubqueryRef over a fresh copy of the view's SELECT, itself
+    view-expanded)."""
+    if depth > MAX_DEPTH:
+        raise ViewRecursionError(
+            "infinite recursion detected in view expansion"
+        )
+
+    def expand_ref(ref):
+        if isinstance(ref, A.RelRef) and ref.name in views:
+            body = copy.deepcopy(views[ref.name][0])
+            rewrite_views(body, views, depth + 1)
+            return A.SubqueryRef(body, ref.alias or ref.name)
+        if isinstance(ref, A.JoinRef):
+            import dataclasses
+
+            return dataclasses.replace(
+                ref, left=expand_ref(ref.left), right=expand_ref(ref.right)
+            )
+        if isinstance(ref, A.SubqueryRef):
+            rewrite_views(ref.query, views, depth + 1)
+            return ref
+        return ref
+
+    if sel.from_clause is not None:
+        sel.from_clause = expand_ref(sel.from_clause)
+    for _op, sub in sel.set_ops:
+        rewrite_views(sub, views, depth + 1)
+    from opentenbase_tpu.plan.astwalk import select_exprs, walk_expr_subqueries
+
+    for e in select_exprs(sel):
+        walk_expr_subqueries(
+            e, lambda q: rewrite_views(q, views, depth + 1)
+        )
+    return sel
+
+
+def _expr_subqueries(e, views: dict, depth: int) -> None:
+    """Expand views inside the subqueries of one expression tree (for
+    statements that carry bare expressions, e.g. DML WHERE clauses)."""
+    from opentenbase_tpu.plan.astwalk import walk_expr_subqueries
+
+    walk_expr_subqueries(e, lambda q: rewrite_views(q, views, depth + 1))

@@ -350,6 +350,27 @@ class DeallocateStmt(Statement):
 
 
 @dataclass
+class CreateView(Statement):
+    name: str
+    query: "Select"
+    text: str = ""  # verbatim body source (pg_get_viewdef)
+    replace: bool = False
+
+
+@dataclass
+class DropView(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateTableAs(Statement):
+    name: str
+    query: "Select"
+    if_not_exists: bool = False
+
+
+@dataclass
 class AlterTable(Statement):
     """ALTER TABLE: schema evolution + online redistribution (the XL
     ALTER TABLE ... DISTRIBUTE BY path, redistrib.c) + interval-partition

@@ -473,6 +473,11 @@ class Parser:
     # -- CREATE ... -----------------------------------------------------
     def parse_create(self) -> A.Statement:
         self.expect_kw("create")
+        if self.eat_kw("or", "replace"):
+            self.expect_kw("view")
+            return self._create_view(replace=True)
+        if self.eat_kw("view"):
+            return self._create_view(replace=False)
         if self.eat_kw("table"):
             return self._create_table()
         if self.at_kw("unique", "index") or self.at_kw("index"):
@@ -527,9 +532,12 @@ class Parser:
             return A.CreateSequence(name, start, increment, ine)
         self.error("unsupported CREATE")
 
-    def _create_table(self) -> A.CreateTable:
+    def _create_table(self):
         if_not_exists = bool(self.eat_kw("if", "not", "exists"))
         name = self.ident("table name")
+        if self.eat_kw("as"):
+            # CREATE TABLE name AS select (ctas; default distribution)
+            return A.CreateTableAs(name, self.parse_select(), if_not_exists)
         self.expect_op("(")
         columns = [self._column_def()]
         while self.eat_op(","):
@@ -681,6 +689,18 @@ class Parser:
             return self._alter_table()
         self.error("unsupported ALTER")
 
+    def _create_view(self, replace: bool) -> A.Statement:
+        # CREATE [OR REPLACE] VIEW name AS select  (view.c); the body's
+        # source text is captured verbatim so the definition is durable
+        # and printable without a deparser (pg_get_viewdef analog)
+        name = self.ident("view name")
+        self.expect_kw("as")
+        start = self.cur.pos
+        query = self.parse_select()
+        end = self.cur.pos if self.cur.kind != Tok.EOF else len(self.sql)
+        text = self.sql[start:end].strip().rstrip(";").strip()
+        return A.CreateView(name, query, text, replace)
+
     def _alter_table(self) -> A.Statement:
         # ALTER TABLE name {ADD [COLUMN] def | DROP [COLUMN] name |
         #   DISTRIBUTE BY ... | ADD PARTITIONS (n)}  (tablecmds.c +
@@ -712,6 +732,9 @@ class Parser:
 
     def parse_drop(self) -> A.Statement:
         self.expect_kw("drop")
+        if self.eat_kw("view"):
+            if_exists = bool(self.eat_kw("if", "exists"))
+            return A.DropView(self.ident("view name"), if_exists)
         if self.eat_kw("table"):
             if_exists = bool(self.eat_kw("if", "exists"))
             names = [self.ident("table name")]

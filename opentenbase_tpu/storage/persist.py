@@ -324,6 +324,7 @@ class ClusterPersistence:
             "partitions": {
                 name: ps.spec for name, ps in c.partitions.items()
             },
+            "views": {name: text for name, (_q, text) in c.views.items()},
         }
         for name in c.catalog.table_names():
             tm = c.catalog.get(name)
@@ -594,6 +595,10 @@ class ClusterPersistence:
                                 store.row_id[:n] = z["__rowid"]
                                 store.next_row_id = int(z["__rowid"].max()) + 1
                 c.stores.setdefault(node, {})[name] = store
+        from opentenbase_tpu.sql.parser import Parser
+
+        for name, text in meta.get("views", {}).items():
+            c.views[name] = (Parser(text).parse_select(), text)
         from opentenbase_tpu.plan.partition import PartitionSpec
 
         for name, pclause in meta.get("partitions", {}).items():
@@ -679,6 +684,14 @@ class ClusterPersistence:
                         c.stores[n][header["name"]] = ShardStore(
                             meta.schema, meta.dictionaries
                         )
+            elif op == "create_view":
+                from opentenbase_tpu.sql.parser import Parser
+
+                c.views[header["name"]] = (
+                    Parser(header["text"]).parse_select(), header["text"]
+                )
+            elif op == "drop_view":
+                c.views.pop(header["name"], None)
             elif op == "add_column":
                 if c.catalog.has(header["name"]):
                     c.alter_add_column(

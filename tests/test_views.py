@@ -1,0 +1,148 @@
+"""Views (rewriteHandler.c rule expansion) and CREATE TABLE AS."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster, SQLError
+
+
+@pytest.fixture()
+def s():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    sess = c.session()
+    sess.execute(
+        "create table emp (id bigint, dept text, sal bigint)"
+        " distribute by shard(id)"
+    )
+    sess.execute(
+        "insert into emp values (1,'eng',100),(2,'eng',200),(3,'ops',50)"
+    )
+    return sess
+
+
+def test_view_select_and_join(s):
+    s.execute("create view eng as select id, sal from emp where dept = 'eng'")
+    assert s.query("select count(*) from eng") == [(2,)]
+    rows = s.query(
+        "select e.id, e.sal from eng e join emp on e.id = emp.id"
+        " where emp.sal > 150 order by e.id"
+    )
+    assert rows == [(2, 200)]
+
+
+def test_view_over_aggregate_and_nested_views(s):
+    s.execute(
+        "create view dept_tot as select dept, sum(sal) as total from emp"
+        " group by dept"
+    )
+    s.execute("create view big_depts as select dept from dept_tot where total > 100")
+    assert s.query("select dept from big_depts") == [("eng",)]
+
+
+def test_view_dml_rejected_and_drop_semantics(s):
+    s.execute("create view v1 as select id from emp")
+    with pytest.raises(SQLError, match="cannot insert into view"):
+        s.execute("insert into v1 values (9)")
+    with pytest.raises(SQLError, match="cannot update view"):
+        s.execute("update v1 set id = 9")
+    with pytest.raises(SQLError, match="use DROP VIEW"):
+        s.execute("drop table v1")
+    s.execute("drop view v1")
+    with pytest.raises(SQLError, match="does not exist"):
+        s.execute("drop view v1")
+    s.execute("drop view if exists v1")
+
+
+def test_create_or_replace_and_validation(s):
+    s.execute("create view v as select id from emp")
+    with pytest.raises(SQLError, match="already exists"):
+        s.execute("create view v as select sal from emp")
+    s.execute("create or replace view v as select sal from emp")
+    assert s.query("select count(*) from v") == [(3,)]
+    with pytest.raises(Exception):  # body must analyze at CREATE time
+        s.execute("create view broken as select nope from emp")
+    with pytest.raises(SQLError, match="already exists as a table"):
+        s.execute("create view emp as select 1 is not null")
+
+
+def test_pg_views_catalog(s):
+    s.execute("create view v2 as select id from emp where sal > 99")
+    rows = s.query("select definition from pg_views where viewname = 'v2'")
+    assert rows == [("select id from emp where sal > 99",)]
+
+
+def test_views_survive_recovery(tmp_path):
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=str(tmp_path))
+    s = c.session()
+    s.execute("create table t (k bigint, v text) distribute by shard(k)")
+    s.execute("insert into t values (1,'a'),(2,'b')")
+    s.execute("create view recent as select k from t where k > 1")
+    s.execute("create view doomed as select k from t")
+    s.execute("drop view doomed")
+
+    r = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=16)
+    rs = r.session()
+    assert rs.query("select k from recent") == [(2,)]
+    with pytest.raises(Exception):
+        rs.query("select * from doomed")
+
+
+def test_view_over_partitioned_table(s):
+    c = s.cluster
+    s.execute(
+        "create table m (id bigint, ts bigint) partition by range (ts)"
+        " begin (0) step (100) partitions (2) distribute by shard(id)"
+    )
+    s.execute("insert into m values (1,50),(2,150)")
+    s.execute("create view late as select id from m where ts >= 100")
+    assert s.query("select id from late") == [(2,)]
+    assert "m" in c.partitions
+
+
+def test_create_table_as(s):
+    s.execute(
+        "create table eng_copy as select id, sal * 2 as dbl from emp"
+        " where dept = 'eng'"
+    )
+    assert s.query("select id, dbl from eng_copy order by id") == [
+        (1, 200), (2, 400),
+    ]
+    # a real table: writable, durable through the normal paths
+    s.execute("insert into eng_copy values (9, 999)")
+    assert s.query("select count(*) from eng_copy") == [(3,)]
+    with pytest.raises(SQLError, match="already exists"):
+        s.execute("create table eng_copy as select 1 is not null as x")
+
+
+def test_ctas_from_view(s):
+    s.execute("create view small as select id from emp where sal < 150")
+    s.execute("create table snap as select id from small")
+    assert [r[0] for r in s.query("select id from snap order by id")] == [1, 3]
+
+
+def test_ctas_from_partitioned_table(s):
+    s.execute(
+        "create table pm (id bigint, ts bigint) partition by range (ts)"
+        " begin (0) step (100) partitions (2) distribute by shard(id)"
+    )
+    s.execute("insert into pm values (1,50),(2,150)")
+    s.execute("create table psnap as select id from pm where ts >= 100")
+    assert s.query("select id from psnap") == [(2,)]
+
+
+def test_reserved_names_for_views_and_ctas(s):
+    with pytest.raises(SQLError, match="reserved"):
+        s.execute("create view pg_stat_memory as select id from emp")
+    with pytest.raises(SQLError, match="reserved"):
+        s.execute("create table pg_views as select id from emp")
+
+
+def test_drop_rejected_while_views_depend(s):
+    s.execute("create view dep1 as select id from emp")
+    s.execute("create view dep2 as select id from dep1")
+    with pytest.raises(SQLError, match="depend on it"):
+        s.execute("drop table emp")
+    with pytest.raises(SQLError, match="depend on it"):
+        s.execute("drop view dep1")
+    s.execute("drop view dep2")
+    s.execute("drop view dep1")
+    s.execute("drop table emp")  # now unreferenced
