@@ -539,6 +539,8 @@ class Session:
         self.state: str = "idle"
         # PREPARE name AS ... statements (prepare.c's per-session cache)
         self.prepared_statements: dict[str, A.Statement] = {}
+        # last nextval per sequence (currval's session scope)
+        self._seq_currval: dict[str, int] = {}
 
     # -- public ----------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -718,13 +720,183 @@ class Session:
                 f"cannot execute {type(stmt).__name__} in a read-only "
                 "(hot standby) cluster"
             )
+        stmt = self._expand_sequences(stmt)
         stmt = self._expand_partitions(stmt)
         if isinstance(stmt, Result):  # fully handled by partition fanout
             return stmt
         h = getattr(self, f"_x_{type(stmt).__name__.lower()}", None)
         if h is None:
             raise SQLError(f"unsupported statement {type(stmt).__name__}")
+        if self.txn is not None and isinstance(
+            stmt, (A.Insert, A.Update, A.Delete, A.CopyStmt)
+        ):
+            # statement-level atomicity inside an explicit transaction: a
+            # failed statement (constraint violation, mid-append error)
+            # must not leave partial writes for COMMIT to persist — the
+            # implicit per-statement subtransaction of PG's xact.c
+            txn = self.txn
+            txn.mark_savepoint("__stmt__")
+            try:
+                result = h(stmt)
+            except Exception:
+                if self.txn is txn:  # handler may have aborted the txn
+                    txn.rollback_to_savepoint("__stmt__", self.cluster.stores)
+                    del txn.savepoints[txn._find_savepoint("__stmt__"):]
+                raise
+            if self.txn is txn:
+                del txn.savepoints[txn._find_savepoint("__stmt__"):]
+            return result
         return h(stmt)
+
+    # -- sequence functions (nextval/currval/setval as SQL) ---------------
+    _SEQ_FUNCS = ("nextval", "currval", "setval")
+
+    def _seq_increment(self, name: str) -> int:
+        """Best-effort increment lookup: the in-process GTS exposes its
+        registry; the wire client doesn't (no seq-info op), where 1 is
+        assumed."""
+        seqs = getattr(self.cluster.gts, "_seqs", None)
+        if isinstance(seqs, dict) and name in seqs:
+            s = seqs[name]
+            if isinstance(s, dict):
+                return int(s.get("increment", 1))
+            return int(getattr(s, "increment", 1))
+        return 1
+
+    def _stmt_has_seq_funcs(self, stmt) -> bool:
+        import dataclasses
+
+        def walk(e) -> bool:
+            if isinstance(e, A.FuncCall) and e.name in self._SEQ_FUNCS:
+                return True
+            if dataclasses.is_dataclass(e) and not isinstance(e, type):
+                for f in dataclasses.fields(e):
+                    v = getattr(e, f.name)
+                    for x in v if isinstance(v, (list, tuple)) else (v,):
+                        if isinstance(x, A.Expr) and walk(x):
+                            return True
+            return False
+
+        if isinstance(stmt, A.Insert) and stmt.values:
+            return any(walk(v) for row in stmt.values for v in row)
+        if isinstance(stmt, A.Select) and stmt.from_clause is None:
+            return any(walk(it.expr) for it in stmt.items)
+        return False
+
+    def _expand_sequences(self, stmt: A.Statement):
+        """Bind sequence function calls to values drawn from the GTM —
+        per occurrence, so each VALUES row gets its own nextval (the
+        volatile-function semantics of sequence.c). Supported positions:
+        INSERT VALUES rows and FROM-less SELECT items."""
+
+        # reserve each sequence's values in ONE GTM round trip (the
+        # get_rangemax contract, gtm_seq.c): count occurrences first
+        counts: dict[str, int] = {}
+
+        def count(e: A.Expr) -> None:
+            import dataclasses
+
+            if (
+                isinstance(e, A.FuncCall)
+                and e.name == "nextval"
+                and e.args
+                and isinstance(e.args[0], A.Literal)
+            ):
+                counts[str(e.args[0].value)] = (
+                    counts.get(str(e.args[0].value), 0) + 1
+                )
+            if dataclasses.is_dataclass(e) and not isinstance(e, type):
+                for f in dataclasses.fields(e):
+                    v = getattr(e, f.name)
+                    for x in v if isinstance(v, (list, tuple)) else (v,):
+                        if isinstance(x, A.Expr):
+                            count(x)
+
+        if isinstance(stmt, A.Insert) and stmt.values:
+            for row in stmt.values:
+                for v in row:
+                    count(v)
+        elif isinstance(stmt, A.Select) and stmt.from_clause is None:
+            for it in stmt.items:
+                count(it.expr)
+        if not counts and not self._stmt_has_seq_funcs(stmt):
+            return stmt
+        reserved: dict[str, iter] = {}
+        gts = self.cluster.gts
+        for name, n in counts.items():
+            if self.cluster.read_only:
+                raise SQLError(
+                    "cannot execute nextval() in a read-only "
+                    "(hot standby) cluster"
+                )
+            try:
+                first, last = gts.nextval(name, n)
+            except KeyError:
+                raise SQLError(f'sequence "{name}" does not exist')
+            inc = self._seq_increment(name)
+            reserved[name] = iter(range(first, last + inc, inc))
+
+        def bind(e: A.Expr) -> A.Expr:
+            import dataclasses
+
+            if isinstance(e, A.FuncCall) and e.name in self._SEQ_FUNCS:
+                if not e.args or not isinstance(e.args[0], A.Literal):
+                    raise SQLError(f"{e.name} requires a sequence name")
+                name = str(e.args[0].value)
+                if e.name == "nextval":
+                    v = next(reserved[name])
+                    self._seq_currval[name] = v
+                elif e.name == "currval":
+                    if name not in self._seq_currval:
+                        raise SQLError(
+                            f'currval of sequence "{name}" is not yet '
+                            "defined in this session"
+                        )
+                    v = self._seq_currval[name]
+                else:  # setval: PG semantics — v becomes last_value,
+                    # so the NEXT nextval returns v + increment
+                    if len(e.args) < 2:
+                        raise SQLError("setval(sequence, value)")
+                    if self.cluster.read_only:
+                        raise SQLError(
+                            "cannot execute setval() in a read-only "
+                            "(hot standby) cluster"
+                        )
+                    v = int(self._const_arg(e.args[1]))
+                    try:
+                        gts.setval(name, v + self._seq_increment(name))
+                    except KeyError:
+                        raise SQLError(
+                            f'sequence "{name}" does not exist'
+                        )
+                    self._seq_currval[name] = v
+                return A.Literal(v)
+            if dataclasses.is_dataclass(e) and not isinstance(e, type):
+                changes = {}
+                for f in dataclasses.fields(e):
+                    val = getattr(e, f.name)
+                    if isinstance(val, A.Expr):
+                        nv = bind(val)
+                        if nv is not val:
+                            changes[f.name] = nv
+                    elif isinstance(val, (list, tuple)):
+                        out = [
+                            bind(x) if isinstance(x, A.Expr) else x
+                            for x in val
+                        ]
+                        if any(a is not b for a, b in zip(out, val)):
+                            changes[f.name] = type(val)(out)
+                if changes:
+                    return dataclasses.replace(e, **changes)
+            return e
+
+        if isinstance(stmt, A.Insert) and stmt.values:
+            stmt.values = [[bind(v) for v in row] for row in stmt.values]
+        elif isinstance(stmt, A.Select) and stmt.from_clause is None:
+            stmt.items = [
+                A.SelectItem(bind(it.expr), it.alias) for it in stmt.items
+            ]
+        return stmt
 
     # -- view + partitioned-table rewrite ---------------------------------
     def _expand_views(self, stmt: A.Statement):
@@ -853,6 +1025,10 @@ class Session:
         keep = spec.prune(stmt.where, {spec.parent})
         txn, implicit = self._begin_implicit()
         self.txn = txn
+        if not implicit:
+            # the whole fanout is ONE statement: on failure no child's
+            # writes may survive into the explicit txn
+            txn.mark_savepoint("__stmt__")
         total = 0
         tag = "UPDATE" if isinstance(stmt, A.Update) else "DELETE"
         try:
@@ -863,10 +1039,15 @@ class Session:
             if implicit:
                 self._abort_txn(txn)
                 self.txn = None
+            else:
+                txn.rollback_to_savepoint("__stmt__", self.cluster.stores)
+                del txn.savepoints[txn._find_savepoint("__stmt__"):]
             raise
         if implicit:
             self.txn = None
             self._commit_txn(txn)
+        else:
+            del txn.savepoints[txn._find_savepoint("__stmt__"):]
         return Result(tag, rowcount=total)
 
     # -- SELECT ----------------------------------------------------------
@@ -1059,8 +1240,10 @@ class Session:
     def _complete_insert_batch(
         self, meta: TableMeta, columns, src: ColumnBatch
     ) -> ColumnBatch:
-        """Expand to full table-column order, NULL-filling absent columns."""
+        """Expand to full table-column order; absent columns take their
+        DEFAULT, else NULL."""
         given = {c: col for c, col in zip(columns, src.columns.values())}
+        defaults = getattr(meta, "defaults", {})
         out: dict[str, Column] = {}
         n = src.nrows
         for name, ty in meta.schema.items():
@@ -1068,8 +1251,9 @@ class Session:
                 col = given[name]
                 out[name] = Column(ty, col.data, col.validity, col.dictionary)
             else:
+                fill = defaults.get(name)
                 out[name] = column_from_python(
-                    [None] * n, ty, meta.dictionaries.get(name)
+                    [fill] * n, ty, meta.dictionaries.get(name)
                 )
         return ColumnBatch(out, n)
 
@@ -1078,7 +1262,9 @@ class Session:
     ) -> int:
         if batch.nrows == 0:
             return 0
+        self._check_not_null(meta, batch)
         if meta.dist.is_replicated:
+            self._check_unique_pk(meta, meta.node_indices[0], batch, txn)
             for node in meta.node_indices:
                 self._append_one(meta, node, batch, txn)
             return batch.nrows
@@ -1086,8 +1272,57 @@ class Session:
         routes = meta.locator.route_insert(key_cols, batch.nrows)
         for node in np.unique(routes):
             idx = np.nonzero(routes == node)[0]
-            self._append_one(meta, int(node), batch.take(idx), txn)
+            sub = batch.take(idx)
+            self._check_unique_pk(meta, int(node), sub, txn)
+            self._append_one(meta, int(node), sub, txn)
         return batch.nrows
+
+    def _check_not_null(self, meta: TableMeta, batch: ColumnBatch) -> None:
+        for col in getattr(meta, "not_null", ()):  # tablecmds NOT NULL
+            c = batch.columns.get(col)
+            if c is not None and c.validity is not None and not bool(
+                np.all(c.validity)
+            ):
+                raise SQLError(
+                    f'null value in column "{col}" violates not-null '
+                    "constraint"
+                )
+
+    def _check_unique_pk(
+        self, meta: TableMeta, node: int, batch: ColumnBatch, txn
+    ) -> None:
+        """PRIMARY KEY uniqueness — enforced when duplicates are
+        guaranteed colocated (pk is the distribution key, or the table is
+        replicated); otherwise a cross-node index would be required, which
+        the reference also refuses to create."""
+        pk = getattr(meta, "primary_key", None)
+        if pk is None:
+            return
+        colocated = meta.dist.is_replicated or tuple(
+            meta.dist.key_columns
+        ) == (pk,)
+        if not colocated:
+            return
+        from opentenbase_tpu.storage.table import INF_TS
+
+        vals = np.asarray(batch.columns[pk].data)
+        if len(np.unique(vals)) != len(vals):
+            raise SQLError(
+                f'duplicate key value violates primary key "{pk}"'
+            )
+        store = self.cluster.stores[node].get(meta.name)
+        if store is None or store.nrows == 0:
+            return
+        n = store.nrows
+        live = store.xmax_ts[:n] == INF_TS  # incl. our pending inserts
+        # rows this txn already marked for deletion don't conflict
+        tw = txn.writes.get(node, {}).get(meta.name)
+        if tw is not None and tw.del_idx:
+            live[np.asarray(tw.del_idx, dtype=np.int64)] = False
+        if bool(np.isin(vals, store.column_array(pk)[live]).any()):
+            raise SQLError(
+                f'duplicate key value violates primary key "{pk}"'
+            )
 
     def _append_one(self, meta, node: int, batch: ColumnBatch, txn) -> None:
         from opentenbase_tpu.storage.table import PENDING_TS
@@ -1372,14 +1607,56 @@ class Session:
         for cd in stmt.columns:
             schema[cd.name] = t.type_from_name(cd.type_name, cd.type_args)
         dist = self._dist_spec(stmt, schema)
+        constraints = self._column_constraints(stmt, schema)
         if stmt.partition_by is not None:
-            return self._create_partitioned(stmt, schema, dist)
+            return self._create_partitioned(stmt, schema, dist, constraints)
         meta = cat.create_table(stmt.name, schema, dist)
+        self._apply_constraints(meta, constraints)
         self.cluster.create_table_stores(meta)
-        self._log_create_table(stmt.name, schema, dist)
+        self._log_create_table(stmt.name, schema, dist, constraints)
         return Result("CREATE TABLE")
 
-    def _log_create_table(self, name, schema, dist) -> None:
+    def _column_constraints(self, stmt: A.CreateTable, schema) -> dict:
+        not_null, defaults, pk = [], {}, None
+        for cd in stmt.columns:
+            if cd.not_null:
+                not_null.append(cd.name)
+            if cd.primary_key:
+                pk = cd.name
+            if cd.default is not None:
+                try:
+                    v = self._const_arg(cd.default)
+                except SQLError:
+                    raise SQLError(
+                        f'default for column "{cd.name}" must be a constant'
+                    )
+                # validate against the column type NOW (parse_coerce at
+                # DDL time), not at first INSERT
+                from opentenbase_tpu.storage.column import Dictionary
+
+                probe_dict = (
+                    Dictionary()
+                    if schema[cd.name].id == t.TypeId.TEXT
+                    else None
+                )
+                try:
+                    column_from_python([v], schema[cd.name], probe_dict)
+                except (ValueError, TypeError):
+                    raise SQLError(
+                        f'default for column "{cd.name}" is not valid for '
+                        f"type {schema[cd.name]}"
+                    )
+                defaults[cd.name] = v
+        return {"not_null": not_null, "defaults": defaults,
+                "primary_key": pk}
+
+    @staticmethod
+    def _apply_constraints(meta, constraints: dict) -> None:
+        from opentenbase_tpu.storage.persist import _apply_constraints_meta
+
+        _apply_constraints_meta(meta, constraints)
+
+    def _log_create_table(self, name, schema, dist, constraints=None) -> None:
         p = self.cluster.persistence
         if p is not None:
             from opentenbase_tpu.storage.persist import _type_to_str
@@ -1391,10 +1668,13 @@ class Session:
                     "schema": {k: _type_to_str(v) for k, v in schema.items()},
                     "strategy": dist.strategy.value,
                     "key_columns": list(dist.key_columns),
+                    "constraints": constraints or {},
                 }
             )
 
-    def _create_partitioned(self, stmt: A.CreateTable, schema, dist) -> Result:
+    def _create_partitioned(
+        self, stmt: A.CreateTable, schema, dist, constraints=None
+    ) -> Result:
         """Interval/range partitioning (gram.y:4172): the parent is a
         catalog-only shell, each partition a real child table."""
         from opentenbase_tpu.plan.partition import PartitionError, PartitionSpec
@@ -1403,12 +1683,23 @@ class Session:
         col = clause.get("column")
         if col not in schema:
             raise SQLError(f'partition column "{col}" does not exist')
+        pk = (constraints or {}).get("primary_key")
+        if pk is not None and pk != col:
+            # per-child uniqueness is only complete when equal keys always
+            # land in the same child (PG: a PK on a partitioned table must
+            # include the partition key)
+            raise SQLError(
+                "PRIMARY KEY on a partitioned table must be the "
+                "partition column"
+            )
         try:
             spec = PartitionSpec.build(stmt.name, clause, schema[col])
         except PartitionError as e:
             raise SQLError(str(e))
         cat = self.cluster.catalog
         parent_meta = cat.create_table(stmt.name, schema, dist)  # shell
+        constraints = constraints or {}
+        self._apply_constraints(parent_meta, constraints)
         self.cluster.partitions[stmt.name] = spec
         p = self.cluster.persistence
         if p is not None:
@@ -1425,6 +1716,7 @@ class Session:
                     "strategy": dist.strategy.value,
                     "key_columns": list(dist.key_columns),
                     "partition": spec.spec,
+                    "constraints": constraints,
                 }
             )
         for child in spec.children():
@@ -1432,8 +1724,9 @@ class Session:
             # one logical table: all partitions share the parent's
             # dictionaries so encoded batches route freely between them
             meta.dictionaries = parent_meta.dictionaries
+            self._apply_constraints(meta, constraints)
             self.cluster.create_table_stores(meta)
-            self._log_create_table(child, schema, dist)
+            self._log_create_table(child, schema, dist, constraints)
         return Result("CREATE TABLE")
 
     def _dist_spec(self, stmt: A.CreateTable, schema) -> DistributionSpec:

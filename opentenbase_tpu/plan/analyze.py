@@ -1291,6 +1291,12 @@ class Analyzer:
             return E.FuncE("now", (), t.TIMESTAMP)
         if name == "interval":
             raise AnalyzeError("interval only valid in +/- arithmetic")
+        if name in ("nextval", "currval", "setval"):
+            # bound by the session before analysis (engine._expand_sequences)
+            raise AnalyzeError(
+                f"{name}() is only supported in INSERT VALUES and "
+                "FROM-less SELECT"
+            )
         out = self._oracle_func(name, args)
         if out is not None:
             return out

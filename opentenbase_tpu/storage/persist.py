@@ -40,6 +40,12 @@ def _type_to_str(ty: t.SqlType) -> str:
     return ty.id.value
 
 
+def _apply_constraints_meta(meta, cons: dict) -> None:
+    meta.not_null = set(cons.get("not_null", ()))
+    meta.defaults = dict(cons.get("defaults", {}))
+    meta.primary_key = cons.get("primary_key")
+
+
 def _type_from_str(s: str) -> t.SqlType:
     if s.startswith("decimal("):
         p, sc = s[8:-1].split(",")
@@ -336,6 +342,11 @@ class ClusterPersistence:
                 "dictionaries": {
                     col: d.values for col, d in tm.dictionaries.items()
                 },
+                "constraints": {
+                    "not_null": sorted(getattr(tm, "not_null", ())),
+                    "defaults": dict(getattr(tm, "defaults", {})),
+                    "primary_key": getattr(tm, "primary_key", None),
+                },
             }
             for node in tm.node_indices:
                 store = c.stores[node].get(name)
@@ -558,6 +569,7 @@ class ClusterPersistence:
             if not c.catalog.has(name):
                 c.catalog.create_table(name, schema, spec)
             tm = c.catalog.get(name)
+            _apply_constraints_meta(tm, tmeta.get("constraints", {}))
             tm.node_indices = list(tmeta["nodes"])
             for col, values in tmeta["dictionaries"].items():
                 tm.dictionaries[col] = Dictionary(values)
@@ -661,6 +673,7 @@ class ClusterPersistence:
                     tuple(header["key_columns"]),
                 )
                 meta = c.catalog.create_table(header["name"], schema, spec)
+                _apply_constraints_meta(meta, header.get("constraints", {}))
                 # partition children share the parent's dictionaries (the
                 # create_parent record replays first and registers it);
                 # exact membership check — a user table merely containing
@@ -754,7 +767,10 @@ class ClusterPersistence:
                         DistStrategy(header["strategy"]),
                         tuple(header["key_columns"]),
                     )
-                    c.catalog.create_table(header["name"], schema, spec)
+                    pm = c.catalog.create_table(header["name"], schema, spec)
+                    _apply_constraints_meta(
+                        pm, header.get("constraints", {})
+                    )
                     pclause = header["partition"]
                     c.partitions[header["name"]] = PartitionSpec.build(
                         header["name"], pclause, schema[pclause["column"]]

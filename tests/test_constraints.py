@@ -1,0 +1,171 @@
+"""Column constraints (DEFAULT / NOT NULL / PRIMARY KEY) and the
+nextval/currval/setval SQL surface (sequence.c)."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster, SQLError
+
+
+@pytest.fixture()
+def s():
+    return Cluster(num_datanodes=2, shard_groups=16).session()
+
+
+def test_default_values_fill_absent_columns(s):
+    s.execute(
+        "create table t (k bigint, v text default 'none', n bigint default 7)"
+        " distribute by shard(k)"
+    )
+    s.execute("insert into t (k) values (1)")
+    s.execute("insert into t values (2, 'given', 9)")
+    assert s.query("select k, v, n from t order by k") == [
+        (1, "none", 7), (2, "given", 9),
+    ]
+
+
+def test_not_null_enforced(s):
+    s.execute(
+        "create table t (k bigint not null, v text not null)"
+        " distribute by shard(k)"
+    )
+    with pytest.raises(SQLError, match="not-null"):
+        s.execute("insert into t values (1, null)")
+    with pytest.raises(SQLError, match="not-null"):
+        s.execute("insert into t (k) values (1)")  # v absent, no default
+    s.execute("insert into t values (1, 'ok')")
+    with pytest.raises(SQLError, match="not-null"):
+        s.execute("update t set v = null where k = 1")
+    assert s.query("select v from t") == [("ok",)]
+
+
+def test_primary_key_unique_when_colocated(s):
+    s.execute(
+        "create table t (k bigint primary key, v text) distribute by shard(k)"
+    )
+    s.execute("insert into t values (1,'a'),(2,'b')")
+    with pytest.raises(SQLError, match="duplicate key"):
+        s.execute("insert into t values (2,'again')")
+    with pytest.raises(SQLError, match="duplicate key"):
+        s.execute("insert into t values (3,'x'),(3,'y')")  # in-batch dup
+    # updating a NON-key column of an existing row is not a conflict
+    s.execute("update t set v = 'b2' where k = 2")
+    # delete + reinsert in one txn is fine
+    s.execute("begin")
+    s.execute("delete from t where k = 1")
+    s.execute("insert into t values (1,'re')")
+    s.execute("commit")
+    assert s.query("select v from t where k = 1") == [("re",)]
+
+
+def test_pk_unique_on_replicated_table(s):
+    s.execute(
+        "create table r (k bigint primary key, v text)"
+        " distribute by replication"
+    )
+    s.execute("insert into r values (1,'a')")
+    with pytest.raises(SQLError, match="duplicate key"):
+        s.execute("insert into r values (1,'b')")
+
+
+def test_sequence_sql_surface(s):
+    s.execute("create sequence sq")
+    assert s.query("select nextval('sq')") == [(1,)]
+    assert s.query("select nextval('sq')") == [(2,)]
+    assert s.query("select currval('sq')") == [(2,)]
+    s.execute("select setval('sq', 100)")
+    assert s.query("select nextval('sq')") == [(101,)]
+    # each VALUES row draws its own value
+    s.execute("create table t (k bigint, v text) distribute by shard(k)")
+    s.execute(
+        "insert into t values (nextval('sq'),'a'),(nextval('sq'),'b')"
+    )
+    assert [r[0] for r in s.query("select k from t order by k")] == [102, 103]
+    with pytest.raises(SQLError, match="does not exist"):
+        s.query("select nextval('nope')")
+    other = s.cluster.session()
+    with pytest.raises(SQLError, match="not yet defined"):
+        other.query("select currval('sq')")
+
+
+def test_constraints_survive_recovery(tmp_path):
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=str(tmp_path))
+    s = c.session()
+    s.execute(
+        "create table t (k bigint primary key, v text not null,"
+        " n bigint default 5) distribute by shard(k)"
+    )
+    s.execute("insert into t (k, v) values (1, 'a')")
+
+    r = Cluster.recover(str(tmp_path), num_datanodes=2, shard_groups=16)
+    rs = r.session()
+    assert rs.query("select n from t") == [(5,)]
+    with pytest.raises(SQLError, match="duplicate key"):
+        rs.execute("insert into t values (1, 'dup', 1)")
+    with pytest.raises(SQLError, match="not-null"):
+        rs.execute("insert into t (k) values (2)")
+    rs.execute("insert into t (k, v) values (2, 'b')")  # default applies
+    assert rs.query("select n from t where k = 2") == [(5,)]
+
+
+def test_failed_statement_atomic_in_explicit_txn(s):
+    """A constraint failure mid-statement must not leave partial writes
+    for COMMIT (the per-statement subtransaction of xact.c)."""
+    s.execute(
+        "create table t (k bigint primary key, v text not null)"
+        " distribute by shard(k)"
+    )
+    s.execute("insert into t values (1,'a'),(2,'b')")
+    s.execute("begin")
+    with pytest.raises(SQLError, match="not-null"):
+        s.execute("update t set v = null where k = 1")
+    with pytest.raises(SQLError, match="duplicate key"):
+        # multi-row insert: row (3) routes before the dup (2) fails
+        s.execute("insert into t values (3,'c'),(2,'dup')")
+    s.execute("commit")
+    assert s.query("select k, v from t order by k") == [(1, "a"), (2, "b")]
+
+
+def test_sequences_rejected_on_hot_standby(tmp_path):
+    from opentenbase_tpu.storage.replication import StandbyCluster, WalSender
+
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=str(tmp_path))
+    s = c.session()
+    s.execute("create sequence sq")
+    sender = WalSender(c.persistence)
+    sb = StandbyCluster(str(tmp_path) + "_sb", num_datanodes=2, shard_groups=16)
+    sb.start_replication(sender.host, sender.port)
+    assert sb.wait_caught_up(c.persistence)
+    rs = sb.session()
+    with pytest.raises(SQLError, match="read-only"):
+        rs.query("select nextval('sq')")
+    with pytest.raises(SQLError, match="read-only"):
+        rs.query("select setval('sq', 5)")
+    sender.stop()
+    sb.stop()
+
+
+def test_seq_misuse_clean_errors(s):
+    s.execute("create sequence sq2")
+    with pytest.raises(SQLError, match="setval"):
+        s.query("select setval('sq2')")
+    with pytest.raises(SQLError, match="bad default|not valid"):
+        s.execute("create table bad (k bigint, n bigint default 'x')"
+                  " distribute by shard(k)")
+
+
+def test_pk_on_partitioned_table_rules(s):
+    with pytest.raises(SQLError, match="partition column"):
+        s.execute(
+            "create table pm (id bigint primary key, ts bigint)"
+            " partition by range (ts) begin (0) step (10) partitions (2)"
+            " distribute by shard(id)"
+        )
+    # pk == partition column == dist key: enforced per child
+    s.execute(
+        "create table pm (ts bigint primary key, v text)"
+        " partition by range (ts) begin (0) step (10) partitions (2)"
+        " distribute by shard(ts)"
+    )
+    s.execute("insert into pm values (1,'a')")
+    with pytest.raises(SQLError, match="duplicate key"):
+        s.execute("insert into pm values (1,'b')")
