@@ -40,6 +40,16 @@ from opentenbase_tpu.catalog.shardmap import ShardMap
 from opentenbase_tpu.executor.dist import DistExecutor
 from opentenbase_tpu.executor.local import LocalExecutor
 from opentenbase_tpu.gtm import GTSServer
+from opentenbase_tpu.lmgr import (
+    DeadlockError,
+    LockManager,
+    LockNotAvailable,
+    LockTimeout,
+    ROW_SHARE,
+    ROW_UPDATE,
+    TABLE_SHARED,
+    table_lock_mode,
+)
 from opentenbase_tpu.plan import analyze_statement
 from opentenbase_tpu.plan import logical as L
 from opentenbase_tpu.plan.analyze import Analyzer
@@ -212,6 +222,7 @@ class Cluster:
         import threading as _threading
 
         self._exec_lock = _threading.RLock()
+        self.locks = LockManager(self)
         self.barriers: list[tuple[str, int]] = []
         self.indexes: dict[str, A.CreateIndex] = {}
         # interval/range partitioning: parent name -> PartitionSpec
@@ -597,6 +608,76 @@ class Session:
             return self.txn.snapshot_ts
         return self.cluster.gts.snapshot_ts()
 
+    # -- row/table locking (lmgr.py) -------------------------------------
+    @staticmethod
+    def _duration_ms(val, name: str) -> int:
+        """GUC duration: integer milliseconds or a PG unit suffix."""
+        if isinstance(val, (int, float)):
+            return int(val)
+        s = str(val).strip().lower()
+        for suffix, mult in (("ms", 1), ("min", 60000), ("s", 1000)):
+            if s.endswith(suffix):
+                s = s[: -len(suffix)].strip()
+                break
+        else:
+            mult = 1
+        try:
+            return int(float(s) * mult)
+        except ValueError:
+            raise SQLError(f'invalid value for parameter "{name}": {val!r}')
+
+    def _lock_opts(self) -> dict:
+        return {
+            "lock_timeout_ms": self._duration_ms(
+                self.gucs.get("lock_timeout", 0), "lock_timeout"
+            ),
+            "deadlock_timeout_ms": self._duration_ms(
+                self.gucs.get("deadlock_timeout", 1000), "deadlock_timeout"
+            ),
+        }
+
+    def _acquire_row_locks(
+        self, txn: Transaction, table: str, node: int, idx, mode: str,
+        nowait: bool = False,
+    ) -> None:
+        """Take row locks on store positions ``idx`` (keyed by the stable
+        row ids, which survive WAL replay; vacuum is additionally fenced
+        out by the store pin). Then re-check the lock targets for a
+        committed concurrent update — the wait may have ended precisely
+        because a conflicting writer committed, in which case PG's
+        heap_lock_tuple reports HeapTupleUpdated and the statement fails
+        with a serialization error under REPEATABLE READ."""
+        if len(idx) == 0:
+            return
+        from opentenbase_tpu.storage.table import INF_TS
+
+        store = self.cluster.stores[node][table]
+        keys = [
+            (node, table, int(rid)) for rid in store.row_id[np.asarray(idx)]
+        ]
+        # pin BEFORE parking: the pin is the vacuum fence, and the wait
+        # window (engine lock dropped) is exactly when a concurrent VACUUM
+        # could otherwise compact the store and invalidate ``idx``
+        newly_pinned = store not in txn.pinned
+        txn.pin(store)
+        try:
+            self.cluster.locks.acquire(
+                self.session_id, txn.gxid, keys, mode, nowait=nowait,
+                **self._lock_opts(),
+            )
+        except Exception:
+            if newly_pinned:
+                store.unpin()
+                txn.pinned.remove(store)
+            raise
+        # recheck for a committed concurrent update — the wait may have
+        # ended precisely because a conflicting writer committed; PG
+        # raises for FOR SHARE as well (heap_lock_tuple/HeapTupleUpdated)
+        if (store.xmax_ts[np.asarray(idx)] != INF_TS).any():
+            raise SQLError(
+                "could not serialize access due to concurrent update"
+            )
+
     def _check_write_conflicts(self, txn: Transaction) -> None:
         """First-committer-wins: if another transaction already stamped an
         xmax on a row this one deletes/updates, committing would double-
@@ -635,6 +716,7 @@ class Session:
             self._abort_txn(txn, failed_commit_ts=commit_ts)
             raise
         gts.forget(txn.gxid)
+        self.cluster.locks.release_all(self.session_id)
 
     def _stamp_commit(
         self, txn: Transaction, commit_ts: int, wal_log: bool = True
@@ -689,6 +771,7 @@ class Session:
         txn.unpin_all()
         self.cluster.gts.abort(txn.gxid)
         self.cluster.gts.forget(txn.gxid)
+        self.cluster.locks.release_all(self.session_id)
 
     # -- dispatch --------------------------------------------------------
     _READONLY_OK = (
@@ -727,26 +810,41 @@ class Session:
         h = getattr(self, f"_x_{type(stmt).__name__.lower()}", None)
         if h is None:
             raise SQLError(f"unsupported statement {type(stmt).__name__}")
-        if self.txn is not None and isinstance(
-            stmt, (A.Insert, A.Update, A.Delete, A.CopyStmt)
-        ):
-            # statement-level atomicity inside an explicit transaction: a
-            # failed statement (constraint violation, mid-append error)
-            # must not leave partial writes for COMMIT to persist — the
-            # implicit per-statement subtransaction of PG's xact.c
-            txn = self.txn
-            txn.mark_savepoint("__stmt__")
-            try:
-                result = h(stmt)
-            except Exception:
-                if self.txn is txn:  # handler may have aborted the txn
-                    txn.rollback_to_savepoint("__stmt__", self.cluster.stores)
+        try:
+            if self.txn is not None and isinstance(
+                stmt, (A.Insert, A.Update, A.Delete, A.CopyStmt)
+            ):
+                # statement-level atomicity inside an explicit
+                # transaction: a failed statement (constraint violation,
+                # mid-append error) must not leave partial writes for
+                # COMMIT to persist — the implicit per-statement
+                # subtransaction of PG's xact.c
+                txn = self.txn
+                txn.mark_savepoint("__stmt__")
+                try:
+                    result = h(stmt)
+                except Exception:
+                    if self.txn is txn:  # handler may have aborted the txn
+                        txn.rollback_to_savepoint(
+                            "__stmt__", self.cluster.stores
+                        )
+                        del txn.savepoints[txn._find_savepoint("__stmt__"):]
+                    raise
+                if self.txn is txn:
                     del txn.savepoints[txn._find_savepoint("__stmt__"):]
-                raise
-            if self.txn is txn:
-                del txn.savepoints[txn._find_savepoint("__stmt__"):]
-            return result
-        return h(stmt)
+                return result
+            return h(stmt)
+        except DeadlockError as e:
+            # deadlock victim: the whole transaction must die — a
+            # statement-level rollback would keep its locks and leave the
+            # cycle standing (PG aborts the victim's xact the same way)
+            if self.txn is not None:
+                self._abort_txn(self.txn)
+                self.txn = None
+            self.cluster.locks.release_all(self.session_id)
+            raise SQLError(str(e))
+        except (LockTimeout, LockNotAvailable) as e:
+            raise SQLError(str(e))
 
     # -- sequence functions (nextval/currval/setval as SQL) ---------------
     _SEQ_FUNCS = ("nextval", "currval", "setval")
@@ -1052,7 +1150,12 @@ class Session:
 
     # -- SELECT ----------------------------------------------------------
     def _x_select(self, stmt: A.Select) -> Result:
+        r = self._maybe_admin_function(stmt)
+        if r is not None:
+            return r
         self._refresh_system_views(stmt)
+        if stmt.for_update is not None:
+            return self._select_for_update(stmt)
         batch = self._run_select(stmt)
         return Result(
             "SELECT",
@@ -1060,6 +1163,143 @@ class Session:
             batch.column_names(),
             batch.nrows,
         )
+
+    # -- admin functions exposed as FROM-less selects --------------------
+    # (contrib/pg_unlock's SQL functions; pg_clean's cleanup entry)
+    _ADMIN_FUNCS = {
+        "pg_unlock_execute",
+        "pg_unlock_check_deadlock",
+        "pg_unlock_check_dependency",
+        "pg_clean_execute",
+    }
+
+    def _maybe_admin_function(self, stmt: A.Select) -> Optional[Result]:
+        if stmt.from_clause is not None or len(stmt.items) != 1:
+            return None
+        e = stmt.items[0].expr
+        if not isinstance(e, A.FuncCall) or e.name not in self._ADMIN_FUNCS:
+            return None
+        if self.cluster.read_only and e.name in (
+            "pg_unlock_execute", "pg_clean_execute"
+        ):
+            # state-mutating admin functions are primary-only; standby 2PC
+            # state is owned by WAL replay (same gate as nextval/setval)
+            raise SQLError(
+                f"cannot execute {e.name}() in a read-only "
+                "(hot standby) cluster"
+            )
+        locks = self.cluster.locks
+        if e.name == "pg_unlock_execute":
+            gxids = locks.execute_unlock()
+            return Result(
+                "SELECT",
+                [(g,) for g in gxids],
+                ["cancelled_gxid"],
+                len(gxids),
+            )
+        if e.name == "pg_unlock_check_deadlock":
+            rows = locks.check_deadlock()
+            return Result("SELECT", rows, ["cycle", "gxid_path"], len(rows))
+        if e.name == "pg_unlock_check_dependency":
+            rows = locks.check_dependency()
+            return Result(
+                "SELECT",
+                rows,
+                ["waiter_gxid", "holder_gxid", "node_index", "relation"],
+                len(rows),
+            )
+        # pg_clean_execute([max_age_seconds]): resolve stale in-doubt 2PC
+        age = float(self._const_arg(e.args[0])) if e.args else 300.0
+        gids = self.cluster.clean_2pc(max_age_s=age)
+        return Result(
+            "SELECT", [(g,) for g in gids], ["resolved_gid"], len(gids)
+        )
+
+    def _select_for_update(self, stmt: A.Select) -> Result:
+        """SELECT ... FOR UPDATE/SHARE: lock the WHERE-matching rows on
+        every owning datanode, then run the select under the transaction
+        snapshot. Locks taken in an implicit transaction are released at
+        statement end (PG holds them to end of statement too); in an
+        explicit transaction they persist until COMMIT/ROLLBACK."""
+        if self.cluster.read_only:
+            raise SQLError(
+                "cannot execute SELECT FOR UPDATE in a read-only "
+                "(hot standby) cluster"
+            )
+        fc = stmt.from_clause
+        if (
+            not isinstance(fc, A.RelRef)
+            or stmt.group_by
+            or stmt.distinct
+            or stmt.set_ops
+            or not self.cluster.catalog.has(fc.name)
+            or fc.name in _SYSTEM_VIEWS
+        ):
+            raise SQLError(
+                "FOR UPDATE is only allowed on a single base table "
+                "without DISTINCT/GROUP BY/set operations"
+            )
+        meta = self.cluster.catalog.get(fc.name)
+        mode = ROW_UPDATE if stmt.for_update == "update" else ROW_SHARE
+        txn, implicit = self._begin_implicit()
+        prev_txn = self.txn
+        try:
+            # target selection mirrors _x_delete: predicate evaluation per
+            # owning node against the txn snapshot
+            splan = analyze_statement(
+                A.Delete(table=fc.name, where=stmt.where),
+                self.cluster.catalog,
+            )
+            subq = self._subquery_values(splan)
+            for node in meta.node_indices:
+                store = self.cluster.stores[node][fc.name]
+                ex = LocalExecutor(
+                    self.cluster.catalog,
+                    {fc.name: store},
+                    txn.snapshot_ts,
+                    subquery_values=subq,
+                    own_writes=txn.own_writes_view().get(node),
+                )
+                idx = ex.predicate_rows(fc.name, splan.root.predicate)
+                if len(idx):
+                    self._acquire_row_locks(
+                        txn, fc.name, node, idx, mode,
+                        nowait=stmt.lock_nowait,
+                    )
+                if meta.dist.is_replicated:
+                    break  # one copy's locks stand for the row
+            self.txn = txn
+            batch = self._run_select(stmt)
+        except Exception:
+            self.txn = prev_txn
+            if implicit:
+                self._abort_txn(txn)
+            raise
+        if implicit:
+            self.txn = None
+            self._commit_txn(txn)
+        else:
+            self.txn = txn
+        return Result(
+            "SELECT", batch.to_rows(), batch.column_names(), batch.nrows
+        )
+
+    def _x_locktable(self, stmt: A.LockTable) -> Result:
+        """LOCK TABLE (lockcmds.c): table-level lock on every owning
+        datanode, held to transaction end. PG requires a transaction
+        block, and so do we — an immediately-released lock is useless."""
+        if self.txn is None:
+            raise SQLError("LOCK TABLE can only be used in transaction blocks")
+        if not self.cluster.catalog.has(stmt.table):
+            raise SQLError(f'table "{stmt.table}" does not exist')
+        meta = self.cluster.catalog.get(stmt.table)
+        mode = table_lock_mode(stmt.mode)
+        keys = [(node, stmt.table) for node in meta.node_indices]
+        self.cluster.locks.acquire(
+            self.session_id, self.txn.gxid, keys, mode,
+            nowait=stmt.nowait, **self._lock_opts(),
+        )
+        return Result("LOCK TABLE")
 
     # -- system views (pg_stat_* / pgxc_* observability surface) ---------
     def _referenced_tables(self, sel: A.Select, acc: set) -> None:
@@ -1205,6 +1445,13 @@ class Session:
         full = self._complete_insert_batch(meta, iplan.columns, src_batch)
         txn, implicit = self._begin_implicit()
         try:
+            # RowExclusive-class table lock: coexists with other writers,
+            # conflicts with LOCK TABLE ... EXCLUSIVE (lockcmds.c matrix)
+            self.cluster.locks.acquire(
+                self.session_id, txn.gxid,
+                [(node, iplan.table) for node in meta.node_indices],
+                TABLE_SHARED, **self._lock_opts(),
+            )
             spec = self.cluster.partitions.get(iplan.table)
             if spec is not None:
                 n = self._partition_and_append(spec, full, txn)
@@ -1343,20 +1590,28 @@ class Session:
         txn, implicit = self._begin_implicit()
         subq = self._subquery_values(splan)
         total = 0
-        for node in meta.node_indices:
-            store = self.cluster.stores[node][dplan.table]
-            ex = LocalExecutor(
-                self.cluster.catalog,
-                {dplan.table: store},
-                txn.snapshot_ts,
-                subquery_values=subq,
-                own_writes=txn.own_writes_view().get(node),
-            )
-            idx = ex.predicate_rows(dplan.table, dplan.predicate)
-            if len(idx):
-                txn.pin(store)
-                txn.w(node, dplan.table).del_idx.extend(idx.tolist())
-                total += len(idx)
+        try:
+            for node in meta.node_indices:
+                store = self.cluster.stores[node][dplan.table]
+                ex = LocalExecutor(
+                    self.cluster.catalog,
+                    {dplan.table: store},
+                    txn.snapshot_ts,
+                    subquery_values=subq,
+                    own_writes=txn.own_writes_view().get(node),
+                )
+                idx = ex.predicate_rows(dplan.table, dplan.predicate)
+                if len(idx):
+                    self._acquire_row_locks(
+                        txn, dplan.table, node, idx, ROW_UPDATE
+                    )
+                    txn.pin(store)
+                    txn.w(node, dplan.table).del_idx.extend(idx.tolist())
+                    total += len(idx)
+        except Exception:
+            if implicit:
+                self._abort_txn(txn)
+            raise
         if meta.dist.is_replicated and meta.node_indices:
             total //= len(meta.node_indices)
         if implicit:
@@ -1390,6 +1645,9 @@ class Session:
                 idx = ex.predicate_rows(uplan.table, uplan.predicate)
                 if not len(idx):
                     continue
+                self._acquire_row_locks(
+                    txn, uplan.table, node, idx, ROW_UPDATE
+                )
                 old = store.to_batch().take(idx)
                 new_batches.append(self._apply_assignments(meta, old, assigned, subq))
                 txn.pin(store)
@@ -1561,6 +1819,12 @@ class Session:
         import time as _time
 
         txn.prepared_at = _time.time()
+        # session-scoped row locks hand off to the RESERVED_TS stamps: the
+        # resolving session may be a different one (or crash recovery), so
+        # conflict protection for in-doubt txns lives in the stamp, not
+        # the lock table (the reference persists 2PC locks in the twophase
+        # state file for the same reason)
+        self.cluster.locks.release_all(self.session_id)
         self.cluster.__dict__.setdefault("_prepared", {})[stmt.gid] = txn
         if self.cluster.persistence is not None:
             self.cluster.persistence.log_prepare(txn, self.cluster.stores)
@@ -1623,6 +1887,11 @@ class Session:
                 not_null.append(cd.name)
             if cd.primary_key:
                 pk = cd.name
+                # PRIMARY KEY implies NOT NULL (DefineIndex's is_primary
+                # path); without this a NULL pk would be stored as the 0
+                # sentinel and collide with a real 0 key
+                if cd.name not in not_null:
+                    not_null.append(cd.name)
             if cd.default is not None:
                 try:
                     v = self._const_arg(cd.default)
@@ -2381,6 +2650,10 @@ class Session:
 # ---------------------------------------------------------------------------
 
 
+def _sv_pg_locks(c: Cluster):
+    return c.locks.snapshot_rows()
+
+
 def _sv_pgxc_node(c: Cluster):
     return [
         (
@@ -2526,6 +2799,18 @@ def _sv_views(c: Cluster):
 
 
 _SYSTEM_VIEWS: dict[str, tuple] = {
+    "pg_locks": (
+        {
+            "node_index": t.INT4,
+            "relation": t.TEXT,
+            "row_id": t.INT8,
+            "mode": t.TEXT,
+            "granted": t.BOOL,
+            "session_id": t.INT4,
+            "gxid": t.INT8,
+        },
+        _sv_pg_locks,
+    ),
     "pg_views": (
         {"viewname": t.TEXT, "definition": t.TEXT},
         _sv_views,

@@ -1,0 +1,406 @@
+"""Row/table lock manager + distributed deadlock breaker.
+
+The reference's pessimistic-locking surface rebuilt for the batch engine:
+
+- regular heavyweight row/table locks (src/backend/storage/lmgr): here a
+  cluster-wide lock table keyed by (datanode, table, row_id) for row locks
+  and (datanode, table) for table locks, acquired by SELECT ... FOR
+  UPDATE/SHARE, LOCK TABLE, and by UPDATE/DELETE before they record their
+  write-sets;
+- the distributed deadlock breaker (contrib/pg_unlock, 2,396 LoC): the
+  reference collects per-node wait-for graphs over EXECUTE DIRECT, merges
+  them on the coordinator, finds cycles, and cancels victim transactions
+  (pg_unlock_execute / pg_unlock_check_deadlock / pg_unlock_check_dependency).
+  Here every datanode's wait queue lives in the same LockManager, so the
+  "merge" is reading one structure — but the graph is genuinely
+  distributed: edges routinely connect transactions whose conflicting row
+  locks live on different datanodes, which is exactly the cross-node cycle
+  pg_unlock exists to break.
+
+Victim policy: a waiter runs cycle detection after ``deadlock_timeout``
+(PG's policy — the detecting backend aborts itself); an operator (or the
+background breaker) can additionally mark victims via ``execute_unlock``,
+which cancels the youngest transaction of every cycle, exactly pg_unlock's
+rollback choice.
+
+Blocking and the engine statement lock: the wire server serializes
+statements on ``cluster._exec_lock``; a waiter parked while holding it
+would wedge the whole server (nobody could ever commit and release the
+awaited lock), so ``acquire`` drops that lock for the duration of the wait
+and retakes it before returning — the lmgr.c equivalent of sleeping
+without holding the partition LWLocks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class DeadlockError(RuntimeError):
+    """Raised in the waiter chosen as deadlock victim; the session layer
+    aborts the victim's whole transaction (releasing its locks) before
+    surfacing the error."""
+
+
+class LockTimeout(RuntimeError):
+    pass
+
+
+class LockNotAvailable(RuntimeError):
+    """NOWAIT could not acquire immediately (errcode 55P03)."""
+
+
+# Lock modes, reduced to the conflict classes that matter for a columnar
+# engine with no in-place page writes. Row locks: "update" (exclusive) vs
+# "share". Table locks: "shared" coexists with everything but exclusive;
+# "exclusive" (LOCK TABLE ... IN EXCLUSIVE/ACCESS EXCLUSIVE MODE)
+# conflicts with every other lock on that table, row locks included.
+ROW_UPDATE = "update"
+ROW_SHARE = "share"
+TABLE_SHARED = "shared"
+TABLE_EXCLUSIVE = "exclusive"
+
+_EXCLUSIVE_TABLE_MODES = {
+    "exclusive",
+    "access exclusive",
+    "share update exclusive",
+    "share row exclusive",
+}
+
+
+@dataclass
+class _Holder:
+    session_id: int
+    gxid: int
+    mode: str
+
+
+@dataclass
+class _Waiter:
+    session_id: int
+    gxid: int
+    mode: str
+    keys: tuple
+    started: float = field(default_factory=time.monotonic)
+
+
+class LockManager:
+    def __init__(self, cluster=None):
+        self._cluster = cluster
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        # lock key -> list of holders. Row key: (node, table, row_id);
+        # table key: (node, table).
+        self._held: dict[tuple, list[_Holder]] = {}
+        self._by_session: dict[int, set[tuple]] = {}
+        self._waiters: dict[int, _Waiter] = {}
+        self._victims: dict[int, str] = {}  # session_id -> reason
+
+    # -- conflict rules --------------------------------------------------
+    @staticmethod
+    def _conflicts(mode_a: str, mode_b: str) -> bool:
+        if ROW_SHARE == mode_a == mode_b:
+            return False
+        if TABLE_SHARED in (mode_a, mode_b):
+            return TABLE_EXCLUSIVE in (mode_a, mode_b)
+        return True
+
+    def _blockers(self, keys, mode, session_id) -> list[_Holder]:
+        """Holders that prevent this acquisition (self-held locks never
+        conflict — lock re-entrancy within a transaction)."""
+        out = []
+        for key in keys:
+            for h in self._held.get(key, ()):
+                if h.session_id != session_id and self._conflicts(
+                    h.mode, mode
+                ):
+                    out.append(h)
+            if len(key) == 3:
+                # a row lock is also blocked by an exclusive table lock
+                for h in self._held.get(key[:2], ()):
+                    if h.session_id != session_id and h.mode == (
+                        TABLE_EXCLUSIVE
+                    ):
+                        out.append(h)
+            else:
+                # an exclusive table lock is blocked by any row lock on
+                # that (node, table)
+                if mode == TABLE_EXCLUSIVE:
+                    for rk, hs in self._held.items():
+                        if len(rk) == 3 and rk[:2] == key:
+                            out.extend(
+                                h
+                                for h in hs
+                                if h.session_id != session_id
+                            )
+        return out
+
+    # -- acquisition -----------------------------------------------------
+    def acquire(
+        self,
+        session_id: int,
+        gxid: int,
+        keys: list[tuple],
+        mode: str,
+        nowait: bool = False,
+        lock_timeout_ms: int = 0,
+        deadlock_timeout_ms: int = 1000,
+    ) -> None:
+        keys = tuple(keys)
+        engine_lock = getattr(self._cluster, "_exec_lock", None)
+        released_engine_lock = False
+        start = time.monotonic()
+        deadline = (
+            start + lock_timeout_ms / 1000.0 if lock_timeout_ms else None
+        )
+        dl_check_at = start + deadlock_timeout_ms / 1000.0
+        try:
+            with self._cv:
+                while True:
+                    reason = self._victims.pop(session_id, None)
+                    if reason is not None:
+                        raise DeadlockError(reason)
+                    blockers = self._blockers(keys, mode, session_id)
+                    if not blockers:
+                        self._grant(session_id, gxid, keys, mode)
+                        return
+                    if nowait:
+                        raise LockNotAvailable(
+                            "could not obtain lock on row in relation "
+                            f"{keys[0][1]!r}"
+                        )
+                    now = time.monotonic()
+                    if deadline is not None and now >= deadline:
+                        raise LockTimeout(
+                            "canceling statement due to lock timeout"
+                        )
+                    self._waiters[session_id] = _Waiter(
+                        session_id, gxid, mode, keys
+                    )
+                    if now >= dl_check_at:
+                        cycle = self._cycle_through(session_id)
+                        if cycle:
+                            self._waiters.pop(session_id, None)
+                            raise DeadlockError(
+                                "deadlock detected: transactions "
+                                + " -> ".join(str(g) for g in cycle)
+                            )
+                        dl_check_at = now + deadlock_timeout_ms / 1000.0
+                    # park. Engine statement lock must not be held while
+                    # sleeping (see module docstring).
+                    if (
+                        not released_engine_lock
+                        and engine_lock is not None
+                        and engine_lock._is_owned()
+                    ):
+                        engine_lock.release()
+                        released_engine_lock = True
+                    waitfor = min(
+                        0.05,
+                        max(0.0, dl_check_at - now),
+                        *(
+                            [max(0.0, deadline - now)]
+                            if deadline is not None
+                            else []
+                        ),
+                    )
+                    self._cv.wait(timeout=max(waitfor, 0.005))
+                    self._waiters.pop(session_id, None)
+        finally:
+            with self._cv:
+                self._waiters.pop(session_id, None)
+                # a victim marker set while we were abandoning the wait
+                # (timeout, NOWAIT) is stale — consuming it here keeps it
+                # from poisoning this session's next acquisition
+                self._victims.pop(session_id, None)
+            if released_engine_lock:
+                engine_lock.acquire()
+
+    def _grant(self, session_id, gxid, keys, mode) -> None:
+        for key in keys:
+            hs = self._held.setdefault(key, [])
+            if not any(
+                h.session_id == session_id and h.mode == mode for h in hs
+            ):
+                hs.append(_Holder(session_id, gxid, mode))
+            self._by_session.setdefault(session_id, set()).add(key)
+
+    def release_all(self, session_id: int) -> None:
+        with self._cv:
+            self._victims.pop(session_id, None)  # txn over: marker stale
+            for key in self._by_session.pop(session_id, ()):
+                hs = self._held.get(key)
+                if hs is None:
+                    continue
+                hs[:] = [h for h in hs if h.session_id != session_id]
+                if not hs:
+                    del self._held[key]
+            self._cv.notify_all()
+
+    # -- wait-for graph / deadlock breaking ------------------------------
+    def _edges(self) -> list[tuple]:
+        """(waiter_session, waiter_gxid, holder_session, holder_gxid,
+        node, table) — the merged cross-node dependency list
+        (pg_unlock_check_dependency's output shape)."""
+        out = []
+        for w in self._waiters.values():
+            for h in self._blockers(w.keys, w.mode, w.session_id):
+                node, table = w.keys[0][0], w.keys[0][1]
+                out.append(
+                    (w.session_id, w.gxid, h.session_id, h.gxid, node, table)
+                )
+        return out
+
+    def _graph(self) -> dict[int, set[int]]:
+        g: dict[int, set[int]] = {}
+        for ws, _wg, hs, _hg, _n, _t in self._edges():
+            g.setdefault(ws, set()).add(hs)
+        return g
+
+    def _cycle_through(self, session_id: int) -> Optional[list[int]]:
+        """Cycle containing session_id, as a list of gxids (for the error
+        message), else None."""
+        g = self._graph()
+        path: list[int] = []
+        seen: set[int] = set()
+
+        def dfs(s: int) -> Optional[list[int]]:
+            if s in path:
+                return path[path.index(s):]
+            if s in seen:
+                return None
+            seen.add(s)
+            path.append(s)
+            for nxt in g.get(s, ()):  # a holder may itself be waiting
+                got = dfs(nxt)
+                if got is not None:
+                    return got
+            path.pop()
+            return None
+
+        cyc = dfs(session_id)
+        if cyc is None or session_id not in cyc:
+            return None
+        gx = []
+        for s in cyc:
+            w = self._waiters.get(s)
+            if w is not None:
+                gx.append(w.gxid)
+            else:
+                for keys in (self._by_session.get(s, ()),):
+                    for key in keys:
+                        for h in self._held.get(key, ()):
+                            if h.session_id == s:
+                                gx.append(h.gxid)
+                                break
+                        break
+                    break
+        return gx or [0]
+
+    def _all_cycles(self) -> list[list[int]]:
+        """All distinct wait cycles (as session-id lists)."""
+        g = self._graph()
+        cycles: list[list[int]] = []
+        claimed: set[int] = set()
+        for s in list(g):
+            if s in claimed:
+                continue
+            path: list[int] = []
+
+            def dfs(x: int) -> Optional[list[int]]:
+                if x in path:
+                    return path[path.index(x):]
+                if x in claimed:
+                    return None
+                path.append(x)
+                for nxt in g.get(x, ()):
+                    got = dfs(nxt)
+                    if got is not None:
+                        return got
+                path.pop()
+                return None
+
+            cyc = dfs(s)
+            if cyc:
+                cycles.append(cyc)
+                claimed.update(cyc)
+        return cycles
+
+    def check_deadlock(self) -> list[tuple]:
+        """pg_unlock_check_deadlock: one row per detected cycle —
+        (cycle_index, gxid_path_text)."""
+        with self._cv:
+            rows = []
+            for i, cyc in enumerate(self._all_cycles()):
+                gxids = [
+                    self._waiters[s].gxid
+                    for s in cyc
+                    if s in self._waiters
+                ]
+                rows.append(
+                    (i, " -> ".join(str(g) for g in gxids + gxids[:1]))
+                )
+            return rows
+
+    def check_dependency(self) -> list[tuple]:
+        """pg_unlock_check_dependency: the merged wait-for edge list."""
+        with self._cv:
+            return [
+                (wg, hg, int(n), t)
+                for _ws, wg, _hs, hg, n, t in self._edges()
+            ]
+
+    def execute_unlock(self) -> list[int]:
+        """pg_unlock_execute: break every cycle by cancelling its
+        youngest transaction (highest gxid — least work lost, the
+        reference's victim choice). Returns cancelled gxids."""
+        with self._cv:
+            victims = []
+            for cyc in self._all_cycles():
+                in_wait = [s for s in cyc if s in self._waiters]
+                if not in_wait:
+                    continue
+                victim = max(in_wait, key=lambda s: self._waiters[s].gxid)
+                victims.append(self._waiters[victim].gxid)
+                self._victims[victim] = (
+                    "canceling statement due to deadlock "
+                    "(chosen as victim by pg_unlock_execute)"
+                )
+            self._cv.notify_all()
+            return victims
+
+    # -- observability (pg_locks) ----------------------------------------
+    def snapshot_rows(self) -> list[tuple]:
+        """(node, table, row_id|-1, mode, granted, session_id, gxid)."""
+        with self._cv:
+            rows = []
+            for key, hs in self._held.items():
+                node, table = key[0], key[1]
+                row_id = key[2] if len(key) == 3 else -1
+                for h in hs:
+                    rows.append(
+                        (int(node), table, int(row_id), h.mode, True,
+                         h.session_id, h.gxid)
+                    )
+            for w in self._waiters.values():
+                node, table = w.keys[0][0], w.keys[0][1]
+                row_id = w.keys[0][2] if len(w.keys[0]) == 3 else -1
+                rows.append(
+                    (int(node), table, int(row_id), w.mode, False,
+                     w.session_id, w.gxid)
+                )
+            return rows
+
+
+def table_lock_mode(sql_mode: Optional[str]) -> str:
+    """Map LOCK TABLE ... IN <mode> MODE to a conflict class."""
+    if sql_mode is None:
+        return TABLE_EXCLUSIVE  # LOCK TABLE default is ACCESS EXCLUSIVE
+    return (
+        TABLE_EXCLUSIVE
+        if sql_mode.lower() in _EXCLUSIVE_TABLE_MODES
+        else TABLE_SHARED
+    )

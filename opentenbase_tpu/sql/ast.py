@@ -264,6 +264,9 @@ class Select(Statement):
     distinct: bool = False
     # set operation chain: ("union"|"union all"|"intersect"|"except", Select)
     set_ops: list[tuple[str, "Select"]] = field(default_factory=list)
+    # row locking: FOR UPDATE / FOR SHARE [NOWAIT] (top level only)
+    for_update: Optional[str] = None
+    lock_nowait: bool = False
 
 
 @dataclass
@@ -544,6 +547,15 @@ class ExplainStmt(Statement):
 @dataclass
 class VacuumStmt(Statement):
     table: Optional[str] = None
+
+
+@dataclass
+class LockTable(Statement):
+    """LOCK [TABLE] name [IN <mode> MODE] [NOWAIT] (lockcmds.c)."""
+
+    table: str
+    mode: Optional[str] = None
+    nowait: bool = False
 
 
 @dataclass

@@ -217,6 +217,19 @@ class Parser:
             return A.UnpauseCluster()
         if kw == "execute":
             return self.parse_execute_direct()
+        if kw == "lock":
+            self.advance()
+            self.eat_kw("table")
+            name = self.ident("table name")
+            mode = None
+            if self.eat_kw("in"):
+                words = [self.ident("lock mode")]
+                while not self.at_kw("mode"):
+                    words.append(self.ident("lock mode"))
+                self.expect_kw("mode")
+                mode = " ".join(words)
+            nowait = bool(self.eat_kw("nowait"))
+            return A.LockTable(name, mode, nowait)
         self.error(f"unsupported statement {kw.upper()}")
 
     # -- SELECT ---------------------------------------------------------
@@ -247,6 +260,14 @@ class Parser:
                 sel.offset, last.offset = last.offset, None
         # trailing ORDER BY / LIMIT on the outer chain
         self._order_limit(sel)
+        if self.eat_kw("for"):
+            if self.eat_kw("update"):
+                sel.for_update = "update"
+            elif self.eat_kw("share"):
+                sel.for_update = "share"
+            else:
+                self.error("expected UPDATE or SHARE after FOR")
+            sel.lock_nowait = bool(self.eat_kw("nowait"))
         return sel
 
     def _select_core(self) -> A.Select:
@@ -1178,7 +1199,7 @@ _CLAUSE_KEYWORDS = {
     "intersect", "except", "on", "using", "join", "inner", "left", "right",
     "full", "cross", "as", "and", "or", "not", "in", "like", "ilike", "is",
     "between", "when", "then", "else", "end", "asc", "desc", "nulls",
-    "returning", "set", "values", "distribute", "to", "partition",
+    "returning", "set", "values", "distribute", "to", "partition", "for",
 }
 
 

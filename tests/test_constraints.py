@@ -169,3 +169,20 @@ def test_pk_on_partitioned_table_rules(s):
     s.execute("insert into pm values (1,'a')")
     with pytest.raises(SQLError, match="duplicate key"):
         s.execute("insert into pm values (1,'b')")
+
+
+def test_primary_key_implies_not_null():
+    """PRIMARY KEY columns reject NULL (review regression: a NULL pk used
+    to be stored as the 0 sentinel and collide with a real 0 key)."""
+    import pytest
+    from opentenbase_tpu.engine import Cluster, SQLError
+
+    s = Cluster(num_datanodes=2, shard_groups=32).session()
+    s.execute(
+        "create table t (k bigint primary key, v text) "
+        "distribute by shard(k)"
+    )
+    with pytest.raises(SQLError, match="null value"):
+        s.execute("insert into t (v) values ('a')")
+    s.execute("insert into t values (0, 'zero')")
+    assert s.query("select count(*) from t") == [(1,)]

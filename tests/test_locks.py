@@ -1,0 +1,340 @@
+"""Row/table locks + the distributed deadlock breaker (lmgr.py).
+
+Mirrors the reference's lock behavior surface: SELECT FOR UPDATE blocking
+(nodeLockRows.c / heap_lock_tuple), LOCK TABLE (lockcmds.c), NOWAIT /
+lock_timeout errors, and contrib/pg_unlock's cross-node wait-graph cycle
+detection and victim cancellation. Concurrency is driven with real
+threads, statements serialized on cluster._exec_lock exactly the way the
+wire server serializes them — which also exercises the manager's
+release-the-engine-lock-while-waiting path."""
+
+import threading
+import time
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster, SQLError
+
+
+@pytest.fixture()
+def c():
+    cluster = Cluster(num_datanodes=2, shard_groups=32)
+    s = cluster.session()
+    s.execute(
+        "create table acct (id bigint primary key, bal bigint) "
+        "distribute by shard(id)"
+    )
+    s.execute("insert into acct values (1,100),(2,200),(3,300),(4,400)")
+    return cluster
+
+
+def run(cluster, session, sql):
+    """Execute the way the wire server does: under the engine statement
+    lock (lock waits drop it, so other sessions can commit)."""
+    with cluster._exec_lock:
+        return session.execute(sql)
+
+
+def test_for_update_blocks_concurrent_update(c):
+    s1, s2 = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s1, "select * from acct where id = 1 for update")
+
+    done = []
+
+    def writer():
+        run(c, s2, "update acct set bal = 0 where id = 1")
+        done.append(time.monotonic())
+
+    th = threading.Thread(target=writer)
+    t0 = time.monotonic()
+    th.start()
+    time.sleep(0.3)
+    assert not done, "UPDATE should be blocked by FOR UPDATE"
+    run(c, s1, "commit")
+    th.join(timeout=10)
+    assert done and done[0] - t0 >= 0.25
+    assert run(c, c.session(), "select bal from acct where id = 1").rows == [(0,)]
+
+
+def test_for_update_nowait_raises(c):
+    s1, s2 = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s1, "select * from acct where id = 2 for update")
+    run(c, s2, "begin")
+    with pytest.raises(SQLError, match="could not obtain lock"):
+        run(c, s2, "select * from acct where id = 2 for update nowait")
+    run(c, s1, "rollback")
+    # after release it succeeds
+    assert run(c, s2, "select * from acct where id = 2 for update nowait").rowcount == 1
+    run(c, s2, "rollback")
+
+
+def test_for_share_coexists_but_blocks_writers(c):
+    s1, s2, s3 = c.session(), c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s2, "begin")
+    run(c, s1, "select * from acct where id = 3 for share")
+    run(c, s2, "select * from acct where id = 3 for share")  # no block
+    run(c, s3, "set lock_timeout = 200")
+    with pytest.raises(SQLError, match="lock timeout"):
+        run(c, s3, "delete from acct where id = 3")
+    run(c, s1, "commit")
+    run(c, s2, "commit")
+
+
+def test_lock_timeout_on_update(c):
+    s1, s2 = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s1, "update acct set bal = bal + 1 where id = 1")
+    run(c, s2, "set lock_timeout = 150")
+    t0 = time.monotonic()
+    with pytest.raises(SQLError, match="lock timeout"):
+        run(c, s2, "update acct set bal = bal - 1 where id = 1")
+    assert time.monotonic() - t0 < 5
+    run(c, s1, "rollback")
+
+
+def test_serialization_error_after_lock_wait(c):
+    """The waiter wakes because the holder committed an update to the
+    locked row: it must fail with a serialization error, not double-apply
+    (heap_lock_tuple's HeapTupleUpdated under REPEATABLE READ)."""
+    s1, s2 = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s1, "update acct set bal = 111 where id = 1")
+    errs = []
+
+    def waiter():
+        try:
+            run(c, s2, "update acct set bal = 222 where id = 1")
+        except SQLError as e:
+            errs.append(str(e))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.3)
+    run(c, s1, "commit")
+    th.join(timeout=10)
+    assert errs and "serialize" in errs[0]
+    assert run(c, c.session(), "select bal from acct where id = 1").rows == [(111,)]
+
+
+def test_deadlock_detected_and_broken(c):
+    """Classic two-session cycle across different rows. The detecting
+    waiter aborts with a deadlock error; the other proceeds."""
+    s1, s2 = c.session(), c.session()
+    for s in (s1, s2):
+        run(c, s, "set deadlock_timeout = 200")
+    run(c, s1, "begin")
+    run(c, s2, "begin")
+    # rows 1 and 2 hash to (possibly) different datanodes: the wait-for
+    # edges span nodes, which is pg_unlock's distributed case
+    run(c, s1, "update acct set bal = 0 where id = 1")
+    run(c, s2, "update acct set bal = 0 where id = 2")
+    outcome = {}
+
+    def t1():
+        try:
+            run(c, s1, "update acct set bal = 0 where id = 2")
+            outcome["s1"] = "ok"
+        except SQLError as e:
+            outcome["s1"] = str(e)
+
+    def t2():
+        try:
+            run(c, s2, "update acct set bal = 0 where id = 1")
+            outcome["s2"] = "ok"
+        except SQLError as e:
+            outcome["s2"] = str(e)
+
+    a, b = threading.Thread(target=t1), threading.Thread(target=t2)
+    a.start()
+    time.sleep(0.15)
+    b.start()
+    a.join(timeout=15)
+    b.join(timeout=15)
+    assert len(outcome) == 2
+    texts = sorted(outcome.values())
+    assert any("deadlock detected" in x for x in texts), outcome
+    # the survivor's statement completed; its txn can commit
+    survivor = s1 if "deadlock" not in outcome["s1"] else s2
+    assert outcome["s1" if survivor is s1 else "s2"] == "ok"
+    run(c, survivor, "commit")
+
+
+def test_pg_unlock_surface(c):
+    """pg_unlock_check_dependency / check_deadlock / execute as SQL."""
+    s1, s2, admin = c.session(), c.session(), c.session()
+    # huge deadlock_timeout: self-detection never fires, only pg_unlock
+    for s in (s1, s2):
+        run(c, s, "set deadlock_timeout = 600000")
+    run(c, s1, "begin")
+    run(c, s2, "begin")
+    run(c, s1, "update acct set bal = 0 where id = 1")
+    run(c, s2, "update acct set bal = 0 where id = 2")
+    outcome = {}
+
+    def t(sess, key, sql):
+        try:
+            run(c, sess, sql)
+            outcome[key] = "ok"
+        except SQLError as e:
+            outcome[key] = str(e)
+
+    a = threading.Thread(
+        target=t, args=(s1, "s1", "update acct set bal = 0 where id = 2")
+    )
+    b = threading.Thread(
+        target=t, args=(s2, "s2", "update acct set bal = 0 where id = 1")
+    )
+    a.start()
+    time.sleep(0.2)
+    b.start()
+    time.sleep(0.4)
+    # both now waiting: dependency edges + one cycle visible
+    deps = run(c, admin, "select pg_unlock_check_dependency()").rows
+    assert len(deps) >= 2
+    cycles = run(c, admin, "select pg_unlock_check_deadlock()").rows
+    assert len(cycles) == 1
+    cancelled = run(c, admin, "select pg_unlock_execute()").rows
+    assert len(cancelled) == 1
+    a.join(timeout=15)
+    b.join(timeout=15)
+    assert sorted(outcome) == ["s1", "s2"]
+    assert any("deadlock" in v for v in outcome.values()), outcome
+    assert any(v == "ok" for v in outcome.values()), outcome
+    # graph is clean afterwards
+    assert run(c, admin, "select pg_unlock_check_deadlock()").rows == []
+
+
+def test_lock_table_exclusive_blocks_insert_and_for_update(c):
+    s1, s2 = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s1, "lock table acct in exclusive mode")
+    run(c, s2, "set lock_timeout = 150")
+    with pytest.raises(SQLError, match="lock timeout"):
+        run(c, s2, "insert into acct values (9, 900)")
+    with pytest.raises(SQLError, match="lock timeout"):
+        run(c, s2, "select * from acct where id = 1 for update")
+    run(c, s1, "rollback")
+    assert run(c, s2, "insert into acct values (9, 900)").rowcount == 1
+
+
+def test_lock_table_requires_txn_block_and_nowait(c):
+    s1, s2 = c.session(), c.session()
+    with pytest.raises(SQLError, match="transaction block"):
+        run(c, s1, "lock table acct")
+    run(c, s1, "begin")
+    run(c, s1, "lock table acct in access exclusive mode")
+    run(c, s2, "begin")
+    with pytest.raises(SQLError, match="could not obtain lock"):
+        run(c, s2, "lock table acct nowait")
+    run(c, s1, "commit")
+    run(c, s2, "lock table acct nowait")
+    run(c, s2, "commit")
+
+
+def test_shared_lock_table_coexists(c):
+    s1, s2 = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s2, "begin")
+    run(c, s1, "lock table acct in share mode")
+    run(c, s2, "lock table acct in share mode")  # no conflict
+    # inserts coexist with shared table locks
+    run(c, s1, "insert into acct values (10, 0)")
+    run(c, s1, "commit")
+    run(c, s2, "commit")
+
+
+def test_pg_locks_view(c):
+    s1, admin = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s1, "select * from acct where id = 1 for update")
+    rows = run(
+        c, admin,
+        "select relation, mode, granted from pg_locks where granted",
+    ).rows
+    assert ("acct", "update", True) in rows
+    run(c, s1, "commit")
+    assert (
+        run(c, admin, "select count(*) from pg_locks").rows[0][0] == 0
+    )
+
+
+def test_locks_released_on_rollback_and_deadlock_abort(c):
+    s1, s2 = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s1, "select * from acct for update")
+    run(c, s1, "rollback")
+    # all released: immediate acquisition succeeds
+    run(c, s2, "begin")
+    run(c, s2, "select * from acct for update nowait")
+    run(c, s2, "commit")
+
+
+def test_for_update_outside_txn_releases_immediately(c):
+    s1, s2 = c.session(), c.session()
+    assert run(c, s1, "select * from acct where id = 1 for update").rowcount == 1
+    run(c, s2, "begin")
+    run(c, s2, "select * from acct where id = 1 for update nowait")
+    run(c, s2, "commit")
+
+
+def test_for_update_restrictions(c):
+    s = c.session()
+    with pytest.raises(SQLError, match="FOR UPDATE is only allowed"):
+        run(c, s, "select count(*) from acct group by bal for update")
+    with pytest.raises(SQLError, match="FOR UPDATE is only allowed"):
+        run(c, s, "select distinct bal from acct for update")
+
+
+def test_for_share_serialization_after_holder_commit(c):
+    """FOR SHARE must also fail when the awaited row version was
+    superseded by a committed update (review regression)."""
+    s1, s2 = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s1, "update acct set bal = 999 where id = 4")
+    errs = []
+
+    def waiter():
+        try:
+            run(c, s2, "select * from acct where id = 4 for share")
+        except SQLError as e:
+            errs.append(str(e))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.3)
+    run(c, s1, "commit")
+    th.join(timeout=10)
+    assert errs and "serialize" in errs[0]
+
+
+def test_lock_timeout_accepts_pg_duration_strings(c):
+    s1, s2 = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s1, "select * from acct where id = 1 for update")
+    run(c, s2, "set lock_timeout = '150ms'")
+    with pytest.raises(SQLError, match="lock timeout"):
+        run(c, s2, "delete from acct where id = 1")
+    run(c, s2, "set lock_timeout = 'bogus'")
+    with pytest.raises(SQLError, match="invalid value"):
+        run(c, s2, "delete from acct where id = 1")
+    run(c, s1, "rollback")
+
+
+def test_stale_victim_marker_does_not_poison_next_txn(c):
+    """A pg_unlock victim marker set for a session that abandoned its
+    wait (timeout) must not abort that session's next transaction."""
+    s1, s2 = c.session(), c.session()
+    run(c, s1, "begin")
+    run(c, s1, "select * from acct where id = 1 for update")
+    run(c, s2, "set lock_timeout = 100")
+    with pytest.raises(SQLError, match="lock timeout"):
+        run(c, s2, "update acct set bal = 1 where id = 1")
+    # simulate the breaker racing the abandoned wait
+    c.locks._victims[s2.session_id] = "stale"
+    c.locks.release_all(s2.session_id)
+    run(c, s1, "rollback")
+    run(c, s2, "set lock_timeout = 0")
+    assert run(c, s2, "update acct set bal = 1 where id = 1").rowcount == 1

@@ -70,6 +70,12 @@ def test_concurrent_sessions_isolated(server):
 
 
 def test_first_committer_wins(server):
+    """Concurrent writers: the second blocks on the first's row lock
+    (lmgr.py) and, once the first commits, fails its UPDATE with a
+    serialization error — PG's REPEATABLE READ behavior."""
+    import threading
+    import time
+
     with connect_tcp(server.host, server.port) as a, connect_tcp(
         server.host, server.port
     ) as b:
@@ -78,10 +84,22 @@ def test_first_committer_wins(server):
         a.execute("begin")
         a.execute("update t set v = 10 where k = 1")
         b.execute("begin")
-        b.execute("update t set v = 20 where k = 1")
+        errs = []
+
+        def blocked_writer():
+            try:
+                b.execute("update t set v = 20 where k = 1")
+            except WireError as e:
+                errs.append(str(e))
+
+        th = threading.Thread(target=blocked_writer)
+        th.start()
+        time.sleep(0.3)
+        assert th.is_alive(), "second writer should be lock-blocked"
         a.execute("commit")
-        with pytest.raises(WireError, match="serialize"):
-            b.execute("commit")
+        th.join(timeout=10)
+        assert errs and "serialize" in errs[0]
+        b.execute("rollback")
         assert a.query("select v from t where k = 1") == [(10,)]
 
 
@@ -209,11 +227,12 @@ def test_prepare_reserves_rows_commit_prepared_never_fails(server):
         a.execute("prepare transaction 'vote1'")
         # the row is still visible (delete undecided)...
         assert b.query("select v from t where k = 1") == [(0,)]
-        # ...but a competing writer loses against the reservation
+        # ...but a competing writer loses against the reservation — the
+        # row-lock layer surfaces it at the UPDATE itself
         b.execute("begin")
-        b.execute("update t set v = 20 where k = 1")
         with pytest.raises(WireError, match="serialize"):
-            b.execute("commit")
+            b.execute("update t set v = 20 where k = 1")
+        b.execute("rollback")
         a.execute("commit prepared 'vote1'")  # never raises
         assert b.query("select v from t where k = 1") == [(10,)]
 
