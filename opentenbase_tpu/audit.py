@@ -1,0 +1,265 @@
+"""Audit subsystem: statement auditing, FGA policies, audit log stream.
+
+The reference's security/audit layer (SURVEY §2, §1 layer map) consists of
+an Oracle-style AUDIT/NOAUDIT DDL surface (grammar at
+src/backend/parser/gram.y:11189), audit catalogs (src/include/catalog/
+pg_audit.h), fine-grained audit policies (the audit_fga regression suite),
+and a dedicated **auditlogger** postmaster child that receives audit
+records from every backend and writes the audit log stream separately
+from the server log (src/backend/postmaster/auditlogger.c).
+
+Here:
+
+- ``AuditManager`` holds statement-audit policies (action kind x optional
+  relation x optional user x WHENEVER [NOT] SUCCESSFUL) and FGA policies
+  (relation + predicate text), decides per executed statement what to
+  record, and hands records to the logger.
+- ``AuditLogger`` is the auditlogger-process analog: a dedicated writer
+  thread draining a queue into an append-only JSONL file (when the
+  cluster has a data_dir) and a bounded in-memory ring that backs the
+  ``pg_audit_log`` system view either way.
+
+Statement kinds audited: select / insert / update / delete / copy / ddl,
+plus ``all``. FGA (fine-grained audit) fires only when the audited
+relation actually contains rows satisfying the policy predicate under the
+statement's snapshot — the "audit only when the protected data was
+reachable" semantics of audit_fga.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class AuditPolicy:
+    kind: str  # select|insert|update|delete|copy|ddl|all
+    relation: Optional[str] = None  # None = every relation / no relation
+    db_user: Optional[str] = None  # None = every user
+    whenever: str = "all"  # all | successful | not successful
+
+    def matches(self, kind: str, relations: set, user: str,
+                success: bool) -> bool:
+        if self.kind != "all" and self.kind != kind:
+            return False
+        if self.relation is not None and self.relation not in relations:
+            return False
+        if self.db_user is not None and self.db_user != user:
+            return False
+        if self.whenever == "successful" and not success:
+            return False
+        if self.whenever == "not successful" and success:
+            return False
+        return True
+
+
+@dataclass(frozen=True)
+class FgaPolicy:
+    name: str
+    relation: str
+    predicate: str  # SQL boolean expression over the relation's columns
+
+
+class AuditLogger:
+    """Dedicated audit writer (auditlogger.c): backends enqueue, one
+    thread owns the sink. Records never interleave mid-line and a slow
+    disk never blocks a backend."""
+
+    def __init__(self, path: Optional[str] = None, ring_size: int = 10000):
+        self.path = path
+        self.ring: deque = deque(maxlen=ring_size)
+        self._q: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        if path is not None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._thread = threading.Thread(
+                target=self._writer, name="auditlogger", daemon=True
+            )
+            self._thread.start()
+
+    def emit(self, record: dict) -> None:
+        self.ring.append(record)
+        if self._thread is not None:
+            self._q.put(record)
+
+    def _writer(self) -> None:
+        with open(self.path, "a", encoding="utf-8") as f:
+            while True:
+                rec = self._q.get()
+                if rec is None:
+                    return
+                f.write(json.dumps(rec, default=str) + "\n")
+                # drain opportunistically, then fsync once per wakeup
+                try:
+                    while True:
+                        rec = self._q.get_nowait()
+                        if rec is None:
+                            f.flush()
+                            return
+                        f.write(json.dumps(rec, default=str) + "\n")
+                except queue.Empty:
+                    pass
+                f.flush()
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Wait for queued records to hit the file (tests/shutdown)."""
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class AuditManager:
+    _DDL_KINDS = {"ddl"}
+
+    def __init__(self, data_dir: Optional[str] = None):
+        path = (
+            os.path.join(data_dir, "audit", "audit.log")
+            if data_dir is not None
+            else None
+        )
+        self.logger = AuditLogger(path)
+        self.policies: list[AuditPolicy] = []
+        self.fga: dict[str, FgaPolicy] = {}
+        self._lock = threading.Lock()
+
+    # -- policy DDL ------------------------------------------------------
+    def add_policy(self, p: AuditPolicy) -> None:
+        with self._lock:
+            if p not in self.policies:
+                self.policies.append(p)
+
+    def remove_policy(self, kind: str, relation: Optional[str],
+                      db_user: Optional[str]) -> int:
+        """NOAUDIT: drop every policy the spec covers (kind 'all' drops
+        all kinds; no relation given drops both global and per-relation
+        policies of that kind)."""
+        with self._lock:
+            before = len(self.policies)
+            self.policies = [
+                p
+                for p in self.policies
+                if not (
+                    (kind == "all" or p.kind == kind)
+                    and (relation is None or p.relation == relation)
+                    and (db_user is None or p.db_user == db_user)
+                )
+            ]
+            return before - len(self.policies)
+
+    def add_fga(self, p: FgaPolicy) -> None:
+        with self._lock:
+            if p.name in self.fga:
+                raise ValueError(f'FGA policy "{p.name}" already exists')
+            self.fga[p.name] = p
+
+    def drop_fga(self, name: str) -> None:
+        with self._lock:
+            if name not in self.fga:
+                raise ValueError(f'FGA policy "{name}" does not exist')
+            del self.fga[name]
+
+    # -- record ----------------------------------------------------------
+    def record(
+        self,
+        kind: str,
+        relations: set,
+        user: str,
+        session_id: int,
+        success: bool,
+        statement: str,
+        policy_name: str = "",
+    ) -> bool:
+        """Emit an audit record if any policy covers the statement.
+        Returns True when a record was written."""
+        with self._lock:
+            hit = any(
+                p.matches(kind, relations, user, success)
+                for p in self.policies
+            )
+        if not hit and not policy_name:
+            return False
+        self.logger.emit(
+            {
+                "ts": time.time(),
+                "db_user": user,
+                "session_id": session_id,
+                "action": kind,
+                "relations": sorted(relations),
+                "success": success,
+                "statement": statement[:500],
+                "policy": policy_name,
+            }
+        )
+        return True
+
+    def fga_for(self, relations: set) -> list[FgaPolicy]:
+        with self._lock:
+            return [
+                p for p in self.fga.values() if p.relation in relations
+            ]
+
+    # -- observability ---------------------------------------------------
+    def policy_rows(self) -> list[tuple]:
+        with self._lock:
+            return [
+                (
+                    p.kind,
+                    p.relation or "",
+                    p.db_user or "",
+                    p.whenever,
+                )
+                for p in self.policies
+            ] + [
+                (
+                    "fga",
+                    p.relation,
+                    "",
+                    f"{p.name}: {p.predicate}",
+                )
+                for p in self.fga.values()
+            ]
+
+    def log_rows(self) -> list[tuple]:
+        return [
+            (
+                float(r["ts"]),
+                r["db_user"],
+                int(r["session_id"]),
+                r["action"],
+                ",".join(r["relations"]),
+                bool(r["success"]),
+                r["statement"],
+                r.get("policy", ""),
+            )
+            for r in list(self.logger.ring)
+        ]
+
+    # -- durability (redo payloads) --------------------------------------
+    def dump_state(self) -> dict:
+        with self._lock:
+            return {
+                "policies": [vars(p).copy() for p in self.policies],
+                "fga": [vars(p).copy() for p in self.fga.values()],
+            }
+
+    def load_state(self, state: dict) -> None:
+        with self._lock:
+            self.policies = [
+                AuditPolicy(**d) for d in state.get("policies", [])
+            ]
+            self.fga = {
+                d["name"]: FgaPolicy(**d) for d in state.get("fga", [])
+            }

@@ -223,6 +223,9 @@ class Cluster:
 
         self._exec_lock = _threading.RLock()
         self.locks = LockManager(self)
+        from opentenbase_tpu.audit import AuditManager
+
+        self.audit = AuditManager(data_dir)
         self.barriers: list[tuple[str, int]] = []
         self.indexes: dict[str, A.CreateIndex] = {}
         # interval/range partitioning: parent name -> PartitionSpec
@@ -516,6 +519,7 @@ class Cluster:
         close_gts = getattr(self.gts, "close", None)
         if close_gts is not None:
             close_gts()
+        self.audit.logger.close()
         if self.persistence is not None:
             self.persistence.wal.close()
         tmpdir = getattr(self, "_gts_tmpdir", None)
@@ -540,10 +544,12 @@ class Cluster:
 class Session:
     _next_id = 1
 
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster, user: str = "otb"):
         self.cluster = cluster
         self.txn: Optional[Transaction] = None
         self.gucs: dict[str, object] = {}
+        self.user = user
+        self._in_audit = False
         self.session_id = Session._next_id
         Session._next_id += 1
         self.last_query: str = ""
@@ -564,7 +570,16 @@ class Session:
             stmts = parse(sql)
             for i, s in enumerate(stmts):
                 t0 = _time.perf_counter()
-                r = self._execute_one(s)
+                # FGA probes for destructive statements must see the data
+                # BEFORE the statement removes/masks it
+                fga_pre = self._fga_prehits(s)
+                try:
+                    r = self._execute_one(s)
+                except Exception:
+                    self._audit_statement(s, success=False,
+                                          fga_pre=fga_pre)
+                    raise
+                self._audit_statement(s, success=True, fga_pre=fga_pre)
                 ms = (_time.perf_counter() - t0) * 1000
                 if isinstance(
                     s, (A.Select, A.Insert, A.Update, A.Delete, A.ExecuteStmt)
@@ -845,6 +860,129 @@ class Session:
             raise SQLError(str(e))
         except (LockTimeout, LockNotAvailable) as e:
             raise SQLError(str(e))
+
+    # -- audit hooks (auditlogger.c backend side) -------------------------
+    _AUDIT_DML = {
+        "Insert": "insert", "Update": "update", "Delete": "delete",
+        "CopyStmt": "copy",
+    }
+    _AUDIT_DDL_CLASSES = (
+        "CreateTable", "DropTable", "AlterTable", "TruncateTable",
+        "CreateView", "DropView", "CreateTableAs", "CreateIndex",
+        "CreateNode", "DropNode", "AlterNode", "CreateNodeGroup",
+        "DropNodeGroup", "CreateSequence", "DropSequence",
+        "CreateShardingGroup", "AuditStmt", "NoAuditStmt",
+    )
+
+    def _audit_classify(self, stmt) -> tuple[Optional[str], set]:
+        cls = type(stmt).__name__
+        if cls == "Select":
+            refs: set = set()
+            try:
+                self._referenced_tables(stmt, refs)
+            except Exception:
+                pass
+            return "select", refs
+        if cls in self._AUDIT_DML:
+            return self._AUDIT_DML[cls], {getattr(stmt, "table", None)} - {
+                None
+            }
+        if cls in self._AUDIT_DDL_CLASSES:
+            rel = getattr(stmt, "name", None) or getattr(
+                stmt, "table", None
+            ) or getattr(stmt, "relation", None)
+            return "ddl", {rel} - {None}
+        return None, set()
+
+    def _fga_probe_one(self, pol) -> bool:
+        """Does the audited relation hold rows satisfying the policy
+        predicate right now (under the session's current snapshot)?"""
+        try:
+            probe = parse(
+                f"select 1 from {pol.relation} "
+                f"where {pol.predicate} limit 1"
+            )[0]
+            return bool(self._run_select(probe).nrows)
+        except Exception:
+            return False  # a broken predicate must not fail queries
+
+    def _fga_prehits(self, stmt) -> list:
+        """FGA policies whose protected rows are reachable BEFORE a
+        destructive statement runs — an UPDATE/DELETE that removes or
+        masks the protected rows is exactly the access audit_fga exists
+        to catch, so the probe cannot wait until after execution."""
+        mgr = self.cluster.audit
+        if self._in_audit or not mgr.fga:
+            return []
+        kind, relations = self._audit_classify(stmt)
+        if kind not in ("update", "delete", "copy"):
+            return []
+        self._in_audit = True
+        try:
+            return [
+                pol for pol in mgr.fga_for(relations)
+                if self._fga_probe_one(pol)
+            ]
+        finally:
+            self._in_audit = False
+
+    def _audit_statement(self, stmt, success: bool, fga_pre=()) -> None:
+        if self._in_audit:
+            return
+        mgr = self.cluster.audit
+        if not mgr.policies and not mgr.fga:
+            return
+        kind, relations = self._audit_classify(stmt)
+        if kind is None:
+            return
+        self._in_audit = True
+        try:
+            mgr.record(
+                kind, relations, self.user, self.session_id, success,
+                self.last_query,
+            )
+            if not success:
+                return
+            # fine-grained audit (audit_fga semantics): reads probe after
+            # the statement (data unchanged); destructive statements use
+            # the pre-execution probe result
+            hits = list(fga_pre)
+            if kind == "select":
+                hits = [
+                    pol for pol in mgr.fga_for(relations)
+                    if self._fga_probe_one(pol)
+                ]
+            for pol in hits:
+                mgr.record(
+                    kind, {pol.relation}, self.user, self.session_id,
+                    success, self.last_query, policy_name=pol.name,
+                )
+        finally:
+            self._in_audit = False
+
+    def _x_auditstmt(self, stmt: A.AuditStmt) -> Result:
+        from opentenbase_tpu.audit import AuditPolicy
+
+        self.cluster.audit.add_policy(
+            AuditPolicy(stmt.kind, stmt.relation, stmt.db_user,
+                        stmt.whenever)
+        )
+        self._log_audit_state()
+        return Result("AUDIT")
+
+    def _x_noauditstmt(self, stmt: A.NoAuditStmt) -> Result:
+        self.cluster.audit.remove_policy(
+            stmt.kind, stmt.relation, stmt.db_user
+        )
+        self._log_audit_state()
+        return Result("NOAUDIT")
+
+    def _log_audit_state(self) -> None:
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "audit_state",
+                 "payload": self.cluster.audit.dump_state()}
+            )
 
     # -- sequence functions (nextval/currval/setval as SQL) ---------------
     _SEQ_FUNCS = ("nextval", "currval", "setval")
@@ -1171,6 +1309,8 @@ class Session:
         "pg_unlock_check_deadlock",
         "pg_unlock_check_dependency",
         "pg_clean_execute",
+        "pg_audit_add_fga_policy",
+        "pg_audit_drop_fga_policy",
     }
 
     def _maybe_admin_function(self, stmt: A.Select) -> Optional[Result]:
@@ -1180,7 +1320,8 @@ class Session:
         if not isinstance(e, A.FuncCall) or e.name not in self._ADMIN_FUNCS:
             return None
         if self.cluster.read_only and e.name in (
-            "pg_unlock_execute", "pg_clean_execute"
+            "pg_unlock_execute", "pg_clean_execute",
+            "pg_audit_add_fga_policy", "pg_audit_drop_fga_policy",
         ):
             # state-mutating admin functions are primary-only; standby 2PC
             # state is owned by WAL replay (same gate as nextval/setval)
@@ -1208,6 +1349,38 @@ class Session:
                 ["waiter_gxid", "holder_gxid", "node_index", "relation"],
                 len(rows),
             )
+        if e.name == "pg_audit_add_fga_policy":
+            # (relation, predicate_sql, policy_name) — audit_fga's
+            # add_policy with the condition kept as SQL text
+            from opentenbase_tpu.audit import FgaPolicy
+
+            if len(e.args) != 3:
+                raise SQLError(
+                    "pg_audit_add_fga_policy(relation, predicate, name)"
+                )
+            rel, pred, name = (str(self._const_arg(a)) for a in e.args)
+            if not self.cluster.catalog.has(rel):
+                raise SQLError(f'table "{rel}" does not exist')
+            try:  # validate the predicate NOW, not at first audit
+                parse(f"select 1 from {rel} where {pred}")
+            except Exception:
+                raise SQLError(f"invalid FGA predicate: {pred!r}")
+            try:
+                self.cluster.audit.add_fga(FgaPolicy(name, rel, pred))
+            except ValueError as ve:
+                raise SQLError(str(ve))
+            self._log_audit_state()
+            return Result("SELECT", [(name,)], ["policy"], 1)
+        if e.name == "pg_audit_drop_fga_policy":
+            if len(e.args) != 1:
+                raise SQLError("pg_audit_drop_fga_policy(name)")
+            name = str(self._const_arg(e.args[0]))
+            try:
+                self.cluster.audit.drop_fga(name)
+            except ValueError as ve:
+                raise SQLError(str(ve))
+            self._log_audit_state()
+            return Result("SELECT", [(name,)], ["policy"], 1)
         # pg_clean_execute([max_age_seconds]): resolve stale in-doubt 2PC
         age = float(self._const_arg(e.args[0])) if e.args else 300.0
         gids = self.cluster.clean_2pc(max_age_s=age)
@@ -1294,12 +1467,24 @@ class Session:
             raise SQLError(f'table "{stmt.table}" does not exist')
         meta = self.cluster.catalog.get(stmt.table)
         mode = table_lock_mode(stmt.mode)
-        keys = [(node, stmt.table) for node in meta.node_indices]
+        keys = [
+            (node, tb)
+            for tb in self._lock_table_names(stmt.table)
+            for node in meta.node_indices
+        ]
         self.cluster.locks.acquire(
             self.session_id, self.txn.gxid, keys, mode,
             nowait=stmt.nowait, **self._lock_opts(),
         )
         return Result("LOCK TABLE")
+
+    def _lock_table_names(self, name: str) -> list[str]:
+        """Table-lock key set: a partitioned parent covers its children
+        (PG locks partitions through the parent the same way)."""
+        spec = self.cluster.partitions.get(name)
+        if spec is not None:
+            return [name, *spec.children()]
+        return [name]
 
     # -- system views (pg_stat_* / pgxc_* observability surface) ---------
     def _referenced_tables(self, sel: A.Select, acc: set) -> None:
@@ -1446,10 +1631,16 @@ class Session:
         txn, implicit = self._begin_implicit()
         try:
             # RowExclusive-class table lock: coexists with other writers,
-            # conflicts with LOCK TABLE ... EXCLUSIVE (lockcmds.c matrix)
+            # conflicts with LOCK TABLE ... EXCLUSIVE (lockcmds.c matrix).
+            # A partitioned parent locks its children too, so LOCK TABLE
+            # on either the parent or a child partition fences the insert.
             self.cluster.locks.acquire(
                 self.session_id, txn.gxid,
-                [(node, iplan.table) for node in meta.node_indices],
+                [
+                    (node, tb)
+                    for tb in self._lock_table_names(iplan.table)
+                    for node in meta.node_indices
+                ],
                 TABLE_SHARED, **self._lock_opts(),
             )
             spec = self.cluster.partitions.get(iplan.table)
@@ -2502,6 +2693,10 @@ class Session:
                 v = False
             elif low.lstrip("-").isdigit():
                 v = int(low)
+        if stmt.name in ("session_authorization", "role"):
+            # audited statements carry the effective user (pg_audit's
+            # db_user dimension)
+            self.user = str(stmt.value)
         self.gucs[stmt.name] = v
         return Result("SET")
 
@@ -2654,6 +2849,14 @@ def _sv_pg_locks(c: Cluster):
     return c.locks.snapshot_rows()
 
 
+def _sv_audit_actions(c: Cluster):
+    return c.audit.policy_rows()
+
+
+def _sv_audit_log(c: Cluster):
+    return c.audit.log_rows()
+
+
 def _sv_pgxc_node(c: Cluster):
     return [
         (
@@ -2799,6 +3002,28 @@ def _sv_views(c: Cluster):
 
 
 _SYSTEM_VIEWS: dict[str, tuple] = {
+    "pg_audit_actions": (
+        {
+            "action": t.TEXT,
+            "relation": t.TEXT,
+            "db_user": t.TEXT,
+            "whenever": t.TEXT,
+        },
+        _sv_audit_actions,
+    ),
+    "pg_audit_log": (
+        {
+            "ts": t.FLOAT8,
+            "db_user": t.TEXT,
+            "session_id": t.INT4,
+            "action": t.TEXT,
+            "relations": t.TEXT,
+            "success": t.BOOL,
+            "statement": t.TEXT,
+            "policy": t.TEXT,
+        },
+        _sv_audit_log,
+    ),
     "pg_locks": (
         {
             "node_index": t.INT4,

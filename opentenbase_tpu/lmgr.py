@@ -284,21 +284,8 @@ class LockManager:
         cyc = dfs(session_id)
         if cyc is None or session_id not in cyc:
             return None
-        gx = []
-        for s in cyc:
-            w = self._waiters.get(s)
-            if w is not None:
-                gx.append(w.gxid)
-            else:
-                for keys in (self._by_session.get(s, ()),):
-                    for key in keys:
-                        for h in self._held.get(key, ()):
-                            if h.session_id == s:
-                                gx.append(h.gxid)
-                                break
-                        break
-                    break
-        return gx or [0]
+        # every cycle member has an outgoing wait edge, so all are waiters
+        return [self._waiters[s].gxid for s in cyc if s in self._waiters]
 
     def _all_cycles(self) -> list[list[int]]:
         """All distinct wait cycles (as session-id lists)."""

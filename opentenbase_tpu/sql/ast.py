@@ -550,6 +550,24 @@ class VacuumStmt(Statement):
 
 
 @dataclass
+class AuditStmt(Statement):
+    """AUDIT <kind> [ON rel] [BY user] [WHENEVER [NOT] SUCCESSFUL]
+    (gram.y:11189, Oracle-style audit DDL)."""
+
+    kind: str  # all|select|insert|update|delete|copy|ddl
+    relation: Optional[str] = None
+    db_user: Optional[str] = None
+    whenever: str = "all"  # all | successful | not successful
+
+
+@dataclass
+class NoAuditStmt(Statement):
+    kind: str
+    relation: Optional[str] = None
+    db_user: Optional[str] = None
+
+
+@dataclass
 class LockTable(Statement):
     """LOCK [TABLE] name [IN <mode> MODE] [NOWAIT] (lockcmds.c)."""
 

@@ -217,6 +217,30 @@ class Parser:
             return A.UnpauseCluster()
         if kw == "execute":
             return self.parse_execute_direct()
+        if kw in ("audit", "noaudit"):
+            self.advance()
+            kind = self.ident("audit action")
+            if kind not in (
+                "all", "select", "insert", "update", "delete", "copy", "ddl"
+            ):
+                self.error(f"unknown audit action {kind!r}")
+            relation = None
+            db_user = None
+            whenever = "all"
+            while True:
+                if self.eat_kw("on"):
+                    relation = self.ident("relation")
+                elif self.eat_kw("by"):
+                    db_user = self.ident("user")
+                elif kw == "audit" and self.eat_kw("whenever"):
+                    neg = bool(self.eat_kw("not"))
+                    self.expect_kw("successful")
+                    whenever = "not successful" if neg else "successful"
+                else:
+                    break
+            if kw == "audit":
+                return A.AuditStmt(kind, relation, db_user, whenever)
+            return A.NoAuditStmt(kind, relation, db_user)
         if kw == "lock":
             self.advance()
             self.eat_kw("table")

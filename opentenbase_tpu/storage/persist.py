@@ -798,6 +798,8 @@ class ClusterPersistence:
                     node = c.nodes.get(header["name"])
                     c.nodes.drop_node(header["name"], force=True)
                     c.stores.pop(getattr(node, "mesh_index", -1), None)
+            elif op == "audit_state":
+                c.audit.load_state(header["payload"])
             elif op == "dict_extend":
                 tm = c.catalog.get(header["table"])
                 d = tm.dictionaries[header["column"]]

@@ -338,3 +338,26 @@ def test_stale_victim_marker_does_not_poison_next_txn(c):
     run(c, s1, "rollback")
     run(c, s2, "set lock_timeout = 0")
     assert run(c, s2, "update acct set bal = 1 where id = 1").rowcount == 1
+
+
+def test_lock_table_covers_partitions(c):
+    """LOCK TABLE on a child partition blocks inserts routed through the
+    parent, and LOCK TABLE on the parent blocks direct child inserts
+    (review regression)."""
+    s0 = c.session()
+    run(c, s0,
+        "create table ev (ts bigint, v bigint) distribute by shard(ts) "
+        "partition by range (ts) begin (0) step (100) partitions (2)")
+    s1, s2 = c.session(), c.session()
+    run(c, s2, "set lock_timeout = 150")
+    run(c, s1, "begin")
+    run(c, s1, "lock table ev$p0 in exclusive mode")
+    with pytest.raises(SQLError, match="lock timeout"):
+        run(c, s2, "insert into ev values (1, 1)")
+    run(c, s1, "rollback")
+    run(c, s1, "begin")
+    run(c, s1, "lock table ev in exclusive mode")
+    with pytest.raises(SQLError, match="lock timeout"):
+        run(c, s2, "insert into ev$p0 values (2, 2)")
+    run(c, s1, "rollback")
+    assert run(c, s2, "insert into ev values (3, 3)").rowcount == 1
