@@ -226,6 +226,9 @@ class Cluster:
         from opentenbase_tpu.audit import AuditManager
 
         self.audit = AuditManager(data_dir)
+        # logical replication: publications + running apply workers
+        self.publications: dict[str, dict] = {}
+        self.subscriptions: dict[str, object] = {}
         self.barriers: list[tuple[str, int]] = []
         self.indexes: dict[str, A.CreateIndex] = {}
         # interval/range partitioning: parent name -> PartitionSpec
@@ -277,6 +280,11 @@ class Cluster:
         BARRIER point, barrier.c)."""
         c = cls(num_datanodes, shard_groups, data_dir, gts_backend)
         c.persistence.recover(until_barrier=until_barrier)
+        # restart logical-replication apply workers (the launcher starting
+        # apply workers for every enabled subscription after crash
+        # recovery); they reconnect-retry until the publisher is back
+        for worker in c.subscriptions.values():
+            worker.start()
         return c
 
     def fused_executor(self):
@@ -520,6 +528,8 @@ class Cluster:
         if close_gts is not None:
             close_gts()
         self.audit.logger.close()
+        for worker in self.subscriptions.values():
+            worker.stop()
         if self.persistence is not None:
             self.persistence.wal.close()
         tmpdir = getattr(self, "_gts_tmpdir", None)
@@ -1311,6 +1321,10 @@ class Session:
         "pg_clean_execute",
         "pg_audit_add_fga_policy",
         "pg_audit_drop_fga_policy",
+        "pg_current_wal_lsn",
+        "pg_logical_slot_changes",
+        "pg_publication_tables",
+        "pg_logical_sync",
     }
 
     def _maybe_admin_function(self, stmt: A.Select) -> Optional[Result]:
@@ -1348,6 +1362,124 @@ class Session:
                 rows,
                 ["waiter_gxid", "holder_gxid", "node_index", "relation"],
                 len(rows),
+            )
+        if e.name == "pg_current_wal_lsn":
+            p = self.cluster.persistence
+            pos = p.wal.position if p is not None else 0
+            return Result("SELECT", [(int(pos),)], ["lsn"], 1)
+        if e.name == "pg_publication_tables":
+            if len(e.args) != 1:
+                raise SQLError("pg_publication_tables(publication)")
+            pubname = str(self._const_arg(e.args[0]))
+            pub = self.cluster.publications.get(pubname)
+            if pub is None:
+                raise SQLError(
+                    f'publication "{pubname}" does not exist'
+                )
+            tables = (
+                pub["tables"]
+                if pub["tables"] is not None
+                else [
+                    nm for nm in self.cluster.catalog._tables
+                    if nm not in _SYSTEM_VIEWS
+                ]
+            )
+            return Result(
+                "SELECT", [(tb,) for tb in tables], ["tablename"],
+                len(tables),
+            )
+        if e.name == "pg_logical_slot_changes":
+            # the pgoutput/walsender surface: decode committed frames for
+            # a publication starting at the given slot offset
+            import json as _json
+
+            from opentenbase_tpu.storage.logical import decode_changes
+
+            if len(e.args) != 2:
+                raise SQLError(
+                    "pg_logical_slot_changes(publication, lsn)"
+                )
+            pubname = str(self._const_arg(e.args[0]))
+            lsn = int(self._const_arg(e.args[1]))
+            pub = self.cluster.publications.get(pubname)
+            if pub is None:
+                raise SQLError(
+                    f'publication "{pubname}" does not exist'
+                )
+            next_off, frames = decode_changes(self.cluster, pub, lsn)
+
+            def _default(o):
+                item = getattr(o, "item", None)
+                return item() if item is not None else str(o)
+
+            rows = [
+                (
+                    int(fr["next_off"]),
+                    _json.dumps(
+                        {"commit_ts": fr["commit_ts"],
+                         "changes": fr["changes"]},
+                        default=_default,
+                    ),
+                )
+                for fr in frames
+            ]
+            # trailing fast-forward row: the slot must advance past WAL
+            # activity on unpublished tables, else the subscriber
+            # re-scans an ever-growing tail every poll
+            if next_off > lsn and (
+                not rows or rows[-1][0] < next_off
+            ):
+                rows.append((int(next_off), ""))
+            return Result(
+                "SELECT", rows, ["next_lsn", "frame"], len(rows)
+            )
+        if e.name == "pg_logical_sync":
+            # initial-table-sync snapshot: every published table's live
+            # rows + the WAL lsn the copy is consistent with, in ONE
+            # statement (the caller's wire request holds the statement
+            # lock across both)
+            import json as _json
+
+            if len(e.args) != 1:
+                raise SQLError("pg_logical_sync(publication)")
+            pubname = str(self._const_arg(e.args[0]))
+            pub = self.cluster.publications.get(pubname)
+            if pub is None:
+                raise SQLError(
+                    f'publication "{pubname}" does not exist'
+                )
+            p = self.cluster.persistence
+            out = [("", str(int(p.wal.position if p else 0)))]
+
+            def _default(o):
+                item = getattr(o, "item", None)
+                return item() if item is not None else str(o)
+
+            tables = (
+                pub["tables"]
+                if pub["tables"] is not None
+                else [
+                    nm for nm in self.cluster.catalog._tables
+                    if nm not in _SYSTEM_VIEWS
+                ]
+            )
+            for tb in tables:
+                if not self.cluster.catalog.has(tb):
+                    continue
+                meta = self.cluster.catalog.get(tb)
+                cols = ", ".join(meta.schema)
+                batch = self._run_select(
+                    parse(f"select {cols} from {tb}")[0]
+                )
+                for row in batch.to_rows():
+                    out.append(
+                        (tb, _json.dumps(
+                            dict(zip(meta.schema, row)),
+                            default=_default,
+                        ))
+                    )
+            return Result(
+                "SELECT", out, ["tablename", "payload"], len(out)
             )
         if e.name == "pg_audit_add_fga_policy":
             # (relation, predicate_sql, policy_name) — audit_fga's
@@ -1456,6 +1588,76 @@ class Session:
         return Result(
             "SELECT", batch.to_rows(), batch.column_names(), batch.nrows
         )
+
+    # -- logical replication DDL (publicationcmds.c / subscriptioncmds.c,
+    # shard-filtered variants pg_publication_shard.h) ---------------------
+    def _x_createpublication(self, stmt: A.CreatePublication) -> Result:
+        if stmt.name in self.cluster.publications:
+            raise SQLError(f'publication "{stmt.name}" already exists')
+        if stmt.tables is not None:
+            for tb in stmt.tables:
+                if not self.cluster.catalog.has(tb):
+                    raise SQLError(f'table "{tb}" does not exist')
+        nodes = None
+        if stmt.nodes is not None:
+            nodes = [
+                self.cluster.nodes.get(n).mesh_index for n in stmt.nodes
+            ]
+        pub = {"tables": stmt.tables, "nodes": nodes}
+        self.cluster.publications[stmt.name] = pub
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "create_publication", "name": stmt.name, **pub}
+            )
+        return Result("CREATE PUBLICATION")
+
+    def _x_droppublication(self, stmt: A.DropPublication) -> Result:
+        if stmt.name not in self.cluster.publications:
+            raise SQLError(f'publication "{stmt.name}" does not exist')
+        del self.cluster.publications[stmt.name]
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "drop_publication", "name": stmt.name}
+            )
+        return Result("DROP PUBLICATION")
+
+    def _x_createsubscription(self, stmt: A.CreateSubscription) -> Result:
+        from opentenbase_tpu.storage.logical import SubscriptionWorker
+
+        if stmt.name in self.cluster.subscriptions:
+            raise SQLError(f'subscription "{stmt.name}" already exists')
+        worker = SubscriptionWorker(
+            self.cluster, stmt.name, stmt.conninfo, stmt.publication
+        )
+        if not stmt.copy_data:
+            worker.synced = True
+        self.cluster.subscriptions[stmt.name] = worker
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {
+                    "op": "create_subscription",
+                    "name": stmt.name,
+                    "conninfo": stmt.conninfo,
+                    "publication": stmt.publication,
+                    "copy_data": stmt.copy_data,
+                }
+            )
+        worker.start()
+        return Result("CREATE SUBSCRIPTION")
+
+    def _x_dropsubscription(self, stmt: A.DropSubscription) -> Result:
+        worker = self.cluster.subscriptions.pop(stmt.name, None)
+        if worker is None:
+            raise SQLError(f'subscription "{stmt.name}" does not exist')
+        # no join: under the wire server THIS statement holds the cluster
+        # statement lock the worker may be parked on — the worker
+        # re-checks the stop flag under that lock and exits cleanly
+        worker.stop(join=False)
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "drop_subscription", "name": stmt.name}
+            )
+        return Result("DROP SUBSCRIPTION")
 
     def _x_locktable(self, stmt: A.LockTable) -> Result:
         """LOCK TABLE (lockcmds.c): table-level lock on every owning
@@ -2849,6 +3051,33 @@ def _sv_pg_locks(c: Cluster):
     return c.locks.snapshot_rows()
 
 
+def _sv_publication(c: Cluster):
+    return [
+        (
+            name,
+            ",".join(pub["tables"]) if pub["tables"] is not None else "*",
+            ",".join(str(n) for n in pub["nodes"])
+            if pub["nodes"] is not None
+            else "",
+        )
+        for name, pub in c.publications.items()
+    ]
+
+
+def _sv_subscription(c: Cluster):
+    return [
+        (
+            w.name,
+            w.publication,
+            w.conninfo,
+            int(w.lsn),
+            bool(w.synced),
+            w.last_error,
+        )
+        for w in c.subscriptions.values()
+    ]
+
+
 def _sv_audit_actions(c: Cluster):
     return c.audit.policy_rows()
 
@@ -3002,6 +3231,21 @@ def _sv_views(c: Cluster):
 
 
 _SYSTEM_VIEWS: dict[str, tuple] = {
+    "pg_publication": (
+        {"pubname": t.TEXT, "tables": t.TEXT, "nodes": t.TEXT},
+        _sv_publication,
+    ),
+    "pg_subscription": (
+        {
+            "subname": t.TEXT,
+            "publication": t.TEXT,
+            "conninfo": t.TEXT,
+            "lsn": t.INT8,
+            "synced": t.BOOL,
+            "last_error": t.TEXT,
+        },
+        _sv_subscription,
+    ),
     "pg_audit_actions": (
         {
             "action": t.TEXT,

@@ -550,6 +550,38 @@ class VacuumStmt(Statement):
 
 
 @dataclass
+class CreatePublication(Statement):
+    """CREATE PUBLICATION name FOR ALL TABLES | FOR TABLE t1 [, ...]
+    [ON NODE (dn, ...)] — node list = shard-filtered publication
+    (pg_publication_shard)."""
+
+    name: str
+    tables: Optional[list[str]] = None  # None = FOR ALL TABLES
+    nodes: Optional[list[str]] = None
+
+
+@dataclass
+class DropPublication(Statement):
+    name: str
+
+
+@dataclass
+class CreateSubscription(Statement):
+    """CREATE SUBSCRIPTION name CONNECTION 'host=.. port=..'
+    PUBLICATION pub [WITH (copy_data = on|off)]."""
+
+    name: str
+    conninfo: str
+    publication: str
+    copy_data: bool = True
+
+
+@dataclass
+class DropSubscription(Statement):
+    name: str
+
+
+@dataclass
 class AuditStmt(Statement):
     """AUDIT <kind> [ON rel] [BY user] [WHENEVER [NOT] SUCCESSFUL]
     (gram.y:11189, Oracle-style audit DDL)."""

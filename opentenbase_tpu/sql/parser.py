@@ -548,6 +548,46 @@ class Parser:
                 self.expect_op(")")
                 return A.CreateNodeGroup(name, members)
             return self._create_node()
+        if self.eat_kw("publication"):
+            name = self.ident("publication name")
+            self.expect_kw("for")
+            if self.eat_kw("all"):
+                self.expect_kw("tables")
+                tables = None
+            else:
+                self.expect_kw("table")
+                tables = [self.ident("table name")]
+                while self.eat_op(","):
+                    tables.append(self.ident("table name"))
+            nodes = None
+            if self.eat_kw("on"):
+                self.expect_kw("node")
+                self.expect_op("(")
+                nodes = [self.ident("node name")]
+                while self.eat_op(","):
+                    nodes.append(self.ident("node name"))
+                self.expect_op(")")
+            return A.CreatePublication(name, tables, nodes)
+        if self.eat_kw("subscription"):
+            name = self.ident("subscription name")
+            self.expect_kw("connection")
+            conninfo = self._string_lit()
+            self.expect_kw("publication")
+            pub = self.ident("publication name")
+            copy_data = True
+            if self.eat_kw("with"):
+                self.expect_op("(")
+                while not self.at_op(")"):
+                    opt = self.ident("option")
+                    self.expect_op("=")
+                    val = self.advance().value
+                    if opt == "copy_data":
+                        copy_data = str(val).lower() in (
+                            "on", "true", "yes", "1"
+                        )
+                    self.eat_op(",")
+                self.expect_op(")")
+            return A.CreateSubscription(name, conninfo, pub, copy_data)
         if self.eat_kw("sharding", "group"):
             members: list[str] = []
             if self.eat_kw("to", "group"):
@@ -793,6 +833,10 @@ class Parser:
         if self.eat_kw("sequence"):
             if_exists = bool(self.eat_kw("if", "exists"))
             return A.DropSequence(self.ident("sequence name"), if_exists)
+        if self.eat_kw("publication"):
+            return A.DropPublication(self.ident("publication name"))
+        if self.eat_kw("subscription"):
+            return A.DropSubscription(self.ident("subscription name"))
         self.error("unsupported DROP")
 
     def parse_truncate(self) -> A.TruncateTable:

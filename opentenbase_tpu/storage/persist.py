@@ -800,6 +800,35 @@ class ClusterPersistence:
                     c.stores.pop(getattr(node, "mesh_index", -1), None)
             elif op == "audit_state":
                 c.audit.load_state(header["payload"])
+            elif op == "create_publication":
+                c.publications[header["name"]] = {
+                    "tables": header["tables"], "nodes": header["nodes"]
+                }
+            elif op == "drop_publication":
+                c.publications.pop(header["name"], None)
+            elif op == "create_subscription":
+                from opentenbase_tpu.storage.logical import (
+                    SubscriptionWorker,
+                )
+
+                w = SubscriptionWorker(
+                    c, header["name"], header["conninfo"],
+                    header["publication"],
+                )
+                if not header.get("copy_data", True):
+                    w.synced = True
+                # NOT started here: Cluster.recover launches the workers
+                # after redo finishes (the logical-replication launcher)
+                c.subscriptions[header["name"]] = w
+            elif op == "drop_subscription":
+                w = c.subscriptions.pop(header["name"], None)
+                if w is not None:
+                    w.stop()
+            elif op == "subscription_state":
+                w = c.subscriptions.get(header["name"])
+                if w is not None:
+                    w.lsn = max(w.lsn, header["lsn"])
+                    w.synced = w.synced or header.get("synced", False)
             elif op == "dict_extend":
                 tm = c.catalog.get(header["table"])
                 d = tm.dictionaries[header["column"]]

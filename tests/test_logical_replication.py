@@ -1,0 +1,319 @@
+"""Logical replication: publications, decoding, subscriptions, apply.
+
+Mirrors the reference's logical decoding + pgoutput stack
+(src/backend/replication/logical/), shard-filtered publication catalogs
+(pg_publication_shard.h), and the CN-coordinated subscription flow of
+contrib/opentenbase_subscription — two independent clusters, changes
+pulled over the wire protocol and applied transactionally."""
+
+import time
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster, SQLError
+from opentenbase_tpu.net.server import ClusterServer
+
+
+@pytest.fixture()
+def pub_cluster(tmp_path):
+    c = Cluster(num_datanodes=2, shard_groups=32,
+                data_dir=str(tmp_path / "pub"))
+    srv = ClusterServer(c).start()
+    yield c, srv
+    srv.stop()
+    c.close()
+
+
+@pytest.fixture()
+def sub_cluster(tmp_path):
+    # different shard count: publisher and subscriber may shard differently
+    c = Cluster(num_datanodes=4, shard_groups=64,
+                data_dir=str(tmp_path / "sub"))
+    yield c
+    c.close()
+
+
+def wait_until(fn, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_publication_ddl_and_views(pub_cluster):
+    c, _srv = pub_cluster
+    s = c.session()
+    s.execute("create table t (k bigint primary key, v text) "
+              "distribute by shard(k)")
+    s.execute("create publication p1 for table t")
+    with pytest.raises(SQLError, match="already exists"):
+        s.execute("create publication p1 for all tables")
+    with pytest.raises(SQLError, match="does not exist"):
+        s.execute("create publication p2 for table missing")
+    assert s.query("select pubname, tables from pg_publication") == [
+        ("p1", "t")
+    ]
+    assert s.query("select pg_publication_tables('p1')") == [("t",)]
+    s.execute("drop publication p1")
+    assert s.query("select count(*) from pg_publication") == [(0,)]
+
+
+def test_slot_changes_decode_inserts_and_deletes(pub_cluster):
+    c, _srv = pub_cluster
+    s = c.session()
+    s.execute("create table t (k bigint primary key, v text) "
+              "distribute by shard(k)")
+    s.execute("create publication p for table t")
+    lsn0 = s.query("select pg_current_wal_lsn()")[0][0]
+    s.execute("insert into t values (1,'a'),(2,'b'),(3,'c')")
+    s.execute("delete from t where k = 2")
+    rows = s.query(f"select pg_logical_slot_changes('p', {lsn0})")
+    assert len(rows) == 2  # two commit frames
+    import json
+
+    f1, f2 = (json.loads(r[1]) for r in rows)
+    ins_rows = [
+        r for ch in f1["changes"] if ch["op"] == "insert"
+        for r in ch["rows"]
+    ]
+    assert sorted(r["k"] for r in ins_rows) == [1, 2, 3]
+    dele = f2["changes"][0]
+    assert dele["op"] == "delete" and dele["rows"][0]["k"] == 2
+    # slot offsets advance monotonically and resume cleanly
+    again = s.query(
+        f"select pg_logical_slot_changes('p', {rows[0][0]})"
+    )
+    assert len(again) == 1
+
+
+def test_end_to_end_subscription(pub_cluster, sub_cluster):
+    c, srv = pub_cluster
+    sc = sub_cluster
+    ps = c.session()
+    ps.execute("create table t (k bigint primary key, v text) "
+               "distribute by shard(k)")
+    ps.execute("insert into t values (1,'one'),(2,'two')")
+    ps.execute("create publication p for table t")
+    ss = sc.session()
+    ss.execute("create table t (k bigint primary key, v text) "
+               "distribute by shard(k)")
+    ss.execute(
+        f"create subscription s1 connection 'host={srv.host} "
+        f"port={srv.port}' publication p"
+    )
+    # initial sync copies existing rows
+    assert wait_until(
+        lambda: ss.query("select count(*) from t") == [(2,)]
+    )
+    # streaming: inserts, updates (delete+insert), deletes flow over
+    ps.execute("insert into t values (3,'three')")
+    ps.execute("update t set v = 'TWO' where k = 2")
+    ps.execute("delete from t where k = 1")
+    assert wait_until(
+        lambda: sorted(ss.query("select k, v from t"))
+        == [(2, "TWO"), (3, "three")]
+    )
+    sub = ss.query("select subname, publication, synced from pg_subscription")
+    assert sub == [("s1", "p", True)]
+    ss.execute("drop subscription s1")
+    assert ss.query("select count(*) from pg_subscription") == [(0,)]
+
+
+def test_subscription_survives_publisher_restart(pub_cluster, sub_cluster,
+                                                 tmp_path):
+    c, srv = pub_cluster
+    sc = sub_cluster
+    ps = c.session()
+    ps.execute("create table t (k bigint primary key, v bigint) "
+               "distribute by shard(k)")
+    ps.execute("create publication p for table t")
+    ss = sc.session()
+    ss.execute("create table t (k bigint primary key, v bigint) "
+               "distribute by shard(k)")
+    ss.execute(
+        f"create subscription s1 connection 'host={srv.host} "
+        f"port={srv.port}' publication p with (copy_data = off)"
+    )
+    ps.execute("insert into t values (1, 10)")
+    assert wait_until(lambda: ss.query("select count(*) from t") == [(1,)])
+    # publisher's server drops: the worker reconnect-retries
+    srv.stop()
+    assert wait_until(
+        lambda: ss.query(
+            "select last_error from pg_subscription"
+        )[0][0] != "",
+        timeout=15,
+    )
+    srv2 = ClusterServer(c, port=srv.port).start()
+    ps.execute("insert into t values (2, 20)")
+    assert wait_until(lambda: ss.query("select count(*) from t") == [(2,)])
+    srv2.stop()
+
+
+def test_subscription_lsn_survives_recovery(pub_cluster, tmp_path):
+    c, srv = pub_cluster
+    ps = c.session()
+    ps.execute("create table t (k bigint primary key, v bigint) "
+               "distribute by shard(k)")
+    ps.execute("create publication p for table t")
+    sub_dir = str(tmp_path / "sub2")
+    sc = Cluster(num_datanodes=2, shard_groups=32, data_dir=sub_dir)
+    ss = sc.session()
+    ss.execute("create table t (k bigint primary key, v bigint) "
+               "distribute by shard(k)")
+    ss.execute(
+        f"create subscription s1 connection 'host={srv.host} "
+        f"port={srv.port}' publication p with (copy_data = off)"
+    )
+    ps.execute("insert into t values (1, 10),(2, 20)")
+    assert wait_until(lambda: ss.query("select count(*) from t") == [(2,)])
+    sc.close()
+
+    # subscriber crash-recovers: worker restarts at its durable lsn and
+    # does NOT re-apply already-applied frames
+    rc = Cluster.recover(sub_dir, num_datanodes=2, shard_groups=32)
+    rs = rc.session()
+    assert rs.query("select count(*) from t") == [(2,)]
+    ps.execute("insert into t values (3, 30)")
+    assert wait_until(lambda: rs.query("select count(*) from t") == [(3,)])
+    assert sorted(rs.query("select k from t")) == [(1,), (2,), (3,)]
+    rc.close()
+
+
+def test_shard_filtered_publication(pub_cluster):
+    """ON NODE (...) publishes only the listed datanodes' changes — the
+    pg_publication_shard analog."""
+    c, _srv = pub_cluster
+    s = c.session()
+    s.execute("create table t (k bigint primary key) distribute by shard(k)")
+    s.execute("create publication p for table t on node (dn0)")
+    lsn0 = s.query("select pg_current_wal_lsn()")[0][0]
+    s.execute("insert into t values " + ",".join(
+        f"({i})" for i in range(32)
+    ))
+    import json
+
+    rows = s.query(f"select pg_logical_slot_changes('p', {lsn0})")
+    got = [
+        r["k"]
+        for fr in rows
+        for ch in json.loads(fr[1])["changes"]
+        for r in ch["rows"]
+    ]
+    # exactly the rows stored on dn0 (mesh index 0)
+    expect = sorted(
+        int(v)
+        for v in c.stores[c.nodes.get("dn0").mesh_index]["t"]
+        .column_array("k")[: c.stores[0]["t"].nrows]
+    )
+    assert sorted(got) == expect
+    assert 0 < len(got) < 32
+
+
+def test_replicated_table_decodes_once(pub_cluster):
+    c, _srv = pub_cluster
+    s = c.session()
+    s.execute("create table r (k bigint) distribute by replication")
+    s.execute("create publication p for table r")
+    lsn0 = s.query("select pg_current_wal_lsn()")[0][0]
+    s.execute("insert into r values (1),(2)")
+    import json
+
+    rows = s.query(f"select pg_logical_slot_changes('p', {lsn0})")
+    all_rows = [
+        r
+        for fr in rows
+        for ch in json.loads(fr[1])["changes"]
+        for r in ch["rows"]
+    ]
+    assert len(all_rows) == 2  # one logical copy, not one per datanode
+
+
+def test_insert_then_update_same_txn_replicates(pub_cluster, sub_cluster):
+    """Insert + update of the same row in ONE publisher txn: the frame
+    self-compacts (the superseded version never ships), so the
+    subscriber neither resurrects the old version nor hits a duplicate
+    key (review regression)."""
+    c, srv = pub_cluster
+    sc = sub_cluster
+    ps, ss = c.session(), sc.session()
+    for s in (ps, ss):
+        s.execute("create table t (k bigint primary key, v text) "
+                  "distribute by shard(k)")
+    ps.execute("create publication p for table t")
+    ss.execute(
+        f"create subscription s1 connection 'host={srv.host} "
+        f"port={srv.port}' publication p with (copy_data = off)"
+    )
+    ps.execute("begin")
+    ps.execute("insert into t values (1, 'v1')")
+    ps.execute("update t set v = 'v2' where k = 1")
+    ps.execute("commit")
+    assert wait_until(
+        lambda: ss.query("select k, v from t") == [(1, "v2")]
+    ), ss.query("select * from t")
+    # the worker keeps making progress afterwards (not wedged)
+    ps.execute("insert into t values (2, 'x')")
+    assert wait_until(lambda: ss.query("select count(*) from t") == [(2,)])
+
+
+def test_slot_fast_forwards_past_unpublished_activity(pub_cluster):
+    """WAL growth on unpublished tables must advance the slot via the
+    trailing fast-forward row (review regression)."""
+    c, _srv = pub_cluster
+    s = c.session()
+    s.execute("create table pub_t (k bigint) distribute by shard(k)")
+    s.execute("create table priv_t (k bigint) distribute by shard(k)")
+    s.execute("create publication p for table pub_t")
+    lsn0 = s.query("select pg_current_wal_lsn()")[0][0]
+    s.execute("insert into priv_t values (1),(2),(3)")
+    rows = s.query(f"select pg_logical_slot_changes('p', {lsn0})")
+    assert len(rows) == 1 and rows[0][1] == ""  # pure fast-forward
+    assert rows[0][0] > lsn0
+    # from the advanced offset, nothing is re-decoded
+    assert s.query(
+        f"select pg_logical_slot_changes('p', {rows[0][0]})"
+    ) == []
+
+
+def test_initial_sync_consistent_lsn(pub_cluster, sub_cluster):
+    """pg_logical_sync returns copy + lsn atomically; rows present in
+    the copy are not re-streamed (review regression)."""
+    c, srv = pub_cluster
+    sc = sub_cluster
+    ps, ss = c.session(), sc.session()
+    for s in (ps, ss):
+        s.execute("create table t (k bigint primary key, v bigint) "
+                  "distribute by shard(k)")
+    ps.execute("insert into t values (1,1),(2,2),(3,3)")
+    ps.execute("create publication p for table t")
+    ss.execute(
+        f"create subscription s1 connection 'host={srv.host} "
+        f"port={srv.port}' publication p"
+    )
+    assert wait_until(lambda: ss.query("select count(*) from t") == [(3,)])
+    ps.execute("insert into t values (4,4)")
+    assert wait_until(lambda: ss.query("select count(*) from t") == [(4,)])
+    # exact contents, no duplicates
+    assert sorted(ss.query("select k from t")) == [(1,), (2,), (3,), (4,)]
+
+
+def test_delete_with_null_text_identity(pub_cluster, sub_cluster):
+    """A no-PK row with NULL text columns still gets matched and deleted
+    on the subscriber (review regression)."""
+    c, srv = pub_cluster
+    sc = sub_cluster
+    ps, ss = c.session(), sc.session()
+    for s in (ps, ss):
+        s.execute("create table t (k bigint, v text) distribute by shard(k)")
+    ps.execute("create publication p for table t")
+    ss.execute(
+        f"create subscription s1 connection 'host={srv.host} "
+        f"port={srv.port}' publication p with (copy_data = off)"
+    )
+    ps.execute("insert into t (k) values (1)")  # v = NULL
+    assert wait_until(lambda: ss.query("select count(*) from t") == [(1,)])
+    ps.execute("delete from t where k = 1")
+    assert wait_until(lambda: ss.query("select count(*) from t") == [(0,)])
