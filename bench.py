@@ -161,6 +161,23 @@ def cpu_baseline(arrays, repeats: int = 3):
     return result / 10**4, best
 
 
+def _measure(s, cpu_result, repeats: int = 3) -> float:
+    """Best wall-clock for Q6 through the coordinator (warm)."""
+    warm = s.query(Q6)[0][0]
+    assert warm is not None
+    best = float("inf")
+    got = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        got = s.query(Q6)[0][0]
+        best = min(best, time.perf_counter() - t0)
+    assert abs(got - cpu_result) < 1e-6 * max(1.0, abs(cpu_result)), (
+        got,
+        cpu_result,
+    )
+    return best
+
+
 def main():
     arrays = make_lineitem(ROWS)
     cpu_result, cpu_time = cpu_baseline(arrays)
@@ -168,33 +185,36 @@ def main():
     cluster = load_cluster(arrays)
     s = cluster.session()
 
-    # warm-up: compile + device cache upload
-    warm = s.query(Q6)[0][0]
-    assert warm is not None
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        got = s.query(Q6)[0][0]
-        best = min(best, time.perf_counter() - t0)
+    # XLA-fused path
+    s.execute("set enable_pallas_scan = off")
+    xla_best = _measure(s, cpu_result)
+    # pallas single-pass kernel (ops/pallas_scan.py); interpret mode off
+    # the TPU would be measuring the emulator, skip there
+    import jax as _jax
 
-    assert abs(got - cpu_result) < 1e-6 * max(1.0, abs(cpu_result)), (
-        got,
-        cpu_result,
-    )
+    pallas_best = None
+    if _jax.default_backend() == "tpu":
+        try:
+            s.execute("set enable_pallas_scan = on")
+            cluster._fused = None
+            pallas_best = _measure(s, cpu_result)
+        except Exception:
+            pallas_best = None
 
+    best = min(x for x in (xla_best, pallas_best) if x is not None)
     rows_per_sec = ROWS / best
     cpu_rows_per_sec = ROWS / cpu_time
-    print(
-        json.dumps(
-            {
-                "metric": "tpch_q6_rows_per_sec",
-                "value": round(rows_per_sec),
-                "unit": "rows/s",
-                "vs_baseline": round(rows_per_sec / cpu_rows_per_sec, 3),
-                "platform": _BENCH_PLATFORM,
-            }
-        )
-    )
+    record = {
+        "metric": "tpch_q6_rows_per_sec",
+        "value": round(rows_per_sec),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / cpu_rows_per_sec, 3),
+        "platform": _BENCH_PLATFORM,
+        "xla_rows_per_sec": round(ROWS / xla_best),
+    }
+    if pallas_best is not None:
+        record["pallas_rows_per_sec"] = round(ROWS / pallas_best)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -1382,6 +1382,7 @@ class Session:
                 else [
                     nm for nm in self.cluster.catalog._tables
                     if nm not in _SYSTEM_VIEWS
+                    and not nm.startswith("otb_")
                 ]
             )
             return Result(
@@ -1407,6 +1408,12 @@ class Session:
                     f'publication "{pubname}" does not exist'
                 )
             next_off, frames = decode_changes(self.cluster, pub, lsn)
+            # slot bookkeeping: the poll's lsn is the consumer's
+            # confirmed position; the first frame past it is the oldest
+            # dead version decode may still need (vacuum horizon)
+            self.cluster.__dict__.setdefault("_slot_horizon_ts", {})[
+                pubname
+            ] = frames[0]["commit_ts"] if frames else None
 
             def _default(o):
                 item = getattr(o, "item", None)
@@ -1461,23 +1468,45 @@ class Session:
                 else [
                     nm for nm in self.cluster.catalog._tables
                     if nm not in _SYSTEM_VIEWS
+                    and not nm.startswith("otb_")
                 ]
             )
+            snap = self._snapshot()
             for tb in tables:
                 if not self.cluster.catalog.has(tb):
                     continue
                 meta = self.cluster.catalog.get(tb)
-                cols = ", ".join(meta.schema)
-                batch = self._run_select(
-                    parse(f"select {cols} from {tb}")[0]
-                )
-                for row in batch.to_rows():
-                    out.append(
-                        (tb, _json.dumps(
-                            dict(zip(meta.schema, row)),
-                            default=_default,
-                        ))
+                # honor the publication's scope exactly as streaming
+                # decode does: replicated tables copy one logical copy,
+                # ON NODE filters copy only the listed datanodes' rows
+                if meta.dist.is_replicated:
+                    src_nodes = [min(meta.node_indices)]
+                elif pub["nodes"] is not None:
+                    src_nodes = [
+                        n for n in meta.node_indices
+                        if n in pub["nodes"]
+                    ]
+                else:
+                    src_nodes = meta.node_indices
+                for node in src_nodes:
+                    store = self.cluster.stores.get(node, {}).get(tb)
+                    if store is None or store.nrows == 0:
+                        continue
+                    n = store.nrows
+                    live = (store.xmin_ts[:n] <= snap) & (
+                        snap < store.xmax_ts[:n]
                     )
+                    idx = np.nonzero(live)[0]
+                    if not len(idx):
+                        continue
+                    data = store.to_batch().take(idx).to_pydict()
+                    for r in range(len(idx)):
+                        out.append(
+                            (tb, _json.dumps(
+                                {c: data[c][r] for c in data},
+                                default=_default,
+                            ))
+                        )
             return Result(
                 "SELECT", out, ["tablename", "payload"], len(out)
             )
@@ -1605,6 +1634,11 @@ class Session:
             ]
         pub = {"tables": stmt.tables, "nodes": nodes}
         self.cluster.publications[stmt.name] = pub
+        # pin the vacuum horizon from creation until the first consumer
+        # poll (a slot with no confirmed position retains everything)
+        self.cluster.__dict__.setdefault("_slot_horizon_ts", {})[
+            stmt.name
+        ] = self.cluster.gts.snapshot_ts()
         if self.cluster.persistence is not None:
             self.cluster.persistence.log_ddl(
                 {"op": "create_publication", "name": stmt.name, **pub}
@@ -1615,6 +1649,9 @@ class Session:
         if stmt.name not in self.cluster.publications:
             raise SQLError(f'publication "{stmt.name}" does not exist')
         del self.cluster.publications[stmt.name]
+        self.cluster.__dict__.setdefault("_slot_horizon_ts", {}).pop(
+            stmt.name, None
+        )
         if self.cluster.persistence is not None:
             self.cluster.persistence.log_ddl(
                 {"op": "drop_publication", "name": stmt.name}
@@ -1630,7 +1667,34 @@ class Session:
             self.cluster, stmt.name, stmt.conninfo, stmt.publication
         )
         if not stmt.copy_data:
+            # copy_data=off still creates the replication slot NOW (PG
+            # connects at CREATE SUBSCRIPTION): capture the publisher's
+            # current position synchronously so changes committed right
+            # after this statement are never skipped
             worker.synced = True
+            from opentenbase_tpu.storage.logical import (
+                apply_frame, ensure_state_table,
+            )
+
+            try:
+                client = worker._connect()
+                try:
+                    worker.lsn = int(
+                        client.query(
+                            "select pg_current_wal_lsn()"
+                        )[0][0]
+                    )
+                finally:
+                    client.close()
+            except Exception as e:
+                raise SQLError(
+                    f"could not connect to the publisher: {e}"
+                )
+            ensure_state_table(self)
+            apply_frame(
+                self, {"changes": []},
+                slot_state=(stmt.name, worker.lsn, True),
+            )
         self.cluster.subscriptions[stmt.name] = worker
         if self.cluster.persistence is not None:
             self.cluster.persistence.log_ddl(
@@ -1771,12 +1835,20 @@ class Session:
             return None
         from opentenbase_tpu.executor.fused import FusedUnsupported
 
+        # pallas single-pass kernel: default-on on real TPU backends,
+        # opt-in elsewhere (interpret mode is for tests, not speed)
+        import jax as _jax
+
+        use_pallas = self.gucs.get(
+            "enable_pallas_scan", _jax.default_backend() == "tpu"
+        )
         try:
             out = fx.fragment_output(
                 dplan.fragments[0],
                 snapshot,
                 self._dicts_view(),
                 [],
+                use_pallas=bool(use_pallas),
             )
         except FusedUnsupported:
             return None
@@ -2908,6 +2980,12 @@ class Session:
 
     def _x_vacuumstmt(self, stmt: A.VacuumStmt) -> Result:
         oldest = self.cluster.gts.snapshot_ts()
+        # logical-replication slot horizon: dead versions newer than the
+        # oldest unconsumed frame are still needed by decode's old-tuple
+        # lookup (replication slots pinning the vacuum horizon)
+        for ts in getattr(self.cluster, "_slot_horizon_ts", {}).values():
+            if ts is not None:
+                oldest = min(oldest, ts - 1)
         names = [stmt.table] if stmt.table else self.cluster.catalog.table_names()
         removed = 0
         for name in names:

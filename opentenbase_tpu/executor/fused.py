@@ -66,6 +66,9 @@ class DeviceTable:
     rmax: int
     versions: tuple[int, ...]
     node_order: tuple[int, ...]
+    # host-side |max| per column (None where unknown/not numeric):
+    # feeds the pallas certifier (ops/pallas_scan.certify_*)
+    col_maxabs: dict[str, Optional[float]] = None
 
 
 class DeviceCache:
@@ -91,6 +94,7 @@ class DeviceCache:
         sharding = NamedSharding(self.mesh, P("dn"))
         columns = {}
         validity = {}
+        col_maxabs: dict[str, Optional[float]] = {}
         for cname, ty in meta.schema.items():
             stack = np.zeros((S, rmax), dtype=ty.np_dtype)
             vstack = None
@@ -101,6 +105,10 @@ class DeviceCache:
                     if vstack is None:
                         vstack = np.ones((S, rmax), dtype=np.bool_)
                     vstack[i, : s.nrows] = vm[: s.nrows]
+            if np.issubdtype(stack.dtype, np.integer) and stack.size:
+                col_maxabs[cname] = float(np.abs(stack).max())
+            else:
+                col_maxabs[cname] = None
             columns[cname] = jax.device_put(stack, sharding)
             validity[cname] = (
                 None if vstack is None else jax.device_put(vstack, sharding)
@@ -121,6 +129,7 @@ class DeviceCache:
             rmax,
             versions,
             nodes,
+            col_maxabs,
         )
         self._tables[name] = dt
         return dt
@@ -191,6 +200,7 @@ class FusedExecutor:
         dicts_view,
         subquery_values,
         group_cap: int = DEFAULT_GROUP_CAP,
+        use_pallas: bool = True,
     ) -> Optional[ColumnBatch]:
         """If the fragment is fusable, compute its gathered output batch
         (what the motion would deliver to the coordinator). Returns None
@@ -198,6 +208,8 @@ class FusedExecutor:
         overflow (caller falls back)."""
         if frag.motion != "gather":
             return None
+        # hash-slot grouping addresses by hash & (cap-1)
+        group_cap = 1 << max(group_cap - 1, 1).bit_length()
         m = _match_partial_fragment(frag.root)
         if m is None:
             return None
@@ -209,6 +221,11 @@ class FusedExecutor:
                 return None
         dtab = self.cache.get(m.scan.table, meta, self.node_stores)
 
+        if use_pallas:
+            out = self._try_pallas(m, dtab, snapshot_ts)
+            if out is not None:
+                return out
+
         has_valid = tuple(
             dtab.validity[c] is not None for c in m.scan.columns
         )
@@ -218,34 +235,252 @@ class FusedExecutor:
             skey = plan_skey(frag.root)
         except NotImplementedError:
             skey = frag.root.key()
-        key = (skey, dtab.rmax, len(dtab.nrows), group_cap, has_valid)
-        program, param_specs, out_info = self._programs.get(key, (None, None, None))
-        if program is None:
-            program, param_specs, out_info = self._compile(
-                m, meta, dtab, group_cap, has_valid
-            )
-            self._programs[key] = (program, param_specs, out_info)
 
-        params = tuple(
-            resolve_param(s, dicts_view, subquery_values) for s in param_specs
+        def run_mode(grouping: str):
+            key = (
+                skey, dtab.rmax, len(dtab.nrows), group_cap, has_valid,
+                grouping,
+            )
+            cached = self._programs.get(key)
+            if cached is None:
+                cached = self._compile(
+                    m, meta, dtab, group_cap, has_valid, grouping
+                )
+                self._programs[key] = cached
+            program, param_specs, out_info = cached
+            params = tuple(
+                resolve_param(s, dicts_view, subquery_values)
+                for s in param_specs
+            )
+            snap = jnp.int64(
+                snapshot_ts if snapshot_ts is not None else 2**61
+            )
+            col_args = tuple(dtab.columns[c] for c in m.scan.columns)
+            # only pass validity arrays that exist; presence is static
+            # in the compiled program (materializing all-ones masks for
+            # every all-valid column would stream megabytes per call)
+            val_args = tuple(
+                dtab.validity[c]
+                for c in m.scan.columns
+                if dtab.validity[c] is not None
+            )
+            nrows_dev = jnp.asarray(dtab.nrows)
+            outs = program(
+                col_args, val_args, dtab.xmin, dtab.xmax, nrows_dev,
+                snap, params,
+            )
+            return self._collect(m, outs, out_info, group_cap, dtab)
+
+        try:
+            return run_mode("hash")
+        except FusedUnsupported as e:
+            if "collision" not in str(e):
+                raise
+            # a hash slot received two distinct keys (likely >~sqrt(cap)
+            # groups): rerun with the sort-based grouping, still one
+            # on-device shard_map program — not the slow general path
+            return run_mode("sort")
+
+    # -- pallas fast path (ops/pallas_scan.py) ---------------------------
+    def _try_pallas(
+        self, m: _FusablePartial, dtab: DeviceTable, snapshot_ts
+    ) -> Optional[ColumnBatch]:
+        """Route an eligible ungrouped filter+SUM/COUNT fragment through
+        the Pallas single-pass kernel. Eligibility is decided by the f32
+        certifier against host-side column stats; anything else returns
+        None and the XLA-fused program runs instead. Requires one shard
+        per mesh device (the standard deployment shape)."""
+        from opentenbase_tpu.ops import pallas_scan as ps
+
+        if m.agg.group_exprs:
+            return None
+        S = len(dtab.nrows)
+        if S % self.mesh.shape["dn"] != 0:
+            return None
+        if any(dtab.validity[c] is not None for c in m.scan.columns):
+            return None
+        # re-certify against CURRENT column stats on every call: data
+        # growth can push values past the f32-exactness bound, and a
+        # previously-compiled program must not keep running then. The
+        # certification outcome (incl. which products limb-split) is
+        # part of the cache key, so a bound change recompiles or
+        # falls back rather than reusing a stale program.
+        col_bounds = [dtab.col_maxabs.get(c) for c in m.scan.columns]
+        try:
+            preds, agg_args, sig = self._pallas_plan(m, col_bounds)
+        except ps.PallasUnsupported:
+            return None
+        key = ("pallas", m.agg.key(), dtab.rmax, S, sig)
+        cached = self._programs.get(key)
+        if cached is None:
+            try:
+                cached = self._compile_pallas(
+                    m, dtab, preds, agg_args, col_bounds
+                )
+            except ps.PallasUnsupported:
+                cached = False
+            self._programs[key] = cached
+        if cached is False:
+            return None
+        program, layout, n_exprs, specs = cached
+        snap = jnp.int64(
+            snapshot_ts if snapshot_ts is not None else 2**61
         )
-        snap = jnp.int64(snapshot_ts if snapshot_ts is not None else 2**61)
-        col_args = tuple(dtab.columns[c] for c in m.scan.columns)
-        # only pass validity arrays that exist; presence is static in the
-        # compiled program (materializing all-ones masks for every
-        # all-valid column would stream megabytes per call)
-        val_args = tuple(
-            dtab.validity[c]
-            for c in m.scan.columns
-            if dtab.validity[c] is not None
+        cols = tuple(dtab.columns[c] for c in m.scan.columns)
+        try:
+            partials = program(
+                cols, dtab.xmin, dtab.xmax, jnp.asarray(dtab.nrows), snap
+            )
+            sums, counts = ps.combine_partials(
+                jax.device_get(partials), layout, n_exprs
+            )
+        except Exception:
+            # pallas lowering/runtime failure: XLA path takes over
+            self._programs[key] = False
+            return None
+        # per-shard partial rows, matching the XLA scalar path's output
+        # contract (the coordinator's merge aggs combine them)
+        cols_out: dict[str, Column] = {}
+        e = 0
+        for oc, spec in zip(m.agg.schema, specs):
+            if spec == "count_star":
+                d = counts.astype(np.int64)
+                v = np.ones(S, dtype=bool)
+            else:  # sum
+                d = sums[:, e].astype(oc.type.np_dtype)
+                v = counts > 0
+                e += 1
+            cols_out[oc.name] = Column(oc.type, d, v, None)
+        return ColumnBatch(cols_out, S)
+
+    def _pallas_plan(self, m: _FusablePartial, col_bounds):
+        """Inline the Filter/Project chain to scan-schema expressions and
+        certify them against current column bounds. Returns
+        (preds, agg_args, sig) where sig captures every certification
+        decision (so the compiled-program cache key reflects it).
+        Raises PallasUnsupported when outside the certified subset."""
+        from opentenbase_tpu.ops import pallas_scan as ps
+
+        project_chain: list = []
+        preds: list = []
+        for step in m.steps:
+            if isinstance(step, L.Filter):
+                preds.append(
+                    ps.inline_projects(step.predicate, project_chain)
+                )
+            else:
+                project_chain.append(tuple(
+                    ps.inline_projects(e, project_chain)
+                    for e in step.exprs
+                ))
+        for p in preds:
+            if not ps.certify_predicate(p, col_bounds):
+                raise ps.PallasUnsupported("predicate")
+        agg_args: list = []
+        sig_parts: list = []
+        for a in m.agg.aggs:
+            if a.func == "count" and a.arg is None:
+                agg_args.append(None)
+                sig_parts.append("count")
+                continue
+            if a.func != "sum":
+                raise ps.PallasUnsupported(a.func)
+            arg = ps.inline_projects(a.arg, project_chain)
+            dec = ps.decompose_value(arg, col_bounds)
+            if dec is None:
+                raise ps.PallasUnsupported("value bound")
+            agg_args.append((arg, dec))
+            sig_parts.append(f"sum{len(dec)}")
+        return preds, agg_args, tuple(sig_parts)
+
+    def _compile_pallas(
+        self, m: _FusablePartial, dtab: DeviceTable, preds, agg_args,
+        col_bounds,
+    ):
+        from opentenbase_tpu.ops import pallas_scan as ps
+
+        specs: list[str] = []
+        layout: list[tuple[int, float]] = []
+        val_fns: list = []
+        n_exprs = 0
+        for entry in agg_args:
+            if entry is None:
+                specs.append("count_star")
+                continue
+            _arg, dec = entry
+            for fn, scale in dec:
+                val_fns.append(fn)
+                layout.append((n_exprs, scale))
+            specs.append("sum")
+            n_exprs += 1
+        if preds:
+            pred_fns = [ps.compile_f32(p) for p in preds]
+
+            def mask_fn(blk):
+                msk = pred_fns[0](blk)
+                for f in pred_fns[1:]:
+                    msk = msk & f(blk)
+                return msk
+        else:
+            def mask_fn(blk):
+                return jnp.ones(blk[0].shape, dtype=jnp.bool_)
+
+        interpret = jax.default_backend() != "tpu"
+        n_in = len(m.scan.columns) + 1  # + live-mask column
+        run = ps.build_partials(
+            n_in, mask_fn, val_fns, interpret=interpret
         )
-        nrows_dev = jnp.asarray(dtab.nrows)
-        outs = program(col_args, val_args, dtab.xmin, dtab.xmax, nrows_dev, snap, params)
-        return self._collect(m, outs, out_info, group_cap, dtab)
+        mesh = self.mesh
+        rmax = dtab.rmax
+
+        @jax.jit
+        def program(cols, xmin, xmax, nrows, snap):
+            try:
+                from jax import shard_map
+            except ImportError:  # older jax
+                from jax.experimental.shard_map import shard_map
+            # visibility in XLA (int64 timestamps are not pallas
+            # material); the kernel consumes it as an f32 column
+            live = (
+                (jnp.arange(rmax)[None, :] < nrows[:, None])
+                & (xmin <= snap)
+                & (snap < xmax)
+            ).astype(jnp.float32)
+
+            def block(cols, live):
+                # [k, Rmax] per device (k shards per device): vmap the
+                # pallas program over the local shard axis
+                def one(*cs):
+                    blk = [c.astype(jnp.float32) for c in cs[:-1]]
+                    blk.append(cs[-1])
+                    return run(blk)
+
+                return jax.vmap(one)(*cols, live)
+
+            try:
+                sm = shard_map(
+                    block,
+                    mesh=mesh,
+                    in_specs=(tuple(P("dn") for _ in cols), P("dn")),
+                    out_specs=P("dn"),
+                    check_vma=False,  # pallas_call carries no vma info
+                )
+            except TypeError:  # older jax: check_rep instead
+                sm = shard_map(
+                    block,
+                    mesh=mesh,
+                    in_specs=(tuple(P("dn") for _ in cols), P("dn")),
+                    out_specs=P("dn"),
+                    check_rep=False,
+                )
+            return sm(cols, live)
+
+        return program, layout, n_exprs, specs
 
     # -- compilation -----------------------------------------------------
     def _compile(
-        self, m: _FusablePartial, meta, dtab: DeviceTable, group_cap, has_valid
+        self, m: _FusablePartial, meta, dtab: DeviceTable, group_cap,
+        has_valid, grouping: str = "hash",
     ):
         comp = ExprCompiler(lift_consts=True)
         scan_dids = [c.dict_id for c in m.scan.schema]
@@ -320,12 +555,26 @@ class FusedExecutor:
                     [(jnp.reshape(d, (1,)), jnp.reshape(v, (1,))) for d, v in outs],
                     jnp.ones(1, jnp.bool_),
                     jnp.int32(1),
+                    jnp.asarray(False),
                 )
+            if grouping == "hash":
+                # hash-addressed grouping: one linear pass instead of
+                # the sort path's O(k) argsorts; collisions (incl. >cap
+                # groups) are detected exactly and the caller reruns
+                # the sort variant
+                slot, ngroups, collision = agg_ops._hash_slots_impl(
+                    keys, mask, group_cap
+                )
+                out_keys, out_vals, gvalid = agg_ops._group_reduce_impl(
+                    keys, vals, jnp.arange(n, dtype=jnp.int32), slot,
+                    group_cap, tuple(specs),
+                )
+                return out_keys, out_vals, gvalid, ngroups, collision
             perm, seg, ngroups = agg_ops._group_ids_impl(keys, mask)
             out_keys, out_vals, gvalid = agg_ops._group_reduce_impl(
                 keys, vals, perm, seg, group_cap, tuple(specs)
             )
-            return out_keys, out_vals, gvalid, ngroups
+            return out_keys, out_vals, gvalid, ngroups, jnp.asarray(False)
 
         mesh = self.mesh
 
@@ -359,7 +608,10 @@ class FusedExecutor:
             )(cols, valids, xmin, xmax, nrows)
             return out
 
-        out_info = {"grouped": grouped, "nkeys": nkeys, "specs": specs}
+        out_info = {
+            "grouped": grouped, "nkeys": nkeys, "specs": specs,
+            "grouping": grouping,
+        }
         return program, comp.params, out_info
 
     # -- output collection ------------------------------------------------
@@ -367,10 +619,15 @@ class FusedExecutor:
         # ONE batched device->host fetch: per-array np.asarray pays the
         # transfer round-trip each time (expensive over the axon tunnel)
         outs = jax.device_get(outs)
-        out_keys, out_vals, gvalid, ngroups = outs
+        out_keys, out_vals, gvalid, ngroups, collision = outs
         grouped = out_info["grouped"]
-        ng = np.asarray(ngroups)
-        if grouped and int(ng.max()) >= group_cap:
+        if grouped and bool(np.asarray(collision).any()):
+            raise FusedUnsupported("group hash collision")
+        if grouped and out_info.get("grouping") == "sort" and (
+            int(np.asarray(ngroups).max()) >= group_cap
+        ):
+            # sort mode can exceed the static capacity: the general
+            # executor (dynamic group count) recomputes
             raise FusedUnsupported("group capacity overflow")
         # flatten [S, cap] -> rows, keeping only valid groups
         gv = np.asarray(gvalid).reshape(-1)

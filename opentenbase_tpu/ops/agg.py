@@ -72,6 +72,62 @@ def _key_parts(keys):
     return parts
 
 
+def _hash_slots_impl(keys, mask, cap: int):
+    """Hash-addressed grouping: map each visible row straight to a slot in
+    [0, cap) by mixing its key parts — NO sort. The TPU-native replacement
+    for the multi-pass argsort labeling of ``_group_ids_impl`` on the hot
+    fused path: hashing is one linear VPU pass, while argsort is
+    O(n log^2 n) on device.
+
+    Exactness: a slot may receive two distinct keys (hash collision, or
+    more than ``cap`` distinct groups). Per slot we keep the minimum of
+    every key part and flag any visible row that disagrees with its
+    slot's representative — the caller falls back to the sort path when
+    ``collision`` is true, so results are never silently wrong.
+
+    Returns (slot, ngroups, collision): ``slot[i]`` in [0, cap) for
+    visible rows and == cap for invisible ones (the overflow bin
+    ``_group_reduce_impl`` already clamps to), ``ngroups`` the used-slot
+    count, ``collision`` a 0-d bool.
+
+    ``cap`` must be a power of two (slot = hash & (cap-1)).
+    """
+    assert cap & (cap - 1) == 0, "group capacity must be a power of two"
+    parts = _key_parts(keys)
+    n = parts[0][0].shape[0] if parts else mask.shape[0]
+    # 64-bit FNV-style mix over parts + validity bits
+    h = jnp.full(n, 1469598103934665603, dtype=jnp.int64)
+    p64: list = []
+    for d, v in parts:
+        d64 = d.astype(jnp.int64)
+        p64.append(d64)
+        h = (h ^ d64) * jnp.int64(1099511628211)
+        if v is not None:
+            p64.append(v.astype(jnp.int64))
+            h = (h ^ v.astype(jnp.int64)) * jnp.int64(1099511628211)
+    h = h ^ (h >> 29)  # finalize: low bits must feel the high bits
+    slot = jnp.bitwise_and(h, cap - 1).astype(jnp.int32)
+    vis = mask if mask is not None else jnp.ones(n, dtype=jnp.bool_)
+    slot = jnp.where(vis, slot, jnp.int32(cap))
+    # exact collision detection against per-slot representatives
+    collision = jnp.asarray(False)
+    for p in p64:
+        rep = jax.ops.segment_min(
+            jnp.where(vis, p, _I64_MAX), slot, num_segments=cap + 1
+        )
+        collision = collision | jnp.any(
+            vis & (p != jnp.take(rep, slot, axis=0))
+        )
+    used = (
+        jax.ops.segment_sum(
+            vis.astype(jnp.int32), slot, num_segments=cap + 1
+        )[:cap]
+        > 0
+    )
+    ngroups = jnp.sum(used, dtype=jnp.int32)
+    return slot, ngroups, collision
+
+
 def _group_ids_impl(keys, mask):
     """Sort rows by keys (+validity), label segments.
 

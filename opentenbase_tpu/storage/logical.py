@@ -180,15 +180,58 @@ def _resolve_deleted_rows(cluster, tm, node: int, rowids) -> list[dict]:
 # ---------------------------------------------------------------------------
 
 
-def apply_frame(session, frame: dict) -> int:
+STATE_TABLE = "otb_subscription_state"
+
+
+def ensure_state_table(session) -> None:
+    """The subscriber-side replication-origin catalog: one replicated row
+    per subscription holding (lsn, synced), updated INSIDE each apply
+    transaction so the slot position commits atomically with the applied
+    rows (the replication_origin LSN-in-commit-record contract)."""
+    cluster = session.cluster
+    if not cluster.catalog.has(STATE_TABLE):
+        session.execute(
+            f"create table {STATE_TABLE} (subname text, lsn bigint, "
+            "synced bigint) distribute by replication"
+        )
+
+
+def read_slot_state(session, name: str):
+    cluster = session.cluster
+    if not cluster.catalog.has(STATE_TABLE):
+        return None
+    rows = session.query(
+        f"select lsn, synced from {STATE_TABLE} "
+        f"where subname = '{name}'"
+    )
+    if not rows:
+        return None
+    return int(rows[0][0]), bool(rows[0][1])
+
+
+def apply_frame(session, frame: dict, slot_state=None) -> int:
     """Apply one decoded commit frame atomically on the subscriber via
     the normal transaction machinery (worker.c's apply loop). Deletes
     match by primary key when the table has one, else by full row, one
-    store row per change row. Returns rows applied."""
+    store row per change row. ``slot_state`` = (subname, lsn, synced):
+    replaces the subscription's state row IN THE SAME transaction, so a
+    crash can never separate applied rows from the slot position.
+    Returns rows applied."""
     from opentenbase_tpu.executor.local import LocalExecutor
     from opentenbase_tpu.storage.table import ColumnBatch
 
     cluster = session.cluster
+    if slot_state is not None:
+        name, lsn, synced = slot_state
+        frame = {
+            "changes": list(frame.get("changes", ())) + [
+                {"table": STATE_TABLE, "op": "delete",
+                 "rows": [{"subname": name}]},
+                {"table": STATE_TABLE, "op": "insert",
+                 "rows": [{"subname": name, "lsn": int(lsn),
+                           "synced": int(synced)}]},
+            ]
+        }
     txn, _ = session._begin_implicit()
     applied = 0
     try:
@@ -377,19 +420,45 @@ class SubscriptionWorker:
         )
 
     # -- initial table sync + streaming ----------------------------------
+    def _bootstrap(self, client, sess) -> None:
+        """First-connect setup: restore the durable slot state (written
+        atomically with applies into the state table), then either run
+        the initial table sync or — for copy_data=off — capture the
+        publisher's CURRENT lsn so history is never replayed."""
+        with self.cluster._exec_lock:
+            ensure_state_table(sess)
+            state = read_slot_state(sess, self.name)
+        if state is not None:
+            self.lsn, synced = state
+            self.synced = self.synced or synced
+            if self.synced:
+                return
+        if self.synced:
+            # copy_data=off and no durable state yet: stream starts at
+            # the publisher's current position, not at WAL offset 0
+            self.lsn = int(
+                client.query("select pg_current_wal_lsn()")[0][0]
+            )
+            with self.cluster._exec_lock:
+                apply_frame(
+                    sess, {"changes": []},
+                    slot_state=(self.name, self.lsn, True),
+                )
+            return
+        self._initial_sync(client, sess)
+
     def _initial_sync(self, client, sess) -> None:
         """Initial table sync (tablesync.c): ONE publisher statement
         returns the copy AND the lsn it is consistent with (the wire
         server holds the publisher's statement lock for the whole call,
         so no commit can slip between them), applied here as ONE atomic
         replace-contents frame — idempotent, so a subscriber crash
-        mid-sync simply re-syncs on restart."""
-        if self.synced:
-            return
+        mid-sync simply re-syncs on restart. The slot state commits in
+        the same transaction as the copy."""
         rows = client.query(
             f"select pg_logical_sync('{self.publication}')"
         )
-        lsn = None
+        lsn = 0
         by_table: dict[str, list] = {}
         for table, payload in rows:
             if table == "":
@@ -404,11 +473,12 @@ class SubscriptionWorker:
         with self.cluster._exec_lock:
             if self._stop.is_set():
                 return
-            if changes:
-                apply_frame(sess, {"changes": changes})
-        self.lsn = int(lsn if lsn is not None else 0)
+            apply_frame(
+                sess, {"changes": changes},
+                slot_state=(self.name, lsn, True),
+            )
+        self.lsn = lsn
         self.synced = True
-        self._persist_state()
 
     def _loop(self) -> None:
         client = None
@@ -417,27 +487,41 @@ class SubscriptionWorker:
             try:
                 if client is None:
                     client = self._connect()
-                    self._initial_sync(client, sess)
+                    self._bootstrap(client, sess)
                 rows = client.query(
                     "select pg_logical_slot_changes("
                     f"'{self.publication}', {self.lsn})"
                 )
-                advanced = False
+                fast_forward = None
                 for next_off, frame_json in rows:
                     if frame_json:
                         frame = json.loads(frame_json)
                         # serialize with other sessions the way the wire
-                        # server does (apply-worker vs. query interlock)
+                        # server does (apply-worker vs. query interlock);
+                        # the slot advance commits WITH the frame
                         with self.cluster._exec_lock:
                             if self._stop.is_set():
                                 return
-                            apply_frame(sess, frame)
-                    # empty frame = slot fast-forward past WAL activity
-                    # on unpublished tables
-                    self.lsn = max(self.lsn, int(next_off))
-                    advanced = True
-                if advanced:
-                    self._persist_state()
+                            apply_frame(
+                                sess, frame,
+                                slot_state=(
+                                    self.name, int(next_off), True
+                                ),
+                            )
+                        self.lsn = max(self.lsn, int(next_off))
+                    else:
+                        # empty frame = fast-forward past WAL activity
+                        # on unpublished tables
+                        fast_forward = int(next_off)
+                if fast_forward is not None and fast_forward > self.lsn:
+                    self.lsn = fast_forward
+                    with self.cluster._exec_lock:
+                        if self._stop.is_set():
+                            return
+                        apply_frame(
+                            sess, {"changes": []},
+                            slot_state=(self.name, self.lsn, True),
+                        )
                 self.last_error = ""
             except Exception as e:  # connection drop, publisher restart
                 self.last_error = str(e)
@@ -454,14 +538,3 @@ class SubscriptionWorker:
             except Exception:
                 pass
 
-    def _persist_state(self) -> None:
-        c = self.cluster
-        if c.persistence is not None and not c.persistence._in_recovery:
-            c.persistence.log_ddl(
-                {
-                    "op": "subscription_state",
-                    "name": self.name,
-                    "lsn": self.lsn,
-                    "synced": self.synced,
-                }
-            )

@@ -317,3 +317,81 @@ def test_delete_with_null_text_identity(pub_cluster, sub_cluster):
     assert wait_until(lambda: ss.query("select count(*) from t") == [(1,)])
     ps.execute("delete from t where k = 1")
     assert wait_until(lambda: ss.query("select count(*) from t") == [(0,)])
+
+
+def test_copy_data_off_skips_history(pub_cluster, sub_cluster):
+    """copy_data=off must not replay the publisher's WAL history
+    (review regression): pre-existing rows stay out, new rows flow."""
+    c, srv = pub_cluster
+    sc = sub_cluster
+    ps, ss = c.session(), sc.session()
+    for s in (ps, ss):
+        s.execute("create table t (k bigint primary key) "
+                  "distribute by shard(k)")
+    ps.execute("insert into t values (1),(2),(3)")  # history
+    ps.execute("create publication p for table t")
+    ss.execute(
+        f"create subscription s1 connection 'host={srv.host} "
+        f"port={srv.port}' publication p with (copy_data = off)"
+    )
+    ps.execute("insert into t values (4)")
+    assert wait_until(lambda: ss.query("select k from t") == [(4,)])
+    time.sleep(0.3)  # no late history replay either
+    assert ss.query("select k from t") == [(4,)]
+
+
+def test_node_filtered_initial_sync(pub_cluster, sub_cluster):
+    """ON NODE publications copy only the listed datanodes' rows during
+    initial sync, matching the streaming scope (review regression)."""
+    c, srv = pub_cluster
+    sc = sub_cluster
+    ps, ss = c.session(), sc.session()
+    for s in (ps, ss):
+        s.execute("create table t (k bigint primary key) "
+                  "distribute by shard(k)")
+    ps.execute("insert into t values " + ",".join(
+        f"({i})" for i in range(32)
+    ))
+    ps.execute("create publication p for table t on node (dn0)")
+    ss.execute(
+        f"create subscription s1 connection 'host={srv.host} "
+        f"port={srv.port}' publication p"
+    )
+    dn0 = c.nodes.get("dn0").mesh_index
+    store = c.stores[dn0]["t"]
+    expect = sorted(
+        int(v) for v in store.column_array("k")[: store.nrows]
+    )
+    assert wait_until(
+        lambda: sorted(k for (k,) in ss.query("select k from t"))
+        == expect
+    ), ss.query("select k from t")
+
+
+def test_vacuum_respects_slot_horizon(pub_cluster):
+    """Dead versions needed by undecoded deletes survive VACUUM until
+    the consumer confirms past them (review regression)."""
+    c, _srv = pub_cluster
+    s = c.session()
+    s.execute("create table t (k bigint primary key) distribute by shard(k)")
+    s.execute("create publication p for table t")
+    lsn0 = s.query("select pg_current_wal_lsn()")[0][0]
+    s.execute("insert into t values (1),(2)")
+    # consumer confirms up to here
+    rows = s.query(f"select pg_logical_slot_changes('p', {lsn0})")
+    confirmed = rows[-1][0]
+    s.execute("delete from t where k = 1")
+    s.query(f"select pg_logical_slot_changes('p', {confirmed})")
+    # ^ registers the delete frame as the slot horizon, NOT yet confirmed
+    s.execute("vacuum t")
+    # the dead version must still be decodable
+    out = s.query(f"select pg_logical_slot_changes('p', {confirmed})")
+    import json
+
+    deletes = [
+        r
+        for fr in out if fr[1]
+        for ch in json.loads(fr[1])["changes"] if ch["op"] == "delete"
+        for r in ch["rows"]
+    ]
+    assert deletes and deletes[0]["k"] == 1
