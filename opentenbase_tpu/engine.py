@@ -229,6 +229,8 @@ class Cluster:
         # logical replication: publications + running apply workers
         self.publications: dict[str, dict] = {}
         self.subscriptions: dict[str, object] = {}
+        # SQL-language functions (plan/functions.py): name -> SqlFunction
+        self.functions: dict[str, object] = {}
         self.barriers: list[tuple[str, int]] = []
         self.indexes: dict[str, A.CreateIndex] = {}
         # interval/range partitioning: parent name -> PartitionSpec
@@ -1145,6 +1147,24 @@ class Session:
         return stmt
 
     # -- view + partitioned-table rewrite ---------------------------------
+    def _expand_functions(self, stmt: A.Statement):
+        """Inline SQL-function calls before analysis (the planner-side
+        inline_function of optimizer/util/clauses.c)."""
+        funcs = self.cluster.functions
+        if not funcs or isinstance(
+            stmt, (A.CreateFunction, A.DropFunction)
+        ):
+            return stmt
+        from opentenbase_tpu.plan.functions import (
+            FunctionError,
+            expand_calls,
+        )
+
+        try:
+            return expand_calls(stmt, funcs)
+        except FunctionError as e:
+            raise SQLError(str(e))
+
     def _expand_views(self, stmt: A.Statement):
         views = self.cluster.views
         if not views:
@@ -1189,6 +1209,7 @@ class Session:
         return stmt
 
     def _expand_partitions(self, stmt: A.Statement):
+        stmt = self._expand_functions(stmt)
         stmt = self._expand_views(stmt)
         parts = self.cluster.partitions
         if not parts:
@@ -1617,6 +1638,52 @@ class Session:
         return Result(
             "SELECT", batch.to_rows(), batch.column_names(), batch.nrows
         )
+
+    # -- SQL functions (functioncmds.c) ----------------------------------
+    def _x_createfunction(self, stmt: A.CreateFunction) -> Result:
+        from opentenbase_tpu.plan.functions import (
+            FunctionError,
+            SqlFunction,
+        )
+
+        if not stmt.replace and stmt.name in self.cluster.functions:
+            raise SQLError(
+                f'function "{stmt.name}" already exists'
+            )
+        if stmt.name in self._SEQ_FUNCS or stmt.name in self._ADMIN_FUNCS:
+            raise SQLError(
+                f'"{stmt.name}" is a reserved function name'
+            )
+        try:
+            fn = SqlFunction.create(
+                stmt.name, stmt.args, stmt.rettype, stmt.body
+            )
+        except FunctionError as e:
+            raise SQLError(str(e))
+        self.cluster.functions[stmt.name] = fn
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {
+                    "op": "create_function",
+                    "name": stmt.name,
+                    "args": list(map(list, stmt.args)),
+                    "rettype": stmt.rettype,
+                    "body": stmt.body,
+                }
+            )
+        return Result("CREATE FUNCTION")
+
+    def _x_dropfunction(self, stmt: A.DropFunction) -> Result:
+        if stmt.name not in self.cluster.functions:
+            if stmt.if_exists:
+                return Result("DROP FUNCTION")
+            raise SQLError(f'function "{stmt.name}" does not exist')
+        del self.cluster.functions[stmt.name]
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "drop_function", "name": stmt.name}
+            )
+        return Result("DROP FUNCTION")
 
     # -- logical replication DDL (publicationcmds.c / subscriptioncmds.c,
     # shard-filtered variants pg_publication_shard.h) ---------------------
@@ -3129,6 +3196,21 @@ def _sv_pg_locks(c: Cluster):
     return c.locks.snapshot_rows()
 
 
+def _sv_pg_proc(c: Cluster):
+    return [
+        (
+            fn.name,
+            ", ".join(
+                f"{n} {t}" for n, t in zip(fn.argnames, fn.argtypes)
+            ),
+            fn.rettype,
+            "sql",
+            fn.body,
+        )
+        for fn in c.functions.values()
+    ]
+
+
 def _sv_publication(c: Cluster):
     return [
         (
@@ -3309,6 +3391,16 @@ def _sv_views(c: Cluster):
 
 
 _SYSTEM_VIEWS: dict[str, tuple] = {
+    "pg_proc": (
+        {
+            "proname": t.TEXT,
+            "proargs": t.TEXT,
+            "prorettype": t.TEXT,
+            "prolang": t.TEXT,
+            "prosrc": t.TEXT,
+        },
+        _sv_pg_proc,
+    ),
     "pg_publication": (
         {"pubname": t.TEXT, "tables": t.TEXT, "nodes": t.TEXT},
         _sv_publication,

@@ -236,15 +236,15 @@ class FusedExecutor:
         except NotImplementedError:
             skey = frag.root.key()
 
-        def run_mode(grouping: str):
+        def run_mode(grouping: str, cap: int = group_cap):
             key = (
-                skey, dtab.rmax, len(dtab.nrows), group_cap, has_valid,
+                skey, dtab.rmax, len(dtab.nrows), cap, has_valid,
                 grouping,
             )
             cached = self._programs.get(key)
             if cached is None:
                 cached = self._compile(
-                    m, meta, dtab, group_cap, has_valid, grouping
+                    m, meta, dtab, cap, has_valid, grouping
                 )
                 self._programs[key] = cached
             program, param_specs, out_info = cached
@@ -269,17 +269,25 @@ class FusedExecutor:
                 col_args, val_args, dtab.xmin, dtab.xmax, nrows_dev,
                 snap, params,
             )
-            return self._collect(m, outs, out_info, group_cap, dtab)
+            return self._collect(m, outs, out_info, cap, dtab)
 
+        def is_collision(e):
+            return "collision" in str(e)
+
+        # capacity ladder: a small slot table first (the one-hot matmul
+        # cost scales with cap, and most GROUP BYs have few groups),
+        # then the full capacity, then the sort-based device program
         try:
-            return run_mode("hash")
+            return run_mode("hash", min(64, group_cap))
         except FusedUnsupported as e:
-            if "collision" not in str(e):
+            if not is_collision(e):
                 raise
-            # a hash slot received two distinct keys (likely >~sqrt(cap)
-            # groups): rerun with the sort-based grouping, still one
-            # on-device shard_map program — not the slow general path
-            return run_mode("sort")
+        try:
+            return run_mode("hash", group_cap)
+        except FusedUnsupported as e:
+            if not is_collision(e):
+                raise
+            return run_mode("sort", group_cap)
 
     # -- pallas fast path (ops/pallas_scan.py) ---------------------------
     def _try_pallas(
@@ -448,14 +456,14 @@ class FusedExecutor:
             ).astype(jnp.float32)
 
             def block(cols, live):
-                # [k, Rmax] per device (k shards per device): vmap the
-                # pallas program over the local shard axis
-                def one(*cs):
-                    blk = [c.astype(jnp.float32) for c in cs[:-1]]
-                    blk.append(cs[-1])
-                    return run(blk)
-
-                return jax.vmap(one)(*cols, live)
+                # [k, Rmax] per device (k shards per device): flatten
+                # the local shards into one row axis — one pallas grid
+                # per device, no vmap-of-pallas composition
+                blk = [
+                    c.reshape(-1).astype(jnp.float32) for c in cols
+                ]
+                blk.append(live.reshape(-1))
+                return run(blk)[None]
 
             try:
                 sm = shard_map(
@@ -519,11 +527,22 @@ class FusedExecutor:
         grouped = bool(m.agg.group_exprs)
         nkeys = len(m.agg.group_exprs)
 
-        def per_shard(cols, valids, xmin, xmax, nrows, snap, params):
-            # one shard: cols [Rmax] each; ``valids`` holds arrays only for
-            # columns whose has_valid flag is set (static structure)
-            n = xmin.shape[0]
-            live = jnp.arange(n) < nrows
+        def per_device(cols, valids, xmin, xmax, nrows, snap, params):
+            # one device's k local shards, FLATTENED to a single row
+            # axis: [k, Rmax] -> [k*Rmax]. Partial-agg semantics don't
+            # care whether partials are per shard or per device — the
+            # coordinator merge re-aggregates either way — and a flat
+            # pipeline avoids vmap-of-scan/einsum compositions that XLA
+            # lowers poorly on TPU.
+            k, rmax = xmin.shape
+            n = k * rmax
+            live = (
+                jnp.arange(rmax)[None, :] < nrows[:, None]
+            ).reshape(n)
+            xmin = xmin.reshape(n)
+            xmax = xmax.reshape(n)
+            cols = [c.reshape(n) for c in cols]
+            valids = [v.reshape(n) for v in valids]
             live = live & (xmin <= snap) & (snap < xmax)
             env = []
             vi = 0
@@ -562,6 +581,15 @@ class FusedExecutor:
                 # the sort path's O(k) argsorts; collisions (incl. >cap
                 # groups) are detected exactly and the caller reruns
                 # the sort variant
+                if agg_ops.mxu_group_eligible(keys, vals, specs):
+                    # scatter-free: one-hot matmuls on the MXU (TPU
+                    # scatter/sort are orders of magnitude slower)
+                    slot, _p64, _vis = agg_ops._hash_slot_ids(
+                        keys, mask, group_cap
+                    )
+                    return agg_ops._mxu_group_reduce_impl(
+                        keys, vals, slot, group_cap, tuple(specs)
+                    )
                 slot, ngroups, collision = agg_ops._hash_slots_impl(
                     keys, mask, group_cap
                 )
@@ -586,13 +614,12 @@ class FusedExecutor:
                 from jax.experimental.shard_map import shard_map
 
             def block(cols, valids, xmin, xmax, nrows):
-                # block: [S/D, Rmax] — vmap the per-shard pipeline
-                f = jax.vmap(
-                    lambda c, v, a, b, r: per_shard(
-                        c, v, a, b, r, snap, params
-                    )
+                # block: [S/D, Rmax] — one flattened pipeline per device
+                outs = per_device(
+                    list(cols), list(valids), xmin, xmax, nrows, snap,
+                    params,
                 )
-                return f(cols, valids, xmin, xmax, nrows)
+                return jax.tree.map(lambda x: x[None], outs)
 
             out = shard_map(
                 block,

@@ -72,6 +72,28 @@ def _key_parts(keys):
     return parts
 
 
+def _hash_slot_ids(keys, mask, cap: int):
+    """Row -> slot in [0, cap) by mixing the key parts; invisible rows
+    get slot == cap. Returns (slot, int64 key parts, visibility)."""
+    assert cap & (cap - 1) == 0, "group capacity must be a power of two"
+    parts = _key_parts(keys)
+    n = parts[0][0].shape[0] if parts else mask.shape[0]
+    # 64-bit FNV-style mix over parts + validity bits
+    h = jnp.full(n, 1469598103934665603, dtype=jnp.int64)
+    p64: list = []
+    for d, v in parts:
+        d64 = d.astype(jnp.int64)
+        p64.append(d64)
+        h = (h ^ d64) * jnp.int64(1099511628211)
+        if v is not None:
+            p64.append(v.astype(jnp.int64))
+            h = (h ^ v.astype(jnp.int64)) * jnp.int64(1099511628211)
+    h = h ^ (h >> 29)  # finalize: low bits must feel the high bits
+    slot = jnp.bitwise_and(h, cap - 1).astype(jnp.int32)
+    vis = mask if mask is not None else jnp.ones(n, dtype=jnp.bool_)
+    return jnp.where(vis, slot, jnp.int32(cap)), p64, vis
+
+
 def _hash_slots_impl(keys, mask, cap: int):
     """Hash-addressed grouping: map each visible row straight to a slot in
     [0, cap) by mixing its key parts — NO sort. The TPU-native replacement
@@ -92,23 +114,7 @@ def _hash_slots_impl(keys, mask, cap: int):
 
     ``cap`` must be a power of two (slot = hash & (cap-1)).
     """
-    assert cap & (cap - 1) == 0, "group capacity must be a power of two"
-    parts = _key_parts(keys)
-    n = parts[0][0].shape[0] if parts else mask.shape[0]
-    # 64-bit FNV-style mix over parts + validity bits
-    h = jnp.full(n, 1469598103934665603, dtype=jnp.int64)
-    p64: list = []
-    for d, v in parts:
-        d64 = d.astype(jnp.int64)
-        p64.append(d64)
-        h = (h ^ d64) * jnp.int64(1099511628211)
-        if v is not None:
-            p64.append(v.astype(jnp.int64))
-            h = (h ^ v.astype(jnp.int64)) * jnp.int64(1099511628211)
-    h = h ^ (h >> 29)  # finalize: low bits must feel the high bits
-    slot = jnp.bitwise_and(h, cap - 1).astype(jnp.int32)
-    vis = mask if mask is not None else jnp.ones(n, dtype=jnp.bool_)
-    slot = jnp.where(vis, slot, jnp.int32(cap))
+    slot, p64, vis = _hash_slot_ids(keys, mask, cap)
     # exact collision detection against per-slot representatives
     collision = jnp.asarray(False)
     for p in p64:
@@ -126,6 +132,246 @@ def _hash_slots_impl(keys, mask, cap: int):
     )
     ngroups = jnp.sum(used, dtype=jnp.int32)
     return slot, ngroups, collision
+
+
+_MXU_BLOCK = 4096  # rows per one-hot matmul block
+# 8-bit limbs: every limb value (< 256) is exactly representable in
+# bf16, so the MXU's bf16 multiply passes are exact and the f32
+# accumulator holds block sums <= 4096*255 < 2^24 exactly. (12-bit
+# limbs are NOT bf16-exact — the TPU computes "f32" matmuls as bf16
+# product passes.)
+_LIMB_BITS = 8
+_LIMB_MASK = (1 << _LIMB_BITS) - 1
+
+
+def _int_limbs(v, n_limbs: int):
+    """Split an integer column into ``n_limbs`` radix-4096 limbs (f32
+    arrays, each value < 4096; the top limb carries the sign via
+    arithmetic shift). Exact recombination: sum_l limb_l << 12l."""
+    v = v.astype(jnp.int64)
+    out = []
+    for l in range(n_limbs - 1):
+        out.append(
+            jnp.bitwise_and(
+                jnp.right_shift(v, _LIMB_BITS * l), _LIMB_MASK
+            ).astype(jnp.float32)
+        )
+    out.append(
+        jnp.right_shift(v, _LIMB_BITS * (n_limbs - 1)).astype(jnp.float32)
+    )
+    return out
+
+
+def _limbs_needed(dtype) -> int:
+    return 4 if jnp.dtype(dtype).itemsize <= 4 else 8
+
+
+def _mxu_group_reduce_impl(keys, vals, slot, num_groups: int, specs: tuple):
+    """Grouped reduction on the MXU: one-hot(slot) matmuls instead of
+    segment scatters — XLA's TPU scatter/sort are orders of magnitude
+    slower than a systolic-array pass for cap-bounded grouping.
+
+    Exactness: every accumulated quantity is integer-valued and
+    limb-split (radix 4096); each 4096-row block's one-hot matmul sums
+    each limb exactly in f32 (<= 2^24), per-block partials convert to
+    int64 and sum exactly. Group keys are recovered by division
+    (all rows in a slot share one key, or the collision flag is set):
+    khat = sum(key)/count, checked per row via a gather-compare — which
+    doubles as exact hash-collision detection.
+
+    Eligibility (caller-enforced): integer-typed keys/vals, specs in
+    sum/count/count_star. Returns (out_keys, out_vals, gvalid, ngroups,
+    collision) matching the segment path's contract."""
+    cap = num_groups
+    n = slot.shape[0]
+    # two-level blocking: superblocks scanned with an int64 accumulator
+    # so the per-block f32 partials ([sb, cap, K]) stay a few MB instead
+    # of materializing an [nblocks, cap, K] tensor proportional to the
+    # whole table
+    sb = 256  # per-step f32 partials: [sb? no — [sb, cap, K]] ~ tens of MB
+    super_rows = sb * _MXU_BLOCK
+    ns = max(-(-n // super_rows), 1)
+    padded = ns * super_rows
+    nb = padded // _MXU_BLOCK
+    if padded != n:
+        slot = jnp.pad(slot, (0, padded - n), constant_values=cap)
+
+    def pad0(x):
+        return jnp.pad(x, (0, padded - n)) if padded != n else x
+
+    # Plan the accumulated lane layout without materializing anything:
+    # raw columns ride through the scan, limbs are cut per superblock.
+    # Entry kinds: ("limbs", raw_idx, nl) | ("f32", raw_idx).
+    raw: list = []  # padded [ns, super_rows] arrays carried by the scan
+
+    def add_raw(x):
+        raw.append(pad0(x).reshape(ns, super_rows))
+        return len(raw) - 1
+
+    lanes: list = []  # lane plan, len = K
+    key_slices: list = []  # (start, n_limbs) per key DATA column
+    kvalid_idx: list = []  # lane index of the validity column (or None)
+    for data, valid in keys:
+        nl = _limbs_needed(data.dtype)
+        d = data
+        if valid is not None:
+            d = jnp.where(valid, d, jnp.zeros((), d.dtype))
+        key_slices.append((len(lanes), nl))
+        ri = add_raw(d.astype(jnp.int64))
+        lanes.extend(("limbs", ri, nl, l) for l in range(nl))
+        if valid is not None:
+            kvalid_idx.append(len(lanes))
+            lanes.append(("f32", add_raw(valid.astype(jnp.float32)),
+                          0, 0))
+        else:
+            kvalid_idx.append(None)
+    val_slices: list = []  # per spec: (start, n_limbs, vstart) or None
+    for spec, val in zip(specs, vals):
+        if spec == "count_star":
+            val_slices.append(None)
+            continue
+        data, valid = val
+        vstart = None
+        if valid is not None:
+            vstart = len(lanes)
+            lanes.append(("f32", add_raw(valid.astype(jnp.float32)),
+                          0, 0))
+        nl = 8  # sums are widened to int64
+        d = data
+        if valid is not None:
+            d = jnp.where(valid, d, jnp.zeros((), d.dtype))
+        val_slices.append((len(lanes), nl, vstart))
+        ri = add_raw(d.astype(jnp.int64))
+        lanes.extend(("limbs", ri, nl, l) for l in range(nl))
+    cnt_idx = len(lanes)
+    lanes.append(("ones", 0, 0, 0))
+
+    K = len(lanes)
+    slot_b = slot.reshape(ns, sb, _MXU_BLOCK)
+
+    def step(acc, xs):
+        sl = xs[0].reshape(sb, _MXU_BLOCK)
+        cols = xs[1:]
+        lane_arrays = []
+        for kind, ri, nl, l in lanes:
+            if kind == "ones":
+                lane_arrays.append(
+                    jnp.ones((sb, _MXU_BLOCK), dtype=jnp.float32)
+                )
+            elif kind == "f32":
+                lane_arrays.append(
+                    cols[ri].reshape(sb, _MXU_BLOCK)
+                )
+            else:  # one limb of an int64 raw column
+                v = cols[ri].reshape(sb, _MXU_BLOCK)
+                if l == nl - 1:
+                    lane_arrays.append(
+                        jnp.right_shift(
+                            v, _LIMB_BITS * l
+                        ).astype(jnp.float32)
+                    )
+                else:
+                    lane_arrays.append(
+                        jnp.bitwise_and(
+                            jnp.right_shift(v, _LIMB_BITS * l),
+                            _LIMB_MASK,
+                        ).astype(jnp.float32)
+                    )
+        lb = jnp.stack(lane_arrays, axis=-1)  # [sb, B, K]
+        # masked/invisible rows carry slot == cap: their one-hot row is
+        # all zero, so they contribute nothing (incl. the count column)
+        onehot = (
+            sl[..., None] == jnp.arange(cap, dtype=slot.dtype)
+        ).astype(jnp.float32)
+        part = jnp.einsum(
+            "sbc,sbk->sck", onehot, lb,
+            preferred_element_type=jnp.float32,
+        )
+        return acc + jnp.sum(part.astype(jnp.int64), axis=0), None
+
+    totals, _ = jax.lax.scan(
+        step,
+        jnp.zeros((cap, K), dtype=jnp.int64),
+        (slot_b, *raw),
+    )  # [cap, K]
+
+    cnt = totals[:, cnt_idx]
+    got = cnt > 0
+    safe_cnt = jnp.maximum(cnt, 1)
+
+    def recombine(start, nl):
+        acc = totals[:, start + nl - 1]
+        for l in range(nl - 2, -1, -1):
+            acc = jnp.left_shift(acc, _LIMB_BITS) + totals[:, start + l]
+        return acc
+
+    out_keys = []
+    khats = []
+    for (start, nl), vidx, (data, valid) in zip(
+        key_slices, kvalid_idx, keys
+    ):
+        khat = recombine(start, nl) // safe_cnt
+        khats.append((khat, data))
+        d = khat.astype(data.dtype)
+        if vidx is None:
+            v = got
+        else:
+            v = (totals[:, vidx] // safe_cnt > 0) & got
+        out_keys.append((d, v))
+
+    # collision / correctness check: every visible row's key must equal
+    # its slot's division-recovered key (a mixed slot makes khat garbage
+    # and the equality fails) — one gather per key, no scatter
+    vis = slot < cap
+    collision = jnp.asarray(False)
+    gslot = jnp.minimum(slot, cap - 1)
+    for (khat, _data), (orig_data, orig_valid) in zip(khats, keys):
+        d = orig_data
+        if orig_valid is not None:
+            d = jnp.where(orig_valid, d, jnp.zeros((), d.dtype))
+        d = pad0(d).astype(jnp.int64)
+        collision = collision | jnp.any(
+            vis & (d != jnp.take(khat, gslot, axis=0))
+        )
+
+    out_vals = []
+    for spec, val, sl in zip(specs, vals, val_slices):
+        if spec == "count_star":
+            out_vals.append((cnt.astype(jnp.int64), got))
+            continue
+        data, valid = val
+        start, nl, vstart = sl
+        if spec == "count":
+            c = (
+                totals[:, vstart]
+                if vstart is not None
+                else cnt
+            )
+            out_vals.append((c.astype(jnp.int64), got))
+            continue
+        # sum
+        s = recombine(start, nl)
+        nonnull = totals[:, vstart] if vstart is not None else cnt
+        out_vals.append((s, (nonnull > 0) & got))
+
+    ngroups = jnp.sum(got, dtype=jnp.int32)
+    return out_keys, out_vals, got, ngroups, collision
+
+
+def mxu_group_eligible(keys, vals, specs) -> bool:
+    """Integer-typed keys and sum/count vals only (floats keep the
+    segment path: float sums are not limb-splittable exactly)."""
+    for spec in specs:
+        if spec not in ("sum", "count", "count_star"):
+            return False
+    for data, _v in keys:
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            return False
+    for spec, val in zip(specs, vals):
+        if spec == "sum" and val is not None:
+            if jnp.issubdtype(val[0].dtype, jnp.floating):
+                return False
+    return True
 
 
 def _group_ids_impl(keys, mask):

@@ -550,6 +550,26 @@ class VacuumStmt(Statement):
 
 
 @dataclass
+class CreateFunction(Statement):
+    """CREATE [OR REPLACE] FUNCTION name(arg type, ...) RETURNS type
+    AS '<sql body>' LANGUAGE SQL (functioncmds.c + SQL-function
+    inlining). The body is a SELECT; FROM-less single-expression bodies
+    inline as expressions, table-reading bodies as scalar subqueries."""
+
+    name: str
+    args: list[tuple[str, str]]  # (arg name, type name)
+    rettype: str
+    body: str
+    replace: bool = False
+
+
+@dataclass
+class DropFunction(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class CreatePublication(Statement):
     """CREATE PUBLICATION name FOR ALL TABLES | FOR TABLE t1 [, ...]
     [ON NODE (dn, ...)] — node list = shard-filtered publication

@@ -519,8 +519,12 @@ class Parser:
     def parse_create(self) -> A.Statement:
         self.expect_kw("create")
         if self.eat_kw("or", "replace"):
+            if self.eat_kw("function"):
+                return self._create_function(replace=True)
             self.expect_kw("view")
             return self._create_view(replace=True)
+        if self.eat_kw("function"):
+            return self._create_function(replace=False)
         if self.eat_kw("view"):
             return self._create_view(replace=False)
         if self.eat_kw("table"):
@@ -690,6 +694,54 @@ class Parser:
             self.expect_op(")")
         return spec
 
+    def _simple_type_name(self) -> str:
+        type_name = self.ident("type name")
+        if type_name == "double" and self.eat_kw("precision"):
+            type_name = "float8"
+        elif type_name == "character":
+            type_name = "varchar" if self.eat_kw("varying") else "char"
+        if self.eat_op("("):  # precision args accepted, not recorded
+            self._int_lit()
+            while self.eat_op(","):
+                self._int_lit()
+            self.expect_op(")")
+        return type_name
+
+    def _create_function(self, replace: bool) -> A.CreateFunction:
+        name = self.ident("function name")
+        args: list[tuple[str, str]] = []
+        self.expect_op("(")
+        if not self.at_op(")"):
+            while True:
+                an = self.ident("argument name")
+                args.append((an, self._simple_type_name()))
+                if not self.eat_op(","):
+                    break
+        self.expect_op(")")
+        self.expect_kw("returns")
+        rettype = self._simple_type_name()
+        # AS '<body>' LANGUAGE SQL (clauses accepted in either order)
+        body = None
+        while True:
+            if self.eat_kw("as"):
+                body = self._string_lit()
+            elif self.eat_kw("language"):
+                lang = self.ident("language")
+                if lang != "sql":
+                    self.error(
+                        f"unsupported function language {lang!r} "
+                        "(only LANGUAGE SQL)"
+                    )
+            elif self.eat_kw("immutable") or self.eat_kw("stable") or (
+                self.eat_kw("volatile")
+            ):
+                pass  # volatility accepted, not enforced
+            else:
+                break
+        if body is None:
+            self.error("CREATE FUNCTION requires AS '<body>'")
+        return A.CreateFunction(name, args, rettype, body, replace)
+
     def _column_def(self) -> A.ColumnDef:
         name = self.ident("column name")
         type_name = self.ident("type name")
@@ -837,6 +889,13 @@ class Parser:
             return A.DropPublication(self.ident("publication name"))
         if self.eat_kw("subscription"):
             return A.DropSubscription(self.ident("subscription name"))
+        if self.eat_kw("function"):
+            if_exists = bool(self.eat_kw("if", "exists"))
+            name = self.ident("function name")
+            if self.eat_op("("):  # signature accepted, ignored
+                while not self.eat_op(")"):
+                    self.advance()
+            return A.DropFunction(name, if_exists)
         self.error("unsupported DROP")
 
     def parse_truncate(self) -> A.TruncateTable:

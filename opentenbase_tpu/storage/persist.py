@@ -800,6 +800,17 @@ class ClusterPersistence:
                     c.stores.pop(getattr(node, "mesh_index", -1), None)
             elif op == "audit_state":
                 c.audit.load_state(header["payload"])
+            elif op == "create_function":
+                from opentenbase_tpu.plan.functions import SqlFunction
+
+                c.functions[header["name"]] = SqlFunction.create(
+                    header["name"],
+                    [tuple(a) for a in header["args"]],
+                    header["rettype"],
+                    header["body"],
+                )
+            elif op == "drop_function":
+                c.functions.pop(header["name"], None)
             elif op == "create_publication":
                 c.publications[header["name"]] = {
                     "tables": header["tables"], "nodes": header["nodes"]
