@@ -47,6 +47,15 @@ class Catalog:
         # (CASE/COALESCE literals etc.) — dict_id "__lit__" (ops/expr.py).
         self.literals = Dictionary()
 
+    def dictionary(self, dict_id: str) -> Dictionary:
+        """Resolve a column dict_id ("table.col" or the literal-pool
+        "__lit__") to its Dictionary — the one shared implementation for
+        every executor path."""
+        if dict_id == "__lit__":
+            return self.literals
+        table, _, col = dict_id.partition(".")
+        return self.get(table).dictionaries[col]
+
     def create_table(
         self,
         name: str,

@@ -1948,12 +1948,7 @@ class Session:
 
         class _View:
             def __getitem__(self, key):
-                from opentenbase_tpu.ops.expr import LITERAL_DICT
-
-                if key == LITERAL_DICT:
-                    return session.cluster.catalog.literals
-                table, _, col = key.partition(".")
-                return session.cluster.catalog.get(table).dictionaries[col]
+                return session.cluster.catalog.dictionary(key)
 
         return _View()
 
@@ -3307,6 +3302,31 @@ def _sv_stat_tables(c: Cluster):
     return rows
 
 
+def _sv_device_cache(c: Cluster):
+    """Device (HBM) table-cache behavior: hits, full vs incremental
+    uploads, rows delta-appended, MVCC stamp replays."""
+    fx = c._fused
+    if fx is None:
+        return []
+    return [(k, int(v)) for k, v in fx.cache.stats.items()]
+
+
+def _sv_pallas(c: Cluster):
+    """Pallas kernel health: compiled programs and any demoted to the
+    XLA path (a lowering/runtime failure — loud, never silent)."""
+    fx = c._fused
+    if fx is None:
+        return []
+    demoted = set(fx.pallas_fallbacks)
+    rows = [(k, "demoted") for k in fx.pallas_fallbacks]
+    for k, v in fx._programs.items():
+        if isinstance(k, tuple) and k and k[0] == "pallas":
+            if v is False and str(k) in demoted:
+                continue  # already reported as its demotion event
+            rows.append((str(k), "failed" if v is False else "compiled"))
+    return rows
+
+
 def _sv_partitions(c: Cluster):
     rows = []
     snap = c.gts.snapshot_ts()
@@ -3521,6 +3541,14 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
             "n_total_tup": t.INT8,
         },
         _sv_stat_tables,
+    ),
+    "pg_stat_pallas": (
+        {"program": t.TEXT, "state": t.TEXT},
+        _sv_pallas,
+    ),
+    "pg_stat_device_cache": (
+        {"stat": t.TEXT, "value": t.INT8},
+        _sv_device_cache,
     ),
 }
 

@@ -47,6 +47,10 @@ from opentenbase_tpu.storage.table import ColumnBatch
 
 DEFAULT_GROUP_CAP = 1024
 
+import logging
+
+_log = logging.getLogger("opentenbase_tpu.fused")
+
 
 # ---------------------------------------------------------------------------
 # Device table cache: stacked shards on the mesh
@@ -69,32 +73,60 @@ class DeviceTable:
     # host-side |max| per column (None where unknown/not numeric):
     # feeds the pallas certifier (ops/pallas_scan.certify_*)
     col_maxabs: dict[str, Optional[float]] = None
+    # host-side [min, max] per integer column (None elsewhere): sizes the
+    # static group-key domain for the grouped pallas kernel
+    col_range: dict[str, Optional[tuple[int, int]]] = None
+    # per-shard sync state for incremental refresh:
+    # {nrows, structure, mvcc_seq} aligned with node_order
+    sync: list = None
 
 
 class DeviceCache:
     """Uploads/refreshes stacked shard columns; keyed by store versions.
 
-    The buffer-manager analog: instead of 8KB page I/O we re-upload a
-    table's columns when any shard's version changed (storage/table.py).
+    The buffer-manager analog, incremental since round 2: appends upload
+    only the new row tail (columns are append-only, storage/table.py) and
+    MVCC stamps replay from the store's compact stamp log as targeted
+    device scatters. A full re-upload happens only when row positions
+    were rewritten (vacuum, schema change — ``structure_version``), the
+    padded row capacity is outgrown, or a column's NULL-mask presence
+    flips. The reference analog: buffer-manager page replacement vs WAL
+    redo of individual tuples.
     """
 
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
         self._tables: dict[str, DeviceTable] = {}
+        self.stats = {
+            "hits": 0,
+            "full_uploads": 0,
+            "delta_uploads": 0,
+            "delta_rows": 0,
+            "mvcc_replays": 0,
+        }
 
     def get(self, name: str, meta, node_stores: dict[int, dict]) -> DeviceTable:
         nodes = tuple(meta.node_indices)
         stores = [node_stores[n][name] for n in nodes]
         versions = tuple(s.version for s in stores)
         cached = self._tables.get(name)
-        if cached is not None and cached.versions == versions:
+        if cached is not None and cached.versions == versions and (
+            cached.node_order == nodes
+        ):
+            self.stats["hits"] += 1
             return cached
+        if cached is not None and cached.node_order == nodes:
+            updated = self._try_delta(cached, stores, meta, versions)
+            if updated is not None:
+                return updated
+        self.stats["full_uploads"] += 1
         S = _pad_shards(len(stores), self.mesh.shape["dn"])
         rmax = filt_ops.bucket_size(max(max((s.nrows for s in stores), default=0), 1))
         sharding = NamedSharding(self.mesh, P("dn"))
         columns = {}
         validity = {}
         col_maxabs: dict[str, Optional[float]] = {}
+        col_range: dict[str, Optional[tuple[int, int]]] = {}
         for cname, ty in meta.schema.items():
             stack = np.zeros((S, rmax), dtype=ty.np_dtype)
             vstack = None
@@ -105,10 +137,24 @@ class DeviceCache:
                     if vstack is None:
                         vstack = np.ones((S, rmax), dtype=np.bool_)
                     vstack[i, : s.nrows] = vm[: s.nrows]
-            if np.issubdtype(stack.dtype, np.integer) and stack.size:
-                col_maxabs[cname] = float(np.abs(stack).max())
+            if np.issubdtype(stack.dtype, np.integer):
+                # stats over REAL rows only: the zero padding would
+                # inflate the range (e.g. year keys 1992..1998 -> domain
+                # 1999) and disqualify small-domain group keys
+                lo = hi = ma = None
+                for s in stores:
+                    real = s._cols[cname][: s.nrows]
+                    if real.size == 0:
+                        continue
+                    rlo, rhi = int(real.min()), int(real.max())
+                    lo = rlo if lo is None else min(lo, rlo)
+                    hi = rhi if hi is None else max(hi, rhi)
+                    ma = max(ma or 0.0, float(max(abs(rlo), abs(rhi))))
+                col_maxabs[cname] = ma if ma is not None else 0.0
+                col_range[cname] = None if lo is None else (lo, hi)
             else:
                 col_maxabs[cname] = None
+                col_range[cname] = None
             columns[cname] = jax.device_put(stack, sharding)
             validity[cname] = (
                 None if vstack is None else jax.device_put(vstack, sharding)
@@ -130,8 +176,110 @@ class DeviceCache:
             versions,
             nodes,
             col_maxabs,
+            col_range,
+            [
+                {
+                    "nrows": s.nrows,
+                    "structure": s.structure_version,
+                    "mvcc_seq": s.mvcc_seq,
+                }
+                for s in stores
+            ],
         )
         self._tables[name] = dt
+        return dt
+
+    def _try_delta(
+        self, dt: DeviceTable, stores, meta, versions
+    ) -> Optional[DeviceTable]:
+        """Refresh ``dt`` in place with append-tail uploads + MVCC stamp
+        replay. Returns None when only a full rebuild is sound."""
+        if set(meta.schema) != set(dt.columns):
+            return None
+        for s, sy in zip(stores, dt.sync):
+            if s.structure_version != sy["structure"]:
+                return None
+            if s.nrows > dt.rmax or s.nrows < sy["nrows"]:
+                return None
+            for cname in meta.schema:
+                has_dev = dt.validity[cname] is not None
+                if s._validity.get(cname) is not None and not has_dev:
+                    return None  # first NULL appeared: mask must materialize
+        delta_rows = 0
+        replays = 0
+        for i, (s, sy) in enumerate(zip(stores, dt.sync)):
+            old_n, new_n = sy["nrows"], s.nrows
+            if new_n > old_n:
+                delta_rows += new_n - old_n
+                for cname in meta.schema:
+                    tail = np.ascontiguousarray(s._cols[cname][old_n:new_n])
+                    dt.columns[cname] = (
+                        dt.columns[cname].at[i, old_n:new_n].set(tail)
+                    )
+                    vdev = dt.validity[cname]
+                    if vdev is not None:
+                        vm = s._validity.get(cname)
+                        vt = (
+                            np.ones(new_n - old_n, dtype=np.bool_)
+                            if vm is None
+                            else np.ascontiguousarray(vm[old_n:new_n])
+                        )
+                        dt.validity[cname] = vdev.at[i, old_n:new_n].set(vt)
+                    if tail.size and np.issubdtype(tail.dtype, np.integer):
+                        tlo, thi = int(tail.min()), int(tail.max())
+                        rng = dt.col_range.get(cname)
+                        dt.col_range[cname] = (
+                            (tlo, thi)
+                            if rng is None
+                            else (min(rng[0], tlo), max(rng[1], thi))
+                        )
+                        dt.col_maxabs[cname] = max(
+                            dt.col_maxabs[cname] or 0.0,
+                            float(max(abs(tlo), abs(thi))),
+                        )
+                dt.xmin = dt.xmin.at[i, old_n:new_n].set(
+                    np.ascontiguousarray(s.xmin_ts[old_n:new_n])
+                )
+                dt.xmax = dt.xmax.at[i, old_n:new_n].set(
+                    np.ascontiguousarray(s.xmax_ts[old_n:new_n])
+                )
+                dt.nrows[i] = new_n
+            # MVCC stamp replay (idempotent absolute writes, in order)
+            if s.mvcc_seq != sy["mvcc_seq"]:
+                log = s._mvcc_log
+                pending = [e for e in log if e[0] > sy["mvcc_seq"]]
+                expect = s.mvcc_seq - sy["mvcc_seq"]
+                if len(pending) != expect or len(pending) > 8:
+                    # log trimmed past our sync point — or enough entries
+                    # that per-entry device scatters (each a full-array
+                    # copy) would cost more than re-uploading the two
+                    # MVCC columns for this shard
+                    dt.xmin = dt.xmin.at[i, :new_n].set(
+                        np.ascontiguousarray(s.xmin_ts[:new_n])
+                    )
+                    dt.xmax = dt.xmax.at[i, :new_n].set(
+                        np.ascontiguousarray(s.xmax_ts[:new_n])
+                    )
+                    replays += 1
+                else:
+                    for _seq, kind, a, b, ts in pending:
+                        if kind == "xmin":
+                            dt.xmin = dt.xmin.at[i, a:b].set(ts)
+                        elif kind == "xmax_range":
+                            dt.xmax = dt.xmax.at[i, a:b].set(ts)
+                        else:  # "xmax": a is an index array
+                            if len(a):
+                                dt.xmax = dt.xmax.at[i, a].set(ts)
+                        replays += 1
+            dt.sync[i] = {
+                "nrows": new_n,
+                "structure": s.structure_version,
+                "mvcc_seq": s.mvcc_seq,
+            }
+        dt.versions = versions
+        self.stats["delta_uploads"] += 1
+        self.stats["delta_rows"] += delta_rows
+        self.stats["mvcc_replays"] += replays
         return dt
 
 
@@ -191,6 +339,22 @@ class FusedExecutor:
         self.mesh = mesh if mesh is not None else build_mesh()
         self.cache = DeviceCache(self.mesh)
         self._programs: dict = {}
+        # Pallas programs demoted to the XLA path by a lowering/runtime
+        # failure. Loud on purpose (VERDICT r1 §weak-7): a silent
+        # demotion would hide a kernel regression behind a
+        # slower-but-correct fallback. Exposed via pg_stat_pallas.
+        self.pallas_fallbacks: list[str] = []
+
+    def _note_pallas_failure(self, key) -> None:
+        import traceback
+
+        if str(key) not in self.pallas_fallbacks:
+            self.pallas_fallbacks.append(str(key))
+        _log.warning(
+            "pallas kernel demoted to XLA path for %s:\n%s",
+            key,
+            traceback.format_exc(),
+        )
 
     # -- eligibility -----------------------------------------------------
     def fragment_output(
@@ -293,15 +457,14 @@ class FusedExecutor:
     def _try_pallas(
         self, m: _FusablePartial, dtab: DeviceTable, snapshot_ts
     ) -> Optional[ColumnBatch]:
-        """Route an eligible ungrouped filter+SUM/COUNT fragment through
-        the Pallas single-pass kernel. Eligibility is decided by the f32
+        """Route an eligible filter+SUM/COUNT fragment — ungrouped, or
+        grouped by small-domain keys (TPC-H Q1's shape) — through the
+        Pallas single-pass kernel. Eligibility is decided by the f32
         certifier against host-side column stats; anything else returns
         None and the XLA-fused program runs instead. Requires one shard
         per mesh device (the standard deployment shape)."""
         from opentenbase_tpu.ops import pallas_scan as ps
 
-        if m.agg.group_exprs:
-            return None
         S = len(dtab.nrows)
         if S % self.mesh.shape["dn"] != 0:
             return None
@@ -310,12 +473,15 @@ class FusedExecutor:
         # re-certify against CURRENT column stats on every call: data
         # growth can push values past the f32-exactness bound, and a
         # previously-compiled program must not keep running then. The
-        # certification outcome (incl. which products limb-split) is
-        # part of the cache key, so a bound change recompiles or
-        # falls back rather than reusing a stale program.
+        # certification outcome (incl. which products limb-split and the
+        # group-key domain) is part of the cache key, so a bound change
+        # recompiles or falls back rather than reusing a stale program.
         col_bounds = [dtab.col_maxabs.get(c) for c in m.scan.columns]
+        col_ranges = [dtab.col_range.get(c) for c in m.scan.columns]
         try:
-            preds, agg_args, sig = self._pallas_plan(m, col_bounds)
+            preds, agg_args, group_plan, sig = self._pallas_plan(
+                m, col_bounds, col_ranges
+            )
         except ps.PallasUnsupported:
             return None
         key = ("pallas", m.agg.key(), dtab.rmax, S, sig)
@@ -323,7 +489,7 @@ class FusedExecutor:
         if cached is None:
             try:
                 cached = self._compile_pallas(
-                    m, dtab, preds, agg_args, col_bounds
+                    m, dtab, preds, agg_args, group_plan
                 )
             except ps.PallasUnsupported:
                 cached = False
@@ -331,6 +497,9 @@ class FusedExecutor:
         if cached is False:
             return None
         program, layout, n_exprs, specs = cached
+        decoders, n_groups = (
+            (group_plan[1], group_plan[2]) if group_plan else (None, 1)
+        )
         snap = jnp.int64(
             snapshot_ts if snapshot_ts is not None else 2**61
         )
@@ -340,18 +509,26 @@ class FusedExecutor:
                 cols, dtab.xmin, dtab.xmax, jnp.asarray(dtab.nrows), snap
             )
             sums, counts = ps.combine_partials(
-                jax.device_get(partials), layout, n_exprs
+                jax.device_get(partials), layout, n_exprs, n_groups
             )
         except Exception:
             # pallas lowering/runtime failure: XLA path takes over
             self._programs[key] = False
+            self._note_pallas_failure(key)
             return None
+        if decoders is None:
+            return self._pallas_scalar_batch(m, sums[:, 0], counts[:, 0], specs, S)
+        return self._pallas_grouped_batch(
+            m, sums, counts, specs, decoders, S, n_groups
+        )
+
+    def _pallas_scalar_batch(self, m, sums, counts, specs, S) -> ColumnBatch:
         # per-shard partial rows, matching the XLA scalar path's output
         # contract (the coordinator's merge aggs combine them)
         cols_out: dict[str, Column] = {}
         e = 0
         for oc, spec in zip(m.agg.schema, specs):
-            if spec == "count_star":
+            if spec in ("count_star", "count"):
                 d = counts.astype(np.int64)
                 v = np.ones(S, dtype=bool)
             else:  # sum
@@ -361,11 +538,37 @@ class FusedExecutor:
             cols_out[oc.name] = Column(oc.type, d, v, None)
         return ColumnBatch(cols_out, S)
 
-    def _pallas_plan(self, m: _FusablePartial, col_bounds):
+    def _pallas_grouped_batch(
+        self, m, sums, counts, specs, decoders, S, n_groups
+    ) -> ColumnBatch:
+        """[S, G] grouped partials -> (shard, group) partial rows with
+        count > 0, keys decoded from the dense joint index."""
+        keep = counts > 0  # [S, G]
+        sidx, gidx = np.nonzero(keep)
+        nkeys = len(m.agg.group_exprs)
+        cols_out: dict[str, Column] = {}
+        for i, oc in enumerate(m.agg.schema[:nkeys]):
+            _ci, lo, domain, stride = decoders[i]
+            vals = (lo + (gidx // stride) % domain).astype(oc.type.np_dtype)
+            dic = self.catalog.dictionary(oc.dict_id) if oc.dict_id else None
+            cols_out[oc.name] = Column(oc.type, vals, None, dic)
+        e = 0
+        for oc, spec in zip(m.agg.schema[nkeys:], specs):
+            if spec in ("count_star", "count"):
+                d = counts[sidx, gidx].astype(np.int64)
+            else:  # sum
+                d = sums[sidx, gidx, e].astype(oc.type.np_dtype)
+                e += 1
+            cols_out[oc.name] = Column(oc.type, d, None, None)
+        return ColumnBatch(cols_out, len(sidx))
+
+    def _pallas_plan(self, m: _FusablePartial, col_bounds, col_ranges):
         """Inline the Filter/Project chain to scan-schema expressions and
         certify them against current column bounds. Returns
-        (preds, agg_args, sig) where sig captures every certification
-        decision (so the compiled-program cache key reflects it).
+        (preds, agg_args, group_plan, sig) where sig captures every
+        certification decision (so the compiled-program cache key
+        reflects it) and group_plan is None (ungrouped) or
+        (key_exprs, decoders, n_groups).
         Raises PallasUnsupported when outside the certified subset."""
         from opentenbase_tpu.ops import pallas_scan as ps
 
@@ -384,10 +587,30 @@ class FusedExecutor:
         for p in preds:
             if not ps.certify_predicate(p, col_bounds):
                 raise ps.PallasUnsupported("predicate")
-        agg_args: list = []
+        group_plan = None
         sig_parts: list = []
+        if m.agg.group_exprs:
+            key_exprs = [
+                ps.inline_projects(g, project_chain)
+                for g in m.agg.group_exprs
+            ]
+            _key_fn, decoders, n_groups = ps.plan_group_keys(
+                key_exprs, col_ranges
+            )
+            group_plan = (key_exprs, decoders, n_groups)
+            sig_parts.append(("groups", tuple(decoders)))
+        agg_args: list = []
         for a in m.agg.aggs:
-            if a.func == "count" and a.arg is None:
+            if a.func == "count":
+                if a.arg is not None:
+                    # count(expr) == count(*) only when expr can never be
+                    # NULL: columns have no validity masks here (gated
+                    # above) AND the expression stays in the bounded
+                    # arithmetic subset — nullif/division/CASE produce
+                    # dynamic NULLs and must keep the XLA path
+                    arg = ps.inline_projects(a.arg, project_chain)
+                    if ps.bound(arg, col_bounds) is None:
+                        raise ps.PallasUnsupported("nullable count arg")
                 agg_args.append(None)
                 sig_parts.append("count")
                 continue
@@ -399,11 +622,11 @@ class FusedExecutor:
                 raise ps.PallasUnsupported("value bound")
             agg_args.append((arg, dec))
             sig_parts.append(f"sum{len(dec)}")
-        return preds, agg_args, tuple(sig_parts)
+        return preds, agg_args, group_plan, tuple(sig_parts)
 
     def _compile_pallas(
         self, m: _FusablePartial, dtab: DeviceTable, preds, agg_args,
-        col_bounds,
+        group_plan,
     ):
         from opentenbase_tpu.ops import pallas_scan as ps
 
@@ -433,10 +656,16 @@ class FusedExecutor:
             def mask_fn(blk):
                 return jnp.ones(blk[0].shape, dtype=jnp.bool_)
 
+        key_fn, n_groups = None, 1
+        if group_plan is not None:
+            _key_exprs, decoders, n_groups = group_plan
+            key_fn = ps.key_fn_from_decoders(decoders)
+
         interpret = jax.default_backend() != "tpu"
         n_in = len(m.scan.columns) + 1  # + live-mask column
         run = ps.build_partials(
-            n_in, mask_fn, val_fns, interpret=interpret
+            n_in, mask_fn, val_fns, interpret=interpret,
+            key_fn=key_fn, n_groups=n_groups,
         )
         mesh = self.mesh
         rmax = dtab.rmax
@@ -668,10 +897,7 @@ class FusedExecutor:
                 d, v = out_vals[i - out_info["nkeys"]]
             dd = np.asarray(d).reshape(-1)[keep]
             vv = None if v is None else np.asarray(v).reshape(-1)[keep]
-            dic = None
-            if oc.dict_id:
-                table, _, col = oc.dict_id.partition(".")
-                dic = self.catalog.get(table).dictionaries[col]
+            dic = self.catalog.dictionary(oc.dict_id) if oc.dict_id else None
             ty = oc.type
             if dd.dtype != ty.np_dtype:
                 dd = dd.astype(ty.np_dtype)

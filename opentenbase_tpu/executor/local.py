@@ -91,10 +91,7 @@ class LocalExecutor:
 
     # -- dictionary access ----------------------------------------------
     def _dict(self, dict_id: str) -> Dictionary:
-        if dict_id == LITERAL_DICT:
-            return self.catalog.literals
-        table, _, col = dict_id.partition(".")
-        return self.catalog.get(table).dictionaries[col]
+        return self.catalog.dictionary(dict_id)
 
     def _dicts_view(self):
         class _View:

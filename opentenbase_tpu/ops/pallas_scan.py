@@ -249,6 +249,62 @@ def _subst(e: E.TExpr, exprs) -> E.TExpr:
 
 
 # ---------------------------------------------------------------------------
+# Group-key planning for the grouped kernel
+# ---------------------------------------------------------------------------
+
+GROUP_DOMAIN_CAP = 16  # max joint key domain the grouped kernel accepts
+
+
+def plan_group_keys(
+    key_exprs: list, col_ranges: list, cap: int = GROUP_DOMAIN_CAP
+):
+    """Admit GROUP BY keys into the grouped kernel when every key (after
+    project inlining) is a bare column with a small host-known value
+    range — TPC-H Q1's (returnflag, linestatus) shape. Returns
+    (key_fn, decoders, n_groups):
+
+    - ``key_fn(blk) -> f32`` the joint dense group index in [0, D);
+    - ``decoders``: per key, (col_index, min, domain, stride) so the host
+      recovers each key value from a joint index (g // stride) % domain;
+    - ``n_groups``: the static joint domain D <= cap.
+
+    Raises PallasUnsupported outside this subset (the XLA path handles
+    computed keys and large/unknown domains)."""
+    decoders = []
+    stride = 1
+    for e in key_exprs:
+        if not isinstance(e, E.Col):
+            raise PallasUnsupported("computed group key")
+        rng = col_ranges[e.index]
+        if rng is None:
+            raise PallasUnsupported("unbounded group key")
+        lo, hi = rng
+        if abs(lo) >= EXACT or abs(hi) >= EXACT:
+            # key values themselves must be f32-exact: 2^24 and 2^24+1
+            # would collapse to one f32 value and merge two groups
+            raise PallasUnsupported("group key beyond f32-exact bound")
+        domain = hi - lo + 1
+        decoders.append((e.index, lo, domain, stride))
+        stride *= domain
+        if stride > cap:
+            raise PallasUnsupported("group domain too large")
+    return key_fn_from_decoders(decoders), decoders, stride
+
+
+def key_fn_from_decoders(decoders) -> Callable:
+    """fn(blk) -> f32 dense joint group index from (col, min, domain,
+    stride) decoders (see plan_group_keys)."""
+
+    def key_fn(blk):
+        joint = jnp.float32(0.0)
+        for idx, lo, _domain, st in decoders:
+            joint = joint + (blk[idx] - jnp.float32(lo)) * jnp.float32(st)
+        return joint
+
+    return key_fn
+
+
+# ---------------------------------------------------------------------------
 # The kernel
 # ---------------------------------------------------------------------------
 
@@ -259,17 +315,24 @@ def build_partials(
     val_fns: list,
     block: int = BLOCK,
     interpret: bool = False,
+    key_fn: Optional[Callable] = None,
+    n_groups: int = 1,
 ):
-    """Build fn(cols: [n] f32 each) -> f32[2, Q] device partials, where
-    Q = 2*len(val_fns) + 1 accumulated lanes: per value its hi/lo limb
-    block sums, then the count. Row 0 holds the double-float hi parts,
-    row 1 the lo parts — the whole accumulator updates as one vector
+    """Build fn(cols: [n] f32 each) -> f32[2, G*Q] device partials, where
+    Q = 2*len(val_fns) + 1 accumulated lanes per group: per value its
+    hi/lo limb block sums, then the count. Ungrouped aggregation is the
+    G=1 case (key_fn None). Row 0 holds the double-float hi parts, row 1
+    the lo parts — the whole accumulator updates as one vector
     read-modify-write (Mosaic disallows scalar VMEM stores). The LAST
     input column is the visibility mask (1.0/0.0); padding rows carry 0
-    there, so the predicate never sees them."""
+    there, so the predicate never sees them.
+
+    Grouped mode: ``key_fn(blk)`` yields the dense joint group index; a
+    row outside [0, n_groups) contributes to no group (its equality mask
+    never fires) — the planner guarantees in-range keys for live rows."""
     from jax.experimental import pallas as pl
 
-    q_lanes = 2 * len(val_fns) + 1
+    q_lanes = (2 * len(val_fns) + 1) * n_groups
 
     def kernel(*refs):
         (*col_refs, acc_ref) = refs
@@ -282,14 +345,26 @@ def build_partials(
         blk = [r[...] for r in col_refs]
         live = blk[-1] > 0.5
         m = mask_fn(blk) & live
-        mf = m.astype(jnp.float32)
         vs = []
-        for fn in val_fns:
-            v = fn(blk) * mf
-            v_hi = jnp.floor(v / LIMB)
-            vs.append(v_hi)
-            vs.append(v - v_hi * LIMB)
-        vs.append(mf)
+        if key_fn is None:
+            mf = m.astype(jnp.float32)
+            for fn in val_fns:
+                v = fn(blk) * mf
+                v_hi = jnp.floor(v / LIMB)
+                vs.append(v_hi)
+                vs.append(v - v_hi * LIMB)
+            vs.append(mf)
+        else:
+            key = key_fn(blk)
+            vals = [fn(blk) for fn in val_fns]
+            for g in range(n_groups):
+                mg = (m & (key == jnp.float32(g))).astype(jnp.float32)
+                for v in vals:
+                    vg = v * mg
+                    v_hi = jnp.floor(vg / LIMB)
+                    vs.append(v_hi)
+                    vs.append(vg - v_hi * LIMB)
+                vs.append(mg)
         # (Q, block) -> exact per-lane block totals (each < 2^24)
         b = jnp.sum(jnp.stack(vs), axis=1, dtype=jnp.float32)
         acc = acc_ref[...]
@@ -330,20 +405,24 @@ def build_partials(
     return run
 
 
-def combine_partials(partials: np.ndarray, layout, n_exprs: int):
-    """[S, 2, Q] f32 device partials -> per-shard exact
-    (sums int64 [S, n_exprs], counts int64 [S]).
+def combine_partials(
+    partials: np.ndarray, layout, n_exprs: int, n_groups: int = 1
+):
+    """[S, 2, G*Q] f32 device partials -> per-shard exact
+    (sums int64 [S, G, n_exprs], counts int64 [S, G]); the ungrouped
+    G=1 caller squeezes the group axis away.
 
     ``layout``: per decomposed sub-value, its (expr_index, scale) —
     limb-split products contribute several scaled sub-values to one
-    expression's sum. Lane order matches build_partials: per sub-value
-    its hi then lo limb lane, count last."""
+    expression's sum. Lane order matches build_partials: per group, per
+    sub-value its hi then lo limb lane, then the group's count."""
     p = np.asarray(partials, dtype=np.float64)
     totals = p[:, 0, :] + p[:, 1, :]  # double-float pair -> exact f64
     S = p.shape[0]
-    sums = np.zeros((S, n_exprs), dtype=np.int64)
+    totals = totals.reshape(S, n_groups, -1)  # [S, G, Q]
+    sums = np.zeros((S, n_groups, n_exprs), dtype=np.int64)
     for q, (e, scale) in enumerate(layout):
-        v = totals[:, 2 * q] * LIMB + totals[:, 2 * q + 1]
-        sums[:, e] += np.round(scale * v).astype(np.int64)
-    counts = np.round(totals[:, -1]).astype(np.int64)
+        v = totals[:, :, 2 * q] * LIMB + totals[:, :, 2 * q + 1]
+        sums[:, :, e] += np.round(scale * v).astype(np.int64)
+    counts = np.round(totals[:, :, -1]).astype(np.int64)
     return sums, counts

@@ -104,6 +104,15 @@ class ShardStore:
         self.nrows = 0
         self._capacity = 0
         self.version = 0
+        # Incremental device-cache support (executor/fused.DeviceCache):
+        # appends only ever extend the column prefix, and MVCC stamps are
+        # logged below, so the cache can delta-upload instead of
+        # re-uploading whole columns. ``structure_version`` bumps on
+        # anything that rewrites existing row positions (vacuum, schema
+        # change) and forces a full reload.
+        self.structure_version = 0
+        self.mvcc_seq = 0
+        self._mvcc_log: list[tuple] = []  # (seq, kind, a, b, ts)
         # Prepared-but-undecided 2PC transactions hold (start, end) row
         # ranges / index arrays into this store for later stamping. Vacuum
         # compaction would invalidate them, so such transactions pin the
@@ -160,23 +169,36 @@ class ShardStore:
         self.version += 1
         return start, start + n
 
+    _MVCC_LOG_CAP = 64
+
+    def _log_mvcc(self, kind: str, a, b, ts) -> None:
+        self.mvcc_seq += 1
+        self._mvcc_log.append((self.mvcc_seq, kind, a, b, ts))
+        if len(self._mvcc_log) > self._MVCC_LOG_CAP:
+            del self._mvcc_log[0]
+
     def stamp_xmin(self, start: int, end: int, commit_ts: int) -> None:
         self.xmin_ts[start:end] = commit_ts
         self.version += 1
+        self._log_mvcc("xmin", start, end, commit_ts)
 
     def truncate_range(self, start: int, end: int) -> None:
         """Abort path for a prepared insert: mark the range dead forever."""
         self.xmin_ts[start:end] = INF_TS
         self.xmax_ts[start:end] = 0  # dead: xmax <= every snapshot
         self.version += 1
+        self._log_mvcc("xmin", start, end, INF_TS)
+        self._log_mvcc("xmax_range", start, end, 0)
 
     def stamp_xmax(self, idx: np.ndarray, commit_ts: int) -> None:
         self.xmax_ts[idx] = commit_ts
         self.version += 1
+        self._log_mvcc("xmax", np.array(idx, dtype=np.int64), None, commit_ts)
 
     def unstamp_xmax(self, idx: np.ndarray) -> None:
         self.xmax_ts[idx] = INF_TS
         self.version += 1
+        self._log_mvcc("xmax", np.array(idx, dtype=np.int64), None, INF_TS)
 
     # -- schema evolution (ALTER TABLE, tablecmds.c) ---------------------
     def add_column(self, name: str, ty: t.SqlType) -> None:
@@ -186,12 +208,14 @@ class ShardStore:
         self._cols[name] = np.zeros(self._capacity, dtype=ty.np_dtype)
         self._validity[name] = np.zeros(self._capacity, dtype=np.bool_)
         self.version += 1
+        self.structure_version += 1
 
     def drop_column(self, name: str) -> None:
         self.schema.pop(name, None)
         self._cols.pop(name, None)
         self._validity.pop(name, None)
         self.version += 1
+        self.structure_version += 1
 
     # -- reads ----------------------------------------------------------
     def column_array(self, name: str) -> np.ndarray:
@@ -249,4 +273,5 @@ class ShardStore:
         self.nrows = n - ndead
         self._capacity = self.nrows
         self.version += 1
+        self.structure_version += 1  # row positions rewritten
         return ndead

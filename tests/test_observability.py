@@ -91,3 +91,17 @@ def test_join_system_view_with_user_table(sess):
         "where t3.relname = 't' order by n.node_name"
     )
     assert len(rows) == 2 and sum(r[1] for r in rows) == 4
+
+
+def test_pg_stat_pallas_view():
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute("create table pv (a bigint) distribute by shard(a)")
+    s.execute("insert into pv values (1), (2), (3)")
+    s.execute("set enable_pallas_scan = on")
+    s.cluster._fused = None
+    assert s.query("select count(*) from pv")[0][0] == 3
+    rows = s.query("select program, state from pg_stat_pallas")
+    assert any(st == "compiled" for _p, st in rows)
+    assert not any(st == "demoted" for _p, st in rows)

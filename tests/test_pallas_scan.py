@@ -192,3 +192,136 @@ def test_hash_collision_falls_back_to_device_sort():
     got = {g: (sv, c) for g, sv, c in rows}
     for g in range(n_groups):
         assert got[g] == (6 * g + 1, 2)
+
+
+@pytest.fixture()
+def q1():
+    c = Cluster(num_datanodes=2, shard_groups=32)
+    s = c.session()
+    s.execute(
+        "create table li (l_returnflag text, l_linestatus text, "
+        "l_quantity numeric(10,2), l_extendedprice numeric(12,2), "
+        "l_discount numeric(4,2), l_shipdate date) "
+        "distribute by roundrobin"
+    )
+    rng = np.random.default_rng(11)
+    n = 5000
+    rows = ",".join(
+        f"('{f}','{st}',{q:.2f},{p:.2f},0.0{d},'{dt}')"
+        for f, st, q, p, d, dt in zip(
+            rng.choice(["A", "N", "R"], n),
+            rng.choice(["F", "O"], n),
+            rng.uniform(1, 50, n).round(2),
+            rng.uniform(900, 9000, n).round(2),
+            rng.integers(0, 9, n),
+            np.datetime64("1994-01-01") + rng.integers(0, 1500, n),
+        )
+    )
+    s.execute("insert into li values " + rows)
+    return s
+
+
+Q1 = (
+    "select l_returnflag, l_linestatus, sum(l_quantity), "
+    "sum(l_extendedprice), sum(l_extendedprice * l_discount), count(*) "
+    "from li where l_shipdate <= date '1997-09-02' "
+    "group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"
+)
+
+
+def test_engine_grouped_pallas_matches_xla(q1):
+    """TPC-H Q1 shape: small-domain GROUP BY runs in the grouped pallas
+    kernel and matches the XLA path bit-for-bit."""
+    xla = q1.query(Q1)
+    q1.execute("set enable_pallas_scan = on")
+    q1.cluster._fused = None
+    pal = q1.query(Q1)
+    assert pal == xla
+    assert len(pal) == 6
+    fx = q1.cluster.fused_executor()
+    assert any(
+        isinstance(k, tuple) and k and k[0] == "pallas" and v is not False
+        for k, v in fx._programs.items()
+    ), "grouped pallas program was not used"
+
+
+def test_grouped_pallas_int_keys(q1):
+    """Integer group keys with negative values decode correctly."""
+    s = q1
+    s.execute("create table gt (k int, v numeric(10,2)) distribute by roundrobin")
+    s.execute(
+        "insert into gt values (-2, 1.00), (-2, 2.50), (0, 4.00), "
+        "(3, 1.25), (3, 0.25), (3, 1.00)"
+    )
+    q = "select k, sum(v), count(*) from gt group by k order by k"
+    want = s.query(q)
+    s.execute("set enable_pallas_scan = on")
+    s.cluster._fused = None
+    got = s.query(q)
+    assert got == want == [(-2, 3.5, 2), (0, 4.0, 1), (3, 2.5, 3)]
+
+
+def test_grouped_pallas_large_domain_falls_back(q1):
+    """Keys with a domain beyond the kernel cap answer via XLA."""
+    s = q1
+    s.execute("create table wide (k bigint, v bigint) distribute by roundrobin")
+    s.execute(
+        "insert into wide values " + ",".join(
+            f"({k * 1000}, {k})" for k in range(40)
+        )
+    )
+    q = "select k, sum(v) from wide group by k order by k"
+    s.execute("set enable_pallas_scan = on")
+    s.cluster._fused = None
+    got = s.query(q)
+    assert len(got) == 40 and got[0] == (0, 0)
+
+
+def test_grouped_pallas_key_beyond_f32_bound_falls_back(q1):
+    """Keys past 2^24 are not f32-exact: grouped kernel must refuse and
+    the XLA path must answer correctly (adjacent keys stay distinct)."""
+    s = q1
+    s.execute("create table bigk (k bigint, v bigint) distribute by roundrobin")
+    s.execute("insert into bigk values (16777216, 1), (16777217, 2)")
+    s.execute("set enable_pallas_scan = on")
+    s.cluster._fused = None
+    got = s.query("select k, sum(v) from bigk group by k order by k")
+    assert got == [(16777216, 1), (16777217, 2)]
+
+
+def test_grouped_pallas_offset_domain(q1):
+    """Small domain far from zero (e.g. years) must still use the grouped
+    kernel: range stats come from real rows, not padding zeros."""
+    s = q1
+    s.execute("create table yr (y int, v numeric(10,2)) distribute by roundrobin")
+    s.execute(
+        "insert into yr values " + ",".join(
+            f"({1992 + (i % 7)}, {i}.25)" for i in range(50)
+        )
+    )
+    q = "select y, sum(v), count(*) from yr group by y order by y"
+    want = s.query(q)
+    s.execute("set enable_pallas_scan = on")
+    s.cluster._fused = None
+    before = {
+        k for k in s.cluster.fused_executor()._programs if k[0] == "pallas"
+    } if s.cluster._fused else set()
+    got = s.query(q)
+    assert got == want and len(got) == 7
+    fx = s.cluster.fused_executor()
+    assert any(
+        isinstance(k, tuple) and k[0] == "pallas" and v is not False
+        for k, v in fx._programs.items() if k not in before
+    ), "offset-domain keys did not reach the grouped pallas kernel"
+
+
+def test_count_nullif_not_miscounted_by_pallas(q1):
+    """count(expr) where expr can be dynamically NULL must not be folded
+    into count(*) by the pallas path (review regression)."""
+    s = q1
+    s.execute("create table cn (a bigint) distribute by roundrobin")
+    s.execute("insert into cn values (0), (1), (2), (0)")
+    s.execute("set enable_pallas_scan = on")
+    s.cluster._fused = None
+    assert s.query("select count(nullif(a, 0)) from cn")[0][0] == 2
+    assert s.query("select count(a), count(*) from cn")[0] == (4, 4)
