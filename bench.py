@@ -120,10 +120,24 @@ Q1 = (
     "order by l_returnflag, l_linestatus"
 )
 
+# c_mktsegment is generated as an int code; 0 plays 'BUILDING'
+Q3 = (
+    "select l_orderkey, sum(l_extendedprice * (1 - l_discount)), "
+    "o_orderdate, o_shippriority "
+    "from customer, orders, lineitem "
+    "where c_mktsegment = 0 and c_custkey = o_custkey "
+    "and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15' "
+    "and l_shipdate > date '1995-03-15' "
+    "group by l_orderkey, o_orderdate, o_shippriority "
+    "order by 2 desc, o_orderdate limit 10"
+)
+
 
 def make_lineitem(n: int, seed: int = 42):
     rng = np.random.default_rng(seed)
+    n_orders = max(n // 4, 1)
     return {
+        "l_orderkey": rng.integers(1, n_orders + 1, n).astype(np.int64),
         "l_quantity": (rng.uniform(1, 51, n) * 100).astype(np.int64),
         "l_extendedprice": (rng.uniform(900, 105000, n)).astype(np.int64),
         "l_discount": rng.integers(0, 11, n).astype(np.int64),
@@ -135,28 +149,66 @@ def make_lineitem(n: int, seed: int = 42):
     }
 
 
-def load_cluster(arrays) -> Cluster:
-    cluster = Cluster(num_datanodes=NUM_DN, shard_groups=256)
-    s = cluster.session()
-    s.execute(
-        "create table lineitem (l_quantity numeric(10,2), "
-        "l_extendedprice numeric(12,2), l_discount numeric(4,2), "
-        "l_shipdate date, l_returnflag int, l_linestatus int) "
-        "distribute by roundrobin"
-    )
-    meta = cluster.catalog.get("lineitem")
-    n = len(arrays["l_quantity"])
+def make_q3_dims(n: int, seed: int = 43):
+    """orders (n/4 rows) + customer (n/40 rows) scaled off lineitem size,
+    mirroring TPC-H row ratios; segment 0 plays BUILDING (1 of 5)."""
+    rng = np.random.default_rng(seed)
+    n_orders = max(n // 4, 1)
+    n_cust = max(n // 40, 1)
+    orders = {
+        "o_orderkey": np.arange(1, n_orders + 1, dtype=np.int64),
+        "o_custkey": rng.integers(1, n_cust + 1, n_orders).astype(np.int64),
+        "o_orderdate": (8036 + rng.integers(0, 2405, n_orders)).astype(
+            np.int32
+        ),
+        "o_shippriority": rng.integers(0, 3, n_orders).astype(np.int32),
+    }
+    customer = {
+        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_mktsegment": rng.integers(0, 5, n_cust).astype(np.int32),
+    }
+    return orders, customer
+
+
+def _bulk_append(cluster, table: str, arrays) -> None:
+    """Pre-sharded append straight into the stores (the COPY fast path
+    without CSV in the middle)."""
+    meta = cluster.catalog.get(table)
+    n = len(next(iter(arrays.values())))
+    nn = len(meta.node_indices)
     commit_ts = cluster.gts.get_gts()
-    # bulk load: pre-sharded append straight into the stores (the COPY
-    # fast path without CSV in the middle)
     for i, node in enumerate(meta.node_indices):
-        sl = slice(i * n // NUM_DN, (i + 1) * n // NUM_DN)
+        sl = slice(i * n // nn, (i + 1) * n // nn)
         cols = {
             name: Column(meta.schema[name], arrays[name][sl])
             for name in meta.schema
         }
         batch = ColumnBatch(cols, sl.stop - sl.start)
-        cluster.stores[node]["lineitem"].append_batch(batch, commit_ts)
+        cluster.stores[node][table].append_batch(batch, commit_ts)
+
+
+def load_cluster(arrays, orders=None, customer=None) -> Cluster:
+    cluster = Cluster(num_datanodes=NUM_DN, shard_groups=256)
+    s = cluster.session()
+    s.execute(
+        "create table lineitem (l_orderkey bigint, l_quantity numeric(10,2), "
+        "l_extendedprice numeric(12,2), l_discount numeric(4,2), "
+        "l_shipdate date, l_returnflag int, l_linestatus int) "
+        "distribute by roundrobin"
+    )
+    _bulk_append(cluster, "lineitem", arrays)
+    if orders is not None:
+        s.execute(
+            "create table orders (o_orderkey bigint, o_custkey bigint, "
+            "o_orderdate date, o_shippriority int) distribute by roundrobin"
+        )
+        _bulk_append(cluster, "orders", orders)
+    if customer is not None:
+        s.execute(
+            "create table customer (c_custkey bigint, c_mktsegment int) "
+            "distribute by roundrobin"
+        )
+        _bulk_append(cluster, "customer", customer)
     return cluster
 
 
@@ -209,6 +261,34 @@ def cpu_baseline_q1(arrays, repeats: int = 3):
     return best
 
 
+def cpu_baseline_q3(arrays, orders, customer, repeats: int = 2):
+    """Vectorized numpy Q3: array-indexed joins (generous to the CPU —
+    dense integer keys make the 'hash join' a direct index) + bincount
+    group-by + top-10 partition."""
+    no = len(orders["o_orderkey"])
+    nc = len(customer["c_custkey"])
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        building = np.zeros(nc + 1, dtype=bool)
+        building[customer["c_custkey"][customer["c_mktsegment"] == 0]] = True
+        okeep = (orders["o_orderdate"] < 9204) & building[orders["o_custkey"]]
+        okmask = np.zeros(no + 1, dtype=bool)
+        okmask[orders["o_orderkey"][okeep]] = True
+        lk = arrays["l_orderkey"]
+        keep = (arrays["l_shipdate"] > 9204) & okmask[lk]
+        rev = np.bincount(
+            lk[keep],
+            weights=arrays["l_extendedprice"][keep]
+            * (10000 - arrays["l_discount"][keep] * 100),
+            minlength=no + 1,
+        )
+        top = np.argpartition(rev, -10)[-10:]
+        _ = top[np.argsort(-rev[top])]
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def _measure(s, cpu_result, repeats: int = 3) -> float:
     """Best wall-clock for Q6 through the coordinator (warm)."""
     warm = s.query(Q6)[0][0]
@@ -234,11 +314,12 @@ def _phase(msg: str, t0: float) -> None:
 def main():
     t_start = time.monotonic()
     arrays = make_lineitem(ROWS)
+    orders, customer = make_q3_dims(ROWS)
     _phase("data generated", t_start)
     cpu_result, cpu_time = cpu_baseline(arrays)
     _phase("cpu baseline done", t_start)
 
-    cluster = load_cluster(arrays)
+    cluster = load_cluster(arrays, orders, customer)
     s = cluster.session()
     _phase("cluster loaded", t_start)
 
@@ -302,6 +383,28 @@ def main():
             print(json.dumps(record), flush=True)
         except Exception as e:  # Q1 must never break the headline
             _phase(f"q1 failed: {e!r:.200}", t_start)
+
+    # Q3: the distributed-join path (fused DAG: all_to_all exchanges +
+    # sorted-lookup join + partial agg on device; BASELINE config 3)
+    if time.monotonic() - t_start < BENCH_TIMEOUT * 0.8:
+        try:
+            q3_warm = s.query(Q3)  # compile (several fragment programs)
+            assert len(q3_warm) >= 1
+            _phase("q3 compiled", t_start)
+            q3_best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                s.query(Q3)
+                q3_best = min(q3_best, time.perf_counter() - t0)
+            q3_cpu = cpu_baseline_q3(arrays, orders, customer)
+            record["q3_rows_per_sec"] = round(ROWS / q3_best)
+            record["q3_vs_baseline"] = round(
+                (ROWS / q3_best) / (ROWS / q3_cpu), 3
+            )
+            _phase("q3 measured", t_start)
+            print(json.dumps(record), flush=True)
+        except Exception as e:  # Q3 must never break the headline
+            _phase(f"q3 failed: {e!r:.200}", t_start)
 
 
 if __name__ == "__main__":

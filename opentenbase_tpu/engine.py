@@ -54,7 +54,7 @@ from opentenbase_tpu.plan import analyze_statement
 from opentenbase_tpu.plan import logical as L
 from opentenbase_tpu.plan.analyze import Analyzer
 from opentenbase_tpu.plan.distribute import distribute_statement
-from opentenbase_tpu.plan.optimize import prune_columns
+from opentenbase_tpu.plan.optimize import optimize_statement, prune_columns
 from opentenbase_tpu.sql import ast as A
 from opentenbase_tpu.sql import parse
 from opentenbase_tpu.storage.column import Column, column_from_python
@@ -1869,7 +1869,7 @@ class Session:
                 self.cluster.stores[n][name] = store
 
     def _run_select(self, stmt: A.Select) -> ColumnBatch:
-        splan = prune_columns(analyze_statement(stmt, self.cluster.catalog))
+        splan = optimize_statement(analyze_statement(stmt, self.cluster.catalog))
         return self._run_statement_plan(splan)
 
     def _run_statement_plan(self, splan: L.StatementPlan) -> ColumnBatch:
@@ -1895,7 +1895,7 @@ class Session:
             return None
         if self.txn is not None and self.txn.writes:
             return None
-        if len(dplan.fragments) != 1 or dplan.subplans:
+        if not dplan.fragments or dplan.subplans:
             return None
         fx = self.cluster.fused_executor()
         if fx is None:
@@ -1909,14 +1909,27 @@ class Session:
         use_pallas = self.gucs.get(
             "enable_pallas_scan", _jax.default_backend() == "tpu"
         )
+        out = None
+        final_idx = 0
         try:
-            out = fx.fragment_output(
-                dplan.fragments[0],
-                snapshot,
-                self._dicts_view(),
-                [],
-                use_pallas=bool(use_pallas),
-            )
+            if len(dplan.fragments) == 1:
+                out = fx.fragment_output(
+                    dplan.fragments[0],
+                    snapshot,
+                    self._dicts_view(),
+                    [],
+                    use_pallas=bool(use_pallas),
+                )
+            if out is None:
+                # multi-fragment (join) plans — and single-fragment
+                # shapes the scan path rejected — go to the fused DAG
+                # runner (executor/fused_dag.py)
+                res = fx.dag_output(
+                    dplan, snapshot, self._dicts_view(), []
+                )
+                if res is None:
+                    return None
+                final_idx, out = res
         except FusedUnsupported:
             return None
         except Exception:
@@ -1928,7 +1941,7 @@ class Session:
             self.cluster.catalog,
             {},
             snapshot,
-            remote_inputs={0: out},
+            remote_inputs={final_idx: out},
             subquery_values=[],
         )
         # the merge input is tiny (S * group-cap rows at most): run the
@@ -2986,7 +2999,7 @@ class Session:
         inner = stmt.query
         if isinstance(inner, A.Select):
             self._refresh_system_views(inner)
-        splan = prune_columns(
+        splan = optimize_statement(
             analyze_statement(inner, self.cluster.catalog)
         )
         dplan = distribute_statement(splan, self.cluster.catalog)
@@ -3085,7 +3098,7 @@ class Session:
         """EXECUTE DIRECT ON (node) 'query' — run on one datanode only."""
         if not isinstance(stmt.query, A.Select):
             raise SQLError("EXECUTE DIRECT supports only SELECT")
-        splan = prune_columns(
+        splan = optimize_statement(
             analyze_statement(stmt.query, self.cluster.catalog)
         )
         rows: list[tuple] = []

@@ -112,9 +112,15 @@ class DistExecutor:
         # one instrumentation list per top-level run so subplan (InitPlan)
         # fragment timings survive into the EXPLAIN ANALYZE report
         self.instrumentation: list[dict] = []
-        subquery_values = []
-        for sub in dplan.subplans:
-            b = self._run_one(sub, subquery_values=[])
+        # InitPlans evaluate in registration order, sharing the value
+        # list: the analyzer appends a nested scalar subquery BEFORE its
+        # parent finishes (post-order), so every cross-subplan reference
+        # points at a lower index that is already evaluated. (Previously
+        # each subplan got an empty list and nested subqueries crashed.)
+        n = len(dplan.subplans)
+        subquery_values: list = [None] * n
+        for i in range(n):
+            b = self._run_one(dplan.subplans[i], subquery_values)
             ty = (
                 next(iter(b.columns.values())).type
                 if b.columns
@@ -125,11 +131,11 @@ class DistExecutor:
                     "more than one row returned by a subquery used as an expression"
                 )
             if b.nrows == 0 or not b.columns:
-                subquery_values.append((None, ty))
+                subquery_values[i] = (None, ty)
             else:
                 col = next(iter(b.columns.values()))
                 v = col.data[0] if col.valid_mask[0] else None
-                subquery_values.append((v, ty))
+                subquery_values[i] = (v, ty)
         return self._run_one(dplan, subquery_values)
 
     def _run_one(self, dplan: DistributedPlan, subquery_values) -> ColumnBatch:

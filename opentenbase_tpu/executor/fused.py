@@ -105,11 +105,15 @@ class DeviceCache:
             "mvcc_replays": 0,
         }
 
-    def get(self, name: str, meta, node_stores: dict[int, dict]) -> DeviceTable:
-        nodes = tuple(meta.node_indices)
+    def get(
+        self, name: str, meta, node_stores: dict[int, dict], nodes=None
+    ) -> DeviceTable:
+        """``nodes`` overrides which stores to stack (a replicated table
+        reads ONE replica; default = every owning node)."""
+        nodes = tuple(meta.node_indices) if nodes is None else tuple(nodes)
         stores = [node_stores[n][name] for n in nodes]
         versions = tuple(s.version for s in stores)
-        cached = self._tables.get(name)
+        cached = self._tables.get((name, nodes))
         if cached is not None and cached.versions == versions and (
             cached.node_order == nodes
         ):
@@ -186,7 +190,7 @@ class DeviceCache:
                 for s in stores
             ],
         )
-        self._tables[name] = dt
+        self._tables[(name, nodes)] = dt
         return dt
 
     def _try_delta(
@@ -339,11 +343,22 @@ class FusedExecutor:
         self.mesh = mesh if mesh is not None else build_mesh()
         self.cache = DeviceCache(self.mesh)
         self._programs: dict = {}
+        self._dag = None  # lazy DagRunner (executor/fused_dag.py)
         # Pallas programs demoted to the XLA path by a lowering/runtime
         # failure. Loud on purpose (VERDICT r1 §weak-7): a silent
         # demotion would hide a kernel regression behind a
         # slower-but-correct fallback. Exposed via pg_stat_pallas.
         self.pallas_fallbacks: list[str] = []
+
+    def dag_output(self, dplan, snapshot_ts, dicts_view, subquery_values):
+        """Run a whole multi-fragment plan (joins + exchanges + partial
+        agg) on the mesh. Returns (final_fragment_index, batch) or None
+        when the plan is outside the fused DAG subset."""
+        from opentenbase_tpu.executor.fused_dag import DagRunner
+
+        if self._dag is None:
+            self._dag = DagRunner(self)
+        return self._dag.run(dplan, snapshot_ts, dicts_view, subquery_values)
 
     def _note_pallas_failure(self, key) -> None:
         import traceback

@@ -1,0 +1,999 @@
+"""Fused DAG executor: multi-fragment plans (joins) on the device mesh.
+
+The reference executes a distributed join as plan fragments wired through
+the squeue/DataPump socket fabric: producer datanodes hash-route tuples to
+consumer fragments (src/backend/pgxc/squeue/squeue.c:403-660), which run
+hash joins locally (nodeHash.c / nodeHashjoin.c) and feed two-phase
+aggregation upward (createplan.c:1852). This module is the TPU-native
+equivalent of that whole pipeline:
+
+- every fragment compiles to one jitted ``shard_map`` program over the
+  'dn' mesh axis;
+- a ``redistribute`` motion is a bucketed ``jax.lax.all_to_all`` — the
+  DataPump exchange as an ICI collective;
+- the join is a sort + searchsorted lookup against the (verified-unique)
+  build side — the TPU-friendly formulation of a hash join, since sorted
+  binary search vectorizes where per-tuple hash probing does not;
+- the final fragment's partial aggregation reuses the segment-reduce
+  kernels (ops/agg.py) and gathers partial rows to the coordinator, which
+  merges them (the ResponseCombiner role, execRemote.c).
+
+Dynamic cardinalities use the two-pass sizing SURVEY.md §7 prescribes:
+a cheap counting program fixes each exchange's static bucket capacity
+(and the grouped aggregate's group capacity) before the real program
+runs. Intermediates stay in HBM between fragments; only tiny count
+vectors and the final partial rows cross to the host.
+
+Data-dependent bailouts (duplicate build keys for an inner join) are
+exact: the program returns a flag per inner join, and the runner either
+flips the build side or gives up so the host path answers instead.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+import opentenbase_tpu.ops  # noqa: F401  (x64)
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.ops import agg as agg_ops
+from opentenbase_tpu.ops import filter as filt_ops
+from opentenbase_tpu.ops.expr import ExprCompiler, resolve_param
+from opentenbase_tpu.plan import logical as L
+from opentenbase_tpu.plan.distribute import (
+    DistributedPlan,
+    Fragment,
+    RemoteSource,
+)
+from opentenbase_tpu.plan.skey import plan_skey
+from opentenbase_tpu.storage.column import Column
+from opentenbase_tpu.storage.table import ColumnBatch
+from opentenbase_tpu.utils.hashing import combine_hashes, hash32_jnp
+
+OPTIMISTIC_GROUP_CAP = 1 << 16
+
+
+class DagUnsupported(Exception):
+    """Plan shape outside the fused DAG subset (silent host fallback)."""
+
+
+_JOINABLE_KEY_TYPES = (
+    t.TypeId.INT4, t.TypeId.INT8, t.TypeId.BOOL,
+    t.TypeId.DECIMAL, t.TypeId.DATE, t.TypeId.TIMESTAMP,
+)
+
+
+# ---------------------------------------------------------------------------
+# Compile-time plan walking: every expression is compiled BEFORE tracing
+# so the ExprCompiler's lifted params are complete when the program runs.
+# The result of _build() is a closure evaluated inside the shard_map block:
+#   fn(blocks, params, snap) -> (env, mask, n, flags)
+# where ``blocks`` are per-leaf array tuples in discovery order.
+# ---------------------------------------------------------------------------
+
+
+def _scan_nodes(meta) -> tuple:
+    """Stores a scan reads: every shard for distributed tables, exactly
+    ONE replica for replicated ones (reading all would duplicate rows —
+    the locator's preferred-replica read, locator.c REPLICATED)."""
+    if meta.dist.is_replicated:
+        return tuple(meta.node_indices[:1])
+    return tuple(meta.node_indices)
+
+
+def _walk_leaves(node: L.LogicalPlan):
+    """Canonical DFS leaf order — the ONE definition both the closure
+    builder and the per-run array collection follow."""
+    if isinstance(node, (L.Filter, L.Project, L.Aggregate)):
+        yield from _walk_leaves(node.child)
+    elif isinstance(node, L.Join):
+        yield from _walk_leaves(node.left)
+        yield from _walk_leaves(node.right)
+    elif isinstance(node, (L.Scan, RemoteSource)):
+        yield node
+    else:
+        raise DagUnsupported(type(node).__name__)
+
+
+def _leaf_arrays(fx, node, exchanged: dict, D: int):
+    """Device arrays for one leaf — the ONE definition of each leaf's
+    block tuple layout. Called fresh every run so cached programs see
+    current data."""
+    if isinstance(node, L.Scan):
+        meta = fx.catalog.get(node.table)
+        nodes = _scan_nodes(meta)
+        for n in nodes:
+            if node.table not in fx.node_stores.get(n, {}):
+                raise DagUnsupported("missing store")
+        dtab = fx.cache.get(node.table, meta, fx.node_stores, nodes)
+        if len(dtab.nrows) % D != 0:
+            raise DagUnsupported("shards not divisible by mesh")
+        valids = tuple(dtab.validity[c] for c in node.columns)
+        return (
+            tuple(dtab.columns[c] for c in node.columns),
+            tuple(v for v in valids if v is not None),
+            dtab.xmin, dtab.xmax, jnp.asarray(dtab.nrows),
+        )
+    ex = exchanged.get(node.fragment)
+    if ex is None:
+        raise DagUnsupported("remote source order")
+    return (ex["cols"], ex["valids"], ex["counts"])
+
+
+def _collect_arrays(fx, root, exchanged: dict, D: int) -> list:
+    return [
+        _leaf_arrays(fx, n, exchanged, D) for n in _walk_leaves(root)
+    ]
+
+
+class _Builder:
+    def __init__(self, fx, comp: ExprCompiler, orientation: tuple, root):
+        self.fx = fx
+        self.comp = comp
+        self.orientation = orientation
+        self.leaf_index = {
+            id(n): i for i, n in enumerate(_walk_leaves(root))
+        }
+        self.njoin = 0  # inner joins seen (orientation index)
+
+    # -- leaves -----------------------------------------------------------
+    def _leaf_scan(self, node: L.Scan, D: int) -> Callable:
+        meta = self.fx.catalog.get(node.table)
+        dtab = self.fx.cache.get(
+            node.table, meta, self.fx.node_stores, _scan_nodes(meta)
+        )
+        has_valid = tuple(
+            dtab.validity[c] is not None for c in node.columns
+        )
+        idx = self.leaf_index[id(node)]
+
+        def run(blocks, params, snap):
+            cols, valids, xmin, xmax, nrows = blocks[idx]
+            k, rmax = xmin.shape
+            n = k * rmax
+            live = (
+                jnp.arange(rmax)[None, :] < nrows[:, None]
+            ).reshape(n)
+            xmin = xmin.reshape(n)
+            xmax = xmax.reshape(n)
+            live = live & (xmin <= snap) & (snap < xmax)
+            env = []
+            vi = 0
+            for ci in range(len(cols)):
+                d = cols[ci].reshape(n)
+                if has_valid[ci]:
+                    env.append((d, valids[vi].reshape(n)))
+                    vi += 1
+                else:
+                    env.append((d, None))
+            return env, live, n, []
+
+        return run
+
+    def _leaf_exch(self, node: RemoteSource, exchanged: dict) -> Callable:
+        if node.fragment not in exchanged:
+            raise DagUnsupported("remote source order")
+        idx = self.leaf_index[id(node)]
+
+        def run(blocks, params, snap):
+            cols, valids, counts = blocks[idx]
+            dsrc, cap = cols[0].shape
+            n = dsrc * cap
+            live = (
+                jnp.arange(cap)[None, :] < counts[:, None]
+            ).reshape(n)
+            env = [
+                (cols[i].reshape(n), valids[i].reshape(n))
+                for i in range(len(cols))
+            ]
+            return env, live, n, []
+
+        return run
+
+    # -- recursive build ---------------------------------------------------
+    def build(self, node: L.LogicalPlan, exchanged: dict, D: int) -> Callable:
+        if isinstance(node, L.Filter):
+            child = self.build(node.child, exchanged, D)
+            dids = [c.dict_id for c in node.child.schema]
+            pred = self.comp.compile(node.predicate, dids)
+
+            def run(blocks, params, snap):
+                env, mask, n, flags = child(blocks, params, snap)
+                d, v = pred(env, params)
+                keep = d if v is None else (d & v)
+                return env, mask & jnp.broadcast_to(keep, (n,)), n, flags
+
+            return run
+
+        if isinstance(node, L.Project):
+            child = self.build(node.child, exchanged, D)
+            dids = [c.dict_id for c in node.child.schema]
+            fns = [
+                self.comp.compile(
+                    ex, dids,
+                    (oc.dict_id or None) if ex.type.is_text else None,
+                )
+                for ex, oc in zip(node.exprs, node.schema)
+            ]
+
+            def run(blocks, params, snap):
+                env, mask, n, flags = child(blocks, params, snap)
+                out = [_bcast(fn(env, params), n) for fn in fns]
+                return out, mask, n, flags
+
+            return run
+
+        if isinstance(node, L.Scan):
+            return self._leaf_scan(node, D)
+
+        if isinstance(node, RemoteSource):
+            return self._leaf_exch(node, exchanged)
+
+        if isinstance(node, L.Join):
+            return self._build_join(node, exchanged, D)
+
+        raise DagUnsupported(type(node).__name__)
+
+    def _build_join(self, node: L.Join, exchanged: dict, D: int) -> Callable:
+        if node.join_type not in ("inner", "semi", "anti"):
+            raise DagUnsupported(node.join_type)
+        if len(node.left_keys) != 1 or len(node.right_keys) != 1:
+            raise DagUnsupported("multi-key join")
+        for k in (node.left_keys[0], node.right_keys[0]):
+            if k.type.id not in _JOINABLE_KEY_TYPES:
+                raise DagUnsupported(f"join key type {k.type.id}")
+        left = self.build(node.left, exchanged, D)
+        right = self.build(node.right, exchanged, D)
+        ldids = [c.dict_id for c in node.left.schema]
+        rdids = [c.dict_id for c in node.right.schema]
+        lkfn = self.comp.compile(node.left_keys[0], ldids)
+        rkfn = self.comp.compile(node.right_keys[0], rdids)
+        resfn = None
+        if node.residual is not None:
+            jdids = [c.dict_id for c in node.schema]
+            resfn = self.comp.compile(node.residual, jdids)
+        jt = node.join_type
+        build_right = True
+        if jt == "inner":
+            ji = self.njoin
+            self.njoin += 1
+            build_right = (
+                self.orientation[ji] if ji < len(self.orientation) else "R"
+            ) == "R"
+
+        def run(blocks, params, snap):
+            lenv, lmask, ln, lflags = left(blocks, params, snap)
+            renv, rmask, rn, rflags = right(blocks, params, snap)
+            flags = lflags + rflags
+            lk = _bcast(lkfn(lenv, params), ln)
+            rk = _bcast(rkfn(renv, params), rn)
+            if jt in ("semi", "anti"):
+                # existence probe: build-side duplicates are harmless
+                matched, _bidx, _dup = _lookup(
+                    lk, lmask, rk, rmask, check_dup=False
+                )
+                mask = lmask & (matched if jt == "semi" else ~matched)
+                env, n = lenv, ln
+            else:
+                if build_right:
+                    pk, pmask, penv, pn = lk, lmask, lenv, ln
+                    bk, bmask, benv = rk, rmask, renv
+                else:
+                    pk, pmask, penv, pn = rk, rmask, renv, rn
+                    bk, bmask, benv = lk, lmask, lenv
+                matched, bidx, dup = _lookup(
+                    pk, pmask, bk, bmask, check_dup=True
+                )
+                flags = flags + [dup]
+                gathered = [
+                    (
+                        jnp.take(d, bidx, axis=0),
+                        None if v is None else jnp.take(v, bidx, axis=0),
+                    )
+                    for d, v in benv
+                ]
+                env = (
+                    list(penv) + gathered
+                    if build_right
+                    else gathered + list(penv)
+                )
+                mask = pmask & matched
+                n = pn
+            if resfn is not None:
+                d, v = resfn(env, params)
+                keep = d if v is None else (d & v)
+                mask = mask & jnp.broadcast_to(keep, (n,))
+            return env, mask, n, flags
+
+        return run
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+class DagRunner:
+    """Compiles and runs an eligible DistributedPlan fragment DAG on the
+    mesh of its FusedExecutor. One instance per FusedExecutor (program
+    and orientation caches reset together with the device cache)."""
+
+    def __init__(self, fx):
+        self.fx = fx  # FusedExecutor: mesh, cache, catalog, node_stores
+        self._programs: dict = {}
+        self._orientations: dict = {}  # frag skey -> tuple of 'R'/'L'
+        # sizing results remembered per (program, data version): repeat
+        # queries on unchanged data skip the count pass / optimistic
+        # group-capacity round trip entirely
+        self._caps: dict = {}
+        self.completed = 0  # DAG runs that produced the final batch
+
+    # -- public ----------------------------------------------------------
+    def run(
+        self, dplan: DistributedPlan, snapshot_ts, dicts_view,
+        subquery_values,
+    ) -> Optional[tuple[int, ColumnBatch]]:
+        """Execute the whole fragment DAG on device. Returns
+        (final_fragment_index, gathered_batch) or None if the plan is
+        outside the supported subset or bails out on data (duplicate
+        join keys both sides)."""
+        try:
+            return self._run(
+                dplan, snapshot_ts, dicts_view, subquery_values
+            )
+        except DagUnsupported:
+            return None
+
+    def _run(self, dplan, snapshot_ts, dicts_view, subquery_values):
+        frags = dplan.fragments
+        if not frags:
+            raise DagUnsupported("no fragments")
+        final = frags[-1]
+        if final.motion != "gather":
+            raise DagUnsupported("final motion")
+        # Sort/Limit/Distinct wrappers inside the final fragment are
+        # pure pushdown optimizations — the coordinator root re-applies
+        # each above the gather, so the DAG ships unsorted/uncut rows
+        # (merge_keys likewise only order a merge-gather)
+        final_root = final.root
+        while isinstance(final_root, (L.Sort, L.Limit, L.Distinct)):
+            final_root = final_root.child
+        if len(frags) == 1 and not (
+            isinstance(final_root, L.Aggregate)
+            or _contains_join(final_root)
+        ):
+            # a bare scan chain: the host path answers faster than a
+            # device round-trip, and uploading ephemeral tables (system
+            # views) would thrash the device cache
+            raise DagUnsupported("trivial scan")
+        for f in frags[:-1]:
+            if f.motion != "redistribute" or not f.hash_positions:
+                raise DagUnsupported(f.motion)
+        D = self.fx.mesh.shape["dn"]
+        snap = jnp.int64(snapshot_ts if snapshot_ts is not None else 2**61)
+
+        versions = self._data_versions(frags)
+        exchanged: dict[int, dict] = {}
+        for f in frags[:-1]:
+            exchanged[f.index] = self._run_exchange(
+                f, exchanged, snap, dicts_view, subquery_values, D,
+                versions,
+            )
+        batch = self._run_final(
+            final, final_root, exchanged, snap, dicts_view,
+            subquery_values, D, versions,
+        )
+        self.completed += 1
+        return final.index, batch
+
+    def _data_versions(self, frags) -> tuple:
+        """(table, version) for every scanned store — keys the cached
+        exchange/group capacities so they refresh when data changes."""
+        out = []
+        for f in frags:
+            root = f.root
+            while isinstance(
+                root, (L.Sort, L.Limit, L.Distinct, L.Aggregate)
+            ):
+                root = root.child
+            for leaf in _walk_leaves(root):
+                if isinstance(leaf, L.Scan):
+                    meta = self.fx.catalog.get(leaf.table)
+                    for n in _scan_nodes(meta):
+                        store = self.fx.node_stores.get(n, {}).get(
+                            leaf.table
+                        )
+                        if store is None:
+                            raise DagUnsupported("missing store")
+                        out.append((leaf.table, n, store.version))
+        return tuple(out)
+
+    # -- shared plumbing ---------------------------------------------------
+    def _frag_skey(self, frag: Fragment) -> str:
+        try:
+            return plan_skey(frag.root)
+        except NotImplementedError:
+            return frag.root.key()
+
+    def _shapes_sig(self, arrays) -> tuple:
+        return tuple(
+            tuple(
+                (tuple(a.shape), str(a.dtype))
+                for a in jax.tree.leaves(blk)
+            )
+            for blk in arrays
+        )
+
+    def _resolve(self, comp, dicts_view, subquery_values):
+        return tuple(
+            resolve_param(s, dicts_view, subquery_values)
+            for s in comp.params
+        )
+
+    def _orientation_for(self, skey, root):
+        njoins = _count_inner_joins(root)
+        o = self._orientations.get(skey, ())
+        return o if len(o) == njoins else ("R",) * njoins
+
+    def _cap_store(self, key, value) -> None:
+        """Remember a sizing result, bounded: stale (table, version)
+        keys from superseded writes would otherwise accumulate for the
+        life of the executor."""
+        self._caps[key] = value
+        while len(self._caps) > 512:
+            self._caps.pop(next(iter(self._caps)))
+
+    def _flip(self, orientation, flip_idx):
+        if orientation[flip_idx] == "L":
+            raise DagUnsupported("duplicate join keys on both sides")
+        return tuple(
+            "L" if i == flip_idx else o for i, o in enumerate(orientation)
+        )
+
+    # -- exchange (redistribute) fragments ---------------------------------
+    def _run_exchange(
+        self, frag, exchanged, snap, dicts_view, subquery_values, D,
+        versions,
+    ) -> dict:
+        skey = self._frag_skey(frag)
+        orientation = self._orientation_for(skey, frag.root)
+        hashpos = tuple(frag.hash_positions)
+        for p in hashpos:
+            if frag.root.schema[p].type.is_text:
+                # text keys are dict codes local to one column; the host
+                # path translates — here we simply fall back
+                raise DagUnsupported("text redistribution key")
+
+        arrays = _collect_arrays(self.fx, frag.root, exchanged, D)
+        sig = self._shapes_sig(arrays)
+        while True:
+            # pass 1: per-(src, dest) routed-row counts -> bucket size.
+            # Skipped entirely (one round trip saved) when this exact
+            # program + literal values already sized itself against
+            # unchanged data (literals are lifted params, so the skey
+            # alone would alias different constants).
+            ckey = ("xcnt", skey, orientation, hashpos, D, sig)
+            cached = self._programs.get(ckey)
+            if cached is None:
+                cached = self._compile_count(
+                    frag.root, exchanged, orientation, hashpos, D
+                )
+                self._programs[ckey] = cached
+            prog, comp = cached
+            params = self._resolve(comp, dicts_view, subquery_values)
+            capkey = (
+                "cap", skey, orientation, hashpos, D, sig, versions,
+                _params_sig(params),
+            )
+            cap = self._caps.get(capkey)
+            if cap is None:
+                counts, flags = prog(tuple(arrays), params, snap)
+                flags = [np.asarray(f) for f in flags]
+                flip = _first_true(flags)
+                if flip is not None:
+                    orientation = self._flip(orientation, flip)
+                    continue
+                cap = filt_ops.bucket_size(
+                    max(int(np.asarray(counts).max()), 1)
+                )
+                self._cap_store(capkey, cap)
+
+            # pass 2: the bucketed all_to_all
+            xkey = ("xchg", skey, orientation, hashpos, D, cap, sig)
+            cached = self._programs.get(xkey)
+            if cached is None:
+                cached = self._compile_exchange(
+                    frag.root, exchanged, orientation, hashpos, D, cap
+                )
+                self._programs[xkey] = cached
+            prog, comp = cached
+            params = self._resolve(comp, dicts_view, subquery_values)
+            cols, valids, rcounts, flags = prog(tuple(arrays), params, snap)
+            flags = [np.asarray(f) for f in flags]
+            flip = _first_true(flags)
+            if flip is not None:
+                orientation = self._flip(orientation, flip)
+                continue
+            self._orientations[skey] = orientation
+            return {
+                "cols": cols,
+                "valids": valids,
+                "counts": rcounts,
+                "cap": cap,
+                "schema": frag.root.schema,
+            }
+
+    def _routed_eval(self, ev, hashpos, D):
+        def run(blocks, params, snap):
+            env, mask, n, flags = ev(blocks, params, snap)
+            hashes = []
+            for p in hashpos:
+                d, v = env[p]
+                h = hash32_jnp(d)
+                if v is not None:
+                    # NULL keys route to a deterministic bucket; the
+                    # join's matched-logic already excludes them, and
+                    # anti-join probes must SURVIVE, so never drop here
+                    h = jnp.where(v, h, jnp.uint32(0))
+                hashes.append(h)
+            dest = (
+                combine_hashes(hashes, jnp) % jnp.uint32(D)
+            ).astype(jnp.int32)
+            return env, mask, n, dest, flags
+
+        return run
+
+    def _compile_count(self, root, exchanged, orientation, hashpos, D):
+        comp = ExprCompiler(lift_consts=True)
+        b = _Builder(self.fx, comp, orientation, root)
+        ev = b.build(root, exchanged, D)
+        routed = self._routed_eval(ev, hashpos, D)
+        mesh = self.fx.mesh
+        nflags = _count_inner_joins(root)
+
+        def program(arrays, params, snap):
+            def block(blocks):
+                _env, mask, _n, dest, flags = routed(blocks, params, snap)
+                cnt = jax.ops.segment_sum(
+                    mask.astype(jnp.int32), dest, num_segments=D
+                )
+                return cnt[None], [jnp.reshape(f, (1,)) for f in flags]
+
+            return shard_map(
+                block,
+                mesh=mesh,
+                in_specs=(_specs_like(arrays),),
+                out_specs=(P("dn"), [P("dn")] * nflags),
+            )(arrays)
+
+        return jax.jit(program), comp
+
+    def _compile_exchange(
+        self, root, exchanged, orientation, hashpos, D, cap
+    ):
+        comp = ExprCompiler(lift_consts=True)
+        b = _Builder(self.fx, comp, orientation, root)
+        ev = b.build(root, exchanged, D)
+        routed = self._routed_eval(ev, hashpos, D)
+        mesh = self.fx.mesh
+        ncols = len(root.schema)
+        nflags = _count_inner_joins(root)
+
+        def program(arrays, params, snap):
+            def block(blocks):
+                env, mask, n, dest, flags = routed(blocks, params, snap)
+                dkey = jnp.where(mask, dest, D)
+                order = jnp.argsort(dkey, stable=True)
+                sdkey = jnp.take(dkey, order)
+                pos = jnp.arange(n) - jnp.searchsorted(
+                    sdkey, sdkey, side="left"
+                )
+                pos = jnp.clip(pos, 0, cap - 1)
+                out_cols = []
+                out_valids = []
+                for i in range(ncols):
+                    d, v = env[i]
+                    sd = jnp.take(jnp.broadcast_to(d, (n,)), order)
+                    buck = jnp.zeros((D + 1, cap), dtype=sd.dtype)
+                    buck = buck.at[sdkey, pos].set(sd)[:D]
+                    out_cols.append(jax.lax.all_to_all(
+                        buck, "dn", split_axis=0, concat_axis=0
+                    ))
+                    # always exchange a validity plane: keeps the output
+                    # pytree static regardless of input nullability
+                    vv = (
+                        jnp.ones(n, dtype=jnp.bool_)
+                        if v is None
+                        else jnp.broadcast_to(v, (n,))
+                    )
+                    sv = jnp.take(vv, order)
+                    vb = jnp.zeros((D + 1, cap), dtype=jnp.bool_)
+                    vb = vb.at[sdkey, pos].set(sv)[:D]
+                    out_valids.append(jax.lax.all_to_all(
+                        vb, "dn", split_axis=0, concat_axis=0
+                    ))
+                cnt = jax.ops.segment_sum(
+                    mask.astype(jnp.int32), dest, num_segments=D
+                )
+                rcnt = jax.lax.all_to_all(
+                    cnt.reshape(D, 1), "dn", split_axis=0, concat_axis=0
+                ).reshape(D)
+                return (
+                    out_cols,
+                    out_valids,
+                    rcnt,
+                    [jnp.reshape(f, (1,)) for f in flags],
+                )
+
+            return shard_map(
+                block,
+                mesh=mesh,
+                in_specs=(_specs_like(arrays),),
+                out_specs=(
+                    [P("dn")] * ncols,
+                    [P("dn")] * ncols,
+                    P("dn"),
+                    [P("dn")] * nflags,
+                ),
+            )(arrays)
+
+        return jax.jit(program), comp
+
+    # -- final fragment ----------------------------------------------------
+    def _run_final(
+        self, frag, final_root, exchanged, snap, dicts_view,
+        subquery_values, D, versions,
+    ) -> ColumnBatch:
+        agg = None
+        root = final_root
+        if isinstance(root, L.Aggregate):
+            if any(a.distinct for a in root.aggs):
+                raise DagUnsupported("distinct agg")
+            for a in root.aggs:
+                if a.func not in ("sum", "count", "min", "max"):
+                    raise DagUnsupported(a.func)
+            agg = root
+            root = root.child
+        skey = self._frag_skey(frag)
+        orientation = self._orientation_for(skey, root)
+        arrays = _collect_arrays(self.fx, root, exchanged, D)
+        sig = self._shapes_sig(arrays)
+        # start from the remembered exact group capacity when this
+        # program already ran against unchanged data + literals
+        gcapkey = None
+        gcap = OPTIMISTIC_GROUP_CAP
+
+        while True:
+            fkey = ("final", skey, orientation, gcap, D, sig)
+            cached = self._programs.get(fkey)
+            if cached is None:
+                cached = self._compile_final(
+                    frag, agg, root, exchanged, orientation, gcap, D
+                )
+                self._programs[fkey] = cached
+            prog, comp, mode = cached
+            params = self._resolve(comp, dicts_view, subquery_values)
+            if gcapkey is None:
+                gcapkey = (
+                    "gcap", skey, orientation, D, sig, versions,
+                    _params_sig(params),
+                )
+                gcap_known = self._caps.get(gcapkey)
+                if gcap_known is not None and gcap_known != gcap:
+                    gcap = gcap_known
+                    continue  # recompile/lookup at the exact capacity
+            outs = jax.device_get(prog(tuple(arrays), params, snap))
+            if mode == "grouped":
+                out_keys, out_vals, gvalid, ngroups, flags = outs
+            elif mode == "scalar":
+                out_vals, flags = outs
+            else:
+                cols, valids, cnt, nrows_full, flags = outs
+            flip = _first_true(flags)
+            if flip is not None:
+                orientation = self._flip(orientation, flip)
+                gcapkey = None  # keyed per orientation
+                continue
+            if mode == "grouped":
+                actual = int(np.asarray(ngroups).max())
+                if actual >= gcap:
+                    gcap = filt_ops.bucket_size(actual + 1)
+                    continue
+                self._cap_store(gcapkey, gcap)
+                self._orientations[skey] = orientation
+                return self._collect_grouped(agg, out_keys, out_vals, gvalid)
+            if mode == "rows":
+                actual = int(np.asarray(nrows_full).max())
+                if actual > gcap:  # a device overflowed the row capacity
+                    gcap = filt_ops.bucket_size(actual)
+                    continue
+                self._cap_store(gcapkey, gcap)
+                self._orientations[skey] = orientation
+                return self._collect_rows(root.schema, cols, valids, cnt)
+            self._orientations[skey] = orientation
+            return self._collect_scalar(agg, out_vals)
+
+    def _compile_final(
+        self, frag, agg, root, exchanged, orientation, gcap, D
+    ):
+        comp = ExprCompiler(lift_consts=True)
+        b = _Builder(self.fx, comp, orientation, root)
+        ev = b.build(root, exchanged, D)
+        mesh = self.fx.mesh
+        nflags = _count_inner_joins(root)
+
+        if agg is not None:
+            dids = [c.dict_id for c in root.schema]
+            gfns = [comp.compile(g, dids) for g in agg.group_exprs]
+            specs: list[str] = []
+            afns: list = []
+            for a in agg.aggs:
+                if a.func == "count" and a.arg is None:
+                    specs.append("count_star")
+                    afns.append(None)
+                else:
+                    specs.append(a.func)
+                    afns.append(comp.compile(a.arg, dids))
+            grouped = bool(agg.group_exprs)
+            mode = "grouped" if grouped else "scalar"
+            nkeys = len(agg.group_exprs)
+            naggs = len(agg.aggs)
+
+            def program(arrays, params, snap):
+                def block(blocks):
+                    env, mask, n, flags = ev(blocks, params, snap)
+                    flags = [jnp.reshape(f, (1,)) for f in flags]
+                    keys = [_bcast(fn(env, params), n) for fn in gfns]
+                    vals = [
+                        None if fn is None else _bcast(fn(env, params), n)
+                        for fn in afns
+                    ]
+                    if not grouped:
+                        outs = agg_ops._scalar_reduce_impl(
+                            vals, mask, tuple(specs)
+                        )
+                        return [
+                            (jnp.reshape(d, (1,)), jnp.reshape(v, (1,)))
+                            for d, v in outs
+                        ], flags
+                    perm, seg, ngroups = agg_ops._group_ids_impl(keys, mask)
+                    out_keys, out_vals, gvalid = agg_ops._group_reduce_impl(
+                        keys, vals, perm, seg, gcap, tuple(specs)
+                    )
+                    return (
+                        jax.tree.map(lambda x: x[None], out_keys),
+                        jax.tree.map(lambda x: x[None], out_vals),
+                        gvalid[None],
+                        ngroups.reshape(1),
+                        flags,
+                    )
+
+                if grouped:
+                    out_specs = (
+                        [(P("dn"), P("dn"))] * nkeys,
+                        [(P("dn"), P("dn"))] * naggs,
+                        P("dn"),
+                        P("dn"),
+                        [P("dn")] * nflags,
+                    )
+                else:
+                    out_specs = (
+                        [(P("dn"), P("dn"))] * naggs,
+                        [P("dn")] * nflags,
+                    )
+                return shard_map(
+                    block,
+                    mesh=mesh,
+                    in_specs=(_specs_like(arrays),),
+                    out_specs=out_specs,
+                )(arrays)
+
+            return jax.jit(program), comp, mode
+
+        # no aggregate: compact surviving rows on DEVICE to a static
+        # per-device capacity before shipping — never transfer the padded
+        # scan width to the host (the capacity comes from a counting
+        # pass, like the exchange buckets)
+        ncols = len(root.schema)
+        rowcap = gcap  # reused capacity slot for rows mode
+
+        def program(arrays, params, snap):
+            def block(blocks):
+                env, mask, n, flags = ev(blocks, params, snap)
+                order = jnp.argsort(~mask, stable=True)[:rowcap]
+                cnt = jnp.minimum(
+                    jnp.sum(mask, dtype=jnp.int32), rowcap
+                )
+                cols = []
+                valids = []
+                for i in range(ncols):
+                    d = jnp.broadcast_to(env[i][0], (n,))
+                    cols.append(jnp.take(d, order)[None])
+                    v = (
+                        jnp.ones(n, jnp.bool_)
+                        if env[i][1] is None
+                        else jnp.broadcast_to(env[i][1], (n,))
+                    )
+                    valids.append(jnp.take(v, order)[None])
+                nrows_full = jnp.sum(mask, dtype=jnp.int64)
+                return (
+                    cols, valids, cnt.reshape(1),
+                    nrows_full.reshape(1),
+                    [jnp.reshape(f, (1,)) for f in flags],
+                )
+
+            return shard_map(
+                block,
+                mesh=mesh,
+                in_specs=(_specs_like(arrays),),
+                out_specs=(
+                    [P("dn")] * ncols,
+                    [P("dn")] * ncols,
+                    P("dn"),
+                    P("dn"),
+                    [P("dn")] * nflags,
+                ),
+            )(arrays)
+
+        return jax.jit(program), comp, "rows"
+
+    # -- output collection -------------------------------------------------
+    def _dic(self, oc):
+        return self.fx.catalog.dictionary(oc.dict_id) if oc.dict_id else None
+
+    def _collect_grouped(self, agg, out_keys, out_vals, gvalid):
+        gv = np.asarray(gvalid).reshape(-1)
+        keep = np.nonzero(gv)[0]
+        nkeys = len(agg.group_exprs)
+        cols: dict[str, Column] = {}
+        for i, oc in enumerate(agg.schema):
+            if i < nkeys:
+                d, v = out_keys[i]
+            else:
+                d, v = out_vals[i - nkeys]
+            dd = np.asarray(d).reshape(-1)[keep]
+            vv = None if v is None else np.asarray(v).reshape(-1)[keep]
+            if dd.dtype != oc.type.np_dtype:
+                dd = dd.astype(oc.type.np_dtype)
+            cols[oc.name] = Column(oc.type, dd, vv, self._dic(oc))
+        return ColumnBatch(cols, len(keep))
+
+    def _collect_scalar(self, agg, out_vals):
+        cols: dict[str, Column] = {}
+        n = 0
+        for oc, (d, v) in zip(agg.schema, out_vals):
+            dd = np.asarray(d).reshape(-1)
+            vv = np.asarray(v).reshape(-1)
+            if dd.dtype != oc.type.np_dtype:
+                dd = dd.astype(oc.type.np_dtype)
+            cols[oc.name] = Column(oc.type, dd, vv, None)
+            n = len(dd)
+        return ColumnBatch(cols, n)
+
+    def _collect_rows(self, schema, cols, valids, cnt):
+        """Device-compacted rows: per device, the first cnt[d] lanes of
+        each [D, cap] column are live."""
+        cnt = np.asarray(cnt).reshape(-1)
+        cap = np.asarray(cols[0]).shape[-1] if len(cols) else 0
+        keep = np.concatenate([
+            np.arange(d * cap, d * cap + c) for d, c in enumerate(cnt)
+        ]) if len(cnt) else np.empty(0, np.int64)
+        out: dict[str, Column] = {}
+        for i, oc in enumerate(schema):
+            d = np.asarray(cols[i]).reshape(-1)[keep]
+            v = np.asarray(valids[i]).reshape(-1)[keep]
+            if d.dtype != oc.type.np_dtype:
+                d = d.astype(oc.type.np_dtype)
+            out[oc.name] = Column(oc.type, d, v, self._dic(oc))
+        return ColumnBatch(out, len(keep))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _specs_like(arrays):
+    return jax.tree.map(lambda _: P("dn"), tuple(arrays))
+
+
+def _bcast(kv, n):
+    d, v = kv
+    if jnp.ndim(d) == 0:
+        d = jnp.broadcast_to(d, (n,))
+    if v is not None and jnp.ndim(v) == 0:
+        v = jnp.broadcast_to(v, (n,))
+    return (d, v)
+
+
+def _contains_join(plan) -> bool:
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, L.Join):
+            return True
+        if isinstance(node, (L.Filter, L.Project, L.Aggregate)):
+            stack.append(node.child)
+    return False
+
+
+def _count_inner_joins(plan) -> int:
+    n = 0
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, L.Join):
+            if node.join_type == "inner":
+                n += 1
+            stack.extend([node.left, node.right])
+        elif isinstance(node, (L.Filter, L.Project)):
+            stack.append(node.child)
+        elif isinstance(node, L.Aggregate):
+            stack.append(node.child)
+    return n
+
+
+def _params_sig(params) -> tuple:
+    """Hashable digest of resolved literal params — cached data-dependent
+    capacities must not alias across different literal values."""
+    out = []
+    for p in params:
+        a = np.asarray(p)
+        out.append((a.shape, str(a.dtype), hash(a.tobytes())))
+    return tuple(out)
+
+
+def _first_true(flags) -> Optional[int]:
+    """Index of the first raised flag. Each flag gathers per-shard as a
+    [D] vector — ANY shard's duplicate detection must count."""
+    for i, f in enumerate(flags):
+        if bool(np.asarray(f).reshape(-1).any()):
+            return i
+    return None
+
+
+def _lookup(pk, pmask, bk, bmask, check_dup: bool):
+    """Sorted-lookup equi-join primitive. Probe keys pk=(data, valid)
+    [np] against build keys bk [nb]; returns (matched [np] bool,
+    bidx [np] int, dup 0-d bool).
+
+    Dead/NULL build rows participate in the sort but are flagged
+    not-real; the composite stable sort (reals first within equal keys)
+    guarantees ``searchsorted(..., 'left')`` lands on a real row whenever
+    one exists, so no sentinel values are needed and no collision can
+    produce a false or missed match. ``dup`` is exact: adjacent equal
+    keys where both rows are real."""
+    pd, pv = pk
+    bd, bv = bk
+    nb = bd.shape[0]
+    breal = bmask if bv is None else (bmask & bv)
+    bkey = bd.astype(jnp.int64)
+    order = jnp.argsort(~breal, stable=True)  # reals first
+    order = jnp.take(order, jnp.argsort(
+        jnp.take(bkey, order), stable=True
+    ))
+    bs = jnp.take(bkey, order)
+    sreal = jnp.take(breal, order)
+    if check_dup and nb > 1:
+        dup = jnp.any((bs[1:] == bs[:-1]) & sreal[1:] & sreal[:-1])
+    else:
+        dup = jnp.asarray(False)
+    pkey = pd.astype(jnp.int64)
+    pos = jnp.searchsorted(bs, pkey, side="left")
+    posc = jnp.clip(pos, 0, nb - 1)
+    matched = (jnp.take(bs, posc) == pkey) & jnp.take(sreal, posc)
+    if pv is not None:
+        matched = matched & pv
+    matched = matched & pmask
+    bidx = jnp.take(order, posc)
+    return matched, bidx, dup

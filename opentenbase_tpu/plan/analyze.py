@@ -377,9 +377,24 @@ class Analyzer:
         if sel.where is not None:
             plain: list[A.Expr] = []
             for c in _split_and(sel.where):
+                # the parser emits NOT EXISTS as UnaryOp('not', Exists)
+                if (
+                    isinstance(c, A.UnaryOp) and c.op == "not"
+                    and isinstance(c.operand, A.ExistsSubquery)
+                ):
+                    c = A.ExistsSubquery(
+                        c.operand.query, not c.operand.negated
+                    )
                 if isinstance(c, A.InSubquery):
                     plan = self._in_subquery_join(plan, scope, c)
                 elif isinstance(c, A.ExistsSubquery):
+                    # correlated EXISTS -> semi/anti join when every
+                    # correlation is a top-level equality (the sublink
+                    # pull-up, src/backend/optimizer/prep/prepjointree.c)
+                    pulled = self._exists_subquery_join(plan, scope, c)
+                    if pulled is not None:
+                        plan = pulled
+                        continue
                     # uncorrelated EXISTS -> scalar count subquery > 0
                     counted = A.Select(
                         items=[A.SelectItem(A.FuncCall("count", (), star=True))],
@@ -1524,6 +1539,84 @@ class Analyzer:
             lk, rk = _cast(lk, ct), _cast(rk, ct)
         jt = "anti" if c.negated else "semi"
         return L.Join(plan, sub, jt, (lk,), (rk,), None, plan.schema)
+
+    def _exists_subquery_join(
+        self, plan: L.LogicalPlan, scope: Scope, c: A.ExistsSubquery
+    ) -> Optional[L.LogicalPlan]:
+        """Correlated EXISTS pulled up to a semi/anti join. Applies when
+        the subquery is a plain SELECT whose WHERE conjuncts are either
+        fully inner-resolvable (they sink into the inner side) or
+        inner = outer equalities (they become join keys). Returns None
+        when the shape doesn't fit — the caller falls back to the
+        uncorrelated count rewrite."""
+        q = c.query
+        if (
+            q.group_by or q.having is not None or q.limit is not None
+            or q.offset is not None or q.distinct or q.set_ops
+            or q.from_clause is None or q.where is None
+            # an ungrouped aggregate SELECT yields one row regardless of
+            # matches, so EXISTS is unconditionally true — no join
+            # semantics apply (convert_EXISTS_sublink's hasAggs check)
+            or any(self._contains_agg(item.expr) for item in q.items)
+        ):
+            return None
+        # every speculative analysis below rolls back subplan registration
+        # on failure/abandonment, or orphan subqueries would execute on
+        # every statement run (the mark/del pattern of _equi_key)
+        outer_mark = len(self.subplans)
+        try:
+            inner_plan, inner_scope = self._from(q.from_clause)
+        except AnalyzeError:
+            del self.subplans[outer_mark:]
+            return None
+        inner_ctx = ExprContext(inner_scope, self)
+        outer_ctx = ExprContext(scope, self)
+        lkeys: list[E.TExpr] = []
+        rkeys: list[E.TExpr] = []
+        inner_pred: Optional[E.TExpr] = None
+
+        def bail():
+            del self.subplans[outer_mark:]
+            return None
+
+        for conj in _split_and(q.where):
+            mark = len(self.subplans)
+            try:
+                te = _bool_type(self.expr(conj, inner_ctx))
+                inner_pred = (
+                    te if inner_pred is None
+                    else E.BinE("and", inner_pred, te, t.BOOL)
+                )
+                continue
+            except AnalyzeError:
+                del self.subplans[mark:]
+            if not (isinstance(conj, A.BinOp) and conj.op == "="):
+                return bail()
+            for a, b in ((conj.left, conj.right), (conj.right, conj.left)):
+                mark = len(self.subplans)
+                try:
+                    ik = self.expr(a, inner_ctx)
+                    ok_ = self.expr(b, outer_ctx)
+                except AnalyzeError:
+                    del self.subplans[mark:]
+                    continue
+                if ik.type != ok_.type:
+                    ct = _common_input_type(ik.type, ok_.type, "EXISTS")
+                    ik, ok_ = _cast(ik, ct), _cast(ok_, ct)
+                lkeys.append(ok_)
+                rkeys.append(ik)
+                break
+            else:
+                return bail()
+        if not lkeys:
+            return bail()  # uncorrelated: the count rewrite handles it
+        inner = inner_plan
+        if inner_pred is not None:
+            inner = L.Filter(inner, inner_pred, inner.schema)
+        jt = "anti" if c.negated else "semi"
+        return L.Join(
+            plan, inner, jt, tuple(lkeys), tuple(rkeys), None, plan.schema
+        )
 
 
 def _split_and(e: A.Expr) -> list[A.Expr]:

@@ -230,7 +230,7 @@ class Distributor:
     def _prune_nodes(self, scan: L.Scan, pred: E.TExpr, dist: Dist):
         meta = self.catalog.get(scan.table)
         consts: dict[str, object] = {}
-        for c in _conjuncts(pred):
+        for c in E.conjuncts(pred):
             if (
                 isinstance(c, E.BinE)
                 and c.op == "="
@@ -612,14 +612,6 @@ class Distributor:
     def _d_remotesource(self, plan: RemoteSource):
         # already cut (shouldn't recurse here, but harmless)
         return plan, Dist.single(COORDINATOR)
-
-
-def _conjuncts(e: E.TExpr):
-    if isinstance(e, E.BinE) and e.op == "and":
-        yield from _conjuncts(e.left)
-        yield from _conjuncts(e.right)
-    else:
-        yield e
 
 
 def _base_col(e: E.TExpr) -> Optional[int]:

@@ -1,24 +1,236 @@
 """Logical plan optimization passes.
 
 The slice of src/backend/optimizer we need for a columnar engine where
-scans dominate: projection (column) pruning so Scans only materialize
-referenced columns — the columnar equivalent of PG's physical-tlist
-optimization (use_physical_tlist, createplan.c). Cost-based join ordering
-is left to the statement author for now (joins execute in FROM order).
+scans dominate:
+
+- **Predicate pushdown + join-key extraction** (``pushdown_predicates``):
+  WHERE conjuncts sink to the side of a join they reference, and
+  cross-side equality conjuncts become the join's equi-keys — how
+  comma-FROM queries (``FROM a, b WHERE a.x = b.y``) get real equi-joins.
+  The reference does this in deconstruct_jointree / distribute_qual_to_rels
+  (src/backend/optimizer/plan/initsplan.c).
+- **Projection (column) pruning** (``prune_columns``) so Scans only
+  materialize referenced columns — the columnar equivalent of PG's
+  physical-tlist optimization (use_physical_tlist, createplan.c).
+
+``optimize_statement`` runs both in order.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
+from opentenbase_tpu import types as t
 from opentenbase_tpu.plan import logical as L
 from opentenbase_tpu.plan import texpr as E
+
+
+def optimize_statement(plan: L.StatementPlan) -> L.StatementPlan:
+    return prune_columns(pushdown_predicates(plan))
 
 
 def prune_columns(plan: L.StatementPlan) -> L.StatementPlan:
     root = _prune(plan.root, None)
     subplans = [_prune(s, None) for s in plan.subplans]
     return L.StatementPlan(root, subplans)
+
+
+# ---------------------------------------------------------------------------
+# Predicate pushdown + join-key extraction
+# ---------------------------------------------------------------------------
+
+
+def pushdown_predicates(plan: L.StatementPlan) -> L.StatementPlan:
+    return L.StatementPlan(
+        _push(plan.root), [_push(s) for s in plan.subplans]
+    )
+
+
+def _and_all(conjs: list[E.TExpr]) -> Optional[E.TExpr]:
+    if not conjs:
+        return None
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = E.BinE("and", out, c, t.BOOL)
+    return out
+
+
+def _col_sides(e: E.TExpr, nleft: int) -> set[str]:
+    sides: set[str] = set()
+    for n in E.walk(e):
+        if isinstance(n, E.Col):
+            sides.add("L" if n.index < nleft else "R")
+    return sides
+
+
+def _subquery_free(e: E.TExpr) -> bool:
+    return not any(isinstance(n, E.SubqueryParam) for n in E.walk(e))
+
+
+def _shift_right(e: E.TExpr, nleft: int, ntotal: int) -> E.TExpr:
+    mapping = {i: i - nleft for i in range(nleft, ntotal)}
+    for i in range(nleft):
+        mapping[i] = i  # unused, but keeps _remap_expr total
+    return _remap_expr(e, mapping)
+
+
+def _push(plan: L.LogicalPlan) -> L.LogicalPlan:
+    if isinstance(plan, L.Filter):
+        child = plan.child
+        if isinstance(child, L.Filter):
+            merged = L.Filter(
+                child.child,
+                E.BinE("and", child.predicate, plan.predicate, t.BOOL),
+                child.child.schema,
+            )
+            return _push(merged)
+        if isinstance(child, L.Join):
+            jt = child.join_type
+            if jt == "inner":
+                j, _changed = _filter_into_join(child, plan.predicate)
+                return _push_join_children(j)
+            if jt in ("semi", "anti"):
+                # output schema == left schema: the filter commutes with
+                # the existence test
+                new_left = L.Filter(
+                    child.left, plan.predicate, child.left.schema
+                )
+                return _push(dataclasses.replace(child, left=new_left))
+            if jt == "left":
+                nleft = len(child.left.schema)
+                down, keep = [], []
+                for c in E.conjuncts(plan.predicate):
+                    sides = _col_sides(c, nleft)
+                    if sides <= {"L"} and _subquery_free(c):
+                        down.append(c)
+                    else:
+                        keep.append(c)
+                if down:
+                    new_left = L.Filter(
+                        child.left, _and_all(down), child.left.schema
+                    )
+                    j = _push_join_children(
+                        dataclasses.replace(child, left=new_left)
+                    )
+                    if keep:
+                        return L.Filter(j, _and_all(keep), plan.schema)
+                    return j
+        return L.Filter(_push(child), plan.predicate, plan.schema)
+
+    if isinstance(plan, L.Join) and plan.join_type == "inner" and (
+        plan.residual is not None
+    ):
+        base = dataclasses.replace(plan, residual=None)
+        j, changed = _filter_into_join(base, plan.residual)
+        if changed:
+            return _push_join_children(j)
+        return _push_join_children(plan)
+
+    return _map_children(plan, _push)
+
+
+def _push_join_children(j: L.Join) -> L.Join:
+    return dataclasses.replace(
+        j, left=_push(j.left), right=_push(j.right)
+    )
+
+
+def _filter_into_join(
+    join: L.Join, pred: E.TExpr
+) -> tuple[L.Join, bool]:
+    """Split ``pred``'s conjuncts over an inner join: single-side
+    conjuncts sink into that side, cross-side equalities become join
+    keys, the rest stays as the join residual. Returns (join, changed) —
+    changed means at least one conjunct sank or became a key (so the
+    caller knows the residual shrank and re-processing terminates)."""
+    nleft = len(join.left.schema)
+    ntotal = len(join.schema)
+    left_down: list[E.TExpr] = []
+    right_down: list[E.TExpr] = []
+    lkeys: list[E.TExpr] = []
+    rkeys: list[E.TExpr] = []
+    rest: list[E.TExpr] = []
+    changed = False
+    # fold the join's pre-existing residual through the same
+    # classification: ON-clause extras sink/key-extract exactly like
+    # WHERE conjuncts
+    all_conjs = list(E.conjuncts(pred))
+    if join.residual is not None:
+        all_conjs += list(E.conjuncts(join.residual))
+    for c in all_conjs:
+        sides = _col_sides(c, nleft)
+        if not _subquery_free(c):
+            rest.append(c)
+            continue
+        if sides <= {"L"}:
+            left_down.append(c)
+            changed = True
+            continue
+        if sides <= {"R"}:
+            right_down.append(_shift_right(c, nleft, ntotal))
+            changed = True
+            continue
+        pair = _equi_pair(c, nleft, ntotal)
+        if pair is not None:
+            lk, rk = pair
+            lkeys.append(lk)
+            rkeys.append(rk)
+            changed = True
+            continue
+        rest.append(c)
+    left = join.left
+    if left_down:
+        left = L.Filter(left, _and_all(left_down), left.schema)
+    right = join.right
+    if right_down:
+        right = L.Filter(right, _and_all(right_down), right.schema)
+    out = L.Join(
+        left,
+        right,
+        join.join_type,
+        tuple(join.left_keys) + tuple(lkeys),
+        tuple(join.right_keys) + tuple(rkeys),
+        _and_all(rest),
+        join.schema,
+    )
+    return out, changed
+
+
+def _equi_pair(
+    c: E.TExpr, nleft: int, ntotal: int
+) -> Optional[tuple[E.TExpr, E.TExpr]]:
+    """``left_expr = right_expr`` across the join boundary (either
+    orientation) -> (left_key, right_key) with the right key rebased to
+    the right child's schema."""
+    if not (isinstance(c, E.BinE) and c.op == "="):
+        return None
+    a_sides = _col_sides(c.left, nleft)
+    b_sides = _col_sides(c.right, nleft)
+    if a_sides == {"L"} and b_sides == {"R"}:
+        return c.left, _shift_right(c.right, nleft, ntotal)
+    if a_sides == {"R"} and b_sides == {"L"}:
+        return c.right, _shift_right(c.left, nleft, ntotal)
+    return None
+
+
+def _map_children(plan: L.LogicalPlan, fn) -> L.LogicalPlan:
+    """Rebuild a node with ``fn`` applied to its child plan(s)."""
+    if isinstance(plan, (L.Scan, L.ValuesScan)):
+        return plan
+    changes = {}
+    for f in dataclasses.fields(plan):
+        v = getattr(plan, f.name)
+        if isinstance(v, L.LogicalPlan):
+            changes[f.name] = fn(v)
+        elif (
+            isinstance(v, tuple) and v
+            and all(isinstance(x, L.LogicalPlan) for x in v)
+        ):
+            changes[f.name] = tuple(fn(x) for x in v)
+    if not changes:
+        return plan
+    return dataclasses.replace(plan, **changes)
 
 
 def _remap_expr(e: E.TExpr, mapping: dict[int, int]) -> E.TExpr:

@@ -238,3 +238,13 @@ def max_col_index(e: TExpr) -> int:
 
 def is_const(e: TExpr) -> bool:
     return isinstance(e, Const)
+
+
+def conjuncts(e: "TExpr"):
+    """Flatten an AND tree into its conjuncts (shared by the pushdown
+    pass and the distributor's qual classification)."""
+    if isinstance(e, BinE) and e.op == "and":
+        yield from conjuncts(e.left)
+        yield from conjuncts(e.right)
+    else:
+        yield e

@@ -1,0 +1,257 @@
+"""Fused DAG executor (executor/fused_dag.py): distributed joins on the
+device mesh must match the host fragment executor exactly, including
+NULL-key semantics, duplicate-build fallbacks, and data changes between
+queries. Also covers the predicate-pushdown/join-key-extraction pass
+(plan/optimize.py) that feeds it."""
+
+import numpy as np
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Cluster(num_datanodes=4, shard_groups=64).session()
+    s.execute(
+        "create table customer (c_custkey bigint, c_mktsegment text) "
+        "distribute by shard(c_custkey)"
+    )
+    s.execute(
+        "create table orders (o_orderkey bigint, o_custkey bigint, "
+        "o_orderdate date, o_shippriority int) distribute by shard(o_orderkey)"
+    )
+    s.execute(
+        "create table lineitem (l_orderkey bigint, l_extendedprice "
+        "numeric(12,2), l_discount numeric(4,2), l_shipdate date) "
+        "distribute by shard(l_orderkey)"
+    )
+    rng = np.random.default_rng(9)
+    nc, no, nl = 200, 800, 3000
+    s.execute("insert into customer values " + ",".join(
+        f"({k},'{seg}')" for k, seg in zip(
+            range(1, nc + 1),
+            rng.choice(["BUILDING", "AUTOMOBILE", "MACHINERY"], nc),
+        )
+    ))
+    s.execute("insert into orders values " + ",".join(
+        f"({ok},{ck},'{d}',{pr})" for ok, ck, d, pr in zip(
+            range(1, no + 1), rng.integers(1, nc + 1, no),
+            np.datetime64("1994-06-01") + rng.integers(0, 600, no),
+            rng.integers(0, 3, no),
+        )
+    ))
+    s.execute("insert into lineitem values " + ",".join(
+        f"({ok},{p:.2f},0.0{dd},'{d}')" for ok, p, dd, d in zip(
+            rng.integers(1, no + 1, nl),
+            rng.uniform(900, 90000, nl).round(2),
+            rng.integers(0, 9, nl),
+            np.datetime64("1994-06-01") + rng.integers(0, 700, nl),
+        )
+    ))
+    return s
+
+
+Q3 = (
+    "select l_orderkey, sum(l_extendedprice * (1 - l_discount)), "
+    "o_orderdate, o_shippriority "
+    "from customer, orders, lineitem "
+    "where c_mktsegment = 'BUILDING' and c_custkey = o_custkey "
+    "and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15' "
+    "and l_shipdate > date '1995-03-15' "
+    "group by l_orderkey, o_orderdate, o_shippriority "
+    "order by 2 desc, o_orderdate limit 10"
+)
+
+
+def _both(s, q, expect_dag=None):
+    """Run host-path then fused-path; with expect_dag=True assert the
+    fused result was actually PRODUCED by the DAG runner (round-1 lesson:
+    a silent fallback makes dev==host trivially true)."""
+    s.execute("set enable_fused_execution = off")
+    host = s.query(q)
+    s.execute("set enable_fused_execution = on")
+    fx = s.cluster.fused_executor()
+    before = fx._dag.completed if fx._dag is not None else 0
+    dev = s.query(q)
+    if expect_dag is True:
+        assert fx._dag is not None and fx._dag.completed > before, (
+            "query did not complete through the fused DAG"
+        )
+    elif expect_dag is False:
+        after = fx._dag.completed if fx._dag is not None else 0
+        assert after == before, "query unexpectedly ran through the DAG"
+    return host, dev
+
+
+def test_q3_on_device_matches_host(sess):
+    host, dev = _both(sess, Q3, expect_dag=True)
+    assert dev == host
+    assert len(dev) == 10
+
+
+def test_two_table_join_agg(sess):
+    q = (
+        "select o_shippriority, count(*), sum(l_extendedprice) "
+        "from orders, lineitem where o_orderkey = l_orderkey "
+        "group by o_shippriority order by o_shippriority"
+    )
+    host, dev = _both(sess, q, expect_dag=True)
+    assert dev == host and len(dev) == 3
+
+
+def test_join_rows_without_aggregate(sess):
+    q = (
+        "select o_orderkey, l_extendedprice from orders, lineitem "
+        "where o_orderkey = l_orderkey and l_extendedprice < 2000 "
+        "order by o_orderkey, l_extendedprice"
+    )
+    host, dev = _both(sess, q, expect_dag=True)
+    assert dev == host and len(dev) > 0
+
+
+def test_semi_and_anti_joins(sess):
+    semi = (
+        "select count(*) from orders where o_orderkey in "
+        "(select l_orderkey from lineitem where l_extendedprice > 50000)"
+    )
+    anti = (
+        "select count(*) from orders where not exists "
+        "(select 1 from lineitem where l_orderkey = o_orderkey)"
+    )
+    for q in (semi, anti):
+        host, dev = _both(sess, q, expect_dag=True)
+        assert dev == host, q
+
+
+def test_null_join_keys_never_match(sess):
+    s = sess
+    s.execute("create table nl (k bigint, v bigint) distribute by shard(v)")
+    s.execute("create table nr (k bigint, w bigint) distribute by shard(w)")
+    s.execute("insert into nl values (null, 1), (1, 2), (2, 3)")
+    s.execute("insert into nr values (null, 10), (1, 20), (3, 30)")
+    q = "select sum(v + w) from nl, nr where nl.k = nr.k"
+    host, dev = _both(s, q, expect_dag=True)
+    assert dev == host == [(22,)]
+    # anti-join probes with NULL keys must SURVIVE
+    qa = (
+        "select count(*) from nl where not exists "
+        "(select 1 from nr where nr.k = nl.k)"
+    )
+    host, dev = _both(s, qa, expect_dag=True)
+    assert dev == host == [(2,)]  # NULL-key row + k=2
+
+
+def test_duplicate_both_sides_falls_back(sess):
+    s = sess
+    s.execute("create table d1 (k bigint, v bigint) distribute by shard(k)")
+    s.execute("create table d2 (k bigint, w bigint) distribute by shard(k)")
+    s.execute("insert into d1 values (1,10),(1,11),(2,20)")
+    s.execute("insert into d2 values (1,100),(1,101),(3,300)")
+    q = "select sum(v + w) from d1, d2 where d1.k = d2.k"
+    host, dev = _both(s, q, expect_dag=False)
+    assert dev == host == [(444,)]
+
+
+def test_dag_sees_new_writes(sess):
+    s = sess
+    q = (
+        "select count(*) from orders, lineitem "
+        "where o_orderkey = l_orderkey"
+    )
+    s.execute("set enable_fused_execution = on")
+    before = s.query(q)[0][0]
+    s.execute(
+        "insert into lineitem values (1, 5.00, 0.01, '1994-01-01')"
+    )
+    assert s.query(q)[0][0] == before + 1
+    s.execute("delete from lineitem where l_extendedprice = 5.00")
+    assert s.query(q)[0][0] == before
+
+
+def test_pushdown_extracts_keys_and_sinks_filters():
+    from opentenbase_tpu.plan import logical as L
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.plan.optimize import pushdown_predicates
+    from opentenbase_tpu.sql.parser import parse
+
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute("create table a (x bigint, p bigint) distribute by shard(x)")
+    s.execute("create table b (y bigint, q bigint) distribute by shard(y)")
+    stmt = parse(
+        "select sum(p + q) from a, b where x = y and p > 0 and q < 5"
+    )[0]
+    sp = pushdown_predicates(analyze_statement(stmt, c.catalog))
+    # find the join: keys extracted, one filter sunk per side
+    node = sp.root
+    while not isinstance(node, L.Join):
+        node = node.child
+    assert node.left_keys and node.right_keys
+    assert isinstance(node.left, L.Filter)
+    assert isinstance(node.right, L.Filter)
+    assert node.residual is None
+
+
+def test_outer_join_unchanged_semantics(sess):
+    # left joins are not in the DAG subset: must still answer correctly
+    q = (
+        "select count(*) from orders left join lineitem "
+        "on o_orderkey = l_orderkey where o_shippriority = 1"
+    )
+    host, dev = _both(sess, q)
+    assert dev == host
+
+
+def test_on_clause_residual_sinks_under_where():
+    from opentenbase_tpu.plan import logical as L
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.plan.optimize import pushdown_predicates
+    from opentenbase_tpu.sql.parser import parse
+
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute("create table a (x bigint, p bigint) distribute by shard(x)")
+    s.execute("create table b (y bigint, q bigint) distribute by shard(y)")
+    stmt = parse(
+        "select sum(p + q) from a join b on x = y and q < 5 where p > 0"
+    )[0]
+    sp = pushdown_predicates(analyze_statement(stmt, c.catalog))
+    node = sp.root
+    while not isinstance(node, L.Join):
+        node = node.child
+    # the ON-clause extra (q < 5) must sink into the right side even
+    # with a WHERE above (review regression)
+    assert isinstance(node.right, L.Filter)
+    assert node.residual is None
+
+
+def test_exists_rollback_no_orphan_subplans():
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.sql.parser import parse
+
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute("create table o2 (ok bigint) distribute by shard(ok)")
+    s.execute("create table l2 (lk bigint, p bigint) distribute by shard(lk)")
+    s.execute("insert into o2 values (1),(2)")
+    s.execute("insert into l2 values (1, 5),(9, 1)")
+    # uncorrelated EXISTS whose inner WHERE registers a scalar subplan:
+    # the abandoned pull-up trial must roll its registration back, so
+    # exactly one subplan (from the count rewrite) survives
+    sql = (
+        "select count(*) from o2 where exists "
+        "(select 1 from l2 where p > (select min(p) from l2))"
+    )
+    sp = analyze_statement(parse(sql)[0], c.catalog)
+    assert len(sp.subplans) == 2  # count-rewrite subplan + its inner min
+    assert s.query(sql) == [(2,)]
+    # correlated EXISTS with an inner scalar-subquery conjunct: pull-up
+    # succeeds, inner subplan registered exactly once
+    sql2 = (
+        "select count(*) from o2 where exists "
+        "(select 1 from l2 where lk = ok and p > (select min(p) from l2))"
+    )
+    sp2 = analyze_statement(parse(sql2)[0], c.catalog)
+    assert len(sp2.subplans) == 1
+    assert s.query(sql2) == [(1,)]
