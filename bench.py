@@ -321,6 +321,7 @@ def main():
 
     cluster = load_cluster(arrays, orders, customer)
     s = cluster.session()
+    s.execute("analyze")  # stats feed join order + motion costing
     _phase("cluster loaded", t_start)
 
     # XLA-fused path

@@ -27,6 +27,9 @@ class TableMeta:
     dictionaries: dict[str, Dictionary] = field(default_factory=dict)
     locator: Locator | None = None
     next_rowid: int = 0  # hidden unique row id sequence (ctid analog)
+    # optimizer statistics (pg_class.reltuples / pg_statistic analog),
+    # populated by ANALYZE: {"rows": int, "ndv": {col: int}}
+    stats: dict = field(default_factory=dict)
 
     @property
     def column_names(self) -> list[str]:

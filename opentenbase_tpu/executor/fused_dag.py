@@ -377,6 +377,8 @@ class DagRunner:
             # views) would thrash the device cache
             raise DagUnsupported("trivial scan")
         for f in frags[:-1]:
+            if f.motion == "broadcast":
+                continue
             if f.motion != "redistribute" or not f.hash_positions:
                 raise DagUnsupported(f.motion)
         D = self.fx.mesh.shape["dn"]
@@ -385,7 +387,12 @@ class DagRunner:
         versions = self._data_versions(frags)
         exchanged: dict[int, dict] = {}
         for f in frags[:-1]:
-            exchanged[f.index] = self._run_exchange(
+            run = (
+                self._run_broadcast
+                if f.motion == "broadcast"
+                else self._run_exchange
+            )
+            exchanged[f.index] = run(
                 f, exchanged, snap, dicts_view, subquery_values, D,
                 versions,
             )
@@ -532,6 +539,144 @@ class DagRunner:
                 "cap": cap,
                 "schema": frag.root.schema,
             }
+
+    # -- broadcast fragments -----------------------------------------------
+    def _run_broadcast(
+        self, frag, exchanged, snap, dicts_view, subquery_values, D,
+        versions,
+    ) -> dict:
+        """Replicate a (small) fragment's rows to every device: compact
+        per source, then all_gather — the broadcast-motion analog of the
+        bucketed exchange. Output layout matches _run_exchange so the
+        consumer leaf is oblivious."""
+        skey = self._frag_skey(frag)
+        orientation = self._orientation_for(skey, frag.root)
+        arrays = _collect_arrays(self.fx, frag.root, exchanged, D)
+        sig = self._shapes_sig(arrays)
+        while True:
+            ckey = ("bcnt", skey, orientation, D, sig)
+            cached = self._programs.get(ckey)
+            if cached is None:
+                cached = self._compile_broadcast_count(
+                    frag.root, exchanged, orientation, D
+                )
+                self._programs[ckey] = cached
+            prog, comp = cached
+            params = self._resolve(comp, dicts_view, subquery_values)
+            capkey = (
+                "bcap", skey, orientation, D, sig, versions,
+                _params_sig(params),
+            )
+            cap = self._caps.get(capkey)
+            if cap is None:
+                counts, flags = prog(tuple(arrays), params, snap)
+                flags = [np.asarray(f) for f in flags]
+                flip = _first_true(flags)
+                if flip is not None:
+                    orientation = self._flip(orientation, flip)
+                    continue
+                cap = filt_ops.bucket_size(
+                    max(int(np.asarray(counts).max()), 1)
+                )
+                self._cap_store(capkey, cap)
+
+            bkey = ("bcast", skey, orientation, D, cap, sig)
+            cached = self._programs.get(bkey)
+            if cached is None:
+                cached = self._compile_broadcast(
+                    frag.root, exchanged, orientation, D, cap
+                )
+                self._programs[bkey] = cached
+            prog, comp = cached
+            params = self._resolve(comp, dicts_view, subquery_values)
+            cols, valids, rcounts, flags = prog(tuple(arrays), params, snap)
+            flags = [np.asarray(f) for f in flags]
+            flip = _first_true(flags)
+            if flip is not None:
+                orientation = self._flip(orientation, flip)
+                continue
+            self._orientations[skey] = orientation
+            return {
+                "cols": cols,
+                "valids": valids,
+                "counts": rcounts,
+                "cap": cap,
+                "schema": frag.root.schema,
+            }
+
+    def _compile_broadcast_count(self, root, exchanged, orientation, D):
+        comp = ExprCompiler(lift_consts=True)
+        b = _Builder(self.fx, comp, orientation, root)
+        ev = b.build(root, exchanged, D)
+        mesh = self.fx.mesh
+        nflags = _count_inner_joins(root)
+
+        def program(arrays, params, snap):
+            def block(blocks):
+                _env, mask, _n, flags = ev(blocks, params, snap)
+                cnt = jnp.sum(mask, dtype=jnp.int32)
+                return cnt.reshape(1), [
+                    jnp.reshape(f, (1,)) for f in flags
+                ]
+
+            return shard_map(
+                block,
+                mesh=mesh,
+                in_specs=(_specs_like(arrays),),
+                out_specs=(P("dn"), [P("dn")] * nflags),
+            )(arrays)
+
+        return jax.jit(program), comp
+
+    def _compile_broadcast(self, root, exchanged, orientation, D, cap):
+        comp = ExprCompiler(lift_consts=True)
+        b = _Builder(self.fx, comp, orientation, root)
+        ev = b.build(root, exchanged, D)
+        mesh = self.fx.mesh
+        ncols = len(root.schema)
+        nflags = _count_inner_joins(root)
+
+        def program(arrays, params, snap):
+            def block(blocks):
+                env, mask, n, flags = ev(blocks, params, snap)
+                order = jnp.argsort(~mask, stable=True)[:cap]
+                out_cols = []
+                out_valids = []
+                for i in range(ncols):
+                    d = jnp.broadcast_to(env[i][0], (n,))
+                    out_cols.append(jax.lax.all_gather(
+                        jnp.take(d, order), "dn", axis=0
+                    ))
+                    v = (
+                        jnp.ones(n, dtype=jnp.bool_)
+                        if env[i][1] is None
+                        else jnp.broadcast_to(env[i][1], (n,))
+                    )
+                    out_valids.append(jax.lax.all_gather(
+                        jnp.take(v, order), "dn", axis=0
+                    ))
+                cnt = jnp.minimum(jnp.sum(mask, dtype=jnp.int32), cap)
+                rcnt = jax.lax.all_gather(cnt.reshape(1), "dn", axis=0)
+                return (
+                    out_cols,
+                    out_valids,
+                    rcnt.reshape(D),
+                    [jnp.reshape(f, (1,)) for f in flags],
+                )
+
+            return shard_map(
+                block,
+                mesh=mesh,
+                in_specs=(_specs_like(arrays),),
+                out_specs=(
+                    [P("dn")] * ncols,
+                    [P("dn")] * ncols,
+                    P("dn"),
+                    [P("dn")] * nflags,
+                ),
+            )(arrays)
+
+        return jax.jit(program), comp
 
     def _routed_eval(self, ev, hashpos, D):
         def run(blocks, params, snap):

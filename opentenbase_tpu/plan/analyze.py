@@ -1593,6 +1593,18 @@ class Analyzer:
             if not (isinstance(conj, A.BinOp) and conj.op == "="):
                 return bail()
             for a, b in ((conj.left, conj.right), (conj.right, conj.left)):
+                # the outer side must be a BARE column reference that the
+                # inner scope does NOT capture: a compound outer expr
+                # like y + z could silently rebind z to an inner column
+                # (SQL resolves innermost-first), so only the
+                # unambiguous shape is pulled up
+                if not isinstance(b, A.ColumnRef):
+                    continue
+                try:
+                    self.expr(b, inner_ctx)
+                    continue  # inner scope captures it: not a correlation
+                except AnalyzeError:
+                    pass
                 mark = len(self.subplans)
                 try:
                     ik = self.expr(a, inner_ctx)

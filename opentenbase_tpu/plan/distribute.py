@@ -440,6 +440,46 @@ class Distributor:
                 ldist.nodes, ldist.strategy, out_key_positions
             )
 
+        # cost-based motion choice (redistribute_path vs broadcast,
+        # pathnode.c:1469): when one side is estimated much smaller,
+        # broadcast it to the other side's nodes and keep the big side
+        # in place instead of reshuffling both.
+        if plan.left_keys and ldist.kind == "sharded" and (
+            rdist.kind in ("sharded", "single")
+        ):
+            from opentenbase_tpu.plan import costs
+
+            lest = costs.estimate_rows(plan.left, self.catalog)
+            rest = costs.estimate_rows(plan.right, self.catalog)
+            if (
+                jt in ("inner", "left", "semi", "anti")
+                and rest * 8 < lest and rest <= 100_000
+            ):
+                # small right side -> every left node. Only join types
+                # that preserve the LEFT side: a right/full join would
+                # emit each unmatched broadcast row once per left shard
+                rsrc = self._motion_broadcast(right, rdist, ldist.nodes)
+                return rebuild(left, rsrc), Dist.sharded(
+                    ldist.nodes, ldist.strategy, out_key_positions
+                )
+            if (
+                jt == "inner"
+                and rdist.kind == "sharded"
+                and lest * 8 < rest
+                and lest <= 100_000
+            ):
+                # small left side -> every right node (inner only: a
+                # broadcast probe side would duplicate semi/anti/outer
+                # output rows)
+                lsrc = self._motion_broadcast(left, ldist, rdist.nodes)
+                nleft = len(plan.left.schema)
+                rpos = tuple(
+                    nleft + p for p in rdist.key_positions
+                ) if rdist.key_positions else ()
+                return rebuild(lsrc, right), Dist.sharded(
+                    rdist.nodes, rdist.strategy, rpos
+                )
+
         # general case: redistribute both sides by the join keys onto the
         # union nodeset (the squeue all-to-all, squeue.c:403+). Sides whose
         # keys are not simple columns are first projected to append the key.

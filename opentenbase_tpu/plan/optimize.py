@@ -26,8 +26,246 @@ from opentenbase_tpu.plan import logical as L
 from opentenbase_tpu.plan import texpr as E
 
 
-def optimize_statement(plan: L.StatementPlan) -> L.StatementPlan:
-    return prune_columns(pushdown_predicates(plan))
+def optimize_statement(
+    plan: L.StatementPlan, catalog=None
+) -> L.StatementPlan:
+    plan = pushdown_predicates(plan)
+    if catalog is not None:
+        plan = reorder_joins(plan, catalog)
+    return prune_columns(plan)
+
+
+# ---------------------------------------------------------------------------
+# Cost-based join reordering (make_join_rel / join_search_one_level,
+# src/backend/optimizer/path/joinrels.c — greedy left-deep instead of DP)
+# ---------------------------------------------------------------------------
+
+
+def reorder_joins(plan: L.StatementPlan, catalog) -> L.StatementPlan:
+    return L.StatementPlan(
+        _reorder(plan.root, catalog),
+        [_reorder(s, catalog) for s in plan.subplans],
+    )
+
+
+def _reorder(plan: L.LogicalPlan, catalog) -> L.LogicalPlan:
+    if isinstance(plan, L.Join) and plan.join_type == "inner":
+        # flatten the MAXIMAL inner-join cluster first, then recurse
+        # only into its atomic inputs — recursing into Join children
+        # first would wrap sub-clusters in Projects and hide the full
+        # cluster from the greedy pass (4+ table joins would never see
+        # all their inputs together)
+        inputs, edges, residuals = _flatten_inner(plan)
+        inputs = [(_reorder(p, catalog), off) for p, off in inputs]
+        if len(inputs) >= 3:
+            out = _greedy_order(plan, (inputs, edges, residuals), catalog)
+            if out is not None:
+                return out
+        return _rebuild_cluster(plan, dict(
+            (off, p) for p, off in inputs
+        ))
+    # non-cluster nodes: nested clusters under atomic inputs (semi
+    # joins, aggregates) reorder independently
+    return _map_children(plan, lambda p: _reorder(p, catalog))
+
+
+def _rebuild_cluster(node: L.LogicalPlan, by_offset, offset=0):
+    """Reconstruct an inner-join cluster with its (possibly reordered-
+    internally) atomic inputs swapped in, preserving structure."""
+    if isinstance(node, L.Join) and node.join_type == "inner":
+        lw = _cluster_width(node.left)
+        left = _rebuild_cluster(node.left, by_offset, offset)
+        right = _rebuild_cluster(node.right, by_offset, offset + lw)
+        return dataclasses.replace(node, left=left, right=right)
+    return by_offset.get(offset, node)
+
+
+def _cluster_width(node: L.LogicalPlan) -> int:
+    return len(node.schema)
+
+
+def _shift_cols(e: E.TExpr, delta: int) -> E.TExpr:
+    if delta == 0:
+        return e
+    hi = E.max_col_index(e)
+    return _remap_expr(e, {i: i + delta for i in range(hi + 1)})
+
+
+def _flatten_inner(join: L.Join):
+    """Flatten a maximal inner-equi-join tree into
+    (inputs, edges, residuals) where inputs are (plan, offset) in the
+    original concatenated column layout, and edges/residuals are exprs
+    rebased to that global layout."""
+    inputs: list[tuple[L.LogicalPlan, int]] = []
+    edges: list[tuple[E.TExpr, E.TExpr]] = []
+    residuals: list[E.TExpr] = []
+
+    def walk(node, offset) -> int:
+        if isinstance(node, L.Join) and node.join_type == "inner":
+            lw = walk(node.left, offset)
+            rw = walk(node.right, offset + lw)
+            for lk, rk in zip(node.left_keys, node.right_keys):
+                edges.append(
+                    (_shift_cols(lk, offset), _shift_cols(rk, offset + lw))
+                )
+            if node.residual is not None:
+                residuals.extend(
+                    _shift_cols(c, offset)
+                    for c in E.conjuncts(node.residual)
+                )
+            return lw + rw
+        inputs.append((node, offset))
+        return len(node.schema)
+
+    walk(join, 0)
+    return inputs, edges, residuals
+
+
+def _greedy_order(join: L.Join, flat, catalog) -> Optional[L.LogicalPlan]:
+    """Left-deep greedy join order: start from the smallest input, then
+    repeatedly join the connected input producing the smallest estimated
+    intermediate. Output column order is restored with a final Project,
+    so the rewrite is invisible above."""
+    from opentenbase_tpu.plan import costs
+
+    memo: dict = {}  # shared across all estimates in this ordering
+    inputs, edges, residuals = flat
+    n = len(inputs)
+    total = sum(len(p.schema) for p, _ in inputs)
+    owner_of: dict[int, int] = {}
+    for i, (p, off) in enumerate(inputs):
+        for k in range(len(p.schema)):
+            owner_of[off + k] = i
+
+    def owners(e) -> set:
+        return {
+            owner_of[c.index]
+            for c in E.walk(e)
+            if isinstance(c, E.Col)
+        }
+
+    # pending work items: ("edge", lk, rk, lown, rown) | ("res", c, own)
+    pend: list = []
+    for lk, rk in edges:
+        lo, ro = owners(lk), owners(rk)
+        if not lo or not ro:
+            pend.append(("res", E.BinE("=", lk, rk, t.BOOL), lo | ro))
+        else:
+            pend.append(("edge", lk, rk, lo, ro))
+    for c in residuals:
+        pend.append(("res", c, owners(c)))
+
+    est = [costs.estimate_rows(p, catalog, memo) for p, _ in inputs]
+    connected = set()
+    for item in pend:
+        if item[0] == "edge":
+            connected |= item[3] | item[4]
+    start = min(
+        range(n),
+        key=lambda i: (i not in connected, est[i]),
+    )
+    placed = {start}
+    cur = inputs[start][0]
+    pos = {
+        inputs[start][1] + k: k
+        for k in range(len(inputs[start][0].schema))
+    }
+    cur_rows = est[start]
+
+    def usable_edges(j):
+        """Edges joinable when adding input j to the placed set."""
+        out = []
+        for item in pend:
+            if item[0] != "edge":
+                continue
+            _t, lk, rk, lo, ro = item
+            if lo <= placed and ro == {j}:
+                out.append((item, lk, rk, False))
+            elif ro <= placed and lo == {j}:
+                out.append((item, rk, lk, True))
+        return out
+
+    while len(placed) < n:
+        best_j, best_score, best_edges = None, None, []
+        for j in range(n):
+            if j in placed:
+                continue
+            ue = usable_edges(j)
+            if not ue:
+                continue
+            ndv = costs.DEFAULT_NDV
+            for _item, pk, jk, swapped in ue:
+                pn = costs.expr_ndv(
+                    _remap_expr(pk, pos), cur, catalog, memo
+                ) or costs.DEFAULT_NDV
+                jn = costs.expr_ndv(
+                    _shift_cols(jk, -inputs[j][1]), inputs[j][0],
+                    catalog, memo,
+                ) or costs.DEFAULT_NDV
+                ndv = max(ndv, pn, jn)
+            score = cur_rows * est[j] / ndv
+            if best_score is None or score < best_score:
+                best_j, best_score, best_edges = j, score, ue
+        if best_j is None:
+            # no connected input: cross-join the smallest remaining
+            best_j = min(
+                (j for j in range(n) if j not in placed),
+                key=lambda j: est[j],
+            )
+            best_edges = []
+        jplan, joff = inputs[best_j]
+        jwidth = len(jplan.schema)
+        ncur = len(cur.schema)
+        lkeys, rkeys = [], []
+        for item, pk, jk, _swapped in best_edges:
+            pend.remove(item)
+            lkeys.append(_remap_expr(pk, pos))
+            rkeys.append(_shift_cols(jk, -joff))
+        new_pos = dict(pos)
+        for k in range(jwidth):
+            new_pos[joff + k] = ncur + k
+        placed.add(best_j)
+        # residuals (and edges never usable as keys, e.g. a side
+        # spanning several inputs) whose inputs are all placed now
+        res_here = []
+        for item in list(pend):
+            if item[0] == "res":
+                if item[2] <= placed:
+                    res_here.append(_remap_expr(item[1], new_pos))
+                    pend.remove(item)
+            elif (item[3] | item[4]) <= placed:
+                res_here.append(_remap_expr(
+                    E.BinE("=", item[1], item[2], t.BOOL), new_pos
+                ))
+                pend.remove(item)
+        schema = tuple(cur.schema) + tuple(jplan.schema)
+        cur = L.Join(
+            cur, jplan, "inner", tuple(lkeys), tuple(rkeys),
+            _and_all(res_here), schema,
+        )
+        pos = new_pos
+        cur_rows = costs.estimate_rows(cur, catalog, memo)
+
+    # anything never swept (it referenced only the very first input)
+    leftover = []
+    for item in pend:
+        if item[0] == "res":
+            leftover.append(_remap_expr(item[1], pos))
+        else:
+            leftover.append(_remap_expr(
+                E.BinE("=", item[1], item[2], t.BOOL), pos
+            ))
+    if leftover:
+        cur = L.Filter(cur, _and_all(leftover), cur.schema)
+
+    # restore the original column order so the rewrite is transparent
+    exprs = tuple(
+        E.Col(pos[g], join.schema[g].type, join.schema[g].name)
+        for g in range(total)
+    )
+    if all(pos[g] == g for g in range(total)):
+        return cur
+    return L.Project(cur, exprs, join.schema)
 
 
 def prune_columns(plan: L.StatementPlan) -> L.StatementPlan:
