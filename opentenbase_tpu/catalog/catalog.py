@@ -30,6 +30,9 @@ class TableMeta:
     # optimizer statistics (pg_class.reltuples / pg_statistic analog),
     # populated by ANALYZE: {"rows": int, "ndv": {col: int}}
     stats: dict = field(default_factory=dict)
+    # columns with zone maps (CREATE INDEX builds BRIN-style block
+    # min/max summaries; scans prune blocks against them)
+    zone_cols: set = field(default_factory=set)
 
     @property
     def column_names(self) -> list[str]:

@@ -2688,10 +2688,27 @@ class Session:
         return Result("TRUNCATE TABLE")
 
     def _x_createindex(self, stmt: A.CreateIndex) -> Result:
-        # columnar engine: scans + zone maps replace btrees; the index is
-        # recorded for catalog compatibility (SURVEY.md §7 out-of-scope AMs)
-        self.cluster.catalog.get(stmt.table)
+        """Columnar engine: zone maps replace btrees (BRIN-style block
+        min/max, src/backend/access/brin). CREATE INDEX registers the
+        columns for pruning and builds the per-shard summaries."""
+        meta = self.cluster.catalog.get(stmt.table)
+        for col in stmt.columns:  # validate everything before mutating
+            if col not in meta.schema:
+                raise SQLError(
+                    f'column "{col}" of relation "{stmt.table}" does not exist'
+                )
         self.cluster.indexes[stmt.name] = stmt
+        for col in stmt.columns:
+            meta.zone_cols.add(col)
+            for n in meta.node_indices:
+                store = self.cluster.stores.get(n, {}).get(stmt.table)
+                if store is not None:
+                    store.zone_map(col)  # build eagerly
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "create_index", "name": stmt.name,
+                 "table": stmt.table, "columns": list(stmt.columns)}
+            )
         return Result("CREATE INDEX")
 
     # -- DDL: cluster ----------------------------------------------------
@@ -3025,9 +3042,15 @@ class Session:
             total_ms = (_time.perf_counter() - t0) * 1000
             lines.append("")
             for i in getattr(ex, "instrumentation", []):
+                extra = ""
+                if "total_blocks" in i:
+                    extra = (
+                        f" pruned={i['pruned_blocks']}/"
+                        f"{i['total_blocks']} blocks"
+                    )
                 lines.append(
                     f"Fragment {i['fragment']} on dn{i['node']}: "
-                    f"rows={i['rows']} time={i['ms']:.3f} ms"
+                    f"rows={i['rows']} time={i['ms']:.3f} ms" + extra
                 )
             lines.append(
                 f"Total: rows={out.nrows} time={total_ms:.3f} ms"

@@ -165,14 +165,18 @@ class DistExecutor:
                 # per-(fragment, node) instrumentation gathered back to
                 # the coordinator — the distributed EXPLAIN ANALYZE flow
                 # (src/backend/commands/explain_dist.c, recv_instr_htbl)
-                self.instrumentation.append(
-                    {
-                        "fragment": frag.index,
-                        "node": node,
-                        "rows": outs[node].nrows,
-                        "ms": (_time.perf_counter() - t0) * 1000,
-                    }
-                )
+                instr = {
+                    "fragment": frag.index,
+                    "node": node,
+                    "rows": outs[node].nrows,
+                    "ms": (_time.perf_counter() - t0) * 1000,
+                }
+                if getattr(ex, "zone_total_blocks", 0):
+                    instr["pruned_blocks"] = getattr(
+                        ex, "zone_pruned_blocks", 0
+                    )
+                    instr["total_blocks"] = ex.zone_total_blocks
+                self.instrumentation.append(instr)
             motioned[frag.index] = self._apply_motion(frag, outs)
         ex = LocalExecutor(
             self.catalog,

@@ -201,28 +201,41 @@ class LocalExecutor:
         return DevBatch(tuple(schema), cols, jnp.asarray(live), padded)
 
     # -- leaves -----------------------------------------------------------
-    def _eval_scan(self, plan: L.Scan) -> DevBatch:
+    def _eval_scan(self, plan: L.Scan, row_idx=None) -> DevBatch:
+        """``row_idx``: optional physical row subset (zone-map pruning).
+        Callers passing it must have ruled out own-write overlays, whose
+        references are positional over the full store."""
         store = self.stores.get(plan.table)
         if store is None:
             raise ExecError(f"no shard for table {plan.table} on this node")
-        nrows = store.nrows
+        nrows = store.nrows if row_idx is None else len(row_idx)
         padded = filt_ops.bucket_size(max(nrows, 1))
+
+        def subset(arr):
+            a = arr[: store.nrows]
+            return a if row_idx is None else a[row_idx]
+
         cols = []
         for name, oc in zip(plan.columns, plan.schema):
-            arr = store.column_array(name)
-            d = _pad_to(arr, padded)
+            d = _pad_to(subset(store._cols[name]), padded)
             vm = store._validity.get(name)
-            v = None if vm is None else _pad_to(vm[:nrows], padded, fill=False)
-            cols.append((jnp.asarray(d), None if v is None else jnp.asarray(v)))
+            v = (
+                None if vm is None
+                else _pad_to(subset(vm), padded, fill=False)
+            )
+            cols.append(
+                (jnp.asarray(d), None if v is None else jnp.asarray(v))
+            )
         live = np.zeros(padded, dtype=np.bool_)
         live[:nrows] = True
         if self.snapshot_ts is not None:
             snap = np.int64(self.snapshot_ts)
-            xmin = store.xmin_ts[:nrows]
-            xmax = store.xmax_ts[:nrows]
-            live[:nrows] &= (xmin <= snap) & (snap < xmax)
+            live[:nrows] &= (subset(store.xmin_ts) <= snap) & (
+                snap < subset(store.xmax_ts)
+            )
         own = self.own_writes.get(plan.table)
         if own is not None:
+            assert row_idx is None, "own-writes are positional"
             ins_ranges, del_idx = own
             for s, e in ins_ranges:
                 live[s:min(e, nrows)] = True
@@ -262,7 +275,11 @@ class LocalExecutor:
 
     # -- filter / project --------------------------------------------------
     def _eval_filter(self, plan: L.Filter) -> DevBatch:
-        child = self.eval(plan.child)
+        child = None
+        if isinstance(plan.child, L.Scan):
+            child = self._eval_scan_pruned(plan.child, plan.predicate)
+        if child is None:
+            child = self.eval(plan.child)
         fns, params = self._bind(
             [plan.predicate], plan.child.schema, self._subq()
         )
@@ -271,6 +288,58 @@ class LocalExecutor:
         keep = jnp.broadcast_to(keep, (child.n,))
         mask = keep if child.mask is None else (child.mask & keep)
         return DevBatch(plan.schema, child.cols, mask, child.n)
+
+    # -- zone-map block pruning (BRIN-style, CREATE INDEX builds maps) --
+    def _eval_scan_pruned(
+        self, plan: L.Scan, pred
+    ) -> Optional[DevBatch]:
+        """Scan only the blocks whose zone-map [min, max] intersects the
+        predicate's per-column bounds. Returns None when pruning does
+        not apply (no indexed columns bound, no blocks skipped, pending
+        own-writes with positional references)."""
+        store = self.stores.get(plan.table)
+        if store is None or store.nrows == 0:
+            return None
+        if plan.table in self.own_writes:
+            return None  # ins_ranges/del_idx are positional
+        try:
+            meta = self.catalog.get(plan.table)
+        except Exception:
+            return None
+        if not meta.zone_cols:
+            return None
+        bounds = _predicate_bounds(pred, plan)
+        usable = {
+            c: b for c, b in bounds.items()
+            if c in meta.zone_cols
+            and not plan.schema[plan.columns.index(c)].type.is_text
+        }
+        if not usable:
+            return None
+        b = store.ZONE_BLOCK
+        nblocks = -(-store.nrows // b)
+        sel = np.ones(nblocks, dtype=bool)
+        for col, (lo, hi) in usable.items():
+            zm = store.zone_map(col)
+            if zm is None:
+                continue
+            mins, maxs = zm
+            if lo is not None:
+                sel &= maxs >= lo
+            if hi is not None:
+                sel &= mins <= hi
+        self.zone_total_blocks = getattr(self, "zone_total_blocks", 0) + nblocks
+        nsel = int(sel.sum())
+        if nsel == nblocks:
+            return None  # nothing pruned: the plain scan path is simpler
+        self.zone_pruned_blocks = (
+            getattr(self, "zone_pruned_blocks", 0) + (nblocks - nsel)
+        )
+        starts = np.nonzero(sel)[0] * b
+        idx = np.concatenate([
+            np.arange(s, min(s + b, store.nrows)) for s in starts
+        ]) if nsel else np.empty(0, dtype=np.int64)
+        return self._eval_scan(plan, row_idx=idx)
 
     def _eval_project(self, plan: L.Project) -> DevBatch:
         child = self.eval(plan.child)
@@ -994,3 +1063,69 @@ def _pad_dev(arr, n: int, fill=0):
         return arr
     pad = jnp.full((n - cur,), fill, dtype=arr.dtype)
     return jnp.concatenate([arr, pad])
+
+
+def _predicate_bounds(pred, scan: L.Scan) -> dict:
+    """Per-column [lo, hi] bounds (either side None = unbounded) implied
+    by a predicate's top-level conjuncts, in PHYSICAL column units
+    (scaled decimals / epoch days — the analyzer lowers literals to
+    physical form). Only bare Col-vs-Const comparisons and IN lists
+    contribute; anything else is ignored (conservative)."""
+    out: dict = {}
+
+    def narrow(ci: int, lo, hi):
+        name = scan.columns[ci]
+        cur = out.get(name, (None, None))
+        nlo = cur[0] if lo is None else (
+            lo if cur[0] is None else max(cur[0], lo)
+        )
+        nhi = cur[1] if hi is None else (
+            hi if cur[1] is None else min(cur[1], hi)
+        )
+        out[name] = (nlo, nhi)
+
+    _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+    for c in E.conjuncts(pred):
+        if isinstance(c, E.BinE) and c.op in ("=", "<", "<=", ">", ">="):
+            op = c.op
+            col, k = c.left, c.right
+            if isinstance(col, E.Const) and isinstance(k, E.Col):
+                col, k = k, col
+                op = _FLIP.get(op, op)
+            if not (isinstance(col, E.Col) and isinstance(k, E.Const)):
+                continue
+            if k.value is None or isinstance(k.value, (str, bytes)):
+                continue
+            try:
+                v = int(k.value)
+            except (TypeError, ValueError):
+                continue
+            if op == "=":
+                narrow(col.index, v, v)
+            elif op == "<":
+                narrow(col.index, None, v - 1)
+            elif op == "<=":
+                narrow(col.index, None, v)
+            elif op == ">":
+                narrow(col.index, v + 1, None)
+            elif op == ">=":
+                narrow(col.index, v, None)
+        elif isinstance(c, E.InListE) and not c.negated:
+            if not isinstance(c.operand, E.Col):
+                continue
+            vals = []
+            for item in c.items:
+                if not isinstance(item, E.Const) or item.value is None:
+                    vals = []
+                    break
+                if isinstance(item.value, (str, bytes)):
+                    vals = []
+                    break
+                try:
+                    vals.append(int(item.value))
+                except (TypeError, ValueError):
+                    vals = []
+                    break
+            if vals:
+                narrow(c.operand.index, min(vals), max(vals))
+    return out

@@ -347,6 +347,7 @@ class ClusterPersistence:
                     "defaults": dict(getattr(tm, "defaults", {})),
                     "primary_key": getattr(tm, "primary_key", None),
                 },
+                "zone_cols": sorted(tm.zone_cols),
             }
             for node in tm.node_indices:
                 store = c.stores[node].get(name)
@@ -570,6 +571,7 @@ class ClusterPersistence:
                 c.catalog.create_table(name, schema, spec)
             tm = c.catalog.get(name)
             _apply_constraints_meta(tm, tmeta.get("constraints", {}))
+            tm.zone_cols.update(tmeta.get("zone_cols", []))
             tm.node_indices = list(tmeta["nodes"])
             for col, values in tmeta["dictionaries"].items():
                 tm.dictionaries[col] = Dictionary(values)
@@ -690,6 +692,12 @@ class ClusterPersistence:
                 if c.catalog.has(header["name"]):
                     c.catalog.drop_table(header["name"])
                     c.drop_table_stores(header["name"])
+            elif op == "create_index":
+                if c.catalog.has(header["table"]):
+                    meta = c.catalog.get(header["table"])
+                    for col in header["columns"]:
+                        if col in meta.schema:
+                            meta.zone_cols.add(col)
             elif op == "truncate":
                 if c.catalog.has(header["name"]):
                     meta = c.catalog.get(header["name"])

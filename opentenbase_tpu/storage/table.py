@@ -113,6 +113,9 @@ class ShardStore:
         self.structure_version = 0
         self.mvcc_seq = 0
         self._mvcc_log: list[tuple] = []  # (seq, kind, a, b, ts)
+        # zone maps (BRIN analog, src/backend/access/brin): per-column
+        # block min/max built on demand, version-keyed
+        self._zone_cache: dict = {}
         # Prepared-but-undecided 2PC transactions hold (start, end) row
         # ranges / index arrays into this store for later stamping. Vacuum
         # compaction would invalidate them, so such transactions pin the
@@ -216,6 +219,43 @@ class ShardStore:
         self._validity.pop(name, None)
         self.version += 1
         self.structure_version += 1
+
+    ZONE_BLOCK = 4096
+
+    def zone_map(self, name: str):
+        """(mins, maxs) per ZONE_BLOCK rows of an integer-typed column —
+        the BRIN-style min/max summary consulted for block pruning.
+        Computed over ALL physical rows (dead included): conservative, a
+        pruned block provably contains no matching value. Returns None
+        for non-integer columns or empty stores."""
+        arr = self._cols.get(name)
+        if arr is None or self.nrows == 0 or not np.issubdtype(
+            arr.dtype, np.integer
+        ):
+            return None
+        # keyed on DATA shape only (appends + structural rewrites): MVCC
+        # stamps bump ``version`` without touching column values, and a
+        # delete-heavy workload must not rebuild maps per query
+        key = (name, self.structure_version, self.nrows)
+        zm = self._zone_cache.get(key)
+        if zm is not None:
+            return zm
+        n = self.nrows
+        b = self.ZONE_BLOCK
+        nblocks = -(-n // b)
+        padded = nblocks * b
+        data = arr[:n]
+        if padded != n:
+            # pad with the last value: never widens any block's range
+            data = np.concatenate([data, np.full(padded - n, data[-1])])
+        blocks = data.reshape(nblocks, b)
+        zm = (blocks.min(axis=1), blocks.max(axis=1))
+        # evict this column's stale generations only
+        self._zone_cache = {
+            k: v for k, v in self._zone_cache.items() if k[0] != name
+        }
+        self._zone_cache[key] = zm
+        return zm
 
     # -- reads ----------------------------------------------------------
     def column_array(self, name: str) -> np.ndarray:
