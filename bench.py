@@ -212,7 +212,7 @@ def load_cluster(arrays, orders=None, customer=None) -> Cluster:
     return cluster
 
 
-def cpu_baseline(arrays, repeats: int = 3):
+def cpu_baseline(arrays, repeats: int = 2):
     qty, price, disc, ship = (
         arrays["l_quantity"],
         arrays["l_extendedprice"],
@@ -364,9 +364,9 @@ def main():
     _HEADLINE_EMITTED = True
 
     # Q1: the grouped-aggregation path; headline stays Q6 for cross-round
-    # comparability. Runs only on the remaining watchdog budget.
-    if time.monotonic() - t_start < BENCH_TIMEOUT * 0.75:
-        try:
+    # comparability. The headline is already out, so a watchdog cut here
+    # loses nothing.
+    try:
             q1_warm = s.query(Q1)  # compile
             assert len(q1_warm) >= 1
             _phase("q1 compiled", t_start)
@@ -387,8 +387,7 @@ def main():
 
     # Q3: the distributed-join path (fused DAG: all_to_all exchanges +
     # sorted-lookup join + partial agg on device; BASELINE config 3)
-    if time.monotonic() - t_start < BENCH_TIMEOUT * 0.8:
-        try:
+    try:
             q3_warm = s.query(Q3)  # compile (several fragment programs)
             assert len(q3_warm) >= 1
             _phase("q3 compiled", t_start)

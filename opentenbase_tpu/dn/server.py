@@ -1,0 +1,178 @@
+"""Datanode executor server — a real process boundary for fragments.
+
+The reference's datanodes are separate postgres processes that receive
+serialized plan fragments over the wire ('p' message,
+src/backend/tcop/postgres.c:5580 -> exec_plan_message :2050) and stream
+rows back. Here a DN process is:
+
+- a ``StandbyCluster`` following the coordinator's WAL over streaming
+  replication (storage/replication.py) — the DN's copy of the data plane,
+  kept in sync by the same redo machinery as a hot standby;
+- a framed-RPC server executing portable plan fragments
+  (plan/serde.py) against its local shard stores with a coordinator-
+  provided snapshot timestamp, after waiting for its replay position to
+  reach the coordinator's WAL position (read-your-writes, the
+  remote_apply consistency mode).
+
+Run as a module:
+  python -m opentenbase_tpu.dn.server --data-dir D --wal-host H
+      --wal-port P [--listen-port N]
+prints "READY <port>" on stdout once serving.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import threading
+import time
+from typing import Optional
+
+from opentenbase_tpu.net.protocol import recv_frame, send_frame
+
+
+class DNServer:
+    def __init__(
+        self,
+        data_dir: str,
+        wal_host: str,
+        wal_port: int,
+        num_datanodes: int = 2,
+        shard_groups: int = 256,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        from opentenbase_tpu.storage.replication import StandbyCluster
+
+        self.standby = StandbyCluster(data_dir, num_datanodes, shard_groups)
+        self.standby.start_replication(wal_host, wal_port)
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(32)
+        self.host, self.port = self._lsock.getsockname()
+        self._stop = threading.Event()
+        self._accept: Optional[threading.Thread] = None
+
+    def start(self) -> "DNServer":
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        self.standby.stop()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    # -- RPC loop ---------------------------------------------------------
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                msg = recv_frame(conn)
+                if msg is None:
+                    break
+                try:
+                    send_frame(conn, self._dispatch(msg))
+                except Exception as e:
+                    send_frame(
+                        conn, {"error": f"{type(e).__name__}: {e}"}
+                    )
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, msg: dict) -> dict:
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True, "applied": self.standby.applied}
+        if op == "exec_fragment":
+            return self._exec_fragment(msg)
+        return {"error": f"unknown op {op}"}
+
+    def _wait_applied(self, lsn: int, timeout_s: float = 30.0) -> bool:
+        t0 = time.time()
+        while time.time() - t0 < timeout_s:
+            if self.standby.applied >= lsn:
+                return True
+            time.sleep(0.002)
+        return False
+
+    def _exec_fragment(self, msg: dict) -> dict:
+        from opentenbase_tpu.executor.local import LocalExecutor
+        from opentenbase_tpu.plan import serde
+
+        min_lsn = int(msg.get("min_lsn", 0))
+        if min_lsn and not self._wait_applied(min_lsn):
+            return {"error": "replication lag: wal position not reached"}
+        from opentenbase_tpu import types as t
+
+        plan = serde.loads_plan(msg["plan"])
+        node = int(msg["node"])
+        snapshot_ts = msg.get("snapshot_ts")
+        c = self.standby.cluster
+        inputs = {
+            int(k): serde.batch_from_wire(v, c.catalog)
+            for k, v in (msg.get("inputs") or {}).items()
+        }
+        subquery_values = [
+            (v, t.SqlType(t.TypeId(ty[0]), ty[1], ty[2]))
+            for v, ty in (msg.get("subquery_values") or [])
+        ]
+        # execute under the standby's statement lock so redo apply never
+        # interleaves with a fragment read (recovery-conflict interlock)
+        with c._exec_lock:
+            ex = LocalExecutor(
+                c.catalog,
+                c.stores.get(node, {}),
+                snapshot_ts,
+                remote_inputs=inputs,
+                subquery_values=subquery_values,
+            )
+            out = ex.run_plan(plan)
+        return {
+            "batch": serde.batch_to_wire(out, plan.schema),
+            "pruned_blocks": getattr(ex, "zone_pruned_blocks", 0),
+            "total_blocks": getattr(ex, "zone_total_blocks", 0),
+        }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--wal-host", required=True)
+    ap.add_argument("--wal-port", type=int, required=True)
+    ap.add_argument("--listen-port", type=int, default=0)
+    ap.add_argument("--num-datanodes", type=int, default=2)
+    ap.add_argument("--shard-groups", type=int, default=256)
+    args = ap.parse_args(argv)
+    srv = DNServer(
+        args.data_dir, args.wal_host, args.wal_port,
+        args.num_datanodes, args.shard_groups, port=args.listen_port,
+    ).start()
+    print(f"READY {srv.port}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

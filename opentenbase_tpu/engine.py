@@ -233,6 +233,14 @@ class Cluster:
         self.functions: dict[str, object] = {}
         self.barriers: list[tuple[str, int]] = []
         self.indexes: dict[str, A.CreateIndex] = {}
+        # wire authentication: user -> SCRAM verifier (pg_authid analog).
+        # Empty = trust mode (in-process sessions and tests); once any
+        # user exists, the TCP front end requires a SCRAM handshake.
+        self.users: dict[str, dict] = {}
+        # datanode PROCESS topology: node index -> ChannelPool. When a
+        # node has channels, its read fragments ship to the DN server
+        # process (dn/server.py) instead of executing in-process.
+        self.dn_channels: dict[int, object] = {}
         # interval/range partitioning: parent name -> PartitionSpec
         # (children are real catalog tables named parent$pK)
         self.partitions: dict[str, "PartitionSpec"] = {}
@@ -309,6 +317,23 @@ class Cluster:
     def drop_table_stores(self, name: str) -> None:
         for tabs in self.stores.values():
             tabs.pop(name, None)
+
+    def attach_datanode(
+        self, node: int, host: str, port: int, pool_size: int = 4
+    ) -> None:
+        """Route node's fragments to a DN server process (dn/server.py)
+        through a channel pool — CREATE NODE + pooler registration."""
+        from opentenbase_tpu.net.pool import ChannelPool
+
+        old = self.dn_channels.get(node)
+        if old is not None:
+            old.close()
+        self.dn_channels[node] = ChannelPool(host, port, pool_size)
+
+    def detach_datanode(self, node: int) -> None:
+        pool = self.dn_channels.pop(node, None)
+        if pool is not None:
+            pool.close()
 
     def session(self) -> "Session":
         s = Session(self)
@@ -1886,6 +1911,12 @@ class Session:
             self.cluster.stores,
             snapshot,
             own_writes=self.txn.own_writes_view() if self.txn else None,
+            dn_channels=self.cluster.dn_channels,
+            min_lsn=(
+                self.cluster.persistence.wal.position
+                if self.cluster.persistence is not None
+                else 0
+            ),
         )
         return ex.run(dplan)
 
@@ -2686,6 +2717,36 @@ class Session:
                     {"op": "truncate", "name": name}
                 )
         return Result("TRUNCATE TABLE")
+
+    def _x_createuser(self, stmt: A.CreateUser) -> Result:
+        """CREATE/ALTER USER ... PASSWORD: stores a SCRAM-SHA-256
+        verifier (never the password) — auth.c / scram-common.c."""
+        from opentenbase_tpu.net.auth import build_verifier
+
+        if not stmt.alter and stmt.name in self.cluster.users:
+            raise SQLError(f'role "{stmt.name}" already exists')
+        if stmt.alter and stmt.name not in self.cluster.users:
+            raise SQLError(f'role "{stmt.name}" does not exist')
+        verifier = build_verifier(stmt.password)
+        self.cluster.users[stmt.name] = verifier
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "create_user", "name": stmt.name,
+                 "verifier": verifier}
+            )
+        return Result("ALTER ROLE" if stmt.alter else "CREATE ROLE")
+
+    def _x_dropuser(self, stmt: A.DropUser) -> Result:
+        if stmt.name not in self.cluster.users:
+            if stmt.if_exists:
+                return Result("DROP ROLE")
+            raise SQLError(f'role "{stmt.name}" does not exist')
+        del self.cluster.users[stmt.name]
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "drop_user", "name": stmt.name}
+            )
+        return Result("DROP ROLE")
 
     def _x_createindex(self, stmt: A.CreateIndex) -> Result:
         """Columnar engine: zone maps replace btrees (BRIN-style block

@@ -97,11 +97,20 @@ class DistExecutor:
         node_stores: dict[int, dict],  # node index -> {table -> ShardStore}
         snapshot_ts: Optional[int] = None,
         own_writes: Optional[dict[int, dict]] = None,  # node -> table -> writes
+        dn_channels: Optional[dict] = None,  # node -> net.pool.ChannelPool
+        min_lsn: int = 0,
     ):
         self.catalog = catalog
         self.node_stores = node_stores
         self.snapshot_ts = snapshot_ts
         self.own_writes = own_writes or {}
+        # datanode PROCESS execution: nodes with a channel pool run their
+        # fragments in a DN server over serialized plans (dn/server.py,
+        # the 'p'-message path); others run in-process. min_lsn is the
+        # coordinator WAL position the DN must have replayed first
+        # (read-your-writes / remote_apply).
+        self.dn_channels = dn_channels or {}
+        self.min_lsn = min_lsn
 
     def _stores(self, node: int) -> dict:
         if node == COORDINATOR:
@@ -145,9 +154,46 @@ class DistExecutor:
         motioned: dict[int, dict[int, ColumnBatch]] = {}
         if not hasattr(self, "instrumentation"):
             self.instrumentation = []
+        frag_schemas = {f.index: f.root.schema for f in dplan.fragments}
         for frag in dplan.fragments:
             outs: dict[int, ColumnBatch] = {}
-            for node in frag.nodes:
+            # a transaction's own uncommitted writes exist only in the
+            # coordinator's stores: such statements stay local
+            can_remote = not self.own_writes
+            remote = [
+                n for n in frag.nodes
+                if can_remote and n in self.dn_channels
+            ]
+            local = [n for n in frag.nodes if n not in remote]
+            # remote fragments run concurrently in their DN processes
+            # (the reference's parallel RemoteSubplan fan-out)
+            threads = []
+            errors: list = []
+
+            def run_remote(node):
+                t0 = _time.perf_counter()
+                try:
+                    outs[node] = self._exec_remote(
+                        frag, node, motioned, subquery_values,
+                        frag_schemas,
+                    )
+                    self.instrumentation.append({
+                        "fragment": frag.index,
+                        "node": node,
+                        "rows": outs[node].nrows,
+                        "ms": (_time.perf_counter() - t0) * 1000,
+                        "remote": True,
+                    })
+                except Exception as e:
+                    errors.append(e)
+
+            import threading as _threading
+
+            for node in remote:
+                th = _threading.Thread(target=run_remote, args=(node,))
+                th.start()
+                threads.append(th)
+            for node in local:
                 t0 = _time.perf_counter()
                 ex = LocalExecutor(
                     self.catalog,
@@ -177,6 +223,10 @@ class DistExecutor:
                     )
                     instr["total_blocks"] = ex.zone_total_blocks
                 self.instrumentation.append(instr)
+            for th in threads:
+                th.join()
+            if errors:
+                raise errors[0]
             motioned[frag.index] = self._apply_motion(frag, outs)
         ex = LocalExecutor(
             self.catalog,
@@ -190,6 +240,35 @@ class DistExecutor:
             subquery_values=subquery_values,
         )
         return ex.run_plan(dplan.root)
+
+    def _exec_remote(
+        self, frag: Fragment, node: int, motioned, subquery_values,
+        frag_schemas,
+    ) -> ColumnBatch:
+        """Ship the fragment to the node's DN process (plan/serde.py over
+        a pooled channel) and decode its output batch."""
+        from opentenbase_tpu.plan import serde
+
+        inputs = {}
+        for j, per_node in motioned.items():
+            if node in per_node:
+                inputs[str(j)] = serde.batch_to_wire(
+                    per_node[node], frag_schemas[j]
+                )
+        sq = [
+            [v, [ty.id.value, ty.precision, ty.scale]]
+            for v, ty in subquery_values
+        ]
+        resp = self.dn_channels[node].rpc({
+            "op": "exec_fragment",
+            "plan": serde.dumps_plan(frag.root),
+            "node": node,
+            "snapshot_ts": self.snapshot_ts,
+            "inputs": inputs,
+            "subquery_values": sq,
+            "min_lsn": self.min_lsn,
+        })
+        return serde.batch_from_wire(resp["batch"], self.catalog)
 
     def _apply_motion(
         self, frag: Fragment, outs: dict[int, ColumnBatch]

@@ -100,17 +100,24 @@ class DeviceCache:
         self.stats = {
             "hits": 0,
             "full_uploads": 0,
+            "column_uploads": 0,
             "delta_uploads": 0,
             "delta_rows": 0,
             "mvcc_replays": 0,
         }
 
     def get(
-        self, name: str, meta, node_stores: dict[int, dict], nodes=None
+        self, name: str, meta, node_stores: dict[int, dict], nodes=None,
+        columns=None,
     ) -> DeviceTable:
         """``nodes`` overrides which stores to stack (a replicated table
-        reads ONE replica; default = every owning node)."""
+        reads ONE replica; default = every owning node). ``columns``
+        restricts which columns must be device-resident — columns upload
+        LAZILY on first use, so a query touching 4 of 7 columns never
+        pays HBM transfer for the other 3 (physical-tlist, columnar
+        style)."""
         nodes = tuple(meta.node_indices) if nodes is None else tuple(nodes)
+        want = tuple(columns) if columns is not None else tuple(meta.schema)
         stores = [node_stores[n][name] for n in nodes]
         versions = tuple(s.version for s in stores)
         cached = self._tables.get((name, nodes))
@@ -118,28 +125,65 @@ class DeviceCache:
             cached.node_order == nodes
         ):
             self.stats["hits"] += 1
+            self._ensure_columns(cached, stores, meta, want)
             return cached
         if cached is not None and cached.node_order == nodes:
             updated = self._try_delta(cached, stores, meta, versions)
             if updated is not None:
+                self._ensure_columns(updated, stores, meta, want)
                 return updated
         self.stats["full_uploads"] += 1
         S = _pad_shards(len(stores), self.mesh.shape["dn"])
         rmax = filt_ops.bucket_size(max(max((s.nrows for s in stores), default=0), 1))
         sharding = NamedSharding(self.mesh, P("dn"))
-        columns = {}
-        validity = {}
-        col_maxabs: dict[str, Optional[float]] = {}
-        col_range: dict[str, Optional[tuple[int, int]]] = {}
-        for cname, ty in meta.schema.items():
-            stack = np.zeros((S, rmax), dtype=ty.np_dtype)
+        xmin = np.full((S, rmax), 2**62, dtype=np.int64)
+        xmax = np.zeros((S, rmax), dtype=np.int64)
+        nrows = np.zeros(S, dtype=np.int64)
+        for i, s in enumerate(stores):
+            xmin[i, : s.nrows] = s.xmin_ts[: s.nrows]
+            xmax[i, : s.nrows] = s.xmax_ts[: s.nrows]
+            nrows[i] = s.nrows
+        dt = DeviceTable(
+            {},
+            {},
+            jax.device_put(xmin, sharding),
+            jax.device_put(xmax, sharding),
+            nrows,
+            rmax,
+            versions,
+            nodes,
+            {},
+            {},
+            [
+                {
+                    "nrows": s.nrows,
+                    "structure": s.structure_version,
+                    "mvcc_seq": s.mvcc_seq,
+                }
+                for s in stores
+            ],
+        )
+        self._ensure_columns(dt, stores, meta, want)
+        self._tables[(name, nodes)] = dt
+        return dt
+
+    def _ensure_columns(self, dt: DeviceTable, stores, meta, want) -> None:
+        """Upload any of ``want`` not yet device-resident (current store
+        state — callers hold the exec lock, so data matches dt.sync)."""
+        S = _pad_shards(len(stores), self.mesh.shape["dn"])
+        sharding = NamedSharding(self.mesh, P("dn"))
+        for cname in want:
+            if cname in dt.columns:
+                continue
+            ty = meta.schema[cname]
+            stack = np.zeros((S, dt.rmax), dtype=ty.np_dtype)
             vstack = None
             for i, s in enumerate(stores):
                 stack[i, : s.nrows] = s.column_array(cname)
                 vm = s._validity.get(cname)
                 if vm is not None:
                     if vstack is None:
-                        vstack = np.ones((S, rmax), dtype=np.bool_)
+                        vstack = np.ones((S, dt.rmax), dtype=np.bool_)
                     vstack[i, : s.nrows] = vm[: s.nrows]
             if np.issubdtype(stack.dtype, np.integer):
                 # stats over REAL rows only: the zero padding would
@@ -154,58 +198,35 @@ class DeviceCache:
                     lo = rlo if lo is None else min(lo, rlo)
                     hi = rhi if hi is None else max(hi, rhi)
                     ma = max(ma or 0.0, float(max(abs(rlo), abs(rhi))))
-                col_maxabs[cname] = ma if ma is not None else 0.0
-                col_range[cname] = None if lo is None else (lo, hi)
+                dt.col_maxabs[cname] = ma if ma is not None else 0.0
+                dt.col_range[cname] = None if lo is None else (lo, hi)
             else:
-                col_maxabs[cname] = None
-                col_range[cname] = None
-            columns[cname] = jax.device_put(stack, sharding)
-            validity[cname] = (
+                dt.col_maxabs[cname] = None
+                dt.col_range[cname] = None
+            dt.columns[cname] = jax.device_put(stack, sharding)
+            dt.validity[cname] = (
                 None if vstack is None else jax.device_put(vstack, sharding)
             )
-        xmin = np.full((S, rmax), 2**62, dtype=np.int64)
-        xmax = np.zeros((S, rmax), dtype=np.int64)
-        nrows = np.zeros(S, dtype=np.int64)
-        for i, s in enumerate(stores):
-            xmin[i, : s.nrows] = s.xmin_ts[: s.nrows]
-            xmax[i, : s.nrows] = s.xmax_ts[: s.nrows]
-            nrows[i] = s.nrows
-        dt = DeviceTable(
-            columns,
-            validity,
-            jax.device_put(xmin, sharding),
-            jax.device_put(xmax, sharding),
-            nrows,
-            rmax,
-            versions,
-            nodes,
-            col_maxabs,
-            col_range,
-            [
-                {
-                    "nrows": s.nrows,
-                    "structure": s.structure_version,
-                    "mvcc_seq": s.mvcc_seq,
-                }
-                for s in stores
-            ],
-        )
-        self._tables[(name, nodes)] = dt
-        return dt
+            self.stats["column_uploads"] = (
+                self.stats.get("column_uploads", 0) + 1
+            )
 
     def _try_delta(
         self, dt: DeviceTable, stores, meta, versions
     ) -> Optional[DeviceTable]:
         """Refresh ``dt`` in place with append-tail uploads + MVCC stamp
-        replay. Returns None when only a full rebuild is sound."""
-        if set(meta.schema) != set(dt.columns):
+        replay (device-RESIDENT columns only; absent columns upload lazily
+        with current data). Returns None when only a full rebuild is
+        sound."""
+        present = list(dt.columns)
+        if not set(present) <= set(meta.schema):
             return None
         for s, sy in zip(stores, dt.sync):
             if s.structure_version != sy["structure"]:
                 return None
             if s.nrows > dt.rmax or s.nrows < sy["nrows"]:
                 return None
-            for cname in meta.schema:
+            for cname in present:
                 has_dev = dt.validity[cname] is not None
                 if s._validity.get(cname) is not None and not has_dev:
                     return None  # first NULL appeared: mask must materialize
@@ -215,7 +236,7 @@ class DeviceCache:
             old_n, new_n = sy["nrows"], s.nrows
             if new_n > old_n:
                 delta_rows += new_n - old_n
-                for cname in meta.schema:
+                for cname in present:
                     tail = np.ascontiguousarray(s._cols[cname][old_n:new_n])
                     dt.columns[cname] = (
                         dt.columns[cname].at[i, old_n:new_n].set(tail)
@@ -398,7 +419,9 @@ class FusedExecutor:
         for n in frag.nodes:
             if m.scan.table not in self.node_stores.get(n, {}):
                 return None
-        dtab = self.cache.get(m.scan.table, meta, self.node_stores)
+        dtab = self.cache.get(
+            m.scan.table, meta, self.node_stores, columns=m.scan.columns
+        )
 
         if use_pallas:
             out = self._try_pallas(m, dtab, snapshot_ts)

@@ -115,7 +115,9 @@ def _leaf_arrays(fx, node, exchanged: dict, D: int):
         for n in nodes:
             if node.table not in fx.node_stores.get(n, {}):
                 raise DagUnsupported("missing store")
-        dtab = fx.cache.get(node.table, meta, fx.node_stores, nodes)
+        dtab = fx.cache.get(
+            node.table, meta, fx.node_stores, nodes, columns=node.columns
+        )
         if len(dtab.nrows) % D != 0:
             raise DagUnsupported("shards not divisible by mesh")
         valids = tuple(dtab.validity[c] for c in node.columns)
@@ -136,6 +138,28 @@ def _collect_arrays(fx, root, exchanged: dict, D: int) -> list:
     ]
 
 
+def _static_width(node, arrays_by_leaf: dict) -> int:
+    """Per-device output row bound of a fragment root, from leaf shapes:
+    joins emit at most their probe side's width, filters/projects never
+    grow. On a 1-device mesh this bounds the exchange capacity exactly,
+    letting the counting pass be skipped (one compile + round trip)."""
+    if isinstance(node, (L.Filter, L.Project, L.Aggregate)):
+        return _static_width(node.child, arrays_by_leaf)
+    if isinstance(node, L.Join):
+        lw = _static_width(node.left, arrays_by_leaf)
+        if node.join_type in ("semi", "anti"):
+            return lw
+        return max(lw, _static_width(node.right, arrays_by_leaf))
+    blk = arrays_by_leaf[id(node)]
+    if isinstance(node, L.Scan):
+        _cols, _valids, xmin, _xmax, _nrows = blk
+        s_pad, rmax = xmin.shape
+        return s_pad * rmax  # conservative: counts the whole stack
+    cols, _valids, counts = blk
+    dd, cap = cols[0].shape
+    return dd * cap
+
+
 class _Builder:
     def __init__(self, fx, comp: ExprCompiler, orientation: tuple, root):
         self.fx = fx
@@ -150,7 +174,8 @@ class _Builder:
     def _leaf_scan(self, node: L.Scan, D: int) -> Callable:
         meta = self.fx.catalog.get(node.table)
         dtab = self.fx.cache.get(
-            node.table, meta, self.fx.node_stores, _scan_nodes(meta)
+            node.table, meta, self.fx.node_stores, _scan_nodes(meta),
+            columns=node.columns,
         )
         has_valid = tuple(
             dtab.validity[c] is not None for c in node.columns
@@ -483,7 +508,49 @@ class DagRunner:
 
         arrays = _collect_arrays(self.fx, frag.root, exchanged, D)
         sig = self._shapes_sig(arrays)
+        static_cap = None
+        if D == 1:
+            # single-device mesh: every routed row lands on this device,
+            # so the input width BOUNDS the bucket — skipping the count
+            # pass saves a compile + round trip. Only worth it for small
+            # fragments: the bound ignores filter selectivity, and every
+            # consumer program then runs at this width (a selective scan
+            # over a big table must keep the counted cap).
+            by_leaf = {
+                id(n): a
+                for n, a in zip(_walk_leaves(frag.root), arrays)
+            }
+            width = _static_width(frag.root, by_leaf)
+            if width <= (1 << 20):
+                static_cap = filt_ops.bucket_size(max(width, 1))
         while True:
+            if static_cap is not None:
+                cap = static_cap
+                xkey = ("xchg", skey, orientation, hashpos, D, cap, sig)
+                cached = self._programs.get(xkey)
+                if cached is None:
+                    cached = self._compile_exchange(
+                        frag.root, exchanged, orientation, hashpos, D, cap
+                    )
+                    self._programs[xkey] = cached
+                prog, comp = cached
+                params = self._resolve(comp, dicts_view, subquery_values)
+                cols, valids, rcounts, flags = prog(
+                    tuple(arrays), params, snap
+                )
+                flags = [np.asarray(f) for f in flags]
+                flip = _first_true(flags)
+                if flip is not None:
+                    orientation = self._flip(orientation, flip)
+                    continue
+                self._orientations[skey] = orientation
+                return {
+                    "cols": cols,
+                    "valids": valids,
+                    "counts": rcounts,
+                    "cap": cap,
+                    "schema": frag.root.schema,
+                }
             # pass 1: per-(src, dest) routed-row counts -> bucket size.
             # Skipped entirely (one round trip saved) when this exact
             # program + literal values already sized itself against

@@ -1,0 +1,88 @@
+"""SCRAM-SHA-256 authentication for the coordinator wire.
+
+The reference authenticates backends in src/backend/libpq/auth.c
+(CheckSCRAMAuth / scram-common.c). This is the same construction: the
+server stores only a salted verifier (StoredKey/ServerKey — never the
+password), the wire carries a salted challenge-response proof, and both
+sides verify each other:
+
+  client -> {"op": "auth", "user": u, "client_nonce": cn}
+  server -> {"salt": hex, "iterations": i, "nonce": cn + sn}
+  client -> {"op": "proof", "proof": hex(ClientKey XOR ClientSig)}
+  server -> {"ok": true, "server_sig": hex}   (client verifies)
+
+AuthMessage := "user,client_nonce,combined_nonce,salt_hex".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+
+ITERATIONS = 4096
+
+
+def _xor(a: bytes, b: bytes) -> bytes:
+    return bytes(x ^ y for x, y in zip(a, b))
+
+
+def _salted(password: str, salt: bytes, iterations: int) -> bytes:
+    return hashlib.pbkdf2_hmac(
+        "sha256", password.encode(), salt, iterations
+    )
+
+
+def _hmac(key: bytes, msg: bytes) -> bytes:
+    return hmac.new(key, msg, hashlib.sha256).digest()
+
+
+def build_verifier(password: str, iterations: int = ITERATIONS) -> dict:
+    """Server-side stored credentials (pg_authid.rolpassword analog).
+    Contains no recoverable password."""
+    salt = os.urandom(16)
+    sp = _salted(password, salt, iterations)
+    client_key = _hmac(sp, b"Client Key")
+    server_key = _hmac(sp, b"Server Key")
+    return {
+        "salt": salt.hex(),
+        "iterations": iterations,
+        "stored_key": hashlib.sha256(client_key).hexdigest(),
+        "server_key": server_key.hex(),
+    }
+
+
+def auth_message(user: str, client_nonce: str, nonce: str, salt_hex: str) -> bytes:
+    return f"{user},{client_nonce},{nonce},{salt_hex}".encode()
+
+
+def client_proof(
+    password: str, salt_hex: str, iterations: int, authmsg: bytes
+) -> str:
+    sp = _salted(password, bytes.fromhex(salt_hex), iterations)
+    client_key = _hmac(sp, b"Client Key")
+    stored_key = hashlib.sha256(client_key).digest()
+    sig = _hmac(stored_key, authmsg)
+    return _xor(client_key, sig).hex()
+
+
+def verify_proof(verifier: dict, proof_hex: str, authmsg: bytes) -> bool:
+    sig = _hmac(bytes.fromhex(verifier["stored_key"]), authmsg)
+    client_key = _xor(bytes.fromhex(proof_hex), sig)
+    return hmac.compare_digest(
+        hashlib.sha256(client_key).hexdigest(), verifier["stored_key"]
+    )
+
+
+def server_signature(verifier: dict, authmsg: bytes) -> str:
+    return _hmac(bytes.fromhex(verifier["server_key"]), authmsg).hex()
+
+
+def verify_server(
+    password: str, salt_hex: str, iterations: int, authmsg: bytes,
+    server_sig_hex: str,
+) -> bool:
+    sp = _salted(password, bytes.fromhex(salt_hex), iterations)
+    server_key = _hmac(sp, b"Server Key")
+    want = _hmac(server_key, authmsg).hex()
+    return hmac.compare_digest(want, server_sig_hex)

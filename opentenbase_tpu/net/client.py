@@ -28,10 +28,57 @@ class WireResult:
     rowcount: int = 0
 
 
+class AuthError(WireError):
+    """Authentication handshake failure (incl. a server that fails to
+    prove knowledge of the stored verifier — MITM defense)."""
+
+
 class ClientSession:
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 30.0,
+        user: str | None = None,
+        password: str | None = None,
+    ):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if user is not None:
+            self._authenticate(user, password or "")
+
+    def _authenticate(self, user: str, password: str) -> None:
+        """Client half of the SCRAM flow (net/auth.py): prove the
+        password without sending it, then verify the server's
+        signature."""
+        import secrets
+
+        from opentenbase_tpu.net import auth as sa
+
+        client_nonce = secrets.token_hex(16)
+        send_frame(self._sock, {
+            "op": "auth", "user": user, "client_nonce": client_nonce,
+        })
+        chal = recv_frame(self._sock)
+        if chal is None or not all(
+            k in chal for k in ("salt", "nonce", "iterations")
+        ):
+            raise AuthError("malformed auth challenge")
+        authmsg = sa.auth_message(
+            user, client_nonce, chal["nonce"], chal["salt"]
+        )
+        proof = sa.client_proof(
+            password, chal["salt"], int(chal["iterations"]), authmsg
+        )
+        send_frame(self._sock, {"op": "proof", "proof": proof})
+        fin = recv_frame(self._sock)
+        if fin is None or "error" in (fin or {}):
+            raise AuthError((fin or {}).get("error", "connection closed"))
+        if not sa.verify_server(
+            password, chal["salt"], int(chal["iterations"]), authmsg,
+            str(fin.get("server_sig", "")),
+        ):
+            raise AuthError("server failed to prove identity")
 
     def execute(self, sql: str) -> WireResult:
         send_frame(self._sock, {"q": sql})

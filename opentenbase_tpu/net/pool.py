@@ -1,0 +1,118 @@
+"""Connection pool for coordinator -> datanode channels.
+
+The reference runs a dedicated pooler process per postmaster handing
+pooled libpq connections to backends (PoolManagerGetConnections,
+src/backend/pgxc/pool/poolmgr.c:1831; wire protocol in poolcomm.c).
+Here the pool is an in-process object with the same contract: acquire a
+warm framed-RPC channel to a datanode (opening lazily up to ``size``),
+release it back, discard broken ones, and answer pooler-stat queries.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from opentenbase_tpu.net.protocol import recv_frame, send_frame
+
+
+class Channel:
+    """One persistent framed connection (a pooled libpq slot)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.broken = False
+
+    def rpc(self, msg: dict) -> dict:
+        try:
+            send_frame(self.sock, msg)
+            resp = recv_frame(self.sock)
+        except OSError as e:
+            self.broken = True
+            raise ChannelError(f"channel I/O failed: {e}") from e
+        if resp is None:
+            self.broken = True
+            raise ChannelError("channel closed by peer")
+        if "error" in resp:
+            raise ChannelError(resp["error"])
+        return resp
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ChannelError(RuntimeError):
+    pass
+
+
+class ChannelPool:
+    """Bounded pool of channels to ONE datanode."""
+
+    def __init__(self, host: str, port: int, size: int = 4):
+        self.host, self.port, self.size = host, port, size
+        self._idle: list[Channel] = []
+        self._lock = threading.Lock()
+        self._total = 0
+        self._cv = threading.Condition(self._lock)
+        self._closed = False
+        self.stats = {"acquired": 0, "opened": 0, "discarded": 0}
+
+    def acquire(self, timeout: float = 30.0) -> Channel:
+        with self._cv:
+            while True:
+                if self._closed:
+                    raise ChannelError("pool closed")
+                if self._idle:
+                    ch = self._idle.pop()
+                    self.stats["acquired"] += 1
+                    return ch
+                if self._total < self.size:
+                    self._total += 1
+                    break
+                if not self._cv.wait(timeout):
+                    raise ChannelError("pool exhausted")
+        try:
+            ch = Channel(self.host, self.port)
+        except OSError as e:
+            with self._cv:
+                self._total -= 1
+                self._cv.notify()
+            raise ChannelError(f"connect failed: {e}") from e
+        self.stats["opened"] += 1
+        self.stats["acquired"] += 1
+        return ch
+
+    def release(self, ch: Channel) -> None:
+        with self._cv:
+            if ch.broken or self._closed:
+                self._total -= 1
+                self.stats["discarded"] += 1
+                ch.close()
+            else:
+                self._idle.append(ch)
+            self._cv.notify()
+
+    def rpc(self, msg: dict) -> dict:
+        """Acquire -> call -> release convenience."""
+        ch = self.acquire()
+        try:
+            return ch.rpc(msg)
+        finally:
+            self.release(ch)
+
+    def close(self) -> None:
+        """Close idle channels and refuse new acquires; in-flight
+        channels are closed as they release (the _closed flag keeps
+        _total accounting consistent)."""
+        with self._cv:
+            self._closed = True
+            for ch in self._idle:
+                ch.close()
+            self._total -= len(self._idle)
+            self._idle.clear()
+            self._cv.notify_all()

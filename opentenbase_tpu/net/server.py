@@ -84,6 +84,10 @@ class ClusterServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         session = self.cluster.session()
+        # trust mode only while no users exist (pg_hba 'trust' vs
+        # 'scram-sha-256'); once any role is created, the handshake is
+        # mandatory before the first statement
+        authed = not self.cluster.users
         try:
             while not self._stop.is_set():
                 msg = recv_frame(conn)
@@ -92,6 +96,15 @@ class ClusterServer:
                 if msg.get("op") == "close":
                     send_frame(conn, {"ok": True})
                     break
+                if msg.get("op") == "auth":
+                    authed = self._scram_exchange(conn, msg)
+                    continue
+                if not authed:
+                    send_frame(
+                        conn,
+                        {"error": "AuthError: authentication required"},
+                    )
+                    continue
                 sql = msg.get("q")
                 if sql is None:
                     send_frame(conn, {"error": "malformed request"})
@@ -113,13 +126,78 @@ class ClusterServer:
         finally:
             # abort any transaction left open by a dropped connection
             # (the backend-exit cleanup of the reference's tcop loop)
-            if session.txn is not None:
-                try:
-                    with self._exec_lock:
-                        session.execute("rollback")
-                except Exception:
-                    pass
+            self._conn_cleanup(session, conn)
+
+    def _scram_exchange(self, conn: socket.socket, msg: dict) -> bool:
+        """Server half of the SCRAM flow (net/auth.py). Returns True
+        when the client proved knowledge of the password. A fake salt
+        is served for unknown users so the flow does not leak which
+        roles exist (auth.c's mock authentication)."""
+        import hashlib
+        import secrets
+
+        from opentenbase_tpu.net import auth as sa
+
+        user = str(msg.get("user", ""))
+        client_nonce = str(msg.get("client_nonce", ""))
+        verifier = self.cluster.users.get(user)
+        if verifier is None:
+            # fake salt must be stable per user but NOT publicly
+            # computable, or comparing it against sha256(user) would
+            # reveal which roles exist — key it with a per-cluster secret
+            import hmac as _hmac
+            import os as _os
+
+            secret = getattr(self.cluster, "_mock_salt_secret", None)
+            if secret is None:
+                secret = _os.urandom(16)
+                self.cluster._mock_salt_secret = secret
+            fake_salt = _hmac.new(
+                secret, user.encode(), hashlib.sha256
+            ).hexdigest()[:32]
+            verifier = {
+                "salt": fake_salt,
+                "iterations": sa.ITERATIONS,
+                "stored_key": "00" * 32,
+                "server_key": "00" * 32,
+            }
+        nonce = client_nonce + secrets.token_hex(16)
+        send_frame(conn, {
+            "salt": verifier["salt"],
+            "iterations": verifier["iterations"],
+            "nonce": nonce,
+        })
+        reply = recv_frame(conn)
+        if reply is None or reply.get("op") != "proof":
+            send_frame(conn, {"error": "AuthError: handshake aborted"})
+            return False
+        authmsg = sa.auth_message(
+            user, client_nonce, nonce, verifier["salt"]
+        )
+        # the all-zero fake verifier can never validate, so the check is
+        # uniform for real and unknown users (no early-exit timing tell)
+        if sa.verify_proof(
+            verifier, str(reply.get("proof", "")), authmsg
+        ):
+            send_frame(conn, {
+                "ok": True,
+                "server_sig": sa.server_signature(verifier, authmsg),
+            })
+            return True
+        send_frame(
+            conn,
+            {"error": f'AuthError: authentication failed for "{user}"'},
+        )
+        return False
+
+    def _conn_cleanup(self, session, conn) -> None:
+        if session.txn is not None:
             try:
-                conn.close()
-            except OSError:
+                with self._exec_lock:
+                    session.execute("rollback")
+            except Exception:
                 pass
+        try:
+            conn.close()
+        except OSError:
+            pass

@@ -400,6 +400,19 @@ class TruncateTable(Statement):
 
 
 @dataclass
+class CreateUser(Statement):
+    name: str
+    password: str
+    alter: bool = False  # ALTER USER ... PASSWORD
+
+
+@dataclass
+class DropUser(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class CreateIndex(Statement):
     name: str
     table: str

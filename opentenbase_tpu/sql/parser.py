@@ -541,6 +541,11 @@ class Parser:
                 cols.append(self.ident("column"))
             self.expect_op(")")
             return A.CreateIndex(name, table, cols, unique)
+        if self.eat_kw("user") or self.eat_kw("role"):
+            name = self.ident("user name")
+            self.eat_kw("with")
+            self.expect_kw("password")
+            return A.CreateUser(name, self._string_lit())
         if self.eat_kw("node"):
             if self.eat_kw("group"):
                 name = self.ident("group name")
@@ -824,6 +829,11 @@ class Parser:
             return A.AlterNode(name, options)
         if self.eat_kw("table"):
             return self._alter_table()
+        if self.eat_kw("user") or self.eat_kw("role"):
+            name = self.ident("user name")
+            self.eat_kw("with")
+            self.expect_kw("password")
+            return A.CreateUser(name, self._string_lit(), alter=True)
         self.error("unsupported ALTER")
 
     def _create_view(self, replace: bool) -> A.Statement:
@@ -882,6 +892,9 @@ class Parser:
             if self.eat_kw("group"):
                 return A.DropNodeGroup(self.ident("group name"))
             return A.DropNode(self.ident("node name"))
+        if self.eat_kw("user") or self.eat_kw("role"):
+            if_exists = bool(self.eat_kw("if", "exists"))
+            return A.DropUser(self.ident("user name"), if_exists)
         if self.eat_kw("sequence"):
             if_exists = bool(self.eat_kw("if", "exists"))
             return A.DropSequence(self.ident("sequence name"), if_exists)

@@ -331,6 +331,7 @@ class ClusterPersistence:
                 name: ps.spec for name, ps in c.partitions.items()
             },
             "views": {name: text for name, (_q, text) in c.views.items()},
+            "users": c.users,
         }
         for name in c.catalog.table_names():
             tm = c.catalog.get(name)
@@ -537,6 +538,7 @@ class ClusterPersistence:
                 self._dict_synced[f"{name}.{col}"] = len(d)
 
     def _restore_checkpoint(self, meta: dict) -> None:
+        self.cluster.users.update(meta.get("users", {}))
         import numpy as np
 
         from opentenbase_tpu.catalog.distribution import (
@@ -692,6 +694,10 @@ class ClusterPersistence:
                 if c.catalog.has(header["name"]):
                     c.catalog.drop_table(header["name"])
                     c.drop_table_stores(header["name"])
+            elif op == "create_user":
+                c.users[header["name"]] = header["verifier"]
+            elif op == "drop_user":
+                c.users.pop(header["name"], None)
             elif op == "create_index":
                 if c.catalog.has(header["table"]):
                     meta = c.catalog.get(header["table"])

@@ -1,0 +1,81 @@
+"""Wire authentication: SCRAM handshake on the coordinator front end.
+Trust mode only while no roles exist; afterwards unauthenticated
+connections are rejected, wrong passwords fail, the right password
+works, and credentials survive recovery."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.net.client import AuthError, ClientSession, WireError
+from opentenbase_tpu.net.server import ClusterServer
+
+
+@pytest.fixture()
+def served():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute("create table t (a bigint) distribute by shard(a)")
+    s.execute("insert into t values (1), (2)")
+    srv = ClusterServer(c).start()
+    yield c, srv
+    srv.stop()
+
+
+def test_trust_mode_without_users(served):
+    c, srv = served
+    cs = ClientSession(srv.host, srv.port)
+    assert cs.query("select count(*) from t") == [(1 * 2,)]
+    cs.close()
+
+
+def test_auth_required_once_user_exists(served):
+    c, srv = served
+    c.session().execute("create user alice password 's3cret'")
+    cs = ClientSession(srv.host, srv.port)
+    with pytest.raises(WireError, match="authentication required"):
+        cs.query("select 1")
+    cs.close()
+
+
+def test_wrong_password_rejected(served):
+    c, srv = served
+    c.session().execute("create user alice password 's3cret'")
+    with pytest.raises(AuthError, match="authentication failed"):
+        ClientSession(srv.host, srv.port, user="alice", password="nope")
+    with pytest.raises(AuthError):
+        ClientSession(srv.host, srv.port, user="mallory", password="x")
+
+
+def test_scram_roundtrip_and_alter(served):
+    c, srv = served
+    c.session().execute("create user alice password 's3cret'")
+    cs = ClientSession(srv.host, srv.port, user="alice", password="s3cret")
+    assert cs.query("select count(*) from t") == [(2,)]
+    cs.close()
+    c.session().execute("alter user alice password 'new'")
+    with pytest.raises(AuthError):
+        ClientSession(srv.host, srv.port, user="alice", password="s3cret")
+    cs = ClientSession(srv.host, srv.port, user="alice", password="new")
+    assert cs.query("select 1") == [(1,)]
+    cs.close()
+    c.session().execute("drop user alice")
+    cs = ClientSession(srv.host, srv.port)  # back to trust
+    assert cs.query("select 1") == [(1,)]
+    cs.close()
+
+
+def test_users_survive_recovery(tmp_path):
+    d = str(tmp_path / "data")
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=d)
+    c.session().execute("create user bob password 'pw'")
+    c.close()
+    c2 = Cluster.recover(d, 2, 16)
+    assert "bob" in c2.users
+    srv = ClusterServer(c2).start()
+    try:
+        cs = ClientSession(srv.host, srv.port, user="bob", password="pw")
+        assert cs.query("select 1") == [(1,)]
+        cs.close()
+    finally:
+        srv.stop()
+    c2.close()
