@@ -33,6 +33,9 @@ class TableMeta:
     # columns with zone maps (CREATE INDEX builds BRIN-style block
     # min/max summaries; scans prune blocks against them)
     zone_cols: set = field(default_factory=set)
+    # foreign-table spec (server + options) — scans materialize via
+    # fdw.foreign_store instead of shard stores (src/backend/foreign)
+    foreign: dict | None = None
 
     @property
     def column_names(self) -> list[str]:

@@ -1,0 +1,218 @@
+"""Logical dump/restore — the pg_dump / pg_restore analog (src/bin/pg_dump).
+
+Produces one self-contained SQL script: table DDL (with distribution,
+constraints), data as batched multi-row INSERTs, then views and indexes
+(dependency order: data before views, indexes last like pg_dump's
+post-data section). Restoring = executing the script through any
+session (in-process or wire), so the dump is also a portable migration
+path between clusters.
+
+    python -m opentenbase_tpu.cli.otb_dump --data-dir D --out dump.sql
+    python -m opentenbase_tpu.cli.otb_dump --data-dir D --restore dump.sql
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import decimal
+
+BATCH = 500  # rows per INSERT statement
+
+
+def _lit(v) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float, decimal.Decimal)):
+        return str(v)
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return f"'{v.isoformat()}'"
+    s = str(v).replace("'", "''")
+    return f"'{s}'"
+
+
+def _dist_clause(meta) -> str:
+    from opentenbase_tpu.catalog.distribution import DistStrategy
+
+    d = meta.dist
+    if d.strategy == DistStrategy.REPLICATED:
+        return "distribute by replication"
+    if d.strategy == DistStrategy.ROUNDROBIN:
+        return "distribute by roundrobin"
+    keys = ", ".join(d.key_columns)
+    name = {
+        DistStrategy.HASH: "hash",
+        DistStrategy.MODULO: "modulo",
+        DistStrategy.SHARD: "shard",
+        DistStrategy.RANGE: "range",
+    }[d.strategy]
+    return f"distribute by {name}({keys})"
+
+
+def _foreign_ddl(meta) -> str:
+    opts = {k: v for k, v in meta.foreign.items() if k != "server"}
+    optlist = ", ".join(f"{k} '{v}'" for k, v in opts.items())
+    cols = ", ".join(f"{n} {ty}" for n, ty in meta.schema.items())
+    return (
+        f"create foreign table {meta.name} ({cols}) "
+        f"server {meta.foreign.get('server', 'file')} "
+        f"options ({optlist});"
+    )
+
+
+def _partition_clause(pspec) -> str:
+    c = pspec.spec
+    step = c.get("step")
+    unit = c.get("step_unit")
+    step_txt = f"{step} {unit}" if unit else f"{step}"
+    return (
+        f" partition by range ({pspec.column}) begin ('{c.get('begin')}') "
+        f"step ({step_txt}) partitions ({pspec.nparts})"
+    )
+
+
+def _table_ddl(meta, pspec=None) -> str:
+    cols = []
+    not_null = getattr(meta, "not_null", set()) or set()
+    defaults = getattr(meta, "defaults", {}) or {}
+    pk = getattr(meta, "primary_key", None)
+    for name, ty in meta.schema.items():
+        piece = f"{name} {ty}"
+        if name in not_null:
+            piece += " not null"
+        if name in defaults:
+            piece += f" default {defaults[name]}"
+        if pk == name:
+            piece += " primary key"
+        cols.append(piece)
+    part = _partition_clause(pspec) if pspec is not None else ""
+    return (
+        f"create table {meta.name} ({', '.join(cols)}) "
+        f"{_dist_clause(meta)}{part};"
+    )
+
+
+def dump_sql(cluster) -> str:
+    """The whole cluster as one SQL script."""
+    s = cluster.session()
+    out: list[str] = [
+        "-- opentenbase_tpu dump",
+        "-- restore by executing this script against an empty cluster",
+    ]
+    view_names = set(cluster.views)
+    parts = set()
+    for spec in cluster.partitions.values():
+        children = getattr(spec, "children", None)
+        if callable(children):
+            parts.update(children())
+    for name in cluster.catalog.table_names():
+        if name in view_names or name in parts:
+            continue
+        if name.startswith("pg_") or name.startswith("pgxc_"):
+            continue  # system views materialize on demand
+        meta = cluster.catalog.get(name)
+        out.append("")
+        if meta.foreign is not None:
+            out.append(_foreign_ddl(meta))
+            continue  # external data stays external (pg_dump behavior)
+        out.append(_table_ddl(meta, cluster.partitions.get(name)))
+        collist = ", ".join(meta.schema.keys())
+        rows = s.query(f"select {collist} from {name}")
+        for i in range(0, len(rows), BATCH):
+            chunk = rows[i : i + BATCH]
+            values = ",\n  ".join(
+                "(" + ", ".join(_lit(v) for v in r) + ")" for r in chunk
+            )
+            out.append(f"insert into {name} ({collist}) values\n  {values};")
+    for name, (_ast, text) in cluster.views.items():
+        out.append("")
+        out.append(f"create view {name} as {text};")
+    for iname, stmt in cluster.indexes.items():
+        cols = ", ".join(stmt.columns)
+        uniq = "unique " if getattr(stmt, "unique", False) else ""
+        out.append(
+            f"create {uniq}index {iname} on {stmt.table} ({cols});"
+        )
+    out.append("")
+    return "\n".join(out)
+
+
+def restore_sql(session, script: str) -> int:
+    """Execute a dump script statement by statement; returns the number
+    of statements applied."""
+    from opentenbase_tpu.sql.parser import parse
+
+    n = 0
+    for stmt_text in _split_statements(script):
+        if not stmt_text.strip():
+            continue
+        session.execute(stmt_text)
+        n += 1
+    return n
+
+
+def _split_statements(script: str):
+    """Split on top-level semicolons (respecting quoted strings) so one
+    oversized script streams through the parser statement-wise."""
+    buf: list[str] = []
+    in_str = False
+    for line in script.splitlines():
+        if line.startswith("--"):
+            continue
+        i = 0
+        while i < len(line):
+            ch = line[i]
+            if ch == "'":
+                # handle '' escapes inside strings
+                if in_str and i + 1 < len(line) and line[i + 1] == "'":
+                    buf.append("''")
+                    i += 2
+                    continue
+                in_str = not in_str
+            if ch == ";" and not in_str:
+                yield "".join(buf)
+                buf = []
+                i += 1
+                continue
+            buf.append(ch)
+            i += 1
+        buf.append("\n")
+    tail = "".join(buf)
+    if tail.strip():
+        yield tail
+
+
+def main(argv=None) -> int:
+    from opentenbase_tpu.engine import Cluster
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--num-datanodes", type=int, default=2)
+    ap.add_argument("--shard-groups", type=int, default=256)
+    ap.add_argument("--out")
+    ap.add_argument("--restore")
+    args = ap.parse_args(argv)
+    if args.restore:
+        c = Cluster(args.num_datanodes, args.shard_groups, args.data_dir)
+        with open(args.restore) as f:
+            n = restore_sql(c.session(), f.read())
+        c.close()
+        print(f"restored {n} statements")
+        return 0
+    c = Cluster.recover(
+        args.data_dir, args.num_datanodes, args.shard_groups
+    )
+    text = dump_sql(c)
+    c.close()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

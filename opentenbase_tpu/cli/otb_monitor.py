@@ -1,0 +1,84 @@
+"""Node liveness prober — the pgxc_monitor / clustermon analog.
+
+Probes each configured endpoint with its own protocol (coordinator wire
+'select 1', GTS PING opcode, DN process ping) and reports per-node
+liveness — the monitoring loop contrib/pgxc_monitor runs over libpq and
+the GTM API.
+
+    python -m opentenbase_tpu.cli.otb_monitor --cn HOST:PORT \
+        --gts HOST:PORT --dn HOST:PORT [--dn HOST:PORT ...]
+
+Exit code 0 when every probed node is alive, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def probe_cn(host: str, port: int, user=None, password=None) -> bool:
+    from opentenbase_tpu.net.client import ClientSession
+
+    try:
+        cs = ClientSession(host, port, timeout=5, user=user, password=password)
+        ok = cs.query("select 1") == [(1,)]
+        cs.close()
+        return ok
+    except Exception:
+        return False
+
+
+def probe_gts(host: str, port: int) -> bool:
+    from opentenbase_tpu.gtm.client import NativeGTS
+
+    try:
+        gts = NativeGTS(host, port)
+        ok = gts.ping()
+        gts.close()
+        return bool(ok)
+    except Exception:
+        return False
+
+
+def probe_dn(host: str, port: int) -> bool:
+    from opentenbase_tpu.net.pool import Channel
+
+    try:
+        ch = Channel(host, port, timeout=5)
+        resp = ch.rpc({"op": "ping"})
+        ch.close()
+        return bool(resp.get("ok"))
+    except Exception:
+        return False
+
+
+def _hostport(s: str) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cn", action="append", default=[])
+    ap.add_argument("--gts", action="append", default=[])
+    ap.add_argument("--dn", action="append", default=[])
+    ap.add_argument("--user")
+    ap.add_argument("--password")
+    args = ap.parse_args(argv)
+    ok = True
+    for role, targets, probe in (
+        ("coordinator", args.cn,
+         lambda h, p: probe_cn(h, p, args.user, args.password)),
+        ("gts", args.gts, probe_gts),
+        ("datanode", args.dn, probe_dn),
+    ):
+        for target in targets:
+            h, p = _hostport(target)
+            alive = probe(h, p)
+            ok = ok and alive
+            print(f"{role} {h}:{p}: {'running' if alive else 'NOT running'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

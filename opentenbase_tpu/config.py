@@ -1,0 +1,146 @@
+"""GUC registry + configuration file — the guc.c machinery.
+
+The reference defines every setting with a type, default, and validator
+in src/backend/utils/misc/guc.c (14k LoC of tables) and reads
+postgresql.conf at startup. Here the registry is a declarative dict;
+``SET`` validates against it (unknown names error unless namespaced with
+a dot, PG's custom-variable rule), and a cluster reads
+``<data_dir>/opentenbase.conf`` (``key = value`` lines, ``#`` comments)
+into its session defaults.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class GucError(ValueError):
+    pass
+
+
+def _bool(v):
+    if isinstance(v, bool):
+        return v
+    s = str(v).lower()
+    if s in ("true", "on", "yes", "1"):
+        return True
+    if s in ("false", "off", "no", "0"):
+        return False
+    raise GucError(f"invalid boolean: {v!r}")
+
+
+def _int(v):
+    if isinstance(v, bool):
+        raise GucError(f"invalid integer: {v!r}")
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise GucError(f"invalid integer: {v!r}") from None
+
+
+def _str(v):
+    return str(v)
+
+
+_DURATION_UNITS = {"us": 0.001, "ms": 1, "s": 1000, "min": 60000, "h": 3600000}
+
+
+def _duration(v):
+    """int milliseconds, or a PG duration string ('150ms', '2s')."""
+    if isinstance(v, bool):
+        raise GucError(f"invalid duration: {v!r}")
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip()
+    for unit, mult in sorted(
+        _DURATION_UNITS.items(), key=lambda kv: -len(kv[0])
+    ):
+        if s.endswith(unit):
+            num = s[: -len(unit)].strip()
+            try:
+                return int(float(num) * mult)
+            except ValueError:
+                break
+    try:
+        return int(s)
+    except ValueError:
+        raise GucError(f"invalid duration: {v!r}") from None
+
+
+def _enum(*allowed):
+    def f(v):
+        s = str(v).lower()
+        if s not in allowed:
+            raise GucError(f"must be one of {allowed}, got {v!r}")
+        return s
+
+    return f
+
+
+# name -> (validator, default). Defaults mirror the engine's historical
+# behavior; None means "engine decides" (e.g. backend-dependent).
+GUCS: dict = {
+    "enable_fused_execution": (_bool, True),
+    "enable_pallas_scan": (_bool, None),
+    "enable_fast_query_shipping": (_bool, True),
+    "lock_timeout": (_duration, 0),
+    "deadlock_timeout": (_duration, 1000),
+    "statement_timeout": (_duration, 0),
+    "work_mem": (_int, 65536),
+    "search_path": (_str, "public"),
+    "session_authorization": (_str, None),
+    "role": (_str, None),
+    "application_name": (_str, ""),
+    "client_min_messages": (
+        _enum("debug", "log", "notice", "warning", "error"), "notice",
+    ),
+    "autovacuum": (_bool, False),
+    "autovacuum_naptime_s": (_int, 60),
+    "autovacuum_scale_factor_pct": (_int, 20),
+}
+
+
+def validate(name: str, value):
+    """Validated value for SET; unknown names must be namespaced
+    ('ext.knob'), PG's custom-variable-class rule."""
+    entry = GUCS.get(name)
+    if entry is None:
+        if "." not in name:
+            raise GucError(f'unrecognized configuration parameter "{name}"')
+        return value
+    fn, _default = entry
+    return fn(value)
+
+
+def defaults() -> dict:
+    return {
+        name: default
+        for name, (_fn, default) in GUCS.items()
+        if default is not None
+    }
+
+
+def load_conf(data_dir: Optional[str]) -> dict:
+    """Read <data_dir>/opentenbase.conf (the postgresql.conf analog):
+    ``name = value`` per line, '#' comments, validated on load."""
+    out: dict = {}
+    if not data_dir:
+        return out
+    path = os.path.join(data_dir, "opentenbase.conf")
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if "=" not in line:
+                raise GucError(
+                    f"{path}:{lineno}: expected name = value, got {raw!r}"
+                )
+            name, _, value = line.partition("=")
+            name = name.strip()
+            value = value.strip().strip("'\"")
+            out[name] = validate(name, value)
+    return out

@@ -241,6 +241,19 @@ class Cluster:
         # node has channels, its read fragments ship to the DN server
         # process (dn/server.py) instead of executing in-process.
         self.dn_channels: dict[int, object] = {}
+        # conf-file overrides applied to every session's GUC defaults
+        # (config.py reads <data_dir>/opentenbase.conf)
+        from opentenbase_tpu import config as _config
+
+        self.conf_gucs: dict = _config.load_conf(data_dir)
+        self._autovacuum_stop = None
+        if self.conf_gucs.get("autovacuum"):
+            self._autovacuum_stop = self.start_autovacuum(
+                interval_s=self.conf_gucs.get("autovacuum_naptime_s", 60),
+                scale_pct=self.conf_gucs.get(
+                    "autovacuum_scale_factor_pct", 20
+                ),
+            )
         # interval/range partitioning: parent name -> PartitionSpec
         # (children are real catalog tables named parent$pK)
         self.partitions: dict[str, "PartitionSpec"] = {}
@@ -523,6 +536,55 @@ class Cluster:
             pass
         return resolved
 
+    def start_autovacuum(
+        self, interval_s: float = 60.0, scale_pct: int = 20
+    ):
+        """Background vacuum daemon (src/backend/postmaster/autovacuum.c):
+        wakes every naptime, vacuums tables whose dead-row fraction
+        exceeds the scale factor. Returns a stop() callable."""
+        import threading as _threading
+
+        stop = _threading.Event()
+
+        def dead_fraction(name) -> float:
+            meta = self.catalog.get(name)
+            snap = self.gts.snapshot_ts()
+            total = dead = 0
+            for n in meta.node_indices:
+                store = self.stores.get(n, {}).get(name)
+                if store is None or store.nrows == 0:
+                    continue
+                total += store.nrows
+                # only rows DELETED before every snapshot are vacuumable;
+                # pending (uncommitted) inserts must not look dead or a
+                # bulk load would trigger vacuum storms
+                dead += int(
+                    (store.xmax_ts[: store.nrows] <= snap).sum()
+                )
+            return dead / total if total else 0.0
+
+        def loop() -> None:
+            while not stop.wait(interval_s):
+                try:
+                    s = self.session()
+                    for name in self.catalog.table_names():
+                        if self.catalog.get(name).foreign is not None:
+                            continue
+                        if dead_fraction(name) * 100 >= scale_pct:
+                            with self._exec_lock:
+                                s.execute(f"vacuum {name}")
+                except Exception:
+                    pass
+
+        t = _threading.Thread(target=loop, daemon=True)
+        t.start()
+
+        def stopper() -> None:
+            stop.set()
+            t.join(timeout=5)
+
+        return stopper
+
     def start_clean2pc(
         self, interval_s: float = 60.0, max_age_s: float = 300.0
     ):
@@ -551,6 +613,9 @@ class Cluster:
     def close(self) -> None:
         """Release external resources: the native GTS subprocess (if any)
         and the WAL file handle. Idempotent."""
+        if self._autovacuum_stop is not None:
+            self._autovacuum_stop()
+            self._autovacuum_stop = None
         close_gts = getattr(self.gts, "close", None)
         if close_gts is not None:
             close_gts()
@@ -584,7 +649,13 @@ class Session:
     def __init__(self, cluster: Cluster, user: str = "otb"):
         self.cluster = cluster
         self.txn: Optional[Transaction] = None
-        self.gucs: dict[str, object] = {}
+        # registry defaults, overlaid with the cluster's conf-file
+        # settings (config.py — the guc.c + postgresql.conf machinery)
+        from opentenbase_tpu import config as _config
+
+        self.gucs: dict[str, object] = {
+            **_config.defaults(), **cluster.conf_gucs
+        }
         self.user = user
         self._in_audit = False
         self.session_id = Session._next_id
@@ -663,20 +734,15 @@ class Session:
     # -- row/table locking (lmgr.py) -------------------------------------
     @staticmethod
     def _duration_ms(val, name: str) -> int:
-        """GUC duration: integer milliseconds or a PG unit suffix."""
-        if isinstance(val, (int, float)):
-            return int(val)
-        s = str(val).strip().lower()
-        for suffix, mult in (("ms", 1), ("min", 60000), ("s", 1000)):
-            if s.endswith(suffix):
-                s = s[: -len(suffix)].strip()
-                break
-        else:
-            mult = 1
+        """GUC duration — delegates to the one parser in config.py."""
+        from opentenbase_tpu import config as _config
+
         try:
-            return int(float(s) * mult)
-        except ValueError:
-            raise SQLError(f'invalid value for parameter "{name}": {val!r}')
+            return _config._duration(val)
+        except _config.GucError:
+            raise SQLError(
+                f'invalid value for parameter "{name}": {val!r}'
+            ) from None
 
     def _lock_opts(self) -> dict:
         return {
@@ -2007,6 +2073,10 @@ class Session:
         iplan = splan.root
         assert isinstance(iplan, L.InsertPlan)
         meta = self.cluster.catalog.get(iplan.table)
+        if meta.foreign is not None:
+            raise SQLError(
+                f'cannot change foreign table "{meta.name}"'
+            )
         src_batch = self._run_statement_plan(
             L.StatementPlan(iplan.source, splan.subplans)
         )
@@ -2161,6 +2231,10 @@ class Session:
         dplan = splan.root
         assert isinstance(dplan, L.DeletePlan)
         meta = self.cluster.catalog.get(dplan.table)
+        if meta.foreign is not None:
+            raise SQLError(
+                f'cannot change foreign table "{meta.name}"'
+            )
         txn, implicit = self._begin_implicit()
         subq = self._subquery_values(splan)
         total = 0
@@ -2201,6 +2275,10 @@ class Session:
         uplan = splan.root
         assert isinstance(uplan, L.UpdatePlan)
         meta = self.cluster.catalog.get(uplan.table)
+        if meta.foreign is not None:
+            raise SQLError(
+                f'cannot change foreign table "{meta.name}"'
+            )
         txn, implicit = self._begin_implicit()
         subq = self._subquery_values(splan)
         assigned = dict(uplan.assignments)
@@ -2428,6 +2506,31 @@ class Session:
         return Result("ROLLBACK PREPARED")
 
     # -- DDL: tables -----------------------------------------------------
+    def _x_createforeigntable(self, stmt: A.CreateForeignTable) -> Result:
+        """Foreign tables (src/backend/foreign, contrib/file_fdw): a
+        catalog entry whose scan materializes from an external source
+        (fdw.py) — no shard stores."""
+        cat = self.cluster.catalog
+        if cat.has(stmt.name):
+            raise SQLError(f'relation "{stmt.name}" already exists')
+        schema: dict[str, t.SqlType] = {}
+        for cd in stmt.columns:
+            schema[cd.name] = t.type_from_name(cd.type_name, cd.type_args)
+        dist = DistributionSpec(DistStrategy.REPLICATED)
+        meta = cat.create_table(stmt.name, schema, dist)
+        meta.node_indices = meta.node_indices[:1]  # scan runs on one node
+        meta.foreign = dict(stmt.options)
+        meta.foreign["server"] = stmt.server
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl({
+                "op": "create_foreign_table",
+                "name": stmt.name,
+                "schema": {k: str(v) for k, v in schema.items()},
+                "server": stmt.server,
+                "options": dict(stmt.options),
+            })
+        return Result("CREATE FOREIGN TABLE")
+
     def _x_createtable(self, stmt: A.CreateTable) -> Result:
         cat = self.cluster.catalog
         if stmt.name in _SYSTEM_VIEWS:
@@ -3120,6 +3223,8 @@ class Session:
         return Result("EXPLAIN", rows, ["QUERY PLAN"], len(rows))
 
     def _x_setstmt(self, stmt: A.SetStmt) -> Result:
+        from opentenbase_tpu import config as _config
+
         # normalize boolean/int GUC spellings (guc.c's parse_bool analog)
         v = stmt.value
         if isinstance(v, str):
@@ -3130,6 +3235,10 @@ class Session:
                 v = False
             elif low.lstrip("-").isdigit():
                 v = int(low)
+        try:
+            v = _config.validate(stmt.name, v)
+        except _config.GucError as e:
+            raise SQLError(str(e)) from None
         if stmt.name in ("session_authorization", "role"):
             # audited statements carry the effective user (pg_audit's
             # db_user dimension)
@@ -3138,6 +3247,11 @@ class Session:
         return Result("SET")
 
     def _x_showstmt(self, stmt: A.ShowStmt) -> Result:
+        if stmt.name == "all":
+            rows = sorted(
+                (k, str(v)) for k, v in self.gucs.items()
+            )
+            return Result("SHOW", rows, ["name", "setting"], len(rows))
         v = self.gucs.get(stmt.name)
         return Result("SHOW", [(v,)], [stmt.name], 1)
 
@@ -3259,6 +3373,8 @@ class Session:
     # -- COPY ------------------------------------------------------------
     def _x_copystmt(self, stmt: A.CopyStmt) -> Result:
         meta = self.cluster.catalog.get(stmt.table)
+        if meta.foreign is not None and stmt.direction == "from":
+            raise SQLError(f'cannot change foreign table "{meta.name}"')
         columns = stmt.columns or list(meta.schema.keys())
         if stmt.direction == "to":
             from opentenbase_tpu.plan.partition import rewrite_select

@@ -205,7 +205,9 @@ class LocalExecutor:
         """``row_idx``: optional physical row subset (zone-map pruning).
         Callers passing it must have ruled out own-write overlays, whose
         references are positional over the full store."""
-        store = self.stores.get(plan.table)
+        store = self._foreign_store(plan.table)
+        if store is None:
+            store = self.stores.get(plan.table)
         if store is None:
             raise ExecError(f"no shard for table {plan.table} on this node")
         nrows = store.nrows if row_idx is None else len(row_idx)
@@ -288,6 +290,18 @@ class LocalExecutor:
         keep = jnp.broadcast_to(keep, (child.n,))
         mask = keep if child.mask is None else (child.mask & keep)
         return DevBatch(plan.schema, child.cols, mask, child.n)
+
+    def _foreign_store(self, table: str):
+        """Foreign tables materialize at scan time (fdw.py)."""
+        try:
+            meta = self.catalog.get(table)
+        except Exception:
+            return None
+        if getattr(meta, "foreign", None) is None:
+            return None
+        from opentenbase_tpu.fdw import foreign_store
+
+        return foreign_store(meta)
 
     # -- zone-map block pruning (BRIN-style, CREATE INDEX builds maps) --
     def _eval_scan_pruned(

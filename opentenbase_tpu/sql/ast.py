@@ -400,6 +400,14 @@ class TruncateTable(Statement):
 
 
 @dataclass
+class CreateForeignTable(Statement):
+    name: str
+    columns: list["ColumnDef"]
+    server: str
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
 class CreateUser(Statement):
     name: str
     password: str

@@ -198,7 +198,10 @@ class Parser:
             return self.parse_set()
         if kw == "show":
             self.advance()
-            return A.ShowStmt(self.ident("setting name"))
+            name = self.ident("setting name")
+            while self.eat_op("."):  # namespaced custom GUCs
+                name += "." + self.ident("setting name")
+            return A.ShowStmt(name)
         if kw == "alter":
             return self.parse_alter()
         if kw == "move":
@@ -541,6 +544,23 @@ class Parser:
                 cols.append(self.ident("column"))
             self.expect_op(")")
             return A.CreateIndex(name, table, cols, unique)
+        if self.eat_kw("foreign", "table"):
+            name = self.ident("table name")
+            self.expect_op("(")
+            columns = [self._column_def()]
+            while self.eat_op(","):
+                columns.append(self._column_def())
+            self.expect_op(")")
+            self.expect_kw("server")
+            server = self.ident("server name")
+            options: dict = {}
+            if self.eat_kw("options"):
+                self.expect_op("(")
+                while not self.eat_op(")"):
+                    key = self.ident("option")
+                    options[key] = self._string_lit()
+                    self.eat_op(",")
+            return A.CreateForeignTable(name, columns, server, options)
         if self.eat_kw("user") or self.eat_kw("role"):
             name = self.ident("user name")
             self.eat_kw("with")
@@ -1019,6 +1039,8 @@ class Parser:
         self.expect_kw("set")
         self.eat_kw("local") or self.eat_kw("session")
         name = self.ident("setting name")
+        while self.eat_op("."):  # namespaced custom GUCs (ext.knob)
+            name += "." + self.ident("setting name")
         if not (self.eat_op("=") or self.eat_kw("to")):
             self.error("expected = or TO")
         if self.cur.kind == Tok.STRING:

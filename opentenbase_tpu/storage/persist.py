@@ -349,6 +349,7 @@ class ClusterPersistence:
                     "primary_key": getattr(tm, "primary_key", None),
                 },
                 "zone_cols": sorted(tm.zone_cols),
+                "foreign": tm.foreign,
             }
             for node in tm.node_indices:
                 store = c.stores[node].get(name)
@@ -574,6 +575,10 @@ class ClusterPersistence:
             tm = c.catalog.get(name)
             _apply_constraints_meta(tm, tmeta.get("constraints", {}))
             tm.zone_cols.update(tmeta.get("zone_cols", []))
+            if tmeta.get("foreign"):
+                tm.foreign = dict(tmeta["foreign"])
+                tm.node_indices = tm.node_indices[:1]
+                continue  # no shard stores: scans materialize via fdw
             tm.node_indices = list(tmeta["nodes"])
             for col, values in tmeta["dictionaries"].items():
                 tm.dictionaries[col] = Dictionary(values)
@@ -694,6 +699,23 @@ class ClusterPersistence:
                 if c.catalog.has(header["name"]):
                     c.catalog.drop_table(header["name"])
                     c.drop_table_stores(header["name"])
+            elif op == "create_foreign_table":
+                if not c.catalog.has(header["name"]):
+                    from opentenbase_tpu.catalog.distribution import (
+                        DistributionSpec as _DS,
+                        DistStrategy as _St,
+                    )
+
+                    schema = {
+                        k: _type_from_str(v)
+                        for k, v in header["schema"].items()
+                    }
+                    meta = c.catalog.create_table(
+                        header["name"], schema, _DS(_St.REPLICATED)
+                    )
+                    meta.node_indices = meta.node_indices[:1]
+                    meta.foreign = dict(header["options"])
+                    meta.foreign["server"] = header["server"]
             elif op == "create_user":
                 c.users[header["name"]] = header["verifier"]
             elif op == "drop_user":

@@ -1,0 +1,257 @@
+"""Component long tail: dump/restore, foreign tables (file_fdw), the GUC
+registry + conf file, the autovacuum daemon, and the liveness prober."""
+
+import time
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+
+
+# -- dump / restore ---------------------------------------------------------
+
+
+def test_dump_restore_roundtrip(tmp_path):
+    from opentenbase_tpu.cli.otb_dump import dump_sql, restore_sql
+
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table people (id bigint not null, name text, "
+        "balance numeric(12,2), born date) distribute by shard(id)"
+    )
+    s.execute(
+        "insert into people values "
+        "(1, 'ann', 10.50, '1990-01-02'), "
+        "(2, null, -3.25, null), "
+        "(3, 'bob''s', 0.00, '2000-12-31')"
+    )
+    s.execute("create view rich as select * from people where balance > 0")
+    s.execute("create index people_id on people (id)")
+    script = dump_sql(c)
+    assert "create table people" in script
+    assert "bob''s" in script
+
+    c2 = Cluster(num_datanodes=2, shard_groups=16)
+    s2 = c2.session()
+    n = restore_sql(s2, script)
+    assert n >= 4
+    q = "select id, name, balance, born from people order by id"
+    assert s2.query(q) == s.query(q)
+    assert s2.query("select count(*) from rich") == [(1,)]
+    assert c2.catalog.get("people").zone_cols == {"id"}
+
+
+# -- foreign tables (file_fdw) ----------------------------------------------
+
+
+def test_foreign_table_scan_and_refresh(tmp_path):
+    path = tmp_path / "ext.csv"
+    path.write_text("id,name,score\n1,ann,2.5\n2,bob,\n")
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        f"create foreign table ext (id bigint, name text, "
+        f"score numeric(4,2)) server file options "
+        f"(filename '{path}', format 'csv', header 'true')"
+    )
+    assert s.query("select id, name, score from ext order by id") == [
+        (1, "ann", 2.5), (2, "bob", None),
+    ]
+    # joins against regular tables work
+    s.execute("create table loc (id bigint, city text) distribute by shard(id)")
+    s.execute("insert into loc values (1, 'rome'), (2, 'oslo')")
+    got = s.query(
+        "select ext.name, loc.city from ext, loc "
+        "where ext.id = loc.id order by ext.id"
+    )
+    assert got == [("ann", "rome"), ("bob", "oslo")]
+    # file change is picked up (mtime-keyed cache)
+    time.sleep(0.01)
+    path.write_text("id,name,score\n7,zed,1.0\n")
+    assert s.query("select id from ext") == [(7,)]
+
+
+def test_foreign_table_survives_recovery(tmp_path):
+    path = tmp_path / "f.csv"
+    path.write_text("1\n2\n")
+    d = str(tmp_path / "data")
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=d)
+    c.session().execute(
+        f"create foreign table f (v bigint) server file "
+        f"options (filename '{path}')"
+    )
+    c.close()
+    c2 = Cluster.recover(d, 2, 16)
+    assert c2.session().query("select sum(v) from f") == [(3,)]
+    c2.close()
+
+
+# -- GUC registry + conf file ----------------------------------------------
+
+
+def test_set_validates_against_registry():
+    from opentenbase_tpu.engine import SQLError
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute("set enable_fused_execution = off")
+    assert s.gucs["enable_fused_execution"] is False
+    with pytest.raises(SQLError, match="unrecognized configuration"):
+        s.execute("set no_such_knob = 1")
+    with pytest.raises(SQLError, match="invalid duration"):
+        s.execute("set lock_timeout = 'soon'")
+    s.execute("set myext.knob = 'x'")  # namespaced customs allowed
+    rows = s.query("show all")
+    assert any(r[0] == "work_mem" for r in rows)
+
+
+def test_conf_file_sets_session_defaults(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    (d / "opentenbase.conf").write_text(
+        "# comment\nwork_mem = 1234\nenable_fused_execution = off\n"
+    )
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=str(d))
+    s = c.session()
+    assert s.gucs["work_mem"] == 1234
+    assert s.gucs["enable_fused_execution"] is False
+    c.close()
+
+
+def test_bad_conf_rejected(tmp_path):
+    from opentenbase_tpu.config import GucError, load_conf
+
+    d = tmp_path / "data"
+    d.mkdir()
+    (d / "opentenbase.conf").write_text("work_mem = lots\n")
+    with pytest.raises(GucError):
+        load_conf(str(d))
+
+
+# -- autovacuum -------------------------------------------------------------
+
+
+def test_autovacuum_reclaims_dead_rows():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute("create table av (k bigint) distribute by shard(k)")
+    s.execute("insert into av values " + ",".join(
+        f"({i})" for i in range(100)))
+    s.execute("delete from av where k < 90")
+    before = sum(
+        st["av"].nrows for st in c.stores.values() if "av" in st
+    )
+    assert before == 100
+    stop = c.start_autovacuum(interval_s=0.05, scale_pct=20)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            left = sum(
+                st["av"].nrows for st in c.stores.values() if "av" in st
+            )
+            if left <= 10:
+                break
+            time.sleep(0.05)
+        assert left <= 10, "autovacuum never reclaimed dead rows"
+        assert s.query("select count(*) from av") == [(10,)]
+    finally:
+        stop()
+
+
+# -- liveness prober --------------------------------------------------------
+
+
+def test_monitor_probes():
+    from opentenbase_tpu.cli import otb_monitor
+    from opentenbase_tpu.net.server import ClusterServer
+
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    srv = ClusterServer(c).start()
+    try:
+        assert otb_monitor.probe_cn(srv.host, srv.port)
+        assert not otb_monitor.probe_cn("127.0.0.1", 1)  # nothing there
+        assert otb_monitor.main(
+            ["--cn", f"{srv.host}:{srv.port}"]
+        ) == 0
+    finally:
+        srv.stop()
+
+
+def test_foreign_table_survives_checkpoint(tmp_path):
+    path = tmp_path / "c.csv"
+    path.write_text("5\n6\n")
+    d = str(tmp_path / "data")
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=d)
+    c.session().execute(
+        f"create foreign table cf (v bigint) server file "
+        f"options (filename '{path}')"
+    )
+    c.persistence.checkpoint()
+    c.close()
+    c2 = Cluster.recover(d, 2, 16)
+    assert c2.catalog.get("cf").foreign is not None
+    assert c2.session().query("select sum(v) from cf") == [(11,)]
+    c2.close()
+
+
+def test_dml_on_foreign_table_rejected(tmp_path):
+    from opentenbase_tpu.engine import SQLError
+
+    path = tmp_path / "d.csv"
+    path.write_text("1\n")
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        f"create foreign table df (v bigint) server file "
+        f"options (filename '{path}')"
+    )
+    for sql in (
+        "insert into df values (9)",
+        "update df set v = 9",
+        "delete from df",
+    ):
+        with pytest.raises(SQLError, match="cannot change foreign table"):
+            s.execute(sql)
+
+
+def test_dump_partitioned_and_foreign(tmp_path):
+    from opentenbase_tpu.cli.otb_dump import dump_sql, restore_sql
+
+    path = tmp_path / "p.csv"
+    path.write_text("1\n")
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table events (ts date, v bigint) distribute by shard(v) "
+        "partition by range (ts) begin ('2024-01-01') "
+        "step (1 month) partitions (3)"
+    )
+    s.execute("insert into events values ('2024-02-10', 7)")
+    s.execute(
+        f"create foreign table pf (v bigint) server file "
+        f"options (filename '{path}')"
+    )
+    script = dump_sql(c)
+    assert "partition by range" in script
+    assert "create foreign table pf" in script
+    c2 = Cluster(num_datanodes=2, shard_groups=16)
+    restore_sql(c2.session(), script)
+    assert c2.session().query("select v from events") == [(7,)]
+    assert c2.session().query("select v from pf") == [(1,)]
+
+
+def test_show_namespaced_guc():
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute("set myext.knob = 'abc'")
+    assert s.query("show myext.knob") == [("abc",)]
+
+
+def test_close_stops_autovacuum(tmp_path):
+    d = tmp_path / "data"
+    d.mkdir()
+    (d / "opentenbase.conf").write_text(
+        "autovacuum = on\nautovacuum_naptime_s = 1\n"
+    )
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=str(d))
+    assert c._autovacuum_stop is not None
+    c.close()
+    assert c._autovacuum_stop is None
