@@ -106,7 +106,7 @@ class DNServer:
             return self._exec_fragment(msg)
         return {"error": f"unknown op {op}"}
 
-    def _wait_applied(self, lsn: int, timeout_s: float = 30.0) -> bool:
+    def _wait_applied(self, lsn: int, timeout_s: float = 90.0) -> bool:
         t0 = time.time()
         while time.time() - t0 < timeout_s:
             if self.standby.applied >= lsn:

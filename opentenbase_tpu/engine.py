@@ -221,7 +221,12 @@ class Cluster:
         # a time; the net server and standby WAL-apply serialize on it
         import threading as _threading
 
-        self._exec_lock = _threading.RLock()
+        from opentenbase_tpu.utils.rwlock import RWStatementLock
+
+        self._exec_lock = RWStatementLock()
+        # serializes fused-executor (device) access among concurrent
+        # readers: program/device caches are shared mutable state
+        self._fused_lock = _threading.RLock()
         self.locks = LockManager(self)
         from opentenbase_tpu.audit import AuditManager
 
@@ -312,14 +317,22 @@ class Cluster:
 
     def fused_executor(self):
         """Lazily built FusedExecutor over the default device mesh (the
-        real TPU under axon; virtual CPU devices elsewhere)."""
+        real TPU under axon; virtual CPU devices elsewhere). Constructed
+        under the fused lock: concurrent readers must share ONE
+        program/device cache."""
         if self._fused is None and not self._fused_failed:
-            try:
-                from opentenbase_tpu.executor.fused import FusedExecutor
+            with self._fused_lock:
+                if self._fused is None and not self._fused_failed:
+                    try:
+                        from opentenbase_tpu.executor.fused import (
+                            FusedExecutor,
+                        )
 
-                self._fused = FusedExecutor(self.catalog, self.stores)
-            except Exception:
-                self._fused_failed = True
+                        self._fused = FusedExecutor(
+                            self.catalog, self.stores
+                        )
+                    except Exception:
+                        self._fused_failed = True
         return self._fused
 
     # -- table lifecycle -------------------------------------------------
@@ -2002,6 +2015,8 @@ class Session:
             return None
         from opentenbase_tpu.executor.fused import FusedUnsupported
 
+        fused_gate = self.cluster._fused_lock
+
         # pallas single-pass kernel: default-on on real TPU backends,
         # opt-in elsewhere (interpret mode is for tests, not speed)
         import jax as _jax
@@ -2012,24 +2027,25 @@ class Session:
         out = None
         final_idx = 0
         try:
-            if len(dplan.fragments) == 1:
-                out = fx.fragment_output(
-                    dplan.fragments[0],
-                    snapshot,
-                    self._dicts_view(),
-                    [],
-                    use_pallas=bool(use_pallas),
-                )
-            if out is None:
-                # multi-fragment (join) plans — and single-fragment
-                # shapes the scan path rejected — go to the fused DAG
-                # runner (executor/fused_dag.py)
-                res = fx.dag_output(
-                    dplan, snapshot, self._dicts_view(), []
-                )
-                if res is None:
-                    return None
-                final_idx, out = res
+            with fused_gate:
+                if len(dplan.fragments) == 1:
+                    out = fx.fragment_output(
+                        dplan.fragments[0],
+                        snapshot,
+                        self._dicts_view(),
+                        [],
+                        use_pallas=bool(use_pallas),
+                    )
+                if out is None:
+                    # multi-fragment (join) plans — and single-fragment
+                    # shapes the scan path rejected — go to the fused
+                    # DAG runner (executor/fused_dag.py)
+                    res = fx.dag_output(
+                        dplan, snapshot, self._dicts_view(), []
+                    )
+                    if res is None:
+                        return None
+                    final_idx, out = res
         except FusedUnsupported:
             return None
         except Exception:

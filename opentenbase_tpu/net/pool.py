@@ -53,8 +53,12 @@ class ChannelError(RuntimeError):
 class ChannelPool:
     """Bounded pool of channels to ONE datanode."""
 
-    def __init__(self, host: str, port: int, size: int = 4):
+    def __init__(
+        self, host: str, port: int, size: int = 4,
+        rpc_timeout: float = 120.0,
+    ):
         self.host, self.port, self.size = host, port, size
+        self.rpc_timeout = rpc_timeout
         self._idle: list[Channel] = []
         self._lock = threading.Lock()
         self._total = 0
@@ -77,7 +81,7 @@ class ChannelPool:
                 if not self._cv.wait(timeout):
                     raise ChannelError("pool exhausted")
         try:
-            ch = Channel(self.host, self.port)
+            ch = Channel(self.host, self.port, timeout=self.rpc_timeout)
         except OSError as e:
             with self._cv:
                 self._total -= 1

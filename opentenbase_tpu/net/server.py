@@ -23,6 +23,22 @@ from typing import Optional
 from opentenbase_tpu.net.protocol import recv_frame, send_frame
 
 
+def _walk_ast(node):
+    """Generic AST walk over dataclass fields (expressions only)."""
+    import dataclasses
+
+    yield node
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, (list, tuple)):
+                for x in v:
+                    if dataclasses.is_dataclass(x):
+                        yield from _walk_ast(x)
+            elif dataclasses.is_dataclass(v):
+                yield from _walk_ast(v)
+
+
 class ClusterServer:
     def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0):
         self.cluster = cluster
@@ -110,8 +126,17 @@ class ClusterServer:
                     send_frame(conn, {"error": "malformed request"})
                     continue
                 try:
-                    with self._exec_lock:
-                        res = session.execute(sql)
+                    # read-only statements share the data plane (MVCC
+                    # snapshots isolate them from each other); writes,
+                    # DDL, and anything uncertain take it exclusively —
+                    # the statement-level analog of the reference's
+                    # lock-free MVCC readers
+                    if self._is_readonly(sql, session):
+                        with self._exec_lock.read():
+                            res = session.execute(sql)
+                    else:
+                        with self._exec_lock:
+                            res = session.execute(sql)
                     send_frame(
                         conn,
                         {
@@ -127,6 +152,45 @@ class ClusterServer:
             # abort any transaction left open by a dropped connection
             # (the backend-exit cleanup of the reference's tcop loop)
             self._conn_cleanup(session, conn)
+
+    def _is_readonly(self, sql: str, session) -> bool:
+        """True only when the statement provably reads: a single plain
+        SELECT (no FOR UPDATE) outside a transaction, referencing no
+        system view (their refresh materializes tables), no view (whose
+        expansion could), and calling no state-mutating function
+        (sequence ops, pg_clean/pg_unlock/audit admin). Parse errors
+        classify exclusive and surface from the normal execution path."""
+        if session.txn is not None:
+            return False
+        try:
+            from opentenbase_tpu.engine import _SYSTEM_VIEWS
+            from opentenbase_tpu.sql import ast as A
+            from opentenbase_tpu.sql.parser import parse
+
+            stmts = parse(sql)
+            if len(stmts) != 1 or not isinstance(stmts[0], A.Select):
+                return False
+            sel = stmts[0]
+            if sel.for_update is not None:
+                return False
+            refs: set = set()
+            session._referenced_tables(sel, refs)
+            if refs & set(_SYSTEM_VIEWS):
+                return False
+            if refs & set(self.cluster.views):
+                return False
+            # FROM-less admin/sequence function calls mutate state
+            # (clean_2pc, deadlock victims, FGA policies, nextval)
+            mutating = set(session._ADMIN_FUNCS) | set(session._SEQ_FUNCS)
+            for item in sel.items:
+                for node in _walk_ast(item.expr):
+                    if isinstance(node, A.FuncCall) and (
+                        node.name in mutating
+                    ):
+                        return False
+            return True
+        except Exception:
+            return False
 
     def _scram_exchange(self, conn: socket.socket, msg: dict) -> bool:
         """Server half of the SCRAM flow (net/auth.py). Returns True

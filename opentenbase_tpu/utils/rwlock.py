@@ -1,0 +1,70 @@
+"""Reader-writer statement lock for the coordinator.
+
+The reference gets statement concurrency from per-buffer/tuple locking +
+MVCC; the columnar engine instead classifies statements: read-only
+statements share the data plane (MVCC snapshots isolate them), while
+writes/DDL take it exclusively. The exclusive side mimics
+``threading.RLock`` (acquire/release/_is_owned, reentrant, context
+manager) because the lock-manager wait loop (lmgr.py) releases and
+re-acquires it around parks — existing exclusive users are unchanged.
+
+Writer preference: once a writer is waiting, new readers queue behind it
+(readers enter through the writer mutex), so writers cannot starve.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+
+class RWStatementLock:
+    def __init__(self):
+        self._w = threading.RLock()
+        self._cond = threading.Condition()
+        self._readers = 0
+        self.max_concurrent_readers = 0  # observability / tests
+
+    # -- exclusive (RLock-compatible surface) ----------------------------
+    def acquire(self) -> bool:
+        self._w.acquire()
+        with self._cond:
+            while self._readers > 0:
+                self._cond.wait()
+        return True
+
+    def release(self) -> None:
+        self._w.release()
+
+    def _is_owned(self) -> bool:
+        return self._w._is_owned()
+
+    def __enter__(self) -> "RWStatementLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # -- shared -----------------------------------------------------------
+    @contextmanager
+    def read(self):
+        """Shared access: concurrent with other readers, excluded by any
+        exclusive holder (entry passes through the writer mutex, which
+        also gives writers preference over queued readers)."""
+        self._w.acquire()
+        try:
+            with self._cond:
+                self._readers += 1
+                self.max_concurrent_readers = max(
+                    self.max_concurrent_readers, self._readers
+                )
+        finally:
+            self._w.release()
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._readers -= 1
+                if self._readers == 0:
+                    self._cond.notify_all()
