@@ -367,44 +367,64 @@ def main():
     # comparability. The headline is already out, so a watchdog cut here
     # loses nothing.
     try:
-            q1_warm = s.query(Q1)  # compile
-            assert len(q1_warm) >= 1
-            _phase("q1 compiled", t_start)
-            q1_best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                s.query(Q1)
-                q1_best = min(q1_best, time.perf_counter() - t0)
-            q1_cpu = cpu_baseline_q1(arrays)
-            record["q1_rows_per_sec"] = round(ROWS / q1_best)
-            record["q1_vs_baseline"] = round(
-                (ROWS / q1_best) / (ROWS / q1_cpu), 3
-            )
-            _phase("q1 measured", t_start)
-            print(json.dumps(record), flush=True)
-        except Exception as e:  # Q1 must never break the headline
-            _phase(f"q1 failed: {e!r:.200}", t_start)
+        q1_warm = s.query(Q1)  # compile
+        assert len(q1_warm) >= 1
+        _phase("q1 compiled", t_start)
+        q1_best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            s.query(Q1)
+            q1_best = min(q1_best, time.perf_counter() - t0)
+        q1_cpu = cpu_baseline_q1(arrays)
+        record["q1_rows_per_sec"] = round(ROWS / q1_best)
+        record["q1_vs_baseline"] = round(
+            (ROWS / q1_best) / (ROWS / q1_cpu), 3
+        )
+        _phase("q1 measured", t_start)
+        print(json.dumps(record), flush=True)
+    except Exception as e:  # Q1 must never break the headline
+        _phase(f"q1 failed: {e!r:.200}", t_start)
 
     # Q3: the distributed-join path (fused DAG: all_to_all exchanges +
-    # sorted-lookup join + partial agg on device; BASELINE config 3)
+    # sorted-lookup join + partial agg on device; BASELINE config 3).
+    # Capped at 16M lineitem rows: the join exchanges materialize ~3x
+    # their payload and a 60M-row Q3 exhausts one v5e's HBM (the DAG
+    # guards with a budget and falls back, but the host fallback at 60M
+    # eats the whole watchdog budget for one number). Baseline and
+    # device run use the same capped data, so the ratio stays honest.
     try:
-            q3_warm = s.query(Q3)  # compile (several fragment programs)
-            assert len(q3_warm) >= 1
-            _phase("q3 compiled", t_start)
-            q3_best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                s.query(Q3)
-                q3_best = min(q3_best, time.perf_counter() - t0)
-            q3_cpu = cpu_baseline_q3(arrays, orders, customer)
-            record["q3_rows_per_sec"] = round(ROWS / q3_best)
-            record["q3_vs_baseline"] = round(
-                (ROWS / q3_best) / (ROWS / q3_cpu), 3
-            )
-            _phase("q3 measured", t_start)
-            print(json.dumps(record), flush=True)
-        except Exception as e:  # Q3 must never break the headline
-            _phase(f"q3 failed: {e!r:.200}", t_start)
+        q3_rows = min(ROWS, 16_000_000)
+        if q3_rows < ROWS:
+            # release the 60M-row residency (HBM via the fused cache,
+            # host RAM via the arrays + stores) before building the
+            # capped dataset — Q6/Q1 are already measured and printed
+            cluster._fused = None
+            cluster.stores.clear()
+            del arrays, orders, customer
+            arrays3 = make_lineitem(q3_rows)
+            orders3, customer3 = make_q3_dims(q3_rows)
+            s2 = load_cluster(arrays3, orders3, customer3).session()
+            s2.execute("analyze")
+        else:
+            arrays3, orders3, customer3, s2 = arrays, orders, customer, s
+        record["q3_rows"] = q3_rows
+        q3_warm = s2.query(Q3)  # compile (several fragment programs)
+        assert len(q3_warm) >= 1
+        _phase("q3 compiled", t_start)
+        q3_best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            s2.query(Q3)
+            q3_best = min(q3_best, time.perf_counter() - t0)
+        q3_cpu = cpu_baseline_q3(arrays3, orders3, customer3)
+        record["q3_rows_per_sec"] = round(q3_rows / q3_best)
+        record["q3_vs_baseline"] = round(
+            (q3_rows / q3_best) / (q3_rows / q3_cpu), 3
+        )
+        _phase("q3 measured", t_start)
+        print(json.dumps(record), flush=True)
+    except Exception as e:  # Q3 must never break the headline
+        _phase(f"q3 failed: {e!r:.200}", t_start)
 
 
 if __name__ == "__main__":

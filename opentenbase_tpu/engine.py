@@ -345,7 +345,8 @@ class Cluster:
             tabs.pop(name, None)
 
     def attach_datanode(
-        self, node: int, host: str, port: int, pool_size: int = 4
+        self, node: int, host: str, port: int, pool_size: int = 4,
+        rpc_timeout: float = 120.0,
     ) -> None:
         """Route node's fragments to a DN server process (dn/server.py)
         through a channel pool — CREATE NODE + pooler registration."""
@@ -354,7 +355,9 @@ class Cluster:
         old = self.dn_channels.get(node)
         if old is not None:
             old.close()
-        self.dn_channels[node] = ChannelPool(host, port, pool_size)
+        self.dn_channels[node] = ChannelPool(
+            host, port, pool_size, rpc_timeout=rpc_timeout
+        )
 
     def detach_datanode(self, node: int) -> None:
         pool = self.dn_channels.pop(node, None)

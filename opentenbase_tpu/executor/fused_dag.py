@@ -62,6 +62,16 @@ from opentenbase_tpu.utils.hashing import combine_hashes, hash32_jnp
 
 OPTIMISTIC_GROUP_CAP = 1 << 16
 
+import os
+
+# Exchange buffers materialize ~3x their payload (bucket scatter, the
+# all_to_all result, consumer copies). Beyond this budget the DAG bails
+# to the host path instead of crashing the TPU worker on HBM exhaustion
+# (observed at TPC-H SF10 Q3 on one 16GB v5e).
+EXCHANGE_HBM_BUDGET = int(
+    os.environ.get("OTB_EXCHANGE_HBM_BUDGET", 4_000_000_000)
+)
+
 
 class DagUnsupported(Exception):
     """Plan shape outside the fused DAG subset (silent host fallback)."""
@@ -492,6 +502,19 @@ class DagRunner:
             "L" if i == flip_idx else o for i, o in enumerate(orientation)
         )
 
+    def _check_hbm_budget(self, cap: int, schema, D: int) -> None:
+        """Bail to the host path before an exchange whose buffers would
+        exhaust device memory (a crashed TPU worker is unrecoverable
+        in-process; the host path is merely slower)."""
+        row_bytes = sum(
+            np.dtype(c.type.np_dtype).itemsize + 1 for c in schema
+        )
+        est = cap * (D + 1) * D * row_bytes * 3
+        if est > EXCHANGE_HBM_BUDGET:
+            raise DagUnsupported(
+                f"exchange needs ~{est >> 20} MiB (> budget)"
+            )
+
     # -- exchange (redistribute) fragments ---------------------------------
     def _run_exchange(
         self, frag, exchanged, snap, dicts_view, subquery_values, D,
@@ -526,6 +549,7 @@ class DagRunner:
         while True:
             if static_cap is not None:
                 cap = static_cap
+                self._check_hbm_budget(cap, frag.root.schema, D)
                 xkey = ("xchg", skey, orientation, hashpos, D, cap, sig)
                 cached = self._programs.get(xkey)
                 if cached is None:
@@ -581,6 +605,7 @@ class DagRunner:
                     max(int(np.asarray(counts).max()), 1)
                 )
                 self._cap_store(capkey, cap)
+            self._check_hbm_budget(cap, frag.root.schema, D)
 
             # pass 2: the bucketed all_to_all
             xkey = ("xchg", skey, orientation, hashpos, D, cap, sig)
@@ -646,6 +671,7 @@ class DagRunner:
                     max(int(np.asarray(counts).max()), 1)
                 )
                 self._cap_store(capkey, cap)
+            self._check_hbm_budget(cap, frag.root.schema, D)
 
             bkey = ("bcast", skey, orientation, D, cap, sig)
             cached = self._programs.get(bkey)

@@ -58,7 +58,9 @@ def topology(tmp_path):
             line = p.stdout.readline().strip()
             assert line.startswith("READY "), line
             port = int(line.split()[1])
-            c.attach_datanode(node, "127.0.0.1", port, pool_size=2)
+            c.attach_datanode(
+                node, "127.0.0.1", port, pool_size=2, rpc_timeout=300,
+            )
             procs.append(p)
         yield c, s
     finally:
