@@ -142,32 +142,75 @@ def _leaf_arrays(fx, node, exchanged: dict, D: int):
     return (ex["cols"], ex["valids"], ex["counts"])
 
 
+def _inline_sources(node, producers: dict):
+    """Substitute each RemoteSource with its producer fragment's root
+    (recursively: producers may consume earlier fragments). Only valid
+    when the motions are identities (1-device mesh)."""
+    import dataclasses
+
+    if isinstance(node, RemoteSource):
+        return _inline_sources(producers[node.fragment], producers)
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        changes = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, (L.LogicalPlan, RemoteSource)):
+                nv = _inline_sources(v, producers)
+                if nv is not v:
+                    changes[f.name] = nv
+            elif isinstance(v, tuple) and v and all(
+                isinstance(x, L.LogicalPlan) for x in v
+            ):
+                nv = tuple(_inline_sources(x, producers) for x in v)
+                if any(a is not b for a, b in zip(nv, v)):
+                    changes[f.name] = nv
+        if changes:
+            return dataclasses.replace(node, **changes)
+    return node
+
+
+def _pack_group_keys(keys, mask):
+    """Pack integer group keys into ONE int64 sort key using runtime
+    per-key ranges (data-dependent VALUES, not shapes — no recompile):
+    packed = sum((k_i - min_i) * stride_i), NULLs in a dedicated bucket.
+    Returns (packed, ok): when the combined range overflows int64, ok is
+    False and the caller retries with per-key sorting. Cuts the grouped
+    aggregation from one argsort per key part to a single argsort."""
+    stride = jnp.int64(1)
+    prod = jnp.float64(1.0)
+    ok = jnp.asarray(True)
+    packed = jnp.zeros(mask.shape[0], dtype=jnp.int64)
+    big = jnp.int64(2**62)
+    for d, v in keys:
+        live = mask if v is None else (mask & v)
+        d64 = d.astype(jnp.int64)
+        mn = jnp.min(jnp.where(live, d64, big))
+        mx = jnp.max(jnp.where(live, d64, -big))
+        mn = jnp.minimum(mn, mx)  # no live rows: degenerate range 1
+        # the range itself can overflow int64 (mx - mn wraps negative):
+        # guard in float64 BEFORE using the int64 value
+        rngf = (mx.astype(jnp.float64) - mn.astype(jnp.float64)) + 1.0
+        ok = ok & (rngf < jnp.float64(2**62))
+        rng = jnp.maximum(mx - mn + 1, 1)
+        if v is None:
+            x = d64 - mn
+            r = rng
+            rf = rngf
+        else:
+            x = jnp.where(v, d64 - mn, rng)  # NULL bucket past the range
+            r = rng + 1
+            rf = rngf + 1.0
+        packed = packed + x * stride  # dead rows may wrap: masked anyway
+        stride = stride * r
+        prod = prod * jnp.maximum(rf, 1.0)
+    ok = ok & (prod < jnp.float64(2**62))
+    return packed, ok
+
+
 def _collect_arrays(fx, root, exchanged: dict, D: int) -> list:
     return [
         _leaf_arrays(fx, n, exchanged, D) for n in _walk_leaves(root)
     ]
-
-
-def _static_width(node, arrays_by_leaf: dict) -> int:
-    """Per-device output row bound of a fragment root, from leaf shapes:
-    joins emit at most their probe side's width, filters/projects never
-    grow. On a 1-device mesh this bounds the exchange capacity exactly,
-    letting the counting pass be skipped (one compile + round trip)."""
-    if isinstance(node, (L.Filter, L.Project, L.Aggregate)):
-        return _static_width(node.child, arrays_by_leaf)
-    if isinstance(node, L.Join):
-        lw = _static_width(node.left, arrays_by_leaf)
-        if node.join_type in ("semi", "anti"):
-            return lw
-        return max(lw, _static_width(node.right, arrays_by_leaf))
-    blk = arrays_by_leaf[id(node)]
-    if isinstance(node, L.Scan):
-        _cols, _valids, xmin, _xmax, _nrows = blk
-        s_pad, rmax = xmin.shape
-        return s_pad * rmax  # conservative: counts the whole stack
-    cols, _valids, counts = blk
-    dd, cap = cols[0].shape
-    return dd * cap
 
 
 class _Builder:
@@ -421,16 +464,26 @@ class DagRunner:
 
         versions = self._data_versions(frags)
         exchanged: dict[int, dict] = {}
-        for f in frags[:-1]:
-            run = (
-                self._run_broadcast
-                if f.motion == "broadcast"
-                else self._run_exchange
+        if D == 1 and len(frags) > 1:
+            # single-device mesh: every exchange is an identity (all
+            # rows already live on the one device), so the whole DAG
+            # collapses into ONE program — RemoteSources inline to their
+            # producer fragments, eliminating the bucket sorts,
+            # inter-fragment buffers, and per-fragment compiles entirely
+            final_root = _inline_sources(
+                final_root, {f.index: f.root for f in frags[:-1]}
             )
-            exchanged[f.index] = run(
-                f, exchanged, snap, dicts_view, subquery_values, D,
-                versions,
-            )
+        else:
+            for f in frags[:-1]:
+                run = (
+                    self._run_broadcast
+                    if f.motion == "broadcast"
+                    else self._run_exchange
+                )
+                exchanged[f.index] = run(
+                    f, exchanged, snap, dicts_view, subquery_values, D,
+                    versions,
+                )
         batch = self._run_final(
             final, final_root, exchanged, snap, dicts_view,
             subquery_values, D, versions,
@@ -462,10 +515,7 @@ class DagRunner:
 
     # -- shared plumbing ---------------------------------------------------
     def _frag_skey(self, frag: Fragment) -> str:
-        try:
-            return plan_skey(frag.root)
-        except NotImplementedError:
-            return frag.root.key()
+        return _plan_skey_of(frag.root)
 
     def _shapes_sig(self, arrays) -> tuple:
         return tuple(
@@ -531,50 +581,7 @@ class DagRunner:
 
         arrays = _collect_arrays(self.fx, frag.root, exchanged, D)
         sig = self._shapes_sig(arrays)
-        static_cap = None
-        if D == 1:
-            # single-device mesh: every routed row lands on this device,
-            # so the input width BOUNDS the bucket — skipping the count
-            # pass saves a compile + round trip. Only worth it for small
-            # fragments: the bound ignores filter selectivity, and every
-            # consumer program then runs at this width (a selective scan
-            # over a big table must keep the counted cap).
-            by_leaf = {
-                id(n): a
-                for n, a in zip(_walk_leaves(frag.root), arrays)
-            }
-            width = _static_width(frag.root, by_leaf)
-            if width <= (1 << 20):
-                static_cap = filt_ops.bucket_size(max(width, 1))
         while True:
-            if static_cap is not None:
-                cap = static_cap
-                self._check_hbm_budget(cap, frag.root.schema, D)
-                xkey = ("xchg", skey, orientation, hashpos, D, cap, sig)
-                cached = self._programs.get(xkey)
-                if cached is None:
-                    cached = self._compile_exchange(
-                        frag.root, exchanged, orientation, hashpos, D, cap
-                    )
-                    self._programs[xkey] = cached
-                prog, comp = cached
-                params = self._resolve(comp, dicts_view, subquery_values)
-                cols, valids, rcounts, flags = prog(
-                    tuple(arrays), params, snap
-                )
-                flags = [np.asarray(f) for f in flags]
-                flip = _first_true(flags)
-                if flip is not None:
-                    orientation = self._flip(orientation, flip)
-                    continue
-                self._orientations[skey] = orientation
-                return {
-                    "cols": cols,
-                    "valids": valids,
-                    "counts": rcounts,
-                    "cap": cap,
-                    "schema": frag.root.schema,
-                }
             # pass 1: per-(src, dest) routed-row counts -> bucket size.
             # Skipped entirely (one round trip saved) when this exact
             # program + literal values already sized itself against
@@ -902,7 +909,9 @@ class DagRunner:
                     raise DagUnsupported(a.func)
             agg = root
             root = root.child
-        skey = self._frag_skey(frag)
+        # the executed tree (inlined at D==1) keys the program cache —
+        # the fragment's own root would alias different producer DAGs
+        skey = _plan_skey_of(final_root)
         orientation = self._orientation_for(skey, root)
         arrays = _collect_arrays(self.fx, root, exchanged, D)
         sig = self._shapes_sig(arrays)
@@ -910,13 +919,16 @@ class DagRunner:
         # program already ran against unchanged data + literals
         gcapkey = None
         gcap = OPTIMISTIC_GROUP_CAP
+        packing = True  # packed single-sort grouping until it overflows
+        n_dup = _count_inner_joins(root)
 
         while True:
-            fkey = ("final", skey, orientation, gcap, D, sig)
+            fkey = ("final", skey, orientation, gcap, D, sig, packing)
             cached = self._programs.get(fkey)
             if cached is None:
                 cached = self._compile_final(
-                    frag, agg, root, exchanged, orientation, gcap, D
+                    frag, agg, root, exchanged, orientation, gcap, D,
+                    packing,
                 )
                 self._programs[fkey] = cached
             prog, comp, mode = cached
@@ -939,6 +951,11 @@ class DagRunner:
                 cols, valids, cnt, nrows_full, flags = outs
             flip = _first_true(flags)
             if flip is not None:
+                if flip >= n_dup:
+                    # the packed-key range overflowed int64: retry with
+                    # per-key sorting (correctness never depended on it)
+                    packing = False
+                    continue
                 orientation = self._flip(orientation, flip)
                 gcapkey = None  # keyed per orientation
                 continue
@@ -962,7 +979,8 @@ class DagRunner:
             return self._collect_scalar(agg, out_vals)
 
     def _compile_final(
-        self, frag, agg, root, exchanged, orientation, gcap, D
+        self, frag, agg, root, exchanged, orientation, gcap, D,
+        packing: bool = True,
     ):
         comp = ExprCompiler(lift_consts=True)
         b = _Builder(self.fx, comp, orientation, root)
@@ -986,6 +1004,13 @@ class DagRunner:
             mode = "grouped" if grouped else "scalar"
             nkeys = len(agg.group_exprs)
             naggs = len(agg.aggs)
+            # packed single-sort grouping applies to all-integer keys
+            # (dtype is static); a runtime range-overflow flag retries
+            # with per-key sorting
+            use_packed = packing and grouped and all(
+                g.type.id in _JOINABLE_KEY_TYPES or g.type.is_text
+                for g in agg.group_exprs
+            )
 
             def program(arrays, params, snap):
                 def block(blocks):
@@ -1004,7 +1029,16 @@ class DagRunner:
                             (jnp.reshape(d, (1,)), jnp.reshape(v, (1,)))
                             for d, v in outs
                         ], flags
-                    perm, seg, ngroups = agg_ops._group_ids_impl(keys, mask)
+                    if use_packed:
+                        packed, pack_ok = _pack_group_keys(keys, mask)
+                        perm, seg, ngroups = agg_ops._group_ids_impl(
+                            [(packed, None)], mask
+                        )
+                        flags = flags + [jnp.reshape(~pack_ok, (1,))]
+                    else:
+                        perm, seg, ngroups = agg_ops._group_ids_impl(
+                            keys, mask
+                        )
                     out_keys, out_vals, gvalid = agg_ops._group_reduce_impl(
                         keys, vals, perm, seg, gcap, tuple(specs)
                     )
@@ -1022,7 +1056,7 @@ class DagRunner:
                         [(P("dn"), P("dn"))] * naggs,
                         P("dn"),
                         P("dn"),
-                        [P("dn")] * nflags,
+                        [P("dn")] * (nflags + (1 if use_packed else 0)),
                     )
                 else:
                     out_specs = (
@@ -1179,6 +1213,14 @@ def _count_inner_joins(plan) -> int:
         elif isinstance(node, L.Aggregate):
             stack.append(node.child)
     return n
+
+
+def _plan_skey_of(plan) -> str:
+    """Structural cache key: literals lifted to params where supported."""
+    try:
+        return plan_skey(plan)
+    except NotImplementedError:
+        return plan.key()
 
 
 def _params_sig(params) -> tuple:

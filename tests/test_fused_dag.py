@@ -383,3 +383,94 @@ def test_join_reorder_four_tables():
         bottom = bottom.child
     assert bottom.table == "t4", "4-table cluster must start from t4"
     assert s.query(sql) == [((3 * 3 + 30) + (4 * 3 + 40),)]
+
+
+def test_single_device_mesh_inlines_whole_dag(sess):
+    """On a 1-device mesh every exchange is an identity: the DAG must
+    collapse to one inlined program and still match the host answer."""
+    import jax
+    import numpy as _np
+
+    from opentenbase_tpu.executor.fused import FusedExecutor
+    from opentenbase_tpu.executor.fused_dag import DagRunner
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.plan.distribute import distribute_statement
+    from opentenbase_tpu.plan.optimize import optimize_statement
+    from opentenbase_tpu.sql.parser import parse
+
+    c = sess.cluster
+    mesh1 = jax.sharding.Mesh(
+        _np.asarray(jax.devices("cpu")[:1]), ("dn",)
+    )
+    fx1 = FusedExecutor(c.catalog, c.stores, mesh=mesh1)
+    runner = DagRunner(fx1)
+    sess.execute("set enable_fused_execution = off")
+    want = sess.query(Q3)
+    sp = optimize_statement(
+        analyze_statement(parse(Q3)[0], c.catalog), c.catalog
+    )
+    dp = distribute_statement(sp, c.catalog)
+    assert len(dp.fragments) > 1  # a real multi-fragment join plan
+    res = runner.run(dp, c.gts.snapshot_ts(), sess._dicts_view(), [])
+    assert res is not None, "1-device DAG fell back"
+    final_idx, batch = res
+    from opentenbase_tpu.executor.local import LocalExecutor
+
+    ex = LocalExecutor(
+        c.catalog, {}, c.gts.snapshot_ts(),
+        remote_inputs={final_idx: batch}, subquery_values=[],
+    )
+    got = ex.run_plan(dp.root).to_rows()
+    assert got == want
+    # exactly one final program, ZERO exchange programs were built
+    kinds = {k[0] for k in runner._programs}
+    assert "final" in kinds
+    assert not any(
+        k in kinds for k in ("xcnt", "xchg", "bcnt", "bcast")
+    ), kinds
+    sess.execute("set enable_fused_execution = on")  # module fixture
+
+
+def test_packed_group_overflow_falls_back(sess):
+    """Group keys whose combined range exceeds int64 must trip the
+    pack-overflow flag and still answer correctly via per-key sorting."""
+    s = sess
+    s.execute(
+        "create table wide2 (a bigint, b bigint, v bigint) "
+        "distribute by shard(v)"
+    )
+    big = 2**40
+    s.execute(
+        "insert into wide2 values "
+        f"(0, 0, 1), ({big}, {big}, 2), (0, {big}, 3), ({big}, 0, 4)"
+    )
+    q = (
+        "select wide2.a, wide2.b, sum(wide2.v) from wide2, wide2 w2 "
+        "where wide2.v = w2.v group by wide2.a, wide2.b "
+        "order by wide2.a, wide2.b"
+    )
+    s.execute("set enable_fused_execution = off")
+    want = s.query(q)
+    s.execute("set enable_fused_execution = on")
+    fx = s.cluster.fused_executor()
+    before = fx._dag.completed if fx._dag is not None else 0
+    got = s.query(q)
+    assert got == want and len(got) == 4
+    assert fx._dag is not None and fx._dag.completed > before
+
+
+def test_packed_range_wrap_detected():
+    """A single key whose value spread itself overflows int64 must trip
+    the pack guard (review repro: the guard must not wrap)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from opentenbase_tpu.executor.fused_dag import _pack_group_keys
+
+    a = jnp.asarray(np.array([0, 0, 1, 1], dtype=np.int64))
+    b = jnp.asarray(
+        np.array([-(2**62), 2**62 - 1, 0, 1], dtype=np.int64)
+    )
+    mask = jnp.ones(4, dtype=bool)
+    _packed, ok = _pack_group_keys([(a, None), (b, None)], mask)
+    assert not bool(np.asarray(ok)), "wrapping range must clear ok"
