@@ -193,36 +193,53 @@ class DistExecutor:
                 th = _threading.Thread(target=run_remote, args=(node,))
                 th.start()
                 threads.append(th)
-            for node in local:
+
+            def run_local(node):
                 t0 = _time.perf_counter()
-                ex = LocalExecutor(
-                    self.catalog,
-                    self._stores(node),
-                    self.snapshot_ts,
-                    remote_inputs={
-                        j: per_node[node]
-                        for j, per_node in motioned.items()
-                        if node in per_node
-                    },
-                    subquery_values=subquery_values,
-                    own_writes=self.own_writes.get(node),
-                )
-                outs[node] = ex.run_plan(frag.root)
-                # per-(fragment, node) instrumentation gathered back to
-                # the coordinator — the distributed EXPLAIN ANALYZE flow
-                # (src/backend/commands/explain_dist.c, recv_instr_htbl)
-                instr = {
-                    "fragment": frag.index,
-                    "node": node,
-                    "rows": outs[node].nrows,
-                    "ms": (_time.perf_counter() - t0) * 1000,
-                }
-                if getattr(ex, "zone_total_blocks", 0):
-                    instr["pruned_blocks"] = getattr(
-                        ex, "zone_pruned_blocks", 0
+                try:
+                    ex = LocalExecutor(
+                        self.catalog,
+                        self._stores(node),
+                        self.snapshot_ts,
+                        remote_inputs={
+                            j: per_node[node]
+                            for j, per_node in motioned.items()
+                            if node in per_node
+                        },
+                        subquery_values=subquery_values,
+                        own_writes=self.own_writes.get(node),
                     )
-                    instr["total_blocks"] = ex.zone_total_blocks
-                self.instrumentation.append(instr)
+                    outs[node] = ex.run_plan(frag.root)
+                    # per-(fragment, node) instrumentation gathered back
+                    # to the coordinator — distributed EXPLAIN ANALYZE
+                    # (src/backend/commands/explain_dist.c)
+                    instr = {
+                        "fragment": frag.index,
+                        "node": node,
+                        "rows": outs[node].nrows,
+                        "ms": (_time.perf_counter() - t0) * 1000,
+                    }
+                    if getattr(ex, "zone_total_blocks", 0):
+                        instr["pruned_blocks"] = getattr(
+                            ex, "zone_pruned_blocks", 0
+                        )
+                        instr["total_blocks"] = ex.zone_total_blocks
+                    self.instrumentation.append(instr)
+                except Exception as e:
+                    errors.append(e)
+
+            # local fragments execute concurrently across datanodes too
+            # (the parallel-worker fan-out, execParallel.c:565): each
+            # node's LocalExecutor touches only its own stores, and jax
+            # releases the GIL during compiles/execution
+            if len(local) > 1:
+                for node in local:
+                    th = _threading.Thread(target=run_local, args=(node,))
+                    th.start()
+                    threads.append(th)
+            else:
+                for node in local:
+                    run_local(node)
             for th in threads:
                 th.join()
             if errors:

@@ -410,6 +410,7 @@ class DagRunner:
         self.fx = fx  # FusedExecutor: mesh, cache, catalog, node_stores
         self._programs: dict = {}
         self._orientations: dict = {}  # frag skey -> tuple of 'R'/'L'
+        self._packing: dict = {}  # skey -> packed grouping viable?
         # sizing results remembered per (program, data version): repeat
         # queries on unchanged data skip the count pass / optimistic
         # group-capacity round trip entirely
@@ -919,7 +920,10 @@ class DagRunner:
         # program already ran against unchanged data + literals
         gcapkey = None
         gcap = OPTIMISTIC_GROUP_CAP
-        packing = True  # packed single-sort grouping until it overflows
+        # packed single-sort grouping until its range overflows — the
+        # outcome is remembered per plan so repeat queries never re-run
+        # a doomed packed program
+        packing = self._packing.get(skey, True)
         n_dup = _count_inner_joins(root)
 
         while True:
@@ -955,6 +959,7 @@ class DagRunner:
                     # the packed-key range overflowed int64: retry with
                     # per-key sorting (correctness never depended on it)
                     packing = False
+                    self._packing[skey] = False
                     continue
                 orientation = self._flip(orientation, flip)
                 gcapkey = None  # keyed per orientation

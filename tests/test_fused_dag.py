@@ -404,31 +404,33 @@ def test_single_device_mesh_inlines_whole_dag(sess):
     )
     fx1 = FusedExecutor(c.catalog, c.stores, mesh=mesh1)
     runner = DagRunner(fx1)
-    sess.execute("set enable_fused_execution = off")
-    want = sess.query(Q3)
-    sp = optimize_statement(
-        analyze_statement(parse(Q3)[0], c.catalog), c.catalog
-    )
-    dp = distribute_statement(sp, c.catalog)
-    assert len(dp.fragments) > 1  # a real multi-fragment join plan
-    res = runner.run(dp, c.gts.snapshot_ts(), sess._dicts_view(), [])
-    assert res is not None, "1-device DAG fell back"
-    final_idx, batch = res
-    from opentenbase_tpu.executor.local import LocalExecutor
+    try:
+        sess.execute("set enable_fused_execution = off")
+        want = sess.query(Q3)
+        sp = optimize_statement(
+            analyze_statement(parse(Q3)[0], c.catalog), c.catalog
+        )
+        dp = distribute_statement(sp, c.catalog)
+        assert len(dp.fragments) > 1  # a real multi-fragment join plan
+        res = runner.run(dp, c.gts.snapshot_ts(), sess._dicts_view(), [])
+        assert res is not None, "1-device DAG fell back"
+        final_idx, batch = res
+        from opentenbase_tpu.executor.local import LocalExecutor
 
-    ex = LocalExecutor(
-        c.catalog, {}, c.gts.snapshot_ts(),
-        remote_inputs={final_idx: batch}, subquery_values=[],
-    )
-    got = ex.run_plan(dp.root).to_rows()
-    assert got == want
-    # exactly one final program, ZERO exchange programs were built
-    kinds = {k[0] for k in runner._programs}
-    assert "final" in kinds
-    assert not any(
-        k in kinds for k in ("xcnt", "xchg", "bcnt", "bcast")
-    ), kinds
-    sess.execute("set enable_fused_execution = on")  # module fixture
+        ex = LocalExecutor(
+            c.catalog, {}, c.gts.snapshot_ts(),
+            remote_inputs={final_idx: batch}, subquery_values=[],
+        )
+        got = ex.run_plan(dp.root).to_rows()
+        assert got == want
+        # exactly one final program, ZERO exchange programs were built
+        kinds = {k[0] for k in runner._programs}
+        assert "final" in kinds
+        assert not any(
+            k in kinds for k in ("xcnt", "xchg", "bcnt", "bcast")
+        ), kinds
+    finally:
+        sess.execute("set enable_fused_execution = on")  # module fixture
 
 
 def test_packed_group_overflow_falls_back(sess):
