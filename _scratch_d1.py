@@ -1,0 +1,38 @@
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+import time
+import numpy as np
+from opentenbase_tpu.engine import Cluster
+from bench import make_lineitem, make_q3_dims, _bulk_append, Q3, cpu_baseline_q3
+
+N = 2_000_000
+cluster = Cluster(num_datanodes=2, shard_groups=16)
+s = cluster.session()
+s.execute("create table lineitem (l_orderkey bigint, l_quantity numeric(10,2), l_extendedprice numeric(12,2), l_discount numeric(4,2), l_shipdate date, l_returnflag int, l_linestatus int) distribute by roundrobin")
+arrays = make_lineitem(N)
+_bulk_append(cluster, "lineitem", arrays)
+orders, customer = make_q3_dims(N)
+s.execute("create table orders (o_orderkey bigint, o_custkey bigint, o_orderdate date, o_shippriority int) distribute by roundrobin")
+_bulk_append(cluster, "orders", orders)
+s.execute("create table customer (c_custkey bigint, c_mktsegment int) distribute by roundrobin")
+_bulk_append(cluster, "customer", customer)
+s.execute("analyze")
+
+r1 = s.query(Q3)
+t0 = time.perf_counter(); r2 = s.query(Q3); dt = time.perf_counter() - t0
+print("mode:", cluster._fused._dag.last_mode if cluster._fused and cluster._fused._dag else None)
+print(f"Q3 warm: {dt:.3f}s -> {N/dt/1e6:.2f} M rows/s")
+print(r2[:3])
+# reference host answer
+s.execute("set enable_fused_execution = off")
+r_host = s.query(Q3)
+assert [tuple(x) for x in r2] == [tuple(x) for x in r_host], (r2, r_host)
+print("matches host path:", len(r_host), "rows")

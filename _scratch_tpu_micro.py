@@ -1,0 +1,51 @@
+import time, numpy as np, jax, jax.numpy as jnp
+import opentenbase_tpu.ops  # x64
+print("backend:", jax.default_backend())
+
+N = 60_000_000
+B = 16_000_000
+
+rng = np.random.default_rng(0)
+bidx_h = rng.integers(0, B, N).astype(np.int32)
+val_h = rng.integers(0, 10**6, N).astype(np.int64)
+bkey_h = rng.permutation(np.arange(B, dtype=np.int64))
+pkey_h = rng.integers(0, B, N).astype(np.int64)
+
+t0=time.time()
+bidx = jax.device_put(bidx_h); val = jax.device_put(val_h)
+bkey = jax.device_put(bkey_h); pkey = jax.device_put(pkey_h)
+skey = jax.jit(jnp.sort)(bkey)
+print(f"upload: {time.time()-t0:.1f}s")
+
+@jax.jit
+def seg(val, bidx):
+    return jnp.sum(jax.ops.segment_sum(val, bidx, num_segments=B)[:13])
+
+@jax.jit
+def srt(bkey):
+    return jnp.sum(jnp.argsort(bkey)[:13])
+
+@jax.jit
+def ss(skey, pkey):
+    return jnp.sum(jnp.searchsorted(skey, pkey)[:13])
+
+@jax.jit
+def topk(v):
+    big = jnp.int64(2**62)
+    def body(i, st):
+        key, idx = st
+        j = jnp.argmin(key).astype(jnp.int32)
+        return key.at[j].set(big), idx.at[i].set(j)
+    _, idx = jax.lax.fori_loop(0, 10, body, (v, jnp.zeros(10, jnp.int32)))
+    return jnp.sum(idx)
+
+for name, fn, args in [("segment_sum 60M->16M i64", seg, (val, bidx)),
+                       ("argsort 16M i64", srt, (bkey,)),
+                       ("searchsorted 60M in 16M", ss, (skey, pkey)),
+                       ("topk10 over 16M", topk, (bkey,))]:
+    v = int(jax.device_get(fn(*args)))  # compile+run+fetch
+    best = 1e9
+    for _ in range(3):
+        t0 = time.time(); v = int(jax.device_get(fn(*args)))
+        best = min(best, time.time()-t0)
+    print(f"{name}: {best*1000:.0f} ms")

@@ -1,0 +1,44 @@
+import sys, time, numpy as np, jax, jax.numpy as jnp
+from jax import lax
+import opentenbase_tpu.ops  # x64
+print("backend:", jax.default_backend(), flush=True)
+
+N = 60_000_000
+B = 16_000_000
+M = B + N
+
+rng = np.random.default_rng(0)
+t0=time.time()
+tbl = jax.device_put(rng.integers(0, 10**6, B).astype(np.int64))
+gidx = jax.device_put(rng.integers(0, B, N).astype(np.int32))
+key32 = jax.device_put(rng.integers(0, B, M).astype(np.int32))
+pay8 = jax.device_put(rng.integers(0, 2, M).astype(np.int8))
+pay32 = jax.device_put(rng.integers(0, 3000, M).astype(np.int32))
+pay64 = jax.device_put(rng.integers(0, 10**6, M).astype(np.int64))
+print(f"upload done {time.time()-t0:.0f}s", flush=True)
+
+def run(name, fn, *args):
+    t0 = time.time()
+    v = jax.device_get(fn(*args))
+    print(f"{name}: first(compile+run) {time.time()-t0:.1f}s", flush=True)
+    best = 1e9
+    for _ in range(2):
+        t0 = time.time(); v = jax.device_get(fn(*args)); best = min(best, time.time()-t0)
+    print(f"{name}: {best*1000:.0f} ms", flush=True)
+
+@jax.jit
+def gather60(tbl, gidx):
+    return jnp.sum(jnp.take(tbl, gidx)[:13])
+
+@jax.jit
+def big_cumsum(pay64):
+    return jnp.sum(jnp.cumsum(pay64)[:13])
+
+@jax.jit
+def cosort(key32, pay8, pay32, pay64):
+    outs = lax.sort((key32, pay8, pay32, pay64), num_keys=2, is_stable=False)
+    return sum(jnp.sum(o[:7].astype(jnp.int64)) for o in outs)
+
+run("cumsum 76M i64", big_cumsum, pay64)
+run("gather 60M from 16M", gather60, tbl, gidx)
+run("co-sort 76M 2keys+2payload", cosort, key32, pay8, pay32, pay64)

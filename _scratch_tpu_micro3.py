@@ -1,0 +1,70 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from jax import lax
+import opentenbase_tpu.ops
+print("backend:", jax.default_backend(), flush=True)
+
+B = 4_194_304
+P = 16_777_216
+M = B + P
+rng = np.random.default_rng(0)
+allk = jax.device_put(np.concatenate([rng.permutation(B).astype(np.int64), rng.integers(0, B, P).astype(np.int64)]))
+isprobe = jax.device_put(np.concatenate([np.zeros(B, np.int8), np.ones(P, np.int8)]))
+val = jax.device_put(rng.integers(0, 10**9, M).astype(np.int64))
+slot = jax.device_put(rng.integers(0, 3000, M).astype(np.int64))
+brow = jax.device_put(rng.integers(0, B, M).astype(np.int32))
+
+def run(name, fn, *args):
+    t0=time.time(); v = jax.device_get(fn(*args)); print(f"{name}: compile+run {time.time()-t0:.1f}s", flush=True)
+    best = 1e9
+    for _ in range(2):
+        t0 = time.time(); v = jax.device_get(fn(*args)); best = min(best, time.time()-t0)
+    print(f"{name}: {best*1000:.0f} ms", flush=True)
+
+@jax.jit
+def sort5(allk, isprobe, val, slot, brow):
+    outs = lax.sort((allk, isprobe, val, slot, brow), num_keys=2, is_stable=False)
+    return sum(jnp.sum(o[:7].astype(jnp.int64)) for o in outs)
+
+@jax.jit
+def sort2(allk, isprobe):
+    outs = lax.sort((allk, isprobe), num_keys=2, is_stable=False)
+    return jnp.sum(outs[0][:7])
+
+@jax.jit
+def scanchain(allk, val):
+    boundary = jnp.concatenate([jnp.ones(1, jnp.bool_), allk[1:] != allk[:-1]])
+    runid = jnp.cumsum(boundary.astype(jnp.int32))
+    prevail = lax.cummax(jnp.where(boundary, runid, jnp.int32(-1)))
+    cs = jnp.cumsum(val)
+    end = jnp.concatenate([boundary[1:], jnp.ones(1, jnp.bool_)])
+    at_end = jnp.where(end, cs, jnp.int64(2**62))
+    ce = jnp.flip(lax.cummin(jnp.flip(at_end)))
+    return jnp.sum((ce - cs)[:7]) + jnp.sum(prevail[:7])
+
+@jax.jit
+def topk10(val):
+    big = jnp.int64(2**62)
+    key = val
+    n = key.shape[0]
+    cs = 8192
+    nc = -(-n // cs)
+    pad = nc*cs - n
+    kp = jnp.pad(key, (0, pad), constant_values=2**62) if pad else key
+    chunks = kp.reshape(nc, cs)
+    mins = jnp.min(chunks, axis=1)
+    def body(i, st):
+        chunks, mins, idx = st
+        c = jnp.argmin(mins).astype(jnp.int32)
+        row = chunks[c]
+        j = jnp.argmin(row).astype(jnp.int32)
+        row = row.at[j].set(big)
+        chunks = chunks.at[c].set(row)
+        mins = mins.at[c].set(jnp.min(row))
+        return chunks, mins, idx.at[i].set(c*cs+j)
+    _, _, idx = lax.fori_loop(0, 10, body, (chunks, mins, jnp.zeros(10, jnp.int32)))
+    return jnp.sum(idx)
+
+run("sort 21M 2key only", sort2, allk, isprobe)
+run("sort 21M 2key+3payload", sort5, allk, isprobe, val, slot, brow)
+run("scan chain 21M", scanchain, allk, val)
+run("topk10 hier 21M", topk10, val)

@@ -1,0 +1,38 @@
+import time, numpy as np, jax, jax.numpy as jnp
+from jax import lax
+import opentenbase_tpu.ops
+print("backend:", jax.default_backend(), flush=True)
+M = 21_000_000
+rng = np.random.default_rng(0)
+k64 = jax.device_put(rng.integers(0, 2**25, M).astype(np.int64))
+v64 = jax.device_put(rng.integers(0, 2**30, M).astype(np.int64))
+s64 = jax.device_put(rng.integers(0, 2**36, M).astype(np.int64))
+k32 = jax.device_put(rng.integers(0, 2**25, M).astype(np.int32))
+v32 = jax.device_put(rng.integers(0, 2**30, M).astype(np.int32))
+b32 = jax.device_put(rng.integers(0, 2**22, M).astype(np.int32))
+
+def run(name, fn, *args):
+    v = jax.device_get(fn(*args))
+    best = 1e9
+    for _ in range(2):
+        t0 = time.time(); v = jax.device_get(fn(*args)); best = min(best, time.time()-t0)
+    print(f"{name}: {best*1000:.0f} ms", flush=True)
+
+@jax.jit
+def s_i64(k64, v64, s64):
+    o = lax.sort((k64, v64, s64), num_keys=1, is_stable=False)
+    return sum(jnp.sum(x[:5]) for x in o)
+
+@jax.jit
+def s_i32(k32, v32, b32):
+    o = lax.sort((k32, v32, b32), num_keys=1, is_stable=False)
+    return sum(jnp.sum(x[:5].astype(jnp.int64)) for x in o)
+
+@jax.jit
+def s_i32k(k32, v64, s64):
+    o = lax.sort((k32, v64, s64), num_keys=1, is_stable=False)
+    return jnp.sum(o[0][:5].astype(jnp.int64)) + jnp.sum(o[1][:5]) + jnp.sum(o[2][:5])
+
+run("sort 21M (i64,i64,i64)", s_i64, k64, v64, s64)
+run("sort 21M (i32,i32,i32)", s_i32, k32, v32, b32)
+run("sort 21M (i32,i64,i64)", s_i32k, k32, v64, s64)

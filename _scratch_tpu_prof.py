@@ -1,0 +1,41 @@
+import os, sys, time
+import numpy as np
+N = 16_000_000
+import jax
+print("backend:", jax.default_backend(), flush=True)
+from opentenbase_tpu.engine import Cluster
+from bench import make_lineitem, make_q3_dims, _bulk_append, Q3
+
+cluster = Cluster(num_datanodes=2, shard_groups=16)
+s = cluster.session()
+s.execute("create table lineitem (l_orderkey bigint, l_quantity numeric(10,2), l_extendedprice numeric(12,2), l_discount numeric(4,2), l_shipdate date, l_returnflag int, l_linestatus int) distribute by roundrobin")
+arrays = make_lineitem(N)
+_bulk_append(cluster, "lineitem", arrays)
+orders, customer = make_q3_dims(N)
+s.execute("create table orders (o_orderkey bigint, o_custkey bigint, o_orderdate date, o_shippriority int) distribute by roundrobin")
+_bulk_append(cluster, "orders", orders)
+s.execute("create table customer (c_custkey bigint, c_mktsegment int) distribute by roundrobin")
+_bulk_append(cluster, "customer", customer)
+s.execute("analyze")
+
+t0=time.time(); r1 = s.query(Q3); print(f"first: {time.time()-t0:.0f}s", flush=True)
+
+# now time the raw program call via the runner internals
+dag = cluster._fused._dag
+import opentenbase_tpu.executor.fused_dag as FD
+orig = FD.DagRunner._run_final
+import jax
+def timed(self, frag, final_root, exchanged, snap, dicts_view, subquery_values, D, versions, dplan=None):
+    t0 = time.perf_counter()
+    out = orig(self, frag, final_root, exchanged, snap, dicts_view, subquery_values, D, versions, dplan)
+    print(f"   _run_final: {time.perf_counter()-t0:.3f}s", flush=True)
+    return out
+FD.DagRunner._run_final = timed
+for i in range(3):
+    t0 = time.perf_counter(); s.query(Q3)
+    print(f"query total: {time.perf_counter()-t0:.3f}s", flush=True)
+
+# and raw prog repeat: find the cached program
+progs = [(k, v) for k, v in dag._programs.items() if v[2] == "gsort"]
+(fkey, (prog, comp, mode)), = progs[:1]
+print("have gsort prog", flush=True)

@@ -476,3 +476,142 @@ def test_packed_range_wrap_detected():
     mask = jnp.ones(4, dtype=bool)
     _packed, ok = _pack_group_keys([(a, None), (b, None)], mask)
     assert not bool(np.asarray(ok)), "wrapping range must clear ok"
+
+
+def _mesh1_runner(sess):
+    """Fresh 1-device DagRunner over the module cluster's stores."""
+    import jax
+    import numpy as _np
+
+    from opentenbase_tpu.executor.fused import FusedExecutor
+    from opentenbase_tpu.executor.fused_dag import DagRunner
+
+    c = sess.cluster
+    mesh1 = jax.sharding.Mesh(
+        _np.asarray(jax.devices("cpu")[:1]), ("dn",)
+    )
+    return DagRunner(FusedExecutor(c.catalog, c.stores, mesh=mesh1))
+
+
+def _run_mesh1(sess, runner, q):
+    from opentenbase_tpu.executor.local import LocalExecutor
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.plan.distribute import distribute_statement
+    from opentenbase_tpu.plan.optimize import optimize_statement
+    from opentenbase_tpu.sql.parser import parse
+
+    c = sess.cluster
+    sp = optimize_statement(
+        analyze_statement(parse(q)[0], c.catalog), c.catalog
+    )
+    dp = distribute_statement(sp, c.catalog)
+    res = runner.run(dp, c.gts.snapshot_ts(), sess._dicts_view(), [])
+    if res is None:
+        return None
+    final_idx, batch = res
+    ex = LocalExecutor(
+        c.catalog, {}, c.gts.snapshot_ts(),
+        remote_inputs={final_idx: batch}, subquery_values=[],
+    )
+    return ex.run_plan(dp.root).to_rows()
+
+
+def test_gsort_mode_engaged_for_q3_shape(sess):
+    """The Q3 shape (group-by-unique-build + ORDER BY/LIMIT) at mesh
+    size 1 must take the co-sort path — the round-3 fast join — and
+    match the host answer exactly."""
+    sess.execute("set enable_fused_execution = off")
+    want = sess.query(Q3)
+    sess.execute("set enable_fused_execution = on")
+    runner = _mesh1_runner(sess)
+    got = _run_mesh1(sess, runner, Q3)
+    assert got == want
+    assert runner.last_mode == "gsort", runner.last_mode
+
+
+def test_topk_ships_only_limit_rows(sess):
+    """With ORDER BY + LIMIT the device must ship k rows, not every
+    group (the round-2 Q3 killer was a full-group-capacity gather)."""
+    runner = _mesh1_runner(sess)
+    got = _run_mesh1(sess, runner, Q3)
+    assert got is not None
+    assert runner.last_mode in ("gsort", "gseg", "grouped_topk")
+
+
+def test_grouped_topk_mode_when_group_not_on_build(sess):
+    """Grouping by a PROBE-side non-key column can't use the build-row
+    segment trick but still ranks on device at mesh size 1."""
+    q = (
+        "select l_shipdate, sum(l_extendedprice) from orders, lineitem "
+        "where o_orderkey = l_orderkey group by l_shipdate "
+        "order by 2 desc limit 5"
+    )
+    sess.execute("set enable_fused_execution = off")
+    want = sess.query(q)
+    sess.execute("set enable_fused_execution = on")
+    runner = _mesh1_runner(sess)
+    got = _run_mesh1(sess, runner, q)
+    assert got == want
+    assert runner.last_mode == "grouped_topk", runner.last_mode
+
+
+def test_rows_topk_mode(sess):
+    """ORDER BY ... LIMIT over plain join rows ranks on device and ships
+    k rows per device at any mesh size."""
+    q = (
+        "select o_orderkey, l_extendedprice from orders, lineitem "
+        "where o_orderkey = l_orderkey "
+        "order by l_extendedprice desc limit 7"
+    )
+    sess.execute("set enable_fused_execution = off")
+    want = sess.query(q)
+    sess.execute("set enable_fused_execution = on")
+    runner = _mesh1_runner(sess)
+    got = _run_mesh1(sess, runner, q)
+    assert got == want and len(got) == 7
+    assert runner.last_mode == "rows_topk", runner.last_mode
+
+
+def test_gsort_negative_sums_fall_back_correctly(sess):
+    """Negative aggregate values break the monotone-prefix fast path;
+    the runtime flag must reject it and the query still answers right."""
+    s = sess
+    s.execute(
+        "create table negd (g bigint, v bigint) distribute by shard(g)"
+    )
+    s.execute(
+        "insert into negd values (1, -5), (1, 10), (2, -7), (3, 4)"
+    )
+    s.execute(
+        "create table negk (k bigint, tag int) distribute by shard(k)"
+    )
+    s.execute("insert into negk values (1, 0), (2, 1), (3, 0)")
+    q = (
+        "select negd.g, sum(negd.v) from negk, negd "
+        "where negk.k = negd.g group by negd.g "
+        "order by 2 desc limit 2"
+    )
+    s.execute("set enable_fused_execution = off")
+    want = s.query(q)
+    s.execute("set enable_fused_execution = on")
+    runner = _mesh1_runner(sess)
+    got = _run_mesh1(sess, runner, q)
+    assert got == want, (got, want)
+
+
+def test_count_star_via_gsort(sess):
+    """count(*) and count(col) ride the run-length scans."""
+    q = (
+        "select o_orderkey, count(*), sum(l_extendedprice), "
+        "o_orderdate from orders, lineitem "
+        "where o_orderkey = l_orderkey "
+        "group by o_orderkey, o_orderdate "
+        "order by 2 desc, o_orderkey limit 6"
+    )
+    sess.execute("set enable_fused_execution = off")
+    want = sess.query(q)
+    sess.execute("set enable_fused_execution = on")
+    runner = _mesh1_runner(sess)
+    got = _run_mesh1(sess, runner, q)
+    assert got == want
+    assert runner.last_mode == "gsort", runner.last_mode
