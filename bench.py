@@ -38,7 +38,7 @@ import numpy as np
 #     timeout can interrupt it — and fall back to the CPU platform (the
 #     bench then honestly reports platform=cpu).
 # ---------------------------------------------------------------------------
-BENCH_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", 1700))
+BENCH_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", 3300))
 _BENCH_PLATFORM = "default"
 
 # Once the Q6 headline record has been printed, the watchdog must NOT
@@ -363,6 +363,52 @@ def main():
     print(json.dumps(record), flush=True)
     _HEADLINE_EMITTED = True
 
+    # Transport-amortized kernel roof (VERDICT r2 §weak-6): the per-query
+    # wall time sits near the tunnel's ~110ms dispatch floor, so also
+    # time a jitted 16-iteration on-device loop over the SAME resident
+    # columns and report effective HBM GB/s next to rows/s.
+    try:
+        import jax as _j
+        import jax.numpy as _jnp
+
+        fx = cluster.fused_executor()
+        meta = cluster.catalog.get("lineitem")
+        cols = ["l_quantity", "l_extendedprice", "l_discount",
+                "l_shipdate"]
+        dtab = fx.cache.get(
+            "lineitem", meta, cluster.stores,
+            tuple(meta.node_indices), columns=cols,
+        )
+        qty, price, disc, ship = (dtab.columns[c] for c in cols)
+        iters = 16
+
+        @_j.jit
+        def loop(qty, price, disc, ship):
+            def body(i, acc):
+                # the i-dependent bound stops XLA hoisting the whole
+                # body out of the loop as loop-invariant
+                keep = (
+                    (ship >= 8766 + i) & (ship < 9131)
+                    & (disc >= 5) & (disc <= 7) & (qty < 2400)
+                )
+                rev = _jnp.sum(_jnp.where(keep, price * disc, 0))
+                return acc + rev
+
+            return _j.lax.fori_loop(0, iters, body, _jnp.int64(0))
+
+        got = int(_j.device_get(loop(qty, price, disc, ship)))  # warm
+        assert got != 0
+        t0 = time.perf_counter()
+        int(_j.device_get(loop(qty, price, disc, ship)))
+        amort = (time.perf_counter() - t0) / iters
+        touched = ROWS * (8 + 8 + 8 + 4)
+        record["q6_amortized_rows_per_sec"] = round(ROWS / amort)
+        record["q6_effective_gbps"] = round(touched / amort / 1e9, 1)
+        _phase("q6 amortized measured", t_start)
+        print(json.dumps(record), flush=True)
+    except Exception as e:
+        _phase(f"q6 amortized failed: {e!r:.120}", t_start)
+
     # Q1: the grouped-aggregation path; headline stays Q6 for cross-round
     # comparability. The headline is already out, so a watchdog cut here
     # loses nothing.
@@ -385,46 +431,149 @@ def main():
     except Exception as e:  # Q1 must never break the headline
         _phase(f"q1 failed: {e!r:.200}", t_start)
 
-    # Q3: the distributed-join path (fused DAG: all_to_all exchanges +
-    # sorted-lookup join + partial agg on device; BASELINE config 3).
-    # Capped at 16M lineitem rows: the join exchanges materialize ~3x
-    # their payload and a 60M-row Q3 exhausts one v5e's HBM (the DAG
-    # guards with a budget and falls back, but the host fallback at 60M
-    # eats the whole watchdog budget for one number). Baseline and
-    # device run use the same capped data, so the ratio stays honest.
+    # Q3: the distributed-join path (BASELINE config 3) at FULL size —
+    # the round-3 co-sort engine (executor/fused_dag.py gsort mode:
+    # one lax.sort + prefix scans + device top-k, no scatter, no
+    # searchsorted) runs 60M rows in-HBM with no row cap.
     try:
-        q3_rows = min(ROWS, 16_000_000)
-        if q3_rows < ROWS:
-            # release the 60M-row residency (HBM via the fused cache,
-            # host RAM via the arrays + stores) before building the
-            # capped dataset — Q6/Q1 are already measured and printed
-            cluster._fused = None
-            cluster.stores.clear()
-            del arrays, orders, customer
-            arrays3 = make_lineitem(q3_rows)
-            orders3, customer3 = make_q3_dims(q3_rows)
-            s2 = load_cluster(arrays3, orders3, customer3).session()
-            s2.execute("analyze")
-        else:
-            arrays3, orders3, customer3, s2 = arrays, orders, customer, s
-        record["q3_rows"] = q3_rows
-        q3_warm = s2.query(Q3)  # compile (several fragment programs)
+        record["q3_rows"] = ROWS
+        q3_warm = s.query(Q3)  # compile
         assert len(q3_warm) >= 1
         _phase("q3 compiled", t_start)
         q3_best = float("inf")
         for _ in range(2):
             t0 = time.perf_counter()
-            s2.query(Q3)
+            s.query(Q3)
             q3_best = min(q3_best, time.perf_counter() - t0)
-        q3_cpu = cpu_baseline_q3(arrays3, orders3, customer3)
-        record["q3_rows_per_sec"] = round(q3_rows / q3_best)
+        q3_cpu = cpu_baseline_q3(arrays, orders, customer)
+        record["q3_rows_per_sec"] = round(ROWS / q3_best)
         record["q3_vs_baseline"] = round(
-            (q3_rows / q3_best) / (q3_rows / q3_cpu), 3
+            (ROWS / q3_best) / (ROWS / q3_cpu), 3
         )
         _phase("q3 measured", t_start)
         print(json.dumps(record), flush=True)
     except Exception as e:  # Q3 must never break the headline
         _phase(f"q3 failed: {e!r:.200}", t_start)
+
+    # ClickBench-like (BASELINE config 5): high-cardinality GROUP BY +
+    # TopK over a single wide table — the fused gagg path (one packed-key
+    # sort + prefix scans + device top-k). SSB-like star join (config 4)
+    # follows on the same cluster. Both at half scale to fit the bench
+    # wall-clock; row counts are recorded so ratios stay honest.
+    try:
+        ex_rows = min(ROWS, 30_000_000)
+        # free the TPC-H residency (HBM via the device cache, host RAM
+        # via the stores) before loading the second dataset
+        cluster._fused = None
+        cluster.stores.clear()
+        del arrays, orders, customer
+        rng = np.random.default_rng(7)
+        n_users = max(ex_rows // 10, 1)
+        hits = {
+            "userid": rng.integers(0, n_users, ex_rows).astype(np.int64),
+            "duration": rng.integers(0, 10_000, ex_rows).astype(np.int64),
+        }
+        n_dates, n_parts = 2556, 200_000
+        lineorder = {
+            "lo_orderdate": rng.integers(0, n_dates, ex_rows).astype(
+                np.int64
+            ),
+            "lo_partkey": rng.integers(0, n_parts, ex_rows).astype(
+                np.int64
+            ),
+            "lo_revenue": rng.integers(100, 10_000, ex_rows).astype(
+                np.int64
+            ),
+        }
+        date_dim = {
+            "d_datekey": np.arange(n_dates, dtype=np.int64),
+            "d_year": (1992 + np.arange(n_dates) // 365).astype(np.int64),
+        }
+        part = {
+            "p_partkey": np.arange(n_parts, dtype=np.int64),
+            "p_category": rng.integers(0, 25, n_parts).astype(np.int64),
+            "p_brand": rng.integers(0, 1000, n_parts).astype(np.int64),
+        }
+        cluster2 = Cluster(num_datanodes=NUM_DN, shard_groups=256)
+        s3 = cluster2.session()
+        s3.execute(
+            "create table hits (userid bigint, duration bigint) "
+            "distribute by roundrobin"
+        )
+        _bulk_append(cluster2, "hits", hits)
+        s3.execute(
+            "create table lineorder (lo_orderdate bigint, lo_partkey "
+            "bigint, lo_revenue bigint) distribute by roundrobin"
+        )
+        _bulk_append(cluster2, "lineorder", lineorder)
+        s3.execute(
+            "create table date_dim (d_datekey bigint, d_year bigint) "
+            "distribute by roundrobin"
+        )
+        _bulk_append(cluster2, "date_dim", date_dim)
+        s3.execute(
+            "create table part (p_partkey bigint, p_category bigint, "
+            "p_brand bigint) distribute by roundrobin"
+        )
+        _bulk_append(cluster2, "part", part)
+        s3.execute("analyze")
+        _phase("extra datasets loaded", t_start)
+
+        Q_CB = (
+            "select userid, count(*) from hits group by userid "
+            "order by 2 desc limit 10"
+        )
+        s3.query(Q_CB)  # compile
+        _phase("clickbench compiled", t_start)
+        cb_best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            s3.query(Q_CB)
+            cb_best = min(cb_best, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        cnt = np.bincount(hits["userid"], minlength=n_users)
+        top = np.argpartition(cnt, -10)[-10:]
+        _ = top[np.argsort(-cnt[top])]
+        cb_cpu = time.perf_counter() - t0
+        record["clickbench_rows"] = ex_rows
+        record["clickbench_rows_per_sec"] = round(ex_rows / cb_best)
+        record["clickbench_vs_baseline"] = round(cb_cpu / cb_best, 3)
+        _phase("clickbench measured", t_start)
+        print(json.dumps(record), flush=True)
+
+        Q_SSB = (
+            "select d_year, p_brand, sum(lo_revenue) "
+            "from lineorder, date_dim, part "
+            "where lo_orderdate = d_datekey and lo_partkey = p_partkey "
+            "and p_category = 1 group by d_year, p_brand "
+            "order by 3 desc limit 10"
+        )
+        s3.query(Q_SSB)  # compile
+        _phase("ssb compiled", t_start)
+        ssb_best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            s3.query(Q_SSB)
+            ssb_best = min(ssb_best, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        keep = part["p_category"][lineorder["lo_partkey"]] == 1
+        year = date_dim["d_year"][lineorder["lo_orderdate"]][keep]
+        brand = part["p_brand"][lineorder["lo_partkey"]][keep]
+        key = (year - 1992) * 1000 + brand
+        rev = np.bincount(
+            key, weights=lineorder["lo_revenue"][keep],
+            minlength=8 * 1000,
+        )
+        top = np.argpartition(rev, -10)[-10:]
+        _ = top[np.argsort(-rev[top])]
+        ssb_cpu = time.perf_counter() - t0
+        record["ssb_rows"] = ex_rows
+        record["ssb_rows_per_sec"] = round(ex_rows / ssb_best)
+        record["ssb_vs_baseline"] = round(ssb_cpu / ssb_best, 3)
+        _phase("ssb measured", t_start)
+        print(json.dumps(record), flush=True)
+    except Exception as e:  # extra legs must never break the record
+        _phase(f"extra legs failed: {e!r:.200}", t_start)
 
 
 if __name__ == "__main__":

@@ -104,7 +104,103 @@ class DNServer:
             return {"ok": True, "applied": self.standby.applied}
         if op == "exec_fragment":
             return self._exec_fragment(msg)
+        if op == "2pc_prepare":
+            return self._twophase_prepare(msg)
+        if op == "2pc_commit":
+            return self._twophase_finish(msg, committed=True)
+        if op == "2pc_abort":
+            return self._twophase_finish(msg, committed=False)
+        if op == "2pc_list":
+            entries = self._twophase_list()
+            return {
+                "ok": True,
+                "gids": [e["gid"] for e in entries],
+                "entries": entries,
+            }
         return {"error": f"unknown op {op}"}
+
+    # -- two-phase commit participant -------------------------------------
+    # The reference's datanodes vote in the coordinator's implicit 2PC
+    # (pgxc_node_remote_prepare, execRemote.c:3936; the 2PC control
+    # messages, pgxcnode.c:2843-3081). Here the DN's durable vote is a
+    # fsynced journal entry under <data_dir>/prepared_2pc: PREPARE
+    # persists the gid before the coordinator's irrevocable commit stamp,
+    # COMMIT/ABORT PREPARED retire it, and 2pc_list lets the
+    # coordinator's resolve_indoubt sweep orphans after a crash. Row data
+    # still flows through WAL replication — the journal is the vote.
+
+    def _twophase_dir(self) -> str:
+        import os
+
+        d = os.path.join(self.standby.data_dir, "prepared_2pc")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _twophase_prepare(self, msg: dict) -> dict:
+        import json
+        import os
+
+        gid = str(msg["gid"])
+        if not gid or "/" in gid or gid.startswith("."):
+            return {"error": f"bad gid {gid!r}"}
+        d = self._twophase_dir()
+        tmp = os.path.join(d, f".{gid}.tmp")
+        path = os.path.join(d, gid)
+        entry = {
+            "gid": gid,
+            "gxid": msg.get("gxid"),
+            "participants": msg.get("participants") or [],
+            "prepared_at": time.time(),
+        }
+        with open(tmp, "w") as f:
+            json.dump(entry, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)  # the rename itself must be durable
+        finally:
+            os.close(dfd)
+        return {"ok": True}
+
+    def _twophase_finish(self, msg: dict, committed: bool) -> dict:
+        import os
+
+        gid = str(msg["gid"])
+        path = os.path.join(self._twophase_dir(), gid)
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            # presumed-abort protocol: finishing an unknown gid is a
+            # no-op (the prepare may never have arrived)
+            return {"ok": True, "known": False}
+        return {"ok": True, "known": True}
+
+    def _twophase_list(self) -> list:
+        import json
+        import os
+
+        out = []
+        d = self._twophase_dir()
+        try:
+            names = sorted(
+                g for g in os.listdir(d) if not g.startswith(".")
+            )
+        except OSError:
+            return []
+        now = time.time()
+        for g in names:
+            age = None
+            try:
+                with open(os.path.join(d, g)) as f:
+                    age = now - float(
+                        json.load(f).get("prepared_at") or 0.0
+                    )
+            except (OSError, ValueError):
+                pass
+            out.append({"gid": g, "age_s": age})
+        return out
 
     def _wait_applied(self, lsn: int, timeout_s: float = 90.0) -> bool:
         t0 = time.time()

@@ -34,6 +34,19 @@ from opentenbase_tpu.storage.table import ColumnBatch
 from opentenbase_tpu.utils.hashing import combine_hashes, hash32_np
 
 
+def _scan_tables(plan) -> set:
+    """Base tables a plan fragment reads (recursive over all children)."""
+    out: set = set()
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        tb = getattr(node, "table", None)
+        if isinstance(tb, str):
+            out.add(tb)
+        stack.extend(node.children())
+    return out
+
+
 def concat_batches(batches: list[ColumnBatch]) -> ColumnBatch:
     batches = [b for b in batches if b is not None]
     if not batches:
@@ -157,12 +170,23 @@ class DistExecutor:
         frag_schemas = {f.index: f.root.schema for f in dplan.fragments}
         for frag in dplan.fragments:
             outs: dict[int, ColumnBatch] = {}
-            # a transaction's own uncommitted writes exist only in the
-            # coordinator's stores: such statements stay local
-            can_remote = not self.own_writes
+            # A transaction's own uncommitted writes exist only in the
+            # coordinator's stores (rows reach the WAL — and thus the DN
+            # standbys — at commit). A fragment may still run remotely on
+            # node n when NONE of the tables it scans were written by
+            # this transaction on n (execRemote.c keeps the same
+            # rule per-relation via the command-id visibility check).
+            frag_tables = _scan_tables(frag.root)
+
+            def can_remote(n):
+                touched = self.own_writes.get(n)
+                return not touched or not (
+                    frag_tables & set(touched.keys())
+                )
+
             remote = [
                 n for n in frag.nodes
-                if can_remote and n in self.dn_channels
+                if n in self.dn_channels and can_remote(n)
             ]
             local = [n for n in frag.nodes if n not in remote]
             # remote fragments run concurrently in their DN processes

@@ -394,6 +394,33 @@ def _detect_gsort(agg, root, orientation):
     }
 
 
+def _detect_gagg(agg, topk):
+    """Eligibility for the sort-based grouped-agg + top-k formulation
+    with NO build-side requirement (the ClickBench shape: GROUP BY
+    high-cardinality key ORDER BY agg LIMIT k). Groups become runs of a
+    single packed-key sort; aggregates are prefix-sum differences; only
+    k rows ship. Requires: every ORDER BY position is an AGGREGATE
+    column (the packed group key preserves equality, not order) and
+    specs are sum/count."""
+    if not agg.group_exprs:
+        return None
+    k, sspecs, _merged = topk
+    nkeys = len(agg.group_exprs)
+    if any(p < nkeys for p, _d, _nf in sspecs):
+        return None
+    for a in agg.aggs:
+        if a.func == "count":
+            continue
+        if a.func != "sum":
+            return None
+    for g in agg.group_exprs:
+        if not (
+            g.type.id in _JOINABLE_KEY_TYPES or g.type.is_text
+        ):
+            return None
+    return True
+
+
 def _build_side_node(root):
     """The top join node under ``root`` (Filters stripped), or None."""
     node = root
@@ -754,6 +781,9 @@ class DagRunner:
         self._caps: dict = {}
         self.completed = 0  # DAG runs that produced the final batch
         self.last_mode = None  # final-fragment mode of the last run
+        # bounded log of plans that fell back to the host path and why —
+        # surfaced through pg_stat_fused so demotion is NEVER silent
+        self.unsupported: list = []
 
     # -- public ----------------------------------------------------------
     def run(
@@ -768,7 +798,9 @@ class DagRunner:
             return self._run(
                 dplan, snapshot_ts, dicts_view, subquery_values
             )
-        except DagUnsupported:
+        except DagUnsupported as e:
+            self.unsupported.append(str(e) or type(e).__name__)
+            del self.unsupported[:-64]
             return None
 
     def _run(self, dplan, snapshot_ts, dicts_view, subquery_values):
@@ -785,8 +817,11 @@ class DagRunner:
         final_root = final.root
         while isinstance(final_root, (L.Sort, L.Limit, L.Distinct)):
             final_root = final_root.child
+        probe_root = final_root
+        if isinstance(probe_root, L.Project):
+            probe_root = probe_root.child
         if len(frags) == 1 and not (
-            isinstance(final_root, L.Aggregate)
+            isinstance(probe_root, L.Aggregate)
             or _contains_join(final_root)
         ):
             # a bare scan chain: the host path answers faster than a
@@ -1287,7 +1322,11 @@ class DagRunner:
         if (
             isinstance(root, L.Project)
             and isinstance(root.child, L.Aggregate)
+            and root.child.group_exprs  # scalar partials need the
+            # coordinator merge; shipping D per-device rows un-merged
+            # would surface as D result rows
             and all(isinstance(e, E.Col) for e in root.exprs)
+            and len({c.name for c in root.schema}) == len(root.schema)
         ):
             out_proj = (
                 tuple(e.index for e in root.exprs), root.schema
@@ -1360,6 +1399,7 @@ class DagRunner:
             # trivially complete) > plain grouped/rows/scalar
             bg = None
             gs = None
+            ga = None
             psum = False
             use_topk = tk is not None
             if use_topk and agg is not None and (D == 1 or complete):
@@ -1368,7 +1408,9 @@ class DagRunner:
                 # sharding (per-device runs aren't group-aligned across
                 # devices, so partials can't psum)
                 gs = _detect_gsort(agg, root, orientation)
-            if use_topk and agg is not None and gs is None:
+                if gs is None:
+                    ga = _detect_gagg(agg, tk)
+            if use_topk and agg is not None and gs is None and ga is None:
                 bg = _detect_build_group(agg, root, orientation)
                 if bg is not None and D > 1 and not complete:
                     join = _build_side_node(root)
@@ -1390,7 +1432,7 @@ class DagRunner:
             fkey = (
                 "final", skey, orientation, gcap, D, sig, packing,
                 tk if use_topk else None, bg is not None, psum,
-                gs is not None,
+                gs is not None, ga is not None,
             )
             cached = self._programs.get(fkey)
             if cached is None:
@@ -1399,6 +1441,14 @@ class DagRunner:
                     b = _Builder(self.fx, comp, orientation, root)
                     cached = self._compile_gsort(
                         b, comp, agg, gs, root, exchanged, tk, D,
+                        _count_inner_joins(root),
+                    )
+                elif ga is not None:
+                    comp = ExprCompiler(lift_consts=True)
+                    b = _Builder(self.fx, comp, orientation, root)
+                    ev = b.build(root, exchanged, D)
+                    cached = self._compile_gagg(
+                        b, ev, comp, agg, root, tk, D,
                         _count_inner_joins(root),
                     )
                 else:
@@ -1423,7 +1473,7 @@ class DagRunner:
             self.last_mode = mode
             okf = None
             ngroups = None
-            if mode in ("gseg", "gsort"):
+            if mode in ("gseg", "gsort", "gagg"):
                 out_keys, out_vals, gvalid, okf, flags = outs
             elif mode == "grouped_topk":
                 out_keys, out_vals, gvalid, ngroups, okf, flags = outs
@@ -1455,7 +1505,7 @@ class DagRunner:
                     self._topk_off.pop(next(iter(self._topk_off)))
                 tk = None
                 continue
-            if mode in ("gseg", "gsort"):
+            if mode in ("gseg", "gsort", "gagg"):
                 self._orientations[skey] = orientation
                 if not complete:
                     # psum/D==1: every device holds the SAME complete
@@ -1655,6 +1705,180 @@ class DagRunner:
             )(arrays)
 
         return jax.jit(program), comp, "gseg"
+
+    def _compile_gagg(self, b, ev, comp, agg, root, topk, D, nflags):
+        """Grouped aggregation + top-k as ONE sort + prefix scans, no
+        join required (reference shape: nodeAgg.c hashed grouping +
+        LIMIT pushdown). Rows co-sort by the runtime-packed group key;
+        groups are runs; sums/counts are prefix differences against a
+        cummax-propagated run base; ranking happens at run-END positions
+        where every aggregate is final. High-cardinality GROUP BY never
+        touches a scatter or a multi-pass argsort, and only LIMIT rows
+        leave the device."""
+        dids = [c.dict_id for c in root.schema]
+        gfns = [comp.compile(g, dids) for g in agg.group_exprs]
+        specs: list[str] = []
+        afns: list = []
+        for a in agg.aggs:
+            if a.func == "count" and a.arg is None:
+                specs.append("count_star")
+                afns.append(None)
+            else:
+                specs.append(a.func)
+                afns.append(comp.compile(a.arg, dids))
+        k, sspecs, _merged = topk
+        nkeys = len(agg.group_exprs)
+        naggs = len(agg.aggs)
+        mesh = self.fx.mesh
+
+        def program(arrays, params, snap):
+            def block(blocks):
+                env, mask, n, flags = ev(blocks, params, snap)
+                flags = [jnp.reshape(f, (1,)) for f in flags]
+                keys = [_bcast(fn(env, params), n) for fn in gfns]
+                packed, pok = _pack_group_keys(keys, mask)
+                ok = pok
+                BIGK = jnp.int64(2**62)
+                operands = [jnp.where(mask, packed, BIGK)]
+                val_pos: list = []
+                for fn in afns:
+                    if fn is None:
+                        val_pos.append(None)
+                        continue
+                    d, v = _bcast(fn(env, params), n)
+                    if jnp.issubdtype(d.dtype, jnp.integer):
+                        d = d.astype(jnp.int64)
+                    elif jnp.issubdtype(d.dtype, jnp.floating):
+                        d = d.astype(jnp.float64)
+                    vv = mask if v is None else (mask & v)
+                    operands.append(
+                        jnp.where(vv, d, jnp.zeros((), d.dtype))
+                    )
+                    vi = None
+                    if v is not None:
+                        vi = len(operands)
+                        operands.append(vv.astype(jnp.int8))
+                    val_pos.append((len(operands) - (2 if vi else 1), vi))
+                rid_i = len(operands)
+                operands.append(jnp.arange(n, dtype=jnp.int32))
+                sorted_ops = jax.lax.sort(
+                    tuple(operands), num_keys=1, is_stable=False
+                )
+                salk = sorted_ops[0]
+                boundary = jnp.concatenate([
+                    jnp.ones(1, jnp.bool_), salk[1:] != salk[:-1]
+                ])
+                end = jnp.concatenate([
+                    boundary[1:], jnp.ones(1, jnp.bool_)
+                ])
+                live_end = end & (salk < BIGK)
+
+                def run_from_start(cs, own):
+                    # aggregate value at any position = prefix minus the
+                    # prefix just before the run start (propagated by a
+                    # cummax — valid because cs is monotone)
+                    base = jax.lax.cummax(
+                        jnp.where(
+                            boundary, cs - own,
+                            jnp.asarray(-1, dtype=cs.dtype),
+                        )
+                    )
+                    return cs - base
+
+                run_cnt = None
+
+                def get_run_cnt():
+                    nonlocal run_cnt
+                    if run_cnt is None:
+                        lv = (salk < BIGK).astype(jnp.int32)
+                        run_cnt = run_from_start(jnp.cumsum(lv), lv)
+                    return run_cnt
+
+                out_vals_pos = []
+                for spec, vp in zip(specs, val_pos):
+                    if spec == "count_star":
+                        c = get_run_cnt()
+                        out_vals_pos.append(
+                            (c.astype(jnp.int64), c > 0)
+                        )
+                        continue
+                    oi, vi = vp
+                    sval = sorted_ops[oi]
+                    if vi is not None:
+                        lv = sorted_ops[vi].astype(jnp.int32)
+                        vcnt = run_from_start(jnp.cumsum(lv), lv)
+                        vvalid = vcnt > 0
+                    else:
+                        vvalid = live_end
+                    if spec == "count":
+                        c = (
+                            vcnt if vi is not None else get_run_cnt()
+                        )
+                        out_vals_pos.append(
+                            (c.astype(jnp.int64), live_end)
+                        )
+                        continue
+                    ok = ok & ~(jnp.min(sval) < 0)
+                    cs = jnp.cumsum(sval)
+                    if not jnp.issubdtype(cs.dtype, jnp.floating):
+                        ok = ok & (cs[-1] < jnp.int64(2**62)) & (
+                            cs[-1] >= 0
+                        )
+                    out_vals_pos.append(
+                        (run_from_start(cs, sval), vvalid)
+                    )
+
+                stride = jnp.int64(1)
+                prod = jnp.float64(1.0)
+                packed_rank = jnp.zeros(n, dtype=jnp.int64)
+                for p, desc, nf in reversed(sspecs):
+                    d64, v = out_vals_pos[p - nkeys]
+                    x, r, rf, okbit = _rank_encode(
+                        d64.astype(jnp.int64), v, desc, nf, live_end
+                    )
+                    packed_rank = packed_rank + x * stride
+                    stride = stride * r
+                    prod = prod * jnp.maximum(rf, 1.0)
+                    ok = ok & okbit
+                ok = ok & (prod < jnp.float64(2**62))
+
+                idx, sel = _topk_idx(packed_rank, live_end, k)
+                row_k = jnp.take(sorted_ops[rid_i], idx)
+                out_keys = []
+                for d, v in keys:
+                    dk = jnp.take(jnp.broadcast_to(d, (n,)), row_k)
+                    vk = (
+                        jnp.ones(k, jnp.bool_)
+                        if v is None
+                        else jnp.take(jnp.broadcast_to(v, (n,)), row_k)
+                    )
+                    out_keys.append((dk, vk))
+                out_vals = [
+                    (jnp.take(dd, idx), jnp.take(vv, idx))
+                    for dd, vv in out_vals_pos
+                ]
+                return (
+                    jax.tree.map(lambda x: x[None], out_keys),
+                    jax.tree.map(lambda x: x[None], out_vals),
+                    sel[None],
+                    jnp.reshape(ok, (1,)),
+                    flags,
+                )
+
+            return shard_map(
+                block,
+                mesh=mesh,
+                in_specs=(_specs_like(arrays),),
+                out_specs=(
+                    [(P("dn"), P("dn"))] * nkeys,
+                    [(P("dn"), P("dn"))] * naggs,
+                    P("dn"),
+                    P("dn"),
+                    [P("dn")] * nflags,
+                ),
+            )(arrays)
+
+        return jax.jit(program), comp, "gagg"
 
     def _compile_gsort(
         self, b, comp, agg, gs, root, exchanged, topk, D, nflags

@@ -322,26 +322,18 @@ class LocalExecutor:
             return None
         if not meta.zone_cols:
             return None
+        from opentenbase_tpu.storage.table import (
+            zone_candidate_blocks,
+            zone_usable_bounds,
+        )
+
         bounds = _predicate_bounds(pred, plan)
-        usable = {
-            c: b for c, b in bounds.items()
-            if c in meta.zone_cols
-            and not plan.schema[plan.columns.index(c)].type.is_text
-        }
+        usable = zone_usable_bounds(bounds, meta, plan)
         if not usable:
             return None
         b = store.ZONE_BLOCK
         nblocks = -(-store.nrows // b)
-        sel = np.ones(nblocks, dtype=bool)
-        for col, (lo, hi) in usable.items():
-            zm = store.zone_map(col)
-            if zm is None:
-                continue
-            mins, maxs = zm
-            if lo is not None:
-                sel &= maxs >= lo
-            if hi is not None:
-                sel &= mins <= hi
+        sel = zone_candidate_blocks(store, usable)
         self.zone_total_blocks = getattr(self, "zone_total_blocks", 0) + nblocks
         nsel = int(sel.sum())
         if nsel == nblocks:

@@ -289,9 +289,15 @@ def _mxu_group_reduce_impl(keys, vals, slot, num_groups: int, specs: tuple):
         )
         return acc + jnp.sum(part.astype(jnp.int64), axis=0), None
 
+    # the init carry derives from ``slot`` so its varying-manual-axes
+    # match inside shard_map (a plain zeros init is replicated and the
+    # scan body's output — computed from sharded operands — is varying)
+    acc0 = jnp.zeros((cap, K), dtype=jnp.int64) + (
+        slot_b[0, 0, 0] * 0
+    ).astype(jnp.int64)
     totals, _ = jax.lax.scan(
         step,
-        jnp.zeros((cap, K), dtype=jnp.int64),
+        acc0,
         (slot_b, *raw),
     )  # [cap, K]
 

@@ -100,6 +100,7 @@ class StandbyCluster:
         from opentenbase_tpu.engine import Cluster
 
         os.makedirs(data_dir, exist_ok=True)
+        self.data_dir = data_dir
         self.cluster = Cluster(num_datanodes, shard_groups, data_dir)
         self.cluster.read_only = True
         p = self.cluster.persistence

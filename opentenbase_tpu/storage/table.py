@@ -315,3 +315,34 @@ class ShardStore:
         self.version += 1
         self.structure_version += 1  # row positions rewritten
         return ndead
+
+
+def zone_usable_bounds(bounds: dict, meta, scan) -> dict:
+    """Filter predicate bounds down to zone-indexed, non-text columns —
+    the ONE eligibility rule shared by the host scan pruner
+    (executor/local.py) and the fused device window
+    (executor/fused.py)."""
+    return {
+        c: b for c, b in bounds.items()
+        if c in meta.zone_cols
+        and not scan.schema[scan.columns.index(c)].type.is_text
+    }
+
+
+def zone_candidate_blocks(store, usable: dict):
+    """Boolean candidate mask over a store's zone blocks for per-column
+    [lo, hi] bounds: False = PROVEN to contain no matching row. The ONE
+    definition of the min/max intersection both pruning paths use."""
+    b = store.ZONE_BLOCK
+    nblocks = -(-store.nrows // b) if store.nrows else 0
+    sel = np.ones(nblocks, dtype=bool)
+    for col, (lo, hi) in usable.items():
+        zm = store.zone_map(col)
+        if zm is None:
+            continue
+        mins, maxs = zm
+        if lo is not None:
+            sel &= maxs >= lo
+        if hi is not None:
+            sel &= mins <= hi
+    return sel

@@ -139,3 +139,106 @@ def test_pool_reuses_channels(topology):
     pool = c.dn_channels[0]
     assert pool.stats["acquired"] >= 3
     assert pool.stats["opened"] <= 2  # warm channels were reused
+
+
+def test_writing_txn_still_reads_other_tables_remotely(topology):
+    """A transaction that wrote table u must still run fragments over
+    table t in the DN processes (VERDICT r2: writes used to disable ALL
+    remote execution; the rule is now per-fragment table overlap)."""
+    c, s = topology
+    s.execute("set enable_fused_execution = off")
+    s.execute("create table u (k bigint, w bigint) distribute by shard(k)")
+    s.execute("begin")
+    s.execute("insert into u values (1, 10), (2, 20)")
+    from opentenbase_tpu.executor.dist import DistExecutor
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.plan.distribute import distribute_statement
+    from opentenbase_tpu.plan.optimize import optimize_statement
+    from opentenbase_tpu.sql.parser import parse
+
+    sp = optimize_statement(
+        analyze_statement(parse("select count(*) from t")[0], c.catalog),
+        c.catalog,
+    )
+    dp = distribute_statement(sp, c.catalog)
+    ex = DistExecutor(
+        c.catalog, c.stores, c.gts.snapshot_ts(),
+        own_writes=s.txn.own_writes_view(),
+        dn_channels=c.dn_channels,
+        min_lsn=c.persistence.wal.position,
+    )
+    out = ex.run(dp)
+    assert any(i.get("remote") for i in ex.instrumentation), (
+        "fragment over an un-written table should run remotely"
+    )
+    assert out.to_rows()[0][0] == 500
+    # ...but a fragment over the WRITTEN table stays local (uncommitted
+    # rows exist only in the coordinator)
+    sp2 = optimize_statement(
+        analyze_statement(parse("select count(*) from u")[0], c.catalog),
+        c.catalog,
+    )
+    dp2 = distribute_statement(sp2, c.catalog)
+    ex2 = DistExecutor(
+        c.catalog, c.stores, c.gts.snapshot_ts(),
+        own_writes=s.txn.own_writes_view(),
+        dn_channels=c.dn_channels,
+        min_lsn=c.persistence.wal.position,
+    )
+    out2 = ex2.run(dp2)
+    assert not any(i.get("remote") for i in ex2.instrumentation)
+    assert out2.to_rows()[0][0] == 2
+    s.execute("commit")
+
+
+def test_implicit_2pc_votes_on_dn_processes(topology):
+    """A multi-node write commits through implicit 2PC: every DN process
+    journals the vote at prepare and retires it at commit-prepared
+    (execRemote.c:3936 analog across a real process boundary)."""
+    c, s = topology
+    # rows routed to both datanodes -> 2 participants -> implicit 2PC
+    s.execute("begin")
+    s.execute("insert into t values " + ",".join(
+        f"({i}, 0.10, 'w')" for i in range(6000, 6040)
+    ))
+    s.execute("commit")
+    # both DN journals must be empty again (prepare happened, then
+    # commit retired the vote)
+    for n, ch in c.dn_channels.items():
+        resp = ch.rpc({"op": "2pc_list"})
+        assert resp.get("gids") == [], (n, resp)
+    # and the rows are visible through the DN processes
+    out = _fragments_ran_remotely(
+        s, "select count(*) from t where k >= 6000"
+    )
+    assert out.to_rows()[0][0] == 40
+
+
+def test_explicit_2pc_journal_and_orphan_sweep(topology):
+    """PREPARE TRANSACTION journals on the DN processes; a lost phase-2
+    message leaves an orphan that clean_2pc retires."""
+    c, s = topology
+    s.execute("begin")
+    s.execute("insert into t values " + ",".join(
+        f"({i}, 0.20, 'p')" for i in range(7000, 7040)
+    ))
+    s.execute("prepare transaction 'gid_dn_test'")
+    gids = {
+        n: ch.rpc({"op": "2pc_list"}).get("gids", [])
+        for n, ch in c.dn_channels.items()
+    }
+    assert any("gid_dn_test" in g for g in gids.values()), gids
+    s.execute("commit prepared 'gid_dn_test'")
+    for n, ch in c.dn_channels.items():
+        assert "gid_dn_test" not in ch.rpc({"op": "2pc_list"}).get(
+            "gids", []
+        )
+    # orphan: journal a vote no coordinator state knows about
+    c.dn_channels[0].rpc({
+        "op": "2pc_prepare", "gid": "orphan_gid", "gxid": 999999,
+    })
+    resolved = c.clean_2pc(max_age_s=0.0)
+    assert any("orphan_gid" in r for r in resolved), resolved
+    assert "orphan_gid" not in c.dn_channels[0].rpc(
+        {"op": "2pc_list"}
+    ).get("gids", [])

@@ -540,7 +540,8 @@ def test_topk_ships_only_limit_rows(sess):
 
 def test_grouped_topk_mode_when_group_not_on_build(sess):
     """Grouping by a PROBE-side non-key column can't use the build-row
-    segment trick but still ranks on device at mesh size 1."""
+    segment trick; with an agg-only ORDER BY it rides the no-join
+    sorted-runs path (gagg) at mesh size 1."""
     q = (
         "select l_shipdate, sum(l_extendedprice) from orders, lineitem "
         "where o_orderkey = l_orderkey group by l_shipdate "
@@ -552,7 +553,7 @@ def test_grouped_topk_mode_when_group_not_on_build(sess):
     runner = _mesh1_runner(sess)
     got = _run_mesh1(sess, runner, q)
     assert got == want
-    assert runner.last_mode == "grouped_topk", runner.last_mode
+    assert runner.last_mode == "gagg", runner.last_mode
 
 
 def test_rows_topk_mode(sess):
@@ -615,3 +616,92 @@ def test_count_star_via_gsort(sess):
     got = _run_mesh1(sess, runner, q)
     assert got == want
     assert runner.last_mode == "gsort", runner.last_mode
+
+
+def test_demotion_is_loud_not_silent(sess):
+    """An unexpected exception inside the fused path must (a) not break
+    the query — the host path answers — and (b) land in pg_stat_fused
+    (VERDICT r2: the blanket except may never demote invisibly)."""
+    s = sess
+    fx = s.cluster.fused_executor()
+    q = (
+        "select o_shippriority, sum(l_extendedprice) from orders, "
+        "lineitem where o_orderkey = l_orderkey group by o_shippriority "
+        "order by o_shippriority"
+    )
+    s.execute("set enable_fused_execution = off")
+    want = s.query(q)
+    s.execute("set enable_fused_execution = on")
+    orig = fx.dag_output
+    fx.dag_output = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("injected fused failure")
+    )
+    try:
+        before = len(fx.dag_demotions)
+        got = s.query(q)
+        assert got == want  # host path answered
+        assert len(fx.dag_demotions) == before + 1
+        assert "injected fused failure" in fx.dag_demotions[-1]
+        stat = s.query(
+            "select count(*) from pg_stat_fused where event = 'demoted'"
+        )
+        assert stat[0][0] >= 1
+    finally:
+        fx.dag_output = orig
+
+
+def test_unsupported_fallback_reason_recorded(sess):
+    """Plans outside the DAG subset must leave a reason in
+    pg_stat_fused rather than vanishing to the host path."""
+    s = sess
+    fx = s.cluster.fused_executor()
+    # a left join with an ORDER BY/LIMIT shape routes to the DAG runner
+    # first and is outside its subset -> the reason must be recorded
+    q = (
+        "select o_orderkey from orders left join lineitem "
+        "on o_orderkey = l_orderkey order by o_orderkey limit 3"
+    )
+    s.execute("set enable_fused_execution = on")
+    s.query(q)
+    assert fx._dag is not None and fx._dag.unsupported, (
+        "DAG fallback left no reason"
+    )
+    reasons = s.query(
+        "select count(*) from pg_stat_fused "
+        "where event = 'unsupported'"
+    )
+    assert reasons[0][0] >= 1
+
+
+def test_gagg_mode_clickbench_shape(sess):
+    """High-cardinality GROUP BY + ORDER BY agg LIMIT (the ClickBench
+    hot pattern) rides the no-join sort formulation."""
+    q = (
+        "select l_orderkey, count(*), sum(l_extendedprice) "
+        "from lineitem group by l_orderkey "
+        "order by 2 desc, 3 desc limit 8"
+    )
+    sess.execute("set enable_fused_execution = off")
+    want = sess.query(q)
+    sess.execute("set enable_fused_execution = on")
+    runner = _mesh1_runner(sess)
+    got = _run_mesh1(sess, runner, q)
+    assert got == want
+    assert runner.last_mode == "gagg", runner.last_mode
+
+
+def test_gagg_group_col_order_falls_back(sess):
+    """ORDER BY on a group column can't ride the packed-key runs (packed
+    preserves equality, not order) — falls to the grouped path and still
+    matches the host."""
+    q = (
+        "select l_orderkey, sum(l_extendedprice) from lineitem "
+        "group by l_orderkey order by l_orderkey limit 8"
+    )
+    sess.execute("set enable_fused_execution = off")
+    want = sess.query(q)
+    sess.execute("set enable_fused_execution = on")
+    runner = _mesh1_runner(sess)
+    got = _run_mesh1(sess, runner, q)
+    assert got == want
+    assert runner.last_mode != "gagg", runner.last_mode
