@@ -82,6 +82,12 @@ def _enum(*allowed):
 # behavior; None means "engine decides" (e.g. backend-dependent).
 GUCS: dict = {
     "enable_fused_execution": (_bool, True),
+    # wire encryption (be-secure.c): the coordinator front end wraps
+    # every accepted socket in TLS when ssl=on; plaintext clients are
+    # rejected at the handshake
+    "ssl": (_bool, False),
+    "ssl_cert_file": (_str, ""),
+    "ssl_key_file": (_str, ""),
     "enable_pallas_scan": (_bool, None),
     "enable_fast_query_shipping": (_bool, True),
     "lock_timeout": (_duration, 0),

@@ -25,6 +25,7 @@ recipe (mesh → shardings → XLA inserts collectives).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional
@@ -97,6 +98,11 @@ class DeviceCache:
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
         self._tables: dict[str, DeviceTable] = {}
+        # concurrent readers may both miss and upload; the map itself
+        # must never be mutated mid-iteration (window eviction iterates)
+        import threading as _threading
+
+        self._mu = _threading.RLock()
         self.stats = {
             "hits": 0,
             "full_uploads": 0,
@@ -120,6 +126,14 @@ class DeviceCache:
         want = tuple(columns) if columns is not None else tuple(meta.schema)
         stores = [node_stores[n][name] for n in nodes]
         versions = tuple(s.version for s in stores)
+        with self._mu:
+            return self._get_locked(
+                name, meta, stores, nodes, want, versions
+            )
+
+    def _get_locked(
+        self, name, meta, stores, nodes, want, versions
+    ) -> DeviceTable:
         cached = self._tables.get((name, nodes))
         if cached is not None and cached.versions == versions and (
             cached.node_order == nodes
@@ -165,6 +179,103 @@ class DeviceCache:
         )
         self._ensure_columns(dt, stores, meta, want)
         self._tables[(name, nodes)] = dt
+        return dt
+
+    def get_window(
+        self, name: str, meta, node_stores: dict[int, dict], nodes,
+        columns, start: int, length: int,
+    ) -> DeviceTable:
+        """A DeviceTable over row window [start, start+length) of every
+        shard — the streaming unit for tables bigger than the HBM
+        budget. Only the MOST RECENT window of a table stays resident
+        (sequential scans revisit windows in order, and keeping more
+        would defeat the point of chunking). Any full-table residency
+        for the same table is evicted first."""
+        nodes = tuple(nodes)
+        want = tuple(sorted(columns))
+        stores = [node_stores[n][name] for n in nodes]
+        versions = tuple(s.version for s in stores)
+        wkey = (name, nodes, "win", start, length, want)
+        self._mu.acquire()
+        try:
+            return self._get_window_locked(
+                wkey, name, meta, stores, nodes, want, versions,
+                start, length,
+            )
+        finally:
+            self._mu.release()
+
+    def _get_window_locked(
+        self, wkey, name, meta, stores, nodes, want, versions,
+        start, length,
+    ) -> DeviceTable:
+        cached = self._tables.get(wkey)
+        if cached is not None and cached.versions == versions:
+            self.stats["hits"] += 1
+            return cached
+        # evict every other residency of this table (full or windowed)
+        for k in [
+            k for k in self._tables
+            if k[0] == name and k[1] == nodes and k != wkey
+        ]:
+            del self._tables[k]
+        self.stats["window_uploads"] = (
+            self.stats.get("window_uploads", 0) + 1
+        )
+        S = _pad_shards(len(stores), self.mesh.shape["dn"])
+        W = filt_ops.bucket_size(max(length, 1))
+        sharding = NamedSharding(self.mesh, P("dn"))
+        xmin = np.full((S, W), 2**62, dtype=np.int64)
+        xmax = np.zeros((S, W), dtype=np.int64)
+        nrows = np.zeros(S, dtype=np.int64)
+        for i, s in enumerate(stores):
+            n = max(min(s.nrows - start, length), 0)
+            if n:
+                xmin[i, :n] = s.xmin_ts[start:start + n]
+                xmax[i, :n] = s.xmax_ts[start:start + n]
+            nrows[i] = n
+        cols: dict = {}
+        valids: dict = {}
+        for cname in want:
+            ty = meta.schema[cname]
+            stack = np.zeros((S, W), dtype=ty.np_dtype)
+            vstack = None
+            for i, s in enumerate(stores):
+                n = max(min(s.nrows - start, length), 0)
+                if not n:
+                    continue
+                stack[i, :n] = s.column_array(cname)[start:start + n]
+                vm = s._validity.get(cname)
+                if vm is not None:
+                    if vstack is None:
+                        vstack = np.ones((S, W), dtype=np.bool_)
+                    vstack[i, :n] = vm[start:start + n]
+            cols[cname] = jax.device_put(stack, sharding)
+            valids[cname] = (
+                None if vstack is None
+                else jax.device_put(vstack, sharding)
+            )
+        dt = DeviceTable(
+            cols,
+            valids,
+            jax.device_put(xmin, sharding),
+            jax.device_put(xmax, sharding),
+            nrows,
+            W,
+            versions,
+            nodes,
+            {},
+            {},
+            [
+                {
+                    "nrows": s.nrows,
+                    "structure": s.structure_version,
+                    "mvcc_seq": s.mvcc_seq,
+                }
+                for s in stores
+            ],
+        )
+        self._tables[wkey] = dt
         return dt
 
     def _ensure_columns(self, dt: DeviceTable, stores, meta, want) -> None:
@@ -331,6 +442,15 @@ class _FusablePartial:
     agg: L.Aggregate
 
 
+# Resident-cache ceiling for one table's scan columns: beyond this the
+# fused path streams fixed-width shard windows instead of caching the
+# whole table in HBM (one v5e has 16 GB; leave room for intermediates
+# and other tables).
+SCAN_HBM_BUDGET = int(
+    os.environ.get("OTB_SCAN_HBM_BUDGET", 8_000_000_000)
+)
+
+
 def _match_partial_fragment(root: L.LogicalPlan) -> Optional[_FusablePartial]:
     if not isinstance(root, L.Aggregate):
         return None
@@ -426,6 +546,18 @@ class FusedExecutor:
         for n in frag.nodes:
             if m.scan.table not in self.node_stores.get(n, {}):
                 return None
+
+        # bigger-than-HBM tables STREAM: shard-row windows run through
+        # one windowed program sequentially; partial outputs concat and
+        # the coordinator merge combines them exactly like any other
+        # partials (reference: work_mem batching — nodeHash.c
+        # ExecHashIncreaseNumBatches, tuplestore.c spill)
+        if self._resident_bytes(meta, m.scan.columns) > SCAN_HBM_BUDGET:
+            return self._fragment_chunked(
+                m, meta, snapshot_ts, dicts_view, subquery_values,
+                group_cap,
+            )
+
         dtab = self.cache.get(
             m.scan.table, meta, self.node_stores, columns=m.scan.columns
         )
@@ -440,16 +572,25 @@ class FusedExecutor:
         # padded width (reference: src/backend/access/brin/brin.c — the
         # host LocalExecutor got this in r2, the fused path now too)
         zone = self._zone_window(m, meta, dtab)
+        return self._run_xla_fragment(
+            m, meta, dtab, zone, snapshot_ts, dicts_view,
+            subquery_values, group_cap,
+        )
 
+    def _run_xla_fragment(
+        self, m, meta, dtab, zone, snapshot_ts, dicts_view,
+        subquery_values, group_cap,
+    ) -> ColumnBatch:
         has_valid = tuple(
             dtab.validity[c] is not None for c in m.scan.columns
         )
         # structural key: literals are lifted to params, so queries
         # differing only in constants reuse the compiled program
+        # (m.agg IS the fragment root — the match requires it topmost)
         try:
-            skey = plan_skey(frag.root)
+            skey = plan_skey(m.agg)
         except NotImplementedError:
-            skey = frag.root.key()
+            skey = m.agg.key()
 
         def run_mode(grouping: str, cap: int = group_cap):
             win = zone[1] if zone is not None else None
@@ -510,6 +651,84 @@ class FusedExecutor:
             if not is_collision(e):
                 raise
             return run_mode("sort", group_cap)
+
+    def _scan_footprint(self, meta, columns) -> tuple[int, int, int, int]:
+        """(resident_bytes, row_bytes, S, max_shard_rows) for caching a
+        table's scan columns (+16B/row of MVCC timestamps) at padded
+        width — the ONE footprint model the chunk trigger and the window
+        sizing both use."""
+        row_bytes = 16 + sum(
+            np.dtype(meta.schema[c].np_dtype).itemsize + 1
+            for c in columns
+        )
+        mx = 0
+        for n in meta.node_indices:
+            s = self.node_stores.get(n, {}).get(meta.name)
+            if s is not None:
+                mx = max(mx, s.nrows)
+        rmax = filt_ops.bucket_size(max(mx, 1))
+        S = _pad_shards(len(meta.node_indices), self.mesh.shape["dn"])
+        return S * rmax * row_bytes, row_bytes, S, mx
+
+    def _resident_bytes(self, meta, columns) -> int:
+        return self._scan_footprint(meta, columns)[0]
+
+    def _fragment_chunked(
+        self, m, meta, snapshot_ts, dicts_view, subquery_values,
+        group_cap,
+    ) -> ColumnBatch:
+        """Stream a bigger-than-HBM scan: fixed-width shard-row windows
+        upload, run the (same, cached) windowed program, and free; the
+        concatenated window partials are ordinary partial-agg rows the
+        coordinator merge combines. Pallas and zone windows are skipped
+        here — the streaming upload dominates and the window program is
+        already minimal."""
+        from opentenbase_tpu.executor.dist import concat_batches
+
+        _bytes, row_bytes, S, mx = self._scan_footprint(
+            meta, m.scan.columns
+        )
+        budget_rows = max(
+            SCAN_HBM_BUDGET // max(S * row_bytes, 1), 4096
+        )
+        W = filt_ops.bucket_size(budget_rows)
+        if W > budget_rows:
+            W //= 2  # bucket rounding must not overshoot the budget
+        parts: list[ColumnBatch] = []
+        start = 0
+        nchunks = 0
+        while start < mx:
+            dtab = self.cache.get_window(
+                meta.name, meta, self.node_stores,
+                tuple(meta.node_indices), tuple(m.scan.columns),
+                start, W,
+            )
+            parts.append(
+                self._run_xla_fragment(
+                    m, meta, dtab, None, snapshot_ts, dicts_view,
+                    subquery_values, group_cap,
+                )
+            )
+            start += W
+            nchunks += 1
+        self.cache.stats["chunked_scans"] = (
+            self.cache.stats.get("chunked_scans", 0) + 1
+        )
+        self.cache.stats["scan_chunks"] = (
+            self.cache.stats.get("scan_chunks", 0) + nchunks
+        )
+        if not parts:
+            return self._run_xla_fragment(
+                m, meta,
+                self.cache.get_window(
+                    meta.name, meta, self.node_stores,
+                    tuple(meta.node_indices), tuple(m.scan.columns),
+                    0, 1,
+                ),
+                None, snapshot_ts, dicts_view, subquery_values,
+                group_cap,
+            )
+        return concat_batches(parts)
 
     def _zone_window(self, m: "_FusablePartial", meta, dtab):
         """Per-shard contiguous row window covering every zone-map

@@ -152,6 +152,7 @@ class LockManager:
         keys = tuple(keys)
         engine_lock = getattr(self._cluster, "_exec_lock", None)
         released_engine_lock = False
+        park_token = None
         start = time.monotonic()
         deadline = (
             start + lock_timeout_ms / 1000.0 if lock_timeout_ms else None
@@ -190,14 +191,21 @@ class LockManager:
                             )
                         dl_check_at = now + deadlock_timeout_ms / 1000.0
                     # park. Engine statement lock must not be held while
-                    # sleeping (see module docstring).
-                    if (
-                        not released_engine_lock
-                        and engine_lock is not None
-                        and engine_lock._is_owned()
-                    ):
-                        engine_lock.release()
-                        released_engine_lock = True
+                    # sleeping (see module docstring) — neither the
+                    # exclusive side NOR a shared group slot: a parked
+                    # table-granular writer holding its slot would keep
+                    # an exclusive committer (possibly the very blocker)
+                    # out forever.
+                    if not released_engine_lock and engine_lock is not None:
+                        if hasattr(engine_lock, "park_release"):
+                            tok = engine_lock.park_release()
+                            if tok is not None:
+                                park_token = tok
+                                released_engine_lock = True
+                        elif engine_lock._is_owned():
+                            engine_lock.release()
+                            park_token = ("x",)
+                            released_engine_lock = True
                     waitfor = min(
                         0.05,
                         max(0.0, dl_check_at - now),
@@ -217,7 +225,10 @@ class LockManager:
                 # from poisoning this session's next acquisition
                 self._victims.pop(session_id, None)
             if released_engine_lock:
-                engine_lock.acquire()
+                if hasattr(engine_lock, "park_reacquire"):
+                    engine_lock.park_reacquire(park_token)
+                else:
+                    engine_lock.acquire()
 
     def _grant(self, session_id, gxid, keys, mode) -> None:
         for key in keys:

@@ -41,9 +41,23 @@ class ClientSession:
         timeout: float = 30.0,
         user: str | None = None,
         password: str | None = None,
+        ssl: bool = False,
+        ssl_ca: str | None = None,
     ):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if ssl:
+            import ssl as _ssl
+
+            if ssl_ca:
+                ctx = _ssl.create_default_context(cafile=ssl_ca)
+                ctx.check_hostname = False  # self-signed deployments
+            else:
+                # sslmode=require semantics: encrypt, skip verification
+                ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_CLIENT)
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+            self._sock = ctx.wrap_socket(self._sock)
         if user is not None:
             self._authenticate(user, password or "")
 

@@ -40,7 +40,14 @@ def _walk_ast(node):
 
 
 class ClusterServer:
-    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        cluster,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        ssl_cert: Optional[str] = None,
+        ssl_key: Optional[str] = None,
+    ):
         self.cluster = cluster
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -52,6 +59,22 @@ class ClusterServer:
         self._conn_threads: list[threading.Thread] = []
         # engine-wide statement lock (owned by the Cluster; see docstring)
         self._exec_lock = cluster._exec_lock
+        # TLS (be-secure.c): explicit ctor args win, else the ssl* GUCs
+        # from <data_dir>/opentenbase.conf. With a context set, EVERY
+        # accepted socket must complete the handshake — a plaintext
+        # client is dropped at accept, so credentials and data never
+        # cross the wire unencrypted.
+        self._ssl_ctx = None
+        conf = getattr(cluster, "conf_gucs", {}) or {}
+        if ssl_cert is None and conf.get("ssl"):
+            ssl_cert = conf.get("ssl_cert_file") or None
+            ssl_key = conf.get("ssl_key_file") or None
+        if ssl_cert:
+            import ssl as _ssl
+
+            ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(ssl_cert, ssl_key or None)
+            self._ssl_ctx = ctx
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "ClusterServer":
@@ -99,6 +122,23 @@ class ClusterServer:
             self._conn_threads.append(t)
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self._ssl_ctx is not None:
+            # the handshake runs HERE, in the per-connection thread,
+            # with a timeout — a silent client must never stall the
+            # accept loop (be-secure.c does its handshake in the forked
+            # backend for the same reason)
+            try:
+                conn.settimeout(10.0)
+                conn = self._ssl_ctx.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)
+            except Exception:
+                # plaintext (or bad, or stalled) client against a
+                # TLS-required server: reject at the handshake
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
         session = self.cluster.session()
         # trust mode only while no users exist (pg_hba 'trust' vs
         # 'scram-sha-256'); once any role is created, the handshake is
@@ -131,8 +171,18 @@ class ClusterServer:
                     # DDL, and anything uncertain take it exclusively —
                     # the statement-level analog of the reference's
                     # lock-free MVCC readers
+                    wt = None
                     if self._is_readonly(sql, session):
                         with self._exec_lock.read():
+                            res = session.execute(sql)
+                    elif (
+                        wt := self._write_tables(sql, session)
+                    ) is not None:
+                        # plain autocommit DML: writers on DISJOINT
+                        # tables share the data plane (per-table
+                        # mutexes serialize same-table writers); DDL
+                        # and explicit transactions stay exclusive
+                        with self._exec_lock.write_tables(wt):
                             res = session.execute(sql)
                     else:
                         with self._exec_lock:
@@ -152,6 +202,54 @@ class ClusterServer:
             # abort any transaction left open by a dropped connection
             # (the backend-exit cleanup of the reference's tcop loop)
             self._conn_cleanup(session, conn)
+
+    def _write_tables(self, sql: str, session):
+        """Tables a plain autocommit DML statement writes, or None when
+        the statement must take the exclusive side: inside an explicit
+        transaction (its COMMIT touches every written table), DDL,
+        partitioned targets (children fan out), views, subquery sources
+        (which READ other tables — fine under the shared side, but the
+        statement also reads its source tables: include them so a writer
+        on the source serializes against us)."""
+        if session.txn is not None:
+            return None
+        try:
+            from opentenbase_tpu.sql import ast as A
+            from opentenbase_tpu.sql.parser import parse
+
+            stmts = parse(sql)
+            if len(stmts) != 1:
+                return None
+            st = stmts[0]
+            if not isinstance(st, (A.Insert, A.Update, A.Delete)):
+                return None
+            refs: set = {st.table}
+            if isinstance(st, A.Insert) and st.query is not None:
+                session._referenced_tables(st.query, refs)
+            # a subquery anywhere else (WHERE/SET/VALUES) reads tables
+            # this walk can't see: classify exclusive
+            for node in _walk_ast(st):
+                if isinstance(
+                    node,
+                    (A.InSubquery, A.ExistsSubquery, A.ScalarSubquery),
+                ):
+                    return None
+            if getattr(st, "returning", None):
+                pass  # RETURNING reads only the written table
+            cat = self.cluster.catalog
+            for tb in refs:
+                if not cat.has(tb):
+                    return None
+                if tb in self.cluster.partitions:
+                    return None
+                if tb in self.cluster.views:
+                    return None
+                meta = cat.get(tb)
+                if getattr(meta, "foreign", None) is not None:
+                    return None
+            return refs
+        except Exception:
+            return None
 
     def _is_readonly(self, sql: str, session) -> bool:
         """True only when the statement provably reads: a single plain

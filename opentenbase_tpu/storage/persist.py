@@ -69,6 +69,11 @@ class WAL:
                 with open(path, "r+b") as f:
                     f.truncate(end)
         self._f = open(path, "ab")
+        # concurrent writers (table-granular statement gating) must not
+        # interleave record bytes: one append = one atomic frame
+        import threading as _threading
+
+        self._mu = _threading.Lock()
 
     def append(self, tag: bytes, header: dict, arrays: Optional[dict] = None) -> int:
         hdr = json.dumps(header).encode()
@@ -78,10 +83,11 @@ class WAL:
             np.savez(buf, **arrays)
             payload += buf.getvalue()
         rec = struct.pack("<IB", 1 + len(payload), tag[0]) + payload
-        self._f.write(rec)
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        return self._f.tell()
+        with self._mu:
+            self._f.write(rec)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            return self._f.tell()
 
     def close(self) -> None:
         self._f.close()

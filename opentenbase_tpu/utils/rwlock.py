@@ -22,8 +22,22 @@ class RWStatementLock:
     def __init__(self):
         self._w = threading.RLock()
         self._cond = threading.Condition()
-        self._readers = 0
+        self._readers = 0  # total shared holders (all groups)
+        # shared holders by class: 'r' (read-only statements) and 'w'
+        # (table-granular writers). Classes never mix: a reader's scan
+        # holds raw references into store arrays that a concurrent
+        # append may REALLOCATE, so writers share only with writers
+        # (each fenced by per-table mutexes), readers only with readers
+        # (MVCC snapshots isolate them).
+        self._groups = {"r": 0, "w": 0}
         self.max_concurrent_readers = 0  # observability / tests
+        self.max_concurrent_table_writers = 0
+        self._table_writers = 0
+        self._table_locks: dict = {}
+        # which shared group (if any) the CURRENT thread holds — lets
+        # the lock manager park a shared holder (release the slot so an
+        # exclusive committer can pass) and re-acquire on wake
+        self._tls = threading.local()
 
     # -- exclusive (RLock-compatible surface) ----------------------------
     def acquire(self) -> bool:
@@ -46,25 +60,108 @@ class RWStatementLock:
     def __exit__(self, *exc) -> None:
         self.release()
 
+    # -- shared (class-based) ---------------------------------------------
+    def _enter_shared(self, group: str) -> None:
+        other = "w" if group == "r" else "r"
+        self._w.acquire()  # fence: exclusive holders/waiters first
+        try:
+            with self._cond:
+                while self._groups[other] > 0:
+                    self._cond.wait()
+                self._groups[group] += 1
+                self._readers += 1
+                if group == "r":
+                    self.max_concurrent_readers = max(
+                        self.max_concurrent_readers, self._readers
+                    )
+        finally:
+            self._w.release()
+        self._tls.group = group
+
+    def _exit_shared(self, group: str) -> None:
+        self._tls.group = None
+        with self._cond:
+            self._groups[group] -= 1
+            self._readers -= 1
+            if self._readers == 0 or self._groups[group] == 0:
+                self._cond.notify_all()
+
+    @contextmanager
+    def _shared(self, group: str):
+        self._enter_shared(group)
+        try:
+            yield
+        finally:
+            self._exit_shared(group)
+
+    # -- lock-manager parking ---------------------------------------------
+    def park_release(self):
+        """Release whatever THIS THREAD holds — the exclusive side or a
+        shared group slot — so other sessions (including an exclusive
+        committer that would otherwise deadlock against a parked shared
+        holder) can run while the caller sleeps in the lock manager.
+        Returns an opaque token for ``park_reacquire``; None when the
+        thread holds nothing."""
+        g = getattr(self._tls, "group", None)
+        if g is not None:
+            self._exit_shared(g)
+            return ("s", g)
+        if self._w._is_owned():
+            self.release()
+            return ("x",)
+        return None
+
+    def park_reacquire(self, token) -> None:
+        if token is None:
+            return
+        if token[0] == "x":
+            self.acquire()
+        else:
+            self._enter_shared(token[1])
+
+    # -- table-granular writers -------------------------------------------
+    @contextmanager
+    def write_tables(self, tables):
+        """Writer-class shared access PLUS per-table mutexes: two
+        writers touching disjoint table sets run concurrently; writers
+        on the same table serialize; readers and DDL/uncertain
+        statements are excluded (the reference's lock manager allows
+        exactly this — RowExclusive coexists with RowExclusive on other
+        relations, src/backend/storage/lmgr)."""
+        names = sorted(set(tables))  # total order: no lock-order cycles
+        with self._cond:
+            locks = [
+                self._table_locks.setdefault(n, threading.Lock())
+                for n in names
+            ]
+        # table mutexes come BEFORE the group slot: a writer queued on a
+        # same-table mutex must hold NO slot, or it would keep an
+        # exclusive committer (whose commit the mutex holder may be
+        # waiting on transitively through the lock manager) out forever
+        for lk in locks:
+            lk.acquire()
+        try:
+            with self._shared("w"):
+                with self._cond:
+                    self._table_writers += 1
+                    self.max_concurrent_table_writers = max(
+                        self.max_concurrent_table_writers,
+                        self._table_writers,
+                    )
+                try:
+                    yield
+                finally:
+                    with self._cond:
+                        self._table_writers -= 1
+        finally:
+            for lk in reversed(locks):
+                lk.release()
+
     # -- shared -----------------------------------------------------------
     @contextmanager
     def read(self):
         """Shared access: concurrent with other readers, excluded by any
         exclusive holder (entry passes through the writer mutex, which
         also gives writers preference over queued readers)."""
-        self._w.acquire()
-        try:
-            with self._cond:
-                self._readers += 1
-                self.max_concurrent_readers = max(
-                    self.max_concurrent_readers, self._readers
-                )
-        finally:
-            self._w.release()
-        try:
+        with self._shared("r"):
             yield
-        finally:
-            with self._cond:
-                self._readers -= 1
-                if self._readers == 0:
-                    self._cond.notify_all()

@@ -1,0 +1,63 @@
+"""Bigger-than-HBM streaming (VERDICT r2 missing-2): when a table's scan
+columns exceed the HBM budget, the fused path runs fixed-width shard
+windows through one cached program and the window partials merge exactly
+— no silent host fallback, no wrong sums at chunk boundaries."""
+
+import numpy as np
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+
+
+@pytest.fixture()
+def small_budget(monkeypatch):
+    from opentenbase_tpu.executor import fused
+
+    monkeypatch.setattr(fused, "SCAN_HBM_BUDGET", 200_000)
+    return fused
+
+
+def test_chunked_scan_agg_matches_host(small_budget):
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        "create table big (k bigint, v numeric(10,2), g int) "
+        "distribute by roundrobin"
+    )
+    n = 30_000
+    rng = np.random.default_rng(3)
+    rows = ",".join(
+        f"({i}, {i % 1000}.50, {int(gg)})"
+        for i, gg in zip(range(n), rng.integers(0, 5, n))
+    )
+    s.execute("insert into big values " + rows)
+    s.execute("set enable_pallas_scan = off")
+    s.execute("set enable_fused_execution = off")
+    want_scalar = s.query("select count(*), sum(v) from big where k >= 7")
+    want_grouped = s.query(
+        "select g, count(*), sum(v) from big group by g order by g"
+    )
+    s.execute("set enable_fused_execution = on")
+    fx = s.cluster.fused_executor()
+    got_scalar = s.query("select count(*), sum(v) from big where k >= 7")
+    got_grouped = s.query(
+        "select g, count(*), sum(v) from big group by g order by g"
+    )
+    assert got_scalar == want_scalar
+    assert got_grouped == want_grouped
+    assert fx.cache.stats.get("chunked_scans", 0) >= 2, fx.cache.stats
+    assert fx.cache.stats.get("scan_chunks", 0) >= 4, fx.cache.stats
+
+
+def test_chunked_sees_writes_and_deletes(small_budget):
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute("create table big2 (k bigint, v bigint) distribute by roundrobin")
+    n = 20_000
+    s.execute("insert into big2 values " + ",".join(
+        f"({i}, 1)" for i in range(n)
+    ))
+    s.execute("set enable_pallas_scan = off")
+    assert s.query("select sum(v) from big2")[0][0] == n
+    s.execute("delete from big2 where k < 100")
+    assert s.query("select sum(v) from big2")[0][0] == n - 100
+    s.execute("insert into big2 values (999999, 5)")
+    assert s.query("select sum(v) from big2")[0][0] == n - 100 + 5
