@@ -1,0 +1,47 @@
+"""otb_basebackup — physical backup of a cluster data directory.
+
+The pg_basebackup analog (src/bin/pg_basebackup): against a RUNNING
+coordinator, connect over the wire and call pg_basebackup('<target>')
+(which checkpoints first); against a stopped cluster, copy the directory
+generation-consistently offline.
+
+  python -m opentenbase_tpu.cli.otb_basebackup --data-dir D --output B
+  python -m opentenbase_tpu.cli.otb_basebackup --host H --port P --output B
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="otb_basebackup")
+    ap.add_argument("--data-dir", help="offline source data directory")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, help="running coordinator port")
+    ap.add_argument("--output", "-o", required=True)
+    args = ap.parse_args(argv)
+    if args.port is not None:
+        from opentenbase_tpu.net.client import connect_tcp
+
+        with connect_tcp(args.host, args.port) as s:
+            row = s.query(
+                f"select pg_basebackup('{args.output}')"
+            )
+        print(f"backup complete: {row}")
+        return 0
+    if not args.data_dir:
+        ap.error("need --data-dir (offline) or --port (live)")
+    from opentenbase_tpu.storage.backup import basebackup
+
+    man = basebackup(args.data_dir, args.output)
+    print(
+        f"backup complete: {len(man['files'])} files, "
+        f"{man['wal_bytes']} WAL bytes"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+"""otb_rewind — resynchronize a diverged data directory.
+
+The pg_rewind analog (src/bin/pg_rewind): after a failover, the old
+primary's WAL carries records the new primary never had. Rewind finds
+the byte divergence point of the two WALs, truncates the target there,
+copies the source's tail, and drops any target checkpoint taken after
+the divergence (its snapshots could contain diverged rows). The rewound
+directory then recovers to a consistent prefix of the NEW timeline.
+
+  python -m opentenbase_tpu.cli.otb_rewind --target D1 --source D2
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from opentenbase_tpu.storage.backup import rewind
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="otb_rewind")
+    ap.add_argument("--target", required=True, help="diverged data dir")
+    ap.add_argument("--source", required=True, help="new-primary data dir")
+    args = ap.parse_args(argv)
+    info = rewind(args.target, args.source)
+    print(
+        f"rewound at byte {info['divergence']}: copied "
+        f"{info['tail_bytes']} tail bytes"
+        + (", dropped post-divergence checkpoint"
+           if info["dropped_checkpoint"] else "")
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

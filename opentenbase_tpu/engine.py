@@ -1559,6 +1559,7 @@ class Session:
         "pg_logical_slot_changes",
         "pg_publication_tables",
         "pg_logical_sync",
+        "pg_basebackup",
     }
 
     def _maybe_admin_function(self, stmt: A.Select) -> Optional[Result]:
@@ -1601,6 +1602,41 @@ class Session:
             p = self.cluster.persistence
             pos = p.wal.position if p is not None else 0
             return Result("SELECT", [(int(pos),)], ["lsn"], 1)
+        if e.name == "pg_basebackup":
+            # physical backup of the live cluster (pg_basebackup analog):
+            # checkpoint first so the copy is mostly snapshots + a short
+            # WAL tail, then the generation-consistent directory copy
+            if len(e.args) != 1:
+                raise SQLError("pg_basebackup(target_directory)")
+            p = self.cluster.persistence
+            if p is None:
+                raise SQLError(
+                    "pg_basebackup requires a durable cluster (data_dir)"
+                )
+            from opentenbase_tpu.storage.backup import basebackup
+
+            target = str(self._const_arg(e.args[0]))
+            p.checkpoint()
+            # the directory copy runs WITHOUT the cluster-wide statement
+            # lock (backup.py's checkpoint-generation retry makes the
+            # copy safe against concurrent activity) — only the
+            # checkpoint above needed exclusivity
+            lock = self.cluster._exec_lock
+            tok = (
+                lock.park_release()
+                if hasattr(lock, "park_release") else None
+            )
+            try:
+                man = basebackup(p.dir, target)
+            finally:
+                if hasattr(lock, "park_reacquire"):
+                    lock.park_reacquire(tok)
+            return Result(
+                "SELECT",
+                [(target, len(man["files"]), int(man["wal_bytes"]))],
+                ["backup_dir", "files", "wal_bytes"],
+                1,
+            )
         if e.name == "pg_publication_tables":
             if len(e.args) != 1:
                 raise SQLError("pg_publication_tables(publication)")

@@ -69,6 +69,14 @@ class ClusterServer:
         if ssl_cert is None and conf.get("ssl"):
             ssl_cert = conf.get("ssl_cert_file") or None
             ssl_key = conf.get("ssl_key_file") or None
+            if not ssl_cert:
+                # ssl=on without a certificate must REFUSE to start —
+                # silently serving plaintext while the operator believes
+                # TLS is enforced is the one unacceptable outcome
+                # (postmaster.c refuses the same misconfiguration)
+                raise ValueError(
+                    "ssl = on requires ssl_cert_file in opentenbase.conf"
+                )
         if ssl_cert:
             import ssl as _ssl
 
@@ -171,13 +179,11 @@ class ClusterServer:
                     # DDL, and anything uncertain take it exclusively —
                     # the statement-level analog of the reference's
                     # lock-free MVCC readers
-                    wt = None
-                    if self._is_readonly(sql, session):
+                    kind, wt = self._classify(sql, session)
+                    if kind == "read":
                         with self._exec_lock.read():
                             res = session.execute(sql)
-                    elif (
-                        wt := self._write_tables(sql, session)
-                    ) is not None:
+                    elif kind == "write":
                         # plain autocommit DML: writers on DISJOINT
                         # tables share the data plane (per-table
                         # mutexes serialize same-table writers); DDL
@@ -203,92 +209,87 @@ class ClusterServer:
             # (the backend-exit cleanup of the reference's tcop loop)
             self._conn_cleanup(session, conn)
 
-    def _write_tables(self, sql: str, session):
-        """Tables a plain autocommit DML statement writes, or None when
-        the statement must take the exclusive side: inside an explicit
-        transaction (its COMMIT touches every written table), DDL,
-        partitioned targets (children fan out), views, subquery sources
-        (which READ other tables — fine under the shared side, but the
-        statement also reads its source tables: include them so a writer
-        on the source serializes against us)."""
-        if session.txn is not None:
-            return None
-        try:
-            from opentenbase_tpu.sql import ast as A
-            from opentenbase_tpu.sql.parser import parse
+    def _classify(self, sql: str, session):
+        """ONE parse classifying the statement's lock class:
 
-            stmts = parse(sql)
-            if len(stmts) != 1:
-                return None
-            st = stmts[0]
-            if not isinstance(st, (A.Insert, A.Update, A.Delete)):
-                return None
-            refs: set = {st.table}
-            if isinstance(st, A.Insert) and st.query is not None:
-                session._referenced_tables(st.query, refs)
-            # a subquery anywhere else (WHERE/SET/VALUES) reads tables
-            # this walk can't see: classify exclusive
-            for node in _walk_ast(st):
-                if isinstance(
-                    node,
-                    (A.InSubquery, A.ExistsSubquery, A.ScalarSubquery),
-                ):
-                    return None
-            if getattr(st, "returning", None):
-                pass  # RETURNING reads only the written table
-            cat = self.cluster.catalog
-            for tb in refs:
-                if not cat.has(tb):
-                    return None
-                if tb in self.cluster.partitions:
-                    return None
-                if tb in self.cluster.views:
-                    return None
-                meta = cat.get(tb)
-                if getattr(meta, "foreign", None) is not None:
-                    return None
-            return refs
-        except Exception:
-            return None
-
-    def _is_readonly(self, sql: str, session) -> bool:
-        """True only when the statement provably reads: a single plain
-        SELECT (no FOR UPDATE) outside a transaction, referencing no
-        system view (their refresh materializes tables), no view (whose
-        expansion could), and calling no state-mutating function
-        (sequence ops, pg_clean/pg_unlock/audit admin). Parse errors
-        classify exclusive and surface from the normal execution path."""
+        - ("read", None): a single plain SELECT (no FOR UPDATE) outside
+          a transaction, referencing no system view (their refresh
+          materializes tables), no view (whose expansion could), and
+          calling no state-mutating function — shares the data plane
+          with other readers (MVCC snapshots isolate them).
+        - ("write", tables): plain autocommit DML on known, plain,
+          non-partitioned tables with no subqueries — shares the data
+          plane with writers on DISJOINT tables.
+        - ("excl", None): everything else — DDL, explicit transactions,
+          anything uncertain, parse errors (which then surface from the
+          normal execution path)."""
         if session.txn is not None:
-            return False
+            return "excl", None
         try:
             from opentenbase_tpu.engine import _SYSTEM_VIEWS
             from opentenbase_tpu.sql import ast as A
             from opentenbase_tpu.sql.parser import parse
 
             stmts = parse(sql)
-            if len(stmts) != 1 or not isinstance(stmts[0], A.Select):
-                return False
-            sel = stmts[0]
-            if sel.for_update is not None:
-                return False
-            refs: set = set()
-            session._referenced_tables(sel, refs)
-            if refs & set(_SYSTEM_VIEWS):
-                return False
-            if refs & set(self.cluster.views):
-                return False
-            # FROM-less admin/sequence function calls mutate state
-            # (clean_2pc, deadlock victims, FGA policies, nextval)
-            mutating = set(session._ADMIN_FUNCS) | set(session._SEQ_FUNCS)
-            for item in sel.items:
-                for node in _walk_ast(item.expr):
-                    if isinstance(node, A.FuncCall) and (
-                        node.name in mutating
+            if len(stmts) != 1:
+                return "excl", None
+            st = stmts[0]
+            if isinstance(st, A.Select):
+                if st.for_update is not None:
+                    return "excl", None
+                refs: set = set()
+                session._referenced_tables(st, refs)
+                if refs & set(_SYSTEM_VIEWS):
+                    return "excl", None
+                if refs & set(self.cluster.views):
+                    return "excl", None
+                # FROM-less admin/sequence calls mutate state
+                # (clean_2pc, deadlock victims, FGA policies, nextval)
+                mutating = set(session._ADMIN_FUNCS) | set(
+                    session._SEQ_FUNCS
+                )
+                for item in st.items:
+                    for node in _walk_ast(item.expr):
+                        if isinstance(node, A.FuncCall) and (
+                            node.name in mutating
+                        ):
+                            return "excl", None
+                return "read", None
+            if isinstance(st, (A.Insert, A.Update, A.Delete)):
+                refs = {st.table}
+                if isinstance(st, A.Insert) and st.query is not None:
+                    session._referenced_tables(st.query, refs)
+                # a subquery anywhere else (WHERE/SET/VALUES) reads
+                # tables this walk can't see: classify exclusive
+                for node in _walk_ast(st):
+                    if isinstance(
+                        node,
+                        (
+                            A.InSubquery,
+                            A.ExistsSubquery,
+                            A.ScalarSubquery,
+                        ),
                     ):
-                        return False
-            return True
+                        return "excl", None
+                cat = self.cluster.catalog
+                for tb in refs:
+                    if not cat.has(tb):
+                        return "excl", None
+                    if tb in self.cluster.partitions:
+                        return "excl", None
+                    if tb in self.cluster.views:
+                        return "excl", None
+                    meta = cat.get(tb)
+                    if getattr(meta, "foreign", None) is not None:
+                        return "excl", None
+                return "write", refs
+            return "excl", None
         except Exception:
-            return False
+            return "excl", None
+
+    def _is_readonly(self, sql: str, session) -> bool:
+        """Back-compat shim over _classify (tests use it)."""
+        return self._classify(sql, session)[0] == "read"
 
     def _scram_exchange(self, conn: socket.socket, msg: dict) -> bool:
         """Server half of the SCRAM flow (net/auth.py). Returns True

@@ -1,0 +1,165 @@
+"""Physical backup + divergence repair — the pg_basebackup / pg_rewind
+analogs (src/bin/pg_basebackup, src/bin/pg_rewind).
+
+``basebackup`` copies a RUNNING cluster's durable state (checkpoint
+generation files + checkpoint.json + the WAL prefix + GTS/sequence/conf
+state) into a target directory that ``Cluster.recover`` can open
+directly. The copy is made consistent by snapshotting checkpoint.json
+FIRST and the WAL LAST: anything committed after the WAL copy simply
+isn't in the backup (like a backup taken at that LSN), and a torn tail
+record is truncated by WAL open-time repair.
+
+``find_divergence``/``rewind`` repair a diverged timeline: after a
+failover the old primary's WAL may contain records the new primary never
+had. Rewind truncates the old primary's WAL at the last common byte
+prefix and copies the new primary's tail — after which the rewound
+directory recovers to a state that can re-follow the new primary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+
+# auxiliary single files copied verbatim when present
+_AUX_FILES = (
+    "gts.json",
+    "gts_seqs",
+    "opentenbase.conf",
+    "audit.log",
+    "users.json",
+)
+
+
+def basebackup(src_dir: str, dst_dir: str) -> dict:
+    """Copy the durable state of the cluster at ``src_dir`` into
+    ``dst_dir`` (created; must be empty). Returns a manifest. Safe on a
+    RUNNING primary — see module docstring for the consistency rule."""
+    os.makedirs(dst_dir, exist_ok=True)
+    if os.listdir(dst_dir):
+        raise ValueError(f"backup target {dst_dir!r} is not empty")
+    manifest: dict = {"files": []}
+
+    def cp(rel: str) -> None:
+        s = os.path.join(src_dir, rel)
+        d = os.path.join(dst_dir, rel)
+        os.makedirs(os.path.dirname(d), exist_ok=True)
+        shutil.copy2(s, d)
+        manifest["files"].append(rel)
+
+    ckpt = os.path.join(src_dir, "checkpoint.json")
+    for _attempt in range(8):
+        manifest["files"].clear()
+        for stale in os.listdir(dst_dir):
+            p = os.path.join(dst_dir, stale)
+            (shutil.rmtree if os.path.isdir(p) else os.unlink)(p)
+        # 1. checkpoint.json first: it names a generation whose files
+        # are immutable once written (a concurrent checkpoint writes a
+        # NEW generation and re-points the json after its files land)
+        gen = None
+        if os.path.exists(ckpt):
+            cp("checkpoint.json")
+            with open(os.path.join(dst_dir, "checkpoint.json")) as f:
+                gen = json.load(f).get("gen")
+        # 2. the named generation's snapshot files (+ dictionaries etc.)
+        try:
+            for root, _dirs, files in os.walk(src_dir):
+                rel_root = os.path.relpath(root, src_dir)
+                for fn in files:
+                    rel = os.path.normpath(os.path.join(rel_root, fn))
+                    if rel in ("checkpoint.json", "wal.log"):
+                        continue
+                    if rel.startswith("prepared_2pc"):
+                        continue  # DN vote journals are per-instance
+                    if fn.endswith(".npz.tmp") or fn.endswith(".tmp"):
+                        continue  # write in flight: not ours
+                    if fn.startswith("ckpt") and fn.endswith(".npz"):
+                        # only the LIVE generation's snapshots
+                        # (naming: ckpt{gen}_dn{node}_{table}.npz)
+                        if gen is None or not fn.startswith(
+                            f"ckpt{gen}_"
+                        ):
+                            continue
+                    cp(rel)
+        except FileNotFoundError:
+            continue  # a concurrent checkpoint GC'd our generation
+        # 3. the WAL last: records appended after this copy are simply
+        # beyond the backup's horizon
+        if os.path.exists(os.path.join(src_dir, "wal.log")):
+            cp("wal.log")
+        # consistency check: if a concurrent checkpoint superseded our
+        # generation (its GC may have raced our snapshot copy), retry
+        if os.path.exists(ckpt):
+            with open(ckpt) as f:
+                now_gen = json.load(f).get("gen")
+            if now_gen != gen:
+                continue
+        break
+    else:
+        raise RuntimeError("backup kept racing checkpoints; giving up")
+    manifest["wal_bytes"] = os.path.getsize(
+        os.path.join(dst_dir, "wal.log")
+    ) if os.path.exists(os.path.join(dst_dir, "wal.log")) else 0
+    with open(os.path.join(dst_dir, "backup_manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    return manifest
+
+
+def find_divergence(wal_a: str, wal_b: str, chunk: int = 1 << 20) -> int:
+    """Length of the common byte prefix of two WAL files — the
+    divergence point of two timelines that share a history."""
+    pos = 0
+    with open(wal_a, "rb") as fa, open(wal_b, "rb") as fb:
+        while True:
+            a = fa.read(chunk)
+            b = fb.read(chunk)
+            n = min(len(a), len(b))
+            if n == 0:
+                return pos
+            if a[:n] == b[:n]:
+                pos += n
+                if len(a) != len(b):
+                    return pos
+                continue
+            for i in range(n):
+                if a[i] != b[i]:
+                    return pos + i
+            return pos + n
+
+
+def rewind(target_dir: str, source_dir: str) -> dict:
+    """Make ``target_dir`` (a diverged old primary) recoverable as a
+    follower of ``source_dir`` (the new primary): truncate the target's
+    WAL at the divergence point, append the source's tail, and adopt the
+    source's checkpoint state when the divergence predates the target's
+    checkpoint (whose snapshot could contain diverged rows)."""
+    twal = os.path.join(target_dir, "wal.log")
+    swal = os.path.join(source_dir, "wal.log")
+    div = find_divergence(twal, swal)
+    with open(swal, "rb") as f:
+        f.seek(div)
+        tail = f.read()
+    with open(twal, "r+b") as f:
+        f.truncate(div)
+        f.seek(div)
+        f.write(tail)
+        f.flush()
+        os.fsync(f.fileno())
+    # a checkpoint taken AFTER the divergence snapshots diverged rows —
+    # drop it so recovery replays the (now-correct) WAL from the latest
+    # pre-divergence checkpoint, or from scratch
+    ckpt = os.path.join(target_dir, "checkpoint.json")
+    dropped_ckpt = False
+    if os.path.exists(ckpt):
+        with open(ckpt) as f:
+            meta = json.load(f)
+        if int(meta.get("wal_position", 0)) > div:
+            os.unlink(ckpt)
+            dropped_ckpt = True
+    return {
+        "divergence": div,
+        "tail_bytes": len(tail),
+        "dropped_checkpoint": dropped_ckpt,
+    }

@@ -705,3 +705,35 @@ def test_gagg_group_col_order_falls_back(sess):
     got = _run_mesh1(sess, runner, q)
     assert got == want
     assert runner.last_mode != "gagg", runner.last_mode
+
+
+def test_gsort_narrow_overflow_retries_wide(sess):
+    """Keys past the i32 narrow range must trip the runtime flag and
+    re-run the wide (i64) program with identical results."""
+    s = sess
+    big = 2**40
+    s.execute(
+        "create table wk (k bigint, pr int) distribute by shard(k)"
+    )
+    s.execute("insert into wk values " + ",".join(
+        f"({big + i}, {i % 3})" for i in range(50)
+    ))
+    s.execute(
+        "create table wl (lk bigint, amt bigint) distribute by shard(lk)"
+    )
+    s.execute("insert into wl values " + ",".join(
+        f"({big + (i % 50)}, {i})" for i in range(400)
+    ))
+    q = (
+        "select wl.lk, sum(wl.amt), wk.pr from wk, wl "
+        "where wk.k = wl.lk group by wl.lk, wk.pr "
+        "order by 2 desc limit 5"
+    )
+    s.execute("set enable_fused_execution = off")
+    want = s.query(q)
+    s.execute("set enable_fused_execution = on")
+    runner = _mesh1_runner(sess)
+    got = _run_mesh1(sess, runner, q)
+    assert got == want
+    assert runner.last_mode == "gsort"
+    assert runner._narrow_off, "narrow overflow was never flagged"
