@@ -73,6 +73,17 @@ EXCHANGE_HBM_BUDGET = int(
     os.environ.get("OTB_EXCHANGE_HBM_BUDGET", 4_000_000_000)
 )
 
+# Dimension-fold: an inner join whose build side is this small (and at
+# most half the probe) is attempted as a dense direct-index lookup — the
+# build rows sort once (small) and every probe row gathers its match by
+# key arithmetic, replacing the two full-width sorts of the sort-merge
+# path. A runtime density flag falls back when the build keys aren't a
+# gap-free unique range (the replicated-dim join shippability the
+# reference reaches through pgxcship.c:139, done the TPU way).
+DIMFOLD_MAX_BUILD = int(
+    os.environ.get("OTB_DIMFOLD_MAX", 33_554_432)
+)
+
 
 class DagUnsupported(Exception):
     """Plan shape outside the fused DAG subset (silent host fallback)."""
@@ -398,27 +409,142 @@ def _detect_gagg(agg, topk):
     """Eligibility for the sort-based grouped-agg + top-k formulation
     with NO build-side requirement (the ClickBench shape: GROUP BY
     high-cardinality key ORDER BY agg LIMIT k). Groups become runs of a
-    single packed-key sort; aggregates are prefix-sum differences; only
-    k rows ship. Requires: every ORDER BY position is an AGGREGATE
-    column (the packed group key preserves equality, not order) and
-    specs are sum/count."""
+    single packed-key sort; sums/counts are prefix-sum differences,
+    min/max segmented scans; ORDER BY may mix aggregate columns with
+    group keys (group-key values decode back out of the monotone
+    packing, or ride the sort as operands when packing dropped them);
+    only k rows ship."""
     if not agg.group_exprs:
         return None
-    k, sspecs, _merged = topk
-    nkeys = len(agg.group_exprs)
-    if any(p < nkeys for p, _d, _nf in sspecs):
-        return None
     for a in agg.aggs:
-        if a.func == "count":
+        if a.func in ("count", "sum", "min", "max"):
             continue
-        if a.func != "sum":
-            return None
+        return None
     for g in agg.group_exprs:
         if not (
             g.type.id in _JOINABLE_KEY_TYPES or g.type.is_text
         ):
             return None
     return True
+
+
+def _fd_map(root, orientation):
+    """Functional dependencies between output columns, in root.schema
+    positions: {determined: determining}. Every verified-unique inner
+    join makes its build-side columns functions of the probe key (the
+    dup/density flags guarantee uniqueness at runtime — a program that
+    RETURNS without flags proved its FDs). Lets grouped aggregation
+    pack a determinant subset of the GROUP BY keys (the reference
+    derives the same through unique-index functional dependency,
+    check_functional_grouping, src/backend/catalog/pg_constraint.c)."""
+    counter = [0]
+
+    # walk mirrors _Builder.build: recurse BOTH children of every join
+    # (semi/anti included — their subtree joins consume indices too),
+    # assign this join's index post-order
+    def walk(node):
+        if isinstance(node, (L.Scan, RemoteSource)):
+            return {}
+        if isinstance(node, (L.Filter,)):
+            return walk(node.child)
+        if isinstance(node, L.Project):
+            cfd = walk(node.child)
+            pos_of = {}
+            for o, ex in enumerate(node.exprs):
+                if isinstance(ex, E.Col) and ex.index not in pos_of:
+                    pos_of[ex.index] = o
+            out = {}
+            for o, ex in enumerate(node.exprs):
+                if not isinstance(ex, E.Col):
+                    continue
+                q = cfd.get(ex.index)
+                if q is not None and q in pos_of and pos_of[q] != o:
+                    out[o] = pos_of[q]
+            return out
+        if isinstance(node, L.Join):
+            if node.join_type in ("semi", "anti"):
+                lfd = walk(node.left)
+                walk(node.right)  # index alignment only
+                return lfd
+            lfd = walk(node.left)
+            rfd = walk(node.right)
+            nl = len(node.left.schema)
+            out = dict(lfd)
+            out.update({
+                k + nl: v + nl for k, v in rfd.items()
+            })
+            if node.join_type != "inner":
+                return out
+            ji = counter[0]
+            counter[0] += 1
+            build_right = (
+                orientation[ji] if ji < len(orientation) else "R"
+            ) == "R"
+            if len(node.left_keys) != 1:
+                return out
+            pkey = (
+                node.left_keys[0] if build_right else node.right_keys[0]
+            )
+            if not isinstance(pkey, E.Col):
+                return out
+            pkpos = pkey.index + (0 if build_right else nl)
+            lo, hi = (nl, nl + len(node.right.schema)) if build_right \
+                else (0, nl)
+            for p in range(lo, hi):
+                if p != pkpos:
+                    out[p] = pkpos
+            return out
+        return {}
+
+    return walk(root)
+
+
+def _fold_gate(runner, node: "L.Join", ji: int, build_right: bool,
+               fold_off) -> bool:
+    """THE dimension-fold gate — one definition shared by the builder
+    (which compiles the fold) and the runner's mode selection (which
+    predicts it). Static checks only; density/uniqueness is verified
+    at runtime by the fold flag, PER DEVICE, which covers every
+    topology where the sort-merge lookup it replaces is correct: the
+    fold sees exactly the per-device build rows sort-merge would, an
+    empty build shard matches nothing under both, and a sharded
+    (non-dense-per-device) build trips the flag once and disables
+    itself. Requires a runner (row estimates), a build subtree of
+    shape Filter*(leaf) (predicates peel into slot validity; a
+    Project/Join would change rows), and a build side small in
+    absolute terms AND relative to the probe (folding a same-size side
+    would just rename the sort)."""
+    if runner is None or ji in fold_off:
+        return False
+    bnode = node.right if build_right else node.left
+    pnode = node.left if build_right else node.right
+    chain = bnode
+    while isinstance(chain, L.Filter):
+        chain = chain.child
+    if not isinstance(chain, (L.Scan, RemoteSource)):
+        return False
+    try:
+        best = runner._est_rows(bnode)
+        pest = runner._est_rows(pnode)
+    except Exception:
+        return False
+    return 0 < best <= DIMFOLD_MAX_BUILD and best * 2 <= pest
+
+
+def _seg_scan(x, boundary, op):
+    """Segmented scan: at every position, ``op`` over the prefix of its
+    run (runs delimited by ``boundary``); at run-END positions this is
+    the run's full reduction. One associative_scan — the min/max
+    counterpart of the cumsum-difference trick (which only works for
+    invertible ops)."""
+
+    def comb(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, op(av, bv))
+
+    _, out = jax.lax.associative_scan(comb, (boundary, x))
+    return out
 
 
 def _build_side_node(root):
@@ -555,7 +681,8 @@ def _collect_arrays(fx, root, exchanged: dict, D: int) -> list:
 class _Builder:
     def __init__(
         self, fx, comp: ExprCompiler, orientation: tuple, root,
-        capture_id=None,
+        capture_id=None, runner=None, D: int = 1,
+        fold_off=frozenset(),
     ):
         self.fx = fx
         self.comp = comp
@@ -564,6 +691,16 @@ class _Builder:
             id(n): i for i, n in enumerate(_walk_leaves(root))
         }
         self.njoin = 0  # inner joins seen (orientation index)
+        # dimension-fold state: the runner supplies row estimates and
+        # producer motions; ``fold_off`` are join indices whose dense
+        # lookup already failed at runtime (fall back to sort-merge);
+        # ``folded`` records which joins THIS compile folded so the
+        # runner can route their flags to fold-disable instead of
+        # orientation flips
+        self.runner = runner
+        self.D = D
+        self.fold_off = fold_off
+        self.folded: set = set()
         # group-by-build-side: the join node whose (bidx, build env) the
         # final program consumes; written at trace time, read right after
         # ev() inside the same trace
@@ -576,6 +713,55 @@ class _Builder:
         except Exception:
             plat = "cpu"
         self.lookup = _lookup_sortmerge if plat == "tpu" else _lookup
+
+    def _fold_eligible(self, node: L.Join, ji: int, build_right: bool):
+        """Attempt the dense direct-index lookup for this inner join?
+        See ``_fold_gate`` — the one shared definition."""
+        return _fold_gate(
+            self.runner, node, ji, build_right, self.fold_off
+        )
+
+    def _repl_scan_leaves(self, node) -> bool:
+        """True when ``node``'s subtree scans a REPLICATED table
+        directly. On a multi-device mesh such a scan places the one
+        replica's rows on ONE device — fine alone (each row processed
+        once), but a join side built from it sees only a fraction of
+        the rows per device. The reference never faces this: every
+        datanode holds a full copy of a replicated table
+        (pgxc/locator.c LOCATOR_TYPE_REPLICATED)."""
+        try:
+            leaves = list(_walk_leaves(node))
+        except DagUnsupported:
+            return False
+        return any(
+            isinstance(lf, L.Scan)
+            and self.fx.catalog.get(lf.table).dist.is_replicated
+            for lf in leaves
+        )
+
+    def _complete_rows(self, ev, D: int) -> Callable:
+        """Wrap a side's closure so its rows are per-device COMPLETE:
+        all_gather the per-device blocks inside the program — the
+        in-program equivalent of the broadcast motion, for replicated
+        tables whose single replica store landed on one mesh device."""
+
+        def run(blocks, params, snap):
+            env, mask, n, flags = ev(blocks, params, snap)
+
+            def gath(x):
+                g = jax.lax.all_gather(x, "dn", axis=0)
+                return g.reshape((D * n,) + x.shape[1:])
+
+            env2 = [
+                (
+                    gath(jnp.broadcast_to(d, (n,) + d.shape[1:])),
+                    None if v is None else gath(jnp.broadcast_to(v, (n,))),
+                )
+                for d, v in env
+            ]
+            return env2, gath(jnp.broadcast_to(mask, (n,))), D * n, flags
+
+        return run
 
     # -- leaves -----------------------------------------------------------
     def _leaf_scan(self, node: L.Scan, D: int) -> Callable:
@@ -696,12 +882,62 @@ class _Builder:
             resfn = self.comp.compile(node.residual, jdids)
         jt = node.join_type
         build_right = True
+        fold = False
+        bstrip_fn = None
+        bpred_fns: list = []
         if jt == "inner":
             ji = self.njoin
             self.njoin += 1
             build_right = (
                 self.orientation[ji] if ji < len(self.orientation) else "R"
             ) == "R"
+            fold = self._fold_eligible(node, ji, build_right)
+            if fold:
+                self.folded.add(ji)
+                # compile the build side with its Filter chain peeled:
+                # the leaf closure supplies env + visibility (the
+                # density domain), the predicates become slot validity
+                bnode = node.right if build_right else node.left
+                chain = bnode
+                while isinstance(chain, L.Filter):
+                    cdids = [c.dict_id for c in chain.child.schema]
+                    bpred_fns.append(
+                        self.comp.compile(chain.predicate, cdids)
+                    )
+                    chain = chain.child
+                bstrip_fn = self.build(chain, exchanged, D)
+        if self.D > 1:
+            # replicated tables scanned INSIDE a multi-device join
+            # fragment hold their rows on one device — a build side
+            # must be made per-device complete (in-program broadcast),
+            # and a one-device probe against a sharded build cannot
+            # match at all (host path answers instead)
+            motions = (
+                getattr(self.runner, "_motions", {})
+                if self.runner is not None else {}
+            )
+            if jt in ("semi", "anti"):
+                bnode2, pnode2, b_is_right = node.right, node.left, True
+            else:
+                bnode2 = node.right if build_right else node.left
+                pnode2 = node.left if build_right else node.right
+                b_is_right = build_right
+            if self._repl_scan_leaves(bnode2):
+                if b_is_right:
+                    right = self._complete_rows(right, self.D)
+                else:
+                    left = self._complete_rows(left, self.D)
+                if bstrip_fn is not None:
+                    bstrip_fn = self._complete_rows(bstrip_fn, self.D)
+                b_complete = True
+            else:
+                b_complete = _subtree_replicated(
+                    bnode2, self.fx, motions
+                )
+            if self._repl_scan_leaves(pnode2) and not b_complete:
+                raise DagUnsupported(
+                    "replicated probe vs sharded build on mesh"
+                )
         do_capture = self.capture_id is not None and (
             id(node) == self.capture_id
         )
@@ -709,6 +945,49 @@ class _Builder:
         lookup = self.lookup
 
         def run(blocks, params, snap):
+            if fold:
+                # evaluate only the probe side's full closure; the build
+                # side comes from the stripped leaf chain (a leaf chain
+                # contributes no flags, so flag ordering is preserved)
+                benv, bvis, bn, _bf = bstrip_fn(blocks, params, snap)
+                bfull = bvis
+                for pf in bpred_fns:
+                    d, v = pf(benv, params)
+                    keep = d if v is None else (d & v)
+                    bfull = bfull & jnp.broadcast_to(keep, (bn,))
+                if build_right:
+                    penv, pmask, pn, pflags = left(blocks, params, snap)
+                    pk = _bcast(lkfn(penv, params), pn)
+                    bk = _bcast(rkfn(benv, params), bn)
+                else:
+                    penv, pmask, pn, pflags = right(blocks, params, snap)
+                    pk = _bcast(rkfn(penv, params), pn)
+                    bk = _bcast(lkfn(benv, params), bn)
+                matched, bidx, dup = _lookup_dense(
+                    pk, pmask, bk, bvis, bfull
+                )
+                flags = pflags + [dup]
+                if do_capture:
+                    builder.captured = (bidx, benv, bn)
+                gathered = [
+                    (
+                        jnp.take(d, bidx, axis=0),
+                        None if v is None else jnp.take(v, bidx, axis=0),
+                    )
+                    for d, v in benv
+                ]
+                env = (
+                    list(penv) + gathered
+                    if build_right
+                    else gathered + list(penv)
+                )
+                mask = pmask & matched
+                n = pn
+                if resfn is not None:
+                    d, v = resfn(env, params)
+                    keep = d if v is None else (d & v)
+                    mask = mask & jnp.broadcast_to(keep, (n,))
+                return env, mask, n, flags
             lenv, lmask, ln, lflags = left(blocks, params, snap)
             renv, rmask, rn, rflags = right(blocks, params, snap)
             flags = lflags + rflags
@@ -776,12 +1055,14 @@ class DagRunner:
         self._packing: dict = {}  # skey -> packed grouping viable?
         self._topk_off: dict = {}  # (skey, topk spec) -> ranking overflowed
         self._narrow_off: dict = {}  # skey -> i32 operands overflowed
+        self._fold_off: dict = {}  # skey -> {join idx}: dense fold failed
         # sizing results remembered per (program, data version): repeat
         # queries on unchanged data skip the count pass / optimistic
         # group-capacity round trip entirely
         self._caps: dict = {}
         self.completed = 0  # DAG runs that produced the final batch
         self.last_mode = None  # final-fragment mode of the last run
+        self.last_folded = frozenset()  # joins dense-folded in last run
         # bounded log of plans that fell back to the host path and why —
         # surfaced through pg_stat_fused so demotion is NEVER silent
         self.unsupported: list = []
@@ -967,6 +1248,34 @@ class DagRunner:
             "L" if i == flip_idx else o for i, o in enumerate(orientation)
         )
 
+    def _top_join_foldable(self, root, orientation, skey) -> bool:
+        """``_fold_gate`` applied to the TOP join — used to choose
+        gagg-over-folds instead of the gsort concat-sort before any
+        builder exists."""
+        join = _build_side_node(root)
+        if join is None or join.join_type != "inner":
+            return False
+        ji = _count_inner_joins(root) - 1
+        build_right = (
+            orientation[ji] if ji < len(orientation) else "R"
+        ) == "R"
+        return _fold_gate(
+            self, join, ji, build_right, self._fold_off.get(skey, ())
+        )
+
+    def _on_flag(self, skey, orientation, flip, folded):
+        """One join raised its data flag. For a folded join the flag
+        means 'build keys not a dense unique range' — disable the fold
+        for that join (keep the orientation) and let sort-merge answer;
+        for a sort-merge join it means duplicate build keys — flip the
+        build side (raises when both sides were tried)."""
+        if flip in folded:
+            self._fold_off.setdefault(skey, set()).add(flip)
+            while len(self._fold_off) > 512:
+                self._fold_off.pop(next(iter(self._fold_off)))
+            return orientation
+        return self._flip(orientation, flip)
+
     def _check_hbm_budget(self, cap: int, schema, D: int) -> None:
         """Bail to the host path before an exchange whose buffers would
         exhaust device memory (a crashed TPU worker is unrecoverable
@@ -997,22 +1306,23 @@ class DagRunner:
         arrays = _collect_arrays(self.fx, frag.root, exchanged, D)
         sig = self._shapes_sig(arrays)
         while True:
+            fo = frozenset(self._fold_off.get(skey, ()))
             # pass 1: per-(src, dest) routed-row counts -> bucket size.
             # Skipped entirely (one round trip saved) when this exact
             # program + literal values already sized itself against
             # unchanged data (literals are lifted params, so the skey
             # alone would alias different constants).
-            ckey = ("xcnt", skey, orientation, hashpos, D, sig)
+            ckey = ("xcnt", skey, orientation, hashpos, D, sig, fo)
             cached = self._programs.get(ckey)
             if cached is None:
                 cached = self._compile_count(
-                    frag.root, exchanged, orientation, hashpos, D
+                    frag.root, exchanged, orientation, hashpos, D, fo
                 )
                 self._programs[ckey] = cached
-            prog, comp = cached
+            prog, comp, folded = cached
             params = self._resolve(comp, dicts_view, subquery_values)
             capkey = (
-                "cap", skey, orientation, hashpos, D, sig, versions,
+                "cap", skey, orientation, hashpos, D, sig, versions, fo,
                 _params_sig(params),
             )
             cap = self._caps.get(capkey)
@@ -1021,7 +1331,9 @@ class DagRunner:
                 flags = [np.asarray(f) for f in flags]
                 flip = _first_true(flags)
                 if flip is not None:
-                    orientation = self._flip(orientation, flip)
+                    orientation = self._on_flag(
+                        skey, orientation, flip, folded
+                    )
                     continue
                 cap = filt_ops.bucket_size(
                     max(int(np.asarray(counts).max()), 1)
@@ -1030,20 +1342,21 @@ class DagRunner:
             self._check_hbm_budget(cap, frag.root.schema, D)
 
             # pass 2: the bucketed all_to_all
-            xkey = ("xchg", skey, orientation, hashpos, D, cap, sig)
+            xkey = ("xchg", skey, orientation, hashpos, D, cap, sig, fo)
             cached = self._programs.get(xkey)
             if cached is None:
                 cached = self._compile_exchange(
-                    frag.root, exchanged, orientation, hashpos, D, cap
+                    frag.root, exchanged, orientation, hashpos, D, cap,
+                    fo,
                 )
                 self._programs[xkey] = cached
-            prog, comp = cached
+            prog, comp, folded = cached
             params = self._resolve(comp, dicts_view, subquery_values)
             cols, valids, rcounts, flags = prog(tuple(arrays), params, snap)
             flags = [np.asarray(f) for f in flags]
             flip = _first_true(flags)
             if flip is not None:
-                orientation = self._flip(orientation, flip)
+                orientation = self._on_flag(skey, orientation, flip, folded)
                 continue
             self._orientations[skey] = orientation
             return {
@@ -1068,17 +1381,18 @@ class DagRunner:
         arrays = _collect_arrays(self.fx, frag.root, exchanged, D)
         sig = self._shapes_sig(arrays)
         while True:
-            ckey = ("bcnt", skey, orientation, D, sig)
+            fo = frozenset(self._fold_off.get(skey, ()))
+            ckey = ("bcnt", skey, orientation, D, sig, fo)
             cached = self._programs.get(ckey)
             if cached is None:
                 cached = self._compile_broadcast_count(
-                    frag.root, exchanged, orientation, D
+                    frag.root, exchanged, orientation, D, fo
                 )
                 self._programs[ckey] = cached
-            prog, comp = cached
+            prog, comp, folded = cached
             params = self._resolve(comp, dicts_view, subquery_values)
             capkey = (
-                "bcap", skey, orientation, D, sig, versions,
+                "bcap", skey, orientation, D, sig, versions, fo,
                 _params_sig(params),
             )
             cap = self._caps.get(capkey)
@@ -1087,7 +1401,9 @@ class DagRunner:
                 flags = [np.asarray(f) for f in flags]
                 flip = _first_true(flags)
                 if flip is not None:
-                    orientation = self._flip(orientation, flip)
+                    orientation = self._on_flag(
+                        skey, orientation, flip, folded
+                    )
                     continue
                 cap = filt_ops.bucket_size(
                     max(int(np.asarray(counts).max()), 1)
@@ -1095,20 +1411,20 @@ class DagRunner:
                 self._cap_store(capkey, cap)
             self._check_hbm_budget(cap, frag.root.schema, D)
 
-            bkey = ("bcast", skey, orientation, D, cap, sig)
+            bkey = ("bcast", skey, orientation, D, cap, sig, fo)
             cached = self._programs.get(bkey)
             if cached is None:
                 cached = self._compile_broadcast(
-                    frag.root, exchanged, orientation, D, cap
+                    frag.root, exchanged, orientation, D, cap, fo
                 )
                 self._programs[bkey] = cached
-            prog, comp = cached
+            prog, comp, folded = cached
             params = self._resolve(comp, dicts_view, subquery_values)
             cols, valids, rcounts, flags = prog(tuple(arrays), params, snap)
             flags = [np.asarray(f) for f in flags]
             flip = _first_true(flags)
             if flip is not None:
-                orientation = self._flip(orientation, flip)
+                orientation = self._on_flag(skey, orientation, flip, folded)
                 continue
             self._orientations[skey] = orientation
             return {
@@ -1119,9 +1435,14 @@ class DagRunner:
                 "schema": frag.root.schema,
             }
 
-    def _compile_broadcast_count(self, root, exchanged, orientation, D):
+    def _compile_broadcast_count(
+        self, root, exchanged, orientation, D, fo=frozenset()
+    ):
         comp = ExprCompiler(lift_consts=True)
-        b = _Builder(self.fx, comp, orientation, root)
+        b = _Builder(
+            self.fx, comp, orientation, root, runner=self, D=D,
+            fold_off=fo,
+        )
         ev = b.build(root, exchanged, D)
         mesh = self.fx.mesh
         nflags = _count_inner_joins(root)
@@ -1141,11 +1462,16 @@ class DagRunner:
                 out_specs=(P("dn"), [P("dn")] * nflags),
             )(arrays)
 
-        return jax.jit(program), comp
+        return jax.jit(program), comp, frozenset(b.folded)
 
-    def _compile_broadcast(self, root, exchanged, orientation, D, cap):
+    def _compile_broadcast(
+        self, root, exchanged, orientation, D, cap, fo=frozenset()
+    ):
         comp = ExprCompiler(lift_consts=True)
-        b = _Builder(self.fx, comp, orientation, root)
+        b = _Builder(
+            self.fx, comp, orientation, root, runner=self, D=D,
+            fold_off=fo,
+        )
         ev = b.build(root, exchanged, D)
         mesh = self.fx.mesh
         ncols = len(root.schema)
@@ -1191,7 +1517,7 @@ class DagRunner:
                 ),
             )(arrays)
 
-        return jax.jit(program), comp
+        return jax.jit(program), comp, frozenset(b.folded)
 
     def _routed_eval(self, ev, hashpos, D):
         def run(blocks, params, snap):
@@ -1213,9 +1539,14 @@ class DagRunner:
 
         return run
 
-    def _compile_count(self, root, exchanged, orientation, hashpos, D):
+    def _compile_count(
+        self, root, exchanged, orientation, hashpos, D, fo=frozenset()
+    ):
         comp = ExprCompiler(lift_consts=True)
-        b = _Builder(self.fx, comp, orientation, root)
+        b = _Builder(
+            self.fx, comp, orientation, root, runner=self, D=D,
+            fold_off=fo,
+        )
         ev = b.build(root, exchanged, D)
         routed = self._routed_eval(ev, hashpos, D)
         mesh = self.fx.mesh
@@ -1236,13 +1567,17 @@ class DagRunner:
                 out_specs=(P("dn"), [P("dn")] * nflags),
             )(arrays)
 
-        return jax.jit(program), comp
+        return jax.jit(program), comp, frozenset(b.folded)
 
     def _compile_exchange(
-        self, root, exchanged, orientation, hashpos, D, cap
+        self, root, exchanged, orientation, hashpos, D, cap,
+        fo=frozenset(),
     ):
         comp = ExprCompiler(lift_consts=True)
-        b = _Builder(self.fx, comp, orientation, root)
+        b = _Builder(
+            self.fx, comp, orientation, root, runner=self, D=D,
+            fold_off=fo,
+        )
         ev = b.build(root, exchanged, D)
         routed = self._routed_eval(ev, hashpos, D)
         mesh = self.fx.mesh
@@ -1307,7 +1642,7 @@ class DagRunner:
                 ),
             )(arrays)
 
-        return jax.jit(program), comp
+        return jax.jit(program), comp, frozenset(b.folded)
 
     # -- final fragment ----------------------------------------------------
     def _run_final(
@@ -1407,10 +1742,19 @@ class DagRunner:
                 # co-sort formulation: needs whole groups per device —
                 # a 1-device mesh, or a plan whose grouping subsumes the
                 # sharding (per-device runs aren't group-aligned across
-                # devices, so partials can't psum)
-                gs = _detect_gsort(agg, root, orientation)
-                if gs is None:
-                    ga = _detect_gagg(agg, tk)
+                # devices, so partials can't psum). When the top join
+                # dimension-folds, gagg over the folded tree beats the
+                # gsort concat-sort (probe-width sort vs probe+build,
+                # and the folded build costs one small sort + gathers)
+                ga_ok = _detect_gagg(agg, tk)
+                if ga_ok and self._top_join_foldable(
+                    root, orientation, skey
+                ):
+                    ga = ga_ok
+                else:
+                    gs = _detect_gsort(agg, root, orientation)
+                    if gs is None:
+                        ga = ga_ok
             if use_topk and agg is not None and gs is None and ga is None:
                 bg = _detect_build_group(agg, root, orientation)
                 if bg is not None and D > 1 and not complete:
@@ -1430,37 +1774,47 @@ class DagRunner:
                         bg = None
                 if bg is None and D > 1 and not complete:
                     use_topk = False  # partial groups: must ship all
-            narrow = gs is not None and not self._narrow_off.get(skey)
+            narrow = (
+                gs is not None or ga is not None
+            ) and not self._narrow_off.get(skey)
+            fo = frozenset(self._fold_off.get(skey, ()))
             fkey = (
                 "final", skey, orientation, gcap, D, sig, packing,
                 tk if use_topk else None, bg is not None, psum,
-                gs is not None, ga is not None, narrow,
+                gs is not None, ga is not None, narrow, fo,
             )
             cached = self._programs.get(fkey)
             if cached is None:
                 if gs is not None:
                     comp = ExprCompiler(lift_consts=True)
-                    b = _Builder(self.fx, comp, orientation, root)
+                    b = _Builder(
+                        self.fx, comp, orientation, root, runner=self,
+                        D=D, fold_off=fo,
+                    )
                     cached = self._compile_gsort(
                         b, comp, agg, gs, root, exchanged, tk, D,
                         _count_inner_joins(root), narrow=narrow,
-                    )
+                    ) + (frozenset(b.folded),)
                 elif ga is not None:
                     comp = ExprCompiler(lift_consts=True)
-                    b = _Builder(self.fx, comp, orientation, root)
+                    b = _Builder(
+                        self.fx, comp, orientation, root, runner=self,
+                        D=D, fold_off=fo,
+                    )
                     ev = b.build(root, exchanged, D)
                     cached = self._compile_gagg(
                         b, ev, comp, agg, root, tk, D,
-                        _count_inner_joins(root),
-                    )
+                        _count_inner_joins(root), narrow=narrow,
+                    ) + (frozenset(b.folded),)
                 else:
                     cached = self._compile_final(
                         frag, agg, root, exchanged, orientation, gcap, D,
                         packing,
                         topk=tk if use_topk else None, bg=bg, psum=psum,
+                        fo=fo,
                     )
                 self._programs[fkey] = cached
-            prog, comp, mode = cached
+            prog, comp, mode, folded = cached
             params = self._resolve(comp, dicts_view, subquery_values)
             if gcapkey is None:
                 gcapkey = (
@@ -1473,6 +1827,7 @@ class DagRunner:
                     continue  # recompile/lookup at the exact capacity
             outs = jax.device_get(prog(tuple(arrays), params, snap))
             self.last_mode = mode
+            self.last_folded = folded
             okf = None
             ngroups = None
             if mode in ("gseg", "gsort", "gagg"):
@@ -1495,11 +1850,11 @@ class DagRunner:
                     packing = False
                     self._packing[skey] = False
                     continue
-                orientation = self._flip(orientation, flip)
+                orientation = self._on_flag(skey, orientation, flip, folded)
                 gcapkey = None  # keyed per orientation
                 continue
             if okf is not None and not bool(np.asarray(okf).all()):
-                if mode == "gsort" and narrow:
+                if mode in ("gsort", "gagg") and narrow:
                     # i32 operand range overflowed: retry the wide
                     # program before giving up on ranking entirely
                     self._narrow_off[skey] = True
@@ -1717,15 +2072,29 @@ class DagRunner:
 
         return jax.jit(program), comp, "gseg"
 
-    def _compile_gagg(self, b, ev, comp, agg, root, topk, D, nflags):
+    def _compile_gagg(
+        self, b, ev, comp, agg, root, topk, D, nflags,
+        narrow: bool = False,
+    ):
         """Grouped aggregation + top-k as ONE sort + prefix scans, no
         join required (reference shape: nodeAgg.c hashed grouping +
         LIMIT pushdown). Rows co-sort by the runtime-packed group key;
         groups are runs; sums/counts are prefix differences against a
-        cummax-propagated run base; ranking happens at run-END positions
-        where every aggregate is final. High-cardinality GROUP BY never
-        touches a scatter or a multi-pass argsort, and only LIMIT rows
-        leave the device."""
+        cummax-propagated run base, min/max one segmented scan each;
+        ranking happens at run-END positions where every aggregate is
+        final. High-cardinality GROUP BY never touches a scatter or a
+        multi-pass argsort, and only LIMIT rows leave the device.
+
+        Sort-width minimization (the sort IS the cost on a TPU):
+        - group keys functionally determined by another grouped key
+          (through verified-unique joins, ``_fd_map``) stay OUT of the
+          packed key and are recovered per output row;
+        - the packed key and integer value operands narrow to i32 when
+          runtime ranges fit (flag -> wide retry, like gsort);
+        - when nothing was FD-dropped the row-id operand is dropped
+          too: the monotone packing is INVERTIBLE, so output key
+          values decode straight out of the sorted key — ClickBench's
+          count(*) shape sorts ONE i32 operand and nothing else."""
         dids = [c.dict_id for c in root.schema]
         gfns = [comp.compile(g, dids) for g in agg.group_exprs]
         specs: list[str] = []
@@ -1742,17 +2111,104 @@ class DagRunner:
         naggs = len(agg.aggs)
         mesh = self.fx.mesh
 
+        # FD-reduce the packed key set: keys determined (transitively)
+        # by another present key don't need to sort — grouping by a
+        # determinant subset yields identical runs
+        fd = _fd_map(root, b.orientation)
+        colpos = {
+            i: g.index
+            for i, g in enumerate(agg.group_exprs)
+            if isinstance(g, E.Col)
+        }
+        present = {p: i for i, p in colpos.items()}
+        drop: set = set()
+        changed = True
+        while changed:
+            changed = False
+            for i, p in colpos.items():
+                if i in drop:
+                    continue
+                q = fd.get(p)
+                seen = set()
+                while q is not None and q not in present and (
+                    q not in seen
+                ):
+                    seen.add(q)
+                    q = fd.get(q)
+                if (
+                    q is not None and q in present
+                    and present[q] != i and present[q] not in drop
+                ):
+                    drop.add(i)
+                    changed = True
+        kept = [i for i in range(nkeys) if i not in drop]
+        need_rid = bool(drop)
+        # ORDER BY group keys that were FD-dropped must ride the sort
+        # as carried operands (their values aren't in the packed key)
+        carried = sorted({
+            p for p, _d, _nf in sspecs if p < nkeys and p in drop
+        })
+
         def program(arrays, params, snap):
             def block(blocks):
                 env, mask, n, flags = ev(blocks, params, snap)
                 flags = [jnp.reshape(f, (1,)) for f in flags]
                 keys = [_bcast(fn(env, params), n) for fn in gfns]
-                packed, pok = _pack_group_keys(keys, mask)
-                ok = pok
-                BIGK = jnp.int64(2**62)
-                operands = [jnp.where(mask, packed, BIGK)]
+                ok = jnp.asarray(True)
+
+                # pack kept keys, remembering (mn, r, has_null) per key
+                # so values decode back out of the sorted key
+                stride0 = jnp.int64(1)
+                prod0 = jnp.float64(1.0)
+                packed = jnp.zeros(n, dtype=jnp.int64)
+                decode_info = {}
+                big = jnp.int64(2**62)
+                for i in kept:
+                    d, v = keys[i]
+                    live = mask if v is None else (mask & v)
+                    d64 = jnp.broadcast_to(d, (n,)).astype(jnp.int64)
+                    mn = jnp.min(jnp.where(live, d64, big))
+                    mx = jnp.max(jnp.where(live, d64, -big))
+                    mn = jnp.minimum(mn, mx)
+                    rngf = (
+                        mx.astype(jnp.float64)
+                        - mn.astype(jnp.float64)
+                    ) + 1.0
+                    ok = ok & (rngf < jnp.float64(2**62))
+                    rng = jnp.maximum(mx - mn + 1, 1)
+                    if v is None:
+                        x, r, rf = d64 - mn, rng, rngf
+                    else:
+                        x = jnp.where(v, d64 - mn, rng)
+                        r, rf = rng + 1, rngf + 1.0
+                    decode_info[i] = (mn, stride0, r, rng)
+                    packed = packed + x * stride0
+                    stride0 = stride0 * r
+                    prod0 = prod0 * jnp.maximum(rf, 1.0)
+                ok = ok & (prod0 < jnp.float64(2**62))
+
+                if narrow:
+                    ok = ok & (prod0 < jnp.float64(2**31 - 1))
+                    KSENT = jnp.int32(2**31 - 1)
+                    skeyop = jnp.where(
+                        mask, packed, jnp.int64(2**31 - 1)
+                    ).astype(jnp.int32)
+                else:
+                    KSENT = big
+                    skeyop = jnp.where(mask, packed, big)
+
+                def narrow_val(dv):
+                    nonlocal ok
+                    if narrow and dv.dtype == jnp.int64:
+                        ok = ok & (
+                            jnp.max(dv) < jnp.int64(2**31 - 1)
+                        ) & (jnp.min(dv) > jnp.int64(-(2**31 - 1)))
+                        return dv.astype(jnp.int32)
+                    return dv
+
+                operands = [skeyop]
                 val_pos: list = []
-                for fn in afns:
+                for spec, fn in zip(specs, afns):
                     if fn is None:
                         val_pos.append(None)
                         continue
@@ -1762,16 +2218,50 @@ class DagRunner:
                     elif jnp.issubdtype(d.dtype, jnp.floating):
                         d = d.astype(jnp.float64)
                     vv = mask if v is None else (mask & v)
-                    operands.append(
-                        jnp.where(vv, d, jnp.zeros((), d.dtype))
-                    )
+                    if spec in ("min", "max"):
+                        # identity padding so dead/NULL rows never win
+                        # (vvalid masks all-dead runs, so the identity
+                        # only needs to lose comparisons — it must NOT
+                        # trip the narrow range check itself)
+                        if jnp.issubdtype(d.dtype, jnp.floating):
+                            ident = jnp.asarray(
+                                jnp.inf if spec == "min" else -jnp.inf,
+                                d.dtype,
+                            )
+                        else:
+                            mag = (2**31 - 2) if narrow else 2**62
+                            ident = jnp.asarray(
+                                mag if spec == "min" else -mag,
+                                d.dtype,
+                            )
+                        dv = narrow_val(jnp.where(vv, d, ident))
+                    else:
+                        dv = jnp.where(vv, d, jnp.zeros((), d.dtype))
+                        dv = narrow_val(dv)
+                    operands.append(dv)
                     vi = None
-                    if v is not None:
+                    if v is not None or spec in ("min", "max"):
                         vi = len(operands)
                         operands.append(vv.astype(jnp.int8))
                     val_pos.append((len(operands) - (2 if vi else 1), vi))
-                rid_i = len(operands)
-                operands.append(jnp.arange(n, dtype=jnp.int32))
+                carried_pos = {}
+                for p in carried:
+                    d, v = keys[p]
+                    d64 = jnp.broadcast_to(d, (n,)).astype(jnp.int64)
+                    dv = narrow_val(jnp.where(mask, d64, 0))
+                    operands.append(dv)
+                    ci = len(operands) - 1
+                    vi = None
+                    if v is not None:
+                        operands.append(
+                            (mask & v).astype(jnp.int8)
+                        )
+                        vi = len(operands) - 1
+                    carried_pos[p] = (ci, vi)
+                rid_i = None
+                if need_rid:
+                    rid_i = len(operands)
+                    operands.append(jnp.arange(n, dtype=jnp.int32))
                 sorted_ops = jax.lax.sort(
                     tuple(operands), num_keys=1, is_stable=False
                 )
@@ -1782,7 +2272,7 @@ class DagRunner:
                 end = jnp.concatenate([
                     boundary[1:], jnp.ones(1, jnp.bool_)
                 ])
-                live_end = end & (salk < BIGK)
+                live_end = end & (salk < KSENT)
 
                 def run_from_start(cs, own):
                     # aggregate value at any position = prefix minus the
@@ -1801,7 +2291,7 @@ class DagRunner:
                 def get_run_cnt():
                     nonlocal run_cnt
                     if run_cnt is None:
-                        lv = (salk < BIGK).astype(jnp.int32)
+                        lv = (salk < KSENT).astype(jnp.int32)
                         run_cnt = run_from_start(jnp.cumsum(lv), lv)
                     return run_cnt
 
@@ -1829,23 +2319,58 @@ class DagRunner:
                             (c.astype(jnp.int64), live_end)
                         )
                         continue
+                    if spec in ("min", "max"):
+                        op = jnp.minimum if spec == "min" else (
+                            jnp.maximum
+                        )
+                        sv = _seg_scan(sval, boundary, op)
+                        if jnp.issubdtype(sv.dtype, jnp.integer):
+                            sv = sv.astype(jnp.int64)
+                        out_vals_pos.append((sv, vvalid))
+                        continue
                     ok = ok & ~(jnp.min(sval) < 0)
-                    cs = jnp.cumsum(sval)
-                    if not jnp.issubdtype(cs.dtype, jnp.floating):
+                    if jnp.issubdtype(sval.dtype, jnp.integer):
+                        cs = jnp.cumsum(sval, dtype=jnp.int64)
                         ok = ok & (cs[-1] < jnp.int64(2**62)) & (
                             cs[-1] >= 0
                         )
+                        own = sval.astype(jnp.int64)
+                    else:
+                        cs = jnp.cumsum(sval)
+                        own = sval
                     out_vals_pos.append(
-                        (run_from_start(cs, sval), vvalid)
+                        (run_from_start(cs, own), vvalid)
                     )
+
+                def decode_key(i, src):
+                    """(value, valid|None) of kept key i from a packed
+                    key array ``src`` (inverts the monotone packing)."""
+                    mn, strd, r, rng = decode_info[i]
+                    x = (src.astype(jnp.int64) // strd) % r
+                    d = x + mn
+                    _kd, kv = keys[i]
+                    if kv is None:
+                        return d, None
+                    return jnp.where(x == rng, 0, d), x != rng
 
                 stride = jnp.int64(1)
                 prod = jnp.float64(1.0)
                 packed_rank = jnp.zeros(n, dtype=jnp.int64)
                 for p, desc, nf in reversed(sspecs):
-                    d64, v = out_vals_pos[p - nkeys]
+                    if p >= nkeys:
+                        d64, v = out_vals_pos[p - nkeys]
+                        d64 = d64.astype(jnp.int64)
+                    elif p in drop:
+                        ci, vi = carried_pos[p]
+                        d64 = sorted_ops[ci].astype(jnp.int64)
+                        v = (
+                            None if vi is None
+                            else sorted_ops[vi] > 0
+                        )
+                    else:
+                        d64, v = decode_key(p, salk)
                     x, r, rf, okbit = _rank_encode(
-                        d64.astype(jnp.int64), v, desc, nf, live_end
+                        d64, v, desc, nf, live_end
                     )
                     packed_rank = packed_rank + x * stride
                     stride = stride * r
@@ -1854,15 +2379,29 @@ class DagRunner:
                 ok = ok & (prod < jnp.float64(2**62))
 
                 idx, sel = _topk_idx(packed_rank, live_end, k)
-                row_k = jnp.take(sorted_ops[rid_i], idx)
+                row_k = (
+                    None if rid_i is None
+                    else jnp.take(sorted_ops[rid_i], idx)
+                )
+                salk_k = jnp.take(salk, idx)
                 out_keys = []
-                for d, v in keys:
-                    dk = jnp.take(jnp.broadcast_to(d, (n,)), row_k)
-                    vk = (
-                        jnp.ones(k, jnp.bool_)
-                        if v is None
-                        else jnp.take(jnp.broadcast_to(v, (n,)), row_k)
-                    )
+                for i, (d, v) in enumerate(keys):
+                    if i in drop:
+                        dk = jnp.take(
+                            jnp.broadcast_to(d, (n,)), row_k
+                        )
+                        vk = (
+                            jnp.ones(k, jnp.bool_)
+                            if v is None
+                            else jnp.take(
+                                jnp.broadcast_to(v, (n,)), row_k
+                            )
+                        )
+                    else:
+                        dk, vk = decode_key(i, salk_k)
+                        dk = dk.astype(jnp.asarray(d).dtype)
+                        if vk is None:
+                            vk = jnp.ones(k, jnp.bool_)
                     out_keys.append((dk, vk))
                 out_vals = [
                     (jnp.take(dd, idx), jnp.take(vv, idx))
@@ -2269,11 +2808,13 @@ class DagRunner:
     def _compile_final(
         self, frag, agg, root, exchanged, orientation, gcap, D,
         packing: bool = True, topk=None, bg=None, psum: bool = False,
+        fo=frozenset(),
     ):
         comp = ExprCompiler(lift_consts=True)
         b = _Builder(
             self.fx, comp, orientation, root,
             capture_id=bg[0] if bg is not None else None,
+            runner=self, D=D, fold_off=fo,
         )
         ev = b.build(root, exchanged, D)
         mesh = self.fx.mesh
@@ -2282,7 +2823,7 @@ class DagRunner:
         if agg is not None and bg is not None and topk is not None:
             return self._compile_gseg(
                 b, ev, comp, agg, root, topk, psum, D, nflags
-            )
+            ) + (frozenset(b.folded),)
 
         if agg is not None:
             dids = [c.dict_id for c in root.schema]
@@ -2402,7 +2943,7 @@ class DagRunner:
                     out_specs=out_specs,
                 )(arrays)
 
-            return jax.jit(program), comp, mode
+            return jax.jit(program), comp, mode, frozenset(b.folded)
 
         # no aggregate: compact surviving rows on DEVICE to a static
         # per-device capacity before shipping — never transfer the padded
@@ -2456,7 +2997,10 @@ class DagRunner:
                     ),
                 )(arrays)
 
-            return jax.jit(program), comp, "rows_topk"
+            return (
+                jax.jit(program), comp, "rows_topk",
+                frozenset(b.folded),
+            )
 
         rowcap = gcap  # reused capacity slot for rows mode
 
@@ -2498,7 +3042,7 @@ class DagRunner:
                 ),
             )(arrays)
 
-        return jax.jit(program), comp, "rows"
+        return jax.jit(program), comp, "rows", frozenset(b.folded)
 
     # -- output collection -------------------------------------------------
     def _apply_proj(self, batch, agg, out_proj):
@@ -2647,6 +3191,57 @@ def _first_true(flags) -> Optional[int]:
         if bool(np.asarray(f).reshape(-1).any()):
             return i
     return None
+
+
+def _lookup_dense(pk, pmask, bk, bvis, bfull):
+    """Equi-join primitive for a small dense-keyed build side.
+
+    Sort the build rows by key (cheap — the build side is small by the
+    fold gate), then verify the VISIBLE keys form a gap-free unique
+    range [base, base+cnt): sorted position i must hold key base+i.
+    When they do, the sorted arrays ARE a perfect-hash table and every
+    probe row finds its build row with pure arithmetic: slot =
+    key - base. One small sort + one gather replaces the sort-merge
+    path's two full-probe-width sorts.
+
+    The density domain is ``bvis`` (storage visibility only); query
+    predicates arrive separately as ``bfull`` and act as SLOT validity
+    — a filtered dimension keeps its dense key range, its filtered-out
+    rows just match nothing (otherwise any selective dim filter would
+    punch gaps and defeat the fold). Duplicates and gaps both break
+    the position identity, so the single ``notdense`` flag subsumes
+    the dup check. Returns (matched [np] bool, bidx [np] int,
+    notdense 0-d bool)."""
+    pd, pv = pk
+    bd, bv = bk
+    nb = bd.shape[0]
+    npr = pd.shape[0]
+    if nb == 0:  # static: no build rows can ever match
+        return (
+            jnp.zeros(npr, jnp.bool_),
+            jnp.zeros(npr, jnp.int32),
+            jnp.asarray(False),
+        )
+    breal = bvis if bv is None else (bvis & bv)
+    preal = pmask if pv is None else (pmask & pv)
+    BIG = jnp.int64(2**62)
+    bkey = jnp.where(breal, bd.astype(jnp.int64), BIG)
+    sk, sidx = jax.lax.sort(
+        (bkey, jnp.arange(nb, dtype=jnp.int32)), num_keys=1,
+        is_stable=False,
+    )
+    cnt = jnp.sum(breal, dtype=jnp.int32)
+    iota = jnp.arange(nb, dtype=jnp.int64)
+    base = sk[0]
+    dense = jnp.all(
+        jnp.where(iota < cnt, sk == base + iota, True)
+    )
+    slot = pd.astype(jnp.int64) - base
+    inr = (slot >= 0) & (slot < cnt.astype(jnp.int64))
+    sloti = jnp.clip(slot, 0, max(nb - 1, 0)).astype(jnp.int32)
+    bidx = jnp.take(sidx, sloti)
+    matched = inr & preal & jnp.take(bfull, bidx)
+    return matched, bidx, ~dense
 
 
 def _lookup_sortmerge(pk, pmask, bk, bmask, check_dup: bool):

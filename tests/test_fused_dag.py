@@ -601,7 +601,36 @@ def test_gsort_negative_sums_fall_back_correctly(sess):
 
 
 def test_count_star_via_gsort(sess):
-    """count(*) and count(col) ride the run-length scans."""
+    """count(*) and count(col) ride the run-length scans. Folds are
+    pinned off so the gsort co-sort path itself stays covered (with
+    folds on, this foldable shape prefers gagg — tested separately)."""
+    import opentenbase_tpu.executor.fused_dag as fd
+
+    q = (
+        "select o_orderkey, count(*), sum(l_extendedprice), "
+        "o_orderdate from orders, lineitem "
+        "where o_orderkey = l_orderkey "
+        "group by o_orderkey, o_orderdate "
+        "order by 2 desc, o_orderkey limit 6"
+    )
+    sess.execute("set enable_fused_execution = off")
+    want = sess.query(q)
+    sess.execute("set enable_fused_execution = on")
+    runner = _mesh1_runner(sess)
+    saved = fd.DIMFOLD_MAX_BUILD
+    fd.DIMFOLD_MAX_BUILD = 0
+    try:
+        got = _run_mesh1(sess, runner, q)
+    finally:
+        fd.DIMFOLD_MAX_BUILD = saved
+    assert got == want
+    assert runner.last_mode == "gsort", runner.last_mode
+
+
+def test_count_star_via_gagg_fold(sess):
+    """The same foldable shape with folds ON rides gagg: the dim join
+    becomes a dense gather, grouping FD-reduces to the probe key, and
+    the carried ORDER BY column restores output order."""
     q = (
         "select o_orderkey, count(*), sum(l_extendedprice), "
         "o_orderdate from orders, lineitem "
@@ -615,7 +644,8 @@ def test_count_star_via_gsort(sess):
     runner = _mesh1_runner(sess)
     got = _run_mesh1(sess, runner, q)
     assert got == want
-    assert runner.last_mode == "gsort", runner.last_mode
+    assert runner.last_mode == "gagg", runner.last_mode
+    assert runner.last_folded, "top join did not fold"
 
 
 def test_demotion_is_loud_not_silent(sess):
@@ -690,10 +720,10 @@ def test_gagg_mode_clickbench_shape(sess):
     assert runner.last_mode == "gagg", runner.last_mode
 
 
-def test_gagg_group_col_order_falls_back(sess):
-    """ORDER BY on a group column can't ride the packed-key runs (packed
-    preserves equality, not order) — falls to the grouped path and still
-    matches the host."""
+def test_gagg_group_col_order_decodes_key(sess):
+    """ORDER BY on a group column rides gagg: the monotone packing is
+    invertible, so the ranking reads key values decoded from the sorted
+    packed key (no extra operand, no fallback)."""
     q = (
         "select l_orderkey, sum(l_extendedprice) from lineitem "
         "group by l_orderkey order by l_orderkey limit 8"
@@ -704,7 +734,7 @@ def test_gagg_group_col_order_falls_back(sess):
     runner = _mesh1_runner(sess)
     got = _run_mesh1(sess, runner, q)
     assert got == want
-    assert runner.last_mode != "gagg", runner.last_mode
+    assert runner.last_mode == "gagg", runner.last_mode
 
 
 def test_gsort_narrow_overflow_retries_wide(sess):
@@ -733,7 +763,14 @@ def test_gsort_narrow_overflow_retries_wide(sess):
     want = s.query(q)
     s.execute("set enable_fused_execution = on")
     runner = _mesh1_runner(sess)
-    got = _run_mesh1(sess, runner, q)
+    import opentenbase_tpu.executor.fused_dag as fd
+
+    saved = fd.DIMFOLD_MAX_BUILD
+    fd.DIMFOLD_MAX_BUILD = 0
+    try:
+        got = _run_mesh1(sess, runner, q)
+    finally:
+        fd.DIMFOLD_MAX_BUILD = saved
     assert got == want
     assert runner.last_mode == "gsort"
     assert runner._narrow_off, "narrow overflow was never flagged"
