@@ -531,6 +531,55 @@ def _fold_gate(runner, node: "L.Join", ji: int, build_right: bool,
     return 0 < best <= DIMFOLD_MAX_BUILD and best * 2 <= pest
 
 
+def _agg_specs(comp, agg, dids):
+    """(specs, afns) for an Aggregate's functions — the ONE compile
+    loop shared by every grouped formulation."""
+    specs: list[str] = []
+    afns: list = []
+    for a in agg.aggs:
+        if a.func == "count" and a.arg is None:
+            specs.append("count_star")
+            afns.append(None)
+        else:
+            specs.append(a.func)
+            afns.append(comp.compile(a.arg, dids))
+    return specs, afns
+
+
+def _fd_reduce(root, orientation, agg):
+    """(kept, dropped) group-expr indices after removing keys
+    functionally determined (transitively) by another present key —
+    the ONE fixpoint shared by gagg and wgagg (a one-sided change
+    would silently group the windowed and in-core paths differently)."""
+    fd = _fd_map(root, orientation)
+    nkeys = len(agg.group_exprs)
+    colpos = {
+        i: g.index
+        for i, g in enumerate(agg.group_exprs)
+        if isinstance(g, E.Col)
+    }
+    present = {p: i for i, p in colpos.items()}
+    drop: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for i, p in colpos.items():
+            if i in drop:
+                continue
+            q = fd.get(p)
+            seen = set()
+            while q is not None and q not in present and q not in seen:
+                seen.add(q)
+                q = fd.get(q)
+            if (
+                q is not None and q in present
+                and present[q] != i and present[q] not in drop
+            ):
+                drop.add(i)
+                changed = True
+    return [i for i in range(nkeys) if i not in drop], sorted(drop)
+
+
 def _seg_scan(x, boundary, op):
     """Segmented scan: at every position, ``op`` over the prefix of its
     run (runs delimited by ``boundary``); at run-END positions this is
@@ -682,7 +731,7 @@ class _Builder:
     def __init__(
         self, fx, comp: ExprCompiler, orientation: tuple, root,
         capture_id=None, runner=None, D: int = 1,
-        fold_off=frozenset(),
+        fold_off=frozenset(), window=None,
     ):
         self.fx = fx
         self.comp = comp
@@ -701,6 +750,10 @@ class _Builder:
         self.D = D
         self.fold_off = fold_off
         self.folded: set = set()
+        # windowed execution: (leaf id, width) — that scan leaf reads
+        # only [wstart, wstart+width) of each shard's rows per run; the
+        # runner appends the traced ``wstart`` to the leaf's block tuple
+        self.window = window
         # group-by-build-side: the join node whose (bidx, build env) the
         # final program consumes; written at trace time, read right after
         # ev() inside the same trace
@@ -774,14 +827,39 @@ class _Builder:
             dtab.validity[c] is not None for c in node.columns
         )
         idx = self.leaf_index[id(node)]
+        win = (
+            self.window[1]
+            if self.window is not None and self.window[0] == id(node)
+            else None
+        )
 
         def run(blocks, params, snap):
-            cols, valids, xmin, xmax, nrows = blocks[idx]
-            k, rmax = xmin.shape
-            n = k * rmax
-            live = (
-                jnp.arange(rmax)[None, :] < nrows[:, None]
-            ).reshape(n)
+            if win is not None:
+                cols, valids, xmin, xmax, nrows, wstart = blocks[idx]
+                k, rmax = xmin.shape
+                W = win
+
+                def sl(a2d):
+                    return jax.lax.dynamic_slice(
+                        a2d,
+                        (jnp.asarray(0, wstart.dtype), wstart),
+                        (k, W),
+                    )
+
+                cols = [sl(c) for c in cols]
+                valids = [sl(v) for v in valids]
+                xmin, xmax = sl(xmin), sl(xmax)
+                n = k * W
+                live = (
+                    wstart + jnp.arange(W)[None, :] < nrows[:, None]
+                ).reshape(n)
+            else:
+                cols, valids, xmin, xmax, nrows = blocks[idx]
+                k, rmax = xmin.shape
+                n = k * rmax
+                live = (
+                    jnp.arange(rmax)[None, :] < nrows[:, None]
+                ).reshape(n)
             xmin = xmin.reshape(n)
             xmax = xmax.reshape(n)
             live = live & (xmin <= snap) & (snap < xmax)
@@ -1056,6 +1134,9 @@ class DagRunner:
         self._topk_off: dict = {}  # (skey, topk spec) -> ranking overflowed
         self._narrow_off: dict = {}  # skey -> i32 operands overflowed
         self._fold_off: dict = {}  # skey -> {join idx}: dense fold failed
+        # negative sum values break the cumsum+cummax run-base trick;
+        # the robust retry switches those sums to a segmented add scan
+        self._robust_on: dict = {}
         # sizing results remembered per (program, data version): repeat
         # queries on unchanged data skip the count pass / optimistic
         # group-capacity round trip entirely
@@ -1755,6 +1836,17 @@ class DagRunner:
                     gs = _detect_gsort(agg, root, orientation)
                     if gs is None:
                         ga = ga_ok
+            if ga is not None and D == 1:
+                # bigger-than-HBM probe: stream the dominant scan leaf
+                # through the same program in windows (device-resident
+                # partials, one merge, one fetch)
+                wplan = self._wgagg_leaf(root, agg, tk)
+                if wplan is not None:
+                    return self._run_wgagg(
+                        wplan, agg, root, exchanged, tk, D, skey,
+                        orientation, sig, versions, snap, dicts_view,
+                        subquery_values, out_proj,
+                    )
             if use_topk and agg is not None and gs is None and ga is None:
                 bg = _detect_build_group(agg, root, orientation)
                 if bg is not None and D > 1 and not complete:
@@ -1777,11 +1869,12 @@ class DagRunner:
             narrow = (
                 gs is not None or ga is not None
             ) and not self._narrow_off.get(skey)
+            robust = bool(self._robust_on.get(skey))
             fo = frozenset(self._fold_off.get(skey, ()))
             fkey = (
                 "final", skey, orientation, gcap, D, sig, packing,
                 tk if use_topk else None, bg is not None, psum,
-                gs is not None, ga is not None, narrow, fo,
+                gs is not None, ga is not None, narrow, fo, robust,
             )
             cached = self._programs.get(fkey)
             if cached is None:
@@ -1805,6 +1898,7 @@ class DagRunner:
                     cached = self._compile_gagg(
                         b, ev, comp, agg, root, tk, D,
                         _count_inner_joins(root), narrow=narrow,
+                        robust=robust,
                     ) + (frozenset(b.folded),)
                 else:
                     cached = self._compile_final(
@@ -1861,6 +1955,16 @@ class DagRunner:
                     while len(self._narrow_off) > 512:
                         self._narrow_off.pop(
                             next(iter(self._narrow_off))
+                        )
+                    continue
+                if mode == "gagg" and not robust:
+                    # negative sum values (or a wrapping global prefix)
+                    # broke the cumsum run base: retry with segmented
+                    # add scans before giving up on ranking
+                    self._robust_on[skey] = True
+                    while len(self._robust_on) > 512:
+                        self._robust_on.pop(
+                            next(iter(self._robust_on))
                         )
                     continue
                 # ranking-key range overflowed int64 (data-dependent, so
@@ -2074,7 +2178,7 @@ class DagRunner:
 
     def _compile_gagg(
         self, b, ev, comp, agg, root, topk, D, nflags,
-        narrow: bool = False,
+        narrow: bool = False, robust: bool = False,
     ):
         """Grouped aggregation + top-k as ONE sort + prefix scans, no
         join required (reference shape: nodeAgg.c hashed grouping +
@@ -2097,15 +2201,7 @@ class DagRunner:
           count(*) shape sorts ONE i32 operand and nothing else."""
         dids = [c.dict_id for c in root.schema]
         gfns = [comp.compile(g, dids) for g in agg.group_exprs]
-        specs: list[str] = []
-        afns: list = []
-        for a in agg.aggs:
-            if a.func == "count" and a.arg is None:
-                specs.append("count_star")
-                afns.append(None)
-            else:
-                specs.append(a.func)
-                afns.append(comp.compile(a.arg, dids))
+        specs, afns = _agg_specs(comp, agg, dids)
         k, sspecs, _merged = topk
         nkeys = len(agg.group_exprs)
         naggs = len(agg.aggs)
@@ -2114,34 +2210,8 @@ class DagRunner:
         # FD-reduce the packed key set: keys determined (transitively)
         # by another present key don't need to sort — grouping by a
         # determinant subset yields identical runs
-        fd = _fd_map(root, b.orientation)
-        colpos = {
-            i: g.index
-            for i, g in enumerate(agg.group_exprs)
-            if isinstance(g, E.Col)
-        }
-        present = {p: i for i, p in colpos.items()}
-        drop: set = set()
-        changed = True
-        while changed:
-            changed = False
-            for i, p in colpos.items():
-                if i in drop:
-                    continue
-                q = fd.get(p)
-                seen = set()
-                while q is not None and q not in present and (
-                    q not in seen
-                ):
-                    seen.add(q)
-                    q = fd.get(q)
-                if (
-                    q is not None and q in present
-                    and present[q] != i and present[q] not in drop
-                ):
-                    drop.add(i)
-                    changed = True
-        kept = [i for i in range(nkeys) if i not in drop]
+        kept, dropped = _fd_reduce(root, b.orientation, agg)
+        drop = set(dropped)
         need_rid = bool(drop)
         # ORDER BY group keys that were FD-dropped must ride the sort
         # as carried operands (their values aren't in the packed key)
@@ -2328,19 +2398,22 @@ class DagRunner:
                             sv = sv.astype(jnp.int64)
                         out_vals_pos.append((sv, vvalid))
                         continue
-                    ok = ok & ~(jnp.min(sval) < 0)
                     if jnp.issubdtype(sval.dtype, jnp.integer):
-                        cs = jnp.cumsum(sval, dtype=jnp.int64)
-                        ok = ok & (cs[-1] < jnp.int64(2**62)) & (
-                            cs[-1] >= 0
-                        )
-                        own = sval.astype(jnp.int64)
+                        sval = sval.astype(jnp.int64)
+                    if robust:
+                        sv = _seg_scan(sval, boundary, jnp.add)
                     else:
+                        # cumsum+cummax base needs non-negative values
+                        # and a non-wrapping global prefix; the robust
+                        # retry (segmented add scan) lifts both limits
+                        ok = ok & ~(jnp.min(sval) < 0)
                         cs = jnp.cumsum(sval)
-                        own = sval
-                    out_vals_pos.append(
-                        (run_from_start(cs, own), vvalid)
-                    )
+                        if jnp.issubdtype(cs.dtype, jnp.integer):
+                            ok = ok & (cs[-1] < jnp.int64(2**62)) & (
+                                cs[-1] >= 0
+                            )
+                        sv = run_from_start(cs, sval)
+                    out_vals_pos.append((sv, vvalid))
 
                 def decode_key(i, src):
                     """(value, valid|None) of kept key i from a packed
@@ -2429,6 +2502,524 @@ class DagRunner:
             )(arrays)
 
         return jax.jit(program), comp, "gagg"
+
+    # -- windowed grouped aggregation (bigger-than-HBM probes) -----------
+    def _wgagg_leaf(self, root, agg, tk):
+        """(leaf, window_plan) when the final gagg program's sort
+        operands would exceed the window budget: the dominant Scan leaf
+        streams in shard-row windows. None when it all fits."""
+        budget = int(os.environ.get(
+            "OTB_DAG_WINDOW_BUDGET", 6_000_000_000
+        ))
+        leaves = [
+            lf for lf in _walk_leaves(root) if isinstance(lf, L.Scan)
+        ]
+        if not leaves:
+            return None
+        big = max(leaves, key=lambda lf: self._est_rows(lf))
+        rows = self._est_rows(big)
+        # sort-operand footprint per probe row: key + per-agg value and
+        # validity + carried keys + rid, roughly tripled for the sorted
+        # copies and prefix scans
+        per_row = 8 + len(agg.aggs) * 9 + 8 + 4
+        if rows * per_row * 3 <= budget:
+            return None
+        meta = self.fx.catalog.get(big.table)
+        nodes = _scan_nodes(meta)
+        stores = [
+            self.fx.node_stores[n][big.table] for n in nodes
+        ]
+        rmax = filt_ops.bucket_size(
+            max(max((s.nrows for s in stores), default=0), 1)
+        )
+        k = len(stores)
+        # power-of-two window width dividing the power-of-two rmax, so
+        # dynamic_slice never clamps into the previous window
+        width = rmax
+        while k * width * per_row * 3 > budget and width > 1024:
+            width //= 2
+        if width >= rmax:
+            return None
+        return big, width, rmax
+
+    def _run_wgagg(
+        self, wplan, agg, root, exchanged, tk, D, skey, orientation,
+        sig, versions, snap, dicts_view, subquery_values, out_proj,
+    ):
+        """Windowed gagg: the dominant scan leaf streams in shard-row
+        windows through the SAME folded/filtered tree; each window
+        emits its compacted per-group partials (device-resident — no
+        fetch), and one merge program re-groups the partials, ranks,
+        and ships only the LIMIT rows. Build sides stay resident, so
+        the reference's multi-batch hash join
+        (nodeHash.c ExecHashIncreaseNumBatches) becomes: same program,
+        sliding window, one concat+sort of partials at the end."""
+        leaf, width, rmax = wplan
+        nwin = rmax // width
+        k, sspecs, _merged = tk
+        cap = max(width // 4, 4096)
+        wcapkey = ("wcap", skey, orientation, D, sig, versions)
+        cap = self._caps.get(wcapkey, cap)
+        while True:
+            fo = frozenset(self._fold_off.get(skey, ()))
+            robust = bool(self._robust_on.get(skey))
+            ckey = (
+                "wgagg", skey, orientation, D, sig, fo, cap, width,
+                robust,
+            )
+            cached = self._programs.get(ckey)
+            if cached is None:
+                cached = self._compile_wgagg(
+                    agg, root, exchanged, tk, D, orientation, fo,
+                    leaf, width, cap, robust=robust,
+                )
+                self._programs[ckey] = cached
+            wprog, mprog, comp, folded = cached
+            params = self._resolve(comp, dicts_view, subquery_values)
+            arrays = _collect_arrays(self.fx, root, exchanged, D)
+            lidx = self.leaf_index_of(root, leaf)
+            wouts = []
+            for w in range(nwin):
+                arr_w = list(arrays)
+                arr_w[lidx] = tuple(arr_w[lidx]) + (
+                    jnp.int32(w * width),
+                )
+                # device handles only — nothing fetches until merge
+                wouts.append(wprog(tuple(arr_w), params, snap))
+            outs = jax.device_get(mprog(tuple(wouts), params, snap))
+            (out_keys, out_vals, gvalid, novf, okf, flags) = outs
+            self.last_mode = "wgagg"
+            self.last_folded = folded
+            flip = _first_true(flags)
+            if flip is not None:
+                orientation = self._on_flag(
+                    skey, orientation, flip, folded
+                )
+                continue
+            if bool(np.asarray(novf).any()):
+                cap *= 2  # a window had more groups than the compact cap
+                if cap > width:
+                    raise DagUnsupported("wgagg partials exceed window")
+                self._cap_store(wcapkey, cap)
+                continue
+            if not bool(np.asarray(okf).all()):
+                if not robust:
+                    self._robust_on[skey] = True
+                    continue
+                self._topk_off[(skey, tk, versions)] = True
+                raise DagUnsupported("wgagg ranking overflow")
+            self._orientations[skey] = orientation
+            out_keys = jax.tree.map(lambda x: x[:1], out_keys)
+            out_vals = jax.tree.map(lambda x: x[:1], out_vals)
+            gvalid = gvalid[:1]
+            return self._apply_proj(
+                self._collect_grouped(agg, out_keys, out_vals, gvalid),
+                agg, out_proj,
+            )
+
+    def leaf_index_of(self, root, leaf) -> int:
+        for i, lf in enumerate(_walk_leaves(root)):
+            if lf is leaf:
+                return i
+        raise DagUnsupported("window leaf not found")
+
+    def _compile_wgagg(
+        self, agg, root, exchanged, topk, D, orientation, fo, leaf,
+        width, cap, robust: bool = False,
+    ):
+        """Compile the (window, merge) program pair. Restriction: after
+        FD-reduction exactly ONE bare integer group key remains — its
+        RAW value is the sort key in both programs, so per-window sorts
+        stay comparable without a global range pass."""
+        comp = ExprCompiler(lift_consts=True)
+        b = _Builder(
+            self.fx, comp, orientation, root, runner=self, D=D,
+            fold_off=fo, window=(id(leaf), width),
+        )
+        ev = b.build(root, exchanged, D)
+        dids = [c.dict_id for c in root.schema]
+        gfns = [comp.compile(g, dids) for g in agg.group_exprs]
+        specs, afns = _agg_specs(comp, agg, dids)
+        k, sspecs, _merged = topk
+        nkeys = len(agg.group_exprs)
+        naggs = len(agg.aggs)
+        mesh = self.fx.mesh
+        nflags = _count_inner_joins(root)
+
+        kept, dropped = _fd_reduce(root, orientation, agg)
+        if len(kept) != 1 or not isinstance(
+            agg.group_exprs[kept[0]], E.Col
+        ):
+            raise DagUnsupported("wgagg needs one bare group key")
+        kidx = kept[0]
+        if agg.group_exprs[kidx].type.is_text:
+            raise DagUnsupported("wgagg text group key")
+        NULLS = jnp.int64(2**62 - 1)
+        DEADS = jnp.int64(2**62)
+        # merge semantics per partial: sum/count partials re-SUM,
+        # min/min, max/max (the reference's two-phase split,
+        # src/backend/optimizer/plan/createplan.c:1852)
+        merge_op = [
+            "sum" if s in ("sum", "count", "count_star") else s
+            for s in specs
+        ]
+
+        def window_program(arrays, params, snap):
+            def block(blocks):
+                env, mask, n, flags = ev(blocks, params, snap)
+                flags = [jnp.reshape(f, (1,)) for f in flags]
+                ok = jnp.asarray(True)
+                kd, kv = _bcast(gfns[kidx](env, params), n)
+                k64 = kd.astype(jnp.int64)
+                # raw keys must stay strictly below the NULL/dead
+                # sentinels (the packed gagg path rebases instead; keys
+                # this extreme flag out and demote)
+                live_k = mask if kv is None else (mask & kv)
+                ok = ok & jnp.all(
+                    jnp.where(live_k, k64 < NULLS, True)
+                ) & jnp.all(
+                    jnp.where(live_k, k64 > -DEADS, True)
+                )
+                if kv is not None:
+                    k64 = jnp.where(kv, k64, NULLS)
+                keyop = jnp.where(mask, k64, DEADS)
+                operands = [keyop]
+                val_pos: list = []
+                for spec, fn in zip(specs, afns):
+                    if fn is None:
+                        val_pos.append(None)
+                        continue
+                    d, v = _bcast(fn(env, params), n)
+                    if jnp.issubdtype(d.dtype, jnp.integer):
+                        d = d.astype(jnp.int64)
+                    elif jnp.issubdtype(d.dtype, jnp.floating):
+                        d = d.astype(jnp.float64)
+                    vv = mask if v is None else (mask & v)
+                    if spec in ("min", "max"):
+                        if jnp.issubdtype(d.dtype, jnp.floating):
+                            ident = jnp.asarray(
+                                jnp.inf if spec == "min" else -jnp.inf,
+                                d.dtype,
+                            )
+                        else:
+                            ident = jnp.asarray(
+                                2**62 if spec == "min" else -(2**62),
+                                d.dtype,
+                            )
+                        dv = jnp.where(vv, d, ident)
+                    else:
+                        dv = jnp.where(vv, d, jnp.zeros((), d.dtype))
+                    operands.append(dv)
+                    vi = len(operands)
+                    operands.append(vv.astype(jnp.int8))
+                    val_pos.append((vi - 1, vi))
+                carried_pos = []
+                for p in dropped:
+                    d, v = _bcast(gfns[p](env, params), n)
+                    operands.append(
+                        jnp.where(mask, d.astype(jnp.int64), 0)
+                    )
+                    ci = len(operands) - 1
+                    vi = None
+                    if v is not None:
+                        operands.append((mask & v).astype(jnp.int8))
+                        vi = len(operands) - 1
+                    carried_pos.append((ci, vi))
+                sorted_ops = jax.lax.sort(
+                    tuple(operands), num_keys=1, is_stable=False
+                )
+                salk = sorted_ops[0]
+                boundary = jnp.concatenate([
+                    jnp.ones(1, jnp.bool_), salk[1:] != salk[:-1]
+                ])
+                end = jnp.concatenate([
+                    boundary[1:], jnp.ones(1, jnp.bool_)
+                ])
+                live_end = end & (salk < DEADS)
+
+                def run_from_start(cs, own):
+                    base = jax.lax.cummax(
+                        jnp.where(
+                            boundary, cs - own,
+                            jnp.asarray(-1, dtype=cs.dtype),
+                        )
+                    )
+                    return cs - base
+
+                run_cnt = None
+
+                def get_run_cnt():
+                    nonlocal run_cnt
+                    if run_cnt is None:
+                        lv = (salk < DEADS).astype(jnp.int32)
+                        run_cnt = run_from_start(jnp.cumsum(lv), lv)
+                    return run_cnt
+
+                pvals = []  # per agg: (partial value, partial valid)
+                for spec, vp in zip(specs, val_pos):
+                    if spec == "count_star":
+                        c = get_run_cnt()
+                        pvals.append((c.astype(jnp.int64), c > 0))
+                        continue
+                    oi, vi = vp
+                    sval = sorted_ops[oi]
+                    lv = sorted_ops[vi].astype(jnp.int32)
+                    vcnt = run_from_start(jnp.cumsum(lv), lv)
+                    vvalid = vcnt > 0
+                    if spec == "count":
+                        pvals.append(
+                            (vcnt.astype(jnp.int64), live_end)
+                        )
+                        continue
+                    if spec in ("min", "max"):
+                        op = jnp.minimum if spec == "min" else (
+                            jnp.maximum
+                        )
+                        sv = _seg_scan(sval, boundary, op)
+                        if jnp.issubdtype(sv.dtype, jnp.integer):
+                            sv = sv.astype(jnp.int64)
+                        pvals.append((sv, vvalid))
+                        continue
+                    if jnp.issubdtype(sval.dtype, jnp.integer):
+                        sval = sval.astype(jnp.int64)
+                    if robust:
+                        sv = _seg_scan(sval, boundary, jnp.add)
+                    else:
+                        ok = ok & ~(jnp.min(sval) < 0)
+                        cs = jnp.cumsum(sval)
+                        if jnp.issubdtype(cs.dtype, jnp.integer):
+                            ok = ok & (
+                                cs[-1] < jnp.int64(2**62)
+                            ) & (cs[-1] >= 0)
+                        sv = run_from_start(cs, sval)
+                    pvals.append((sv, vvalid))
+
+                nend = jnp.sum(live_end, dtype=jnp.int32)
+                novf = nend > cap
+                order = jnp.argsort(~live_end)[:cap]
+
+                def pick(x):
+                    return jnp.take(x, order)
+
+                out = [pick(salk)]
+                for dd, vv in pvals:
+                    out.append(pick(dd))
+                    out.append(pick(vv))
+                for ci, vi in carried_pos:
+                    out.append(pick(sorted_ops[ci]))
+                    out.append(
+                        pick(
+                            sorted_ops[vi] > 0 if vi is not None
+                            else jnp.ones_like(salk, jnp.bool_)
+                        )
+                    )
+                out.append(pick(live_end))
+                return (
+                    [o[None] for o in out],
+                    jnp.reshape(novf, (1,)),
+                    jnp.reshape(ok, (1,)),
+                    flags,
+                )
+
+            return shard_map(
+                block,
+                mesh=mesh,
+                in_specs=(_specs_like(arrays),),
+                out_specs=(
+                    [P("dn")] * (1 + 2 * naggs + 2 * len(dropped) + 1),
+                    P("dn"),
+                    P("dn"),
+                    [P("dn")] * nflags,
+                ),
+            )(arrays)
+
+        nwcols = 1 + 2 * naggs + 2 * len(dropped) + 1
+
+        def merge_program(wouts, params, snap):
+            def block(*wcols_flat):
+                # wcols_flat per window: nwcols columns + novf + ok
+                # + flags
+                per = nwcols + 2 + nflags
+                wins = [
+                    wcols_flat[i * per:(i + 1) * per]
+                    for i in range(len(wouts))
+                ]
+                cols = [
+                    jnp.concatenate([w[i].reshape(-1) for w in wins])
+                    for i in range(nwcols)
+                ]
+                novf = jnp.any(
+                    jnp.stack([w[nwcols].any() for w in wins])
+                )
+                wok = jnp.all(
+                    jnp.stack([w[nwcols + 1].all() for w in wins])
+                )
+                flags = [
+                    jnp.reshape(
+                        jnp.any(jnp.stack([
+                            w[nwcols + 2 + f].any() for w in wins
+                        ])),
+                        (1,),
+                    )
+                    for f in range(nflags)
+                ]
+                live_in = cols[-1]
+                key_in = jnp.where(
+                    live_in, cols[0], DEADS
+                )
+                operands = [key_in] + list(cols[1:-1])
+                sorted_ops = jax.lax.sort(
+                    tuple(operands), num_keys=1, is_stable=False
+                )
+                salk = sorted_ops[0]
+                m = salk.shape[0]
+                boundary = jnp.concatenate([
+                    jnp.ones(1, jnp.bool_), salk[1:] != salk[:-1]
+                ])
+                end = jnp.concatenate([
+                    boundary[1:], jnp.ones(1, jnp.bool_)
+                ])
+                live_end = end & (salk < DEADS)
+                ok = wok
+
+                def run_from_start(cs, own):
+                    base = jax.lax.cummax(
+                        jnp.where(
+                            boundary, cs - own,
+                            jnp.asarray(-1, dtype=cs.dtype),
+                        )
+                    )
+                    return cs - base
+
+                out_vals_pos = []
+                for ai, mop in enumerate(merge_op):
+                    sval = sorted_ops[1 + 2 * ai]
+                    svld = sorted_ops[2 + 2 * ai]
+                    lv = svld.astype(jnp.int32)
+                    vcnt = run_from_start(jnp.cumsum(lv), lv)
+                    vvalid = vcnt > 0
+                    if mop in ("min", "max"):
+                        if jnp.issubdtype(sval.dtype, jnp.floating):
+                            ident = jnp.asarray(
+                                jnp.inf if mop == "min" else -jnp.inf,
+                                sval.dtype,
+                            )
+                        else:
+                            ident = jnp.asarray(
+                                2**62 if mop == "min" else -(2**62),
+                                sval.dtype,
+                            )
+                        sv = jnp.where(lv > 0, sval, ident)
+                        op = jnp.minimum if mop == "min" else (
+                            jnp.maximum
+                        )
+                        out_vals_pos.append(
+                            (_seg_scan(sv, boundary, op), vvalid)
+                        )
+                        continue
+                    sv = jnp.where(lv > 0, sval, jnp.zeros(
+                        (), sval.dtype
+                    ))
+                    if jnp.issubdtype(sv.dtype, jnp.integer):
+                        sv = sv.astype(jnp.int64)
+                    if robust:
+                        out_vals_pos.append(
+                            (_seg_scan(sv, boundary, jnp.add), vvalid)
+                        )
+                        continue
+                    ok = ok & ~(jnp.min(sv) < 0)
+                    cs = jnp.cumsum(sv)
+                    if jnp.issubdtype(cs.dtype, jnp.integer):
+                        ok = ok & (cs[-1] < jnp.int64(2**62)) & (
+                            cs[-1] >= 0
+                        )
+                    out_vals_pos.append(
+                        (run_from_start(cs, sv), vvalid)
+                    )
+
+                coff = 1 + 2 * naggs
+                stride = jnp.int64(1)
+                prod = jnp.float64(1.0)
+                packed_rank = jnp.zeros(m, dtype=jnp.int64)
+                for p, desc, nf in reversed(sspecs):
+                    if p >= nkeys:
+                        d64, v = out_vals_pos[p - nkeys]
+                        d64 = d64.astype(jnp.int64)
+                    elif p == kidx:
+                        d64 = salk
+                        v = salk != NULLS
+                    else:
+                        di = dropped.index(p)
+                        d64 = sorted_ops[coff + 2 * di]
+                        v = sorted_ops[coff + 2 * di + 1]
+                    x, r, rf, okbit = _rank_encode(
+                        d64, v, desc, nf, live_end
+                    )
+                    packed_rank = packed_rank + x * stride
+                    stride = stride * r
+                    prod = prod * jnp.maximum(rf, 1.0)
+                    ok = ok & okbit
+                ok = ok & (prod < jnp.float64(2**62))
+
+                idx, sel = _topk_idx(packed_rank, live_end, k)
+                salk_k = jnp.take(salk, idx)
+                out_keys = []
+                for i in range(nkeys):
+                    if i == kidx:
+                        out_keys.append(
+                            (salk_k, salk_k != NULLS)
+                        )
+                    else:
+                        di = dropped.index(i)
+                        out_keys.append((
+                            jnp.take(
+                                sorted_ops[coff + 2 * di], idx
+                            ),
+                            jnp.take(
+                                sorted_ops[coff + 2 * di + 1], idx
+                            ).astype(jnp.bool_),
+                        ))
+                out_vals = [
+                    (jnp.take(dd, idx), jnp.take(vv, idx))
+                    for dd, vv in out_vals_pos
+                ]
+                return (
+                    jax.tree.map(lambda x: x[None], out_keys),
+                    jax.tree.map(lambda x: x[None], out_vals),
+                    sel[None],
+                    jnp.reshape(novf, (1,)),
+                    jnp.reshape(ok, (1,)),
+                    flags,
+                )
+
+            flat = []
+            for wo in wouts:
+                cols_w, novf_w, ok_w, flags_w = wo
+                flat.extend(cols_w)
+                flat.append(novf_w)
+                flat.append(ok_w)
+                flat.extend(flags_w)
+            in_specs = tuple([P("dn")] * len(flat))
+            return shard_map(
+                block,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=(
+                    [(P("dn"), P("dn"))] * nkeys,
+                    [(P("dn"), P("dn"))] * naggs,
+                    P("dn"),
+                    P("dn"),
+                    P("dn"),
+                    [P("dn")] * nflags,
+                ),
+            )(*flat)
+
+        return (
+            jax.jit(window_program),
+            jax.jit(merge_program),
+            comp,
+            frozenset(b.folded),
+        )
 
     def _compile_gsort(
         self, b, comp, agg, gs, root, exchanged, topk, D, nflags,
@@ -3127,7 +3718,10 @@ class DagRunner:
 
 
 def _specs_like(arrays):
-    return jax.tree.map(lambda _: P("dn"), tuple(arrays))
+    # scalars (e.g. the wgagg window start) replicate; arrays shard
+    return jax.tree.map(
+        lambda a: P() if jnp.ndim(a) == 0 else P("dn"), tuple(arrays)
+    )
 
 
 def _bcast(kv, n):

@@ -201,3 +201,131 @@ def test_gagg_narrow_overflow_retries_wide(sess):
     r = _runner(sess)
     assert r.last_mode == "gagg"
     assert r._narrow_off, "narrow overflow was never flagged"
+
+
+def test_windowed_gagg_matches_host(monkeypatch):
+    """Bigger-than-budget probes stream in windows (wgagg): per-window
+    compacted partials merge in one final program. Forced here with a
+    tiny OTB_DAG_WINDOW_BUDGET on a 1-device mesh; results must match
+    the host path exactly, including FD-dropped group keys and
+    cross-window groups (the reference analog: multi-batch hash join,
+    nodeHash.c ExecHashIncreaseNumBatches)."""
+    import jax
+
+    monkeypatch.setenv("OTB_DAG_WINDOW_BUDGET", "200000")
+    s = Cluster(num_datanodes=1, shard_groups=16).session()
+    rng = np.random.default_rng(7)
+    s.execute(
+        "create table dim (k bigint, cat bigint) "
+        "distribute by replication"
+    )
+    s.execute(
+        "create table f (fk bigint, v bigint) distribute by roundrobin"
+    )
+    nd, nf = 64, 6000
+    s.execute("insert into dim values " + ",".join(
+        f"({i},{i % 7})" for i in range(nd)
+    ))
+    s.execute("insert into f values " + ",".join(
+        f"({int(k)},{int(v)})" for k, v in zip(
+            rng.integers(0, nd, nf), rng.integers(1, 50, nf)
+        )
+    ))
+    q = (
+        "select fk, cat, sum(v), count(*) from f, dim where fk = k "
+        "group by fk, cat order by 3 desc, fk limit 9"
+    )
+    s.execute("set enable_fused_execution = off")
+    want = s.query(q)
+
+    from opentenbase_tpu.executor.fused import FusedExecutor
+    from opentenbase_tpu.executor.fused_dag import DagRunner
+    from opentenbase_tpu.executor.local import LocalExecutor
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.plan.distribute import distribute_statement
+    from opentenbase_tpu.plan.optimize import optimize_statement
+    from opentenbase_tpu.sql.parser import parse
+
+    c = s.cluster
+    mesh1 = jax.sharding.Mesh(
+        np.asarray(jax.devices("cpu")[:1]), ("dn",)
+    )
+    runner = DagRunner(FusedExecutor(c.catalog, c.stores, mesh=mesh1))
+    sp = optimize_statement(
+        analyze_statement(parse(q)[0], c.catalog), c.catalog
+    )
+    dp = distribute_statement(sp, c.catalog)
+    res = runner.run(dp, c.gts.snapshot_ts(), s._dicts_view(), [])
+    assert res is not None, runner.unsupported[-3:]
+    assert runner.last_mode == "wgagg", runner.last_mode
+    final_idx, batch = res
+    ex = LocalExecutor(
+        c.catalog, {}, c.gts.snapshot_ts(),
+        remote_inputs={final_idx: batch}, subquery_values=[],
+    )
+    got = ex.run_plan(dp.root).to_rows()
+    assert got == want, (got, want)
+
+
+def test_windowed_gagg_minmax_and_carried_order(monkeypatch):
+    """min/max partials merge across windows; ORDER BY an FD-dropped
+    key rides the carried columns."""
+    import jax
+
+    monkeypatch.setenv("OTB_DAG_WINDOW_BUDGET", "200000")
+    s = Cluster(num_datanodes=1, shard_groups=16).session()
+    rng = np.random.default_rng(9)
+    s.execute(
+        "create table dim (k bigint, cat bigint) "
+        "distribute by replication"
+    )
+    s.execute(
+        "create table f (fk bigint, v bigint) distribute by roundrobin"
+    )
+    s.execute("insert into dim values " + ",".join(
+        f"({i},{(i * 3) % 11})" for i in range(48)
+    ))
+    vals = [
+        f"({int(kk)},{int(v)})" for kk, v in zip(
+            rng.integers(0, 48, 5000),
+            rng.integers(-900, 900, 5000),
+        )
+    ]
+    vals.append("(3, null)")
+    s.execute("insert into f values " + ",".join(vals))
+    q = (
+        "select fk, cat, min(v), max(v), sum(v) from f, dim "
+        "where fk = k group by fk, cat "
+        "order by 5 desc, cat, fk limit 11"
+    )
+    s.execute("set enable_fused_execution = off")
+    want = s.query(q)
+
+    import jax as _j
+    from opentenbase_tpu.executor.fused import FusedExecutor
+    from opentenbase_tpu.executor.fused_dag import DagRunner
+    from opentenbase_tpu.executor.local import LocalExecutor
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.plan.distribute import distribute_statement
+    from opentenbase_tpu.plan.optimize import optimize_statement
+    from opentenbase_tpu.sql.parser import parse
+
+    c = s.cluster
+    mesh1 = _j.sharding.Mesh(
+        np.asarray(_j.devices("cpu")[:1]), ("dn",)
+    )
+    runner = DagRunner(FusedExecutor(c.catalog, c.stores, mesh=mesh1))
+    sp = optimize_statement(
+        analyze_statement(parse(q)[0], c.catalog), c.catalog
+    )
+    dp = distribute_statement(sp, c.catalog)
+    res = runner.run(dp, c.gts.snapshot_ts(), s._dicts_view(), [])
+    assert res is not None, runner.unsupported[-3:]
+    assert runner.last_mode == "wgagg", runner.last_mode
+    final_idx, batch = res
+    ex = LocalExecutor(
+        c.catalog, {}, c.gts.snapshot_ts(),
+        remote_inputs={final_idx: batch}, subquery_values=[],
+    )
+    got = ex.run_plan(dp.root).to_rows()
+    assert got == want, (got, want)
