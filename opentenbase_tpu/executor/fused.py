@@ -150,13 +150,42 @@ class DeviceCache:
         S = _pad_shards(len(stores), self.mesh.shape["dn"])
         rmax = filt_ops.bucket_size(max(max((s.nrows for s in stores), default=0), 1))
         sharding = NamedSharding(self.mesh, P("dn"))
-        xmin = np.full((S, rmax), 2**62, dtype=np.int64)
-        xmax = np.zeros((S, rmax), dtype=np.int64)
-        nrows = np.zeros(S, dtype=np.int64)
-        for i, s in enumerate(stores):
-            xmin[i, : s.nrows] = s.xmin_ts[: s.nrows]
-            xmax[i, : s.nrows] = s.xmax_ts[: s.nrows]
-            nrows[i] = s.nrows
+        # COMPACT visibility: after a bulk load every row of a shard
+        # carries the same (xmin, xmax), so the two MVCC planes upload
+        # as [S, 1] per-shard constants instead of 16 bytes/row — the
+        # visibility compare broadcasts on device for free. Any
+        # non-uniform shard falls back to the full planes. (The
+        # reference pays this with per-tuple xmin/xmax in the heap
+        # header, src/include/access/htup_details.h.)
+        uniform = True
+        for s in stores:
+            nr = s.nrows
+            if nr == 0:
+                continue
+            xm = s.xmin_ts[:nr]
+            xx = s.xmax_ts[:nr]
+            if xm[0] != xm[-1] or xx[0] != xx[-1] or not (
+                np.all(xm == xm[0]) and np.all(xx == xx[0])
+            ):
+                uniform = False
+                break
+        if uniform:
+            xmin = np.full((S, 1), 2**62, dtype=np.int64)
+            xmax = np.zeros((S, 1), dtype=np.int64)
+            nrows = np.zeros(S, dtype=np.int64)
+            for i, s in enumerate(stores):
+                if s.nrows:
+                    xmin[i, 0] = s.xmin_ts[0]
+                    xmax[i, 0] = s.xmax_ts[0]
+                nrows[i] = s.nrows
+        else:
+            xmin = np.full((S, rmax), 2**62, dtype=np.int64)
+            xmax = np.zeros((S, rmax), dtype=np.int64)
+            nrows = np.zeros(S, dtype=np.int64)
+            for i, s in enumerate(stores):
+                xmin[i, : s.nrows] = s.xmin_ts[: s.nrows]
+                xmax[i, : s.nrows] = s.xmax_ts[: s.nrows]
+                nrows[i] = s.nrows
         dt = DeviceTable(
             {},
             {},
@@ -179,6 +208,82 @@ class DeviceCache:
         )
         self._ensure_columns(dt, stores, meta, want)
         self._tables[(name, nodes)] = dt
+        return dt
+
+    def register_external(
+        self, name: str, meta, nodes, columns: dict, nrows,
+        versions=None,
+    ) -> DeviceTable:
+        """Register a DEVICE-RESIDENT table whose columns never lived in
+        host stores — e.g. benchmark data generated on-chip with
+        jax.random (the tunnel's ~10MB/s upload makes host-side
+        generation of SF100-scale tables unusable; on-chip threefry is
+        deterministic across backends, so a CPU baseline regenerates
+        identical data locally). ``columns``: {name: [S, rmax] array}
+        covering every column queries will touch (there is no host
+        backing to lazy-load more). Visibility is compact all-visible
+        planes; rmax may be ANY row count (not bucket-padded).
+        Pair with stub stores exposing .nrows/.version so planner
+        estimates and version checks keep working."""
+        nodes = tuple(nodes)
+        first = next(iter(columns.values()))
+        S, rmax = first.shape
+        sharding = NamedSharding(self.mesh, P("dn"))
+        xmin = np.zeros((S, 1), dtype=np.int64)
+        xmax = np.full((S, 1), 2**62, dtype=np.int64)
+        nr = np.zeros(S, dtype=np.int64)
+        nr[: len(nrows)] = nrows
+        cols = {}
+        col_range: dict = {}
+        col_maxabs: dict = {}
+        mins = {}
+        maxs = {}
+        nr_dev = jnp.asarray(nr)
+        for cname, arr in columns.items():
+            cols[cname] = jax.device_put(arr, sharding)
+            if jnp.issubdtype(arr.dtype, jnp.integer):
+                # stats over LIVE rows only — padding garbage would
+                # widen the range and disable narrow-operand paths
+                live = (
+                    jnp.arange(rmax)[None, :] < nr_dev[:S, None]
+                )
+                info = jnp.iinfo(arr.dtype)
+                mins[cname] = jnp.min(
+                    jnp.where(live, cols[cname], info.max)
+                )
+                maxs[cname] = jnp.max(
+                    jnp.where(live, cols[cname], info.min)
+                )
+        fetched = jax.device_get((mins, maxs))
+        for cname in columns:
+            if cname in fetched[0]:
+                lo = int(fetched[0][cname])
+                hi = int(fetched[1][cname])
+                col_range[cname] = (lo, hi)
+                col_maxabs[cname] = float(max(abs(lo), abs(hi)))
+            else:
+                col_range[cname] = None
+                col_maxabs[cname] = None
+        if versions is None:
+            versions = (1,) * len(nodes)
+        dt = DeviceTable(
+            cols,
+            {c: None for c in cols},
+            jax.device_put(xmin, sharding),
+            jax.device_put(xmax, sharding),
+            nr,
+            rmax,
+            tuple(versions),
+            nodes,
+            col_maxabs,
+            col_range,
+            [
+                {"nrows": int(n), "structure": 0, "mvcc_seq": 0}
+                for n in nr[: len(nodes)]
+            ],
+        )
+        with self._mu:
+            self._tables[(name, nodes)] = dt
         return dt
 
     def get_window(
@@ -332,6 +437,13 @@ class DeviceCache:
         present = list(dt.columns)
         if not set(present) <= set(meta.schema):
             return None
+        if dt.xmin.shape[1] == 1:
+            # compact visibility planes can't take per-row writes —
+            # expand them ON DEVICE (broadcast, no tunnel traffic)
+            # before append tails / MVCC stamp replay land
+            S = dt.xmin.shape[0]
+            dt.xmin = jnp.broadcast_to(dt.xmin, (S, dt.rmax))
+            dt.xmax = jnp.broadcast_to(dt.xmax, (S, dt.rmax))
         for s, sy in zip(stores, dt.sync):
             if s.structure_version != sy["structure"]:
                 return None
@@ -1093,6 +1205,8 @@ class FusedExecutor:
         grouped = bool(m.agg.group_exprs)
         nkeys = len(m.agg.group_exprs)
 
+        rmax0 = dtab.rmax
+
         def per_device(
             cols, valids, xmin, xmax, nrows, snap, params, starts=None,
         ):
@@ -1101,8 +1215,12 @@ class FusedExecutor:
             # care whether partials are per shard or per device — the
             # coordinator merge re-aggregates either way — and a flat
             # pipeline avoids vmap-of-scan/einsum compositions that XLA
-            # lowers poorly on TPU.
-            k, rmax = xmin.shape
+            # lowers poorly on TPU. Visibility planes arrive either
+            # full [k, Rmax] or compact [k, 1] (uniform per shard) —
+            # the 2-D compare broadcasts the compact form for free.
+            k = xmin.shape[0]
+            rmax = rmax0
+            compact = xmin.shape[1] == 1
             if starts is not None:
                 # zone-map window: read only the candidate-block slice
                 # of each shard from HBM (dynamic start, static width)
@@ -1115,21 +1233,20 @@ class FusedExecutor:
 
                 cols = [sl(c) for c in cols]
                 valids = [sl(v) for v in valids]
-                xmin = sl(xmin)
-                xmax = sl(xmax)
+                if not compact:
+                    xmin = sl(xmin)
+                    xmax = sl(xmax)
                 nrows = jnp.clip(
                     nrows - starts.astype(nrows.dtype), 0, win
                 )
                 rmax = win
             n = k * rmax
             live = (
-                jnp.arange(rmax)[None, :] < nrows[:, None]
+                (jnp.arange(rmax)[None, :] < nrows[:, None])
+                & (xmin <= snap) & (snap < xmax)
             ).reshape(n)
-            xmin = xmin.reshape(n)
-            xmax = xmax.reshape(n)
             cols = [c.reshape(n) for c in cols]
             valids = [v.reshape(n) for v in valids]
-            live = live & (xmin <= snap) & (snap < xmax)
             env = []
             vi = 0
             for ci, d in enumerate(cols):

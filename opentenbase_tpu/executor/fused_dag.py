@@ -499,8 +499,62 @@ def _fd_map(root, orientation):
     return walk(root)
 
 
+def _chain_leaf(node, folded_ids=None, est=None):
+    """Peel a build subtree down to the ONE leaf whose rows it
+    preserves: Filters keep rows; an inner join that dimension-FOLDS
+    keeps its probe side's rows (folds mask, never drop). Returns
+    (leaf, leaf_positions) where leaf_positions are the positions of
+    ``node.schema`` backed directly by leaf columns — a fold key must
+    be leaf-backed, since folded-in dim columns hold garbage on
+    unmatched rows and can't anchor the density domain. None when the
+    chain breaks.
+
+    ``folded_ids``: exact set of folded join ids (builder, post-order
+    known). ``est``: row-estimate fallback used by the runner's mode
+    prediction BEFORE any builder exists — it assumes a small-side
+    join will fold, which only risks picking a slower mode, never a
+    wrong result."""
+    offset = 0
+    width = len(node.schema)
+    while True:
+        if isinstance(node, L.Filter):
+            node = node.child
+            continue
+        if isinstance(node, L.Join) and node.join_type == "inner":
+            nl = len(node.left.schema)
+            if folded_ids is not None:
+                if id(node) not in folded_ids:
+                    return None
+                build_right = folded_ids[id(node)]
+            elif est is not None:
+                try:
+                    le, re = est(node.left), est(node.right)
+                except Exception:
+                    return None
+                build_right = le > re
+                bn_est = min(le, re)
+                if not (
+                    0 < bn_est <= DIMFOLD_MAX_BUILD
+                    and bn_est * 2 <= max(le, re)
+                ):
+                    return None
+            else:
+                return None
+            if build_right:
+                node = node.left
+                width = nl
+            else:
+                node = node.right
+                offset += nl
+                width = len(node.schema)
+            continue
+        if isinstance(node, (L.Scan, RemoteSource)):
+            return node, range(offset, offset + width)
+        return None
+
+
 def _fold_gate(runner, node: "L.Join", ji: int, build_right: bool,
-               fold_off) -> bool:
+               fold_off, folded_ids=None) -> bool:
     """THE dimension-fold gate — one definition shared by the builder
     (which compiles the fold) and the runner's mode selection (which
     predicts it). Static checks only; density/uniqueness is verified
@@ -509,19 +563,24 @@ def _fold_gate(runner, node: "L.Join", ji: int, build_right: bool,
     fold sees exactly the per-device build rows sort-merge would, an
     empty build shard matches nothing under both, and a sharded
     (non-dense-per-device) build trips the flag once and disables
-    itself. Requires a runner (row estimates), a build subtree of
-    shape Filter*(leaf) (predicates peel into slot validity; a
-    Project/Join would change rows), and a build side small in
-    absolute terms AND relative to the probe (folding a same-size side
-    would just rename the sort)."""
+    itself. Requires a runner (row estimates), a build subtree that
+    preserves ONE leaf's rows — Filter chains and already-folded
+    child joins both qualify (predicates and join matches peel into
+    slot validity) — with the join key backed by that leaf, and a
+    build side small in absolute terms AND relative to the probe
+    (folding a same-size side would just rename the sort)."""
     if runner is None or ji in fold_off:
         return False
     bnode = node.right if build_right else node.left
     pnode = node.left if build_right else node.right
-    chain = bnode
-    while isinstance(chain, L.Filter):
-        chain = chain.child
-    if not isinstance(chain, (L.Scan, RemoteSource)):
+    chain = _chain_leaf(
+        bnode, folded_ids=folded_ids,
+        est=runner._est_rows if folded_ids is None else None,
+    )
+    if chain is None:
+        return False
+    bkey = (node.right_keys if build_right else node.left_keys)[0]
+    if not _expr_cols(bkey) <= set(chain[1]):
         return False
     try:
         best = runner._est_rows(bnode)
@@ -600,6 +659,17 @@ def _build_side_node(root):
     """The top join node under ``root`` (Filters stripped), or None."""
     node = root
     while isinstance(node, L.Filter):
+        node = node.child
+    return node if isinstance(node, L.Join) else None
+
+
+def _top_join(root):
+    """The outermost join under ``root``, peeling Filters AND Projects
+    (a Project remaps columns but doesn't change which join is
+    outermost — used where only the JOIN itself matters: fold-gate
+    prediction and build-side hoisting)."""
+    node = root
+    while isinstance(node, (L.Filter, L.Project)):
         node = node.child
     return node if isinstance(node, L.Join) else None
 
@@ -750,6 +820,7 @@ class _Builder:
         self.D = D
         self.fold_off = fold_off
         self.folded: set = set()
+        self.folded_ids: dict = {}  # id(join) -> build_right, folded
         # windowed execution: (leaf id, width) — that scan leaf reads
         # only [wstart, wstart+width) of each shard's rows per run; the
         # runner appends the traced ``wstart`` to the leaf's block tuple
@@ -769,9 +840,11 @@ class _Builder:
 
     def _fold_eligible(self, node: L.Join, ji: int, build_right: bool):
         """Attempt the dense direct-index lookup for this inner join?
-        See ``_fold_gate`` — the one shared definition."""
+        See ``_fold_gate`` — the one shared definition. Children build
+        first (post-order), so their fold decisions are exact."""
         return _fold_gate(
-            self.runner, node, ji, build_right, self.fold_off
+            self.runner, node, ji, build_right, self.fold_off,
+            folded_ids=self.folded_ids,
         )
 
     def _repl_scan_leaves(self, node) -> bool:
@@ -833,10 +906,14 @@ class _Builder:
             else None
         )
 
+        rmax0 = dtab.rmax
+
         def run(blocks, params, snap):
+            # visibility planes are full [k, Rmax] or compact [k, 1]
+            # (uniform per shard) — 2-D compares broadcast either form
             if win is not None:
                 cols, valids, xmin, xmax, nrows, wstart = blocks[idx]
-                k, rmax = xmin.shape
+                k = xmin.shape[0]
                 W = win
 
                 def sl(a2d):
@@ -848,21 +925,22 @@ class _Builder:
 
                 cols = [sl(c) for c in cols]
                 valids = [sl(v) for v in valids]
-                xmin, xmax = sl(xmin), sl(xmax)
+                if xmin.shape[1] != 1:
+                    xmin, xmax = sl(xmin), sl(xmax)
                 n = k * W
                 live = (
-                    wstart + jnp.arange(W)[None, :] < nrows[:, None]
+                    (wstart + jnp.arange(W)[None, :] < nrows[:, None])
+                    & (xmin <= snap) & (snap < xmax)
                 ).reshape(n)
             else:
                 cols, valids, xmin, xmax, nrows = blocks[idx]
-                k, rmax = xmin.shape
+                k = xmin.shape[0]
+                rmax = rmax0
                 n = k * rmax
                 live = (
-                    jnp.arange(rmax)[None, :] < nrows[:, None]
+                    (jnp.arange(rmax)[None, :] < nrows[:, None])
+                    & (xmin <= snap) & (snap < xmax)
                 ).reshape(n)
-            xmin = xmin.reshape(n)
-            xmax = xmax.reshape(n)
-            live = live & (xmin <= snap) & (snap < xmax)
             env = []
             vi = 0
             for ci in range(len(cols)):
@@ -962,7 +1040,6 @@ class _Builder:
         build_right = True
         fold = False
         bstrip_fn = None
-        bpred_fns: list = []
         if jt == "inner":
             ji = self.njoin
             self.njoin += 1
@@ -972,18 +1049,19 @@ class _Builder:
             fold = self._fold_eligible(node, ji, build_right)
             if fold:
                 self.folded.add(ji)
-                # compile the build side with its Filter chain peeled:
-                # the leaf closure supplies env + visibility (the
-                # density domain), the predicates become slot validity
+                self.folded_ids[id(node)] = build_right
+                # the chain leaf's closure supplies the density domain
+                # (visibility only); the FULL build closure's mask —
+                # filters, nested fold matches, everything — becomes
+                # slot validity
                 bnode = node.right if build_right else node.left
-                chain = bnode
-                while isinstance(chain, L.Filter):
-                    cdids = [c.dict_id for c in chain.child.schema]
-                    bpred_fns.append(
-                        self.comp.compile(chain.predicate, cdids)
-                    )
-                    chain = chain.child
-                bstrip_fn = self.build(chain, exchanged, D)
+                leaf, _lp = _chain_leaf(
+                    bnode, folded_ids=self.folded_ids
+                )
+                bstrip_fn = self.build(leaf, exchanged, D)
+                presorted = isinstance(leaf, RemoteSource) and bool(
+                    exchanged.get(leaf.fragment, {}).get("presorted")
+                )
         if self.D > 1:
             # replicated tables scanned INSIDE a multi-device join
             # fragment hold their rows on one device — a build side
@@ -1024,27 +1102,27 @@ class _Builder:
 
         def run(blocks, params, snap):
             if fold:
-                # evaluate only the probe side's full closure; the build
-                # side comes from the stripped leaf chain (a leaf chain
-                # contributes no flags, so flag ordering is preserved)
-                benv, bvis, bn, _bf = bstrip_fn(blocks, params, snap)
-                bfull = bvis
-                for pf in bpred_fns:
-                    d, v = pf(benv, params)
-                    keep = d if v is None else (d & v)
-                    bfull = bfull & jnp.broadcast_to(keep, (bn,))
+                lenv, lmask, ln, lflags = left(blocks, params, snap)
+                renv, rmask, rn, rflags = right(blocks, params, snap)
+                flags = lflags + rflags
                 if build_right:
-                    penv, pmask, pn, pflags = left(blocks, params, snap)
+                    penv, pmask, pn = lenv, lmask, ln
+                    benv, bmask, bn = renv, rmask, rn
                     pk = _bcast(lkfn(penv, params), pn)
                     bk = _bcast(rkfn(benv, params), bn)
                 else:
-                    penv, pmask, pn, pflags = right(blocks, params, snap)
+                    penv, pmask, pn = renv, rmask, rn
+                    benv, bmask, bn = lenv, lmask, ln
                     pk = _bcast(rkfn(penv, params), pn)
                     bk = _bcast(lkfn(benv, params), bn)
+                # density domain: the chain leaf's visibility (XLA CSEs
+                # the duplicate leaf read); slot validity: the full
+                # build mask (filters + nested fold matches)
+                _lenv, bvis, _bvn, _bf = bstrip_fn(blocks, params, snap)
                 matched, bidx, dup = _lookup_dense(
-                    pk, pmask, bk, bvis, bfull
+                    pk, pmask, bk, bvis, bmask, presorted=presorted
                 )
-                flags = pflags + [dup]
+                flags = flags + [dup]
                 if do_capture:
                     builder.captured = (bidx, benv, bn)
                 gathered = [
@@ -1333,7 +1411,7 @@ class DagRunner:
         """``_fold_gate`` applied to the TOP join — used to choose
         gagg-over-folds instead of the gsort concat-sort before any
         builder exists."""
-        join = _build_side_node(root)
+        join = _top_join(root)
         if join is None or join.join_type != "inner":
             return False
         ji = _count_inner_joins(root) - 1
@@ -2529,14 +2607,21 @@ class DagRunner:
         stores = [
             self.fx.node_stores[n][big.table] for n in nodes
         ]
-        rmax = filt_ops.bucket_size(
-            max(max((s.nrows for s in stores), default=0), 1)
+        # the cache's ACTUAL padded capacity (external registrations
+        # are exact-sized, not bucket-padded)
+        dtab = self.fx.cache.get(
+            big.table, meta, self.fx.node_stores, nodes,
+            columns=big.columns,
         )
+        rmax = dtab.rmax
         k = len(stores)
         # power-of-two window width dividing the power-of-two rmax, so
         # dynamic_slice never clamps into the previous window
         width = rmax
-        while k * width * per_row * 3 > budget and width > 1024:
+        while (
+            k * width * per_row * 3 > budget
+            and width % 2 == 0 and width > 1024
+        ):
             width //= 2
         if width >= rmax:
             return None
@@ -2560,24 +2645,51 @@ class DagRunner:
         cap = max(width // 4, 4096)
         wcapkey = ("wcap", skey, orientation, D, sig, versions)
         cap = self._caps.get(wcapkey, cap)
+        h = None
+        h_key = None
         while True:
             fo = frozenset(self._fold_off.get(skey, ()))
             robust = bool(self._robust_on.get(skey))
+            root_c, exch_c = root, exchanged
+            ori_c, fo_c = orientation, fo
+            gmap = None
+            if h_key != (orientation, fo):
+                # prep survives cap/robust retries; only orientation or
+                # fold-off changes invalidate the hoisted build
+                h = self._maybe_hoist(
+                    root, agg, orientation, skey, exchanged, D, snap,
+                    dicts_view, subquery_values, leaf, sig, versions,
+                )
+                h_key = (orientation, fo)
+            if h == "retry":
+                h_key = None
+                continue
+            if h is not None:
+                root_c, exch_c, gmap = h
+                nj2 = _count_inner_joins(root_c)
+                ori_c = tuple(
+                    orientation[gmap(i)]
+                    if gmap(i) < len(orientation) else "R"
+                    for i in range(nj2 - 1)
+                ) + ("R",)  # prepped source always sits on the right
+                fo_c = frozenset(
+                    i for i in range(nj2) if gmap(i) in fo
+                )
             ckey = (
                 "wgagg", skey, orientation, D, sig, fo, cap, width,
-                robust,
+                robust, h is not None,
             )
             cached = self._programs.get(ckey)
             if cached is None:
                 cached = self._compile_wgagg(
-                    agg, root, exchanged, tk, D, orientation, fo,
+                    agg, root_c, exch_c, tk, D, ori_c, fo_c,
                     leaf, width, cap, robust=robust,
                 )
                 self._programs[ckey] = cached
             wprog, mprog, comp, folded = cached
             params = self._resolve(comp, dicts_view, subquery_values)
-            arrays = _collect_arrays(self.fx, root, exchanged, D)
-            lidx = self.leaf_index_of(root, leaf)
+            arrays = _collect_arrays(self.fx, root_c, exch_c, D)
+            lidx = self.leaf_index_of(root_c, leaf)
             wouts = []
             for w in range(nwin):
                 arr_w = list(arrays)
@@ -2588,12 +2700,18 @@ class DagRunner:
                 wouts.append(wprog(tuple(arr_w), params, snap))
             outs = jax.device_get(mprog(tuple(wouts), params, snap))
             (out_keys, out_vals, gvalid, novf, okf, flags) = outs
+            gfolded = (
+                folded if gmap is None
+                else frozenset(gmap(x) for x in folded)
+            )
             self.last_mode = "wgagg"
-            self.last_folded = folded
+            self.last_folded = gfolded
             flip = _first_true(flags)
             if flip is not None:
                 orientation = self._on_flag(
-                    skey, orientation, flip, folded
+                    skey, orientation,
+                    flip if gmap is None else gmap(flip),
+                    gfolded,
                 )
                 continue
             if bool(np.asarray(novf).any()):
@@ -2622,6 +2740,211 @@ class DagRunner:
             if lf is leaf:
                 return i
         raise DagUnsupported("window leaf not found")
+
+    # -- fold-prep hoisting (window-invariant build sides) ---------------
+    PREP_FRAG = -7
+    HOIST_MIN_ROWS = 4_000_000
+
+    def _maybe_hoist(
+        self, root, agg, orientation, skey, exchanged, D, snap,
+        dicts_view, subquery_values, wleaf, sig, versions,
+    ):
+        """When the top join's build side is window-invariant and big,
+        evaluate + key-sort it ONCE in a prep program and rewrite the
+        tree so every window consumes it as a presorted RemoteSource
+        behind a match-validity Filter — otherwise each window would
+        re-sort the whole build (the multi-batch hash join keeps its
+        hash table across batches for the same reason, nodeHash.c).
+        Returns (root2, exchanged2, ori_map) or None; ``ori_map``
+        translates the rewritten tree's join indices back to the
+        original orientation/fold-off index space."""
+        top = _top_join(root)
+        if top is None or top.join_type != "inner":
+            return None
+        gji = _count_inner_joins(root) - 1
+        build_right = (
+            orientation[gji] if gji < len(orientation) else "R"
+        ) == "R"
+        if build_right:
+            bnode, pnode = top.right, top.left
+        else:
+            if top.residual is not None:
+                return None  # residual positions would need remapping
+            bnode, pnode = top.left, top.right
+        if any(lf is wleaf for lf in _walk_leaves(bnode)):
+            return None  # windowed leaf on the build side: not invariant
+        if not any(lf is wleaf for lf in _walk_leaves(pnode)):
+            return None
+        if self._est_rows(bnode) < self.HOIST_MIN_ROWS:
+            return None  # per-window sort of a small build is cheap
+        if not self._top_join_foldable(root, orientation, skey):
+            return None
+        p = _count_inner_joins(pnode)
+        b = _count_inner_joins(bnode)
+        # post-order numbering: the FIRST-BUILT child's joins come
+        # first — build joins occupy [p, p+b) when the build side is
+        # the right child, [0, b) when it is the left
+        boff = p if build_right else 0
+        poff = 0 if build_right else b
+        fo = self._fold_off.get(skey, set())
+        ori_local = tuple(orientation[boff:boff + b])
+        fo_local = frozenset(
+            x - boff for x in fo if boff <= x < boff + b
+        )
+        bkey = (top.right_keys if build_right else top.left_keys)[0]
+        pkey = (
+            "prep", skey, tuple(orientation), D, fo_local, sig,
+            versions,
+        )
+        cached = self._programs.get(pkey)
+        if cached is None:
+            cached = self._compile_fold_prep(
+                bnode, exchanged, ori_local, fo_local, D, bkey
+            )
+            self._programs[pkey] = cached
+        prog, comp, folded_local = cached
+        params = self._resolve(comp, dicts_view, subquery_values)
+        arrays = _collect_arrays(self.fx, bnode, exchanged, D)
+        cols, valids, counts, flags = prog(tuple(arrays), params, snap)
+        flags = jax.device_get(flags)  # tiny; build data stays on device
+        flip = _first_true(flags)
+        if flip is not None:
+            # map the prep-local join index back to the global space
+            self._on_flag(
+                skey, orientation, flip + boff,
+                frozenset(x + boff for x in folded_local),
+            )
+            return "retry"
+        schema2 = tuple(bnode.schema) + (
+            L.OutCol("__match_ok", t.BOOL),
+        )
+        rs = RemoteSource(fragment=self.PREP_FRAG, schema=schema2)
+        filt = L.Filter(
+            child=rs,
+            predicate=E.Col(len(bnode.schema), t.BOOL, "__match_ok"),
+            schema=schema2,
+        )
+        import dataclasses
+
+        if build_right:
+            top2 = dataclasses.replace(top, right=filt)
+            repl = top2
+        else:
+            # swap sides so the prepped source (with its trailing
+            # __match_ok column) sits on the RIGHT — appending there
+            # shifts no downstream positions — and restore the
+            # original column order with a Project above
+            nr0 = len(top.right.schema)
+            swapped = dataclasses.replace(
+                top, left=top.right, right=filt,
+                left_keys=top.right_keys, right_keys=top.left_keys,
+                schema=tuple(top.right.schema) + schema2,
+            )
+            proj_exprs = tuple(
+                E.Col(nr0 + i, c.type, c.name)
+                for i, c in enumerate(top.left.schema)
+            ) + tuple(
+                E.Col(i, c.type, c.name)
+                for i, c in enumerate(top.right.schema)
+            )
+            repl = L.Project(
+                child=swapped, exprs=proj_exprs, schema=top.schema
+            )
+        root2 = _replace_node(root, top, repl)
+        exchanged2 = dict(exchanged)
+        exchanged2[self.PREP_FRAG] = {
+            "cols": cols,
+            "valids": valids,
+            "counts": counts,
+            "cap": cols[0].shape[-1],
+            "schema": schema2,
+            "presorted": True,
+        }
+        self._producers = dict(getattr(self, "_producers", {}))
+        self._producers[self.PREP_FRAG] = bnode
+
+        def ori_map(local_idx: int) -> int:
+            # rewritten tree: probe joins occupy local [0, p) (the
+            # prepped source replaced the build subtree and always
+            # sits right), the top join is local p -> global p + b
+            return poff + local_idx if local_idx < p else p + b
+
+        return root2, exchanged2, ori_map
+
+    def _compile_fold_prep(
+        self, bnode, exchanged, ori_local, fo_local, D, bkey
+    ):
+        """ONE evaluation + key-sort of a build subtree: rows sorted by
+        the join key over the density domain (chain-leaf visibility),
+        every schema column + validity riding the sort, the full build
+        mask appended as a __match_ok column. Output is exchange-layout
+        so the window programs read it like any motioned fragment."""
+        comp = ExprCompiler(lift_consts=True)
+        b = _Builder(
+            self.fx, comp, ori_local, bnode, runner=self, D=D,
+            fold_off=fo_local,
+        )
+        ev = b.build(bnode, exchanged, D)
+        chain = _chain_leaf(bnode, folded_ids=b.folded_ids)
+        if chain is None:
+            # a nested build join was runtime-disabled (fold_off):
+            # the spine no longer folds — loud fallback, host answers
+            raise DagUnsupported("prep build side is not a fold chain")
+        leaf = chain[0]
+        bstrip = b.build(leaf, exchanged, D)
+        dids = [c.dict_id for c in bnode.schema]
+        bkfn = comp.compile(bkey, dids)
+        ncols = len(bnode.schema)
+        nflags = _count_inner_joins(bnode)
+        mesh = self.fx.mesh
+        BIG = jnp.int64(2**62)
+
+        def program(arrays, params, snap):
+            def block(blocks):
+                env, mask, n, flags = ev(blocks, params, snap)
+                _e2, vis, _n2, _f2 = bstrip(blocks, params, snap)
+                kd, kv = _bcast(bkfn(env, params), n)
+                kreal = vis if kv is None else (vis & kv)
+                key = jnp.where(kreal, kd.astype(jnp.int64), BIG)
+                ops = [key]
+                for i in range(ncols):
+                    d, v = env[i]
+                    ops.append(jnp.broadcast_to(d, (n,)))
+                    ops.append(
+                        jnp.ones(n, jnp.bool_) if v is None
+                        else jnp.broadcast_to(v, (n,))
+                    )
+                ops.append(mask)
+                sops = jax.lax.sort(
+                    tuple(ops), num_keys=1, is_stable=False
+                )
+                cnt = jnp.sum(kreal, dtype=jnp.int32)
+                out_cols = [sops[1 + 2 * i][None] for i in range(ncols)]
+                out_cols.append(sops[-1][None])  # __match_ok data
+                out_valids = [
+                    sops[2 + 2 * i][None] for i in range(ncols)
+                ]
+                out_valids.append(jnp.ones((1, n), jnp.bool_))
+                return (
+                    out_cols,
+                    out_valids,
+                    cnt.reshape(1),
+                    [jnp.reshape(f, (1,)) for f in flags],
+                )
+
+            return shard_map(
+                block,
+                mesh=mesh,
+                in_specs=(_specs_like(arrays),),
+                out_specs=(
+                    [P("dn")] * (ncols + 1),
+                    [P("dn")] * (ncols + 1),
+                    P("dn"),
+                    [P("dn")] * nflags,
+                ),
+            )(arrays)
+
+        return jax.jit(program), comp, frozenset(b.folded)
 
     def _compile_wgagg(
         self, agg, root, exchanged, topk, D, orientation, fo, leaf,
@@ -3733,6 +4056,26 @@ def _bcast(kv, n):
     return (d, v)
 
 
+def _replace_node(root, old, new):
+    """Rebuild ``root`` with the subtree ``old`` (by identity) replaced
+    by ``new``. Dataclass-generic, mirrors _inline_sources."""
+    import dataclasses
+
+    if root is old:
+        return new
+    if dataclasses.is_dataclass(root) and not isinstance(root, type):
+        changes = {}
+        for f in dataclasses.fields(root):
+            v = getattr(root, f.name)
+            if isinstance(v, (L.LogicalPlan, RemoteSource)):
+                nv = _replace_node(v, old, new)
+                if nv is not v:
+                    changes[f.name] = nv
+        if changes:
+            return dataclasses.replace(root, **changes)
+    return root
+
+
 def _contains_join(plan) -> bool:
     stack = [plan]
     while stack:
@@ -3787,7 +4130,7 @@ def _first_true(flags) -> Optional[int]:
     return None
 
 
-def _lookup_dense(pk, pmask, bk, bvis, bfull):
+def _lookup_dense(pk, pmask, bk, bvis, bfull, presorted=False):
     """Equi-join primitive for a small dense-keyed build side.
 
     Sort the build rows by key (cheap — the build side is small by the
@@ -3820,10 +4163,17 @@ def _lookup_dense(pk, pmask, bk, bvis, bfull):
     preal = pmask if pv is None else (pmask & pv)
     BIG = jnp.int64(2**62)
     bkey = jnp.where(breal, bd.astype(jnp.int64), BIG)
-    sk, sidx = jax.lax.sort(
-        (bkey, jnp.arange(nb, dtype=jnp.int32)), num_keys=1,
-        is_stable=False,
-    )
+    if presorted:
+        # a fold-prep program already key-sorted these rows; the
+        # position-identity check below still fully verifies the claim
+        # (an out-of-place or dead row breaks sk[i] == base + i)
+        sk = bkey
+        sidx = jnp.arange(nb, dtype=jnp.int32)
+    else:
+        sk, sidx = jax.lax.sort(
+            (bkey, jnp.arange(nb, dtype=jnp.int32)), num_keys=1,
+            is_stable=False,
+        )
     cnt = jnp.sum(breal, dtype=jnp.int32)
     iota = jnp.arange(nb, dtype=jnp.int64)
     base = sk[0]

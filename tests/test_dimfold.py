@@ -329,3 +329,79 @@ def test_windowed_gagg_minmax_and_carried_order(monkeypatch):
     )
     got = ex.run_plan(dp.root).to_rows()
     assert got == want, (got, want)
+
+
+def test_windowed_gagg_hoisted_build_prep(monkeypatch):
+    """A big window-invariant build side hoists into ONE prep program
+    (evaluate + key-sort once) and every window consumes it presorted —
+    results identical, top join still folds."""
+    import jax
+
+    monkeypatch.setenv("OTB_DAG_WINDOW_BUDGET", "200000")
+    s = Cluster(num_datanodes=1, shard_groups=16).session()
+    rng = np.random.default_rng(13)
+    s.execute(
+        "create table seg (g bigint, cat bigint) "
+        "distribute by replication"
+    )
+    s.execute(
+        "create table ord (ok bigint, gk bigint, od bigint) "
+        "distribute by replication"
+    )
+    s.execute(
+        "create table f (fk bigint, v bigint) distribute by roundrobin"
+    )
+    ng, no, nf = 32, 600, 7000
+    s.execute("insert into seg values " + ",".join(
+        f"({i},{i % 5})" for i in range(ng)
+    ))
+    s.execute("insert into ord values " + ",".join(
+        f"({i},{int(g)},{int(d)})" for i, g, d in zip(
+            range(no), rng.integers(0, ng, no),
+            rng.integers(0, 99, no),
+        )
+    ))
+    s.execute("insert into f values " + ",".join(
+        f"({int(k)},{int(v)})" for k, v in zip(
+            rng.integers(0, no + 40, nf), rng.integers(1, 60, nf)
+        )
+    ))
+    q = (
+        "select fk, od, cat, sum(v), count(*) from f, ord, seg "
+        "where fk = ok and gk = g and cat < 4 "
+        "group by fk, od, cat order by 4 desc, fk limit 10"
+    )
+    s.execute("set enable_fused_execution = off")
+    want = s.query(q)
+
+    from opentenbase_tpu.executor.fused import FusedExecutor
+    from opentenbase_tpu.executor.fused_dag import DagRunner
+    from opentenbase_tpu.executor.local import LocalExecutor
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.plan.distribute import distribute_statement
+    from opentenbase_tpu.plan.optimize import optimize_statement
+    from opentenbase_tpu.sql.parser import parse
+
+    c = s.cluster
+    mesh1 = jax.sharding.Mesh(
+        np.asarray(jax.devices("cpu")[:1]), ("dn",)
+    )
+    runner = DagRunner(FusedExecutor(c.catalog, c.stores, mesh=mesh1))
+    monkeypatch.setattr(runner, "HOIST_MIN_ROWS", 100)
+    sp = optimize_statement(
+        analyze_statement(parse(q)[0], c.catalog), c.catalog
+    )
+    dp = distribute_statement(sp, c.catalog)
+    res = runner.run(dp, c.gts.snapshot_ts(), s._dicts_view(), [])
+    assert res is not None, runner.unsupported[-3:]
+    assert runner.last_mode == "wgagg", runner.last_mode
+    assert ("prep",) == tuple(
+        k[0] for k in runner._programs if k[0] == "prep"
+    ), "prep program was not compiled (hoist did not engage)"
+    final_idx, batch = res
+    ex = LocalExecutor(
+        c.catalog, {}, c.gts.snapshot_ts(),
+        remote_inputs={final_idx: batch}, subquery_values=[],
+    )
+    got = ex.run_plan(dp.root).to_rows()
+    assert got == want, (got, want)

@@ -518,15 +518,38 @@ def _run_mesh1(sess, runner, q):
 
 def test_gsort_mode_engaged_for_q3_shape(sess):
     """The Q3 shape (group-by-unique-build + ORDER BY/LIMIT) at mesh
-    size 1 must take the co-sort path — the round-3 fast join — and
-    match the host answer exactly."""
+    size 1 takes the co-sort path when folds are pinned off — the
+    round-3 fast join stays covered — and matches the host answer.
+    (With folds on this shape chain-folds into gagg, tested below.)"""
+    import opentenbase_tpu.executor.fused_dag as fd
+
+    sess.execute("set enable_fused_execution = off")
+    want = sess.query(Q3)
+    sess.execute("set enable_fused_execution = on")
+    runner = _mesh1_runner(sess)
+    saved = fd.DIMFOLD_MAX_BUILD
+    fd.DIMFOLD_MAX_BUILD = 0
+    try:
+        got = _run_mesh1(sess, runner, Q3)
+    finally:
+        fd.DIMFOLD_MAX_BUILD = saved
+    assert got == want
+    assert runner.last_mode == "gsort", runner.last_mode
+
+
+def test_q3_chain_folds_into_gagg(sess):
+    """With folds on, the 3-table Q3 peels customer INTO orders and
+    orders INTO lineitem (chain folds), FD-reduces the grouping to
+    l_orderkey, and runs ONE probe-width gagg sort — matching the
+    host exactly."""
     sess.execute("set enable_fused_execution = off")
     want = sess.query(Q3)
     sess.execute("set enable_fused_execution = on")
     runner = _mesh1_runner(sess)
     got = _run_mesh1(sess, runner, Q3)
     assert got == want
-    assert runner.last_mode == "gsort", runner.last_mode
+    assert runner.last_mode == "gagg", runner.last_mode
+    assert len(runner.last_folded) == 2, runner.last_folded
 
 
 def test_topk_ships_only_limit_rows(sess):
@@ -535,7 +558,7 @@ def test_topk_ships_only_limit_rows(sess):
     runner = _mesh1_runner(sess)
     got = _run_mesh1(sess, runner, Q3)
     assert got is not None
-    assert runner.last_mode in ("gsort", "gseg", "grouped_topk")
+    assert runner.last_mode in ("gsort", "gseg", "grouped_topk", "gagg")
 
 
 def test_grouped_topk_mode_when_group_not_on_build(sess):
