@@ -29,6 +29,13 @@ import time
 
 import numpy as np
 
+# Engine knobs for the large legs, set BEFORE the package imports bake
+# module constants: the SF100 leg's orders build side (151M rows) must
+# pass the dimension-fold gate, and its 4 resident i32 columns (9.7GB)
+# must stay on the non-chunked scan path.
+os.environ.setdefault("OTB_DIMFOLD_MAX", "260000000")
+os.environ.setdefault("OTB_SCAN_HBM_BUDGET", "11000000000")
+
 # ---------------------------------------------------------------------------
 # Resilience: the bench must ALWAYS emit its one JSON line.
 # (a) Watchdog: if anything (device init, compile, the tunnel) wedges, a
@@ -172,13 +179,17 @@ def make_q3_dims(n: int, seed: int = 43):
 
 def _bulk_append(cluster, table: str, arrays) -> None:
     """Pre-sharded append straight into the stores (the COPY fast path
-    without CSV in the middle)."""
+    without CSV in the middle). Replicated tables receive the FULL row
+    set on every replica."""
     meta = cluster.catalog.get(table)
     n = len(next(iter(arrays.values())))
     nn = len(meta.node_indices)
     commit_ts = cluster.gts.get_gts()
     for i, node in enumerate(meta.node_indices):
-        sl = slice(i * n // nn, (i + 1) * n // nn)
+        sl = (
+            slice(0, n) if meta.dist.is_replicated
+            else slice(i * n // nn, (i + 1) * n // nn)
+        )
         cols = {
             name: Column(meta.schema[name], arrays[name][sl])
             for name in meta.schema
@@ -450,6 +461,9 @@ def main():
         record["q3_vs_baseline"] = round(
             (ROWS / q3_best) / (ROWS / q3_cpu), 3
         )
+        fxq = cluster.fused_executor()
+        if fxq is not None and fxq._dag is not None:
+            record["q3_mode"] = str(fxq._dag.last_mode)
         _phase("q3 measured", t_start)
         print(json.dumps(record), flush=True)
     except Exception as e:  # Q3 must never break the headline
@@ -461,59 +475,70 @@ def main():
     # follows on the same cluster. Both at half scale to fit the bench
     # wall-clock; row counts are recorded so ratios stay honest.
     try:
-        ex_rows = min(ROWS, 30_000_000)
+        # ClickBench's spec'd config is hits_100m (BASELINE.md config 5)
+        # and SSB is SF100-class: the extra legs default to 100M rows
+        # with int32 columns — honest scale amortizes the tunnel's fixed
+        # ~110ms round trip, and the CPU baseline's bincount goes
+        # DRAM-bound at the real 1:5 user:hits cardinality while the
+        # device sort degrades only as n log n.
+        ex_rows = int(os.environ.get(
+            "BENCH_EX_ROWS",
+            # real runs scale to the spec'd 100M; smoke-test configs
+            # (tiny BENCH_ROWS) stay proportional
+            100_000_000 if ROWS >= 8_000_000 else ROWS,
+        ))
         # free the TPC-H residency (HBM via the device cache, host RAM
         # via the stores) before loading the second dataset
         cluster._fused = None
         cluster.stores.clear()
         del arrays, orders, customer
         rng = np.random.default_rng(7)
-        n_users = max(ex_rows // 10, 1)
+        n_users = max(ex_rows // 5, 1)  # hits_100m: 17.6M/100M uniques
         hits = {
-            "userid": rng.integers(0, n_users, ex_rows).astype(np.int64),
-            "duration": rng.integers(0, 10_000, ex_rows).astype(np.int64),
+            "userid": rng.integers(0, n_users, ex_rows).astype(np.int32),
+            "duration": rng.integers(0, 10_000, ex_rows).astype(np.int32),
         }
         n_dates, n_parts = 2556, 200_000
         lineorder = {
             "lo_orderdate": rng.integers(0, n_dates, ex_rows).astype(
-                np.int64
+                np.int32
             ),
             "lo_partkey": rng.integers(0, n_parts, ex_rows).astype(
-                np.int64
+                np.int32
             ),
             "lo_revenue": rng.integers(100, 10_000, ex_rows).astype(
-                np.int64
+                np.int32
             ),
         }
         date_dim = {
-            "d_datekey": np.arange(n_dates, dtype=np.int64),
-            "d_year": (1992 + np.arange(n_dates) // 365).astype(np.int64),
+            "d_datekey": np.arange(n_dates, dtype=np.int32),
+            "d_year": (1992 + np.arange(n_dates) // 365).astype(np.int32),
         }
         part = {
-            "p_partkey": np.arange(n_parts, dtype=np.int64),
-            "p_category": rng.integers(0, 25, n_parts).astype(np.int64),
-            "p_brand": rng.integers(0, 1000, n_parts).astype(np.int64),
+            "p_partkey": np.arange(n_parts, dtype=np.int32),
+            "p_category": rng.integers(0, 25, n_parts).astype(np.int32),
+            "p_brand": rng.integers(0, 1000, n_parts).astype(np.int32),
         }
         cluster2 = Cluster(num_datanodes=NUM_DN, shard_groups=256)
         s3 = cluster2.session()
         s3.execute(
-            "create table hits (userid bigint, duration bigint) "
+            "create table hits (userid int, duration int) "
             "distribute by roundrobin"
         )
         _bulk_append(cluster2, "hits", hits)
         s3.execute(
-            "create table lineorder (lo_orderdate bigint, lo_partkey "
-            "bigint, lo_revenue bigint) distribute by roundrobin"
+            "create table lineorder (lo_orderdate int, lo_partkey "
+            "int, lo_revenue int) distribute by roundrobin"
         )
         _bulk_append(cluster2, "lineorder", lineorder)
         s3.execute(
-            "create table date_dim (d_datekey bigint, d_year bigint) "
-            "distribute by roundrobin"
+            "create table date_dim (d_datekey int, d_year int) "
+            "distribute by replication"
         )
         _bulk_append(cluster2, "date_dim", date_dim)
         s3.execute(
-            "create table part (p_partkey bigint, p_category bigint, "
-            "p_brand bigint) distribute by roundrobin"
+            "create table part (p_partkey int, p_category int, "
+            "p_brand int) distribute by replication"
         )
         _bulk_append(cluster2, "part", part)
         s3.execute("analyze")
@@ -538,6 +563,9 @@ def main():
         record["clickbench_rows"] = ex_rows
         record["clickbench_rows_per_sec"] = round(ex_rows / cb_best)
         record["clickbench_vs_baseline"] = round(cb_cpu / cb_best, 3)
+        fx2 = cluster2.fused_executor()
+        if fx2 is not None and fx2._dag is not None:
+            record["clickbench_mode"] = str(fx2._dag.last_mode)
         _phase("clickbench measured", t_start)
         print(json.dumps(record), flush=True)
 
@@ -570,10 +598,256 @@ def main():
         record["ssb_rows"] = ex_rows
         record["ssb_rows_per_sec"] = round(ex_rows / ssb_best)
         record["ssb_vs_baseline"] = round(ssb_cpu / ssb_best, 3)
+        fx2 = cluster2.fused_executor()
+        if fx2 is not None and fx2._dag is not None:
+            record["ssb_mode"] = str(fx2._dag.last_mode)
+            record["ssb_folds"] = len(fx2._dag.last_folded)
         _phase("ssb measured", t_start)
         print(json.dumps(record), flush=True)
     except Exception as e:  # extra legs must never break the record
         _phase(f"extra legs failed: {e!r:.200}", t_start)
+
+    try:
+        if os.environ.get("BENCH_SF100", "1") == "1":
+            # free the extra-leg residency first
+            try:
+                cluster2._fused = None
+                cluster2.stores.clear()
+                del hits, lineorder, date_dim, part
+            except Exception:
+                pass
+            sf100_legs(record, t_start)
+    except Exception as e:
+        _phase(f"sf100 legs failed: {e!r:.200}", t_start)
+
+
+class _ExtStore:
+    """Planner/version stub for a device-resident external table (no
+    host rows — DeviceCache.register_external holds the data)."""
+
+    def __init__(self, nrows: int):
+        self.nrows = nrows
+        self.version = 1
+        self.structure_version = 0
+        self.mvcc_seq = 0
+
+
+def sf100_legs(record, t_start) -> None:
+    """TPC-H SF100-scale Q3 + Q6 ON DEVICE (BASELINE config 3 at its
+    written scale): 604M lineitem rows generated on-chip with threefry
+    (deterministic across backends — the CPU baseline regenerates bit-
+    identical data locally; the ~10MB/s tunnel could never upload
+    ~12GB), registered as device-resident external tables. Q3 runs the
+    windowed gagg path (build sides hoisted + folded, probe streamed in
+    HBM-budget windows); Q6 the fused scan path."""
+    import jax
+    import jax.numpy as jnp
+
+    avail_kb = 0
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable"):
+                    avail_kb = int(line.split()[1])
+                    break
+    except OSError:
+        pass
+    N = int(os.environ.get("BENCH_SF_ROWS", 603_979_776))
+    # default 2^26 * 9: window-halvable, ~SF100.6
+    if N > 100_000_000 and avail_kb < 40_000_000:
+        _phase(f"sf100 skipped: {avail_kb}kB host RAM", t_start)
+        return
+    NO, NC = N // 4, N // 40
+    cpu0 = jax.devices("cpu")[0]
+
+    def gen(seed, shape, lo, hi, device):
+        k = jax.random.PRNGKey(seed)
+        with jax.default_device(device):
+            return jax.random.randint(k, shape, lo, hi, dtype=jnp.int32)
+
+    specs_li = {
+        "l_orderkey": (11, 1, NO + 1),
+        "l_quantity": (12, 100, 5100),
+        "l_extendedprice": (13, 900, 105001),
+        "l_discount": (14, 0, 11),
+        "l_shipdate": (15, 8036, 8036 + 2556),
+    }
+    specs_ord = {
+        "o_custkey": (21, 1, NC + 1),
+        "o_orderdate": (22, 8036, 8036 + 2405),
+        "o_shippriority": (23, 0, 3),
+    }
+
+    from opentenbase_tpu.engine import Cluster as _Cluster
+
+    c3 = _Cluster(num_datanodes=1, shard_groups=16)
+    s4 = c3.session()
+    s4.execute(
+        "create table lineitem (l_orderkey int, l_quantity int, "
+        "l_extendedprice int, l_discount int, l_shipdate int) "
+        "distribute by roundrobin"
+    )
+    s4.execute(
+        "create table orders (o_orderkey int, o_custkey int, "
+        "o_orderdate int, o_shippriority int) distribute by roundrobin"
+    )
+    s4.execute(
+        "create table customer (c_custkey int, c_mktsegment int) "
+        "distribute by roundrobin"
+    )
+    node_li = c3.catalog.get("lineitem").node_indices[0]
+    c3.stores[node_li]["lineitem"] = _ExtStore(N)
+    c3.stores[node_li]["orders"] = _ExtStore(NO)
+    c3.stores[node_li]["customer"] = _ExtStore(NC)
+    # optimizer stats the ANALYZE pass would have produced
+    c3.catalog.get("lineitem").stats = {
+        "rows": N, "ndv": {"l_orderkey": NO, "l_shipdate": 2556},
+    }
+    c3.catalog.get("orders").stats = {
+        "rows": NO, "ndv": {"o_orderkey": NO, "o_custkey": NC},
+    }
+    c3.catalog.get("customer").stats = {
+        "rows": NC, "ndv": {"c_custkey": NC, "c_mktsegment": 5},
+    }
+    fx = c3.fused_executor()
+
+    def register(table, nrows, cols):
+        meta = c3.catalog.get(table)
+        fx.cache.register_external(
+            table, meta, (node_li,), cols, [nrows]
+        )
+
+    # device-side generation (TPU threefry): orders/customer up front
+    ord_cols = {
+        "o_orderkey": jnp.arange(
+            1, NO + 1, dtype=jnp.int32
+        ).reshape(1, NO),
+    }
+    for name, (seed, lo, hi) in specs_ord.items():
+        ord_cols[name] = gen(seed, (1, NO), lo, hi, jax.devices()[0])
+    register("orders", NO, ord_cols)
+    del ord_cols
+    cust_cols = {
+        "c_custkey": jnp.arange(
+            1, NC + 1, dtype=jnp.int32
+        ).reshape(1, NC),
+        "c_mktsegment": gen(31, (1, NC), 0, 5, jax.devices()[0]),
+    }
+    register("customer", NC, cust_cols)
+    del cust_cols
+
+    # determinism spot-check: device threefry must equal host threefry
+    probe_dev = np.asarray(
+        gen(13, (1, 64), 900, 105001, jax.devices()[0])
+    )
+    probe_cpu = np.asarray(gen(13, (1, 64), 900, 105001, cpu0))
+    if not np.array_equal(probe_dev, probe_cpu):
+        _phase("sf100 skipped: threefry backend mismatch", t_start)
+        return
+
+    # ---- Q6 at SF100: resident scan columns qty/price/disc/ship ----
+    li_cols = {
+        name: gen(sd, (1, N), lo, hi, jax.devices()[0])
+        for name, (sd, lo, hi) in specs_li.items()
+        if name != "l_orderkey"
+    }
+    register("lineitem", N, li_cols)
+    del li_cols
+    Q6_SF = (
+        "select sum(l_extendedprice * l_discount) from lineitem "
+        "where l_shipdate >= 8766 and l_shipdate < 9131 "
+        "and l_discount between 5 and 7 and l_quantity < 2400"
+    )
+    got6 = s4.query(Q6_SF)[0][0]
+    _phase("sf100 q6 compiled", t_start)
+    q6_best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        s4.query(Q6_SF)
+        q6_best = min(q6_best, time.perf_counter() - t0)
+    # CPU baseline on bit-identical host-generated data
+    qty = np.asarray(gen(12, (1, N), 100, 5100, cpu0)).ravel()
+    price = np.asarray(gen(13, (1, N), 900, 105001, cpu0)).ravel()
+    disc = np.asarray(gen(14, (1, N), 0, 11, cpu0)).ravel()
+    ship = np.asarray(
+        gen(15, (1, N), 8036, 8036 + 2556, cpu0)
+    ).ravel()
+    t0 = time.perf_counter()
+    keep = (
+        (ship >= 8766) & (ship < 9131) & (disc >= 5) & (disc <= 7)
+        & (qty < 2400)
+    )
+    want6 = int(
+        np.sum(np.where(keep, price.astype(np.int64) * disc, 0))
+    )
+    q6_cpu = time.perf_counter() - t0
+    assert got6 == want6, (got6, want6)
+    del qty
+    record["sf100_rows"] = N
+    record["q6_sf100_rows_per_sec"] = round(N / q6_best)
+    record["q6_sf100_vs_baseline"] = round(q6_cpu / q6_best, 3)
+    _phase("sf100 q6 measured", t_start)
+    print(json.dumps(record), flush=True)
+
+    # ---- Q3 at SF100: swap qty column for the orderkey ----
+    dt = fx.cache._tables[("lineitem", (node_li,))]
+    del dt.columns["l_quantity"]
+    dt.columns["l_orderkey"] = jax.device_put(
+        gen(11, (1, N), 1, NO + 1, jax.devices()[0])
+    )
+    dt.validity["l_orderkey"] = None
+    dt.col_range["l_orderkey"] = (1, NO)
+    dt.col_maxabs["l_orderkey"] = float(NO)
+    Q3_SF = (
+        "select l_orderkey, sum(l_extendedprice * (10 - l_discount)), "
+        "o_orderdate, o_shippriority "
+        "from customer, orders, lineitem "
+        "where c_mktsegment = 0 and c_custkey = o_custkey "
+        "and l_orderkey = o_orderkey and o_orderdate < 9204 "
+        "and l_shipdate > 9204 "
+        "group by l_orderkey, o_orderdate, o_shippriority "
+        "order by 2 desc, o_orderdate limit 10"
+    )
+    got3 = s4.query(Q3_SF)
+    _phase(
+        f"sf100 q3 compiled (mode={fx._dag.last_mode})", t_start
+    )
+    q3_best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        s4.query(Q3_SF)
+        q3_best = min(q3_best, time.perf_counter() - t0)
+    okey = np.asarray(gen(11, (1, N), 1, NO + 1, cpu0)).ravel()
+    ocust = np.asarray(gen(21, (1, NO), 1, NC + 1, cpu0)).ravel()
+    odate = np.asarray(
+        gen(22, (1, NO), 8036, 8036 + 2405, cpu0)
+    ).ravel()
+    seg = np.asarray(gen(31, (1, NC), 0, 5, cpu0)).ravel()
+    t0 = time.perf_counter()
+    building = np.zeros(NC + 1, dtype=bool)
+    building[np.arange(1, NC + 1)[seg == 0]] = True
+    okeep = (odate < 9204) & building[ocust]
+    okmask = np.zeros(NO + 1, dtype=bool)
+    okmask[np.arange(1, NO + 1)[okeep]] = True
+    keep = (ship > 9204) & okmask[okey]
+    rev = np.bincount(
+        okey[keep],
+        weights=(
+            price[keep].astype(np.int64) * (10 - disc[keep])
+        ),
+        minlength=NO + 1,
+    )
+    top = np.argpartition(rev, -10)[-10:]
+    top = top[np.argsort(-rev[top])]
+    q3_cpu = time.perf_counter() - t0
+    assert got3 and got3[0][0] == int(top[0]) and (
+        got3[0][1] == int(rev[top[0]])
+    ), (got3[:2], top[:2], rev[top[0]])
+    record["q3_sf100_rows_per_sec"] = round(N / q3_best)
+    record["q3_sf100_vs_baseline"] = round(q3_cpu / q3_best, 3)
+    record["q3_sf100_mode"] = str(fx._dag.last_mode)
+    _phase("sf100 q3 measured", t_start)
+    print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":

@@ -768,7 +768,11 @@ class FusedExecutor:
         """(resident_bytes, row_bytes, S, max_shard_rows) for caching a
         table's scan columns (+16B/row of MVCC timestamps) at padded
         width — the ONE footprint model the chunk trigger and the window
-        sizing both use."""
+        sizing both use. A table already device-resident with every
+        wanted column (e.g. a register_external table: exact row
+        capacity, compact [S,1] MVCC planes) reports its ACTUAL bytes —
+        the padded-width estimate would overstate it and bounce the
+        scan onto the chunked path its stub stores can't serve."""
         row_bytes = 16 + sum(
             np.dtype(meta.schema[c].np_dtype).itemsize + 1
             for c in columns
@@ -778,8 +782,21 @@ class FusedExecutor:
             s = self.node_stores.get(n, {}).get(meta.name)
             if s is not None:
                 mx = max(mx, s.nrows)
-        rmax = filt_ops.bucket_size(max(mx, 1))
         S = _pad_shards(len(meta.node_indices), self.mesh.shape["dn"])
+        dt = self.cache._tables.get(
+            (meta.name, tuple(meta.node_indices))
+        )
+        if dt is not None and all(c in dt.columns for c in columns):
+            actual = sum(
+                dt.columns[c].nbytes
+                + (
+                    dt.validity[c].nbytes
+                    if dt.validity.get(c) is not None else 0
+                )
+                for c in columns
+            ) + dt.xmin.nbytes + dt.xmax.nbytes
+            return actual, row_bytes, S, mx
+        rmax = filt_ops.bucket_size(max(mx, 1))
         return S * rmax * row_bytes, row_bytes, S, mx
 
     def _resident_bytes(self, meta, columns) -> int:
