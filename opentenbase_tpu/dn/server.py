@@ -46,6 +46,25 @@ class DNServer:
         from opentenbase_tpu.storage.replication import StandbyCluster
 
         self.standby = StandbyCluster(data_dir, num_datanodes, shard_groups)
+        # gids resolved by the replication stream (their 'G' frame was
+        # applied here): a late/repeat 2PC decision for one of these
+        # must NOT re-apply its journal payload
+        self._stream_resolved: set = set()
+        # startup sweep: 'G' frames already in the local WAL copy were
+        # applied during StandbyCluster replay — retire their journals
+        # before any repeat 2pc_commit could double-apply them
+        from opentenbase_tpu.storage.persist import WAL as _WAL
+
+        try:
+            for tag, header, _arr, _off in _WAL.read_records(
+                self.standby.cluster.persistence.wal.path,
+                decode_arrays=False,
+            ):
+                if tag == "G" and header.get("gid"):
+                    self._on_stream_txn(header["gid"])
+        except OSError:
+            pass
+        self.standby.stream_txn_hook = self._on_stream_txn
         self.standby.start_replication(wal_host, wal_port)
         self._lsock = socket.socket()
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -122,12 +141,17 @@ class DNServer:
     # -- two-phase commit participant -------------------------------------
     # The reference's datanodes vote in the coordinator's implicit 2PC
     # (pgxc_node_remote_prepare, execRemote.c:3936; the 2PC control
-    # messages, pgxcnode.c:2843-3081). Here the DN's durable vote is a
-    # fsynced journal entry under <data_dir>/prepared_2pc: PREPARE
-    # persists the gid before the coordinator's irrevocable commit stamp,
-    # COMMIT/ABORT PREPARED retire it, and 2pc_list lets the
-    # coordinator's resolve_indoubt sweep orphans after a crash. Row data
-    # still flows through WAL replication — the journal is the vote.
+    # messages, pgxcnode.c:2843-3081). The DN's durable vote is a
+    # fsynced journal entry under <data_dir>/prepared_2pc that CARRIES
+    # THE TRANSACTION'S WRITE SET (twophase.c's state files hold the
+    # prepared WAL records the same way): PREPARE persists gid + data
+    # before the coordinator's irrevocable commit stamp; COMMIT applies
+    # the journaled writes to this DN's stores immediately through the
+    # stream-replay code path (read-your-writes without waiting for the
+    # WAL stream), with gid-tagged 'G' frames deduplicating the two
+    # delivery paths exactly-once; ABORT discards; 2pc_list lets the
+    # coordinator's resolve_indoubt sweep orphans after a crash. The
+    # prepared data also survives a coordinator crash on the DN's disk.
 
     def _twophase_dir(self) -> str:
         import os
@@ -135,6 +159,19 @@ class DNServer:
         d = os.path.join(self.standby.data_dir, "prepared_2pc")
         os.makedirs(d, exist_ok=True)
         return d
+
+    def _on_stream_txn(self, gid: str) -> None:
+        """The replication stream applied (or is about to apply) the
+        'G' frame for ``gid``: its journal is resolved."""
+        import os
+
+        self._stream_resolved.add(gid)
+        while len(self._stream_resolved) > 4096:
+            self._stream_resolved.pop()
+        try:
+            os.unlink(os.path.join(self._twophase_dir(), gid))
+        except OSError:
+            pass
 
     def _twophase_prepare(self, msg: dict) -> dict:
         import json
@@ -152,6 +189,12 @@ class DNServer:
             "participants": msg.get("participants") or [],
             "prepared_at": time.time(),
         }
+        # shipped DML (execRemote.c:3936): the write set itself rides
+        # the prepare and fsyncs WITH the vote — the twophase.c state
+        # file contract. COMMIT applies it locally without waiting for
+        # the WAL stream; the gid-tagged 'G' frame dedups later.
+        if msg.get("writes") is not None:
+            entry["writes"] = msg["writes"]
         with open(tmp, "w") as f:
             json.dump(entry, f)
             f.flush()
@@ -165,17 +208,55 @@ class DNServer:
         return {"ok": True}
 
     def _twophase_finish(self, msg: dict, committed: bool) -> dict:
+        import json
         import os
 
         gid = str(msg["gid"])
         path = os.path.join(self._twophase_dir(), gid)
         try:
-            os.unlink(path)
+            with open(path) as f:
+                entry = json.load(f)
         except FileNotFoundError:
             # presumed-abort protocol: finishing an unknown gid is a
-            # no-op (the prepare may never have arrived)
+            # no-op (the prepare may never have arrived, or the stream
+            # already resolved it)
             return {"ok": True, "known": False}
-        return {"ok": True, "known": True}
+        except ValueError:
+            entry = {}
+        applied = False
+        if committed and entry.get("writes") is not None:
+            applied = self._apply_journal(gid, entry, msg)
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        return {"ok": True, "known": True, "applied": applied}
+
+    def _apply_journal(self, gid: str, entry: dict, msg: dict) -> bool:
+        """Apply a journaled write set to OUR stores through the same
+        code path stream replay uses — exactly once across the two
+        delivery paths (direct_applied tells the stream to skip the
+        matching 'G' frame; _stream_resolved tells us the stream won)."""
+        from opentenbase_tpu.plan import serde
+
+        c = self.standby.cluster
+        with c._exec_lock:
+            if (
+                gid in self._stream_resolved
+                or gid in self.standby.direct_applied
+            ):
+                return False
+            commit_ts = msg.get("commit_ts")
+            if commit_ts is None:
+                return False
+            sub, arrays = serde.frame_from_wire(entry["writes"])
+            c.persistence._apply(
+                "G",
+                {"commit_ts": int(commit_ts), "writes": sub, "gid": gid},
+                arrays,
+            )
+            self.standby.direct_applied.add(gid)
+        return True
 
     def _twophase_list(self) -> list:
         import json

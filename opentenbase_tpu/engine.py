@@ -910,21 +910,58 @@ class Session:
             acked.append(n)
         return acked
 
+    def _txn_write_frame(self, txn: Transaction):
+        """The transaction's writes as a commit-group frame for DML
+        shipping to datanode processes (execRemote.c:3936 ships the
+        statements; we ship the materialized write set — same contract:
+        the DN's prepare becomes durable WITH the data). Returns
+        (sub, arrays) or None when a touched table's dictionary state
+        can't ride the payload (text columns sync via the WAL stream's
+        'D' records, which a direct apply would race)."""
+        from opentenbase_tpu.storage.persist import encode_commit_group
+
+        writes = [
+            (node, table, tw.ins_ranges, tw.del_idx)
+            for node, tabs in txn.writes.items()
+            for table, tw in tabs.items()
+        ]
+        if not writes:
+            return None
+        for _n, table, _i, _d in writes:
+            meta = self.cluster.catalog.get(table)
+            if any(c.is_text for c in meta.schema.values()):
+                return None
+        return encode_commit_group(writes, self.cluster.stores)
+
     def _commit_txn(self, txn: Transaction) -> None:
         self._check_write_conflicts(txn)
         gts = self.cluster.gts
         nodes = txn.touched_nodes()
         implicit_gid = None
+        shipped = False
+        frame = None
         if len(nodes) > 1 and txn.prepared_gid is None:
-            # implicit 2PC: datanode processes vote (durable journal
-            # entry) and the GTS records the prepare BEFORE the
-            # irrevocable commit-ts stamp (pgxc_node_remote_prepare,
+            # implicit 2PC: datanode processes vote with a durable
+            # journal entry that CARRIES THE WRITE SET — the prepared
+            # data survives a DN (or even coordinator) crash on the
+            # DN's disk, the 2PC state file contract of twophase.c —
+            # and the GTS records the prepare BEFORE the irrevocable
+            # commit-ts stamp (pgxc_node_remote_prepare,
             # execRemote.c:3936)
             implicit_gid = f"__implicit_{txn.gxid}"
+            extra = {}
+            chans = getattr(self.cluster, "dn_channels", None) or {}
+            if any(n in chans for n in nodes):
+                frame = self._txn_write_frame(txn)
+                if frame is not None:
+                    from opentenbase_tpu.plan import serde as _serde
+
+                    extra["writes"] = _serde.frame_to_wire(*frame)
+                    shipped = True
             try:
                 self._dn_2pc(
                     "2pc_prepare", implicit_gid, nodes,
-                    gxid=txn.gxid, participants=list(nodes),
+                    gxid=txn.gxid, participants=list(nodes), **extra,
                 )
             except Exception:
                 self._abort_txn(txn)
@@ -932,7 +969,11 @@ class Session:
             gts.prepare(txn.gxid, implicit_gid, tuple(nodes))
         commit_ts = gts.commit(txn.gxid)
         try:
-            self._stamp_commit(txn, commit_ts)
+            self._stamp_commit(
+                txn, commit_ts,
+                gid=implicit_gid if shipped else None,
+                frame=frame if shipped else None,
+            )
         except Exception:
             # half-applied stamp (WAL I/O failure, ...): roll back our own
             # commit_ts stamps so the in-memory state matches the WAL,
@@ -959,7 +1000,8 @@ class Session:
         self.cluster.locks.release_all(self.session_id)
 
     def _stamp_commit(
-        self, txn: Transaction, commit_ts: int, wal_log: bool = True
+        self, txn: Transaction, commit_ts: int, wal_log: bool = True,
+        gid=None, frame=None,
     ) -> None:
         # wal_log=False for explicitly-prepared txns: their writes are
         # already durable as a 'T' record, so the decision is logged as a
@@ -984,6 +1026,8 @@ class Session:
                 ],
                 self.cluster.stores,
                 commit_ts,
+                gid=gid,
+                frame=frame,
             )
         txn.unpin_all()
 

@@ -145,3 +145,21 @@ def batch_from_wire(w: dict, catalog) -> ColumnBatch:
             )
             cols[m["name"]] = Column(ty, d, v, dic)
     return ColumnBatch(cols, int(w["nrows"]))
+
+
+def frame_to_wire(sub: list, arrays: dict) -> dict:
+    """Commit-group frame (storage/persist.py encode_commit_group) ->
+    JSON-safe wire dict — the DN-shipped DML payload."""
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return {
+        "sub": sub,
+        "npz": base64.b64encode(buf.getvalue()).decode(),
+    }
+
+
+def frame_from_wire(w: dict) -> tuple[list, dict]:
+    data = base64.b64decode(w["npz"])
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    return list(w["sub"]), arrays

@@ -53,6 +53,36 @@ def _type_from_str(s: str) -> t.SqlType:
     return t.SqlType(t.TypeId(s))
 
 
+def encode_commit_group(writes, stores):
+    """(sub, arrays) for one committed transaction — THE 'G'-frame body.
+    Shared by WAL logging and the DN-shipped DML payload so a direct
+    apply on a datanode is byte-identical to stream replay.
+
+    ``writes``: iterable of (node, table, ins_ranges, del_idx)."""
+    sub = []
+    arrays: dict = {}
+    for node, table, ins_ranges, del_idx in writes:
+        store = stores[node][table]
+        for s, e in ins_ranges:
+            i = len(sub)
+            for name in store.schema:
+                arrays[f"w{i}_{name}"] = store._cols[name][s:e]
+                vm = store._validity.get(name)
+                if vm is not None:
+                    arrays[f"w{i}__v_{name}"] = vm[s:e]
+            sub.append(
+                {"node": node, "table": table, "kind": "ins",
+                 "nrows": e - s,
+                 "row_id_start": int(store.row_id[s]) if e > s else 0}
+            )
+        if len(del_idx):
+            i = len(sub)
+            idx = np.asarray(del_idx, dtype=np.int64)
+            arrays[f"w{i}_del"] = store.row_id[idx]
+            sub.append({"node": node, "table": table, "kind": "del"})
+    return sub, arrays
+
+
 class WAL:
     """Append-only framed log with fsync on every commit record."""
 
@@ -203,7 +233,7 @@ class ClusterPersistence:
         self.wal.append(b"D", op)
 
     def log_commit_group(
-        self, writes, stores, commit_ts: int
+        self, writes, stores, commit_ts: int, gid=None, frame=None
     ) -> None:
         """Log one committed transaction as ONE frame ('G'): a commit that
         touches many tables/nodes must be atomic under the torn-tail rule,
@@ -214,34 +244,24 @@ class ClusterPersistence:
         Deletes are logged by stable row id, not position: replayed stores
         omit aborted rows and may order interleaved commits differently,
         so positions drift while row ids never do.
-        """
-        sub = []
-        arrays: dict = {}
+
+        ``gid``: set when this transaction's writes were ALSO shipped to
+        datanode processes inside their 2PC prepare — the tag lets a
+        standby that direct-applied the prepared data skip this frame
+        (exactly-once across the two delivery paths). ``frame``: the
+        (sub, arrays) encoding when the caller already built it for the
+        shipped payload — avoids encoding the write set twice."""
+        sub, arrays = (
+            frame if frame is not None
+            else encode_commit_group(writes, stores)
+        )
         for table in {w[1] for w in writes}:
             self.sync_dicts(table)
-        for node, table, ins_ranges, del_idx in writes:
-            store = stores[node][table]
-            for s, e in ins_ranges:
-                i = len(sub)
-                for name in store.schema:
-                    arrays[f"w{i}_{name}"] = store._cols[name][s:e]
-                    vm = store._validity.get(name)
-                    if vm is not None:
-                        arrays[f"w{i}__v_{name}"] = vm[s:e]
-                sub.append(
-                    {"node": node, "table": table, "kind": "ins",
-                     "nrows": e - s,
-                     "row_id_start": int(store.row_id[s]) if e > s else 0}
-                )
-            if len(del_idx):
-                i = len(sub)
-                idx = np.asarray(del_idx, dtype=np.int64)
-                arrays[f"w{i}_del"] = store.row_id[idx]
-                sub.append({"node": node, "table": table, "kind": "del"})
         if sub:
-            self.wal.append(
-                b"G", {"commit_ts": commit_ts, "writes": sub}, arrays or None
-            )
+            header = {"commit_ts": commit_ts, "writes": sub}
+            if gid is not None:
+                header["gid"] = gid
+            self.wal.append(b"G", header, arrays or None)
 
     def log_barrier(self, name: str, ts: int) -> None:
         self.wal.append(b"B", {"name": name, "ts": ts})

@@ -107,6 +107,12 @@ class StandbyCluster:
         # standby redo must not re-log replayed side effects (sequence
         # events); cleared on promote
         p._in_recovery = True
+        # shipped-DML bookkeeping (see the full comments further down)
+        # must exist BEFORE the local replay below: the local WAL copy
+        # can already contain gid-tagged 'G' frames from before a
+        # restart, and _apply_one consults both attributes
+        self.direct_applied: set = set()
+        self.stream_txn_hook = None
         # replay whatever WAL already exists locally (crash-restart of the
         # standby itself), but keep in-doubt txns pending until promote
         self.applied = 0
@@ -117,6 +123,16 @@ class StandbyCluster:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.promoted = False
+        # direct_applied (set above): gids whose writes THIS process
+        # already applied directly from a shipped-DML 2PC journal
+        # (dn/server.py) — the stream's matching 'G' frame must be
+        # skipped, exactly once across the two delivery paths. Volatile
+        # ON PURPOSE: direct applies never enter the local WAL copy (it
+        # must stay a verbatim coordinator prefix for offset-based
+        # streaming), so after a restart the stream's frame is the one
+        # that repopulates the data. stream_txn_hook(gid) fires when a
+        # 'G' frame resolves a gid via the stream — the DN server uses
+        # it to retire its 2PC journal entry.
 
     # -- walreceiver ------------------------------------------------------
     def start_replication(self, host: str, port: int) -> "StandbyCluster":
@@ -164,8 +180,17 @@ class StandbyCluster:
         p = c.persistence
         if tag == "B":
             c.barriers.append((header["name"], header["ts"]))
-        else:
-            p._apply(tag, header, arrays)
+            return
+        if tag == "G":
+            gid = header.get("gid")
+            if gid:
+                if self.stream_txn_hook is not None:
+                    self.stream_txn_hook(gid)
+                if gid in self.direct_applied:
+                    # the shipped-DML journal already applied this txn
+                    self.direct_applied.discard(gid)
+                    return
+        p._apply(tag, header, arrays)
 
     # -- client surface ---------------------------------------------------
     def session(self):

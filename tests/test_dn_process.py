@@ -38,6 +38,11 @@ def topology(tmp_path):
     sender = WalSender(c.persistence)
     procs = []
     env = dict(os.environ)
+    # hermeticity extends to CHILD processes: with the axon var present
+    # the DN would register the remote-TPU backend and its first jnp
+    # dispatch can hang forever on a wedged tunnel (conftest.py pops
+    # the factory in-process, which subprocesses don't inherit)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     try:
