@@ -249,6 +249,18 @@ class Cluster:
         # node has channels, its read fragments ship to the DN server
         # process (dn/server.py) instead of executing in-process.
         self.dn_channels: dict[int, object] = {}
+        # commit timestamps whose xmin/xmax stamps are mid-flight: new
+        # snapshots clamp BELOW them so a reader overlapping a
+        # committing writer (readers and table-granular writers share
+        # the statement lock since round 4) can never observe a
+        # half-stamped transaction. The mutex spans the GTS commit call
+        # so snapshot acquisition linearizes against registration.
+        import threading as _threading
+
+        self._stamping: set = set()
+        self._pending_commits = 0
+        self._stamping_mu = _threading.Lock()
+        self._stamping_cond = _threading.Condition(self._stamping_mu)
         # conf-file overrides applied to every session's GUC defaults
         # (config.py reads <data_dir>/opentenbase.conf)
         from opentenbase_tpu import config as _config
@@ -659,6 +671,73 @@ class Cluster:
 
         return stopper
 
+    # -- commit-stamp snapshot fencing ----------------------------------
+    # Readers overlap table-granular writers since round 4; a commit's
+    # xmin/xmax stamps land element-by-element, so a snapshot acquired
+    # MID-stamp must not straddle it. A new snapshot WAITS (stamping is
+    # a few memory writes + one WAL fsync — milliseconds) for older
+    # in-flight stamp phases to finish instead of clamping below them:
+    # clamping would break read-your-writes — a session whose OWN
+    # commit fully stamped at ts 100 must not get snapshot 98 because
+    # an unrelated commit at 99 is still fsyncing. The mutex spans the
+    # GTS commit-ts assignment, so registration linearizes with ts
+    # issue (the reference's fence: ProcArrayEndTransaction's atomic
+    # xid removal, procarray.c). A pathological stall falls back to
+    # the clamp — consistent, merely stale.
+
+    def commit_ts_begin_stamping(self, gxid) -> int:
+        """The GTS round trip runs OUTSIDE the mutex (holding it would
+        queue every snapshot acquisition behind each commit's RPC); the
+        pending counter covers the window where a commit ts exists at
+        the GTS but isn't registered here yet."""
+        with self._stamping_mu:
+            self._pending_commits += 1
+        cts = None
+        try:
+            cts = self.gts.commit(gxid)
+        finally:
+            with self._stamping_mu:
+                self._pending_commits -= 1
+                if cts is not None:
+                    self._stamping.add(cts)
+                self._stamping_cond.notify_all()
+        return cts
+
+    def stamping_done(self, cts: int) -> None:
+        with self._stamping_mu:
+            self._stamping.discard(cts)
+            self._stamping_cond.notify_all()
+
+    def _fence_ts(self, ts: int) -> int:
+        """Caller holds _stamping_mu (via _stamping_cond)."""
+        import time as _time
+
+        deadline = _time.monotonic() + 10.0
+        while self._pending_commits > 0 or (
+            self._stamping and min(self._stamping) <= ts
+        ):
+            if not self._stamping_cond.wait(
+                timeout=deadline - _time.monotonic()
+            ):
+                break
+            if _time.monotonic() >= deadline:
+                break
+        if self._stamping:
+            ts = min(ts, min(self._stamping) - 1)
+        return ts
+
+    def clamp_ts(self, ts: int) -> int:
+        with self._stamping_mu:
+            return self._fence_ts(ts)
+
+    def clamped_snapshot(self) -> int:
+        # the GTS snapshot RPC stays outside the mutex; monotonicity
+        # makes the post-hoc fence sound (any commit ts assigned after
+        # our snapshot is strictly greater)
+        ts = self.gts.snapshot_ts()
+        with self._stamping_mu:
+            return self._fence_ts(ts)
+
     def close(self) -> None:
         """Release external resources: the native GTS subprocess (if any)
         and the WAL file handle. Idempotent."""
@@ -773,12 +852,13 @@ class Session:
         if self.txn is not None:
             return self.txn, False
         info = self.cluster.gts.begin()
-        return Transaction(info.gxid, info.start_ts), True
+        start_ts = self.cluster.clamp_ts(info.start_ts)
+        return Transaction(info.gxid, start_ts), True
 
     def _snapshot(self) -> int:
         if self.txn is not None:
             return self.txn.snapshot_ts
-        return self.cluster.gts.snapshot_ts()
+        return self.cluster.clamped_snapshot()
 
     # -- row/table locking (lmgr.py) -------------------------------------
     @staticmethod
@@ -967,24 +1047,27 @@ class Session:
                 self._abort_txn(txn)
                 raise
             gts.prepare(txn.gxid, implicit_gid, tuple(nodes))
-        commit_ts = gts.commit(txn.gxid)
+        commit_ts = self.cluster.commit_ts_begin_stamping(txn.gxid)
         try:
-            self._stamp_commit(
-                txn, commit_ts,
-                gid=implicit_gid if shipped else None,
-                frame=frame if shipped else None,
-            )
-        except Exception:
-            # half-applied stamp (WAL I/O failure, ...): roll back our own
-            # commit_ts stamps so the in-memory state matches the WAL,
-            # which never got the atomic 'G' record
-            self._abort_txn(txn, failed_commit_ts=commit_ts)
-            if implicit_gid is not None:
-                try:
-                    self._dn_2pc("2pc_abort", implicit_gid, nodes)
-                except Exception:
-                    pass  # clean2pc sweeps the orphaned vote
-            raise
+            try:
+                self._stamp_commit(
+                    txn, commit_ts,
+                    gid=implicit_gid if shipped else None,
+                    frame=frame if shipped else None,
+                )
+            except Exception:
+                # half-applied stamp (WAL I/O failure, ...): roll back
+                # our own commit_ts stamps so the in-memory state
+                # matches the WAL, which never got the atomic 'G' record
+                self._abort_txn(txn, failed_commit_ts=commit_ts)
+                if implicit_gid is not None:
+                    try:
+                        self._dn_2pc("2pc_abort", implicit_gid, nodes)
+                    except Exception:
+                        pass  # clean2pc sweeps the orphaned vote
+                raise
+        finally:
+            self.cluster.stamping_done(commit_ts)
         gts.forget(txn.gxid)
         if implicit_gid is not None:
             # phase 2: retire the DN votes. A lost message here is safe —
@@ -2730,8 +2813,11 @@ class Session:
             raise SQLError(f'prepared transaction "{stmt.gid}" does not exist')
         # no conflict check here: PREPARE reserved the delete targets, so
         # the commit vote cannot be invalidated after the fact
-        commit_ts = self.cluster.gts.commit(txn.gxid)
-        self._stamp_commit(txn, commit_ts, wal_log=False)
+        commit_ts = self.cluster.commit_ts_begin_stamping(txn.gxid)
+        try:
+            self._stamp_commit(txn, commit_ts, wal_log=False)
+        finally:
+            self.cluster.stamping_done(commit_ts)
         if self.cluster.persistence is not None:
             self.cluster.persistence.log_commit_prepared(stmt.gid, commit_ts)
         self.cluster.gts.forget(txn.gxid)

@@ -148,7 +148,10 @@ class DeviceCache:
                 return updated
         self.stats["full_uploads"] += 1
         S = _pad_shards(len(stores), self.mesh.shape["dn"])
-        rmax = filt_ops.bucket_size(max(max((s.nrows for s in stores), default=0), 1))
+        # ONE nrows capture per store (concurrent appends advance nrows
+        # after writing rows; every plane must slice the same prefix)
+        totals = [s.nrows for s in stores]
+        rmax = filt_ops.bucket_size(max(max(totals, default=0), 1))
         sharding = NamedSharding(self.mesh, P("dn"))
         # COMPACT visibility: after a bulk load every row of a shard
         # carries the same (xmin, xmax), so the two MVCC planes upload
@@ -158,8 +161,7 @@ class DeviceCache:
         # reference pays this with per-tuple xmin/xmax in the heap
         # header, src/include/access/htup_details.h.)
         uniform = True
-        for s in stores:
-            nr = s.nrows
+        for s, nr in zip(stores, totals):
             if nr == 0:
                 continue
             xm = s.xmin_ts[:nr]
@@ -174,18 +176,19 @@ class DeviceCache:
             xmax = np.zeros((S, 1), dtype=np.int64)
             nrows = np.zeros(S, dtype=np.int64)
             for i, s in enumerate(stores):
-                if s.nrows:
+                if totals[i]:
                     xmin[i, 0] = s.xmin_ts[0]
                     xmax[i, 0] = s.xmax_ts[0]
-                nrows[i] = s.nrows
+                nrows[i] = totals[i]
         else:
             xmin = np.full((S, rmax), 2**62, dtype=np.int64)
             xmax = np.zeros((S, rmax), dtype=np.int64)
             nrows = np.zeros(S, dtype=np.int64)
             for i, s in enumerate(stores):
-                xmin[i, : s.nrows] = s.xmin_ts[: s.nrows]
-                xmax[i, : s.nrows] = s.xmax_ts[: s.nrows]
-                nrows[i] = s.nrows
+                nr = totals[i]
+                xmin[i, :nr] = s.xmin_ts[:nr]
+                xmax[i, :nr] = s.xmax_ts[:nr]
+                nrows[i] = nr
         dt = DeviceTable(
             {},
             {},
@@ -333,8 +336,11 @@ class DeviceCache:
         xmin = np.full((S, W), 2**62, dtype=np.int64)
         xmax = np.zeros((S, W), dtype=np.int64)
         nrows = np.zeros(S, dtype=np.int64)
+        # ONE nrows capture per store: appends may run concurrently and
+        # every column must slice the same consistent prefix
+        totals = [s.nrows for s in stores]
         for i, s in enumerate(stores):
-            n = max(min(s.nrows - start, length), 0)
+            n = max(min(totals[i] - start, length), 0)
             if n:
                 xmin[i, :n] = s.xmin_ts[start:start + n]
                 xmax[i, :n] = s.xmax_ts[start:start + n]
@@ -346,10 +352,10 @@ class DeviceCache:
             stack = np.zeros((S, W), dtype=ty.np_dtype)
             vstack = None
             for i, s in enumerate(stores):
-                n = max(min(s.nrows - start, length), 0)
+                n = int(nrows[i])
                 if not n:
                     continue
-                stack[i, :n] = s.column_array(cname)[start:start + n]
+                stack[i, :n] = s.column_array(cname, start + n)[start:]
                 vm = s._validity.get(cname)
                 if vm is not None:
                     if vstack is None:
@@ -395,19 +401,22 @@ class DeviceCache:
             stack = np.zeros((S, dt.rmax), dtype=ty.np_dtype)
             vstack = None
             for i, s in enumerate(stores):
-                stack[i, : s.nrows] = s.column_array(cname)
+                n0 = min(s.nrows, dt.rmax)  # ONE capture per store
+                stack[i, :n0] = s.column_array(cname, n0)
                 vm = s._validity.get(cname)
                 if vm is not None:
                     if vstack is None:
                         vstack = np.ones((S, dt.rmax), dtype=np.bool_)
-                    vstack[i, : s.nrows] = vm[: s.nrows]
+                    vstack[i, :n0] = vm[:n0]
             if np.issubdtype(stack.dtype, np.integer):
                 # stats over REAL rows only: the zero padding would
                 # inflate the range (e.g. year keys 1992..1998 -> domain
                 # 1999) and disqualify small-domain group keys
                 lo = hi = ma = None
                 for s in stores:
-                    real = s._cols[cname][: s.nrows]
+                    real = s.column_array(
+                        cname, min(s.nrows, dt.rmax)
+                    )
                     if real.size == 0:
                         continue
                     rlo, rhi = int(real.min()), int(real.max())
@@ -444,10 +453,14 @@ class DeviceCache:
             S = dt.xmin.shape[0]
             dt.xmin = jnp.broadcast_to(dt.xmin, (S, dt.rmax))
             dt.xmax = jnp.broadcast_to(dt.xmax, (S, dt.rmax))
-        for s, sy in zip(stores, dt.sync):
+        # ONE nrows capture per store: a concurrent append between the
+        # validation below and the tail upload could cross dt.rmax and
+        # write past the device buffer
+        totals = [s.nrows for s in stores]
+        for s, sy, nr in zip(stores, dt.sync, totals):
             if s.structure_version != sy["structure"]:
                 return None
-            if s.nrows > dt.rmax or s.nrows < sy["nrows"]:
+            if nr > dt.rmax or nr < sy["nrows"]:
                 return None
             for cname in present:
                 has_dev = dt.validity[cname] is not None
@@ -456,7 +469,7 @@ class DeviceCache:
         delta_rows = 0
         replays = 0
         for i, (s, sy) in enumerate(zip(stores, dt.sync)):
-            old_n, new_n = sy["nrows"], s.nrows
+            old_n, new_n = sy["nrows"], totals[i]
             if new_n > old_n:
                 delta_rows += new_n - old_n
                 for cname in present:
@@ -587,10 +600,48 @@ class FusedUnsupported(Exception):
 # ---------------------------------------------------------------------------
 
 
+_CACHE_WIRED = False
+
+
+def enable_compile_cache() -> Optional[str]:
+    """Wire jax's persistent compilation cache (idempotent). The fused
+    join programs compile in ~15-105s on the real chip (TPUTESTS_r03:
+    gsort 104.6s) — without a disk cache EVERY fresh process pays that
+    before its first distributed join answers. With it, a second cold
+    process deserializes the executable instead of recompiling
+    (xla_compile_cache; PG has no analog — it interprets — but this is
+    our plan-cache-across-backends story). Off via
+    OTB_COMPILE_CACHE_DIR=''. Returns the directory or None."""
+    global _CACHE_WIRED
+    d = os.environ.get(
+        "OTB_COMPILE_CACHE_DIR",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "opentenbase_tpu", "xla"
+        ),
+    )
+    if not d:
+        return None
+    if _CACHE_WIRED:
+        return d
+    try:
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # join programs are the multi-second compiles worth persisting;
+        # trivial sub-second kernels would just churn the directory
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 2.0
+        )
+        _CACHE_WIRED = True
+        return d
+    except Exception:
+        return None
+
+
 class FusedExecutor:
     """Compiles eligible partial-agg fragments to one shard_map program."""
 
     def __init__(self, catalog, node_stores, mesh: Optional[Mesh] = None):
+        enable_compile_cache()
         self.catalog = catalog
         self.node_stores = node_stores
         self.mesh = mesh if mesh is not None else build_mesh()
@@ -710,13 +761,20 @@ class FusedExecutor:
                 skey, dtab.rmax, len(dtab.nrows), cap, has_valid,
                 grouping, win,
             )
+            # the structural key masks literal values; the compile-time
+            # param specs BAKE them. Rebuild the (lazily-jitted, cheap)
+            # compile output for THIS query and pair the cached
+            # executable with the fresh specs — otherwise 'x = 1'
+            # silently reuses 'x = 7''s parameter
+            fresh = self._compile(
+                m, meta, dtab, cap, has_valid, grouping, win=win
+            )
             cached = self._programs.get(key)
             if cached is None:
-                cached = self._compile(
-                    m, meta, dtab, cap, has_valid, grouping, win=win
-                )
-                self._programs[key] = cached
-            program, param_specs, out_info = cached
+                self._programs[key] = fresh
+                cached = fresh
+            program = cached[0]
+            _prog_unused, param_specs, out_info = fresh
             params = tuple(
                 resolve_param(s, dicts_view, subquery_values)
                 for s in param_specs

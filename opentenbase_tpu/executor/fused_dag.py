@@ -1332,6 +1332,46 @@ class DagRunner:
         return tuple(out)
 
     # -- shared plumbing ---------------------------------------------------
+    def _cached_program(self, key, compile_fn):
+        """Program cache with LITERAL-SAFE param binding. Cache keys are
+        structural (plan_skey masks constant values so literal changes
+        reuse the compiled executable) — but the compile-time
+        ExprCompiler BAKES the first query's literal values into its
+        param specs. So compile_fn runs on EVERY call (cheap: closure
+        building only — jax.jit is lazy, no tracing happens) to bind
+        the CURRENT plan's literals, while the jitted program object
+        comes from the cache. Without this, 'who = 1' silently reuses
+        the program compiled for 'who = 7' WITH 7's parameter."""
+        fresh = compile_fn()
+        cached = self._programs.get(key)
+        if cached is None:
+            self._programs[key] = fresh
+            return fresh
+        np_ = self._NPROGS.get(key[0], 1)
+        if self._entry_sig(fresh, np_) != self._entry_sig(cached, np_):
+            # compile inputs OUTSIDE the key drifted (e.g. row-estimate
+            # fold eligibility flipped as data grew): the cached
+            # executable no longer matches the fresh specs — replace
+            self._programs[key] = fresh
+            return fresh
+        return tuple(cached[:np_]) + tuple(fresh[np_:])
+
+    _NPROGS = {"wgagg": 2}  # cache entries holding >1 jitted program
+
+    @staticmethod
+    def _entry_sig(entry, np_):
+        """Structure of a cache entry's non-program parts: param-spec
+        TYPES (values are the whole point of rebinding), modes, folded
+        sets — anything that must agree between the cached executable
+        and freshly-bound params."""
+        out = []
+        for x in entry[np_:]:
+            if isinstance(x, ExprCompiler):
+                out.append(tuple(type(p).__name__ for p in x.params))
+            else:
+                out.append(x)
+        return tuple(out)
+
     def _frag_skey(self, frag: Fragment) -> str:
         return _plan_skey_of(frag.root)
 
@@ -1472,13 +1512,12 @@ class DagRunner:
             # unchanged data (literals are lifted params, so the skey
             # alone would alias different constants).
             ckey = ("xcnt", skey, orientation, hashpos, D, sig, fo)
-            cached = self._programs.get(ckey)
-            if cached is None:
-                cached = self._compile_count(
+            prog, comp, folded = self._cached_program(
+                ckey,
+                lambda: self._compile_count(
                     frag.root, exchanged, orientation, hashpos, D, fo
-                )
-                self._programs[ckey] = cached
-            prog, comp, folded = cached
+                ),
+            )
             params = self._resolve(comp, dicts_view, subquery_values)
             capkey = (
                 "cap", skey, orientation, hashpos, D, sig, versions, fo,
@@ -1502,14 +1541,13 @@ class DagRunner:
 
             # pass 2: the bucketed all_to_all
             xkey = ("xchg", skey, orientation, hashpos, D, cap, sig, fo)
-            cached = self._programs.get(xkey)
-            if cached is None:
-                cached = self._compile_exchange(
+            prog, comp, folded = self._cached_program(
+                xkey,
+                lambda: self._compile_exchange(
                     frag.root, exchanged, orientation, hashpos, D, cap,
                     fo,
-                )
-                self._programs[xkey] = cached
-            prog, comp, folded = cached
+                ),
+            )
             params = self._resolve(comp, dicts_view, subquery_values)
             cols, valids, rcounts, flags = prog(tuple(arrays), params, snap)
             flags = [np.asarray(f) for f in flags]
@@ -1542,13 +1580,12 @@ class DagRunner:
         while True:
             fo = frozenset(self._fold_off.get(skey, ()))
             ckey = ("bcnt", skey, orientation, D, sig, fo)
-            cached = self._programs.get(ckey)
-            if cached is None:
-                cached = self._compile_broadcast_count(
+            prog, comp, folded = self._cached_program(
+                ckey,
+                lambda: self._compile_broadcast_count(
                     frag.root, exchanged, orientation, D, fo
-                )
-                self._programs[ckey] = cached
-            prog, comp, folded = cached
+                ),
+            )
             params = self._resolve(comp, dicts_view, subquery_values)
             capkey = (
                 "bcap", skey, orientation, D, sig, versions, fo,
@@ -1571,13 +1608,12 @@ class DagRunner:
             self._check_hbm_budget(cap, frag.root.schema, D)
 
             bkey = ("bcast", skey, orientation, D, cap, sig, fo)
-            cached = self._programs.get(bkey)
-            if cached is None:
-                cached = self._compile_broadcast(
+            prog, comp, folded = self._cached_program(
+                bkey,
+                lambda: self._compile_broadcast(
                     frag.root, exchanged, orientation, D, cap, fo
-                )
-                self._programs[bkey] = cached
-            prog, comp, folded = cached
+                ),
+            )
             params = self._resolve(comp, dicts_view, subquery_values)
             cols, valids, rcounts, flags = prog(tuple(arrays), params, snap)
             flags = [np.asarray(f) for f in flags]
@@ -1954,39 +1990,39 @@ class DagRunner:
                 tk if use_topk else None, bg is not None, psum,
                 gs is not None, ga is not None, narrow, fo, robust,
             )
-            cached = self._programs.get(fkey)
-            if cached is None:
+            def compile_final():
                 if gs is not None:
                     comp = ExprCompiler(lift_consts=True)
                     b = _Builder(
                         self.fx, comp, orientation, root, runner=self,
                         D=D, fold_off=fo,
                     )
-                    cached = self._compile_gsort(
+                    return self._compile_gsort(
                         b, comp, agg, gs, root, exchanged, tk, D,
                         _count_inner_joins(root), narrow=narrow,
                     ) + (frozenset(b.folded),)
-                elif ga is not None:
+                if ga is not None:
                     comp = ExprCompiler(lift_consts=True)
                     b = _Builder(
                         self.fx, comp, orientation, root, runner=self,
                         D=D, fold_off=fo,
                     )
                     ev = b.build(root, exchanged, D)
-                    cached = self._compile_gagg(
+                    return self._compile_gagg(
                         b, ev, comp, agg, root, tk, D,
                         _count_inner_joins(root), narrow=narrow,
                         robust=robust,
                     ) + (frozenset(b.folded),)
-                else:
-                    cached = self._compile_final(
-                        frag, agg, root, exchanged, orientation, gcap, D,
-                        packing,
-                        topk=tk if use_topk else None, bg=bg, psum=psum,
-                        fo=fo,
-                    )
-                self._programs[fkey] = cached
-            prog, comp, mode, folded = cached
+                return self._compile_final(
+                    frag, agg, root, exchanged, orientation, gcap, D,
+                    packing,
+                    topk=tk if use_topk else None, bg=bg, psum=psum,
+                    fo=fo,
+                )
+
+            prog, comp, mode, folded = self._cached_program(
+                fkey, compile_final
+            )
             params = self._resolve(comp, dicts_view, subquery_values)
             if gcapkey is None:
                 gcapkey = (
@@ -2679,14 +2715,14 @@ class DagRunner:
                 "wgagg", skey, orientation, D, sig, fo, cap, width,
                 robust, h is not None,
             )
-            cached = self._programs.get(ckey)
-            if cached is None:
-                cached = self._compile_wgagg(
-                    agg, root_c, exch_c, tk, D, ori_c, fo_c,
-                    leaf, width, cap, robust=robust,
-                )
-                self._programs[ckey] = cached
-            wprog, mprog, comp, folded = cached
+            wprog, mprog, comp, folded = self._cached_program(
+                ckey,
+                lambda rc=root_c, ec=exch_c, oc=ori_c, fc=fo_c, rb=robust:
+                self._compile_wgagg(
+                    agg, rc, ec, tk, D, oc, fc, leaf, width, cap,
+                    robust=rb,
+                ),
+            )
             params = self._resolve(comp, dicts_view, subquery_values)
             arrays = _collect_arrays(self.fx, root_c, exch_c, D)
             lidx = self.leaf_index_of(root_c, leaf)
@@ -2796,13 +2832,12 @@ class DagRunner:
             "prep", skey, tuple(orientation), D, fo_local, sig,
             versions,
         )
-        cached = self._programs.get(pkey)
-        if cached is None:
-            cached = self._compile_fold_prep(
+        prog, comp, folded_local = self._cached_program(
+            pkey,
+            lambda: self._compile_fold_prep(
                 bnode, exchanged, ori_local, fo_local, D, bkey
-            )
-            self._programs[pkey] = cached
-        prog, comp, folded_local = cached
+            ),
+        )
         params = self._resolve(comp, dicts_view, subquery_values)
         arrays = _collect_arrays(self.fx, bnode, exchanged, D)
         cols, valids, counts, flags = prog(tuple(arrays), params, snap)

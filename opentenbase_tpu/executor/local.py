@@ -210,11 +210,17 @@ class LocalExecutor:
             store = self.stores.get(plan.table)
         if store is None:
             raise ExecError(f"no shard for table {plan.table} on this node")
-        nrows = store.nrows if row_idx is None else len(row_idx)
+        # capture the row count ONCE: a concurrent append (readers and
+        # table-granular writers now overlap) advances store.nrows
+        # AFTER the new rows are fully written, so any single captured
+        # n is a consistent fully-written prefix — but re-reading
+        # nrows per column would tear the scan across columns
+        n0 = store.nrows
+        nrows = n0 if row_idx is None else len(row_idx)
         padded = filt_ops.bucket_size(max(nrows, 1))
 
         def subset(arr):
-            a = arr[: store.nrows]
+            a = arr[:n0]
             return a if row_idx is None else a[row_idx]
 
         cols = []

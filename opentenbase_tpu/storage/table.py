@@ -258,23 +258,33 @@ class ShardStore:
         return zm
 
     # -- reads ----------------------------------------------------------
-    def column_array(self, name: str) -> np.ndarray:
-        return self._cols[name][: self.nrows]
+    # Read paths capture ``nrows`` BEFORE touching column arrays:
+    # appends write data first and advance nrows last, and array
+    # growth replaces (never shrinks) the objects, so any array
+    # fetched after the capture holds at least that many fully-written
+    # rows — the epoch/COW publication that lets read statements
+    # overlap table-granular writers (the columnar answer to MVCC
+    # readers-never-block, tqual.c).
+    def column_array(self, name: str, nrows=None) -> np.ndarray:
+        n = self.nrows if nrows is None else nrows
+        return self._cols[name][:n]
 
     def column(self, name: str) -> Column:
+        n = self.nrows
         vm = self._validity[name]
         return Column(
             self.schema[name],
-            self._cols[name][: self.nrows],
-            None if vm is None else vm[: self.nrows],
+            self._cols[name][:n],
+            None if vm is None else vm[:n],
             self.dictionaries.get(name),
         )
 
     def snapshot_arrays(self) -> dict[str, np.ndarray]:
         """All columns + MVCC columns as contiguous arrays (for device upload)."""
-        out = {name: self._cols[name][: self.nrows] for name in self.schema}
-        out["__xmin_ts"] = self.xmin_ts[: self.nrows]
-        out["__xmax_ts"] = self.xmax_ts[: self.nrows]
+        n = self.nrows
+        out = {name: self._cols[name][:n] for name in self.schema}
+        out["__xmin_ts"] = self.xmin_ts[:n]
+        out["__xmax_ts"] = self.xmax_ts[:n]
         return out
 
     def to_batch(self) -> ColumnBatch:

@@ -24,14 +24,19 @@ class RWStatementLock:
         self._cond = threading.Condition()
         self._readers = 0  # total shared holders (all groups)
         # shared holders by class: 'r' (read-only statements) and 'w'
-        # (table-granular writers). Classes never mix: a reader's scan
-        # holds raw references into store arrays that a concurrent
-        # append may REALLOCATE, so writers share only with writers
-        # (each fenced by per-table mutexes), readers only with readers
-        # (MVCC snapshots isolate them).
+        # (table-granular writers). Since round 4 the classes MIX:
+        # stores publish by epoch (appends write rows first and advance
+        # nrows last; growth REPLACES arrays, never invalidating held
+        # references; read paths capture nrows once — storage/table.py)
+        # and commit stamps clamp new snapshots (engine.py
+        # clamp_snapshot), so a long reader no longer stalls writers —
+        # MVCC readers-never-block, the columnar way (tqual.c:2274).
+        # Exclusive statements (DDL, vacuum, uncertain) still fence out
+        # everything.
         self._groups = {"r": 0, "w": 0}
         self.max_concurrent_readers = 0  # observability / tests
         self.max_concurrent_table_writers = 0
+        self.mixed_overlaps = 0  # reader+writer held simultaneously
         self._table_writers = 0
         self._table_locks: dict = {}
         # which shared group (if any) the CURRENT thread holds — lets
@@ -66,10 +71,10 @@ class RWStatementLock:
         self._w.acquire()  # fence: exclusive holders/waiters first
         try:
             with self._cond:
-                while self._groups[other] > 0:
-                    self._cond.wait()
                 self._groups[group] += 1
                 self._readers += 1
+                if self._groups[other] > 0:
+                    self.mixed_overlaps += 1
                 if group == "r":
                     self.max_concurrent_readers = max(
                         self.max_concurrent_readers, self._readers

@@ -405,3 +405,24 @@ def test_windowed_gagg_hoisted_build_prep(monkeypatch):
     )
     got = ex.run_plan(dp.root).to_rows()
     assert got == want, (got, want)
+
+
+def test_dag_literal_change_binds_current_values(sess):
+    """The DAG runner's structural program cache must bind the CURRENT
+    query's literals (round-4 regression: the first query's lifted
+    constants were baked into the cached param specs)."""
+    q7 = (
+        "select d_cat, count(*) from fact, dim "
+        "where f_key = d_key and d_cat = 2 group by d_cat"
+    )
+    q1 = (
+        "select d_cat, count(*) from fact, dim "
+        "where f_key = d_key and d_cat = 3 group by d_cat"
+    )
+    h7, g7 = _both(sess, q7)
+    assert g7 == h7
+    h1, g1 = _both(sess, q1)
+    assert g1 == h1
+    assert g1 != g7  # different literal, different answer
+    h7b, g7b = _both(sess, q7)
+    assert g7b == h7

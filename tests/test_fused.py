@@ -100,3 +100,38 @@ def test_entry_compiles():
     out = jax.jit(fn)(*args)
     rev, cnt = [np.asarray(o) for o in out]
     assert cnt > 0 and rev > 0
+
+
+def test_literal_change_reuses_program_not_parameters(jax8):
+    """Structural program caching lifts literals to params — but the
+    params must bind THIS query's literals, not the compile-time ones.
+    Round-4 regression: 'who = 1' silently returned the count for
+    'who = 7' on every fused path."""
+    from opentenbase_tpu.engine import Cluster
+
+    c = Cluster(num_datanodes=2, shard_groups=32)
+    s = c.session()
+    s.execute(
+        "create table lit (k bigint, who bigint) distribute by shard(k)"
+    )
+    s.execute("insert into lit values " + ",".join(
+        f"({j},7)" for j in range(40)
+    ))
+    s.execute("insert into lit values " + ",".join(
+        f"({100 + j},1)" for j in range(12)
+    ))
+    assert s.query("select count(*) from lit where who = 7") == [(40,)]
+    assert s.query("select count(*) from lit where who = 1") == [(12,)]
+    assert s.query("select count(*) from lit where who = 7") == [(40,)]
+    assert s.query(
+        "select sum(k) from lit where who = 1"
+    ) == [(sum(range(100, 112)),)]
+    # grouped shape too
+    assert s.query(
+        "select who, count(*) from lit where k < 100 group by who "
+        "order by who"
+    ) == [(7, 40)]
+    assert s.query(
+        "select who, count(*) from lit where k < 1000 group by who "
+        "order by who"
+    ) == [(1, 12), (7, 40)]

@@ -21,7 +21,64 @@ sys.path.insert(
 import numpy as np
 
 
+def cold_join() -> int:
+    """Fresh-process probe: same data, same join shape as the lane's
+    join_dimfold_gagg — times the FIRST answer (cache-hit compile)."""
+    import jax  # noqa: F401
+
+    from opentenbase_tpu.engine import Cluster
+    from opentenbase_tpu.storage.column import Column
+    from opentenbase_tpu.storage.table import ColumnBatch
+
+    N = 400_000
+    rng = np.random.default_rng(11)
+    c = Cluster(num_datanodes=2, shard_groups=32)
+    s = c.session()
+    s.execute(
+        "create table li (ok bigint, price numeric(12,2), "
+        "disc numeric(4,2), ship date) distribute by roundrobin"
+    )
+    meta = c.catalog.get("li")
+    arrays = {
+        "ok": rng.integers(1, N // 4, N).astype(np.int64),
+        "price": rng.integers(900_00, 90000_00, N).astype(np.int64),
+        "disc": rng.integers(0, 10, N).astype(np.int64),
+        "ship": (8036 + rng.integers(0, 2556, N)).astype(np.int32),
+    }
+    commit_ts = c.gts.get_gts()
+    for i, node in enumerate(meta.node_indices):
+        sl = slice(i * N // 2, (i + 1) * N // 2)
+        cols = {
+            nm: Column(meta.schema[nm], arrays[nm][sl])
+            for nm in meta.schema
+        }
+        c.stores[node]["li"].append_batch(
+            ColumnBatch(cols, sl.stop - sl.start), commit_ts
+        )
+    s.execute(
+        "create table od (k bigint, pr int) distribute by roundrobin"
+    )
+    s.execute("insert into od values " + ",".join(
+        f"({k},{k % 3})" for k in range(1, 2000)
+    ))
+    s.execute("analyze")
+    s.execute("create index li_ship on li (ship)")
+    t0 = time.time()
+    got = s.query(
+        "select li.ok, sum(price * (1 - disc)), od.pr from od, li "
+        "where od.k = li.ok and od.pr < 2 "
+        "group by li.ok, od.pr order by 2 desc, li.ok limit 10"
+    )
+    dt = time.time() - t0
+    print(json.dumps({
+        "ok": bool(got), "first_join_s": round(dt, 1),
+    }))
+    return 0
+
+
 def main() -> int:
+    if "--cold-join" in sys.argv:
+        return cold_join()
     out_path = sys.argv[1] if len(sys.argv) > 1 else "TPUTESTS.json"
     record: dict = {"kernels": [], "ok": False}
     t_all = time.time()
@@ -126,12 +183,26 @@ def main() -> int:
         "where ship >= date '1999-01-01'",
         pallas=False,
     )
+    import opentenbase_tpu.executor.fused_dag as fd
+
+    saved_fold = fd.DIMFOLD_MAX_BUILD
+    fd.DIMFOLD_MAX_BUILD = 0  # pin folds off: cover the co-sort path
+    try:
+        run(
+            "join_sortmerge_gsort",
+            "select li.ok, sum(price * (1 - disc)), od.pr from od, li "
+            "where od.k = li.ok and od.pr < 2 "
+            "group by li.ok, od.pr order by 2 desc limit 10",
+            want_mode="gsort",
+        )
+    finally:
+        fd.DIMFOLD_MAX_BUILD = saved_fold
     run(
-        "join_sortmerge_gsort",
+        "join_dimfold_gagg",
         "select li.ok, sum(price * (1 - disc)), od.pr from od, li "
         "where od.k = li.ok and od.pr < 2 "
-        "group by li.ok, od.pr order by 2 desc limit 10",
-        want_mode="gsort",
+        "group by li.ok, od.pr order by 2 desc, li.ok limit 10",
+        want_mode="gagg",
     )
     run(
         "highcard_group_topk_gagg",
@@ -139,10 +210,40 @@ def main() -> int:
         "order by 2 desc limit 10",
         want_mode="gagg",
     )
+    os.environ["OTB_DAG_WINDOW_BUDGET"] = "3000000"
+    try:
+        run(
+            "windowed_gagg",
+            "select li.ok, sum(price), od.pr from od, li "
+            "where od.k = li.ok group by li.ok, od.pr "
+            "order by 2 desc, li.ok limit 10",
+            want_mode="wgagg",
+        )
+    finally:
+        os.environ.pop("OTB_DAG_WINDOW_BUDGET", None)
     fx = c._fused
     if fx is not None:
         record["zone_stats"] = dict(fx.zone_stats)
         record["pallas_fallbacks"] = list(fx.pallas_fallbacks)
+
+    # persistent compile cache (VERDICT r3 weak-5): a SECOND cold
+    # process must answer its first join far below the 15-105s compile
+    # cost — the executable deserializes from the on-disk cache this
+    # process just populated
+    try:
+        import subprocess
+        import sys as _sys
+
+        r = subprocess.run(
+            [_sys.executable, os.path.abspath(__file__), "--cold-join"],
+            capture_output=True, text=True, timeout=900,
+        )
+        cold = json.loads(r.stdout.strip().splitlines()[-1])
+        record["cold_process_first_join_s"] = cold.get("first_join_s")
+        record["cold_process_ok"] = bool(cold.get("ok"))
+    except Exception as e:
+        record["cold_process_ok"] = False
+        record["cold_process_error"] = f"{type(e).__name__}: {e}"[:200]
     record["ok"] = all(k.get("ok") for k in record["kernels"])
     record["total_s"] = round(time.time() - t_all, 1)
     json.dump(record, open(out_path, "w"), indent=1)
