@@ -49,7 +49,10 @@ class DNServer:
         # gids resolved by the replication stream (their 'G' frame was
         # applied here): a late/repeat 2PC decision for one of these
         # must NOT re-apply its journal payload
-        self._stream_resolved: set = set()
+        # insertion-ordered gid set (dict keys): bounded eviction must
+        # drop the OLDEST gids, not arbitrary ones — set.pop() could
+        # evict the gid just added while keeping stale ones (ADVICE r4)
+        self._stream_resolved: dict = {}
         # startup sweep: 'G' frames already in the local WAL copy were
         # applied during StandbyCluster replay — retire their journals
         # before any repeat 2pc_commit could double-apply them
@@ -165,9 +168,11 @@ class DNServer:
         'G' frame for ``gid``: its journal is resolved."""
         import os
 
-        self._stream_resolved.add(gid)
+        self._stream_resolved[gid] = None
         while len(self._stream_resolved) > 4096:
-            self._stream_resolved.pop()
+            self._stream_resolved.pop(
+                next(iter(self._stream_resolved))
+            )
         try:
             os.unlink(os.path.join(self._twophase_dir(), gid))
         except OSError:

@@ -259,6 +259,14 @@ class Cluster:
 
         self._stamping: set = set()
         self._pending_commits = 0
+        # floor tokens for commits still inside the GTS RPC: each maps
+        # to the highest commit ts KNOWN ISSUED when the RPC began —
+        # GTS monotonicity puts the in-flight ts strictly above it, so
+        # a timed-out fence can clamp below the floor and never
+        # straddle a half-stamped transaction (ADVICE r4)
+        self._pending_token = 0
+        self._pending_floors: dict = {}
+        self._issued_hwm = 0
         self._stamping_mu = _threading.Lock()
         self._stamping_cond = _threading.Condition(self._stamping_mu)
         # conf-file overrides applied to every session's GUC defaults
@@ -692,14 +700,20 @@ class Cluster:
         the GTS but isn't registered here yet."""
         with self._stamping_mu:
             self._pending_commits += 1
+            self._pending_token += 1
+            token = self._pending_token
+            self._pending_floors[token] = self._issued_hwm
         cts = None
         try:
             cts = self.gts.commit(gxid)
         finally:
             with self._stamping_mu:
                 self._pending_commits -= 1
+                self._pending_floors.pop(token, None)
                 if cts is not None:
                     self._stamping.add(cts)
+                    if cts > self._issued_hwm:
+                        self._issued_hwm = cts
                 self._stamping_cond.notify_all()
         return cts
 
@@ -724,6 +738,12 @@ class Cluster:
                 break
         if self._stamping:
             ts = min(ts, min(self._stamping) - 1)
+        if self._pending_floors:
+            # a commit still inside the GTS RPC has no registered ts;
+            # its eventual ts is strictly above the floor recorded when
+            # its RPC began, so clamping to the floor keeps it (and
+            # anything it could stamp) invisible to this snapshot
+            ts = min(ts, min(self._pending_floors.values()))
         return ts
 
     def clamp_ts(self, ts: int) -> int:

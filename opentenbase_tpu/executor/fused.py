@@ -148,9 +148,14 @@ class DeviceCache:
                 return updated
         self.stats["full_uploads"] += 1
         S = _pad_shards(len(stores), self.mesh.shape["dn"])
-        # ONE nrows capture per store (concurrent appends advance nrows
-        # after writing rows; every plane must slice the same prefix)
+        # ONE capture per store of nrows AND mvcc_seq/structure, taken
+        # BEFORE any plane/column read (concurrent appends advance nrows
+        # after writing rows; every plane must slice the same prefix,
+        # and the sync record must not claim stamps newer than what was
+        # read — an early seq only causes harmless idempotent re-replay)
         totals = [s.nrows for s in stores]
+        seqs = [s.mvcc_seq for s in stores]
+        structs = [s.structure_version for s in stores]
         rmax = filt_ops.bucket_size(max(max(totals, default=0), 1))
         sharding = NamedSharding(self.mesh, P("dn"))
         # COMPACT visibility: after a bulk load every row of a shard
@@ -202,14 +207,14 @@ class DeviceCache:
             {},
             [
                 {
-                    "nrows": s.nrows,
-                    "structure": s.structure_version,
-                    "mvcc_seq": s.mvcc_seq,
+                    "nrows": totals[i],
+                    "structure": structs[i],
+                    "mvcc_seq": seqs[i],
                 }
-                for s in stores
+                for i in range(len(stores))
             ],
         )
-        self._ensure_columns(dt, stores, meta, want)
+        self._ensure_columns(dt, stores, meta, want, totals)
         self._tables[(name, nodes)] = dt
         return dt
 
@@ -336,9 +341,13 @@ class DeviceCache:
         xmin = np.full((S, W), 2**62, dtype=np.int64)
         xmax = np.zeros((S, W), dtype=np.int64)
         nrows = np.zeros(S, dtype=np.int64)
-        # ONE nrows capture per store: appends may run concurrently and
-        # every column must slice the same consistent prefix
+        # ONE capture per store of nrows AND mvcc_seq/structure, BEFORE
+        # any plane/column read: appends may run concurrently and every
+        # column must slice the same consistent prefix; the sync record
+        # must not claim stamps newer than the planes just read
         totals = [s.nrows for s in stores]
+        seqs = [s.mvcc_seq for s in stores]
+        structs = [s.structure_version for s in stores]
         for i, s in enumerate(stores):
             n = max(min(totals[i] - start, length), 0)
             if n:
@@ -379,21 +388,34 @@ class DeviceCache:
             {},
             [
                 {
-                    "nrows": s.nrows,
-                    "structure": s.structure_version,
-                    "mvcc_seq": s.mvcc_seq,
+                    "nrows": totals[i],
+                    "structure": structs[i],
+                    "mvcc_seq": seqs[i],
                 }
-                for s in stores
+                for i in range(len(stores))
             ],
         )
         self._tables[wkey] = dt
         return dt
 
-    def _ensure_columns(self, dt: DeviceTable, stores, meta, want) -> None:
-        """Upload any of ``want`` not yet device-resident (current store
-        state — callers hold the exec lock, so data matches dt.sync)."""
+    def _ensure_columns(
+        self, dt: DeviceTable, stores, meta, want, totals=None
+    ) -> None:
+        """Upload any of ``want`` not yet device-resident. Row bounds
+        come from ``totals`` (the caller's one-shot nrows capture) or,
+        absent that, from dt.sync — NEVER from a fresh s.nrows read,
+        which a concurrent append could have advanced past the MVCC
+        planes already on device."""
         S = _pad_shards(len(stores), self.mesh.shape["dn"])
         sharding = NamedSharding(self.mesh, P("dn"))
+        bounds = [
+            min(
+                totals[i] if totals is not None
+                else dt.sync[i]["nrows"],
+                dt.rmax,
+            )
+            for i in range(len(stores))
+        ]
         for cname in want:
             if cname in dt.columns:
                 continue
@@ -401,7 +423,7 @@ class DeviceCache:
             stack = np.zeros((S, dt.rmax), dtype=ty.np_dtype)
             vstack = None
             for i, s in enumerate(stores):
-                n0 = min(s.nrows, dt.rmax)  # ONE capture per store
+                n0 = bounds[i]
                 stack[i, :n0] = s.column_array(cname, n0)
                 vm = s._validity.get(cname)
                 if vm is not None:
@@ -413,10 +435,8 @@ class DeviceCache:
                 # inflate the range (e.g. year keys 1992..1998 -> domain
                 # 1999) and disqualify small-domain group keys
                 lo = hi = ma = None
-                for s in stores:
-                    real = s.column_array(
-                        cname, min(s.nrows, dt.rmax)
-                    )
+                for i, s in enumerate(stores):
+                    real = s.column_array(cname, bounds[i])
                     if real.size == 0:
                         continue
                     rlo, rhi = int(real.min()), int(real.max())
@@ -453,13 +473,20 @@ class DeviceCache:
             S = dt.xmin.shape[0]
             dt.xmin = jnp.broadcast_to(dt.xmin, (S, dt.rmax))
             dt.xmax = jnp.broadcast_to(dt.xmax, (S, dt.rmax))
-        # ONE nrows capture per store: a concurrent append between the
+        # ONE capture per store of nrows AND mvcc_seq/structure, BEFORE
+        # any plane/column read: a concurrent append between the
         # validation below and the tail upload could cross dt.rmax and
-        # write past the device buffer
+        # write past the device buffer, and a commit stamping between
+        # the plane read and the sync update would be recorded as
+        # synced without having landed on device. An early seq capture
+        # only costs an idempotent re-replay next refresh.
         totals = [s.nrows for s in stores]
-        for s, sy, nr in zip(stores, dt.sync, totals):
-            if s.structure_version != sy["structure"]:
+        seqs = [s.mvcc_seq for s in stores]
+        structs = [s.structure_version for s in stores]
+        for s, sy, st in zip(stores, dt.sync, structs):
+            if st != sy["structure"]:
                 return None
+        for s, sy, nr in zip(stores, dt.sync, totals):
             if nr > dt.rmax or nr < sy["nrows"]:
                 return None
             for cname in present:
@@ -506,10 +533,15 @@ class DeviceCache:
                 )
                 dt.nrows[i] = new_n
             # MVCC stamp replay (idempotent absolute writes, in order)
-            if s.mvcc_seq != sy["mvcc_seq"]:
+            # — bounded by the seqs[i] capture: entries stamped after
+            # it replay on the NEXT refresh, never silently skip
+            if seqs[i] != sy["mvcc_seq"]:
                 log = s._mvcc_log
-                pending = [e for e in log if e[0] > sy["mvcc_seq"]]
-                expect = s.mvcc_seq - sy["mvcc_seq"]
+                pending = [
+                    e for e in log
+                    if sy["mvcc_seq"] < e[0] <= seqs[i]
+                ]
+                expect = seqs[i] - sy["mvcc_seq"]
                 if len(pending) != expect or len(pending) > 8:
                     # log trimmed past our sync point — or enough entries
                     # that per-entry device scatters (each a full-array
@@ -534,8 +566,8 @@ class DeviceCache:
                         replays += 1
             dt.sync[i] = {
                 "nrows": new_n,
-                "structure": s.structure_version,
-                "mvcc_seq": s.mvcc_seq,
+                "structure": structs[i],
+                "mvcc_seq": seqs[i],
             }
         dt.versions = versions
         self.stats["delta_uploads"] += 1

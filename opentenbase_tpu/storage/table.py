@@ -288,7 +288,20 @@ class ShardStore:
         return out
 
     def to_batch(self) -> ColumnBatch:
-        return ColumnBatch({name: self.column(name) for name in self.schema}, self.nrows)
+        # capture-once: a concurrent append between per-column nrows
+        # reads would yield unequal column lengths and a batch.nrows
+        # beyond the shortest column (ADVICE r4)
+        n = self.nrows
+        cols = {}
+        for name in self.schema:
+            vm = self._validity[name]
+            cols[name] = Column(
+                self.schema[name],
+                self._cols[name][:n],
+                None if vm is None else vm[:n],
+                self.dictionaries.get(name),
+            )
+        return ColumnBatch(cols, n)
 
     # -- pinning --------------------------------------------------------
     def pin(self) -> None:
