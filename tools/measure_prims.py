@@ -14,12 +14,30 @@ Writes JSON lines to stdout and a summary dict at the end.
 from __future__ import annotations
 
 import json
+import os
 import sys
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+# HARD watchdog before anything can touch the tunnel: a wedged axon
+# tunnel blocks inside PJRT where no Python exception can reach, and a
+# hung holder poisons the ONE shared chip for every later user (the
+# round-4 judge found this tool hung for hours holding the tunnel).
+TOOL_TIMEOUT = int(os.environ.get("TOOL_TIMEOUT", 900))
+
+
+def _watchdog():
+    time.sleep(TOOL_TIMEOUT)
+    print(json.dumps({"error": f"timed out after {TOOL_TIMEOUT}s"}),
+          flush=True)
+    os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 
 def bench(name, fn, *args, reps=2):

@@ -12,13 +12,29 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
-import numpy as np
+# HARD watchdog before anything can touch the tunnel: a wedged axon
+# tunnel blocks inside PJRT where no Python exception can reach, and a
+# hung holder poisons the ONE shared chip for every later user.
+TOOL_TIMEOUT = int(os.environ.get("TOOL_TIMEOUT", 1800))
+
+
+def _watchdog():
+    time.sleep(TOOL_TIMEOUT)
+    print(json.dumps({"error": f"timed out after {TOOL_TIMEOUT}s"}),
+          flush=True)
+    os._exit(3)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
+
+import numpy as np  # noqa: E402
 
 
 def cold_join() -> int:
