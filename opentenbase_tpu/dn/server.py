@@ -53,6 +53,9 @@ class DNServer:
         # drop the OLDEST gids, not arbitrary ones — set.pop() could
         # evict the gid just added while keeping stale ones (ADVICE r4)
         self._stream_resolved: dict = {}
+        # observability: shipped-DML direct applies vs gap-deferred
+        # fallbacks (surfaced through ping -> coordinator pg_stat_dml)
+        self.stats: dict = {}
         # startup sweep: 'G' frames already in the local WAL copy were
         # applied during StandbyCluster replay — retire their journals
         # before any repeat 2pc_commit could double-apply them
@@ -123,7 +126,10 @@ class DNServer:
     def _dispatch(self, msg: dict) -> dict:
         op = msg.get("op")
         if op == "ping":
-            return {"ok": True, "applied": self.standby.applied}
+            return {
+                "ok": True, "applied": self.standby.applied,
+                "dml_stats": dict(self.stats),
+            }
         if op == "exec_fragment":
             return self._exec_fragment(msg)
         if op == "2pc_prepare":
@@ -255,12 +261,27 @@ class DNServer:
             if commit_ts is None:
                 return False
             sub, arrays = serde.frame_from_wire(entry["writes"])
+            if c.persistence.frame_apply_gap(sub):
+                # our replica is BEHIND this frame: a touched table's
+                # DDL hasn't streamed yet, or our dictionaries are
+                # missing values below the frame's delta — a direct
+                # apply would lose rows or assign wrong codes. Defer —
+                # the gid-tagged 'G' frame arrives in stream order
+                # with everything it needs, and direct_applied stays
+                # unset so the stream applies it.
+                self.stats["dml_deferred_gap"] = (
+                    self.stats.get("dml_deferred_gap", 0) + 1
+                )
+                return False
             c.persistence._apply(
                 "G",
                 {"commit_ts": int(commit_ts), "writes": sub, "gid": gid},
                 arrays,
             )
             self.standby.direct_applied.add(gid)
+            self.stats["dml_direct_applied"] = (
+                self.stats.get("dml_direct_applied", 0) + 1
+            )
         return True
 
     def _twophase_list(self) -> list:

@@ -112,6 +112,7 @@ class DistExecutor:
         own_writes: Optional[dict[int, dict]] = None,  # node -> table -> writes
         dn_channels: Optional[dict] = None,  # node -> net.pool.ChannelPool
         min_lsn: int = 0,
+        local_only_tables=None,
     ):
         self.catalog = catalog
         self.node_stores = node_stores
@@ -124,6 +125,10 @@ class DistExecutor:
         # (read-your-writes / remote_apply).
         self.dn_channels = dn_channels or {}
         self.min_lsn = min_lsn
+        # coordinator-materialized tables (pg_stat_* system views) are
+        # never WAL-logged, so a DN process has no store for them —
+        # their fragments always run in-process
+        self.local_only_tables = frozenset(local_only_tables or ())
 
     def _stores(self, node: int) -> dict:
         if node == COORDINATOR:
@@ -179,6 +184,8 @@ class DistExecutor:
             frag_tables = _scan_tables(frag.root)
 
             def can_remote(n):
+                if frag_tables & self.local_only_tables:
+                    return False
                 touched = self.own_writes.get(n)
                 return not touched or not (
                     frag_tables & set(touched.keys())

@@ -262,6 +262,117 @@ def test_dn_crash_between_prepare_and_commit_recovers_data(topo):
     assert _dn_rows(port, c.gts.snapshot_ts()) == 100
 
 
+def test_shipped_dml_text_table(topo):
+    """Text-column tables ship too (VERDICT r4 ask #5): the dictionary
+    delta rides the prepare frame ordered before the rows, the DN
+    direct-applies it, and pg_stat_dml surfaces shipped-vs-fallback."""
+    c, s, procs, sender, tmp_path = topo
+    s.execute(
+        "create table txt (k bigint, note text) distribute by shard(k)"
+    )
+    # let the DNs stream the DDL first: a DN whose catalog is behind
+    # correctly DEFERS the direct apply (frame_apply_gap), which is
+    # its own path — here we want the direct-apply path deterministic
+    pos = c.persistence.wal.position
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if all(
+            c.dn_channels[n].rpc({"op": "ping"})["applied"] >= pos
+            for n in (0, 1)
+        ):
+            break
+        time.sleep(0.05)
+    sess = c.session()
+    state = {}
+    orig = type(sess)._dn_2pc
+
+    def spy(self, op, gid, nodes, **extra):
+        if op == "2pc_prepare":
+            state["extra"] = extra
+        return orig(self, op, gid, nodes, **extra)
+
+    type(sess)._dn_2pc = spy
+    try:
+        sess.execute("insert into txt values " + ",".join(
+            f"({i}, 'w{i % 37}')" for i in range(200)
+        ))
+    finally:
+        type(sess)._dn_2pc = orig
+    w = state["extra"].get("writes")
+    assert w is not None, "text-table write set was not shipped"
+    from opentenbase_tpu.plan import serde
+
+    sub, arrays = serde.frame_from_wire(w)
+    dicts = [x for x in sub if x.get("kind") == "dict"]
+    assert dicts, "dictionary delta did not ride the frame"
+    d0 = dicts[0]
+    assert d0["table"] == "txt" and d0["start"] == 0
+    assert set(d0["values"]) == {f"w{i}" for i in range(37)}
+    kinds = [x.get("kind") for x in sub]
+    assert kinds.index("dict") < kinds.index("ins"), (
+        "dict records must precede row records"
+    )
+    # the DN applied the journaled payload directly (not via stream)
+    stats = [
+        c.dn_channels[n].rpc({"op": "ping"})["dml_stats"]
+        for n in (0, 1)
+    ]
+    assert any(
+        st.get("dml_direct_applied", 0) >= 1 for st in stats
+    ), stats
+    # coordinator-side accounting
+    m = dict(s.query("select stat, value from pg_stat_dml"))
+    assert m.get("cn.shipped", 0) >= 1, m
+    # text decodes correctly through a DN fragment read
+    assert s.query("select note from txt where k = 7") == [("w7",)]
+    got = s.query("select count(*) from txt")
+    assert got[0][0] == 200
+
+
+def test_frame_gap_defers_not_corrupts(tmp_path):
+    """A frame touching a table this replica doesn't know yet, or a
+    dict delta starting above the local dictionary length, must be
+    detected (frame_apply_gap) and applying the delta must be a no-op
+    — appending across a gap would assign wrong codes, and a direct
+    apply of an unknown table would mark the gid applied while
+    dropping its rows."""
+    from opentenbase_tpu.engine import Cluster
+
+    c = Cluster(
+        num_datanodes=2, shard_groups=32,
+        data_dir=str(tmp_path / "cn"),
+    )
+    try:
+        s = c.session()
+        s.execute(
+            "create table g (k bigint, w text) distribute by shard(k)"
+        )
+        p = c.persistence
+        gap = [{
+            "kind": "dict", "table": "g", "column": "w",
+            "start": 5, "values": ["x"],
+        }]
+        assert p.frame_apply_gap(gap) is True
+        p._apply_dict_delta(gap[0])
+        d = c.catalog.get("g").dictionaries.get("w")
+        assert d is None or len(d) == 0
+        # a table the replica hasn't created yet defers the whole frame
+        assert p.frame_apply_gap([{
+            "kind": "ins", "table": "not_streamed_yet", "nrows": 1,
+        }]) is True
+        ok = [{
+            "kind": "dict", "table": "g", "column": "w",
+            "start": 0, "values": ["a", "b"],
+        }]
+        assert p.frame_apply_gap(ok) is False
+        p._apply_dict_delta(ok[0])
+        p._apply_dict_delta(ok[0])  # idempotent re-apply
+        d = c.catalog.get("g").dictionaries["w"]
+        assert d.values == ["a", "b"]
+    finally:
+        c.close()
+
+
 def test_duplicate_commit_rpc_is_idempotent(topo):
     c, s, procs, sender, tmp_path = topo
     import opentenbase_tpu.engine as eng
