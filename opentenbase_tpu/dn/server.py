@@ -54,8 +54,18 @@ class DNServer:
         # evict the gid just added while keeping stale ones (ADVICE r4)
         self._stream_resolved: dict = {}
         # observability: shipped-DML direct applies vs gap-deferred
-        # fallbacks (surfaced through ping -> coordinator pg_stat_dml)
+        # fallbacks (surfaced through ping -> coordinator pg_stat_dml);
+        # bumped from concurrent connection threads, hence the lock
         self.stats: dict = {}
+        self._stats_mu = threading.Lock()
+        # peer exchange (squeue.c's consumer-keyed tuple queues): other
+        # DNs push motioned partitions here; consumer fragments wait on
+        # the condition until every producer's part arrived
+        self._exch: dict = {}        # (xid, dest) -> {from: wire batch}
+        self._exch_born: dict = {}   # (xid, dest) -> arrival time (GC)
+        self._exch_cv = threading.Condition()
+        self._peer_pools: dict = {}  # (host, port) -> ChannelPool
+        self._peer_mu = threading.Lock()
         # startup sweep: 'G' frames already in the local WAL copy were
         # applied during StandbyCluster replay — retire their journals
         # before any repeat 2pc_commit could double-apply them
@@ -91,6 +101,13 @@ class DNServer:
             self._lsock.close()
         except OSError:
             pass
+        with self._peer_mu:
+            for pool in self._peer_pools.values():
+                try:
+                    pool.close()
+                except Exception:
+                    pass
+            self._peer_pools.clear()
         self.standby.stop()
 
     def _accept_loop(self) -> None:
@@ -126,9 +143,12 @@ class DNServer:
     def _dispatch(self, msg: dict) -> dict:
         op = msg.get("op")
         if op == "ping":
+            self._exch_gc()  # periodic sweep rides the health checks
+            with self._stats_mu:
+                st = dict(self.stats)
             return {
                 "ok": True, "applied": self.standby.applied,
-                "dml_stats": dict(self.stats),
+                "dml_stats": st,
             }
         if op == "exec_fragment":
             return self._exec_fragment(msg)
@@ -138,6 +158,10 @@ class DNServer:
             return self._twophase_finish(msg, committed=True)
         if op == "2pc_abort":
             return self._twophase_finish(msg, committed=False)
+        if op == "exch_put":
+            return self._exch_put(msg)
+        if op == "exch_take":
+            return self._exch_take(msg)
         if op == "2pc_list":
             entries = self._twophase_list()
             return {
@@ -269,9 +293,7 @@ class DNServer:
                 # the gid-tagged 'G' frame arrives in stream order
                 # with everything it needs, and direct_applied stays
                 # unset so the stream applies it.
-                self.stats["dml_deferred_gap"] = (
-                    self.stats.get("dml_deferred_gap", 0) + 1
-                )
+                self._bump("dml_deferred_gap")
                 return False
             c.persistence._apply(
                 "G",
@@ -279,9 +301,7 @@ class DNServer:
                 arrays,
             )
             self.standby.direct_applied.add(gid)
-            self.stats["dml_direct_applied"] = (
-                self.stats.get("dml_direct_applied", 0) + 1
-            )
+            self._bump("dml_direct_applied")
         return True
 
     def _twophase_list(self) -> list:
@@ -309,6 +329,137 @@ class DNServer:
             out.append({"gid": g, "age_s": age})
         return out
 
+    # -- peer DN<->DN exchange --------------------------------------------
+    # The reference's redistribution data plane is producer datanodes
+    # writing tuples into consumer-keyed shared queues / DataPump
+    # sockets (/root/reference/src/backend/pgxc/squeue/squeue.c:403-660)
+    # with the coordinator only coordinating. Same shape here: the
+    # producer fragment partitions its output locally and pushes each
+    # partition to the consumer DN's exchange store over a peer
+    # channel; the coordinator ships the address book and sees row
+    # counts only.
+
+    def _exch_gc(self, max_age_s: float = 600.0) -> None:
+        now = time.time()
+        with self._exch_cv:
+            for k in [
+                k for k, born in self._exch_born.items()
+                if now - born > max_age_s
+            ]:
+                self._exch.pop(k, None)
+                self._exch_born.pop(k, None)
+
+    def _bump(self, key: str, by: int = 1) -> None:
+        with self._stats_mu:
+            self.stats[key] = self.stats.get(key, 0) + by
+
+    def _exch_put(self, msg: dict) -> dict:
+        key = (str(msg["xid"]), int(msg["dest"]))
+        with self._exch_cv:
+            self._exch.setdefault(key, {})[int(msg["from"])] = (
+                msg["batch"]
+            )
+            self._exch_born.setdefault(key, time.time())
+            self._exch_cv.notify_all()
+        self._bump("exch_parts_in")
+        self._exch_gc()
+        return {"ok": True}
+
+    # The wait budget must sit BELOW the coordinator channel's rpc
+    # timeout (120s default): producers completed their RPCs before any
+    # consumer dispatches, so a missing part means a dead producer —
+    # surface the DN's clean "exchange timed out" error rather than
+    # letting the client socket time out first and discard the channel.
+    EXCH_WAIT_S = 60.0
+
+    def _exch_wait(self, xid: str, dest: int, producers,
+                   timeout_s: float = EXCH_WAIT_S):
+        """Wire parts from every producer, in producer order — or None
+        on timeout. Pops the entry (one consumption per exchange)."""
+        key = (str(xid), int(dest))
+        deadline = time.time() + timeout_s
+        with self._exch_cv:
+            while True:
+                parts = self._exch.get(key, {})
+                if all(int(p) in parts for p in producers):
+                    self._exch.pop(key, None)
+                    self._exch_born.pop(key, None)
+                    return [parts[int(p)] for p in producers]
+                left = deadline - time.time()
+                if left <= 0:
+                    return None
+                self._exch_cv.wait(min(left, 1.0))
+
+    def _exch_take(self, msg: dict) -> dict:
+        self._exch_gc()
+        parts = self._exch_wait(
+            msg["xid"], int(msg["dest"]), msg.get("producers") or [],
+        )
+        if parts is None:
+            return {"error": "exchange timeout"}
+        return {"ok": True, "parts": parts}
+
+    def _peer(self, host: str, port: int):
+        from opentenbase_tpu.net.pool import ChannelPool
+
+        key = (host, int(port))
+        with self._peer_mu:
+            pool = self._peer_pools.get(key)
+            if pool is None:
+                pool = ChannelPool(host, int(port), size=2)
+                self._peer_pools[key] = pool
+            return pool
+
+    def _motion_push(self, out, mo: dict, node: int, plan) -> None:
+        """Partition ``out`` per the motion spec and push each part to
+        its consumer DN — remote pushes in parallel (the serial wall
+        time would grow linearly with cluster size otherwise);
+        self-parts deposit locally without a socket."""
+        from opentenbase_tpu.executor.dist import partition_batch
+        from opentenbase_tpu.plan import serde
+
+        dest = mo["dest"]  # [[node, host, port], ...]
+        kind = mo["kind"]
+        parts: dict[int, object] = {}
+        if kind == "broadcast":
+            wire = serde.batch_to_wire(out, plan.schema)
+            for dn, _h, _p in dest:
+                parts[int(dn)] = wire
+        else:  # redistribute — the ONE shared routing formula
+            idx_by = partition_batch(
+                out, mo["hash_positions"], len(dest)
+            )
+            for di in range(len(dest)):
+                parts[int(dest[di][0])] = serde.batch_to_wire(
+                    out.take(idx_by[di]), plan.schema
+                )
+        errors: list = []
+        pushers = []
+        for dn, host_, port_ in dest:
+            dn = int(dn)
+            payload = {
+                "op": "exch_put", "xid": mo["xid"], "dest": dn,
+                "from": int(mo["from"]), "batch": parts[dn],
+            }
+            if (host_, int(port_)) == (self.host, self.port):
+                self._exch_put(payload)  # self-part: no socket
+                continue
+
+            def push(h=host_, p=port_, pl=payload):
+                try:
+                    self._peer(h, p).rpc(pl)
+                    self._bump("exch_parts_out")
+                except Exception as e:
+                    errors.append(e)
+
+            th = threading.Thread(target=push, daemon=True)
+            th.start()
+            pushers.append(th)
+        for th in pushers:
+            th.join()
+        if errors:
+            raise errors[0]
+
     def _wait_applied(self, lsn: int, timeout_s: float = 90.0) -> bool:
         t0 = time.time()
         while time.time() - t0 < timeout_s:
@@ -334,6 +485,21 @@ class DNServer:
             int(k): serde.batch_from_wire(v, c.catalog)
             for k, v in (msg.get("inputs") or {}).items()
         }
+        # peer-exchanged inputs: wait for every producer DN's pushed
+        # partition (the consumer side of the squeue data plane) —
+        # OUTSIDE the exec lock so redo apply keeps flowing while we
+        # wait on peers
+        for k, spec in (msg.get("exchanges") or {}).items():
+            parts = self._exch_wait(
+                spec["xid"], node, spec.get("producers") or [],
+            )
+            if parts is None:
+                return {"error": f"exchange {spec['xid']} timed out"}
+            from opentenbase_tpu.executor.dist import concat_batches
+
+            inputs[int(k)] = concat_batches([
+                serde.batch_from_wire(p, c.catalog) for p in parts
+            ])
         subquery_values = [
             (v, t.SqlType(t.TypeId(ty[0]), ty[1], ty[2]))
             for v, ty in (msg.get("subquery_values") or [])
@@ -349,6 +515,16 @@ class DNServer:
                 subquery_values=subquery_values,
             )
             out = ex.run_plan(plan)
+        mo = msg.get("motion")
+        if mo is not None:
+            # producer side: partition + push peer-to-peer; the
+            # coordinator gets control-plane info only (row count)
+            self._motion_push(out, mo, node, plan)
+            return {
+                "ok": True, "rows": out.nrows,
+                "pruned_blocks": getattr(ex, "zone_pruned_blocks", 0),
+                "total_blocks": getattr(ex, "zone_total_blocks", 0),
+            }
         return {
             "batch": serde.batch_to_wire(out, plan.schema),
             "pruned_blocks": getattr(ex, "zone_pruned_blocks", 0),

@@ -28,6 +28,7 @@ from opentenbase_tpu.plan.distribute import (
     COORDINATOR,
     DistributedPlan,
     Fragment,
+    RemoteSource,
 )
 from opentenbase_tpu.storage.column import Column
 from opentenbase_tpu.storage.table import ColumnBatch
@@ -43,6 +44,22 @@ def _scan_tables(plan) -> set:
         tb = getattr(node, "table", None)
         if isinstance(tb, str):
             out.add(tb)
+        stack.extend(node.children())
+    return out
+
+
+def _remote_source_ids(plan) -> set:
+    """Producer-fragment indices this plan actually consumes. Inputs
+    MUST be restricted to these: handing every motioned batch to every
+    later fragment was merely wasteful with inline copies, but a
+    pop-on-consume peer exchange handed to a non-consumer would eat
+    the parts the real consumer is waiting on."""
+    out: set = set()
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, RemoteSource):
+            out.add(node.fragment)
         stack.extend(node.children())
     return out
 
@@ -77,6 +94,20 @@ def concat_batches(batches: list[ColumnBatch]) -> ColumnBatch:
     return ColumnBatch(cols, sum(b.nrows for b in batches))
 
 
+def partition_batch(
+    batch: ColumnBatch, hash_positions, ndest: int
+) -> list[np.ndarray]:
+    """Row-index arrays per destination slot. THE one redistribute
+    routing formula — the coordinator's _apply_motion and the DN's
+    peer-exchange push must route identically or rows silently land on
+    the wrong consumer."""
+    if batch.nrows == 0:
+        return [np.empty(0, np.int64) for _ in range(ndest)]
+    h = hash_batch_columns(batch, list(hash_positions))
+    route = (h % np.uint32(ndest)).astype(np.int64)
+    return [np.nonzero(route == di)[0] for di in range(ndest)]
+
+
 def hash_batch_columns(batch: ColumnBatch, positions: list[int]) -> np.ndarray:
     """uint32 placement hash over key columns — must agree with the
     locator's routing (utils/hashing.py shared formula)."""
@@ -99,6 +130,24 @@ def hash_batch_columns(batch: ColumnBatch, positions: list[int]) -> np.ndarray:
             h = np.where(col.validity, h, np.uint32(0))
         hashes.append(h)
     return combine_hashes(hashes, np)
+
+
+class ExchangeRef:
+    """Marker standing in for a motioned batch that never visited the
+    coordinator: the producer DN pushed its partition straight to the
+    consumer DN's exchange store (the squeue/DataPump data plane,
+    /root/reference/src/backend/pgxc/squeue/squeue.c:403-660 — there
+    producers write tuples into consumer-keyed shared queues; here they
+    push framed batches into the consumer DN's in-memory exchange).
+    The coordinator hands out the address book and carries only this
+    control-plane reference."""
+
+    __slots__ = ("xid", "producers", "schema")
+
+    def __init__(self, xid: str, producers, schema):
+        self.xid = xid
+        self.producers = list(producers)
+        self.schema = schema
 
 
 class DistExecutor:
@@ -167,12 +216,15 @@ class DistExecutor:
 
     def _run_one(self, dplan: DistributedPlan, subquery_values) -> ColumnBatch:
         import time as _time
+        import uuid as _uuid
 
-        # fragment -> consumer node -> input batch
+        # fragment -> consumer node -> input batch (or ExchangeRef when
+        # the data plane went DN->DN and never visited the coordinator)
         motioned: dict[int, dict[int, ColumnBatch]] = {}
         if not hasattr(self, "instrumentation"):
             self.instrumentation = []
         frag_schemas = {f.index: f.root.schema for f in dplan.fragments}
+        qxid = _uuid.uuid4().hex[:16]
         for frag in dplan.fragments:
             outs: dict[int, ColumnBatch] = {}
             # A transaction's own uncommitted writes exist only in the
@@ -182,6 +234,7 @@ class DistExecutor:
             # this transaction on n (execRemote.c keeps the same
             # rule per-relation via the command-id visibility check).
             frag_tables = _scan_tables(frag.root)
+            frag_sources = _remote_source_ids(frag.root)
 
             def can_remote(n):
                 if frag_tables & self.local_only_tables:
@@ -196,6 +249,19 @@ class DistExecutor:
                 if n in self.dn_channels and can_remote(n)
             ]
             local = [n for n in frag.nodes if n not in remote]
+            # PEER exchange (VERDICT r4 missing-2): when every producer
+            # of a redistribute/broadcast runs in a DN process and
+            # every consumer node has one too, the data plane goes
+            # DN->DN directly — the coordinator ships the address book
+            # with the producer fragment and sees row counts only.
+            peer_xid = None
+            if (
+                frag.motion in ("redistribute", "broadcast")
+                and frag.dest_nodes
+                and local == []
+                and all(n in self.dn_channels for n in frag.dest_nodes)
+            ):
+                peer_xid = f"{qxid}:{frag.index}"
             # remote fragments run concurrently in their DN processes
             # (the reference's parallel RemoteSubplan fan-out)
             threads = []
@@ -204,14 +270,17 @@ class DistExecutor:
             def run_remote(node):
                 t0 = _time.perf_counter()
                 try:
-                    outs[node] = self._exec_remote(
+                    rows, batch = self._exec_remote(
                         frag, node, motioned, subquery_values,
-                        frag_schemas,
+                        frag_schemas, peer_xid=peer_xid,
+                        frag_sources=frag_sources,
                     )
+                    if batch is not None:
+                        outs[node] = batch
                     self.instrumentation.append({
                         "fragment": frag.index,
                         "node": node,
-                        "rows": outs[node].nrows,
+                        "rows": rows,
                         "ms": (_time.perf_counter() - t0) * 1000,
                         "remote": True,
                     })
@@ -233,9 +302,9 @@ class DistExecutor:
                         self._stores(node),
                         self.snapshot_ts,
                         remote_inputs={
-                            j: per_node[node]
+                            j: self._resolve_input(per_node[node], node)
                             for j, per_node in motioned.items()
-                            if node in per_node
+                            if node in per_node and j in frag_sources
                         },
                         subquery_values=subquery_values,
                         own_writes=self.own_writes.get(node),
@@ -275,7 +344,15 @@ class DistExecutor:
                 th.join()
             if errors:
                 raise errors[0]
-            motioned[frag.index] = self._apply_motion(frag, outs)
+            if peer_xid is not None:
+                ref = ExchangeRef(
+                    peer_xid, list(frag.nodes), frag.root.schema
+                )
+                motioned[frag.index] = {
+                    n: ref for n in frag.dest_nodes
+                }
+            else:
+                motioned[frag.index] = self._apply_motion(frag, outs)
         ex = LocalExecutor(
             self.catalog,
             {},
@@ -289,25 +366,56 @@ class DistExecutor:
         )
         return ex.run_plan(dplan.root)
 
-    def _exec_remote(
-        self, frag: Fragment, node: int, motioned, subquery_values,
-        frag_schemas,
-    ) -> ColumnBatch:
-        """Ship the fragment to the node's DN process (plan/serde.py over
-        a pooled channel) and decode its output batch."""
+    def _resolve_input(self, val, node: int) -> ColumnBatch:
+        """A local executor consuming a peer-exchanged input pulls the
+        parts from the consumer node's DN exchange store (the safety
+        valve for mixed local/remote placements — normally consumers
+        run remotely and the parts never leave the DNs)."""
         from opentenbase_tpu.plan import serde
 
+        if not isinstance(val, ExchangeRef):
+            return val
+        resp = self.dn_channels[node].rpc({
+            "op": "exch_take", "xid": val.xid, "dest": node,
+            "producers": val.producers,
+        })
+        return concat_batches([
+            serde.batch_from_wire(p, self.catalog)
+            for p in resp["parts"]
+        ])
+
+    def _exec_remote(
+        self, frag: Fragment, node: int, motioned, subquery_values,
+        frag_schemas, peer_xid=None, frag_sources=None,
+    ):
+        """Ship the fragment to the node's DN process (plan/serde.py over
+        a pooled channel). Returns (rows, batch) — with ``peer_xid`` the
+        DN partitions and pushes its output straight to the consumer DNs
+        (address book in the message), only a row count returns, and
+        batch is None."""
+        from opentenbase_tpu.plan import serde
+
+        if frag_sources is None:
+            frag_sources = _remote_source_ids(frag.root)
         inputs = {}
+        exchanges = {}
         for j, per_node in motioned.items():
-            if node in per_node:
+            if node not in per_node or j not in frag_sources:
+                continue
+            v = per_node[node]
+            if isinstance(v, ExchangeRef):
+                exchanges[str(j)] = {
+                    "xid": v.xid, "producers": v.producers,
+                }
+            else:
                 inputs[str(j)] = serde.batch_to_wire(
-                    per_node[node], frag_schemas[j]
+                    v, frag_schemas[j]
                 )
         sq = [
             [v, [ty.id.value, ty.precision, ty.scale]]
             for v, ty in subquery_values
         ]
-        resp = self.dn_channels[node].rpc({
+        msg = {
             "op": "exec_fragment",
             "plan": serde.dumps_plan(frag.root),
             "node": node,
@@ -315,8 +423,26 @@ class DistExecutor:
             "inputs": inputs,
             "subquery_values": sq,
             "min_lsn": self.min_lsn,
-        })
-        return serde.batch_from_wire(resp["batch"], self.catalog)
+        }
+        if exchanges:
+            msg["exchanges"] = exchanges
+        if peer_xid is not None:
+            msg["motion"] = {
+                "xid": peer_xid,
+                "kind": frag.motion,
+                "hash_positions": list(frag.hash_positions),
+                "from": node,
+                "dest": [
+                    [n, self.dn_channels[n].host,
+                     self.dn_channels[n].port]
+                    for n in frag.dest_nodes
+                ],
+            }
+        resp = self.dn_channels[node].rpc(msg)
+        if peer_xid is not None:
+            return int(resp.get("rows", 0)), None
+        batch = serde.batch_from_wire(resp["batch"], self.catalog)
+        return batch.nrows, batch
 
     def _apply_motion(
         self, frag: Fragment, outs: dict[int, ColumnBatch]
@@ -333,11 +459,11 @@ class DistExecutor:
             for b in ordered:
                 if b.nrows == 0:
                     continue
-                h = hash_batch_columns(b, list(frag.hash_positions))
-                route = (h % np.uint32(len(dest))).astype(np.int64)
+                parts = partition_batch(
+                    b, frag.hash_positions, len(dest)
+                )
                 for di, n in enumerate(dest):
-                    idx = np.nonzero(route == di)[0]
-                    shards[n].append(b.take(idx))
+                    shards[n].append(b.take(parts[di]))
             out = {}
             for n in dest:
                 parts = shards[n] or [self._empty_like(ordered)]
