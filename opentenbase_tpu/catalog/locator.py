@@ -92,16 +92,12 @@ class Locator:
     def nodes_for_write(self) -> list[int]:
         return list(self.node_indices)
 
-    def prune_by_key_equal(self, values: dict[str, object]) -> list[int] | None:
-        """If the quals pin every distribution-key column to a constant,
-        return the single owning node ([n]); else None (all nodes). This is
-        the fast-query-shipping pruning step (GetRelationNodesByQuals,
-        locator.c:2511). Constants are converted to each key column's
-        *physical* representation before hashing so the result always
-        matches route_insert."""
-        s = self.spec.strategy
-        if s in (DistStrategy.REPLICATED, DistStrategy.ROUNDROBIN):
-            return None
+    def _eq_hash(self, values: dict[str, object]):
+        """(placement hash, first physical key) for a fully-pinned key
+        set, or None. THE one constant→physical→hash sequence — node
+        pruning and the shard barrier's membership proof must agree or
+        a statement could 'prove' it misses a moving shard while
+        routing to it."""
         if not all(k in values for k in self.spec.key_columns):
             return None
         hashes = []
@@ -118,7 +114,35 @@ class Locator:
                 hashes.append(hash_strings([phys]))
             else:
                 hashes.append(hash32_np(phys))
-        h = combine_hashes(hashes, np)
+        return combine_hashes(hashes, np), first_phys
+
+    def shard_id_by_key_equal(self, values: dict[str, object]):
+        """The single shard group a fully-pinned key routes to (SHARD
+        strategy only), or None. Lets the shard barrier prove a
+        statement touches no in-move shard (shardbarrier.c's check is
+        the same shard-id membership test)."""
+        if self.spec.strategy != DistStrategy.SHARD:
+            return None
+        hp = self._eq_hash(values)
+        if hp is None:
+            return None
+        assert self.shardmap is not None
+        return int(self.shardmap.shard_ids(hp[0])[0])
+
+    def prune_by_key_equal(self, values: dict[str, object]) -> list[int] | None:
+        """If the quals pin every distribution-key column to a constant,
+        return the single owning node ([n]); else None (all nodes). This is
+        the fast-query-shipping pruning step (GetRelationNodesByQuals,
+        locator.c:2511). Constants are converted to each key column's
+        *physical* representation before hashing so the result always
+        matches route_insert."""
+        s = self.spec.strategy
+        if s in (DistStrategy.REPLICATED, DistStrategy.ROUNDROBIN):
+            return None
+        hp = self._eq_hash(values)
+        if hp is None:
+            return None
+        h, first_phys = hp
         if s == DistStrategy.SHARD:
             assert self.shardmap is not None
             return [int(self.shardmap.route_hash(h)[0])]

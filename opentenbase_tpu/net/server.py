@@ -283,6 +283,15 @@ class ClusterServer:
                     if getattr(meta, "foreign", None) is not None:
                         return "excl", None
                 return "write", refs
+            if isinstance(st, A.MoveData):
+                # MOVE DATA holds its own per-shard barrier and takes a
+                # brief exclusive acquire only for the ownership flip —
+                # readers of non-moving shards overlap the copy phase
+                # (shardbarrier.c semantics; VERDICT r4 ask #7). The
+                # writer-class slot serializes it against same-table
+                # writers through the engine's barrier gate instead of
+                # fencing out every reader.
+                return "write", set()
             return "excl", None
         except Exception:
             return "excl", None

@@ -88,6 +88,23 @@ class RemoteSource(L.LogicalPlan):
         return f"remotesrc({self.fragment})"
 
 
+def eq_consts(scan, pred) -> dict:
+    """Column-name → constant for every `col = const` conjunct over a
+    scan. THE one equality-pinning walk — node pruning and the shard
+    barrier's membership proof must extract identically."""
+    consts: dict = {}
+    for c in E.conjuncts(pred):
+        if (
+            isinstance(c, E.BinE)
+            and c.op == "="
+            and isinstance(c.left, E.Col)
+            and isinstance(c.right, E.Const)
+            and c.right.value is not None
+        ):
+            consts[scan.columns[c.left.index]] = c.right.value
+    return consts
+
+
 @dataclass
 class Fragment:
     """One plan fragment + the motion delivering its output upward."""
@@ -229,17 +246,7 @@ class Distributor:
 
     def _prune_nodes(self, scan: L.Scan, pred: E.TExpr, dist: Dist):
         meta = self.catalog.get(scan.table)
-        consts: dict[str, object] = {}
-        for c in E.conjuncts(pred):
-            if (
-                isinstance(c, E.BinE)
-                and c.op == "="
-                and isinstance(c.left, E.Col)
-                and isinstance(c.right, E.Const)
-                and c.right.value is not None
-            ):
-                colname = scan.columns[c.left.index]
-                consts[colname] = c.right.value
+        consts = eq_consts(scan, pred)
         if not all(k in consts for k in meta.dist.key_columns):
             return None
         values = {k: consts[k] for k in meta.dist.key_columns}

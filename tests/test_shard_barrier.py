@@ -1,0 +1,221 @@
+"""Per-shard MOVE DATA barrier (VERDICT r4 ask #7): the copy phase of a
+shard rebalance blocks ONLY statements touching the moving shards —
+point reads of other shards proceed concurrently — mirroring the
+reference's shard-barrier bitmap (shardbarrier.c)."""
+
+import threading
+import time
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.storage.table import ShardStore
+
+
+@pytest.fixture()
+def cl():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table m (k bigint, v bigint) distribute by shard(k)"
+    )
+    s.execute("insert into m values " + ",".join(
+        f"({i}, {i * 10})" for i in range(400)
+    ))
+    return c, s
+
+
+def _shard_of(c, key: int) -> int:
+    meta = c.catalog.get("m")
+    return meta.locator.shard_id_by_key_equal({"k": key})
+
+
+def test_reads_of_other_shards_overlap_move(cl, monkeypatch):
+    c, s = cl
+    # pick a key on node 0 and a key on a DIFFERENT shard
+    sm = c.shardmap
+    k_moving = next(
+        k for k in range(400)
+        if sm.map[_shard_of(c, k)] == 0
+    )
+    sid_moving = _shard_of(c, k_moving)
+    k_other = next(
+        k for k in range(400) if _shard_of(c, k) != sid_moving
+    )
+    want_moving = s.query(
+        f"select v from m where k = {k_moving}"
+    )
+    want_other = s.query(f"select v from m where k = {k_other}")
+
+    in_move = threading.Event()
+    release = threading.Event()
+    orig = ShardStore.stamp_xmax
+
+    def slow_stamp(self, idx, ts):
+        in_move.set()
+        assert release.wait(20), "test driver never released the move"
+        return orig(self, idx, ts)
+
+    monkeypatch.setattr(ShardStore, "stamp_xmax", slow_stamp)
+    mover_err = []
+
+    def mover():
+        try:
+            c.session().execute(
+                f"move data from dn0 to dn1 shards ({sid_moving})"
+            )
+        except Exception as e:  # surface in the main thread
+            mover_err.append(e)
+            in_move.set()
+
+    th = threading.Thread(target=mover)
+    th.start()
+    try:
+        assert in_move.wait(20), "move never reached the copy phase"
+        assert not mover_err, mover_err
+        # barrier is up, copy is mid-flight...
+        assert c.shard_barrier.active()
+        # ...a point read of a NON-moving shard completes NOW
+        s2 = c.session()
+        got_other = s2.query(
+            f"select v from m where k = {k_other}"
+        )
+        assert got_other == want_other
+        # ...a point read of the MOVING shard blocks until the flip
+        done = threading.Event()
+        got_moving = []
+
+        def reader():
+            got_moving.append(
+                c.session().query(
+                    f"select v from m where k = {k_moving}"
+                )
+            )
+            done.set()
+
+        rt = threading.Thread(target=reader, daemon=True)
+        rt.start()
+        assert not done.wait(1.0), (
+            "read of the moving shard did not wait for the barrier"
+        )
+    finally:
+        monkeypatch.setattr(ShardStore, "stamp_xmax", orig)
+        release.set()
+        th.join(30)
+    assert not mover_err, mover_err
+    assert done.wait(20), "blocked reader never resumed"
+    assert got_moving[0] == want_moving
+    # the shard now lives on dn1 and the data still reads back whole
+    assert int(c.shardmap.map[sid_moving]) == 1
+    assert s.query("select count(*) from m")[0][0] == 400
+
+
+def test_unprovable_statement_waits(cl, monkeypatch):
+    """A full scan (no dist-key pin) can't prove shard membership and
+    must wait for the barrier."""
+    c, s = cl
+    with c.shard_barrier.moving({3}):
+        done = threading.Event()
+
+        def scanner():
+            c.session().query("select count(*) from m")
+            done.set()
+
+        th = threading.Thread(target=scanner, daemon=True)
+        th.start()
+        assert not done.wait(0.8), "full scan ignored the barrier"
+    assert done.wait(20)
+
+
+def test_writes_wait_for_barrier(cl):
+    c, s = cl
+    with c.shard_barrier.moving({5}):
+        done = threading.Event()
+
+        def writer():
+            c.session().execute("insert into m values (9001, 1)")
+            done.set()
+
+        th = threading.Thread(target=writer, daemon=True)
+        th.start()
+        assert not done.wait(0.8), "write ignored the barrier"
+    assert done.wait(20)
+    assert c.session().query(
+        "select count(*) from m where k = 9001"
+    )[0][0] == 1
+
+
+def test_tcp_path_no_deadlock_during_move(monkeypatch):
+    """Through the TCP front end (where statements hold RWStatementLock
+    slots) a full scan arriving mid-move must resume after the flip —
+    not deadlock against the move's exclusive acquire (the gate parks
+    its slot while waiting on the barrier)."""
+    from opentenbase_tpu.net.client import connect_tcp
+    from opentenbase_tpu.net.server import ClusterServer
+
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    srv = ClusterServer(c).start()
+    try:
+        with connect_tcp(srv.host, srv.port) as s:
+            s.execute(
+                "create table m (k bigint, v bigint) "
+                "distribute by shard(k)"
+            )
+            s.execute("insert into m values " + ",".join(
+                f"({i}, {i})" for i in range(200)
+            ))
+            meta = c.catalog.get("m")
+            sid = next(
+                meta.locator.shard_id_by_key_equal({"k": k})
+                for k in range(200)
+                if c.shardmap.map[
+                    meta.locator.shard_id_by_key_equal({"k": k})
+                ] == 0
+            )
+            in_move = threading.Event()
+            release = threading.Event()
+            orig = ShardStore.stamp_xmax
+
+            def slow_stamp(self, idx, ts):
+                in_move.set()
+                assert release.wait(20)
+                return orig(self, idx, ts)
+
+            monkeypatch.setattr(ShardStore, "stamp_xmax", slow_stamp)
+            errs = []
+
+            def mover():
+                try:
+                    with connect_tcp(srv.host, srv.port) as s2:
+                        s2.execute(
+                            f"move data from dn0 to dn1 shards ({sid})"
+                        )
+                except Exception as e:
+                    errs.append(e)
+                    in_move.set()
+
+            got = []
+
+            def scanner():
+                try:
+                    with connect_tcp(srv.host, srv.port) as s3:
+                        got.append(
+                            s3.query("select count(*) from m")[0][0]
+                        )
+                except Exception as e:
+                    errs.append(e)
+
+            mt = threading.Thread(target=mover)
+            mt.start()
+            assert in_move.wait(20) and not errs, errs
+            st = threading.Thread(target=scanner)
+            st.start()
+            time.sleep(0.3)  # scanner reaches the barrier gate
+            release.set()
+            mt.join(30)
+            st.join(30)
+            assert not errs, errs
+            assert got == [200], got
+    finally:
+        monkeypatch.setattr(ShardStore, "stamp_xmax", orig)
+        srv.stop()
