@@ -90,6 +90,9 @@ GUCS: dict = {
     "ssl_key_file": (_str, ""),
     "enable_pallas_scan": (_bool, None),
     "enable_fast_query_shipping": (_bool, True),
+    # within-fragment scan workers on DN processes (execParallel.c's
+    # max_parallel_workers_per_gather analog)
+    "dn_parallel_workers": (_int, 4),
     "lock_timeout": (_duration, 0),
     "deadlock_timeout": (_duration, 1000),
     "statement_timeout": (_duration, 0),

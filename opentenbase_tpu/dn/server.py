@@ -507,14 +507,32 @@ class DNServer:
         # execute under the standby's statement lock so redo apply never
         # interleaves with a fragment read (recovery-conflict interlock)
         with c._exec_lock:
-            ex = LocalExecutor(
-                c.catalog,
-                c.stores.get(node, {}),
-                snapshot_ts,
-                remote_inputs=inputs,
-                subquery_values=subquery_values,
-            )
-            out = ex.run_plan(plan)
+            out = None
+            ex = None
+            K = int(msg.get("parallel", 1))
+            if K > 1:
+                # within-fragment parallel scan+partial-agg over row
+                # blocks (execParallel.c:565); None = shape/size does
+                # not qualify, fall through to the serial path
+                from opentenbase_tpu.executor.local import (
+                    run_fragment_parallel,
+                )
+
+                out = run_fragment_parallel(
+                    c.catalog, c.stores.get(node, {}), snapshot_ts,
+                    plan, inputs, subquery_values, K,
+                )
+                if out is not None:
+                    self._bump("parallel_fragments")
+            if out is None:
+                ex = LocalExecutor(
+                    c.catalog,
+                    c.stores.get(node, {}),
+                    snapshot_ts,
+                    remote_inputs=inputs,
+                    subquery_values=subquery_values,
+                )
+                out = ex.run_plan(plan)
         mo = msg.get("motion")
         if mo is not None:
             # producer side: partition + push peer-to-peer; the
@@ -530,7 +548,6 @@ class DNServer:
             "pruned_blocks": getattr(ex, "zone_pruned_blocks", 0),
             "total_blocks": getattr(ex, "zone_total_blocks", 0),
         }
-
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser()

@@ -55,7 +55,6 @@ from opentenbase_tpu.lmgr import (
 )
 from opentenbase_tpu.plan import analyze_statement
 from opentenbase_tpu.plan import logical as L
-from opentenbase_tpu.plan import texpr as E
 from opentenbase_tpu.plan.analyze import Analyzer
 from opentenbase_tpu.plan.distribute import distribute_statement
 from opentenbase_tpu.plan.optimize import optimize_statement, prune_columns
@@ -1789,16 +1788,10 @@ class Session:
             # lock (backup.py's checkpoint-generation retry makes the
             # copy safe against concurrent activity) — only the
             # checkpoint above needed exclusivity
-            lock = self.cluster._exec_lock
-            tok = (
-                lock.park_release()
-                if hasattr(lock, "park_release") else None
-            )
-            try:
+            from opentenbase_tpu.utils.rwlock import parked
+
+            with parked(self.cluster._exec_lock):
                 man = basebackup(p.dir, target)
-            finally:
-                if hasattr(lock, "park_reacquire"):
-                    lock.park_reacquire(tok)
             return Result(
                 "SELECT",
                 [(target, len(man["files"]), int(man["wal_bytes"]))],
@@ -2353,20 +2346,16 @@ class Session:
         # front end classes statements before execute): waiting on the
         # barrier while holding a reader slot would deadlock against
         # the move's exclusive ownership-flip acquire
-        lock = self.cluster._exec_lock
-        tok = (
-            lock.park_release()
-            if hasattr(lock, "park_release") else None
-        )
+        from opentenbase_tpu.utils.rwlock import parked
+
         try:
-            bar.wait_readable(
-                None if splan is None else self._plan_shard_ids(splan)
-            )
+            with parked(self.cluster._exec_lock):
+                bar.wait_readable(
+                    None if splan is None
+                    else self._plan_shard_ids(splan)
+                )
         except ShardBarrierTimeout as e:
             raise SQLError(str(e)) from None
-        finally:
-            if tok is not None:
-                lock.park_reacquire(tok)
 
     def _run_statement_plan(self, splan: L.StatementPlan) -> ColumnBatch:
         self._shard_barrier_gate(splan)
@@ -2387,6 +2376,7 @@ class Session:
                 else 0
             ),
             local_only_tables=_SYSTEM_VIEWS,
+            parallel_workers=self.gucs.get("dn_parallel_workers", 4),
         )
         return ex.run(dplan)
 
@@ -3514,16 +3504,11 @@ class Session:
             # copy snapshot — stranded invisible post-flip. One brief
             # exclusive acquire (park our own slot first) empties the
             # data plane; everything arriving after waits at the gate.
-            tok0 = (
-                lock.park_release()
-                if hasattr(lock, "park_release") else None
-            )
-            try:
+            from opentenbase_tpu.utils.rwlock import parked
+
+            with parked(lock):
                 with lock:
                     pass
-            finally:
-                if hasattr(lock, "park_reacquire"):
-                    lock.park_reacquire(tok0)
             snapshot = self.cluster.gts.snapshot_ts()
             for meta in [
                 self.cluster.catalog.get(n)
@@ -3585,13 +3570,65 @@ class Session:
             # position renumbering runs with the data plane quiesced.
             # park first: the front end may have classed this statement
             # shared, and exclusive can't be acquired over our own slot.
-            lock = self.cluster._exec_lock
-            tok = (
-                lock.park_release()
-                if hasattr(lock, "park_release") else None
-            )
-            try:
+            with parked(lock):
                 with lock:
+                    # catch-up pass: rows COMMITTED into the moving
+                    # shards after the copy snapshot (a writer past the
+                    # barrier gate before it registered — embedded
+                    # sessions take no statement lock). Still-open
+                    # embedded transactions at this point remain the
+                    # documented out-of-contract case.
+                    snap2 = self.cluster.gts.get_gts()
+                    for meta in [
+                        self.cluster.catalog.get(n)
+                        for n in self.cluster.catalog.table_names()
+                    ]:
+                        if meta.dist.strategy != DistStrategy.SHARD:
+                            continue
+                        src = self.cluster.stores[from_node].get(
+                            meta.name
+                        )
+                        if src is None or src.nrows == 0:
+                            continue
+                        key_cols = {
+                            k: src.column(k)
+                            for k in meta.dist.key_columns
+                        }
+                        h = meta.locator.key_hash(key_cols)
+                        sid = sm.shard_ids(h)
+                        nr = src.nrows
+                        late = (
+                            (src.xmin_ts[:nr] > snapshot)
+                            & (src.xmin_ts[:nr] <= snap2)
+                            & (src.xmax_ts[:nr] > snap2)
+                            & np.isin(sid, list(moved_set))
+                        )
+                        idx = np.nonzero(late)[0]
+                        if not len(idx):
+                            continue
+                        batch = src.to_batch().take(idx)
+                        dst = self.cluster.stores.setdefault(
+                            to_node, {}
+                        ).setdefault(
+                            meta.name,
+                            ShardStore(meta.schema, meta.dictionaries),
+                        )
+                        cts = self.cluster.gts.get_gts()
+                        ds, de = dst.append_batch(batch, cts)
+                        src.stamp_xmax(idx, cts)
+                        if self.cluster.persistence is not None:
+                            self.cluster.persistence.log_commit_group(
+                                [(from_node, meta.name, [], idx),
+                                 (to_node, meta.name, [(ds, de)], [])],
+                                self.cluster.stores,
+                                cts,
+                            )
+                        if src not in vacuum_srcs:
+                            vacuum_srcs.append(src)
+                        if to_node not in meta.node_indices:
+                            meta.node_indices.append(to_node)
+                            meta.locator.node_indices.append(to_node)
+                        nmoved += len(idx)
                     for sid in moved_set:
                         sm.move_shard(sid, to_node)
                     horizon = self.cluster.gts.get_gts()
@@ -3602,9 +3639,6 @@ class Session:
                             {"op": "shardmap", "map": sm.map.tolist()}
                         )
                         self.cluster.persistence.checkpoint()
-            finally:
-                if hasattr(lock, "park_reacquire"):
-                    lock.park_reacquire(tok)
         return Result("MOVE DATA", rowcount=nmoved)
 
     # -- sequences -------------------------------------------------------

@@ -162,6 +162,7 @@ class DistExecutor:
         dn_channels: Optional[dict] = None,  # node -> net.pool.ChannelPool
         min_lsn: int = 0,
         local_only_tables=None,
+        parallel_workers: int = 1,
     ):
         self.catalog = catalog
         self.node_stores = node_stores
@@ -178,6 +179,10 @@ class DistExecutor:
         # never WAL-logged, so a DN process has no store for them —
         # their fragments always run in-process
         self.local_only_tables = frozenset(local_only_tables or ())
+        # within-fragment worker count shipped to DN processes
+        # (dn_parallel_workers GUC; execParallel.c's
+        # max_parallel_workers_per_gather analog)
+        self.parallel_workers = max(int(parallel_workers or 1), 1)
 
     def _stores(self, node: int) -> dict:
         if node == COORDINATOR:
@@ -424,6 +429,8 @@ class DistExecutor:
             "subquery_values": sq,
             "min_lsn": self.min_lsn,
         }
+        if self.parallel_workers > 1:
+            msg["parallel"] = self.parallel_workers
         if exchanges:
             msg["exchanges"] = exchanges
         if peer_xid is not None:

@@ -88,6 +88,11 @@ class LocalExecutor:
         # (the reference's "xmin is my own xid" branch of
         # HeapTupleSatisfiesMVCC, tqual.c)
         self.own_writes = own_writes or {}
+        # within-fragment parallel worker: restrict the (single) base
+        # scan to this physical row block — the parallel seq scan
+        # chunking of execParallel.c:565 (each worker scans a disjoint
+        # block; a Gather-analog merge combines partials)
+        self.scan_block: Optional[tuple[int, int]] = None
 
     # -- dictionary access ----------------------------------------------
     def _dict(self, dict_id: str) -> Dictionary:
@@ -216,12 +221,19 @@ class LocalExecutor:
         # n is a consistent fully-written prefix — but re-reading
         # nrows per column would tear the scan across columns
         n0 = store.nrows
-        nrows = n0 if row_idx is None else len(row_idx)
+        blk = self.scan_block
+        if blk is not None:
+            assert row_idx is None and not self.own_writes
+            s0, e0 = max(0, blk[0]), min(blk[1], n0)
+            e0 = max(e0, s0)
+        else:
+            s0, e0 = 0, n0
+        nrows = (e0 - s0) if row_idx is None else len(row_idx)
         padded = filt_ops.bucket_size(max(nrows, 1))
 
         def subset(arr):
-            a = arr[:n0]
-            return a if row_idx is None else a[row_idx]
+            a = arr[s0:e0]
+            return a if row_idx is None else arr[:n0][row_idx]
 
         cols = []
         for name, oc in zip(plan.columns, plan.schema):
@@ -320,6 +332,8 @@ class LocalExecutor:
         store = self.stores.get(plan.table)
         if store is None or store.nrows == 0:
             return None
+        if self.scan_block is not None:
+            return None  # block workers scan plain contiguous ranges
         if plan.table in self.own_writes:
             return None  # ins_ranges/del_idx are positional
         try:
@@ -1141,3 +1155,147 @@ def _predicate_bounds(pred, scan: L.Scan) -> dict:
             if vals:
                 narrow(c.operand.index, min(vals), max(vals))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Within-fragment parallelism (execParallel.c:565 / nodeGather.c:134):
+# split a fragment's base scan across K host threads over contiguous row
+# blocks, run the SAME partial-aggregate plan per block, and merge the
+# block partials with the 2-phase merge functions — the parallel seq
+# scan + Gather shape, columnar style. numpy/XLA release the GIL during
+# kernel execution, so host threads give real scan parallelism.
+# ---------------------------------------------------------------------------
+
+_BLOCK_MERGE_FUNC = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+
+
+def _parallel_min_rows() -> int:
+    """Read per call (not at import) so DN processes and tests can
+    lower it through the environment."""
+    import os
+
+    return int(os.environ.get("OTB_DN_PARALLEL_MIN_ROWS", 100_000))
+
+
+def _parallel_shape(plan):
+    """(aggregate, scan) when the fragment is a mergeable partial
+    aggregate over a Filter/Project chain to ONE base scan — the shape
+    block workers can split; None otherwise."""
+    from opentenbase_tpu.plan import logical as L
+
+    if not isinstance(plan, L.Aggregate):
+        return None
+    for a in plan.aggs:
+        if a.distinct or a.func not in _BLOCK_MERGE_FUNC:
+            return None
+    node = plan.child
+    while isinstance(node, (L.Filter, L.Project)):
+        node = node.child
+    if not isinstance(node, L.Scan):
+        return None
+    return plan, node
+
+
+def run_fragment_parallel(
+    catalog, stores, snapshot_ts, plan, remote_inputs,
+    subquery_values, nworkers: int,
+):
+    """Run ``plan`` split across ``nworkers`` scan-block threads, or
+    return None when the shape/size doesn't qualify (caller falls back
+    to the single-threaded path)."""
+    import threading
+
+    from opentenbase_tpu.plan import logical as L
+    from opentenbase_tpu.plan import texpr as E
+    from opentenbase_tpu.plan.distribute import RemoteSource
+
+    shape = _parallel_shape(plan)
+    if shape is None or nworkers <= 1:
+        return None
+    agg, scan = shape
+    store = stores.get(scan.table)
+    min_rows = _parallel_min_rows()
+    if store is None or store.nrows < min_rows:
+        return None
+    # block workers scan plain contiguous ranges; when zone-map pruning
+    # would apply (indexed columns bound by the predicate) the serial
+    # path's block skipping usually beats brute-force parallel scanning
+    # — leave those to the pruned path
+    node = agg.child
+    pred = None
+    while isinstance(node, (L.Filter, L.Project)):
+        if isinstance(node, L.Filter) and isinstance(
+            node.child, L.Scan
+        ):
+            pred = node.predicate
+        node = node.child
+    if pred is not None:
+        try:
+            meta = catalog.get(scan.table)
+            if meta.zone_cols:
+                from opentenbase_tpu.storage.table import (
+                    zone_usable_bounds,
+                )
+
+                if zone_usable_bounds(
+                    _predicate_bounds(pred, scan), meta, scan
+                ):
+                    return None
+        except Exception:
+            pass
+    n0 = store.nrows  # ONE capture: blocks cover a consistent prefix
+    k = min(nworkers, max(n0 // max(min_rows // 2, 1), 1))
+    if k <= 1:
+        return None
+    bounds = [
+        (n0 * i // k, n0 * (i + 1) // k) for i in range(k)
+    ]
+    parts: list = [None] * k
+    errors: list = []
+
+    def worker(i):
+        try:
+            ex = LocalExecutor(
+                catalog, stores, snapshot_ts,
+                remote_inputs=remote_inputs,
+                subquery_values=subquery_values,
+            )
+            ex.scan_block = bounds[i]
+            parts[i] = ex.run_plan(plan)
+        except Exception as e:
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(k)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    if errors:
+        raise errors[0]
+    from opentenbase_tpu.executor.dist import concat_batches
+
+    merged_in = concat_batches(parts)
+    ngroups = len(agg.group_exprs)
+    merge_groups = tuple(
+        E.Col(i, agg.schema[i].type) for i in range(ngroups)
+    )
+    merge_aggs = tuple(
+        E.AggCall(
+            _BLOCK_MERGE_FUNC[a.func],
+            E.Col(ngroups + i, agg.schema[ngroups + i].type),
+            False,
+            agg.schema[ngroups + i].type,
+        )
+        for i, a in enumerate(agg.aggs)
+    )
+    src = RemoteSource(fragment=-1, schema=tuple(agg.schema))
+    merge_plan = L.Aggregate(
+        src, merge_groups, merge_aggs, tuple(agg.schema)
+    )
+    ex = LocalExecutor(
+        catalog, {}, None, remote_inputs={-1: merged_in},
+        subquery_values=subquery_values,
+    )
+    return ex.run_plan(merge_plan)

@@ -18,6 +18,21 @@ import threading
 from contextlib import contextmanager
 
 
+@contextmanager
+def parked(lock):
+    """Release whatever statement-lock slot the current thread holds
+    for the duration of the block (no-op for locks without parking) —
+    THE one home for the park/reacquire protocol."""
+    tok = (
+        lock.park_release() if hasattr(lock, "park_release") else None
+    )
+    try:
+        yield
+    finally:
+        if tok is not None:
+            lock.park_reacquire(tok)
+
+
 class RWStatementLock:
     def __init__(self):
         self._w = threading.RLock()

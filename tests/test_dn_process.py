@@ -330,3 +330,115 @@ def test_peer_exchange_data_plane(topology, monkeypatch):
         for ch in c.dn_channels.values()
     ]
     assert sum(st.get("exch_parts_in", 0) for st in stats) >= 2, stats
+
+
+@pytest.fixture()
+def par_topology(tmp_path, monkeypatch):
+    """Like ``topology`` but DN children get a tiny parallel-threshold
+    env so within-fragment workers engage on test-sized tables."""
+    monkeypatch.setenv("OTB_DN_PARALLEL_MIN_ROWS", "50")
+    cn_dir = str(tmp_path / "cn")
+    c = Cluster(num_datanodes=2, shard_groups=32, data_dir=cn_dir)
+    s = c.session()
+    s.execute(
+        "create table t (k bigint, v numeric(10,2), tag text) "
+        "distribute by shard(k)"
+    )
+    rng = np.random.default_rng(4)
+    rows = ",".join(
+        f"({i}, {i}.25, '{w}')"
+        for i, w in zip(range(500), rng.choice(["x", "y", "z"], 500))
+    )
+    s.execute(f"insert into t values {rows}")
+    sender = WalSender(c.persistence)
+    procs = []
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    try:
+        for node in (0, 1):
+            p = subprocess.Popen(
+                [
+                    sys.executable, "-m", "opentenbase_tpu.dn.server",
+                    "--data-dir", str(tmp_path / f"dn{node}"),
+                    "--wal-host", sender.host,
+                    "--wal-port", str(sender.port),
+                    "--num-datanodes", "2",
+                    "--shard-groups", "32",
+                ],
+                stdout=subprocess.PIPE, text=True, env=env,
+            )
+            procs.append(p)
+            line = p.stdout.readline().strip()
+            assert line.startswith("READY "), line
+            c.attach_datanode(
+                node, "127.0.0.1", int(line.split()[1]),
+                pool_size=2, rpc_timeout=300,
+            )
+        yield c, s
+    finally:
+        for node in (0, 1):
+            try:
+                c.detach_datanode(node)
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                if p.poll() is None:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait(timeout=5)
+            except Exception:
+                pass
+        try:
+            sender.stop()
+        except Exception:
+            pass
+        c.close()
+
+
+def test_parallel_fragment_matches_serial(par_topology):
+    """Within-fragment scan workers (execParallel.c analog): the same
+    fragment split over K blocks + merge must answer exactly like the
+    serial path, and the DNs must report parallel executions."""
+    from opentenbase_tpu.executor.dist import DistExecutor
+    from opentenbase_tpu.plan.analyze import analyze_statement
+    from opentenbase_tpu.plan.distribute import distribute_statement
+    from opentenbase_tpu.plan.optimize import optimize_statement
+    from opentenbase_tpu.sql.parser import parse
+
+    c, s = par_topology
+    s.execute("set enable_fused_execution = off")
+    qs = [
+        "select count(*), sum(v), min(v), max(v) from t "
+        "where k < 400",
+        "select tag, count(*), sum(v) from t group by tag "
+        "order by tag",
+    ]
+    for q in qs:
+        want = _fragments_ran_remotely(s, q).to_rows()
+        sp = optimize_statement(
+            analyze_statement(parse(q)[0], c.catalog), c.catalog
+        )
+        dp = distribute_statement(sp, c.catalog)
+        ex = DistExecutor(
+            c.catalog, c.stores, c.gts.snapshot_ts(),
+            dn_channels=c.dn_channels,
+            min_lsn=c.persistence.wal.position,
+            parallel_workers=4,
+        )
+        got = ex.run(dp).to_rows()
+        assert sorted(got) == sorted(want), (q, got, want)
+    stats = [
+        ch.rpc({"op": "ping"})["dml_stats"]
+        for ch in c.dn_channels.values()
+    ]
+    assert sum(
+        st.get("parallel_fragments", 0) for st in stats
+    ) >= 1, stats
