@@ -25,6 +25,9 @@ def main(argv=None) -> int:
     ap.add_argument("--gts", choices=["python", "native"], default="python")
     ap.add_argument("--wal-port", type=int, default=None,
                     help="serve the WAL stream for standbys (walsender)")
+    ap.add_argument("--pg-port", type=int, default=None,
+                    help="also listen for PostgreSQL v3-protocol "
+                         "clients (psql/libpq/JDBC) on this port")
     args = ap.parse_args(argv)
 
     from opentenbase_tpu.engine import Cluster
@@ -45,6 +48,12 @@ def main(argv=None) -> int:
             gts_backend=args.gts,
         )
     server = ClusterServer(cluster, args.host, args.port).start()
+    pgsrv = None
+    if args.pg_port is not None:
+        from opentenbase_tpu.net.pgwire import PgWireServer
+
+        pgsrv = PgWireServer(cluster, args.host, args.pg_port).start()
+        print(f"pg wire on {pgsrv.host}:{pgsrv.port}", flush=True)
     sender = None
     if args.wal_port is not None:
         from opentenbase_tpu.storage.replication import WalSender
@@ -64,6 +73,8 @@ def main(argv=None) -> int:
     done.wait()
     if sender is not None:
         sender.stop()
+    if pgsrv is not None:
+        pgsrv.stop()
     server.stop()
     cluster.close()
     return 0

@@ -3491,8 +3491,11 @@ class Session:
         else:
             # hand over everything the source node owns
             moved_set = set(int(s) for s in sm.shards_on_node(from_node))
+        from opentenbase_tpu.storage.table import INF_TS
+
         nmoved = 0
         vacuum_srcs = []
+        copied = []  # (meta, src, dst, idx, dst_start, move_cts)
         lock = self.cluster._exec_lock
         # one rebalance at a time: overlapping moves would double-copy
         # rows and tear each other's barrier accounting down mid-flight
@@ -3545,8 +3548,18 @@ class Session:
                     ShardStore(meta.schema, meta.dictionaries),
                 )
                 commit_ts = self.cluster.gts.get_gts()
+                # a concurrent DELETE may have stamped some of these
+                # rows between the live mask and here; capture those
+                # stamps BEFORE ours overwrites them so the dst copies
+                # don't resurrect deleted rows
+                pre_xmax = src.xmax_ts[idx].copy()
                 ds, de = dst.append_batch(batch, commit_ts)
                 src.stamp_xmax(idx, commit_ts)
+                for pos in np.nonzero(pre_xmax < INF_TS)[0]:
+                    dst.stamp_xmax(
+                        np.array([ds + pos]), int(pre_xmax[pos])
+                    )
+                copied.append((meta, src, dst, idx, ds, commit_ts))
                 p = self.cluster.persistence
                 if p is not None:
                     # log the move as one delete+insert frame so PITR
@@ -3578,6 +3591,19 @@ class Session:
                     # sessions take no statement lock). Still-open
                     # embedded transactions at this point remain the
                     # documented out-of-contract case.
+                    # (a) late DELETEs/UPDATE-deletes: a deleter's
+                    # stamp OVERWROTE our move commit_ts on the source
+                    # copy — propagate it to the destination copy so
+                    # the row doesn't resurrect post-flip (durable via
+                    # the checkpoint below)
+                    for meta, src, dst, idx, ds, cts in copied:
+                        cur = src.xmax_ts[idx]
+                        for pos in np.nonzero(cur != cts)[0]:
+                            dst.stamp_xmax(
+                                np.array([ds + int(pos)]),
+                                int(cur[pos]),
+                            )
+                    # (b) late INSERTs
                     snap2 = self.cluster.gts.get_gts()
                     for meta in [
                         self.cluster.catalog.get(n)

@@ -19,8 +19,10 @@ from opentenbase_tpu.engine import Cluster
 from opentenbase_tpu.storage.replication import WalSender
 
 
-@pytest.fixture()
-def topology(tmp_path):
+def _topology_impl(tmp_path, extra_env=None):
+    """ONE spawn/teardown implementation shared by every topology
+    fixture — the round-4 orphaned-children fix and the axon
+    hermeticity pop must never fork into divergent copies."""
     cn_dir = str(tmp_path / "cn")
     c = Cluster(num_datanodes=2, shard_groups=32, data_dir=cn_dir)
     s = c.session()
@@ -45,6 +47,7 @@ def topology(tmp_path):
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    env.update(extra_env or {})
     try:
         for node in (0, 1):
             p = subprocess.Popen(
@@ -92,6 +95,11 @@ def topology(tmp_path):
         except Exception:
             pass
         c.close()
+
+
+@pytest.fixture()
+def topology(tmp_path):
+    yield from _topology_impl(tmp_path)
 
 
 def _fragments_ran_remotely(s, q):
@@ -333,74 +341,12 @@ def test_peer_exchange_data_plane(topology, monkeypatch):
 
 
 @pytest.fixture()
-def par_topology(tmp_path, monkeypatch):
+def par_topology(tmp_path):
     """Like ``topology`` but DN children get a tiny parallel-threshold
     env so within-fragment workers engage on test-sized tables."""
-    monkeypatch.setenv("OTB_DN_PARALLEL_MIN_ROWS", "50")
-    cn_dir = str(tmp_path / "cn")
-    c = Cluster(num_datanodes=2, shard_groups=32, data_dir=cn_dir)
-    s = c.session()
-    s.execute(
-        "create table t (k bigint, v numeric(10,2), tag text) "
-        "distribute by shard(k)"
+    yield from _topology_impl(
+        tmp_path, extra_env={"OTB_DN_PARALLEL_MIN_ROWS": "50"}
     )
-    rng = np.random.default_rng(4)
-    rows = ",".join(
-        f"({i}, {i}.25, '{w}')"
-        for i, w in zip(range(500), rng.choice(["x", "y", "z"], 500))
-    )
-    s.execute(f"insert into t values {rows}")
-    sender = WalSender(c.persistence)
-    procs = []
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.setdefault(
-        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
-    )
-    try:
-        for node in (0, 1):
-            p = subprocess.Popen(
-                [
-                    sys.executable, "-m", "opentenbase_tpu.dn.server",
-                    "--data-dir", str(tmp_path / f"dn{node}"),
-                    "--wal-host", sender.host,
-                    "--wal-port", str(sender.port),
-                    "--num-datanodes", "2",
-                    "--shard-groups", "32",
-                ],
-                stdout=subprocess.PIPE, text=True, env=env,
-            )
-            procs.append(p)
-            line = p.stdout.readline().strip()
-            assert line.startswith("READY "), line
-            c.attach_datanode(
-                node, "127.0.0.1", int(line.split()[1]),
-                pool_size=2, rpc_timeout=300,
-            )
-        yield c, s
-    finally:
-        for node in (0, 1):
-            try:
-                c.detach_datanode(node)
-            except Exception:
-                pass
-        for p in procs:
-            try:
-                if p.poll() is None:
-                    p.terminate()
-                    try:
-                        p.wait(timeout=5)
-                    except subprocess.TimeoutExpired:
-                        p.kill()
-                        p.wait(timeout=5)
-            except Exception:
-                pass
-        try:
-            sender.stop()
-        except Exception:
-            pass
-        c.close()
 
 
 def test_parallel_fragment_matches_serial(par_topology):

@@ -1,0 +1,371 @@
+"""PostgreSQL FE/BE v3 wire protocol front end (net/pgwire.py,
+VERDICT r4 missing-5): a from-scratch byte-level v3 client — speaking
+ONLY the documented protocol (startup, SASL SCRAM-SHA-256 per RFC
+5802, simple + extended query flows) — must interoperate, proving any
+libpq-compatible driver could."""
+
+import base64
+import hashlib
+import hmac
+import socket
+import struct
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.net.pgwire import PgWireServer
+
+
+class V3Client:
+    """Minimal strict protocol-v3 client (the libpq stand-in)."""
+
+    def __init__(self, host, port, user="app", password=None):
+        self.sock = socket.create_connection((host, port), timeout=30)
+        self.user = user
+        self.password = password
+        self.params = {}
+        self._startup()
+
+    def close(self):
+        self._send(b"X", b"")
+        self.sock.close()
+
+    # -- framing ---------------------------------------------------------
+    def _send(self, tag: bytes, body: bytes):
+        self.sock.sendall(tag + struct.pack("!I", len(body) + 4) + body)
+
+    def _read_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            c = self.sock.recv(n - len(buf))
+            assert c, "server closed connection"
+            buf += c
+        return buf
+
+    def _recv(self):
+        tag = self._read_exact(1)
+        (ln,) = struct.unpack("!I", self._read_exact(4))
+        return tag, self._read_exact(ln - 4)
+
+    # -- startup + auth ---------------------------------------------------
+    def _startup(self):
+        body = struct.pack("!I", 196608)
+        body += b"user\0" + self.user.encode() + b"\0"
+        body += b"database\0postgres\0\0"
+        self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        while True:
+            tag, payload = self._recv()
+            if tag == b"R":
+                (code,) = struct.unpack("!I", payload[:4])
+                if code == 0:
+                    continue
+                if code == 10:
+                    self._scram(payload[4:])
+                    continue
+                raise AssertionError(f"unexpected auth code {code}")
+            if tag == b"S":
+                k, v, _ = payload.split(b"\0", 2)
+                self.params[k.decode()] = v.decode()
+            elif tag == b"K":
+                pass
+            elif tag == b"Z":
+                self.txn_status = payload
+                return
+            elif tag == b"E":
+                raise AssertionError(f"server error: {payload!r}")
+
+    def _scram(self, mechs: bytes):
+        assert b"SCRAM-SHA-256" in mechs
+        cnonce = "clientnonce123"
+        bare = f"n={self.user},r={cnonce}"
+        first = "n,," + bare
+        body = (
+            b"SCRAM-SHA-256\0"
+            + struct.pack("!i", len(first))
+            + first.encode()
+        )
+        self._send(b"p", body)
+        tag, payload = self._recv()
+        if tag == b"E":
+            raise AssertionError(f"auth failed: {payload!r}")
+        assert tag == b"R"
+        (code,) = struct.unpack("!I", payload[:4])
+        assert code == 11, code
+        server_first = payload[4:].decode()
+        f = dict(
+            x.split("=", 1) for x in server_first.split(",") if "=" in x
+        )
+        nonce, salt, iters = f["r"], f["s"], int(f["i"])
+        assert nonce.startswith(cnonce)
+        salted = hashlib.pbkdf2_hmac(
+            "sha256", self.password.encode(),
+            base64.b64decode(salt), iters,
+        )
+        client_key = hmac.new(
+            salted, b"Client Key", hashlib.sha256
+        ).digest()
+        stored = hashlib.sha256(client_key).digest()
+        without_proof = f"c=biws,r={nonce}"
+        auth_msg = f"{bare},{server_first},{without_proof}".encode()
+        sig = hmac.new(stored, auth_msg, hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, sig))
+        final = (
+            without_proof + ",p=" + base64.b64encode(proof).decode()
+        )
+        self._send(b"p", final.encode())
+        tag, payload = self._recv()
+        if tag == b"E":
+            raise AssertionError(f"auth failed: {payload!r}")
+        assert tag == b"R"
+        (code,) = struct.unpack("!I", payload[:4])
+        assert code == 12, code
+        # verify the server signature (mutual auth)
+        server_key = hmac.new(
+            salted, b"Server Key", hashlib.sha256
+        ).digest()
+        want = base64.b64encode(
+            hmac.new(server_key, auth_msg, hashlib.sha256).digest()
+        )
+        assert payload[4:] == b"v=" + want
+
+    # -- simple query -----------------------------------------------------
+    def query(self, sql: str):
+        self._send(b"Q", sql.encode() + b"\0")
+        cols, rows, tag_str, err = None, [], None, None
+        while True:
+            tag, payload = self._recv()
+            if tag == b"T":
+                (n,) = struct.unpack("!H", payload[:2])
+                cols, off = [], 2
+                for _ in range(n):
+                    end = payload.index(b"\0", off)
+                    name = payload[off:end].decode()
+                    off = end + 1 + 18
+                    cols.append(name)
+            elif tag == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off, row = 2, []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from("!i", payload, off)
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif tag == b"C":
+                tag_str = payload.rstrip(b"\0").decode()
+            elif tag == b"E":
+                err = payload
+            elif tag == b"Z":
+                self.txn_status = payload
+                if err is not None:
+                    raise RuntimeError(err.decode(errors="replace"))
+                return cols, rows, tag_str
+            elif tag == b"I":
+                tag_str = ""
+
+    # -- extended protocol ------------------------------------------------
+    def extended(self, sql: str, args=()):
+        self._send(
+            b"P", b"\0" + sql.encode() + b"\0" + struct.pack("!H", 0)
+        )
+        pvals = b""
+        for a in args:
+            s = str(a).encode()
+            pvals += struct.pack("!i", len(s)) + s
+        self._send(
+            b"B",
+            b"\0\0" + struct.pack("!H", 0)
+            + struct.pack("!H", len(args)) + pvals
+            + struct.pack("!H", 0),
+        )
+        self._send(b"E", b"\0" + struct.pack("!i", 0))
+        self._send(b"S", b"")
+        rows, err = [], None
+        while True:
+            tag, payload = self._recv()
+            if tag == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off, row = 2, []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from("!i", payload, off)
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif tag == b"E":
+                err = payload
+            elif tag == b"Z":
+                if err is not None:
+                    raise RuntimeError(err.decode(errors="replace"))
+                return rows
+
+
+@pytest.fixture()
+def pgsrv():
+    c = Cluster(num_datanodes=2, shard_groups=32)
+    srv = PgWireServer(c).start()
+    yield c, srv
+    srv.stop()
+
+
+def test_simple_query_roundtrip(pgsrv):
+    c, srv = pgsrv
+    cl = V3Client(srv.host, srv.port)
+    try:
+        _, _, tag = cl.query(
+            "create table t (k bigint, v text, amount decimal(10,2)) "
+            "distribute by shard(k)"
+        )
+        assert tag == "CREATE TABLE"
+        _, _, tag = cl.query(
+            "insert into t values (1,'héllo',12.34),(2,null,null)"
+        )
+        assert tag == "INSERT 0 2"
+        cols, rows, tag = cl.query(
+            "select k, v, amount from t order by k"
+        )
+        assert cols == ["k", "v", "amount"]
+        assert tag == "SELECT 2"
+        assert rows[0] == ("1", "héllo", "12.34")
+        assert rows[1][1] is None and rows[1][2] is None
+    finally:
+        cl.close()
+
+
+def test_errors_recover_and_txn_status(pgsrv):
+    c, srv = pgsrv
+    cl = V3Client(srv.host, srv.port)
+    try:
+        with pytest.raises(RuntimeError):
+            cl.query("select * from missing_table")
+        # connection still serves statements after the error
+        _, rows, _ = cl.query("select 1 + 1")
+        assert rows == [("2",)]
+        cl.query("begin")
+        assert cl.txn_status == b"T"
+        cl.query("rollback")
+        assert cl.txn_status == b"I"
+    finally:
+        cl.close()
+
+
+def test_extended_protocol_params(pgsrv):
+    c, srv = pgsrv
+    cl = V3Client(srv.host, srv.port)
+    try:
+        cl.query(
+            "create table p (k bigint, w text) distribute by shard(k)"
+        )
+        cl.query("insert into p values (1,'a'),(2,'b'),(3,'c')")
+        rows = cl.extended(
+            "select w from p where k = $1", args=(2,)
+        )
+        assert rows == [("b",)]
+        # error inside the extended flow recovers at Sync
+        with pytest.raises(RuntimeError):
+            cl.extended("select * from nope", args=())
+        rows = cl.extended("select count(*) from p", args=())
+        assert rows == [("3",)]
+    finally:
+        cl.close()
+
+
+def test_scram_auth_over_pg_wire(pgsrv):
+    c, srv = pgsrv
+    c.session().execute("create user app with password 'sekrit'")
+    cl = V3Client(srv.host, srv.port, user="app", password="sekrit")
+    try:
+        _, rows, _ = cl.query("select 40 + 2")
+        assert rows == [("42",)]
+    finally:
+        cl.close()
+    with pytest.raises(AssertionError):
+        V3Client(srv.host, srv.port, user="app", password="wrong")
+    with pytest.raises(AssertionError):
+        V3Client(srv.host, srv.port, user="ghost", password="x")
+
+
+def test_ssl_request_refused_cleanly(pgsrv):
+    c, srv = pgsrv
+    s = socket.create_connection((srv.host, srv.port), timeout=10)
+    s.sendall(struct.pack("!II", 8, 80877103))  # SSLRequest
+    assert s.recv(1) == b"N"
+    # client proceeds in cleartext per libpq behavior
+    body = struct.pack("!I", 196608) + b"user\0x\0\0"
+    s.sendall(struct.pack("!I", len(body) + 4) + body)
+    tag = s.recv(1)
+    assert tag == b"R"
+    s.close()
+
+
+def test_param_oids_honored(pgsrv):
+    """A parameter declared text in Parse must stay a string even when
+    it looks numeric (JDBC setString(1, '007'))."""
+    c, srv = pgsrv
+    cl = V3Client(srv.host, srv.port)
+    try:
+        cl.query(
+            "create table tags (k bigint, tag text) "
+            "distribute by shard(k)"
+        )
+        # Parse with an explicit text OID (25) for $2
+        sql = "insert into tags values ($1, $2)"
+        body = b"\0" + sql.encode() + b"\0" + struct.pack("!HII", 2, 20, 25)
+        cl._send(b"P", body)
+        pvals = b""
+        for a in ("1", "007"):
+            s = str(a).encode()
+            pvals += struct.pack("!i", len(s)) + s
+        cl._send(
+            b"B",
+            b"\0\0" + struct.pack("!H", 0)
+            + struct.pack("!H", 2) + pvals + struct.pack("!H", 0),
+        )
+        cl._send(b"E", b"\0" + struct.pack("!i", 0))
+        cl._send(b"S", b"")
+        err = None
+        while True:
+            tag, payload = cl._recv()
+            if tag == b"E":
+                err = payload
+            if tag == b"Z":
+                break
+        assert err is None, err
+        _, rows, _ = cl.query("select tag from tags where k = 1")
+        assert rows == [("007",)]
+    finally:
+        cl.close()
+
+
+def test_binary_result_format_rejected(pgsrv):
+    c, srv = pgsrv
+    cl = V3Client(srv.host, srv.port)
+    try:
+        cl._send(b"P", b"\0select 1\0" + struct.pack("!H", 0))
+        cl._send(
+            b"B",
+            b"\0\0" + struct.pack("!H", 0) + struct.pack("!H", 0)
+            + struct.pack("!Hh", 1, 1),  # ONE binary result column
+        )
+        cl._send(b"E", b"\0" + struct.pack("!i", 0))
+        cl._send(b"S", b"")
+        saw_error = False
+        while True:
+            tag, payload = cl._recv()
+            if tag == b"E":
+                saw_error = True
+                assert b"binary result format" in payload
+            if tag == b"Z":
+                break
+        assert saw_error
+        # connection recovers
+        _, rows, _ = cl.query("select 7")
+        assert rows == [("7",)]
+    finally:
+        cl.close()
