@@ -916,8 +916,6 @@ class LocalExecutor:
             return DevBatch(schema, probe.cols, keep, probe.n)
 
         outer = jt in ("left", "full")
-        if jt == "full":
-            raise ExecError("FULL OUTER JOIN not yet supported")
         tot = int(total)
         if outer:
             # every zero-count probe lane emits one null-extended row on
@@ -955,12 +953,72 @@ class LocalExecutor:
             )
             d, v = fns[0](out.cols, params)
             keep = d if v is None else (d & v)
-            if jt == "left":
+            if jt in ("left", "full"):
                 # residual only filters matched rows; unmatched stay
                 keep = keep | ~matched
             out = DevBatch(
                 plan.schema, out.cols, out.mask & keep, out.n
             )
+
+        if jt == "full":
+            # the probe side's unmatched rows are already null-extended
+            # (outer=True above); append the unmatched BUILD rows with
+            # a null-extended probe side — the full-join second half
+            # (nodeHashjoin.c's HJ_FILL_INNER pass over unmatched
+            # build-bucket tuples)
+            _bo2, _lo2, counts_b, _t2 = join_ops.match_counts(
+                probe_ids, build_ids
+            )
+            un_b = counts_b == 0
+            if build.mask is not None:
+                un_b = un_b & build.mask
+            seg_p = [
+                (
+                    jnp.zeros((build.n,), data.dtype),
+                    jnp.zeros(build.n, jnp.bool_),
+                )
+                for data, _v in probe.cols
+            ]
+            seg_b = [
+                (
+                    data,
+                    jnp.ones(build.n, jnp.bool_) if v is None else v,
+                )
+                for data, v in build.cols
+            ]
+            seg_cols = (
+                seg_b + seg_p if flipped else seg_p + seg_b
+            )
+            new_n = filt_ops.bucket_size(out.n + build.n)
+
+            def cat(a, n_a, b, n_b):
+                return _pad_dev(
+                    jnp.concatenate([a[:n_a], b[:n_b]]), new_n
+                )
+
+            cols2 = []
+            for (da, va), (db, vb) in zip(out.cols, seg_cols):
+                d2 = cat(da, out.n, db, build.n)
+                if va is None and vb is None:
+                    v2 = None
+                else:
+                    v2 = cat(
+                        jnp.ones(out.n, jnp.bool_) if va is None
+                        else va,
+                        out.n,
+                        jnp.ones(build.n, jnp.bool_) if vb is None
+                        else vb,
+                        build.n,
+                    )
+                cols2.append((d2, v2))
+            m2 = cat(
+                jnp.ones(out.n, jnp.bool_) if out.mask is None
+                else out.mask,
+                out.n,
+                un_b,
+                build.n,
+            )
+            out = DevBatch(plan.schema, cols2, m2, new_n)
         return out
 
     # -- union -------------------------------------------------------------

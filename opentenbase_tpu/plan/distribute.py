@@ -422,7 +422,12 @@ class Distributor:
         out_key_positions = self._join_out_keys(plan, ldist, jt)
 
         # replicated inner side: join runs where the outer side lives
-        if rdist.kind == "replicated" and ldist.kind == "sharded":
+        # (not FULL: each node would emit the replica's unmatched rows
+        # once per left shard)
+        if (
+            rdist.kind == "replicated" and ldist.kind == "sharded"
+            and jt != "full"
+        ):
             if set(ldist.nodes) <= set(rdist.nodes):
                 return rebuild(left, right), Dist.sharded(
                     ldist.nodes, ldist.strategy, out_key_positions
@@ -507,13 +512,22 @@ class Distributor:
             else (ldist.nodes if ldist.kind == "sharded" else rdist.nodes)
         )
 
-        lsrc = self._motion_by_keys(left, ldist, plan.left_keys, dest)
-        rsrc = self._motion_by_keys(right, rdist, plan.right_keys, dest)
+        lsrc = self._motion_by_keys(
+            left, ldist, plan.left_keys, dest, force=(jt == "full")
+        )
+        rsrc = self._motion_by_keys(
+            right, rdist, plan.right_keys, dest, force=(jt == "full")
+        )
         return rebuild(lsrc, rsrc), Dist.sharded(dest, DistStrategy.HASH, ())
 
     def _join_out_keys(self, plan: L.Join, ldist: Dist, jt: str):
         """Left-side key positions survive into the join output (left
-        columns come first; semi/anti output only left columns)."""
+        columns come first; semi/anti output only left columns). A
+        FULL join null-extends the left side for unmatched right rows,
+        so its output is NOT distributed by the left key — downstream
+        dist-key shortcuts (grouping, FQS) must not assume it."""
+        if jt == "full":
+            return ()
         if ldist.kind != "sharded" or not ldist.key_positions:
             return ()
         return ldist.key_positions
@@ -537,8 +551,12 @@ class Distributor:
         want = list(zip(ldist.key_positions, rdist.key_positions))
         return all(p in pairs for p in want)
 
-    def _motion_by_keys(self, plan, dist, keys, dest):
-        """Redistribute ``plan`` by hash of join ``keys`` onto ``dest``."""
+    def _motion_by_keys(self, plan, dist, keys, dest, force=False):
+        """Redistribute ``plan`` by hash of join ``keys`` onto ``dest``.
+        ``force`` redistributes even a replicated input — required for
+        FULL joins, where an in-place replica would emit its unmatched
+        rows once per dest node."""
+        src_override = None
         if (
             dist.kind == "sharded"
             and dist.strategy == DistStrategy.HASH
@@ -551,8 +569,11 @@ class Distributor:
         ):
             return plan  # already hash-placed on these keys
         if dist.kind == "replicated":
-            if set(dest) <= set(dist.nodes):
+            if not force and set(dest) <= set(dist.nodes):
                 return plan
+            # one replica is the truth: produce from a single node so
+            # every row redistributes exactly once
+            src_override = tuple(dist.nodes[:1])
         # ensure keys are plain output columns; append via Project if not
         positions = []
         exprs = None
@@ -575,7 +596,9 @@ class Distributor:
             )
             src_plan = L.Project(plan, proj_exprs, proj_schema)
             positions = [n + i for i in range(len(keys))]
-        src_nodes = dist.nodes if dist.kind != "single" else dist.nodes
+        src_nodes = (
+            src_override if src_override is not None else dist.nodes
+        )
         rs = self._cut(
             src_plan,
             src_nodes,
