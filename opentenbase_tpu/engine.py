@@ -214,6 +214,10 @@ class Cluster:
         else:
             gts_store = os.path.join(data_dir, "gts.json") if data_dir else None
             self.gts = GTSServer(gts_store)
+        # announce the topology to the GTM (register_gtm.c: every
+        # coordinator/datanode registers at startup; CREATE/DROP NODE
+        # keeps the registry current)
+        self._gtm_register_all()
         # node mesh index -> table name -> ShardStore
         self.stores: dict[int, dict[str, ShardStore]] = {
             i: {} for i in self.nodes.datanode_indices()
@@ -689,6 +693,33 @@ class Cluster:
             t.join(timeout=5)
 
         return stopper
+
+    # -- GTM node registration (recovery/register_gtm.c) -----------------
+    def _gtm_register_all(self) -> None:
+        """Register every catalog node with the GTM service (best
+        effort: an older native GTS build without the ops must not
+        block startup)."""
+        reg = getattr(self.gts, "register_node", None)
+        if reg is None:
+            return
+        for node in self.nodes.all_nodes():
+            try:  # per-node: one failure must not skip the rest
+                reg(
+                    node.name, node.role.value,
+                    getattr(node, "host", "") or "",
+                    getattr(node, "port", 0) or 0,
+                )
+            except Exception:
+                pass
+
+    def gtm_registered_nodes(self) -> dict:
+        fn = getattr(self.gts, "registered_nodes", None)
+        if fn is None:
+            return {}
+        try:
+            return fn()
+        except Exception:
+            return {}
 
     # -- commit-stamp snapshot fencing ----------------------------------
     # Readers overlap table-granular writers since round 4; a commit's
@@ -3336,6 +3367,13 @@ class Session:
         self.cluster.nodes.create_node(node)
         if role == NodeRole.DATANODE:
             self.cluster.stores[node.mesh_index] = {}
+        reg = getattr(self.cluster.gts, "register_node", None)
+        if reg is not None:
+            try:  # register_gtm.c: new nodes announce themselves
+                reg(node.name, role.value, stmt.host or "",
+                    stmt.port or 0)
+            except Exception:
+                pass
         if self.cluster.persistence is not None:
             self.cluster.persistence.log_ddl(
                 {"op": "create_node", "name": node.name,
@@ -3360,6 +3398,12 @@ class Session:
             self.cluster.stores.pop(node.mesh_index, None)
         else:
             self.cluster.nodes.drop_node(stmt.name)
+        unreg = getattr(self.cluster.gts, "unregister_node", None)
+        if unreg is not None:
+            try:
+                unreg(stmt.name)
+            except Exception:
+                pass
         if self.cluster.persistence is not None:
             self.cluster.persistence.log_ddl(
                 {"op": "drop_node", "name": stmt.name}
@@ -4171,6 +4215,18 @@ def _sv_pallas(c: Cluster):
     return rows
 
 
+def _sv_gtm_nodes(c: Cluster):
+    """The GTM's node registry (register_gtm.c's registry, the
+    pgxc_node view of who announced themselves)."""
+    return [
+        (
+            name, d.get("kind", ""), d.get("host", ""),
+            int(d.get("port", 0)), d.get("status", "connected"),
+        )
+        for name, d in sorted(c.gtm_registered_nodes().items())
+    ]
+
+
 def _sv_dml(c: Cluster):
     """Shipped-DML observability (VERDICT r4 weak-4: the text-table
     fallback was invisible): how many multi-node commits shipped their
@@ -4446,6 +4502,16 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
     "pg_stat_dml": (
         {"stat": t.TEXT, "value": t.INT8},
         _sv_dml,
+    ),
+    "pgxc_gtm_nodes": (
+        {
+            "node_name": t.TEXT,
+            "kind": t.TEXT,
+            "host": t.TEXT,
+            "port": t.INT4,
+            "status": t.TEXT,
+        },
+        _sv_gtm_nodes,
     ),
 }
 

@@ -39,6 +39,16 @@ OP_SEQ_DROP = 0x0A
 OP_SEQ_SET = 0x0B
 OP_SNAPSHOT = 0x0C
 OP_PING = 0x0D
+# node registration (recovery/register_gtm.c): length-prefixed strings
+# so the native C++ server implements the same ops without JSON
+OP_NODE_REGISTER = 0x0E
+OP_NODE_UNREGISTER = 0x0F
+OP_NODE_LIST = 0x10
+
+
+def _lp(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("<H", len(b)) + b
 
 
 def build_server(build_dir: str) -> str:
@@ -229,6 +239,39 @@ class NativeGTS:
 
     def txn(self, gxid: int) -> Optional[TxnInfo]:
         return self._txns.get(gxid)
+
+    # -- node registration (register_gtm.c client side) -------------------
+    def register_node(
+        self, name: str, kind: str, host: str = "", port: int = 0,
+    ) -> None:
+        self._rpc(
+            OP_NODE_REGISTER,
+            _lp(name) + _lp(kind) + _lp(host)
+            + struct.pack("<i", int(port)),
+        )
+
+    def unregister_node(self, name: str) -> bool:
+        return self._rpc(OP_NODE_UNREGISTER, _lp(name)) == b"\x01"
+
+    def registered_nodes(self) -> dict:
+        body = self._rpc(OP_NODE_LIST)
+        (n,) = struct.unpack_from("<H", body, 0)
+        off = 2
+        out = {}
+        for _ in range(n):
+            rec = []
+            for _f in range(3):
+                (ln,) = struct.unpack_from("<H", body, off)
+                off += 2
+                rec.append(body[off:off + ln].decode())
+                off += ln
+            (port,) = struct.unpack_from("<i", body, off)
+            off += 4
+            out[rec[0]] = {
+                "kind": rec[1], "host": rec[2], "port": port,
+                "status": "connected",
+            }
+        return out
 
     # -- sequences -------------------------------------------------------
     def create_sequence(self, name: str, start: int = 1, increment: int = 1,

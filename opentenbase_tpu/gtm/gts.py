@@ -163,6 +163,55 @@ class GTSServer:
                         st["min_value"], st["max_value"], st["cycle"],
                     )
                     self._seq_durable[name] = st["next_value"]
+        # node registry (register_gtm.c: coordinators/datanodes/proxies
+        # announce themselves at startup; the registry survives GTM
+        # restart via the node file and replicates to standbys)
+        self._nodes: dict[str, dict] = {}
+        self._nodes_path = (
+            store_path + ".nodes" if store_path else None
+        )
+        if self._nodes_path and os.path.exists(self._nodes_path):
+            with open(self._nodes_path) as f:
+                self._nodes = json.load(f)
+
+    # -- node registration (recovery/register_gtm.c) --------------------
+    def _persist_nodes(self) -> None:
+        if self._nodes_path is None:
+            return
+        tmp = self._nodes_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._nodes, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._nodes_path)
+
+    def register_node(
+        self, name: str, kind: str, host: str = "", port: int = 0,
+    ) -> None:
+        """ProcessPGXCNodeRegister: a node announces itself. Re-register
+        of the same name updates its address (restart with a new
+        port)."""
+        with self._lock:
+            self._nodes[name] = {
+                "kind": kind, "host": host, "port": int(port),
+                "status": "connected",
+            }
+            self._persist_nodes()
+            self._rep("node_register", {"name": name,
+                                        **self._nodes[name]})
+
+    def unregister_node(self, name: str) -> bool:
+        """ProcessPGXCNodeUnregister."""
+        with self._lock:
+            existed = self._nodes.pop(name, None) is not None
+            if existed:
+                self._persist_nodes()
+                self._rep("node_unregister", {"name": name})
+            return existed
+
+    def registered_nodes(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in self._nodes.items()}
 
     def _persist_seqs(self) -> None:
         if self._seq_path is None:
@@ -366,5 +415,8 @@ class GTSServer:
                         "cycle": s.cycle,
                     }
                     for n, s in self._seqs.items()
+                },
+                "nodes": {
+                    k: dict(v) for k, v in self._nodes.items()
                 },
             }

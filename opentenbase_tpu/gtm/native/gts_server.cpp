@@ -277,6 +277,120 @@ class SeqStore {
 };
 
 // ---------------------------------------------------------------------------
+// Node registry (recovery/register_gtm.c): coordinators/datanodes
+// announce themselves; the registry survives restart via gts_nodes.
+// ---------------------------------------------------------------------------
+struct NodeRec {
+  std::string kind;
+  std::string host;
+  int32_t port = 0;
+};
+
+// Fields are %-escaped (%%, %t=tab, %n=newline) and tab-separated so
+// any byte sequence round-trips — a whitespace-bearing host must not
+// corrupt the registry on restart.
+static std::string node_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '%') out += "%%";
+    else if (c == '\t') out += "%t";
+    else if (c == '\n') out += "%n";
+    else out += c;
+  }
+  return out;
+}
+
+static std::string node_unescape(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '%' && i + 1 < s.size()) {
+      char c = s[++i];
+      out += c == 't' ? '\t' : c == 'n' ? '\n' : c;
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+class NodeRegistry {
+ public:
+  explicit NodeRegistry(const std::string& dir)
+      : path_(dir + "/gts_nodes") {
+    FILE* f = fopen(path_.c_str(), "r");
+    if (!f) return;
+    std::string line;
+    int ch;
+    while ((ch = fgetc(f)) != EOF) {
+      if (ch != '\n') {
+        line += (char)ch;
+        continue;
+      }
+      parse_line(line);
+      line.clear();
+    }
+    if (!line.empty()) parse_line(line);
+    fclose(f);
+  }
+
+  void put(const std::string& name, NodeRec rec) {
+    nodes_[name] = rec;
+    persist();
+  }
+
+  bool erase(const std::string& name) {
+    if (!nodes_.erase(name)) return false;
+    persist();
+    return true;
+  }
+
+  const std::map<std::string, NodeRec>& all() const { return nodes_; }
+
+ private:
+  void parse_line(const std::string& line) {
+    // name\tkind\thost\tport — malformed lines are skipped, never
+    // allowed to truncate the rest of the registry
+    std::vector<std::string> parts;
+    std::string cur;
+    for (char c : line) {
+      if (c == '\t') {
+        parts.push_back(cur);
+        cur.clear();
+      } else {
+        cur += c;
+      }
+    }
+    parts.push_back(cur);
+    if (parts.size() != 4) return;
+    NodeRec rec;
+    rec.kind = node_unescape(parts[1]);
+    rec.host = node_unescape(parts[2]);
+    rec.port = atoi(parts[3].c_str());
+    std::string name = node_unescape(parts[0]);
+    if (!name.empty()) nodes_[name] = rec;
+  }
+
+  void persist() {
+    std::string tmp = path_ + ".tmp";
+    FILE* f = fopen(tmp.c_str(), "w");
+    if (!f) return;
+    for (auto& kv : nodes_) {
+      fprintf(f, "%s\t%s\t%s\t%d\n",
+              node_escape(kv.first).c_str(),
+              node_escape(kv.second.kind).c_str(),
+              node_escape(kv.second.host).c_str(), kv.second.port);
+    }
+    fflush(f);
+    fsync(fileno(f));
+    fclose(f);
+    rename(tmp.c_str(), path_.c_str());
+  }
+
+  std::string path_;
+  std::map<std::string, NodeRec> nodes_;
+};
+
+// ---------------------------------------------------------------------------
 // Wire helpers
 // ---------------------------------------------------------------------------
 struct Reader {
@@ -326,7 +440,8 @@ struct Writer {
 class Server {
  public:
   Server(int port, const std::string& dir)
-      : clock_(dir), plog_(dir), seqstore_(dir), port_(port) {
+      : clock_(dir), plog_(dir), seqstore_(dir), nodes_(dir),
+        port_(port) {
     next_gxid_ = plog_.max_gxid() + 1;
   }
 
@@ -520,6 +635,35 @@ class Server {
       case 0x0D:  // PING
         w.put<uint8_t>(1);
         return reply(fd, 0, w);
+      case 0x0E: {  // NODE_REGISTER
+        std::string name = r.get_str();
+        NodeRec rec;
+        rec.kind = r.get_str();
+        rec.host = r.get_str();
+        rec.port = r.get<int32_t>();
+        if (!r.ok || name.empty()) return reply(fd, 1, w);
+        std::lock_guard<std::mutex> g(mu_);
+        nodes_.put(name, rec);
+        return reply(fd, 0, w);
+      }
+      case 0x0F: {  // NODE_UNREGISTER
+        std::string name = r.get_str();
+        std::lock_guard<std::mutex> g(mu_);
+        w.put<uint8_t>(nodes_.erase(name) ? 1 : 0);
+        return reply(fd, 0, w);
+      }
+      case 0x10: {  // NODE_LIST
+        std::lock_guard<std::mutex> g(mu_);
+        auto& all = nodes_.all();
+        w.put<uint16_t>((uint16_t)all.size());
+        for (auto& kv : all) {
+          w.put_str(kv.first);
+          w.put_str(kv.second.kind);
+          w.put_str(kv.second.host);
+          w.put<int32_t>(kv.second.port);
+        }
+        return reply(fd, 0, w);
+      }
       default:
         return reply(fd, 1, w);
     }
@@ -528,6 +672,7 @@ class Server {
   Clock clock_;
   PreparedLog plog_;
   SeqStore seqstore_;
+  NodeRegistry nodes_;
   std::mutex mu_;
   int64_t next_gxid_ = 1;
   int port_;

@@ -148,6 +148,32 @@ class GTSFrontend:
             (value,) = struct.unpack_from("<q", p, 2 + nl)
             g.setval(name, value)
             return b""
+        if op == C.OP_NODE_REGISTER:
+            off = 0
+            rec = []
+            for _f in range(3):
+                (ln,) = struct.unpack_from("<H", p, off)
+                off += 2
+                rec.append(p[off:off + ln].decode())
+                off += ln
+            (port,) = struct.unpack_from("<i", p, off)
+            if not rec[0]:
+                raise ValueError("empty node name")  # native parity
+            g.register_node(rec[0], rec[1], rec[2], port)
+            return b""
+        if op == C.OP_NODE_UNREGISTER:
+            (nl,) = struct.unpack_from("<H", p, 0)
+            name = p[2:2 + nl].decode()
+            return b"\x01" if g.unregister_node(name) else b"\x00"
+        if op == C.OP_NODE_LIST:
+            nodes = g.registered_nodes()
+            out = struct.pack("<H", len(nodes))
+            for name, d in sorted(nodes.items()):
+                for s in (name, d.get("kind", ""), d.get("host", "")):
+                    b = s.encode()
+                    out += struct.pack("<H", len(b)) + b
+                out += struct.pack("<i", int(d.get("port", 0)))
+            return out
         raise ValueError(f"unknown op {op:#x}")
 
     @staticmethod

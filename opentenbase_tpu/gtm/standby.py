@@ -50,6 +50,7 @@ class GTSStandby:
             p["gid"]: p for p in snapshot["prepared"]
         }
         self._seqs: dict[str, dict] = dict(snapshot["sequences"])
+        self._nodes: dict[str, dict] = dict(snapshot.get("nodes", {}))
         self.promoted: Optional[GTSServer] = None
 
     # -- feed ------------------------------------------------------------
@@ -91,6 +92,11 @@ class GTSStandby:
                     s["next_value"] = payload.get(
                         "next", payload.get("value")
                     )
+            elif event == "node_register":
+                p = dict(payload)
+                self._nodes[p.pop("name")] = p
+            elif event == "node_unregister":
+                self._nodes.pop(payload["name"], None)
 
     # -- failover --------------------------------------------------------
     def promote(self, store_path: Optional[str] = None) -> GTSServer:
@@ -126,6 +132,10 @@ class GTSStandby:
                 )
                 srv._seq_durable[name] = s["next_value"]
             srv._persist_seqs()
+            # the node registry survives failover (register_gtm.c's
+            # registry is part of the standby backup)
+            srv._nodes = {k: dict(v) for k, v in self._nodes.items()}
+            srv._persist_nodes()
             self.promoted = srv
             return srv
 

@@ -211,3 +211,41 @@ def test_concurrent_attach_under_load_no_deadlock_no_loss():
     expected = {p.gid for p in primary.prepared_txns()}
     for sb in standbys:
         assert {p.gid for p in sb.promote().prepared_txns()} == expected
+
+
+def test_node_registry_replicates_and_survives_promote():
+    """The node registry is part of the standby backup
+    (register_gtm.c + gtm_standby.c): registrations stream to the
+    standby and survive failover."""
+    from opentenbase_tpu.gtm.gts import GTSServer
+
+    primary = GTSServer()
+    primary.register_node("cn0", "coordinator")  # pre-attach state
+    link = ReplicationLink(primary)
+    sb = link.add_standby()
+    primary.register_node("dn0", "datanode", "hostA", 7777)
+    primary.register_node("dn1", "datanode")
+    primary.unregister_node("dn1")
+    promoted = sb.promote()
+    nodes = promoted.registered_nodes()
+    assert set(nodes) == {"cn0", "dn0"}, nodes
+    assert nodes["dn0"]["host"] == "hostA"
+
+
+def test_cluster_registers_topology_and_view():
+    from opentenbase_tpu.engine import Cluster
+
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    rows = s.query(
+        "select node_name, kind from pgxc_gtm_nodes order by node_name"
+    )
+    assert ("cn0", "coordinator") in rows
+    assert ("gtm0", "gtm") in rows
+    assert ("dn0", "datanode") in rows and ("dn1", "datanode") in rows
+    s.execute("create node dn9 with (type = 'datanode')")
+    rows = dict(s.query("select node_name, kind from pgxc_gtm_nodes"))
+    assert rows.get("dn9") == "datanode"
+    s.execute("drop node dn9")
+    rows = dict(s.query("select node_name, kind from pgxc_gtm_nodes"))
+    assert "dn9" not in rows

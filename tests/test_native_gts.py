@@ -135,3 +135,41 @@ def test_sequences_survive_restart(tmp_path):
     nxt, _ = client2.nextval("s1")
     assert nxt > first  # durable, and never reissued
     client2.close()
+
+
+def test_node_registration(gts, tmp_path):
+    """register_gtm.c: nodes announce themselves; the registry lists,
+    unregisters, and survives a GTM restart."""
+    gts.register_node("cn0", "coordinator", "10.0.0.1", 5433)
+    gts.register_node("dn0", "datanode", "10.0.0.2", 15432)
+    nodes = gts.registered_nodes()
+    assert nodes["cn0"]["kind"] == "coordinator"
+    assert nodes["cn0"]["host"] == "10.0.0.1"
+    assert nodes["dn0"]["port"] == 15432
+    # re-register updates the address (restart with a new port)
+    gts.register_node("dn0", "datanode", "10.0.0.2", 25432)
+    assert gts.registered_nodes()["dn0"]["port"] == 25432
+    assert gts.unregister_node("dn0") is True
+    assert gts.unregister_node("dn0") is False
+    assert "dn0" not in gts.registered_nodes()
+
+
+def test_node_registry_survives_native_restart(tmp_path):
+    state = str(tmp_path / "gts")
+    client = NativeGTS.spawn(state)
+    try:
+        client.register_node("cn0", "coordinator", "h1", 1111)
+        client.register_node("dn3", "datanode", "", 0)
+    finally:
+        client.close()
+    client2 = NativeGTS.spawn(state)
+    try:
+        nodes = client2.registered_nodes()
+        assert nodes["cn0"] == {
+            "kind": "coordinator", "host": "h1", "port": 1111,
+            "status": "connected",
+        }
+        assert nodes["dn3"]["kind"] == "datanode"
+        assert nodes["dn3"]["host"] == ""
+    finally:
+        client2.close()
