@@ -370,15 +370,18 @@ def _detect_gsort(agg, root, orientation):
     scatter, no searchsorted; both are serial disasters on TPU while its
     sort streams at memory bandwidth). Requires the gseg shape
     (group-by-unique-build + topk) AND: the aggregate sits directly on
-    the join, no residual, aggregate args touch only probe columns.
-    Specs may be sum/count (cumsum differences) or min/max (one
-    reverse segmented scan each lands the run reduction at the build
-    position — VERDICT r4 ask #6). Returns a spec dict or None."""
+    the join, aggregate args touch only probe columns. Specs may be
+    sum/count (cumsum differences) or min/max (one reverse segmented
+    scan each lands the run reduction at the build position). A join
+    RESIDUAL rides too: its build-side inputs forward-propagate from
+    each run's leading build row and failing probe rows drop out of
+    every per-run reduction (VERDICT r4 ask #6). Returns a spec dict
+    or None."""
     bg = _detect_build_group(agg, root, orientation)
     if bg is None:
         return None
     join = root if isinstance(root, L.Join) else None
-    if join is None or join.residual is not None:
+    if join is None:
         return None
     ji = _count_inner_joins(root) - 1
     build_right = (
@@ -402,6 +405,7 @@ def _detect_gsort(agg, root, orientation):
         "build_right": build_right,
         "build_cols": bg[1],
         "bkey_col": bkey.index,
+        "residual": join.residual,
     }
 
 
@@ -3408,6 +3412,7 @@ class DagRunner:
         build_right = gs["build_right"]
         build_cols = gs["build_cols"]
         bkey_col = gs["bkey_col"]
+        residual = gs.get("residual")
         left_fn = b.build(join.left, exchanged, D)
         right_fn = b.build(join.right, exchanged, D)
         ldids = [c.dict_id for c in join.left.schema]
@@ -3415,6 +3420,14 @@ class DagRunner:
         lkfn = comp.compile(join.left_keys[0], ldids)
         rkfn = comp.compile(join.right_keys[0], rdids)
         jdids = [c.dict_id for c in join.schema]
+        resfn = (
+            comp.compile(residual, jdids)
+            if residual is not None else None
+        )
+        res_cols = (
+            sorted(_expr_cols(residual))
+            if residual is not None else []
+        )
         specs: list[str] = []
         afns: list = []
         for a in agg.aggs:
@@ -3495,10 +3508,12 @@ class DagRunner:
                     env_full[poff + i] = penv[i]
                 operands = [allk]
                 val_pos: list = []  # per agg: (operand idx, vcnt idx|None)
+                sents: list = []  # per agg: min/max sentinel or None
                 pz = jnp.zeros(bn, jnp.int64)
                 for spec, fn in zip(specs, afns):
                     if fn is None:
                         val_pos.append(None)
+                        sents.append(None)
                         continue
                     d, v = _bcast(fn(env_full, params), pn)
                     if jnp.issubdtype(d.dtype, jnp.integer):
@@ -3535,8 +3550,10 @@ class DagRunner:
                         sentv = jnp.asarray(sent, dtype=dv.dtype)
                         dv = jnp.where(vv, dv, sentv)
                         bfill = jnp.full(bn, sentv, dtype=dv.dtype)
+                        sents.append(sentv)
                     else:
                         bfill = pz.astype(dv.dtype)
+                        sents.append(None)
                     operands.append(jnp.concatenate([bfill, dv]))
                     vi = None
                     if v is not None:
@@ -3546,6 +3563,50 @@ class DagRunner:
                             vv.astype(jnp.int8),
                         ]))
                     val_pos.append((len(operands) - (2 if vi else 1), vi))
+                # residual inputs ride the sort: probe-side columns are
+                # local at probe positions; build-side columns sit at
+                # each run's LEADING build row and forward-propagate
+                # after the sort (the ON-clause evaluation of
+                # nodeHashjoin.c's joinqual, co-sort style)
+                res_pos: dict = {}  # col -> (op idx, valid idx, is_build)
+                if resfn is not None:
+                    pspan = range(poff, poff + len(penv))
+                    for c in res_cols:
+                        if c in pspan:
+                            d, v = penv[c - poff]
+                            d = jnp.broadcast_to(d, (pn,))
+                            dv = jnp.concatenate([
+                                jnp.zeros(bn, d.dtype), d
+                            ])
+                            v8 = (
+                                None if v is None else jnp.concatenate([
+                                    jnp.zeros(bn, jnp.int8),
+                                    jnp.broadcast_to(
+                                        v, (pn,)
+                                    ).astype(jnp.int8),
+                                ])
+                            )
+                        else:
+                            d, v = benv[c - boff]
+                            d = jnp.broadcast_to(d, (bn,))
+                            dv = jnp.concatenate([
+                                d, jnp.zeros(pn, d.dtype)
+                            ])
+                            v8 = (
+                                None if v is None else jnp.concatenate([
+                                    jnp.broadcast_to(
+                                        v, (bn,)
+                                    ).astype(jnp.int8),
+                                    jnp.zeros(pn, jnp.int8),
+                                ])
+                            )
+                        oi = len(operands)
+                        operands.append(dv)
+                        vi = None
+                        if v8 is not None:
+                            vi = len(operands)
+                            operands.append(v8)
+                        res_pos[c] = (oi, vi, c not in pspan)
                 # build ORDER BY slots: direction+NULL encoded at the
                 # build side (ranges over real build rows — a superset of
                 # matched groups, still order-preserving). All slots pack
@@ -3615,11 +3676,35 @@ class DagRunner:
                     boundary[1:], jnp.ones(1, jnp.bool_)
                 ])
                 BIG32 = jnp.int32(2**31 - 1)
-                # a run holds >=1 probe row iff its (first-position)
-                # build row is NOT also the run's end — so group
-                # existence costs NOTHING (no count scan unless COUNT
-                # itself was requested)
-                has_probe = ~end
+                # residual evaluation at SORTED positions: build-side
+                # inputs forward-propagate from each run's leading
+                # build row (keep-first segmented scan); rows failing
+                # the residual drop out of every reduction below
+                resid_ok = None
+                if resfn is not None:
+                    env_res: list = [
+                        (jnp.zeros((), jnp.int32), None)
+                    ] * (nl + nr)
+                    for c, (oi, vi, is_bld) in res_pos.items():
+                        rd = sorted_ops[oi]
+                        rv = None if vi is None else sorted_ops[vi]
+                        if is_bld:
+                            keep_first = lambda a, _b: a  # noqa: E731
+                            rd = _seg_scan(rd, boundary, keep_first)
+                            if rv is not None:
+                                rv = _seg_scan(
+                                    rv, boundary, keep_first
+                                )
+                        env_res[c] = (
+                            rd, None if rv is None else rv > 0
+                        )
+                    okd, okv = resfn(env_res, params)
+                    okd = jnp.broadcast_to(okd, (bn + pn,))
+                    resid_ok = (
+                        okd if okv is None
+                        else okd & jnp.broadcast_to(okv, (bn + pn,))
+                    )
+                isp_ok = isp if resid_ok is None else (isp & resid_ok)
 
                 def run_total(cs):
                     # cs must be monotone; value at BUILD position =
@@ -3645,12 +3730,19 @@ class DagRunner:
                     nonlocal run_cnt
                     if run_cnt is None:
                         run_cnt = run_total(
-                            jnp.cumsum(isp.astype(jnp.int32))
+                            jnp.cumsum(isp_ok.astype(jnp.int32))
                         )
                     return run_cnt
 
+                # group existence: without a residual it is free (the
+                # run's leading build row is not also its end); with
+                # one, a group lives iff any probe row PASSED
+                has_probe = (
+                    ~end if resid_ok is None else (get_run_cnt() > 0)
+                )
+
                 out_vals_pos = []  # per agg: (value array, valid array)
-                for spec, vp in zip(specs, val_pos):
+                for spec, vp, sentv in zip(specs, val_pos, sents):
                     if spec == "count_star":
                         out_vals_pos.append(
                             (get_run_cnt().astype(jnp.int64), has_probe)
@@ -3658,17 +3750,26 @@ class DagRunner:
                         continue
                     oi, vi = vp
                     sval = sorted_ops[oi]
+                    if resid_ok is not None:
+                        # failing probe rows leave every reduction:
+                        # identity for sums, sentinel for min/max
+                        fail = isp & ~resid_ok
+                        sval = jnp.where(
+                            fail,
+                            sentv if sentv is not None
+                            else jnp.zeros((), sval.dtype),
+                            sval,
+                        )
                     if vi is not None:
-                        vlive = isp & (sorted_ops[vi] > 0)
+                        vlive = isp_ok & (sorted_ops[vi] > 0)
                         vcnt = run_total(
                             jnp.cumsum(vlive.astype(jnp.int32))
                         )
                         vvalid = vcnt > 0
                     else:
-                        vlive = isp
+                        vlive = isp_ok
                         vcnt = None
                         vvalid = has_probe
-
 
                     if spec == "count":
                         c = (
