@@ -1570,11 +1570,60 @@ class Session:
             FunctionError,
             expand_calls,
         )
+        from opentenbase_tpu.plan.plpgsql import PlpgsqlError
+
+        if isinstance(stmt, A.ExplainStmt):
+            # EXPLAIN must not execute a side-effectful PL body; the
+            # call site plans as a NULL literal placeholder
+            def pl_eval(fn, vals):
+                return None
+        else:
+            def pl_eval(fn, vals):
+                return self._pl_call(fn, vals)
 
         try:
-            return expand_calls(stmt, funcs)
+            return expand_calls(stmt, funcs, pl_eval=pl_eval)
         except FunctionError as e:
             raise SQLError(str(e))
+        except PlpgsqlError as e:
+            raise SQLError(str(e)) from None
+
+    def _pl_call(self, fn, vals):
+        """One PL/pgSQL invocation: depth-bounded (fmgr's
+        max_stack_depth) and ATOMIC — the body's statements commit or
+        roll back as one unit, like a function running inside the
+        caller's transaction (pl_exec.c under the outer xact)."""
+        from opentenbase_tpu.plan.functions import FunctionError
+
+        depth = getattr(self, "_pl_depth", 0)
+        if depth >= 8:
+            raise FunctionError(
+                "plpgsql call nesting exceeds the recursion limit"
+            )
+        started = self.txn is None
+        if started:
+            self.execute("begin")
+        txn = self.txn
+        txn.mark_savepoint("__pl__")
+        self._pl_depth = depth + 1
+        try:
+            out = fn.execute(self, vals)
+        except Exception:
+            if self.txn is txn:
+                txn.rollback_to_savepoint(
+                    "__pl__", self.cluster.stores
+                )
+                del txn.savepoints[txn._find_savepoint("__pl__"):]
+                if started:
+                    self.execute("rollback")
+            raise
+        finally:
+            self._pl_depth = depth
+        if self.txn is txn:
+            del txn.savepoints[txn._find_savepoint("__pl__"):]
+            if started:
+                self.execute("commit")
+        return out
 
     def _expand_views(self, stmt: A.Statement):
         views = self.cluster.views
@@ -2095,12 +2144,25 @@ class Session:
             raise SQLError(
                 f'"{stmt.name}" is a reserved function name'
             )
-        try:
-            fn = SqlFunction.create(
-                stmt.name, stmt.args, stmt.rettype, stmt.body
+        if stmt.language == "plpgsql":
+            from opentenbase_tpu.plan.plpgsql import (
+                PlpgsqlError,
+                PlpgsqlFunction,
             )
-        except FunctionError as e:
-            raise SQLError(str(e))
+
+            try:
+                fn = PlpgsqlFunction.create(
+                    stmt.name, stmt.args, stmt.rettype, stmt.body
+                )
+            except PlpgsqlError as e:
+                raise SQLError(str(e))
+        else:
+            try:
+                fn = SqlFunction.create(
+                    stmt.name, stmt.args, stmt.rettype, stmt.body
+                )
+            except FunctionError as e:
+                raise SQLError(str(e))
         self.cluster.functions[stmt.name] = fn
         if self.cluster.persistence is not None:
             self.cluster.persistence.log_ddl(
@@ -2110,6 +2172,7 @@ class Session:
                     "args": list(map(list, stmt.args)),
                     "rettype": stmt.rettype,
                     "body": stmt.body,
+                    "language": stmt.language,
                 }
             )
         return Result("CREATE FUNCTION")
@@ -4087,7 +4150,7 @@ def _sv_pg_proc(c: Cluster):
                 f"{n} {t}" for n, t in zip(fn.argnames, fn.argtypes)
             ),
             fn.rettype,
-            "sql",
+            getattr(fn, "language", "sql"),
             fn.body,
         )
         for fn in c.functions.values()

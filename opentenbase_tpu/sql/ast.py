@@ -582,6 +582,7 @@ class CreateFunction(Statement):
     rettype: str
     body: str
     replace: bool = False
+    language: str = "sql"  # 'sql' | 'plpgsql'
 
 
 @dataclass

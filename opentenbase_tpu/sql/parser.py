@@ -745,17 +745,18 @@ class Parser:
         self.expect_op(")")
         self.expect_kw("returns")
         rettype = self._simple_type_name()
-        # AS '<body>' LANGUAGE SQL (clauses accepted in either order)
+        # AS '<body>' LANGUAGE SQL|PLPGSQL (clauses in either order)
         body = None
+        lang = "sql"
         while True:
             if self.eat_kw("as"):
                 body = self._string_lit()
             elif self.eat_kw("language"):
                 lang = self.ident("language")
-                if lang != "sql":
+                if lang not in ("sql", "plpgsql"):
                     self.error(
                         f"unsupported function language {lang!r} "
-                        "(only LANGUAGE SQL)"
+                        "(LANGUAGE SQL or PLPGSQL)"
                     )
             elif self.eat_kw("immutable") or self.eat_kw("stable") or (
                 self.eat_kw("volatile")
@@ -765,7 +766,9 @@ class Parser:
                 break
         if body is None:
             self.error("CREATE FUNCTION requires AS '<body>'")
-        return A.CreateFunction(name, args, rettype, body, replace)
+        return A.CreateFunction(
+            name, args, rettype, body, replace, lang
+        )
 
     def _column_def(self) -> A.ColumnDef:
         name = self.ident("column name")

@@ -890,9 +890,16 @@ class ClusterPersistence:
             elif op == "audit_state":
                 c.audit.load_state(header["payload"])
             elif op == "create_function":
-                from opentenbase_tpu.plan.functions import SqlFunction
+                if header.get("language") == "plpgsql":
+                    from opentenbase_tpu.plan.plpgsql import (
+                        PlpgsqlFunction as _FnCls,
+                    )
+                else:
+                    from opentenbase_tpu.plan.functions import (
+                        SqlFunction as _FnCls,
+                    )
 
-                c.functions[header["name"]] = SqlFunction.create(
+                c.functions[header["name"]] = _FnCls.create(
                     header["name"],
                     [tuple(a) for a in header["args"]],
                     header["rettype"],

@@ -125,3 +125,140 @@ def test_function_in_dml(s):
     assert s.query("select bal from acct where id = 4") == [(1000,)]
     s.execute("update acct set bal = base() * 2 where id = 4")
     assert s.query("select bal from acct where id = 4") == [(2000,)]
+
+
+def test_plpgsql_control_flow(s):
+    """PL/pgSQL subset (pl_exec.c analog): DECLARE, :=, IF/ELSIF,
+    WHILE, FOR, RETURN."""
+    s.execute(
+        "create function fib(n bigint) returns bigint as '"
+        "declare a bigint := 0; b bigint := 1; t bigint;"
+        "begin"
+        "  if n < 0 then return null; end if;"
+        "  for i in 1 .. n loop"
+        "    t := a + b; a := b; b := t;"
+        "  end loop;"
+        "  return a;"
+        "end' language plpgsql"
+    )
+    assert s.query("select fib(10)") == [(55,)]
+    assert s.query("select fib(0)") == [(0,)]
+    assert s.query("select fib(-1)") == [(None,)]
+    s.execute(
+        "create function collatz_steps(n bigint) returns bigint as '"
+        "declare steps bigint := 0;"
+        "begin"
+        "  while n > 1 loop"
+        "    if n % 2 = 0 then n := n / 2;"
+        "    else n := 3 * n + 1; end if;"
+        "    steps := steps + 1;"
+        "  end loop;"
+        "  return steps;"
+        "end' language plpgsql"
+    )
+    assert s.query("select collatz_steps(6)") == [(8,)]
+
+
+def test_plpgsql_sql_statements_and_into(s):
+    """SQL inside the body: SELECT INTO, DML side effects, PERFORM."""
+    # fixture table acct holds (1,100),(2,200),(3,300)
+    s.execute(
+        "create function transfer(src bigint, dst bigint, amt bigint) "
+        "returns bigint as '"
+        "declare sbal bigint;"
+        "begin"
+        "  select bal into sbal from acct where id = src;"
+        "  if sbal is null then"
+        "    raise exception ''no such account: %'', src;"
+        "  end if;"
+        "  if sbal < amt then"
+        "    raise exception ''insufficient funds'';"
+        "  end if;"
+        "  update acct set bal = bal - amt where id = src;"
+        "  update acct set bal = bal + amt where id = dst;"
+        "  select bal into sbal from acct where id = src;"
+        "  return sbal;"
+        "end' language plpgsql"
+    )
+    assert s.query("select transfer(1, 2, 40)") == [(60,)]
+    assert s.query("select bal from acct order by id") == [
+        (60,), (240,), (300,),
+    ]
+    with pytest.raises(Exception, match="insufficient funds"):
+        s.query("select transfer(2, 1, 1000)")
+    with pytest.raises(Exception, match="no such account: 9"):
+        s.query("select transfer(9, 1, 5)")
+
+
+def test_plpgsql_survives_recovery(tmp_path):
+    from opentenbase_tpu.engine import Cluster
+
+    d = str(tmp_path / "cn")
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=d)
+    s2 = c.session()
+    s2.execute(
+        "create function tri(n bigint) returns bigint as '"
+        "declare acc bigint := 0;"
+        "begin for i in 1 .. n loop acc := acc + i; end loop;"
+        "return acc; end' language plpgsql"
+    )
+    assert s2.query("select tri(4)") == [(10,)]
+    c.close()
+    c2 = Cluster.recover(d, num_datanodes=2, shard_groups=16)
+    assert c2.session().query("select tri(5)") == [(15,)]
+    c2.close()
+
+
+def test_plpgsql_infinite_loop_bounded(s, monkeypatch):
+    import opentenbase_tpu.plan.plpgsql as pl
+
+    monkeypatch.setattr(pl, "MAX_STEPS", 200)
+    s.execute(
+        "create function spin() returns bigint as '"
+        "begin while true loop end loop; return 0; end' "
+        "language plpgsql"
+    )
+    with pytest.raises(Exception, match="exceeded"):
+        s.query("select spin()")
+
+
+def test_plpgsql_body_is_atomic(s):
+    """An exception mid-body rolls back EVERY statement the body ran
+    (pl_exec.c under the outer xact) — no partial side effects."""
+    s.execute(
+        "create function bad_transfer(src bigint, amt bigint) "
+        "returns bigint as '"
+        "begin"
+        "  update acct set bal = bal - amt where id = src;"
+        "  raise exception ''boom after debit'';"
+        "end' language plpgsql"
+    )
+    before = s.query("select bal from acct order by id")
+    with pytest.raises(Exception, match="boom after debit"):
+        s.query("select bad_transfer(1, 40)")
+    assert s.query("select bal from acct order by id") == before
+
+
+def test_plpgsql_notice_continues(s):
+    s.execute(
+        "create function noisy() returns bigint as '"
+        "begin raise notice ''progress %'', 1; return 7; end' "
+        "language plpgsql"
+    )
+    assert s.query("select noisy()") == [(7,)]
+
+
+def test_plpgsql_case_inside_if_condition(s):
+    s.execute(
+        "create function sgn(n bigint) returns bigint as '"
+        "begin"
+        "  if (case when n > 0 then 1 else 0 end) = 1 then"
+        "    return 1;"
+        "  end if;"
+        "  if n = 0 then return 0; end if;"
+        "  return -1;"
+        "end' language plpgsql"
+    )
+    assert s.query("select sgn(5)") == [(1,)]
+    assert s.query("select sgn(0)") == [(0,)]
+    assert s.query("select sgn(-2)") == [(-1,)]
