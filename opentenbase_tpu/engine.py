@@ -1625,7 +1625,50 @@ class Session:
                 self.execute("commit")
         return out
 
+    def _expand_ctes_stmt(self, stmt: A.Statement):
+        """Expand WITH clauses (statement-scoped views, parse_cte.c).
+        Runs BEFORE view expansion — a CTE name shadows a same-named
+        view — and again after it, for view bodies that carry WITH."""
+        from opentenbase_tpu.plan.astwalk import walk_expr_subqueries
+        from opentenbase_tpu.plan.views import (
+            ViewRecursionError,
+            expand_ctes,
+        )
+
+        try:
+            if isinstance(stmt, A.Select):
+                expand_ctes(stmt)
+            elif isinstance(stmt, A.ExplainStmt) and isinstance(
+                stmt.query, A.Select
+            ):
+                expand_ctes(stmt.query)
+            elif isinstance(stmt, A.CreateTableAs):
+                expand_ctes(stmt.query)
+            elif isinstance(stmt, (A.Update, A.Delete, A.Insert)):
+                if (
+                    isinstance(stmt, A.Insert)
+                    and stmt.query is not None
+                ):
+                    expand_ctes(stmt.query)
+                exprs = []
+                if getattr(stmt, "where", None) is not None:
+                    exprs.append(stmt.where)
+                for _c, e in getattr(stmt, "assignments", ()):
+                    exprs.append(e)
+                for row in getattr(stmt, "values", ()):
+                    exprs.extend(row)
+                for item in getattr(stmt, "returning", ()):
+                    exprs.append(item.expr)
+                for e in exprs:
+                    walk_expr_subqueries(
+                        e, lambda q: expand_ctes(q)
+                    )
+        except ViewRecursionError as e:
+            raise SQLError(str(e))
+        return stmt
+
     def _expand_views(self, stmt: A.Statement):
+        stmt = self._expand_ctes_stmt(stmt)
         views = self.cluster.views
         if not views:
             return stmt
@@ -1666,7 +1709,8 @@ class Session:
                 rewrite_views(stmt.query, views)
         except ViewRecursionError as e:
             raise SQLError(str(e))
-        return stmt
+        # view bodies may themselves carry WITH clauses
+        return self._expand_ctes_stmt(stmt)
 
     def _expand_partitions(self, stmt: A.Statement):
         stmt = self._expand_functions(stmt)

@@ -42,20 +42,30 @@ def relation_names(sel: A.Select, acc: set | None = None) -> set:
     dependency set pg_depend tracks for views."""
     if acc is None:
         acc = set()
+    # CTE names are statement-LOCAL: their bodies' references are real
+    # dependencies, the names themselves are not (PostgreSQL's
+    # pg_depend records through the CTE the same way)
+    local: set = set()
+    for _name, _aliases, body in getattr(sel, "ctes", ()):
+        inner = relation_names(body)
+        acc |= inner - local
+        local.add(_name)
+    here: set = set()
 
     def from_ref(r):
         if isinstance(r, A.RelRef):
-            acc.add(r.name)
+            here.add(r.name)
         elif isinstance(r, A.JoinRef):
             from_ref(r.left)
             from_ref(r.right)
         elif isinstance(r, A.SubqueryRef):
-            relation_names(r.query, acc)
+            relation_names(r.query, here)
 
     if sel.from_clause is not None:
         from_ref(sel.from_clause)
     for _op, sub in sel.set_ops:
-        relation_names(sub, acc)
+        relation_names(sub, here)
     for e in select_exprs(sel):
-        walk_expr_subqueries(e, lambda q: relation_names(q, acc))
+        walk_expr_subqueries(e, lambda q: relation_names(q, here))
+    acc |= here - local
     return acc

@@ -267,6 +267,9 @@ class Select(Statement):
     # row locking: FOR UPDATE / FOR SHARE [NOWAIT] (top level only)
     for_update: Optional[str] = None
     lock_nowait: bool = False
+    # WITH clause: [(name, column_aliases, Select)] — statement-scoped
+    # views, expanded by plan/views.py expand_ctes before analysis
+    ctes: list = field(default_factory=list)
 
 
 @dataclass

@@ -261,7 +261,30 @@ class Parser:
 
     # -- SELECT ---------------------------------------------------------
     def parse_select(self) -> A.Select:
+        # WITH name [(cols)] AS (select), ... — parse.c's CTE list.
+        # Non-recursive only: bodies are statement-scoped views,
+        # expanded before analysis (plan/views.py expand_ctes).
+        ctes = []
+        if self.eat_kw("with"):
+            if self.at_kw("recursive"):
+                self.error("WITH RECURSIVE is not supported")
+            while True:
+                cname = self.ident("CTE name")
+                aliases = []
+                if self.eat_op("("):
+                    aliases.append(self.ident("column alias"))
+                    while self.eat_op(","):
+                        aliases.append(self.ident("column alias"))
+                    self.expect_op(")")
+                self.expect_kw("as")
+                self.expect_op("(")
+                body = self.parse_select()
+                self.expect_op(")")
+                ctes.append((cname, aliases, body))
+                if not self.eat_op(","):
+                    break
         sel = self._select_core()
+        sel.ctes = ctes
         while True:
             if self.at_kw("union"):
                 self.advance()
@@ -432,7 +455,9 @@ class Parser:
 
     def _table_ref(self) -> A.TableRef:
         if self.eat_op("("):
-            if self.at_kw("select") or self.at_op("("):
+            if self.at_kw("select") or self.at_kw("with") or (
+                self.at_op("(")
+            ):
                 query = self.parse_select()
                 self.expect_op(")")
                 alias = self._opt_alias()
@@ -1186,7 +1211,9 @@ class Parser:
             return A.Between(left, low, high)
         if op == "in":
             self.expect_op("(")
-            if self.at_kw("select") or self.at_kw("values"):
+            if self.at_kw("select") or self.at_kw("values") or (
+                self.at_kw("with")
+            ):
                 q = self.parse_select()
                 self.expect_op(")")
                 return A.InSubquery(left, q)
@@ -1245,7 +1272,7 @@ class Parser:
             return A.Param(int(t.value))
         if t.kind == Tok.OP and t.value == "(":
             self.advance()
-            if self.at_kw("select"):
+            if self.at_kw("select") or self.at_kw("with"):
                 q = self.parse_select()
                 self.expect_op(")")
                 return A.ScalarSubquery(q)

@@ -146,3 +146,122 @@ def test_drop_rejected_while_views_depend(s):
     s.execute("drop view dep2")
     s.execute("drop view dep1")
     s.execute("drop table emp")  # now unreferenced
+
+
+def test_ctes_expand_as_statement_scoped_views():
+    """WITH (parse_cte.c): chained CTEs, column aliases, joins between
+    CTEs, subquery WITH, and CTE-shadows-view scoping."""
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        "create table t (k bigint, g bigint, v bigint) "
+        "distribute by shard(k)"
+    )
+    s.execute("insert into t values (1,1,10),(2,1,20),(3,2,30),(4,2,5)")
+    assert s.query(
+        "with big as (select * from t where v > 15) "
+        "select count(*) from big"
+    ) == [(2,)]
+    # chained: later CTE reads an earlier one
+    assert s.query(
+        "with big as (select * from t where v > 15), "
+        "bigger as (select * from big where v > 25) "
+        "select k from bigger"
+    ) == [(3,)]
+    # column aliases
+    assert s.query(
+        "with a (x) as (select k from t where k < 3) "
+        "select sum(x) from a"
+    ) == [(3,)]
+    # join between two CTEs
+    assert s.query(
+        "with a as (select k from t), "
+        "b as (select k from t where k > 2) "
+        "select count(*) from a join b on a.k = b.k"
+    ) == [(2,)]
+    # WITH inside a scalar subquery and inside IN (...)
+    assert s.query(
+        "select (with m as (select max(v) as mv from t) "
+        "select mv from m)"
+    ) == [(30,)]
+    assert s.query(
+        "select k from t where k in (with w as "
+        "(select k from t where v > 15) select k from w) order by k"
+    ) == [(2,), (3,)]
+    # grouped CTE consumed with a filter on its aggregate
+    assert s.query(
+        "with q as (select g, sum(v) as sv from t group by g) "
+        "select g from q where sv > 30 order by g"
+    ) == [(2,)]
+    # a CTE name shadows a same-named view
+    s.execute("create view vv as select * from t where v > 15")
+    assert s.query("select count(*) from vv") == [(2,)]
+    assert s.query(
+        "with vv as (select k from t) select count(*) from vv"
+    ) == [(4,)]
+    # and a view body may itself use WITH
+    s.execute(
+        "create view wv as with base as (select * from t where g = 2) "
+        "select sum(v) as s2 from base"
+    )
+    assert s.query("select s2 from wv") == [(35,)]
+    # WITH RECURSIVE is rejected loudly
+    import pytest
+
+    with pytest.raises(Exception, match="RECURSIVE"):
+        s.query(
+            "with recursive r as (select 1) select * from r"
+        )
+
+
+def test_cte_scoping_and_dependencies():
+    """Round-5 review regressions: inner WITH shadows an outer CTE,
+    WITH works in UPDATE SET and FROM derived tables, duplicate CTE
+    names error, view dependencies track THROUGH CTE bodies, and a
+    view's CTE body may reference another view."""
+    import pytest
+
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        "create table t (k bigint, v bigint) distribute by shard(k)"
+    )
+    s.execute("insert into t values (1,10),(2,20),(3,30)")
+    # inner WITH shadows the outer CTE (PostgreSQL returns 2 here)
+    assert s.query(
+        "with a as (select 1 as x) select (with a as "
+        "(select 2 as x) select x from a)"
+    ) == [(2,)]
+    # WITH inside an UPDATE SET scalar subquery
+    s.execute(
+        "update t set v = (with m as (select max(v) as mv from t) "
+        "select mv from m) where k = 1"
+    )
+    assert s.query("select v from t where k = 1") == [(30,)]
+    # CTE-bearing derived table in FROM
+    assert s.query(
+        "select * from (with a as (select 1 as x) select * from a) s"
+    ) == [(1,)]
+    # duplicate CTE names are an error, not last-wins
+    with pytest.raises(Exception, match="more than once"):
+        s.query(
+            "with a as (select 1 as x), a as (select 2 as x) "
+            "select * from a"
+        )
+    # view dependency tracking reaches through CTE bodies
+    s.execute("create table u2 (k bigint) distribute by shard(k)")
+    s.execute(
+        "create view cv as with b as (select * from u2) "
+        "select count(*) as c from b"
+    )
+    with pytest.raises(Exception, match="depend"):
+        s.execute("drop table u2")
+    # a view's CTE body referencing ANOTHER view expands fully
+    s.execute("create view v1 as select * from t where v > 15")
+    s.execute(
+        "create view wv as with b as (select * from v1) "
+        "select count(*) as c from b"
+    )
+    assert s.query("select c from wv") == [(3,)]
