@@ -40,7 +40,7 @@ from opentenbase_tpu.catalog.catalog import Catalog, TableMeta
 from opentenbase_tpu.catalog.distribution import DistributionSpec, DistStrategy
 from opentenbase_tpu.catalog.nodes import NodeDef, NodeManager, NodeRole
 from opentenbase_tpu.catalog.shardmap import ShardMap
-from opentenbase_tpu.executor.dist import DistExecutor
+from opentenbase_tpu.executor.dist import DistExecutor, concat_batches
 from opentenbase_tpu.executor.local import LocalExecutor
 from opentenbase_tpu.gtm import GTSServer
 from opentenbase_tpu.lmgr import (
@@ -2624,10 +2624,67 @@ class Session:
 
         return _View()
 
+    # -- RETURNING --------------------------------------------------------
+    @staticmethod
+    def _concat_affected(meta: TableMeta, batches) -> ColumnBatch:
+        if not batches:
+            return ColumnBatch(
+                {
+                    n: column_from_python(
+                        [], ty, meta.dictionaries.get(n)
+                    )
+                    for n, ty in meta.schema.items()
+                },
+                0,
+            )
+        if len(batches) == 1:
+            return batches[0]
+        return concat_batches(batches)
+
+    def _validate_returning(self, meta: TableMeta, items):
+        """Resolve the RETURNING list to (column names, labels) —
+        called BEFORE the DML executes so a bad projection rejects the
+        whole statement without persisting the write (PostgreSQL
+        semantics). Column references and ``*`` only — the working set
+        of the reference's RETURNING projections (execMain.c) without
+        a full projection executor on the write path."""
+        names: list[str] = []
+        labels: list[str] = []
+        for item in items:
+            e = item.expr
+            qual = getattr(e, "table", None)
+            if qual is not None and qual != meta.name:
+                raise SQLError(
+                    f'invalid reference to table "{qual}" in '
+                    "RETURNING"
+                )
+            if isinstance(e, A.Star):
+                names.extend(meta.schema)
+                labels.extend(meta.schema)
+                continue
+            if isinstance(e, A.ColumnRef):
+                if e.name not in meta.schema:
+                    raise SQLError(
+                        f'column "{e.name}" does not exist'
+                    )
+                names.append(e.name)
+                labels.append(item.alias or e.name)
+                continue
+            raise SQLError(
+                "RETURNING supports column references and *"
+            )
+        return names, labels
+
+    def _returning_result(
+        self, verb: str, resolved, batch: ColumnBatch, rowcount: int,
+    ) -> Result:
+        names, labels = resolved
+        cols = [batch.columns[n].to_python() for n in names]
+        rows = list(zip(*cols)) if cols else []
+        return Result(verb, rows, labels, rowcount)
+
     # -- INSERT ----------------------------------------------------------
     def _x_insert(self, stmt: A.Insert) -> Result:
-        if stmt.returning:
-            raise SQLError("RETURNING is not yet supported")
         # writers route by the shardmap: never write a shard mid-move
         # (conservative full wait — writes are short)
         self._shard_barrier_gate()
@@ -2639,6 +2696,10 @@ class Session:
             raise SQLError(
                 f'cannot change foreign table "{meta.name}"'
             )
+        ret = (
+            self._validate_returning(meta, stmt.returning)
+            if stmt.returning else None
+        )
         src_batch = self._run_statement_plan(
             L.StatementPlan(iplan.source, splan.subplans)
         )
@@ -2671,6 +2732,8 @@ class Session:
             self._commit_txn(txn)
         else:
             self.txn = txn
+        if ret is not None:
+            return self._returning_result("INSERT", ret, full, n)
         return Result("INSERT", rowcount=n)
 
     def _partition_and_append(self, spec, full: ColumnBatch, txn) -> int:
@@ -2787,8 +2850,6 @@ class Session:
 
     # -- UPDATE / DELETE -------------------------------------------------
     def _x_delete(self, stmt: A.Delete) -> Result:
-        if stmt.returning:
-            raise SQLError("RETURNING is not yet supported")
         self._shard_barrier_gate()
         splan = analyze_statement(stmt, self.cluster.catalog)
         dplan = splan.root
@@ -2798,9 +2859,14 @@ class Session:
             raise SQLError(
                 f'cannot change foreign table "{meta.name}"'
             )
+        ret = (
+            self._validate_returning(meta, stmt.returning)
+            if stmt.returning else None
+        )
         txn, implicit = self._begin_implicit()
         subq = self._subquery_values(splan)
         total = 0
+        old_batches: list[ColumnBatch] = []
         try:
             for node in meta.node_indices:
                 store = self.cluster.stores[node][dplan.table]
@@ -2816,6 +2882,11 @@ class Session:
                     self._acquire_row_locks(
                         txn, dplan.table, node, idx, ROW_UPDATE
                     )
+                    if ret is not None:
+                        # old values, captured before the delete marks
+                        old_batches.append(store.to_batch().take(idx))
+                        if meta.dist.is_replicated:
+                            old_batches = old_batches[:1]
                     txn.pin(store)
                     txn.w(node, dplan.table).del_idx.extend(idx.tolist())
                     total += len(idx)
@@ -2829,11 +2900,14 @@ class Session:
             self._commit_txn(txn)
         else:
             self.txn = txn
+        if ret is not None:
+            return self._returning_result(
+                "DELETE", ret,
+                self._concat_affected(meta, old_batches), total,
+            )
         return Result("DELETE", rowcount=total)
 
     def _x_update(self, stmt: A.Update) -> Result:
-        if stmt.returning:
-            raise SQLError("RETURNING is not yet supported")
         self._shard_barrier_gate()
         splan = analyze_statement(stmt, self.cluster.catalog)
         uplan = splan.root
@@ -2843,6 +2917,10 @@ class Session:
             raise SQLError(
                 f'cannot change foreign table "{meta.name}"'
             )
+        ret = (
+            self._validate_returning(meta, stmt.returning)
+            if stmt.returning else None
+        )
         txn, implicit = self._begin_implicit()
         subq = self._subquery_values(splan)
         assigned = dict(uplan.assignments)
@@ -2884,6 +2962,11 @@ class Session:
             self._commit_txn(txn)
         else:
             self.txn = txn
+        if ret is not None:
+            return self._returning_result(
+                "UPDATE", ret,
+                self._concat_affected(meta, new_batches), total,
+            )
         return Result("UPDATE", rowcount=total)
 
     def _apply_assignments(

@@ -360,3 +360,61 @@ def test_full_outer_join():
         "group by fa.k order by 1 nulls last"
     )
     assert got == [(1, 1), (2, 1), (3, 2), (None, 2)], got
+
+
+def test_dml_returning():
+    """INSERT/UPDATE/DELETE ... RETURNING (execMain.c projections, the
+    column-ref + * working set): new values for INSERT/UPDATE, old
+    values for DELETE, across shards and inside transactions."""
+    import pytest
+
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        "create table r (k bigint, v bigint, w text) "
+        "distribute by shard(k)"
+    )
+    res = s.execute(
+        "insert into r values (1, 10, 'a'), (2, 20, 'b') "
+        "returning k, w"
+    )
+    assert res.columns == ["k", "w"]
+    assert sorted(res.rows) == [(1, "a"), (2, "b")]
+    assert res.rowcount == 2
+    # star + alias
+    res = s.execute(
+        "insert into r values (3, 30, null) returning *"
+    )
+    assert res.columns == ["k", "v", "w"]
+    assert res.rows == [(3, 30, None)]
+    # UPDATE returns NEW values
+    res = s.execute(
+        "update r set v = v + 5 where k < 3 returning k, v"
+    )
+    assert sorted(res.rows) == [(1, 15), (2, 25)]
+    # DELETE returns OLD values
+    res = s.execute("delete from r where k = 2 returning v, w")
+    assert res.rows == [(25, "b")]
+    assert s.query("select count(*) from r") == [(2,)]
+    # zero affected rows -> empty result, correct columns
+    res = s.execute("delete from r where k = 99 returning k")
+    assert res.rows == [] and res.columns == ["k"]
+    # unsupported expressions stay loud — and the statement is
+    # rejected BEFORE any write persists (PostgreSQL semantics)
+    before = s.query("select count(*) from r")[0][0]
+    with pytest.raises(Exception, match="column references"):
+        s.execute("insert into r values (9,9,null) returning k + 1")
+    with pytest.raises(Exception, match="does not exist"):
+        s.execute("delete from r where k = 1 returning nosuchcol")
+    with pytest.raises(Exception, match="invalid reference"):
+        s.execute("delete from r where k = 1 returning other.v")
+    assert s.query("select count(*) from r")[0][0] == before
+    assert s.query("select count(*) from r where k = 1")[0][0] == 1
+    # default-filled column comes back
+    s.execute(
+        "create table d (k bigint, tag text default 'x') "
+        "distribute by shard(k)"
+    )
+    res = s.execute("insert into d (k) values (7) returning tag")
+    assert res.rows == [("x",)]
