@@ -2637,8 +2637,6 @@ class Session:
                 },
                 0,
             )
-        if len(batches) == 1:
-            return batches[0]
         return concat_batches(batches)
 
     def _validate_returning(self, meta: TableMeta, items):
@@ -2882,11 +2880,12 @@ class Session:
                     self._acquire_row_locks(
                         txn, dplan.table, node, idx, ROW_UPDATE
                     )
-                    if ret is not None:
+                    if ret is not None and (
+                        not meta.dist.is_replicated or not old_batches
+                    ):
                         # old values, captured before the delete marks
+                        # (one replica's copy is the truth)
                         old_batches.append(store.to_batch().take(idx))
-                        if meta.dist.is_replicated:
-                            old_batches = old_batches[:1]
                     txn.pin(store)
                     txn.w(node, dplan.table).del_idx.extend(idx.tolist())
                     total += len(idx)

@@ -1052,6 +1052,11 @@ class Analyzer:
             if fld not in ("year", "month", "day", "quarter", "dow", "doy"):
                 raise AnalyzeError(f"unsupported EXTRACT field {fld}")
             return E.FuncE(f"extract_{fld}", (operand,), t.INT4)
+        if isinstance(e, A.RowExpr):
+            raise AnalyzeError(
+                "row expressions are only supported in IN lists and "
+                "=/<> comparisons"
+            )
         raise AnalyzeError(f"unsupported expression {type(e).__name__}")
 
     def _literal(self, v: object) -> E.TExpr:

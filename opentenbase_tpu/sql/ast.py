@@ -98,6 +98,17 @@ class Between(Expr):
 
 
 @dataclass(frozen=True)
+class RowExpr(Expr):
+    """(a, b, ...) row constructor — exists only between the parser's
+    paren handling and the IN desugaring; never reaches analysis."""
+
+    items: tuple[Expr, ...] = ()
+
+    def __str__(self):
+        return "(" + ", ".join(map(str, self.items)) + ")"
+
+
+@dataclass(frozen=True)
 class InList(Expr):
     operand: Expr
     items: tuple[Expr, ...]

@@ -1221,6 +1221,25 @@ class Parser:
             while self.eat_op(","):
                 items.append(self.parse_expr())
             self.expect_op(")")
+            if isinstance(left, A.RowExpr):
+                # row-value IN: (a, b) IN ((1, 2), ...) desugars to
+                # OR-of-AND equalities (transformAExprIn's row case);
+                # frozen AST nodes share safely, no copies
+                ors = None
+                for it in items:
+                    if not isinstance(it, A.RowExpr) or (
+                        len(it.items) != len(left.items)
+                    ):
+                        self.error(
+                            "IN list entries must be rows of the "
+                            "same arity"
+                        )
+                    ands = self._row_eq(left, it)
+                    ors = (
+                        ands if ors is None
+                        else A.BinOp("or", ors, ands)
+                    )
+                return ors
             return A.InList(left, tuple(items))
         if op in ("like", "ilike"):
             right = self.parse_expr(prec + 1)
@@ -1228,7 +1247,31 @@ class Parser:
         if op == "!=":
             op = "<>"
         right = self.parse_expr(prec + 1)
+        if op in ("=", "<>") and (
+            isinstance(left, A.RowExpr) or isinstance(right, A.RowExpr)
+        ):
+            # row comparison: (a, b) = (c, d) desugars to pairwise
+            # equality; <> is its negation (transformAExprOp row case)
+            if not (
+                isinstance(left, A.RowExpr)
+                and isinstance(right, A.RowExpr)
+                and len(left.items) == len(right.items)
+            ):
+                self.error(
+                    "row comparisons need rows of the same arity "
+                    "on both sides"
+                )
+            ands = self._row_eq(left, right)
+            return ands if op == "=" else A.UnaryOp("not", ands)
         return A.BinOp(op, left, right)
+
+    @staticmethod
+    def _row_eq(left: "A.RowExpr", right: "A.RowExpr") -> A.Expr:
+        ands = None
+        for lhs, rhs in zip(left.items, right.items):
+            eq = A.BinOp("=", lhs, rhs)
+            ands = eq if ands is None else A.BinOp("and", ands, eq)
+        return ands
 
     def _unary(self) -> A.Expr:
         if self.eat_kw("not"):
@@ -1277,6 +1320,13 @@ class Parser:
                 self.expect_op(")")
                 return A.ScalarSubquery(q)
             expr = self.parse_expr()
+            if self.at_op(","):
+                # (a, b, ...) row constructor — desugared by IN
+                parts = [expr]
+                while self.eat_op(","):
+                    parts.append(self.parse_expr())
+                self.expect_op(")")
+                return A.RowExpr(tuple(parts))
             self.expect_op(")")
             return expr
         if t.kind != Tok.IDENT:

@@ -270,3 +270,32 @@ def test_comments_and_quoting():
     )
     assert s.items[0].expr == A.ColumnRef("Weird Col")
     assert s.items[1].expr == A.Literal("it's")
+
+
+def test_row_value_in_desugars():
+    """(a, b) IN ((1, 2), (3, 4)) — transformAExprIn's row case as a
+    parse-time OR-of-AND desugar."""
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=1, shard_groups=8).session()
+    s.execute("create table rv (k bigint, v bigint) distribute by roundrobin")
+    s.execute("insert into rv values (1,10),(2,20),(3,30)")
+    assert s.query(
+        "select k from rv where (k, v) in ((1, 10), (3, 30)) order by k"
+    ) == [(1,), (3,)]
+    assert s.query(
+        "select k from rv where (k, v) in ((1, 99)) order by k"
+    ) == []
+    import pytest
+
+    with pytest.raises(Exception, match="same arity"):
+        s.query("select k from rv where (k, v) in ((1, 2, 3))")
+    # row comparisons desugar too
+    assert s.query(
+        "select k from rv where (k, v) = (2, 20)"
+    ) == [(2,)]
+    assert s.query(
+        "select k from rv where (k, v) <> (2, 20) order by k"
+    ) == [(1,), (3,)]
+    with pytest.raises(Exception, match="same arity"):
+        s.query("select k from rv where (k, v) = (1, 2, 3)")
