@@ -82,6 +82,8 @@ class DNServer:
             pass
         self.standby.stream_txn_hook = self._on_stream_txn
         self.standby.start_replication(wal_host, wal_port)
+        self._promoted_srv = None
+        self._promote_mu = threading.Lock()
         self._lsock = socket.socket()
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((host, port))
@@ -108,6 +110,11 @@ class DNServer:
                 except Exception:
                     pass
             self._peer_pools.clear()
+        if self._promoted_srv is not None:
+            try:
+                self._promoted_srv.stop()
+            except Exception:
+                pass
         self.standby.stop()
 
     def _accept_loop(self) -> None:
@@ -146,9 +153,25 @@ class DNServer:
             self._exch_gc()  # periodic sweep rides the health checks
             with self._stats_mu:
                 st = dict(self.stats)
-            return {
+            out = {
                 "ok": True, "applied": self.standby.applied,
                 "dml_stats": st,
+            }
+            if self._promoted_srv is not None:
+                out["promoted"] = True
+                out["coordinator_port"] = self._promoted_srv.port
+            return out
+        if op == "promote":
+            return self._promote(msg)
+        if self._promoted_srv is not None:
+            # a promoted node owns its data read-write; replication-
+            # role ops from a partitioned old coordinator must be
+            # refused, or its 2PC decisions would write behind the new
+            # primary's back (the split-brain fence a promoted PG
+            # standby applies by rejecting the WAL stream)
+            return {
+                "error": "datanode has been promoted to coordinator; "
+                "replication-role ops refused",
             }
         if op == "exec_fragment":
             return self._exec_fragment(msg)
@@ -459,6 +482,24 @@ class DNServer:
             th.join()
         if errors:
             raise errors[0]
+
+    # -- coordinator failover ---------------------------------------------
+    def _promote(self, msg: dict) -> dict:
+        """Promote this datanode process to a full COORDINATOR: its
+        StandbyCluster holds the complete replicated state (WAL copy,
+        catalog, 2PC journals), so any DN can take over when the
+        coordinator dies — pg_ctl promote pointed at a datanode.
+        Stops WAL replication, finishes recovery (re-parks in-doubt
+        2PC), and opens a read-write SQL front end; returns its port.
+        Idempotent."""
+        from opentenbase_tpu.net.server import ClusterServer
+
+        with self._promote_mu:  # idempotent under concurrent RPCs
+            if self._promoted_srv is None:
+                c = self.standby.promote()
+                self._promoted_srv = ClusterServer(c).start()
+                self._bump("promoted")
+            return {"ok": True, "port": self._promoted_srv.port}
 
     def _wait_applied(self, lsn: int, timeout_s: float = 90.0) -> bool:
         t0 = time.time()

@@ -388,3 +388,52 @@ def test_parallel_fragment_matches_serial(par_topology):
     assert sum(
         st.get("parallel_fragments", 0) for st in stats
     ) >= 1, stats
+
+
+def test_dn_promotes_to_coordinator(topology):
+    """Coordinator failover to a DATANODE: the DN's StandbyCluster is a
+    complete replicated copy (WAL, catalog, data), so killing the
+    coordinator and promoting a DN yields a working read-write SQL
+    front end with all the data."""
+    from opentenbase_tpu.net.client import connect_tcp
+
+    c, s = topology
+    want = s.query("select count(*), sum(k) from t")
+    # wait for the DN to fully replay, then promote it
+    pos = c.persistence.wal.position
+    deadline = time.time() + 20
+    applied = -1
+    while time.time() < deadline:
+        applied = c.dn_channels[0].rpc({"op": "ping"})["applied"]
+        if applied >= pos:
+            break
+        time.sleep(0.05)
+    assert applied >= pos, f"replica never caught up ({applied}/{pos})"
+    resp = c.dn_channels[0].rpc({"op": "promote"})
+    assert resp.get("ok") and resp.get("port"), resp
+    # idempotent
+    assert c.dn_channels[0].rpc({"op": "promote"})["port"] == resp["port"]
+    with connect_tcp("127.0.0.1", resp["port"]) as nc:
+        assert nc.query("select count(*), sum(k) from t") == want
+        # the promoted DN is read-WRITE: inserts work and persist
+        nc.execute("insert into t values (777001, 1.00, 'z')")
+        got = nc.query("select count(*) from t where k = 777001")
+        assert got == [(1,)]
+    # ping now advertises the role change...
+    ping = c.dn_channels[0].rpc({"op": "ping"})
+    assert ping.get("promoted") and (
+        ping.get("coordinator_port") == resp["port"]
+    )
+    # ...and replication-role ops are FENCED (split-brain guard): the
+    # old coordinator's 2PC decisions must not write behind the new
+    # primary's back
+    import pytest as _pytest
+
+    from opentenbase_tpu.net.pool import ChannelError
+
+    with _pytest.raises(ChannelError, match="promoted"):
+        c.dn_channels[0].rpc({"op": "2pc_prepare", "gid": "late_gid"})
+    with _pytest.raises(ChannelError, match="promoted"):
+        c.dn_channels[0].rpc({
+            "op": "exec_fragment", "plan": "", "node": 0,
+        })
