@@ -1106,8 +1106,31 @@ class Analyzer:
         if op in _ARITH:
             return self._make_arith(op, l, r)
         if op in ("is distinct from", "is not distinct from"):
-            eq = E.FuncE("null_safe_eq", (l, r), t.BOOL)
-            return E.UnaryE("not", eq, t.BOOL) if op == "is distinct from" else eq
+            # null-safe equality composed from existing machinery so
+            # text operands get the same dictionary alignment ordinary
+            # comparisons do: (l = r AND both NOT NULL) OR (both NULL)
+            eq = self._make_cmp("=", l, r)
+            ln = E.IsNullE(l, False)
+            rn = E.IsNullE(r, False)
+            both_nn = E.BinE(
+                "and",
+                E.UnaryE("not", ln, t.BOOL),
+                E.UnaryE("not", rn, t.BOOL),
+                t.BOOL,
+            )
+            # the raw = can be NULL when an operand is; COALESCE it to
+            # FALSE so the AND/OR algebra below is two-valued
+            eq2 = E.FuncE("coalesce", (eq, E.Const(False, t.BOOL)), t.BOOL)
+            nse = E.BinE(
+                "or",
+                E.BinE("and", eq2, both_nn, t.BOOL),
+                E.BinE("and", ln, rn, t.BOOL),
+                t.BOOL,
+            )
+            return (
+                E.UnaryE("not", nse, t.BOOL)
+                if op == "is distinct from" else nse
+            )
         raise AnalyzeError(f"unsupported operator {op}")
 
     def _maybe_interval(self, e: A.Expr, ctx: ExprContext):
@@ -1500,6 +1523,23 @@ class Analyzer:
     def _cast_expr(self, e: A.Cast, ctx: ExprContext) -> E.TExpr:
         ty = t.type_from_name(e.type_name, e.type_args)
         operand = self.expr(e.operand, ctx)
+        if ty.is_text and not operand.type.is_text:
+            if isinstance(operand, E.Const):
+                v = operand.value
+                if v is None:
+                    s = None
+                elif isinstance(v, bool):
+                    s = "true" if v else "false"  # PG boolout
+                else:
+                    s = str(v)
+                return E.Const(s, ty)
+            # dictionary-encoded text has no device rendering for
+            # arbitrary numeric domains; reject instead of emitting
+            # out-of-range dictionary codes
+            raise AnalyzeError(
+                f"cannot cast {operand.type.id.value} to text "
+                "(only constants)"
+            )
         return _cast(operand, ty)
 
     def _case(self, e: A.CaseExpr, ctx: ExprContext) -> E.TExpr:

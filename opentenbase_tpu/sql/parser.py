@@ -702,8 +702,31 @@ class Parser:
         return stmt
 
     def _maybe_over(self, fn: A.FuncCall) -> A.Expr:
-        """``f(...) OVER ([PARTITION BY ...] [ORDER BY ...])`` — window
-        function invocation (gram.y's over_clause)."""
+        """``f(...) [FILTER (WHERE ...)] [OVER (...)]`` — the FILTER
+        clause desugars to CASE WHEN inside the aggregate argument
+        (gram.y's filter_clause; nodeAgg applies aggfilter the same
+        row-conditional way), then the over_clause."""
+        if self.eat_kw("filter"):
+            if fn.name not in ("count", "sum", "min", "max", "avg"):
+                self.error(
+                    f"FILTER specified, but {fn.name} is not an "
+                    "aggregate function"
+                )
+            if len(fn.args) > 1:
+                self.error(
+                    "FILTER requires a single-argument aggregate"
+                )
+            self.expect_op("(")
+            self.expect_kw("where")
+            cond = self.parse_expr()
+            self.expect_op(")")
+            arg = (
+                A.Literal(1) if fn.star or not fn.args else fn.args[0]
+            )
+            case = A.CaseExpr(None, ((cond, arg),), None)
+            fn = A.FuncCall(
+                fn.name, (case,), distinct=fn.distinct
+            )
         if not self.eat_kw("over"):
             return fn
         self.expect_op("(")
@@ -1205,9 +1228,20 @@ class Parser:
                 return A.BinOp("is distinct from" if not negated else "is not distinct from", left, right)
             self.error("expected NULL/TRUE/FALSE after IS")
         if op == "between":
+            symmetric = bool(self.eat_kw("symmetric"))
             low = self.parse_expr(_PRECEDENCE["between"] + 1)
             self.expect_kw("and")
             high = self.parse_expr(_PRECEDENCE["between"] + 1)
+            if symmetric:
+                # BETWEEN SYMMETRIC: two-sided OR over SHARED operand
+                # nodes (frozen AST) — wrapping the bounds in
+                # least/greatest would analyze and evaluate each bound
+                # expression twice
+                return A.BinOp(
+                    "or",
+                    A.Between(left, low, high),
+                    A.Between(left, high, low),
+                )
             return A.Between(left, low, high)
         if op == "in":
             self.expect_op("(")
@@ -1243,6 +1277,36 @@ class Parser:
             return A.InList(left, tuple(items))
         if op in ("like", "ilike"):
             right = self.parse_expr(prec + 1)
+            if self.eat_kw("escape"):
+                esc = self._string_lit()
+                if len(esc) != 1:
+                    self.error("ESCAPE must be a single character")
+                if not (
+                    isinstance(right, A.Literal)
+                    and isinstance(right.value, str)
+                ):
+                    self.error("ESCAPE requires a literal pattern")
+                # rewrite the custom escape to the matcher's backslash
+                out = []
+                i = 0
+                pat = right.value
+                while i < len(pat):
+                    c = pat[i]
+                    if c == esc:
+                        if i + 1 >= len(pat):
+                            self.error(
+                                "LIKE pattern must not end with "
+                                "escape character"
+                            )
+                        out.append("\\" + pat[i + 1])
+                        i += 2
+                        continue
+                    if c == "\\":
+                        out.append("\\\\")
+                    else:
+                        out.append(c)
+                    i += 1
+                right = A.Literal("".join(out))
             return A.BinOp(op, left, right)
         if op == "!=":
             op = "<>"
@@ -1398,8 +1462,15 @@ class Parser:
                 return self._maybe_over(A.FuncCall(name, ()))
             distinct = bool(self.eat_kw("distinct"))
             args = [self.parse_expr()]
-            while self.eat_op(","):
+            if name == "substring" and self.eat_kw("from"):
+                # substring(s FROM start [FOR length]) — gram.y's
+                # substr_from/substr_for form of the comma call
                 args.append(self.parse_expr())
+                if self.eat_kw("for"):
+                    args.append(self.parse_expr())
+            else:
+                while self.eat_op(","):
+                    args.append(self.parse_expr())
             self.expect_op(")")
             return self._maybe_over(
                 A.FuncCall(name, tuple(args), distinct=distinct)

@@ -47,16 +47,22 @@ def jax8():
 
 
 def _orphaned_dn_pids():
+    """DN server processes whose PARENT is this pytest process — i.e.
+    children a fixture spawned and failed to reap. Restricting to our
+    own children keeps a concurrently running second test session's
+    DNs out of scope (they are someone else's, not leaks of ours)."""
     import subprocess
 
+    me = os.getpid()
     try:
         out = subprocess.run(
-            ["pgrep", "-f", "opentenbase_tpu.dn.server"],
+            ["pgrep", "-P", str(me), "-f",
+             "opentenbase_tpu.dn.server"],
             capture_output=True, text=True, timeout=10,
         ).stdout.split()
     except (OSError, subprocess.TimeoutExpired):
         return []
-    return [int(p) for p in out if p.strip() and int(p) != os.getpid()]
+    return [int(p) for p in out if p.strip()]
 
 
 @pytest.fixture(scope="session", autouse=True)

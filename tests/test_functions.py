@@ -146,3 +146,54 @@ def test_months_between_whole_month_rule(s):
         "select months_between(date '2020-03-31', date '2020-02-29')"
         " from t where k = 1"
     ) == [(1.0,)]
+
+
+def test_sql_sugar_round5():
+    """IS [NOT] DISTINCT FROM (null-safe, dictionary-aligned text),
+    BETWEEN SYMMETRIC, substring FROM/FOR, aggregate FILTER (WHERE),
+    LIKE ... ESCAPE, and constant cast-to-text (columns reject
+    cleanly)."""
+    import pytest
+
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        "create table t (k bigint, g bigint, v bigint, w text) "
+        "distribute by shard(k)"
+    )
+    s.execute(
+        "insert into t values (1,1,10,'ab'),(2,1,20,'cd'),"
+        "(3,2,30,null),(4,2,5,'a%b')"
+    )
+    assert s.query(
+        "select k from t where w is distinct from 'ab' order by k"
+    ) == [(2,), (3,), (4,)]
+    assert s.query(
+        "select k from t where w is not distinct from null order by k"
+    ) == [(3,)]
+    assert s.query(
+        "select k from t where v is not distinct from 10"
+    ) == [(1,)]
+    assert s.query(
+        "select k from t where v between symmetric 20 and 10 order by k"
+    ) == [(1,), (2,)]
+    assert s.query(
+        "select substring(w from 1 for 1) from t order by k limit 2"
+    ) == [("a",), ("c",)]
+    assert s.query(
+        "select g, count(*) filter (where v > 10), "
+        "sum(v) filter (where v < 15) from t group by g order by g"
+    ) == [(1, 1, 10), (2, 1, 5)]
+    assert s.query(
+        "select k from t where w like 'a!%%' escape '!' order by k"
+    ) == [(4,)]
+    assert s.query("select cast(42 as text)") == [("42",)]
+    assert s.query("select cast(true as text)") == [("true",)]
+    with pytest.raises(Exception, match="cannot cast"):
+        s.query("select cast(v as text) from t")
+    # FILTER on a non-aggregate and trailing escape chars stay loud
+    with pytest.raises(Exception, match="not an aggregate"):
+        s.query("select upper(w) filter (where k = 1) from t")
+    with pytest.raises(Exception, match="end with escape"):
+        s.query("select k from t where w like 'ab!' escape '!'")
