@@ -1305,6 +1305,11 @@ class FusedExecutor:
                 specs.append("count_star")
                 afns.append(None)
             elif a.func in ("sum", "count", "min", "max"):
+                if a.func in ("min", "max") and a.arg.type.is_text:
+                    # dictionary codes are insertion-ordered, not
+                    # collation-ordered: device min/max over codes
+                    # would be wrong — the host path ranks first
+                    raise FusedUnsupported(f"{a.func} over text")
                 specs.append(a.func)
                 afns.append(comp.compile(a.arg, dids))
             else:

@@ -397,6 +397,8 @@ def _detect_gsort(agg, root, orientation):
             continue
         if a.func not in ("sum", "count", "min", "max"):
             return None
+        if a.func in ("min", "max") and a.arg.type.is_text:
+            return None  # code order != collation order: host path
         if any(not (plo <= c < phi) for c in _expr_cols(a.arg)):
             return None
     bkey = (join.right_keys if build_right else join.left_keys)[0]
@@ -421,6 +423,10 @@ def _detect_gagg(agg, topk):
     if not agg.group_exprs:
         return None
     for a in agg.aggs:
+        if a.func in ("min", "max") and (
+            a.arg is not None and a.arg.type.is_text
+        ):
+            return None  # code order != collation order: host path
         if a.func in ("count", "sum", "min", "max"):
             continue
         return None
@@ -604,6 +610,13 @@ def _agg_specs(comp, agg, dids):
             specs.append("count_star")
             afns.append(None)
         else:
+            if a.func in ("min", "max") and a.arg.type.is_text:
+                # dictionary codes are insertion-ordered, not
+                # collation-ordered: a device min over codes would be
+                # wrong — the host path aggregates over ranks
+                raise DagUnsupported(
+                    f"{a.func}() over TEXT stays on the host path"
+                )
             specs.append(a.func)
             afns.append(comp.compile(a.arg, dids))
     return specs, afns
@@ -3928,6 +3941,13 @@ class DagRunner:
                     specs.append("count_star")
                     afns.append(None)
                 else:
+                    if a.func in ("min", "max") and (
+                        a.arg.type.is_text
+                    ):
+                        raise DagUnsupported(
+                            f"{a.func}() over TEXT stays on the "
+                            "host path (code order != collation)"
+                        )
                     specs.append(a.func)
                     afns.append(comp.compile(a.arg, dids))
             grouped = bool(agg.group_exprs)

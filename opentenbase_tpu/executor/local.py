@@ -435,9 +435,13 @@ class LocalExecutor:
     def _agg_inputs(self, aggs, child: DevBatch):
         """Lower AggCalls to kernel specs + input value columns. avg(x)
         becomes sum+count (merged in _finalize_aggs) — the same transition
-        split the reference's 2-phase aggregation uses."""
+        split the reference's 2-phase aggregation uses. min/max over
+        TEXT aggregate over dictionary RANKS (codes are insertion-
+        ordered, not collation-ordered — the same mapping ORDER BY
+        uses) and _finalize_aggs maps the winning rank back to a code."""
         specs: list[str] = []
         vals: list = []
+        self._agg_rank_inv: list = []  # per-spec rank->code map or None
         afns = []
         comp = ExprCompiler()
         dids = [c.dict_id for c in child.schema]
@@ -453,6 +457,7 @@ class LocalExecutor:
             if a.func == "count" and a.arg is None:
                 specs.append("count_star")
                 vals.append(None)
+                self._agg_rank_inv.append(None)
                 continue
             d, v = fn(child.cols, params)
             d, v = self._broadcast((d, v), child.n)
@@ -461,9 +466,25 @@ class LocalExecutor:
                 vals.append((d, v))
                 specs.append("count")
                 vals.append((d, v))
+                self._agg_rank_inv.extend([None, None])
             elif a.func in ("sum", "count", "min", "max"):
+                inv = None
+                if a.func in ("min", "max") and a.arg.type.is_text:
+                    did = _texpr_did(a.arg, child.schema) or LITERAL_DICT
+                    ranks = self._dict_ranks(did)
+                    d = ranks[jnp.clip(d, 0, ranks.shape[0] - 1)]
+                    # inverse permutation: rank -> dictionary code
+                    dic = self._dict(did)
+                    order = np.argsort(
+                        np.asarray(dic.values, dtype=object)
+                    ).astype(np.int32)
+                    pad = filt_ops.bucket_size(max(len(order), 1))
+                    invarr = np.zeros(pad, dtype=np.int32)
+                    invarr[: len(order)] = order
+                    inv = jnp.asarray(invarr)
                 specs.append(a.func)
                 vals.append((d, v))
+                self._agg_rank_inv.append(inv)
             else:
                 raise ExecError(f"aggregate {a.func} not supported")
         return specs, vals
@@ -488,6 +509,11 @@ class LocalExecutor:
                 cols.append((d, v))
             else:
                 d, v = outs[i]
+                inv = getattr(self, "_agg_rank_inv", None)
+                if inv is not None and inv[i] is not None:
+                    # min/max over TEXT reduced in rank space: map the
+                    # winning rank back to its dictionary code
+                    d = inv[i][jnp.clip(d, 0, inv[i].shape[0] - 1)]
                 i += 1
                 if a.func == "sum" and a.type.id == t.TypeId.INT8:
                     d = d.astype(jnp.int64)

@@ -495,6 +495,12 @@ def _group_reduce_impl(keys, vals, perm, seg, num_groups: int, specs: tuple):
             elif data.dtype == jnp.bool_:
                 data = data.astype(jnp.int32)
                 sent = 2 if spec == "min" else -1
+            elif jnp.dtype(data.dtype).itemsize < 8:
+                # an int64 sentinel WRAPS when cast into a narrower
+                # lane (e.g. int32 text codes -> -1), poisoning every
+                # group's min with the wrapped value
+                info = jnp.iinfo(data.dtype)
+                sent = info.max if spec == "min" else info.min
             else:
                 sent = _I64_MAX if spec == "min" else _I64_MIN
             d = jnp.where(vvalid, data, jnp.asarray(sent, dtype=data.dtype))
@@ -553,6 +559,11 @@ def _scalar_reduce_impl(vals, mask, specs: tuple):
             elif d.dtype == jnp.bool_:
                 d = d.astype(jnp.int32)
                 sent = 2 if spec == "min" else -1
+            elif jnp.dtype(d.dtype).itemsize < 8:
+                # same wrap hazard as group_reduce: narrow-lane casts
+                # of the int64 sentinel flip its sign
+                info = jnp.iinfo(d.dtype)
+                sent = info.max if spec == "min" else info.min
             else:
                 sent = _I64_MAX if spec == "min" else _I64_MIN
             dd = jnp.where(vvalid, d, jnp.asarray(sent, dtype=d.dtype))

@@ -376,6 +376,7 @@ class Analyzer:
         # vectorized Filter.
         if sel.where is not None:
             plain: list[A.Expr] = []
+            pre_tes: list[E.TExpr] = []
             for c in _split_and(sel.where):
                 # the parser emits NOT EXISTS as UnaryOp('not', Exists)
                 if (
@@ -403,11 +404,21 @@ class Analyzer:
                     cmp = A.BinOp("=" if c.negated else ">", A.ScalarSubquery(counted), A.Literal(0))
                     plain.append(cmp)
                 else:
+                    # correlated scalar-aggregate comparison -> grouped
+                    # LEFT join on the correlation keys
+                    corr = self._try_corr_scalar(plan, scope, c)
+                    if corr is not None:
+                        plan, corr_te = corr
+                        pre_tes.append(corr_te)
+                        continue
                     plain.append(c)
-            if plain:
+            if plain or pre_tes:
                 pred: Optional[E.TExpr] = None
                 for c in plain:
                     te = _bool_type(self.expr(c, ctx))
+                    pred = te if pred is None else E.BinE("and", pred, te, t.BOOL)
+                for te in pre_tes:
+                    te = _bool_type(te)
                     pred = te if pred is None else E.BinE("and", pred, te, t.BOOL)
                 assert pred is not None
                 plan = L.Filter(plan, pred, plan.schema)
@@ -810,7 +821,18 @@ class Analyzer:
                 L.OutCol(f"__g{i}", g.type, _texpr_dict_id(g, ctx.scope))
                 for i, g in enumerate(group_texprs)
             ]
-            + [L.OutCol(f"__a{i}", a.type) for i, a in enumerate(gctx.aggs)]
+            + [
+                # min/max over TEXT output codes in the ARGUMENT's
+                # dictionary — without it, decode reads the empty
+                # literal dictionary (pre-round-5 latent bug)
+                L.OutCol(
+                    f"__a{i}", a.type,
+                    _texpr_dict_id(a.arg, ctx.scope)
+                    if a.func in ("min", "max") and a.arg is not None
+                    else None,
+                )
+                for i, a in enumerate(gctx.aggs)
+            ]
         )
         result: L.LogicalPlan = L.Aggregate(
             plan, tuple(group_texprs), tuple(gctx.aggs), agg_schema
@@ -1499,23 +1521,9 @@ class Analyzer:
         _need_ast(e.args, 1, e.name)
         arg = self.expr(e.args[0], g.input_ctx)
         name = e.name
-        if name == "count":
-            return g.agg_col(E.AggCall("count", arg, e.distinct, t.INT8))
-        if name == "sum":
-            at = arg.type
-            if at.is_integer:
-                rty = t.INT8
-            elif at.id == t.TypeId.DECIMAL:
-                rty = t.decimal(38, at.scale)
-            elif at.id in (t.TypeId.FLOAT4, t.TypeId.FLOAT8):
-                rty = t.FLOAT8
-            else:
-                raise AnalyzeError(f"sum over {at} is not defined")
-            return g.agg_col(E.AggCall("sum", arg, e.distinct, rty))
-        if name == "avg":
-            if not arg.type.is_numeric:
-                raise AnalyzeError(f"avg over {arg.type} is not defined")
-            return g.agg_col(E.AggCall("avg", arg, e.distinct, t.FLOAT8))
+        if name in ("count", "sum", "avg"):
+            rty = self._agg_result_type(name, arg.type)
+            return g.agg_col(E.AggCall(name, arg, e.distinct, rty))
         if name in ("min", "max"):
             return g.agg_col(E.AggCall(name, arg, False, arg.type))
         raise AnalyzeError(f"unknown aggregate {name}")
@@ -1584,6 +1592,247 @@ class Analyzer:
             lk, rk = _cast(lk, ct), _cast(rk, ct)
         jt = "anti" if c.negated else "semi"
         return L.Join(plan, sub, jt, (lk,), (rk,), None, plan.schema)
+
+    _CORR_AGGS = ("count", "sum", "min", "max", "avg")
+
+    def _has_unresolved_ref(self, q: A.Select, inner_ctx) -> bool:
+        """Any TOP-LEVEL ColumnRef of ``q`` that the inner scope does
+        not capture (nested subqueries excluded — their own scopes
+        resolve them, and if they correlate further the standalone
+        path's error is the same one it raised before this feature)."""
+        refs: list[A.ColumnRef] = []
+
+        def walk(node):
+            if isinstance(node, (
+                A.ScalarSubquery, A.InSubquery, A.ExistsSubquery,
+            )):
+                return
+            if isinstance(node, A.ColumnRef):
+                refs.append(node)
+            import dataclasses
+
+            if dataclasses.is_dataclass(node) and not isinstance(
+                node, type
+            ):
+                for f in dataclasses.fields(node):
+                    v = getattr(node, f.name)
+                    if isinstance(v, (list, tuple)):
+                        for x in v:
+                            if isinstance(x, (A.Expr, A.SelectItem)):
+                                walk(
+                                    x.expr
+                                    if isinstance(x, A.SelectItem)
+                                    else x
+                                )
+                    elif isinstance(v, A.Expr):
+                        walk(v)
+
+        for item in q.items:
+            walk(item.expr)
+        if q.where is not None:
+            walk(q.where)
+        for r in refs:
+            mark = len(self.subplans)
+            try:
+                self.expr(r, inner_ctx)
+            except AnalyzeError:
+                del self.subplans[mark:]
+                return True
+        return False
+
+    @staticmethod
+    def _agg_result_type(name: str, arg_type) -> "t.SqlType":
+        """THE aggregate result-typing rules — shared by the grouped
+        path (_agg_call) and the decorrelated scalar path."""
+        if name == "count":
+            return t.INT8
+        if name == "sum":
+            if arg_type.is_integer:
+                return t.INT8
+            if arg_type.id == t.TypeId.DECIMAL:
+                return t.decimal(38, arg_type.scale)
+            if arg_type.id in (t.TypeId.FLOAT4, t.TypeId.FLOAT8):
+                return t.FLOAT8
+            raise AnalyzeError(f"sum over {arg_type} is not defined")
+        if name == "avg":
+            if not arg_type.is_numeric:
+                raise AnalyzeError(
+                    f"avg over {arg_type} is not defined"
+                )
+            return t.FLOAT8
+        return arg_type  # min / max
+
+    def _try_corr_scalar(self, plan, scope, c: A.Expr):
+        """Decorrelate ``<outer> <cmp> (SELECT agg(x) FROM i WHERE
+        eq-correlations [AND inner preds])`` — the scalar-sublink
+        pull-up PG performs in convert_ANY/EXISTS + the classic
+        Kim-style aggregate decorrelation: the subquery becomes a
+        grouped aggregate LEFT-joined on the correlation keys and the
+        conjunct compares against the joined aggregate column. Returns
+        (new_plan, conjunct_texpr) or None (caller falls back to the
+        ordinary path, which handles uncorrelated scalars)."""
+        if not (isinstance(c, A.BinOp) and c.op in _CMP):
+            return None
+        flipped = False
+        outer_ast, sub = c.left, c.right
+        if isinstance(outer_ast, A.ScalarSubquery):
+            outer_ast, sub, flipped = sub, outer_ast, True
+        if not isinstance(sub, A.ScalarSubquery):
+            return None
+        q = sub.query
+        if (
+            q.group_by or q.having is not None or q.limit is not None
+            or q.offset is not None or q.distinct or q.set_ops
+            or q.ctes or q.from_clause is None or q.where is None
+            or len(q.items) != 1
+        ):
+            return None
+        item = q.items[0].expr
+        if not (
+            isinstance(item, A.FuncCall)
+            and item.name in self._CORR_AGGS
+            and not item.distinct
+        ):
+            return None
+        mark = len(self.subplans)
+
+        def bail():
+            del self.subplans[mark:]
+            return None
+
+        try:
+            inner_plan, inner_scope = self._from(q.from_clause)
+        except AnalyzeError:
+            return bail()
+        inner_ctx = ExprContext(inner_scope, self)
+        # the standalone path must keep handling uncorrelated scalars:
+        # engage only when some TOP-LEVEL column reference fails to
+        # resolve against the inner scope (a cheap read-only walk —
+        # re-analyzing the whole subquery here would double the work
+        # for every uncorrelated scalar and compound with nesting)
+        if not self._has_unresolved_ref(q, inner_ctx):
+            return bail()
+        outer_ctx = ExprContext(scope, self)
+        lkeys: list[E.TExpr] = []
+        rkeys: list[E.TExpr] = []
+        inner_pred: Optional[E.TExpr] = None
+        for conj in _split_and(q.where):
+            m2 = len(self.subplans)
+            try:
+                te = _bool_type(self.expr(conj, inner_ctx))
+                inner_pred = (
+                    te if inner_pred is None
+                    else E.BinE("and", inner_pred, te, t.BOOL)
+                )
+                continue
+            except AnalyzeError:
+                del self.subplans[m2:]
+            if not (isinstance(conj, A.BinOp) and conj.op == "="):
+                return bail()
+            for a, b in ((conj.left, conj.right),
+                         (conj.right, conj.left)):
+                # same pull-up contract as EXISTS: the outer side must
+                # be a bare column the inner scope does NOT capture
+                if not isinstance(b, A.ColumnRef):
+                    continue
+                try:
+                    self.expr(b, inner_ctx)
+                    continue
+                except AnalyzeError:
+                    pass
+                m3 = len(self.subplans)
+                try:
+                    ik = self.expr(a, inner_ctx)
+                    ok_ = self.expr(b, outer_ctx)
+                except AnalyzeError:
+                    del self.subplans[m3:]
+                    continue
+                if ik.type != ok_.type:
+                    ct = _common_input_type(ik.type, ok_.type, "=")
+                    ik, ok_ = _cast(ik, ct), _cast(ok_, ct)
+                lkeys.append(ok_)
+                rkeys.append(ik)
+                break
+            else:
+                return bail()
+        if not lkeys:
+            return bail()
+        # the aggregate itself, typed with the ordinary agg rules
+        name = item.name
+        arg = None
+        if item.star:
+            if name != "count":
+                return bail()
+        else:
+            if len(item.args) != 1:
+                return bail()
+            m4 = len(self.subplans)
+            try:
+                arg = self.expr(item.args[0], inner_ctx)
+            except AnalyzeError:
+                del self.subplans[m4:]
+                return bail()
+        try:
+            rty = self._agg_result_type(
+                name, arg.type if arg is not None else None
+            )
+        except AnalyzeError:
+            return bail()
+        aggcall = E.AggCall(name, arg, False, rty)
+        inner = inner_plan
+        if inner_pred is not None:
+            inner = L.Filter(inner, inner_pred, inner.schema)
+        sub_schema = tuple(
+            [
+                L.OutCol(
+                    f"__ck{i}", k.type,
+                    _expr_dict_id(k, inner_plan.schema),
+                )
+                for i, k in enumerate(rkeys)
+            ]
+            + [L.OutCol(
+                "__sq", aggcall.type,
+                _expr_dict_id(arg, inner_plan.schema)
+                if arg is not None and name in ("min", "max")
+                else None,
+            )]
+        )
+        agg_node = L.Aggregate(
+            inner, tuple(rkeys), (aggcall,), sub_schema
+        )
+        nbase = len(plan.schema)
+        nkeys = len(rkeys)
+        joined_schema = tuple(plan.schema) + sub_schema
+        new_plan = L.Join(
+            plan, agg_node, "left",
+            tuple(lkeys),
+            tuple(
+                E.Col(i, rkeys[i].type) for i in range(nkeys)
+            ),
+            None,
+            joined_schema,
+        )
+        sq_col: E.TExpr = E.Col(
+            nbase + nkeys, aggcall.type, "__sq"
+        )
+        if name == "count":
+            # COUNT over an empty correlated set is 0, not NULL — the
+            # LEFT join's null-extension must coalesce
+            sq_col = E.FuncE(
+                "coalesce", (sq_col, E.Const(0, t.INT8)), t.INT8
+            )
+        m5 = len(self.subplans)
+        try:
+            outer_te = self.expr(outer_ast, outer_ctx)
+        except AnalyzeError:
+            del self.subplans[m5:]
+            return bail()
+        te = (
+            self._make_cmp(c.op, sq_col, outer_te)
+            if flipped
+            else self._make_cmp(c.op, outer_te, sq_col)
+        )
+        return new_plan, te
 
     def _exists_subquery_join(
         self, plan: L.LogicalPlan, scope: Scope, c: A.ExistsSubquery

@@ -318,18 +318,29 @@ class Distributor:
         partial_aggs: list[E.AggCall] = []
         # original agg index -> list of partial output offsets
         slots: list[list[int]] = []
-        for a in plan.aggs:
+        # per-partial dict id: min/max outputs stay codes in the
+        # ARGUMENT's dictionary (plan.schema carries it since the
+        # analyzer stamps agg output dict ids) — dropping it made the
+        # merge translate text codes into the wrong dictionary
+        pdicts: list = []
+        for j, a in enumerate(plan.aggs):
             if a.func == "avg":
                 at = a.arg.type
                 sum_t = at if at.id == t.TypeId.DECIMAL else t.FLOAT8
                 partial_aggs.append(E.AggCall("sum", a.arg, False, sum_t))
                 partial_aggs.append(E.AggCall("count", a.arg, False, t.INT8))
+                pdicts.extend([None, None])
                 slots.append([len(partial_aggs) - 2, len(partial_aggs) - 1])
             elif a.func == "count":
                 partial_aggs.append(a)
+                pdicts.append(None)
                 slots.append([len(partial_aggs) - 1])
             else:
                 partial_aggs.append(a)
+                pdicts.append(
+                    plan.schema[ngroups + j].dict_id
+                    if a.func in ("min", "max") else None
+                )
                 slots.append([len(partial_aggs) - 1])
 
         partial_schema = tuple(
@@ -337,7 +348,10 @@ class Distributor:
                 L.OutCol(f"__g{i}", g.type, plan.schema[i].dict_id)
                 for i, g in enumerate(plan.group_exprs)
             ]
-            + [L.OutCol(f"__p{i}", a.type) for i, a in enumerate(partial_aggs)]
+            + [
+                L.OutCol(f"__p{i}", a.type, pdicts[i])
+                for i, a in enumerate(partial_aggs)
+            ]
         )
         partial = L.Aggregate(
             child, plan.group_exprs, tuple(partial_aggs), partial_schema
@@ -357,7 +371,10 @@ class Distributor:
             merge_aggs.append(E.AggCall(func, col, False, out_t))
         merge_schema = tuple(
             list(partial_schema[:ngroups])
-            + [L.OutCol(f"__m{i}", a.type) for i, a in enumerate(merge_aggs)]
+            + [
+                L.OutCol(f"__m{i}", a.type, pdicts[i])
+                for i, a in enumerate(merge_aggs)
+            ]
         )
         merged = L.Aggregate(src, merge_groups, tuple(merge_aggs), merge_schema)
 

@@ -327,3 +327,73 @@ def test_explain_tree_renders(catalog):
     )
     text = explain_tree(sp.root)
     assert "Aggregate" in text and "Scan" in text and "Limit" in text
+
+
+def test_correlated_scalar_subquery_decorrelates():
+    """Equality-correlated scalar-aggregate subqueries decorrelate to
+    a grouped LEFT join on the correlation keys (the classic aggregate
+    decorrelation; PG reaches the same via subplan params — here the
+    vectorized engine needs the join form)."""
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        "create table ct (k bigint, g bigint, v bigint) "
+        "distribute by shard(k)"
+    )
+    s.execute(
+        "insert into ct values (1,1,10),(2,1,20),(3,2,30),(4,2,5),"
+        "(5,3,7)"
+    )
+    # above-group-average
+    assert s.query(
+        "select k from ct a where v > (select avg(v) from ct b "
+        "where b.g = a.g) order by k"
+    ) == [(2,), (3,)]
+    # group-max membership
+    assert s.query(
+        "select k from ct a where v = (select max(v) from ct b "
+        "where b.g = a.g) order by k"
+    ) == [(2,), (3,), (5,)]
+    # COUNT over an empty correlated set compares as 0, not NULL
+    assert s.query(
+        "select k from ct a where (select count(*) from ct b "
+        "where b.g = a.g and b.v > 25) = 0 order by k"
+    ) == [(1,), (2,), (5,)]
+    # subquery on the LEFT side of the comparison
+    assert s.query(
+        "select k from ct a where (select min(v) from ct b "
+        "where b.g = a.g) = v order by k"
+    ) == [(1,), (4,), (5,)]
+    # inner-only predicates ride into the aggregate's input
+    assert s.query(
+        "select k from ct a where v > (select avg(v) from ct b "
+        "where b.g = a.g and b.v < 25) order by k"
+    ) == [(2,), (3,)]
+    # combined with other conjuncts and an outer aggregate on top
+    assert s.query(
+        "select g, count(*) from ct a where v >= (select avg(v) "
+        "from ct b where b.g = a.g) and k < 5 group by g order by g"
+    ) == [(1, 1), (2, 1)]
+    # uncorrelated scalars keep the standalone (InitPlan) path
+    assert s.query(
+        "select k from ct where v > (select avg(v) from ct) order by k"
+    ) == [(2,), (3,)]
+    # TEXT correlation keys join through aligned dictionaries
+    s.execute(
+        "create table cn (k bigint, nm text, v bigint) "
+        "distribute by shard(k)"
+    )
+    s.execute(
+        "insert into cn values (1,'a',10),(2,'a',20),(3,'b',30),"
+        "(4,'b',5),(5,'c',7)"
+    )
+    assert s.query(
+        "select k from cn a where v > (select avg(v) from cn b "
+        "where b.nm = a.nm) order by k"
+    ) == [(2,), (3,)]
+    # min over a text column through the correlated path
+    assert s.query(
+        "select k from cn a where (select min(nm) from cn b "
+        "where b.v = a.v) = 'a' order by k"
+    ) == [(1,), (2,)]

@@ -418,3 +418,31 @@ def test_dml_returning():
     )
     res = s.execute("insert into d (k) values (7) returning tag")
     assert res.rows == [("x",)]
+
+
+def test_text_min_max_collation_order():
+    """min/max over TEXT order by STRING, not dictionary code
+    (round-5 latent-bug find: codes are insertion-ordered, so 'z'
+    inserted first would win a code-order min). Host aggregates over
+    ORDER BY's dictionary ranks; device paths demote."""
+    from opentenbase_tpu.engine import Cluster
+
+    for ndn in (1, 2):
+        s = Cluster(num_datanodes=ndn, shard_groups=8).session()
+        s.execute(
+            "create table u (k bigint, g bigint, nm text) "
+            "distribute by shard(k)"
+        )
+        s.execute(
+            "insert into u values (1,0,'z'),(2,1,'a'),(3,0,'m'),"
+            "(4,1,'b'),(5,0,null)"
+        )
+        for fused in ("off", "on"):
+            s.execute(f"set enable_fused_execution = {fused}")
+            assert s.query("select min(nm), max(nm) from u") == [
+                ("a", "z")
+            ], (ndn, fused)
+            assert s.query(
+                "select g, min(nm), max(nm) from u group by g "
+                "order by g"
+            ) == [(0, "m", "z"), (1, "a", "b")], (ndn, fused)
