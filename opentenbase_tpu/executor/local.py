@@ -471,17 +471,8 @@ class LocalExecutor:
                 inv = None
                 if a.func in ("min", "max") and a.arg.type.is_text:
                     did = _texpr_did(a.arg, child.schema) or LITERAL_DICT
-                    ranks = self._dict_ranks(did)
+                    ranks, inv = self._dict_ranks(did, with_order=True)
                     d = ranks[jnp.clip(d, 0, ranks.shape[0] - 1)]
-                    # inverse permutation: rank -> dictionary code
-                    dic = self._dict(did)
-                    order = np.argsort(
-                        np.asarray(dic.values, dtype=object)
-                    ).astype(np.int32)
-                    pad = filt_ops.bucket_size(max(len(order), 1))
-                    invarr = np.zeros(pad, dtype=np.int32)
-                    invarr[: len(order)] = order
-                    inv = jnp.asarray(invarr)
                 specs.append(a.func)
                 vals.append((d, v))
                 self._agg_rank_inv.append(inv)
@@ -599,10 +590,15 @@ class LocalExecutor:
             keys.append((d, v, k.descending, k.nulls_first))
         return keys
 
-    def _dict_ranks(self, dict_id: str):
+    def _dict_ranks(self, dict_id: str, with_order: bool = False):
+        """code->collation-rank map (padded); with_order also returns
+        the INVERSE (rank->code) from the same single argsort —
+        callers needing both must not sort the dictionary twice."""
         dic = self._dict(dict_id)
         vals = dic.values
-        order = np.argsort(np.asarray(vals, dtype=object))
+        order = np.argsort(np.asarray(vals, dtype=object)).astype(
+            np.int32
+        )
         ranks = np.empty(max(len(vals), 1), dtype=np.int32)
         ranks[order if len(vals) else slice(0, 0)] = np.arange(
             len(vals), dtype=np.int32
@@ -610,7 +606,11 @@ class LocalExecutor:
         padded = filt_ops.bucket_size(max(len(vals), 1))
         out = np.zeros(padded, dtype=np.int32)
         out[: len(vals)] = ranks[: len(vals)]
-        return jnp.asarray(out)
+        if not with_order:
+            return jnp.asarray(out)
+        inv = np.zeros(padded, dtype=np.int32)
+        inv[: len(order)] = order
+        return jnp.asarray(out), jnp.asarray(inv)
 
     def _eval_sort(self, plan: L.Sort) -> DevBatch:
         child = self.eval(plan.child)

@@ -387,6 +387,13 @@ class Analyzer:
                         c.operand.query, not c.operand.negated
                     )
                 if isinstance(c, A.InSubquery):
+                    # correlated IN: rewrite to the EXISTS pull-up
+                    # (x IN (SELECT e FROM ...) == EXISTS(... AND
+                    # e = x), convert_ANY_sublink_to_join)
+                    pulled = self._in_corr_pullup(plan, scope, c)
+                    if pulled is not None:
+                        plan = pulled
+                        continue
                     plan = self._in_subquery_join(plan, scope, c)
                 elif isinstance(c, A.ExistsSubquery):
                     # correlated EXISTS -> semi/anti join when every
@@ -1602,6 +1609,15 @@ class Analyzer:
         path's error is the same one it raised before this feature)."""
         refs: list[A.ColumnRef] = []
 
+        def walk_field(v):
+            if isinstance(v, (list, tuple)):
+                for x in v:
+                    walk_field(x)  # nested tuples: CaseExpr.whens
+            elif isinstance(v, A.SelectItem):
+                walk(v.expr)
+            elif isinstance(v, A.Expr):
+                walk(v)
+
         def walk(node):
             if isinstance(node, (
                 A.ScalarSubquery, A.InSubquery, A.ExistsSubquery,
@@ -1615,17 +1631,7 @@ class Analyzer:
                 node, type
             ):
                 for f in dataclasses.fields(node):
-                    v = getattr(node, f.name)
-                    if isinstance(v, (list, tuple)):
-                        for x in v:
-                            if isinstance(x, (A.Expr, A.SelectItem)):
-                                walk(
-                                    x.expr
-                                    if isinstance(x, A.SelectItem)
-                                    else x
-                                )
-                    elif isinstance(v, A.Expr):
-                        walk(v)
+                    walk_field(getattr(node, f.name))
 
         for item in q.items:
             walk(item.expr)
@@ -1833,6 +1839,60 @@ class Analyzer:
             else self._make_cmp(c.op, outer_te, sq_col)
         )
         return new_plan, te
+
+    def _in_corr_pullup(self, plan, scope, c: A.InSubquery):
+        """Correlated IN: ``x IN (SELECT e FROM i WHERE corr)``
+        rewrites to EXISTS(SELECT 1 FROM i WHERE corr AND e = x) and
+        rides the EXISTS pull-up (convert_ANY_sublink_to_join).
+        Engages only when the subquery is actually correlated — the
+        plain membership path stays untouched otherwise — and the
+        operand is a bare outer column (the same unambiguous-shape
+        rule the EXISTS pull-up enforces)."""
+        if not isinstance(c.operand, A.ColumnRef):
+            return None
+        q = c.query
+        if (
+            q.group_by or q.having is not None or q.limit is not None
+            or q.offset is not None or q.distinct or q.set_ops
+            or q.ctes or q.from_clause is None or q.where is None
+            or len(q.items) != 1
+            or self._contains_agg(q.items[0].expr)
+        ):
+            return None
+        mark = len(self.subplans)
+        try:
+            _, inner_scope = self._from(q.from_clause)
+        except AnalyzeError:
+            del self.subplans[mark:]
+            return None
+        inner_ctx = ExprContext(inner_scope, self)
+        correlated = self._has_unresolved_ref(q, inner_ctx)
+        if correlated:
+            # the spliced `e = x` conjunct resolves innermost-first:
+            # if the inner scope CAPTURES the operand's name, the
+            # equality would silently degenerate to an inner-only
+            # tautology — bail to the pre-feature error instead
+            m2 = len(self.subplans)
+            try:
+                self.expr(c.operand, inner_ctx)
+                correlated = False  # capturable: ambiguous, bail
+            except AnalyzeError:
+                pass
+            del self.subplans[m2:]
+        del self.subplans[mark:]
+        if not correlated:
+            return None
+        q2 = A.Select(
+            items=[A.SelectItem(A.Literal(1))],
+            from_clause=q.from_clause,
+            where=A.BinOp(
+                "and", q.where,
+                A.BinOp("=", q.items[0].expr, c.operand),
+            ),
+        )
+        return self._exists_subquery_join(
+            plan, scope, A.ExistsSubquery(q2, c.negated)
+        )
 
     def _exists_subquery_join(
         self, plan: L.LogicalPlan, scope: Scope, c: A.ExistsSubquery

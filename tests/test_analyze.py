@@ -397,3 +397,37 @@ def test_correlated_scalar_subquery_decorrelates():
         "select k from cn a where (select min(nm) from cn b "
         "where b.v = a.v) = 'a' order by k"
     ) == [(1,), (2,)]
+
+
+def test_correlated_in_subquery_pullup():
+    """Correlated IN rewrites to the EXISTS pull-up
+    (convert_ANY_sublink_to_join): multi-key semi/anti join."""
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute("create table ia (k bigint, g bigint) distribute by shard(k)")
+    s.execute("create table ib (x bigint, g bigint) distribute by shard(x)")
+    s.execute("insert into ia values (1,1),(2,1),(3,2),(4,3)")
+    s.execute("insert into ib values (1,1),(3,2),(9,2)")
+    assert s.query(
+        "select k from ia where k in (select x from ib "
+        "where ib.g = ia.g) order by k"
+    ) == [(1,), (3,)]
+    assert s.query(
+        "select k from ia where k not in (select x from ib "
+        "where ib.g = ia.g) order by k"
+    ) == [(2,), (4,)]
+    # uncorrelated membership keeps the plain semi-join path
+    assert s.query(
+        "select k from ia where k in (select x from ib) order by k"
+    ) == [(1,), (3,)]
+    # an operand whose name the inner scope CAPTURES must not pull up
+    # (the spliced equality would degenerate to an inner tautology) —
+    # it keeps the pre-feature unresolved-column error
+    import pytest as _pytest
+
+    with _pytest.raises(Exception, match="does not exist"):
+        s.query(
+            "select k from ia where g in (select g from ib "
+            "where ib.x = ia.k)"
+        )
