@@ -460,7 +460,25 @@ class Analyzer:
             gctx = None
             post_scope = scope
         else:
-            out_exprs, out_schema = self._select_items(sel.items, ctx, scope)
+            # BARE correlated scalar-aggregate subqueries as select
+            # items decorrelate the same way WHERE conjuncts do; the
+            # joined value column replaces the subquery expression
+            pre_cols: dict = {}
+            for ii, item in enumerate(sel.items):
+                if isinstance(item.expr, A.ScalarSubquery):
+                    out = self._decorr_scalar(plan, scope, item.expr)
+                    if out is not None:
+                        plan, te_col = out
+                        # dict id resolved against the JOINED schema
+                        # (a TEXT min/max value column keeps its
+                        # table dictionary)
+                        pre_cols[ii] = (
+                            te_col,
+                            _expr_dict_id(te_col, plan.schema),
+                        )
+            out_exprs, out_schema = self._select_items(
+                sel.items, ctx, scope, pre_cols=pre_cols
+            )
             gctx = None
             post_scope = scope
 
@@ -625,11 +643,20 @@ class Analyzer:
     # Select items / aggregation
     # ------------------------------------------------------------------
     def _select_items(
-        self, items: list[A.SelectItem], ctx: ExprContext, scope: Scope
+        self, items: list[A.SelectItem], ctx: ExprContext, scope: Scope,
+        pre_cols=None,
     ) -> tuple[list[E.TExpr], list[L.OutCol]]:
+        """``pre_cols``: item index -> pre-analyzed TExpr (decorrelated
+        scalar subqueries whose value column already joined in)."""
         out_exprs: list[E.TExpr] = []
         out_schema: list[L.OutCol] = []
-        for item in items:
+        for ii, item in enumerate(items):
+            if pre_cols and ii in pre_cols:
+                te, did = pre_cols[ii]
+                name = item.alias or _default_name(item.expr)
+                out_exprs.append(te)
+                out_schema.append(L.OutCol(name, te.type, did))
+                continue
             if isinstance(item.expr, A.Star):
                 matched = 0
                 for i, c in enumerate(scope.cols):
@@ -1670,13 +1697,11 @@ class Analyzer:
 
     def _try_corr_scalar(self, plan, scope, c: A.Expr):
         """Decorrelate ``<outer> <cmp> (SELECT agg(x) FROM i WHERE
-        eq-correlations [AND inner preds])`` — the scalar-sublink
-        pull-up PG performs in convert_ANY/EXISTS + the classic
-        Kim-style aggregate decorrelation: the subquery becomes a
-        grouped aggregate LEFT-joined on the correlation keys and the
-        conjunct compares against the joined aggregate column. Returns
-        (new_plan, conjunct_texpr) or None (caller falls back to the
-        ordinary path, which handles uncorrelated scalars)."""
+        eq-correlations [AND inner preds])``: _decorr_scalar builds
+        the grouped LEFT join and this wrapper compares against the
+        joined aggregate column. Returns (new_plan, conjunct_texpr)
+        or None (caller falls back to the ordinary path, which handles
+        uncorrelated scalars)."""
         if not (isinstance(c, A.BinOp) and c.op in _CMP):
             return None
         flipped = False
@@ -1685,6 +1710,30 @@ class Analyzer:
             outer_ast, sub, flipped = sub, outer_ast, True
         if not isinstance(sub, A.ScalarSubquery):
             return None
+        out = self._decorr_scalar(plan, scope, sub)
+        if out is None:
+            return None
+        new_plan, sq_col = out
+        outer_ctx = ExprContext(scope, self)
+        m5 = len(self.subplans)
+        try:
+            outer_te = self.expr(outer_ast, outer_ctx)
+        except AnalyzeError:
+            del self.subplans[m5:]
+            return None
+        te = (
+            self._make_cmp(c.op, sq_col, outer_te)
+            if flipped
+            else self._make_cmp(c.op, outer_te, sq_col)
+        )
+        return new_plan, te
+
+    def _decorr_scalar(self, plan, scope, sub: A.ScalarSubquery):
+        """The Kim-style aggregate decorrelation core: an equality-
+        correlated scalar-aggregate subquery becomes a grouped
+        aggregate LEFT-joined on the correlation keys. Returns
+        (new_plan, value_texpr) — the value column the caller projects
+        or compares — or None when the shape doesn't fit."""
         q = sub.query
         if (
             q.group_by or q.having is not None or q.limit is not None
@@ -1827,18 +1876,7 @@ class Analyzer:
             sq_col = E.FuncE(
                 "coalesce", (sq_col, E.Const(0, t.INT8)), t.INT8
             )
-        m5 = len(self.subplans)
-        try:
-            outer_te = self.expr(outer_ast, outer_ctx)
-        except AnalyzeError:
-            del self.subplans[m5:]
-            return bail()
-        te = (
-            self._make_cmp(c.op, sq_col, outer_te)
-            if flipped
-            else self._make_cmp(c.op, outer_te, sq_col)
-        )
-        return new_plan, te
+        return new_plan, sq_col
 
     def _in_corr_pullup(self, plan, scope, c: A.InSubquery):
         """Correlated IN: ``x IN (SELECT e FROM i WHERE corr)``
@@ -1847,8 +1885,11 @@ class Analyzer:
         Engages only when the subquery is actually correlated — the
         plain membership path stays untouched otherwise — and the
         operand is a bare outer column (the same unambiguous-shape
-        rule the EXISTS pull-up enforces)."""
-        if not isinstance(c.operand, A.ColumnRef):
+        rule the EXISTS pull-up enforces). NOT IN is excluded: its
+        NULL semantics (any NULL in the set nullifies the predicate)
+        differ from an anti join — PG's convert_ANY_sublink_to_join
+        applies only to non-negated ANY for the same reason."""
+        if c.negated or not isinstance(c.operand, A.ColumnRef):
             return None
         q = c.query
         if (
