@@ -2718,10 +2718,22 @@ class Session:
                 TABLE_SHARED, **self._lock_opts(),
             )
             spec = self.cluster.partitions.get(iplan.table)
+            n_upd = 0
+            upd_batches: list[ColumnBatch] = []
+            if stmt.on_conflict is not None:
+                if spec is not None:
+                    raise SQLError(
+                        "ON CONFLICT on partitioned tables is not "
+                        "supported"
+                    )
+                full, n_upd, upd_batches = self._apply_on_conflict(
+                    meta, stmt.on_conflict, full, txn
+                )
             if spec is not None:
                 n = self._partition_and_append(spec, full, txn)
             else:
                 n = self._route_and_append(meta, full, txn)
+            n += n_upd
         except Exception:
             if implicit:
                 self._abort_txn(txn)
@@ -2731,8 +2743,183 @@ class Session:
         else:
             self.txn = txn
         if ret is not None:
-            return self._returning_result("INSERT", ret, full, n)
+            # upsert RETURNING covers inserted AND updated rows
+            # (ExecOnConflictUpdate projects both)
+            batch = (
+                self._concat_affected(meta, [full] + upd_batches)
+                if upd_batches else full
+            )
+            return self._returning_result("INSERT", ret, batch, n)
         return Result("INSERT", rowcount=n)
+
+    def _apply_on_conflict(
+        self, meta: TableMeta, oc, full: ColumnBatch, txn
+    ):
+        """INSERT ... ON CONFLICT over the PRIMARY KEY arbiter
+        (speculative insertion, src/backend/executor/nodeModifyTable.c
+        ExecOnConflictUpdate): conflicting proposed rows are dropped
+        (DO NOTHING) or turn into an update of the existing row
+        (DO UPDATE, with ``excluded.col`` naming the proposed values).
+        Same colocation rule as PK enforcement. Returns
+        (non-conflicting batch, rows updated)."""
+        from opentenbase_tpu.storage.table import INF_TS
+
+        target, action, sets = oc
+        pk = getattr(meta, "primary_key", None)
+        if pk is None or not self._pk_colocated(meta, pk) or (
+            target is not None and target != pk
+        ):
+            if action == "nothing" and target is None:
+                # targetless DO NOTHING needs no arbiter: with none
+                # available it degrades to a plain insert (PG infers
+                # zero arbiters and allows it)
+                return full, 0, []
+            raise SQLError(
+                "there is no unique or exclusion constraint matching "
+                "the ON CONFLICT specification"
+            )
+        vals = np.asarray(full.columns[pk].data)
+        pv = full.columns[pk].validity
+        notnull = (
+            np.ones(len(vals), dtype=bool) if pv is None
+            else np.asarray(pv)
+        )
+        nn_vals = vals[notnull]
+        if action == "update" and len(np.unique(nn_vals)) != len(
+            nn_vals
+        ):
+            raise SQLError(
+                "ON CONFLICT DO UPDATE command cannot affect row a "
+                "second time"
+            )
+        conflict = np.zeros(len(vals), dtype=bool)
+        n_updated = 0
+        newbs: list[ColumnBatch] = []
+        for node in meta.node_indices:
+            store = self.cluster.stores[node].get(meta.name)
+            if store is None or store.nrows == 0:
+                continue
+            n0 = store.nrows
+            live = store.xmax_ts[:n0] == INF_TS
+            tw = txn.writes.get(node, {}).get(meta.name)
+            if tw is not None and tw.del_idx:
+                live[np.asarray(tw.del_idx, dtype=np.int64)] = False
+            keycol = store.column_array(pk, n0)
+            # a NULL key conflicts with nothing: it flows through to
+            # the insert path, where the NOT NULL check rejects it
+            hit = np.isin(vals, keycol[live]) & notnull
+            if action == "update" and hit.any():
+                pos_live = np.nonzero(live)[0]
+                sel = np.isin(keycol[pos_live], vals[hit])
+                idx = pos_live[sel]
+                old = store.to_batch().take(idx)
+                okeys = np.asarray(old.columns[pk].data)
+                prop_pos = {k: i for i, k in enumerate(vals.tolist())}
+                align = np.asarray(
+                    [prop_pos[k] for k in okeys.tolist()],
+                    dtype=np.int64,
+                )
+                self._acquire_row_locks(
+                    txn, meta.name, node, idx, ROW_UPDATE
+                )
+                txn.pin(store)
+                txn.w(node, meta.name).del_idx.extend(idx.tolist())
+                newbs.append(
+                    self._upsert_new_batch(meta, old, full, align, sets)
+                )
+                n_updated += len(idx)
+                if meta.dist.is_replicated:
+                    # one replica's copy is the truth; the re-insert
+                    # fans back out to every replica (the UPDATE
+                    # path's rule)
+                    newbs = newbs[:1]
+                    n_updated = len(idx)
+            conflict |= hit
+        for nb in newbs:
+            self._route_and_append(meta, nb, txn)
+        keep = full.take(np.nonzero(~conflict)[0])
+        if action == "nothing" and keep.nrows:
+            # duplicates WITHIN the statement: the first proposed row
+            # inserts, later ones conflict against it (PG processes
+            # rows sequentially); NULL keys are never duplicates
+            kv = np.asarray(keep.columns[pk].data)
+            kn = (
+                np.ones(keep.nrows, dtype=bool)
+                if keep.columns[pk].validity is None
+                else np.asarray(keep.columns[pk].validity)
+            )
+            seen: set = set()
+            sel = []
+            for i in range(keep.nrows):
+                if not kn[i]:
+                    sel.append(i)
+                    continue
+                if kv[i] not in seen:
+                    seen.add(kv[i])
+                    sel.append(i)
+            if len(sel) != keep.nrows:
+                keep = keep.take(np.asarray(sel, dtype=np.int64))
+        return keep, n_updated, newbs
+
+    @staticmethod
+    def _pk_colocated(meta: TableMeta, pk) -> bool:
+        """Duplicates are guaranteed colocated — THE one rule shared
+        by PK enforcement and the ON CONFLICT arbiter."""
+        return meta.dist.is_replicated or tuple(
+            meta.dist.key_columns
+        ) == (pk,)
+
+    def _upsert_new_batch(
+        self, meta: TableMeta, old: ColumnBatch, full: ColumnBatch,
+        align: np.ndarray, sets,
+    ) -> ColumnBatch:
+        """The DO UPDATE row images: start from the existing rows,
+        apply SET items — ``excluded.col`` (the proposed row), a bare
+        column (the existing row), or a constant."""
+        out = {
+            name: Column(col.type, col.data, col.validity, col.dictionary)
+            for name, col in old.columns.items()
+        }
+        n = old.nrows
+        for col, expr in sets:
+            if col not in meta.schema:
+                raise SQLError(f'column "{col}" does not exist')
+            ty = meta.schema[col]
+            if (
+                isinstance(expr, A.ColumnRef)
+                and expr.table == "excluded"
+            ):
+                if expr.name not in full.columns:
+                    raise SQLError(
+                        f'column "excluded.{expr.name}" does not exist'
+                    )
+                src = full.columns[expr.name]
+                out[col] = Column(
+                    ty,
+                    np.asarray(src.data)[align],
+                    None if src.validity is None
+                    else np.asarray(src.validity)[align],
+                    src.dictionary,
+                )
+            elif isinstance(expr, A.ColumnRef) and expr.table in (
+                None, meta.name,
+            ):
+                if expr.name not in old.columns:
+                    raise SQLError(
+                        f'column "{expr.name}" does not exist'
+                    )
+                src = old.columns[expr.name]
+                out[col] = Column(ty, src.data, src.validity, src.dictionary)
+            elif isinstance(expr, A.Literal):
+                out[col] = column_from_python(
+                    [expr.value] * n, ty, meta.dictionaries.get(col)
+                )
+            else:
+                raise SQLError(
+                    "ON CONFLICT DO UPDATE supports excluded.col, "
+                    "column, and constant assignments"
+                )
+        return ColumnBatch(out, n)
 
     def _partition_and_append(self, spec, full: ColumnBatch, txn) -> int:
         """Split the batch by partition boundaries, then shard-route each
@@ -2812,10 +2999,7 @@ class Session:
         pk = getattr(meta, "primary_key", None)
         if pk is None:
             return
-        colocated = meta.dist.is_replicated or tuple(
-            meta.dist.key_columns
-        ) == (pk,)
-        if not colocated:
+        if not self._pk_colocated(meta, pk):
             return
         from opentenbase_tpu.storage.table import INF_TS
 

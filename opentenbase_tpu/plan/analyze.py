@@ -317,6 +317,7 @@ class Analyzer:
         base = A.Select(
             items=sel.items, from_clause=sel.from_clause, where=sel.where,
             group_by=sel.group_by, having=sel.having, distinct=sel.distinct,
+            values_rows=sel.values_rows,
         )
         plan = self._select_core(base)
         for op, branch_ast in sel.set_ops:
@@ -365,7 +366,9 @@ class Analyzer:
 
     def _select_core(self, sel: A.Select) -> L.LogicalPlan:
         # FROM
-        if sel.from_clause is not None:
+        if sel.values_rows and not sel.items:
+            plan, scope = self._values_stmt(sel)
+        elif sel.from_clause is not None:
             plan, scope = self._from(sel.from_clause)
         else:
             plan, scope = self._no_from(sel)
@@ -559,6 +562,45 @@ class Analyzer:
         """SELECT without FROM: one-row ValuesScan."""
         plan = L.ValuesScan(((),), ())
         return plan, Scope([])
+
+    def _values_stmt(self, sel: A.Select) -> tuple[L.LogicalPlan, Scope]:
+        """Standalone VALUES (...), (...): a ValuesScan with PG's
+        column1..columnN names; column types unify across rows.
+        Synthesizes the select list so projection/ORDER BY/set ops all
+        run the ordinary path."""
+        ectx = ExprContext(Scope([]), self)
+        rows_te = []
+        arity = len(sel.values_rows[0])
+        for row in sel.values_rows:
+            if len(row) != arity:
+                raise AnalyzeError(
+                    "VALUES lists must all be the same length"
+                )
+            rows_te.append([self.expr(v, ectx) for v in row])
+        types = []
+        for i in range(arity):
+            ty = rows_te[0][i].type
+            for r in rows_te[1:]:
+                if r[i].type != ty:
+                    ty = _common_input_type(ty, r[i].type, "VALUES")
+            types.append(ty)
+        rows_cast = tuple(
+            tuple(_cast(v, ty) for v, ty in zip(r, types))
+            for r in rows_te
+        )
+        schema = tuple(
+            L.OutCol(f"column{i + 1}", ty)
+            for i, ty in enumerate(types)
+        )
+        plan = L.ValuesScan(rows_cast, schema)
+        scope = Scope([
+            ScopeCol(None, c.name, c.type, c.dict_id) for c in schema
+        ])
+        sel.items = [
+            A.SelectItem(A.ColumnRef(c.name, None)) for c in schema
+        ]
+        sel.values_rows = []
+        return plan, scope
 
     # ------------------------------------------------------------------
     # FROM clause

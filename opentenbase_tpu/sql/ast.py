@@ -281,6 +281,8 @@ class Select(Statement):
     # WITH clause: [(name, column_aliases, Select)] — statement-scoped
     # views, expanded by plan/views.py expand_ctes before analysis
     ctes: list = field(default_factory=list)
+    # standalone VALUES (...), (...) rows; items is empty then
+    values_rows: list = field(default_factory=list)
 
 
 @dataclass
@@ -290,6 +292,9 @@ class Insert(Statement):
     values: list[list[Expr]]  # VALUES rows
     query: Optional[Select] = None  # INSERT ... SELECT
     returning: list[SelectItem] = field(default_factory=list)
+    # ON CONFLICT [(col)] DO NOTHING | DO UPDATE SET ...:
+    # (target_col|None, "nothing"|"update", [(col, Expr)])
+    on_conflict: Optional[tuple] = None
 
 
 @dataclass

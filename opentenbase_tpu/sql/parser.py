@@ -117,7 +117,7 @@ class Parser:
         if t.kind != Tok.IDENT:
             self.error("expected statement")
         kw = t.value
-        if kw in ("select", "values", "with"):
+        if kw in ("select", "values", "with", "table"):
             return self.parse_select()
         if kw == "insert":
             return self.parse_insert()
@@ -325,6 +325,22 @@ class Parser:
             sel = self.parse_select()
             self.expect_op(")")
             return sel
+        if self.eat_kw("values"):
+            # standalone VALUES lists (gram.y values_clause as a full
+            # statement; also composes under set ops / ORDER BY)
+            rows = [self._values_row()]
+            while self.eat_op(","):
+                rows.append(self._values_row())
+            sel = A.Select(items=[])
+            sel.values_rows = rows
+            self._order_limit(sel)
+            return sel
+        if self.eat_kw("table"):
+            # TABLE name == SELECT * FROM name (gram.y simple form)
+            sel = A.Select(items=[A.SelectItem(A.Star())])
+            sel.from_clause = A.RelRef(self.ident("table name"), None)
+            self._order_limit(sel)
+            return sel
         self.expect_kw("select")
         distinct = False
         if self.eat_kw("distinct"):
@@ -455,8 +471,9 @@ class Parser:
 
     def _table_ref(self) -> A.TableRef:
         if self.eat_op("("):
-            if self.at_kw("select") or self.at_kw("with") or (
-                self.at_op("(")
+            if (
+                self.at_kw("select") or self.at_kw("with")
+                or self.at_kw("values") or self.at_op("(")
             ):
                 query = self.parse_select()
                 self.expect_op(")")
@@ -497,6 +514,28 @@ class Parser:
             stmt = A.Insert(table, columns, rows)
         else:
             stmt = A.Insert(table, columns, [], query=self.parse_select())
+        if self.eat_kw("on"):
+            # ON CONFLICT [(col)] DO NOTHING | DO UPDATE SET c = e, ...
+            # (gram.y opt_on_conflict; speculative insertion arbiter)
+            self.expect_kw("conflict")
+            target = None
+            if self.eat_op("("):
+                target = self.ident("conflict column")
+                self.expect_op(")")
+            self.expect_kw("do")
+            if self.eat_kw("nothing"):
+                stmt.on_conflict = (target, "nothing", [])
+            else:
+                self.expect_kw("update")
+                self.expect_kw("set")
+                sets = []
+                while True:
+                    col = self.ident("column")
+                    self.expect_op("=")
+                    sets.append((col, self.parse_expr()))
+                    if not self.eat_op(","):
+                        break
+                stmt.on_conflict = (target, "update", sets)
         if self.eat_kw("returning"):
             stmt.returning = [self._select_item()]
             while self.eat_op(","):

@@ -186,3 +186,85 @@ def test_primary_key_implies_not_null():
         s.execute("insert into t (v) values ('a')")
     s.execute("insert into t values (0, 'zero')")
     assert s.query("select count(*) from t") == [(1,)]
+
+
+def test_insert_on_conflict_upsert():
+    """INSERT ... ON CONFLICT over the PK arbiter
+    (ExecOnConflictUpdate): DO NOTHING drops conflicting proposed rows
+    (incl. within-statement dups), DO UPDATE rewrites the existing row
+    with excluded.*/column/constant assignments."""
+    import pytest
+
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        "create table t (k bigint primary key, g bigint, v bigint) "
+        "distribute by shard(k)"
+    )
+    s.execute("insert into t values (1,1,10),(2,1,20)")
+    r = s.execute(
+        "insert into t values (1,9,9),(3,3,30),(3,4,40) "
+        "on conflict do nothing"
+    )
+    assert r.rowcount == 1
+    assert s.query("select * from t order by k") == [
+        (1, 1, 10), (2, 1, 20), (3, 3, 30),
+    ]
+    r = s.execute(
+        "insert into t values (1,5,55),(4,4,40) on conflict (k) "
+        "do update set v = excluded.v, g = excluded.g"
+    )
+    assert r.rowcount == 2  # one inserted + one updated
+    assert s.query("select * from t order by k") == [
+        (1, 5, 55), (2, 1, 20), (3, 3, 30), (4, 4, 40),
+    ]
+    s.execute(
+        "insert into t values (2,0,0) on conflict (k) "
+        "do update set v = 999"
+    )
+    assert s.query("select v from t where k = 2") == [(999,)]
+    with pytest.raises(Exception, match="a second time"):
+        s.execute(
+            "insert into t values (7,7,7),(7,8,8) on conflict (k) "
+            "do update set v = excluded.v"
+        )
+    with pytest.raises(Exception, match="no unique"):
+        s.execute(
+            "insert into t values (9,9,9) on conflict (g) do nothing"
+        )
+    # CROSS-NODE upsert: conflicting keys living on DIFFERENT
+    # datanodes must each be updated (not silently deleted)
+    r = s.execute(
+        "insert into t select k, 0, k * 1000 from t "
+        "on conflict (k) do update set v = excluded.v"
+    )
+    assert r.rowcount == 4
+    assert s.query("select k, v from t order by k") == [
+        (1, 1000), (2, 2000), (3, 3000), (4, 4000),
+    ]
+    # upsert RETURNING covers inserted AND updated rows
+    r = s.execute(
+        "insert into t values (4, 0, 7), (50, 0, 8) on conflict (k) "
+        "do update set v = excluded.v returning k, v"
+    )
+    assert sorted(r.rows) == [(4, 7), (50, 8)]
+    # NULL key rows never conflict: the NOT NULL check rejects them
+    with pytest.raises(Exception, match="not-null"):
+        s.execute(
+            "insert into t values (null, 0, 0) on conflict do nothing"
+        )
+    # targetless DO NOTHING without any PK degrades to a plain insert
+    s.execute("create table np (a bigint) distribute by shard(a)")
+    s.execute("insert into np values (1) on conflict do nothing")
+    assert s.query("select count(*) from np") == [(1,)]
+    # upserting inside an explicit txn and rolling back restores all
+    before = s.query("select v from t where k = 2")
+    s.execute("begin")
+    s.execute(
+        "insert into t values (2,0,0) on conflict (k) "
+        "do update set v = 1"
+    )
+    assert s.query("select v from t where k = 2") == [(1,)]
+    s.execute("rollback")
+    assert s.query("select v from t where k = 2") == before

@@ -299,3 +299,26 @@ def test_row_value_in_desugars():
     ) == [(1,), (3,)]
     with pytest.raises(Exception, match="same arity"):
         s.query("select k from rv where (k, v) = (1, 2, 3)")
+
+
+def test_values_and_table_statements():
+    """Standalone VALUES lists and the TABLE shorthand (gram.y
+    values_clause / simple TABLE form)."""
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=1, shard_groups=8).session()
+    s.execute("create table vt (k bigint, w text) distribute by roundrobin")
+    s.execute("insert into vt values (1,'a'),(2,'b')")
+    assert s.query("values (1, 'x'), (2, 'y')") == [(1, "x"), (2, "y")]
+    assert s.query(
+        "values (3, 4) union all values (5, 6) order by 1 desc"
+    ) == [(5, 6), (3, 4)]
+    assert sorted(s.query("table vt")) == [(1, "a"), (2, "b")]
+    assert s.query(
+        "select column1 + column2 from (values (1, 10)) vv"
+    ) == [(11,)]
+    assert s.query(
+        "select * from (values (1, 10), (2, 20)) vv order by 1"
+    ) == [(1, 10), (2, 20)]
+    # mixed numeric types unify
+    assert s.query("values (1, 2.5), (3, 4)") == [(1, 2.5), (3, 4.0)]
