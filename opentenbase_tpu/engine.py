@@ -3032,6 +3032,9 @@ class Session:
 
     # -- UPDATE / DELETE -------------------------------------------------
     def _x_delete(self, stmt: A.Delete) -> Result:
+        if stmt.from_table is not None:
+            return self._dml_from(stmt, update=False)
+        self._fold_dml_alias(stmt)
         self._shard_barrier_gate()
         splan = analyze_statement(stmt, self.cluster.catalog)
         dplan = splan.root
@@ -3090,7 +3093,59 @@ class Session:
             )
         return Result("DELETE", rowcount=total)
 
+    @staticmethod
+    def _fold_dml_alias(stmt) -> None:
+        """A target alias without FROM/USING: qualifier references to
+        the alias rewrite to the table name so the plain analyzer
+        resolves them (transformUpdateStmt's rangetable alias)."""
+        alias = getattr(stmt, "alias", None)
+        if not alias or alias == stmt.table:
+            return
+        import dataclasses as _dc
+
+        def walk(e):
+            if isinstance(e, A.ColumnRef) and e.table == alias:
+                return _dc.replace(e, table=stmt.table)
+            if isinstance(e, A.Star) and e.table == alias:
+                return _dc.replace(e, table=stmt.table)
+            if _dc.is_dataclass(e) and not isinstance(e, type):
+                ch = {}
+                for f in _dc.fields(e):
+                    v = getattr(e, f.name)
+                    if isinstance(v, A.Expr):
+                        nv = walk(v)
+                        if nv is not v:
+                            ch[f.name] = nv
+                    elif isinstance(v, (list, tuple)):
+                        nv = [
+                            walk(x) if isinstance(x, A.Expr) else x
+                            for x in v
+                        ]
+                        if any(a is not b for a, b in zip(nv, v)):
+                            ch[f.name] = type(v)(nv)
+                if ch:
+                    try:
+                        return _dc.replace(e, **ch)
+                    except TypeError:
+                        for k, v in ch.items():
+                            setattr(e, k, v)
+            return e
+
+        if stmt.where is not None:
+            stmt.where = walk(stmt.where)
+        for i, (c, e) in enumerate(
+            getattr(stmt, "assignments", []) or []
+        ):
+            stmt.assignments[i] = (c, walk(e))
+        for i, item in enumerate(stmt.returning or []):
+            ne = walk(item.expr)
+            if ne is not item.expr:
+                stmt.returning[i] = _dc.replace(item, expr=ne)
+
     def _x_update(self, stmt: A.Update) -> Result:
+        if stmt.from_table is not None:
+            return self._dml_from(stmt, update=True)
+        self._fold_dml_alias(stmt)
         self._shard_barrier_gate()
         splan = analyze_statement(stmt, self.cluster.catalog)
         uplan = splan.root
@@ -3151,6 +3206,306 @@ class Session:
                 self._concat_affected(meta, new_batches), total,
             )
         return Result("UPDATE", rowcount=total)
+
+    def _dml_from(self, stmt, update: bool) -> Result:
+        """UPDATE ... FROM / DELETE ... USING: join the target table
+        against ONE source table and update/delete the matched target
+        rows (the reference plans these as a join feeding ModifyTable,
+        nodeModifyTable.c). Evaluated per target node as an ordinary
+        executor join over (target rows + a position column, gathered
+        source), so SET and WHERE get full expression power over both
+        sides; an equality conjunct pairing the two sides is required
+        (the join key)."""
+        from opentenbase_tpu.plan import texpr as TE
+        from opentenbase_tpu.plan.analyze import (
+            Analyzer,
+            ExprContext,
+            Scope,
+            ScopeCol,
+            _bool_type,
+            _cast,
+            _common_input_type,
+        )
+        from opentenbase_tpu.plan.distribute import RemoteSource
+
+        self._shard_barrier_gate()
+        meta = self.cluster.catalog.get(stmt.table)
+        if meta.foreign is not None:
+            raise SQLError(
+                f'cannot change foreign table "{meta.name}"'
+            )
+        src_name, src_alias = stmt.from_table
+        smeta = self.cluster.catalog.get(src_name)
+        if stmt.where is None:
+            raise SQLError(
+                "UPDATE ... FROM / DELETE ... USING require a WHERE "
+                "join condition"
+            )
+        ret = (
+            self._validate_returning(meta, stmt.returning)
+            if stmt.returning else None
+        )
+        tq = stmt.alias or stmt.table
+        sq = src_alias or src_name
+
+        def dictid(table, col, ty):
+            return f"{table}.{col}" if ty.id == t.TypeId.TEXT else None
+
+        tcols = list(meta.schema.items())
+        scols = list(smeta.schema.items())
+        nt = len(tcols)
+        scope_cols = (
+            [
+                ScopeCol(tq, c, ty, dictid(stmt.table, c, ty))
+                for c, ty in tcols
+            ]
+            + [
+                ScopeCol(sq, c, ty, dictid(src_name, c, ty))
+                for c, ty in scols
+            ]
+        )
+        an = Analyzer(self.cluster.catalog)
+        ctx = ExprContext(Scope(scope_cols), an)
+
+        def side(te) -> str:
+            cols = set()
+
+            def walk(e):
+                if isinstance(e, TE.Col):
+                    cols.add(e.index)
+                for ch in e.children():
+                    walk(ch)
+
+            walk(te)
+            if cols and max(cols) >= nt and min(cols) >= nt:
+                return "s"
+            if cols and max(cols) < nt:
+                return "t"
+            return "mixed" if cols else "none"
+
+        from opentenbase_tpu.plan.analyze import _split_and
+
+        lkeys: list = []
+        rkeys: list = []
+        residual = None
+        for conj in _split_and(stmt.where):
+            te = _bool_type(an.expr(conj, ctx))
+            added = False
+            if isinstance(te, TE.BinE) and te.op == "=":
+                ls, rs = side(te.left), side(te.right)
+                if (ls, rs) == ("t", "s"):
+                    lk, rk = te.left, te.right
+                    added = True
+                elif (ls, rs) == ("s", "t"):
+                    lk, rk = te.right, te.left
+                    added = True
+                if added:
+                    if lk.type != rk.type:
+                        ct = _common_input_type(lk.type, rk.type, "=")
+                        lk, rk = _cast(lk, ct), _cast(rk, ct)
+                    lkeys.append(lk)
+                    rkeys.append(rk)
+            if not added:
+                residual = (
+                    te if residual is None
+                    else TE.BinE("and", residual, te, t.BOOL)
+                )
+        if an.subplans:
+            raise SQLError(
+                "subqueries are not supported in UPDATE ... FROM / "
+                "DELETE ... USING conditions"
+            )
+        if not lkeys:
+            raise SQLError(
+                "UPDATE ... FROM / DELETE ... USING need an equality "
+                "condition joining the two tables"
+            )
+        # source gathered once through the ordinary read machinery
+        src_batch = self._run_select(
+            parse(f"select * from {src_name}")[0]
+        )
+        # schemas for the two RemoteSources: target cols + __pos
+        t_schema = tuple(
+            [
+                L.OutCol(c, ty, dictid(stmt.table, c, ty))
+                for c, ty in tcols
+            ]
+            + [L.OutCol("__pos", t.INT8)]
+        )
+        s_schema = tuple(
+            L.OutCol(c, ty, dictid(src_name, c, ty))
+            for c, ty in scols
+        )
+        # ONE column-index rewriter: analysis positions are [t][s];
+        # the join OUTPUT is [t][__pos][s] (remap) and the RIGHT child
+        # alone is [s] (rebase)
+        def _rewrite_cols(te, fn):
+            import dataclasses as _dc
+
+            if isinstance(te, TE.Col):
+                ni = fn(te.index)
+                return te if ni == te.index else _dc.replace(
+                    te, index=ni
+                )
+            if _dc.is_dataclass(te) and not isinstance(te, type):
+                ch = {}
+                for f in _dc.fields(te):
+                    v = getattr(te, f.name)
+                    if isinstance(v, TE.TExpr):
+                        nv = _rewrite_cols(v, fn)
+                        if nv is not v:
+                            ch[f.name] = nv
+                    elif isinstance(v, tuple) and any(
+                        isinstance(x, TE.TExpr) for x in v
+                    ):
+                        ch[f.name] = tuple(
+                            _rewrite_cols(x, fn)
+                            if isinstance(x, TE.TExpr) else x
+                            for x in v
+                        )
+                if ch:
+                    return _dc.replace(te, **ch)
+            return te
+
+        def remap(te):
+            return _rewrite_cols(
+                te, lambda i: i + 1 if i >= nt else i
+            )
+
+        rkeys = [
+            _rewrite_cols(k, lambda i: i - nt if i >= nt else i)
+            for k in rkeys
+        ]
+        jschema = tuple(t_schema) + s_schema
+        join = L.Join(
+            RemoteSource(0, t_schema),
+            RemoteSource(1, s_schema),
+            "inner", tuple(lkeys), tuple(rkeys), None, jschema,
+        )
+        # residual and SET expressions evaluate over the JOIN output
+        proj_exprs: list = [TE.Col(nt, t.INT8, "__pos")]
+        proj_schema: list = [L.OutCol("__pos", t.INT8)]
+        set_info = []
+        if update:
+            assigned = dict(stmt.assignments)
+            for col, e_ast in assigned.items():
+                if col not in meta.schema:
+                    raise SQLError(
+                        f'column "{col}" does not exist'
+                    )
+                ty = meta.schema[col]
+                te = _cast(remap(an.expr(e_ast, ctx)), ty)
+                set_info.append(col)
+                proj_exprs.append(te)
+                proj_schema.append(
+                    L.OutCol(f"__set_{col}", ty,
+                             dictid(stmt.table, col, ty))
+                )
+            if an.subplans:
+                raise SQLError(
+                    "subqueries are not supported in UPDATE ... FROM "
+                    "SET expressions"
+                )
+        node_plan: L.LogicalPlan = join
+        if residual is not None:
+            node_plan = L.Filter(
+                node_plan, remap(residual), node_plan.schema
+            )
+        node_plan = L.Project(
+            node_plan, tuple(proj_exprs), tuple(proj_schema)
+        )
+
+        txn, implicit = self._begin_implicit()
+        total = 0
+        new_batches: list[ColumnBatch] = []
+        ret_old: list[ColumnBatch] = []
+        try:
+            for node in meta.node_indices:
+                store = self.cluster.stores[node][stmt.table]
+                n0 = store.nrows
+                snap = np.int64(txn.snapshot_ts)
+                live = (store.xmin_ts[:n0] <= snap) & (
+                    snap < store.xmax_ts[:n0]
+                )
+                ow = txn.own_writes_view().get(node, {}).get(
+                    stmt.table
+                )
+                if ow is not None:
+                    for s0, e0 in ow[0]:
+                        live[s0:min(e0, n0)] = True
+                    if len(ow[1]):
+                        live[np.asarray(ow[1], dtype=np.int64)] = False
+                pos = np.nonzero(live)[0]
+                if not len(pos):
+                    continue
+                tb = store.to_batch().take(pos)
+                tb_cols = dict(tb.columns)
+                tb_cols["__pos"] = Column(
+                    t.INT8, pos.astype(np.int64)
+                )
+                tbp = ColumnBatch(tb_cols, tb.nrows)
+                ex = LocalExecutor(
+                    self.cluster.catalog, {}, None,
+                    remote_inputs={0: tbp, 1: src_batch},
+                )
+                out = ex.run_plan(node_plan)
+                if out.nrows == 0:
+                    continue
+                opos = np.asarray(
+                    out.columns["__pos"].data, dtype=np.int64
+                )
+                # one update per target row: first match wins (PG is
+                # nondeterministic under multiple matches too)
+                _u, first = np.unique(opos, return_index=True)
+                sel = np.sort(first)
+                opos = opos[sel]
+                self._acquire_row_locks(
+                    txn, stmt.table, node, opos, ROW_UPDATE
+                )
+                txn.pin(store)
+                txn.w(node, stmt.table).del_idx.extend(opos.tolist())
+                total += len(opos)
+                if update:
+                    old = store.to_batch().take(opos)
+                    newc = dict(old.columns)
+                    outcols = list(out.columns.values())
+                    for i, col in enumerate(set_info):
+                        c = outcols[1 + i]
+                        newc[col] = Column(
+                            meta.schema[col],
+                            np.asarray(c.data)[sel],
+                            None if c.validity is None
+                            else np.asarray(c.validity)[sel],
+                            meta.dictionaries.get(col),
+                        )
+                    new_batches.append(ColumnBatch(newc, len(opos)))
+                    if meta.dist.is_replicated:
+                        # one representative copy; the re-insert fans
+                        # back out to every replica (_x_update's rule)
+                        new_batches = new_batches[:1]
+                elif ret is not None and (
+                    not meta.dist.is_replicated or not ret_old
+                ):
+                    ret_old.append(store.to_batch().take(opos))
+            for nb in new_batches:
+                self._route_and_append(meta, nb, txn)
+        except Exception:
+            if implicit:
+                self._abort_txn(txn)
+            raise
+        if meta.dist.is_replicated and meta.node_indices:
+            total //= len(meta.node_indices)
+        if implicit:
+            self._commit_txn(txn)
+        else:
+            self.txn = txn
+        verb = "UPDATE" if update else "DELETE"
+        if ret is not None:
+            batch = self._concat_affected(
+                meta, new_batches if update else ret_old
+            )
+            return self._returning_result(verb, ret, batch, total)
+        return Result(verb, rowcount=total)
 
     def _apply_assignments(
         self, meta: TableMeta, old: ColumnBatch, assigned, subq

@@ -275,11 +275,18 @@ class LocalExecutor:
             valid = np.zeros(padded, dtype=np.bool_)
             for ri, row in enumerate(plan.rows):
                 e = row[ci]
-                if not isinstance(e, E.Const):
+                if isinstance(e, E.SubqueryParam):
+                    # an InitPlan's scalar result may sit in a VALUES
+                    # row: resolve it like any subquery parameter
+                    v, _ty = self._subq()[e.index]
+                    if v is None:
+                        continue
+                elif not isinstance(e, E.Const):
                     raise ExecError("VALUES rows must be constants")
-                if e.value is None:
+                elif e.value is None:
                     continue
-                v = e.value
+                else:
+                    v = e.value
                 if oc.type.is_text:
                     d = self._dict(oc.dict_id or LITERAL_DICT)
                     v = d.encode_one(str(v))

@@ -22,6 +22,8 @@ def select_exprs(sel: A.Select):
     yield from sel.group_by
     for si in sel.order_by:
         yield si.expr
+    for row in getattr(sel, "values_rows", ()):
+        yield from row  # standalone VALUES rows may hold subqueries
 
 
 def walk_expr_subqueries(e: A.Expr, fn) -> None:

@@ -115,17 +115,39 @@ def expand_ctes(sel: A.Select, depth: int = 0) -> A.Select:
             expand_ctes(body, depth + 1)  # nested WITH in the body
             rewrite_views(body, cte_views, depth + 1)
             if aliases:
-                if len(aliases) != len(body.items):
+                if body.values_rows and not body.items:
+                    # a VALUES body names its columns at analysis
+                    # time (column1..N); aliasing goes through a
+                    # wrapping projection
+                    if len(aliases) != len(body.values_rows[0]):
+                        raise ViewRecursionError(
+                            f'CTE "{name}" has {len(aliases)} column '
+                            "aliases but "
+                            f"{len(body.values_rows[0])} output "
+                            "columns"
+                        )
+                    body = A.Select(
+                        items=[
+                            A.SelectItem(
+                                A.ColumnRef(f"column{i + 1}", None),
+                                alias,
+                            )
+                            for i, alias in enumerate(aliases)
+                        ],
+                        from_clause=A.SubqueryRef(body, "__v"),
+                    )
+                elif len(aliases) != len(body.items):
                     raise ViewRecursionError(
                         f'CTE "{name}" has {len(aliases)} column '
                         f"aliases but {len(body.items)} output columns"
                     )
-                import dataclasses
+                else:
+                    import dataclasses
 
-                body.items = [
-                    dataclasses.replace(item, alias=alias)
-                    for item, alias in zip(body.items, aliases)
-                ]
+                    body.items = [
+                        dataclasses.replace(item, alias=alias)
+                        for item, alias in zip(body.items, aliases)
+                    ]
             cte_views[name] = (body, "")
         sel.ctes = []
         rewrite_views(sel, cte_views, depth + 1)

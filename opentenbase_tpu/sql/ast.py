@@ -303,6 +303,9 @@ class Update(Statement):
     assignments: list[tuple[str, Expr]]
     where: Optional[Expr] = None
     returning: list[SelectItem] = field(default_factory=list)
+    alias: Optional[str] = None
+    # UPDATE ... FROM source: (table name, alias|None)
+    from_table: Optional[tuple] = None
 
 
 @dataclass
@@ -310,6 +313,9 @@ class Delete(Statement):
     table: str
     where: Optional[Expr] = None
     returning: list[SelectItem] = field(default_factory=list)
+    alias: Optional[str] = None
+    # DELETE ... USING source: (table name, alias|None)
+    from_table: Optional[tuple] = None
 
 
 @dataclass(frozen=True)

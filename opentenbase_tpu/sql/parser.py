@@ -553,12 +553,32 @@ class Parser:
     def parse_update(self) -> A.Update:
         self.expect_kw("update")
         table = self.ident("table name")
+        alias = (
+            self.ident("alias")
+            if self.cur.kind == Tok.IDENT and not self.at_kw("set")
+            else None
+        )
         self.expect_kw("set")
         assignments = [self._assignment()]
         while self.eat_op(","):
             assignments.append(self._assignment())
+        from_table = None
+        if self.eat_kw("from"):
+            # UPDATE ... FROM source [alias] (one source table, the
+            # working set of gram.y's from_clause on UPDATE)
+            fname = self.ident("table name")
+            falias = (
+                self.ident("alias")
+                if self.cur.kind == Tok.IDENT
+                and not self.at_kw("where")
+                and not self.at_kw("returning")
+                else None
+            )
+            from_table = (fname, falias)
         where = self.parse_expr() if self.eat_kw("where") else None
         stmt = A.Update(table, assignments, where)
+        stmt.alias = alias
+        stmt.from_table = from_table
         if self.eat_kw("returning"):
             stmt.returning = [self._select_item()]
             while self.eat_op(","):
@@ -574,8 +594,28 @@ class Parser:
         self.expect_kw("delete")
         self.expect_kw("from")
         table = self.ident("table name")
+        alias = (
+            self.ident("alias")
+            if self.cur.kind == Tok.IDENT
+            and not self.at_kw("where") and not self.at_kw("using")
+            and not self.at_kw("returning")
+            else None
+        )
+        from_table = None
+        if self.eat_kw("using"):
+            fname = self.ident("table name")
+            falias = (
+                self.ident("alias")
+                if self.cur.kind == Tok.IDENT
+                and not self.at_kw("where")
+                and not self.at_kw("returning")
+                else None
+            )
+            from_table = (fname, falias)
         where = self.parse_expr() if self.eat_kw("where") else None
         stmt = A.Delete(table, where)
+        stmt.alias = alias
+        stmt.from_table = from_table
         if self.eat_kw("returning"):
             stmt.returning = [self._select_item()]
             while self.eat_op(","):

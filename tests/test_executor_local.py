@@ -446,3 +446,59 @@ def test_text_min_max_collation_order():
                 "select g, min(nm), max(nm) from u group by g "
                 "order by g"
             ) == [(0, "m", "z"), (1, "a", "b")], (ndn, fused)
+
+
+def test_update_from_delete_using():
+    """UPDATE ... FROM / DELETE ... USING (nodeModifyTable.c join-fed
+    modify): target rows join one source table; SET/WHERE see both
+    sides, aliases work, first match wins on duplicates, RETURNING
+    covers affected rows."""
+    import pytest
+
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        "create table t (k bigint, g bigint, v bigint) "
+        "distribute by shard(k)"
+    )
+    s.execute(
+        "create table u (k bigint, w bigint, tag bigint) "
+        "distribute by shard(k)"
+    )
+    s.execute("insert into t values (1,1,10),(2,1,20),(3,2,30)")
+    s.execute("insert into u values (1,100,0),(3,300,1),(9,900,0)")
+    r = s.execute("update t set v = u.w from u where t.k = u.k")
+    assert r.rowcount == 2
+    assert s.query("select * from t order by k") == [
+        (1, 1, 100), (2, 1, 20), (3, 2, 300),
+    ]
+    # expressions over BOTH sides + a source-side filter
+    r = s.execute(
+        "update t set v = u.w + t.g from u "
+        "where t.k = u.k and u.tag = 0"
+    )
+    assert r.rowcount == 1
+    assert s.query("select v from t where k = 1") == [(101,)]
+    r = s.execute("delete from t using u where t.k = u.k and u.tag = 1")
+    assert r.rowcount == 1
+    assert s.query("select k from t order by k") == [(1,), (2,)]
+    # aliases + RETURNING
+    r = s.execute(
+        "update t a set v = 0 from u b where a.k = b.k returning k, v"
+    )
+    assert r.rows == [(1, 0)]
+    # duplicate source matches: exactly one update per target row
+    s.execute("insert into u values (2, 7, 0), (2, 8, 0)")
+    r = s.execute("update t set v = u.w from u where t.k = u.k")
+    assert r.rowcount == 2
+    assert s.query("select v from t where k = 2")[0][0] in (7, 8)
+    # missing equality join errors loudly
+    with pytest.raises(Exception, match="equality"):
+        s.execute("update t set v = 1 from u where u.tag > t.g")
+    # and inside an explicit txn it rolls back atomically
+    before = s.query("select k, v from t order by k")
+    s.execute("begin")
+    s.execute("update t set v = 12345 from u where t.k = u.k")
+    s.execute("rollback")
+    assert s.query("select k, v from t order by k") == before
