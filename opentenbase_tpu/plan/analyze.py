@@ -309,6 +309,19 @@ class Analyzer:
     # SELECT
     # ------------------------------------------------------------------
     def select(self, sel: A.Select) -> L.LogicalPlan:
+        if sel.ctes:
+            # WITH needs no engine state — expanding here makes CTEs
+            # work for every analyzer consumer, not just the session
+            # pipeline (which also runs this; it is idempotent)
+            from opentenbase_tpu.plan.views import (
+                ViewRecursionError,
+                expand_ctes,
+            )
+
+            try:
+                expand_ctes(sel)
+            except ViewRecursionError as e:
+                raise AnalyzeError(str(e)) from None
         if sel.set_ops:
             return self._set_ops(sel)
         return self._select_core(sel)
