@@ -107,6 +107,19 @@ class _Return:
 
 
 @dataclass
+class _LoopCtl:
+    kind: str  # 'exit' | 'continue'
+    cond: list  # WHEN tokens ([] = unconditional)
+
+
+@dataclass
+class _ForQuery:
+    var: str
+    sql: list  # SELECT tokens (single output column)
+    body: list
+
+
+@dataclass
 class _Raise:
     fmt: str
     args: list  # list of token spans
@@ -263,6 +276,15 @@ class _Parser:
             self.next()
             var = self.next().lower()
             self.expect("in")
+            if self.peek() == "select":
+                # FOR var IN <query> LOOP (pl_exec.c's stmt_fors):
+                # iterate the (single-column) result rows
+                sql = self._until("loop")
+                body = self._stmts(("end",))
+                self.expect("end")
+                self.expect("loop")
+                self.expect(";")
+                return _ForQuery(var, sql, body)
             lo = self._until("..")
             hi = []
             step = ["1"]
@@ -280,6 +302,14 @@ class _Parser:
             self.expect("loop")
             self.expect(";")
             return _For(var, lo, hi, step, body)
+        if p in ("exit", "continue"):
+            kind = self.next().lower()
+            cond: list = []
+            if self.eat("when"):
+                cond = self._until(";")
+            else:
+                self.expect(";")
+            return _LoopCtl(kind, cond)
         # assignment: ident := expr ;
         if _is_ident(p or "") and self.peek(1) == ":=":
             name = self.next().lower()
@@ -316,6 +346,14 @@ class _Parser:
 class _ReturnValue(Exception):
     def __init__(self, value):
         self.value = value
+
+
+class _ExitLoop(Exception):
+    pass
+
+
+class _ContinueLoop(Exception):
+    pass
 
 
 def _format_raise(fmt: str, vals: list) -> str:
@@ -402,6 +440,10 @@ class PlpgsqlFunction:
             self._run(session, self.block.stmts, env, budget)
         except _ReturnValue as r:
             return r.value
+        except (_ExitLoop, _ContinueLoop):
+            raise PlpgsqlError(
+                "EXIT/CONTINUE cannot be used outside a loop"
+            ) from None
         raise PlpgsqlError(
             f"control reached end of function {self.name!r} "
             "without RETURN"
@@ -442,7 +484,12 @@ class PlpgsqlFunction:
                             f"function {self.name!r} exceeded "
                             f"{MAX_STEPS} statements"
                         )
-                    self._run(session, st.body, env, budget)
+                    try:
+                        self._run(session, st.body, env, budget)
+                    except _ContinueLoop:
+                        continue
+                    except _ExitLoop:
+                        break
             elif isinstance(st, _For):
                 lo = self._eval(session, st.lo, env)
                 hi = self._eval(session, st.hi, env)
@@ -458,8 +505,45 @@ class PlpgsqlFunction:
                             f"function {self.name!r} exceeded "
                             f"{MAX_STEPS} statements"
                         )
-                    self._run(session, st.body, env, budget)
+                    try:
+                        self._run(session, st.body, env, budget)
+                    except _ContinueLoop:
+                        pass
+                    except _ExitLoop:
+                        break
                     v = v + step
+            elif isinstance(st, _ForQuery):
+                sql = self._subst(st.sql, env)
+                rows = session.query(sql)
+                if rows and len(rows[0]) != 1:
+                    raise PlpgsqlError(
+                        "FOR ... IN <query> needs a single-column "
+                        "SELECT (record variables are not supported)"
+                    )
+                for (val,) in rows:
+                    env[st.var] = val
+                    budget[0] -= 1
+                    if budget[0] <= 0:
+                        raise PlpgsqlError(
+                            f"function {self.name!r} exceeded "
+                            f"{MAX_STEPS} statements"
+                        )
+                    try:
+                        self._run(session, st.body, env, budget)
+                    except _ContinueLoop:
+                        continue
+                    except _ExitLoop:
+                        break
+            elif isinstance(st, _LoopCtl):
+                fire = (
+                    True if not st.cond
+                    else bool(self._eval(session, st.cond, env))
+                )
+                if fire:
+                    raise (
+                        _ExitLoop() if st.kind == "exit"
+                        else _ContinueLoop()
+                    )
             elif isinstance(st, _Raise):
                 vals = [
                     self._eval(session, a, env) for a in st.args

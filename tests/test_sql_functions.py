@@ -262,3 +262,55 @@ def test_plpgsql_case_inside_if_condition(s):
     assert s.query("select sgn(5)") == [(1,)]
     assert s.query("select sgn(0)") == [(0,)]
     assert s.query("select sgn(-2)") == [(-1,)]
+
+
+def test_plpgsql_loop_control_and_for_query(s):
+    """EXIT [WHEN], CONTINUE [WHEN], FOR var IN <query> LOOP
+    (pl_exec.c stmt_exit/stmt_fors)."""
+    s.execute(
+        "create function first_big(th bigint) returns bigint as '"
+        "declare found bigint := -1;"
+        "begin"
+        "  for b in select bal from acct order by id loop"
+        "    continue when b < th;"
+        "    found := b;"
+        "    exit;"
+        "  end loop;"
+        "  return found;"
+        "end' language plpgsql"
+    )
+    # acct fixture: (1,100),(2,200),(3,300)
+    assert s.query("select first_big(150)") == [(200,)]
+    assert s.query("select first_big(1000)") == [(-1,)]
+    s.execute(
+        "create function count_until(lim bigint) returns bigint as '"
+        "declare n bigint := 0;"
+        "begin"
+        "  while true loop"
+        "    n := n + 1;"
+        "    exit when n >= lim;"
+        "  end loop;"
+        "  return n;"
+        "end' language plpgsql"
+    )
+    assert s.query("select count_until(7)") == [(7,)]
+    s.execute(
+        "create function sum_evens(hi bigint) returns bigint as '"
+        "declare acc bigint := 0;"
+        "begin"
+        "  for i in 1 .. hi loop"
+        "    continue when i % 2 = 1;"
+        "    acc := acc + i;"
+        "  end loop;"
+        "  return acc;"
+        "end' language plpgsql"
+    )
+    assert s.query("select sum_evens(10)") == [(30,)]
+    import pytest as _pt
+
+    with _pt.raises(Exception, match="outside a loop"):
+        s.execute(
+            "create function badexit() returns bigint as '"
+            "begin exit; return 1; end' language plpgsql"
+        )
+        s.query("select badexit()")
