@@ -785,9 +785,14 @@ class LocalExecutor:
                 a = np.ones(m, dtype=np.int64)
                 avm = np.ones(m, dtype=bool)
                 scale = 1
-            vals, valid = self._window_agg(
-                kind, a, avm, newpart, newpeer, bool(spec.order)
-            )
+            if spec.frame is not None:
+                vals, valid = self._window_agg_framed(
+                    kind, a, avm, newpart, spec.frame
+                )
+            else:
+                vals, valid = self._window_agg(
+                    kind, a, avm, newpart, newpeer, bool(spec.order)
+                )
             if kind == "avg" and scale != 1:
                 vals = vals / scale  # unscale DECIMAL averages (agg parity)
             if postmap is not None:
@@ -805,6 +810,66 @@ class LocalExecutor:
         have = np.where(newpeer, np.arange(m), -1)
         ff = np.maximum.accumulate(have)  # index of the current peer head
         return pos[ff] + 1
+
+    @staticmethod
+    def _window_agg_framed(kind, a, avm, newpart, frame):
+        """ROWS-frame aggregation (nodeWindowAgg's row-mode frames):
+        per-row window [i+start, i+end] clamped to the partition.
+        sums/counts are prefix differences; min/max answer range
+        queries from an O(m log m) sparse table — both fully
+        vectorized."""
+        m = len(a)
+        s_off, e_off = frame
+        idx = np.arange(m)
+        part_id = np.cumsum(newpart) - 1
+        starts_idx = np.nonzero(newpart)[0]
+        ps = starts_idx[part_id]
+        ends_idx = np.append(starts_idx[1:], m) - 1
+        pe = ends_idx[part_id]
+        lo = ps if s_off is None else np.maximum(idx + s_off, ps)
+        hi = pe if e_off is None else np.minimum(idx + e_off, pe)
+        nonempty = lo <= hi
+        lo = np.clip(lo, 0, m - 1)
+        hi = np.clip(hi, 0, m - 1)
+        af = a.astype(np.float64)
+        contrib = np.where(avm, af, 0.0)
+        ccnt = np.concatenate(
+            [[0], np.cumsum(avm.astype(np.int64))]
+        )
+        cnt = np.where(nonempty, ccnt[hi + 1] - ccnt[lo], 0)
+        if kind == "count":
+            return cnt, np.ones(m, dtype=bool)
+        if kind in ("sum", "avg"):
+            cs = np.concatenate([[0.0], np.cumsum(contrib)])
+            s = np.where(nonempty, cs[hi + 1] - cs[lo], 0.0)
+            if kind == "sum":
+                return s, cnt > 0
+            return s / np.maximum(cnt, 1), cnt > 0
+        # min / max: sparse table over sentinel-filled values
+        big = np.float64(np.inf if kind == "min" else -np.inf)
+        red = np.minimum if kind == "min" else np.maximum
+        level0 = np.where(avm, af, big)
+        tables = [level0]
+        span = 1
+        while span * 2 <= m:
+            prev = tables[-1]
+            nxt = prev.copy()
+            nxt[: m - span] = red(prev[: m - span], prev[span:])
+            tables.append(nxt)
+            span *= 2
+        length = hi - lo + 1
+        k = np.floor(
+            np.log2(np.maximum(length, 1))
+        ).astype(np.int64)
+        pow2 = 1 << k
+        t_idx = np.clip(k, 0, len(tables) - 1)
+        stacked = np.stack(tables)
+        left = stacked[t_idx, lo]
+        right = stacked[t_idx, np.maximum(hi - pow2 + 1, 0)]
+        vals = red(left, right)
+        valid = nonempty & (cnt > 0)
+        vals = np.where(valid, vals, 0.0)
+        return vals, valid
 
     @staticmethod
     def _window_agg(kind, a, avm, newpart, newpeer, running: bool):

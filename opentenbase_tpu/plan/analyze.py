@@ -850,9 +850,16 @@ class Analyzer:
                     dict_id = plan.schema[arg_idx].dict_id
                 else:
                     dict_id = extra_schema[arg_idx - len(base_cols)].dict_id
+            frame = getattr(wc, "frame", None)
+            if frame is not None and kind not in (
+                "count", "sum", "avg", "min", "max",
+            ):
+                raise AnalyzeError(
+                    f"a ROWS frame is not meaningful for {kind}()"
+                )
             spec = L.WinSpec(
                 kind, arg_idx, part, order,
-                L.OutCol(name, rty, dict_id), offset,
+                L.OutCol(name, rty, dict_id), offset, frame,
             )
             win_slots.append(len(specs))
             specs.append(spec)

@@ -172,12 +172,15 @@ class WinSpec:
     order: tuple[tuple[int, bool], ...]  # (child col, descending)
     out: OutCol = OutCol("", None)  # type: ignore[arg-type]
     offset: int = 1  # lag/lead distance
+    # ROWS frame (start, end): None = unbounded, negative = PRECEDING,
+    # 0 = CURRENT ROW, positive = FOLLOWING; frame=None = default
+    frame: Optional[tuple] = None
 
     def key(self) -> str:
         o = ",".join(f"{c}{'D' if d else 'A'}" for c, d in self.order)
         return (
             f"{self.kind}({self.arg})p[{','.join(map(str, self.partition))}]"
-            f"o[{o}]+{self.offset}"
+            f"o[{o}]+{self.offset}f{self.frame}"
         )
 
 

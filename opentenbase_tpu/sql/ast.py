@@ -147,6 +147,9 @@ class WindowCall(Expr):
     func: "FuncCall"
     partition_by: tuple = ()
     order_by: tuple = ()  # tuple[SortItem, ...]
+    # ROWS frame: (start, end) with None = unbounded, negative =
+    # k PRECEDING, 0 = CURRENT ROW, positive = k FOLLOWING
+    frame: "Optional[tuple]" = None
 
     def __str__(self):
         return f"{self.func} OVER (...)"

@@ -190,6 +190,23 @@ def _expr(e: A.Expr) -> str:
                     s += " nulls last"
                 keys.append(s)
             over.append("order by " + ", ".join(keys))
+        if e.frame is not None:
+            def bnd(v, is_start):
+                if v is None:
+                    return (
+                        "unbounded preceding" if is_start
+                        else "unbounded following"
+                    )
+                if v == 0:
+                    return "current row"
+                if v < 0:
+                    return f"{-v} preceding"
+                return f"{v} following"
+
+            over.append(
+                f"rows between {bnd(e.frame[0], True)} "
+                f"and {bnd(e.frame[1], False)}"
+            )
         return f"{base} over ({' '.join(over)})"
     if isinstance(e, A.Cast):
         targs = (

@@ -819,8 +819,60 @@ class Parser:
             order.append(self._sort_item())
             while self.eat_op(","):
                 order.append(self._sort_item())
+        frame = None
+        if self.at_kw("range") or self.at_kw("groups"):
+            self.error(
+                "only ROWS window frames are supported"
+            )
+        if self.eat_kw("rows"):
+            # ROWS BETWEEN <bound> AND <bound> | ROWS <bound>
+            def bound():
+                if self.eat_kw("unbounded"):
+                    if self.eat_kw("preceding"):
+                        return None, "p"
+                    self.expect_kw("following")
+                    return None, "f"
+                if self.eat_kw("current"):
+                    self.expect_kw("row")
+                    return 0, "c"
+                k = self._int_lit()
+                if k < 0:
+                    self.error(
+                        "frame offset must not be negative"
+                    )
+                if self.eat_kw("preceding"):
+                    return -k, "p"
+                self.expect_kw("following")
+                return k, "f"
+
+            if self.eat_kw("between"):
+                s_val, s_kind = bound()
+                self.expect_kw("and")
+                e_val, e_kind = bound()
+            else:
+                s_val, s_kind = bound()
+                e_val, e_kind = 0, "c"
+            if s_kind == "f" and s_val is None:
+                self.error(
+                    "frame start cannot be UNBOUNDED FOLLOWING"
+                )
+            if e_kind == "p" and e_val is None:
+                self.error(
+                    "frame end cannot be UNBOUNDED PRECEDING"
+                )
+            if (
+                s_val is not None and e_val is not None
+                and s_val > e_val
+            ):
+                self.error(
+                    "frame starting bound cannot follow its ending "
+                    "bound"
+                )
+            frame = (s_val, e_val)
         self.expect_op(")")
-        return A.WindowCall(fn, tuple(partition), tuple(order))
+        return A.WindowCall(
+            fn, tuple(partition), tuple(order), frame
+        )
 
     def _partition_spec(self) -> dict:
         # PARTITION BY RANGE (col) [BEGIN (literal) STEP (literal unit)

@@ -205,3 +205,79 @@ def test_window_sum_text_rejected(s):
         s.query("select sum(dept) over () from emp")
     with pytest.raises(AnalyzeError, match="integer constant"):
         s.query("select lag(sal, null) over (order by id) from emp")
+
+
+def test_rows_frames():
+    """ROWS window frames (nodeWindowAgg row mode): moving sums via
+    prefix differences, min/max via range queries, partition-clamped
+    bounds, NULL argument handling, shorthand form."""
+    import pytest
+
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=1, shard_groups=8).session()
+    s.execute(
+        "create table t (k bigint, g bigint, v bigint) "
+        "distribute by roundrobin"
+    )
+    s.execute(
+        "insert into t values (1,0,10),(2,0,20),(3,0,30),(4,1,5),"
+        "(5,1,7),(6,1,null)"
+    )
+    assert s.query(
+        "select k, sum(v) over (partition by g order by k rows "
+        "between 1 preceding and current row) from t order by k"
+    ) == [(1, 10), (2, 30), (3, 50), (4, 5), (5, 12), (6, 7)]
+    assert s.query(
+        "select k, min(v) over (partition by g order by k rows "
+        "between 1 preceding and 1 following) from t order by k"
+    ) == [(1, 10), (2, 10), (3, 20), (4, 5), (5, 5), (6, 7)]
+    assert s.query(
+        "select k, max(v) over (partition by g order by k rows "
+        "between current row and unbounded following) from t "
+        "order by k"
+    ) == [(1, 30), (2, 30), (3, 30), (4, 7), (5, 7), (6, None)]
+    assert s.query(
+        "select k, count(v) over (order by k rows 2 preceding) "
+        "from t order by k"
+    ) == [(1, 1), (2, 2), (3, 3), (4, 3), (5, 3), (6, 2)]
+    assert s.query(
+        "select k, avg(v) over (partition by g order by k rows "
+        "between 1 preceding and current row) from t order by k"
+    ) == [
+        (1, 10.0), (2, 15.0), (3, 25.0), (4, 5.0), (5, 6.0), (6, 7.0),
+    ]
+    with pytest.raises(Exception, match="only ROWS"):
+        s.query(
+            "select sum(v) over (order by k range between 1 "
+            "preceding and current row) from t"
+        )
+    with pytest.raises(Exception, match="not meaningful"):
+        s.query(
+            "select row_number() over (order by k rows 2 preceding) "
+            "from t"
+        )
+    # misordered/negative bounds are parse errors, not empty frames
+    with pytest.raises(Exception, match="cannot follow"):
+        s.query(
+            "select sum(v) over (order by k rows between current "
+            "row and 1 preceding) from t"
+        )
+    with pytest.raises(Exception, match="cannot follow"):
+        s.query("select sum(v) over (order by k rows 3 following) from t")
+    with pytest.raises(Exception, match="not be negative"):
+        s.query(
+            "select sum(v) over (order by k rows between -1 "
+            "preceding and current row) from t"
+        )
+    # the deparser round-trips the frame clause
+    from opentenbase_tpu.sql.deparse import deparse
+    from opentenbase_tpu.sql.parser import parse
+
+    q = (
+        "select sum(v) over (order by k rows between 1 preceding "
+        "and current row) from t"
+    )
+    rt = deparse(parse(q)[0])
+    assert "rows between 1 preceding and current row" in rt, rt
+    assert s.query(q) == s.query(rt)
