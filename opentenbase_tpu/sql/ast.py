@@ -286,6 +286,9 @@ class Select(Statement):
     ctes: list = field(default_factory=list)
     # standalone VALUES (...), (...) rows; items is empty then
     values_rows: list = field(default_factory=list)
+    # DISTINCT ON (exprs) — desugared by the parser into a
+    # row_number() window over a derived table
+    distinct_on: Optional[list] = None
 
 
 @dataclass

@@ -303,7 +303,27 @@ class Parser:
             # last branch's _order_limit grabbed them, so hoist.
             last = sel.set_ops[-1][1]
             if last.order_by and not sel.order_by:
-                sel.order_by, last.order_by = last.order_by, []
+                hoist = last.order_by
+                if (
+                    isinstance(last.from_clause, A.SubqueryRef)
+                    and last.from_clause.alias == "__don"
+                ):
+                    # DISTINCT ON desugar rewrote the (chain-level)
+                    # ORDER BY into hidden __oN refs private to the
+                    # derived table — hoist the original exprs, kept
+                    # as the inner __oN select items.
+                    origs = {
+                        i.alias: i.expr
+                        for i in last.from_clause.query.items
+                    }
+                    hoist = [
+                        A.SortItem(
+                            origs[k.expr.name],
+                            k.descending, k.nulls_first,
+                        )
+                        for k in hoist
+                    ]
+                sel.order_by, last.order_by = hoist, []
             if last.limit is not None and sel.limit is None:
                 sel.limit, last.limit = last.limit, None
             if last.offset is not None and sel.offset is None:
@@ -343,14 +363,25 @@ class Parser:
             return sel
         self.expect_kw("select")
         distinct = False
+        on_exprs = None
         if self.eat_kw("distinct"):
-            distinct = True
+            if self.eat_kw("on"):
+                # DISTINCT ON (...) — desugared after the clause parse
+                self.expect_op("(")
+                on_exprs = [self.parse_expr()]
+                while self.eat_op(","):
+                    on_exprs.append(self.parse_expr())
+                self.expect_op(")")
+            else:
+                distinct = True
         else:
             self.eat_kw("all")
         items = [self._select_item()]
         while self.eat_op(","):
             items.append(self._select_item())
         sel = A.Select(items=items, distinct=distinct)
+        if on_exprs is not None:
+            sel.distinct_on = on_exprs
         if self.eat_kw("from"):
             sel.from_clause = self._from_clause()
         if self.eat_kw("where"):
@@ -362,7 +393,125 @@ class Parser:
         if self.eat_kw("having"):
             sel.having = self.parse_expr()
         self._order_limit(sel)
+        if sel.distinct_on is not None:
+            sel = self._desugar_distinct_on(sel)
         return sel
+
+    def _desugar_distinct_on(self, sel: A.Select) -> A.Select:
+        """DISTINCT ON (e...) keeps the first row per e-group under the
+        ORDER BY (PG's nodeUnique over a presorted input). Desugar:
+        a row_number() window partitioned by the ON exprs inside a
+        derived table, outer filter __rn = 1, outer ORDER BY over
+        re-projected columns."""
+        on_exprs = sel.distinct_on
+        sel.distinct_on = None
+        if sel.group_by or sel.having is not None:
+            self.error(
+                "DISTINCT ON with GROUP BY is not supported"
+            )
+        # Resolve ordinal (ORDER BY 2) and output-alias sort keys
+        # against the select list first — the hidden-column
+        # re-projection would otherwise turn them into constants /
+        # unresolvable names (transformSortClause does this resolution
+        # before transformDistinctOnClause sees the list).
+        resolved = []
+        for si in sel.order_by:
+            e = si.expr
+            if (
+                isinstance(e, A.Literal)
+                and isinstance(e.value, int)
+                and not isinstance(e.value, bool)
+            ):
+                if not 1 <= e.value <= len(sel.items):
+                    self.error(
+                        f"ORDER BY position {e.value} is not in "
+                        "select list"
+                    )
+                e = sel.items[e.value - 1].expr
+            elif isinstance(e, A.ColumnRef) and e.table is None:
+                for item in sel.items:
+                    if item.alias == e.name:
+                        e = item.expr
+                        break
+            resolved.append(
+                A.SortItem(e, si.descending, si.nulls_first)
+            )
+        # PG's transformDistinctOnClause rule: sort items matching an
+        # ON expr must form a prefix, and once a non-ON sort item is
+        # seen every ON expr must already have been covered.
+        skipped = False
+        matched = []
+        for si in resolved:
+            if any(si.expr == oe for oe in on_exprs):
+                if skipped:
+                    self.error(
+                        "SELECT DISTINCT ON expressions must match "
+                        "initial ORDER BY expressions"
+                    )
+                matched.append(si.expr)
+            else:
+                skipped = True
+        if skipped and any(
+            all(oe != m for m in matched) for oe in on_exprs
+        ):
+            self.error(
+                "SELECT DISTINCT ON expressions must match "
+                "initial ORDER BY expressions"
+            )
+        # Inner names are positional (__c{i}) so duplicate output
+        # names / aliases colliding with the hidden __rn column can't
+        # make the outer re-projection ambiguous.
+        inner_items = []
+        out_aliases = []
+        for i, item in enumerate(sel.items):
+            if isinstance(item.expr, A.Star):
+                self.error("DISTINCT ON with * is not supported")
+            inner_items.append(A.SelectItem(item.expr, f"__c{i}"))
+            out_aliases.append(item.alias or (
+                item.expr.name
+                if isinstance(item.expr, A.ColumnRef) else f"__c{i}"
+            ))
+        # ORDER BY exprs re-project as hidden columns so the outer
+        # select can re-order after the window filter
+        order_refs = []
+        for j, si in enumerate(resolved):
+            inner_items.append(
+                A.SelectItem(si.expr, f"__o{j}")
+            )
+            order_refs.append(
+                A.SortItem(
+                    A.ColumnRef(f"__o{j}", None),
+                    si.descending, si.nulls_first,
+                )
+            )
+        inner_items.append(A.SelectItem(
+            A.WindowCall(
+                A.FuncCall("row_number", ()),
+                tuple(on_exprs),
+                tuple(resolved),
+            ),
+            "__rn",
+        ))
+        inner = A.Select(
+            items=inner_items,
+            from_clause=sel.from_clause,
+            where=sel.where,
+        )
+        outer = A.Select(
+            items=[
+                A.SelectItem(A.ColumnRef(f"__c{i}", None), a)
+                for i, a in enumerate(out_aliases)
+            ],
+            from_clause=A.SubqueryRef(inner, "__don"),
+            where=A.BinOp(
+                "=", A.ColumnRef("__rn", None), A.Literal(1)
+            ),
+            order_by=order_refs,
+            limit=sel.limit,
+            offset=sel.offset,
+        )
+        outer.ctes = sel.ctes
+        return outer
 
     def _order_limit(self, sel: A.Select) -> None:
         if self.eat_kw("order", "by"):

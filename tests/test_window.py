@@ -281,3 +281,107 @@ def test_rows_frames():
     rt = deparse(parse(q)[0])
     assert "rows between 1 preceding and current row" in rt, rt
     assert s.query(q) == s.query(rt)
+
+
+# -- DISTINCT ON (desugared by the parser into a row_number() window
+# over a derived table; PG's nodeUnique over a presorted path) --------
+
+def test_distinct_on_first_per_group(s):
+    rows = s.query(
+        "select distinct on (dept) dept, sal from emp"
+        " order by dept, sal"
+    )
+    assert rows == [("eng", 100), ("ops", 50), ("sales", 90)]
+
+
+def test_distinct_on_desc_and_tiebreak(s):
+    rows = s.query(
+        "select distinct on (dept) dept, id, sal from emp"
+        " order by dept, sal desc, id"
+    )
+    assert rows == [("eng", 4, 300), ("ops", 6, 70), ("sales", 7, 90)]
+
+
+def test_distinct_on_expression_and_limit(s):
+    rows = s.query(
+        "select distinct on (sal % 2) sal % 2 as p, sal from emp"
+        " order by sal % 2, sal limit 1"
+    )
+    assert rows == [(0, 50)]
+
+
+def test_distinct_on_no_order_by(s):
+    rows = sorted(s.query("select distinct on (dept) dept from emp"))
+    assert rows == [("eng",), ("ops",), ("sales",)]
+
+
+def test_distinct_on_in_cte(s):
+    rows = s.query(
+        "with top as (select distinct on (dept) dept, sal from emp"
+        " order by dept, sal desc)"
+        " select sum(sal) from top"
+    )
+    assert rows == [(460,)]
+
+
+def test_distinct_on_rejections(s):
+    from opentenbase_tpu.sql.parser import ParseError
+    with pytest.raises(ParseError):
+        s.query("select distinct on (dept) * from emp")
+    with pytest.raises(ParseError):
+        s.query(
+            "select distinct on (dept) dept, sum(sal) from emp"
+            " group by dept"
+        )
+
+
+def test_distinct_on_ordinal_and_alias_sort_keys(s):
+    # ORDER BY 1, 2 resolves positionally before desugaring
+    assert s.query(
+        "select distinct on (dept) dept, sal from emp order by 1, 2"
+    ) == [("eng", 100), ("ops", 50), ("sales", 90)]
+    # output alias resolves to its expression
+    assert s.query(
+        "select distinct on (dept) dept, sal as s from emp"
+        " order by dept, s desc"
+    ) == [("eng", 300), ("ops", 70), ("sales", 90)]
+
+
+def test_distinct_on_duplicate_and_colliding_names(s):
+    assert s.query(
+        "select distinct on (dept) dept, dept from emp order by dept"
+    ) == [("eng", "eng"), ("ops", "ops"), ("sales", "sales")]
+    # user alias that collides with the hidden row_number column
+    assert s.query(
+        "select distinct on (dept) dept, sal as __rn from emp"
+        " order by dept, sal"
+    ) == [("eng", 100), ("ops", 50), ("sales", 90)]
+
+
+def test_distinct_on_under_set_op_chain_order(s):
+    # chain-level ORDER BY after a DISTINCT ON arm hoists the
+    # original exprs, not the hidden __oN refs
+    rows = s.query(
+        "select dept from emp where dept = 'sales'"
+        " union all"
+        " select distinct on (dept) dept from emp where dept <> 'sales'"
+        " order by 1 desc"
+    )
+    assert rows == [("sales",), ("ops",), ("eng",)]
+
+
+def test_distinct_on_order_by_mismatch_rejected(s):
+    from opentenbase_tpu.sql.parser import ParseError
+    # PG: SELECT DISTINCT ON expressions must match initial ORDER BY
+    with pytest.raises(ParseError):
+        s.query("select distinct on (dept) dept, sal from emp order by sal")
+    with pytest.raises(ParseError):
+        s.query(
+            "select distinct on (dept) dept, sal from emp"
+            " order by sal, dept"
+        )
+    # but any permutation of the ON exprs as the leading keys is fine
+    assert s.query(
+        "select distinct on (dept, sal) dept, sal from emp"
+        " order by sal, dept limit 3"
+    ) == [("ops", 50), ("ops", 70), ("sales", 90)]
