@@ -339,7 +339,15 @@ class Analyzer:
                 raise AnalyzeError("each UNION query must have the same number of columns")
             plan_c, branch_c = self._align_schemas(plan, branch)
             if op in ("union", "union all"):
-                u = L.Union((plan_c, branch_c), plan_c.schema)
+                # prefer whichever side knows the dictionary — a
+                # NULL-literal text column (grouping-set padding)
+                # carries none
+                schema = tuple(
+                    ca if ca.dict_id is not None or cb.dict_id is None
+                    else L.OutCol(ca.name, ca.type, cb.dict_id)
+                    for ca, cb in zip(plan_c.schema, branch_c.schema)
+                )
+                u = L.Union((plan_c, branch_c), schema)
                 plan = u if op == "union all" else L.Distinct(u, u.schema)
             elif op == "intersect":
                 plan = self._setop_join(plan_c, branch_c, "semi")
@@ -351,16 +359,50 @@ class Analyzer:
     def _align_schemas(
         self, a: L.LogicalPlan, b: L.LogicalPlan
     ) -> tuple[L.LogicalPlan, L.LogicalPlan]:
-        """Coerce two set-op branches to a common schema."""
+        """Coerce two set-op branches to a common schema. A column that
+        is a bare NULL literal on one side (PG's "unknown" type —
+        grouping-set padding produces these) adopts the other side's
+        type instead of forcing a common-type lookup."""
+        def null_cols(p: L.LogicalPlan) -> set:
+            if isinstance(p, L.Project):
+                return {
+                    i for i, e in enumerate(p.exprs)
+                    if isinstance(e, E.Const) and e.value is None
+                }
+            if isinstance(p, L.Union):
+                # a chained set-op output column is known-NULL when
+                # every input's is
+                out = null_cols(p.inputs[0])
+                for q in p.inputs[1:]:
+                    out &= null_cols(q)
+                return out
+            if isinstance(p, (L.Distinct, L.Sort, L.Limit)):
+                return null_cols(p.children()[0])
+            return set()
+
+        na, nb = null_cols(a), null_cols(b)
         types = []
-        for ca, cb in zip(a.schema, b.schema):
-            types.append(ca.type if ca.type == cb.type else _common_input_type(ca.type, cb.type, "UNION"))
+        for i, (ca, cb) in enumerate(zip(a.schema, b.schema)):
+            if ca.type == cb.type:
+                types.append(ca.type)
+            elif i in na and i not in nb:
+                types.append(cb.type)
+            elif i in nb and i not in na:
+                types.append(ca.type)
+            else:
+                types.append(
+                    _common_input_type(ca.type, cb.type, "UNION")
+                )
 
         def project_to(p: L.LogicalPlan) -> L.LogicalPlan:
             if all(c.type == ty for c, ty in zip(p.schema, types)):
                 return p
+            nulls = null_cols(p)
+            # known-all-NULL columns re-project as typed NULL consts
+            # (no runtime cast path needed for e.g. int4 -> text)
             exprs = tuple(
-                _cast(E.Col(i, c.type, c.name), ty)
+                E.Const(None, ty) if i in nulls
+                else _cast(E.Col(i, c.type, c.name), ty)
                 for i, (c, ty) in enumerate(zip(p.schema, types))
             )
             schema = tuple(

@@ -289,6 +289,9 @@ class Select(Statement):
     # DISTINCT ON (exprs) — desugared by the parser into a
     # row_number() window over a derived table
     distinct_on: Optional[list] = None
+    # GROUP BY ROLLUP/CUBE/GROUPING SETS — list of grouping sets
+    # (tuples of exprs); desugared by the parser into UNION ALL
+    grouping_sets: Optional[list] = None
 
 
 @dataclass

@@ -9,12 +9,120 @@ precedence climbing (c_expr/a_expr equivalent).
 
 from __future__ import annotations
 
+import dataclasses
+
 from opentenbase_tpu.sql import ast as A
 from opentenbase_tpu.sql.lexer import LexError, Tok, Token, tokenize
 
 
 class ParseError(ValueError):
     pass
+
+
+# aggregate names whose arguments see base rows, not group keys —
+# the grouping-set NULL substitution must not descend into them
+_GS_AGG_NAMES = {"sum", "count", "avg", "min", "max"}
+
+
+def _gs_eq(a, b) -> bool:
+    """Structural equality between a referenced expr and a grouping
+    key, lenient about a missing table qualifier on either side
+    (t.a matches key a) — the parser has no scope to resolve against,
+    so this approximates the analyzer's semantic match."""
+    if isinstance(a, A.ColumnRef) and isinstance(b, A.ColumnRef):
+        return a.name == b.name and (
+            a.table == b.table or a.table is None or b.table is None
+        )
+    if type(a) is not type(b):
+        return a == b
+    if dataclasses.is_dataclass(a) and not isinstance(a, type):
+        for f in dataclasses.fields(a):
+            va, vb = getattr(a, f.name), getattr(b, f.name)
+            if isinstance(va, (tuple, list)):
+                if (
+                    not isinstance(vb, (tuple, list))
+                    or len(va) != len(vb)
+                    or any(
+                        not _gs_eq(x, y) for x, y in zip(va, vb)
+                    )
+                ):
+                    return False
+            elif not _gs_eq(va, vb):
+                return False
+        return True
+    return a == b
+
+
+def _gs_rewrite(e, removed, all_keys, err):
+    """One grouping-set branch's expression rewrite: grouped-out key
+    exprs become NULL, grouping(...) becomes its bitmask constant
+    (1-bit per argument, leftmost = most significant, set when the
+    argument is grouped out). Aggregate arguments and subquery bodies
+    are left untouched."""
+    if e is None:
+        return None
+    for k in removed:
+        if _gs_eq(e, k):
+            return A.Literal(None)
+    if isinstance(e, A.FuncCall):
+        name = e.name.lower()
+        if name == "grouping":
+            if not e.args:
+                err("grouping() requires arguments")
+            val = 0
+            for a in e.args:
+                if not any(_gs_eq(a, k) for k in all_keys):
+                    err(
+                        "arguments to grouping() must be "
+                        "grouping expressions"
+                    )
+                val = val * 2 + (
+                    1 if any(_gs_eq(a, k) for k in removed) else 0
+                )
+            return A.Literal(val)
+        if name in _GS_AGG_NAMES:
+            return e
+    if isinstance(e, A.Select):
+        return e
+    if dataclasses.is_dataclass(e) and not isinstance(e, type):
+        kw = {
+            f.name: _gs_walk_val(
+                getattr(e, f.name), removed, all_keys, err
+            )
+            for f in dataclasses.fields(e)
+        }
+        return dataclasses.replace(e, **kw)
+    return e
+
+
+def _gs_mentions_grouping(vals) -> bool:
+    """Cheap scan for a grouping(...) call anywhere in the exprs."""
+    stack = list(vals)
+    while stack:
+        x = stack.pop()
+        if x is None or isinstance(x, A.Select):
+            continue
+        if isinstance(x, A.FuncCall) and x.name.lower() == "grouping":
+            return True
+        if isinstance(x, (tuple, list)):
+            stack.extend(x)
+        elif dataclasses.is_dataclass(x) and not isinstance(x, type):
+            stack.extend(
+                getattr(x, f.name) for f in dataclasses.fields(x)
+            )
+    return False
+
+
+def _gs_walk_val(v, removed, all_keys, err):
+    if isinstance(v, A.Select):
+        return v
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return _gs_rewrite(v, removed, all_keys, err)
+    if isinstance(v, tuple):
+        return tuple(_gs_walk_val(x, removed, all_keys, err) for x in v)
+    if isinstance(v, list):
+        return [_gs_walk_val(x, removed, all_keys, err) for x in v]
+    return v
 
 
 # binary operator precedence (higher binds tighter)
@@ -387,15 +495,189 @@ class Parser:
         if self.eat_kw("where"):
             sel.where = self.parse_expr()
         if self.eat_kw("group", "by"):
-            sel.group_by.append(self.parse_expr())
-            while self.eat_op(","):
-                sel.group_by.append(self.parse_expr())
+            sets = self._group_by_factors()
+            if len(sets) == 1:
+                sel.group_by = list(sets[0])
+            else:
+                sel.grouping_sets = sets
         if self.eat_kw("having"):
             sel.having = self.parse_expr()
         self._order_limit(sel)
+        if sel.grouping_sets is not None:
+            sel = self._desugar_grouping_sets(sel)
+        elif sel.group_by and _gs_mentions_grouping(
+            [it.expr for it in sel.items]
+            + [sel.having]
+            + [si.expr for si in sel.order_by]
+        ):
+            # single grouping set: every grouping() is 0 (validated
+            # against the keys), including in ORDER BY
+            rw = lambda x: _gs_rewrite(
+                x, [], sel.group_by, self.error
+            )
+            sel.items = [
+                A.SelectItem(rw(it.expr), it.alias)
+                for it in sel.items
+            ]
+            sel.having = rw(sel.having)
+            new_order = []
+            for si in sel.order_by:
+                ne = rw(si.expr)
+                if ne != si.expr and isinstance(ne, A.Literal):
+                    # grouping() folded to a constant — a constant
+                    # sort key is a no-op (and a bare int literal
+                    # would otherwise read as an ordinal)
+                    continue
+                new_order.append(
+                    A.SortItem(ne, si.descending, si.nulls_first)
+                )
+            sel.order_by = new_order
         if sel.distinct_on is not None:
             sel = self._desugar_distinct_on(sel)
         return sel
+
+    # -- GROUP BY ROLLUP / CUBE / GROUPING SETS -------------------------
+    # (parse.c transformGroupingSet; expanded here into a UNION ALL of
+    # plain grouped selects — one branch per grouping set — with
+    # grouped-out key references replaced by NULL and grouping()
+    # calls replaced by their per-set bitmask constants)
+
+    def _group_by_factors(self) -> list:
+        """Parse the GROUP BY list into grouping sets: each comma item
+        is a factor (plain expr = one singleton set; rollup/cube/
+        grouping sets = several); factors combine by cross product."""
+        factors = [self._group_by_factor()]
+        while self.eat_op(","):
+            factors.append(self._group_by_factor())
+        sets = [()]
+        for f in factors:
+            sets = [s + g for s in sets for g in f]
+        if len(sets) > 64:
+            self.error("too many grouping sets (max 64)")
+        return sets
+
+    def _group_by_factor(self) -> list:
+        def paren_ahead():
+            t = self.peek(1)
+            return t.kind == Tok.OP and t.value == "("
+
+        if self.at_kw("rollup") and paren_ahead():
+            self.pos += 2
+            exprs = [self.parse_expr()]
+            while self.eat_op(","):
+                exprs.append(self.parse_expr())
+            self.expect_op(")")
+            return [tuple(exprs[:i]) for i in range(len(exprs), -1, -1)]
+        if self.at_kw("cube") and paren_ahead():
+            self.pos += 2
+            exprs = [self.parse_expr()]
+            while self.eat_op(","):
+                exprs.append(self.parse_expr())
+            self.expect_op(")")
+            if len(exprs) > 6:
+                self.error("CUBE supports at most 6 expressions")
+            out = []
+            for mask in range(1 << len(exprs)):
+                out.append(tuple(
+                    e for i, e in enumerate(exprs) if mask >> i & 1
+                ))
+            return sorted(out, key=len, reverse=True)
+        if self.at_kw("grouping", "sets"):
+            t = self.peek(2)
+            if t.kind == Tok.OP and t.value == "(":
+                self.pos += 3
+                out = []
+                while True:
+                    out.extend(self._grouping_set_item())
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+                return out
+        return [(self.parse_expr(),)]
+
+    def _grouping_set_item(self) -> list:
+        """One element of a GROUPING SETS list: (), (e, ...), a bare
+        expr, or a nested rollup/cube."""
+        t = self.peek(1)
+        nested = (
+            (self.at_kw("rollup") or self.at_kw("cube"))
+            and t.kind == Tok.OP and t.value == "("
+        ) or self.at_kw("grouping", "sets")
+        if nested:
+            return self._group_by_factor()
+        if self.at_op("("):
+            # try a column-list set first; if the closing paren is
+            # followed by more expression (e.g. (a+b)*2), backtrack
+            # and reparse as a single scalar element
+            mark = self.pos
+            self.pos += 1
+            if self.eat_op(")"):
+                return [()]
+            try:
+                exprs = [self.parse_expr()]
+                while self.eat_op(","):
+                    exprs.append(self.parse_expr())
+                self.expect_op(")")
+                if self.at_op(",") or self.at_op(")"):
+                    return [tuple(exprs)]
+            except ParseError:
+                pass
+            self.pos = mark
+        return [(self.parse_expr(),)]
+
+    def _desugar_grouping_sets(self, sel: A.Select) -> A.Select:
+        sets = sel.grouping_sets
+        sel.grouping_sets = None
+        if sel.distinct or sel.distinct_on is not None:
+            self.error(
+                "DISTINCT with multiple grouping sets is not supported"
+            )
+        # union (ordered) of key exprs across all sets
+        all_keys = []
+        for S in sets:
+            for e in S:
+                if not any(e == k for k in all_keys):
+                    all_keys.append(e)
+        branches = []
+        for S in sets:
+            removed = [
+                k for k in all_keys if not any(k == e for e in S)
+            ]
+            rw = lambda x: _gs_rewrite(
+                x, removed, all_keys, self.error
+            )
+            # a grouped-out key rewritten to NULL must keep its
+            # output column name for the union header / chain ORDER BY
+            b = A.Select(
+                items=[
+                    A.SelectItem(rw(it.expr), it.alias or (
+                        it.expr.name
+                        if isinstance(it.expr, A.ColumnRef) else None
+                    ))
+                    for it in sel.items
+                ],
+                from_clause=sel.from_clause,
+                where=sel.where,
+            )
+            b.group_by = list(S)
+            if sel.having is not None:
+                b.having = rw(sel.having)
+            branches.append(b)
+        if _gs_mentions_grouping(
+            [si.expr for si in sel.order_by]
+        ):
+            self.error(
+                "grouping() in ORDER BY with multiple grouping sets "
+                "is not supported — select it as a column and order "
+                "by the alias"
+            )
+        base = branches[0]
+        base.set_ops = [("union all", b) for b in branches[1:]]
+        base.order_by = sel.order_by
+        base.limit = sel.limit
+        base.offset = sel.offset
+        base.ctes = sel.ctes
+        return base
 
     def _desugar_distinct_on(self, sel: A.Select) -> A.Select:
         """DISTINCT ON (e...) keeps the first row per e-group under the

@@ -1,0 +1,192 @@
+"""GROUP BY ROLLUP / CUBE / GROUPING SETS (parse.c
+transformGroupingSet + nodeAgg grouping-set support in the reference;
+here desugared at parse time into a UNION ALL of plain grouped
+selects, with grouped-out keys replaced by NULL and grouping()
+replaced by per-branch bitmask constants)."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.sql.parser import ParseError
+
+
+@pytest.fixture(scope="module")
+def s():
+    sess = Cluster(num_datanodes=2, shard_groups=16).session()
+    sess.execute(
+        "create table sales (k bigint, city text, cat text, v bigint)"
+        " distribute by shard(k)"
+    )
+    sess.execute(
+        "insert into sales values (1,'ny','a',10),(2,'ny','b',20),"
+        "(3,'sf','a',30),(4,null,'b',40)"
+    )
+    return sess
+
+
+def test_rollup_basic(s):
+    rows = s.query(
+        "select city, cat, sum(v), count(*) from sales"
+        " group by rollup(city, cat)"
+        " order by 1 nulls last, 2 nulls last, 3"
+    )
+    assert rows == [
+        ("ny", "a", 10, 1), ("ny", "b", 20, 1), ("ny", None, 30, 2),
+        ("sf", "a", 30, 1), ("sf", None, 30, 1),
+        (None, "b", 40, 1), (None, None, 40, 1), (None, None, 100, 4),
+    ]
+
+
+def test_cube(s):
+    rows = s.query(
+        "select city, cat, sum(v) from sales group by cube(city, cat)"
+        " order by 1 nulls last, 2 nulls last, 3"
+    )
+    assert rows == [
+        ("ny", "a", 10), ("ny", "b", 20), ("ny", None, 30),
+        ("sf", "a", 30), ("sf", None, 30),
+        (None, "a", 40), (None, "b", 40), (None, "b", 60),
+        (None, None, 40), (None, None, 100),
+    ]
+
+
+def test_grouping_sets_explicit_with_empty(s):
+    rows = s.query(
+        "select city, sum(v) from sales"
+        " group by grouping sets ((city), ())"
+        " order by 1 nulls last, 2"
+    )
+    assert rows == [("ny", 30), ("sf", 30), (None, 40), (None, 100)]
+
+
+def test_grouping_sets_null_branch_first(s):
+    # the NULL-padded branch comes first: the union type/dict
+    # unification must adopt the later branch's text type
+    rows = s.query(
+        "select city, sum(v) from sales"
+        " group by grouping sets ((), (city))"
+        " order by 1 nulls last, 2"
+    )
+    assert rows == [("ny", 30), ("sf", 30), (None, 40), (None, 100)]
+
+
+def test_mixed_plain_and_rollup_cross_product(s):
+    rows = s.query(
+        "select cat, city, sum(v) from sales group by cat, rollup(city)"
+        " order by 1, 2 nulls last, 3"
+    )
+    assert rows == [
+        ("a", "ny", 10), ("a", "sf", 30), ("a", None, 40),
+        ("b", "ny", 20), ("b", None, 40), ("b", None, 60),
+    ]
+
+
+def test_grouping_marker_and_having(s):
+    rows = s.query(
+        "select city, grouping(city), sum(v) from sales"
+        " group by rollup(city) order by 2, 1 nulls last"
+    )
+    assert rows == [
+        ("ny", 0, 30), ("sf", 0, 30), (None, 0, 40), (None, 1, 100),
+    ]
+    # grand-total row only, selected per branch via the folded marker
+    assert s.query(
+        "select sum(v) from sales group by rollup(city)"
+        " having grouping(city) = 1"
+    ) == [(100,)]
+
+
+def test_nested_rollup_inside_grouping_sets(s):
+    rows = s.query(
+        "select city, cat, sum(v) from sales"
+        " group by grouping sets (rollup(city), (cat))"
+        " order by 1 nulls last, 2 nulls last, 3"
+    )
+    assert rows == [
+        ("ny", None, 30), ("sf", None, 30),
+        (None, "a", 40), (None, "b", 60),
+        (None, None, 40), (None, None, 100),
+    ]
+
+
+def test_expression_keys_and_agg_args_untouched(s):
+    # sum(k) aggregates base rows even where k % 2 is grouped out
+    rows = s.query(
+        "select k % 2, sum(k) from sales group by rollup(k % 2)"
+        " order by 1 nulls last"
+    )
+    assert rows == [(0, 6), (1, 4), (None, 10)]
+
+
+def test_rejections(s):
+    with pytest.raises(ParseError, match="DISTINCT"):
+        s.query(
+            "select distinct city, sum(v) from sales"
+            " group by rollup(city)"
+        )
+    with pytest.raises(ParseError, match="grouping"):
+        s.query(
+            "select city, grouping(v) from sales group by rollup(city)"
+        )
+    with pytest.raises(ParseError, match="CUBE"):
+        s.query(
+            "select count(*) from sales"
+            " group by cube(k, v, city, cat, k+1, v+1, k+2)"
+        )
+
+
+def test_rollup_cube_still_valid_identifiers(s):
+    # ROLLUP/CUBE are not reserved: only rollup( / cube( in GROUP BY
+    # trigger the construct
+    s.execute(
+        "create table rollup (k bigint, cube bigint)"
+        " distribute by shard(k)"
+    )
+    s.execute("insert into rollup values (1, 5)")
+    assert s.query("select cube from rollup group by cube") == [(5,)]
+
+
+def test_null_branch_keeps_output_name(s):
+    # ORDER BY a name the first branch NULLs out still resolves
+    rows = s.query(
+        "select city, cat, sum(v) from sales"
+        " group by grouping sets ((city), (cat))"
+        " order by cat nulls last, city nulls last, 3"
+    )
+    assert rows == [
+        (None, "a", 40), (None, "b", 60),
+        ("ny", None, 30), ("sf", None, 30), (None, None, 40),
+    ]
+
+
+def test_qualified_ref_matches_unqualified_key(s):
+    rows = s.query(
+        "select sales.city, sum(v) from sales group by rollup(city)"
+        " order by 1 nulls last, 2"
+    )
+    assert rows == [("ny", 30), ("sf", 30), (None, 40), (None, 100)]
+
+
+def test_grouping_marker_single_set(s):
+    # grouping() under a plain GROUP BY is always 0; in ORDER BY the
+    # folded constant is dropped (not read as an ordinal)
+    assert s.query(
+        "select city, grouping(city), count(*) from sales"
+        " where city is not null group by city order by grouping(city), 1"
+    ) == [("ny", 0, 2), ("sf", 0, 1)]
+
+
+def test_parenthesized_scalar_grouping_element(s):
+    rows = s.query(
+        "select (k+1)*2, sum(v) from sales"
+        " group by grouping sets ((k+1)*2, ()) order by 1 nulls last"
+    )
+    assert rows == [(4, 10), (6, 20), (8, 30), (10, 40), (None, 100)]
+
+
+def test_grouping_in_order_by_multiset_rejected(s):
+    with pytest.raises(ParseError, match="ORDER BY"):
+        s.query(
+            "select city, sum(v) from sales group by rollup(city)"
+            " order by grouping(city)"
+        )
