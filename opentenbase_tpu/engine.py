@@ -1233,6 +1233,16 @@ class Session:
         return False
 
     def _execute_one(self, stmt: A.Statement) -> Result:
+        rec = self._materialize_recursive_ctes(stmt)
+        if rec is None:
+            return self._execute_one_inner(stmt)
+        stmt, temps = rec
+        try:
+            return self._execute_one_inner(stmt)
+        finally:
+            self._drop_temps(temps)
+
+    def _execute_one_inner(self, stmt: A.Statement) -> Result:
         if self.cluster.paused and not isinstance(stmt, A.UnpauseCluster):
             raise SQLError("cluster is paused")
         if self.cluster.read_only and not self._is_readonly_stmt(stmt):
@@ -1624,6 +1634,247 @@ class Session:
             if started:
                 self.execute("commit")
         return out
+
+    # -- WITH RECURSIVE (parse_cte.c checkWellFormedRecursion +
+    # nodeRecursiveUnion.c) ----------------------------------------------
+    def _materialize_recursive_ctes(self, stmt: A.Statement):
+        """Fixpoint-evaluate self-referencing CTEs into temp tables
+        before analysis (the working/intermediate-table iteration of
+        nodeRecursiveUnion.c, table-backed so every later stage sees a
+        plain relation). Returns (stmt, temp tables to drop) or None
+        when the statement has no recursive CTEs."""
+        sel = None
+        if isinstance(stmt, A.Select):
+            sel = stmt
+        elif isinstance(stmt, A.ExplainStmt) and isinstance(
+            stmt.query, A.Select
+        ):
+            sel = stmt.query
+        elif isinstance(stmt, A.CreateTableAs):
+            sel = stmt.query
+        elif isinstance(stmt, A.Insert) and stmt.query is not None:
+            sel = stmt.query
+        if (
+            sel is None
+            or not getattr(sel, "ctes_recursive", False)
+            or not sel.ctes
+        ):
+            return None
+        from opentenbase_tpu.plan.astwalk import (
+            relation_names,
+            rename_relations,
+        )
+
+        if not any(
+            name in relation_names(body)
+            for name, _a, body in sel.ctes
+        ):
+            return None  # RECURSIVE written, nothing recursive: plain
+        if isinstance(stmt, A.ExplainStmt):
+            raise SQLError(
+                "EXPLAIN of a recursive query is not supported"
+            )
+        if self.cluster.read_only:
+            raise SQLError(
+                "recursive queries are not supported on a read-only "
+                "(hot standby) cluster"
+            )
+        temps: list[str] = []
+        rename: dict[str, str] = {}
+        kept = []
+        try:
+            for name, aliases, body in sel.ctes:
+                if rename:
+                    rename_relations(body, rename)
+                if name not in relation_names(body):
+                    kept.append((name, aliases, body))
+                    continue
+                rename[name] = self._recursive_union(
+                    name, aliases, body, temps, kept
+                )
+            sel.ctes = kept
+            if rename:
+                rename_relations(sel, rename)
+        except Exception:
+            self._drop_temps(temps)
+            raise
+        return stmt, temps
+
+    def _drop_temps(self, temps: list) -> None:
+        for t in reversed(temps):
+            try:
+                self.execute(f"drop table if exists {t}")
+            except SQLError:
+                pass
+
+    def _recursive_union(
+        self,
+        name: str,
+        aliases: list,
+        body: A.Select,
+        temps: list,
+        siblings: list = (),
+    ) -> str:
+        """Materialize one recursive CTE; returns the temp table
+        holding its full result."""
+        import copy as _copy
+        import os as _os
+
+        from opentenbase_tpu.plan.astwalk import (
+            relation_names,
+            rename_relations,
+        )
+        from opentenbase_tpu.plan.views import expand_ctes
+        from opentenbase_tpu.sql.deparse import (
+            DeparseError,
+            deparse_select,
+        )
+
+        if not body.set_ops:
+            raise SQLError(
+                f'recursive query "{name}" must have the form '
+                "non-recursive-term UNION [ALL] recursive-term"
+            )
+        if (
+            body.order_by
+            or body.limit is not None
+            or body.offset is not None
+        ):
+            raise SQLError(
+                "ORDER BY/LIMIT in a recursive query is not supported"
+            )
+        if siblings:
+            # non-recursive sibling CTEs from the same WITH list are
+            # in scope for this body — inline fresh copies so the
+            # deparsed CTAS below still resolves them
+            body.ctes = [
+                _copy.deepcopy(sib) for sib in siblings
+            ] + list(body.ctes)
+        expand_ctes(body)  # inner WITHs won't survive deparsing
+        op, rec_term = body.set_ops[-1]
+        if op not in ("union", "union all"):
+            raise SQLError(
+                f'recursive query "{name}" must use UNION [ALL]'
+            )
+        dedup = op == "union"
+        base = _copy.copy(body)
+        base.set_ops = body.set_ops[:-1]
+        if name in relation_names(base):
+            raise SQLError(
+                f'recursive reference to query "{name}" must not '
+                "appear within its non-recursive term"
+            )
+        import uuid as _uuid
+
+        # cluster-wide unique: sessions share one catalog, so a
+        # session-local counter would collide across sessions
+        full = f"__rec_{_uuid.uuid4().hex[:10]}_{name}"
+
+        def push_aliases(q: A.Select, cols: list) -> bool:
+            """Alias ``q``'s top-level items to ``cols`` when shapes
+            allow — the preferred way to give the CTE its declared
+            column names (CTAS needs unique, named outputs)."""
+            if not cols or len(q.items) != len(cols) or any(
+                isinstance(it.expr, A.Star) for it in q.items
+            ):
+                return False
+            q.items = [
+                A.SelectItem(it.expr, c)
+                for it, c in zip(q.items, cols)
+            ]
+            return True
+
+        def ctas(tbl: str, q: A.Select, cols: list) -> list:
+            """CREATE TABLE AS with the output renamed to ``cols``
+            (when given); returns the created table's column names."""
+            try:
+                sql = deparse_select(q)
+            except DeparseError as e:
+                raise SQLError(
+                    f'recursive query "{name}": {e}'
+                ) from None
+            self.execute(f"create table {tbl} as {sql}")
+            temps.append(tbl)
+            got = list(self.cluster.catalog.get(tbl).schema)
+            if cols and got != cols:
+                if len(got) != len(cols):
+                    raise SQLError(
+                        f'recursive query "{name}" column arity '
+                        f"mismatch: {len(got)} vs {len(cols)}"
+                    )
+                if any(not g.replace("_", "").isalnum() for g in got):
+                    raise SQLError(
+                        f'recursive query "{name}": alias unnamed '
+                        "output columns in the CTE column list"
+                    )
+                proj = ", ".join(
+                    f"{g} as {c}" for g, c in zip(got, cols)
+                )
+                self.execute(
+                    f"create table {tbl}r as select {proj} from {tbl}"
+                )
+                temps.append(f"{tbl}r")
+                self.execute(f"drop table {tbl}")
+                temps.remove(tbl)
+                return cols
+            return got
+
+        want = list(aliases)
+        if push_aliases(base, want):
+            want = []
+        if dedup:
+            base = A.Select(
+                items=[A.SelectItem(A.Star(), None)],
+                from_clause=A.SubqueryRef(base, "__rb"),
+                distinct=True,
+            )
+        cols = ctas(full, base, want)
+        if f"{full}r" in temps:
+            full = f"{full}r"
+        work = f"{full}_w"
+        self.execute(f"create table {work} as select * from {full}")
+        temps.append(work)
+        limit = int(_os.environ.get("OTB_MAX_RECURSION", "200"))
+        for it in range(1, limit + 1):
+            rec = _copy.deepcopy(rec_term)
+            refs = rename_relations(rec, {name: work})
+            if it == 1 and refs != 1:
+                raise SQLError(
+                    f'recursive reference to query "{name}" must '
+                    "appear exactly once in the recursive term"
+                )
+            delta = f"{full}_d{it}"
+            want = list(cols)
+            if push_aliases(rec, want):
+                want = []
+            if dedup:
+                rec = A.Select(
+                    items=[A.SelectItem(A.Star(), None)],
+                    from_clause=A.SubqueryRef(rec, "__rd"),
+                )
+                rec.set_ops = [(
+                    "except",
+                    A.Select(
+                        items=[A.SelectItem(A.Star(), None)],
+                        from_clause=A.RelRef(full, None),
+                    ),
+                )]
+            ctas(delta, rec, want)
+            if f"{delta}r" in temps:
+                delta = f"{delta}r"
+            n = self.query(f"select count(*) from {delta}")[0][0]
+            self.execute(f"drop table {work}")
+            temps.remove(work)
+            work = delta
+            if n == 0:
+                return full
+            self.execute(
+                f"insert into {full} select * from {delta}"
+            )
+        raise SQLError(
+            f'recursion limit ({limit}) exceeded in query "{name}" '
+            "— set OTB_MAX_RECURSION to raise it"
+        )
 
     def _expand_ctes_stmt(self, stmt: A.Statement):
         """Expand WITH clauses (statement-scoped views, parse_cte.c).

@@ -111,6 +111,16 @@ def expand_ctes(sel: A.Select, depth: int = 0) -> A.Select:
                     f'WITH query name "{name}" specified more '
                     "than once"
                 )
+            from opentenbase_tpu.plan.astwalk import relation_names
+
+            if name in relation_names(body):
+                # the session materializes top-level recursive CTEs
+                # before this runs — one reaching here would silently
+                # resolve against a same-named base table
+                raise ViewRecursionError(
+                    f'recursive WITH query "{name}" is only '
+                    "supported at the top level of a statement"
+                )
             body = copy.deepcopy(body)
             expand_ctes(body, depth + 1)  # nested WITH in the body
             rewrite_views(body, cte_views, depth + 1)

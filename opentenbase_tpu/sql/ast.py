@@ -292,6 +292,9 @@ class Select(Statement):
     # GROUP BY ROLLUP/CUBE/GROUPING SETS — list of grouping sets
     # (tuples of exprs); desugared by the parser into UNION ALL
     grouping_sets: Optional[list] = None
+    # WITH RECURSIVE was written: self-referencing CTEs are
+    # materialized iteratively by the engine before analysis
+    ctes_recursive: bool = False
 
 
 @dataclass

@@ -373,9 +373,9 @@ class Parser:
         # Non-recursive only: bodies are statement-scoped views,
         # expanded before analysis (plan/views.py expand_ctes).
         ctes = []
+        recursive = False
         if self.eat_kw("with"):
-            if self.at_kw("recursive"):
-                self.error("WITH RECURSIVE is not supported")
+            recursive = self.eat_kw("recursive")
             while True:
                 cname = self.ident("CTE name")
                 aliases = []
@@ -393,6 +393,7 @@ class Parser:
                     break
         sel = self._select_core()
         sel.ctes = ctes
+        sel.ctes_recursive = recursive
         while True:
             if self.at_kw("union"):
                 self.advance()
@@ -677,6 +678,7 @@ class Parser:
         base.limit = sel.limit
         base.offset = sel.offset
         base.ctes = sel.ctes
+        base.ctes_recursive = sel.ctes_recursive
         return base
 
     def _desugar_distinct_on(self, sel: A.Select) -> A.Select:
@@ -793,6 +795,7 @@ class Parser:
             offset=sel.offset,
         )
         outer.ctes = sel.ctes
+        outer.ctes_recursive = sel.ctes_recursive
         return outer
 
     def _order_limit(self, sel: A.Select) -> None:

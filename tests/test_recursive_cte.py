@@ -1,0 +1,213 @@
+"""WITH RECURSIVE (parse_cte.c checkWellFormedRecursion +
+nodeRecursiveUnion.c): self-referencing CTEs are fixpoint-evaluated
+into temp tables before analysis — base term materializes, the
+recursive term runs against the per-iteration working (delta) table,
+UNION dedups against everything seen (cycle-safe), UNION ALL appends."""
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster, SQLError
+
+
+@pytest.fixture(scope="module")
+def c():
+    return Cluster(num_datanodes=2, shard_groups=16)
+
+
+@pytest.fixture(scope="module")
+def s(c):
+    sess = c.session()
+    sess.execute(
+        "create table edges (k bigint, src bigint, dst bigint)"
+        " distribute by shard(k)"
+    )
+    sess.execute(
+        "insert into edges values (1,1,2),(2,2,3),(3,3,1),(4,3,4)"
+    )
+    return sess
+
+
+def _no_rec_temps(c):
+    return [n for n in c.catalog._tables if n.startswith("__rec")]
+
+
+def test_counter_union_all(s, c):
+    assert s.query(
+        "with recursive t(n) as"
+        " (select 1 union all select n+1 from t where n < 5)"
+        " select sum(n), count(*) from t"
+    ) == [(15, 5)]
+    assert _no_rec_temps(c) == []
+
+
+def test_cycle_terminates_under_union(s, c):
+    # 1->2->3->1 cycle plus 3->4: UNION dedup reaches the fixpoint
+    rows = s.query(
+        "with recursive reach(node) as ("
+        " select 2 union"
+        " select e.dst from edges e join reach r on e.src = r.node"
+        ") select node from reach order by node"
+    )
+    assert rows == [(1,), (2,), (3,), (4,)]
+    assert _no_rec_temps(c) == []
+
+
+def test_delta_semantics_union_all(s):
+    # the recursive term sees only the previous iteration's rows
+    # (working table), not the accumulated result
+    assert s.query(
+        "with recursive b(m) as"
+        " (select 10 union all select m+1 from b where m < 12)"
+        " select sum(m) from b"
+    ) == [(33,)]
+
+
+def test_second_cte_uses_first(s):
+    rows = s.query(
+        "with recursive a(n) as"
+        " (select 1 union all select n+1 from a where n < 3),"
+        " b(m) as (select n*10 from a union all"
+        "          select m+1 from b where m < 12)"
+        " select sum(m) from b"
+    )
+    assert rows == [(83,)]  # 10+20+30 + 11+12
+
+
+def test_plain_cte_after_recursive(s):
+    rows = s.query(
+        "with recursive t(n) as"
+        " (select 1 union all select n+1 from t where n < 4),"
+        " doubled as (select n*2 as d from t)"
+        " select sum(d) from doubled"
+    )
+    assert rows == [(20,)]
+
+
+def test_recursive_keyword_without_recursion(s):
+    # RECURSIVE is allowed on non-self-referencing CTEs (plain path)
+    assert s.query(
+        "with recursive x as (select 42 as v) select v from x"
+    ) == [(42,)]
+
+
+def test_text_columns_roundtrip(s):
+    # text flows through the per-iteration temp tables (dictionary
+    # re-encode on every CTAS) without corruption
+    s.execute(
+        "create table nm (k bigint, label text) distribute by shard(k)"
+    )
+    s.execute(
+        "insert into nm values (1,'uno'),(2,'dos'),(3,'tres'),(4,'vier')"
+    )
+    rows = s.query(
+        "with recursive r(node, label) as ("
+        " select 1, 'start'"
+        " union"
+        " select e.dst, nm.label from edges e"
+        "  join r on e.src = r.node join nm on nm.k = e.dst"
+        ") select node, label from r order by node"
+    )
+    assert rows == [
+        (1, "start"), (1, "uno"), (2, "dos"), (3, "tres"), (4, "vier"),
+    ]
+
+
+def test_into_insert_and_ctas(s, c):
+    s.execute(
+        "create table fib (i bigint, f bigint) distribute by shard(i)"
+    )
+    s.execute(
+        "insert into fib"
+        " with recursive fb(i, a, b) as ("
+        "  select 1, 0, 1"
+        "  union all select i+1, b, a+b from fb where i < 8"
+        " ) select i, a from fb"
+    )
+    assert s.query("select f from fib order by i") == [
+        (0,), (1,), (1,), (2,), (3,), (5,), (8,), (13,)
+    ]
+    s.execute(
+        "create table seq5 as with recursive t(n) as"
+        " (select 1 union all select n+1 from t where n < 5)"
+        " select n from t"
+    )
+    assert s.query("select count(*), max(n) from seq5") == [(5, 5)]
+    assert _no_rec_temps(c) == []
+
+
+def test_malformed_and_limits(s, c):
+    with pytest.raises(SQLError, match="UNION"):
+        s.query(
+            "with recursive t(n) as (select n+1 from t where n < 3)"
+            " select * from t"
+        )
+    with pytest.raises(SQLError, match="non-recursive term"):
+        s.query(
+            "with recursive t(n) as"
+            " (select n from t union all select 1) select * from t"
+        )
+    with pytest.raises(SQLError, match="exactly once"):
+        s.query(
+            "with recursive t(n) as (select 1 union all"
+            " select a.n + b.n from t a, t b) select * from t"
+        )
+    with pytest.raises(SQLError, match="ORDER BY"):
+        s.query(
+            "with recursive t(n) as (select 1 union all"
+            " select n+1 from t where n < 3 order by n)"
+            " select * from t"
+        )
+    with pytest.raises(SQLError, match="recursion limit"):
+        s.query(
+            "with recursive t(n) as"
+            " (select 1 union all select n+1 from t)"
+            " select count(*) from t"
+        )
+    # failed recursions must not leak temp tables either
+    assert _no_rec_temps(c) == []
+
+
+def test_recursive_body_uses_earlier_plain_sibling(s):
+    # a plain sibling CTE from the same WITH list is in scope inside
+    # the recursive body (inlined before materialization)
+    rows = s.query(
+        "with recursive seed as (select 1 as n),"
+        " t(n) as (select n from seed"
+        "          union all select n+1 from t where n < 3)"
+        " select n from t order by n"
+    )
+    assert rows == [(1,), (2,), (3,)]
+
+
+def test_explain_recursive_rejected(s):
+    with pytest.raises(SQLError, match="EXPLAIN"):
+        s.query(
+            "explain with recursive t(n) as"
+            " (select 1 union all select n+1 from t where n < 3)"
+            " select * from t"
+        )
+
+
+def test_concurrent_sessions_no_collision(c):
+    # temp names are cluster-unique, not per-session counters
+    import threading
+
+    results = {}
+
+    def run(tag):
+        sess = c.session()
+        results[tag] = sess.query(
+            "with recursive t(n) as"
+            " (select 1 union all select n+1 from t where n < 6)"
+            " select sum(n) from t"
+        )
+
+    ts = [
+        threading.Thread(target=run, args=(i,)) for i in range(3)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert all(results[i] == [(21,)] for i in range(3))
+    assert _no_rec_temps(c) == []

@@ -206,12 +206,23 @@ def test_ctes_expand_as_statement_scoped_views():
         "select sum(v) as s2 from base"
     )
     assert s.query("select s2 from wv") == [(35,)]
-    # WITH RECURSIVE is rejected loudly
+    # WITH RECURSIVE now works (materialized fixpoint; the full
+    # surface is covered in test_recursive_cte.py) — and a
+    # non-recursive CTE under the RECURSIVE keyword takes the plain
+    # expansion path
+    assert s.query(
+        "with recursive r as (select 1 as one) select * from r"
+    ) == [(1,)]
+    # ...but only at statement top level: a recursive CTE inside a
+    # subquery or view body is rejected loudly, never silently
+    # resolved against a same-named base table
     import pytest
 
-    with pytest.raises(Exception, match="RECURSIVE"):
+    with pytest.raises(Exception, match="top level"):
         s.query(
-            "with recursive r as (select 1) select * from r"
+            "select * from (with recursive r(n) as"
+            " (select 1 union all select n+1 from r where n < 3)"
+            " select * from r) d"
         )
 
 
