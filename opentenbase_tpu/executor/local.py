@@ -1228,6 +1228,10 @@ def _texpr_did(e: E.TExpr, schema) -> Optional[str]:
         return schema[e.index].dict_id
     if isinstance(e, E.CastE):
         return _texpr_did(e.operand, schema)
+    if e.type.is_text:
+        # computed text (upper(col), col || 'x') canonicalizes into
+        # the literal pool (ops/expr.py: dst = want or LITERAL_DICT)
+        return LITERAL_DICT
     return None
 
 

@@ -1100,6 +1100,9 @@ _HOST_TEXT_FNS = {
     "length": len,
     "char_length": len,
     "instr": _py_instr,
+    # constant side pre-stringified by the analyzer (s_of)
+    "concat_r": lambda s, suf: s + suf,
+    "concat_l": lambda s, pre: pre + s,
     "to_number": lambda s: float(s),
     "to_date": _py_to_date,
     "to_timestamp": _py_to_timestamp,

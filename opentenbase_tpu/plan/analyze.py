@@ -1253,7 +1253,66 @@ class Analyzer:
                 raise AnalyzeError("LIKE pattern must be a string constant")
             return E.LikeE(operand, pat.value, op == "ilike", False)
         if op == "||":
-            raise AnalyzeError("string concatenation must be computed host-side (unsupported)")
+            # concatenation rides the dictionary-transform path
+            # (ops/expr.py _text_func): a constant side folds into the
+            # transform's extra args, so it costs one table lookup per
+            # code. Two non-constant sides would need a pairwise table
+            # — not supported.
+            l = self.expr(e.left, ctx)
+            r = self.expr(e.right, ctx)
+
+            def s_of(c: E.Const) -> str:
+                v = c.value
+                if isinstance(v, bool):
+                    return "true" if v else "false"
+                if c.type.id == t.TypeId.DECIMAL:
+                    # integer rendering keeps declared scale and full
+                    # precision (no float round-trip)
+                    scale = len(str(c.type.decimal_factor)) - 1
+                    s = str(abs(v)).rjust(scale + 1, "0")
+                    sign = "-" if v < 0 else ""
+                    return f"{sign}{s[:-scale]}.{s[-scale:]}" if scale else str(v)
+                if c.type.id == t.TypeId.DATE:
+                    import datetime as _dt
+
+                    return str(
+                        _dt.date(1970, 1, 1) + _dt.timedelta(days=v)
+                    )
+                if c.type.id == t.TypeId.TIMESTAMP:
+                    import datetime as _dt
+
+                    dt = _dt.datetime(
+                        1970, 1, 1, tzinfo=_dt.timezone.utc
+                    ) + _dt.timedelta(microseconds=v)
+                    return dt.strftime("%Y-%m-%d %H:%M:%S") + (
+                        f".{dt.microsecond:06d}".rstrip("0")
+                        if dt.microsecond else ""
+                    )
+                return str(v)
+
+            if isinstance(l, E.Const) and isinstance(r, E.Const):
+                if l.value is None or r.value is None:
+                    return E.Const(None, t.TEXT)
+                return E.Const(s_of(l) + s_of(r), t.TEXT)
+            if isinstance(r, E.Const):
+                if r.value is None:
+                    return E.Const(None, t.TEXT)
+                if not l.type.is_text:
+                    raise AnalyzeError("|| needs a text operand")
+                return E.FuncE(
+                    "concat_r", (l, E.Const(s_of(r), t.TEXT)), t.TEXT
+                )
+            if isinstance(l, E.Const):
+                if l.value is None:
+                    return E.Const(None, t.TEXT)
+                if not r.type.is_text:
+                    raise AnalyzeError("|| needs a text operand")
+                return E.FuncE(
+                    "concat_l", (r, E.Const(s_of(l), t.TEXT)), t.TEXT
+                )
+            raise AnalyzeError(
+                "|| of two non-constant values is not supported"
+            )
         # interval arithmetic
         li = self._maybe_interval(e.left, ctx)
         ri = self._maybe_interval(e.right, ctx)
@@ -2158,12 +2217,26 @@ def _default_name(e: A.Expr) -> str:
     return "?column?"
 
 
+def _computed_text_did(te: E.TExpr) -> Optional[str]:
+    """Dictionary for a non-column TEXT expr: computed text
+    (upper(col), col || 'x', CASE literals) is canonicalized into the
+    session literal pool by the expr compiler (ops/expr.py: dst =
+    want or LITERAL_DICT). A NULL literal stays dict-less so set-op
+    alignment can adopt the other side's dictionary (grouping-set
+    padding relies on this)."""
+    from opentenbase_tpu.ops.expr import LITERAL_DICT
+
+    if isinstance(te, E.Const) and te.value is None:
+        return None
+    return LITERAL_DICT
+
+
 def _texpr_dict_id(te: E.TExpr, scope: Scope) -> Optional[str]:
     if te.type.id != t.TypeId.TEXT:
         return None
     if isinstance(te, E.Col) and te.index < len(scope.cols):
         return scope.cols[te.index].dict_id
-    return None
+    return _computed_text_did(te)
 
 
 def _texpr_dict_id_grouped(te: E.TExpr, gctx: GroupedContext) -> Optional[str]:
@@ -2172,7 +2245,7 @@ def _texpr_dict_id_grouped(te: E.TExpr, gctx: GroupedContext) -> Optional[str]:
     if isinstance(te, E.Col) and te.index < len(gctx.group_texprs):
         inner = gctx.group_texprs[te.index]
         return _texpr_dict_id(inner, gctx.input_ctx.scope)
-    return None
+    return _computed_text_did(te)
 
 
 def _expr_dict_id(te: E.TExpr, schema: tuple[L.OutCol, ...]) -> Optional[str]:
@@ -2180,7 +2253,7 @@ def _expr_dict_id(te: E.TExpr, schema: tuple[L.OutCol, ...]) -> Optional[str]:
         return None
     if isinstance(te, E.Col) and te.index < len(schema):
         return schema[te.index].dict_id
-    return None
+    return _computed_text_did(te)
 
 
 # ---------------------------------------------------------------------------

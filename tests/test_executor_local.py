@@ -502,3 +502,67 @@ def test_update_from_delete_using():
     s.execute("update t set v = 12345 from u where t.k = u.k")
     s.execute("rollback")
     assert s.query("select k, v from t order by k") == before
+
+
+# -- string concatenation (|| via dictionary transforms) ----------------
+
+def test_concat_basics():
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        "create table cc (k bigint, nm text, v bigint)"
+        " distribute by shard(k)"
+    )
+    s.execute("insert into cc values (1,'ada',10),(2,'bo',20),(3,null,30)")
+    assert s.query("select nm || '!' from cc order by k") == [
+        ("ada!",), ("bo!",), (None,)
+    ]
+    assert s.query("select '<' || nm || '>' from cc order by k") == [
+        ("<ada>",), ("<bo>",), (None,)
+    ]
+    # non-text const side stringifies; const folding
+    assert s.query("select 'n=' || 5") == [("n=5",)]
+    assert s.query("select 'a' || 'b' || 'c'") == [("abc",)]
+    # NULL const side -> NULL
+    assert s.query("select nm || null from cc where k = 1") == [(None,)]
+    # usable in WHERE / GROUP BY / ORDER BY (literal-pool dictionary)
+    assert s.query("select count(*) from cc where nm || 's' = 'adas'") == [(1,)]
+    assert s.query(
+        "select nm || '_g', sum(v) from cc where nm is not null"
+        " group by nm || '_g' order by 1"
+    ) == [("ada_g", 10), ("bo_g", 20)]
+    assert s.query(
+        "select upper(nm) from cc where nm is not null"
+        " order by upper(nm) desc"
+    ) == [("BO",), ("ADA",)]
+    import pytest
+
+    from opentenbase_tpu.plan.analyze import AnalyzeError
+    with pytest.raises(AnalyzeError, match="non-constant"):
+        s.query("select nm || nm from cc")
+
+
+def test_concat_typed_constants():
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=1, shard_groups=8).session()
+    # date/timestamp constants render as their SQL text, not raw
+    # epoch integers; decimals keep declared scale without a float
+    # round-trip
+    assert s.query("select 'on ' || date '2020-01-02'") == [
+        ("on 2020-01-02",)
+    ]
+    assert s.query(
+        "select 'at ' || timestamp '2020-01-02 03:04:05'"
+    ) == [("at 2020-01-02 03:04:05",)]
+    assert s.query(
+        "select 'p=' || cast(1.50 as decimal(10,2))"
+    ) == [("p=1.50",)]
+    assert s.query(
+        "select 'n=' || cast(-2.05 as decimal(10,2))"
+    ) == [("n=-2.05",)]
+    # NULL folds before the text-operand check: int || NULL is NULL
+    s.execute("create table ic (k bigint, v bigint) distribute by shard(k)")
+    s.execute("insert into ic values (1, 7)")
+    assert s.query("select v || null from ic") == [(None,)]
