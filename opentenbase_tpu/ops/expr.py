@@ -77,6 +77,26 @@ class DictTranslateParam:
 
 
 @dataclass(frozen=True)
+class PairConcatParam:
+    """2D int32 table for ``pre || a || mid || b || post`` over two
+    non-constant TEXT operands: entry [code_a, code_b] = ``dst`` code
+    of the joined string, both axes padded to powers of two.
+    ``steps_a``/``steps_b`` are per-side host-fn chains applied to the
+    axis values first (upper(x) || y composes into the table).
+    Size-gated (OTB_CONCAT_PAIR_MAX product entries, default 2^20)
+    since it enumerates the cross product host-side; the result is
+    cached on the ``dst`` dictionary keyed by source sizes (append-only
+    dictionaries make that stable)."""
+
+    src_a: str
+    src_b: str
+    dst: str
+    segs: tuple = ("", "", "")  # (pre, mid, post)
+    steps_a: tuple = ()
+    steps_b: tuple = ()
+
+
+@dataclass(frozen=True)
 class CodeMaskParam:
     """Per-code bool membership mask over dictionary ``dict_id``, padded to
     a power of two. ``patterns`` are LIKE patterns (ORed); ``values`` exact
@@ -106,6 +126,14 @@ class StrTransformParam:
     fn: str
     args: tuple = ()
     out_dtype: str = "int32"
+    # composed chain ((fn, args), ...) applied innermost-first over the
+    # BASE dictionary — upper(lower(x)) or lower(x) || 's' become ONE
+    # table over x's column dict instead of canonicalizing every
+    # intermediate through the shared literal pool (whose whole-pool
+    # axes would otherwise re-enumerate their own past outputs and grow
+    # the pool every execution). When set, ``fn``/``args`` are display
+    # only.
+    steps: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -591,6 +619,8 @@ class ExprCompiler:
         import jax.numpy as jnp
 
         name = e.name
+        if name == "concat_pair":
+            return self._concat_pair(e, dids, want)
         if name in _HOST_TEXT_FNS:
             # compiled separately: argument compilation differs (codes in
             # the SOURCE dictionary, not the output one)
@@ -831,28 +861,69 @@ class ExprCompiler:
 
         raise NotImplementedError(f"function {name}")
 
+    def _concat_pair(self, e: E.FuncE, dids, want) -> CompiledExpr:
+        """pre || a || mid || b || post with two non-constant text
+        sides: 2D table gather over the two source dictionaries
+        (PairConcatParam). Host-fn chains on a side (upper(x) || y)
+        compose into the table over the BASE dictionary."""
+        import jax.numpy as jnp
+
+        segs = tuple(a.value for a in e.args[2:]) or ("", "", "")
+        fns = []
+        srcs = []
+        chains = []
+        for a in e.args[:2]:
+            base, steps = _host_chain(a)
+            src = self._text_src_did(base, dids)
+            if src is None:
+                # non-chainable computed side (CASE etc.): canonicalize
+                # the whole side through the literal pool
+                src = LITERAL_DICT
+                fns.append(self._c(a, dids, src))
+                steps = ()
+            else:
+                fns.append(self._c(base, dids, None))
+            srcs.append(src)
+            chains.append(steps)
+        dst = want or LITERAL_DICT
+        pi = self._param(PairConcatParam(
+            srcs[0], srcs[1], dst, segs, chains[0], chains[1]
+        ))
+
+        def run_pair(cols, params):
+            a, av = fns[0](cols, params)
+            b, bv = fns[1](cols, params)
+            tbl, tvalid = params[pi]
+            ia = jnp.clip(a, 0, tbl.shape[0] - 1)
+            ib = jnp.clip(b, 0, tbl.shape[1] - 1)
+            return (
+                tbl[ia, ib],
+                _and_valid(_and_valid(av, bv), tvalid[ia, ib]),
+            )
+
+        return run_pair
+
     # -- host-evaluated text functions (dictionary transforms) -----------
     def _text_func(self, e: E.FuncE, dids, want) -> CompiledExpr:
         import jax.numpy as jnp
 
         name = e.name
         textual = e.type.is_text
-        # the transform table is built over the codes the first argument
-        # actually carries: a bare column keeps its own dictionary, any
-        # composed text expression is canonicalized through the target
-        src = self._text_src_did(e.args[0], dids)
+        # Peel nested host fns into one composed chain so the table is
+        # built over the BASE argument's own dictionary — upper(lower
+        # (col)) or trim(col) || 's' never canonicalize intermediates
+        # through the shared literal pool.
+        base, steps = _host_chain(e)
+        if not steps:
+            raise NotImplementedError(
+                f"{name}: non-constant arguments beyond the first"
+            )
+        src = self._text_src_did(base, dids)
         if src is None:
             src = want or LITERAL_DICT
-            argf = self._c(e.args[0], dids, src)
+            argf = self._c(base, dids, src)
         else:
-            argf = self._c(e.args[0], dids, None)
-        extra = []
-        for a in e.args[1:]:
-            if not isinstance(a, E.Const):
-                raise NotImplementedError(
-                    f"{name}: non-constant arguments beyond the first"
-                )
-            extra.append(a.value)
+            argf = self._c(base, dids, None)
         dst = (want or LITERAL_DICT) if textual else None
         out_dtype = "int32"
         if not textual:
@@ -860,7 +931,9 @@ class ExprCompiler:
                 t.TypeId.TIMESTAMP: "int64", t.TypeId.FLOAT8: "float64",
             }.get(e.type.id, "int32")
         pi = self._param(
-            StrTransformParam(src, dst, name, tuple(extra), out_dtype)
+            StrTransformParam(
+                src, dst, name, steps[-1][1], out_dtype, steps
+            )
         )
 
         def run_text(cols, params):
@@ -1100,13 +1173,45 @@ _HOST_TEXT_FNS = {
     "length": len,
     "char_length": len,
     "instr": _py_instr,
-    # constant side pre-stringified by the analyzer (s_of)
-    "concat_r": lambda s, suf: s + suf,
-    "concat_l": lambda s, pre: pre + s,
+    # constant segments pre-stringified by the analyzer (s_of)
+    "concat_seg": lambda s, pre, post: pre + s + post,
     "to_number": lambda s: float(s),
     "to_date": _py_to_date,
     "to_timestamp": _py_to_timestamp,
 }
+
+
+def _host_chain(e):
+    """Peel nested host-text fns with constant extra args off ``e``:
+    returns (base_expr, steps) with ``steps`` = ((fn, extras), ...)
+    applied innermost-first. Inner links must be text-valued (they feed
+    the next fn's string input); the outermost may be scalar-valued
+    (length/to_date/...). A bare column/const returns (e, ())."""
+    steps = []
+    cur = e
+    while (
+        isinstance(cur, E.FuncE)
+        and cur.name in _HOST_TEXT_FNS
+        and cur.args
+        and all(isinstance(a, E.Const) for a in cur.args[1:])
+        and (cur is e or cur.type.is_text)
+    ):
+        steps.append(
+            (cur.name, tuple(a.value for a in cur.args[1:]))
+        )
+        cur = cur.args[0]
+    steps.reverse()
+    return cur, tuple(steps)
+
+
+def _run_chain(value, steps):
+    """Thread a string through a host-fn chain; exceptions mean NULL
+    (try_cast semantics, same as the single-fn path)."""
+    for fname, fargs in steps:
+        value = _HOST_TEXT_FNS[fname](value, *fargs)
+        if value is None:
+            return None
+    return value
 
 
 def resolve_param(spec: ParamSpec, dictionaries, subquery_values=None):
@@ -1139,7 +1244,7 @@ def resolve_param(spec: ParamSpec, dictionaries, subquery_values=None):
 
     if isinstance(spec, StrTransformParam):
         src = dictionaries[spec.src]
-        fn = _HOST_TEXT_FNS[spec.fn]
+        steps = spec.steps or ((spec.fn, spec.args),)
         # per-value evaluation with try_cast semantics: the table covers
         # EVERY dictionary entry, including '' NULL placeholders and
         # values belonging to rows a WHERE clause would filter out —
@@ -1148,7 +1253,7 @@ def resolve_param(spec: ParamSpec, dictionaries, subquery_values=None):
         outs, ok = [], []
         for sv in src.values:
             try:
-                r = fn(sv, *spec.args)
+                r = _run_chain(sv, steps)
             except (ValueError, TypeError, OverflowError):
                 r = None
             outs.append(r)
@@ -1169,6 +1274,71 @@ def resolve_param(spec: ParamSpec, dictionaries, subquery_values=None):
                 if o is not None:
                     table[i] = o
         return (jnp.asarray(table), jnp.asarray(valid))
+
+    if isinstance(spec, PairConcatParam):
+        import os as _os
+
+        da = dictionaries[spec.src_a]
+        db = dictionaries[spec.src_b]
+        dst = dictionaries[spec.dst]
+        na, nb = len(da.values), len(db.values)
+        if na == 0 or nb == 0:
+            z = jnp.zeros((1, 1), dtype=jnp.int32)
+            return (z, jnp.zeros((1, 1), dtype=jnp.bool_))
+        # append-only dictionaries make the table a pure function of
+        # (spec, na, nb): cache it on the dst dictionary object
+        cache = getattr(dst, "_pair_cache", None)
+        if cache is None:
+            cache = {}
+            try:
+                dst._pair_cache = cache
+            except AttributeError:
+                cache = None
+        ckey = (spec, na, nb)
+        if cache is not None and ckey in cache:
+            return cache[ckey]
+        cap = int(_os.environ.get("OTB_CONCAT_PAIR_MAX", str(1 << 20)))
+        if na * nb > cap:
+            raise RuntimeError(
+                f"|| of two columns needs a {na}x{nb} pairwise "
+                f"table, over OTB_CONCAT_PAIR_MAX={cap}"
+            )
+        pre, mid, post = spec.segs
+
+        def axis(vals, steps):
+            out = []
+            for v in vals:
+                try:
+                    out.append(_run_chain(v, steps))
+                except (ValueError, TypeError, OverflowError):
+                    out.append(None)
+            return out
+
+        ta = axis(da.values, spec.steps_a)
+        tb = axis(db.values, spec.steps_b)
+        pa, pb = _next_pow2(na), _next_pow2(nb)
+        table = np.zeros((pa, pb), dtype=np.int32)
+        valid = np.zeros((pa, pb), dtype=np.bool_)
+        joined, slots = [], []
+        for i, a in enumerate(ta):
+            if a is None:
+                continue
+            for j, b in enumerate(tb):
+                if b is None:
+                    continue
+                joined.append(pre + a + mid + b + post)
+                slots.append((i, j))
+        if joined:
+            codes = dst.encode(joined)
+            for (i, j), c in zip(slots, codes):
+                table[i, j] = c
+                valid[i, j] = True
+        out = (jnp.asarray(table), jnp.asarray(valid))
+        if cache is not None:
+            if len(cache) > 32:
+                cache.clear()
+            cache[ckey] = out
+        return out
 
     if isinstance(spec, CodeMaskParam):
         d = dictionaries[spec.dict_id]

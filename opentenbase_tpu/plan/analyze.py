@@ -1254,12 +1254,9 @@ class Analyzer:
             return E.LikeE(operand, pat.value, op == "ilike", False)
         if op == "||":
             # concatenation rides the dictionary-transform path
-            # (ops/expr.py _text_func): a constant side folds into the
-            # transform's extra args, so it costs one table lookup per
-            # code. Two non-constant sides would need a pairwise table
-            # — not supported.
-            l = self.expr(e.left, ctx)
-            r = self.expr(e.right, ctx)
+            # (ops/expr.py): constant segments fold into transform
+            # extra args (one 1D table lookup per code); two
+            # non-constant sides use a pairwise table (PairConcatParam)
 
             def s_of(c: E.Const) -> str:
                 v = c.value
@@ -1290,28 +1287,100 @@ class Analyzer:
                     )
                 return str(v)
 
-            if isinstance(l, E.Const) and isinstance(r, E.Const):
-                if l.value is None or r.value is None:
-                    return E.Const(None, t.TEXT)
-                return E.Const(s_of(l) + s_of(r), t.TEXT)
-            if isinstance(r, E.Const):
-                if r.value is None:
-                    return E.Const(None, t.TEXT)
-                if not l.type.is_text:
-                    raise AnalyzeError("|| needs a text operand")
-                return E.FuncE(
-                    "concat_r", (l, E.Const(s_of(r), t.TEXT)), t.TEXT
+            # Flatten the whole || spine into constant segments and
+            # non-constant exprs so one transform covers the chain
+            # (a || ' ' || b becomes ONE pairwise table; 'x' || a ||
+            # 'y' ONE 1D table) — no intermediate results ever
+            # canonicalize through the shared literal pool.
+            parts: list = []  # Const | TExpr, in order
+
+            def walk(node):
+                if isinstance(node, A.BinOp) and node.op == "||":
+                    walk(node.left)
+                    walk(node.right)
+                else:
+                    parts.append(self.expr(node, ctx))
+
+            walk(e)
+            # NULL anywhere folds the whole chain before operand-type
+            # checks (PG: int_col || NULL is NULL, not an error)
+            if any(
+                isinstance(p, E.Const) and p.value is None
+                for p in parts
+            ):
+                return E.Const(None, t.TEXT)
+            merged: list = []
+            for p in parts:
+                if isinstance(p, E.Const):
+                    s = s_of(p)
+                    if merged and isinstance(merged[-1], str):
+                        merged[-1] += s
+                    else:
+                        merged.append(s)
+                else:
+                    if not p.type.is_text:
+                        raise AnalyzeError("|| needs a text operand")
+                    merged.append(p)
+            exprs = [p for p in merged if not isinstance(p, str)]
+            if not exprs:
+                return E.Const(merged[0] if merged else "", t.TEXT)
+
+            def seg_after(idx):
+                return (
+                    merged[idx + 1]
+                    if idx + 1 < len(merged)
+                    and isinstance(merged[idx + 1], str) else ""
                 )
-            if isinstance(l, E.Const):
-                if l.value is None:
-                    return E.Const(None, t.TEXT)
-                if not r.type.is_text:
-                    raise AnalyzeError("|| needs a text operand")
+
+            pre = merged[0] if isinstance(merged[0], str) else ""
+            if len(exprs) == 1:
+                i0 = merged.index(exprs[0])
                 return E.FuncE(
-                    "concat_l", (r, E.Const(s_of(l), t.TEXT)), t.TEXT
+                    "concat_seg",
+                    (
+                        exprs[0],
+                        E.Const(pre, t.TEXT),
+                        E.Const(seg_after(i0), t.TEXT),
+                    ),
+                    t.TEXT,
+                )
+            if len(exprs) == 2:
+                # both pairwise axes must be stable column
+                # dictionaries: a literal-pool axis would re-enumerate
+                # its own past outputs and grow the pool every run
+                from opentenbase_tpu.ops.expr import (
+                    LITERAL_DICT,
+                    _host_chain,
+                )
+
+                for side in exprs:
+                    sbase, _steps = _host_chain(side)
+                    if (
+                        not isinstance(sbase, E.Col)
+                        or _texpr_dict_id(sbase, ctx.scope)
+                        in (None, LITERAL_DICT)
+                    ):
+                        raise AnalyzeError(
+                            "|| of two computed text values is not "
+                            "supported — make one side a column or "
+                            "a constant"
+                        )
+                i0 = merged.index(exprs[0])
+                i1 = merged.index(exprs[1], i0 + 1)
+                return E.FuncE(
+                    "concat_pair",
+                    (
+                        exprs[0],
+                        exprs[1],
+                        E.Const(pre, t.TEXT),
+                        E.Const(seg_after(i0), t.TEXT),
+                        E.Const(seg_after(i1), t.TEXT),
+                    ),
+                    t.TEXT,
                 )
             raise AnalyzeError(
-                "|| of two non-constant values is not supported"
+                "|| of more than two non-constant values is not "
+                "supported"
             )
         # interval arithmetic
         li = self._maybe_interval(e.left, ctx)

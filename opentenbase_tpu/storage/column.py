@@ -25,7 +25,9 @@ class Dictionary:
     distributed COPY. Codes are dense int32 starting at 0.
     """
 
-    __slots__ = ("_values", "_index", "_lock", "_hashes")
+    # _pair_cache: pairwise-concat tables cached by resolve_param
+    # (ops/expr.py PairConcatParam) — lazily set, keyed by source sizes
+    __slots__ = ("_values", "_index", "_lock", "_hashes", "_pair_cache")
 
     def __init__(self, values: list[str] | None = None):
         self._values: list[str] = list(values) if values else []

@@ -536,11 +536,10 @@ def test_concat_basics():
         "select upper(nm) from cc where nm is not null"
         " order by upper(nm) desc"
     ) == [("BO",), ("ADA",)]
-    import pytest
-
-    from opentenbase_tpu.plan.analyze import AnalyzeError
-    with pytest.raises(AnalyzeError, match="non-constant"):
-        s.query("select nm || nm from cc")
+    # two non-constant sides take the pairwise-table path
+    assert s.query("select nm || nm from cc order by k") == [
+        ("adaada",), ("bobo",), (None,)
+    ]
 
 
 def test_concat_typed_constants():
@@ -566,3 +565,118 @@ def test_concat_typed_constants():
     s.execute("create table ic (k bigint, v bigint) distribute by shard(k)")
     s.execute("insert into ic values (1, 7)")
     assert s.query("select v || null from ic") == [(None,)]
+
+
+def test_concat_two_columns_pairwise():
+    from opentenbase_tpu.engine import Cluster
+
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute(
+        "create table pp (k bigint, fn text, ln text)"
+        " distribute by shard(k)"
+    )
+    s.execute(
+        "insert into pp values (1,'ada','lovelace'),(2,'bo','liu'),"
+        "(3,null,'x'),(4,'solo',null)"
+    )
+    # both sides non-constant: 2D pairwise dictionary table
+    assert s.query("select fn || ln from pp order by k") == [
+        ("adalovelace",), ("boliu",), (None,), (None,)
+    ]
+    # composes with constant segments and transforms
+    assert s.query(
+        "select fn || ' ' || ln from pp where k <= 2 order by k"
+    ) == [("ada lovelace",), ("bo liu",)]
+    assert s.query("select ln || upper(fn) from pp where k = 1") == [
+        ("lovelaceADA",)
+    ]
+    # usable in WHERE and GROUP BY
+    assert s.query("select count(*) from pp where fn || ln = 'boliu'") == [(1,)]
+    assert s.query(
+        "select fn || ln, count(*) from pp where k <= 2"
+        " group by fn || ln order by 1"
+    ) == [("adalovelace", 1), ("boliu", 1)]
+
+
+def test_concat_pairwise_size_gate(monkeypatch):
+    from opentenbase_tpu.engine import Cluster
+
+    monkeypatch.setenv("OTB_CONCAT_PAIR_MAX", "4")
+    s = Cluster(num_datanodes=1, shard_groups=8).session()
+    s.execute(
+        "create table pg (k bigint, a text, b text)"
+        " distribute by shard(k)"
+    )
+    s.execute(
+        "insert into pg values (1,'q','x'),(2,'w','y'),(3,'e','z')"
+    )
+    with pytest.raises(Exception, match="OTB_CONCAT_PAIR_MAX"):
+        s.query("select a || b from pg")
+
+
+def test_concat_chains_and_pool_stability():
+    from opentenbase_tpu.engine import Cluster
+
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table ch (k bigint, fn text, ln text)"
+        " distribute by shard(k)"
+    )
+    s.execute("insert into ch values (1,'ada','lovelace'),(2,'bo','liu')")
+    # the || spine flattens: constant segments fold into ONE transform
+    assert s.query(
+        "select '<' || fn || '-' || ln || '>' from ch where k = 1"
+    ) == [("<ada-lovelace>",)]
+    # host-fn chains compose over the base column dictionary
+    assert s.query(
+        "select upper(fn) || ln from ch where k = 1"
+    ) == [("ADAlovelace",)]
+    assert s.query(
+        "select upper(lower(upper(fn))) from ch where k = 1"
+    ) == [("ADA",)]
+    assert s.query(
+        "select length(upper(fn) || '!') from ch where k = 1"
+    ) == [(4,)]
+    # repeated execution must NOT grow the session literal pool (the
+    # pairwise table would otherwise re-enumerate its own past outputs)
+    lit = c.catalog.literals
+    for _ in range(3):
+        s.query("select fn || ' ' || ln from ch")
+    n1 = len(lit.values)
+    for _ in range(3):
+        s.query("select fn || ' ' || ln from ch")
+    assert len(lit.values) == n1
+    # empty source table: no pairwise table, empty result
+    s.execute(
+        "create table che (k bigint, a text, b text)"
+        " distribute by shard(k)"
+    )
+    assert s.query("select a || b from che") == []
+    # more than two non-constant sides is a clear error
+    import pytest
+
+    from opentenbase_tpu.plan.analyze import AnalyzeError
+    with pytest.raises(AnalyzeError, match="more than two"):
+        s.query("select fn || ln || fn from ch")
+
+
+def test_concat_pair_rejects_unstable_axes():
+    # a pairwise axis must be a stable column dictionary — a CASE (or
+    # other non-chainable computed text) side would put the shared
+    # literal pool on the axis and grow it every execution
+    from opentenbase_tpu.engine import Cluster
+    from opentenbase_tpu.plan.analyze import AnalyzeError
+
+    s = Cluster(num_datanodes=1, shard_groups=8).session()
+    s.execute(
+        "create table cr (k bigint, a text, b text)"
+        " distribute by shard(k)"
+    )
+    s.execute("insert into cr values (1,'x','y')")
+    with pytest.raises(AnalyzeError, match="computed text"):
+        s.query(
+            "select (case when k = 1 then a else b end) || b from cr"
+        )
+    # ...but a host-fn chain side is fine (composes over the base dict)
+    assert s.query("select upper(a) || b from cr") == [("Xy",)]
