@@ -20,7 +20,9 @@ def probe_cn(host: str, port: int, user=None, password=None) -> bool:
     from opentenbase_tpu.net.client import ClientSession
 
     try:
-        cs = ClientSession(host, port, timeout=5, user=user, password=password)
+        # liveness probes want FAST down-detection: no connect retries
+        cs = ClientSession(host, port, timeout=5, user=user,
+                           password=password, connect_retries=0)
         ok = cs.query("select 1") == [(1,)]
         cs.close()
         return ok
@@ -32,7 +34,7 @@ def probe_gts(host: str, port: int) -> bool:
     from opentenbase_tpu.gtm.client import NativeGTS
 
     try:
-        gts = NativeGTS(host, port)
+        gts = NativeGTS(host, port, connect_retries=0)
         ok = gts.ping()
         gts.close()
         return bool(ok)
@@ -44,12 +46,51 @@ def probe_dn(host: str, port: int) -> bool:
     from opentenbase_tpu.net.pool import Channel
 
     try:
-        ch = Channel(host, port, timeout=5)
+        ch = Channel(host, port, timeout=5, connect_retries=0)
         resp = ch.rpc({"op": "ping"})
         ch.close()
         return bool(resp.get("ok"))
     except Exception:
         return False
+
+
+def report_wlm(host: str, port: int, user=None, password=None) -> bool:
+    """Workload-management status over the coordinator wire: one line
+    per resource group from pg_stat_wlm (running/waiting plus the
+    admitted/queued/shed/timed_out totals), then any live queue
+    waiters. Returns False when the coordinator is unreachable."""
+    from opentenbase_tpu.net.client import ClientSession
+
+    try:
+        cs = ClientSession(host, port, timeout=5, user=user,
+                           password=password, connect_retries=0)
+        try:
+            groups = cs.query(
+                "select group_name, concurrency, queue_depth, running, "
+                "waiting, admitted, shed, timed_out from pg_stat_wlm"
+            )
+            waiters = cs.query(
+                "select group_name, session_id, wait_ms from "
+                "pg_stat_wlm_queue"
+            )
+        finally:
+            cs.close()
+    except Exception as e:
+        print(f"wlm {host}:{port}: unreachable ({e})")
+        return False
+    for (name, conc, depth, running, waiting, admitted, shed,
+         timed_out) in groups:
+        print(
+            f"wlm {host}:{port} group={name} concurrency={conc} "
+            f"queue_depth={depth} running={running} waiting={waiting} "
+            f"admitted={admitted} shed={shed} timed_out={timed_out}"
+        )
+    for name, sid, wait_ms in waiters:
+        print(
+            f"wlm {host}:{port} waiter group={name} session={sid} "
+            f"waited_ms={wait_ms}"
+        )
+    return True
 
 
 def _hostport(s: str) -> tuple[str, int]:
@@ -64,8 +105,15 @@ def main(argv=None) -> int:
     ap.add_argument("--dn", action="append", default=[])
     ap.add_argument("--user")
     ap.add_argument("--password")
+    ap.add_argument(
+        "--wlm", action="append", default=[],
+        help="coordinator HOST:PORT to report pg_stat_wlm for",
+    )
     args = ap.parse_args(argv)
     ok = True
+    for target in args.wlm:
+        h, p = _hostport(target)
+        ok = report_wlm(h, p, args.user, args.password) and ok
     for role, targets, probe in (
         ("coordinator", args.cn,
          lambda h, p: probe_cn(h, p, args.user, args.password)),

@@ -97,6 +97,12 @@ GUCS: dict = {
     "deadlock_timeout": (_duration, 1000),
     "statement_timeout": (_duration, 0),
     "work_mem": (_int, 65536),
+    # workload management (wlm/): session override of the role->group
+    # binding; '' = use ALTER ROLE ... RESOURCE GROUP / default_group
+    "resource_group": (_str, ""),
+    # cap on the admission-queue wait when statement_timeout is 0
+    # (otherwise a parked statement waits unbounded); 0 = no cap
+    "wlm_queue_timeout": (_duration, 0),
     "search_path": (_str, "public"),
     "session_authorization": (_str, None),
     "role": (_str, None),

@@ -80,7 +80,15 @@ class Result:
 
 
 class SQLError(RuntimeError):
-    pass
+    """Engine statement error. ``sqlstate`` maps to the PG error-code
+    class the wire front ends report ('E' message C field)."""
+
+    sqlstate = "XX000"
+
+    def __init__(self, msg: str, sqlstate: Optional[str] = None):
+        super().__init__(msg)
+        if sqlstate is not None:
+            self.sqlstate = sqlstate
 
 
 # ---------------------------------------------------------------------------
@@ -238,6 +246,11 @@ class Cluster:
         from opentenbase_tpu.audit import AuditManager
 
         self.audit = AuditManager(data_dir)
+        # workload management (wlm/): resource groups + the admission
+        # controller every session consults before dispatching fragments
+        from opentenbase_tpu.wlm import WorkloadManager
+
+        self.wlm = WorkloadManager()
         # logical replication: publications + running apply workers
         self.publications: dict[str, dict] = {}
         self.subscriptions: dict[str, object] = {}
@@ -856,6 +869,23 @@ class Session:
         self.prepared_statements: dict[str, A.Statement] = {}
         # last nextval per sequence (currval's session scope)
         self._seq_currval: dict[str, int] = {}
+        # workload management: the admission ticket of the statement in
+        # flight (wlm/), and the statement_timeout deadline (monotonic)
+        self._wlm_ticket = None
+        self._stmt_deadline: Optional[float] = None
+
+    def close(self) -> None:
+        """Backend-exit cleanup (the tcop loop's on-exit path): release
+        any workload-management slot still held and deregister from
+        pg_stat_cluster_activity NOW rather than at GC time — a session
+        that errored out mid-admission must never linger as a phantom
+        waiter or activity row."""
+        ticket = self._wlm_ticket
+        if ticket is not None:
+            self._wlm_ticket = None
+            ticket.release()
+        self.state = "closed"
+        self.cluster.sessions.discard(self)
 
     # -- public ----------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -1233,14 +1263,36 @@ class Session:
         return False
 
     def _execute_one(self, stmt: A.Statement) -> Result:
-        rec = self._materialize_recursive_ctes(stmt)
-        if rec is None:
-            return self._execute_one_inner(stmt)
-        stmt, temps = rec
+        # per-statement deadline (statement_timeout, guc.c): enforced by
+        # the admission queue, pg_sleep, and the distributed executor's
+        # fragment dispatch loop. Established HERE — the entry shared by
+        # the simple-query path (execute) and the extended protocol
+        # (pgwire Bind/Execute) — only when no statement is already in
+        # flight: nested internal statements (PL/pgSQL bodies, EXECUTE)
+        # inherit the outer statement's budget instead of restarting it,
+        # and the finally-clear keeps a finished statement's deadline
+        # from leaking into the next one.
+        import time as _time
+
+        top = self._stmt_deadline is None
+        if top:
+            timeout_ms = self._duration_ms(
+                self.gucs.get("statement_timeout", 0), "statement_timeout"
+            )
+            if timeout_ms > 0:
+                self._stmt_deadline = _time.monotonic() + timeout_ms / 1000.0
         try:
-            return self._execute_one_inner(stmt)
+            rec = self._materialize_recursive_ctes(stmt)
+            if rec is None:
+                return self._execute_one_inner(stmt)
+            stmt, temps = rec
+            try:
+                return self._execute_one_inner(stmt)
+            finally:
+                self._drop_temps(temps)
         finally:
-            self._drop_temps(temps)
+            if top:
+                self._stmt_deadline = None
 
     def _execute_one_inner(self, stmt: A.Statement) -> Result:
         if self.cluster.paused and not isinstance(stmt, A.UnpauseCluster):
@@ -1258,6 +1310,20 @@ class Session:
         h = getattr(self, f"_x_{type(stmt).__name__.lower()}", None)
         if h is None:
             raise SQLError(f"unsupported statement {type(stmt).__name__}")
+        # workload management: admit / queue / shed BEFORE any plan
+        # fragment is dispatched (wlm/); the ticket is released on every
+        # exit path, success or error
+        ticket = self._wlm_admit(stmt)
+        try:
+            return self._dispatch_stmt(stmt, h)
+        finally:
+            if ticket is not None:
+                self._wlm_ticket = None
+                ticket.release()
+
+    def _dispatch_stmt(self, stmt: A.Statement, h) -> Result:
+        from opentenbase_tpu.executor.dist import StatementTimeout
+
         try:
             if self.txn is not None and isinstance(
                 stmt, (A.Insert, A.Update, A.Delete, A.CopyStmt)
@@ -1293,6 +1359,105 @@ class Session:
             raise SQLError(str(e))
         except (LockTimeout, LockNotAvailable) as e:
             raise SQLError(str(e))
+        except StatementTimeout as e:
+            raise SQLError(str(e), "57014")
+
+    # -- workload management (wlm/) ---------------------------------------
+    _WLM_GATED = (A.Select, A.Insert, A.Update, A.Delete, A.CopyStmt)
+
+    def _wlm_group_name(self) -> str:
+        """The session's resource group: the ``resource_group`` GUC
+        (SET resource_group = g) wins, else the role binding
+        (ALTER ROLE ... RESOURCE GROUP), else default_group."""
+        gname = self.gucs.get("resource_group") or ""
+        if gname:
+            return str(gname)
+        return self.cluster.wlm.group_for_role(self.user)
+
+    def _wlm_admit(self, stmt: A.Statement):
+        """Admission control: consulted before any plan fragment is
+        dispatched. Gates autocommit resource-consuming statements
+        only — a statement inside an explicit transaction already holds
+        locks, and parking it in the admission queue could deadlock
+        against the running statement it waits on (the reference's
+        resource queues carry the same hazard; we sidestep it).
+        Returns the AdmissionTicket (caller releases) or None."""
+        if self._wlm_ticket is not None or self.txn is not None:
+            return None
+        if not isinstance(stmt, self._WLM_GATED):
+            return None
+        if isinstance(stmt, A.Select):
+            # diagnostics must stay reachable from a saturated group: a
+            # SELECT touching only system views bypasses admission (the
+            # reference exempts system queries from resource queues)
+            refs: set = set()
+            try:
+                self._referenced_tables(stmt, refs)
+            except Exception:
+                refs = set()
+            if refs and refs <= set(_SYSTEM_VIEWS):
+                return None
+        mgr = self.cluster.wlm
+        gname = self._wlm_group_name()
+        group = mgr.groups.get(gname)
+        if group is None:
+            raise SQLError(
+                f'resource group "{gname}" does not exist', "42704"
+            )
+        est = 0
+        if group.memory_limit > 0:
+            from opentenbase_tpu.wlm.estimate import (
+                estimate_statement_memory,
+            )
+
+            est = estimate_statement_memory(stmt, self.cluster.catalog)
+        timeout_ms = 0
+        if group.limited():
+            # queue-wait deadline: the REMAINING statement budget when a
+            # deadline is in force (time already spent rewriting/CTE
+            # materialization counts — re-granting the full
+            # statement_timeout here would let a statement overshoot it
+            # by ~2x), else the wlm_queue_timeout safety cap (0 = wait
+            # unbounded, PG's resource-queue behavior; a client that
+            # disconnects mid-wait is only noticed once admitted — set
+            # the cap to bound that, as PG's pre-connection-check
+            # backends needed statement_timeout to)
+            if self._stmt_deadline is not None:
+                import time as _time
+
+                timeout_ms = max(
+                    int((self._stmt_deadline - _time.monotonic()) * 1000),
+                    1,
+                )
+            else:
+                timeout_ms = self._duration_ms(
+                    self.gucs.get("wlm_queue_timeout", 0),
+                    "wlm_queue_timeout",
+                )
+        # uncontended fast path: no lock parking, one mutex trip
+        ticket = mgr.try_admit(gname, est)
+        if ticket is None:
+            prev_state = self.state
+            self.state = "queued"
+            # the statement must QUEUE: park any statement-lock slot
+            # this thread holds for the wait (the shard-barrier
+            # protocol) — a parked waiter must not fence out the
+            # exclusive DDL (e.g. the ALTER RESOURCE GROUP that would
+            # relieve the saturation) or another group's same-table
+            # writer for the duration of an unbounded wait
+            from opentenbase_tpu.utils.rwlock import parked
+
+            try:
+                with parked(self.cluster._exec_lock):
+                    ticket = mgr.admit(
+                        gname, est, timeout_ms,
+                        session_id=self.session_id,
+                        query=self.last_query,
+                    )
+            finally:
+                self.state = prev_state
+        self._wlm_ticket = ticket
+        return ticket
 
     # -- audit hooks (auditlogger.c backend side) -------------------------
     _AUDIT_DML = {
@@ -1305,6 +1470,8 @@ class Session:
         "CreateNode", "DropNode", "AlterNode", "CreateNodeGroup",
         "DropNodeGroup", "CreateSequence", "DropSequence",
         "CreateShardingGroup", "AuditStmt", "NoAuditStmt",
+        "CreateResourceGroup", "DropResourceGroup",
+        "AlterRoleResourceGroup",
     )
 
     def _audit_classify(self, stmt) -> tuple[Optional[str], set]:
@@ -2103,12 +2270,43 @@ class Session:
         "pg_logical_sync",
         "pg_basebackup",
     }
+    # FROM-less builtins that mutate nothing: the wire front ends may
+    # class them as plain reads (pg_sleep is the WLM/timeout test probe)
+    _READONLY_ADMIN_FUNCS = {"pg_sleep"}
+
+    def _pg_sleep(self, e: A.FuncCall) -> Result:
+        """pg_sleep(seconds) — sleeps in short slices so the session's
+        statement_timeout deadline still cancels it (SQLSTATE 57014)."""
+        import time as _time
+
+        secs = float(self._const_arg(e.args[0])) if e.args else 0.0
+        end = _time.monotonic() + max(secs, 0.0)
+        while True:
+            now = _time.monotonic()
+            if now >= end:
+                break
+            if (
+                self._stmt_deadline is not None
+                and now >= self._stmt_deadline
+            ):
+                raise SQLError(
+                    "canceling statement due to statement timeout",
+                    "57014",
+                )
+            _time.sleep(min(0.02, end - now))
+        return Result("SELECT", [("",)], ["pg_sleep"], 1)
 
     def _maybe_admin_function(self, stmt: A.Select) -> Optional[Result]:
         if stmt.from_clause is not None or len(stmt.items) != 1:
             return None
         e = stmt.items[0].expr
-        if not isinstance(e, A.FuncCall) or e.name not in self._ADMIN_FUNCS:
+        if not isinstance(e, A.FuncCall):
+            return None
+        if e.name in self._READONLY_ADMIN_FUNCS:
+            # dispatch by name: a future member of the set must route to
+            # ITS handler, never silently into pg_sleep's body
+            return getattr(self, f"_{e.name}")(e)
+        if e.name not in self._ADMIN_FUNCS:
             return None
         if self.cluster.read_only and e.name in (
             "pg_unlock_execute", "pg_clean_execute",
@@ -2435,7 +2633,8 @@ class Session:
             raise SQLError(
                 f'function "{stmt.name}" already exists'
             )
-        if stmt.name in self._SEQ_FUNCS or stmt.name in self._ADMIN_FUNCS:
+        if stmt.name in self._SEQ_FUNCS or stmt.name in self._ADMIN_FUNCS \
+                or stmt.name in self._READONLY_ADMIN_FUNCS:
             raise SQLError(
                 f'"{stmt.name}" is a reserved function name'
             )
@@ -2750,6 +2949,18 @@ class Session:
         self._shard_barrier_gate(splan)
         dplan = distribute_statement(splan, self.cluster.catalog)
         snapshot = self._snapshot()
+        # the fused path is a single device dispatch with no
+        # per-fragment checkpoints: enforce the deadline at ITS dispatch
+        # boundary (an already-expired budget must not launch the
+        # program; the host path below checks per fragment)
+        if self._stmt_deadline is not None:
+            import time as _time
+
+            if _time.monotonic() >= self._stmt_deadline:
+                raise SQLError(
+                    "canceling statement due to statement timeout",
+                    "57014",
+                )
         fused = self._try_fused(dplan, snapshot)
         if fused is not None:
             return fused
@@ -2766,6 +2977,8 @@ class Session:
             ),
             local_only_tables=_SYSTEM_VIEWS,
             parallel_workers=self.gucs.get("dn_parallel_workers", 4),
+            deadline=self._stmt_deadline,
+            wlm_ticket=self._wlm_ticket,
         )
         return ex.run(dplan)
 
@@ -4311,7 +4524,78 @@ class Session:
             self.cluster.persistence.log_ddl(
                 {"op": "drop_user", "name": stmt.name}
             )
+        # a dangling WLM binding would block DROP RESOURCE GROUP forever
+        # and show a phantom row in pg_resgroup_role
+        if stmt.name in self.cluster.wlm.role_bindings:
+            self.cluster.wlm.bind_role(stmt.name, None)
+            self._log_wlm_state()
         return Result("DROP ROLE")
+
+    # -- DDL: workload management (wlm/) ----------------------------------
+    @staticmethod
+    def _wlm_config_sqlerror(e) -> SQLError:
+        """WlmConfigError -> SQLError with the PG error class a driver
+        expects: undefined_object / duplicate_object /
+        invalid_parameter_value — never internal-error XX000."""
+        msg = str(e)
+        if "does not exist" in msg:
+            state = "42704"
+        elif "already exists" in msg:
+            state = "42710"
+        else:
+            state = "22023"
+        return SQLError(msg, state)
+
+    def _log_wlm_state(self) -> None:
+        """Resource-group DDL is WAL-logged as the full config dump (the
+        audit_state pattern): replay-idempotent and order-insensitive
+        against checkpoints."""
+        if self.cluster.persistence is not None:
+            self.cluster.persistence.log_ddl(
+                {"op": "wlm_state",
+                 "payload": self.cluster.wlm.dump_state()}
+            )
+
+    def _x_createresourcegroup(self, stmt: A.CreateResourceGroup) -> Result:
+        from opentenbase_tpu.wlm import WlmConfigError
+
+        mgr = self.cluster.wlm
+        try:
+            if stmt.alter:
+                mgr.alter_group(stmt.name, stmt.options)
+            else:
+                mgr.create_group(stmt.name, stmt.options)
+        except WlmConfigError as e:
+            raise self._wlm_config_sqlerror(e) from None
+        self._log_wlm_state()
+        return Result(
+            "ALTER RESOURCE GROUP" if stmt.alter else "CREATE RESOURCE GROUP"
+        )
+
+    def _x_dropresourcegroup(self, stmt: A.DropResourceGroup) -> Result:
+        from opentenbase_tpu.wlm import WlmConfigError
+
+        try:
+            dropped = self.cluster.wlm.drop_group(
+                stmt.name, if_exists=stmt.if_exists
+            )
+        except WlmConfigError as e:
+            raise self._wlm_config_sqlerror(e) from None
+        if dropped:
+            self._log_wlm_state()
+        return Result("DROP RESOURCE GROUP")
+
+    def _x_alterroleresourcegroup(
+        self, stmt: A.AlterRoleResourceGroup
+    ) -> Result:
+        from opentenbase_tpu.wlm import WlmConfigError
+
+        try:
+            self.cluster.wlm.bind_role(stmt.role, stmt.group)
+        except WlmConfigError as e:
+            raise self._wlm_config_sqlerror(e) from None
+        self._log_wlm_state()
+        return Result("ALTER ROLE")
 
     def _x_createindex(self, stmt: A.CreateIndex) -> Result:
         """Columnar engine: zone maps replace btrees (BRIN-style block
@@ -4796,6 +5080,8 @@ class Session:
                 self.cluster.stores,
                 self._snapshot(),
                 own_writes=self.txn.own_writes_view() if self.txn else None,
+                deadline=self._stmt_deadline,
+                wlm_ticket=self._wlm_ticket,
             )
             t0 = _time.perf_counter()
             out = ex.run(dplan)
@@ -5148,6 +5434,21 @@ def _sv_shard_map(c: Cluster):
     return [(i, int(n)) for i, n in enumerate(c.shardmap.map)]
 
 
+def _sv_wlm(c: Cluster):
+    """Per-resource-group workload management counters (wlm/): config
+    plus admitted/queued/shed/timed_out totals and peak usage."""
+    return c.wlm.stat_rows()
+
+
+def _sv_wlm_queue(c: Cluster):
+    """Live admission-queue waiters, FIFO order per group."""
+    return c.wlm.queue_rows()
+
+
+def _sv_resgroup_role(c: Cluster):
+    return c.wlm.binding_rows()
+
+
 def _sv_stat_tables(c: Cluster):
     rows = []
     snap = c.gts.snapshot_ts()
@@ -5481,6 +5782,39 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
     "pg_stat_dml": (
         {"stat": t.TEXT, "value": t.INT8},
         _sv_dml,
+    ),
+    "pg_stat_wlm": (
+        {
+            "group_name": t.TEXT,
+            "concurrency": t.INT4,
+            "memory_limit": t.INT8,
+            "queue_depth": t.INT4,
+            "priority": t.INT4,
+            "running": t.INT4,
+            "waiting": t.INT4,
+            "admitted": t.INT8,
+            "queued": t.INT8,
+            "shed": t.INT8,
+            "timed_out": t.INT8,
+            "peak_memory": t.INT8,
+            "peak_running": t.INT4,
+            "peak_result_bytes": t.INT8,
+        },
+        _sv_wlm,
+    ),
+    "pg_stat_wlm_queue": (
+        {
+            "group_name": t.TEXT,
+            "session_id": t.INT4,
+            "query": t.TEXT,
+            "wait_ms": t.FLOAT8,
+            "memory_est": t.INT8,
+        },
+        _sv_wlm_queue,
+    ),
+    "pg_resgroup_role": (
+        {"rolname": t.TEXT, "group_name": t.TEXT},
+        _sv_resgroup_role,
     ),
     "pgxc_gtm_nodes": (
         {

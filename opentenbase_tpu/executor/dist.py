@@ -35,6 +35,15 @@ from opentenbase_tpu.storage.table import ColumnBatch
 from opentenbase_tpu.utils.hashing import combine_hashes, hash32_np
 
 
+class StatementTimeout(RuntimeError):
+    """statement_timeout expired mid-execution (SQLSTATE 57014). Raised
+    between fragment dispatches and when a remote fragment RPC is cut
+    by the per-call socket deadline — the engine converts it to the
+    query_canceled SQLError the wire front ends report."""
+
+    sqlstate = "57014"
+
+
 def _scan_tables(plan) -> set:
     """Base tables a plan fragment reads (recursive over all children)."""
     out: set = set()
@@ -163,6 +172,8 @@ class DistExecutor:
         min_lsn: int = 0,
         local_only_tables=None,
         parallel_workers: int = 1,
+        deadline: Optional[float] = None,  # time.monotonic() cutoff
+        wlm_ticket=None,  # wlm.AdmissionTicket held for this statement
     ):
         self.catalog = catalog
         self.node_stores = node_stores
@@ -183,6 +194,28 @@ class DistExecutor:
         # (dn_parallel_workers GUC; execParallel.c's
         # max_parallel_workers_per_gather analog)
         self.parallel_workers = max(int(parallel_workers or 1), 1)
+        # runtime enforcement (wlm/): statement_timeout deadline checked
+        # before every fragment dispatch and bounded into each remote
+        # RPC; the admission ticket is held for the whole run (released
+        # by the session on completion OR error) and fed the observed
+        # result bytes for pg_stat_wlm.peak_memory
+        self.deadline = deadline
+        self.wlm_ticket = wlm_ticket
+
+    def _check_deadline(self) -> None:
+        import time as _time
+
+        if self.deadline is not None and _time.monotonic() >= self.deadline:
+            raise StatementTimeout(
+                "canceling statement due to statement timeout"
+            )
+
+    def _remaining_s(self) -> Optional[float]:
+        import time as _time
+
+        if self.deadline is None:
+            return None
+        return max(self.deadline - _time.monotonic(), 0.05)
 
     def _stores(self, node: int) -> dict:
         if node == COORDINATOR:
@@ -217,7 +250,15 @@ class DistExecutor:
                 col = next(iter(b.columns.values()))
                 v = col.data[0] if col.valid_mask[0] else None
                 subquery_values[i] = (v, ty)
-        return self._run_one(dplan, subquery_values)
+        out = self._run_one(dplan, subquery_values)
+        if self.wlm_ticket is not None:
+            try:
+                self.wlm_ticket.note_bytes(
+                    sum(c.data.nbytes for c in out.columns.values())
+                )
+            except Exception:
+                pass  # stats only — never fail a finished query
+        return out
 
     def _run_one(self, dplan: DistributedPlan, subquery_values) -> ColumnBatch:
         import time as _time
@@ -231,6 +272,10 @@ class DistExecutor:
         frag_schemas = {f.index: f.root.schema for f in dplan.fragments}
         qxid = _uuid.uuid4().hex[:16]
         for frag in dplan.fragments:
+            # statement_timeout gate: no new fragment is dispatched past
+            # the deadline (stragglers of the current fragment are cut
+            # by the per-RPC socket timeout below)
+            self._check_deadline()
             outs: dict[int, ColumnBatch] = {}
             # A transaction's own uncommitted writes exist only in the
             # coordinator's stores (rows reach the WAL — and thus the DN
@@ -348,6 +393,14 @@ class DistExecutor:
             for th in threads:
                 th.join()
             if errors:
+                # a straggler cut by the RPC socket deadline surfaces as
+                # the timeout it is — but ONLY channel-level failures
+                # are reinterpreted; a genuine executor error that
+                # happens to race the deadline must keep its identity
+                from opentenbase_tpu.net.pool import ChannelError
+
+                if all(isinstance(e, ChannelError) for e in errors):
+                    self._check_deadline()
                 raise errors[0]
             if peer_xid is not None:
                 ref = ExchangeRef(
@@ -445,7 +498,25 @@ class DistExecutor:
                     for n in frag.dest_nodes
                 ],
             }
-        resp = self.dn_channels[node].rpc(msg)
+        # statement_timeout bounds the RPC: a straggler DN is cut at the
+        # socket deadline (channel discarded, slot released) instead of
+        # holding the statement past its budget. Only passed when a
+        # deadline is set, so plain channels (and test doubles) keep the
+        # bare rpc(msg) signature. Known simplification: there is no
+        # DN-side cancel message in the protocol, so an abandoned
+        # fragment runs to completion on the datanode (the reference
+        # sends a real cancel); the coordinator merely stops waiting.
+        pool = self.dn_channels[node]
+        timeout_s = self._remaining_s()
+        if timeout_s is None:
+            resp = pool.rpc(msg)
+        else:
+            # clamp to the channel's own deadline: statement_timeout may
+            # only TIGHTEN hung-DN detection, never loosen it
+            default_s = getattr(pool, "rpc_timeout", None)
+            if default_s:
+                timeout_s = min(timeout_s, default_s)
+            resp = pool.rpc(msg, timeout_s=timeout_s)
         if peer_xid is not None:
             return int(resp.get("rows", 0)), None
         batch = serde.batch_from_wire(resp["batch"], self.catalog)

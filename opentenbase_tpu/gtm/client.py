@@ -89,10 +89,18 @@ class NativeGTS:
     model of the reference; the pooler/proxy batching layer can multiplex
     later exactly as src/gtm/proxy does)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, connect_retries: int = 3):
+        from opentenbase_tpu.net.client import connect_with_retry
+
         self.host = host
         self.port = port
-        self._sock = socket.create_connection((host, port), timeout=10)
+        # bounded-retry connect (net/client.py): a GTM still binding its
+        # listener after spawn/failover costs a few jittered retries,
+        # not a hard ConnectionRefusedError. Probes that WANT fast
+        # failure (otb_monitor) pass connect_retries=0.
+        self._sock = connect_with_retry(
+            host, port, timeout=10, retries=connect_retries
+        )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
         self._proc: Optional[subprocess.Popen] = None

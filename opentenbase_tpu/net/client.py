@@ -8,14 +8,76 @@ way the reference's psql and pgbench both sit on PQexec.
 
 from __future__ import annotations
 
+import random
 import socket
+import time
 from dataclasses import dataclass, field
 
 from opentenbase_tpu.net.protocol import recv_frame, send_frame
 
 
 class WireError(RuntimeError):
-    """Server-reported statement error (the 'E' message analog)."""
+    """Server-reported statement error (the 'E' message analog).
+    ``sqlstate`` carries the server's error class when it sent one
+    (e.g. 53xxx workload-management sheds)."""
+
+    sqlstate: str | None = None
+
+
+class RetryExhausted(WireError):
+    """Initial connect failed after every bounded retry (the libpq
+    connect_timeout + retry loop's terminal error)."""
+
+
+def connect_with_retry(
+    host: str,
+    port: int,
+    timeout: float = 30.0,
+    retries: int = 3,
+    backoff_s: float = 0.05,
+    backoff_max_s: float = 2.0,
+) -> socket.socket:
+    """TCP connect with bounded retries, exponential backoff + jitter.
+
+    The shared connect path of every wire client — coordinator sessions
+    (this module), DN channels (net/pool.py), and the GTM client
+    (gtm/client.py) — so a node that is still binding its listener
+    (cluster cold start, failover) costs a few jittered retries instead
+    of an immediate hard failure. ``retries`` counts the EXTRA attempts
+    after the first; raises RetryExhausted when all fail.
+    """
+    attempts = max(int(retries), 0) + 1
+    last: Exception | None = None
+    made = 0
+    for i in range(attempts):
+        try:
+            made += 1
+            return socket.create_connection((host, port), timeout=timeout)
+        except OSError as e:
+            last = e
+            # only failures a restarting listener explains are worth
+            # retrying (refused/reset/aborted); a timed-out connect to a
+            # black-holed host already burned the full timeout, and a
+            # DNS error or unreachable route will not heal in 100ms
+            if not isinstance(
+                e,
+                (
+                    ConnectionRefusedError,
+                    ConnectionResetError,
+                    ConnectionAbortedError,
+                ),
+            ):
+                break
+            if i == attempts - 1:
+                break
+            # full jitter on an exponential base: concurrent clients
+            # hammering a restarting node must not reconnect in lockstep
+            delay = min(backoff_s * (2 ** i), backoff_max_s)
+            time.sleep(delay * (0.5 + random.random() * 0.5))
+    raise RetryExhausted(
+        f"connect to {host}:{port} failed after {made} "
+        f"attempt(s): {last}"
+    ) from last
 
 
 @dataclass
@@ -43,8 +105,11 @@ class ClientSession:
         password: str | None = None,
         ssl: bool = False,
         ssl_ca: str | None = None,
+        connect_retries: int = 3,
     ):
-        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock = connect_with_retry(
+            host, port, timeout=timeout, retries=connect_retries
+        )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if ssl:
             import ssl as _ssl
@@ -100,7 +165,9 @@ class ClientSession:
         if resp is None:
             raise WireError("connection closed by server")
         if "error" in resp:
-            raise WireError(resp["error"])
+            err = WireError(resp["error"])
+            err.sqlstate = resp.get("sqlstate")
+            raise err
         return WireResult(
             resp["tag"],
             [tuple(r) for r in resp["rows"]],

@@ -241,6 +241,10 @@ class PgWireServer:
             if self.cluster.users:
                 if not self._sasl_auth(conn, user):
                     return
+            if user:
+                # the startup user (trust mode) / proven identity (SASL)
+                # drives role-based WLM bindings and audit attribution
+                session.user = user
             conn.auth(0)  # AuthenticationOk
             conn.parameter_status("server_version", "10.0 (opentenbase_tpu)")
             conn.parameter_status("client_encoding", "UTF8")
@@ -271,6 +275,15 @@ class PgWireServer:
                     session.execute("rollback")
         except Exception:
             pass
+        # release any WLM slot and leave pg_stat_cluster_activity NOW
+        session.close()
+
+    @staticmethod
+    def _sqlstate_of(e: Exception) -> str:
+        state = getattr(e, "sqlstate", None)
+        if state:
+            return state
+        return "42601" if "syntax" in str(e).lower() else "XX000"
 
     # -- auth ------------------------------------------------------------
     def _sasl_auth(self, conn: _Conn, user: str) -> bool:
@@ -489,7 +502,7 @@ class PgWireServer:
                         f"unsupported message {tag!r}"
                     )
             except Exception as e:
-                conn.error(f"{type(e).__name__}: {e}")
+                conn.error(f"{type(e).__name__}: {e}", self._sqlstate_of(e))
                 # skip to Sync (extended-protocol error recovery)
                 while True:
                     t2, _b2 = conn.read_message()
@@ -527,6 +540,12 @@ class PgWireServer:
             return s
 
     def _run_ast(self, session, ast, sql=None):
+        if sql:
+            # extended protocol skips execute(): record the statement
+            # text so pg_stat_cluster_activity / pg_stat_wlm_queue show
+            # THIS query, not the connection's previous simple query
+            session.last_query = sql.strip()
+
         def fn():
             return session._execute_one(ast)
 
@@ -544,8 +563,5 @@ class PgWireServer:
             )
             self._emit_result(conn, res)
         except Exception as e:
-            state = (
-                "42601" if "syntax" in str(e).lower() else "XX000"
-            )
-            conn.error(f"{type(e).__name__}: {e}", state)
+            conn.error(f"{type(e).__name__}: {e}", self._sqlstate_of(e))
         conn.ready(self._txn_status(session))

@@ -20,18 +20,34 @@ from opentenbase_tpu.net.protocol import recv_frame, send_frame
 class Channel:
     """One persistent framed connection (a pooled libpq slot)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
+    def __init__(
+        self, host: str, port: int, timeout: float = 30.0,
+        connect_retries: int = 3,
+    ):
+        from opentenbase_tpu.net.client import connect_with_retry
+
+        self.sock = connect_with_retry(
+            host, port, timeout=timeout, retries=connect_retries
+        )
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._timeout = timeout
         self.broken = False
 
-    def rpc(self, msg: dict) -> dict:
+    def rpc(self, msg: dict, timeout_s: Optional[float] = None) -> dict:
+        """One request/response. ``timeout_s`` overrides the socket
+        deadline for THIS call (statement_timeout enforcement); a cut
+        call marks the channel broken so the pool discards it."""
         try:
+            if timeout_s is not None:
+                self.sock.settimeout(timeout_s)
             send_frame(self.sock, msg)
             resp = recv_frame(self.sock)
         except OSError as e:
             self.broken = True
             raise ChannelError(f"channel I/O failed: {e}") from e
+        finally:
+            if timeout_s is not None and not self.broken:
+                self.sock.settimeout(self._timeout)
         if resp is None:
             self.broken = True
             raise ChannelError("channel closed by peer")
@@ -82,7 +98,9 @@ class ChannelPool:
                     raise ChannelError("pool exhausted")
         try:
             ch = Channel(self.host, self.port, timeout=self.rpc_timeout)
-        except OSError as e:
+        except Exception as e:
+            # OSError or RetryExhausted (connect_with_retry): either way
+            # the reserved slot must go back or the pool leaks capacity
             with self._cv:
                 self._total -= 1
                 self._cv.notify()
@@ -101,11 +119,11 @@ class ChannelPool:
                 self._idle.append(ch)
             self._cv.notify()
 
-    def rpc(self, msg: dict) -> dict:
+    def rpc(self, msg: dict, timeout_s: Optional[float] = None) -> dict:
         """Acquire -> call -> release convenience."""
         ch = self.acquire()
         try:
-            return ch.rpc(msg)
+            return ch.rpc(msg, timeout_s=timeout_s)
         finally:
             self.release(ch)
 

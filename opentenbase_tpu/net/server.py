@@ -162,6 +162,10 @@ class ClusterServer:
                     break
                 if msg.get("op") == "auth":
                     authed = self._scram_exchange(conn, msg)
+                    if authed:
+                        # the proven identity drives role-based WLM
+                        # bindings and audit attribution
+                        session.user = str(msg.get("user", session.user))
                     continue
                 if not authed:
                     send_frame(
@@ -203,7 +207,11 @@ class ClusterServer:
                         },
                     )
                 except Exception as e:  # engine errors go to the client
-                    send_frame(conn, {"error": f"{type(e).__name__}: {e}"})
+                    frame = {"error": f"{type(e).__name__}: {e}"}
+                    sqlstate = getattr(e, "sqlstate", None)
+                    if sqlstate:  # 53xxx sheds, 57014 timeouts, ...
+                        frame["sqlstate"] = sqlstate
+                    send_frame(conn, frame)
         finally:
             # abort any transaction left open by a dropped connection
             # (the backend-exit cleanup of the reference's tcop loop)
@@ -369,6 +377,9 @@ class ClusterServer:
                     session.execute("rollback")
             except Exception:
                 pass
+        # release any WLM slot and leave pg_stat_cluster_activity NOW —
+        # a dropped connection must not linger as a phantom session
+        session.close()
         try:
             conn.close()
         except OSError:

@@ -679,6 +679,32 @@ class NoAuditStmt(Statement):
 
 
 @dataclass
+class CreateResourceGroup(Statement):
+    """CREATE/ALTER RESOURCE GROUP name WITH (concurrency=N,
+    memory_limit='64MB', queue_depth=N, priority=N) — the workload
+    management DDL surface (wlm/)."""
+
+    name: str
+    options: dict = field(default_factory=dict)
+    alter: bool = False
+
+
+@dataclass
+class DropResourceGroup(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class AlterRoleResourceGroup(Statement):
+    """ALTER ROLE r RESOURCE GROUP g | ALTER ROLE r NO RESOURCE GROUP
+    (group None = unbind)."""
+
+    role: str
+    group: Optional[str] = None
+
+
+@dataclass
 class LockTable(Statement):
     """LOCK [TABLE] name [IN <mode> MODE] [NOWAIT] (lockcmds.c)."""
 

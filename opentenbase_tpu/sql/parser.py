@@ -1155,6 +1155,9 @@ class Parser:
                     self.eat_op(",")
                 self.expect_op(")")
             return A.CreateSubscription(name, conninfo, pub, copy_data)
+        if self.eat_kw("resource", "group"):
+            name = self.ident("resource group name")
+            return A.CreateResourceGroup(name, self._wlm_options())
         if self.eat_kw("sharding", "group"):
             members: list[str] = []
             if self.eat_kw("to", "group"):
@@ -1465,12 +1468,44 @@ class Parser:
             return A.AlterNode(name, options)
         if self.eat_kw("table"):
             return self._alter_table()
+        if self.eat_kw("resource", "group"):
+            name = self.ident("resource group name")
+            return A.CreateResourceGroup(
+                name, self._wlm_options(), alter=True
+            )
         if self.eat_kw("user") or self.eat_kw("role"):
             name = self.ident("user name")
+            if self.eat_kw("resource", "group"):
+                return A.AlterRoleResourceGroup(
+                    name, self.ident("resource group name")
+                )
+            if self.eat_kw("no", "resource", "group"):
+                return A.AlterRoleResourceGroup(name, None)
             self.eat_kw("with")
             self.expect_kw("password")
             return A.CreateUser(name, self._string_lit(), alter=True)
         self.error("unsupported ALTER")
+
+    def _wlm_options(self) -> dict:
+        """WITH (key = value, ...) of resource-group DDL. Values:
+        numbers, strings ('64MB'), or bare idents."""
+        self.expect_kw("with")
+        self.expect_op("(")
+        options: dict = {}
+        while not self.at_op(")"):
+            key = self.ident("resource group option")
+            self.eat_op("=")
+            if self.cur.kind == Tok.STRING:
+                options[key] = self._string_lit()
+            elif self.cur.kind == Tok.NUMBER:
+                options[key] = self._int_lit()
+            elif self.cur.kind == Tok.IDENT:
+                options[key] = self.advance().value
+            else:
+                self.error("expected resource group option value")
+            self.eat_op(",")
+        self.expect_op(")")
+        return options
 
     def _create_view(self, replace: bool) -> A.Statement:
         # CREATE [OR REPLACE] VIEW name AS select  (view.c); the body's
@@ -1528,6 +1563,11 @@ class Parser:
             if self.eat_kw("group"):
                 return A.DropNodeGroup(self.ident("group name"))
             return A.DropNode(self.ident("node name"))
+        if self.eat_kw("resource", "group"):
+            if_exists = bool(self.eat_kw("if", "exists"))
+            return A.DropResourceGroup(
+                self.ident("resource group name"), if_exists
+            )
         if self.eat_kw("user") or self.eat_kw("role"):
             if_exists = bool(self.eat_kw("if", "exists"))
             return A.DropUser(self.ident("user name"), if_exists)

@@ -385,6 +385,7 @@ class ClusterPersistence:
             },
             "views": {name: text for name, (_q, text) in c.views.items()},
             "users": c.users,
+            "wlm": c.wlm.dump_state(),
         }
         for name in c.catalog.table_names():
             tm = c.catalog.get(name)
@@ -593,6 +594,8 @@ class ClusterPersistence:
 
     def _restore_checkpoint(self, meta: dict) -> None:
         self.cluster.users.update(meta.get("users", {}))
+        if meta.get("wlm"):
+            self.cluster.wlm.load_state(meta["wlm"])
         import numpy as np
 
         from opentenbase_tpu.catalog.distribution import (
@@ -889,6 +892,10 @@ class ClusterPersistence:
                     c.stores.pop(getattr(node, "mesh_index", -1), None)
             elif op == "audit_state":
                 c.audit.load_state(header["payload"])
+            elif op == "wlm_state":
+                # resource-group DDL replays as the full config dump
+                # (wlm/manager.py dump_state/load_state)
+                c.wlm.load_state(header["payload"])
             elif op == "create_function":
                 if header.get("language") == "plpgsql":
                     from opentenbase_tpu.plan.plpgsql import (

@@ -116,15 +116,26 @@ class RWStatementLock:
 
     # -- lock-manager parking ---------------------------------------------
     def park_release(self):
-        """Release whatever THIS THREAD holds — the exclusive side or a
-        shared group slot — so other sessions (including an exclusive
+        """Release whatever THIS THREAD holds — the exclusive side, a
+        shared group slot, and (for table-granular writers) the
+        per-table mutexes — so other sessions (including an exclusive
         committer that would otherwise deadlock against a parked shared
-        holder) can run while the caller sleeps in the lock manager.
-        Returns an opaque token for ``park_reacquire``; None when the
-        thread holds nothing."""
+        holder, or another group's writer on the same table) can run
+        while the caller sleeps in the lock manager or the WLM
+        admission queue. A parked writer mutates nothing while asleep
+        and reacquires mutexes-then-slot (write_tables order) on wake,
+        so store mutation stays exclusive. Returns an opaque token for
+        ``park_reacquire``; None when the thread holds nothing."""
         g = getattr(self._tls, "group", None)
         if g is not None:
+            held = getattr(self._tls, "table_locks", None)
             self._exit_shared(g)
+            if g == "w" and held:
+                self._tls.table_locks = None
+                names, locks = held
+                for lk in reversed(locks):
+                    lk.release()
+                return ("wt", g, held)
             return ("s", g)
         if self._w._is_owned():
             self.release()
@@ -136,6 +147,13 @@ class RWStatementLock:
             return
         if token[0] == "x":
             self.acquire()
+        elif token[0] == "wt":
+            _g, held = token[1], token[2]
+            _names, locks = held
+            for lk in locks:  # same sorted order as write_tables
+                lk.acquire()
+            self._enter_shared(_g)
+            self._tls.table_locks = held
         else:
             self._enter_shared(token[1])
 
@@ -162,6 +180,10 @@ class RWStatementLock:
             lk.acquire()
         try:
             with self._shared("w"):
+                # visible to park_release: a parked writer must drop
+                # these too (a queued same-table writer holding the
+                # mutex would block every other group's writer)
+                self._tls.table_locks = (names, locks)
                 with self._cond:
                     self._table_writers += 1
                     self.max_concurrent_table_writers = max(
@@ -171,6 +193,7 @@ class RWStatementLock:
                 try:
                     yield
                 finally:
+                    self._tls.table_locks = None
                     with self._cond:
                         self._table_writers -= 1
         finally:

@@ -1,0 +1,899 @@
+"""Workload management (wlm/): resource groups, admission control, load
+shedding — DDL, queueing/shedding under concurrency, statement_timeout,
+WAL crash recovery of the group catalog, connect-retry hardening, and
+the end-to-end graceful-degradation path over the PG v3 wire."""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster, SQLError
+from opentenbase_tpu.wlm import (
+    DEFAULT_GROUP,
+    AdmissionError,
+    WorkloadManager,
+    parse_memory,
+)
+
+
+def _cluster():
+    return Cluster(num_datanodes=2)
+
+
+def _seeded(c):
+    s = c.session()
+    s.execute("create table wt (a int8, b int8) distribute by shard(a)")
+    s.execute("insert into wt values (1, 10), (2, 20), (3, 30)")
+    return s
+
+
+# ---------------------------------------------------------------------------
+# manager unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_parse_memory_units():
+    assert parse_memory(1024) == 1024
+    assert parse_memory("64MB") == 64 * 1024**2
+    assert parse_memory("512kB") == 512 * 1024
+    assert parse_memory("1gb") == 1024**3
+    assert parse_memory("123") == 123
+    with pytest.raises(ValueError):
+        parse_memory("lots")
+    with pytest.raises(ValueError):  # negative with a unit suffix too
+        parse_memory("-1MB")
+    with pytest.raises(ValueError):
+        parse_memory(-5)
+
+
+def test_alter_with_bad_option_leaves_group_untouched():
+    c = _cluster()
+    s = c.session()
+    s.execute("create resource group ga with (concurrency=2)")
+    with pytest.raises(SQLError):
+        s.execute("alter resource group ga with (concurrency=5, warp=1)")
+    assert c.wlm.groups["ga"].concurrency == 2  # not partially applied
+
+
+def test_manager_fifo_and_shed():
+    mgr = WorkloadManager()
+    mgr.create_group("g", {"concurrency": 1, "queue_depth": 1})
+    t1 = mgr.admit("g")
+    # queue has room for exactly one waiter; a second arrival sheds
+    got = []
+
+    def waiter():
+        t = mgr.admit("g", timeout_ms=5000)
+        got.append(t)
+        t.release()
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    deadline = time.monotonic() + 2
+    while not mgr.groups["g"].queue and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert len(mgr.groups["g"].queue) == 1
+    with pytest.raises(AdmissionError) as ei:
+        mgr.admit("g")
+    assert ei.value.sqlstate == "53000"
+    t1.release()
+    th.join(timeout=5)
+    assert got and got[0].released
+    g = mgr.groups["g"]
+    assert g.stats["admitted"] == 2
+    assert g.stats["shed"] == 1
+    assert g.stats["queued"] == 1
+    assert g.running == 0 and not g.queue
+
+
+def test_manager_queue_timeout_is_57014():
+    mgr = WorkloadManager()
+    mgr.create_group("g", {"concurrency": 1, "queue_depth": 4})
+    t1 = mgr.admit("g")
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionError) as ei:
+        mgr.admit("g", timeout_ms=100)
+    assert ei.value.sqlstate == "57014"
+    assert time.monotonic() - t0 < 5
+    assert mgr.groups["g"].stats["timed_out"] == 1
+    t1.release()
+
+
+def test_manager_release_idempotent_and_drop_rules():
+    mgr = WorkloadManager()
+    mgr.create_group("g", {"concurrency": 2})
+    t = mgr.admit("g")
+    t.release()
+    t.release()  # second release must not underflow the slot count
+    assert mgr.groups["g"].running == 0
+    with pytest.raises(ValueError):
+        mgr.drop_group(DEFAULT_GROUP)
+    mgr.bind_role("r1", "g")
+    with pytest.raises(ValueError):  # bound role blocks the drop
+        mgr.drop_group("g")
+    mgr.bind_role("r1", None)
+    held = mgr.admit("g")
+    with pytest.raises(ValueError):  # busy group blocks the drop
+        mgr.drop_group("g")
+    held.release()
+    mgr.drop_group("g")
+    assert "g" not in mgr.groups
+
+
+# ---------------------------------------------------------------------------
+# DDL surface + views
+# ---------------------------------------------------------------------------
+
+
+def test_resource_group_ddl_roundtrip():
+    c = _cluster()
+    s = c.session()
+    s.execute(
+        "create resource group rg1 with (concurrency=2, "
+        "memory_limit='64MB', queue_depth=4, priority=5)"
+    )
+    rows = dict(
+        (r[0], r)
+        for r in s.query(
+            "select group_name, concurrency, memory_limit, queue_depth, "
+            "priority from pg_stat_wlm"
+        )
+    )
+    assert rows["rg1"][1:] == (2, 64 * 1024**2, 4, 5)
+    assert DEFAULT_GROUP in rows
+    with pytest.raises(SQLError):  # duplicate
+        s.execute("create resource group rg1 with (concurrency=1)")
+    with pytest.raises(SQLError):  # unknown option
+        s.execute("create resource group rg2 with (warp_factor=9)")
+    s.execute("alter resource group rg1 with (concurrency=7)")
+    assert s.query(
+        "select concurrency from pg_stat_wlm where group_name = 'rg1'"
+    ) == [(7,)]
+    s.execute("alter role alice resource group rg1")
+    assert s.query("select * from pg_resgroup_role") == [("alice", "rg1")]
+    with pytest.raises(SQLError):  # bound role blocks drop
+        s.execute("drop resource group rg1")
+    s.execute("alter role alice no resource group")
+    s.execute("drop resource group rg1")
+    s.execute("drop resource group if exists rg1")  # idempotent form
+    with pytest.raises(SQLError):
+        s.execute("drop resource group rg1")
+    with pytest.raises(SQLError):  # binding to a missing group
+        s.execute("alter role bob resource group nope")
+
+
+def test_unknown_resource_group_guc_rejected_at_admission():
+    c = _cluster()
+    s = _seeded(c)
+    s.execute("set resource_group = missing")
+    with pytest.raises(SQLError) as ei:
+        s.query("select count(*) from wt")
+    assert "does not exist" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# admission under concurrency (K+1 sessions vs concurrency=K)
+# ---------------------------------------------------------------------------
+
+
+def _run_sleepers(c, group, n, sleep_s, stagger_s=0.2, timeout="0"):
+    """n sessions in ``group`` each run one pg_sleep; returns the
+    per-thread outcome list ("ok" or the error's sqlstate)."""
+    sessions = []
+    for _ in range(n):
+        s = c.session()
+        s.execute(f"set resource_group = {group}")
+        if timeout != "0":
+            s.execute(f"set statement_timeout = '{timeout}'")
+        sessions.append(s)
+    outcomes = [None] * n
+
+    def run(i):
+        try:
+            sessions[i].execute(f"select pg_sleep({sleep_s})")
+            outcomes[i] = "ok"
+        except Exception as e:
+            outcomes[i] = getattr(e, "sqlstate", "XX000")
+
+    threads = []
+    for i in range(n):
+        th = threading.Thread(target=run, args=(i,))
+        th.start()
+        threads.append(th)
+        if i < n - 1:
+            time.sleep(stagger_s)
+    for th in threads:
+        th.join(timeout=30)
+    return outcomes
+
+
+def test_k_plus_one_queues_with_room():
+    """concurrency=K with a deep queue: K+1 statements ALL complete —
+    the extra one just waits its turn."""
+    c = _cluster()
+    c.session().execute(
+        "create resource group gk with (concurrency=2, queue_depth=8)"
+    )
+    outcomes = _run_sleepers(c, "gk", 3, 0.9)
+    assert outcomes == ["ok", "ok", "ok"]
+    g = c.wlm.groups["gk"]
+    assert g.stats["admitted"] == 3
+    assert g.stats["queued"] >= 1
+    assert g.stats["shed"] == 0
+    assert g.running == 0 and not g.queue
+
+
+def test_k_plus_one_sheds_when_queue_full():
+    """concurrency=1, queue_depth=1: of three concurrent statements one
+    runs, one queues then completes, one is shed with 53xxx."""
+    c = _cluster()
+    c.session().execute(
+        "create resource group small with (concurrency=1, queue_depth=1)"
+    )
+    outcomes = _run_sleepers(c, "small", 3, 0.8)
+    assert sorted(outcomes) == ["53000", "ok", "ok"]
+    g = c.wlm.groups["small"]
+    assert g.stats["admitted"] == 2
+    assert g.stats["shed"] == 1
+    assert g.running == 0 and not g.queue
+
+
+def test_queue_wait_bounded_by_statement_timeout():
+    c = _cluster()
+    c.session().execute(
+        "create resource group gt with (concurrency=1, queue_depth=4)"
+    )
+    runner = c.session()
+    runner.execute("set resource_group = gt")
+    waiter = c.session()
+    waiter.execute("set resource_group = gt")
+    waiter.execute("set statement_timeout = '150ms'")
+
+    th = threading.Thread(
+        target=lambda: runner.execute("select pg_sleep(1.0)")
+    )
+    th.start()
+    deadline = time.monotonic() + 2
+    while not c.wlm.groups["gt"].running and time.monotonic() < deadline:
+        time.sleep(0.01)
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionError) as ei:  # times out IN the queue
+        waiter.execute("select pg_sleep(0.1)")
+    assert ei.value.sqlstate == "57014"
+    assert time.monotonic() - t0 < 1.0
+    th.join(timeout=10)
+    g = c.wlm.groups["gt"]
+    assert g.stats["timed_out"] == 1
+    assert g.running == 0 and not g.queue
+
+
+def test_memory_budget_shed_53200():
+    c = _cluster()
+    s = _seeded(c)
+    # unanalyzed table -> default 1000-row estimate x 16B width, far
+    # over a 1kB budget: shed outright with out_of_memory
+    s.execute("create resource group tiny with "
+              "(concurrency=4, memory_limit='1kB', queue_depth=4)")
+    s.execute("set resource_group = tiny")
+    with pytest.raises(AdmissionError) as ei:
+        s.query("select a, b from wt")
+    assert ei.value.sqlstate == "53200"
+    assert c.wlm.groups["tiny"].stats["shed"] == 1
+    # pg_stat_wlm itself must stay reachable from an unbudgeted session
+    s.execute("set resource_group = ''")
+    shed = dict(
+        (r[0], r[1])
+        for r in s.query("select group_name, shed from pg_stat_wlm")
+    )
+    assert shed["tiny"] == 1
+
+
+# ---------------------------------------------------------------------------
+# session lifecycle: no lingering slots or phantom sessions
+# ---------------------------------------------------------------------------
+
+
+def test_errored_sessions_release_slots_and_close_deregisters():
+    c = _cluster()
+    s = _seeded(c)
+    s.execute("create resource group small with "
+              "(concurrency=1, queue_depth=0)")
+    holder = c.session()
+    holder.execute("set resource_group = small")
+    errored = c.session()
+    errored.execute("set resource_group = small")
+
+    t = threading.Thread(
+        target=lambda: holder.execute("select pg_sleep(0.6)")
+    )
+    t.start()
+    deadline = time.monotonic() + 2
+    while not c.wlm.groups["small"].running and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # queue_depth=0: the second statement sheds...
+    with pytest.raises(AdmissionError):
+        errored.query("select count(*) from wt")
+    # ...and the error path must leave NO charge behind
+    assert c.wlm.groups["small"].running == 1  # only the holder
+    assert not c.wlm.groups["small"].queue
+    assert errored.state in ("idle", "idle in transaction")
+    t.join(timeout=10)
+    assert c.wlm.groups["small"].running == 0
+
+    # close() deregisters immediately (no lingering
+    # pg_stat_cluster_activity row, engine.py linger risk)
+    sid = errored.session_id
+    errored.close()
+    rows = s.query(
+        "select session_id from pg_stat_cluster_activity"
+    )
+    assert (sid,) not in rows
+    # double-close is fine
+    errored.close()
+
+
+def test_wlm_error_mid_statement_releases_ticket():
+    c = _cluster()
+    s = _seeded(c)
+    s.execute("create resource group g1 with (concurrency=2)")
+    s.execute("set resource_group = g1")
+    with pytest.raises(Exception):  # AnalyzeError: no such column
+        s.query("select no_such_col from wt")
+    g = c.wlm.groups["g1"]
+    assert g.running == 0
+    assert s._wlm_ticket is None
+
+
+# ---------------------------------------------------------------------------
+# WAL crash recovery of resource-group DDL
+# ---------------------------------------------------------------------------
+
+
+def test_wal_crash_recovery_of_resource_groups(tmp_path):
+    d = str(tmp_path / "data")
+    c = Cluster(num_datanodes=2, data_dir=d)
+    s = c.session()
+    s.execute("create resource group g1 with "
+              "(concurrency=3, memory_limit='32MB', queue_depth=2)")
+    s.execute("alter role alice resource group g1")
+    c.persistence.checkpoint()
+    # DDL after the checkpoint rides the WAL tail
+    s.execute("create resource group g2 with (concurrency=1, priority=9)")
+    s.execute("alter resource group g1 with (concurrency=5)")
+    s.execute("alter role bob resource group g2")
+    s.execute("alter role alice no resource group")
+    # simulated crash: NO close/checkpoint — recover from disk
+    r = Cluster.recover(d, num_datanodes=2)
+    g1 = r.wlm.groups["g1"]
+    g2 = r.wlm.groups["g2"]
+    assert g1.concurrency == 5
+    assert g1.memory_limit == 32 * 1024**2
+    assert g1.queue_depth == 2
+    assert g2.concurrency == 1 and g2.priority == 9
+    assert r.wlm.role_bindings == {"bob": "g2"}
+    # recovered groups enforce immediately
+    rs = r.session()
+    rs.execute("set resource_group = g2")
+    assert rs.query("select 1")[0] == (1,)
+    assert r.wlm.groups["g2"].stats["admitted"] == 1
+    r.close()
+    c.close()
+
+
+def test_recovery_after_drop(tmp_path):
+    d = str(tmp_path / "data")
+    c = Cluster(num_datanodes=2, data_dir=d)
+    s = c.session()
+    s.execute("create resource group gone with (concurrency=1)")
+    s.execute("create resource group kept with (concurrency=2)")
+    c.persistence.checkpoint()
+    s.execute("drop resource group gone")
+    r = Cluster.recover(d, num_datanodes=2)
+    assert "gone" not in r.wlm.groups
+    assert r.wlm.groups["kept"].concurrency == 2
+    r.close()
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# net/client connect-retry hardening
+# ---------------------------------------------------------------------------
+
+
+def test_connect_retry_exhausted_is_typed():
+    import socket
+
+    from opentenbase_tpu.net.client import (
+        RetryExhausted,
+        WireError,
+        connect_with_retry,
+    )
+
+    # grab a port nothing listens on
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    t0 = time.monotonic()
+    with pytest.raises(RetryExhausted) as ei:
+        connect_with_retry("127.0.0.1", port, retries=2, backoff_s=0.01)
+    assert isinstance(ei.value, WireError)
+    assert "3 attempt(s)" in str(ei.value)
+    assert time.monotonic() - t0 < 5
+
+
+def test_connect_retry_succeeds_when_listener_appears():
+    import socket
+
+    from opentenbase_tpu.net.client import connect_with_retry
+
+    holder = socket.socket()
+    holder.bind(("127.0.0.1", 0))
+    port = holder.getsockname()[1]
+    holder.close()  # free it; the listener appears shortly after
+
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+
+    def listen_later():
+        time.sleep(0.15)
+        lsock.bind(("127.0.0.1", port))
+        lsock.listen(1)
+
+    th = threading.Thread(target=listen_later)
+    th.start()
+    try:
+        sock = connect_with_retry(
+            "127.0.0.1", port, retries=8, backoff_s=0.05
+        )
+        sock.close()
+    finally:
+        th.join()
+        lsock.close()
+
+
+# ---------------------------------------------------------------------------
+# wire surfaces: SQLSTATE over JSON wire + E2E graceful degradation (v3)
+# ---------------------------------------------------------------------------
+
+
+def test_json_wire_reports_sqlstate_on_shed():
+    from opentenbase_tpu.net.client import WireError, connect_tcp
+    from opentenbase_tpu.net.server import ClusterServer
+
+    c = _cluster()
+    s = _seeded(c)
+    s.execute("create resource group tiny with "
+              "(concurrency=4, memory_limit='1kB', queue_depth=4)")
+    with ClusterServer(c, port=0) as srv:
+        cs = connect_tcp(srv.host, srv.port)
+        try:
+            cs.execute("set resource_group = tiny")
+            with pytest.raises(WireError) as ei:
+                cs.query("select a, b from wt")
+            assert ei.value.sqlstate == "53200"
+        finally:
+            cs.close()
+
+
+class _V3:
+    """Minimal PG v3 client (trust mode) capturing SQLSTATE codes."""
+
+    def __init__(self, host, port, user):
+        import socket
+
+        self.sock = socket.create_connection((host, port), timeout=30)
+        body = struct.pack("!I", 196608)
+        body += b"user\0" + user.encode() + b"\0\0"
+        self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        while True:
+            tag, payload = self._recv()
+            if tag == b"Z":
+                break
+            if tag == b"E":
+                raise AssertionError(f"startup error: {payload!r}")
+
+    def _read_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            assert chunk, "server closed connection"
+            buf += chunk
+        return buf
+
+    def _recv(self):
+        tag = self._read_exact(1)
+        (ln,) = struct.unpack("!I", self._read_exact(4))
+        return tag, self._read_exact(ln - 4)
+
+    def query(self, sql):
+        """Returns ("ok", rows) or ("error", sqlstate)."""
+        body = sql.encode() + b"\0"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(body) + 4) + body)
+        rows, err = [], None
+        while True:
+            tag, payload = self._recv()
+            if tag == b"D":
+                (ncols,) = struct.unpack_from("!H", payload, 0)
+                off, vals = 2, []
+                for _ in range(ncols):
+                    (ln,) = struct.unpack_from("!i", payload, off)
+                    off += 4
+                    if ln == -1:
+                        vals.append(None)
+                    else:
+                        vals.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(tuple(vals))
+            elif tag == b"E":
+                fields = {}
+                for part in payload.split(b"\0"):
+                    if part:
+                        fields[chr(part[0])] = part[1:].decode()
+                err = fields.get("C", "?????")
+            elif tag == b"Z":
+                return ("error", err) if err else ("ok", rows)
+
+    def close(self):
+        try:
+            self.sock.sendall(b"X" + struct.pack("!I", 4))
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def test_e2e_pgwire_graceful_degradation():
+    """THE acceptance path: resource group small (concurrency=1,
+    queue_depth=1), three concurrent v3 clients -> exactly one runs,
+    one queues then completes, one is shed with SQLSTATE 53xxx — and
+    pg_stat_wlm agrees (admitted=2, shed=1)."""
+    from opentenbase_tpu.net.pgwire import PgWireServer
+
+    c = _cluster()
+    admin = c.session()
+    admin.execute(
+        "create resource group small with (concurrency=1, queue_depth=1)"
+    )
+    admin.execute("alter role app resource group small")
+    srv = PgWireServer(c, port=0).start()
+    try:
+        clients = [_V3(srv.host, srv.port, "app") for _ in range(3)]
+        results = [None] * 3
+
+        def run(i):
+            results[i] = clients[i].query("select pg_sleep(0.8)")
+
+        threads = []
+        for i in range(3):
+            th = threading.Thread(target=run, args=(i,))
+            th.start()
+            threads.append(th)
+            if i < 2:
+                time.sleep(0.25)
+        for th in threads:
+            th.join(timeout=30)
+        ok = [r for r in results if r and r[0] == "ok"]
+        errs = [r for r in results if r and r[0] == "error"]
+        assert len(ok) == 2, results
+        assert len(errs) == 1, results
+        assert errs[0][1].startswith("53"), results
+        # counters through the same wire, from an unthrottled session
+        mon = _V3(srv.host, srv.port, "monitor")
+        state, rows = mon.query(
+            "select admitted, shed, queued from pg_stat_wlm "
+            "where group_name = 'small'"
+        )
+        assert state == "ok"
+        assert rows == [("2", "1", "1")]
+        mon.close()
+        for cl in clients:
+            cl.close()
+    finally:
+        srv.stop()
+
+
+def test_set_statement_timeout_applies_within_same_string():
+    c = _cluster()
+    s = c.session()
+    t0 = time.monotonic()
+    with pytest.raises(SQLError) as ei:
+        s.execute("set statement_timeout = '50ms'; select pg_sleep(10)")
+    assert ei.value.sqlstate == "57014"
+    assert time.monotonic() - t0 < 5
+
+
+def test_pool_slot_recovered_after_connect_failure():
+    import socket
+
+    from opentenbase_tpu.net.pool import ChannelError, ChannelPool
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    pool = ChannelPool("127.0.0.1", port, size=1)
+    for _ in range(3):  # each failure must give the slot back
+        with pytest.raises(ChannelError):
+            pool.acquire(timeout=1)
+    assert pool._total == 0
+    pool.close()
+
+
+def test_no_stale_deadline_for_extended_protocol_path():
+    """pgwire's Bind/Execute enters at _execute_one, not execute(): a
+    deadline left over from an earlier timed-out simple query must not
+    spuriously cancel it, and statement_timeout must still be enforced
+    on that entry path."""
+    from opentenbase_tpu.sql import parse
+
+    c = _cluster()
+    s = c.session()
+    s.execute("set statement_timeout = '50ms'")
+    with pytest.raises(SQLError):
+        s.execute("select pg_sleep(10)")
+    assert s._stmt_deadline is None  # cleared, not leaked
+    s.execute("set statement_timeout = 0")
+    # direct _execute_one (the extended-protocol entry) runs clean...
+    r = s._execute_one(parse("select pg_sleep(0.05)")[0])
+    assert r.columns == ["pg_sleep"]
+    # ...and enforces the GUC when set
+    s.execute("set statement_timeout = '50ms'")
+    t0 = time.monotonic()
+    with pytest.raises(SQLError) as ei:
+        s._execute_one(parse("select pg_sleep(10)")[0])
+    assert ei.value.sqlstate == "57014"
+    assert time.monotonic() - t0 < 5
+
+
+def test_nested_statement_inherits_outer_deadline():
+    """A statement executed while another is in flight (PL/pgSQL body,
+    EXECUTE) shares the outer statement's budget rather than
+    restarting it."""
+    c = _cluster()
+    s = c.session()
+    s._stmt_deadline = time.monotonic() + 0.05  # outer statement's budget
+    t0 = time.monotonic()
+    with pytest.raises(SQLError) as ei:
+        s.execute("select pg_sleep(10)")  # nested entry: no GUC set
+    assert ei.value.sqlstate == "57014"
+    assert time.monotonic() - t0 < 5
+    s._stmt_deadline = None
+
+
+def test_queued_waiter_shed_when_alter_shrinks_memory_budget():
+    mgr = WorkloadManager()
+    mgr.create_group("g", {"concurrency": 4, "memory_limit": 1024,
+                           "queue_depth": 4})
+    big = mgr.admit("g", est=900)
+    result = {}
+
+    def waiter():
+        try:
+            t = mgr.admit("g", est=500)  # fits the limit, must queue
+            t.release()
+            result["r"] = "admitted"
+        except AdmissionError as e:
+            result["r"] = e.sqlstate
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    deadline = time.monotonic() + 2
+    while not mgr.groups["g"].queue and time.monotonic() < deadline:
+        time.sleep(0.005)
+    mgr.alter_group("g", {"memory_limit": 100})  # now it can NEVER fit
+    th.join(timeout=5)
+    assert result["r"] == "53200"
+    assert not mgr.groups["g"].queue  # FIFO not blocked
+    big.release()
+
+
+def test_system_view_selects_bypass_admission():
+    """Diagnostics stay reachable from a saturated group."""
+    c = _cluster()
+    s = c.session()
+    s.execute("create resource group jam with (concurrency=1, queue_depth=0)")
+    s.execute("set resource_group = jam")
+    held = c.wlm.admit("jam")  # saturate the group
+    try:
+        rows = s.query(
+            "select group_name, running from pg_stat_wlm "
+            "where group_name = 'jam'"
+        )
+        assert rows == [("jam", 1)]
+    finally:
+        held.release()
+    assert c.wlm.groups["jam"].stats["shed"] == 0
+
+
+def test_wlm_queue_timeout_guc_caps_wait():
+    c = _cluster()
+    s0 = c.session()
+    s0.execute("create resource group gq with (concurrency=1, queue_depth=4)")
+    held = c.wlm.admit("gq")
+    s = c.session()
+    s.execute("set resource_group = gq")
+    s.execute("set wlm_queue_timeout = '100ms'")  # statement_timeout stays 0
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionError) as ei:
+        s.query("select 1")
+    assert ei.value.sqlstate == "57014"
+    assert time.monotonic() - t0 < 5
+    held.release()
+
+
+def test_wlm_ddl_errors_carry_sqlstate():
+    c = _cluster()
+    s = c.session()
+    with pytest.raises(SQLError) as ei:
+        s.execute("drop resource group nosuch")
+    assert ei.value.sqlstate == "42704"
+    s.execute("create resource group dup with (concurrency=1)")
+    with pytest.raises(SQLError) as ei:
+        s.execute("create resource group dup with (concurrency=1)")
+    assert ei.value.sqlstate == "42710"
+    with pytest.raises(SQLError) as ei:
+        s.execute("create resource group bad with (warp_factor=9)")
+    assert ei.value.sqlstate == "22023"
+
+
+def test_queued_waiter_does_not_block_exclusive_ddl():
+    """A statement parked in the admission queue must PARK its
+    statement-lock slot (the shard-barrier protocol) so exclusive DDL —
+    notably the ALTER RESOURCE GROUP that relieves the saturation — can
+    run cluster-wide."""
+    from opentenbase_tpu.net.client import connect_tcp
+    from opentenbase_tpu.net.server import ClusterServer
+
+    c = _cluster()
+    admin = c.session()
+    admin.execute(
+        "create resource group jam with (concurrency=1, queue_depth=4)"
+    )
+    with ClusterServer(c, port=0) as srv:
+        runner = connect_tcp(srv.host, srv.port)
+        runner.execute("set resource_group = jam")
+        waiter = connect_tcp(srv.host, srv.port)
+        waiter.execute("set resource_group = jam")
+        results = {}
+
+        def run_long():
+            results["runner"] = runner.query("select pg_sleep(0.6)")
+
+        def run_waiter():
+            # once admitted this runs for 2s: DDL completing well under
+            # runner+waiter proves it never waited on the QUEUED waiter
+            results["waiter"] = waiter.query("select pg_sleep(2.0)")
+
+        t1 = threading.Thread(target=run_long)
+        t1.start()
+        deadline = time.monotonic() + 2
+        while not c.wlm.groups["jam"].running and time.monotonic() < deadline:
+            time.sleep(0.01)
+        t2 = threading.Thread(target=run_waiter)
+        t2.start()
+        deadline = time.monotonic() + 2
+        while not c.wlm.groups["jam"].queue and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # the queued waiter's statement-lock slot is PARKED: exclusive
+        # DDL waits only for the RUNNING statement (~0.6s), never for
+        # the queue to drain (runner + waiter would be ~2.6s)
+        ddl = connect_tcp(srv.host, srv.port)
+        t0 = time.monotonic()
+        ddl.execute("alter resource group jam with (queue_depth=8)")
+        assert time.monotonic() - t0 < 1.8
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert "runner" in results and "waiter" in results
+        for x in (runner, waiter, ddl):
+            x.close()
+
+
+def test_queued_writer_releases_table_mutex_for_other_groups():
+    """A throttled group's queued DML must not hold its per-table write
+    mutex across the admission wait — another group's writer on the
+    SAME table proceeds (rwlock invariant: a queued writer holds no
+    slot)."""
+    from opentenbase_tpu.net.client import connect_tcp
+    from opentenbase_tpu.net.server import ClusterServer
+
+    c = _cluster()
+    s = _seeded(c)
+    s.execute("create resource group thr with (concurrency=1, queue_depth=4)")
+    with ClusterServer(c, port=0) as srv:
+        holder = connect_tcp(srv.host, srv.port)
+        holder.execute("set resource_group = thr")
+        queued = connect_tcp(srv.host, srv.port)
+        queued.execute("set resource_group = thr")
+        other = connect_tcp(srv.host, srv.port)  # default group
+        res = {}
+
+        th1 = threading.Thread(
+            target=lambda: res.update(h=holder.query("select pg_sleep(1.5)"))
+        )
+        th1.start()
+        deadline = time.monotonic() + 2
+        while not c.wlm.groups["thr"].running and time.monotonic() < deadline:
+            time.sleep(0.01)
+        th2 = threading.Thread(
+            target=lambda: res.update(
+                q=queued.execute("update wt set b = b + 1 where a = 1")
+            )
+        )
+        th2.start()
+        deadline = time.monotonic() + 2
+        while not c.wlm.groups["thr"].queue and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # same-table writer from an unthrottled group: must not wait for
+        # the queue to drain (holder has ~1.2s left)
+        t0 = time.monotonic()
+        other.execute("update wt set b = b + 10 where a = 2")
+        assert time.monotonic() - t0 < 1.0
+        th1.join(timeout=10)
+        th2.join(timeout=10)
+        assert "h" in res and "q" in res
+        for x in (holder, queued, other):
+            x.close()
+
+
+def test_queue_wait_uses_remaining_statement_budget():
+    """Time already spent in the statement counts against the queue
+    deadline — admission must not re-grant the full statement_timeout."""
+    from opentenbase_tpu.sql import parse
+
+    c = _cluster()
+    c.session().execute(
+        "create resource group gb with (concurrency=1, queue_depth=4)"
+    )
+    held = c.wlm.admit("gb")
+    s = c.session()
+    s.execute("set resource_group = gb")
+    # simulate an outer statement that has already burned most of its
+    # budget before reaching admission (CTE materialization, EXECUTE)
+    s._stmt_deadline = time.monotonic() + 0.15
+    t0 = time.monotonic()
+    with pytest.raises(AdmissionError) as ei:
+        s._execute_one(parse("select 1")[0])
+    assert ei.value.sqlstate == "57014"
+    assert time.monotonic() - t0 < 1.0  # NOT a fresh full wait
+    s._stmt_deadline = None
+    held.release()
+
+
+def test_expired_deadline_cancels_fused_and_explain_paths():
+    """statement_timeout holds on the fused dispatch boundary and on
+    EXPLAIN ANALYZE's executor, not just the host fragment loop."""
+    c = _cluster()
+    s = _seeded(c)
+    s._stmt_deadline = time.monotonic() - 0.01  # already expired
+    with pytest.raises(SQLError) as ei:
+        s._run_select(__import__(
+            "opentenbase_tpu.sql", fromlist=["parse"]
+        ).parse("select sum(b) from wt")[0])
+    assert ei.value.sqlstate == "57014"
+    s._stmt_deadline = None
+    # EXPLAIN ANALYZE passes the session deadline through
+    s.execute("set statement_timeout = '60s'")
+    r = s.execute("explain analyze select sum(b) from wt")
+    assert any("Total:" in row[0] for row in r.rows)
+
+
+def test_drop_role_removes_wlm_binding():
+    c = _cluster()
+    s = c.session()
+    s.execute("create user carol with password 'pw'")
+    s.execute("create resource group gc with (concurrency=1)")
+    s.execute("alter role carol resource group gc")
+    s.execute("drop user carol")
+    assert "carol" not in c.wlm.role_bindings
+    s.execute("drop resource group gc")  # no dangling binding blocks it
+
+
+def test_pg_sleep_blocked_as_user_function_name():
+    c = _cluster()
+    s = c.session()
+    with pytest.raises(SQLError):
+        s.execute(
+            "create function pg_sleep(x int8) returns int8 as 'select 1'"
+        )

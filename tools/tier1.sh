@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Tier-1 gate wrapper (ROADMAP.md "Tier-1 verify"):
+#
+#   1. python -m compileall  — syntax breakage fails in seconds, before
+#      the 870 s pytest budget is spent;
+#   2. the fast WLM smoke subset (tests/test_wlm.py, ~15 s) — the
+#      admission-control layer sits in front of every statement, so a
+#      regression there poisons everything downstream;
+#   3. the full ROADMAP tier-1 pytest command, verbatim.
+#
+# Usage: tools/tier1.sh   (from anywhere; cd's to the repo root)
+
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+
+echo "== tier1: compileall =="
+python -m compileall -q opentenbase_tpu || exit 1
+
+echo "== tier1: WLM smoke subset =="
+timeout -k 10 120 python -m pytest tests/test_wlm.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
+echo "== tier1: full suite =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc
