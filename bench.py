@@ -344,6 +344,28 @@ def _phase(msg: str, t0: float) -> None:
           file=sys.stderr, flush=True)
 
 
+def _phase_breakdown(cluster) -> dict:
+    """Where the measured queries spent their time (obs/): the fused
+    executor's cumulative compile/device/host split plus host-path
+    motion ms — so future rounds can attribute perf wins and losses
+    instead of reporting only end-to-end ratios. Disable with
+    BENCH_PHASES=0."""
+    out = {}
+    fx = getattr(cluster, "_fused", None)
+    for k, v in (getattr(fx, "phase_totals", None) or {}).items():
+        out[k] = round(v, 3)
+    metrics = getattr(cluster, "metrics", None)
+    if metrics is not None:
+        h = metrics.histograms.get("phase.motion")
+        if h is not None and h.count:
+            out["motion_ms"] = round(h.total, 3)
+        for name in ("execute", "plan"):
+            h = metrics.histograms.get(f"phase.{name}")
+            if h is not None and h.count:
+                out[f"{name}_ms"] = round(h.total, 3)
+    return out
+
+
 def _device_alive(record, t_start, timeout: float = 60.0) -> bool:
     """Mid-run device liveness: fetch one tiny op through the existing
     in-process client in a daemon thread. A wedged tunnel hangs the
@@ -423,6 +445,11 @@ def main():
         record["tunnel_down"] = True
     if pallas_best is not None:
         record["pallas_rows_per_sec"] = round(ROWS / pallas_best)
+    if os.environ.get("BENCH_PHASES", "1") == "1":
+        try:
+            record["phase_breakdown"] = _phase_breakdown(cluster)
+        except Exception:
+            pass  # attribution is optional; never sink the headline
 
     # Emit the headline IMMEDIATELY — before any optional leg can wedge.
     # Extra legs re-print an enriched superset record afterwards; a driver

@@ -1,0 +1,82 @@
+"""Query-trace exporter — fetch the coordinator's recent query traces
+as Chrome-trace-format JSON (load in chrome://tracing or
+https://ui.perfetto.dev).
+
+    python -m opentenbase_tpu.cli.otb_trace --cn HOST:PORT \
+        [--last N] [--out trace.json] [--user U] [--password P]
+
+The coordinator keeps a bounded in-memory ring of finished query traces
+(``trace_queries = on`` traces every statement; EXPLAIN ANALYZE always
+traces its own). This tool calls the ``pg_export_traces(N)`` admin
+function over the wire and writes the document to ``--out``.
+
+Exit code 0 on success (even when the ring is empty — an empty trace is
+a valid trace), 1 when the coordinator is unreachable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def fetch_traces(
+    host: str, port: int, last: int, user=None, password=None
+) -> dict:
+    from opentenbase_tpu.net.client import ClientSession
+
+    cs = ClientSession(
+        host, port, timeout=30, user=user, password=password,
+        connect_retries=0,
+    )
+    try:
+        rows = cs.query(f"select pg_export_traces({int(last)})")
+    finally:
+        cs.close()
+    return json.loads(rows[0][0])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="otb_trace",
+        description="Export recent query traces as Chrome trace JSON",
+    )
+    ap.add_argument(
+        "--cn", required=True, metavar="HOST:PORT",
+        help="coordinator wire endpoint",
+    )
+    ap.add_argument(
+        "--last", type=int, default=20,
+        help="number of most-recent traces to export (default 20)",
+    )
+    ap.add_argument(
+        "--out", default="trace.json",
+        help="output file (default trace.json)",
+    )
+    ap.add_argument("--user", default=None)
+    ap.add_argument("--password", default=None)
+    args = ap.parse_args(argv)
+
+    host, _, port = args.cn.rpartition(":")
+    try:
+        doc = fetch_traces(
+            host or "127.0.0.1", int(port), args.last,
+            user=args.user, password=args.password,
+        )
+    except Exception as e:
+        print(f"otb_trace: {args.cn}: {e}", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    events = doc.get("traceEvents", [])
+    queries = len({e["pid"] for e in events}) if events else 0
+    print(
+        f"wrote {args.out}: {len(events)} events from {queries} "
+        "traced queries"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

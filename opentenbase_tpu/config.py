@@ -110,6 +110,10 @@ GUCS: dict = {
     "client_min_messages": (
         _enum("debug", "log", "notice", "warning", "error"), "notice",
     ),
+    # span tracing (obs/trace.py): off = zero-cost (no span allocation
+    # anywhere on the statement path); EXPLAIN ANALYZE always traces
+    # its one statement regardless
+    "trace_queries": (_bool, False),
     "autovacuum": (_bool, False),
     "autovacuum_naptime_s": (_int, 60),
     "autovacuum_scale_factor_pct": (_int, 20),

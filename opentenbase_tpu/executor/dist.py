@@ -73,6 +73,17 @@ def _remote_source_ids(plan) -> set:
     return out
 
 
+def _batch_bytes(batch: ColumnBatch) -> int:
+    """Payload bytes of a motioned batch (data + validity bitmaps) —
+    what pg_squeue's byte counters would have measured."""
+    total = 0
+    for col in batch.columns.values():
+        total += col.data.nbytes
+        if col.validity is not None:
+            total += col.validity.nbytes
+    return total
+
+
 def concat_batches(batches: list[ColumnBatch]) -> ColumnBatch:
     batches = [b for b in batches if b is not None]
     if not batches:
@@ -174,6 +185,10 @@ class DistExecutor:
         parallel_workers: int = 1,
         deadline: Optional[float] = None,  # time.monotonic() cutoff
         wlm_ticket=None,  # wlm.AdmissionTicket held for this statement
+        instrument_ops: bool = False,  # per-operator EXPLAIN ANALYZE
+        trace=None,  # obs.trace.QueryTrace (None = untraced)
+        waits=None,  # obs.waits.WaitEventRegistry
+        session_id: int = 0,
     ):
         self.catalog = catalog
         self.node_stores = node_stores
@@ -201,6 +216,19 @@ class DistExecutor:
         # result bytes for pg_stat_wlm.peak_memory
         self.deadline = deadline
         self.wlm_ticket = wlm_ticket
+        # observability (obs/): instrumentation is a FIRST-CLASS
+        # attribute — EXPLAIN ANALYZE reads it directly, no getattr
+        # default that silently yields nothing on un-run executors.
+        # instrumentation: per-(fragment, node) summary rows;
+        # op_instrumentation: per-operator records (instrument_ops on);
+        # motion_stats: fragment index -> {kind, rows, bytes, ms}.
+        self.instrument_ops = instrument_ops
+        self.trace = trace
+        self.waits = waits
+        self.session_id = session_id
+        self.instrumentation: list[dict] = []
+        self.op_instrumentation: list[dict] = []
+        self.motion_stats: dict[int, dict] = {}
 
     def _check_deadline(self) -> None:
         import time as _time
@@ -225,7 +253,9 @@ class DistExecutor:
     def run(self, dplan: DistributedPlan) -> ColumnBatch:
         # one instrumentation list per top-level run so subplan (InitPlan)
         # fragment timings survive into the EXPLAIN ANALYZE report
-        self.instrumentation: list[dict] = []
+        self.instrumentation = []
+        self.op_instrumentation = []
+        self.motion_stats = {}
         # InitPlans evaluate in registration order, sharing the value
         # list: the analyzer appends a nested scalar subquery BEFORE its
         # parent finishes (post-order), so every cross-subplan reference
@@ -234,7 +264,9 @@ class DistExecutor:
         n = len(dplan.subplans)
         subquery_values: list = [None] * n
         for i in range(n):
-            b = self._run_one(dplan.subplans[i], subquery_values)
+            b = self._run_one(
+                dplan.subplans[i], subquery_values, tag=f"sub{i}"
+            )
             ty = (
                 next(iter(b.columns.values())).type
                 if b.columns
@@ -260,15 +292,20 @@ class DistExecutor:
                 pass  # stats only — never fail a finished query
         return out
 
-    def _run_one(self, dplan: DistributedPlan, subquery_values) -> ColumnBatch:
+    def _run_one(
+        self, dplan: DistributedPlan, subquery_values, tag=None
+    ) -> ColumnBatch:
         import time as _time
         import uuid as _uuid
 
         # fragment -> consumer node -> input batch (or ExchangeRef when
         # the data plane went DN->DN and never visited the coordinator)
         motioned: dict[int, dict[int, ColumnBatch]] = {}
-        if not hasattr(self, "instrumentation"):
-            self.instrumentation = []
+        # ``tag`` ("sub0", ...) namespaces this run's observability
+        # records: subplan (InitPlan) fragments reuse the main plan's
+        # fragment indices, so untagged keys would collide and EXPLAIN
+        # ANALYZE would misattribute rows/operators to the main tree
+        instr_start = len(self.instrumentation)
         frag_schemas = {f.index: f.root.schema for f in dplan.fragments}
         qxid = _uuid.uuid4().hex[:16]
         for frag in dplan.fragments:
@@ -327,13 +364,19 @@ class DistExecutor:
                     )
                     if batch is not None:
                         outs[node] = batch
+                    t1 = _time.perf_counter()
                     self.instrumentation.append({
                         "fragment": frag.index,
                         "node": node,
                         "rows": rows,
-                        "ms": (_time.perf_counter() - t0) * 1000,
+                        "ms": (t1 - t0) * 1000,
                         "remote": True,
                     })
+                    if self.trace is not None:
+                        self.trace.record(
+                            f"fragment {frag.index} @ dn{node}",
+                            "fragment", t0, t1, rows=rows, remote=True,
+                        )
                 except Exception as e:
                     errors.append(e)
 
@@ -358,8 +401,10 @@ class DistExecutor:
                         },
                         subquery_values=subquery_values,
                         own_writes=self.own_writes.get(node),
+                        instrument=self.instrument_ops,
                     )
                     outs[node] = ex.run_plan(frag.root)
+                    t1 = _time.perf_counter()
                     # per-(fragment, node) instrumentation gathered back
                     # to the coordinator — distributed EXPLAIN ANALYZE
                     # (src/backend/commands/explain_dist.c)
@@ -367,7 +412,7 @@ class DistExecutor:
                         "fragment": frag.index,
                         "node": node,
                         "rows": outs[node].nrows,
-                        "ms": (_time.perf_counter() - t0) * 1000,
+                        "ms": (t1 - t0) * 1000,
                     }
                     if getattr(ex, "zone_total_blocks", 0):
                         instr["pruned_blocks"] = getattr(
@@ -375,6 +420,18 @@ class DistExecutor:
                         )
                         instr["total_blocks"] = ex.zone_total_blocks
                     self.instrumentation.append(instr)
+                    if self.instrument_ops:
+                        self.op_instrumentation.append({
+                            "fragment": frag.index,
+                            "node": node,
+                            "subplan": tag,
+                            "ops": ex.op_records,
+                        })
+                    if self.trace is not None:
+                        self.trace.record(
+                            f"fragment {frag.index} @ dn{node}",
+                            "fragment", t0, t1, rows=outs[node].nrows,
+                        )
                 except Exception as e:
                     errors.append(e)
 
@@ -409,8 +466,50 @@ class DistExecutor:
                 motioned[frag.index] = {
                     n: ref for n in frag.dest_nodes
                 }
+                # the data plane went DN->DN: the coordinator saw only
+                # row counts, so bytes are unknown here (instrumentation
+                # rows restricted to THIS run — subplans share indices)
+                mkey = frag.index if tag is None else (tag, frag.index)
+                self.motion_stats[mkey] = {
+                    "kind": frag.motion,
+                    "rows": sum(
+                        i["rows"]
+                        for i in self.instrumentation[instr_start:]
+                        if i["fragment"] == frag.index
+                    ),
+                    "bytes": None,
+                    "ms": None,
+                    "peer": True,
+                }
             else:
+                t_m0 = _time.perf_counter()
                 motioned[frag.index] = self._apply_motion(frag, outs)
+                t_m1 = _time.perf_counter()
+                moved = motioned[frag.index]
+                rows = nbytes = 0
+                seen: set[int] = set()
+                for b in moved.values():
+                    if id(b) in seen:  # broadcast shares ONE batch
+                        continue
+                    seen.add(id(b))
+                    rows += b.nrows
+                    nbytes += _batch_bytes(b)
+                if frag.motion == "broadcast":
+                    fanout = max(len(moved), 1)
+                    rows *= fanout
+                    nbytes *= fanout
+                mkey = frag.index if tag is None else (tag, frag.index)
+                self.motion_stats[mkey] = {
+                    "kind": frag.motion,
+                    "rows": rows,
+                    "bytes": nbytes,
+                    "ms": (t_m1 - t_m0) * 1000,
+                }
+                if self.trace is not None:
+                    self.trace.record(
+                        f"motion {frag.motion} (fragment {frag.index})",
+                        "motion", t_m0, t_m1, rows=rows, bytes=nbytes,
+                    )
         ex = LocalExecutor(
             self.catalog,
             {},
@@ -421,8 +520,17 @@ class DistExecutor:
                 if COORDINATOR in per_node
             },
             subquery_values=subquery_values,
+            instrument=self.instrument_ops,
         )
-        return ex.run_plan(dplan.root)
+        out = ex.run_plan(dplan.root)
+        if self.instrument_ops:
+            self.op_instrumentation.append({
+                "fragment": COORDINATOR,
+                "node": COORDINATOR,
+                "subplan": tag,
+                "ops": ex.op_records,
+            })
+        return out
 
     def _resolve_input(self, val, node: int) -> ColumnBatch:
         """A local executor consuming a peer-exchanged input pulls the
@@ -508,15 +616,29 @@ class DistExecutor:
         # sends a real cancel); the coordinator merely stops waiting.
         pool = self.dn_channels[node]
         timeout_s = self._remaining_s()
-        if timeout_s is None:
-            resp = pool.rpc(msg)
-        else:
+        if timeout_s is not None:
             # clamp to the channel's own deadline: statement_timeout may
             # only TIGHTEN hung-DN detection, never loosen it
             default_s = getattr(pool, "rpc_timeout", None)
             if default_s:
                 timeout_s = min(timeout_s, default_s)
-            resp = pool.rpc(msg, timeout_s=timeout_s)
+        # the round trip is a real wait: the session is parked on the DN
+        # until the fragment answers (wait_event IPC/remote_fragment)
+        wait_token = (
+            self.waits.begin(
+                self.session_id, "IPC", "remote_fragment"
+            )
+            if self.waits is not None
+            else None
+        )
+        try:
+            if timeout_s is None:
+                resp = pool.rpc(msg)
+            else:
+                resp = pool.rpc(msg, timeout_s=timeout_s)
+        finally:
+            if wait_token is not None:
+                self.waits.end(wait_token)
         if peer_xid is not None:
             return int(resp.get("rows", 0)), None
         batch = serde.batch_from_wire(resp["batch"], self.catalog)

@@ -1245,6 +1245,10 @@ class DagRunner:
         self._caps: dict = {}
         self.completed = 0  # DAG runs that produced the final batch
         self.last_mode = None  # final-fragment mode of the last run
+        # per-fragment wall time of the last completed run (exchange
+        # programs + the final fragment, key "final") — the device-side
+        # breakdown EXPLAIN ANALYZE VERBOSE prints for fused plans
+        self.last_frag_ms: dict = {}
         self.last_folded = frozenset()  # joins dense-folded in last run
         # bounded log of plans that fell back to the host path and why —
         # surfaced through pg_stat_fused so demotion is NEVER silent
@@ -1269,6 +1273,9 @@ class DagRunner:
             return None
 
     def _run(self, dplan, snapshot_ts, dicts_view, subquery_values):
+        from time import perf_counter as _perf_counter
+
+        frag_ms: dict = {}
         frags = dplan.fragments
         if not frags:
             raise DagUnsupported("no fragments")
@@ -1322,14 +1329,19 @@ class DagRunner:
                     if f.motion == "broadcast"
                     else self._run_exchange
                 )
+                t_f0 = _perf_counter()
                 exchanged[f.index] = run(
                     f, exchanged, snap, dicts_view, subquery_values, D,
                     versions,
                 )
+                frag_ms[f.index] = (_perf_counter() - t_f0) * 1000.0
+        t_f0 = _perf_counter()
         batch = self._run_final(
             final, final_root, exchanged, snap, dicts_view,
             subquery_values, D, versions, dplan,
         )
+        frag_ms["final"] = (_perf_counter() - t_f0) * 1000.0
+        self.last_frag_ms = frag_ms
         self.completed += 1
         return final.index, batch
 

@@ -74,6 +74,7 @@ class LocalExecutor:
         remote_inputs: Optional[dict[int, ColumnBatch]] = None,
         subquery_values: Optional[list] = None,
         own_writes: Optional[dict] = None,
+        instrument: bool = False,
     ):
         self.catalog = catalog
         self.stores = stores
@@ -93,6 +94,12 @@ class LocalExecutor:
         # chunking of execParallel.c:565 (each worker scans a disjoint
         # block; a Gather-analog merge combines partials)
         self.scan_block: Optional[tuple[int, int]] = None
+        # per-operator instrumentation (EXPLAIN ANALYZE, the
+        # InstrStartNode/InstrStopNode pair of instrument.c): pre-order
+        # records {depth, op, detail, ms, rows, batch_rows} filled by
+        # eval(); None = off, the untraced hot path
+        self.op_records: Optional[list[dict]] = [] if instrument else None
+        self._op_depth = 0
 
     # -- dictionary access ----------------------------------------------
     def _dict(self, dict_id: str) -> Dictionary:
@@ -181,7 +188,31 @@ class LocalExecutor:
         m = getattr(self, f"_eval_{type(plan).__name__.lower()}", None)
         if m is None:
             raise ExecError(f"no executor for {type(plan).__name__}")
-        return m(plan)
+        recs = self.op_records
+        if recs is None:
+            return m(plan)
+        # instrumented (EXPLAIN ANALYZE) path: record pre-order so the
+        # list reads as the plan tree; times are INCLUSIVE of children
+        # (instrument.c's actual-total convention). live_count() is a
+        # device reduce — a cost only ANALYZE pays.
+        import time as _time
+
+        rec = {
+            "depth": self._op_depth,
+            "op": type(plan).__name__,
+            "detail": _op_detail(plan),
+        }
+        recs.append(rec)
+        self._op_depth += 1
+        t0 = _time.perf_counter()
+        try:
+            out = m(plan)
+        finally:
+            self._op_depth -= 1
+        rec["ms"] = (_time.perf_counter() - t0) * 1000.0
+        rec["rows"] = int(out.live_count())
+        rec["batch_rows"] = int(out.n)
+        return out
 
     def _eval_remotesource(self, plan) -> DevBatch:
         batch = self.remote_inputs.get(plan.fragment)
@@ -1196,6 +1227,20 @@ class LocalExecutor:
             mask = batch.mask
         m = np.asarray(mask)[: store.nrows]
         return np.nonzero(m)[0]
+
+
+def _op_detail(plan) -> Optional[str]:
+    """Short per-node annotation for the EXPLAIN ANALYZE tree."""
+    table = getattr(plan, "table", None)
+    if isinstance(table, str):
+        return table
+    frag = getattr(plan, "fragment", None)
+    if frag is not None and type(plan).__name__ == "RemoteSource":
+        return f"fragment {frag}"
+    jt = getattr(plan, "join_type", None)
+    if jt is not None:
+        return str(jt)
+    return None
 
 
 def _align_key_dtypes(probe_keys, build_keys):

@@ -153,6 +153,10 @@ class LockManager:
         engine_lock = getattr(self._cluster, "_exec_lock", None)
         released_engine_lock = False
         park_token = None
+        # wait-event accounting (obs/waits.py): begun lazily on the
+        # first blocked iteration so uncontended acquires stay free
+        waits = getattr(self._cluster, "waits", None)
+        wait_token = None
         start = time.monotonic()
         deadline = (
             start + lock_timeout_ms / 1000.0 if lock_timeout_ms else None
@@ -177,6 +181,11 @@ class LockManager:
                     if deadline is not None and now >= deadline:
                         raise LockTimeout(
                             "canceling statement due to lock timeout"
+                        )
+                    if waits is not None and wait_token is None:
+                        wait_token = waits.begin(
+                            session_id, "Lock",
+                            "tuple" if len(keys[0]) == 3 else "relation",
                         )
                     self._waiters[session_id] = _Waiter(
                         session_id, gxid, mode, keys
@@ -224,6 +233,8 @@ class LockManager:
                 # (timeout, NOWAIT) is stale — consuming it here keeps it
                 # from poisoning this session's next acquisition
                 self._victims.pop(session_id, None)
+            if wait_token is not None:
+                waits.end(wait_token)
             if released_engine_lock:
                 if hasattr(engine_lock, "park_reacquire"):
                     engine_lock.park_reacquire(park_token)

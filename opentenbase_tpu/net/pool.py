@@ -71,10 +71,13 @@ class ChannelPool:
 
     def __init__(
         self, host: str, port: int, size: int = 4,
-        rpc_timeout: float = 120.0,
+        rpc_timeout: float = 120.0, wait_registry=None,
     ):
         self.host, self.port, self.size = host, port, size
         self.rpc_timeout = rpc_timeout
+        # obs/waits.py registry (cumulative only — the pool runs below
+        # the session layer, so waits are recorded without a session id)
+        self.wait_registry = wait_registry
         self._idle: list[Channel] = []
         self._lock = threading.Lock()
         self._total = 0
@@ -94,7 +97,20 @@ class ChannelPool:
                 if self._total < self.size:
                     self._total += 1
                     break
-                if not self._cv.wait(timeout):
+                # pool saturated: a real wait (the PoolManager's
+                # "waiting for a connection" state) — recorded so
+                # pg_stat_wait_events shows channel starvation
+                wr = self.wait_registry
+                token = (
+                    wr.begin(None, "IPC", "dn_channel_acquire")
+                    if wr is not None else None
+                )
+                try:
+                    got = self._cv.wait(timeout)
+                finally:
+                    if token is not None:
+                        wr.end(token)
+                if not got:
                     raise ChannelError("pool exhausted")
         try:
             ch = Channel(self.host, self.port, timeout=self.rpc_timeout)

@@ -1,0 +1,28 @@
+"""Observability: span tracing, wait events, and metrics (SURVEY §5).
+
+The reference's operability surface is spread over contrib modules —
+``pg_stat_cluster_activity`` (cluster-wide session/query view),
+``stormstats`` (per-statement stats), ``explain_dist.c`` (per-plan-node
+distributed EXPLAIN ANALYZE) and the wait-event columns of
+``pg_stat_activity``.  This package is the engine-side equivalent:
+
+- :mod:`opentenbase_tpu.obs.trace`   — nested spans over the query path
+  (query → parse/plan/queue/execute → fragment → operator → motion),
+  bounded in-memory ring, near-zero-cost when ``trace_queries = off``;
+- :mod:`opentenbase_tpu.obs.waits`   — cumulative + current wait events
+  (locks, pool channels, WLM admission queues, remote-fragment RPCs);
+- :mod:`opentenbase_tpu.obs.metrics` — allocation-free fixed-bucket
+  histograms/counters backing ``pg_stat_query_phases`` and the enriched
+  ``pg_stat_statements``;
+- :mod:`opentenbase_tpu.obs.export`  — Chrome-trace-format (Perfetto /
+  chrome://tracing) JSON export, also reachable through the
+  ``otb_trace`` CLI and the ``pg_export_traces()`` admin function;
+- :mod:`opentenbase_tpu.obs.explain` — the per-operator plan-node tree
+  EXPLAIN (ANALYZE) prints, aggregated across datanodes.
+"""
+
+from opentenbase_tpu.obs.metrics import MetricsRegistry
+from opentenbase_tpu.obs.trace import Tracer
+from opentenbase_tpu.obs.waits import WaitEventRegistry
+
+__all__ = ["MetricsRegistry", "Tracer", "WaitEventRegistry"]

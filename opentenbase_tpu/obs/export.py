@@ -1,0 +1,55 @@
+"""Chrome-trace-format export (chrome://tracing / Perfetto JSON).
+
+Spans already carry (ts, dur) in microseconds on one monotonic clock,
+so export is a flat dump of "X" (complete) events: one pid per query
+trace (Perfetto then lays queries out as separate process tracks), tid
+= the recording thread.  ``otb_trace`` and the ``pg_export_traces()``
+admin function both funnel through here.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+def chrome_trace(traces) -> dict:
+    """The Chrome trace document for an iterable of QueryTraces."""
+    events: list[dict] = []
+    for tr in traces:
+        pid = tr.qid
+        events.append({
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": f"q{tr.qid}: {tr.query[:120]}"},
+        })
+        with tr._mu:
+            spans = list(tr.spans)
+        for sp in spans:
+            ev = {
+                "name": sp.name,
+                "cat": sp.cat,
+                "ph": "X",
+                "ts": round(sp.ts_us, 3),
+                "dur": round(sp.dur_us, 3),
+                "pid": pid,
+                "tid": sp.tid,
+            }
+            if sp.args:
+                ev["args"] = sp.args
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_chrome_trace(
+    cluster, path: Optional[str] = None, last: Optional[int] = None
+) -> dict:
+    """Export the cluster's most recent ``last`` traces (all when None);
+    writes JSON to ``path`` when given, returns the document."""
+    doc = chrome_trace(cluster.tracer.last(last))
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

@@ -1,0 +1,100 @@
+"""Fixed-bucket latency histograms.
+
+The histogram is the pg_stat_statements/stormstats accumulation model
+done allocation-free: bucket bounds are a static tuple, ``record`` is a
+bisect + integer increments under a lock (no list growth, no dict
+churn), and p50/p95/p99 answer from the bucket counts — good enough for
+operator dashboards, free enough for the per-statement hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+
+# upper bounds in milliseconds; one overflow bucket follows the last
+DEFAULT_BOUNDS_MS: tuple = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+    50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+    30000.0, 60000.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket ms histogram with exact count/sum/min/max."""
+
+    __slots__ = ("bounds", "counts", "count", "total", "min", "max", "_mu")
+
+    def __init__(self, bounds: tuple = DEFAULT_BOUNDS_MS):
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+        self._mu = threading.Lock()
+
+    def record(self, ms: float) -> None:
+        i = bisect_left(self.bounds, ms)
+        with self._mu:
+            self.counts[i] += 1
+            self.count += 1
+            self.total += ms
+            if ms < self.min:
+                self.min = ms
+            if ms > self.max:
+                self.max = ms
+
+    def percentile(self, p: float) -> float:
+        """Estimated percentile (0 < p <= 1): the upper bound of the
+        bucket holding the p-th observation (the exact max for the
+        overflow bucket)."""
+        with self._mu:
+            if self.count == 0:
+                return 0.0
+            target = self.count * p
+            seen = 0
+            for i, n in enumerate(self.counts):
+                seen += n
+                if seen >= target:
+                    if i < len(self.bounds):
+                        return min(self.bounds[i], self.max)
+                    return self.max
+            return self.max
+
+
+class MetricsRegistry:
+    """name -> Counter/Histogram, created on first use."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.histograms: dict[str, Histogram] = {}
+
+    def histogram(self, name: str) -> Histogram:
+        h = self.histograms.get(name)
+        if h is None:
+            with self._mu:
+                h = self.histograms.setdefault(name, Histogram())
+        return h
+
+    def phase_rows(self) -> list[tuple]:
+        """pg_stat_query_phases rows: one per ``phase.*`` histogram —
+        (phase, statements, total_ms, avg_ms, p50_ms, p95_ms, p99_ms)."""
+        with self._mu:
+            items = sorted(
+                (k, v) for k, v in self.histograms.items()
+                if k.startswith("phase.")
+            )
+        rows = []
+        for name, h in items:
+            n = h.count
+            rows.append((
+                name[len("phase."):],
+                n,
+                round(h.total, 3),
+                round(h.total / n, 3) if n else 0.0,
+                round(h.percentile(0.50), 3),
+                round(h.percentile(0.95), 3),
+                round(h.percentile(0.99), 3),
+            ))
+        return rows

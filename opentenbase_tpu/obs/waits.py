@@ -1,0 +1,87 @@
+"""Wait-event model: who is blocked on what, and for how long.
+
+The reference's pg_stat_activity carries (wait_event_type, wait_event)
+per backend and pg_wait_sampling-style extensions accumulate totals.
+Here one registry per cluster does both:
+
+- **current**: a per-session stack of in-flight waits — the columns
+  ``pg_stat_cluster_activity`` shows while a session is parked on a
+  lock, a pool channel, a WLM admission queue, or a remote-fragment
+  RPC;
+- **cumulative**: (type, event) -> [count, total_ms], the
+  ``pg_stat_wait_events`` view.
+
+Wait classes mirror the reference's vocabulary where it maps:
+``Lock`` (lmgr row/table locks), ``IPC`` (pool channel acquisition,
+remote fragment RPCs), ``ResourceGroup`` (WLM admission queues).
+
+Producers only call in when they actually block (the uncontended fast
+paths never touch the registry), so counts mean real waits, not
+acquisitions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+WAIT_LOCK = "Lock"
+WAIT_IPC = "IPC"
+WAIT_RESGROUP = "ResourceGroup"
+
+
+class WaitEventRegistry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        # (wait_event_type, wait_event) -> [count, total_ms]
+        self._cum: dict[tuple, list] = {}
+        # session_id -> stack of [wtype, event, t0] (nested waits: the
+        # innermost is what the activity view shows)
+        self._current: dict[int, list] = {}
+
+    def begin(self, session_id: Optional[int], wtype: str, event: str):
+        """Start a wait; returns the token ``end`` consumes. A None
+        session_id records cumulatively only (callers below the session
+        layer, e.g. the channel pool)."""
+        entry = [session_id, wtype, event, time.monotonic()]
+        if session_id is not None:
+            with self._mu:
+                self._current.setdefault(session_id, []).append(entry)
+        return entry
+
+    def end(self, token) -> None:
+        session_id, wtype, event, t0 = token
+        ms = (time.monotonic() - t0) * 1000.0
+        with self._mu:
+            if session_id is not None:
+                stack = self._current.get(session_id)
+                if stack is not None:
+                    try:
+                        stack.remove(token)
+                    except ValueError:
+                        pass
+                    if not stack:
+                        del self._current[session_id]
+            ent = self._cum.setdefault((wtype, event), [0, 0.0])
+            ent[0] += 1
+            ent[1] += ms
+
+    # -- observability ----------------------------------------------------
+    def current_for(self, session_id: int) -> tuple:
+        """(wait_event_type, wait_event) the session is in RIGHT NOW,
+        or ("", "") when it isn't waiting."""
+        with self._mu:
+            stack = self._current.get(session_id)
+            if not stack:
+                return ("", "")
+            _sid, wtype, event, _t0 = stack[-1]
+            return (wtype, event)
+
+    def rows(self) -> list[tuple]:
+        """pg_stat_wait_events: (type, event, count, total_ms)."""
+        with self._mu:
+            return [
+                (wtype, event, ent[0], round(ent[1], 3))
+                for (wtype, event), ent in sorted(self._cum.items())
+            ]

@@ -118,6 +118,10 @@ class ResourceGroup:
             # a per-statement actual, deliberately NOT mixed into
             # peak_memory which tracks the budget charge
             "peak_result_bytes": 0,
+            # cumulative milliseconds statements spent parked in this
+            # group's admission queue (admitted, shed, or timed out —
+            # every exit path pays its wait in)
+            "queue_wait_ms": 0.0,
         }
 
     def limited(self) -> bool:
@@ -215,6 +219,9 @@ class WorkloadManager:
         }
         # role name -> group name (pg_authid.rolresgroup analog)
         self.role_bindings: dict[str, str] = {}
+        # obs/waits.py registry (set by the Cluster): queued statements
+        # surface as ResourceGroup/<group> wait events while parked
+        self.wait_registry = None
 
     # -- DDL --------------------------------------------------------------
     def create_group(self, name: str, options: dict) -> None:
@@ -371,6 +378,11 @@ class WorkloadManager:
             w = _Waiter(session_id, query, est)
             g.queue.append(w)
             g.stats["queued"] += 1
+            wr = self.wait_registry
+            wait_token = (
+                wr.begin(session_id or None, "ResourceGroup", name)
+                if wr is not None else None
+            )
             deadline = (
                 time.monotonic() + timeout_ms / 1000.0
                 if timeout_ms and timeout_ms > 0
@@ -410,6 +422,11 @@ class WorkloadManager:
                             )
                     self._cv.wait(remaining)
             finally:
+                g.stats["queue_wait_ms"] += (
+                    time.monotonic() - w.enqueued_at
+                ) * 1000.0
+                if wait_token is not None:
+                    wr.end(wait_token)
                 if w in g.queue:
                     g.queue.remove(w)
                     self._cv.notify_all()
@@ -458,6 +475,7 @@ class WorkloadManager:
                     g.stats["peak_memory"],
                     g.stats["peak_running"],
                     g.stats["peak_result_bytes"],
+                    round(g.stats["queue_wait_ms"], 3),
                 )
                 for _, g in sorted(self.groups.items())
             ]

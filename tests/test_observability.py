@@ -1,6 +1,11 @@
-"""Observability surface: system views, pg_stat_statements, distributed
-EXPLAIN ANALYZE (SURVEY §5 — pg_stat_cluster_activity, stormstats,
-explain_dist.c equivalents)."""
+"""Observability surface: system views, enriched pg_stat_statements,
+per-operator distributed EXPLAIN ANALYZE, wait events, query phases,
+and Chrome-trace export (SURVEY §5 — pg_stat_cluster_activity,
+stormstats, explain_dist.c equivalents; obs/ package)."""
+
+import json
+import threading
+import time
 
 import pytest
 
@@ -13,6 +18,15 @@ def sess():
     s.execute("create table t (k bigint, v text) distribute by shard(k)")
     s.execute("insert into t values (1,'a'),(2,'b'),(3,'c'),(4,'d')")
     return s
+
+
+@pytest.fixture()
+def join_sess(sess):
+    sess.execute(
+        "create table u (k bigint, w bigint) distribute by shard(k)"
+    )
+    sess.execute("insert into u values (1,10),(2,20),(3,30),(4,40)")
+    return sess
 
 
 def test_pgxc_node_view(sess):
@@ -51,6 +65,24 @@ def test_stat_statements(sess):
     assert any("count(*) from t" in r[0] for r in rows)
 
 
+def test_stat_statements_enriched(sess):
+    sess.query("select v, count(*) from t group by v")
+    sess.query("select v, count(*) from t group by v")
+    rows = sess.query(
+        "select calls, total_ms, plan_ms, exec_ms, min_ms, max_ms, "
+        "mean_ms, stddev_ms from pg_stat_statements "
+        "where query like '%group by v%'"
+    )
+    assert rows, "statement missing from pg_stat_statements"
+    calls, total, plan, exc, mn, mx, mean, stddev = rows[0]
+    assert calls >= 2
+    assert total > 0 and plan > 0 and exc > 0
+    assert 0 < mn <= mx <= total
+    assert mn <= mean <= mx and stddev >= 0
+    # plan + exec never exceed the whole
+    assert plan + exc <= total + 1e-6
+
+
 def test_shard_map_view(sess):
     rows = sess.query(
         "select node_index, count(*) from pgxc_shard_map group by node_index "
@@ -75,12 +107,275 @@ def test_stat_user_tables(sess):
 
 
 def test_explain_analyze(sess):
+    sess.execute("set enable_fused_execution = off")
     res = sess.execute(
         "explain analyze select v, count(*) from t group by v"
     )
     text = "\n".join(r[0] for r in res.rows)
     assert "Fragment 0 on dn0" in text and "Fragment 0 on dn1" in text
     assert "Total: rows=4" in text and "ms" in text
+
+
+def test_explain_analyze_operator_tree(join_sess):
+    """Host path: EXPLAIN (ANALYZE, VERBOSE) of a 2-DN sharded join
+    prints a per-operator tree with rows/time aggregated across
+    datanodes (min/max/avg like explain_dist.c) plus per-motion
+    rows+bytes; VERBOSE adds the per-datanode breakdown."""
+    s = join_sess
+    s.execute("set enable_fused_execution = off")
+    res = s.execute(
+        "explain (analyze, verbose) select t.v, sum(u.w) from t "
+        "join u on t.k = u.k group by t.v"
+    )
+    lines = [r[0] for r in res.rows]
+    text = "\n".join(lines)
+    # plan-node tree with per-node aggregation over both datanodes
+    join_lines = [ln for ln in lines if "Join inner" in ln and "avg=" in ln]
+    assert join_lines and "loops=2" in join_lines[0]
+    scan_lines = [ln for ln in lines if "Scan t" in ln and "rows=" in ln]
+    assert scan_lines and "min=" in scan_lines[0] and "max=" in scan_lines[0]
+    # per-motion rows + bytes on the fragment header
+    assert any("motion rows=" in ln and "bytes=" in ln for ln in lines)
+    # VERBOSE: per-datanode rows under each operator
+    assert "on dn0:" in text and "on dn1:" in text
+    # the coordinator's merge side of the tree is reported too
+    assert "Coordinator:" in text
+    assert any("Total: rows=" in ln for ln in lines)
+
+
+def test_explain_analyze_fused_phases(sess):
+    """Fused path: EXPLAIN ANALYZE reports compile vs device-execute
+    vs host-merge ms, and pg_stat_fused carries the same attribution."""
+    res = sess.execute("explain analyze select count(*) from t")
+    text = "\n".join(r[0] for r in res.rows)
+    assert "Fused device execution:" in text, text
+    assert "compile=" in text and "device=" in text
+    assert "Total: rows=1" in text
+    rows = sess.query("select event, detail from pg_stat_fused")
+    events = {r[0] for r in rows}
+    assert "last_compile_ms" in events and "last_device_ms" in events
+    assert "total_device_ms" in events
+
+
+def test_explain_analyze_fused_join(join_sess):
+    """The fused DAG path (2-DN sharded join collapsed onto the device
+    mesh) reports its compile/device/host split in EXPLAIN output."""
+    s = join_sess
+    res = s.execute(
+        "explain (analyze, verbose) select t.v, sum(u.w) from t "
+        "join u on t.k = u.k group by t.v"
+    )
+    text = "\n".join(r[0] for r in res.rows)
+    if "Fused device execution:" not in text:
+        pytest.skip("join plan not fused on this backend")
+    assert "compile=" in text and "device=" in text
+    assert "Total: rows=4" in text
+
+
+def test_wait_event_lock(sess):
+    """A session blocked on a row lock is visible to ANOTHER session
+    through pg_stat_cluster_activity's wait columns, and the wait lands
+    in pg_stat_wait_events afterwards."""
+    c = sess.cluster
+    holder = c.session()
+    holder.execute("begin")
+    holder.execute("update t set v = 'x' where k = 2")
+    waiter = c.session()
+    errs = []
+
+    def blocked():
+        try:
+            waiter.execute("update t set v = 'y' where k = 2")
+        except Exception as e:  # released by rollback below
+            errs.append(e)
+
+    th = threading.Thread(target=blocked)
+    th.start()
+    try:
+        deadline = time.monotonic() + 10
+        seen = None
+        while time.monotonic() < deadline:
+            rows = sess.query(
+                "select session_id, wait_event_type, wait_event "
+                "from pg_stat_cluster_activity "
+                "where wait_event_type = 'Lock'"
+            )
+            if rows:
+                seen = rows
+                break
+            time.sleep(0.02)
+        assert seen, "blocked session never surfaced a Lock wait"
+        assert seen[0][0] == waiter.session_id
+        assert seen[0][2] == "tuple"
+    finally:
+        holder.execute("rollback")
+        th.join(timeout=10)
+    ev = sess.query(
+        "select count, total_ms from pg_stat_wait_events "
+        "where wait_event_type = 'Lock' and wait_event = 'tuple'"
+    )
+    assert ev and ev[0][0] >= 1 and ev[0][1] > 0
+
+
+def test_wait_event_wlm_queue(sess):
+    """A statement parked in a full WLM admission queue surfaces as a
+    ResourceGroup wait (visible from a second session) and accumulates
+    into pg_stat_wait_events + pg_stat_wlm.queue_wait_ms."""
+    c = sess.cluster
+    sess.execute("create resource group obsg with (concurrency=1, queue_depth=4)")
+    a, b = c.session(), c.session()
+    for x in (a, b):
+        x.execute("set resource_group = obsg")
+    started = threading.Event()
+    errs = []
+
+    def hold():
+        try:
+            started.set()
+            a.execute("select pg_sleep(1.2)")
+        except Exception as e:
+            errs.append(e)
+
+    def queued():
+        try:
+            started.wait(5)
+            time.sleep(0.15)  # let the holder take the one slot
+            b.execute("select count(*) from t")
+        except Exception as e:
+            errs.append(e)
+
+    th_a = threading.Thread(target=hold)
+    th_b = threading.Thread(target=queued)
+    th_a.start()
+    th_b.start()
+    try:
+        deadline = time.monotonic() + 10
+        seen = None
+        while time.monotonic() < deadline:
+            rows = sess.query(
+                "select session_id, state, wait_event from "
+                "pg_stat_cluster_activity "
+                "where wait_event_type = 'ResourceGroup'"
+            )
+            if rows:
+                seen = rows
+                break
+            time.sleep(0.02)
+        assert seen, "queued session never surfaced a ResourceGroup wait"
+        assert seen[0][0] == b.session_id
+        assert seen[0][1] == "queued"
+        assert seen[0][2] == "obsg"
+    finally:
+        th_a.join(timeout=15)
+        th_b.join(timeout=15)
+    assert not errs, errs
+    ev = sess.query(
+        "select count from pg_stat_wait_events "
+        "where wait_event_type = 'ResourceGroup' and wait_event = 'obsg'"
+    )
+    assert ev and ev[0][0] >= 1
+    qw = sess.query(
+        "select queue_wait_ms from pg_stat_wlm where group_name = 'obsg'"
+    )
+    assert qw and qw[0][0] > 0
+
+
+def test_query_phases_view(sess):
+    sess.query("select v, count(*) from t group by v")
+    rows = sess.query(
+        "select phase, statements, total_ms, p50_ms, p99_ms "
+        "from pg_stat_query_phases"
+    )
+    phases = {r[0]: r for r in rows}
+    for must in ("parse", "plan", "execute"):
+        assert must in phases, (must, rows)
+        assert phases[must][1] > 0
+        assert phases[must][2] >= 0
+    # percentiles come from the same histogram: p50 <= p99
+    for r in rows:
+        assert r[3] <= r[4] + 1e-9
+
+
+def test_chrome_trace_export(join_sess, tmp_path):
+    """trace_queries=on traces a query end to end; the export round-
+    trips through json.load with well-nested span timestamps; the
+    pg_export_traces() admin function serves the same document over
+    SQL (what the otb_trace CLI fetches)."""
+    from opentenbase_tpu.obs.export import export_chrome_trace
+
+    s = join_sess
+    # host path: fragment + motion spans are the interesting content
+    s.execute("set enable_fused_execution = off")
+    s.execute("set trace_queries = on")
+    s.query(
+        "select t.v, sum(u.w) from t join u on t.k = u.k group by t.v"
+    )
+    s.execute("set trace_queries = off")
+    path = tmp_path / "trace.json"
+    export_chrome_trace(s.cluster, str(path))
+    with open(path) as f:
+        doc = json.load(f)
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert events, "no spans exported"
+    by_pid: dict = {}
+    for e in events:
+        by_pid.setdefault(e["pid"], []).append(e)
+    # the traced query carries a root 'query' span enclosing the rest
+    traced = [
+        evs for evs in by_pid.values()
+        if any(e["name"] == "query" for e in evs)
+    ]
+    assert traced
+    for evs in traced:
+        root = next(e for e in evs if e["name"] == "query")
+        lo, hi = root["ts"], root["ts"] + root["dur"]
+        for e in evs:
+            assert e["ts"] >= lo - 1000  # 1ms slack for clock rounding
+            assert e["ts"] + e["dur"] <= hi + 1000
+    # the join query's trace recorded real executor work under its root
+    # (the trailing SET's trace is legitimately parse-only)
+    join_names = [
+        {e["name"] for e in evs} for evs in traced
+        if any(
+            e["name"] == "query" and "join" in (
+                (e.get("args") or {}).get("query") or ""
+            )
+            for e in evs
+        )
+    ]
+    assert join_names, "join query was not traced"
+    names = join_names[0]
+    assert any(n.startswith("fragment") for n in names), names
+    assert any(n.startswith("motion") for n in names), names
+    assert "plan" in names and "execute" in names
+    # same document over the SQL surface
+    via_sql = json.loads(
+        s.query("select pg_export_traces(10)")[0][0]
+    )
+    assert via_sql["traceEvents"]
+
+
+def test_trace_off_zero_span_allocations(sess):
+    """With trace_queries=off and no EXPLAIN ANALYZE, a query allocates
+    ZERO spans — the tracer must be free when disabled."""
+    from opentenbase_tpu.obs.trace import Span
+
+    sess.query("select count(*) from t")  # warm everything up
+    before = Span.allocations
+    sess.query("select v, count(*) from t group by v")
+    sess.query("select count(*) from t where k > 1")
+    assert Span.allocations == before
+
+
+def test_explain_analyze_traces_without_guc(sess):
+    """EXPLAIN ANALYZE always lands a trace in the ring, GUC off."""
+    tracer = sess.cluster.tracer
+    before = len(tracer)
+    sess.execute("set enable_fused_execution = off")
+    sess.execute("explain analyze select count(*) from t")
+    assert len(tracer) == before + 1
+    spans = tracer.last(1)[0].spans
+    assert any(sp.cat == "fragment" for sp in spans)
 
 
 def test_join_system_view_with_user_table(sess):

@@ -179,13 +179,35 @@ def test_recursive_body_uses_earlier_plain_sibling(s):
     assert rows == [(1,), (2,), (3,)]
 
 
-def test_explain_recursive_rejected(s):
-    with pytest.raises(SQLError, match="EXPLAIN"):
-        s.query(
+def test_explain_recursive_plain(s):
+    """Plain EXPLAIN prints the recursive plan shape WITHOUT executing
+    (shape-only stand-in tables; nothing materialized, nothing left
+    behind)."""
+    before = set(s.cluster.catalog.table_names())
+    lines = [
+        r[0] for r in s.query(
             "explain with recursive t(n) as"
             " (select 1 union all select n+1 from t where n < 3)"
             " select * from t"
         )
+    ]
+    text = "\n".join(lines)
+    assert 'Recursive Union "t" (UNION ALL)' in text
+    assert "Non-recursive term:" in text and "Recursive term:" in text
+    # the stand-in is renamed back to the CTE name in the output...
+    assert "__recshape_" not in text
+    # ...and dropped from the catalog (no execution, no leftovers)
+    assert set(s.cluster.catalog.table_names()) == before
+
+
+def test_explain_analyze_recursive_executes(s):
+    rows = s.query(
+        "explain analyze with recursive t(n) as"
+        " (select 1 union all select n+1 from t where n < 3)"
+        " select count(*) from t"
+    )
+    text = "\n".join(r[0] for r in rows)
+    assert "Total: rows=1" in text
 
 
 def test_concurrent_sessions_no_collision(c):

@@ -328,6 +328,9 @@ def test_subquery_instrumentation_survives(tmp_path):
     rows = s.query(
         "explain analyze select k from t where v = (select max(v) from t)"
     )
-    frag_lines = [r[0] for r in rows if r[0].startswith("Fragment ") and "rows=" in r[0]]
+    frag_lines = [
+        r[0] for r in rows
+        if r[0].startswith("Fragment ") and " on dn" in r[0]
+    ]
     # 2 datanodes x (subplan fragment + main fragment) = 4 instrumented runs
     assert len(frag_lines) == 4

@@ -6,7 +6,11 @@
 #   2. the fast WLM smoke subset (tests/test_wlm.py, ~15 s) — the
 #      admission-control layer sits in front of every statement, so a
 #      regression there poisons everything downstream;
-#   3. the full ROADMAP tier-1 pytest command, verbatim.
+#   3. an observability smoke (obs/): EXPLAIN (ANALYZE, VERBOSE) of a
+#      2-DN sharded join must print per-node rows, and a traced query
+#      must export parseable Chrome-trace JSON — instrumentation
+#      regressions fail fast here;
+#   4. the full ROADMAP tier-1 pytest command, verbatim.
 #
 # Usage: tools/tier1.sh   (from anywhere; cd's to the repo root)
 
@@ -20,6 +24,35 @@ python -m compileall -q opentenbase_tpu || exit 1
 echo "== tier1: WLM smoke subset =="
 timeout -k 10 120 python -m pytest tests/test_wlm.py -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
+echo "== tier1: observability smoke =="
+timeout -k 10 180 python - <<'PY' || exit 1
+import json, tempfile, os
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.obs.export import export_chrome_trace
+
+s = Cluster(num_datanodes=2, shard_groups=16).session()
+s.execute("create table st (k bigint, v text) distribute by shard(k)")
+s.execute("create table su (k bigint, w bigint) distribute by shard(k)")
+s.execute("insert into st values (1,'a'),(2,'b'),(3,'c'),(4,'d')")
+s.execute("insert into su values (1,10),(2,20),(3,30),(4,40)")
+s.execute("set enable_fused_execution = off")
+s.execute("set trace_queries = on")
+lines = [r[0] for r in s.query(
+    "explain (analyze, verbose) select st.v, sum(su.w) "
+    "from st join su on st.k = su.k group by st.v"
+)]
+text = "\n".join(lines)
+assert "on dn0:" in text and "on dn1:" in text, text  # per-node rows
+assert any("rows=" in ln and "loops=2" in ln for ln in lines), text
+assert any("motion rows=" in ln for ln in lines), text
+out = os.path.join(tempfile.mkdtemp(prefix="otbtrace_"), "trace.json")
+export_chrome_trace(s.cluster, out)
+with open(out) as f:
+    doc = json.load(f)  # must be parseable JSON
+assert doc["traceEvents"], "empty trace export"
+print(f"observability smoke OK: {len(doc['traceEvents'])} trace events")
+PY
 
 echo "== tier1: full suite =="
 rm -f /tmp/_t1.log
