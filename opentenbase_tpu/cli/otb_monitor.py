@@ -93,6 +93,48 @@ def report_wlm(host: str, port: int, user=None, password=None) -> bool:
     return True
 
 
+def report_matviews(host: str, port: int, user=None, password=None) -> bool:
+    """Materialized-view health over the coordinator wire: one line
+    per matview from pg_matviews + pg_stat_matview (freshness, refresh
+    mode split, delta rows consumed, serving-path rewrite hits)."""
+    from opentenbase_tpu.net.client import ClientSession
+
+    try:
+        cs = ClientSession(host, port, timeout=5, user=user,
+                           password=password, connect_retries=0)
+        try:
+            views = cs.query(
+                "select matviewname, incremental, is_fresh, "
+                "last_refresh_lsn from pg_matviews"
+            )
+            stats = {
+                r[0]: r[1:] for r in cs.query(
+                    "select matviewname, n_rows, "
+                    "incremental_refreshes, full_refreshes, "
+                    "deltas_applied, rewrites, last_refresh_ms, "
+                    "last_mode from pg_stat_matview"
+                )
+            }
+        finally:
+            cs.close()
+    except Exception as e:
+        print(f"matview {host}:{port}: unreachable ({e})")
+        return False
+    if not views:
+        print(f"matview {host}:{port}: no materialized views")
+        return True
+    for name, incremental, fresh, lsn in views:
+        st = stats.get(name, (0, 0, 0, 0, 0, 0.0, ""))
+        print(
+            f"matview {host}:{port} {name}: rows={st[0]} "
+            f"incremental={'on' if incremental else 'off'} "
+            f"fresh={'yes' if fresh else 'STALE'} lsn={lsn} "
+            f"refreshes={st[1]}incr/{st[2]}full deltas={st[3]} "
+            f"rewrites={st[4]} last={st[6] or '-'} ({st[5]} ms)"
+        )
+    return True
+
+
 def _hostport(s: str) -> tuple[str, int]:
     host, _, port = s.rpartition(":")
     return host or "127.0.0.1", int(port)
@@ -109,11 +151,18 @@ def main(argv=None) -> int:
         "--wlm", action="append", default=[],
         help="coordinator HOST:PORT to report pg_stat_wlm for",
     )
+    ap.add_argument(
+        "--matview", action="append", default=[],
+        help="coordinator HOST:PORT to report matview health for",
+    )
     args = ap.parse_args(argv)
     ok = True
     for target in args.wlm:
         h, p = _hostport(target)
         ok = report_wlm(h, p, args.user, args.password) and ok
+    for target in args.matview:
+        h, p = _hostport(target)
+        ok = report_matviews(h, p, args.user, args.password) and ok
     for role, targets, probe in (
         ("coordinator", args.cn,
          lambda h, p: probe_cn(h, p, args.user, args.password)),

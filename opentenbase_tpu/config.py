@@ -110,6 +110,11 @@ GUCS: dict = {
     "client_min_messages": (
         _enum("debug", "log", "notice", "warning", "error"), "notice",
     ),
+    # matview serving path (matview/rewrite.py): a SELECT whose
+    # canonical text exactly matches a FRESH materialized view's
+    # defining query is answered from the matview instead of the fact
+    # tables; staleness is checked against per-table write versions
+    "enable_matview_rewrite": (_bool, True),
     # span tracing (obs/trace.py): off = zero-cost (no span allocation
     # anywhere on the statement path); EXPLAIN ANALYZE always traces
     # its one statement regardless

@@ -356,6 +356,17 @@ class Cluster:
         self.partitions: dict[str, "PartitionSpec"] = {}
         # views: name -> (query AST template, verbatim body text)
         self.views: dict[str, tuple] = {}
+        # materialized views (matview/): name -> MatviewDef; the
+        # backing store is a real catalog table + an aux partial-state
+        # table, so everything below the def is ordinary table machinery
+        self.matviews: dict = {}
+        # per-table committed-write counters: the matview serving
+        # path's staleness check (bumped on every commit/replay/
+        # truncate that touches the table)
+        self.table_version: dict[str, int] = {}
+        # coordinator-only throwaway tables (matview delta scratch):
+        # fragments over these must never ship to DN processes
+        self.local_tables: set = set()
         # observability (SURVEY §5): session registry + per-statement stats.
         # Sessions register weakly so short-lived connections don't pin
         # memory or linger forever in pg_stat_cluster_activity.
@@ -403,12 +414,36 @@ class Cluster:
         BARRIER point, barrier.c)."""
         c = cls(num_datanodes, shard_groups, data_dir, gts_backend)
         c.persistence.recover(until_barrier=until_barrier)
+        # matview catalog fixup: fold the replayed otb_matview_state
+        # rows back into the defs and decide serving-path freshness
+        # (matview/defs.py load_state)
+        if c.matviews:
+            from opentenbase_tpu.matview.defs import load_state
+
+            load_state(c)
         # restart logical-replication apply workers (the launcher starting
         # apply workers for every enabled subscription after crash
         # recovery); they reconnect-retry until the publisher is back
         for worker in c.subscriptions.values():
             worker.start()
         return c
+
+    def bump_table_versions(self, tables) -> None:
+        """Advance the committed-write counter of every named table —
+        the matview rewrite's staleness evidence. Called from commit
+        stamping, WAL redo, and content-replacing DDL. A write to a
+        partition CHILD also bumps its parent: matviews over a
+        partitioned table track the parent name (DML fans out to
+        children before any version bump happens)."""
+        tables = set(tables)
+        if self.partitions:
+            for parent, spec in self.partitions.items():
+                if parent not in tables and not tables.isdisjoint(
+                    spec.children()
+                ):
+                    tables.add(parent)
+        for tb in tables:
+            self.table_version[tb] = self.table_version.get(tb, 0) + 1
 
     def fused_executor(self):
         """Lazily built FusedExecutor over the default device mesh (the
@@ -930,6 +965,11 @@ class Session:
         # internal stand-in names mapped back to user-visible names in
         # EXPLAIN output (recursive-CTE shape tables)
         self._explain_rename: dict[str, str] = {}
+        # True while matview machinery (refresh / populate) issues
+        # internal statements: disables the serving-path rewrite (a
+        # refresh must read the base tables, never itself) and the
+        # matview write guard
+        self._matview_internal = False
 
     def close(self) -> None:
         """Backend-exit cleanup (the tcop loop's on-exit path): release
@@ -1333,6 +1373,9 @@ class Session:
                 gid=gid,
                 frame=frame,
             )
+        self.cluster.bump_table_versions(
+            {tb for tabs in txn.writes.values() for tb in tabs}
+        )
         txn.unpin_all()
 
     def _abort_txn(
@@ -1452,6 +1495,9 @@ class Session:
                 f"cannot execute {type(stmt).__name__} in a read-only "
                 "(hot standby) cluster"
             )
+        if not self._matview_internal:
+            self._matview_write_guard(stmt)
+            stmt = self._maybe_matview_rewrite(stmt)
         stmt = self._expand_sequences(stmt)
         stmt = self._expand_partitions(stmt)
         if isinstance(stmt, Result):  # fully handled by partition fanout
@@ -1512,7 +1558,13 @@ class Session:
             raise SQLError(str(e), "57014")
 
     # -- workload management (wlm/) ---------------------------------------
-    _WLM_GATED = (A.Select, A.Insert, A.Update, A.Delete, A.CopyStmt)
+    # matview population/refresh statements are resource-consuming
+    # (they run the defining query) and go through admission like any
+    # read — the estimator charges them by their defining query
+    _WLM_GATED = (
+        A.Select, A.Insert, A.Update, A.Delete, A.CopyStmt,
+        A.RefreshMatview, A.CreateMatview,
+    )
 
     def _wlm_group_name(self) -> str:
         """The session's resource group: the ``resource_group`` GUC
@@ -1559,7 +1611,13 @@ class Session:
                 estimate_statement_memory,
             )
 
-            est = estimate_statement_memory(stmt, self.cluster.catalog)
+            est_stmt = stmt
+            if isinstance(stmt, A.RefreshMatview):
+                # charge a refresh by its defining query's plan
+                d = self.cluster.matviews.get(stmt.name)
+                if d is not None:
+                    est_stmt = d.query
+            est = estimate_statement_memory(est_stmt, self.cluster.catalog)
         timeout_ms = 0
         if group.limited():
             # queue-wait deadline: the REMAINING statement budget when a
@@ -1611,6 +1669,122 @@ class Session:
         self._wlm_ticket = ticket
         return ticket
 
+    # -- materialized views (matview/) ------------------------------------
+    def _matview_write_guard(self, stmt: A.Statement) -> None:
+        """A matview's contents (and its aux partial-state table) are
+        maintained only by REFRESH: direct DML/DDL against them errors
+        with SQLSTATE 42809 (wrong_object_type), as matview.c does.
+        The durable refresh-state table is equally off limits — a
+        corrupted last_refresh_lsn would make the next 'incremental'
+        refresh re-apply history."""
+        c = self.cluster
+        names: list = []
+        if isinstance(stmt, (A.Insert, A.Update, A.Delete)):
+            names = [stmt.table]
+        elif isinstance(stmt, A.CopyStmt) and stmt.direction == "from":
+            names = [stmt.table]
+        elif isinstance(stmt, (A.TruncateTable, A.DropTable)):
+            names = list(stmt.names)
+        elif isinstance(stmt, A.AlterTable):
+            names = [stmt.table]
+        if not names:
+            return
+        from opentenbase_tpu.matview.defs import STATE_TABLE
+
+        for name in names:
+            if name == STATE_TABLE and c.catalog.has(STATE_TABLE):
+                raise SQLError(
+                    f'"{STATE_TABLE}" is the materialized-view '
+                    "refresh-state catalog",
+                    "42809",
+                )
+        if not c.matviews:
+            return
+        aux_owners = {
+            d.aux_table: nm for nm, d in c.matviews.items()
+        }
+        for name in names:
+            if name in c.matviews:
+                if isinstance(stmt, A.DropTable):
+                    raise SQLError(
+                        f'"{name}" is a materialized view — use '
+                        "DROP MATERIALIZED VIEW",
+                        "42809",
+                    )
+                raise SQLError(
+                    f'cannot change materialized view "{name}"',
+                    "42809",
+                )
+            if name in aux_owners:
+                raise SQLError(
+                    f'"{name}" is the auxiliary state table of '
+                    f'materialized view "{aux_owners[name]}"',
+                    "42809",
+                )
+
+    def _maybe_matview_rewrite(self, stmt: A.Statement) -> A.Statement:
+        """Serving path (enable_matview_rewrite GUC): an incoming
+        SELECT that exactly matches a FRESH matview's defining query
+        is answered by scanning the matview. EXPLAIN shows the rewrite
+        as a prelude line over the Scan."""
+        c = self.cluster
+        if not c.matviews or not self.gucs.get(
+            "enable_matview_rewrite", True
+        ):
+            return stmt
+        if self.txn is not None and self.txn.writes:
+            # the transaction's own uncommitted writes are invisible to
+            # the matview (versions bump only at commit): the normal
+            # executor path must serve them
+            return stmt
+        sel = stmt.query if isinstance(stmt, A.ExplainStmt) else stmt
+        if not isinstance(sel, A.Select):
+            return stmt
+        from opentenbase_tpu.matview.rewrite import try_rewrite
+
+        hit = try_rewrite(c, sel)
+        if hit is None:
+            return stmt
+        name, new_sel = hit
+        d = c.matviews[name]
+        d.stats["rewrites"] = d.stats.get("rewrites", 0) + 1
+        if isinstance(stmt, A.ExplainStmt):
+            self._explain_prelude.append(
+                f'Matview rewrite: query served from "{name}" '
+                f"(lsn {d.last_refresh_lsn})"
+            )
+            stmt.query = new_sel
+            return stmt
+        return new_sel
+
+    def _dependent_matviews(self, relname: str) -> list[str]:
+        """Matviews whose defining queries read ``relname`` (including
+        through views) — the pg_depend edge DROP must honor."""
+        from opentenbase_tpu.plan.astwalk import relation_names
+
+        out = []
+        for nm, d in self.cluster.matviews.items():
+            if nm == relname:
+                continue
+            if relname in d.base_tables or relname in relation_names(
+                d.query
+            ):
+                out.append(nm)
+        return sorted(out)
+
+    def _drop_dependents(self, relname: str) -> None:
+        """CASCADE: drop every view and matview depending on
+        ``relname`` (depth-first, so chains unwind leaf-first)."""
+        for v in self._dependent_views(relname):
+            if v in self.cluster.views:
+                self._drop_dependents(v)
+                self._x_dropview(A.DropView(v, if_exists=True))
+        for m in self._dependent_matviews(relname):
+            if m in self.cluster.matviews:
+                self._x_dropmatview(
+                    A.DropMatview(m, if_exists=True, cascade=True)
+                )
+
     # -- audit hooks (auditlogger.c backend side) -------------------------
     _AUDIT_DML = {
         "Insert": "insert", "Update": "update", "Delete": "delete",
@@ -1624,6 +1798,7 @@ class Session:
         "CreateShardingGroup", "AuditStmt", "NoAuditStmt",
         "CreateResourceGroup", "DropResourceGroup",
         "AlterRoleResourceGroup",
+        "CreateMatview", "DropMatview", "RefreshMatview",
     )
 
     def _audit_classify(self, stmt) -> tuple[Optional[str], set]:
@@ -2321,7 +2496,7 @@ class Session:
                 stmt.query, A.Select
             ):
                 expand_ctes(stmt.query)
-            elif isinstance(stmt, A.CreateTableAs):
+            elif isinstance(stmt, (A.CreateTableAs, A.CreateMatview)):
                 expand_ctes(stmt.query)
             elif isinstance(stmt, (A.Update, A.Delete, A.Insert)):
                 if (
@@ -2384,7 +2559,7 @@ class Session:
                         raise SQLError(
                             f'"{n}" is a view (use DROP VIEW)'
                         )
-            elif isinstance(stmt, A.CreateTableAs):
+            elif isinstance(stmt, (A.CreateTableAs, A.CreateMatview)):
                 rewrite_views(stmt.query, views)
         except ViewRecursionError as e:
             raise SQLError(str(e))
@@ -2399,7 +2574,7 @@ class Session:
             return stmt
         from opentenbase_tpu.plan.partition import rewrite_select
 
-        if isinstance(stmt, A.CreateTableAs):
+        if isinstance(stmt, (A.CreateTableAs, A.CreateMatview)):
             rewrite_select(stmt.query, parts)
             return stmt
 
@@ -2446,10 +2621,23 @@ class Session:
                 if n in parts:
                     if isinstance(stmt, A.DropTable):
                         deps = self._dependent_views(n)
+                        mv_deps = self._dependent_matviews(n)
+                        if (deps or mv_deps) and stmt.cascade:
+                            self._drop_dependents(n)
+                            deps = self._dependent_views(n)
+                            mv_deps = self._dependent_matviews(n)
                         if deps:
                             raise SQLError(
                                 f'cannot drop table "{n}": view(s) '
-                                f"{', '.join(sorted(deps))} depend on it"
+                                f"{', '.join(sorted(deps))} depend on it",
+                                "2BP01",
+                            )
+                        if mv_deps:
+                            raise SQLError(
+                                f'cannot drop table "{n}": '
+                                "materialized view(s) "
+                                f"{', '.join(mv_deps)} depend on it",
+                                "2BP01",
                             )
                     names.extend(parts[n].children())
                     if isinstance(stmt, A.DropTable):
@@ -3268,7 +3456,11 @@ class Session:
                     if self.cluster.persistence is not None
                     else 0
                 ),
-                local_only_tables=_SYSTEM_VIEWS,
+                local_only_tables=(
+                    set(_SYSTEM_VIEWS) | self.cluster.local_tables
+                    if self.cluster.local_tables
+                    else _SYSTEM_VIEWS
+                ),
                 parallel_workers=self.gucs.get("dn_parallel_workers", 4),
                 deadline=self._stmt_deadline,
                 wlm_ticket=self._wlm_ticket,
@@ -4777,15 +4969,252 @@ class Session:
                 return Result("DROP VIEW")
             raise SQLError(f'view "{stmt.name}" does not exist')
         deps = self._dependent_views(stmt.name)
+        mv_deps = self._dependent_matviews(stmt.name)
         if deps:
             raise SQLError(
                 f'cannot drop view "{stmt.name}": view(s) '
-                f"{', '.join(sorted(deps))} depend on it"
+                f"{', '.join(sorted(deps))} depend on it",
+                "2BP01",
+            )
+        if mv_deps:
+            raise SQLError(
+                f'cannot drop view "{stmt.name}": materialized '
+                f"view(s) {', '.join(mv_deps)} depend on it",
+                "2BP01",
             )
         del c.views[stmt.name]
         if c.persistence is not None:
             c.persistence.log_ddl({"op": "drop_view", "name": stmt.name})
         return Result("DROP VIEW")
+
+    # -- materialized views (matview/) ------------------------------------
+    def _matview_dist(self, options: dict, schema: dict) -> DistributionSpec:
+        """Distribution of a matview's backing table: WITH (distribute
+        = ...) wins, else ROUNDROBIN (matview rows are derived — no
+        natural key to co-locate on without user guidance)."""
+        strat = (options.get("distribute") or "").lower()
+        if not strat:
+            return DistributionSpec(DistStrategy.ROUNDROBIN)
+        keys = list(options.get("distribute_keys") or [])
+        for k in keys:
+            if k not in schema:
+                raise SQLError(
+                    f'distribution key "{k}" is not an output column '
+                    "of the materialized view"
+                )
+        return self._dist_spec_named(strat, keys, None)
+
+    def _x_creatematview(self, stmt: A.CreateMatview) -> Result:
+        from opentenbase_tpu.matview import defs as _mv
+        from opentenbase_tpu.matview.refresh import (
+            apply_refresh,
+            build_partials_select,
+        )
+        from opentenbase_tpu.storage.persist import _type_to_str
+
+        c = self.cluster
+        name = stmt.name
+        if name in _SYSTEM_VIEWS:
+            raise SQLError(
+                f'relation name "{name}" is reserved for a system view'
+            )
+        if self.txn is not None:
+            # the populate commits on its own and the catalog entry is
+            # not transactional: a rollback would leave a registered,
+            # fresh-marked, EMPTY matview for the rewrite to serve
+            raise SQLError(
+                "CREATE MATERIALIZED VIEW cannot run inside a "
+                "transaction block",
+                "25001",
+            )
+        if name in c.matviews:
+            if stmt.if_not_exists:
+                return Result("CREATE MATERIALIZED VIEW")
+            raise SQLError(
+                f'materialized view "{name}" already exists', "42P07"
+            )
+        if c.catalog.has(name) or name in c.views or name in c.partitions:
+            if stmt.if_not_exists:
+                return Result("CREATE MATERIALIZED VIEW")
+            raise SQLError(f'relation "{name}" already exists', "42P07")
+        _mv.ensure_state_table(self)
+        p = c.persistence
+        lsn0 = p.wal.position if p is not None else 0
+        refresh_ts = c.gts.snapshot_ts()
+        # versions are captured WITH lsn0 (see refresh_matview): a
+        # base commit during population must leave the matview stale
+        versions0 = {
+            tb: c.table_version.get(tb, 0)
+            for tb in c.table_version
+        }
+        prev_internal = self._matview_internal
+        self._matview_internal = True
+        try:
+            # the populate read: the query was view/CTE/partition
+            # expanded by the statement pipeline above
+            batch = self._run_select(stmt.query)
+            schema: dict[str, t.SqlType] = {}
+            for colname, col in batch.columns.items():
+                if colname in schema or not colname:
+                    raise SQLError(
+                        "CREATE MATERIALIZED VIEW needs unique, named "
+                        "output columns"
+                    )
+                schema[colname] = col.type
+            if not schema:
+                raise SQLError(
+                    "CREATE MATERIALIZED VIEW needs at least one column"
+                )
+            dist = self._matview_dist(stmt.options, schema)
+            meta = c.catalog.create_table(name, schema, dist)
+            c.create_table_stores(meta)
+            d = _mv.register(c, name, stmt.text, stmt.options)
+            # aux partial-state table: only agg shapes maintained
+            # incrementally need one
+            aux_rows = None
+            if d.wants_incremental() and d.shape.kind == "agg":
+                aux_batch = self._run_select(
+                    build_partials_select(d.shape)
+                )
+                aux_schema = {
+                    cn: cb.type
+                    for cn, cb in aux_batch.columns.items()
+                }
+                aux_meta = c.catalog.create_table(
+                    d.aux_table, aux_schema,
+                    DistributionSpec(DistStrategy.ROUNDROBIN),
+                )
+                c.create_table_stores(aux_meta)
+                d.aux_schema = {
+                    cn: _type_to_str(ty)
+                    for cn, ty in aux_schema.items()
+                }
+                aux_rows = {
+                    cn: cb.to_python()
+                    for cn, cb in zip(
+                        aux_meta.schema, aux_batch.columns.values()
+                    )
+                }
+            if p is not None:
+                p.log_ddl({
+                    "op": "create_matview",
+                    "name": name,
+                    "text": stmt.text,
+                    "options": dict(stmt.options),
+                    "schema": {
+                        k: _type_to_str(v) for k, v in schema.items()
+                    },
+                    "strategy": dist.strategy.value,
+                    "key_columns": list(dist.key_columns),
+                    "aux_schema": d.aux_schema,
+                })
+            d.last_refresh_lsn = lsn0
+            d.last_refresh_ts = refresh_ts
+            mv_rows = {
+                cn: cb.to_python()
+                for cn, cb in zip(meta.schema, batch.columns.values())
+            }
+            try:
+                apply_refresh(
+                    self, d, meta,
+                    {"deletes": [], "mv_rows": mv_rows,
+                     "aux_rows": aux_rows, "row_deletes": []},
+                    _mv.state_row(d),
+                )
+            except Exception:
+                # unwind the half-created matview (population failed)
+                c.matviews.pop(name, None)
+                for tb in (name, d.aux_table):
+                    if c.catalog.has(tb):
+                        c.catalog.drop_table(tb)
+                        c.drop_table_stores(tb)
+                if p is not None:
+                    p.log_ddl({"op": "drop_matview", "name": name})
+                raise
+        finally:
+            self._matview_internal = prev_internal
+        d.base_versions = {
+            tb: versions0.get(tb, 0) for tb in d.base_tables
+        }
+        return Result("CREATE MATERIALIZED VIEW", rowcount=batch.nrows)
+
+    def _x_refreshmatview(self, stmt: A.RefreshMatview) -> Result:
+        c = self.cluster
+        d = c.matviews.get(stmt.name)
+        if d is None:
+            raise SQLError(
+                f'materialized view "{stmt.name}" does not exist',
+                "42P01",
+            )
+        if self.txn is not None:
+            raise SQLError(
+                "REFRESH MATERIALIZED VIEW cannot run inside a "
+                "transaction block",
+                "25001",
+            )
+        from opentenbase_tpu.matview.refresh import refresh_matview
+
+        info = refresh_matview(
+            self, d, concurrently=stmt.concurrently
+        )
+        return Result(
+            "REFRESH MATERIALIZED VIEW", rowcount=info["deltas"]
+        )
+
+    def _x_dropmatview(self, stmt: A.DropMatview) -> Result:
+        from opentenbase_tpu.matview.defs import STATE_TABLE
+
+        c = self.cluster
+        d = c.matviews.get(stmt.name)
+        if d is None:
+            if stmt.if_exists:
+                return Result("DROP MATERIALIZED VIEW")
+            raise SQLError(
+                f'materialized view "{stmt.name}" does not exist',
+                "42P01",
+            )
+        if self.txn is not None:
+            # the catalog/table drop is not transactional (a ROLLBACK
+            # could not restore it) — refuse, as CREATE/REFRESH do
+            raise SQLError(
+                "DROP MATERIALIZED VIEW cannot run inside a "
+                "transaction block",
+                "25001",
+            )
+        deps = self._dependent_views(stmt.name)
+        mv_deps = self._dependent_matviews(stmt.name)
+        if (deps or mv_deps) and not stmt.cascade:
+            what = ", ".join(sorted(deps + mv_deps))
+            raise SQLError(
+                f'cannot drop materialized view "{stmt.name}": other '
+                f"objects ({what}) depend on it",
+                "2BP01",
+            )
+        if stmt.cascade:
+            self._drop_dependents(stmt.name)
+        c.matviews.pop(stmt.name, None)
+        for tb in (stmt.name, d.aux_table):
+            if c.catalog.has(tb):
+                c.catalog.drop_table(tb)
+                c.drop_table_stores(tb)
+        if c.catalog.has(STATE_TABLE):
+            prev_internal = self._matview_internal
+            self._matview_internal = True
+            try:
+                self._execute_one(A.Delete(
+                    table=STATE_TABLE,
+                    where=A.BinOp(
+                        "=", A.ColumnRef("mv", None),
+                        A.Literal(stmt.name),
+                    ),
+                ))
+            finally:
+                self._matview_internal = prev_internal
+        if c.persistence is not None:
+            c.persistence.log_ddl(
+                {"op": "drop_matview", "name": stmt.name}
+            )
+        return Result("DROP MATERIALIZED VIEW")
 
     def _x_createtableas(self, stmt: A.CreateTableAs) -> Result:
         c = self.cluster
@@ -4832,10 +5261,22 @@ class Session:
     def _x_droptable(self, stmt: A.DropTable) -> Result:
         for name in stmt.names:
             deps = self._dependent_views(name)
+            mv_deps = self._dependent_matviews(name)
+            if (deps or mv_deps) and stmt.cascade:
+                self._drop_dependents(name)
+                deps = self._dependent_views(name)
+                mv_deps = self._dependent_matviews(name)
             if deps:
                 raise SQLError(
                     f'cannot drop table "{name}": view(s) '
-                    f"{', '.join(sorted(deps))} depend on it"
+                    f"{', '.join(sorted(deps))} depend on it",
+                    "2BP01",
+                )
+            if mv_deps:
+                raise SQLError(
+                    f'cannot drop table "{name}": materialized '
+                    f"view(s) {', '.join(mv_deps)} depend on it",
+                    "2BP01",
                 )
             if not self.cluster.catalog.has(name):
                 if stmt.if_exists:
@@ -4860,6 +5301,7 @@ class Session:
                 self.cluster.persistence.log_ddl(
                     {"op": "truncate", "name": name}
                 )
+        self.cluster.bump_table_versions(stmt.names)
         return Result("TRUNCATE TABLE")
 
     def _x_createuser(self, stmt: A.CreateUser) -> Result:
@@ -5551,10 +5993,23 @@ class Session:
         removed = 0
         for name in names:
             meta = self.cluster.catalog.get(name)
+            # matview delta horizon: the incremental refresh resolves
+            # deleted rows against their dead versions, so a base
+            # table's dead rows newer than any dependent incremental
+            # matview's last refresh snapshot must survive (the slot-
+            # horizon rule logical replication already pins above)
+            t_oldest = oldest
+            for d in self.cluster.matviews.values():
+                if (
+                    d.wants_incremental()
+                    and name in d.base_tables
+                    and d.last_refresh_ts
+                ):
+                    t_oldest = min(t_oldest, d.last_refresh_ts)
             for n in meta.node_indices:
                 store = self.cluster.stores[n].get(name)
                 if store is not None:
-                    removed += store.vacuum(oldest)
+                    removed += store.vacuum(t_oldest)
         # vacuum compaction renumbers rows, invalidating WAL row indices:
         # take a checkpoint so redo starts from the compacted state
         if removed and self.cluster.persistence is not None:
@@ -6074,6 +6529,59 @@ def _sv_views(c: Cluster):
     return [(name, text) for name, (_q, text) in c.views.items()]
 
 
+def _sv_matviews(c: Cluster):
+    """pg_matviews: every materialized view's definition, distribution,
+    effective maintenance mode, and serving-path freshness."""
+    from opentenbase_tpu.matview.defs import is_fresh
+
+    rows = []
+    for name, d in c.matviews.items():
+        strategy = ""
+        if c.catalog.has(name):
+            strategy = c.catalog.get(name).dist.strategy.value
+        rows.append((
+            name,
+            d.text,
+            bool(d.wants_incremental()),
+            strategy,
+            bool(is_fresh(c, d)),
+            int(d.last_refresh_lsn),
+        ))
+    return rows
+
+
+def _sv_matview_stats(c: Cluster):
+    """pg_stat_matview: refresh counters (incremental vs full, delta
+    rows consumed), serving-path rewrite hits, and last-refresh
+    latency/LSN — the evidence that the delta path actually ran."""
+    rows = []
+    snap = c.gts.snapshot_ts()
+    for name, d in c.matviews.items():
+        live = 0
+        if c.catalog.has(name):
+            meta = c.catalog.get(name)
+            for n in meta.node_indices:
+                store = c.stores.get(n, {}).get(name)
+                if store is None:
+                    continue
+                live += len(store.live_index(snap))
+                if meta.dist.is_replicated:
+                    break
+        st = d.stats
+        rows.append((
+            name,
+            live,
+            int(st.get("incremental_refreshes", 0)),
+            int(st.get("full_refreshes", 0)),
+            int(st.get("deltas_applied", 0)),
+            int(st.get("rewrites", 0)),
+            float(st.get("last_refresh_ms", 0.0)),
+            int(d.last_refresh_lsn),
+            st.get("last_mode", "") or "",
+        ))
+    return rows
+
+
 _SYSTEM_VIEWS: dict[str, tuple] = {
     "pg_proc": (
         {
@@ -6137,6 +6645,31 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
     "pg_views": (
         {"viewname": t.TEXT, "definition": t.TEXT},
         _sv_views,
+    ),
+    "pg_matviews": (
+        {
+            "matviewname": t.TEXT,
+            "definition": t.TEXT,
+            "incremental": t.BOOL,
+            "strategy": t.TEXT,
+            "is_fresh": t.BOOL,
+            "last_refresh_lsn": t.INT8,
+        },
+        _sv_matviews,
+    ),
+    "pg_stat_matview": (
+        {
+            "matviewname": t.TEXT,
+            "n_rows": t.INT8,
+            "incremental_refreshes": t.INT8,
+            "full_refreshes": t.INT8,
+            "deltas_applied": t.INT8,
+            "rewrites": t.INT8,
+            "last_refresh_ms": t.FLOAT8,
+            "last_refresh_lsn": t.INT8,
+            "last_mode": t.TEXT,
+        },
+        _sv_matview_stats,
     ),
     "pg_stat_memory": (
         {

@@ -404,6 +404,35 @@ class DropView(Statement):
 
 
 @dataclass
+class CreateMatview(Statement):
+    """CREATE MATERIALIZED VIEW name [WITH (distribute = ...,
+    incremental = on|off)] AS select — matview.c's DDL surface plus
+    the incremental-maintenance and distribution knobs (matview/)."""
+
+    name: str
+    query: "Select"
+    text: str = ""  # verbatim body source (durable definition)
+    options: dict = field(default_factory=dict)
+    if_not_exists: bool = False
+
+
+@dataclass
+class RefreshMatview(Statement):
+    """REFRESH MATERIALIZED VIEW [CONCURRENTLY] name (matview.c's
+    ExecRefreshMatView; CONCURRENTLY overlaps readers)."""
+
+    name: str
+    concurrently: bool = False
+
+
+@dataclass
+class DropMatview(Statement):
+    name: str
+    if_exists: bool = False
+    cascade: bool = False
+
+
+@dataclass
 class CreateTableAs(Statement):
     name: str
     query: "Select"
@@ -429,6 +458,9 @@ class AlterTable(Statement):
 class DropTable(Statement):
     names: list[str]
     if_exists: bool = False
+    # CASCADE drops dependent views/materialized views instead of
+    # refusing with SQLSTATE 2BP01 (dependent_objects_still_exist)
+    cascade: bool = False
 
 
 @dataclass

@@ -45,6 +45,33 @@ def deparse(stmt: A.Statement) -> str:
     if isinstance(stmt, A.Delete):
         where = f" where {_expr(stmt.where)}" if stmt.where else ""
         return f"delete from {stmt.table}{where}{_returning(stmt)}"
+    if isinstance(stmt, A.CreateMatview):
+        ine = " if not exists" if stmt.if_not_exists else ""
+        opts = []
+        if stmt.options.get("distribute"):
+            strat = stmt.options["distribute"]
+            keys = stmt.options.get("distribute_keys") or []
+            opts.append(
+                "distribute = " + strat
+                + (f"({', '.join(keys)})" if keys else "")
+            )
+        if "incremental" in stmt.options:
+            opts.append(
+                "incremental = "
+                + ("on" if stmt.options["incremental"] else "off")
+            )
+        with_clause = f" with ({', '.join(opts)})" if opts else ""
+        return (
+            f"create materialized view{ine} {stmt.name}{with_clause} "
+            f"as {deparse_select(stmt.query)}"
+        )
+    if isinstance(stmt, A.RefreshMatview):
+        conc = " concurrently" if stmt.concurrently else ""
+        return f"refresh materialized view{conc} {stmt.name}"
+    if isinstance(stmt, A.DropMatview):
+        ie = " if exists" if stmt.if_exists else ""
+        casc = " cascade" if stmt.cascade else ""
+        return f"drop materialized view{ie} {stmt.name}{casc}"
     raise DeparseError(f"cannot deparse {type(stmt).__name__}")
 
 

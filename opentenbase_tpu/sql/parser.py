@@ -235,6 +235,14 @@ class Parser:
             return self.parse_delete()
         if kw == "create":
             return self.parse_create()
+        if kw == "refresh":
+            self.advance()
+            self.expect_kw("materialized")
+            self.expect_kw("view")
+            concurrently = bool(self.eat_kw("concurrently"))
+            return A.RefreshMatview(
+                self.ident("materialized view name"), concurrently
+            )
         if kw == "drop":
             return self.parse_drop()
         if kw == "truncate":
@@ -1066,6 +1074,9 @@ class Parser:
             return self._create_view(replace=True)
         if self.eat_kw("function"):
             return self._create_function(replace=False)
+        if self.eat_kw("materialized"):
+            self.expect_kw("view")
+            return self._create_matview()
         if self.eat_kw("view"):
             return self._create_view(replace=False)
         if self.eat_kw("table"):
@@ -1507,6 +1518,55 @@ class Parser:
         self.expect_op(")")
         return options
 
+    def _create_matview(self) -> A.Statement:
+        # CREATE MATERIALIZED VIEW name [WITH (distribute = shard(k) |
+        # replication | roundrobin, incremental = on|off)] AS select —
+        # the body's source text is captured verbatim (the durable
+        # definition, as for CREATE VIEW)
+        if_not_exists = bool(self.eat_kw("if", "not", "exists"))
+        name = self.ident("materialized view name")
+        options: dict = {}
+        if self.at_kw("with"):
+            options = self._matview_options()
+        self.expect_kw("as")
+        start = self.cur.pos
+        query = self.parse_select()
+        end = self.cur.pos if self.cur.kind != Tok.EOF else len(self.sql)
+        text = self.sql[start:end].strip().rstrip(";").strip()
+        return A.CreateMatview(name, query, text, options, if_not_exists)
+
+    def _matview_options(self) -> dict:
+        """WITH (distribute = strategy[(cols)], incremental = on|off)
+        of matview DDL; '=' is optional, as in reloptions lists."""
+        self.expect_kw("with")
+        self.expect_op("(")
+        options: dict = {}
+        while not self.at_op(")"):
+            key = self.ident("materialized view option")
+            self.eat_op("=")
+            if key == "distribute":
+                strat = self.ident("distribution strategy")
+                options["distribute"] = strat
+                keys: list[str] = []
+                if self.eat_op("("):
+                    keys.append(self.ident("column"))
+                    while self.eat_op(","):
+                        keys.append(self.ident("column"))
+                    self.expect_op(")")
+                options["distribute_keys"] = keys
+            elif key == "incremental":
+                if self.cur.kind not in (Tok.IDENT, Tok.NUMBER):
+                    self.error("expected on or off for incremental")
+                v = str(self.advance().value).lower()
+                options["incremental"] = v in ("on", "true", "yes", "1")
+            else:
+                self.error(
+                    f"unknown materialized view option {key!r}"
+                )
+            self.eat_op(",")
+        self.expect_op(")")
+        return options
+
     def _create_view(self, replace: bool) -> A.Statement:
         # CREATE [OR REPLACE] VIEW name AS select  (view.c); the body's
         # source text is captured verbatim so the definition is durable
@@ -1550,6 +1610,13 @@ class Parser:
 
     def parse_drop(self) -> A.Statement:
         self.expect_kw("drop")
+        if self.eat_kw("materialized"):
+            self.expect_kw("view")
+            if_exists = bool(self.eat_kw("if", "exists"))
+            name = self.ident("materialized view name")
+            cascade = bool(self.eat_kw("cascade"))
+            self.eat_kw("restrict")
+            return A.DropMatview(name, if_exists, cascade)
         if self.eat_kw("view"):
             if_exists = bool(self.eat_kw("if", "exists"))
             return A.DropView(self.ident("view name"), if_exists)
@@ -1558,7 +1625,9 @@ class Parser:
             names = [self.ident("table name")]
             while self.eat_op(","):
                 names.append(self.ident("table name"))
-            return A.DropTable(names, if_exists)
+            cascade = bool(self.eat_kw("cascade"))
+            self.eat_kw("restrict")
+            return A.DropTable(names, if_exists, cascade)
         if self.eat_kw("node"):
             if self.eat_kw("group"):
                 return A.DropNodeGroup(self.ident("group name"))

@@ -44,6 +44,37 @@ import numpy as np
 # ---------------------------------------------------------------------------
 
 
+def _frame_self_deletes(header: dict, arrays) -> set:
+    """Frame-local compaction, shared by BOTH delta consumers (the
+    publication decoder and matview incremental maintenance): an
+    insert-then-update/delete txn both inserts a row version and
+    deletes it IN THE SAME FRAME (by its rowid). Such self-deleted
+    versions must never surface on either side of a delta — shipping
+    them reordered would resurrect the old version or trip the
+    subscriber's PK check. Keys are (node, table, rowid); rowids are
+    per-(node, table) stable ids. ``kind: "dict"`` sub-records
+    (dictionary deltas riding shipped-DML frames) are skipped."""
+    self_del: set[tuple] = set()
+    ins_ranges: dict[tuple, list[tuple[int, int]]] = {}
+    for i, wm in enumerate(header["writes"]):
+        if wm.get("kind") == "dict":
+            continue
+        key = (wm["node"], wm["table"])
+        if wm["kind"] == "ins":
+            rid0 = wm["row_id_start"]
+            ins_ranges.setdefault(key, []).append(
+                (rid0, rid0 + wm["nrows"])
+            )
+        else:
+            for rid in np.asarray(arrays[f"w{i}_del"]).tolist():
+                if any(
+                    lo <= rid < hi
+                    for lo, hi in ins_ranges.get(key, ())
+                ):
+                    self_del.add((*key, rid))
+    return self_del
+
+
 def decode_changes(
     cluster, pub: dict, from_off: int, limit_frames: int = 200
 ) -> tuple[int, list[dict]]:
@@ -71,28 +102,7 @@ def decode_changes(
             if len(frames) >= limit_frames:
                 break
             continue
-        # Frame-local compaction: an insert-then-update/delete txn both
-        # inserts a row version and deletes it IN THE SAME FRAME (by its
-        # rowid). Such self-deleted versions must never reach the
-        # subscriber — shipping them reordered would either resurrect the
-        # old version or trip the subscriber's PK check. Keys are
-        # (node, table, rowid); rowids are per-(node, table) stable ids.
-        self_del: set[tuple] = set()
-        ins_ranges: dict[tuple, list[tuple[int, int]]] = {}
-        for i, wm in enumerate(header["writes"]):
-            key = (wm["node"], wm["table"])
-            if wm["kind"] == "ins":
-                rid0 = wm["row_id_start"]
-                ins_ranges.setdefault(key, []).append(
-                    (rid0, rid0 + wm["nrows"])
-                )
-            else:
-                for rid in np.asarray(arrays[f"w{i}_del"]).tolist():
-                    if any(
-                        lo <= rid < hi
-                        for lo, hi in ins_ranges.get(key, ())
-                    ):
-                        self_del.add((*key, rid))
+        self_del = _frame_self_deletes(header, arrays)
         changes: list[dict] = []
         for i, wm in enumerate(header["writes"]):
             table = wm["table"]
@@ -173,6 +183,106 @@ def _resolve_deleted_rows(cluster, tm, node: int, rowids) -> list[dict]:
     return [
         {c: data[c][r] for c in data} for r in range(len(pos))
     ]
+
+
+def decode_table_deltas(
+    cluster, table: str, from_off: int, upto: Optional[int] = None
+) -> tuple[list[dict], list[dict], bool]:
+    """Row-level deltas of ONE table from committed 'G' frames in
+    ``(from_off .. upto]`` — the matview incremental-maintenance feed.
+    Returns (ins_rows, del_rows, complete); ``complete`` is False when
+    a delete's old tuple was already vacuumed away (the delta stream
+    is unrecoverable there and the caller must fall back to a full
+    recompute — never silently under-apply deletes, which the
+    publication decoder is allowed to do but IVM is not)."""
+    from opentenbase_tpu.matview.defs import CONTENT_DDL_OPS
+    from opentenbase_tpu.storage.column import Column
+    from opentenbase_tpu.storage.persist import WAL
+    from opentenbase_tpu.storage.table import ColumnBatch
+
+    p = cluster.persistence
+    if p is None:
+        raise ValueError(
+            "incremental maintenance requires a durable cluster "
+            "(data_dir)"
+        )
+    if not cluster.catalog.has(table):
+        return [], [], False
+    tm = cluster.catalog.get(table)
+    ins_rows: list[dict] = []
+    del_rows: list[dict] = []
+    for tag, header, arrays, off in WAL.read_records(
+        p.wal.path, start=from_off
+    ):
+        if upto is not None and off > upto:
+            break
+        if tag == "D" and header.get("name") == table and (
+            header.get("op") in CONTENT_DDL_OPS
+        ):
+            # content/row-id-rewriting DDL leaves no 'G' frames (and
+            # redistribution renumbers the stable row ids old delete
+            # frames reference): the delta stream breaks here — the
+            # caller must full-recompute
+            return [], [], False
+        if tag == "T" and any(
+            wm.get("table") == table
+            for wm in header.get("writes", ())
+        ):
+            # explicitly-PREPAREd writes commit later as a compact 'C'
+            # decision with no row frame — row-accurate delta replay
+            # across the 2PC split is not worth the bookkeeping, so
+            # the stream breaks (full recompute)
+            return [], [], False
+        if tag == "C":
+            # a commit decision for a 'T' record that may predate this
+            # window (tables unknown from the 'C' alone): conservative
+            # break
+            return [], [], False
+        if tag != "G":
+            continue
+        self_del = _frame_self_deletes(header, arrays)
+        for i, wm in enumerate(header["writes"]):
+            if wm.get("kind") == "dict" or wm["table"] != table:
+                continue
+            if tm.dist.is_replicated and wm["node"] != min(
+                tm.node_indices
+            ):
+                continue  # one copy is the logical truth
+            if wm["kind"] == "ins":
+                cols = {}
+                for colname, ty in tm.schema.items():
+                    k = f"w{i}_{colname}"
+                    if k not in arrays:
+                        continue  # column added after this frame
+                    cols[colname] = Column(
+                        ty, arrays[k], arrays.get(f"w{i}__v_{colname}"),
+                        tm.dictionaries.get(colname),
+                    )
+                if not cols:
+                    continue
+                data = ColumnBatch(cols, wm["nrows"]).to_pydict()
+                rid0 = wm["row_id_start"]
+                for r in range(wm["nrows"]):
+                    if (wm["node"], table, rid0 + r) in self_del:
+                        continue
+                    row = {c: data[c][r] for c in data}
+                    for c in tm.schema:
+                        row.setdefault(c, None)
+                    ins_rows.append(row)
+            else:
+                rowids = [
+                    rid
+                    for rid in np.asarray(arrays[f"w{i}_del"]).tolist()
+                    if (wm["node"], table, rid) not in self_del
+                ]
+                rows = _resolve_deleted_rows(
+                    cluster, tm, wm["node"], rowids
+                )
+                if len(rows) < len(rowids):
+                    # vacuum reclaimed a dead version the delta needs
+                    return [], [], False
+                del_rows.extend(rows)
+    return ins_rows, del_rows, True
 
 
 # ---------------------------------------------------------------------------

@@ -384,6 +384,17 @@ class ClusterPersistence:
                 name: ps.spec for name, ps in c.partitions.items()
             },
             "views": {name: text for name, (_q, text) in c.views.items()},
+            # matview defs ride the checkpoint (the backing + aux
+            # tables are already in "tables"); refresh state lives in
+            # the otb_matview_state table and needs nothing extra here
+            "matviews": {
+                name: {
+                    "text": d.text,
+                    "options": dict(d.options),
+                    "aux_schema": d.aux_schema,
+                }
+                for name, d in c.matviews.items()
+            },
             "users": c.users,
             "wlm": c.wlm.dump_state(),
         }
@@ -676,6 +687,14 @@ class ClusterPersistence:
 
         for name, text in meta.get("views", {}).items():
             c.views[name] = (Parser(text).parse_select(), text)
+        if meta.get("matviews"):
+            from opentenbase_tpu.matview.defs import register
+
+            for name, mrec in meta["matviews"].items():
+                register(
+                    c, name, mrec["text"], mrec.get("options") or {},
+                    aux_schema=mrec.get("aux_schema"),
+                )
         from opentenbase_tpu.plan.partition import PartitionSpec
 
         for name, pclause in meta.get("partitions", {}).items():
@@ -789,6 +808,7 @@ class ClusterPersistence:
                         c.stores[n][header["name"]] = ShardStore(
                             meta.schema, meta.dictionaries
                         )
+                    c.bump_table_versions({header["name"]})
             elif op == "create_view":
                 from opentenbase_tpu.sql.parser import Parser
 
@@ -797,6 +817,48 @@ class ClusterPersistence:
                 )
             elif op == "drop_view":
                 c.views.pop(header["name"], None)
+            elif op == "create_matview":
+                if header["name"] not in c.matviews:
+                    if not c.catalog.has(header["name"]):
+                        schema = {
+                            k: _type_from_str(v)
+                            for k, v in header["schema"].items()
+                        }
+                        spec = DistributionSpec(
+                            DistStrategy(header["strategy"]),
+                            tuple(header["key_columns"]),
+                        )
+                        m = c.catalog.create_table(
+                            header["name"], schema, spec
+                        )
+                        c.create_table_stores(m)
+                    aux = header.get("aux_schema")
+                    aux_name = f"{header['name']}$aux"
+                    if aux and not c.catalog.has(aux_name):
+                        am = c.catalog.create_table(
+                            aux_name,
+                            {
+                                k: _type_from_str(v)
+                                for k, v in aux.items()
+                            },
+                            DistributionSpec(DistStrategy.ROUNDROBIN),
+                        )
+                        c.create_table_stores(am)
+                    from opentenbase_tpu.matview.defs import register
+
+                    register(
+                        c, header["name"], header["text"],
+                        header.get("options") or {},
+                        aux_schema=aux,
+                    )
+            elif op == "drop_matview":
+                c.matviews.pop(header["name"], None)
+                for tb in (
+                    header["name"], f"{header['name']}$aux"
+                ):
+                    if c.catalog.has(tb):
+                        c.catalog.drop_table(tb)
+                        c.drop_table_stores(tb)
             elif op == "add_column":
                 if c.catalog.has(header["name"]):
                     c.alter_add_column(
@@ -960,6 +1022,7 @@ class ClusterPersistence:
                         np.isin(store.row_id[: store.nrows], wm["rowids"])
                     )[0]
                     store.stamp_xmax(pos, header["commit_ts"])
+            c.bump_table_versions({wm["table"] for wm in writes})
             return
         if tag == "T":  # PREPARE TRANSACTION: materialize pending writes
             from opentenbase_tpu.storage.table import PENDING_TS
@@ -997,6 +1060,10 @@ class ClusterPersistence:
                         res = pos[store.xmax_ts[pos] == RESERVED_TS]
                         if len(res):
                             store.unstamp_xmax(res)
+            if tag == "C":
+                c.bump_table_versions(
+                    {wm["table"] for wm in pend["writes"]}
+                )
             return
 
     def _apply_dict_delta(self, wm: dict) -> None:

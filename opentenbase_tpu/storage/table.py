@@ -312,6 +312,16 @@ class ShardStore:
         self._pins -= 1
 
     # -- vacuum ---------------------------------------------------------
+    def live_index(self, snapshot_ts: int) -> np.ndarray:
+        """Positions of rows visible at ``snapshot_ts`` (the MVCC
+        visibility predicate xmin <= snap < xmax) — the ONE helper for
+        host-side direct store reads (system views, matview state)."""
+        n = self.nrows
+        return np.nonzero(
+            (self.xmin_ts[:n] <= snapshot_ts)
+            & (snapshot_ts < self.xmax_ts[:n])
+        )[0]
+
     def vacuum(self, oldest_ts: int) -> int:
         """Reclaim rows deleted before every live snapshot (shard_vacuum.c
         equivalent, src/backend/pgxc/shard/shard_vacuum.c). Returns rows

@@ -64,6 +64,11 @@ def estimate_statement_memory(stmt, catalog) -> int:
     """
     from opentenbase_tpu.sql import ast as A
 
+    if isinstance(stmt, A.CreateMatview) and isinstance(
+        stmt.query, A.Select
+    ):
+        # matview population is its defining query's read
+        stmt = stmt.query
     if isinstance(stmt, A.Select):
         try:
             from opentenbase_tpu.plan import analyze_statement

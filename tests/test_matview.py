@@ -1,0 +1,726 @@
+"""Materialized views (matview/): DDL, incremental-vs-full differential
+over randomized DML, crash recovery of the matview catalog +
+last_refresh_lsn, CONCURRENTLY under a concurrent reader, serving-path
+rewrite gating, and dependent-object protection (SQLSTATE 2BP01) on
+both wire protocols.
+
+Most tests share ONE durable module cluster (each on its own tables /
+matview names — fingerprints are exact, so distinct defining queries
+never cross-serve); crash recovery and the non-durable fallback get
+their own clusters.
+"""
+
+import random
+import struct
+import threading
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster, SQLError
+
+
+@pytest.fixture(scope="module")
+def cl(tmp_path_factory):
+    c = Cluster(
+        num_datanodes=2, shard_groups=16,
+        data_dir=str(tmp_path_factory.mktemp("mvdata")),
+    )
+    yield c
+    c.close()
+
+
+@pytest.fixture(scope="module")
+def sess(cl):
+    s = cl.session()
+    # the fused device path XLA-compiles every novel plan shape —
+    # irrelevant to matview semantics (test_fused* covers it) and the
+    # dominant cost of this module's many one-off queries
+    s.execute("set enable_fused_execution = off")
+    s.execute(
+        "create table fact (k bigint, grp text, v bigint, w float8) "
+        "distribute by shard(k)"
+    )
+    s.execute(
+        "insert into fact values "
+        "(1,'a',10,1.5),(2,'b',20,2.5),(3,'a',30,3.5),"
+        "(4,'b',40,4.5),(5,'c',null,5.5),(6,'a',60,6.5)"
+    )
+    return s
+
+
+AGG_Q = (
+    "select grp, count(*) as n, count(v) as nv, sum(v) as s, "
+    "avg(v) as a from fact group by grp"
+)
+
+
+def _oracle(s, q):
+    s.execute("set enable_matview_rewrite = off")
+    try:
+        return sorted(s.query(q))
+    finally:
+        s.execute("set enable_matview_rewrite = on")
+
+
+def _mv_rows(s, name):
+    return _oracle(s, f"select * from {name}")
+
+
+def _stat(s, name, cols):
+    return s.query(
+        f"select {cols} from pg_stat_matview "
+        f"where matviewname = '{name}'"
+    )
+
+
+# ---------------------------------------------------------------------------
+# basics: DDL, population, serving-path rewrite
+# ---------------------------------------------------------------------------
+
+
+def test_create_populates_and_serves(sess):
+    sess.execute(f"create materialized view agg as {AGG_Q}")
+    assert _mv_rows(sess, "agg") == _oracle(sess, AGG_Q)
+    assert sess.query(
+        "select matviewname, incremental, is_fresh from pg_matviews "
+        "where matviewname = 'agg'"
+    ) == [("agg", True, True)]
+    (defn,) = sess.query(
+        "select definition from pg_matviews where matviewname = 'agg'"
+    )[0]
+    assert "group by grp" in defn
+
+
+def test_rewrite_explain_on_off_stale(sess):
+    def explained():
+        return [r[0] for r in sess.query(f"explain {AGG_Q}")]
+
+    # fresh + GUC on: EXPLAIN shows the rewrite over a matview scan
+    lines = explained()
+    assert any("Matview rewrite" in ln for ln in lines), lines
+    assert any("Scan on agg" in ln for ln in lines), lines
+    # the served query returns the same rows as the real computation
+    assert sorted(sess.query(AGG_Q)) == _oracle(sess, AGG_Q)
+    assert _stat(sess, "agg", "rewrites")[0][0] >= 2
+    # GUC off: no rewrite
+    sess.execute("set enable_matview_rewrite = off")
+    lines = [r[0] for r in sess.query(f"explain {AGG_Q}")]
+    assert not any("Matview rewrite" in ln for ln in lines)
+    sess.execute("set enable_matview_rewrite = on")
+    # stale (base write since refresh): no rewrite until REFRESH
+    sess.execute("insert into fact values (7,'a',70,7.0)")
+    assert not any("Matview rewrite" in ln for ln in explained())
+    assert sess.query(
+        "select is_fresh from pg_matviews where matviewname = 'agg'"
+    ) == [(False,)]
+    sess.execute("refresh materialized view agg")
+    assert any("Matview rewrite" in ln for ln in explained())
+    # EXPLAIN ANALYZE executes the rewritten scan
+    lines = [r[0] for r in sess.query(f"explain analyze {AGG_Q}")]
+    assert any("Matview rewrite" in ln for ln in lines), lines
+    assert any("Total: rows=" in ln for ln in lines), lines
+
+
+def test_rewrite_skipped_for_own_uncommitted_writes(sess):
+    """Inside a transaction that wrote a base table, the rewrite must
+    NOT serve the matview: the txn's own (uncommitted) writes are
+    invisible to it, and MVCC says the session sees its own writes."""
+    sess.execute("refresh materialized view agg")
+    sess.execute("begin")
+    try:
+        sess.execute("insert into fact values (777,'zz',7,0.5)")
+        got = sorted(sess.query(AGG_Q))  # rewrite GUC is on
+        assert any(r[0] == "zz" for r in got), got
+        sess.execute("set enable_matview_rewrite = off")
+        want = sorted(sess.query(AGG_Q))
+        sess.execute("set enable_matview_rewrite = on")
+        assert got == want
+    finally:
+        sess.execute("rollback")
+
+
+def test_with_options_distribute_and_incremental_off(sess):
+    sess.execute(
+        "create materialized view aggrep with "
+        "(distribute = replication, incremental = off) as "
+        "select grp, sum(w) as sw from fact group by grp"
+    )
+    assert sess.query(
+        "select strategy, incremental from pg_matviews "
+        "where matviewname = 'aggrep'"
+    ) == [("replicated", False)]
+    sess.execute("insert into fact values (8,'d',80,1.0)")
+    sess.execute("refresh materialized view aggrep")
+    assert _stat(
+        sess, "aggrep",
+        "incremental_refreshes, full_refreshes, last_mode",
+    ) == [(0, 1, "full")]
+    assert _mv_rows(sess, "aggrep") == _oracle(
+        sess, "select grp, sum(w) as sw from fact group by grp"
+    )
+    sess.execute("drop materialized view aggrep")
+
+
+def test_unsupported_shape_degrades_to_full(sess):
+    sess.execute("create table dim (grp text, label text) "
+                 "distribute by replication")
+    sess.execute("insert into dim values ('a','alpha'),('b','beta')")
+    q = (
+        "select d.label, count(*) as n from fact f "
+        "join dim d on f.grp = d.grp group by d.label"
+    )
+    sess.execute(f"create materialized view j as {q}")
+    assert sess.query(
+        "select incremental from pg_matviews where matviewname = 'j'"
+    ) == [(False,)]
+    sess.execute("insert into fact values (9,'b',90,9.0)")
+    sess.execute("refresh materialized view j")
+    assert _stat(sess, "j", "last_mode") == [("full",)]
+    assert _mv_rows(sess, "j") == _oracle(sess, q)
+    sess.execute("drop materialized view j")
+    sess.execute("drop table dim")
+
+
+# ---------------------------------------------------------------------------
+# THE differential: incremental REFRESH == recompute from scratch over
+# randomized interleaved DML, for every supported shape at once — and
+# the delta path provably ran (incremental_refreshes counts, no silent
+# full fallback)
+# ---------------------------------------------------------------------------
+
+DIFF_ROUNDS = 3
+
+DIFF_SHAPES = {
+    "d_agg": (
+        "select g, count(*) as n, count(v) as nv, sum(v) as s, "
+        "avg(v) as a from dfact group by g"
+    ),
+    "d_mm": (
+        "select g, min(v) as lo, max(v) as hi, count(*) as n "
+        "from dfact group by g"
+    ),
+    "d_proj": "select k, g, v from dfact where v > 15",
+}
+
+
+def _random_dml_round(s, rng, next_key):
+    for _ in range(rng.randint(2, 4)):
+        op = rng.random()
+        if op < 0.45:
+            rows = ", ".join(
+                "({}, '{}', {})".format(
+                    next_key[0] + i,
+                    rng.choice("abcdefg"),
+                    rng.choice(["null", str(rng.randint(-50, 100))]),
+                )
+                for i in range(rng.randint(1, 4))
+            )
+            next_key[0] += 4
+            s.execute(f"insert into dfact values {rows}")
+        elif op < 0.75:
+            s.execute(
+                f"delete from dfact where k = {rng.randint(1, next_key[0])}"
+            )
+        else:
+            v = rng.choice(["null", str(rng.randint(-50, 100))])
+            s.execute(
+                f"update dfact set v = {v} "
+                f"where k = {rng.randint(1, next_key[0])}"
+            )
+
+
+def test_incremental_differential_randomized(sess):
+    rng = random.Random(20260803)
+    sess.execute(
+        "create table dfact (k bigint, g text, v bigint) "
+        "distribute by shard(k)"
+    )
+    sess.execute(
+        "insert into dfact values (1,'a',10),(2,'b',20),(3,'a',30),"
+        "(4,'b',null),(5,'c',50),(6,'a',60)"
+    )
+    for name, q in DIFF_SHAPES.items():
+        sess.execute(f"create materialized view {name} as {q}")
+    next_key = [7]
+    rounds = DIFF_ROUNDS
+    for rnd in range(rounds):
+        _random_dml_round(sess, rng, next_key)
+        for name, q in DIFF_SHAPES.items():
+            sess.execute(f"refresh materialized view {name}")
+            assert _mv_rows(sess, name) == _oracle(sess, q), (
+                f"{name} diverged in round {rnd}"
+            )
+    for name in DIFF_SHAPES:
+        incr, full = _stat(
+            sess, name, "incremental_refreshes, full_refreshes"
+        )[0]
+        # every refresh took the delta path — no silent full fallback
+        assert (incr, full) == (rounds, 0), (name, incr, full)
+
+
+def test_refresh_with_no_deltas_counts_incremental(sess):
+    # dfact untouched since the differential's last refreshes
+    sess.execute("refresh materialized view d_agg")
+    incr, full, deltas_mode = None, None, None
+    incr, full = _stat(
+        sess, "d_agg", "incremental_refreshes, full_refreshes"
+    )[0]
+    assert (incr, full) == (DIFF_ROUNDS + 1, 0)
+    assert _stat(sess, "d_agg", "last_mode") == [("incremental",)]
+
+
+def test_vacuumed_deltas_fall_back_to_full_loudly(sess, cl):
+    """When vacuum reclaims a dead version the delta stream needs, the
+    refresh must degrade to a FULL recompute and count it — never
+    silently under-apply deletes."""
+    # a row that provably exists and is folded into the matview …
+    sess.execute("insert into dfact values (5000,'vv',77)")
+    sess.execute("refresh materialized view d_agg")
+    # … then dies, and its dead version is vacuumed away before the
+    # delta is consumed
+    sess.execute("delete from dfact where k = 5000")
+    # defeat the matview vacuum horizon of EVERY dependent matview
+    # (any one of them would otherwise pin the dead version)
+    saved = {
+        nm: cl.matviews[nm].last_refresh_ts for nm in DIFF_SHAPES
+    }
+    for nm in DIFF_SHAPES:
+        cl.matviews[nm].last_refresh_ts = 0
+    try:
+        assert sess.execute("vacuum dfact").rowcount > 0
+    finally:
+        for nm, ts in saved.items():
+            cl.matviews[nm].last_refresh_ts = ts
+    sess.execute("refresh materialized view d_agg")
+    assert _stat(sess, "d_agg", "full_refreshes, last_mode") == [
+        (1, "full")
+    ]
+    assert _mv_rows(sess, "d_agg") == _oracle(
+        sess, DIFF_SHAPES["d_agg"]
+    )
+    # resync the siblings (their pending deltas were vacuumed too)
+    sess.execute("refresh materialized view d_mm")
+    sess.execute("refresh materialized view d_proj")
+    # ...and the next refresh goes back to the delta path
+    sess.execute("insert into dfact values (999,'a',1)")
+    sess.execute("refresh materialized view d_agg")
+    assert _stat(sess, "d_agg", "last_mode") == [("incremental",)]
+
+
+def test_truncate_and_alter_break_the_delta_stream(sess):
+    """TRUNCATE / ALTER TABLE leave no 'G' frames (and redistribution
+    renumbers row ids): the next refresh must detect the break and
+    full-recompute — never serve pre-truncate rows as current."""
+    sess.execute("create table tb (k bigint, v bigint) "
+                 "distribute by shard(k)")
+    sess.execute("insert into tb values (1,10),(2,20)")
+    sess.execute(
+        "create materialized view tbmv as select k, v from tb "
+        "where v > 5"
+    )
+    sess.execute("truncate table tb")
+    sess.execute("insert into tb values (9,90)")
+    sess.execute("refresh materialized view tbmv")
+    assert _stat(sess, "tbmv", "last_mode") == [("full",)]
+    assert _mv_rows(sess, "tbmv") == [(9, 90)]
+    sess.execute("alter table tb add column w bigint")
+    sess.execute("insert into tb values (10,100,1)")
+    sess.execute("refresh materialized view tbmv")
+    assert _mv_rows(sess, "tbmv") == [(9, 90), (10, 100)]
+    sess.execute("drop materialized view tbmv")
+    sess.execute("drop table tb")
+
+
+def test_two_phase_commit_breaks_the_delta_stream(sess, cl):
+    """Explicitly-PREPAREd writes are WAL-logged as 'T'+'C' records
+    with no row frame: the refresh must detect them and full-recompute
+    — never count an 'incremental' success that dropped the rows."""
+    sess.execute("create table pb (k bigint, v bigint) "
+                 "distribute by shard(k)")
+    sess.execute("insert into pb values (1,10),(2,20)")
+    sess.execute(
+        "create materialized view pbmv as "
+        "select k, count(*) as n, sum(v) as s from pb group by k"
+    )
+    assert sess.query(
+        "select incremental from pg_matviews "
+        "where matviewname = 'pbmv'"
+    ) == [(True,)]
+    sess.execute("begin")
+    sess.execute("insert into pb values (3,30)")
+    sess.execute("prepare transaction 'mv2pc'")
+    s2 = cl.session()
+    s2.execute("commit prepared 'mv2pc'")
+    # freshness saw the 2PC commit (version bump rides _stamp_commit)
+    assert sess.query(
+        "select is_fresh from pg_matviews where matviewname = 'pbmv'"
+    ) == [(False,)]
+    sess.execute("refresh materialized view pbmv")
+    assert _stat(sess, "pbmv", "last_mode") == [("full",)]
+    assert _mv_rows(sess, "pbmv") == _oracle(
+        sess, "select k, count(*) as n, sum(v) as s from pb group by k"
+    )
+    sess.execute("drop materialized view pbmv")
+    sess.execute("drop table pb")
+
+
+def test_partitioned_base_staleness(sess, cl):
+    """DML against a partitioned parent fans out to child tables; the
+    version bump must reach the PARENT the matview tracks."""
+    sess.execute(
+        "create table pt (k bigint, v bigint) distribute by shard(k) "
+        "partition by range (k) begin (0) step (100) partitions (3)"
+    )
+    sess.execute("insert into pt values (5,50),(150,60)")
+    sess.execute(
+        "create materialized view ptmv as "
+        "select count(*) as n, sum(v) as s from pt"
+    )
+    assert sess.query(
+        "select incremental, is_fresh from pg_matviews "
+        "where matviewname = 'ptmv'"
+    ) == [(False, True)]
+    sess.execute("insert into pt values (250,70)")
+    assert sess.query(
+        "select is_fresh from pg_matviews where matviewname = 'ptmv'"
+    ) == [(False,)]
+    sess.execute("refresh materialized view ptmv")
+    assert _mv_rows(sess, "ptmv") == _oracle(
+        sess, "select count(*) as n, sum(v) as s from pt"
+    )
+    sess.execute("drop materialized view ptmv")
+    sess.execute("drop table pt")
+
+
+def test_state_row_commits_with_contents(sess):
+    rows = sess.query(
+        "select lsn from otb_matview_state where mv = 'd_agg'"
+    )
+    assert rows and rows[0][0] == sess.query(
+        "select last_refresh_lsn from pg_matviews "
+        "where matviewname = 'd_agg'"
+    )[0][0]
+
+
+# ---------------------------------------------------------------------------
+# CONCURRENTLY + transactional/WLM gating
+# ---------------------------------------------------------------------------
+
+
+def test_refresh_concurrently_under_reader(sess, cl):
+    old_n = len(_mv_rows(sess, "d_proj"))
+    sess.execute(
+        "insert into dfact select k + 10000, 'a', 99 from dfact"
+    )
+    new_n = len(_oracle(sess, DIFF_SHAPES["d_proj"]))
+    assert new_n > old_n
+    counts, errs = set(), []
+    stop = threading.Event()
+
+    def reader():
+        rs = cl.session()
+        rs.execute("set enable_matview_rewrite = off")
+        while not stop.is_set():
+            try:
+                counts.add(
+                    rs.query("select count(*) from d_proj")[0][0]
+                )
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+    th = threading.Thread(target=reader)
+    th.start()
+    try:
+        sess.execute("refresh materialized view concurrently d_proj")
+    finally:
+        stop.set()
+        th.join(timeout=10)
+    assert not errs, errs
+    # old contents or new contents — never a half-applied state
+    assert counts <= {old_n, new_n}, (counts, old_n, new_n)
+    assert _mv_rows(sess, "d_proj") == _oracle(
+        sess, DIFF_SHAPES["d_proj"]
+    )
+
+
+def test_refresh_and_create_refused_inside_transaction(sess):
+    sess.execute("begin")
+    try:
+        with pytest.raises(SQLError) as ei:
+            sess.execute("refresh materialized view d_agg")
+        assert ei.value.sqlstate == "25001"
+        # CREATE is equally non-transactional: a rollback would leave
+        # a registered, fresh-marked, EMPTY matview behind
+        with pytest.raises(SQLError) as ei:
+            sess.execute(
+                "create materialized view mtx as select k from dfact"
+            )
+        assert ei.value.sqlstate == "25001"
+        # ...and DROP could not be rolled back either
+        with pytest.raises(SQLError) as ei:
+            sess.execute("drop materialized view d_agg")
+        assert ei.value.sqlstate == "25001"
+    finally:
+        sess.execute("rollback")
+    assert sess.query(
+        "select count(*) from pg_matviews where matviewname = 'd_agg'"
+    ) == [(1,)]
+    assert sess.query(
+        "select count(*) from pg_matviews where matviewname = 'mtx'"
+    ) == [(0,)]
+
+
+def test_matview_over_view_refreshes(sess):
+    """A matview whose defining query reads a VIEW must stay
+    refreshable: the stored raw definition re-expands through the
+    rewrite pipeline at refresh time."""
+    sess.execute(
+        "create view dview as select k, g, v from dfact where v > 0"
+    )
+    sess.execute(
+        "create materialized view dvmv as "
+        "select g, count(*) as n from dview group by g"
+    )
+    sess.execute("insert into dfact values (8000,'vw',5)")
+    sess.execute("refresh materialized view dvmv")
+    assert _mv_rows(sess, "dvmv") == _oracle(
+        sess, "select g, count(*) as n from dview group by g"
+    )
+    sess.execute("drop materialized view dvmv")
+    sess.execute("drop view dview")
+
+
+def test_refresh_goes_through_wlm_admission(sess):
+    """REFRESH is a resource-consuming statement: a memory-capped
+    group sheds it (insufficient-resources SQLSTATE), like any
+    oversized query."""
+    from opentenbase_tpu.wlm.manager import AdmissionError
+
+    sess.execute(
+        "create resource group mvtiny with "
+        "(concurrency=4, memory_limit='1kB', queue_depth=4)"
+    )
+    sess.execute("set resource_group = mvtiny")
+    try:
+        with pytest.raises((SQLError, AdmissionError)) as ei:
+            sess.execute("refresh materialized view d_agg")
+        assert ei.value.sqlstate in ("53200", "53000")
+    finally:
+        sess.execute("set resource_group = default_group")
+    sess.execute("refresh materialized view d_agg")
+    assert _mv_rows(sess, "d_agg") == _oracle(
+        sess, DIFF_SHAPES["d_agg"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# dependent-object protection + direct-write guard + both wires
+# ---------------------------------------------------------------------------
+
+
+def test_drop_table_refuses_with_2bp01_and_cascade_drops(sess, cl):
+    sess.execute("create table base1 (k bigint, v bigint) "
+                 "distribute by shard(k)")
+    sess.execute("insert into base1 values (1,1),(2,2)")
+    sess.execute(
+        "create materialized view b1mv as select k, v from base1 "
+        "where v > 0"
+    )
+    with pytest.raises(SQLError) as ei:
+        sess.execute("drop table base1")
+    assert ei.value.sqlstate == "2BP01"
+    assert "b1mv" in str(ei.value)
+    sess.execute("drop table base1 cascade")
+    assert sess.query(
+        "select count(*) from pg_matviews where matviewname = 'b1mv'"
+    ) == [(0,)]
+    assert not cl.catalog.has("base1") and not cl.catalog.has("b1mv")
+
+
+def test_drop_matview_dependency_and_cascade(sess):
+    sess.execute("create table base2 (k bigint, g text) "
+                 "distribute by shard(k)")
+    sess.execute("insert into base2 values (1,'x'),(2,'y')")
+    sess.execute(
+        "create materialized view b2mv as select k, g from base2"
+    )
+    # a matview over a matview (it is a real table, so this works)
+    sess.execute(
+        "create materialized view b2agg as "
+        "select g, count(*) as n from b2mv group by g"
+    )
+    with pytest.raises(SQLError) as ei:
+        sess.execute("drop materialized view b2mv")
+    assert ei.value.sqlstate == "2BP01"
+    sess.execute("drop materialized view b2mv cascade")
+    assert sess.query(
+        "select count(*) from pg_matviews where matviewname "
+        "in ('b2mv','b2agg')"
+    ) == [(0,)]
+    sess.execute("drop table base2")
+
+
+def test_direct_writes_refused_42809(sess):
+    for sql in (
+        "insert into d_agg values ('x',1,1,1,1.0)",
+        "update d_agg set n = 0",
+        "delete from d_agg",
+        "truncate table d_agg",
+        "drop table d_agg",
+        "delete from d_agg$aux",
+        "alter table d_agg add column junk bigint",
+        # the refresh-state catalog: corrupting last_refresh_lsn would
+        # make the next incremental refresh re-apply history
+        "delete from otb_matview_state",
+        "update otb_matview_state set lsn = 0",
+        "drop table otb_matview_state",
+        "truncate table otb_matview_state",
+    ):
+        with pytest.raises(SQLError) as ei:
+            sess.execute(sql)
+        assert ei.value.sqlstate == "42809", sql
+
+
+def test_2bp01_rides_both_wire_protocols(sess, cl):
+    """The dependent-objects error must surface with SQLSTATE 2BP01 on
+    the JSON frame protocol AND the PG v3 wire ('E' message C field)."""
+    from opentenbase_tpu.net.client import WireError, connect_tcp
+    from opentenbase_tpu.net.pgwire import PgWireServer
+    from opentenbase_tpu.net.server import ClusterServer
+
+    with ClusterServer(cl, port=0) as srv:
+        cs = connect_tcp(srv.host, srv.port)
+        try:
+            with pytest.raises(WireError) as ei:
+                cs.execute("drop table dfact")
+            assert ei.value.sqlstate == "2BP01"
+        finally:
+            cs.close()
+    pg = PgWireServer(cl, port=0).start()
+    try:
+        import socket
+
+        sock = socket.create_connection((pg.host, pg.port), timeout=30)
+        body = struct.pack("!I", 196608) + b"user\0otb\0\0"
+        sock.sendall(struct.pack("!I", len(body) + 4) + body)
+
+        def recv():
+            tag = b""
+            while len(tag) < 1:
+                tag += sock.recv(1)
+            hdr = b""
+            while len(hdr) < 4:
+                hdr += sock.recv(4 - len(hdr))
+            (ln,) = struct.unpack("!I", hdr)
+            payload = b""
+            while len(payload) < ln - 4:
+                payload += sock.recv(ln - 4 - len(payload))
+            return tag, payload
+
+        while True:
+            tag, _p = recv()
+            if tag == b"Z":
+                break
+        q = b"drop table dfact\0"
+        sock.sendall(b"Q" + struct.pack("!I", len(q) + 4) + q)
+        sqlstate = None
+        while True:
+            tag, payload = recv()
+            if tag == b"E":
+                for fld in payload.split(b"\0"):
+                    if fld[:1] == b"C":
+                        sqlstate = fld[1:].decode()
+            elif tag == b"Z":
+                break
+        assert sqlstate == "2BP01"
+        sock.close()
+    finally:
+        pg.stop()
+
+
+# ---------------------------------------------------------------------------
+# crash recovery: catalog + last_refresh_lsn + counters survive; the
+# next refresh after recovery is still incremental. Checkpoint
+# survival rides the same cluster (WAL create record GC'd by ckpt).
+# ---------------------------------------------------------------------------
+
+
+def test_crash_recovery_catalog_lsn_and_checkpoint(tmp_path):
+    data = str(tmp_path / "data")
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=data)
+    s = c.session()
+    s.execute("set enable_fused_execution = off")
+    s.execute("create table rf (k bigint, g text, v bigint) "
+              "distribute by shard(k)")
+    s.execute("insert into rf values (1,'a',10),(2,'b',20),(3,'a',30)")
+    q = "select g, count(*) as n, sum(v) as s from rf group by g"
+    s.execute(f"create materialized view rmv as {q}")
+    s.execute("insert into rf values (4,'b',40)")
+    s.execute("refresh materialized view rmv")
+    # checkpoint AFTER the refresh: the def must survive without its
+    # WAL create record being replayed
+    c.persistence.checkpoint()
+    lsn = s.query("select last_refresh_lsn from pg_matviews")[0][0]
+    s.execute("set enable_matview_rewrite = off")
+    before = sorted(s.query("select * from rmv"))
+    # one more committed base write the matview has NOT folded in
+    s.execute("insert into rf values (5,'c',50)")
+    c.close()  # crash
+
+    c2 = Cluster.recover(data, num_datanodes=2, shard_groups=16)
+    s2 = c2.session()
+    s2.execute("set enable_fused_execution = off")
+    assert s2.query(
+        "select matviewname, incremental, last_refresh_lsn, is_fresh "
+        "from pg_matviews"
+    ) == [("rmv", True, lsn, False)]
+    assert s2.query(
+        "select incremental_refreshes from pg_stat_matview"
+    ) == [(1,)]
+    s2.execute("set enable_matview_rewrite = off")
+    assert sorted(s2.query("select * from rmv")) == before
+    s2.execute("refresh materialized view rmv")
+    assert s2.query(
+        "select incremental_refreshes, last_mode from pg_stat_matview"
+    ) == [(2, "incremental")]
+    assert sorted(s2.query("select * from rmv")) == sorted(
+        s2.query(q)
+    )
+    s2.execute("set enable_matview_rewrite = on")
+    assert s2.query("select is_fresh from pg_matviews") == [(True,)]
+    # a TRUNCATE leaves no 'G' frames — recovery's staleness probe
+    # must still see it (D-record scan) and refuse to serve the
+    # pre-truncate rows as fresh
+    s2.execute("truncate table rf")
+    c2.close()
+    c3 = Cluster.recover(data, num_datanodes=2, shard_groups=16)
+    s3 = c3.session()
+    s3.execute("set enable_fused_execution = off")
+    assert s3.query("select is_fresh from pg_matviews") == [(False,)]
+    lines = [r[0] for r in s3.query(f"explain {q}")]
+    assert not any("Matview rewrite" in ln for ln in lines), lines
+    s3.execute("refresh materialized view rmv")
+    assert s3.query("select * from rmv") == []
+    c3.close()
+
+
+def test_non_durable_cluster_always_full():
+    c = Cluster(num_datanodes=2, shard_groups=16)  # no WAL
+    s = c.session()
+    s.execute("set enable_fused_execution = off")
+    s.execute("create table nf (k bigint, v bigint) "
+              "distribute by shard(k)")
+    s.execute("insert into nf values (1,10),(2,20)")
+    s.execute(
+        "create materialized view nmv as select k, v from nf "
+        "where v > 5"
+    )
+    s.execute("insert into nf values (3,30)")
+    s.execute("refresh materialized view nmv")
+    assert s.query(
+        "select last_mode from pg_stat_matview"
+    ) == [("full",)]
+    s.execute("set enable_matview_rewrite = off")
+    assert sorted(s.query("select * from nmv")) == sorted(
+        s.query("select k, v from nf where v > 5")
+    )
