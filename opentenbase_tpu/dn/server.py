@@ -29,7 +29,11 @@ import threading
 import time
 from typing import Optional
 
-from opentenbase_tpu.net.protocol import recv_frame, send_frame
+from opentenbase_tpu.net.protocol import (
+    recv_frame,
+    send_frame,
+    shutdown_and_close,
+)
 
 
 class DNServer:
@@ -99,10 +103,7 @@ class DNServer:
 
     def stop(self) -> None:
         self._stop.set()
-        try:
-            self._lsock.close()
-        except OSError:
-            pass
+        shutdown_and_close(self._lsock)
         with self._peer_mu:
             for pool in self._peer_pools.values():
                 try:

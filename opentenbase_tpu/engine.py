@@ -1732,10 +1732,13 @@ class Session:
             "enable_matview_rewrite", True
         ):
             return stmt
-        if self.txn is not None and self.txn.writes:
-            # the transaction's own uncommitted writes are invisible to
-            # the matview (versions bump only at commit): the normal
-            # executor path must serve them
+        if self.txn is not None:
+            # never rewrite inside an explicit transaction block: the
+            # txn's pinned snapshot may predate the matview's last
+            # refresh (freshness is judged against CURRENT committed
+            # versions, so the scan could serve pre-refresh rows the
+            # defining query at this snapshot would not), and the txn's
+            # own uncommitted writes are invisible to the matview
             return stmt
         sel = stmt.query if isinstance(stmt, A.ExplainStmt) else stmt
         if not isinstance(sel, A.Select):
@@ -1747,14 +1750,18 @@ class Session:
             return stmt
         name, new_sel = hit
         d = c.matviews[name]
-        d.stats["rewrites"] = d.stats.get("rewrites", 0) + 1
         if isinstance(stmt, A.ExplainStmt):
+            if stmt.analyze:
+                # plan-only EXPLAIN serves no rows — only ANALYZE
+                # (which executes) counts as a serving-path hit
+                d.stats["rewrites"] = d.stats.get("rewrites", 0) + 1
             self._explain_prelude.append(
                 f'Matview rewrite: query served from "{name}" '
                 f"(lsn {d.last_refresh_lsn})"
             )
             stmt.query = new_sel
             return stmt
+        d.stats["rewrites"] = d.stats.get("rewrites", 0) + 1
         return new_sel
 
     def _dependent_matviews(self, relname: str) -> list[str]:
@@ -5007,6 +5014,7 @@ class Session:
     def _x_creatematview(self, stmt: A.CreateMatview) -> Result:
         from opentenbase_tpu.matview import defs as _mv
         from opentenbase_tpu.matview.refresh import (
+            PinnedSnapshot,
             apply_refresh,
             build_partials_select,
         )
@@ -5040,7 +5048,10 @@ class Session:
         _mv.ensure_state_table(self)
         p = c.persistence
         lsn0 = p.wal.position if p is not None else 0
-        refresh_ts = c.gts.snapshot_ts()
+        # ONE read snapshot pinned adjacent to the lsn0 capture: see
+        # PinnedSnapshot (matview/refresh.py) for the contract
+        pin = PinnedSnapshot(self)
+        refresh_ts = pin.snapshot_ts
         # versions are captured WITH lsn0 (see refresh_matview): a
         # base commit during population must leave the matview stale
         versions0 = {
@@ -5095,6 +5106,9 @@ class Session:
                         aux_meta.schema, aux_batch.columns.values()
                     )
                 }
+            # reads done: release the pinned snapshot before the apply
+            # (which runs its own transaction, as in refresh_matview)
+            pin.release()
             if p is not None:
                 p.log_ddl({
                     "op": "create_matview",
@@ -5132,6 +5146,7 @@ class Session:
                     p.log_ddl({"op": "drop_matview", "name": name})
                 raise
         finally:
+            pin.release()
             self._matview_internal = prev_internal
         d.base_versions = {
             tb: versions0.get(tb, 0) for tb in d.base_tables

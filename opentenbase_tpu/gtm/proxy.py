@@ -20,6 +20,7 @@ from collections import Counter
 from typing import Optional
 
 from opentenbase_tpu.gtm.client import NativeGTS
+from opentenbase_tpu.net.protocol import shutdown_and_close
 
 
 class GTSProxy:
@@ -46,10 +47,7 @@ class GTSProxy:
 
     def stop(self) -> None:
         self._stop.set()
-        try:
-            self._lsock.close()
-        except OSError:
-            pass
+        shutdown_and_close(self._lsock)
         self.upstream.close()
 
     def _accept_loop(self) -> None:

@@ -17,6 +17,7 @@ from typing import Optional
 
 from opentenbase_tpu.gtm import client as C
 from opentenbase_tpu.gtm.gts import GTSServer
+from opentenbase_tpu.net.protocol import shutdown_and_close
 
 
 class GTSFrontend:
@@ -38,10 +39,7 @@ class GTSFrontend:
         return self
 
     def stop(self) -> None:
-        try:
-            self._lsock.close()
-        except OSError:
-            pass
+        shutdown_and_close(self._lsock)
 
     def _accept_loop(self) -> None:
         while True:

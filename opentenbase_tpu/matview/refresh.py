@@ -227,6 +227,42 @@ def _drop_delta_table(session, name: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+class PinnedSnapshot:
+    """The refresh-snapshot protocol, shared by CREATE MATERIALIZED VIEW
+    and REFRESH: ONE read snapshot pinned adjacent to the caller's lsn0
+    capture. A base commit landing after lsn0 must be invisible to the
+    compute-phase reads — a commit the reads absorbed but lsn0 predates
+    would be decoded from WAL by the next incremental refresh and
+    applied AGAIN. ``release()`` is idempotent: callers drop the pin the
+    moment their reads finish (the apply runs its own transaction) and
+    still guard exception paths with a ``finally``. Both entry points
+    reject transaction blocks (25001) before pinning, so the pin is
+    always a fresh implicit txn and release returns ``session.txn`` to
+    None; a session already holding a transaction is refused here too
+    rather than silently losing it."""
+
+    def __init__(self, session):
+        if session.txn is not None:
+            from opentenbase_tpu.engine import SQLError
+
+            raise SQLError(
+                "matview population cannot pin a snapshot inside a "
+                "transaction block",
+                "25001",
+            )
+        self._session = session
+        self.txn, _ = session._begin_implicit()
+        self.snapshot_ts = self.txn.snapshot_ts
+        session.txn = self.txn
+        self._pinned = True
+
+    def release(self) -> None:
+        if self._pinned:
+            self._pinned = False
+            self._session.txn = None
+            self._session._abort_txn(self.txn)
+
+
 def refresh_matview(session, d: MatviewDef, concurrently: bool = False) -> dict:
     """Refresh one matview. Plain REFRESH computes and applies while
     holding whatever statement slot the session owns (the wire server
@@ -243,13 +279,12 @@ def refresh_matview(session, d: MatviewDef, concurrently: bool = False) -> dict:
     meta = c.catalog.get(d.name)
     durable = c.persistence is not None
     lsn0 = c.persistence.wal.position if durable else 0
-    # the compute phase reads under ONE snapshot pinned here, adjacent
-    # to the lsn0 capture: under a parked CONCURRENTLY compute, a base
-    # commit landing mid-phase must be on exactly one side of the
-    # refresh — past the delta cutoff AND invisible to the recompute
-    # reads (the next refresh picks it up), never in both
-    rtxn, _ = session._begin_implicit()
-    refresh_ts = rtxn.snapshot_ts
+    # under a parked CONCURRENTLY compute, a base commit landing
+    # mid-phase must be on exactly one side of the refresh — past the
+    # delta cutoff AND invisible to the recompute reads (the next
+    # refresh picks it up), never in both: see PinnedSnapshot
+    pin = PinnedSnapshot(session)
+    refresh_ts = pin.snapshot_ts
     # freshness versions are captured WITH lsn0 for the same reason:
     # absorbing a mid-compute commit's bump would mark the matview
     # fresh while missing its rows
@@ -260,9 +295,7 @@ def refresh_matview(session, d: MatviewDef, concurrently: bool = False) -> dict:
         else contextlib.nullcontext()
     )
     prev_internal = session._matview_internal
-    prev_txn = session.txn
     session._matview_internal = True
-    session.txn = rtxn
     plan = None
     mode = "full"
     try:
@@ -281,8 +314,7 @@ def refresh_matview(session, d: MatviewDef, concurrently: bool = False) -> dict:
         finally:
             # the pinned read snapshot ends with the compute phase
             # (it wrote nothing); the apply runs its own transaction
-            session.txn = prev_txn
-            session._abort_txn(rtxn)
+            pin.release()
         # counters roll forward INSIDE the state row that commits with
         # the contents — a crash can't lose or double-count a refresh
         new_stats = dict(d.stats)
@@ -302,6 +334,7 @@ def refresh_matview(session, d: MatviewDef, concurrently: bool = False) -> dict:
         staged.stats = new_stats
         apply_refresh(session, d, meta, plan, state_row(staged))
     finally:
+        pin.release()  # no-op unless the compute phase never ran
         session._matview_internal = prev_internal
     # commit succeeded: publish the new state on the def. Only the
     # refresh-owned counters are written back — live counters (e.g.

@@ -35,6 +35,7 @@ import threading
 from typing import Optional
 
 from opentenbase_tpu.net import auth as sa
+from opentenbase_tpu.net.protocol import shutdown_and_close
 
 _PROTO_V3 = 196608
 _SSL_REQUEST = 80877103
@@ -204,10 +205,7 @@ class PgWireServer:
 
     def stop(self) -> None:
         self._stop.set()
-        try:
-            self._lsock.close()
-        except OSError:
-            pass
+        shutdown_and_close(self._lsock)
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():

@@ -55,6 +55,21 @@ def _revive_tree(x):
     return x
 
 
+def shutdown_and_close(sock: socket.socket) -> None:
+    """Teardown that actually unblocks peers: shutdown() wakes a thread
+    blocked in accept()/recv() on this socket; close() alone does not
+    (the blocked call holds the old fd). Every server stop() path uses
+    this so no join(timeout) has to expire waiting for a sleeper."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 def send_frame(sock: socket.socket, obj: dict) -> None:
     data = json.dumps(obj, default=_default).encode()
     sock.sendall(struct.pack("<I", len(data)) + data)

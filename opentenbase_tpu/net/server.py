@@ -20,7 +20,11 @@ import socket
 import threading
 from typing import Optional
 
-from opentenbase_tpu.net.protocol import recv_frame, send_frame
+from opentenbase_tpu.net.protocol import (
+    recv_frame,
+    send_frame,
+    shutdown_and_close,
+)
 
 
 def _walk_ast(node):
@@ -57,6 +61,8 @@ class ClusterServer:
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._conn_threads: list[threading.Thread] = []
+        # raw accepted sockets of live backends, force-closed on stop()
+        self._conns: set = set()
         # engine-wide statement lock (owned by the Cluster; see docstring)
         self._exec_lock = cluster._exec_lock
         # TLS (be-secure.c): explicit ctor args win, else the ssl* GUCs
@@ -93,14 +99,19 @@ class ClusterServer:
 
     def stop(self) -> None:
         self._stop.set()
-        try:
-            self._lsock.close()
-        except OSError:
-            pass
-        # the accept loop exits on the listener close; join it first so
-        # _conn_threads cannot grow while we iterate a snapshot of it
+        shutdown_and_close(self._lsock)
+        # join the accept loop first so _conn_threads cannot grow while
+        # we iterate a snapshot of it
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
+        # force-disconnect live backends: a client that never sends its
+        # close frame must not hold shutdown hostage (the postmaster
+        # SIGTERMs its backends on smart shutdown for the same reason)
+        for c in list(self._conns):
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         for t in list(self._conn_threads):
             t.join(timeout=5)
 
@@ -118,6 +129,7 @@ class ClusterServer:
             except OSError:
                 return  # listener closed
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.add(conn)
             t = threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             )
@@ -130,6 +142,7 @@ class ClusterServer:
             self._conn_threads.append(t)
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        raw = conn  # the accepted socket registered in _conns
         if self._ssl_ctx is not None:
             # the handshake runs HERE, in the per-connection thread,
             # with a timeout — a silent client must never stall the
@@ -146,7 +159,14 @@ class ClusterServer:
                     conn.close()
                 except OSError:
                     pass
+                self._conns.discard(raw)
                 return
+            # wrap_socket() detached the raw fd — re-register the live
+            # SSLSocket or stop()'s force-disconnect would shut down a
+            # dead fd and never wake this backend
+            self._conns.discard(raw)
+            self._conns.add(conn)
+            raw = conn
         session = self.cluster.session()
         # trust mode only while no users exist (pg_hba 'trust' vs
         # 'scram-sha-256'); once any role is created, the handshake is
@@ -212,9 +232,15 @@ class ClusterServer:
                     if sqlstate:  # 53xxx sheds, 57014 timeouts, ...
                         frame["sqlstate"] = sqlstate
                     send_frame(conn, frame)
+        except OSError:
+            # the socket died under us — client vanished mid-frame, or
+            # stop() force-disconnected this backend while a statement
+            # was in flight; either way exit quietly, cleanup below
+            pass
         finally:
             # abort any transaction left open by a dropped connection
             # (the backend-exit cleanup of the reference's tcop loop)
+            self._conns.discard(raw)
             self._conn_cleanup(session, conn)
 
     def _classify(self, sql: str, session):

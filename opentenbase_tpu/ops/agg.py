@@ -188,7 +188,12 @@ def _mxu_group_reduce_impl(keys, vals, slot, num_groups: int, specs: tuple):
     # so the per-block f32 partials ([sb, cap, K]) stay a few MB instead
     # of materializing an [nblocks, cap, K] tensor proportional to the
     # whole table
-    sb = 256  # per-step f32 partials: [sb? no — [sb, cap, K]] ~ tens of MB
+    # superblock height adapts to the data: a shard with one block of
+    # rows must not pad to (and one-hot-matmul over) 256 blocks of
+    # zeros — the fixed floor made every small GROUP BY pay a
+    # million-row scan
+    nb_needed = max(-(-n // _MXU_BLOCK), 1)
+    sb = min(256, nb_needed)  # per-step f32 partials: [sb, cap, K]
     super_rows = sb * _MXU_BLOCK
     ns = max(-(-n // super_rows), 1)
     padded = ns * super_rows

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Optional
 
+from opentenbase_tpu.net.protocol import shutdown_and_close
 from opentenbase_tpu.storage.persist import WAL
 
 
@@ -50,10 +51,7 @@ class WalSender:
 
     def stop(self) -> None:
         self._stop.set()
-        try:
-            self._lsock.close()
-        except OSError:
-            pass
+        shutdown_and_close(self._lsock)
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -227,10 +225,7 @@ class StandbyCluster:
         """pg_ctl promote: finish recovery and go read-write."""
         self._stop.set()
         if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
+            shutdown_and_close(self._sock)
         if self._thread is not None:
             self._thread.join(timeout=5)
         p = self.cluster.persistence
@@ -243,9 +238,6 @@ class StandbyCluster:
     def stop(self) -> None:
         self._stop.set()
         if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
+            shutdown_and_close(self._sock)
 
 
