@@ -119,6 +119,21 @@ GUCS: dict = {
     # anywhere on the statement path); EXPLAIN ANALYZE always traces
     # its one statement regardless
     "trace_queries": (_bool, False),
+    # fault injection (fault/): pg_fault_inject() refuses unless the
+    # session turned this on — an accidental arm in production SQL must
+    # be a two-step mistake. Off adds nothing to any hot path: every
+    # FAULT site is a single empty-dict lookup.
+    "fault_injection": (_bool, False),
+    # self-healing reads (executor/dist.py): extra attempts for a
+    # failed/timed-out remote READ fragment before failing over to the
+    # coordinator's own caught-up copy; writes never blind-retry — they
+    # abort with a retryable SQLSTATE (40001/08006) instead
+    "fragment_retries": (_int, 2),
+    "fragment_retry_backoff_ms": (_duration, 25),
+    # GTM client failover (gtm/client.py NativeGTS): 'host:port' of the
+    # standby's wire frontend; on primary loss the client reconnects
+    # there instead of erroring the session
+    "gtm_standby_addr": (_str, ""),
     "autovacuum": (_bool, False),
     "autovacuum_naptime_s": (_int, 60),
     "autovacuum_scale_factor_pct": (_int, 20),

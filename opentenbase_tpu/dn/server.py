@@ -29,11 +29,19 @@ import threading
 import time
 from typing import Optional
 
+from opentenbase_tpu import fault as _fault
+from opentenbase_tpu.fault import FAULT, FaultDropConnection
 from opentenbase_tpu.net.protocol import (
     recv_frame,
     send_frame,
     shutdown_and_close,
 )
+
+
+class FragmentCancelled(RuntimeError):
+    """The coordinator sent cancel_fragment for this token (it abandoned
+    the fragment at its socket deadline); execution stops at the next
+    operator boundary instead of running to completion."""
 
 
 class DNServer:
@@ -88,6 +96,17 @@ class DNServer:
         self.standby.start_replication(wal_host, wal_port)
         self._promoted_srv = None
         self._promote_mu = threading.Lock()
+        # DN-side fragment cancel (the reference's real cancel message):
+        # tokens the coordinator abandoned; running fragments poll the
+        # set at operator boundaries. Insertion-ordered for bounded
+        # eviction of the oldest, like _stream_resolved.
+        self._cancelled: dict = {}
+        self._cancel_mu = threading.Lock()
+        # crash_node fault: True once an injected crash took this node
+        # down — the listener is closed and every live connection drops
+        # its request without a reply (indistinguishable from a killed
+        # process to the coordinator, while tests keep the object)
+        self._crashed = False
         self._lsock = socket.socket()
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((host, port))
@@ -136,8 +155,16 @@ class DNServer:
                 msg = recv_frame(conn)
                 if msg is None:
                     break
+                if self._crashed and msg.get("op") not in (
+                    "fault_arm", "fault_clear", "fault_stats"
+                ):
+                    break  # injected crash: no replies (fault-control
+                    # ops on a surviving channel stay answerable so a
+                    # chaos harness can always disarm + revive)
                 try:
                     send_frame(conn, self._dispatch(msg))
+                except FaultDropConnection:
+                    break  # drop without a reply, like a dying process
                 except Exception as e:
                     send_frame(
                         conn, {"error": f"{type(e).__name__}: {e}"}
@@ -148,8 +175,54 @@ class DNServer:
             except OSError:
                 pass
 
+    def _simulate_crash(self) -> None:
+        """crash_node fault: stop accepting, stop answering. The python
+        object survives (tests can inspect/recover it) but from every
+        peer's perspective the node is gone mid-request."""
+        self._crashed = True
+        shutdown_and_close(self._lsock)
+        self._bump("injected_crashes")
+
+    def _failpoint(self, site: str, **ctx):
+        """Evaluate one FAULT site with the DN's crash_node semantics
+        (take the node down, sever THIS request without a reply) handled
+        in one place; returns the action for any other site-handled
+        reaction."""
+        act = FAULT(site, **ctx)
+        if act == "crash_node":
+            self._simulate_crash()
+            raise FaultDropConnection("injected datanode crash")
+        return act
+
     def _dispatch(self, msg: dict) -> dict:
         op = msg.get("op")
+        # fault-control ops answer even on a 'crashed' node: the chaos
+        # harness must always be able to clear its own faults (the
+        # control plane a real kill would provide via process respawn)
+        if op == "fault_arm":
+            _fault.inject(
+                str(msg["site"]), str(msg["action"]),
+                str(msg.get("spec") or ""),
+            )
+            return {"ok": True}
+        if op == "fault_clear":
+            n = _fault.clear(msg.get("site"))
+            if self._crashed:
+                # disarm + revive in one control message: the chaos
+                # harness's equivalent of respawning the process
+                self._revive()
+            return {"ok": True, "cleared": n}
+        if op == "fault_stats":
+            return {"ok": True, "rows": [list(r) for r in _fault.stats()]}
+        self._failpoint("dn/dispatch", op=op)
+        if op == "cancel_fragment":
+            tok = str(msg.get("token") or "")
+            with self._cancel_mu:
+                self._cancelled[tok] = time.time()
+                while len(self._cancelled) > 1024:
+                    self._cancelled.pop(next(iter(self._cancelled)))
+            self._bump("cancel_requests")
+            return {"ok": True}
         if op == "ping":
             self._exch_gc()  # periodic sweep rides the health checks
             with self._stats_mu:
@@ -239,6 +312,9 @@ class DNServer:
         gid = str(msg["gid"])
         if not gid or "/" in gid or gid.startswith("."):
             return {"error": f"bad gid {gid!r}"}
+        # failpoint BEFORE the vote journal hits disk: an error here is
+        # a DN that never voted (the coordinator must abort the txn)
+        self._failpoint("dn/2pc_prepare", gid=gid)
         d = self._twophase_dir()
         tmp = os.path.join(d, f".{gid}.tmp")
         path = os.path.join(d, gid)
@@ -264,6 +340,10 @@ class DNServer:
             os.fsync(dfd)  # the rename itself must be durable
         finally:
             os.close(dfd)
+        # failpoint AFTER the journal is durable: the vote exists but
+        # the ack is lost — the in-doubt shape pg_resolve_indoubt()
+        # exists to drive to a decision
+        self._failpoint("dn/2pc_prepare:after_journal", gid=gid)
         return {"ok": True}
 
     def _twophase_finish(self, msg: dict, committed: bool) -> dict:
@@ -271,6 +351,10 @@ class DNServer:
         import os
 
         gid = str(msg["gid"])
+        verb = "2pc_commit" if committed else "2pc_abort"
+        # before-journal failpoint: the decision message arrived but
+        # nothing was applied/retired yet — a lost phase-2 delivery
+        self._failpoint(f"dn/{verb}", gid=gid)
         path = os.path.join(self._twophase_dir(), gid)
         try:
             with open(path) as f:
@@ -289,6 +373,8 @@ class DNServer:
             os.unlink(path)
         except FileNotFoundError:
             pass
+        # after-journal failpoint: applied + journal retired, ack lost
+        self._failpoint(f"dn/{verb}:after_journal", gid=gid)
         return {"ok": True, "known": True, "applied": applied}
 
     def _apply_journal(self, gid: str, entry: dict, msg: dict) -> bool:
@@ -397,9 +483,11 @@ class DNServer:
     EXCH_WAIT_S = 60.0
 
     def _exch_wait(self, xid: str, dest: int, producers,
-                   timeout_s: float = EXCH_WAIT_S):
+                   timeout_s: float = EXCH_WAIT_S, cancelled=None):
         """Wire parts from every producer, in producer order — or None
-        on timeout. Pops the entry (one consumption per exchange)."""
+        on timeout/cancel. Pops the entry (one consumption per
+        exchange). ``cancelled`` is polled between waits so an
+        abandoned consumer stops parking on dead producers."""
         key = (str(xid), int(dest))
         deadline = time.time() + timeout_s
         with self._exch_cv:
@@ -409,10 +497,12 @@ class DNServer:
                     self._exch.pop(key, None)
                     self._exch_born.pop(key, None)
                     return [parts[int(p)] for p in producers]
+                if cancelled is not None and cancelled():
+                    return None
                 left = deadline - time.time()
                 if left <= 0:
                     return None
-                self._exch_cv.wait(min(left, 1.0))
+                self._exch_cv.wait(min(left, 0.25 if cancelled else 1.0))
 
     def _exch_take(self, msg: dict) -> dict:
         self._exch_gc()
@@ -502,11 +592,31 @@ class DNServer:
                 self._bump("promoted")
             return {"ok": True, "port": self._promoted_srv.port}
 
-    def _wait_applied(self, lsn: int, timeout_s: float = 90.0) -> bool:
+    def _revive(self) -> None:
+        """Undo an injected crash: reopen the listener on the same port
+        and accept again (the chaos harness's process respawn)."""
+        if not self._crashed:
+            return
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((self.host, self.port))
+        self._lsock.listen(32)
+        self._crashed = False
+        self._accept = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept.start()
+        self._bump("revives")
+
+    def _wait_applied(
+        self, lsn: int, timeout_s: float = 90.0, cancelled=None
+    ) -> bool:
         t0 = time.time()
         while time.time() - t0 < timeout_s:
             if self.standby.applied >= lsn:
                 return True
+            if cancelled is not None and cancelled():
+                return False
             time.sleep(0.002)
         return False
 
@@ -514,13 +624,33 @@ class DNServer:
         from opentenbase_tpu.executor.local import LocalExecutor
         from opentenbase_tpu.plan import serde
 
+        node = int(msg["node"])
+        self._failpoint("dn/exec_fragment", node=node)
+        # the coordinator's abandon message (cancel_fragment) is keyed
+        # by this token; cancelled() is polled at every batch/operator
+        # boundary below and inside LocalExecutor
+        token = msg.get("cancel_token")
+
+        def cancelled() -> bool:
+            return token is not None and token in self._cancelled
+
+        def cancel_check() -> None:
+            if cancelled():
+                raise FragmentCancelled(
+                    "fragment canceled by coordinator"
+                )
+
         min_lsn = int(msg.get("min_lsn", 0))
-        if min_lsn and not self._wait_applied(min_lsn):
+        if min_lsn and not self._wait_applied(
+            min_lsn, cancelled=cancelled
+        ):
+            if cancelled():
+                self._bump("fragments_cancelled")
+                return {"error": "fragment canceled by coordinator"}
             return {"error": "replication lag: wal position not reached"}
         from opentenbase_tpu import types as t
 
         plan = serde.loads_plan(msg["plan"])
-        node = int(msg["node"])
         snapshot_ts = msg.get("snapshot_ts")
         c = self.standby.cluster
         inputs = {
@@ -531,65 +661,86 @@ class DNServer:
         # partition (the consumer side of the squeue data plane) —
         # OUTSIDE the exec lock so redo apply keeps flowing while we
         # wait on peers
-        for k, spec in (msg.get("exchanges") or {}).items():
-            parts = self._exch_wait(
-                spec["xid"], node, spec.get("producers") or [],
-            )
-            if parts is None:
-                return {"error": f"exchange {spec['xid']} timed out"}
-            from opentenbase_tpu.executor.dist import concat_batches
+        try:
+            for k, spec in (msg.get("exchanges") or {}).items():
+                cancel_check()  # between batch waits
+                parts = self._exch_wait(
+                    spec["xid"], node, spec.get("producers") or [],
+                    cancelled=cancelled,
+                )
+                if parts is None:
+                    cancel_check()
+                    return {"error": f"exchange {spec['xid']} timed out"}
+                from opentenbase_tpu.executor.dist import concat_batches
 
-            inputs[int(k)] = concat_batches([
-                serde.batch_from_wire(p, c.catalog) for p in parts
-            ])
-        subquery_values = [
-            (v, t.SqlType(t.TypeId(ty[0]), ty[1], ty[2]))
-            for v, ty in (msg.get("subquery_values") or [])
-        ]
-        # execute under the standby's statement lock so redo apply never
-        # interleaves with a fragment read (recovery-conflict interlock)
-        with c._exec_lock:
-            out = None
-            ex = None
-            K = int(msg.get("parallel", 1))
-            if K > 1:
-                # within-fragment parallel scan+partial-agg over row
-                # blocks (execParallel.c:565); None = shape/size does
-                # not qualify, fall through to the serial path
-                from opentenbase_tpu.executor.local import (
-                    run_fragment_parallel,
-                )
+                inputs[int(k)] = concat_batches([
+                    serde.batch_from_wire(p, c.catalog) for p in parts
+                ])
+            subquery_values = [
+                (v, t.SqlType(t.TypeId(ty[0]), ty[1], ty[2]))
+                for v, ty in (msg.get("subquery_values") or [])
+            ]
+            # execute under the standby's statement lock so redo apply
+            # never interleaves with a fragment read (recovery-conflict
+            # interlock)
+            with c._exec_lock:
+                cancel_check()
+                out = None
+                ex = None
+                K = int(msg.get("parallel", 1))
+                if K > 1:
+                    # within-fragment parallel scan+partial-agg over row
+                    # blocks (execParallel.c:565); None = shape/size does
+                    # not qualify, fall through to the serial path
+                    from opentenbase_tpu.executor.local import (
+                        run_fragment_parallel,
+                    )
 
-                out = run_fragment_parallel(
-                    c.catalog, c.stores.get(node, {}), snapshot_ts,
-                    plan, inputs, subquery_values, K,
-                )
-                if out is not None:
-                    self._bump("parallel_fragments")
-            if out is None:
-                ex = LocalExecutor(
-                    c.catalog,
-                    c.stores.get(node, {}),
-                    snapshot_ts,
-                    remote_inputs=inputs,
-                    subquery_values=subquery_values,
-                )
-                out = ex.run_plan(plan)
-        mo = msg.get("motion")
-        if mo is not None:
-            # producer side: partition + push peer-to-peer; the
-            # coordinator gets control-plane info only (row count)
-            self._motion_push(out, mo, node, plan)
+                    out = run_fragment_parallel(
+                        c.catalog, c.stores.get(node, {}), snapshot_ts,
+                        plan, inputs, subquery_values, K,
+                        cancel_check=(
+                            cancel_check if token is not None else None
+                        ),
+                    )
+                    if out is not None:
+                        self._bump("parallel_fragments")
+                if out is None:
+                    ex = LocalExecutor(
+                        c.catalog,
+                        c.stores.get(node, {}),
+                        snapshot_ts,
+                        remote_inputs=inputs,
+                        subquery_values=subquery_values,
+                        cancel_check=(
+                            cancel_check if token is not None else None
+                        ),
+                    )
+                    out = ex.run_plan(plan)
+            mo = msg.get("motion")
+            if mo is not None:
+                # producer side: partition + push peer-to-peer; the
+                # coordinator gets control-plane info only (row count)
+                cancel_check()
+                self._motion_push(out, mo, node, plan)
+                return {
+                    "ok": True, "rows": out.nrows,
+                    "pruned_blocks": getattr(ex, "zone_pruned_blocks", 0),
+                    "total_blocks": getattr(ex, "zone_total_blocks", 0),
+                }
+            cancel_check()
             return {
-                "ok": True, "rows": out.nrows,
+                "batch": serde.batch_to_wire(out, plan.schema),
                 "pruned_blocks": getattr(ex, "zone_pruned_blocks", 0),
                 "total_blocks": getattr(ex, "zone_total_blocks", 0),
             }
-        return {
-            "batch": serde.batch_to_wire(out, plan.schema),
-            "pruned_blocks": getattr(ex, "zone_pruned_blocks", 0),
-            "total_blocks": getattr(ex, "zone_total_blocks", 0),
-        }
+        except FragmentCancelled:
+            self._bump("fragments_cancelled")
+            return {"error": "fragment canceled by coordinator"}
+        finally:
+            if token is not None:
+                with self._cancel_mu:
+                    self._cancelled.pop(token, None)
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser()

@@ -37,6 +37,7 @@ _engine_log = logging.getLogger("opentenbase_tpu.engine")
 
 from opentenbase_tpu import types as t
 from opentenbase_tpu.catalog.catalog import Catalog, TableMeta
+from opentenbase_tpu.fault import FaultError as _FaultError
 from opentenbase_tpu.catalog.distribution import DistributionSpec, DistStrategy
 from opentenbase_tpu.catalog.nodes import NodeDef, NodeManager, NodeRole
 from opentenbase_tpu.catalog.shardmap import ShardMap
@@ -329,6 +330,17 @@ class Cluster:
         # incremented from concurrent session threads, so guarded
         self.dml_stats: dict = {"shipped": 0, "stream_only": 0}
         self._dml_stats_mu = _threading.Lock()
+        # in-doubt 2PC resolver counters (pg_stat_2pc): bumped from the
+        # admin fn, the background loop, and concurrent sessions
+        self.twophase_stats: dict = {
+            "resolver_runs": 0,
+            "indoubt_seen": 0,
+            "resolved_commit": 0,
+            "resolved_abort": 0,
+            "awaiting_operator": 0,
+            "unreachable_datanodes": 0,
+        }
+        self._2pc_stats_mu = _threading.Lock()
         # per-shard MOVE DATA barrier (shardbarrier.c): readers of
         # non-moving shards overlap a rebalance (VERDICT r4 ask #7);
         # concurrent MOVE DATA statements serialize on the move mutex
@@ -343,6 +355,15 @@ class Cluster:
         from opentenbase_tpu import config as _config
 
         self.conf_gucs: dict = _config.load_conf(data_dir)
+        # GTM HA: point the native GTS client's failover at the standby
+        # frontend (gtm_standby_addr = 'host:port' in opentenbase.conf)
+        _sb = str(self.conf_gucs.get("gtm_standby_addr") or "")
+        if _sb and ":" in _sb and hasattr(self.gts, "set_standby"):
+            _h, _, _p = _sb.rpartition(":")
+            try:
+                self.gts.set_standby(_h, int(_p))
+            except ValueError:
+                pass
         self._autovacuum_stop = None
         if self.conf_gucs.get("autovacuum"):
             self._autovacuum_stop = self.start_autovacuum(
@@ -787,6 +808,139 @@ class Cluster:
 
         return stopper
 
+    # -- in-doubt 2PC resolver (clean2pc.c + pg_clean, decision-driven) --
+    def resolve_indoubt(self, min_age_s: float = 0.0) -> list[tuple]:
+        """Drive every in-doubt gid to a decision after a coordinator
+        crash or partition: candidates come from the GTM's prepared
+        registry and each reachable DN's ``2pc_list`` journal; the
+        verdict comes from the coordinator WAL's durable commit record
+        (storage/persist.py gid_decision) — present means COMMIT
+        (replay phase 2), absent means presumed ABORT. Explicitly
+        PREPAREd transactions still parked for their operator are only
+        touched when a durable decision already exists (they are
+        awaiting a client, not in doubt). ``min_age_s`` guards the
+        background loop against racing a live commit's prepare→commit
+        window; the admin fn runs with 0 (the operator knows the old
+        coordinator is gone). Returns [(gid, outcome)]."""
+        out: list[tuple] = []
+        st = self.twophase_stats
+        with self._2pc_stats_mu:
+            st["resolver_runs"] += 1
+        explicit = set(self.__dict__.get("_prepared", {}))
+        gts_prepared: dict[str, object] = {}
+        try:
+            for info in self.gts.prepared_txns():
+                if info.gid:
+                    gts_prepared[info.gid] = info
+        except Exception:
+            pass
+        chans = getattr(self, "dn_channels", None) or {}
+        dn_votes: dict[str, list[int]] = {}
+        vote_age: dict[str, float] = {}
+        for n, ch in chans.items():
+            try:
+                resp = ch.rpc({"op": "2pc_list"})
+            except Exception:
+                with self._2pc_stats_mu:
+                    st["unreachable_datanodes"] += 1
+                continue  # a down DN resolves on a later run
+            entries = resp.get("entries") or [
+                {"gid": g, "age_s": None} for g in resp.get("gids", [])
+            ]
+            for e in entries:
+                dn_votes.setdefault(e["gid"], []).append(n)
+                age = e.get("age_s")
+                if age is not None:
+                    prev = vote_age.get(e["gid"])
+                    vote_age[e["gid"]] = (
+                        age if prev is None else min(prev, age)
+                    )
+        p = self.persistence
+
+        def decision_for(gid):
+            return p.gid_decision(gid) if p is not None else None
+
+        for gid in sorted(set(gts_prepared) | set(dn_votes)):
+            decision = decision_for(gid)
+            if gid in explicit and decision is None:
+                # operator-owned PREPARE TRANSACTION: not in doubt
+                with self._2pc_stats_mu:
+                    st["awaiting_operator"] += 1
+                out.append((gid, "awaiting_operator"))
+                continue
+            if decision is None and min_age_s > 0:
+                # age gate (background loop): a vote younger than the
+                # threshold may be a commit in flight between the DN
+                # prepare and the WAL record — never presume-abort it
+                age = vote_age.get(gid)
+                if gid in dn_votes and (age is None or age < min_age_s):
+                    continue
+                if gid not in dn_votes:
+                    continue  # registry-only entries: clean_2pc's job
+            with self._2pc_stats_mu:
+                st["indoubt_seen"] += 1
+            ok = True
+            if decision is not None and decision[0] == "commit":
+                for n in dn_votes.get(gid, []):
+                    try:
+                        chans[n].rpc({
+                            "op": "2pc_commit", "gid": gid,
+                            "commit_ts": decision[1],
+                        })
+                    except Exception:
+                        ok = False
+                outcome = "committed" if ok else "commit_retry"
+                if ok:
+                    with self._2pc_stats_mu:
+                        st["resolved_commit"] += 1
+            else:
+                # presumed abort: no durable commit record exists, so
+                # no reader can ever have observed this txn
+                for n in dn_votes.get(gid, []):
+                    try:
+                        chans[n].rpc({"op": "2pc_abort", "gid": gid})
+                    except Exception:
+                        ok = False
+                outcome = "aborted" if ok else "abort_retry"
+                if ok:
+                    with self._2pc_stats_mu:
+                        st["resolved_abort"] += 1
+            info = gts_prepared.get(gid)
+            if info is not None and ok:
+                try:
+                    if decision is None or decision[0] != "commit":
+                        self.gts.abort(info.gxid)
+                    self.gts.forget(info.gxid)
+                except Exception:
+                    pass
+            out.append((gid, outcome))
+        return out
+
+    def start_indoubt_resolver(
+        self, interval_s: float = 60.0, min_age_s: float = 60.0
+    ):
+        """Background in-doubt resolver (the clean2pc bgworker shape).
+        Returns a stop() callable."""
+        import threading as _threading
+
+        stop = _threading.Event()
+
+        def loop() -> None:
+            while not stop.wait(interval_s):
+                try:
+                    self.resolve_indoubt(min_age_s=min_age_s)
+                except Exception:
+                    pass
+
+        t = _threading.Thread(target=loop, daemon=True)
+        t.start()
+
+        def stopper() -> None:
+            stop.set()
+            t.join(timeout=5)
+
+        return stopper
+
     # -- GTM node registration (recovery/register_gtm.c) -----------------
     def _gtm_register_all(self) -> None:
         """Register every catalog node with the GTM service (best
@@ -970,6 +1124,11 @@ class Session:
         # refresh must read the base tables, never itself) and the
         # matview write guard
         self._matview_internal = False
+        # self-healing reads: cumulative remote-fragment retries /
+        # local failovers across this session's statements
+        # (pg_stat_cluster_activity surfaces both)
+        self.frag_retries = 0
+        self.frag_failovers = 0
 
     def close(self) -> None:
         """Backend-exit cleanup (the tcop loop's on-exit path): release
@@ -1174,7 +1333,8 @@ class Session:
         # raises for FOR SHARE as well (heap_lock_tuple/HeapTupleUpdated)
         if (store.xmax_ts[np.asarray(idx)] != INF_TS).any():
             raise SQLError(
-                "could not serialize access due to concurrent update"
+                "could not serialize access due to concurrent update",
+                "40001",
             )
 
     def _check_write_conflicts(self, txn: Transaction) -> None:
@@ -1194,7 +1354,9 @@ class Session:
                 if (store.xmax_ts[idx] != INF_TS).any():
                     self._abort_txn(txn)
                     raise SQLError(
-                        "could not serialize access due to concurrent update"
+                        "could not serialize access due to concurrent "
+                        "update",
+                        "40001",
                     )
 
     def _dn_2pc(self, op: str, gid: str, nodes, **extra) -> list[int]:
@@ -1230,11 +1392,24 @@ class Session:
             for th in ths:
                 th.join()
         if errors:
+            # a channel-level failure is retryable from the client's
+            # side: the statement aborts whole (write paths never
+            # blind-retry) and 08006 (connection_failure) tells the
+            # client layer a re-run is safe and warranted
             n, e = errors[0]
-            raise SQLError(f"datanode {n} failed {op} for {gid!r}: {e}")
+            raise SQLError(
+                f"datanode {n} failed {op} for {gid!r}: {e}", "08006"
+            )
         acked: list[int] = []
         for n, resp in results.items():
             if resp.get("error"):
+                # an application-level rejection over a HEALTHY channel
+                # (pool channels raise for error frames, so this is the
+                # non-raising-transport path): the statement still
+                # aborts whole, but this is NOT a connection failure —
+                # claiming 08006 would invite clients to retry a
+                # deterministic failure (bad gid, unwritable journal
+                # dir) as if it were a network blip
                 raise SQLError(
                     f"datanode {n} rejected {op} for {gid!r}: "
                     f"{resp['error']}"
@@ -1308,6 +1483,15 @@ class Session:
                 self._abort_txn(txn)
                 raise
             gts.prepare(txn.gxid, implicit_gid, tuple(nodes))
+            # failpoint: the coordinator dying BETWEEN prepare and the
+            # commit record. Raising here bypasses every abort handler
+            # (this is outside their try blocks) — the durable state it
+            # leaves (DN vote journals, GTS prepared entry, NO commit
+            # record) is exactly a crash at this instant, and
+            # pg_resolve_indoubt() must drive it to abort
+            from opentenbase_tpu.fault import FAULT as _FAULT
+
+            _FAULT("coord/2pc_after_prepare", gid=implicit_gid)
         commit_ts = self.cluster.commit_ts_begin_stamping(txn.gxid)
         try:
             try:
@@ -1329,6 +1513,13 @@ class Session:
                 raise
         finally:
             self.cluster.stamping_done(commit_ts)
+        if implicit_gid is not None:
+            # failpoint: the coordinator dying AFTER the durable commit
+            # record but BEFORE phase 2 — the in-doubt shape the
+            # resolver must drive to commit (the decision is in the WAL)
+            from opentenbase_tpu.fault import FAULT as _FAULT
+
+            _FAULT("coord/2pc_before_phase2", gid=implicit_gid)
         gts.forget(txn.gxid)
         if implicit_gid is not None:
             # phase 2: retire the DN votes. A lost message here is safe —
@@ -2725,6 +2916,10 @@ class Session:
         "pg_publication_tables",
         "pg_logical_sync",
         "pg_basebackup",
+        # fault injection (fault/) + the in-doubt 2PC resolver
+        "pg_fault_inject",
+        "pg_fault_clear",
+        "pg_resolve_indoubt",
     }
     # FROM-less builtins that mutate nothing: the wire front ends may
     # class them as plain reads (pg_sleep is the WLM/timeout test probe)
@@ -2781,12 +2976,76 @@ class Session:
         if self.cluster.read_only and e.name in (
             "pg_unlock_execute", "pg_clean_execute",
             "pg_audit_add_fga_policy", "pg_audit_drop_fga_policy",
+            "pg_resolve_indoubt",
         ):
             # state-mutating admin functions are primary-only; standby 2PC
             # state is owned by WAL replay (same gate as nextval/setval)
             raise SQLError(
                 f"cannot execute {e.name}() in a read-only "
                 "(hot standby) cluster"
+            )
+        if e.name == "pg_fault_inject":
+            # arm a failpoint (fault/): two-step by design — the session
+            # must have turned the fault_injection GUC on first, so a
+            # stray production statement can't arm chaos by accident
+            from opentenbase_tpu import fault as _fault
+
+            if not self.gucs.get("fault_injection"):
+                raise SQLError(
+                    "pg_fault_inject() requires fault_injection = on",
+                    "55000",
+                )
+            if len(e.args) not in (2, 3):
+                raise SQLError("pg_fault_inject(site, action[, spec])")
+            site = str(self._const_arg(e.args[0]))
+            action = str(self._const_arg(e.args[1]))
+            spec = (
+                str(self._const_arg(e.args[2]))
+                if len(e.args) == 3 else ""
+            )
+            try:
+                _fault.inject(site, action, spec)
+            except ValueError as ve:
+                raise SQLError(str(ve)) from None
+            # registries are process-local: forward the arm to every
+            # attached DN server process so chaos control works across
+            # the real topology (best effort — an unreachable DN is
+            # often the point of the exercise)
+            forwarded = 0
+            for ch in (self.cluster.dn_channels or {}).values():
+                try:
+                    ch.rpc({
+                        "op": "fault_arm", "site": site,
+                        "action": action, "spec": spec,
+                    })
+                    forwarded += 1
+                except Exception:
+                    pass
+            return Result(
+                "SELECT", [(site, forwarded)],
+                ["site", "datanodes_armed"], 1,
+            )
+        if e.name == "pg_fault_clear":
+            # clearing never requires the GUC: an operator must always
+            # be able to disarm, even from a session that lost its SET
+            from opentenbase_tpu import fault as _fault
+
+            site = (
+                str(self._const_arg(e.args[0])) if e.args else None
+            )
+            n = _fault.clear(site)
+            for ch in (self.cluster.dn_channels or {}).values():
+                try:
+                    resp = ch.rpc({"op": "fault_clear", "site": site})
+                    n += int(resp.get("cleared", 0))
+                except Exception:
+                    pass
+            return Result("SELECT", [(n,)], ["cleared"], 1)
+        if e.name == "pg_resolve_indoubt":
+            age = float(self._const_arg(e.args[0])) if e.args else 0.0
+            rows = self.cluster.resolve_indoubt(min_age_s=age)
+            return Result(
+                "SELECT", rows, ["gid", "outcome"], len(rows)
             )
         locks = self.cluster.locks
         if e.name == "pg_unlock_execute":
@@ -3475,8 +3734,19 @@ class Session:
                 trace=self._trace,
                 waits=self.cluster.waits,
                 session_id=self.session_id,
+                fragment_retries=self.gucs.get("fragment_retries", 2),
+                retry_backoff_ms=self._duration_ms(
+                    self.gucs.get("fragment_retry_backoff_ms", 25),
+                    "fragment_retry_backoff_ms",
+                ),
             )
-            batch = ex.run(dplan)
+            try:
+                batch = ex.run(dplan)
+            finally:
+                # retry accounting survives errors too: a statement
+                # that exhausted its retries should still show them
+                self.frag_retries += ex.retry_stats["retries"]
+                self.frag_failovers += ex.retry_stats["failovers"]
             motion_ms = sum(
                 m["ms"] for m in ex.motion_stats.values()
                 if m.get("ms") is not None
@@ -4637,6 +4907,15 @@ class Session:
             self._commit_txn(txn)
         except SQLError:
             raise  # serialization failure: _commit_txn already aborted
+        except _FaultError:
+            # an injected fault (fault/) models the coordinator dying AT
+            # the site: no cleanup may run — the whole point is to leave
+            # the in-doubt state (DN vote journals, GTS prepared entry,
+            # maybe a durable commit record) for pg_resolve_indoubt()
+            # exactly as a real crash would. In particular the generic
+            # handler below would be WRONG after the commit record is
+            # durable: aborting then would truncate committed rows.
+            raise
         except Exception:
             # infrastructure failure mid-commit (GTS drop, WAL I/O):
             # undo what was applied so no pins/PENDING rows leak
@@ -5951,6 +6230,12 @@ class Session:
                             f" pruned={i['pruned_blocks']}/"
                             f"{i['total_blocks']} blocks"
                         )
+                    if i.get("retries"):
+                        # self-healing reads: the retry/failover story
+                        # is part of the execution record
+                        extra += f" retries={i['retries']}"
+                    if i.get("failover"):
+                        extra += f" failover={i['failover']}"
                     lines.append(
                         f"Fragment {i['fragment']} on dn{i['node']}: "
                         f"rows={i['rows']} time={i['ms']:.3f} ms" + extra
@@ -6292,9 +6577,11 @@ def _sv_cluster_activity(c: Cluster):
     rows = []
     for s in sorted(c.sessions, key=lambda s: s.session_id):
         wtype, wevent = c.waits.current_for(s.session_id)
-        rows.append(
-            (s.session_id, s.state, s.last_query[:100], wtype, wevent)
-        )
+        rows.append((
+            s.session_id, s.state, s.last_query[:100], wtype, wevent,
+            int(getattr(s, "frag_retries", 0)),
+            int(getattr(s, "frag_failovers", 0)),
+        ))
     return rows
 
 
@@ -6597,6 +6884,39 @@ def _sv_matview_stats(c: Cluster):
     return rows
 
 
+def _sv_faults(c: Cluster):
+    """pg_stat_faults: every failpoint the process (and each attached
+    DN server process) has seen armed — arms/hits/fired counters plus
+    the live armed action/trigger. Counters survive pg_fault_clear so
+    a chaos run stays auditable after disarm."""
+    from opentenbase_tpu import fault as _fault
+
+    rows = [("cn",) + tuple(r) for r in _fault.stats()]
+    for n, ch in sorted((getattr(c, "dn_channels", None) or {}).items()):
+        try:
+            resp = ch.rpc({"op": "fault_stats"})
+        except Exception:
+            continue  # an unreachable DN is often the point
+        for r in resp.get("rows", []):
+            rows.append((f"dn{n}",) + tuple(r))
+    return rows
+
+
+def _sv_2pc(c: Cluster):
+    """pg_stat_2pc: in-doubt resolver counters + the live prepared
+    registry size."""
+    with c._2pc_stats_mu:
+        items = sorted(c.twophase_stats.items())
+    rows = [(k, int(v)) for k, v in items]
+    try:
+        rows.append(
+            ("prepared_registry", len(c.gts.prepared_txns()))
+        )
+    except Exception:
+        pass
+    return rows
+
+
 _SYSTEM_VIEWS: dict[str, tuple] = {
     "pg_proc": (
         {
@@ -6740,6 +7060,10 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
             "query": t.TEXT,
             "wait_event_type": t.TEXT,
             "wait_event": t.TEXT,
+            # self-healing reads: cumulative remote-fragment retries and
+            # local failovers this session's statements needed
+            "frag_retries": t.INT8,
+            "frag_failovers": t.INT8,
         },
         _sv_cluster_activity,
     ),
@@ -6851,6 +7175,23 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
             "status": t.TEXT,
         },
         _sv_gtm_nodes,
+    ),
+    "pg_stat_faults": (
+        {
+            "node": t.TEXT,
+            "site": t.TEXT,
+            "action": t.TEXT,
+            "trigger_spec": t.TEXT,
+            "arms": t.INT8,
+            "hits": t.INT8,
+            "fired": t.INT8,
+            "armed": t.BOOL,
+        },
+        _sv_faults,
+    ),
+    "pg_stat_2pc": (
+        {"stat": t.TEXT, "value": t.INT8},
+        _sv_2pc,
     ),
 }
 

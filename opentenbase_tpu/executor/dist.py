@@ -189,6 +189,8 @@ class DistExecutor:
         trace=None,  # obs.trace.QueryTrace (None = untraced)
         waits=None,  # obs.waits.WaitEventRegistry
         session_id: int = 0,
+        fragment_retries: int = 2,  # extra remote attempts per fragment
+        retry_backoff_ms: float = 25.0,  # base backoff (doubles per try)
     ):
         self.catalog = catalog
         self.node_stores = node_stores
@@ -229,6 +231,22 @@ class DistExecutor:
         self.instrumentation: list[dict] = []
         self.op_instrumentation: list[dict] = []
         self.motion_stats: dict[int, dict] = {}
+        # self-healing reads (fault/ robustness work): a failed or
+        # timed-out remote READ fragment is retried with bounded
+        # exponential backoff, then failed over to the coordinator's
+        # own stores — which hold the caught-up primary copy the DN
+        # process was replicating. Every dispatched fragment is a read
+        # (writes happen on the coordinator and reach DNs through the
+        # 2PC/WAL path), so a re-execution can never double-apply.
+        self.fragment_retries = max(int(fragment_retries or 0), 0)
+        self.retry_backoff_ms = float(retry_backoff_ms or 0.0)
+        self.retry_stats = {"retries": 0, "failovers": 0, "cancels": 0}
+        # monotonic per-attempt suffix for cancel tokens (see
+        # _exec_remote): itertools.count is atomic under the GIL, so
+        # concurrent dispatch threads never draw the same value
+        import itertools as _it
+
+        self._cancel_seq = _it.count(1)
 
     def _check_deadline(self) -> None:
         import time as _time
@@ -355,27 +373,93 @@ class DistExecutor:
             errors: list = []
 
             def run_remote(node):
+                from opentenbase_tpu.fault import FAULT
+                from opentenbase_tpu.net.pool import ChannelError
+
                 t0 = _time.perf_counter()
+                retries = 0
+                failover = False
+                # a fragment whose inputs were peer-exchanged (or that
+                # produces a peer motion) must not re-execute: exchange
+                # parts pop on consumption, so a second attempt would
+                # park on state the first attempt already ate
+                retryable = peer_xid is None and not any(
+                    isinstance(per_node.get(node), ExchangeRef)
+                    for j, per_node in motioned.items()
+                    if j in frag_sources
+                )
                 try:
-                    rows, batch = self._exec_remote(
-                        frag, node, motioned, subquery_values,
-                        frag_schemas, peer_xid=peer_xid,
-                        frag_sources=frag_sources,
-                    )
+                    while True:
+                        try:
+                            # coordinator-side failpoint: fails THIS
+                            # dispatch attempt the way a dead channel
+                            # would, without a DN process in the loop
+                            act = FAULT(
+                                "exec/fragment",
+                                node=node, fragment=frag.index,
+                            )
+                            if act == "crash_node":
+                                raise ChannelError(
+                                    "injected coordinator-side "
+                                    "channel failure"
+                                )
+                            rows, batch = self._exec_remote(
+                                frag, node, motioned, subquery_values,
+                                frag_schemas, peer_xid=peer_xid,
+                                frag_sources=frag_sources,
+                                qxid=qxid,
+                            )
+                            break
+                        except ChannelError:
+                            # bounded-backoff retry (reads only — which
+                            # is everything that reaches this loop),
+                            # then failover below; never past the
+                            # statement deadline
+                            if not retryable:
+                                raise
+                            self._check_deadline()
+                            if retries >= self.fragment_retries:
+                                # failover: the coordinator's own
+                                # stores ARE the caught-up copy the DN
+                                # was replicating (primary-side read)
+                                rows, batch, _ex = (
+                                    self._exec_local_fragment(
+                                        frag, node, motioned,
+                                        subquery_values, frag_sources,
+                                    )
+                                )
+                                failover = True
+                                self.retry_stats["failovers"] += 1
+                                break
+                            retries += 1
+                            self.retry_stats["retries"] += 1
+                            delay = (
+                                self.retry_backoff_ms
+                                * (2 ** (retries - 1))
+                                / 1000.0
+                            )
+                            if delay > 0:
+                                _time.sleep(min(delay, 2.0))
                     if batch is not None:
                         outs[node] = batch
                     t1 = _time.perf_counter()
-                    self.instrumentation.append({
+                    instr = {
                         "fragment": frag.index,
                         "node": node,
                         "rows": rows,
                         "ms": (t1 - t0) * 1000,
-                        "remote": True,
-                    })
+                        "remote": not failover,
+                    }
+                    if retries:
+                        instr["retries"] = retries
+                    if failover:
+                        instr["failover"] = "local"
+                    self.instrumentation.append(instr)
                     if self.trace is not None:
                         self.trace.record(
                             f"fragment {frag.index} @ dn{node}",
-                            "fragment", t0, t1, rows=rows, remote=True,
+                            "fragment", t0, t1, rows=rows,
+                            remote=not failover,
                         )
                 except Exception as e:
                     errors.append(e)
@@ -390,20 +474,11 @@ class DistExecutor:
             def run_local(node):
                 t0 = _time.perf_counter()
                 try:
-                    ex = LocalExecutor(
-                        self.catalog,
-                        self._stores(node),
-                        self.snapshot_ts,
-                        remote_inputs={
-                            j: self._resolve_input(per_node[node], node)
-                            for j, per_node in motioned.items()
-                            if node in per_node and j in frag_sources
-                        },
-                        subquery_values=subquery_values,
-                        own_writes=self.own_writes.get(node),
-                        instrument=self.instrument_ops,
+                    _rows, batch, ex = self._exec_local_fragment(
+                        frag, node, motioned, subquery_values,
+                        frag_sources,
                     )
-                    outs[node] = ex.run_plan(frag.root)
+                    outs[node] = batch
                     t1 = _time.perf_counter()
                     # per-(fragment, node) instrumentation gathered back
                     # to the coordinator — distributed EXPLAIN ANALYZE
@@ -532,6 +607,30 @@ class DistExecutor:
             })
         return out
 
+    def _exec_local_fragment(
+        self, frag: Fragment, node: int, motioned, subquery_values,
+        frag_sources,
+    ):
+        """Run one fragment in-process against the coordinator's stores
+        for ``node`` — the ordinary local path AND the failover target
+        when the node's DN process is unreachable. Returns
+        (rows, batch, executor)."""
+        ex = LocalExecutor(
+            self.catalog,
+            self._stores(node),
+            self.snapshot_ts,
+            remote_inputs={
+                j: self._resolve_input(per_node[node], node)
+                for j, per_node in motioned.items()
+                if node in per_node and j in frag_sources
+            },
+            subquery_values=subquery_values,
+            own_writes=self.own_writes.get(node),
+            instrument=self.instrument_ops,
+        )
+        batch = ex.run_plan(frag.root)
+        return batch.nrows, batch, ex
+
     def _resolve_input(self, val, node: int) -> ColumnBatch:
         """A local executor consuming a peer-exchanged input pulls the
         parts from the consumer node's DN exchange store (the safety
@@ -552,7 +651,7 @@ class DistExecutor:
 
     def _exec_remote(
         self, frag: Fragment, node: int, motioned, subquery_values,
-        frag_schemas, peer_xid=None, frag_sources=None,
+        frag_schemas, peer_xid=None, frag_sources=None, qxid=None,
     ):
         """Ship the fragment to the node's DN process (plan/serde.py over
         a pooled channel). Returns (rows, batch) — with ``peer_xid`` the
@@ -610,18 +709,31 @@ class DistExecutor:
         # socket deadline (channel discarded, slot released) instead of
         # holding the statement past its budget. Only passed when a
         # deadline is set, so plain channels (and test doubles) keep the
-        # bare rpc(msg) signature. Known simplification: there is no
-        # DN-side cancel message in the protocol, so an abandoned
-        # fragment runs to completion on the datanode (the reference
-        # sends a real cancel); the coordinator merely stops waiting.
+        # bare rpc(msg) signature. When the coordinator abandons the
+        # call at the deadline it sends a cancel_fragment message (the
+        # reference's real cancel), so the DN stops at its next
+        # operator boundary instead of running to completion.
         pool = self.dn_channels[node]
         timeout_s = self._remaining_s()
+        cancel_token = None
         if timeout_s is not None:
             # clamp to the channel's own deadline: statement_timeout may
             # only TIGHTEN hung-DN detection, never loosen it
             default_s = getattr(pool, "rpc_timeout", None)
             if default_s:
                 timeout_s = min(timeout_s, default_s)
+            if qxid is not None:
+                # unique per ATTEMPT, not per statement: a retry of a
+                # timed-out fragment must not inherit the cancel the
+                # coordinator sent for the previous attempt (the DN's
+                # cancelled-token map may still hold it while attempt 1
+                # winds down, and a shared token would self-cancel the
+                # retry at its first operator boundary)
+                cancel_token = (
+                    f"{qxid}:{frag.index}:{node}:"
+                    f"{next(self._cancel_seq)}"
+                )
+                msg["cancel_token"] = cancel_token
         # the round trip is a real wait: the session is parked on the DN
         # until the fragment answers (wait_event IPC/remote_fragment)
         wait_token = (
@@ -635,7 +747,27 @@ class DistExecutor:
             if timeout_s is None:
                 resp = pool.rpc(msg)
             else:
-                resp = pool.rpc(msg, timeout_s=timeout_s)
+                from opentenbase_tpu.net.pool import ChannelError
+
+                try:
+                    resp = pool.rpc(msg, timeout_s=timeout_s)
+                except ChannelError as e:
+                    # the socket deadline cut the call: tell the DN to
+                    # stop the abandoned fragment (best effort, on a
+                    # fresh channel — the cut one is already discarded)
+                    if cancel_token is not None and isinstance(
+                        e.__cause__, TimeoutError
+                    ):
+                        try:
+                            pool.rpc(
+                                {"op": "cancel_fragment",
+                                 "token": cancel_token},
+                                timeout_s=2.0,
+                            )
+                            self.retry_stats["cancels"] += 1
+                        except Exception:
+                            pass  # the DN may be gone entirely
+                    raise
         finally:
             if wait_token is not None:
                 self.waits.end(wait_token)

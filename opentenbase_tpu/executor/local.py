@@ -75,6 +75,7 @@ class LocalExecutor:
         subquery_values: Optional[list] = None,
         own_writes: Optional[dict] = None,
         instrument: bool = False,
+        cancel_check=None,
     ):
         self.catalog = catalog
         self.stores = stores
@@ -100,6 +101,11 @@ class LocalExecutor:
         # eval(); None = off, the untraced hot path
         self.op_records: Optional[list[dict]] = [] if instrument else None
         self._op_depth = 0
+        # DN-side cancel (dn/server.py cancel_fragment): a callable that
+        # raises when the coordinator abandoned this fragment, polled at
+        # every operator boundary. None (the overwhelmingly common case)
+        # costs one attribute test per operator.
+        self._cancel_check = cancel_check
 
     # -- dictionary access ----------------------------------------------
     def _dict(self, dict_id: str) -> Dictionary:
@@ -185,6 +191,10 @@ class LocalExecutor:
 
     # -- plan dispatch ----------------------------------------------------
     def eval(self, plan: L.LogicalPlan) -> DevBatch:
+        if self._cancel_check is not None:
+            # coordinator-abandoned fragment: stop at the next operator
+            # boundary instead of running the plan to completion
+            self._cancel_check()
         m = getattr(self, f"_eval_{type(plan).__name__.lower()}", None)
         if m is None:
             raise ExecError(f"no executor for {type(plan).__name__}")
@@ -1403,7 +1413,7 @@ def _parallel_shape(plan):
 
 def run_fragment_parallel(
     catalog, stores, snapshot_ts, plan, remote_inputs,
-    subquery_values, nworkers: int,
+    subquery_values, nworkers: int, cancel_check=None,
 ):
     """Run ``plan`` split across ``nworkers`` scan-block threads, or
     return None when the shape/size doesn't qualify (caller falls back
@@ -1460,10 +1470,16 @@ def run_fragment_parallel(
 
     def worker(i):
         try:
+            # cancel_check rides into every block worker so an
+            # abandoned parallel fragment (dn/server cancel_fragment)
+            # stops at its next operator boundary like the serial path
+            # — these are the largest fragments, the likeliest to be
+            # cut at a statement deadline
             ex = LocalExecutor(
                 catalog, stores, snapshot_ts,
                 remote_inputs=remote_inputs,
                 subquery_values=subquery_values,
+                cancel_check=cancel_check,
             )
             ex.scan_block = bounds[i]
             parts[i] = ex.run_plan(plan)

@@ -51,6 +51,16 @@ def _lp(s: str) -> bytes:
     return struct.pack("<H", len(b)) + b
 
 
+def _recv_exact_from(sock: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise GTSProtocolError("connection closed")
+        out += chunk
+    return out
+
+
 def build_server(build_dir: str) -> str:
     """Compile the server if the cached binary is stale; returns its path."""
     os.makedirs(build_dir, exist_ok=True)
@@ -89,7 +99,10 @@ class NativeGTS:
     model of the reference; the pooler/proxy batching layer can multiplex
     later exactly as src/gtm/proxy does)."""
 
-    def __init__(self, host: str, port: int, connect_retries: int = 3):
+    def __init__(
+        self, host: str, port: int, connect_retries: int = 3,
+        standby: Optional[tuple] = None,
+    ):
         from opentenbase_tpu.net.client import connect_with_retry
 
         self.host = host
@@ -106,6 +119,25 @@ class NativeGTS:
         self._proc: Optional[subprocess.Popen] = None
         # local mirror of txn state for TxnInfo compatibility
         self._txns: dict[int, TxnInfo] = {}
+        # GTM HA (gtm_standby.c's client side): the standby's wire
+        # frontend address. On primary loss an RPC reconnects — primary
+        # first (a fast restart), then here — instead of erroring the
+        # session; ``failovers`` counts the switches.
+        self._standby: Optional[tuple] = (
+            (str(standby[0]), int(standby[1])) if standby else None
+        )
+        # the ORIGINAL primary, remembered across failovers: after a
+        # switch self.host/self.port track the live endpoint, and
+        # without this a later standby outage would leave the client
+        # with a single candidate even though the restarted primary is
+        # reachable again
+        self._primary: tuple = (self.host, self.port)
+        self.failovers = 0
+
+    def set_standby(self, host: str, port: int) -> None:
+        """Point failover at a (promoted) standby's wire frontend —
+        gtm_ctl reconfigure, or the gtm_standby_addr GUC at startup."""
+        self._standby = (str(host), int(port))
 
     # -- lifecycle -------------------------------------------------------
     @staticmethod
@@ -154,23 +186,67 @@ class NativeGTS:
     def _rpc(self, op: int, payload: bytes = b"") -> bytes:
         msg = struct.pack("<IB", 1 + len(payload), op) + payload
         with self._lock:
-            self._sock.sendall(msg)
-            hdr = self._recv_exact(4)
-            (length,) = struct.unpack("<I", hdr)
-            body = self._recv_exact(length)
+            try:
+                self._sock.sendall(msg)
+                hdr = self._recv_exact(4)
+                (length,) = struct.unpack("<I", hdr)
+                body = self._recv_exact(length)
+            except (OSError, GTSProtocolError) as e:
+                # primary loss mid-exchange: fail over instead of
+                # erroring the session (gtm.c reconnects the same way)
+                body = self._failover_rpc(msg, e)
         status = body[0]
         if status != 0:
             raise GTSProtocolError(f"op {op:#x} failed")
         return body[1:]
 
+    def _failover_rpc(self, msg: bytes, err: Exception) -> bytes:
+        """Reconnect — primary first (covers a fast restart), then the
+        standby feed address — and retry the one in-flight request.
+        Caller holds the lock. The retried ops are safe to repeat: GTS
+        grants are fresh values, commit/abort/forget/prepare are
+        idempotent per gxid, and a twice-begun gxid merely burns a
+        number (the reference's reconnect-retry accepts the same)."""
+        from opentenbase_tpu.net.client import connect_with_retry
+
+        candidates = [(self.host, self.port)]
+        for cand in (self._primary, self._standby):
+            if cand is not None and cand not in candidates:
+                candidates.append(cand)
+        for host, port in candidates:
+            try:
+                sock = connect_with_retry(
+                    host, port, timeout=10, retries=1
+                )
+            except Exception:
+                continue
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                sock.sendall(msg)
+                hdr = _recv_exact_from(sock, 4)
+                (length,) = struct.unpack("<I", hdr)
+                body = _recv_exact_from(sock, length)
+            except (OSError, GTSProtocolError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = sock
+            if (host, port) != (self.host, self.port):
+                self.host, self.port = host, port
+                self.failovers += 1
+            return body
+        raise GTSProtocolError(
+            f"GTM unreachable (primary and standby): {err}"
+        ) from err
+
     def _recv_exact(self, n: int) -> bytes:
-        out = b""
-        while len(out) < n:
-            chunk = self._sock.recv(n - len(out))
-            if not chunk:
-                raise GTSProtocolError("connection closed")
-            out += chunk
-        return out
+        return _recv_exact_from(self._sock, n)
 
     # -- GTSServer-compatible API ----------------------------------------
     def get_gts(self) -> GlobalTimestamp:

@@ -94,8 +94,14 @@ class GTSProxy:
         state, so the connection is REPLACED before any other frontend
         can read a stale response as its own — and this request is NOT
         retried (ops like BEGIN are not idempotent)."""
+        from opentenbase_tpu.fault import FAULT
+
         with self.upstream._lock:
             try:
+                # failpoint: the proxy's one upstream socket — drop_conn
+                # exercises the replace-connection recovery below for
+                # every frontend at once
+                FAULT("gtm/proxy_upstream")
                 self.upstream._sock.sendall(frame)
                 rhead = self.upstream._recv_exact(4)
                 (rlen,) = struct.unpack("<I", rhead)

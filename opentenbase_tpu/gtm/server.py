@@ -32,6 +32,13 @@ class GTSFrontend:
         self._lsock.listen(64)
         self.host, self.port = self._lsock.getsockname()
         self._accept: Optional[threading.Thread] = None
+        # live backend sockets, guarded: the accept thread adds while
+        # stop() snapshots (list(set) raises if the set resizes
+        # mid-iteration), and the _stopping flag closes the window
+        # where a conn accepted just before stop() would miss the sweep
+        self._conns: set = set()
+        self._conns_mu = threading.Lock()
+        self._stopping = False
 
     def start(self) -> "GTSFrontend":
         self._accept = threading.Thread(target=self._accept_loop, daemon=True)
@@ -39,7 +46,16 @@ class GTSFrontend:
         return self
 
     def stop(self) -> None:
+        """Stop serving AND sever live backends — a stopped GTM must
+        look dead to its clients (their next RPC fails over to the
+        standby, gtm/client.py), not leave half-open sockets that keep
+        answering from a 'crashed' primary."""
+        self._stopping = True
         shutdown_and_close(self._lsock)
+        with self._conns_mu:
+            conns = list(self._conns)
+        for conn in conns:
+            shutdown_and_close(conn)
 
     def _accept_loop(self) -> None:
         while True:
@@ -48,6 +64,13 @@ class GTSFrontend:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_mu:
+                self._conns.add(conn)
+            if self._stopping:
+                # stop() may have swept before our add: sever here too
+                # (shutdown is idempotent) so no backend outlives stop
+                shutdown_and_close(conn)
+                return
             threading.Thread(
                 target=self._serve, args=(conn,), daemon=True
             ).start()
@@ -69,17 +92,28 @@ class GTSFrontend:
                     conn.sendall(
                         struct.pack("<I", 1 + len(out)) + b"\x00" + out
                     )
+                except ConnectionError:
+                    return  # injected/real drop: sever without a reply
                 except Exception:
                     conn.sendall(struct.pack("<I", 1) + b"\x01")
         except OSError:
             return
         finally:
+            with self._conns_mu:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
                 pass
 
     def _dispatch(self, op: int, p: bytes) -> bytes:
+        from opentenbase_tpu.fault import FAULT
+
+        # failpoint: GTS grants and every other GTM verb. error = a
+        # failed grant (the backend sees a protocol error and can fail
+        # over, gtm/client.py); delay = a slow GTM; drop_conn tears this
+        # backend's GTM connection (primary-loss from one client's view)
+        FAULT("gtm/grant", op=op)
         g = self.gts
         if op in (C.OP_GET_GTS, C.OP_SNAPSHOT):
             fn = g.get_gts if op == C.OP_GET_GTS else g.snapshot_ts

@@ -224,8 +224,14 @@ def serve_feed(link: ReplicationLink, host: str = "127.0.0.1",
         snap, lsn = link.attach(qsb)  # atomic: no event lost in between
         _send(conn, {"snapshot": snap, "lsn": lsn})
         try:
+            from opentenbase_tpu.fault import FAULT
+
             while True:
                 event, payload = q.get()
+                # failpoint: the MSG_BKUP_* feed — drop_conn severs the
+                # standby (it must resync on reconnect); delay models a
+                # lagging standby whose applied_lsn falls behind
+                FAULT("gtm/feed", event=event)
                 _send(conn, {"event": event, "payload": payload})
         except OSError:
             pass

@@ -13,6 +13,7 @@ import socket
 import time
 from dataclasses import dataclass, field
 
+from opentenbase_tpu.fault import FAULT
 from opentenbase_tpu.net.protocol import recv_frame, send_frame
 
 
@@ -52,6 +53,10 @@ def connect_with_retry(
     for i in range(attempts):
         try:
             made += 1
+            # failpoint shared by EVERY wire client (sessions, DN
+            # channels, GTM): drop_conn here simulates a node that is
+            # down/refusing, exercising the retry ladder deterministically
+            FAULT("net/client/connect", host=host, port=port)
             return socket.create_connection((host, port), timeout=timeout)
         except OSError as e:
             last = e
@@ -160,7 +165,9 @@ class ClientSession:
             raise AuthError("server failed to prove identity")
 
     def execute(self, sql: str) -> WireResult:
+        FAULT("net/client/send")
         send_frame(self._sock, {"q": sql})
+        FAULT("net/client/recv")
         resp = recv_frame(self._sock)
         if resp is None:
             raise WireError("connection closed by server")

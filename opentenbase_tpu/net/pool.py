@@ -14,7 +14,8 @@ import socket
 import threading
 from typing import Optional
 
-from opentenbase_tpu.net.protocol import recv_frame, send_frame
+from opentenbase_tpu.fault import FAULT
+from opentenbase_tpu.net.protocol import encode_frame, recv_frame
 
 
 class Channel:
@@ -36,15 +37,30 @@ class Channel:
     def rpc(self, msg: dict, timeout_s: Optional[float] = None) -> dict:
         """One request/response. ``timeout_s`` overrides the socket
         deadline for THIS call (statement_timeout enforcement); a cut
-        call marks the channel broken so the pool discards it."""
+        call marks the channel broken so the pool discards it.
+
+        Exception safety: the request is serialized BEFORE any byte
+        touches the wire — a poisoned message (unserializable value)
+        fails cleanly with the channel still usable and the pool slot
+        intact. Once the send starts, ANY failure — I/O or otherwise
+        (an injected fault, a KeyboardInterrupt mid-recv) — marks the
+        channel broken: a request with no response consumed leaves the
+        stream desynced, and releasing it clean would hand the NEXT
+        caller this call's stale response."""
+        frame = encode_frame(msg)  # may raise: channel untouched
         try:
             if timeout_s is not None:
                 self.sock.settimeout(timeout_s)
-            send_frame(self.sock, msg)
+            FAULT("net/pool/rpc_send", op=msg.get("op"))
+            self.sock.sendall(frame)
+            FAULT("net/pool/rpc_recv", op=msg.get("op"))
             resp = recv_frame(self.sock)
         except OSError as e:
             self.broken = True
             raise ChannelError(f"channel I/O failed: {e}") from e
+        except BaseException:
+            self.broken = True  # desynced: request in flight, no reply
+            raise
         finally:
             if timeout_s is not None and not self.broken:
                 self.sock.settimeout(self._timeout)

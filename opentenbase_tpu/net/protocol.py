@@ -70,9 +70,18 @@ def shutdown_and_close(sock: socket.socket) -> None:
         pass
 
 
-def send_frame(sock: socket.socket, obj: dict) -> None:
+def encode_frame(obj: dict) -> bytes:
+    """Serialize a frame WITHOUT touching the socket. Callers that must
+    stay exception-safe around pooled channels (net/pool.py) encode
+    first: a serialization error before any byte is written leaves the
+    connection clean, while the same error raised mid-send would desync
+    the request/response stream."""
     data = json.dumps(obj, default=_default).encode()
-    sock.sendall(struct.pack("<I", len(data)) + data)
+    return struct.pack("<I", len(data)) + data
+
+
+def send_frame(sock: socket.socket, obj: dict) -> None:
+    sock.sendall(encode_frame(obj))
 
 
 def recv_frame(sock: socket.socket) -> dict | None:

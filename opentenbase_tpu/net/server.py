@@ -20,6 +20,7 @@ import socket
 import threading
 from typing import Optional
 
+from opentenbase_tpu.fault import FAULT, FaultDropConnection
 from opentenbase_tpu.net.protocol import (
     recv_frame,
     send_frame,
@@ -128,6 +129,17 @@ class ClusterServer:
                 conn, _addr = self._lsock.accept()
             except OSError:
                 return  # listener closed
+            try:
+                # failpoint: a coordinator refusing/dropping new backends
+                # (drop_conn closes the just-accepted socket; the accept
+                # loop itself must survive any injected action)
+                FAULT("net/server/accept")
+            except Exception:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._conns.add(conn)
             t = threading.Thread(
@@ -198,6 +210,11 @@ class ClusterServer:
                     send_frame(conn, {"error": "malformed request"})
                     continue
                 try:
+                    # failpoint: statement dispatch. drop_conn tears the
+                    # backend down mid-protocol (client sees a vanished
+                    # server); error surfaces as an 'E' frame like any
+                    # engine error
+                    FAULT("net/server/dispatch")
                     # read-only statements share the data plane (MVCC
                     # snapshots isolate them from each other); writes,
                     # DDL, and anything uncertain take it exclusively —
@@ -226,6 +243,8 @@ class ClusterServer:
                             "rowcount": res.rowcount,
                         },
                     )
+                except FaultDropConnection:
+                    raise  # sever this backend like a real peer reset
                 except Exception as e:  # engine errors go to the client
                     frame = {"error": f"{type(e).__name__}: {e}"}
                     sqlstate = getattr(e, "sqlstate", None)

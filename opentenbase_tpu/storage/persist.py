@@ -133,6 +133,12 @@ class WAL:
         self._mu = _threading.Lock()
 
     def append(self, tag: bytes, header: dict, arrays: Optional[dict] = None) -> int:
+        from opentenbase_tpu.fault import FAULT
+
+        # failpoint: WAL write (error = an fsync/disk failure surfacing
+        # before any byte lands — the commit path must roll back; delay
+        # models a saturated log device)
+        FAULT("storage/wal_write", tag=tag.decode("latin1"))
         hdr = json.dumps(header).encode()
         payload = struct.pack("<I", len(hdr)) + hdr
         if arrays is not None:
@@ -224,6 +230,8 @@ class ClusterPersistence:
     """Checkpoint + WAL manager bound to one Cluster."""
 
     def __init__(self, cluster, data_dir: str):
+        import threading as _threading
+
         self.cluster = cluster
         self.dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
@@ -235,6 +243,15 @@ class ClusterPersistence:
         # gid -> {"gxid", "writes": [...]} of replayed-but-undecided 2PC
         # transactions (populated during recover, drained by C/R records)
         self._pending: dict[str, dict] = {}
+        # gid -> ("commit", commit_ts) | ("abort", None): the DURABLE
+        # commit decision of every gid-tagged transaction this WAL knows
+        # about — populated at log time AND during recovery replay, so
+        # the in-doubt resolver (engine.py resolve_indoubt) can answer
+        # "did this gid commit?" without rescanning the log. Bounded,
+        # insertion-ordered eviction of the oldest (a resolver only ever
+        # asks about recent gids; anything older was already retired).
+        self._gid_decisions: dict[str, tuple] = {}
+        self._gid_decisions_mu = _threading.Lock()
         # True while redo is applying records: side-effect feeds (e.g. the
         # GTM sequence-event bridge) must not re-log what they replay
         self._in_recovery = False
@@ -289,6 +306,8 @@ class ClusterPersistence:
             if gid is not None:
                 header["gid"] = gid
             self.wal.append(b"G", header, arrays or None)
+            if gid is not None:
+                self._record_decision(gid, "commit", commit_ts)
 
     def log_barrier(self, name: str, ts: int) -> None:
         self.wal.append(b"B", {"name": name, "ts": ts})
@@ -332,9 +351,29 @@ class ClusterPersistence:
 
     def log_commit_prepared(self, gid: str, commit_ts: int) -> None:
         self.wal.append(b"C", {"gid": gid, "commit_ts": commit_ts})
+        self._record_decision(gid, "commit", commit_ts)
 
     def log_rollback_prepared(self, gid: str) -> None:
         self.wal.append(b"R", {"gid": gid})
+        self._record_decision(gid, "abort", None)
+
+    def _record_decision(self, gid: str, outcome: str, ts) -> None:
+        # concurrent session threads commit at once: the insert is
+        # GIL-atomic but the evict-oldest loop is read-then-pop, and two
+        # threads popping the same oldest key would raise KeyError AFTER
+        # the commit record is already durable — hence the lock (reads
+        # via gid_decision stay lock-free: a plain .get)
+        with self._gid_decisions_mu:
+            self._gid_decisions[gid] = (outcome, ts)
+            while len(self._gid_decisions) > 8192:
+                self._gid_decisions.pop(
+                    next(iter(self._gid_decisions)), None
+                )
+
+    def gid_decision(self, gid: str):
+        """("commit", commit_ts) / ("abort", None) / None (no durable
+        decision — presumed abort under the 2PC protocol)."""
+        return self._gid_decisions.get(gid)
 
     # -- checkpoint -------------------------------------------------------
     def checkpoint(self) -> None:
@@ -349,6 +388,11 @@ class ClusterPersistence:
         (xmin=PENDING, no 'T'/'prepared' record to decide them) are
         excluded: if they later commit, their 'G' record replays them; if
         not, they must not exist after recovery."""
+        from opentenbase_tpu.fault import FAULT
+
+        # failpoint: a crash/IO failure at checkpoint start — recovery
+        # must still work from the previous generation + WAL tail
+        FAULT("storage/checkpoint")
         c = self.cluster
         gen = self._next_ckpt_gen()
         prep_ranges: dict[tuple[int, str], list[tuple[int, int]]] = {}
@@ -1012,6 +1056,10 @@ class ClusterPersistence:
                     d.encode_one(v)
             return
         if tag == "G":  # one committed transaction, atomically framed
+            if header.get("gid"):
+                self._record_decision(
+                    header["gid"], "commit", header["commit_ts"]
+                )
             writes = self._materialize_writes(
                 header["writes"], arrays, header["commit_ts"]
             )
@@ -1035,6 +1083,11 @@ class ClusterPersistence:
             }
             return
         if tag in ("C", "R"):  # COMMIT / ROLLBACK PREPARED
+            self._record_decision(
+                header["gid"],
+                "commit" if tag == "C" else "abort",
+                header.get("commit_ts"),
+            )
             pend = self._pending.pop(header["gid"], None)
             if pend is None:
                 return

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Optional
 
+from opentenbase_tpu.fault import FAULT, site_rng
 from opentenbase_tpu.net.protocol import shutdown_and_close
 from opentenbase_tpu.storage.persist import WAL
 
@@ -78,7 +79,24 @@ class WalSender:
                 while not self._stop.is_set():
                     chunk = f.read(1 << 20)
                     if chunk:
-                        conn.sendall(chunk)
+                        # failpoint: wal_torn tears the outgoing chunk at
+                        # byte-arbitrary positions (deterministic from the
+                        # fault's seed) — short TCP writes on demand, the
+                        # reassembly the standby's _drain must survive;
+                        # drop_conn here is walsender death mid-frame
+                        act = FAULT("repl/wal_stream", bytes=len(chunk))
+                        if act == "wal_torn" and len(chunk) > 1:
+                            rng = site_rng("repl/wal_stream")
+                            pos = 0
+                            while pos < len(chunk):
+                                cut = pos + rng.randint(
+                                    1, max(len(chunk) - pos, 1)
+                                )
+                                conn.sendall(chunk[pos:cut])
+                                pos = cut
+                                time.sleep(0.001)  # force distinct recvs
+                        else:
+                            conn.sendall(chunk)
                     else:
                         time.sleep(self.poll_s)
         except OSError:
@@ -145,6 +163,10 @@ class StandbyCluster:
         buf = b""
         while not self._stop.is_set():
             try:
+                # failpoint: walreceiver-side stall/death (delay models a
+                # lagging standby; drop_conn kills the receiver thread the
+                # way a real network partition would)
+                FAULT("repl/wal_recv")
                 chunk = self._sock.recv(1 << 20)
             except OSError:
                 return

@@ -80,7 +80,12 @@ def topo(tmp_path):
     s.execute(
         "create table t (k bigint, v bigint) distribute by shard(k)"
     )
-    sender = WalSender(c.persistence)
+    # a slow sender poll keeps the direct-apply path deterministic:
+    # these tests assert the 2PC decision RPC applies the journal AHEAD
+    # of the WAL stream, and under heavy machine load the default 50ms
+    # poll can deliver the 'G' frame first (stream wins the race, no
+    # dml_direct_applied bump — observed as an order-dependent flake)
+    sender = WalSender(c.persistence, poll_s=0.25)
     procs = []
     try:
         for node in (0, 1):

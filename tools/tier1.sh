@@ -93,9 +93,69 @@ c2.close()
 print("matview smoke OK: incremental refresh + rewrite + recovery")
 PY
 
+echo "== tier1: chaos smoke =="
+timeout -k 10 180 python - <<'PY' || exit 1
+# Arm a DN-crash failpoint, run a distributed query, assert the read
+# healed itself (retry + failover) and the pg_stat_faults / pg_stat_2pc
+# counters moved, clear the faults, rerun clean (fault/ subsystem).
+import tempfile
+from opentenbase_tpu import fault
+from opentenbase_tpu.dn.server import DNServer
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.storage.replication import WalSender
+
+d = tempfile.mkdtemp(prefix="otbchaos_")
+c = Cluster(num_datanodes=2, shard_groups=16, data_dir=f"{d}/cn")
+s = c.session()
+s.execute("set enable_fused_execution = off")
+s.execute("create table t (k bigint, v bigint) distribute by shard(k)")
+s.execute("insert into t values " + ",".join(
+    f"({i},{i*3})" for i in range(200)))
+sender = WalSender(c.persistence)
+dns = [DNServer(f"{d}/dn{n}", sender.host, sender.port, 2, 16).start()
+       for n in (0, 1)]
+for n, dn in enumerate(dns):
+    c.attach_datanode(n, "127.0.0.1", dn.port, pool_size=2,
+                      rpc_timeout=60)
+want = s.query("select count(*), sum(v) from t")
+s.execute("set fault_injection = on")
+s.execute("set fragment_retries = 1")
+s.execute("set fragment_retry_backoff_ms = 5")
+s.execute("select pg_fault_inject('dn/exec_fragment', 'crash_node',"
+          " 'node=1, once')")
+assert s.query("select count(*), sum(v) from t") == want  # self-healed
+act = {r[0]: r for r in s.query(
+    "select session_id, frag_retries, frag_failovers "
+    "from pg_stat_cluster_activity")}[s.session_id]
+assert act[1] >= 1 and act[2] >= 1, act
+fired = dict((tuple(r[:2]), r[2]) for r in s.query(
+    "select node, site, fired from pg_stat_faults"))
+assert fired.get(("cn", "dn/exec_fragment"), 0) >= 1, fired
+st = dict(s.query("select stat, value from pg_stat_2pc"))
+assert s.query("select pg_resolve_indoubt()") == []  # nothing in doubt
+st2 = dict(s.query("select stat, value from pg_stat_2pc"))
+assert st2["resolver_runs"] == st.get("resolver_runs", 0) + 1, st2
+s.execute("select pg_fault_clear()")
+dns[1]._revive()
+assert s.query("select count(*), sum(v) from t") == want  # clean rerun
+assert fault.armed() == {}
+for n in (0, 1):
+    c.detach_datanode(n)
+for dn in dns:
+    dn.stop()
+sender.stop()
+c.close()
+print("chaos smoke OK: crash_node -> retry+failover, counters moved, "
+      "clean rerun")
+PY
+
 echo "== tier1: full suite =="
 rm -f /tmp/_t1.log
-timeout -k 10 870 python -m pytest tests/ -q -m 'not slow' \
+# 870s was calibrated against a 786s run of 664 tests; the suite is now
+# 681 tests and shared-runner speed swings ~25% run to run — 1200s keeps
+# the cap meaningful (a hang still trips it) without cutting a slow but
+# healthy run at the 85% mark
+timeout -k 10 1200 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
