@@ -135,6 +135,100 @@ def report_matviews(host: str, port: int, user=None, password=None) -> bool:
     return True
 
 
+def report_health(host: str, port: int, user=None, password=None) -> bool:
+    """Cluster health over the coordinator wire: one line per node from
+    pg_cluster_health (role, up/down, heartbeat age, replication lag,
+    in-flight fragments, armed faults)."""
+    from opentenbase_tpu.net.client import ClientSession
+
+    try:
+        cs = ClientSession(host, port, timeout=10, user=user,
+                           password=password, connect_retries=0)
+        try:
+            rows = cs.query(
+                "select node_name, role, up, heartbeat_age_s, "
+                "replication_lag_bytes, inflight_fragments, "
+                "armed_faults from pg_cluster_health"
+            )
+        finally:
+            cs.close()
+    except Exception as e:
+        print(f"health {host}:{port}: unreachable ({e})")
+        return False
+    ok = True
+    for name, role, up, age, lag, inflight, armed in rows:
+        ok = ok and bool(up)
+        extra = ""
+        if role == "datanode":
+            extra = (
+                f" lag={lag}B inflight={inflight} armed_faults={armed}"
+                f" heartbeat_age={age}s"
+            )
+        print(
+            f"health {host}:{port} {name} ({role}): "
+            f"{'up' if up else 'DOWN'}{extra}"
+        )
+    return ok
+
+
+def report_logs(
+    host: str, port: int, user=None, password=None,
+    min_level=None, node=None, follow: bool = False,
+    poll_s: float = 1.0,
+) -> bool:
+    """Tail the merged cluster log (pg_cluster_logs) over the
+    coordinator wire; ``--follow`` keeps polling for newer records
+    (client-side since-ts filter) until interrupted."""
+    from opentenbase_tpu.net.client import ClientSession
+    from opentenbase_tpu.obs.log import format_record
+
+    args = ""
+    if min_level is not None:
+        args = f"'{min_level}'"
+        if node is not None:
+            args += f", '{node}'"
+    elif node is not None:
+        args = f"'debug', '{node}'"
+    sql = f"select pg_cluster_logs({args})"
+    # records emitted in the same clock tick share a timestamp: a strict
+    # ts watermark alone would drop the rest of a burst (exactly the
+    # dense fault-firing windows a log tail exists for), so ties are
+    # deduped by the full record instead
+    last_ts = 0.0
+    seen_at_last: set = set()
+    try:
+        while True:
+            cs = ClientSession(host, port, timeout=10, user=user,
+                               password=password, connect_retries=0)
+            try:
+                rows = cs.query(sql)
+            finally:
+                cs.close()
+            for r in rows:
+                ts = float(r[0])
+                key = tuple(r)
+                if ts < last_ts or (
+                    ts == last_ts and key in seen_at_last
+                ):
+                    continue
+                print(format_record(key))
+                if ts > last_ts:
+                    last_ts = ts
+                    seen_at_last = {key}
+                else:
+                    seen_at_last.add(key)
+            if not follow:
+                return True
+            import time as _time
+
+            _time.sleep(poll_s)
+    except KeyboardInterrupt:
+        return True
+    except Exception as e:
+        print(f"logs {host}:{port}: unreachable ({e})")
+        return False
+
+
 def _hostport(s: str) -> tuple[str, int]:
     host, _, port = s.rpartition(":")
     return host or "127.0.0.1", int(port)
@@ -155,8 +249,40 @@ def main(argv=None) -> int:
         "--matview", action="append", default=[],
         help="coordinator HOST:PORT to report matview health for",
     )
+    ap.add_argument(
+        "--health", action="append", default=[],
+        help="coordinator HOST:PORT to report pg_cluster_health for",
+    )
+    ap.add_argument(
+        "--logs", action="append", default=[],
+        help="coordinator HOST:PORT to tail pg_cluster_logs from",
+    )
+    ap.add_argument(
+        "--follow", action="store_true",
+        help="with --logs: keep polling for new records",
+    )
+    ap.add_argument(
+        "--min-level", default=None,
+        help="with --logs: minimum severity "
+        "(debug < log < notice < warning < error)",
+    )
+    ap.add_argument(
+        "--node", default=None,
+        help="with --logs: only records from this node "
+        "(cn0/dnN/gtm0 — pg_cluster_health's node names)",
+    )
     args = ap.parse_args(argv)
     ok = True
+    for target in args.health:
+        h, p = _hostport(target)
+        ok = report_health(h, p, args.user, args.password) and ok
+    for target in args.logs:
+        h, p = _hostport(target)
+        ok = report_logs(
+            h, p, args.user, args.password,
+            min_level=args.min_level, node=args.node,
+            follow=args.follow,
+        ) and ok
     for target in args.wlm:
         h, p = _hostport(target)
         ok = report_wlm(h, p, args.user, args.password) and ok

@@ -28,6 +28,9 @@ def main(argv=None) -> int:
     ap.add_argument("--pg-port", type=int, default=None,
                     help="also listen for PostgreSQL v3-protocol "
                          "clients (psql/libpq/JDBC) on this port")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="OpenMetrics exporter port (0 = no listener; "
+                         "the metrics_port conf GUC works too)")
     args = ap.parse_args(argv)
 
     from opentenbase_tpu.engine import Cluster
@@ -48,6 +51,9 @@ def main(argv=None) -> int:
             gts_backend=args.gts,
         )
     server = ClusterServer(cluster, args.host, args.port).start()
+    if args.metrics_port > 0 and cluster._metrics_exporter is None:
+        exp = cluster.start_metrics_exporter(args.metrics_port)
+        print(f"metrics exporter on {exp.host}:{exp.port}", flush=True)
     pgsrv = None
     if args.pg_port is not None:
         from opentenbase_tpu.net.pgwire import PgWireServer

@@ -110,6 +110,23 @@ GUCS: dict = {
     "client_min_messages": (
         _enum("debug", "log", "notice", "warning", "error"), "notice",
     ),
+    # server logging (obs/log.py, the elog.c pipeline). Severity order is
+    # debug < log < notice < warning < error (obs.log.LEVELS); records
+    # below log_min_messages never enter the ring or the file sink.
+    "log_min_messages": (
+        _enum("debug", "log", "notice", "warning", "error"), "log",
+    ),
+    # 'ring' keeps the bounded in-memory ring only; 'file' additionally
+    # appends formatted lines under <data_dir>/<log_directory>/otb.log
+    "log_destination": (_enum("ring", "file"), "ring"),
+    "log_directory": (_str, "log"),
+    # per-node OpenMetrics exporter (obs/exporter.py): 0 = no listener
+    # socket at all (off, the default); >0 = serve GET /metrics there
+    "metrics_port": (_int, 0),
+    # auto_explain (the contrib module): statements running at least
+    # this many ms get their instrumented plan logged at level 'log';
+    # -1 = off (PG's auto_explain.log_min_duration contract), 0 = all
+    "auto_explain_min_duration_ms": (_duration, -1),
     # matview serving path (matview/rewrite.py): a SELECT whose
     # canonical text exactly matches a FRESH materialized view's
     # defining query is answered from the matview instead of the fact

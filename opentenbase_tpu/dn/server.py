@@ -36,6 +36,7 @@ from opentenbase_tpu.net.protocol import (
     send_frame,
     shutdown_and_close,
 )
+from opentenbase_tpu.obs import log as _olog
 
 
 class FragmentCancelled(RuntimeError):
@@ -54,10 +55,21 @@ class DNServer:
         shard_groups: int = 256,
         host: str = "127.0.0.1",
         port: int = 0,
+        metrics_port: int = 0,
     ):
         from opentenbase_tpu.storage.replication import StandbyCluster
 
+        # this process's server log (obs/log.py): its own ring, NOT the
+        # process default — in-process test topologies host the
+        # coordinator and several DN servers in one interpreter, and
+        # each node's records must attribute to that node. Service
+        # threads bind it thread-locally so module-level emitters
+        # (fault firings, channel errors) land here too; the standby
+        # cluster's own logging (WAL recovery, replication) is pointed
+        # at it below. pg_cluster_logs() fetches it over ``log_fetch``.
+        self.log_ring = _olog.LogRing(node="dn")
         self.standby = StandbyCluster(data_dir, num_datanodes, shard_groups)
+        self.standby.cluster.log = self.log_ring
         # gids resolved by the replication stream (their 'G' frame was
         # applied here): a late/repeat 2PC decision for one of these
         # must NOT re-apply its journal payload
@@ -107,6 +119,8 @@ class DNServer:
         # its request without a reply (indistinguishable from a killed
         # process to the coordinator, while tests keep the object)
         self._crashed = False
+        # live fragment executions (pg_cluster_health's in-flight gauge)
+        self._inflight = 0
         self._lsock = socket.socket()
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((host, port))
@@ -114,6 +128,19 @@ class DNServer:
         self.host, self.port = self._lsock.getsockname()
         self._stop = threading.Event()
         self._accept: Optional[threading.Thread] = None
+        # per-node OpenMetrics exporter (metrics_port GUC semantics:
+        # 0 = no listener socket at all)
+        self._metrics_exporter = None
+        if metrics_port > 0:
+            from opentenbase_tpu.obs.exporter import (
+                MetricsExporter,
+                render_cluster_metrics,
+            )
+
+            self._metrics_exporter = MetricsExporter(
+                lambda: render_cluster_metrics(self.standby.cluster),
+                port=metrics_port,
+            )
 
     def start(self) -> "DNServer":
         self._accept = threading.Thread(target=self._accept_loop, daemon=True)
@@ -122,6 +149,8 @@ class DNServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._metrics_exporter is not None:
+            self._metrics_exporter.stop()
         shutdown_and_close(self._lsock)
         with self._peer_mu:
             for pool in self._peer_pools.values():
@@ -150,6 +179,9 @@ class DNServer:
 
     # -- RPC loop ---------------------------------------------------------
     def _serve(self, conn: socket.socket) -> None:
+        # everything this service thread emits — including module-level
+        # fault-firing records — belongs to THIS node's server log
+        _olog.set_thread_ring(self.log_ring)
         try:
             while not self._stop.is_set():
                 msg = recv_frame(conn)
@@ -182,6 +214,11 @@ class DNServer:
         self._crashed = True
         shutdown_and_close(self._lsock)
         self._bump("injected_crashes")
+        self.log_ring.emit(
+            "warning", "fault",
+            "injected crash_node: datanode down "
+            "(listener closed, connections dropping)",
+        )
 
     def _failpoint(self, site: str, **ctx):
         """Evaluate one FAULT site with the DN's crash_node semantics
@@ -214,6 +251,18 @@ class DNServer:
             return {"ok": True, "cleared": n}
         if op == "fault_stats":
             return {"ok": True, "rows": [list(r) for r in _fault.stats()]}
+        if op == "log_fetch":
+            # ship this node's server-log ring to the coordinator
+            # (pg_cluster_logs' merge). Answers even on a 'crashed'
+            # node only for surviving channels — like fault ops, the
+            # control plane a respawned process would provide — but
+            # this op sits BELOW the crashed gate on purpose: a dead
+            # node ships nothing until it is revived.
+            rows = self.log_ring.rows(
+                msg.get("min_level"),
+                float(msg.get("since_ts") or 0.0),
+            )
+            return {"ok": True, "rows": [list(r) for r in rows]}
         self._failpoint("dn/dispatch", op=op)
         if op == "cancel_fragment":
             tok = str(msg.get("token") or "")
@@ -227,9 +276,13 @@ class DNServer:
             self._exch_gc()  # periodic sweep rides the health checks
             with self._stats_mu:
                 st = dict(self.stats)
+                inflight = self._inflight
             out = {
                 "ok": True, "applied": self.standby.applied,
                 "dml_stats": st,
+                # pg_cluster_health's per-node gauges ride the heartbeat
+                "inflight": inflight,
+                "armed_faults": len(_fault.armed()),
             }
             if self._promoted_srv is not None:
                 out["promoted"] = True
@@ -607,6 +660,10 @@ class DNServer:
         )
         self._accept.start()
         self._bump("revives")
+        self.log_ring.emit(
+            "log", "fault",
+            f"datanode revived: listening again on {self.port}",
+        )
 
     def _wait_applied(
         self, lsn: int, timeout_s: float = 90.0, cancelled=None
@@ -625,6 +682,18 @@ class DNServer:
         from opentenbase_tpu.plan import serde
 
         node = int(msg["node"])
+        with self._stats_mu:
+            self._inflight += 1
+        try:
+            return self._exec_fragment_inner(msg, node)
+        finally:
+            with self._stats_mu:
+                self._inflight -= 1
+
+    def _exec_fragment_inner(self, msg: dict, node: int) -> dict:
+        from opentenbase_tpu.executor.local import LocalExecutor
+        from opentenbase_tpu.plan import serde
+
         self._failpoint("dn/exec_fragment", node=node)
         # the coordinator's abandon message (cancel_fragment) is keyed
         # by this token; cancelled() is polled at every batch/operator
@@ -750,10 +819,15 @@ def main(argv=None) -> None:
     ap.add_argument("--listen-port", type=int, default=0)
     ap.add_argument("--num-datanodes", type=int, default=2)
     ap.add_argument("--shard-groups", type=int, default=256)
+    ap.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="OpenMetrics exporter port (0 = no listener)",
+    )
     args = ap.parse_args(argv)
     srv = DNServer(
         args.data_dir, args.wal_host, args.wal_port,
         args.num_datanodes, args.shard_groups, port=args.listen_port,
+        metrics_port=args.metrics_port,
     ).start()
     print(f"READY {srv.port}", flush=True)
     try:

@@ -276,13 +276,28 @@ class Cluster:
         # so both can record waits from their first acquisition.
         from opentenbase_tpu.obs import (
             MetricsRegistry,
+            ProgressRegistry,
             Tracer,
             WaitEventRegistry,
         )
+        from opentenbase_tpu.obs import log as _olog
 
         self.tracer = Tracer()
         self.metrics = MetricsRegistry()
         self.waits = WaitEventRegistry()
+        # structured server log (obs/log.py): the coordinator writes to
+        # the process-default ring (a DN server process rebinds its own);
+        # pg_cluster_logs() merges this ring with every DN's and the GTM's
+        self.log = _olog.default_ring()
+        # command progress (obs/progress.py): pg_stat_progress_* views
+        self.progress = ProgressRegistry()
+        # pg_stat_reset() bookkeeping: epoch of the last counter reset
+        # (0.0 = never), surfaced as the stats_reset column
+        self.stats_reset_at = 0.0
+        # datanode heartbeat bookkeeping for pg_cluster_health / the
+        # exporter gauges: node -> {"ok", "ok_ts", "applied", ...}
+        self._dn_health: dict[int, dict] = {}
+        self._metrics_exporter = None
         self.locks = LockManager(self)
         from opentenbase_tpu.audit import AuditManager
 
@@ -330,6 +345,10 @@ class Cluster:
         # incremented from concurrent session threads, so guarded
         self.dml_stats: dict = {"shipped": 0, "stream_only": 0}
         self._dml_stats_mu = _threading.Lock()
+        # cluster-lifetime fragment self-healing counters: the exporter
+        # renders these (a sum over LIVE sessions would drop when a
+        # session closes — a Prometheus counter must never go backwards)
+        self.frag_heal_stats: dict = {"retries": 0, "failovers": 0}
         # in-doubt 2PC resolver counters (pg_stat_2pc): bumped from the
         # admin fn, the background loop, and concurrent sessions
         self.twophase_stats: dict = {
@@ -355,6 +374,27 @@ class Cluster:
         from opentenbase_tpu import config as _config
 
         self.conf_gucs: dict = _config.load_conf(data_dir)
+        # server-log configuration (obs/log.py): honor log_min_messages
+        # from the conf file (SET updates it at runtime too), and attach
+        # the file sink when log_destination = file asks for one. The
+        # threshold is set UNCONDITIONALLY: the ring is process-shared
+        # (elog.c's per-process server log), so a previous cluster's SET
+        # must not leak into this one's default.
+        self.log.set_min_level(
+            self.conf_gucs.get("log_min_messages")
+            or _config.GUCS["log_min_messages"][1]
+        )
+        self._log_file_attached = False
+        if (
+            data_dir is not None
+            and self.conf_gucs.get("log_destination") == "file"
+        ):
+            self.log.attach_file(os.path.join(
+                data_dir,
+                str(self.conf_gucs.get("log_directory") or "log"),
+                "otb.log",
+            ))
+            self._log_file_attached = True
         # GTM HA: point the native GTS client's failover at the standby
         # frontend (gtm_standby_addr = 'host:port' in opentenbase.conf)
         _sb = str(self.conf_gucs.get("gtm_standby_addr") or "")
@@ -420,6 +460,18 @@ class Cluster:
                         )
 
                 self.gts._on_replicate = _seq_feed
+        # per-node OpenMetrics exporter (obs/exporter.py): off unless the
+        # metrics_port GUC asks for a listener — exporter-off must mean
+        # zero listener sockets, not a disabled endpoint
+        mport = int(self.conf_gucs.get("metrics_port") or 0)
+        if mport > 0:
+            try:
+                self.start_metrics_exporter(mport)
+            except OSError as e:
+                self.log.emit(
+                    "error", "exporter",
+                    f"metrics exporter failed to bind port {mport}: {e}",
+                )
 
     @classmethod
     def recover(
@@ -515,6 +567,62 @@ class Cluster:
         pool = self.dn_channels.pop(node, None)
         if pool is not None:
             pool.close()
+        self._dn_health.pop(node, None)
+
+    # -- telemetry plane (obs/) ------------------------------------------
+    def start_metrics_exporter(self, port: int = 0, host: str = "127.0.0.1"):
+        """Open the per-node OpenMetrics listener (the metrics_port GUC's
+        engine half; port 0 = ephemeral, for tests). Idempotent-ish: a
+        second call replaces the first listener."""
+        from opentenbase_tpu.obs.exporter import (
+            MetricsExporter,
+            render_cluster_metrics,
+        )
+
+        if self._metrics_exporter is not None:
+            self._metrics_exporter.stop()
+        self._metrics_exporter = MetricsExporter(
+            lambda: render_cluster_metrics(self), host=host, port=port,
+        )
+        self.log.emit(
+            "log", "exporter",
+            f"metrics exporter listening on "
+            f"{self._metrics_exporter.host}:{self._metrics_exporter.port}",
+        )
+        return self._metrics_exporter
+
+    def probe_datanodes(self, timeout_s: float = 2.0) -> dict:
+        """One liveness round over every attached DN process (the
+        clustermon heartbeat): a fresh short-lived channel per node —
+        no connect retries, so a crashed node answers 'down' in one
+        refused connect instead of a backoff ladder — recording
+        applied LSN, in-flight fragments, and armed faults into
+        ``_dn_health`` for pg_cluster_health and the exporter gauges."""
+        import time as _time
+
+        from opentenbase_tpu.net.pool import Channel
+
+        for n, pool in sorted((self.dn_channels or {}).items()):
+            h = self._dn_health.setdefault(n, {})
+            h["ts"] = _time.time()
+            try:
+                ch = Channel(
+                    pool.host, pool.port, timeout=timeout_s,
+                    connect_retries=0,
+                )
+                try:
+                    resp = ch.rpc({"op": "ping"}, timeout_s=timeout_s)
+                finally:
+                    ch.close()
+                h["ok"] = bool(resp.get("ok"))
+                if h["ok"]:
+                    h["ok_ts"] = h["ts"]
+                h["applied"] = int(resp.get("applied") or 0)
+                h["inflight"] = int(resp.get("inflight") or 0)
+                h["armed_faults"] = int(resp.get("armed_faults") or 0)
+            except Exception:
+                h["ok"] = False
+        return self._dn_health
 
     def session(self) -> "Session":
         s = Session(self)
@@ -913,6 +1021,15 @@ class Cluster:
                     self.gts.forget(info.gxid)
                 except Exception:
                     pass
+            # every resolution decision is server-log material: after a
+            # coordinator crash the operator reconstructs what happened
+            # to each gid from here, not from a debugger
+            self.log.emit(
+                "warning" if outcome.endswith("_retry") else "log",
+                "2pc", f"in-doubt transaction {outcome}",
+                gid=gid, outcome=outcome,
+                datanodes=",".join(map(str, dn_votes.get(gid, []))),
+            )
             out.append((gid, outcome))
         return out
 
@@ -1050,6 +1167,12 @@ class Cluster:
     def close(self) -> None:
         """Release external resources: the native GTS subprocess (if any)
         and the WAL file handle. Idempotent."""
+        if self._metrics_exporter is not None:
+            self._metrics_exporter.stop()
+            self._metrics_exporter = None
+        if getattr(self, "_log_file_attached", False):
+            self.log.close_file()
+            self._log_file_attached = False
         if self._autovacuum_stop is not None:
             self._autovacuum_stop()
             self._autovacuum_stop = None
@@ -1129,6 +1252,10 @@ class Session:
         # (pg_stat_cluster_activity surfaces both)
         self.frag_retries = 0
         self.frag_failovers = 0
+        # auto_explain (obs/): the last instrumented (dplan, info) pair
+        # stashed by _run_statement_plan while the GUC is on, consumed
+        # by _maybe_auto_explain once the statement's duration is known
+        self._auto_explain_last = None
 
     def close(self) -> None:
         """Backend-exit cleanup (the tcop loop's on-exit path): release
@@ -1187,14 +1314,31 @@ class Session:
                 # FGA probes for destructive statements must see the data
                 # BEFORE the statement removes/masks it
                 fga_pre = self._fga_prehits(s)
+                # a stale stash from an errored statement must never be
+                # rendered under the NEXT statement's query text
+                if self._phase_acc is None:
+                    self._auto_explain_last = None
                 try:
                     r = self._execute_one(s)
-                except Exception:
+                except Exception as exc:
                     self._audit_statement(s, success=False,
                                           fga_pre=fga_pre)
+                    # elog.c logs every ERROR to the server log; a
+                    # statement failure must be visible without a
+                    # client attached (nested internal statements log
+                    # through their outer statement)
+                    if self._phase_acc is None:
+                        self.cluster.log.emit(
+                            "error", "statement",
+                            f"{type(exc).__name__}: {exc}",
+                            session=self.session_id,
+                            sqlstate=getattr(exc, "sqlstate", None),
+                            query=self.last_query[:200],
+                        )
                     raise
                 self._audit_statement(s, success=True, fga_pre=fga_pre)
                 ms = (_time.perf_counter() - t0) * 1000
+                self._maybe_auto_explain(s, ms)
                 if isinstance(
                     s, (A.Select, A.Insert, A.Update, A.Delete, A.ExecuteStmt)
                 ):
@@ -1270,6 +1414,61 @@ class Session:
         acc = self._phase_acc
         if acc is not None:
             acc[name] = acc.get(name, 0.0) + ms
+
+    # -- auto_explain (the contrib module; obs/log.py sink) ---------------
+    def _auto_explain_threshold_ms(self) -> int:
+        """-1 = off; otherwise the minimum duration that gets logged."""
+        return self._duration_ms(
+            self.gucs.get("auto_explain_min_duration_ms", -1),
+            "auto_explain_min_duration_ms",
+        )
+
+    def _maybe_auto_explain(self, stmt: A.Statement, ms: float) -> None:
+        """Log a slow statement's instrumented plan at level 'log' (the
+        auto_explain contract): called once per top-level statement with
+        its wall duration. EXPLAIN itself is exempt (the user already
+        has the plan), as are nested internal statements and the matview
+        machinery's internal reads."""
+        if self._phase_acc is not None or self._matview_internal:
+            return  # nested internal statement
+        if isinstance(stmt, (A.ExplainStmt, A.SetStmt, A.ShowStmt)):
+            return
+        threshold = self._auto_explain_threshold_ms()
+        if threshold < 0 or ms < threshold:
+            if threshold < 0:
+                self._auto_explain_last = None
+            return
+        stash, self._auto_explain_last = self._auto_explain_last, None
+        lines: list[str] = []
+        if stash is not None:
+            dplan, info = stash
+            try:
+                lines = dplan.explain().splitlines()
+                if info.get("mode") == "fused":
+                    ph = info.get("phases") or {}
+                    lines.append(
+                        "Fused device execution: "
+                        f"compile={ph.get('compile_ms', 0.0):.3f} ms "
+                        f"device={ph.get('device_ms', 0.0):.3f} ms "
+                        f"host_merge={ph.get('host_ms', 0.0):.3f} ms"
+                    )
+                else:
+                    from opentenbase_tpu.obs.explain import (
+                        analyze_report,
+                        fragment_summary,
+                    )
+
+                    ex = info["executor"]
+                    lines += analyze_report(dplan, ex)
+                    lines += fragment_summary(ex)
+            except Exception:
+                lines = ["(plan rendering failed)"]
+        self.cluster.log.emit(
+            "log", "auto_explain",
+            f"duration: {ms:.3f} ms  statement: {self.last_query[:200]}",
+            session=self.session_id, duration_ms=round(ms, 3),
+            plan="\n".join(lines) if lines else None,
+        )
 
     # -- row/table locking (lmgr.py) -------------------------------------
     @staticmethod
@@ -2920,10 +3119,67 @@ class Session:
         "pg_fault_inject",
         "pg_fault_clear",
         "pg_resolve_indoubt",
+        # telemetry plane (obs/): counter reset
+        "pg_stat_reset",
     }
     # FROM-less builtins that mutate nothing: the wire front ends may
     # class them as plain reads (pg_sleep is the WLM/timeout test probe)
-    _READONLY_ADMIN_FUNCS = {"pg_sleep", "pg_export_traces"}
+    _READONLY_ADMIN_FUNCS = {
+        "pg_sleep", "pg_export_traces", "pg_cluster_logs",
+    }
+
+    def _pg_cluster_logs(self, e: A.FuncCall) -> Result:
+        """pg_cluster_logs([min_level[, node]]) — the merged, time-
+        ordered server log of the whole cluster: the coordinator's own
+        ring, every attached DN server process's ring (shipped over the
+        ``log_fetch`` protocol op), and the GTM's. Rows:
+        (ts, level, node, component, message, context)."""
+        min_level = (
+            str(self._const_arg(e.args[0])) if len(e.args) >= 1 else None
+        )
+        node_filter = (
+            str(self._const_arg(e.args[1])) if len(e.args) >= 2 else None
+        )
+        if min_level is not None and min_level.lower() not in (
+            "debug", "log", "notice", "warning", "error"
+        ):
+            raise SQLError(
+                f"unknown log level {min_level!r} (expected debug < log "
+                "< notice < warning < error)"
+            )
+        recs = list(self.cluster.log.rows(min_level))
+        # DN server processes ship their rings; rows are labeled with
+        # the coordinator's node name for the channel (the DN process
+        # itself does not know its mesh index)
+        for n, ch in sorted(
+            (getattr(self.cluster, "dn_channels", None) or {}).items()
+        ):
+            try:
+                resp = ch.rpc({
+                    "op": "log_fetch", "min_level": min_level,
+                })
+            except Exception:
+                continue  # an unreachable DN ships nothing — its
+                # failure is visible in pg_cluster_health instead
+            for r in resp.get("rows", []):
+                recs.append((
+                    float(r[0]), str(r[1]), f"dn{n}", str(r[3]),
+                    str(r[4]), str(r[5]),
+                ))
+        gtm_ring = getattr(self.cluster.gts, "log_ring", None)
+        if gtm_ring is not None:
+            recs.extend(gtm_ring.rows(min_level))
+        if node_filter is not None:
+            recs = [r for r in recs if r[2] == node_filter]
+        recs.sort(key=lambda r: r[0])
+        rows = [
+            (float(r[0]), r[1], r[2], r[3], r[4], r[5]) for r in recs
+        ]
+        return Result(
+            "SELECT", rows,
+            ["ts", "level", "node", "component", "message", "context"],
+            len(rows),
+        )
 
     def _pg_export_traces(self, e: A.FuncCall) -> Result:
         """pg_export_traces([last_n]) — the cluster's recent query
@@ -3046,6 +3302,30 @@ class Session:
             rows = self.cluster.resolve_indoubt(min_age_s=age)
             return Result(
                 "SELECT", rows, ["gid", "outcome"], len(rows)
+            )
+        if e.name == "pg_stat_reset":
+            # zero the accumulating statement/phase/wait/DML counters
+            # (pg_stat_reset's contract). Fault counters are excluded —
+            # they are chaos-run evidence owned by pg_fault_clear /
+            # fault.reset_stats, and pg_stat_progress_* rows are live
+            # state, not counters.
+            import time as _time
+
+            c = self.cluster
+            c.stat_statements.clear()
+            c.metrics.reset()
+            c.waits.reset()
+            with c._dml_stats_mu:
+                for k in c.dml_stats:
+                    c.dml_stats[k] = 0
+            c.stats_reset_at = _time.time()
+            c.log.emit(
+                "notice", "stats",
+                "statement/phase/wait/DML statistics reset",
+                session=self.session_id,
+            )
+            return Result(
+                "SELECT", [("",)], ["pg_stat_reset"], 1
             )
         locks = self.cluster.locks
         if e.name == "pg_unlock_execute":
@@ -3680,7 +3960,19 @@ class Session:
         with self._phased("plan"):
             dplan = distribute_statement(splan, self.cluster.catalog)
         snapshot = self._snapshot()
-        batch, _info = self._execute_dplan(dplan, snapshot)
+        # auto_explain: while the GUC is armed every plan runs with
+        # per-operator instrumentation on (auto_explain.log_analyze),
+        # stashed so _maybe_auto_explain can render the tree if the
+        # statement ends up over the threshold
+        instrument = (
+            not self._matview_internal
+            and self._auto_explain_threshold_ms() >= 0
+        )
+        batch, info = self._execute_dplan(
+            dplan, snapshot, instrument=instrument
+        )
+        if instrument:
+            self._auto_explain_last = (dplan, info)
         return batch
 
     def _execute_dplan(
@@ -3733,6 +4025,7 @@ class Session:
                 instrument_ops=instrument,
                 trace=self._trace,
                 waits=self.cluster.waits,
+                log=self.cluster.log,
                 session_id=self.session_id,
                 fragment_retries=self.gucs.get("fragment_retries", 2),
                 retry_backoff_ms=self._duration_ms(
@@ -3747,6 +4040,10 @@ class Session:
                 # that exhausted its retries should still show them
                 self.frag_retries += ex.retry_stats["retries"]
                 self.frag_failovers += ex.retry_stats["failovers"]
+                with self.cluster._dml_stats_mu:
+                    hs = self.cluster.frag_heal_stats
+                    hs["retries"] += ex.retry_stats["retries"]
+                    hs["failovers"] += ex.retry_stats["failovers"]
             motion_ms = sum(
                 m["ms"] for m in ex.motion_stats.values()
                 if m.get("ms") is not None
@@ -6218,28 +6515,15 @@ class Session:
                             f"{frag_ms[k]:.3f} ms"
                         )
             else:
-                from opentenbase_tpu.obs.explain import analyze_report
+                from opentenbase_tpu.obs.explain import (
+                    analyze_report,
+                    fragment_summary,
+                )
 
                 ex = info["executor"]
                 lines += analyze_report(dplan, ex, verbose=stmt.verbose)
                 lines.append("")
-                for i in ex.instrumentation:
-                    extra = ""
-                    if "total_blocks" in i:
-                        extra = (
-                            f" pruned={i['pruned_blocks']}/"
-                            f"{i['total_blocks']} blocks"
-                        )
-                    if i.get("retries"):
-                        # self-healing reads: the retry/failover story
-                        # is part of the execution record
-                        extra += f" retries={i['retries']}"
-                    if i.get("failover"):
-                        extra += f" failover={i['failover']}"
-                    lines.append(
-                        f"Fragment {i['fragment']} on dn{i['node']}: "
-                        f"rows={i['rows']} time={i['ms']:.3f} ms" + extra
-                    )
+                lines += fragment_summary(ex)
             lines.append(
                 f"Total: rows={out.nrows} time={total_ms:.3f} ms"
             )
@@ -6269,6 +6553,11 @@ class Session:
             # audited statements carry the effective user (pg_audit's
             # db_user dimension)
             self.user = str(stmt.value)
+        if stmt.name == "log_min_messages":
+            # the GUC is finally CONSULTED: the ring filters at emit
+            # time, so the threshold lives on the ring (server-wide, as
+            # the reference's postmaster-level GUC is)
+            self.cluster.log.set_min_level(str(v))
         self.gucs[stmt.name] = v
         return Result("SET")
 
@@ -6589,6 +6878,7 @@ def _sv_stat_statements(c: Cluster):
     """Enriched per-statement stats (stormstats + pg_stat_statements):
     plan vs exec split and min/max/mean/stddev over calls."""
     rows = []
+    reset = float(c.stats_reset_at)
     for q, ent in c.stat_statements.items():
         calls = ent[0]
         mean = ent[1] / calls if calls else 0.0
@@ -6598,21 +6888,31 @@ def _sv_stat_statements(c: Cluster):
             round(ent[3], 3), round(ent[4], 3),
             round(ent[5] or 0.0, 3), round(ent[6], 3),
             round(mean, 3), round(var ** 0.5, 3),
+            reset,
         ))
     return rows
 
 
 def _sv_wait_events(c: Cluster):
     """Cumulative wait events (obs/waits.py): locks, pool channels,
-    WLM admission queues, remote-fragment RPCs."""
-    return c.waits.rows()
+    WLM admission queues, remote-fragment RPCs, retry backoffs — plus
+    the fault-injected delay/hang windows (chaos must be legible in
+    the wait model, not vanish from it)."""
+    from opentenbase_tpu import fault as _fault
+
+    reset = float(c.stats_reset_at)
+    rows = [r + (reset,) for r in c.waits.rows()]
+    for site, count, total_ms in _fault.wait_rows():
+        rows.append(("FaultInjection", site, count, total_ms, reset))
+    return rows
 
 
 def _sv_query_phases(c: Cluster):
     """Per-phase latency split (parse/plan/queue/execute + the fused
     path's compile/device/host and host-path motion) with p50/p95/p99
     from the fixed-bucket histograms in obs/metrics.py."""
-    return c.metrics.phase_rows()
+    reset = float(c.stats_reset_at)
+    return [r + (reset,) for r in c.metrics.phase_rows()]
 
 
 def _sv_shard_map(c: Cluster):
@@ -6698,9 +6998,10 @@ def _sv_dml(c: Cluster):
     write set inside the 2PC prepare vs relied on stream-only
     replication, plus each attached DN's direct-apply/gap-defer
     counts."""
+    reset = float(c.stats_reset_at)
     rows = [
-        ("cn.shipped", int(c.dml_stats.get("shipped", 0))),
-        ("cn.stream_only", int(c.dml_stats.get("stream_only", 0))),
+        ("cn.shipped", int(c.dml_stats.get("shipped", 0)), reset),
+        ("cn.stream_only", int(c.dml_stats.get("stream_only", 0)), reset),
     ]
     for n, ch in sorted(getattr(c, "dn_channels", {}).items()):
         try:
@@ -6708,7 +7009,7 @@ def _sv_dml(c: Cluster):
         except Exception:
             continue
         for k in sorted(st):
-            rows.append((f"dn{n}.{k}", int(st[k])))
+            rows.append((f"dn{n}.{k}", int(st[k]), reset))
     return rows
 
 
@@ -6902,6 +7203,98 @@ def _sv_faults(c: Cluster):
     return rows
 
 
+def _sv_progress_refresh(c: Cluster):
+    """pg_stat_progress_refresh: in-flight (and the last finished)
+    REFRESH MATERIALIZED VIEW — phase, deltas decoded/applied, rows."""
+    rows = []
+    for kind, sid, target, state, ms, f in c.progress.rows("refresh"):
+        rows.append((
+            sid, target, str(f.get("phase", "")),
+            int(f.get("deltas_decoded", 0)),
+            int(f.get("deltas_applied", 0)),
+            int(f.get("rows", 0)),
+            float(ms), state,
+        ))
+    return rows
+
+
+def _sv_progress_checkpoint(c: Cluster):
+    """pg_stat_progress_checkpoint: store snapshotting progress."""
+    rows = []
+    for kind, sid, target, state, ms, f in c.progress.rows("checkpoint"):
+        rows.append((
+            str(f.get("phase", "")),
+            int(f.get("tables_total", 0)),
+            int(f.get("tables_done", 0)),
+            int(f.get("wal_position", 0)),
+            float(ms), state,
+        ))
+    return rows
+
+
+def _sv_progress_recovery(c: Cluster):
+    """pg_stat_progress_recovery: WAL replay position vs end."""
+    rows = []
+    for kind, sid, target, state, ms, f in c.progress.rows("recovery"):
+        rows.append((
+            str(f.get("phase", "")),
+            int(f.get("wal_replay_lsn", 0)),
+            int(f.get("wal_end_lsn", 0)),
+            int(f.get("records_applied", 0)),
+            float(ms), state,
+        ))
+    return rows
+
+
+def _sv_cluster_health(c: Cluster):
+    """pg_cluster_health: one row per node — role, liveness, heartbeat
+    age, replication lag, in-flight fragments, armed faults. THE view a
+    chaos run is watched (and watched healing) through: a crash_node'd
+    DN shows up=false with a growing heartbeat age, and flips back
+    after pg_fault_clear revives it."""
+    import time as _time
+
+    from opentenbase_tpu import fault as _fault
+
+    rows = []
+    # coordinator: always this process; its armed faults are local
+    active = sum(1 for s in c.sessions if s.state == "active")
+    rows.append((
+        "cn0", "coordinator", True, 0.0, 0, active,
+        len(_fault.armed()),
+    ))
+    try:
+        gts_ok = (
+            c.gts.ping() if hasattr(c.gts, "ping")
+            else c.gts.get_gts() > 0
+        )
+    except Exception:
+        gts_ok = False
+    rows.append(("gtm0", "gtm", bool(gts_ok), 0.0, 0, 0, 0))
+    chans = getattr(c, "dn_channels", None) or {}
+    if chans:
+        c.probe_datanodes()
+    now = _time.time()
+    wal_pos = int(c.persistence.wal.position) if c.persistence else 0
+    for n in c.nodes.datanode_indices():
+        h = c._dn_health.get(n)
+        if n not in chans:
+            # in-process data plane: the DN *is* this process
+            rows.append((f"dn{n}", "datanode", True, 0.0, 0, 0, 0))
+            continue
+        up = bool(h and h.get("ok"))
+        ok_ts = (h or {}).get("ok_ts")
+        age = round(now - ok_ts, 3) if ok_ts else -1.0
+        lag = max(wal_pos - int((h or {}).get("applied") or 0), 0)
+        rows.append((
+            f"dn{n}", "datanode", up, age,
+            lag if up else -1,
+            int((h or {}).get("inflight") or 0) if up else 0,
+            int((h or {}).get("armed_faults") or 0) if up else 0,
+        ))
+    return rows
+
+
 def _sv_2pc(c: Cluster):
     """pg_stat_2pc: in-doubt resolver counters + the live prepared
     registry size."""
@@ -7079,6 +7472,7 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
             "max_ms": t.FLOAT8,
             "mean_ms": t.FLOAT8,
             "stddev_ms": t.FLOAT8,
+            "stats_reset": t.FLOAT8,
         },
         _sv_stat_statements,
     ),
@@ -7088,6 +7482,7 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
             "wait_event": t.TEXT,
             "count": t.INT8,
             "total_ms": t.FLOAT8,
+            "stats_reset": t.FLOAT8,
         },
         _sv_wait_events,
     ),
@@ -7100,6 +7495,7 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
             "p50_ms": t.FLOAT8,
             "p95_ms": t.FLOAT8,
             "p99_ms": t.FLOAT8,
+            "stats_reset": t.FLOAT8,
         },
         _sv_query_phases,
     ),
@@ -7129,7 +7525,7 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
         _sv_fused,
     ),
     "pg_stat_dml": (
-        {"stat": t.TEXT, "value": t.INT8},
+        {"stat": t.TEXT, "value": t.INT8, "stats_reset": t.FLOAT8},
         _sv_dml,
     ),
     "pg_stat_wlm": (
@@ -7192,6 +7588,53 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
     "pg_stat_2pc": (
         {"stat": t.TEXT, "value": t.INT8},
         _sv_2pc,
+    ),
+    "pg_stat_progress_refresh": (
+        {
+            "session_id": t.INT4,
+            "matviewname": t.TEXT,
+            "phase": t.TEXT,
+            "deltas_decoded": t.INT8,
+            "deltas_applied": t.INT8,
+            "rows": t.INT8,
+            "elapsed_ms": t.FLOAT8,
+            "state": t.TEXT,
+        },
+        _sv_progress_refresh,
+    ),
+    "pg_stat_progress_checkpoint": (
+        {
+            "phase": t.TEXT,
+            "tables_total": t.INT8,
+            "tables_done": t.INT8,
+            "wal_position": t.INT8,
+            "elapsed_ms": t.FLOAT8,
+            "state": t.TEXT,
+        },
+        _sv_progress_checkpoint,
+    ),
+    "pg_stat_progress_recovery": (
+        {
+            "phase": t.TEXT,
+            "wal_replay_lsn": t.INT8,
+            "wal_end_lsn": t.INT8,
+            "records_applied": t.INT8,
+            "elapsed_ms": t.FLOAT8,
+            "state": t.TEXT,
+        },
+        _sv_progress_recovery,
+    ),
+    "pg_cluster_health": (
+        {
+            "node_name": t.TEXT,
+            "role": t.TEXT,
+            "up": t.BOOL,
+            "heartbeat_age_s": t.FLOAT8,
+            "replication_lag_bytes": t.INT8,
+            "inflight_fragments": t.INT8,
+            "armed_faults": t.INT8,
+        },
+        _sv_cluster_health,
     ),
 }
 

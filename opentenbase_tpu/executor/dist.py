@@ -188,6 +188,7 @@ class DistExecutor:
         instrument_ops: bool = False,  # per-operator EXPLAIN ANALYZE
         trace=None,  # obs.trace.QueryTrace (None = untraced)
         waits=None,  # obs.waits.WaitEventRegistry
+        log=None,  # obs.log.LogRing (None = unlogged, e.g. bare tests)
         session_id: int = 0,
         fragment_retries: int = 2,  # extra remote attempts per fragment
         retry_backoff_ms: float = 25.0,  # base backoff (doubles per try)
@@ -227,6 +228,7 @@ class DistExecutor:
         self.instrument_ops = instrument_ops
         self.trace = trace
         self.waits = waits
+        self.log = log
         self.session_id = session_id
         self.instrumentation: list[dict] = []
         self.op_instrumentation: list[dict] = []
@@ -410,7 +412,7 @@ class DistExecutor:
                                 qxid=qxid,
                             )
                             break
-                        except ChannelError:
+                        except ChannelError as ce:
                             # bounded-backoff retry (reads only — which
                             # is everything that reaches this loop),
                             # then failover below; never past the
@@ -422,6 +424,17 @@ class DistExecutor:
                                 # failover: the coordinator's own
                                 # stores ARE the caught-up copy the DN
                                 # was replicating (primary-side read)
+                                if self.log is not None:
+                                    self.log.emit(
+                                        "warning", "executor",
+                                        f"remote fragment "
+                                        f"{frag.index} on dn{node} "
+                                        "failed over to local stores",
+                                        session=self.session_id,
+                                        fragment=frag.index,
+                                        node=node, retries=retries,
+                                        error=str(ce)[:200],
+                                    )
                                 rows, batch, _ex = (
                                     self._exec_local_fragment(
                                         frag, node, motioned,
@@ -433,13 +446,39 @@ class DistExecutor:
                                 break
                             retries += 1
                             self.retry_stats["retries"] += 1
+                            if self.log is not None:
+                                self.log.emit(
+                                    "warning", "executor",
+                                    f"retrying remote fragment "
+                                    f"{frag.index} on dn{node} "
+                                    f"(attempt {retries + 1})",
+                                    session=self.session_id,
+                                    fragment=frag.index, node=node,
+                                    attempt=retries,
+                                    error=str(ce)[:200],
+                                )
                             delay = (
                                 self.retry_backoff_ms
                                 * (2 ** (retries - 1))
                                 / 1000.0
                             )
                             if delay > 0:
-                                _time.sleep(min(delay, 2.0))
+                                # the backoff sleep is a real wait —
+                                # pg_stat_wait_events must show where
+                                # a chaos run's time went
+                                wt = (
+                                    self.waits.begin(
+                                        self.session_id, "Timeout",
+                                        "RetryBackoff",
+                                    )
+                                    if self.waits is not None
+                                    else None
+                                )
+                                try:
+                                    _time.sleep(min(delay, 2.0))
+                                finally:
+                                    if wt is not None:
+                                        self.waits.end(wt)
                     if batch is not None:
                         outs[node] = batch
                     t1 = _time.perf_counter()

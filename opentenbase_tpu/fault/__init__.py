@@ -66,6 +66,7 @@ __all__ = [
     "stats",
     "armed",
     "site_rng",
+    "wait_rows",
 ]
 
 
@@ -166,10 +167,35 @@ class _Fault:
                     _ARMED.pop(self.site, None)
         if not fire:
             return None
+        # every firing leaves a server-log record (obs/log.py): a chaos
+        # run must be reconstructable from telemetry alone, not only
+        # from pg_stat_faults counters. The emit goes to the CURRENT
+        # ring — a DN server thread's own ring when the site fired
+        # inside a DN process, the coordinator's otherwise.
+        from opentenbase_tpu.obs.log import elog as _elog
+
+        _elog(
+            "log", "fault",
+            f"fault fired at {self.site!r}",
+            site=self.site, action=self.action_str(), fired=self.fired,
+            **{
+                k: str(v) for k, v in ctx.items()
+                if k not in ("site", "action", "fired")
+            },
+        )
         if self.action == "error":
             raise FaultError(f"fault injected at {self.site!r}")
         if self.action in ("delay", "hang"):
+            # the injected stall is a real wait: record it so
+            # pg_stat_wait_events tells the truth about where a chaos
+            # run's time went (type FaultInjection, event = the site)
+            t0 = time.monotonic()
             time.sleep(self.ms / 1000.0)
+            waited_ms = (time.monotonic() - t0) * 1000.0
+            with _mu:
+                ent = _wait_stats.setdefault(self.site, [0, 0.0])
+                ent[0] += 1
+                ent[1] += waited_ms
             return self.action
         if self.action == "drop_conn":
             raise FaultDropConnection(
@@ -204,6 +230,9 @@ _ARMED: dict = {}
 # site -> [arms, hits, fired]; survives clear() so pg_stat_faults keeps
 # telling the story of a chaos run after the faults are disarmed
 _stats: dict = {}
+# site -> [count, total_ms] of injected delay/hang windows — the
+# FaultInjection wait-event rows merged into pg_stat_wait_events
+_wait_stats: dict = {}
 _mu = threading.Lock()
 
 
@@ -325,6 +354,19 @@ def reset_stats() -> None:
     """Forget the cumulative counters too (test isolation)."""
     with _mu:
         _stats.clear()
+        _wait_stats.clear()
+
+
+def wait_rows() -> list:
+    """[(site, count, total_ms)] — injected delay/hang windows, the
+    FaultInjection wait-event rows (pg_stat_wait_events merges them;
+    pg_stat_reset leaves them alone — fault telemetry is owned by
+    pg_fault_clear/reset_stats)."""
+    with _mu:
+        return [
+            (site, ent[0], round(ent[1], 3))
+            for site, ent in sorted(_wait_stats.items())
+        ]
 
 
 def armed() -> dict:

@@ -238,9 +238,26 @@ class NativeGTS:
                 pass
             self._sock = sock
             if (host, port) != (self.host, self.port):
+                # GTM failover is never silent: the session survived a
+                # primary loss, and the server log must say so
+                from opentenbase_tpu.obs.log import elog as _elog
+
+                _elog(
+                    "warning", "gtm",
+                    f"GTM connection failed over from "
+                    f"{self.host}:{self.port} to {host}:{port}",
+                    error=str(err)[:200],
+                )
                 self.host, self.port = host, port
                 self.failovers += 1
             return body
+        from opentenbase_tpu.obs.log import elog as _elog
+
+        _elog(
+            "error", "gtm",
+            "GTM unreachable (primary and standby)",
+            error=str(err)[:200],
+        )
         raise GTSProtocolError(
             f"GTM unreachable (primary and standby): {err}"
         ) from err

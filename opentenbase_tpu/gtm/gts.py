@@ -145,6 +145,13 @@ class GTSServer:
         self._seqs: dict[str, _Sequence] = {}
         self._next_gxid = 1
         self._on_replicate = on_replicate
+        # the GTM's own server log (obs/log.py): pg_cluster_logs()
+        # merges it with the coordinator's and every DN's. Registration
+        # and lifecycle events land here; the per-grant hot path stays
+        # unlogged (millions of grants must not churn a ring).
+        from opentenbase_tpu.obs.log import LogRing
+
+        self.log_ring = LogRing(node="gtm0")
         # sequence durability (gtm_store.c): state file beside the clock
         # store, written log-ahead (SEQ_LOG_VALS-style: the persisted
         # next_value runs ahead of the issued one, so a crash skips at
@@ -199,6 +206,10 @@ class GTSServer:
             self._persist_nodes()
             self._rep("node_register", {"name": name,
                                         **self._nodes[name]})
+        self.log_ring.emit(
+            "log", "gtm", f"node registered: {name}",
+            name=name, kind=kind,
+        )
 
     def unregister_node(self, name: str) -> bool:
         """ProcessPGXCNodeUnregister."""

@@ -50,6 +50,13 @@ class GTSFrontend:
         look dead to its clients (their next RPC fails over to the
         standby, gtm/client.py), not leave half-open sockets that keep
         answering from a 'crashed' primary."""
+        ring = getattr(self.gts, "log_ring", None)
+        if ring is not None:
+            ring.emit(
+                "warning", "gtm",
+                f"GTM frontend stopping on {self.host}:{self.port} "
+                "(severing live backends)",
+            )
         self._stopping = True
         shutdown_and_close(self._lsock)
         with self._conns_mu:
@@ -77,6 +84,13 @@ class GTSFrontend:
 
     # -- one backend connection ------------------------------------------
     def _serve(self, conn: socket.socket) -> None:
+        # bind this service thread to the GTM's own ring so module-level
+        # emitters (fault firings at gtm/grant) attribute to the GTM
+        ring = getattr(self.gts, "log_ring", None)
+        if ring is not None:
+            from opentenbase_tpu.obs import log as _olog
+
+            _olog.set_thread_ring(ring)
         try:
             while True:
                 head = self._recv_exact(conn, 4)

@@ -137,6 +137,12 @@ class GTSStandby:
             srv._nodes = {k: dict(v) for k, v in self._nodes.items()}
             srv._persist_nodes()
             self.promoted = srv
+            srv.log_ring.emit(
+                "warning", "gtm",
+                "GTM standby promoted to primary",
+                applied_lsn=self.applied_lsn,
+                prepared=len(self._prepared),
+            )
             return srv
 
 

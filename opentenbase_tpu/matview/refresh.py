@@ -298,9 +298,21 @@ def refresh_matview(session, d: MatviewDef, concurrently: bool = False) -> dict:
     session._matview_internal = True
     plan = None
     mode = "full"
+    # progress (obs/progress.py): a long refresh is watchable from a
+    # second session through pg_stat_progress_refresh while it runs
+    prog = c.progress.begin(
+        "refresh", session.session_id, d.name,
+        phase="decode_deltas", deltas_decoded=0, deltas_applied=0,
+        rows=0,
+    )
     try:
         try:
             with gate:
+                # failpoint: stall/fail the compute phase on demand
+                # (chaos + the progress-view-mid-refresh test hook)
+                from opentenbase_tpu.fault import FAULT
+
+                FAULT("matview/refresh", matview=d.name)
                 if (
                     durable
                     and d.wants_incremental()
@@ -309,12 +321,30 @@ def refresh_matview(session, d: MatviewDef, concurrently: bool = False) -> dict:
                     plan = _plan_incremental(session, d, meta, lsn0)
                     if plan is not None:
                         mode = "incremental"
+                        prog.update(
+                            phase="compute_deltas",
+                            deltas_decoded=plan.get("deltas", 0),
+                        )
+                    else:
+                        # silent degradations are how operators lose
+                        # trust in incremental maintenance: say why the
+                        # cheap path was abandoned
+                        c.log.emit(
+                            "warning", "matview",
+                            f'materialized view "{d.name}" degrading '
+                            "to full recompute (deltas unrecoverable "
+                            "from WAL — vacuumed tuples, DDL break, or "
+                            "truncated stream)",
+                            matview=d.name,
+                        )
                 if plan is None:
+                    prog.update(phase="full_recompute")
                     plan = _plan_full(session, d, meta)
         finally:
             # the pinned read snapshot ends with the compute phase
             # (it wrote nothing); the apply runs its own transaction
             pin.release()
+        prog.update(phase="apply")
         # counters roll forward INSIDE the state row that commits with
         # the contents — a crash can't lose or double-count a refresh
         new_stats = dict(d.stats)
@@ -333,7 +363,22 @@ def refresh_matview(session, d: MatviewDef, concurrently: bool = False) -> dict:
         )
         staged.stats = new_stats
         apply_refresh(session, d, meta, plan, state_row(staged))
+        mv_rows = plan.get("mv_rows")
+        prog.update(
+            deltas_applied=plan.get("deltas", 0),
+            rows=(
+                len(next(iter(mv_rows.values()), []))
+                if mv_rows else 0
+            ),
+        )
+        refresh_ok = True
+    except BaseException:
+        refresh_ok = False
+        raise
     finally:
+        # a failed refresh must never read as a success in
+        # pg_stat_progress_refresh's last-finished row
+        prog.finish(phase="done" if refresh_ok else "failed")
         pin.release()  # no-op unless the compute phase never ran
         session._matview_internal = prev_internal
     # commit succeeded: publish the new state on the def. Only the
@@ -349,6 +394,12 @@ def refresh_matview(session, d: MatviewDef, concurrently: bool = False) -> dict:
     ms = (time.perf_counter() - t0) * 1000.0
     d.stats["last_refresh_ms"] = round(ms, 3)
     d.base_versions = versions0
+    c.log.emit(
+        "log", "matview",
+        f'refresh of "{d.name}" complete',
+        matview=d.name, mode=mode,
+        deltas=plan.get("deltas", 0), ms=round(ms, 3),
+    )
     session._note_phase("matview_refresh", ms)
     if session._trace is not None:
         session._trace.record(

@@ -159,6 +159,17 @@ class ChannelPool:
         finally:
             self.release(ch)
 
+    def occupancy(self) -> dict:
+        """Live slot accounting for the exporter's pool gauges:
+        {'size', 'in_use', 'idle'} under the pool lock."""
+        with self._lock:
+            idle = len(self._idle)
+            return {
+                "size": self.size,
+                "in_use": max(self._total - idle, 0),
+                "idle": idle,
+            }
+
     def close(self) -> None:
         """Close idle channels and refuse new acquires; in-flight
         channels are closed as they release (the _closed flag keeps

@@ -18,11 +18,28 @@ distributed EXPLAIN ANALYZE) and the wait-event columns of
   chrome://tracing) JSON export, also reachable through the
   ``otb_trace`` CLI and the ``pg_export_traces()`` admin function;
 - :mod:`opentenbase_tpu.obs.explain` — the per-operator plan-node tree
-  EXPLAIN (ANALYZE) prints, aggregated across datanodes.
+  EXPLAIN (ANALYZE) prints, aggregated across datanodes;
+- :mod:`opentenbase_tpu.obs.log`     — structured server logging (the
+  elog.c severity pipeline): bounded per-node ring + optional file sink,
+  ``log_min_messages`` filtering, merged cluster-wide through
+  ``pg_cluster_logs()``;
+- :mod:`opentenbase_tpu.obs.exporter` — per-node OpenMetrics HTTP
+  exporter (``metrics_port`` GUC) rendering the registries above;
+- :mod:`opentenbase_tpu.obs.progress` — backend_progress.c-style
+  command progress behind the ``pg_stat_progress_*`` views.
 """
 
+from opentenbase_tpu.obs.log import LogRing, elog
 from opentenbase_tpu.obs.metrics import MetricsRegistry
+from opentenbase_tpu.obs.progress import ProgressRegistry
 from opentenbase_tpu.obs.trace import Tracer
 from opentenbase_tpu.obs.waits import WaitEventRegistry
 
-__all__ = ["MetricsRegistry", "Tracer", "WaitEventRegistry"]
+__all__ = [
+    "LogRing",
+    "MetricsRegistry",
+    "ProgressRegistry",
+    "Tracer",
+    "WaitEventRegistry",
+    "elog",
+]

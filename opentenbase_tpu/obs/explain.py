@@ -114,3 +114,26 @@ def analyze_report(dplan, ex, verbose: bool = False) -> list[str]:
         lines.append("Coordinator:")
         lines += _tree_lines(coord, verbose, "  ")
     return lines
+
+
+def fragment_summary(ex) -> list[str]:
+    """Per-(fragment, node) execution summary lines — rows/time plus the
+    self-healing story (retries / failover) and zone pruning. Shared by
+    EXPLAIN ANALYZE and auto_explain so both report identically."""
+    lines: list[str] = []
+    for i in ex.instrumentation:
+        extra = ""
+        if "total_blocks" in i:
+            extra = (
+                f" pruned={i['pruned_blocks']}/"
+                f"{i['total_blocks']} blocks"
+            )
+        if i.get("retries"):
+            extra += f" retries={i['retries']}"
+        if i.get("failover"):
+            extra += f" failover={i['failover']}"
+        lines.append(
+            f"Fragment {i['fragment']} on dn{i['node']}: "
+            f"rows={i['rows']} time={i['ms']:.3f} ms" + extra
+        )
+    return lines

@@ -1,0 +1,340 @@
+"""Per-node OpenMetrics/Prometheus exporter — scrape without a SQL session.
+
+The reference fleet is scraped through postgres_exporter; here every node
+process can open its own tiny HTTP listener (``metrics_port`` GUC, off by
+default) serving ``GET /metrics`` in the Prometheus text exposition
+format, no dependencies: the existing registries render as
+
+- phase histograms  -> ``otb_phase_duration_ms`` histogram (cumulative
+  ``_bucket{le=...}`` counts + ``_sum``/``_count``), one series per phase;
+- wait events       -> ``otb_wait_events_total`` / ``otb_wait_event_ms_total``;
+- WLM / fault / 2PC / DML / matview counters -> labeled ``_total`` counters;
+- gauges            -> replication lag per connected standby (LSN delta),
+  DN channel-pool occupancy, DN heartbeat age/liveness, live sessions,
+  current WAL position.
+
+A conformance test (tests/test_telemetry.py) asserts every emitted line
+parses under the exposition grammar and that counters are monotone
+across scrapes — the contract a real Prometheus relies on.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Callable, Optional
+
+from opentenbase_tpu.net.protocol import shutdown_and_close
+
+
+def _esc(v) -> str:
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _line(name: str, labels: dict, value) -> str:
+    if labels:
+        lbl = ",".join(
+            f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+        )
+        return f"{name}{{{lbl}}} {value}"
+    return f"{name} {value}"
+
+
+def _head(out: list, name: str, kind: str, help_: str) -> None:
+    out.append(f"# HELP {name} {help_}")
+    out.append(f"# TYPE {name} {kind}")
+
+
+def render_cluster_metrics(cluster) -> str:
+    """The coordinator-side exposition document. Reads the same
+    registries the pg_stat_* views read — one source of truth."""
+    out: list[str] = []
+
+    # phase histograms (obs/metrics.py) as native prometheus histograms
+    with cluster.metrics._mu:
+        hists = sorted(
+            (k, v) for k, v in cluster.metrics.histograms.items()
+            if k.startswith("phase.")
+        )
+    if hists:
+        _head(out, "otb_phase_duration_ms", "histogram",
+              "Per-phase statement latency (parse/plan/queue/execute/...)")
+        for name, h in hists:
+            phase = name[len("phase."):]
+            with h._mu:
+                counts = list(h.counts)
+                total = h.total
+                count = h.count
+            cum = 0
+            for bound, n in zip(h.bounds, counts):
+                cum += n
+                out.append(_line(
+                    "otb_phase_duration_ms_bucket",
+                    {"phase": phase, "le": repr(float(bound))}, cum,
+                ))
+            out.append(_line(
+                "otb_phase_duration_ms_bucket",
+                {"phase": phase, "le": "+Inf"}, count,
+            ))
+            out.append(_line(
+                "otb_phase_duration_ms_sum", {"phase": phase},
+                round(total, 6),
+            ))
+            out.append(_line(
+                "otb_phase_duration_ms_count", {"phase": phase}, count,
+            ))
+
+    # wait events (obs/waits.py + fault-injection windows)
+    from opentenbase_tpu.engine import _sv_wait_events
+
+    rows = _sv_wait_events(cluster)  # (type, event, count, ms, reset)
+    if rows:
+        _head(out, "otb_wait_events_total", "counter",
+              "Completed waits by (type, event)")
+        for wtype, event, count, _ms, _reset in rows:
+            out.append(_line(
+                "otb_wait_events_total",
+                {"type": wtype, "event": event}, count,
+            ))
+        _head(out, "otb_wait_event_ms_total", "counter",
+              "Milliseconds spent waiting by (type, event)")
+        for wtype, event, _count, ms, _reset in rows:
+            out.append(_line(
+                "otb_wait_event_ms_total",
+                {"type": wtype, "event": event}, ms,
+            ))
+
+    # WLM per-group counters + live gauges
+    groups = cluster.wlm.stat_rows()
+    if groups:
+        _head(out, "otb_wlm_statements_total", "counter",
+              "WLM admission outcomes per resource group")
+        for g in groups:
+            name = g[0]
+            for stat, val in zip(
+                ("admitted", "queued", "shed", "timed_out"), g[7:11]
+            ):
+                out.append(_line(
+                    "otb_wlm_statements_total",
+                    {"group": name, "outcome": stat}, val,
+                ))
+        _head(out, "otb_wlm_running", "gauge",
+              "Statements currently admitted per resource group")
+        for g in groups:
+            out.append(_line("otb_wlm_running", {"group": g[0]}, g[5]))
+
+    # fault-injection counters (chaos evidence; process-local half)
+    from opentenbase_tpu import fault as _fault
+
+    frows = _fault.stats()
+    if frows:
+        _head(out, "otb_fault_hits_total", "counter",
+              "Armed-failpoint evaluations per site")
+        for site, _a, _t, _arms, hits, _fired, _armed in frows:
+            out.append(_line("otb_fault_hits_total", {"site": site}, hits))
+        _head(out, "otb_fault_fired_total", "counter",
+              "Failpoint firings per site")
+        for site, _a, _t, _arms, _hits, fired, _armed in frows:
+            out.append(_line(
+                "otb_fault_fired_total", {"site": site}, fired,
+            ))
+
+    # 2PC resolver + shipped-DML counters
+    with cluster._2pc_stats_mu:
+        tp = sorted(cluster.twophase_stats.items())
+    _head(out, "otb_twophase_total", "counter",
+          "In-doubt 2PC resolver counters")
+    for k, v in tp:
+        out.append(_line("otb_twophase_total", {"stat": k}, int(v)))
+    with cluster._dml_stats_mu:
+        dml = sorted(cluster.dml_stats.items())
+    _head(out, "otb_dml_commits_total", "counter",
+          "Multi-node commits by write-set delivery mode")
+    for k, v in dml:
+        out.append(_line("otb_dml_commits_total", {"mode": k}, int(v)))
+
+    # fragment self-healing counters (cluster-lifetime accumulators:
+    # per-session counts die with the session, and a counter that drops
+    # on disconnect would read as a reset to Prometheus)
+    with cluster._dml_stats_mu:
+        heal = dict(cluster.frag_heal_stats)
+    _head(out, "otb_fragment_retries_total", "counter",
+          "Remote fragment retry attempts")
+    out.append(_line(
+        "otb_fragment_retries_total", {}, int(heal.get("retries", 0)),
+    ))
+    _head(out, "otb_fragment_failovers_total", "counter",
+          "Remote fragments failed over to coordinator stores")
+    out.append(_line(
+        "otb_fragment_failovers_total", {},
+        int(heal.get("failovers", 0)),
+    ))
+
+    # matview counters
+    if cluster.matviews:
+        _head(out, "otb_matview_refreshes_total", "counter",
+              "Matview refreshes by mode")
+        for name, d in cluster.matviews.items():
+            for mode, key in (
+                ("incremental", "incremental_refreshes"),
+                ("full", "full_refreshes"),
+            ):
+                out.append(_line(
+                    "otb_matview_refreshes_total",
+                    {"matview": name, "mode": mode},
+                    int(d.stats.get(key, 0)),
+                ))
+        _head(out, "otb_matview_rewrites_total", "counter",
+              "Queries served from a matview by the rewrite path")
+        for name, d in cluster.matviews.items():
+            out.append(_line(
+                "otb_matview_rewrites_total", {"matview": name},
+                int(d.stats.get("rewrites", 0)),
+            ))
+
+    # gauges: WAL position, sessions, replication lag, pool occupancy,
+    # DN heartbeat age (from the health prober's bookkeeping)
+    _head(out, "otb_sessions", "gauge", "Registered sessions")
+    out.append(_line("otb_sessions", {}, len(cluster.sessions)))
+    p = cluster.persistence
+    if p is not None:
+        _head(out, "otb_wal_position_bytes", "gauge",
+              "Current WAL end position")
+        out.append(_line("otb_wal_position_bytes", {}, int(p.wal.position)))
+        peers = []
+        for sender in list(getattr(p, "wal_senders", ())):
+            peers.extend(sender.peer_positions())
+        if peers:
+            _head(out, "otb_replication_lag_bytes", "gauge",
+                  "WAL bytes not yet sent to each connected standby")
+            for addr, sent in peers:
+                out.append(_line(
+                    "otb_replication_lag_bytes", {"peer": addr},
+                    max(int(p.wal.position) - int(sent), 0),
+                ))
+    pools = getattr(cluster, "dn_channels", None) or {}
+    if pools:
+        _head(out, "otb_dn_pool_channels", "gauge",
+              "Channel-pool occupancy per datanode")
+        for n, pool in sorted(pools.items()):
+            occ = pool.occupancy()
+            for state in ("in_use", "idle"):
+                out.append(_line(
+                    "otb_dn_pool_channels",
+                    {"node": f"dn{n}", "state": state}, occ[state],
+                ))
+    health = getattr(cluster, "_dn_health", None) or {}
+    if health:
+        now = time.time()
+        _head(out, "otb_dn_up", "gauge",
+              "Last datanode heartbeat outcome (1 = answered)")
+        for n, h in sorted(health.items()):
+            out.append(_line(
+                "otb_dn_up", {"node": f"dn{n}"}, 1 if h.get("ok") else 0,
+            ))
+        _head(out, "otb_dn_heartbeat_age_seconds", "gauge",
+              "Seconds since the last successful datanode heartbeat")
+        for n, h in sorted(health.items()):
+            ok_ts = h.get("ok_ts")
+            age = round(now - ok_ts, 3) if ok_ts else -1
+            out.append(_line(
+                "otb_dn_heartbeat_age_seconds", {"node": f"dn{n}"}, age,
+            ))
+    return "\n".join(out) + "\n"
+
+
+class MetricsExporter:
+    """Minimal HTTP/1.1 listener serving ``GET /metrics`` from a render
+    callable. One thread per connection, connection: close — a scrape
+    every few seconds, not a web server."""
+
+    def __init__(
+        self, render: Callable[[], str],
+        host: str = "127.0.0.1", port: int = 0,
+    ):
+        self.render = render
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(16)
+        self.host, self.port = self._lsock.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        shutdown_and_close(self._lsock)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(5.0)
+            req = b""
+            while b"\r\n\r\n" not in req and len(req) < 8192:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    return
+                req += chunk
+            line = req.split(b"\r\n", 1)[0].decode("latin-1")
+            parts = line.split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            if path.split("?", 1)[0] not in ("/metrics", "/"):
+                body = b"not found\n"
+                conn.sendall(
+                    b"HTTP/1.1 404 Not Found\r\n"
+                    b"Content-Type: text/plain\r\n"
+                    + f"Content-Length: {len(body)}\r\n".encode()
+                    + b"Connection: close\r\n\r\n" + body
+                )
+                return
+            try:
+                body = self.render().encode()
+            except Exception as e:  # a broken renderer must not kill scrapes
+                body = f"# render error: {e}\n".encode()
+            conn.sendall(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/plain; version=0.0.4; charset=utf-8\r\n"
+                + f"Content-Length: {len(body)}\r\n".encode()
+                + b"Connection: close\r\n\r\n" + body
+            )
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def scrape(host: str, port: int, timeout: float = 5.0) -> str:
+    """Fetch one exposition document (the test/CLI-side scraper)."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(
+            f"GET /metrics HTTP/1.1\r\nHost: {host}\r\n"
+            "Connection: close\r\n\r\n".encode()
+        )
+        buf = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    head, _, body = buf.partition(b"\r\n\r\n")
+    if b" 200 " not in head.split(b"\r\n", 1)[0]:
+        raise RuntimeError(f"scrape failed: {head.splitlines()[0]!r}")
+    return body.decode()

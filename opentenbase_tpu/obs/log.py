@@ -1,0 +1,192 @@
+"""Structured server logging — the elog.c / ereport severity pipeline.
+
+The reference funnels every diagnostic through ``ereport(level, ...)``
+(src/backend/utils/error/elog.c): records carry a severity, are filtered
+by ``log_min_messages``, and land in the server log an operator can tail.
+This module is the engine-side equivalent:
+
+- ``elog(level, component, msg, **ctx)`` emits one single-line structured
+  record — timestamp, severity, component, node name, plus whatever
+  context ids are in scope (session/gid/fragment/...) — into a bounded
+  in-memory ring (``LogRing``) and, when configured, a file sink
+  (``log_destination = file`` + ``log_directory`` GUCs);
+- severities order ``debug < log < notice < warning < error`` and the
+  ring drops records below its ``log_min_messages`` threshold at emit
+  time (the GUC is finally consulted, not just parsed);
+- each server process owns a ring: the coordinator logs into the
+  process-default ring, a DN server process binds its own ring to its
+  service threads (``set_thread_ring``) so fault firings and replication
+  events inside the DN attribute to the DN, and ``pg_cluster_logs()``
+  merges every ring over the ``log_fetch`` protocol op into one
+  time-ordered view.
+
+Record shape (a plain tuple, cheap to ship over the wire):
+    (ts_epoch, level, node, component, message, context_json)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# severity order the reference's elog.c enforces via enum comparison;
+# the repo's historical bug was accepting the names without any order
+LEVELS: dict[str, int] = {
+    "debug": 10,
+    "log": 20,
+    "notice": 30,
+    "warning": 40,
+    "error": 50,
+}
+
+DEFAULT_LEVEL = "log"
+
+
+def level_no(name) -> int:
+    """Numeric rank of a severity name; unknown names rank as error so a
+    typo'd level is never silently dropped."""
+    return LEVELS.get(str(name).lower(), LEVELS["error"])
+
+
+def format_record(rec: tuple) -> str:
+    """One human-readable line (the file-sink / log-tail rendering)."""
+    ts, level, node, component, msg, ctx = rec
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+    frac = f"{ts % 1:.3f}"[1:]
+    line = f"{stamp}{frac} [{level.upper()}] {node} {component}: {msg}"
+    if ctx:
+        line += f"  {ctx}"
+    return line
+
+
+class LogRing:
+    """Bounded in-memory server log for one node process.
+
+    Thread-safe; emit below the threshold is one dict lookup + compare
+    (no allocation), so debug-level call sites stay ~free in production.
+    """
+
+    def __init__(
+        self, node: str = "cn", capacity: int = 4096,
+        min_level: str = DEFAULT_LEVEL,
+    ):
+        self.node = node
+        self._mu = threading.Lock()
+        self._ring: deque[tuple] = deque(maxlen=capacity)
+        self._min_no = level_no(min_level)
+        self.min_level = str(min_level)
+        self._file = None
+        self.dropped = 0  # records below threshold (observability of the filter)
+
+    # -- configuration ---------------------------------------------------
+    def set_min_level(self, name: str) -> None:
+        self.min_level = str(name).lower()
+        self._min_no = level_no(name)
+
+    def attach_file(self, path: str) -> None:
+        """Open ``path`` as the file sink (log_destination = file). Every
+        accepted record is appended as one formatted line."""
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with self._mu:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+            self._file = open(path, "a", buffering=1)
+
+    def close_file(self) -> None:
+        with self._mu:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+    # -- producers -------------------------------------------------------
+    def emit(
+        self, level: str, component: str, msg: str, **ctx,
+    ) -> Optional[tuple]:
+        """Append one record (or drop it below the threshold). Context
+        kwargs with None values are elided so call sites can pass ids
+        unconditionally; the record's node label is always the ring's
+        (a ``node=`` kwarg is ordinary context, e.g. a datanode index)."""
+        if level_no(level) < self._min_no:
+            self.dropped += 1
+            return None
+        ctx_s = ""
+        if ctx:
+            kept = {k: v for k, v in ctx.items() if v is not None}
+            if kept:
+                ctx_s = json.dumps(kept, default=str, sort_keys=True)
+        rec = (
+            time.time(), str(level).lower(), self.node,
+            str(component), str(msg), ctx_s,
+        )
+        with self._mu:
+            self._ring.append(rec)
+            if self._file is not None:
+                try:
+                    self._file.write(format_record(rec) + "\n")
+                except OSError:
+                    pass
+        return rec
+
+    # -- consumers -------------------------------------------------------
+    def rows(
+        self, min_level: Optional[str] = None,
+        since_ts: float = 0.0,
+    ) -> list[tuple]:
+        """Records at/above ``min_level`` newer than ``since_ts``, in
+        emit order (the ring is appended monotonically per process)."""
+        floor = level_no(min_level) if min_level else 0
+        with self._mu:
+            recs = list(self._ring)
+        return [
+            r for r in recs
+            if r[0] > since_ts and level_no(r[1]) >= floor
+        ]
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._ring)
+
+
+# ---------------------------------------------------------------------------
+# process-default ring + per-thread binding (DN / GTM server threads)
+# ---------------------------------------------------------------------------
+
+# node label matches pg_cluster_health's coordinator row, so an
+# operator can feed one view's node name into the other's filter
+_default_ring = LogRing(node="cn0")
+_tls = threading.local()
+
+
+def default_ring() -> LogRing:
+    """The process's own server log — what a coordinator writes to."""
+    return _default_ring
+
+
+def set_thread_ring(ring: Optional[LogRing]) -> None:
+    """Bind ``ring`` as THIS thread's log target: a DN/GTM server thread
+    routes everything module-level code (fault firings, channel errors)
+    emits during its requests into the node's own ring, so the merged
+    cluster view attributes records to the right process."""
+    _tls.ring = ring
+
+
+def current_ring() -> LogRing:
+    ring = getattr(_tls, "ring", None)
+    return ring if ring is not None else _default_ring
+
+
+def elog(level: str, component: str, msg: str, **ctx) -> Optional[tuple]:
+    """Module-level emit into the current (thread-bound or process
+    default) ring — for call sites that have no cluster handle."""
+    return current_ring().emit(level, component, msg, **ctx)

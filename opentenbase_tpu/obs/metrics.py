@@ -77,6 +77,12 @@ class MetricsRegistry:
                 h = self.histograms.setdefault(name, Histogram())
         return h
 
+    def reset(self) -> None:
+        """pg_stat_reset(): drop every histogram (recreated on first
+        use, zeroed)."""
+        with self._mu:
+            self.histograms.clear()
+
     def phase_rows(self) -> list[tuple]:
         """pg_stat_query_phases rows: one per ``phase.*`` histogram —
         (phase, statements, total_ms, avg_ms, p50_ms, p95_ms, p99_ms)."""

@@ -1,0 +1,93 @@
+"""Command-progress reporting — the backend_progress.c machinery.
+
+The reference's ``pgstat_progress_start_command`` family lets a long
+command advertise counters another backend reads through the
+``pg_stat_progress_*`` views while it runs. Same contract here: the
+running command holds a ``ProgressHandle`` and updates plain fields; a
+second session's view query snapshots them lock-cheap.
+
+Unlike the reference (which clears the slot when the command ends), the
+registry keeps the LAST finished record per kind with ``state =
+'finished'`` — a fast checkpoint/recovery is otherwise unobservable,
+and operators get the terminal counters for free.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class ProgressHandle:
+    """One in-flight command's progress slot."""
+
+    __slots__ = ("_reg", "kind", "session_id", "target", "fields",
+                 "started_s", "_done")
+
+    def __init__(self, reg, kind: str, session_id: int, target: str,
+                 fields: dict):
+        self._reg = reg
+        self.kind = kind
+        self.session_id = session_id
+        self.target = target
+        self.fields = fields
+        self.started_s = time.monotonic()
+        self._done = False
+
+    def update(self, **fields) -> None:
+        """Advertise new counter values (no lock: single-writer fields,
+        torn reads of an int are harmless for a progress view)."""
+        self.fields.update(fields)
+
+    def finish(self, **fields) -> None:
+        if fields:
+            self.fields.update(fields)
+        self._reg._finish(self)
+
+    @property
+    def elapsed_ms(self) -> float:
+        return (time.monotonic() - self.started_s) * 1000.0
+
+
+class ProgressRegistry:
+    """kind -> live handles + last finished snapshot."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._live: dict[int, ProgressHandle] = {}
+        self._last: dict[str, tuple] = {}  # kind -> snapshot row
+
+    def begin(
+        self, kind: str, session_id: int = 0, target: str = "",
+        **fields,
+    ) -> ProgressHandle:
+        h = ProgressHandle(self, kind, session_id, target, dict(fields))
+        with self._mu:
+            self._live[id(h)] = h
+        return h
+
+    def _finish(self, h: ProgressHandle) -> None:
+        with self._mu:
+            if h._done:
+                return
+            h._done = True
+            self._live.pop(id(h), None)
+            self._last[h.kind] = self._snapshot(h, "finished")
+
+    @staticmethod
+    def _snapshot(h: ProgressHandle, state: str) -> tuple:
+        return (
+            h.kind, h.session_id, h.target, state,
+            round(h.elapsed_ms, 3), dict(h.fields),
+        )
+
+    def rows(self, kind: str) -> list[tuple]:
+        """(kind, session_id, target, state, elapsed_ms, fields) — live
+        commands first (state='running'), then the last finished one."""
+        with self._mu:
+            live = [h for h in self._live.values() if h.kind == kind]
+            last = self._last.get(kind)
+        out = [self._snapshot(h, "running") for h in live]
+        if last is not None:
+            out.append(last)
+        return out

@@ -67,6 +67,13 @@ class WaitEventRegistry:
             ent[0] += 1
             ent[1] += ms
 
+    def reset(self) -> None:
+        """pg_stat_reset(): zero the cumulative totals. In-flight waits
+        (the ``current`` stacks) are live state, not counters — their
+        eventual ``end`` repopulates the fresh table."""
+        with self._mu:
+            self._cum.clear()
+
     # -- observability ----------------------------------------------------
     def current_for(self, session_id: int) -> tuple:
         """(wait_event_type, wait_event) the session is in RIGHT NOW,

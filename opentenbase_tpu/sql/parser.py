@@ -1772,6 +1772,11 @@ class Parser:
             value: object = self._string_lit()
         elif self.cur.kind == Tok.NUMBER:
             value = self._literal_value()
+        elif self.at_op("-"):
+            # negative numeric values (SET auto_explain_min_duration_ms
+            # = -1 — PG's "off" spelling for several GUCs);
+            # _literal_value consumes the sign itself
+            value = self._literal_value()
         else:
             value = self.ident("value")
         return A.SetStmt(name, value)

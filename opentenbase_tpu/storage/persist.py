@@ -255,6 +255,9 @@ class ClusterPersistence:
         # True while redo is applying records: side-effect feeds (e.g. the
         # GTM sequence-event bridge) must not re-log what they replay
         self._in_recovery = False
+        # live WalSenders streaming this WAL (storage/replication.py
+        # registers/deregisters) — the exporter's replication-lag gauges
+        self.wal_senders: list = []
 
     def sync_dicts(self, table: str) -> None:
         tm = self.cluster.catalog.get(table)
@@ -395,6 +398,37 @@ class ClusterPersistence:
         FAULT("storage/checkpoint")
         c = self.cluster
         gen = self._next_ckpt_gen()
+        # progress + server log (obs/): a long checkpoint is watchable
+        # from another session through pg_stat_progress_checkpoint
+        names_total = len(c.catalog.table_names())
+        prog = None
+        progress = getattr(c, "progress", None)
+        if progress is not None:
+            prog = progress.begin(
+                "checkpoint", 0, f"gen{gen}",
+                phase="snapshot_stores", tables_total=names_total,
+                tables_done=0, wal_position=int(self.wal.position),
+            )
+        log = getattr(c, "log", None)
+        if log is not None:
+            log.emit(
+                "debug", "checkpoint",
+                f"checkpoint starting (gen {gen}, "
+                f"{names_total} tables)",
+            )
+        try:
+            self._checkpoint_inner(c, gen, prog)
+        finally:
+            if prog is not None:
+                prog.finish(phase="done")
+        if log is not None:
+            log.emit(
+                "log", "checkpoint",
+                f"checkpoint complete (gen {gen}, "
+                f"wal_position {int(self.wal.position)})",
+            )
+
+    def _checkpoint_inner(self, c, gen: int, prog) -> None:
         prep_ranges: dict[tuple[int, str], list[tuple[int, int]]] = {}
         for txn in getattr(c, "_prepared", {}).values():
             for node, tabs in txn.writes.items():
@@ -442,6 +476,7 @@ class ClusterPersistence:
             "users": c.users,
             "wlm": c.wlm.dump_state(),
         }
+        done = 0
         for name in c.catalog.table_names():
             tm = c.catalog.get(name)
             meta["tables"][name] = {
@@ -484,6 +519,11 @@ class ClusterPersistence:
                 with open(path + ".tmp", "wb") as f:
                     np.savez(f, **arrays)
                 os.replace(path + ".tmp", path)
+            done += 1
+            if prog is not None:
+                prog.update(tables_done=done)
+        if prog is not None:
+            prog.update(phase="write_meta")
         tmp = os.path.join(self.dir, "checkpoint.json.tmp")
         with open(tmp, "w") as f:
             json.dump(meta, f)
@@ -575,6 +615,25 @@ class ClusterPersistence:
             start = meta["wal_position"]
             self._restore_checkpoint(meta)
         applied = 0
+        wal_end = WAL.scan_end(wal_path) if os.path.exists(wal_path) else 0
+        # progress + server log: recovery is the blackout window an
+        # operator most wants to watch (pg_stat_progress_recovery)
+        prog = None
+        progress = getattr(c, "progress", None)
+        if progress is not None:
+            prog = progress.begin(
+                "recovery", 0, self.dir, phase="redo",
+                wal_replay_lsn=int(start), wal_end_lsn=int(wal_end),
+                records_applied=0,
+            )
+        log = getattr(c, "log", None)
+        if log is not None:
+            log.emit(
+                "log", "recovery",
+                f"WAL recovery starting at {int(start)} "
+                f"(end {int(wal_end)})",
+                until_barrier=until_barrier,
+            )
         self._in_recovery = True
         try:
             for tag, header, arrays, off in WAL.read_records(wal_path, start):
@@ -585,8 +644,19 @@ class ClusterPersistence:
                     continue
                 self._apply(tag, header, arrays)
                 applied += 1
+                if prog is not None:
+                    prog.update(
+                        wal_replay_lsn=int(off), records_applied=applied
+                    )
         finally:
             self._in_recovery = False
+            if prog is not None:
+                prog.finish(phase="done")
+        if log is not None:
+            log.emit(
+                "log", "recovery",
+                f"WAL recovery complete: {applied} records replayed",
+            )
         if barrier_end is not None:
             # abandon the old timeline: discard post-barrier WAL and
             # re-checkpoint the rewound state so the next recovery cannot

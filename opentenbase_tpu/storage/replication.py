@@ -48,11 +48,30 @@ class WalSender:
         self._lsock.listen(8)
         self.host, self.port = self._lsock.getsockname()
         self._stop = threading.Event()
+        # per-connection sent offsets (pg_stat_replication's sent_lsn):
+        # conn id -> [peer_addr, sent_offset]; the exporter renders
+        # wal.position - sent as the replication-lag gauge per standby
+        self._peers: dict = {}
+        self._peers_mu = threading.Lock()
+        # register with the persistence so the coordinator's exporter
+        # can find every live sender without new plumbing
+        getattr(persistence, "wal_senders", []).append(self)
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
     def stop(self) -> None:
         self._stop.set()
+        try:
+            getattr(self.persistence, "wal_senders", []).remove(self)
+        except ValueError:
+            pass
         shutdown_and_close(self._lsock)
+
+    def peer_positions(self) -> list:
+        """[(peer_addr, sent_offset)] of live standby connections."""
+        with self._peers_mu:
+            return [
+                (addr, int(sent)) for addr, sent in self._peers.values()
+            ]
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -67,6 +86,12 @@ class WalSender:
     def _stream(self, conn: socket.socket) -> None:
         path = self.persistence.wal.path
         try:
+            peer = "unknown"
+            try:
+                a = conn.getpeername()
+                peer = f"{a[0]}:{a[1]}"
+            except OSError:
+                pass
             head = b""
             while len(head) < 8:  # short TCP reads are normal
                 chunk = conn.recv(8 - len(head))
@@ -74,6 +99,8 @@ class WalSender:
                     return
                 head += chunk
             (offset,) = struct.unpack("<q", head)
+            with self._peers_mu:
+                self._peers[id(conn)] = [peer, int(offset)]
             with open(path, "rb") as f:
                 f.seek(offset)
                 while not self._stop.is_set():
@@ -97,11 +124,17 @@ class WalSender:
                                 time.sleep(0.001)  # force distinct recvs
                         else:
                             conn.sendall(chunk)
+                        with self._peers_mu:
+                            ent = self._peers.get(id(conn))
+                            if ent is not None:
+                                ent[1] = f.tell()
                     else:
                         time.sleep(self.poll_s)
         except OSError:
             pass
         finally:
+            with self._peers_mu:
+                self._peers.pop(id(conn), None)
             try:
                 conn.close()
             except OSError:
@@ -159,6 +192,11 @@ class StandbyCluster:
         return self
 
     def _recv_loop(self) -> None:
+        # this thread's emits (incl. module-level fault firings at
+        # repl/wal_recv) belong to the standby's own server log
+        from opentenbase_tpu.obs import log as _olog
+
+        _olog.set_thread_ring(self.cluster.log)
         p = self.cluster.persistence
         buf = b""
         while not self._stop.is_set():
@@ -169,8 +207,10 @@ class StandbyCluster:
                 FAULT("repl/wal_recv")
                 chunk = self._sock.recv(1 << 20)
             except OSError:
+                self._log_stream_end("walreceiver connection lost")
                 return
             if not chunk:
+                self._log_stream_end("walreceiver stream ended by peer")
                 return
             # durable first (walreceiver fsyncs before reporting flush),
             # then apply complete records
@@ -178,6 +218,14 @@ class StandbyCluster:
             p.wal._f.flush()
             buf += chunk
             buf = self._drain(buf)
+
+    def _log_stream_end(self, msg: str) -> None:
+        """A severed WAL stream is only log-worthy when it wasn't our
+        own stop()/promote() tearing it down."""
+        if not self._stop.is_set():
+            self.cluster.log.emit(
+                "warning", "replication", msg, applied=self.applied,
+            )
 
     def _drain(self, buf: bytes) -> bytes:
         """Apply every complete record in ``buf``; return the unconsumed
@@ -255,6 +303,11 @@ class StandbyCluster:
         p._in_recovery = False
         self.cluster.read_only = False
         self.promoted = True
+        self.cluster.log.emit(
+            "warning", "replication",
+            "standby promoted to read-write primary",
+            applied=self.applied,
+        )
         return self.cluster
 
     def stop(self) -> None:

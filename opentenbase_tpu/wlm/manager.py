@@ -330,6 +330,8 @@ class WorkloadManager:
             return g, self._admit_locked(g, est)
         if g.memory_limit > 0 and est > g.memory_limit:
             g.stats["shed"] += 1
+            self._log_shed(name, "memory_limit", est=est,
+                           limit=g.memory_limit)
             raise AdmissionError(
                 f"out of memory: statement estimate {est} bytes "
                 f'exceeds resource group "{name}" memory_limit '
@@ -340,6 +342,9 @@ class WorkloadManager:
             return g, self._admit_locked(g, est)
         if len(g.queue) >= g.queue_depth:
             g.stats["shed"] += 1
+            self._log_shed(name, "queue_full",
+                           concurrency=g.concurrency,
+                           queue_depth=g.queue_depth)
             raise AdmissionError(
                 f'resource group "{name}" admission queue is full '
                 f"(concurrency={g.concurrency}, "
@@ -347,6 +352,19 @@ class WorkloadManager:
                 "53000",
             )
         return g, None
+
+    @staticmethod
+    def _log_shed(group: str, reason: str, **ctx) -> None:
+        """Every load-shed leaves a server-log record (obs/log.py): a
+        53xxx storm must be reconstructable without a client that kept
+        its error messages."""
+        from opentenbase_tpu.obs.log import elog
+
+        elog(
+            "warning", "wlm",
+            f'statement shed from resource group "{group}" ({reason})',
+            group=group, reason=reason, **ctx,
+        )
 
     def try_admit(
         self, name: str, est: int = 0
@@ -395,6 +413,8 @@ class WorkloadManager:
                         # estimate: it can never fit — shed instead of
                         # blocking the FIFO head forever
                         g.stats["shed"] += 1
+                        self._log_shed(name, "memory_limit_shrunk",
+                                       est=est, limit=g.memory_limit)
                         raise AdmissionError(
                             f"out of memory: statement estimate {est} "
                             f'bytes exceeds resource group "{name}" '
@@ -412,6 +432,7 @@ class WorkloadManager:
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             g.stats["timed_out"] += 1
+                            self._log_shed(name, "queue_timeout")
                             # neutral wording: the bound may come from
                             # statement_timeout OR wlm_queue_timeout
                             raise AdmissionError(

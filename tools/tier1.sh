@@ -149,6 +149,75 @@ print("chaos smoke OK: crash_node -> retry+failover, counters moved, "
       "clean rerun")
 PY
 
+echo "== tier1: telemetry smoke =="
+timeout -k 10 180 python - <<'PY' || exit 1
+# Telemetry plane (obs/log.py + exporter + health): start a cluster with
+# the metrics_port GUC, scrape twice and assert a known counter moved,
+# then arm crash_node on a DN and reconstruct the whole incident from
+# telemetry alone — fault firing, retries, failover in pg_cluster_logs;
+# the DN down then revived in pg_cluster_health.
+import socket, tempfile
+from opentenbase_tpu import fault
+from opentenbase_tpu.dn.server import DNServer
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.obs.exporter import scrape
+from opentenbase_tpu.storage.replication import WalSender
+
+probe = socket.socket(); probe.bind(("127.0.0.1", 0))
+mport = probe.getsockname()[1]; probe.close()
+d = tempfile.mkdtemp(prefix="otbtelsmoke_")
+import os; os.makedirs(f"{d}/cn")
+with open(f"{d}/cn/opentenbase.conf", "w") as f:
+    f.write(f"metrics_port = {mport}\n")
+c = Cluster(num_datanodes=2, shard_groups=16, data_dir=f"{d}/cn")
+s = c.session()
+s.execute("set enable_fused_execution = off")
+s.execute("create table t (k bigint, v bigint) distribute by shard(k)")
+s.execute("insert into t values " + ",".join(f"({i},{i*2})" for i in range(120)))
+b1 = scrape("127.0.0.1", mport)
+s.execute("select count(*), sum(v) from t")
+b2 = scrape("127.0.0.1", mport)
+def execs(b):
+    for ln in b.splitlines():
+        if ln.startswith('otb_phase_duration_ms_count{phase="execute"}'):
+            return float(ln.rpartition(" ")[2])
+    return 0.0
+assert execs(b2) > execs(b1), "execute-phase counter did not move"
+sender = WalSender(c.persistence)
+dns = [DNServer(f"{d}/dn{n}", sender.host, sender.port, 2, 16).start()
+       for n in (0, 1)]
+for n, dn in enumerate(dns):
+    c.attach_datanode(n, "127.0.0.1", dn.port, pool_size=2, rpc_timeout=60)
+want = s.query("select count(*), sum(v) from t")
+s.execute("set fault_injection = on")
+s.execute("set fragment_retries = 1")
+s.execute("set fragment_retry_backoff_ms = 5")
+s.execute("select pg_fault_inject('dn/exec_fragment', 'crash_node',"
+          " 'node=1, once')")
+assert s.query("select count(*), sum(v) from t") == want  # self-healed
+h = {r[0]: r[2] for r in s.query("select * from pg_cluster_health")}
+assert h["dn1"] is False and h["dn0"] is True, h          # DN down
+s.execute("select pg_fault_clear()")
+dns[1]._revive()
+h = {r[0]: r[2] for r in s.query("select * from pg_cluster_health")}
+assert h["dn1"] is True, h                                # DN revived
+logs = s.query("select pg_cluster_logs()")
+msgs = {(r[2], r[3]): [] for r in logs}
+for r in logs: msgs[(r[2], r[3])].append(r[4])
+assert any("fault fired" in m for m in msgs.get(("dn1", "fault"), [])), msgs
+assert any("retrying" in m for m in msgs.get(("cn0", "executor"), [])), msgs
+assert any("failed over" in m for m in msgs.get(("cn0", "executor"), [])), msgs
+assert [r[0] for r in logs] == sorted(r[0] for r in logs)  # time-ordered
+b3 = scrape("127.0.0.1", mport)
+assert "otb_fault_hits_total" in b3                       # fault counters render
+assert "otb_dn_up" in b3 and "otb_replication_lag_bytes" in b3
+for n in (0, 1): c.detach_datanode(n)
+for dn in dns: dn.stop()
+sender.stop(); c.close(); fault.reset_stats()
+print("telemetry smoke OK: scrape moved, chaos run reconstructed "
+      "from logs + health")
+PY
+
 echo "== tier1: full suite =="
 rm -f /tmp/_t1.log
 # 870s was calibrated against a 786s run of 664 tests; the suite is now
