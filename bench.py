@@ -547,6 +547,7 @@ def main():
             q1_best = min(q1_best, time.perf_counter() - t0)
         q1_cpu = cpu_baseline_q1(arrays)
         record["q1_rows_per_sec"] = round(ROWS / q1_best)
+        record["q1_platform"] = _leg_platform()
         record["q1_vs_baseline"] = round(
             (ROWS / q1_best) / (ROWS / q1_cpu), 3
         )
@@ -561,6 +562,7 @@ def main():
     # searchsorted) runs 60M rows in-HBM with no row cap.
     try:
         record["q3_rows"] = ROWS
+        q3_c0 = _dag_completed(cluster)
         q3_warm = s.query(Q3)  # compile
         assert len(q3_warm) >= 1
         _phase("q3 compiled", t_start)
@@ -574,9 +576,10 @@ def main():
         record["q3_vs_baseline"] = round(
             (ROWS / q3_best) / (ROWS / q3_cpu), 3
         )
-        fxq = cluster.fused_executor()
-        if fxq is not None and fxq._dag is not None:
-            record["q3_mode"] = str(fxq._dag.last_mode)
+        record["q3_mode"], record["q3_join_modes"] = _q3_modes(
+            cluster, q3_c0
+        )
+        record["q3_platform"] = _leg_platform()
         _phase("q3 measured", t_start)
         print(json.dumps(record), flush=True)
     except Exception as e:  # Q3 must never break the headline
@@ -607,7 +610,7 @@ def main():
     # probe would need a second concurrent tunnel attach, which can
     # fail on a healthy run and throw away the scored legs.
     if not _device_alive(record, t_start):
-        return
+        return record
 
     # ClickBench-like (BASELINE config 5): high-cardinality GROUP BY +
     # TopK over a single wide table — the fused gagg path (one packed-key
@@ -692,6 +695,7 @@ def main():
             "select userid, count(*) from hits group by userid "
             "order by 2 desc limit 10"
         )
+        cb_c0 = _dag_completed(cluster2)
         s3.query(Q_CB)  # compile
         _phase("clickbench compiled", t_start)
         cb_best = float("inf")
@@ -705,11 +709,10 @@ def main():
         _ = top[np.argsort(-cnt[top])]
         cb_cpu = time.perf_counter() - t0
         record["clickbench_rows"] = ex_rows
+        record["clickbench_platform"] = _leg_platform()
         record["clickbench_rows_per_sec"] = round(ex_rows / cb_best)
         record["clickbench_vs_baseline"] = round(cb_cpu / cb_best, 3)
-        fx2 = cluster2.fused_executor()
-        if fx2 is not None and fx2._dag is not None:
-            record["clickbench_mode"] = str(fx2._dag.last_mode)
+        record["clickbench_mode"], _jm = _q3_modes(cluster2, cb_c0)
         _phase("clickbench measured", t_start)
         print(json.dumps(record), flush=True)
 
@@ -720,6 +723,7 @@ def main():
             "and p_category = 1 group by d_year, p_brand "
             "order by 3 desc limit 10"
         )
+        ssb_c0 = _dag_completed(cluster2)
         s3.query(Q_SSB)  # compile
         _phase("ssb compiled", t_start)
         ssb_best = float("inf")
@@ -740,11 +744,14 @@ def main():
         _ = top[np.argsort(-rev[top])]
         ssb_cpu = time.perf_counter() - t0
         record["ssb_rows"] = ex_rows
+        record["ssb_platform"] = _leg_platform()
         record["ssb_rows_per_sec"] = round(ex_rows / ssb_best)
         record["ssb_vs_baseline"] = round(ssb_cpu / ssb_best, 3)
+        record["ssb_mode"], record["ssb_join_modes"] = _q3_modes(
+            cluster2, ssb_c0
+        )
         fx2 = cluster2.fused_executor()
         if fx2 is not None and fx2._dag is not None:
-            record["ssb_mode"] = str(fx2._dag.last_mode)
             record["ssb_folds"] = len(fx2._dag.last_folded)
         _phase("ssb measured", t_start)
         print(json.dumps(record), flush=True)
@@ -754,7 +761,7 @@ def main():
     try:
         if os.environ.get("BENCH_SF100", "1") == "1":
             if not _device_alive(record, t_start):
-                return
+                return record
             # free the extra-leg residency first
             try:
                 cluster2._fused = None
@@ -765,6 +772,26 @@ def main():
             sf100_legs(record, t_start)
     except Exception as e:
         _phase(f"sf100 legs failed: {e!r:.200}", t_start)
+    return record
+
+
+def _dag_completed(cluster) -> int:
+    fx = getattr(cluster, "_fused", None)
+    dag = getattr(fx, "_dag", None) if fx is not None else None
+    return dag.completed if dag is not None else 0
+
+
+def _q3_modes(cluster, before: int) -> tuple:
+    """(final mode, join formulations) of the leg's fused runs —
+    'host'/'' when the leg never completed on the device DAG (compared
+    against the pre-leg completion count, so a stale mode from an
+    EARLIER leg can't masquerade as this one's), so EVERY record says
+    which formulation actually answered."""
+    fx = getattr(cluster, "_fused", None)
+    dag = getattr(fx, "_dag", None) if fx is not None else None
+    if dag is None or dag.completed <= before or dag.last_mode is None:
+        return "host", ""
+    return str(dag.last_mode), ",".join(dag.last_join_modes)
 
 
 def matview_leg(record, t_start) -> None:
@@ -858,6 +885,55 @@ def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+
+def _leg_platform() -> str:
+    """The backend the NEXT query actually dispatches to — recorded per
+    leg so every BENCH record says where each formulation ran (r04/r05
+    ran whole rounds on cpu with only one buried field saying so)."""
+    try:
+        import jax
+
+        return str(jax.default_backend())
+    except Exception:
+        return "unknown"
+
+
+def _gate(record) -> int:
+    """Perf-regression gate (opentenbase_tpu/bench_gate.py): evaluate
+    the final record against BENCH_FLOORS.json + demotion checks, print
+    the verdict as one JSON line, and return the process exit code.
+    BENCH_GATE=0 keeps the verdict line but always returns 0."""
+    from opentenbase_tpu import bench_gate
+
+    if record is None:
+        return 0
+    # process-lifetime total — per-executor counters die when a leg
+    # frees device residency via cluster._fused = None
+    try:
+        from opentenbase_tpu.executor.fused import PALLAS_DEMOTIONS_TOTAL
+
+        record["pallas_demotions"] = int(PALLAS_DEMOTIONS_TOTAL[0])
+    except Exception:
+        record["pallas_demotions"] = 0
+    try:
+        doc = bench_gate.load_floors()
+        violations = bench_gate.check_record(record, doc)
+    except Exception as e:  # a broken floors file is itself a failure
+        violations = [f"floors file unusable: {e!r:.200}"]
+    print(
+        json.dumps({
+            "metric": "bench_gate",
+            "pass": not violations,
+            "enforced": bench_gate.gate_enabled(),
+            "violations": violations,
+        }),
+        flush=True,
+    )
+    if violations and bench_gate.gate_enabled():
+        return bench_gate.GATE_EXIT_CODE
+    return 0
 
 
 def dnproc_leg(record, t_start) -> None:
@@ -1174,6 +1250,7 @@ def sf100_legs(record, t_start) -> None:
     assert got6 == want6, (got6, want6)
     del qty
     record["sf100_rows"] = N
+    record["sf100_platform"] = _leg_platform()
     record["q6_sf100_rows_per_sec"] = round(N / q6_best)
     record["q6_sf100_vs_baseline"] = round(q6_cpu / q6_best, 3)
     _phase("sf100 q6 measured", t_start)
@@ -1198,9 +1275,10 @@ def sf100_legs(record, t_start) -> None:
         "group by l_orderkey, o_orderdate, o_shippriority "
         "order by 2 desc, o_orderdate limit 10"
     )
+    q3sf_c0 = _dag_completed(c3)
     got3 = s4.query(Q3_SF)
     _phase(
-        f"sf100 q3 compiled (mode={fx._dag.last_mode})", t_start
+        f"sf100 q3 compiled (mode={_q3_modes(c3, q3sf_c0)[0]})", t_start
     )
     q3_best = float("inf")
     for _ in range(2):
@@ -1235,10 +1313,12 @@ def sf100_legs(record, t_start) -> None:
     ), (got3[:2], top[:2], rev[top[0]])
     record["q3_sf100_rows_per_sec"] = round(N / q3_best)
     record["q3_sf100_vs_baseline"] = round(q3_cpu / q3_best, 3)
-    record["q3_sf100_mode"] = str(fx._dag.last_mode)
+    record["q3_sf100_mode"], record["q3_sf100_join_modes"] = _q3_modes(
+        c3, q3sf_c0
+    )
     _phase("sf100 q3 measured", t_start)
     print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(_gate(main()))

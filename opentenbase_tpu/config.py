@@ -89,6 +89,20 @@ GUCS: dict = {
     "ssl_cert_file": (_str, ""),
     "ssl_key_file": (_str, ""),
     "enable_pallas_scan": (_bool, None),
+    # Pallas MXU bucket-probe for the radix hash join
+    # (ops/pallas_join.py): None = engine decides (on for real TPU
+    # backends, off elsewhere — interpret mode is for tests, not speed)
+    "enable_pallas_join": (_bool, None),
+    # device join formulation (executor/fused_dag.py + the host
+    # executor via OTB_JOIN_MODE): 'auto' picks fold > radix >
+    # sort-merge by planner cardinality estimates; forcing a mode is
+    # for tests, EXPLAIN smoke checks, and perf triage
+    "join_mode": (_enum("auto", "radix", "sortmerge"), "auto"),
+    # spill-aware batch planner (plan/batchplan.py): HBM budget in
+    # bytes every data-dependent device allocation (radix tables,
+    # exchange buffers, probe windows) is sized against; 0 = use the
+    # per-op env knobs / baked-in defaults
+    "device_memory_limit": (_int, 0),
     "enable_fast_query_shipping": (_bool, True),
     # within-fragment scan workers on DN processes (execParallel.c's
     # max_parallel_workers_per_gather analog)

@@ -534,6 +534,26 @@ class Cluster:
                         self._fused = FusedExecutor(
                             self.catalog, self.stores
                         )
+                        plat = self._fused.platform()
+                        import os as _os
+
+                        if plat != "tpu" and _os.environ.get(
+                            "PALLAS_AXON_POOL_IPS"
+                        ):
+                            # a TPU tunnel is configured but the mesh
+                            # came up on another platform: this is the
+                            # r04/r05 silent-demotion shape — warn so
+                            # pg_cluster_logs and a scrape both show it
+                            self.log.emit(
+                                "warning", "device",
+                                "TPU tunnel configured but device "
+                                f"platform is '{plat}' (tunnel down?)",
+                            )
+                        else:
+                            self.log.emit(
+                                "log", "device",
+                                f"fused executor on platform '{plat}'",
+                            )
                     except Exception:
                         self._fused_failed = True
         return self._fused
@@ -4094,6 +4114,10 @@ class Session:
                 dag = fx._dag
                 if dag is not None and dag.last_frag_ms:
                     phases["frag_ms"] = dict(dag.last_frag_ms)
+                if dag is not None and dag.last_join_modes:
+                    phases["join_modes"] = ",".join(
+                        dag.last_join_modes
+                    )
         # phase metrics flow through the per-statement accumulator only
         # (folded into the histograms once, at statement end)
         self._note_phase("compile", compile_ms)
@@ -4125,6 +4149,16 @@ class Session:
         from opentenbase_tpu.executor.fused import FusedUnsupported
 
         fused_gate = self.cluster._fused_lock
+        # session GUC shadows the device planners read (join mode
+        # selection + the spill-aware batch planner's HBM budget)
+        fx.join_mode = str(self.gucs.get("join_mode", "auto"))
+        try:
+            fx.device_memory_limit = int(
+                self.gucs.get("device_memory_limit", 0) or 0
+            )
+        except (TypeError, ValueError):
+            fx.device_memory_limit = 0
+        fx.enable_pallas_join = self.gucs.get("enable_pallas_join")
 
         # pallas single-pass kernel: default-on on real TPU backends,
         # opt-in elsewhere (interpret mode is for tests, not speed)
@@ -4183,6 +4217,14 @@ class Session:
             )
             fx.dag_demotions.append(f"{type(e).__name__}: {e}")
             del fx.dag_demotions[:-64]
+            fx.dag_demotion_count += 1
+            # operator-visible trail (pg_cluster_logs): demotions must
+            # never be python-logger-only
+            self.cluster.log.emit(
+                "warning", "device",
+                f"fused path demoted to host executor: {e!r:.200}",
+                session=self.session_id,
+            )
             return None
         if out is None:
             return None
@@ -6507,6 +6549,13 @@ class Session:
                     f"device={ph.get('device_ms', 0.0):.3f} ms "
                     f"host_merge={ph.get('host_ms', 0.0):.3f} ms"
                 )
+                if ph.get("join_modes"):
+                    # which join formulation(s) the device compiled —
+                    # a mode-selection regression must fail an EXPLAIN
+                    # assertion, not wait for the TPU bench
+                    lines.append(
+                        f"Fused join modes: {ph['join_modes']}"
+                    )
                 frag_ms = ph.get("frag_ms")
                 if stmt.verbose and frag_ms:
                     for k in sorted(frag_ms, key=str):
@@ -7027,6 +7076,10 @@ def _sv_fused(c: Cluster):
         rows.append(("completed", str(dag.completed)))
         if dag.last_mode is not None:
             rows.append(("last_mode", str(dag.last_mode)))
+        if dag.last_join_modes:
+            rows.append(
+                ("last_join_modes", ",".join(dag.last_join_modes))
+            )
         for r in dag.unsupported:
             rows.append(("unsupported", r))
     for d in fx.dag_demotions:

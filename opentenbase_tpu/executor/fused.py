@@ -669,6 +669,12 @@ def enable_compile_cache() -> Optional[str]:
         return None
 
 
+# Process-lifetime pallas demotion count (bench_gate reads this): the
+# per-executor counter dies with its executor, and bench legs recycle
+# executors to free device residency.
+PALLAS_DEMOTIONS_TOTAL = [0]
+
+
 class FusedExecutor:
     """Compiles eligible partial-agg fragments to one shard_map program."""
 
@@ -683,12 +689,24 @@ class FusedExecutor:
         # Pallas programs demoted to the XLA path by a lowering/runtime
         # failure. Loud on purpose (VERDICT r1 §weak-7): a silent
         # demotion would hide a kernel regression behind a
-        # slower-but-correct fallback. Exposed via pg_stat_pallas.
+        # slower-but-correct fallback. Exposed via pg_stat_pallas, a
+        # warning-level server log record (pg_cluster_logs), and the
+        # otb_pallas_demotions_total exporter counter — the r04/r05
+        # silent-CPU-run bug class must show on a scrape.
         self.pallas_fallbacks: list[str] = []
+        self.pallas_demotions = 0  # monotone counter (exporter)
+        # session GUC shadows (engine threads them in before every
+        # fused attempt): join formulation override + the spill-aware
+        # planner's HBM budget (plan/batchplan.py)
+        self.join_mode = "auto"
+        self.device_memory_limit = 0
+        self.enable_pallas_join = None
         # Unexpected exceptions that demoted a fused/DAG query to the
         # host path (VERDICT r2 §weak-3: the blanket except must not be
-        # invisible). Exposed via pg_stat_fused.
+        # invisible). Exposed via pg_stat_fused; the monotone counter
+        # feeds the exporter (the bounded list clamps at 64).
         self.dag_demotions: list[str] = []
+        self.dag_demotion_count = 0
         # zone-map pruning on the DEVICE path (VERDICT r2 missing-5):
         # blocks excluded from the scanned window per fused query
         self.zone_stats = {"pruned_blocks": 0, "total_blocks": 0}
@@ -713,13 +731,36 @@ class FusedExecutor:
     def _note_pallas_failure(self, key) -> None:
         import traceback
 
+        from opentenbase_tpu.obs.log import elog
+
         if str(key) not in self.pallas_fallbacks:
             self.pallas_fallbacks.append(str(key))
+        self.pallas_demotions += 1
+        # process-wide running total: executors are torn down and
+        # rebuilt between bench legs (cluster._fused = None frees HBM
+        # residency), and the gate must still see EVERY demotion
+        PALLAS_DEMOTIONS_TOTAL[0] += 1
         _log.warning(
             "pallas kernel demoted to XLA path for %s:\n%s",
             key,
             traceback.format_exc(),
         )
+        # the server log an operator actually tails (pg_cluster_logs) —
+        # the python logger above is developer-side only
+        elog(
+            "warning", "device",
+            f"pallas kernel demoted to XLA path for {key}",
+            demotions=self.pallas_demotions,
+        )
+
+    def platform(self) -> str:
+        """The mesh's device platform ('tpu'/'cpu'/...) — the exporter
+        gauge that makes an r04/r05-style silent CPU run visible on a
+        scrape instead of in a bench JSON post-mortem."""
+        try:
+            return str(self.mesh.devices.flat[0].platform)
+        except Exception:
+            return "unknown"
 
     # -- eligibility -----------------------------------------------------
     def fragment_output(

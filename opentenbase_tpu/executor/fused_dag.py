@@ -65,10 +65,15 @@ OPTIMISTIC_GROUP_CAP = 1 << 16
 
 import os
 
+from opentenbase_tpu.ops import join as join_ops
+from opentenbase_tpu.plan import batchplan
+
 # Exchange buffers materialize ~3x their payload (bucket scatter, the
 # all_to_all result, consumer copies). Beyond this budget the DAG bails
 # to the host path instead of crashing the TPU worker on HBM exhaustion
-# (observed at TPC-H SF10 Q3 on one 16GB v5e).
+# (observed at TPC-H SF10 Q3 on one 16GB v5e). The ``device_memory_limit``
+# GUC (threaded through FusedExecutor.device_memory_limit) overrides the
+# env knob at runtime — plan/batchplan.resolve_budget is the one resolver.
 EXCHANGE_HBM_BUDGET = int(
     os.environ.get("OTB_EXCHANGE_HBM_BUDGET", 4_000_000_000)
 )
@@ -600,6 +605,139 @@ def _fold_gate(runner, node: "L.Join", ji: int, build_right: bool,
     return 0 < best <= DIMFOLD_MAX_BUILD and best * 2 <= pest
 
 
+def _radix_gate(
+    runner, node: "L.Join", ji: int, build_right: bool, radix_off,
+    mode: str,
+) -> bool:
+    """THE radix-hash-join gate — the builder (which compiles it) and
+    any mode prediction share this one definition. The radix table
+    engages where the dense fold can't (keys unique but not a gap-free
+    range): build side estimated small relative to the probe — the
+    planner's cardinality estimates, the same signal that seeds build
+    orientation — or the ``join_mode`` GUC forcing it. Inner joins
+    only: semi/anti existence probes carry no per-join flag slot to
+    report a bucket overflow through."""
+    if runner is None or ji in radix_off or mode == "sortmerge":
+        return False
+    bnode = node.right if build_right else node.left
+    pnode = node.left if build_right else node.right
+    try:
+        best = runner._est_rows(bnode)
+        pest = runner._est_rows(pnode)
+    except Exception:
+        return False
+    if best <= 0:
+        return False
+    if mode == "radix":
+        return True
+    return best * 2 <= pest
+
+
+# (P, B) -> did the MXU bucket-probe kernel lower AND run on this
+# process's devices? Probed once per shape with a tiny eager self-test;
+# a failure demotes to the XLA probe for THAT shape only — loudly, via
+# the pallas-demotion telemetry — instead of poisoning the whole DAG
+# program and demoting the entire query to the host executor.
+_PALLAS_JOIN_OK: dict = {}
+
+
+def _pallas_join_ok(P: int, B: int, note=None) -> bool:
+    ok = _PALLAS_JOIN_OK.get((P, B))
+    if ok is None:
+        try:
+            from opentenbase_tpu.ops import pallas_join as pj
+
+            m, _bi = pj.probe_radix_pallas(
+                jnp.zeros(P * B + 1, jnp.int64),
+                jnp.zeros(P * B + 1, jnp.bool_),
+                jnp.zeros(P * B + 1, jnp.int32),
+                jnp.zeros(8, jnp.int64),
+                jnp.zeros(8, jnp.bool_),
+                P, B,
+            )
+            jax.device_get(m)  # force real execution, not a lazy handle
+            ok = True
+        except Exception:
+            ok = False
+            if note is not None:
+                try:
+                    note(("pallas_join", P, B))
+                except Exception:
+                    pass
+        _PALLAS_JOIN_OK[(P, B)] = ok
+        while len(_PALLAS_JOIN_OK) > 64:
+            _PALLAS_JOIN_OK.pop(next(iter(_PALLAS_JOIN_OK)))
+    return ok
+
+
+def _lookup_radix(pk, pmask, bk, bmask, budget, fallback,
+                  pallas_probe: bool = False, pallas_note=None):
+    """Equi-join primitive over the bucket-padded radix hash table
+    (ops/join.py): ONE small build-side sort + a log2(bucket)-deep
+    bucket search per probe row, instead of sort-merge's full
+    (build+probe)-width co-sort. The spill-aware batch planner sizes
+    partitions/bucket against ``budget`` at trace time from the STATIC
+    shapes; a build side whose table would blow the budget splits into
+    multi-pass probes (nodeHash.c's nbatch, device-style: probe stays
+    resident, one transient table per pass) — and when even the maximum
+    pass count can't fit, ``fallback`` (the sort-merge primitive, O(1)
+    extra HBM) answers instead of OOMing the worker.
+
+    Same contract as ``_lookup_sortmerge``: (matched, bidx, flag); the
+    flag is raised by duplicate build keys (in-bucket adjacency or a
+    key matching in two passes), or by bucket overflow — the runner
+    then disables the radix formulation for this join and the
+    sort-merge retry re-derives the exact dup verdict."""
+    pd, pv = pk
+    bd, bv = bk
+    nb = bd.shape[0]
+    npr = pd.shape[0]
+    if nb == 0:  # static: no build rows can ever match
+        return (
+            jnp.zeros(npr, jnp.bool_),
+            jnp.zeros(npr, jnp.int32),
+            jnp.asarray(False),
+        )
+    plan = batchplan.plan_radix_join(nb, npr, budget)
+    if plan is None:
+        return fallback(pk, pmask, bk, bmask, check_dup=True)
+    breal = bmask if bv is None else (bmask & bv)
+    preal = pmask if pv is None else (pmask & pv)
+    P, B = plan.partitions, plan.bucket
+    matched = jnp.zeros(npr, jnp.bool_)
+    bidx = jnp.zeros(npr, jnp.int32)
+    flag = jnp.asarray(False)
+    chunk = -(-nb // plan.passes)
+    for p in range(plan.passes):
+        s = p * chunk
+        e = min(s + chunk, nb)
+        if s >= e:
+            break
+        tkeys, tvalid, tbidx, dup, ovf = join_ops.build_radix_table(
+            bd[s:e], breal[s:e], P, B
+        )
+        probed = False
+        if pallas_probe:
+            from opentenbase_tpu.ops import pallas_join as pj
+
+            if pj.eligible(e - s, P, B) and _pallas_join_ok(
+                P, B, note=pallas_note
+            ):
+                m, bi = pj.probe_radix_pallas(
+                    tkeys, tvalid, tbidx, pd, preal, P, B
+                )
+                probed = True
+        if not probed:
+            m, bi = join_ops.probe_radix_first(
+                tkeys, tvalid, tbidx, pd, preal, P, B
+            )
+        # a probe key matching in two passes = build dup across chunks
+        flag = flag | dup | ovf | jnp.any(m & matched)
+        bidx = jnp.where(m & ~matched, bi + jnp.int32(s), bidx)
+        matched = matched | m
+    return matched, bidx, flag
+
+
 def _agg_specs(comp, agg, dids):
     """(specs, afns) for an Aggregate's functions — the ONE compile
     loop shared by every grouped formulation."""
@@ -842,9 +980,27 @@ class _Builder:
         # orientation flips
         self.runner = runner
         self.D = D
-        self.fold_off = fold_off
+        # ``fold_off`` arrives either as a plain frozenset (legacy) or
+        # as the (fold_off, radix_off) pair the runner's retry loops
+        # thread through every compile — joins whose dense fold or
+        # radix table failed at runtime fall back to sort-merge
+        if (
+            isinstance(fold_off, tuple) and len(fold_off) == 2
+            and all(isinstance(s, frozenset) for s in fold_off)
+        ):
+            self.fold_off, self.radix_off = fold_off
+        else:
+            self.fold_off = frozenset(fold_off)
+            self.radix_off = frozenset()
         self.folded: set = set()
         self.folded_ids: dict = {}  # id(join) -> build_right, folded
+        self.radixed: set = set()  # joins THIS compile radix-hashed
+        fx_h = runner.fx if runner is not None else fx
+        self.join_mode = str(getattr(fx_h, "join_mode", "auto"))
+        self.radix_budget = batchplan.resolve_budget(
+            int(getattr(fx_h, "device_memory_limit", 0) or 0),
+            "OTB_RADIX_HBM_BUDGET", batchplan.DEFAULT_EXCHANGE_BUDGET,
+        )
         # windowed execution: (leaf id, width) — that scan leaf reads
         # only [wstart, wstart+width) of each shard's rows per run; the
         # runner appends the traced ``wstart`` to the leaf's block tuple
@@ -856,11 +1012,22 @@ class _Builder:
         self.captured = None
         # join primitive: double-sort merge on TPU (searchsorted is a
         # serial binary search there), sorted binary search elsewhere
-        try:
-            plat = str(fx.mesh.devices.flat[0].platform)
-        except Exception:
-            plat = "cpu"
+        platform_fn = getattr(fx, "platform", None)
+        if callable(platform_fn):
+            plat = platform_fn()  # FusedExecutor's one detector
+        else:  # test stubs without the method
+            try:
+                plat = str(fx.mesh.devices.flat[0].platform)
+            except Exception:
+                plat = "cpu"
+        self.platform = plat
         self.lookup = _lookup_sortmerge if plat == "tpu" else _lookup
+
+    def jinfo(self) -> tuple:
+        """(folded, radixed) join-index sets for THIS compile — cached
+        beside the program so the runner's flag handler knows whether a
+        raised flag means fold-disable, radix-disable, or flip."""
+        return (frozenset(self.folded), frozenset(self.radixed))
 
     def _fold_eligible(self, node: L.Join, ji: int, build_right: bool):
         """Attempt the dense direct-index lookup for this inner join?
@@ -1063,6 +1230,7 @@ class _Builder:
         jt = node.join_type
         build_right = True
         fold = False
+        use_radix = False
         bstrip_fn = None
         if jt == "inner":
             ji = self.njoin
@@ -1085,6 +1253,22 @@ class _Builder:
                 bstrip_fn = self.build(leaf, exchanged, D)
                 presorted = isinstance(leaf, RemoteSource) and bool(
                     exchanged.get(leaf.fragment, {}).get("presorted")
+                )
+            else:
+                # mode selection: fold (perfect hash over a dense key
+                # range) > radix hash table (small-vs-probe build by
+                # planner estimate) > sort-merge — each failure class
+                # degrades one step at runtime via the flag machinery
+                use_radix = _radix_gate(
+                    self.runner, node, ji, build_right, self.radix_off,
+                    self.join_mode,
+                )
+                if use_radix:
+                    self.radixed.add(ji)
+            if self.runner is not None:
+                self.runner.note_join_mode(
+                    ji,
+                    "fold" if fold else ("radix" if use_radix else "merge"),
                 )
         if self.D > 1:
             # replicated tables scanned INSIDE a multi-device join
@@ -1123,6 +1307,16 @@ class _Builder:
         )
         builder = self
         lookup = self.lookup
+        radix_budget = self.radix_budget
+        # the MXU one-hot bucket probe (ops/pallas_join.py) rides only
+        # on real TPU backends; elsewhere interpret mode would measure
+        # the emulator (the enable_pallas_scan convention)
+        pallas_probe = (
+            use_radix
+            and self.platform == "tpu"
+            and getattr(self.fx, "enable_pallas_join", True) is not False
+        )
+        pallas_note = getattr(self.fx, "_note_pallas_failure", None)
 
         def run(blocks, params, snap):
             if fold:
@@ -1189,9 +1383,16 @@ class _Builder:
                     pk, pmask, penv, pn = rk, rmask, renv, rn
                     bk, bmask, benv = lk, lmask, lenv
                     bn = ln
-                matched, bidx, dup = lookup(
-                    pk, pmask, bk, bmask, check_dup=True
-                )
+                if use_radix:
+                    matched, bidx, dup = _lookup_radix(
+                        pk, pmask, bk, bmask, radix_budget, lookup,
+                        pallas_probe=pallas_probe,
+                        pallas_note=pallas_note,
+                    )
+                else:
+                    matched, bidx, dup = lookup(
+                        pk, pmask, bk, bmask, check_dup=True
+                    )
                 flags = flags + [dup]
                 if do_capture:
                     builder.captured = (bidx, benv, bn)
@@ -1236,6 +1437,9 @@ class DagRunner:
         self._topk_off: dict = {}  # (skey, topk spec) -> ranking overflowed
         self._narrow_off: dict = {}  # skey -> i32 operands overflowed
         self._fold_off: dict = {}  # skey -> {join idx}: dense fold failed
+        # skey -> {join idx}: radix table failed at runtime (bucket
+        # overflow or duplicate build keys) — sort-merge answers instead
+        self._radix_off: dict = {}
         # negative sum values break the cumsum+cummax run-base trick;
         # the robust retry switches those sums to a segmented add scan
         self._robust_on: dict = {}
@@ -1250,6 +1454,11 @@ class DagRunner:
         # breakdown EXPLAIN ANALYZE VERBOSE prints for fused plans
         self.last_frag_ms: dict = {}
         self.last_folded = frozenset()  # joins dense-folded in last run
+        # join formulations the last run's programs compiled
+        # ('fold'/'radix'/'merge') — EXPLAIN and pg_stat_fused surface
+        # them so a mode-selection regression is visible per query
+        self.last_join_modes: tuple = ()
+        self._mode_notes: set = set()
         # bounded log of plans that fell back to the host path and why —
         # surfaced through pg_stat_fused so demotion is NEVER silent
         self.unsupported: list = []
@@ -1276,6 +1485,7 @@ class DagRunner:
         from time import perf_counter as _perf_counter
 
         frag_ms: dict = {}
+        self._mode_notes = set()
         frags = dplan.fragments
         if not frags:
             raise DagUnsupported("no fragments")
@@ -1342,8 +1552,13 @@ class DagRunner:
         )
         frag_ms["final"] = (_perf_counter() - t_f0) * 1000.0
         self.last_frag_ms = frag_ms
+        self.last_join_modes = tuple(sorted(self._mode_notes))
         self.completed += 1
         return final.index, batch
+
+    def note_join_mode(self, ji: int, mode: str) -> None:
+        """Builder callback: join ``ji`` compiled with ``mode``."""
+        self._mode_notes.add(mode)
 
     def _data_versions(self, frags) -> tuple:
         """(table, version) for every scanned store — keys the cached
@@ -1498,28 +1713,51 @@ class DagRunner:
             self, join, ji, build_right, self._fold_off.get(skey, ())
         )
 
-    def _on_flag(self, skey, orientation, flip, folded):
+    def _offs(self, skey) -> tuple:
+        """(fold_off, radix_off) frozenset pair for ``skey`` — threaded
+        through every compile (the builder unpacks it) and every cache
+        key (a disabled formulation must not reuse its old program)."""
+        return (
+            frozenset(self._fold_off.get(skey, ())),
+            frozenset(self._radix_off.get(skey, ())),
+        )
+
+    def _on_flag(self, skey, orientation, flip, jinfo):
         """One join raised its data flag. For a folded join the flag
         means 'build keys not a dense unique range' — disable the fold
-        for that join (keep the orientation) and let sort-merge answer;
-        for a sort-merge join it means duplicate build keys — flip the
-        build side (raises when both sides were tried)."""
+        for that join (keep the orientation) and let the next
+        formulation answer; for a radix join it means 'bucket overflow
+        or duplicate build keys' — disable the radix table the same
+        way (sort-merge re-derives the exact dup verdict); for a
+        sort-merge join it means duplicate build keys — flip the build
+        side (raises when both sides were tried)."""
+        folded, radixed = jinfo
         if flip in folded:
             self._fold_off.setdefault(skey, set()).add(flip)
             while len(self._fold_off) > 512:
                 self._fold_off.pop(next(iter(self._fold_off)))
+            return orientation
+        if flip in radixed:
+            self._radix_off.setdefault(skey, set()).add(flip)
+            while len(self._radix_off) > 512:
+                self._radix_off.pop(next(iter(self._radix_off)))
             return orientation
         return self._flip(orientation, flip)
 
     def _check_hbm_budget(self, cap: int, schema, D: int) -> None:
         """Bail to the host path before an exchange whose buffers would
         exhaust device memory (a crashed TPU worker is unrecoverable
-        in-process; the host path is merely slower)."""
-        row_bytes = sum(
-            np.dtype(c.type.np_dtype).itemsize + 1 for c in schema
+        in-process; the host path is merely slower). The budget is the
+        spill-aware planner's (device_memory_limit GUC > env knob >
+        default)."""
+        budget = batchplan.resolve_budget(
+            int(getattr(self.fx, "device_memory_limit", 0) or 0),
+            "OTB_EXCHANGE_HBM_BUDGET", EXCHANGE_HBM_BUDGET,
         )
-        est = cap * (D + 1) * D * row_bytes * 3
-        if est > EXCHANGE_HBM_BUDGET:
+        est = batchplan.exchange_bytes(
+            cap, batchplan.exchange_row_bytes(schema), D
+        )
+        if est > budget:
             raise DagUnsupported(
                 f"exchange needs ~{est >> 20} MiB (> budget)"
             )
@@ -1541,14 +1779,14 @@ class DagRunner:
         arrays = _collect_arrays(self.fx, frag.root, exchanged, D)
         sig = self._shapes_sig(arrays)
         while True:
-            fo = frozenset(self._fold_off.get(skey, ()))
+            fo = self._offs(skey)
             # pass 1: per-(src, dest) routed-row counts -> bucket size.
             # Skipped entirely (one round trip saved) when this exact
             # program + literal values already sized itself against
             # unchanged data (literals are lifted params, so the skey
             # alone would alias different constants).
             ckey = ("xcnt", skey, orientation, hashpos, D, sig, fo)
-            prog, comp, folded = self._cached_program(
+            prog, comp, jinfo = self._cached_program(
                 ckey,
                 lambda: self._compile_count(
                     frag.root, exchanged, orientation, hashpos, D, fo
@@ -1566,7 +1804,7 @@ class DagRunner:
                 flip = _first_true(flags)
                 if flip is not None:
                     orientation = self._on_flag(
-                        skey, orientation, flip, folded
+                        skey, orientation, flip, jinfo
                     )
                     continue
                 cap = filt_ops.bucket_size(
@@ -1577,7 +1815,7 @@ class DagRunner:
 
             # pass 2: the bucketed all_to_all
             xkey = ("xchg", skey, orientation, hashpos, D, cap, sig, fo)
-            prog, comp, folded = self._cached_program(
+            prog, comp, jinfo = self._cached_program(
                 xkey,
                 lambda: self._compile_exchange(
                     frag.root, exchanged, orientation, hashpos, D, cap,
@@ -1589,7 +1827,7 @@ class DagRunner:
             flags = [np.asarray(f) for f in flags]
             flip = _first_true(flags)
             if flip is not None:
-                orientation = self._on_flag(skey, orientation, flip, folded)
+                orientation = self._on_flag(skey, orientation, flip, jinfo)
                 continue
             self._orientations[skey] = orientation
             return {
@@ -1614,9 +1852,9 @@ class DagRunner:
         arrays = _collect_arrays(self.fx, frag.root, exchanged, D)
         sig = self._shapes_sig(arrays)
         while True:
-            fo = frozenset(self._fold_off.get(skey, ()))
+            fo = self._offs(skey)
             ckey = ("bcnt", skey, orientation, D, sig, fo)
-            prog, comp, folded = self._cached_program(
+            prog, comp, jinfo = self._cached_program(
                 ckey,
                 lambda: self._compile_broadcast_count(
                     frag.root, exchanged, orientation, D, fo
@@ -1634,7 +1872,7 @@ class DagRunner:
                 flip = _first_true(flags)
                 if flip is not None:
                     orientation = self._on_flag(
-                        skey, orientation, flip, folded
+                        skey, orientation, flip, jinfo
                     )
                     continue
                 cap = filt_ops.bucket_size(
@@ -1644,7 +1882,7 @@ class DagRunner:
             self._check_hbm_budget(cap, frag.root.schema, D)
 
             bkey = ("bcast", skey, orientation, D, cap, sig, fo)
-            prog, comp, folded = self._cached_program(
+            prog, comp, jinfo = self._cached_program(
                 bkey,
                 lambda: self._compile_broadcast(
                     frag.root, exchanged, orientation, D, cap, fo
@@ -1655,7 +1893,7 @@ class DagRunner:
             flags = [np.asarray(f) for f in flags]
             flip = _first_true(flags)
             if flip is not None:
-                orientation = self._on_flag(skey, orientation, flip, folded)
+                orientation = self._on_flag(skey, orientation, flip, jinfo)
                 continue
             self._orientations[skey] = orientation
             return {
@@ -1693,7 +1931,7 @@ class DagRunner:
                 out_specs=(P("dn"), [P("dn")] * nflags),
             )(arrays)
 
-        return jax.jit(program), comp, frozenset(b.folded)
+        return jax.jit(program), comp, b.jinfo()
 
     def _compile_broadcast(
         self, root, exchanged, orientation, D, cap, fo=frozenset()
@@ -1748,7 +1986,7 @@ class DagRunner:
                 ),
             )(arrays)
 
-        return jax.jit(program), comp, frozenset(b.folded)
+        return jax.jit(program), comp, b.jinfo()
 
     def _routed_eval(self, ev, hashpos, D):
         def run(blocks, params, snap):
@@ -1798,7 +2036,7 @@ class DagRunner:
                 out_specs=(P("dn"), [P("dn")] * nflags),
             )(arrays)
 
-        return jax.jit(program), comp, frozenset(b.folded)
+        return jax.jit(program), comp, b.jinfo()
 
     def _compile_exchange(
         self, root, exchanged, orientation, hashpos, D, cap,
@@ -1873,7 +2111,7 @@ class DagRunner:
                 ),
             )(arrays)
 
-        return jax.jit(program), comp, frozenset(b.folded)
+        return jax.jit(program), comp, b.jinfo()
 
     # -- final fragment ----------------------------------------------------
     def _run_final(
@@ -2020,7 +2258,7 @@ class DagRunner:
                 gs is not None or ga is not None
             ) and not self._narrow_off.get(skey)
             robust = bool(self._robust_on.get(skey))
-            fo = frozenset(self._fold_off.get(skey, ()))
+            fo = self._offs(skey)
             fkey = (
                 "final", skey, orientation, gcap, D, sig, packing,
                 tk if use_topk else None, bg is not None, psum,
@@ -2036,7 +2274,7 @@ class DagRunner:
                     return self._compile_gsort(
                         b, comp, agg, gs, root, exchanged, tk, D,
                         _count_inner_joins(root), narrow=narrow,
-                    ) + (frozenset(b.folded),)
+                    ) + (b.jinfo(),)
                 if ga is not None:
                     comp = ExprCompiler(lift_consts=True)
                     b = _Builder(
@@ -2048,7 +2286,7 @@ class DagRunner:
                         b, ev, comp, agg, root, tk, D,
                         _count_inner_joins(root), narrow=narrow,
                         robust=robust,
-                    ) + (frozenset(b.folded),)
+                    ) + (b.jinfo(),)
                 return self._compile_final(
                     frag, agg, root, exchanged, orientation, gcap, D,
                     packing,
@@ -2056,7 +2294,7 @@ class DagRunner:
                     fo=fo,
                 )
 
-            prog, comp, mode, folded = self._cached_program(
+            prog, comp, mode, jinfo = self._cached_program(
                 fkey, compile_final
             )
             params = self._resolve(comp, dicts_view, subquery_values)
@@ -2071,7 +2309,7 @@ class DagRunner:
                     continue  # recompile/lookup at the exact capacity
             outs = jax.device_get(prog(tuple(arrays), params, snap))
             self.last_mode = mode
-            self.last_folded = folded
+            self.last_folded = jinfo[0]
             okf = None
             ngroups = None
             if mode in ("gseg", "gsort", "gagg"):
@@ -2094,7 +2332,7 @@ class DagRunner:
                     packing = False
                     self._packing[skey] = False
                     continue
-                orientation = self._on_flag(skey, orientation, flip, folded)
+                orientation = self._on_flag(skey, orientation, flip, jinfo)
                 gcapkey = None  # keyed per orientation
                 continue
             if okf is not None and not bool(np.asarray(okf).all()):
@@ -2658,9 +2896,10 @@ class DagRunner:
         """(leaf, window_plan) when the final gagg program's sort
         operands would exceed the window budget: the dominant Scan leaf
         streams in shard-row windows. None when it all fits."""
-        budget = int(os.environ.get(
-            "OTB_DAG_WINDOW_BUDGET", 6_000_000_000
-        ))
+        budget = batchplan.resolve_budget(
+            int(getattr(self.fx, "device_memory_limit", 0) or 0),
+            "OTB_DAG_WINDOW_BUDGET", batchplan.DEFAULT_WINDOW_BUDGET,
+        )
         leaves = [
             lf for lf in _walk_leaves(root) if isinstance(lf, L.Scan)
         ]
@@ -2689,12 +2928,9 @@ class DagRunner:
         k = len(stores)
         # power-of-two window width dividing the power-of-two rmax, so
         # dynamic_slice never clamps into the previous window
-        width = rmax
-        while (
-            k * width * per_row * 3 > budget
-            and width % 2 == 0 and width > 1024
-        ):
-            width //= 2
+        width = batchplan.probe_window_width(
+            rmax, per_row * 3, k, budget
+        )
         if width >= rmax:
             return None
         return big, width, rmax
@@ -2720,7 +2956,7 @@ class DagRunner:
         h = None
         h_key = None
         while True:
-            fo = frozenset(self._fold_off.get(skey, ()))
+            fo = self._offs(skey)
             robust = bool(self._robust_on.get(skey))
             root_c, exch_c = root, exchanged
             ori_c, fo_c = orientation, fo
@@ -2744,14 +2980,17 @@ class DagRunner:
                     if gmap(i) < len(orientation) else "R"
                     for i in range(nj2 - 1)
                 ) + ("R",)  # prepped source always sits on the right
-                fo_c = frozenset(
-                    i for i in range(nj2) if gmap(i) in fo
+                fo_c = tuple(
+                    frozenset(
+                        i for i in range(nj2) if gmap(i) in s
+                    )
+                    for s in fo
                 )
             ckey = (
                 "wgagg", skey, orientation, D, sig, fo, cap, width,
                 robust, h is not None,
             )
-            wprog, mprog, comp, folded = self._cached_program(
+            wprog, mprog, comp, jinfo = self._cached_program(
                 ckey,
                 lambda rc=root_c, ec=exch_c, oc=ori_c, fc=fo_c, rb=robust:
                 self._compile_wgagg(
@@ -2772,18 +3011,20 @@ class DagRunner:
                 wouts.append(wprog(tuple(arr_w), params, snap))
             outs = jax.device_get(mprog(tuple(wouts), params, snap))
             (out_keys, out_vals, gvalid, novf, okf, flags) = outs
-            gfolded = (
-                folded if gmap is None
-                else frozenset(gmap(x) for x in folded)
+            gjinfo = (
+                jinfo if gmap is None
+                else tuple(
+                    frozenset(gmap(x) for x in s) for s in jinfo
+                )
             )
             self.last_mode = "wgagg"
-            self.last_folded = gfolded
+            self.last_folded = gjinfo[0]
             flip = _first_true(flags)
             if flip is not None:
                 orientation = self._on_flag(
                     skey, orientation,
                     flip if gmap is None else gmap(flip),
-                    gfolded,
+                    gjinfo,
                 )
                 continue
             if bool(np.asarray(novf).any()):
@@ -2858,17 +3099,19 @@ class DagRunner:
         # the right child, [0, b) when it is the left
         boff = p if build_right else 0
         poff = 0 if build_right else b
-        fo = self._fold_off.get(skey, set())
         ori_local = tuple(orientation[boff:boff + b])
-        fo_local = frozenset(
-            x - boff for x in fo if boff <= x < boff + b
+        fo_local = tuple(
+            frozenset(
+                x - boff for x in s if boff <= x < boff + b
+            )
+            for s in self._offs(skey)
         )
         bkey = (top.right_keys if build_right else top.left_keys)[0]
         pkey = (
             "prep", skey, tuple(orientation), D, fo_local, sig,
             versions,
         )
-        prog, comp, folded_local = self._cached_program(
+        prog, comp, jinfo_local = self._cached_program(
             pkey,
             lambda: self._compile_fold_prep(
                 bnode, exchanged, ori_local, fo_local, D, bkey
@@ -2883,7 +3126,9 @@ class DagRunner:
             # map the prep-local join index back to the global space
             self._on_flag(
                 skey, orientation, flip + boff,
-                frozenset(x + boff for x in folded_local),
+                tuple(
+                    frozenset(x + boff for x in s) for s in jinfo_local
+                ),
             )
             return "retry"
         schema2 = tuple(bnode.schema) + (
@@ -3015,7 +3260,7 @@ class DagRunner:
                 ),
             )(arrays)
 
-        return jax.jit(program), comp, frozenset(b.folded)
+        return jax.jit(program), comp, b.jinfo()
 
     def _compile_wgagg(
         self, agg, root, exchanged, topk, D, orientation, fo, leaf,
@@ -3412,7 +3657,7 @@ class DagRunner:
             jax.jit(window_program),
             jax.jit(merge_program),
             comp,
-            frozenset(b.folded),
+            b.jinfo(),
         )
 
     def _compile_gsort(
@@ -3941,7 +4186,7 @@ class DagRunner:
         if agg is not None and bg is not None and topk is not None:
             return self._compile_gseg(
                 b, ev, comp, agg, root, topk, psum, D, nflags
-            ) + (frozenset(b.folded),)
+            ) + (b.jinfo(),)
 
         if agg is not None:
             dids = [c.dict_id for c in root.schema]
@@ -4068,7 +4313,7 @@ class DagRunner:
                     out_specs=out_specs,
                 )(arrays)
 
-            return jax.jit(program), comp, mode, frozenset(b.folded)
+            return jax.jit(program), comp, mode, b.jinfo()
 
         # no aggregate: compact surviving rows on DEVICE to a static
         # per-device capacity before shipping — never transfer the padded
@@ -4124,7 +4369,7 @@ class DagRunner:
 
             return (
                 jax.jit(program), comp, "rows_topk",
-                frozenset(b.folded),
+                b.jinfo(),
             )
 
         rowcap = gcap  # reused capacity slot for rows mode
@@ -4167,7 +4412,7 @@ class DagRunner:
                 ),
             )(arrays)
 
-        return jax.jit(program), comp, "rows", frozenset(b.folded)
+        return jax.jit(program), comp, "rows", b.jinfo()
 
     # -- output collection -------------------------------------------------
     def _apply_proj(self, batch, agg, out_proj):

@@ -222,6 +222,13 @@ class LocalExecutor:
         rec["ms"] = (_time.perf_counter() - t0) * 1000.0
         rec["rows"] = int(out.live_count())
         rec["batch_rows"] = int(out.n)
+        if rec["op"] == "Join":
+            # which formulation answered (radix hash vs encode+sort) —
+            # a mode-selection regression must show in EXPLAIN ANALYZE,
+            # not only in a bench post-mortem
+            jm = getattr(self, "last_join_mode", None)
+            if jm:
+                rec["detail"] = f"{rec.get('detail') or ''} ({jm})".strip()
         return out
 
     def _eval_remotesource(self, plan) -> DevBatch:
@@ -1039,12 +1046,25 @@ class LocalExecutor:
             probe_keys[i] = (self._translate_codes(d, pdid, bdid), v)
         probe_keys, build_keys = _align_key_dtypes(probe_keys, build_keys)
 
-        build_ids, probe_ids = join_ops.encode_keys(
-            build_keys, probe_keys, build.mask, probe.mask
+        # single integer-family key: the bucket-padded radix hash table
+        # skips the joint encode sort AND the probe-width searchsorted
+        # (ops/join.py radix path; FULL joins also need the reverse
+        # counts, so they keep the encode ids)
+        build_ids = probe_ids = None
+        radix = None if jt == "full" else self._radix_counts(
+            probe_keys, build_keys, probe, build
         )
-        build_order, lo, counts, total = join_ops.match_counts(
-            build_ids, probe_ids
-        )
+        if radix is not None:
+            build_order, lo, counts, total = radix
+            self.last_join_mode = "radix"
+        else:
+            build_ids, probe_ids = join_ops.encode_keys(
+                build_keys, probe_keys, build.mask, probe.mask
+            )
+            build_order, lo, counts, total = join_ops.match_counts(
+                build_ids, probe_ids
+            )
+            self.last_join_mode = "merge"
 
         if jt in ("semi", "anti"):
             has = counts > 0
@@ -1159,6 +1179,53 @@ class LocalExecutor:
             )
             out = DevBatch(plan.schema, cols2, m2, new_n)
         return out
+
+    def _radix_counts(self, probe_keys, build_keys, probe, build):
+        """match_counts-contract tuple (build_order, lo, counts, total)
+        through the bucket-padded radix table, or None when the shape
+        stays on the encode+sort path: multi-key and float keys need the
+        joint encoding; a bucket-overflowed table (skewed hash) retries
+        once at 4x the quantum, then falls back rather than probing a
+        table that dropped rows."""
+        from opentenbase_tpu.ops.join import JOIN_MODE
+        from opentenbase_tpu.plan import batchplan
+
+        if JOIN_MODE() == "sortmerge" or len(build_keys) != 1:
+            return None
+        bd, bv = build_keys[0]
+        pd, pv = probe_keys[0]
+        if jnp.issubdtype(bd.dtype, jnp.floating) or jnp.issubdtype(
+            pd.dtype, jnp.floating
+        ):
+            return None
+        plan = batchplan.plan_radix_join(
+            build.n, probe.n,
+            batchplan.resolve_budget(
+                0, "OTB_RADIX_HBM_BUDGET",
+                batchplan.DEFAULT_EXCHANGE_BUDGET,
+            ),
+        )
+        if plan is None or plan.passes != 1:
+            return None
+
+        def real(mask, v, n):
+            if mask is None and v is None:
+                return jnp.ones(n, jnp.bool_)
+            if mask is None:
+                return v
+            return mask if v is None else (mask & v)
+
+        breal = real(build.mask, bv, build.n)
+        preal = real(probe.mask, pv, probe.n)
+        bucket = plan.bucket
+        for _ in range(2):
+            bo, lo, cnt, tot, ovf = join_ops.radix_match_counts(
+                bd, breal, pd, preal, plan.partitions, bucket
+            )
+            if not bool(ovf):
+                return bo, lo, cnt, tot
+            bucket *= 4
+        return None
 
     # -- union -------------------------------------------------------------
     def _translate_codes(self, d, src_did: str, dst_did: str):

@@ -195,6 +195,34 @@ def render_cluster_metrics(cluster) -> str:
                 int(d.stats.get("rewrites", 0)),
             ))
 
+    # device health: platform gauge + demotion counters. The r04/r05
+    # bench rounds silently executed on platform=cpu (tunnel_down) and
+    # nobody noticed until the JSON was read — a scrape must show it.
+    fx = getattr(cluster, "_fused", None)
+    if fx is not None:
+        _head(out, "otb_device_platform", "gauge",
+              "Fused-executor device platform (1 = active)")
+        try:
+            plat = fx.platform()
+        except Exception:
+            plat = "unknown"
+        out.append(_line(
+            "otb_device_platform", {"platform": plat}, 1,
+        ))
+        _head(out, "otb_pallas_demotions_total", "counter",
+              "Pallas kernels demoted to the XLA path")
+        out.append(_line(
+            "otb_pallas_demotions_total", {},
+            int(getattr(fx, "pallas_demotions", 0)),
+        ))
+        _head(out, "otb_dag_demotions_total", "counter",
+              "Fused/DAG queries demoted to the host executor "
+              "by unexpected exceptions")
+        out.append(_line(
+            "otb_dag_demotions_total", {},
+            int(getattr(fx, "dag_demotion_count", 0)),
+        ))
+
     # gauges: WAL position, sessions, replication lag, pool occupancy,
     # DN heartbeat age (from the health prober's bookkeeping)
     _head(out, "otb_sessions", "gauge", "Registered sessions")

@@ -1,0 +1,186 @@
+"""Pallas TPU kernel: bucket-padded radix hash-join probe.
+
+The serial half of a hash join — walking a bucket per probe tuple
+(nodeHashjoin.c ExecScanHashBucket) — is hostile to the TPU's vector
+units: Mosaic has no per-lane gather from an arbitrary VMEM table. This
+kernel recasts the bucket walk as an MXU one-hot contraction, the same
+trick the engine's grouped aggregation plays (ops/agg.py superblock):
+
+- the (small) build side is packed OUTSIDE the kernel into a
+  bucket-padded radix table (ops/join.build_radix_table): P power-of-two
+  partitions x B quantum-padded slots, so the table shape is static
+  across batches;
+- probe rows stream HBM -> VMEM in blocks; each block builds a one-hot
+  [block, P] partition-selector and ONE ``jnp.dot`` against the resident
+  table gathers every slot of every probe row's bucket — a gather-free
+  bucket lookup at MXU rate;
+- exactness: Pallas TPU compute is f32, so 64-bit keys ride as
+  radix-4096 limb planes (12 bits per limb, 6 limbs — each limb value
+  < 2^12 is trivially f32-exact, and a one-hot row selects exactly one
+  partition, so the contraction result IS the limb, not a rounded sum).
+  A slot matches iff every limb plane matches. Build row indices stay
+  below 2^24 (the eligibility gate enforces it), so they ride a single
+  exact f32 plane.
+
+The XLA probe (ops/join.probe_radix_first) remains the reference
+semantics; this kernel is the device fast path for small dimension
+tables (P <= 4096 keeps the one-hot block in VMEM). Tested in
+interpreter mode on CPU (tests/test_join_device.py); a lowering or
+runtime failure on the real chip demotes to the XLA probe LOUDLY
+through the pallas-demotion telemetry (obs/exporter.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+try:  # removed from the jax namespace in 0.4.x
+    _enable_x64 = jax.enable_x64
+except AttributeError:
+    from jax.experimental import enable_x64 as _enable_x64
+
+LIMB_BITS = 12
+LIMB_MASK = (1 << LIMB_BITS) - 1
+LIMBS = 6  # 6 x 12 = 72 bits >= the full int64 key domain
+BLOCK = 256  # probe rows per grid step: one-hot block stays ~4 MB VMEM
+MAX_PARTITIONS = 4096  # one-hot lane bound (VMEM) — dimension tables
+MAX_BUILD = 1 << 24  # build row indices must be f32-exact
+
+
+def eligible(nb: int, partitions: int, bucket: int) -> bool:
+    """Static gate: table shapes this kernel can hold in VMEM with
+    exact f32 index planes."""
+    return (
+        0 < nb < MAX_BUILD
+        and partitions <= MAX_PARTITIONS
+        and bucket * LIMBS <= 512
+    )
+
+
+def split_limbs(key64):
+    """[n] int64 -> [n, LIMBS] f32 radix-4096 limb planes (equality on
+    all limbs == equality on the key; each limb < 2^12 is f32-exact)."""
+    u = key64.astype(jnp.int64).astype(jnp.uint64)
+    return jnp.stack(
+        [
+            ((u >> jnp.uint64(LIMB_BITS * i)) & jnp.uint64(LIMB_MASK))
+            .astype(jnp.float32)
+            for i in range(LIMBS)
+        ],
+        axis=-1,
+    )
+
+
+def pack_table(tkeys, tvalid, tbidx, partitions: int, bucket: int):
+    """ops/join radix table -> the kernel's f32 planes:
+    (limbs [P, B*LIMBS], valid [P, B], bidx [P, B])."""
+    P, B = partitions, bucket
+    limbs = split_limbs(tkeys[: P * B]).reshape(P, B * LIMBS)
+    valid = tvalid[: P * B].astype(jnp.float32).reshape(P, B)
+    bidx = tbidx[: P * B].astype(jnp.float32).reshape(P, B)
+    return limbs, valid, bidx
+
+
+def build_probe(
+    partitions: int, bucket: int, block: int = BLOCK,
+    interpret: bool = False,
+):
+    """fn(tlimbs [P, B*L] f32, tvalid [P, B] f32, tbidx [P, B] f32,
+    part [n] f32, plimbs [n, L] f32) -> (matched [n] f32, bidx [n] f32).
+
+    ``part`` is the probe row's radix partition (ops/join.radix_parts,
+    computed outside — it needs the murmur mix, which wants integer
+    ops); NULL/dead probe rows carry part = -1 and match nothing."""
+    from jax.experimental import pallas as pl
+
+    P, B = partitions, bucket
+    L = LIMBS
+
+    def kernel(tl_ref, tv_ref, ti_ref, part_ref, pl_ref, m_ref, b_ref):
+        part = part_ref[...]  # [block]
+        plimbs = pl_ref[...]  # [block, L]
+        lane = jax.lax.broadcasted_iota(jnp.float32, (block, P), 1)
+        onehot = (lane == part[:, None]).astype(jnp.float32)
+        # ONE MXU contraction gathers the whole bucket for the block:
+        # limbs, validity, and index planes concatenate on the slot axis
+        bucket_l = jnp.dot(
+            onehot, tl_ref[...], preferred_element_type=jnp.float32
+        )  # [block, B*L]
+        bucket_v = jnp.dot(
+            onehot, tv_ref[...], preferred_element_type=jnp.float32
+        )  # [block, B]
+        bucket_i = jnp.dot(
+            onehot, ti_ref[...], preferred_element_type=jnp.float32
+        )  # [block, B]
+        matched = jnp.zeros((block,), jnp.float32)
+        bidx = jnp.zeros((block,), jnp.float32)
+        for b in range(B):
+            hit = bucket_v[:, b] > 0.5
+            for l in range(L):
+                hit = hit & (bucket_l[:, b * L + l] == plimbs[:, l])
+            hitf = hit.astype(jnp.float32)
+            # build keys are unique (the dup flag fired otherwise), so
+            # at most one slot hits: max keeps the result exact even on
+            # the flagged-and-discarded duplicate run
+            matched = jnp.maximum(matched, hitf)
+            bidx = jnp.maximum(bidx, hitf * bucket_i[:, b])
+        m_ref[...] = matched
+        b_ref[...] = bidx
+
+    def run(tlimbs, tvalid, tbidx, part, plimbs):
+        n = part.shape[0]
+        grid = max((n + block - 1) // block, 1)
+        padded = grid * block
+        if padded != n:
+            part = jnp.pad(part, (0, padded - n), constant_values=-1.0)
+            plimbs = jnp.pad(plimbs, ((0, padded - n), (0, 0)))
+        # the engine runs in global x64 mode; this kernel is pure f32
+        # (see ops/pallas_scan.py for the Mosaic i64-scalar rationale)
+        with _enable_x64(False):
+            matched, bidx = pl.pallas_call(
+                kernel,
+                grid=(grid,),
+                in_specs=[
+                    pl.BlockSpec((P, B * L), lambda i: (0, 0)),
+                    pl.BlockSpec((P, B), lambda i: (0, 0)),
+                    pl.BlockSpec((P, B), lambda i: (0, 0)),
+                    pl.BlockSpec((block,), lambda i: (i,)),
+                    pl.BlockSpec((block, L), lambda i: (i, 0)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((block,), lambda i: (i,)),
+                    pl.BlockSpec((block,), lambda i: (i,)),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct((padded,), jnp.float32),
+                    jax.ShapeDtypeStruct((padded,), jnp.float32),
+                ],
+                interpret=interpret,
+            )(tlimbs, tvalid, tbidx, part, plimbs)
+        return matched[:n], bidx[:n]
+
+    return run
+
+
+def probe_radix_pallas(
+    tkeys, tvalid, tbidx, probe_key, probe_real, partitions: int,
+    bucket: int, interpret: bool = False,
+):
+    """Drop-in for ops/join.probe_radix_first over the same radix table,
+    probing through the Pallas kernel. Same contract:
+    (matched [np] bool, bidx [np] int32)."""
+    from opentenbase_tpu.ops.join import radix_parts
+
+    key64 = probe_key.astype(jnp.int64)
+    part = jnp.where(
+        probe_real, radix_parts(key64, partitions), jnp.int32(-1)
+    ).astype(jnp.float32)
+    tlimbs, tvalidf, tbidxf = pack_table(
+        tkeys, tvalid, tbidx, partitions, bucket
+    )
+    plimbs = split_limbs(key64)
+    matched, bidx = build_probe(
+        partitions, bucket, interpret=interpret
+    )(tlimbs, tvalidf, tbidxf, part.astype(jnp.float32), plimbs)
+    return matched > 0.5, bidx.astype(jnp.int32)

@@ -218,13 +218,64 @@ print("telemetry smoke OK: scrape moved, chaos run reconstructed "
       "from logs + health")
 PY
 
+echo "== tier1: join-mode + perf-gate smoke =="
+timeout -k 10 180 python - <<'PY' || exit 1
+# Join-mode smoke (ops/join.py radix path + executor mode selection) and
+# the perf-regression gate: a tiny join must answer identically under
+# BOTH formulations on BOTH executors, EXPLAIN must say which mode ran
+# (a mode-selection regression fails HERE, not in the next TPU bench),
+# the checked-in BENCH_FLOORS.json must validate against its schema, and
+# the gate must fail a synthetic floor violation and a forced demotion.
+import os
+from opentenbase_tpu import bench_gate
+from opentenbase_tpu.engine import Cluster
+
+s = Cluster(num_datanodes=2, shard_groups=16).session()
+s.execute("create table jd (k bigint, g int) distribute by roundrobin")
+s.execute("create table jf (k bigint, v bigint) distribute by roundrobin")
+s.execute("insert into jd values "
+          + ",".join(f"({i*5+2}, {i})" for i in range(30)))
+s.execute("insert into jf values "
+          + ",".join(f"({(i%40)*5+2}, {i})" for i in range(900)))
+s.execute("analyze")
+Q = "select g, sum(v) from jf, jd where jf.k = jd.k group by g order by g"
+res = {}
+for mode in ("radix", "sortmerge"):
+    s.execute(f"set join_mode = {mode}")
+    res[mode] = s.query(Q)
+assert res["radix"] == res["sortmerge"], "fused join-mode parity broke"
+s.execute("set join_mode = radix")
+lines = [r[0] for r in s.query(f"explain analyze {Q}")]
+assert any("Fused join modes:" in ln and "radix" in ln for ln in lines), lines
+s.execute("set enable_fused_execution = off")
+os.environ["OTB_JOIN_MODE"] = "radix"
+hostrows = s.query(Q)
+lines = [r[0] for r in s.query(f"explain analyze {Q}")]
+del os.environ["OTB_JOIN_MODE"]
+assert hostrows == res["radix"], "host radix parity broke"
+assert any(ln.strip().startswith("Join") and "(radix)" in ln
+           for ln in lines), lines
+doc = bench_gate.load_floors()  # raises on schema errors
+green = {"platform": "default"}
+for m, spec in doc["floors"].items():
+    green[m] = spec["floor"] * 2
+assert bench_gate.check_record(green, doc) == []
+bad = dict(green); bad["q3_rows_per_sec"] = 1
+assert any("q3_rows_per_sec" in v
+           for v in bench_gate.check_record(bad, doc))
+dem = dict(green); dem["tunnel_down"] = True
+assert any("demotion" in v for v in bench_gate.check_record(dem, doc))
+print("join smoke OK: radix == sortmerge (fused+host), EXPLAIN shows "
+      "mode, floors validate, gate fails violation+demotion")
+PY
+
 echo "== tier1: full suite =="
 rm -f /tmp/_t1.log
 # 870s was calibrated against a 786s run of 664 tests; the suite is now
-# 681 tests and shared-runner speed swings ~25% run to run — 1200s keeps
-# the cap meaningful (a hang still trips it) without cutting a slow but
-# healthy run at the 85% mark
-timeout -k 10 1200 python -m pytest tests/ -q -m 'not slow' \
+# 728 tests (join-device differential suite included) and a loaded
+# shared runner measured 1257s — 1500s keeps the cap meaningful (a hang
+# still trips it) without cutting a slow but healthy run short
+timeout -k 10 1500 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
