@@ -1,0 +1,69 @@
+"""The ratchet: findings diff against a checked-in baseline.
+
+``tools/lint_baseline.json`` records every finding the tree carried
+when the pass landed, keyed by the stable ``rule::path::ident`` key.
+``otb_lint --check`` fails ONLY on findings absent from the baseline —
+new debt — while pre-existing entries are burned down PR by PR.
+``--update-baseline`` regenerates the file deliberately; a shrinking
+baseline is progress, a growing one is a reviewed decision.
+
+Rules in ``core.NEVER_BASELINE`` are refused here: a reasonless
+suppression cannot ratchet itself in by being baselined.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable
+
+from opentenbase_tpu.analysis.core import NEVER_BASELINE, Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = os.path.join("tools", "lint_baseline.json")
+
+
+def load(path: str) -> dict:
+    """Baseline doc: {"version": 1, "findings": {key: summary}}. A
+    missing file is an empty baseline (first run / fresh checkout)."""
+    if not os.path.exists(path):
+        return {"version": BASELINE_VERSION, "findings": {}}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: unsupported baseline version {doc.get('version')!r}"
+        )
+    if not isinstance(doc.get("findings"), dict):
+        raise ValueError(f"{path}: malformed baseline (no findings map)")
+    return doc
+
+
+def save(path: str, findings: Iterable[Finding]) -> dict:
+    """Write the baseline for ``findings`` (sorted, line numbers kept
+    only as a human hint — keys carry no position)."""
+    doc = {
+        "version": BASELINE_VERSION,
+        "findings": {
+            f.key: {"line": f.line, "message": f.message}
+            for f in findings
+            if f.rule not in NEVER_BASELINE
+        },
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as out:
+        json.dump(doc, out, indent=1, sort_keys=True)
+        out.write("\n")
+    os.replace(tmp, path)
+    return doc
+
+
+def diff(findings: Iterable[Finding], doc: dict) -> tuple[list, list]:
+    """(new, fixed): findings not in the baseline, and baseline keys no
+    longer present in the tree. ``new`` failing is the ratchet;
+    ``fixed`` is the burn-down to harvest with --update-baseline."""
+    base = doc["findings"]
+    current = {f.key: f for f in findings}
+    new = [f for k, f in sorted(current.items()) if k not in base]
+    fixed = [k for k in sorted(base) if k not in current]
+    return new, fixed

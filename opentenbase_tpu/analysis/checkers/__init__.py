@@ -1,0 +1,35 @@
+"""Checker registry — one module per invariant family, each encoding a
+bug class this repo has already paid to learn (the motivating incident
+is named in each module's docstring)."""
+
+from __future__ import annotations
+
+from opentenbase_tpu.analysis.checkers import (
+    deprecated,
+    exceptions,
+    faults,
+    guc,
+    numeric,
+    sockets,
+    wire,
+)
+
+_MODULES = (guc, deprecated, sockets, faults, exceptions, numeric, wire)
+
+
+def all_checkers() -> list:
+    out = []
+    for mod in _MODULES:
+        out.extend(mod.checkers())
+    return out
+
+
+def all_rules() -> list[tuple[str, str]]:
+    """(rule, one-line description) for --list-rules."""
+    from opentenbase_tpu.analysis.core import FRAMEWORK_RULES
+
+    out = list(FRAMEWORK_RULES)
+    for c in all_checkers():
+        for rule, desc in c.rules:
+            out.append((rule, desc))
+    return sorted(out)

@@ -1,0 +1,112 @@
+"""Exception hygiene on net/storage paths — the swallowed-
+ConnectionError class.
+
+PR 4's review caught a broad handler that ate a connection failure
+without marking the channel broken: the pool handed the NEXT caller a
+desynced socket carrying the previous call's reply. On distributed
+paths a broad catch must do one of three honest things: re-raise,
+``elog`` the swallow, or mark the resource broken/discarded. A bare
+``except:`` / ``except Exception:`` that does none of them is a bug
+waiting for its traffic.
+
+Scope: ``net/``, ``dn/``, ``gtm/``, ``storage/``, ``executor/dist.py``.
+Narrow handlers (``except OSError``) are out of scope — naming the
+exception is already a decision. Teardown functions (stop/close) are
+exempt: swallowing during shutdown is the idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from opentenbase_tpu.analysis.core import (
+    Finding,
+    Project,
+    iter_functions,
+    walk_shallow,
+)
+from opentenbase_tpu.analysis.checkers.faults import _in_scope
+from opentenbase_tpu.analysis.checkers.sockets import _is_teardown
+
+_LOG_CALL_NAMES = {
+    "elog", "emit", "log", "warning", "error", "exception", "print",
+}
+_BROKEN_CALL_NAMES = {"discard", "mark_broken", "close", "_discard"}
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        base = n.id if isinstance(n, ast.Name) else (
+            n.attr if isinstance(n, ast.Attribute) else None
+        )
+        if base in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _handler_is_honest(handler: ast.ExceptHandler) -> bool:
+    """Re-raises, elogs, or marks something broken/discarded."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                leaf = (
+                    t.attr if isinstance(t, ast.Attribute)
+                    else t.id if isinstance(t, ast.Name) else ""
+                )
+                if "broken" in leaf or "closed" in leaf or "down" in leaf:
+                    return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            leaf = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else ""
+            )
+            if leaf in _LOG_CALL_NAMES or leaf in _BROKEN_CALL_NAMES:
+                return True
+    return False
+
+
+class ExceptionHygieneChecker:
+    rules = (
+        ("except-swallow", "broad except that neither re-raises, "
+                           "elogs, nor marks the channel broken"),
+    )
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for rel, sf in sorted(project.files.items()):
+            if not _in_scope(rel):
+                continue
+            for qualname, fn in iter_functions(sf.tree):
+                if _is_teardown(qualname):
+                    continue
+                seq = 0
+                for node in walk_shallow(fn):
+                    if not isinstance(node, ast.ExceptHandler):
+                        continue
+                    if not _handler_is_broad(node):
+                        continue
+                    seq += 1
+                    if _handler_is_honest(node):
+                        continue
+                    yield Finding(
+                        rule="except-swallow",
+                        path=rel,
+                        line=node.lineno,
+                        message=(
+                            f"{qualname}: broad except swallows on a "
+                            f"distributed path — re-raise, elog the "
+                            f"swallow, or mark the channel broken "
+                            f"(the desynced-pool-socket class)"
+                        ),
+                        ident=f"{qualname}:{seq}",
+                    )
+
+
+def checkers() -> list:
+    return [ExceptionHygieneChecker()]

@@ -1,0 +1,119 @@
+"""Failpoint coverage — every distributed boundary must be provokable.
+
+PR 4 built the failpoint registry on the thesis that recovery code
+nobody can trigger is recovery code that doesn't work. The thesis only
+holds while NEW distributed boundaries keep getting sites — so this
+checker makes the gap mechanical:
+
+- ``fault-missing``: a function under ``net/``, ``dn/``, ``gtm/``,
+  ``storage/`` or in ``executor/dist.py`` that performs socket I/O or
+  fsync must contain a ``FAULT("...")`` (or ``self._failpoint`` /
+  module ``_failpoint`` wrapper) site;
+- ``fault-duplicate-site``: literal site strings are unique across the
+  tree — two boundaries sharing a name means an armed fault fires
+  somewhere the operator didn't aim.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from opentenbase_tpu.analysis.core import (
+    Finding,
+    Project,
+    iter_functions,
+    walk_shallow,
+)
+
+_SCOPED_PREFIXES = (
+    "opentenbase_tpu/net/",
+    "opentenbase_tpu/dn/",
+    "opentenbase_tpu/gtm/",
+    "opentenbase_tpu/storage/",
+)
+_SCOPED_FILES = ("opentenbase_tpu/executor/dist.py",)
+
+# performing one of these = this function IS a distributed boundary
+_IO_ATTRS = {
+    "sendall", "connect", "accept", "recv", "recv_into", "recvfrom",
+    "fsync",
+}
+_IO_FUNCS = {"send_frame", "recv_frame"}
+_FAULT_NAMES = {"FAULT", "_failpoint", "failpoint"}
+
+
+def _in_scope(rel: str) -> bool:
+    return rel.startswith(_SCOPED_PREFIXES) or rel in _SCOPED_FILES
+
+
+class FailpointCoverageChecker:
+    rules = (
+        ("fault-missing", "socket-I/O/fsync function with no FAULT site"),
+        ("fault-duplicate-site", "FAULT site string used more than once"),
+    )
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        # site -> [(path, line, qualname)] across the whole tree
+        sites: dict[str, list] = {}
+        for rel, sf in sorted(project.files.items()):
+            scoped = _in_scope(rel)
+            for qualname, fn in iter_functions(sf.tree):
+                does_io = False
+                io_line = fn.lineno
+                has_fault = False
+                for node in walk_shallow(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    f = node.func
+                    attr = f.attr if isinstance(f, ast.Attribute) else None
+                    name = f.id if isinstance(f, ast.Name) else None
+                    if attr in _IO_ATTRS or name in _IO_FUNCS:
+                        if not does_io:
+                            does_io, io_line = True, node.lineno
+                    if attr in _FAULT_NAMES or name in _FAULT_NAMES:
+                        has_fault = True
+                        if node.args and isinstance(
+                            node.args[0], ast.Constant
+                        ) and isinstance(node.args[0].value, str):
+                            sites.setdefault(
+                                node.args[0].value, []
+                            ).append((rel, node.lineno, qualname))
+                if scoped and does_io and not has_fault:
+                    yield Finding(
+                        rule="fault-missing",
+                        path=rel,
+                        line=io_line,
+                        message=(
+                            f"{qualname} performs socket I/O or fsync "
+                            f"with no FAULT site — this distributed "
+                            f"boundary cannot be chaos-tested; add "
+                            f'FAULT("<area>/<name>") or suppress with '
+                            f"why the boundary is exempt"
+                        ),
+                        ident=qualname,
+                    )
+        for site, uses in sorted(sites.items()):
+            distinct = sorted({(p, q) for p, _ln, q in uses})
+            if len(distinct) <= 1:
+                continue
+            for rel, line, qualname in uses:
+                others = ", ".join(
+                    f"{p}:{q}" for p, q in distinct
+                    if (p, q) != (rel, qualname)
+                )
+                yield Finding(
+                    rule="fault-duplicate-site",
+                    path=rel,
+                    line=line,
+                    message=(
+                        f'FAULT site "{site}" in {qualname} is also '
+                        f"used by {others} — site strings must name "
+                        f"one boundary"
+                    ),
+                    ident=f"{qualname}:{site}",
+                )
+
+
+def checkers() -> list:
+    return [FailpointCoverageChecker()]

@@ -1,0 +1,133 @@
+"""GUC lifecycle — the ``log_min_messages`` class.
+
+PR 5 found ``log_min_messages`` had been *registered, parsed, and
+validated* since PR 1 while the logging pipeline never consulted it:
+every severity was kept. A GUC that validates but does nothing is
+worse than an error — it lies to the operator. Two rules:
+
+- ``guc-unread``: every name in config.py's GUCS registry must appear
+  as a string constant in at least one module other than config.py
+  (tests live outside the package and never count as a read);
+- ``guc-unregistered``: every literal passed to a ``gucs.get`` /
+  ``conf_gucs.get`` / ``GUCS[...]`` read must be a registered name
+  (or dotted, PG's custom-variable escape) — a typo'd read silently
+  returns the default forever, the same lie from the other side.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from opentenbase_tpu.analysis.core import Finding, Project
+
+CONFIG_PATH = "opentenbase_tpu/config.py"
+_READ_ATTRS = {"gucs", "conf_gucs"}
+_READ_SUBSCRIPTS = {"gucs", "conf_gucs", "GUCS"}
+
+
+def registered_gucs(project: Project) -> dict[str, int]:
+    """name -> registration line, from the GUCS dict display in
+    config.py (the single source of truth, parsed not imported so the
+    checker works on any tree state)."""
+    sf = project.get(CONFIG_PATH)
+    if sf is None:
+        return {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.AnnAssign) and not isinstance(
+            node, ast.Assign
+        ):
+            continue
+        targets = (
+            [node.target] if isinstance(node, ast.AnnAssign)
+            else node.targets
+        )
+        if not any(
+            isinstance(t, ast.Name) and t.id == "GUCS" for t in targets
+        ):
+            continue
+        value = node.value
+        if isinstance(value, ast.Dict):
+            return {
+                k.value: k.lineno
+                for k in value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+    return {}
+
+
+class GucLifecycleChecker:
+    rules = (
+        ("guc-unread", "registered GUC never consulted outside config.py"),
+        ("guc-unregistered", "GUC read string not in the registry"),
+    )
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        gucs = registered_gucs(project)
+        for name, lineno in sorted(gucs.items()):
+            if not project.read_anywhere(name, exclude=(CONFIG_PATH,)):
+                yield Finding(
+                    rule="guc-unread",
+                    path=CONFIG_PATH,
+                    line=lineno,
+                    message=(
+                        f'GUC "{name}" is registered but never read '
+                        f"outside config.py — it validates, then lies "
+                        f"(the log_min_messages class); wire it up or "
+                        f"suppress with the reason it exists"
+                    ),
+                    ident=name,
+                )
+        for rel, sf in sorted(project.files.items()):
+            if rel == CONFIG_PATH:
+                continue
+            for node in ast.walk(sf.tree):
+                name, lineno = _guc_read(node)
+                if name is None or "." in name or name in gucs:
+                    continue
+                yield Finding(
+                    rule="guc-unregistered",
+                    path=rel,
+                    line=lineno,
+                    message=(
+                        f'GUC read "{name}" has no registry entry in '
+                        f"config.py — the read silently returns its "
+                        f"fallback forever; register it or fix the typo"
+                    ),
+                    ident=name,
+                )
+
+
+def _guc_read(node: ast.AST):
+    """(name, line) when ``node`` is a GUC read, else (None, None):
+    ``X.gucs.get("n", ...)``, ``X.conf_gucs.get("n")``,
+    ``gucs["n"]`` / ``GUCS["n"]`` subscripts."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "get"
+        and isinstance(node.func.value, ast.Attribute)
+        and node.func.value.attr in _READ_ATTRS
+        and node.args
+        and isinstance(node.args[0], ast.Constant)
+        and isinstance(node.args[0].value, str)
+    ):
+        return node.args[0].value, node.lineno
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        base_name = (
+            base.id if isinstance(base, ast.Name)
+            else base.attr if isinstance(base, ast.Attribute)
+            else None
+        )
+        if (
+            base_name in _READ_SUBSCRIPTS
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+        ):
+            return node.slice.value, node.lineno
+    return None, None
+
+
+def checkers() -> list:
+    return [GucLifecycleChecker()]

@@ -1,0 +1,94 @@
+"""Numeric-width discipline — the ``emit_pairs`` int32-cumsum class.
+
+PR 6's review caught an int32 ``cumsum`` feeding join-pair offsets:
+past 2^31 cumulative pairs the prefix sum wraps negative and the
+gather reads garbage — silently, and only at production cardinality.
+The surviving code (ops/join.py) spells the fix: cast the operand to
+int64 BEFORE the reduction.
+
+Rule ``int32-width``: a ``cumsum``/``sum`` call whose operand is
+explicitly int32 (``astype(jnp.int32)`` / ``dtype=jnp.int32``) inside
+a statement that never mentions int64. Bounded uses (segment ids over
+padded blocks) are real and get pragmas saying exactly why the bound
+holds — the reason IS the review.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from opentenbase_tpu.analysis.core import (
+    Finding,
+    Project,
+    iter_functions,
+    walk_shallow,
+)
+
+_REDUCTIONS = {"cumsum", "sum"}
+
+
+def _mentions(node: ast.AST, needle: str) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and needle in sub.attr:
+            return True
+        if isinstance(sub, ast.Name) and needle in sub.id:
+            return True
+        if isinstance(sub, ast.Constant) and isinstance(
+            sub.value, str
+        ) and needle in sub.value:
+            return True
+    return False
+
+
+class NumericWidthChecker:
+    rules = (
+        ("int32-width", "int32 cumsum/sum result with no int64 cast"),
+    )
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for rel, sf in sorted(project.files.items()):
+            for qualname, fn in iter_functions(sf.tree):
+                seq = 0
+                # simple (leaf) statements only: a compound statement
+                # would both double-visit its calls and smear the
+                # int64-mention test over unrelated lines
+                for stmt in walk_shallow(fn):
+                    if not isinstance(stmt, (
+                        ast.Assign, ast.AugAssign, ast.AnnAssign,
+                        ast.Expr, ast.Return,
+                    )):
+                        continue
+                    if _mentions(stmt, "int64"):
+                        continue
+                    for node in ast.walk(stmt):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        f = node.func
+                        name = (
+                            f.attr if isinstance(f, ast.Attribute)
+                            else f.id if isinstance(f, ast.Name) else ""
+                        )
+                        if name not in _REDUCTIONS:
+                            continue
+                        if not _mentions(node, "int32"):
+                            continue
+                        seq += 1
+                        yield Finding(
+                            rule="int32-width",
+                            path=rel,
+                            line=node.lineno,
+                            message=(
+                                f"{qualname}: {name}() over an int32 "
+                                f"operand with no int64 cast in the "
+                                f"statement — wraps negative past 2^31 "
+                                f"(the emit_pairs overflow); cast the "
+                                f"operand to int64 first, or pragma "
+                                f"with the bound that makes int32 safe"
+                            ),
+                            ident=f"{qualname}:{name}:{seq}",
+                        )
+
+
+def checkers() -> list:
+    return [NumericWidthChecker()]

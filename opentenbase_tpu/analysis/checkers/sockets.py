@@ -1,0 +1,140 @@
+"""Socket teardown hygiene — the 155-seconds-per-run class.
+
+PR 3 found 31 server ``stop()`` paths that ``close()``d sockets
+without ``shutdown()``: a thread blocked in ``accept()``/``recv()``
+holds the old fd, so plain close never wakes it and every teardown
+waited out a ``join(timeout)``. ~155 s of every tier-1 run was
+sleeping. The one blessed idiom is ``net.protocol.shutdown_and_close``.
+
+- ``socket-shutdown``: ``X.close()`` on a socket-ish target inside a
+  stop/close/teardown function, with neither ``X.shutdown(...)`` nor
+  ``shutdown_and_close(X)`` in the same function;
+- ``socket-blocking-loop``: an ``accept()``/``recv()`` call inside a
+  ``while`` loop in a file that never uses ``shutdown_and_close``,
+  ``shutdown()`` or ``settimeout`` — nothing can ever wake that loop
+  for teardown.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from opentenbase_tpu.analysis.core import (
+    Finding,
+    Project,
+    dotted_name,
+    iter_functions,
+)
+
+_TEARDOWN_NAMES = {
+    "stop", "close", "teardown", "shutdown", "disconnect",
+    "stopper", "__exit__", "__del__", "_stop", "_close", "_teardown",
+}
+_RECV_ATTRS = {"accept", "recv", "recv_into", "recvfrom"}
+
+
+def _sockish(target: str) -> bool:
+    """Heuristic for 'this expression is a socket': terminal name
+    mentions sock/conn. `self._lsock`, `self.sock`, `conn`, `c.sock`."""
+    leaf = target.rsplit(".", 1)[-1].lower().lstrip("_")
+    return "sock" in leaf or leaf in ("conn", "connection")
+
+
+def _is_teardown(qualname: str) -> bool:
+    leaf = qualname.rsplit(".", 1)[-1]
+    return leaf in _TEARDOWN_NAMES or leaf.startswith(("stop", "close"))
+
+
+class SocketHygieneChecker:
+    rules = (
+        ("socket-shutdown", "close() without shutdown() in teardown"),
+        ("socket-blocking-loop", "accept()/recv() loop with no wakeup"),
+    )
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for rel, sf in sorted(project.files.items()):
+            file_has_wakeup = any(
+                s in sf.text
+                for s in ("shutdown_and_close", ".shutdown(", "settimeout")
+            )
+            for qualname, fn in iter_functions(sf.tree):
+                yield from self._check_fn(
+                    rel, qualname, fn, file_has_wakeup
+                )
+
+    def _check_fn(self, rel, qualname, fn, file_has_wakeup):
+        closes: list[tuple[str, int]] = []
+        shutdown_targets: set[str] = set()
+        blessed_targets: set[str] = set()
+        recv_in_loop: list[tuple[str, int]] = []
+
+        def visit(node, in_loop):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # nested defs report under their own name
+                child_in_loop = in_loop or isinstance(
+                    child, (ast.While, ast.For)
+                )
+                if isinstance(child, ast.Call):
+                    f = child.func
+                    if isinstance(f, ast.Attribute):
+                        target = dotted_name(f.value) or ""
+                        if f.attr == "close" and not child.args:
+                            closes.append((target, child.lineno))
+                        elif f.attr == "shutdown":
+                            shutdown_targets.add(target)
+                        elif f.attr in _RECV_ATTRS and in_loop:
+                            recv_in_loop.append(
+                                (f"{target}.{f.attr}", child.lineno)
+                            )
+                    elif (
+                        isinstance(f, ast.Name)
+                        and f.id == "shutdown_and_close"
+                        and child.args
+                    ):
+                        blessed_targets.add(
+                            dotted_name(child.args[0]) or ""
+                        )
+                visit(child, child_in_loop)
+
+        visit(fn, False)
+
+        if _is_teardown(qualname):
+            for target, lineno in closes:
+                if not _sockish(target):
+                    continue
+                if target in shutdown_targets or target in blessed_targets:
+                    continue
+                yield Finding(
+                    rule="socket-shutdown",
+                    path=rel,
+                    line=lineno,
+                    message=(
+                        f"{qualname}: {target}.close() without a "
+                        f"preceding {target}.shutdown() — a peer blocked "
+                        f"in accept()/recv() keeps the old fd and sleeps "
+                        f"out its timeout; use "
+                        f"net.protocol.shutdown_and_close({target})"
+                    ),
+                    ident=f"{qualname}:{target}",
+                )
+        if not file_has_wakeup:
+            for seq, (what, lineno) in enumerate(recv_in_loop, 1):
+                yield Finding(
+                    rule="socket-blocking-loop",
+                    path=rel,
+                    line=lineno,
+                    message=(
+                        f"{qualname}: {what}() inside a loop, and this "
+                        f"module never calls shutdown()/settimeout — "
+                        f"no teardown can wake this loop"
+                    ),
+                    # seq disambiguates two loops over one target in
+                    # one function (gtm/standby._recv has exactly that)
+                    ident=f"{qualname}:{what}:{seq}",
+                )
+
+
+def checkers() -> list:
+    return [SocketHygieneChecker()]

@@ -1,0 +1,272 @@
+"""Wire-protocol consistency — ops must land, SQLSTATEs must exist.
+
+Two registries, two rules:
+
+- ``wire-op-unhandled``: a protocol op literal sent through a client
+  (``{"op": ...}`` through ``Channel.rpc`` / ``send_frame`` in
+  net/client.py, or a ``OP_*`` opcode constant in gtm/client.py) must
+  have a matching handler literal in the paired server module. An op
+  with no handler is an error reply at best and a hung client at
+  worst — and it compiles fine.
+- ``sqlstate-unknown``: every SQLSTATE literal (SQLError's second
+  argument, a ``sqlstate=`` kwarg or class attribute) must be a valid
+  5-char code registered in ``opentenbase_tpu/errcodes.py`` — one
+  shared registry, the errcodes.txt discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from opentenbase_tpu.analysis.core import Finding, Project
+
+_SQLSTATE_SHAPE = re.compile(r"^[0-9A-Z]{5}$")
+_ERRCODES_PATH = "opentenbase_tpu/errcodes.py"
+
+
+def _registry_codes(project: Project) -> set:
+    """The ERRCODES keys of the ANALYZED tree (parsed, not imported —
+    `--root` must judge that tree's registry, not the running
+    checkout's). Falls back to the in-process registry only when the
+    analyzed tree has no errcodes.py at all (synthetic test trees)."""
+    sf = project.get(_ERRCODES_PATH)
+    if sf is None:
+        from opentenbase_tpu.errcodes import ERRCODES
+
+        return set(ERRCODES)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                [node.target] if isinstance(node, ast.AnnAssign)
+                else node.targets
+            )
+            if any(
+                isinstance(t, ast.Name) and t.id == "ERRCODES"
+                for t in targets
+            ) and isinstance(node.value, ast.Dict):
+                return {
+                    k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                }
+    return set()
+
+# JSON-op senders -> the server module whose dispatch must know the op.
+# Channel.rpc travels to DN server processes from everywhere (engine,
+# executor, CLI tools), so rpc() calls are collected tree-wide.
+_NET_CLIENT = "opentenbase_tpu/net/client.py"
+_NET_SERVER = "opentenbase_tpu/net/server.py"
+_DN_SERVER = "opentenbase_tpu/dn/server.py"
+_GTM_CLIENT = "opentenbase_tpu/gtm/client.py"
+_GTM_SERVER = "opentenbase_tpu/gtm/server.py"
+
+
+def _op_literal_of_dict(d: ast.Dict):
+    for k, v in zip(d.keys, d.values):
+        if (
+            isinstance(k, ast.Constant) and k.value == "op"
+            and isinstance(v, ast.Constant) and isinstance(v.value, str)
+        ):
+            return v.value
+    return None
+
+
+def _sent_json_ops(project: Project):
+    """[(op, path, line, to_server)] for every op literal that actually
+    crosses a wire: ``X.rpc({"op": ...})`` (DN wire) and
+    ``send_frame(sock, {"op": ...})`` in net/client.py (CN wire).
+    DDL-journal dicts (persistence.log_ddl) never hit a socket and are
+    not collected."""
+    out = []
+    for rel, sf in sorted(project.files.items()):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute) and f.attr == "rpc"
+                and node.args and isinstance(node.args[0], ast.Dict)
+            ):
+                op = _op_literal_of_dict(node.args[0])
+                if op is not None:
+                    out.append((op, rel, node.lineno, _DN_SERVER))
+            elif (
+                rel == _NET_CLIENT
+                and isinstance(f, ast.Name) and f.id == "send_frame"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Dict)
+            ):
+                op = _op_literal_of_dict(node.args[1])
+                if op is not None:
+                    out.append((op, rel, node.lineno, _NET_SERVER))
+    return out
+
+
+def _handled_ops(sf) -> set:
+    """Every string constant COMPARED against something called ``op``
+    in a server module: ``op == "ping"``, ``msg.get("op") == "close"``,
+    ``op in ("a", "b")``. Only Compare nodes are scanned — if a server
+    ever refactors to a dict dispatch table, teach this function the
+    new shape FIRST or every sent op goes red at once."""
+    ops: set = set()
+
+    def is_op_expr(e) -> bool:
+        if isinstance(e, ast.Name) and e.id == "op":
+            return True
+        if (
+            isinstance(e, ast.Call)
+            and isinstance(e.func, ast.Attribute)
+            and e.func.attr == "get"
+            and e.args
+            and isinstance(e.args[0], ast.Constant)
+            and e.args[0].value == "op"
+        ):
+            return True
+        return False
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if not any(is_op_expr(s) for s in sides):
+            continue
+        for s in sides:
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                ops.add(s.value)
+            elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                ops.update(
+                    e.value for e in s.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                )
+    return ops
+
+
+def _gtm_opcodes(sf) -> dict[str, int]:
+    """OP_* -> line from module-level assignments in gtm/client.py."""
+    out = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id.startswith("OP_"):
+                    out[t.id] = node.lineno
+    return out
+
+
+class WireProtocolChecker:
+    rules = (
+        ("wire-op-unhandled", "op sent with no handler in the server"),
+        ("sqlstate-unknown", "SQLSTATE literal not in errcodes registry"),
+    )
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        handled = {
+            srv: _handled_ops(project.get(srv))
+            for srv in (_NET_SERVER, _DN_SERVER)
+            if project.get(srv) is not None
+        }
+        for op, rel, line, srv in _sent_json_ops(project):
+            if op in handled.get(srv, set()):
+                continue
+            yield Finding(
+                rule="wire-op-unhandled",
+                path=rel,
+                line=line,
+                message=(
+                    f'op "{op}" is sent here but {srv} has no handler '
+                    f"literal for it — the peer answers with an error "
+                    f"(or nothing)"
+                ),
+                ident=f"{op}->{srv}",
+            )
+        gtm_client = project.get(_GTM_CLIENT)
+        gtm_server = project.get(_GTM_SERVER)
+        if gtm_client is not None and gtm_server is not None:
+            for name, line in sorted(_gtm_opcodes(gtm_client).items()):
+                if re.search(rf"\b{re.escape(name)}\b", gtm_server.text):
+                    continue
+                yield Finding(
+                    rule="wire-op-unhandled",
+                    path=_GTM_CLIENT,
+                    line=line,
+                    message=(
+                        f"opcode {name} is defined for the GTM wire "
+                        f"but {_GTM_SERVER} never references it — the "
+                        f"server grants an error status for it"
+                    ),
+                    ident=f"{name}->{_GTM_SERVER}",
+                )
+        yield from self._check_sqlstates(project)
+
+    def _check_sqlstates(self, project: Project) -> Iterable[Finding]:
+        registry = _registry_codes(project)
+        for rel, sf in sorted(project.files.items()):
+            if rel == _ERRCODES_PATH:
+                continue
+            for node in ast.walk(sf.tree):
+                for code, line in _sqlstate_literals(node):
+                    if code in registry:
+                        continue
+                    shape = (
+                        "malformed (not 5 chars of [0-9A-Z])"
+                        if not _SQLSTATE_SHAPE.match(code)
+                        else "not registered in errcodes.ERRCODES"
+                    )
+                    yield Finding(
+                        rule="sqlstate-unknown",
+                        path=rel,
+                        line=line,
+                        message=(
+                            f"SQLSTATE {code!r} is {shape} — register "
+                            f"it with its PG condition name or fix "
+                            f"the typo"
+                        ),
+                        ident=code,
+                    )
+
+
+def _sqlstate_literals(node: ast.AST):
+    """(code, line) pairs in SQLSTATE positions: SQLError(msg, CODE),
+    sqlstate=CODE kwargs, and ``sqlstate = CODE`` / ``state = CODE``
+    assignments."""
+    if isinstance(node, ast.Call):
+        fname = (
+            node.func.id if isinstance(node.func, ast.Name)
+            else node.func.attr if isinstance(node.func, ast.Attribute)
+            else ""
+        )
+        if fname == "SQLError" and len(node.args) >= 2:
+            a = node.args[1]
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                yield a.value, a.lineno
+        for kw in node.keywords:
+            if kw.arg == "sqlstate" and isinstance(
+                kw.value, ast.Constant
+            ) and isinstance(kw.value.value, str):
+                yield kw.value.value, kw.value.lineno
+    elif isinstance(node, ast.Assign):
+        for t in node.targets:
+            leaf = (
+                t.id if isinstance(t, ast.Name)
+                else t.attr if isinstance(t, ast.Attribute) else ""
+            )
+            if not isinstance(node.value, ast.Constant) or not isinstance(
+                node.value.value, str
+            ):
+                continue
+            # `sqlstate = X` is always a SQLSTATE position; a bare
+            # `state = X` only when X has the 5-char shape AND a digit
+            # (every real SQLSTATE class carries one; `state = "READY"`
+            # is someone's state machine, not a wire code)
+            if leaf == "sqlstate" or (
+                leaf == "state"
+                and _SQLSTATE_SHAPE.match(node.value.value)
+                and any(ch.isdigit() for ch in node.value.value)
+            ):
+                yield node.value.value, node.value.lineno
+
+
+def checkers() -> list:
+    return [WireProtocolChecker()]

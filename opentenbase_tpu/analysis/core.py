@@ -1,0 +1,267 @@
+"""otb_lint framework: parse once, check many, suppress explicitly.
+
+A ``Project`` walks the package tree, parses every module into a
+``SourceFile`` (text + AST + the per-line pragma table + a string-
+constant index), and hands the whole set to each checker so cross-file
+invariants (a GUC registered here must be read there; an op sent here
+must be handled there) cost one parse per file total.
+
+Findings carry a **stable key** — ``rule::path::ident`` where ``ident``
+names the violating symbol (a GUC name, a function qualname, an op
+string), never a line number — so the baseline survives unrelated
+edits that shift lines.
+
+Suppression is inline and always carries its why::
+
+    sock.close()  # otb_lint: ignore[socket-shutdown] -- rendezvous fd, never connected
+
+A pragma with no ``-- reason`` does not suppress; it becomes a
+``pragma-missing-reason`` finding that can never be baselined, so a
+bare mute cannot ratchet itself in.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+# pragma grammar, after a comment hash: the marker `otb_lint:` then
+# `ignore[...]` with rule names, then a mandatory reason behind `--`
+_PRAGMA_RE = re.compile(
+    r"#\s*otb_lint:\s*ignore\[([A-Za-z0-9_,\- ]*)\]"
+    r"(?:\s*--\s*(.*\S))?\s*$"
+)
+
+# rules whose findings are refused by the baseline: they must be fixed
+# at the source, never ratcheted in
+NEVER_BASELINE = frozenset({"pragma-missing-reason"})
+
+# rules emitted by the framework itself (not by any checker module)
+FRAMEWORK_RULES = (
+    ("pragma-missing-reason", "suppression without a -- reason"),
+    ("pragma-unused", "suppression whose finding no longer fires"),
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored for humans (path:line) and keyed
+    for the ratchet (rule::path::ident)."""
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+    ident: str  # stable within (rule, path): symbol, not position
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.ident}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}"
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: frozenset  # rule names, or {"*"}
+    reason: Optional[str]
+    used: bool = False
+
+    def covers(self, rule: str) -> bool:
+        return "*" in self.rules or rule in self.rules
+
+
+@dataclass
+class SourceFile:
+    path: str  # absolute
+    relpath: str  # repo-relative, forward slashes
+    text: str
+    tree: ast.AST
+    pragmas: dict = field(default_factory=dict)  # line -> Pragma
+    # every str constant in the module -> first line it appears on
+    # (the cross-file "is this name mentioned anywhere" index)
+    str_constants: dict = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: str, relpath: str) -> "SourceFile":
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        tree = ast.parse(text, filename=relpath)
+        sf = cls(path=path, relpath=relpath, text=text, tree=tree)
+        # pragmas come from REAL comment tokens only — a pragma spelled
+        # inside a docstring (this framework's own docs, a checker's
+        # message template) is prose, not a suppression
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _PRAGMA_RE.search(tok.string)
+                if m is None:
+                    continue
+                lineno = tok.start[0]
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                ) or frozenset({"*"})
+                sf.pragmas[lineno] = Pragma(lineno, rules, m.group(2))
+        except tokenize.TokenError:
+            pass  # compileall owns malformed files
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                sf.str_constants.setdefault(node.value, node.lineno)
+        return sf
+
+    def suppression_for(self, finding: Finding) -> Optional[Pragma]:
+        """The pragma covering ``finding``, if any: same line or the
+        line above (for statements too long to share a line)."""
+        for lineno in (finding.line, finding.line - 1):
+            p = self.pragmas.get(lineno)
+            if p is not None and p.covers(finding.rule):
+                return p
+        return None
+
+
+class Project:
+    """The parsed package: ``files`` maps repo-relative paths to
+    SourceFiles. Checkers receive the whole project."""
+
+    def __init__(self, root: str, package: str = "opentenbase_tpu"):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.files: dict[str, SourceFile] = {}
+        self.parse_errors: list[str] = []
+        pkg_dir = os.path.join(self.root, package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+                try:
+                    self.files[rel] = SourceFile.parse(path, rel)
+                except SyntaxError as e:  # compileall owns syntax; note it
+                    self.parse_errors.append(f"{rel}: {e}")
+
+    def get(self, relpath: str) -> Optional[SourceFile]:
+        return self.files.get(relpath)
+
+    def read_anywhere(self, literal: str, exclude: tuple = ()) -> bool:
+        """Does ``literal`` appear as a string constant in any module
+        outside ``exclude``? (Tests live outside the package and are
+        excluded by construction.)"""
+        for rel, sf in self.files.items():
+            if rel in exclude:
+                continue
+            if literal in sf.str_constants:
+                return True
+        return False
+
+
+def iter_functions(tree: ast.AST):
+    """(qualname, node) for every def/async def, nested included."""
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child
+                yield from walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def walk_shallow(fn: ast.AST):
+    """ast.walk that does NOT descend into nested def/class — code in
+    a nested function reports under the nested qualname only, never
+    double-attributed to every enclosing scope."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for an Attribute/Name chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def run_checkers(
+    project: Project, checkers: Iterable,
+) -> tuple[list[Finding], list[Finding]]:
+    """Run every checker; apply pragmas. Returns (active, suppressed)
+    findings, both sorted. Reasonless pragmas that matched a finding
+    surface as ``pragma-missing-reason`` findings of their own."""
+    raw: list[Finding] = []
+    for checker in checkers:
+        raw.extend(checker.run(project))
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in raw:
+        sf = project.files.get(f.path)
+        pragma = sf.suppression_for(f) if sf is not None else None
+        if pragma is None:
+            active.append(f)
+            continue
+        pragma.used = True
+        if pragma.reason:
+            suppressed.append(f)
+        else:
+            active.append(f)
+            active.append(Finding(
+                rule="pragma-missing-reason",
+                path=f.path,
+                line=pragma.line,
+                message=(
+                    f"suppression of {f.rule} has no reason; write "
+                    f"`# otb_lint: ignore[{f.rule}] -- <why>`"
+                ),
+                ident=f"{pragma.line}:{f.rule}",
+            ))
+    # a pragma that matched nothing is rot: its finding was fixed (or
+    # its rule renamed) and the mute now only misleads the next reader
+    for rel, sf in sorted(project.files.items()):
+        seq: dict = {}
+        for lineno in sorted(sf.pragmas):
+            p = sf.pragmas[lineno]
+            if p.used:
+                continue
+            rules = ",".join(sorted(p.rules))
+            n = seq[rules] = seq.get(rules, 0) + 1
+            active.append(Finding(
+                rule="pragma-unused",
+                path=rel,
+                line=lineno,
+                message=(
+                    f"suppression of [{rules}] matches no finding — "
+                    f"the violation is gone; remove the pragma"
+                ),
+                ident=f"{rules}:{n}",
+            ))
+    key = lambda f: (f.path, f.line, f.rule, f.ident)  # noqa: E731
+    return sorted(set(active), key=key), sorted(set(suppressed), key=key)

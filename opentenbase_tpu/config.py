@@ -103,7 +103,7 @@ GUCS: dict = {
     # exchange buffers, probe windows) is sized against; 0 = use the
     # per-op env knobs / baked-in defaults
     "device_memory_limit": (_int, 0),
-    "enable_fast_query_shipping": (_bool, True),
+    "enable_fast_query_shipping": (_bool, True),  # otb_lint: ignore[guc-unread] -- reserved: the FQS fast-path (pgxc_FQS_planner) is not built yet; accepted so conf files written for the reference load unchanged
     # within-fragment scan workers on DN processes (execParallel.c's
     # max_parallel_workers_per_gather analog)
     "dn_parallel_workers": (_int, 4),
@@ -117,11 +117,11 @@ GUCS: dict = {
     # cap on the admission-queue wait when statement_timeout is 0
     # (otherwise a parked statement waits unbounded); 0 = no cap
     "wlm_queue_timeout": (_duration, 0),
-    "search_path": (_str, "public"),
+    "search_path": (_str, "public"),  # otb_lint: ignore[guc-unread] -- the engine has one flat namespace (no CREATE SCHEMA); accepted because every PG client driver SETs it at connect
     "session_authorization": (_str, None),
     "role": (_str, None),
     "application_name": (_str, ""),
-    "client_min_messages": (
+    "client_min_messages": (  # otb_lint: ignore[guc-unread] -- no NOTICE/WARNING wire channel exists yet (frames carry rows or one error); becomes real when the pgwire front end grows NoticeResponse
         _enum("debug", "log", "notice", "warning", "error"), "notice",
     ),
     # server logging (obs/log.py, the elog.c pipeline). Severity order is

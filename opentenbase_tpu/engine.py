@@ -2027,7 +2027,10 @@ class Session:
                 d = self.cluster.matviews.get(stmt.name)
                 if d is not None:
                     est_stmt = d.query
-            est = estimate_statement_memory(est_stmt, self.cluster.catalog)
+            est = estimate_statement_memory(
+                est_stmt, self.cluster.catalog,
+                work_mem=self.gucs.get("work_mem", 0),
+            )
         timeout_ms = 0
         if group.limited():
             # queue-wait deadline: the REMAINING statement budget when a
@@ -6916,7 +6919,9 @@ def _sv_cluster_activity(c: Cluster):
     for s in sorted(c.sessions, key=lambda s: s.session_id):
         wtype, wevent = c.waits.current_for(s.session_id)
         rows.append((
-            s.session_id, s.state, s.last_query[:100], wtype, wevent,
+            s.session_id,
+            str(s.gucs.get("application_name", "") or ""),
+            s.state, s.last_query[:100], wtype, wevent,
             int(getattr(s, "frag_retries", 0)),
             int(getattr(s, "frag_failovers", 0)),
         ))
@@ -7502,6 +7507,9 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
     "pg_stat_cluster_activity": (
         {
             "session_id": t.INT4,
+            # the application_name GUC, PG's pg_stat_activity column —
+            # '' until the client SETs it
+            "application_name": t.TEXT,
             "state": t.TEXT,
             "query": t.TEXT,
             "wait_event_type": t.TEXT,

@@ -1,0 +1,76 @@
+"""THE SQLSTATE registry — src/backend/utils/errcodes.txt in one dict.
+
+The reference generates errcodes.h from a single authoritative table;
+every ``ereport`` names a code from it and nothing else. This module is
+that table for the reproduction: each entry is a valid 5-character
+SQLSTATE (class + subclass, [0-9A-Z]) with its PG condition name.
+``otb_lint``'s wire-protocol checker validates every SQLSTATE literal
+in the tree against this registry, so a typo'd code ("40O01") or an
+invented one fails static analysis instead of reaching a client.
+
+Add a code here WHEN a raise site needs it — with the PG name, so the
+registry stays an index into the reference's semantics rather than a
+dumping ground.
+"""
+
+from __future__ import annotations
+
+ERRCODES: dict[str, str] = {
+    # class 00/08 — success, connection exceptions
+    "00000": "successful_completion",
+    "08000": "connection_exception",
+    "08003": "connection_does_not_exist",
+    "08006": "connection_failure",
+    "08P01": "protocol_violation",
+    # class 22 — data exception
+    "22003": "numeric_value_out_of_range",
+    "22012": "division_by_zero",
+    "22023": "invalid_parameter_value",
+    "22P02": "invalid_text_representation",
+    # class 23 — integrity constraint violation
+    "23505": "unique_violation",
+    "23502": "not_null_violation",
+    # class 25 — invalid transaction state
+    "25001": "active_sql_transaction",
+    "25P02": "in_failed_sql_transaction",
+    # class 28 — invalid authorization specification
+    "28000": "invalid_authorization_specification",
+    "28P01": "invalid_password",
+    # class 2B — dependent objects still exist
+    "2BP01": "dependent_objects_still_exist",
+    # class 40 — transaction rollback
+    "40001": "serialization_failure",
+    "40P01": "deadlock_detected",
+    # class 42 — syntax error or access rule violation
+    "42601": "syntax_error",
+    "42501": "insufficient_privilege",
+    "42704": "undefined_object",
+    "42710": "duplicate_object",
+    "42809": "wrong_object_type",
+    "42P01": "undefined_table",
+    "42P07": "duplicate_table",
+    "42703": "undefined_column",
+    "42883": "undefined_function",
+    # class 53 — insufficient resources
+    "53000": "insufficient_resources",
+    "53200": "out_of_memory",
+    "53300": "too_many_connections",
+    # class 55 — object not in prerequisite state
+    "55000": "object_not_in_prerequisite_state",
+    "55P03": "lock_not_available",
+    # class 57 — operator intervention
+    "57014": "query_canceled",
+    "57P01": "admin_shutdown",
+    # class XX — internal error
+    "XX000": "internal_error",
+}
+
+
+def is_valid(code: str) -> bool:
+    """Registered AND well-formed (5 chars, [0-9A-Z])."""
+    return code in ERRCODES
+
+
+def condition_name(code: str) -> str:
+    """PG condition name for a code ('' when unregistered)."""
+    return ERRCODES.get(code, "")

@@ -23,6 +23,7 @@ import weakref
 from typing import Optional
 
 from opentenbase_tpu.gtm.gts import GlobalTimestamp, TxnInfo, TxnState
+from opentenbase_tpu.net.protocol import shutdown_and_close
 
 _SRC = os.path.join(os.path.dirname(__file__), "native", "gts_server.cpp")
 
@@ -168,7 +169,9 @@ class NativeGTS:
 
     def close(self) -> None:
         try:
-            self._sock.close()
+            # shutdown+close: the server's per-connection thread wakes
+            # from its recv now, not at its socket timeout
+            shutdown_and_close(self._sock)
         finally:
             if self._proc is not None:
                 _reap(self._proc)

@@ -14,7 +14,11 @@ import time
 from dataclasses import dataclass, field
 
 from opentenbase_tpu.fault import FAULT
-from opentenbase_tpu.net.protocol import recv_frame, send_frame
+from opentenbase_tpu.net.protocol import (
+    recv_frame,
+    send_frame,
+    shutdown_and_close,
+)
 
 
 class WireError(RuntimeError):
@@ -192,7 +196,10 @@ class ClientSession:
         except OSError:
             pass
         finally:
-            self._sock.close()
+            # shutdown+close so the server's backend thread blocked in
+            # recv_frame wakes immediately even when the close frame
+            # above never made it out
+            shutdown_and_close(self._sock)
 
     def __enter__(self) -> "ClientSession":
         return self

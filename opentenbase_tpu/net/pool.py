@@ -15,7 +15,11 @@ import threading
 from typing import Optional
 
 from opentenbase_tpu.fault import FAULT
-from opentenbase_tpu.net.protocol import encode_frame, recv_frame
+from opentenbase_tpu.net.protocol import (
+    encode_frame,
+    recv_frame,
+    shutdown_and_close,
+)
 
 
 class Channel:
@@ -72,10 +76,9 @@ class Channel:
         return resp
 
     def close(self) -> None:
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        # shutdown first: the DN-side _serve thread blocked in recv on
+        # this channel wakes NOW instead of sleeping out its timeout
+        shutdown_and_close(self.sock)
 
 
 class ChannelError(RuntimeError):

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 
 try:  # removed from the jax namespace in 0.4.x
-    _enable_x64 = jax.enable_x64
+    _enable_x64 = jax.enable_x64  # otb_lint: ignore[deprecated-api] -- probed under except AttributeError; the 0.4.x location is the fallback below
 except AttributeError:
     from jax.experimental import enable_x64 as _enable_x64
 

@@ -47,7 +47,7 @@ def _plan_peak_bytes(plan, catalog, memo) -> float:
     return peak
 
 
-def estimate_statement_memory(stmt, catalog) -> int:
+def estimate_statement_memory(stmt, catalog, work_mem: int = 0) -> int:
     """Admission-control memory estimate (bytes) for a statement.
 
     SELECTs plan through the analyzer and take the widest estimated
@@ -55,6 +55,12 @@ def estimate_statement_memory(stmt, catalog) -> int:
     short positional passes). Any analysis failure falls back to
     DEFAULT_ESTIMATE — admission must never reject a statement the
     executor could run just because estimation choked.
+
+    ``work_mem`` (the session GUC, bytes) floors every estimate: PG
+    grants each statement's sort/hash scratch up to work_mem before
+    spilling, so admission charges at least that much per statement —
+    raising work_mem honestly shrinks how many statements a
+    memory-budgeted group admits at once.
 
     Cost note: this analyzes the statement a second time (execution
     re-analyzes); only sessions in a group with memory_limit > 0 pay
@@ -69,6 +75,7 @@ def estimate_statement_memory(stmt, catalog) -> int:
     ):
         # matview population is its defining query's read
         stmt = stmt.query
+    floor = max(int(work_mem or 0), 0)
     if isinstance(stmt, A.Select):
         try:
             from opentenbase_tpu.plan import analyze_statement
@@ -78,10 +85,10 @@ def estimate_statement_memory(stmt, catalog) -> int:
             peak = _plan_peak_bytes(splan.root, catalog, memo)
             for sub in getattr(splan, "subplans", ()) or ():
                 peak = max(peak, _plan_peak_bytes(sub, catalog, memo))
-            return max(int(peak), 1)
+            return max(int(peak), floor, 1)
         except Exception:
-            return DEFAULT_ESTIMATE
+            return max(DEFAULT_ESTIMATE, floor)
     if isinstance(stmt, A.Insert):
         nrows = len(stmt.values) if stmt.values else 1000
-        return max(nrows * 64, DEFAULT_ESTIMATE)
-    return DEFAULT_ESTIMATE
+        return max(nrows * 64, DEFAULT_ESTIMATE, floor)
+    return max(DEFAULT_ESTIMATE, floor)

@@ -269,6 +269,46 @@ def test_queue_wait_bounded_by_statement_timeout():
     assert g.running == 0 and not g.queue
 
 
+def test_work_mem_floors_admission_estimate():
+    """The work_mem GUC (PR 8 burn-down wiring): every statement is
+    charged at least work_mem of scratch, so raising it sheds a tiny
+    query out of a small memory budget and lowering it re-admits."""
+    from opentenbase_tpu.wlm.estimate import (
+        DEFAULT_ESTIMATE,
+        estimate_statement_memory,
+    )
+
+    # unit: the floor applies to every estimate path
+    assert estimate_statement_memory(object(), None) == DEFAULT_ESTIMATE
+    assert estimate_statement_memory(
+        object(), None, work_mem=10_000_000
+    ) == 10_000_000
+    # end-to-end: 16MB work_mem vs a 1MB group budget
+    c = _cluster()
+    s = _seeded(c)
+    s.execute("analyze")
+    s.execute("create resource group wm with "
+              "(concurrency=4, memory_limit='1MB', queue_depth=4)")
+    s.execute("set resource_group = wm")
+    s.execute("set work_mem = 16777216")
+    with pytest.raises((AdmissionError, SQLError)) as ei:
+        s.query("select count(*) from wt")
+    assert getattr(ei.value, "sqlstate", "") == "53200"
+    s.execute("set work_mem = 1024")
+    assert s.query("select count(*) from wt") == [(3,)]
+
+
+def test_application_name_in_cluster_activity():
+    """The application_name GUC (PR 8 burn-down wiring) rides
+    pg_stat_cluster_activity like PG's pg_stat_activity column."""
+    c = _cluster()
+    s = _seeded(c)
+    s.execute("set application_name = wlm_suite")
+    rows = s.query("select session_id, application_name "
+                   "from pg_stat_cluster_activity")
+    assert ("wlm_suite" in [r[1] for r in rows]), rows
+
+
 def test_memory_budget_shed_53200():
     c = _cluster()
     s = _seeded(c)

@@ -2,15 +2,19 @@
 # Tier-1 gate wrapper (ROADMAP.md "Tier-1 verify"):
 #
 #   1. python -m compileall  — syntax breakage fails in seconds, before
-#      the 870 s pytest budget is spent;
-#   2. the fast WLM smoke subset (tests/test_wlm.py, ~15 s) — the
+#      the 1500 s pytest budget is spent;
+#   2. static analysis: otb_lint --check against tools/lint_baseline.json
+#      (the ratchet — NEW invariant violations fail here in seconds);
+#   3. lockwatch smoke: a wire-driven concurrent workload under
+#      OTB_LOCKWATCH=1 — any non-allowlisted lock-order cycle fails;
+#   4. the fast WLM smoke subset (tests/test_wlm.py, ~15 s) — the
 #      admission-control layer sits in front of every statement, so a
 #      regression there poisons everything downstream;
-#   3. an observability smoke (obs/): EXPLAIN (ANALYZE, VERBOSE) of a
+#   5. an observability smoke (obs/): EXPLAIN (ANALYZE, VERBOSE) of a
 #      2-DN sharded join must print per-node rows, and a traced query
-#      must export parseable Chrome-trace JSON — instrumentation
-#      regressions fail fast here;
-#   4. the full ROADMAP tier-1 pytest command, verbatim.
+#      must export parseable Chrome-trace JSON;
+#   6. matview / chaos / telemetry / join-mode+perf-gate smokes;
+#   7. the full ROADMAP tier-1 pytest command, verbatim (1500 s cap).
 #
 # Usage: tools/tier1.sh   (from anywhere; cd's to the repo root)
 
@@ -20,6 +24,101 @@ export JAX_PLATFORMS=cpu
 
 echo "== tier1: compileall =="
 python -m compileall -q opentenbase_tpu || exit 1
+
+echo "== tier1: static analysis (otb_lint ratchet) =="
+# fails ONLY on findings absent from tools/lint_baseline.json — new
+# debt. Pre-existing entries are burned down PR by PR; a reviewed
+# addition regenerates the baseline with --update-baseline. Runs
+# before the 1500 s pytest budget so an invariant break (unread GUC,
+# removed jax API, shutdown-less close, FAULTless boundary, int32
+# cumsum, unhandled wire op, bogus SQLSTATE) surfaces in seconds.
+timeout -k 10 120 python -m opentenbase_tpu.cli.otb_lint --check || exit 1
+
+echo "== tier1: lockwatch smoke (lock-order watchdog) =="
+timeout -k 10 180 env OTB_LOCKWATCH=1 python - <<'PY' || exit 1
+# Drive the statement lock through every class it has — shared reads,
+# table-granular writers on overlapping and disjoint table sets, DDL
+# (exclusive), and a 2PC-committing write — with the lock-order
+# watchdog recording every acquisition. Any non-allowlisted cycle in
+# the per-thread acquisition graph (a potential deadlock, caught from
+# the ORDERS alone without needing the fatal interleaving) fails the
+# stage. Prints a one-line JSON verdict like bench_gate.
+import json, sys, threading
+from opentenbase_tpu.analysis import lockwatch
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.net.client import connect_tcp
+from opentenbase_tpu.net.server import ClusterServer
+
+# Statements must flow over the WIRE: the shared lock classes
+# (read() / write_tables() / exclusive, and the lmgr park paths) are
+# taken by the net server's backend threads, not by in-process
+# sessions — a lockwatch smoke that bypasses them watches nothing.
+c = Cluster(num_datanodes=2, shard_groups=16)
+srv = ClusterServer(c).start()
+boot = connect_tcp(srv.host, srv.port)
+boot.execute("set enable_fused_execution = off")
+boot.execute("create table lwa (k bigint, v bigint) distribute by shard(k)")
+boot.execute("create table lwb (k bigint, v bigint) distribute by shard(k)")
+boot.execute("insert into lwa values " + ",".join(
+    f"({i},{i})" for i in range(50)))
+
+def reader():
+    with connect_tcp(srv.host, srv.port) as x:
+        for _ in range(8):
+            x.query("select count(*), sum(v) from lwa")
+
+def writer(tbl, base):
+    with connect_tcp(srv.host, srv.port) as x:
+        for j in range(8):
+            x.execute(f"insert into {tbl} values ({base+j}, 1)")
+
+def multi_table():
+    # two-table write set: the sorted table-mutex path (the allowlisted
+    # same-site hierarchy) actually runs
+    with connect_tcp(srv.host, srv.port) as x:
+        for j in range(4):
+            x.execute(f"insert into lwb select k+{1000+j*100}, v "
+                      f"from lwa where k < 5")
+
+def ddl():
+    with connect_tcp(srv.host, srv.port) as x:
+        x.execute("create table lwc (k bigint) distribute by roundrobin")
+        x.execute("drop table lwc")
+
+errs = []
+def run(fn, *a):
+    # a dead driver thread must FAIL the stage — with the workers
+    # crashed at iteration 0 the watchdog watches nothing and a green
+    # verdict would be vacuous
+    def wrapped():
+        try:
+            fn(*a)
+        except BaseException as e:
+            errs.append(f"{fn.__name__}: {e!r}")
+    return threading.Thread(target=wrapped)
+
+ths = [run(reader) for _ in range(3)]
+ths += [run(writer, "lwa", 100), run(writer, "lwb", 200),
+        run(multi_table), run(ddl)]
+for t in ths: t.start()
+for t in ths: t.join()
+boot.close()
+srv.stop()
+c.close()
+cycles = lockwatch.find_cycles()
+n_edges = len(lockwatch.edges())
+# the concurrent drive reliably orders >= 15 lock pairs (32 observed
+# on landing); far fewer means the workload didn't actually run
+ok = not cycles and not errs and n_edges >= 15
+print(json.dumps({
+    "lockwatch_gate": "ok" if ok else "fail",
+    "ordered_pairs": n_edges, "cycles": len(cycles),
+    "driver_errors": errs,
+}))
+if not ok:
+    lockwatch.report()
+    sys.exit(1)
+PY
 
 echo "== tier1: WLM smoke subset =="
 timeout -k 10 120 python -m pytest tests/test_wlm.py -q -m 'not slow' \
