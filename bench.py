@@ -603,6 +603,16 @@ def main():
     except Exception as e:
         _phase(f"matview leg failed: {e!r:.200}", t_start)
 
+    # serving-plane leg (serving/ + net/concentrator.py): 10k+ pgwire
+    # clients multiplexed over a bounded backend pool with the plan and
+    # result caches on, vs the uncached/unconcentrated baseline on the
+    # same hot queries. No TPU needed.
+    try:
+        if os.environ.get("BENCH_SERVING", "1") == "1":
+            serving_leg(record, t_start)
+    except Exception as e:
+        _phase(f"serving leg failed: {e!r:.200}", t_start)
+
     # Device health check before the next device leg batch: a tunnel
     # that wedged since startup would hang the leg; skip the remaining
     # device legs with an explicit marker instead. IN-PROCESS (a tiny
@@ -885,6 +895,231 @@ def _timed(fn) -> float:
     t0 = time.perf_counter()
     fn()
     return time.perf_counter() - t0
+
+
+# Client half of the serving leg, run in its OWN process: 10k client
+# sockets plus 10k server-side sockets would blow one process's file-
+# descriptor budget, and a separate GIL makes the closed-loop drivers
+# honest competition rather than the server's own threads.
+_SERVING_DRIVER = r"""
+import json, resource, socket, struct, sys, threading, time
+
+host, port = sys.argv[1], int(sys.argv[2])
+want, duration = int(sys.argv[3]), float(sys.argv[4])
+queries = json.loads(sys.argv[5])
+
+soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+try:
+    resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    soft = hard
+except (ValueError, OSError):
+    pass
+n = min(want, max(soft - 500, 64))
+
+class Cli:
+    def __init__(self):
+        self.sock = socket.create_connection((host, port), timeout=60)
+        body = struct.pack("!I", 196608) + b"user\0bench\0\0"
+        self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        self.drain()
+    def rd(self, k):
+        buf = b""
+        while len(buf) < k:
+            c = self.sock.recv(k - len(buf))
+            if not c:
+                raise ConnectionError("eof")
+            buf += c
+        return buf
+    def drain(self):
+        err = None
+        while True:
+            tag = self.rd(1)
+            (ln,) = struct.unpack("!I", self.rd(4))
+            body = self.rd(ln - 4)
+            if tag == b"E":
+                err = body
+            if tag == b"Z":
+                if err:
+                    raise RuntimeError(err.decode(errors="replace"))
+                return
+    def q(self, sql):
+        b = sql.encode() + b"\0"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(b) + 4) + b)
+        self.drain()
+
+t0 = time.time()
+mu = threading.Lock()
+clients = []
+def connect(k):
+    mine = [Cli() for _ in range(k)]
+    with mu:
+        clients.extend(mine)
+errs = []
+ths = [threading.Thread(target=connect, args=(n // 4 + (i < n % 4),))
+       for i in range(4)]
+for t in ths: t.start()
+for t in ths: t.join()
+connect_s = time.time() - t0
+clients[0].q(queries[0])  # end-to-end warmth probe
+
+lat = []
+done = time.time() + duration
+def drive(shard):
+    mine = []
+    i = 0
+    while time.time() < done:
+        cli = shard[i % len(shard)]
+        q = queries[i % len(queries)]
+        t1 = time.perf_counter()
+        try:
+            cli.q(q)
+        except Exception as e:
+            errs.append(repr(e))
+            return
+        mine.append(time.perf_counter() - t1)
+        i += 1
+    with mu:
+        lat.extend(mine)
+
+NDRV = 8
+shards = [clients[i::NDRV] for i in range(NDRV)]
+t0 = time.perf_counter()
+ths = [threading.Thread(target=drive, args=(sh,)) for sh in shards if sh]
+for t in ths: t.start()
+for t in ths: t.join()
+wall = time.perf_counter() - t0
+lat.sort()
+out = {
+    "connected": len(clients), "connect_s": round(connect_s, 2),
+    "total": len(lat), "wall_s": round(wall, 3),
+    "errors": errs[:5],
+}
+if lat:
+    out["p50_ms"] = round(lat[len(lat) // 2] * 1000, 3)
+    out["p99_ms"] = round(lat[int(len(lat) * 0.99)] * 1000, 3)
+print(json.dumps(out), flush=True)
+for cli in clients:
+    try:
+        cli.sock.close()
+    except OSError:
+        pass
+"""
+
+
+def serving_leg(record, t_start) -> None:
+    """Serving plane (ROADMAP open item 2): statements/sec and p50/p99
+    for a hot read-only query mix under 10k+ simulated pgwire clients
+    multiplexed by the session concentrator with the cross-session
+    plan cache + versioned result cache on, against the uncached /
+    unconcentrated baseline (fresh planning per statement, in-process
+    session). The client fleet runs in a subprocess with its own fd
+    budget and GIL."""
+    import resource
+
+    from opentenbase_tpu.net.concentrator import PgConcentrator
+
+    try:
+        _soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    except (ValueError, OSError):
+        pass
+    n = int(os.environ.get("BENCH_SERVING_ROWS", 200_000))
+    want = int(os.environ.get("BENCH_SERVING_CLIENTS", 10_000))
+    duration = float(os.environ.get("BENCH_SERVING_SECS", 20))
+    rng = np.random.default_rng(23)
+    data = {
+        "k": np.arange(n, dtype=np.int64),
+        "g": rng.integers(0, 1000, n).astype(np.int64),
+        "v": rng.integers(0, 10_000, n).astype(np.int64),
+    }
+    c = Cluster(num_datanodes=NUM_DN, shard_groups=64)
+    # front-end measurement: the fused/device path is off so both
+    # sides pay the same (host) execution cost on a miss, and the win
+    # measured is parse/plan/execute elision — not device speed. Set
+    # at the CONF level so the concentrator's backend sessions
+    # (created below with default GUCs) inherit it too.
+    c.conf_gucs["enable_fused_execution"] = False
+    s = c.session()
+    s.execute(
+        "create table serv (k bigint, g bigint, v bigint) "
+        "distribute by shard(k)"
+    )
+    _bulk_append(c, "serv", data)
+    s.execute("analyze")
+    # hot top-k aggregates: a miss pays a real plan (agg + sort +
+    # limit) and a grouped scan; a hit pays ~nothing; the ≤5-row
+    # results keep the wire cost out of the measurement
+    queries = [
+        f"select g, count(*), sum(v * 2 + g) from serv "
+        f"where g < {100 * (i + 1)} group by g order by 3 desc limit 5"
+        for i in range(8)
+    ]
+    # baseline: no caches, no concentrator — every statement pays the
+    # full parse -> analyze -> distribute -> cost -> execute trip
+    s.execute("set enable_plan_cache = off")
+    s.execute("set enable_result_cache = off")
+    for q in queries:
+        s.query(q)  # warm stores/JIT so the baseline isn't cold-start
+    base_n = 16
+    t0 = time.perf_counter()
+    for i in range(base_n):
+        s.query(queries[i % len(queries)])
+    base_sps = base_n / (time.perf_counter() - t0)
+    _phase(f"serving baseline {base_sps:.1f} st/s", t_start)
+    # serving plane on
+    s.execute("set enable_plan_cache = on")
+    s.execute("set enable_result_cache = on")
+    conc = PgConcentrator(
+        c, backends=4, queue_depth=4096, queue_timeout_s=120,
+    ).start()
+    driver = None
+    try:
+        driver = subprocess.Popen(
+            [
+                sys.executable, "-c", _SERVING_DRIVER,
+                conc.host, str(conc.port), str(want), str(duration),
+                json.dumps(queries),
+            ],
+            stdout=subprocess.PIPE, text=True,
+        )
+        out, _ = driver.communicate(timeout=duration + 600)
+        res = json.loads(out.strip().splitlines()[-1])
+        if res.get("errors"):
+            raise RuntimeError(
+                f"serving driver errors: {res['errors']}"
+            )
+        sps = res["total"] / res["wall_s"] if res["wall_s"] else 0.0
+        record["serving_clients"] = res["connected"]
+        record["serving_backends"] = conc.backends
+        record["serving_connect_s"] = res["connect_s"]
+        record["serving_stmts"] = res["total"]
+        record["serving_stmts_per_sec"] = round(sps, 1)
+        record["serving_p50_ms"] = res.get("p50_ms")
+        record["serving_p99_ms"] = res.get("p99_ms")
+        record["serving_baseline_stmts_per_sec"] = round(base_sps, 2)
+        record["serving_speedup"] = round(sps / max(base_sps, 1e-9), 1)
+        record["serving_plan_cache_hits"] = dict(
+            s.query("select stat, value from pg_stat_plan_cache")
+        )["hits"]
+        record["serving_result_cache_hits"] = dict(
+            s.query("select stat, value from pg_stat_result_cache")
+        )["hits"]
+        record["serving_sheds"] = dict(conc.stat_rows())["sheds"]
+    finally:
+        # a wedged/failed driver must not leak the concentrator's
+        # threads, 4 backend sessions, the cluster, or a still-running
+        # 10k-socket child into the device legs' measurements
+        if driver is not None and driver.poll() is None:
+            driver.kill()
+        conc.stop()
+        c.close()
+    _phase(
+        f"serving leg: {res['connected']} clients, {sps:.0f} st/s "
+        f"({record['serving_speedup']}x baseline), "
+        f"p50={res.get('p50_ms')}ms p99={res.get('p99_ms')}ms",
+        t_start,
+    )
+    print(json.dumps(record), flush=True)
 
 
 

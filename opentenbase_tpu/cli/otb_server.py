@@ -31,6 +31,11 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="OpenMetrics exporter port (0 = no listener; "
                          "the metrics_port conf GUC works too)")
+    ap.add_argument("--concentrator-port", type=int, default=None,
+                    help="pgwire session concentrator port: tens of "
+                         "thousands of v3 clients multiplexed over "
+                         "--concentrator-backends sessions")
+    ap.add_argument("--concentrator-backends", type=int, default=8)
     args = ap.parse_args(argv)
 
     from opentenbase_tpu.engine import Cluster
@@ -60,6 +65,18 @@ def main(argv=None) -> int:
 
         pgsrv = PgWireServer(cluster, args.host, args.pg_port).start()
         print(f"pg wire on {pgsrv.host}:{pgsrv.port}", flush=True)
+    conc = None
+    if args.concentrator_port is not None:
+        from opentenbase_tpu.net.concentrator import PgConcentrator
+
+        conc = PgConcentrator(
+            cluster, args.host, args.concentrator_port,
+            backends=args.concentrator_backends,
+        ).start()
+        print(
+            f"concentrator on {conc.host}:{conc.port} "
+            f"({conc.backends} backends)", flush=True,
+        )
     sender = None
     if args.wal_port is not None:
         from opentenbase_tpu.storage.replication import WalSender
@@ -79,6 +96,8 @@ def main(argv=None) -> int:
     done.wait()
     if sender is not None:
         sender.stop()
+    if conc is not None:
+        conc.stop()
     if pgsrv is not None:
         pgsrv.stop()
     server.stop()

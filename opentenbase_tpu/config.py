@@ -141,6 +141,21 @@ GUCS: dict = {
     # this many ms get their instrumented plan logged at level 'log';
     # -1 = off (PG's auto_explain.log_min_duration contract), 0 = all
     "auto_explain_min_duration_ms": (_duration, -1),
+    # serving plane (serving/plancache.py) — these four are CLUSTER-
+    # scoped: SET in any live session applies to every session
+    # immediately and flushes the affected cache (engine._x_setstmt
+    # routes them through ServingPlane.set_guc). enable_plan_cache
+    # keys the full planned artifact on the canonical deparse
+    # fingerprint with constants parameterized out; a hit skips
+    # parse->analyze->distribute->cost entirely.
+    "enable_plan_cache": (_bool, True),
+    "plan_cache_size": (_int, 512),       # entries (constant variants)
+    # result cache: whole result sets keyed by (fingerprint, per-table
+    # committed-write versions) — off by default: it is snapshot-
+    # correct but makes repeated-query benchmarks measure the cache,
+    # so turning the serving plane on is an explicit act
+    "enable_result_cache": (_bool, False),
+    "result_cache_size": (_int, 64 << 20),  # bytes, LRU-evicted
     # matview serving path (matview/rewrite.py): a SELECT whose
     # canonical text exactly matches a FRESH materialized view's
     # defining query is answered from the matview instead of the fact

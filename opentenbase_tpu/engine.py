@@ -425,6 +425,23 @@ class Cluster:
         # path's staleness check (bumped on every commit/replay/
         # truncate that touches the table)
         self.table_version: dict[str, int] = {}
+        # serving plane (serving/): cross-session plan cache +
+        # versioned result cache. catalog_epoch is their DDL clock —
+        # every DDL/ALTER/redistribute/ANALYZE bumps it, and a cached
+        # artifact planned under an older epoch is discarded at lookup
+        # (the same event class whose D-records break matview deltas).
+        from opentenbase_tpu.serving import ServingPlane
+
+        self.serving = ServingPlane(self.conf_gucs)
+        self.catalog_epoch = 0
+        # runtime cluster-wide GUC overrides (today: the cache GUCs,
+        # which are cluster-scoped by design): sessions created later
+        # inherit these ON TOP of the conf file; RESET restores the
+        # conf-file/registry default, not the last SET
+        self.runtime_gucs: dict = {}
+        # pgwire session concentrator (net/concentrator.py), when one
+        # is attached: pg_stat_concentrator + exporter gauges read it
+        self._concentrator = None
         # coordinator-only throwaway tables (matview delta scratch):
         # fragments over these must never ship to DN processes
         self.local_tables: set = set()
@@ -517,6 +534,13 @@ class Cluster:
                     tables.add(parent)
         for tb in tables:
             self.table_version[tb] = self.table_version.get(tb, 0) + 1
+
+    def bump_catalog_epoch(self) -> None:
+        """Advance the serving plane's DDL clock (plan/result cache
+        invalidation): called for every statement outside the
+        epoch-neutral read/write/txn classes, from WAL redo of
+        D-records, and from the direct ALTER/redistribute APIs."""
+        self.catalog_epoch += 1
 
     def fused_executor(self):
         """Lazily built FusedExecutor over the default device mesh (the
@@ -675,6 +699,7 @@ class Cluster:
                 store = self.stores.get(node, {}).get(child)
                 if store is not None:
                     store.add_column(col, ty)
+        self.bump_catalog_epoch()
 
     def alter_drop_column(self, name: str, col: str) -> None:
         meta = self.catalog.get(name)
@@ -697,6 +722,7 @@ class Cluster:
                 store = self.stores.get(node, {}).get(target)
                 if store is not None:
                     store.drop_column(col)
+        self.bump_catalog_epoch()
 
     def redistribute_table(self, name: str, dist: DistributionSpec) -> int:
         """Online redistribution (ALTER TABLE ... DISTRIBUTE BY,
@@ -771,6 +797,8 @@ class Cluster:
                     moved += sub.nrows
         if name in self.partitions:  # parent shell keeps matching metadata
             self.catalog.get(name).dist = dist
+        # cached plans embed the OLD locator's node pruning
+        self.bump_catalog_epoch()
         return moved
 
     def extend_partitions(self, name: str, count: int) -> None:
@@ -791,6 +819,8 @@ class Cluster:
             meta.dictionaries = parent.dictionaries
             self.create_table_stores(meta)
         self.partitions[name] = new_spec
+        # a cached plan over the parent expands to the OLD child set
+        self.bump_catalog_epoch()
 
     # -- in-doubt 2PC repair (clean2pc.c bgworker + contrib/pg_clean) -----
     def clean_2pc(self, max_age_s: float = 300.0) -> list[str]:
@@ -1234,7 +1264,8 @@ class Session:
         from opentenbase_tpu import config as _config
 
         self.gucs: dict[str, object] = {
-            **_config.defaults(), **cluster.conf_gucs
+            **_config.defaults(), **cluster.conf_gucs,
+            **cluster.runtime_gucs,
         }
         self.user = user
         self._in_audit = False
@@ -1276,6 +1307,19 @@ class Session:
         # stashed by _run_statement_plan while the GUC is on, consumed
         # by _maybe_auto_explain once the statement's duration is known
         self._auto_explain_last = None
+        # serving plane (serving/): the cache key of the SELECT in
+        # flight ((generic_fp, consts), stashed pre-expansion so
+        # volatile nextval() rewrites can't alias distinct statements),
+        # the catalog epoch it was computed under, the tables its plan
+        # scanned, and the last lookup verdict EXPLAIN ANALYZE shows
+        self._plan_key = None
+        self._plan_key_epoch = 0
+        self._last_plan_tables: set = set()
+        self._last_plan_cache = ""
+        # >0 while executing a statement rewritten over throwaway
+        # tables (recursive-CTE materialization): those fingerprints
+        # embed per-call temp names and must never enter the caches
+        self._no_cache_depth = 0
 
     def close(self) -> None:
         """Backend-exit cleanup (the tcop loop's on-exit path): release
@@ -1825,6 +1869,23 @@ class Session:
         A.SavepointStmt, A.RollbackToSavepoint, A.ReleaseSavepoint,
     )
 
+    # statement classes that can NOT change what a cached plan depends
+    # on (schemas, distribution, shardmap, views, optimizer stats) —
+    # everything else bumps Cluster.catalog_epoch and so invalidates
+    # the serving plane's caches. DML stays neutral (the result cache
+    # tracks data through per-table version counters instead); ANALYZE
+    # and MOVE DATA are deliberately NOT neutral.
+    _EPOCH_NEUTRAL = (
+        A.Select, A.Insert, A.Update, A.Delete, A.CopyStmt,
+        A.SetStmt, A.ShowStmt, A.ExplainStmt,
+        A.BeginStmt, A.CommitStmt, A.RollbackStmt,
+        A.SavepointStmt, A.RollbackToSavepoint, A.ReleaseSavepoint,
+        A.PrepareStmt, A.ExecuteStmt, A.DeallocateStmt,
+        A.VacuumStmt, A.LockTable,
+        A.PrepareTransaction, A.CommitPrepared, A.RollbackPrepared,
+        A.RefreshMatview, A.CreateBarrier,
+    )
+
     def _is_readonly_stmt(self, stmt: A.Statement) -> bool:
         if isinstance(stmt, self._READONLY_OK):
             return True
@@ -1865,9 +1926,11 @@ class Session:
             if rec is None:
                 return self._execute_one_inner(stmt)
             stmt, temps = rec
+            self._no_cache_depth += 1
             try:
                 return self._execute_one_inner(stmt)
             finally:
+                self._no_cache_depth -= 1
                 self._drop_temps(temps)
                 # an abort between the rewrite and _x_explainstmt's
                 # consumption must not leak the recursive-shape prelude
@@ -1908,6 +1971,27 @@ class Session:
         if not self._matview_internal:
             self._matview_write_guard(stmt)
             stmt = self._maybe_matview_rewrite(stmt)
+        # serving plane: compute the cache key BEFORE sequence/
+        # partition expansion mutates the tree (nextval() becomes a
+        # per-call literal, a partitioned parent becomes its child
+        # union) — EXPLAIN ANALYZE keys its inner query at the SAME
+        # point so its verdict matches what execution would do
+        self._plan_key = None
+        sv = self.cluster.serving
+        key_target = stmt
+        if isinstance(stmt, A.ExplainStmt) and stmt.analyze:
+            key_target = stmt.query
+        if (
+            (sv.plan_enabled or sv.result_enabled)
+            and isinstance(key_target, A.Select)
+            and self.txn is None
+            and self._no_cache_depth == 0
+            and not self._matview_internal
+        ):
+            from opentenbase_tpu.serving import statement_key
+
+            self._plan_key = statement_key(self, key_target)
+            self._plan_key_epoch = self.cluster.catalog_epoch
         stmt = self._expand_sequences(stmt)
         stmt = self._expand_partitions(stmt)
         if isinstance(stmt, Result):  # fully handled by partition fanout
@@ -1922,6 +2006,11 @@ class Session:
         try:
             return self._dispatch_stmt(stmt, h)
         finally:
+            # DDL-class statements advance the serving plane's catalog
+            # epoch (bumped even on failure — a half-applied ALTER must
+            # invalidate, never serve, a cached plan)
+            if not isinstance(stmt, self._EPOCH_NEUTRAL):
+                self.cluster.bump_catalog_epoch()
             if ticket is not None:
                 self._wlm_ticket = None
                 ticket.release()
@@ -3116,13 +3205,55 @@ class Session:
         self._refresh_system_views(stmt)
         if stmt.for_update is not None:
             return self._select_for_update(stmt)
+        # serving plane, layer (b): versioned result cache. A hit is
+        # served without touching a datanode; freshness is judged
+        # against the per-table committed-write counters, so any
+        # committed write to a referenced table invalidates for free.
+        c = self.cluster
+        sv = c.serving
+        key = self._plan_key
+        versions = None
+        if key is not None and sv.result_enabled:
+            e = sv.result_cache.lookup(key, c)
+            if e is not None:
+                return Result(
+                    "SELECT", list(e.rows), list(e.columns), e.rowcount
+                )
+            # Capture the version snapshot BEFORE execution (and before
+            # the read snapshot): a commit landing mid-query bumps past
+            # this snapshot and the stored entry is stillborn rather
+            # than stale. A commit mid-STAMP right now may have bumped
+            # counters for rows not yet snapshot-visible — skip caching
+            # through that window (the matview refresh pins its version
+            # snapshot against the same hazard).
+            # the copy must happen INSIDE the same critical section as
+            # the quiesced check: a commit entering the stamping window
+            # right after the check could bump counters for rows our
+            # snapshot will not see, and a copy taken then would key
+            # pre-commit rows under post-commit versions
+            with c._stamping_mu:
+                if c._pending_commits == 0 and not c._stamping:
+                    versions = dict(c.table_version)
         batch = self._run_select(stmt)
-        return Result(
+        res = Result(
             "SELECT",
             batch.to_rows(),
             batch.column_names(),
             batch.nrows,
         )
+        if versions is not None and sv.result_enabled:
+            sv.result_cache.insert(
+                key,
+                tuple(res.rows),
+                tuple(res.columns),
+                res.rowcount,
+                {
+                    tb: versions.get(tb, 0)
+                    for tb in self._last_plan_tables
+                },
+                self._plan_key_epoch,
+            )
+        return res
 
     # -- admin functions exposed as FROM-less selects --------------------
     # (contrib/pg_unlock's SQL functions; pg_clean's cleanup entry)
@@ -3899,12 +4030,69 @@ class Session:
                 self.cluster.stores[n][name] = store
 
     def _run_select(self, stmt: A.Select) -> ColumnBatch:
+        # serving plane: a plan-cache hit skips analyze/optimize/
+        # distribute entirely and goes straight to _execute_dplan. The
+        # lookup is timed as the plan phase so per-phase statement
+        # counts stay comparable between hit and miss paths.
+        key, self._plan_key = self._plan_key, None
+        self._last_plan_tables = set()
+        self._last_plan_cache = ""
+        sv = self.cluster.serving
+        if (
+            key is not None and sv.plan_enabled
+            # while a shard move is in flight, cached plans are
+            # unusable: their node pruning predates the coming flip,
+            # and waiting out EVERY move would fence readers of
+            # non-moving shards the barrier protocol promises to serve
+            # — take the replan path, whose gate prunes per shard
+            and not self.cluster.shard_barrier.active()
+        ):
+            with self._phased("plan"):
+                entry = sv.plan_cache.lookup(
+                    key, self.cluster.catalog_epoch
+                )
+            if entry is not None:
+                self._last_plan_cache = "hit"
+                self._last_plan_tables = set(entry.tables)
+                return self._run_cached_dplan(entry.dplan)
+            self._last_plan_cache = "miss"
         with self._phased("plan"):
             splan = optimize_statement(
                 analyze_statement(stmt, self.cluster.catalog),
                 self.cluster.catalog,
             )
-        return self._run_statement_plan(splan)
+        return self._run_statement_plan(splan, cache_key=key)
+
+    def _run_cached_dplan(self, dplan) -> ColumnBatch:
+        """Hit path: execute an already-planned artifact through the
+        one shared dispatch point (no re-planning). The shard-barrier
+        interaction lives at the lookup: an active move disables hits
+        outright (a cached plan's pruning predates the flip), and a
+        completed move invalidated the entry via the catalog epoch."""
+        snapshot = self._snapshot()
+        instrument = (
+            not self._matview_internal
+            and self._auto_explain_threshold_ms() >= 0
+        )
+        batch, info = self._execute_dplan(
+            dplan, snapshot, instrument=instrument
+        )
+        if instrument:
+            self._auto_explain_last = (dplan, info)
+        return batch
+
+    def _splan_tables(self, splan) -> set:
+        """Tables a logical plan scans (post view/partition expansion):
+        the result cache's version-snapshot domain."""
+        out: set = set()
+        stack = [splan.root]
+        stack.extend(splan.subplans or [])
+        while stack:
+            node = stack.pop()
+            if isinstance(node, L.Scan):
+                out.add(node.table)
+            stack.extend(node.children())
+        return out
 
     def _plan_shard_ids(self, splan):
         """Shard ids this LOGICAL plan provably touches (dist-key
@@ -3978,10 +4166,24 @@ class Session:
         except ShardBarrierTimeout as e:
             raise SQLError(str(e)) from None
 
-    def _run_statement_plan(self, splan: L.StatementPlan) -> ColumnBatch:
+    def _run_statement_plan(
+        self, splan: L.StatementPlan, cache_key=None
+    ) -> ColumnBatch:
         self._shard_barrier_gate(splan)
         with self._phased("plan"):
             dplan = distribute_statement(splan, self.cluster.catalog)
+        if cache_key is not None:
+            # serving plane, miss path: remember the scanned tables for
+            # the result cache and publish the planned artifact under
+            # the epoch captured at key time — a DDL that landed while
+            # we planned leaves the entry stillborn, never stale
+            tables = frozenset(self._splan_tables(splan))
+            self._last_plan_tables = set(tables)
+            sv = self.cluster.serving
+            if sv.plan_enabled:
+                sv.plan_cache.insert(
+                    cache_key, dplan, tables, self._plan_key_epoch
+                )
         snapshot = self._snapshot()
         # auto_explain: while the GUC is armed every plan runs with
         # per-operator instrumentation on (auto_explain.log_analyze),
@@ -6511,12 +6713,52 @@ class Session:
         unrename, self._explain_rename = self._explain_rename, {}
         if isinstance(inner, A.Select):
             self._refresh_system_views(inner)
-        with self._phased("plan"):
-            splan = optimize_statement(
-                analyze_statement(inner, self.cluster.catalog),
-                self.cluster.catalog,
+        # serving plane: EXPLAIN ANALYZE consults (and on a miss,
+        # populates) the shared plan cache exactly like execution, and
+        # reports the verdict as a prelude line — the operator-visible
+        # surface of plan_cache=hit|miss. Plain EXPLAIN stays
+        # cache-blind so its output is stable plan text.
+        pc_key = pc_status = None
+        sv = self.cluster.serving
+        if (
+            stmt.analyze and sv.plan_enabled
+            and not self.cluster.shard_barrier.active()
+        ):
+            # the key was stashed by _execute_one_inner BEFORE the
+            # expansion passes mutated the tree — computing it here
+            # would fingerprint the expanded form and never match the
+            # keys execution inserts
+            pc_key, self._plan_key = self._plan_key, None
+        dplan = None
+        # lookup validates against the CURRENT epoch (a DDL since the
+        # stash must miss); the insert is stamped with the epoch
+        # captured at key time, so a DDL landing mid-plan leaves the
+        # entry stillborn, never stale — both exactly as _run_select
+        pc_epoch = self._plan_key_epoch
+        if pc_key is not None:
+            entry = sv.plan_cache.lookup(
+                pc_key, self.cluster.catalog_epoch
             )
-            dplan = distribute_statement(splan, self.cluster.catalog)
+            if entry is not None:
+                pc_status = "hit"
+                dplan = entry.dplan
+            else:
+                pc_status = "miss"
+        if dplan is None:
+            with self._phased("plan"):
+                splan = optimize_statement(
+                    analyze_statement(inner, self.cluster.catalog),
+                    self.cluster.catalog,
+                )
+                dplan = distribute_statement(splan, self.cluster.catalog)
+            if pc_status == "miss":
+                sv.plan_cache.insert(
+                    pc_key, dplan,
+                    frozenset(self._splan_tables(splan)),
+                    pc_epoch,
+                )
+        if pc_status is not None:
+            prelude = prelude + [f"Plan cache: plan_cache={pc_status}"]
         lines = prelude + dplan.explain().splitlines()
         if stmt.analyze:
             # execute the ONE plan built above through the same dispatch
@@ -6589,6 +6831,19 @@ class Session:
 
         # normalize boolean/int GUC spellings (guc.c's parse_bool analog)
         v = stmt.value
+        if v is None:
+            # RESET name / SET name TO DEFAULT: back to the conf-file
+            # override if one exists, else the registry default
+            if stmt.name in self.cluster.conf_gucs:
+                v = self.cluster.conf_gucs[stmt.name]
+            else:
+                entry = _config.GUCS.get(stmt.name)
+                if entry is None and "." not in stmt.name:
+                    raise SQLError(
+                        f'unrecognized configuration parameter '
+                        f'"{stmt.name}"'
+                    )
+                v = entry[1] if entry is not None else None
         if isinstance(v, str):
             low = v.lower()
             if low in ("true", "on", "yes", "1"):
@@ -6597,29 +6852,63 @@ class Session:
                 v = False
             elif low.lstrip("-").isdigit():
                 v = int(low)
-        try:
-            v = _config.validate(stmt.name, v)
-        except _config.GucError as e:
-            raise SQLError(str(e)) from None
+        if v is not None:
+            try:
+                v = _config.validate(stmt.name, v)
+            except _config.GucError as e:
+                raise SQLError(str(e)) from None
         if stmt.name in ("session_authorization", "role"):
             # audited statements carry the effective user (pg_audit's
-            # db_user dimension)
-            self.user = str(stmt.value)
+            # db_user dimension); RESET restores the identity the
+            # session logged in with (stashed at the first SET). The
+            # RAW spelling is the identity — the boolean/int GUC
+            # normalization above must not turn role "on" into 'True'.
+            if stmt.value is not None:
+                if not hasattr(self, "_login_user"):
+                    self._login_user = self.user
+                self.user = str(stmt.value)
+            else:
+                self.user = getattr(self, "_login_user", self.user)
         if stmt.name == "log_min_messages":
             # the GUC is finally CONSULTED: the ring filters at emit
             # time, so the threshold lives on the ring (server-wide, as
             # the reference's postmaster-level GUC is)
             self.cluster.log.set_min_level(str(v))
-        self.gucs[stmt.name] = v
+        from opentenbase_tpu.serving.plancache import CACHE_GUCS
+
+        if stmt.name in CACHE_GUCS:
+            # cache GUCs are CLUSTER-scoped: the new value applies to
+            # every live session immediately, the affected cache is
+            # flushed (a stale entry must not outlive the knob that
+            # disowned it), and later sessions inherit it via the
+            # cluster's runtime overrides (RESET clears the override)
+            self.cluster.serving.set_guc(stmt.name, v)
+            if stmt.value is None:
+                self.cluster.runtime_gucs.pop(stmt.name, None)
+            else:
+                self.cluster.runtime_gucs[stmt.name] = v
+        if v is None:
+            self.gucs.pop(stmt.name, None)
+        else:
+            self.gucs[stmt.name] = v
         return Result("SET")
 
     def _x_showstmt(self, stmt: A.ShowStmt) -> Result:
+        from opentenbase_tpu.serving.plancache import CACHE_GUCS
+
+        def effective(name, v):
+            # cache GUCs are cluster-scoped: SHOW must report what the
+            # cluster is actually doing, not this session's stale copy
+            if name in CACHE_GUCS:
+                return self.cluster.serving.get_guc(name)
+            return v
+
         if stmt.name == "all":
             rows = sorted(
-                (k, str(v)) for k, v in self.gucs.items()
+                (k, str(effective(k, v))) for k, v in self.gucs.items()
             )
             return Result("SHOW", rows, ["name", "setting"], len(rows))
-        v = self.gucs.get(stmt.name)
+        v = effective(stmt.name, self.gucs.get(stmt.name))
         return Result("SHOW", [(v,)], [stmt.name], 1)
 
     def _x_vacuumstmt(self, stmt: A.VacuumStmt) -> Result:
@@ -7353,6 +7642,28 @@ def _sv_cluster_health(c: Cluster):
     return rows
 
 
+def _sv_plan_cache(c: Cluster):
+    """pg_stat_plan_cache: cross-session plan cache counters
+    (serving/plancache.py) — hits/misses/inserts/evictions/
+    invalidations/forced_misses plus live entries and capacity."""
+    return c.serving.plan_cache.stat_rows()
+
+
+def _sv_result_cache(c: Cluster):
+    """pg_stat_result_cache: versioned result cache counters plus live
+    entries and resident bytes."""
+    return c.serving.result_cache.stat_rows()
+
+
+def _sv_concentrator(c: Cluster):
+    """pg_stat_concentrator: live gauges of the attached pgwire session
+    concentrator (empty when none is running)."""
+    conc = getattr(c, "_concentrator", None)
+    if conc is None:
+        return []
+    return conc.stat_rows()
+
+
 def _sv_2pc(c: Cluster):
     """pg_stat_2pc: in-doubt resolver counters + the live prepared
     registry size."""
@@ -7649,6 +7960,18 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
     "pg_stat_2pc": (
         {"stat": t.TEXT, "value": t.INT8},
         _sv_2pc,
+    ),
+    "pg_stat_plan_cache": (
+        {"stat": t.TEXT, "value": t.INT8},
+        _sv_plan_cache,
+    ),
+    "pg_stat_result_cache": (
+        {"stat": t.TEXT, "value": t.INT8},
+        _sv_result_cache,
+    ),
+    "pg_stat_concentrator": (
+        {"stat": t.TEXT, "value": t.INT8},
+        _sv_concentrator,
     ),
     "pg_stat_progress_refresh": (
         {

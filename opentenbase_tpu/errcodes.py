@@ -36,6 +36,8 @@ ERRCODES: dict[str, str] = {
     # class 28 — invalid authorization specification
     "28000": "invalid_authorization_specification",
     "28P01": "invalid_password",
+    # class 0A — feature not supported
+    "0A000": "feature_not_supported",
     # class 2B — dependent objects still exist
     "2BP01": "dependent_objects_still_exist",
     # class 40 — transaction rollback

@@ -108,7 +108,10 @@ def _has_volatile(sel: A.Select) -> bool:
     while stack:
         x = stack.pop()
         if x is None:
-            return False
+            # a None FIELD only ends this branch of the walk, never
+            # the whole search (returning here made the check miss
+            # volatile calls behind any earlier-popped empty field)
+            continue
         if isinstance(x, A.FuncCall) and x.name.lower() in _VOLATILE_FUNCS:
             return True
         if isinstance(x, (tuple, list)):

@@ -143,6 +143,9 @@ class ClientSession:
 
         from opentenbase_tpu.net import auth as sa
 
+        # failpoint: the credential exchange is its own boundary — a
+        # drop here must surface as an auth failure, not a hang
+        FAULT("net/client/auth")
         client_nonce = secrets.token_hex(16)
         send_frame(self._sock, {
             "op": "auth", "user": user, "client_nonce": client_nonce,
@@ -191,6 +194,8 @@ class ClientSession:
 
     def close(self) -> None:
         try:
+            # failpoint: the goodbye frame racing a dying peer
+            FAULT("net/client/close")
             send_frame(self._sock, {"op": "close"})
             recv_frame(self._sock)
         except OSError:

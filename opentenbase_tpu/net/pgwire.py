@@ -34,6 +34,7 @@ import struct
 import threading
 from typing import Optional
 
+from opentenbase_tpu.fault import FAULT
 from opentenbase_tpu.net import auth as sa
 from opentenbase_tpu.net.protocol import shutdown_and_close
 
@@ -92,6 +93,92 @@ def _command_tag(res) -> str:
     return cmd
 
 
+# -- SCRAM-SHA-256 server core (RFC 5802), shared with the session
+# -- concentrator (net/concentrator.py): the exchange is split into two
+# -- pure steps so a non-blocking state machine can drive it.
+
+def scram_server_first(cluster, user: str, client_first: str) -> tuple:
+    """(state, server_first_text) from the SASLInitialResponse payload.
+    Unknown users get a mock verifier (auth.c's mock authentication) so
+    the flow never leaks which roles exist."""
+    bare = client_first.split(",", 2)[2]
+    fields = dict(
+        f.split("=", 1) for f in bare.split(",") if "=" in f
+    )
+    cnonce = fields.get("r", "")
+    verifier = cluster.users.get(user)
+    real = verifier is not None
+    if verifier is None:
+        verifier = {  # mock: all-zero keys can never validate
+            "salt": secrets.token_bytes(16).hex(),
+            "iterations": sa.ITERATIONS,
+            "stored_key": "00" * 32,
+            "server_key": "00" * 32,
+        }
+    nonce = cnonce + secrets.token_hex(12)
+    salt_b64 = base64.b64encode(
+        bytes.fromhex(verifier["salt"])
+    ).decode()
+    server_first = (
+        f"r={nonce},s={salt_b64},i={verifier['iterations']}"
+    )
+    return {
+        "bare": bare, "verifier": verifier, "real": real,
+        "nonce": nonce, "server_first": server_first,
+    }, server_first
+
+
+def scram_verify_final(state: dict, client_final: str) -> tuple:
+    """(ok, b"v="+server_signature) from the final SASLResponse. The
+    check is uniform for real and unknown users (no timing tell)."""
+    verifier = state["verifier"]
+    ffields = dict(
+        f.split("=", 1) for f in client_final.split(",") if "=" in f
+    )
+    proof_b64 = ffields.pop("p", "")
+    without_proof = client_final.rsplit(",p=", 1)[0]
+    auth_msg = (
+        f"{state['bare']},{state['server_first']},{without_proof}"
+    ).encode()
+    try:
+        proof = base64.b64decode(proof_b64)
+        stored_key = bytes.fromhex(verifier["stored_key"])
+        client_sig = hmac.new(
+            stored_key, auth_msg, hashlib.sha256
+        ).digest()
+        client_key = bytes(a ^ b for a, b in zip(proof, client_sig))
+        ok = (
+            ffields.get("r") == state["nonce"]
+            and state["real"]
+            and hmac.compare_digest(
+                hashlib.sha256(client_key).digest(), stored_key
+            )
+        )
+    except (ValueError, KeyError):
+        # malformed base64/hex from the client is a failed proof, not
+        # a server error (binascii.Error subclasses ValueError)
+        ok = False
+    server_sig = hmac.new(
+        bytes.fromhex(verifier["server_key"]), auth_msg, hashlib.sha256
+    ).digest()
+    return ok, b"v=" + base64.b64encode(server_sig)
+
+
+def emit_result(conn: "_Conn", res) -> None:
+    """RowDescription + DataRows + CommandComplete for one result
+    (shared by the per-connection server and the concentrator)."""
+    if res.columns:
+        ncols = len(res.columns)
+        oids = [
+            _infer_oid([r[i] for r in res.rows[:50]])
+            for i in range(ncols)
+        ]
+        conn.row_description(res.columns, oids)
+        for row in res.rows:
+            conn.data_row(row)
+    conn.command_complete(_command_tag(res))
+
+
 class _Conn:
     """One backend connection: framing + message builders."""
 
@@ -101,6 +188,8 @@ class _Conn:
 
     # -- receive ---------------------------------------------------------
     def _read_exact(self, n: int) -> bytes:
+        # failpoint: a v3 client vanishing / stalling mid-message
+        FAULT("net/pgwire/recv")
         buf = b""
         while len(buf) < n:
             chunk = self.sock.recv(n - len(buf))
@@ -131,11 +220,15 @@ class _Conn:
         self._out += tag + struct.pack("!I", len(body) + 4) + body
 
     def flush(self) -> None:
+        # failpoint: the response path to a v3 client (drop_conn =
+        # the client's socket dying under a half-written result)
+        FAULT("net/pgwire/send")
         if self._out:
             self.sock.sendall(bytes(self._out))
             self._out.clear()
 
     def send_raw(self, data: bytes) -> None:
+        FAULT("net/pgwire/send_raw")
         self.sock.sendall(data)
 
     # -- message builders ------------------------------------------------
@@ -213,6 +306,16 @@ class PgWireServer:
                 sock, _ = self._lsock.accept()
             except OSError:
                 return
+            try:
+                # failpoint: a refused/dropped v3 client at accept (the
+                # accept loop itself must survive any injected action)
+                FAULT("net/pgwire/accept")
+            except Exception:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(
                 target=self._serve, args=(sock,), daemon=True
@@ -271,8 +374,14 @@ class PgWireServer:
             if session.txn is not None:
                 with self._exec_lock:
                     session.execute("rollback")
-        except Exception:
-            pass
+        except Exception as e:
+            # a failed disconnect-rollback leaves the txn for the
+            # in-doubt machinery — but never silently
+            self.cluster.log.emit(
+                "warning", "session",
+                f"rollback on disconnect failed: {e!r:.200}",
+                session=session.session_id,
+            )
         # release any WLM slot and leave pg_stat_cluster_activity NOW
         session.close()
 
@@ -303,26 +412,8 @@ class PgWireServer:
         (ln,) = struct.unpack("!i", rest[:4])
         client_first = rest[4:4 + ln].decode()
         # gs2 header "n,," then "n=<user>,r=<nonce>"
-        bare = client_first.split(",", 2)[2]
-        fields = dict(
-            f.split("=", 1) for f in bare.split(",") if "=" in f
-        )
-        cnonce = fields.get("r", "")
-        verifier = self.cluster.users.get(user)
-        if verifier is None:
-            verifier = {  # mock: do not leak which roles exist
-                "salt": secrets.token_bytes(16).hex(),
-                "iterations": sa.ITERATIONS,
-                "stored_key": "00" * 32,
-                "server_key": "00" * 32,
-            }
-        snonce = secrets.token_hex(12)
-        nonce = cnonce + snonce
-        salt_b64 = base64.b64encode(
-            bytes.fromhex(verifier["salt"])
-        ).decode()
-        server_first = (
-            f"r={nonce},s={salt_b64},i={verifier['iterations']}"
+        state, server_first = scram_server_first(
+            self.cluster, user, client_first
         )
         conn.auth(11, server_first.encode())  # SASLContinue
         conn.flush()
@@ -331,35 +422,7 @@ class PgWireServer:
             conn.error("expected SASLResponse", "28000")
             conn.flush()
             return False
-        client_final = body.decode()
-        ffields = dict(
-            f.split("=", 1)
-            for f in client_final.split(",")
-            if "=" in f
-        )
-        proof_b64 = ffields.pop("p", "")
-        without_proof = client_final.rsplit(",p=", 1)[0]
-        auth_msg = (
-            f"{bare},{server_first},{without_proof}"
-        ).encode()
-        try:
-            proof = base64.b64decode(proof_b64)
-            stored_key = bytes.fromhex(verifier["stored_key"])
-            client_sig = hmac.new(
-                stored_key, auth_msg, hashlib.sha256
-            ).digest()
-            client_key = bytes(
-                a ^ b for a, b in zip(proof, client_sig)
-            )
-            ok = (
-                ffields.get("r") == nonce
-                and self.cluster.users.get(user) is not None
-                and hmac.compare_digest(
-                    hashlib.sha256(client_key).digest(), stored_key
-                )
-            )
-        except Exception:
-            ok = False
+        ok, server_sig = scram_verify_final(state, body.decode())
         if not ok:
             conn.error(
                 f'password authentication failed for user "{user}"',
@@ -367,14 +430,7 @@ class PgWireServer:
             )
             conn.flush()
             return False
-        server_sig = hmac.new(
-            bytes.fromhex(verifier["server_key"]),
-            auth_msg,
-            hashlib.sha256,
-        ).digest()
-        conn.auth(
-            12, b"v=" + base64.b64encode(server_sig)
-        )  # SASLFinal
+        conn.auth(12, server_sig)  # SASLFinal
         return True
 
     # -- statement execution under the lock classes ----------------------
@@ -396,16 +452,7 @@ class PgWireServer:
             return fn()
 
     def _emit_result(self, conn: _Conn, res) -> None:
-        if res.columns:
-            ncols = len(res.columns)
-            oids = [
-                _infer_oid([r[i] for r in res.rows[:50]])
-                for i in range(ncols)
-            ]
-            conn.row_description(res.columns, oids)
-            for row in res.rows:
-                conn.data_row(row)
-        conn.command_complete(_command_tag(res))
+        emit_result(conn, res)
 
     # -- message loop -----------------------------------------------------
     def _message_loop(self, conn: _Conn, session) -> None:
@@ -534,7 +581,7 @@ class PgWireServer:
             pass
         try:
             return decimal.Decimal(s)
-        except Exception:
+        except ArithmeticError:  # InvalidOperation: not a number
             return s
 
     def _run_ast(self, session, ast, sql=None):

@@ -22,6 +22,8 @@ import json
 import socket
 import struct
 
+from opentenbase_tpu.fault import FAULT
+
 
 def _default(o):
     if isinstance(o, decimal.Decimal):
@@ -81,6 +83,9 @@ def encode_frame(obj: dict) -> bytes:
 
 
 def send_frame(sock: socket.socket, obj: dict) -> None:
+    # failpoint at the shared frame-send boundary: EVERY JSON-wire
+    # peer (sessions, DN channels, GTM, log shipping) crosses it
+    FAULT("net/protocol/send")
     sock.sendall(encode_frame(obj))
 
 
@@ -96,6 +101,8 @@ def recv_frame(sock: socket.socket) -> dict | None:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    # failpoint: a peer stalling/vanishing mid-frame (torn reads)
+    FAULT("net/protocol/recv")
     out = b""
     while len(out) < n:
         chunk = sock.recv(n - len(out))

@@ -245,7 +245,7 @@ class ClusterServer:
                     )
                 except FaultDropConnection:
                     raise  # sever this backend like a real peer reset
-                except Exception as e:  # engine errors go to the client
+                except Exception as e:  # otb_lint: ignore[except-swallow] -- not a swallow: the error is delivered to the client as an error frame below, and Session.execute already elog'd it at level error
                     frame = {"error": f"{type(e).__name__}: {e}"}
                     sqlstate = getattr(e, "sqlstate", None)
                     if sqlstate:  # 53xxx sheds, 57014 timeouts, ...
@@ -262,8 +262,10 @@ class ClusterServer:
             self._conns.discard(raw)
             self._conn_cleanup(session, conn)
 
-    def _classify(self, sql: str, session):
-        """ONE parse classifying the statement's lock class:
+    def _classify(self, sql: str, session, stmts=None):
+        """ONE parse classifying the statement's lock class (callers
+        that already parsed — the concentrator's pin detection — pass
+        ``stmts`` to skip re-parsing):
 
         - ("read", None): a single plain SELECT (no FOR UPDATE) outside
           a transaction, referencing no system view (their refresh
@@ -283,7 +285,8 @@ class ClusterServer:
             from opentenbase_tpu.sql import ast as A
             from opentenbase_tpu.sql.parser import parse
 
-            stmts = parse(sql)
+            if stmts is None:
+                stmts = parse(sql)
             if len(stmts) != 1:
                 return "excl", None
             st = stmts[0]
@@ -346,7 +349,7 @@ class ClusterServer:
                 # fencing out every reader.
                 return "write", set()
             return "excl", None
-        except Exception:
+        except Exception:  # otb_lint: ignore[except-swallow] -- by design: any statement the classifier cannot parse/prove classes as exclusive, and the parse error (if real) surfaces from the normal execution path a moment later
             return "excl", None
 
     def _is_readonly(self, sql: str, session) -> bool:
@@ -363,6 +366,9 @@ class ClusterServer:
 
         from opentenbase_tpu.net import auth as sa
 
+        # failpoint: the server half of the SCRAM exchange (a client
+        # vanishing mid-handshake must leave no half-authed backend)
+        FAULT("net/server/scram")
         user = str(msg.get("user", ""))
         client_nonce = str(msg.get("client_nonce", ""))
         verifier = self.cluster.users.get(user)
@@ -420,8 +426,14 @@ class ClusterServer:
             try:
                 with self._exec_lock:
                     session.execute("rollback")
-            except Exception:
-                pass
+            except Exception as e:
+                # never silent: the orphaned txn is now the in-doubt
+                # machinery's problem, and the log says why
+                self.cluster.log.emit(
+                    "warning", "session",
+                    f"rollback on disconnect failed: {e!r:.200}",
+                    session=session.session_id,
+                )
         # release any WLM slot and leave pg_stat_cluster_activity NOW —
         # a dropped connection must not linger as a phantom session
         session.close()

@@ -223,6 +223,68 @@ def render_cluster_metrics(cluster) -> str:
             int(getattr(fx, "dag_demotion_count", 0)),
         ))
 
+    # serving plane (serving/ + net/concentrator.py): cache counters
+    # as counters, occupancy as gauges, concentrator live gauges
+    serving = getattr(cluster, "serving", None)
+    if serving is not None:
+        for prefix, cache in (
+            ("otb_plan_cache", serving.plan_cache),
+            ("otb_result_cache", serving.result_cache),
+        ):
+            rows = dict(cache.stat_rows())
+            _head(out, f"{prefix}_total", "counter",
+                  "Serving-plane cache outcomes")
+            for stat in ("hits", "misses", "inserts", "evictions",
+                         "invalidations", "forced_misses"):
+                out.append(_line(
+                    f"{prefix}_total", {"outcome": stat},
+                    int(rows.get(stat, 0)),
+                ))
+            _head(out, f"{prefix}_entries", "gauge",
+                  "Live serving-plane cache entries")
+            out.append(_line(
+                f"{prefix}_entries", {}, int(rows.get("entries", 0)),
+            ))
+            if prefix == "otb_result_cache":
+                _head(out, "otb_result_cache_bytes", "gauge",
+                      "Resident result-cache bytes")
+                out.append(_line(
+                    "otb_result_cache_bytes", {},
+                    int(rows.get("bytes", 0)),
+                ))
+    conc = getattr(cluster, "_concentrator", None)
+    if conc is not None:
+        crows = dict(conc.stat_rows())
+        _head(out, "otb_concentrator_clients", "gauge",
+              "Client connections multiplexed by the concentrator")
+        out.append(_line(
+            "otb_concentrator_clients", {}, int(crows.get("clients", 0)),
+        ))
+        _head(out, "otb_concentrator_backends", "gauge",
+              "Concentrator backend sessions by state")
+        for state in ("backends", "backends_free", "pinned"):
+            out.append(_line(
+                "otb_concentrator_backends", {"state": state},
+                int(crows.get(state, 0)),
+            ))
+        _head(out, "otb_concentrator_queued", "gauge",
+              "Statements waiting for a concentrator backend")
+        out.append(_line(
+            "otb_concentrator_queued", {}, int(crows.get("queued", 0)),
+        ))
+        _head(out, "otb_concentrator_statements_total", "counter",
+              "Statements executed through the concentrator")
+        out.append(_line(
+            "otb_concentrator_statements_total", {},
+            int(crows.get("statements", 0)),
+        ))
+        _head(out, "otb_concentrator_sheds_total", "counter",
+              "Statements shed by the concentrator (SQLSTATE 53300)")
+        out.append(_line(
+            "otb_concentrator_sheds_total", {},
+            int(crows.get("sheds", 0)),
+        ))
+
     # gauges: WAL position, sessions, replication lag, pool occupancy,
     # DN heartbeat age (from the health prober's bookkeeping)
     _head(out, "otb_sessions", "gauge", "Registered sessions")

@@ -1,0 +1,32 @@
+"""High-QPS serving plane (ROADMAP Open item 2).
+
+Three layers between the wire front ends and the planner/executor so
+the same hot query arriving millions of times stops costing millions
+of parse→analyze→distribute→cost trips:
+
+- **cross-session plan cache** (`plancache.PlanCache`): the full
+  planned artifact keyed by the canonical deparse fingerprint with
+  constants parameterized out, invalidated by the cluster catalog
+  epoch (every DDL/ALTER/redistribute bumps it — the same class of
+  D-record events that break matview delta streams);
+- **versioned result cache** (`plancache.ResultCache`): whole result
+  sets keyed by (fingerprint, per-table committed-write version
+  snapshot) — a matview nobody declared, invalidated for free by the
+  counters that already power matview freshness;
+- **session concentrator** (`net/concentrator.py`): a pgbouncer-style
+  front end multiplexing tens of thousands of client connections over
+  a bounded pool of backend sessions.
+
+``ServingPlane`` is the per-cluster facade holding both caches and the
+cluster-scoped cache GUCs (``enable_plan_cache`` /
+``enable_result_cache`` / ``result_cache_size`` — a SET in ANY live
+session takes effect immediately for every session and flushes the
+affected cache).
+"""
+
+from opentenbase_tpu.serving.plancache import (  # noqa: F401
+    PlanCache,
+    ResultCache,
+    ServingPlane,
+    statement_key,
+)

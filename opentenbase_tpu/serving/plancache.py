@@ -1,0 +1,462 @@
+"""Cross-session plan cache + versioned result cache (plancache.c's
+cross-session cousin, and the Napa-style hot-result layer).
+
+**Plan cache.** Every statement used to re-run
+parse→analyze→distribute→cost even when the identical query arrived a
+millisecond ago — PREPARE only helped within one session. Here the
+FULL planned artifact (the distributed plan the fused DAG compiles
+from) is cached cluster-wide, keyed by
+
+    (generic fingerprint, constant vector)
+
+where the generic fingerprint is the canonical deparse (the same
+canonicalization the matview rewrite matches on, so whitespace/alias/
+case differences collapse) of the statement with every literal
+parameterized out as ``$n``. Constants are part of the key — never
+substituted into a reused plan — because the planner folds them into
+shard pruning and costing; what IS shared is the generic entry across
+its constant variants (PG's plancache keeps custom plans per parameter
+set for the same reason; ours survive the session). A cache hit skips
+straight to ``Session._execute_dplan``.
+
+Invalidation is by catalog epoch: every DDL / ALTER / redistribute /
+MOVE DATA / ANALYZE bumps ``Cluster.catalog_epoch`` (the same event
+class whose D-records break matview delta streams), and an entry
+planned under an older epoch is discarded at lookup.
+
+**Result cache.** Hot read-only queries additionally cache their
+result sets, keyed by (fingerprint, snapshot of the per-table
+committed-write version counters that already power matview
+freshness). A hit is served without touching a datanode; any committed
+write to a referenced table bumps its counter and invalidates the
+entry for free — a matview nobody had to declare. The same exclusions
+the matview rewrite enforces apply: volatile functions, explicit
+transaction blocks (their pinned snapshot may predate the cached
+result), FOR UPDATE, system views, and non-SELECTs never cache.
+Entries store results computed only while no commit was mid-stamp
+(``Cluster._pending_commits``): a version counter bumps BEFORE the
+commit becomes snapshot-visible, so caching through that window could
+key pre-commit rows under post-commit versions.
+
+Both layers carry a ``FAULT`` site at their lookup boundary
+(``serving/plan_cache_lookup`` / ``serving/result_cache_lookup``) so
+chaos runs can force misses deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from opentenbase_tpu.fault import FAULT, FaultError
+from opentenbase_tpu.sql import ast as A
+
+# result-set entries larger than cache_size // _MAX_ENTRY_FRACTION are
+# never cached: one giant report query must not evict the whole hot set
+_MAX_ENTRY_FRACTION = 8
+
+
+# ---------------------------------------------------------------------------
+# statement canonicalization (the cache key)
+# ---------------------------------------------------------------------------
+
+
+def _lift_constants(stmt: A.Select) -> tuple[A.Select, tuple]:
+    """A rebuilt statement with every Literal replaced by ``$n``, plus
+    the lifted constant vector (typed — 1 and 1.0 must not share a
+    plan key even though they compare equal). ``lift`` is pure: nodes
+    are replaced via ``dataclasses.replace``, the input tree is never
+    mutated, so no defensive copy is needed on this hot path."""
+    consts: list = []
+
+    def lift(node):
+        if isinstance(node, A.Literal):
+            consts.append(node.value)
+            return A.Param(len(consts))
+        if isinstance(node, (list, tuple)):
+            return type(node)(lift(x) for x in node)
+        if dataclasses.is_dataclass(node) and not isinstance(node, type):
+            changes = {}
+            for f in dataclasses.fields(node):
+                v = getattr(node, f.name)
+                nv = lift(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            if changes:
+                return dataclasses.replace(node, **changes)
+        return node
+
+    lifted = lift(stmt)
+    key = tuple(
+        (type(v).__name__, v) for v in consts
+    )
+    return lifted, key
+
+
+def _walk_exprs(node):
+    yield node
+    if isinstance(node, (list, tuple)):
+        for x in node:
+            yield from _walk_exprs(x)
+    elif dataclasses.is_dataclass(node) and not isinstance(node, type):
+        for f in dataclasses.fields(node):
+            yield from _walk_exprs(getattr(node, f.name))
+
+
+def statement_key(session, stmt) -> Optional[tuple]:
+    """``(generic_fp, consts)`` for a cacheable SELECT, else None.
+
+    Cacheable = a plain SELECT outside an explicit transaction whose
+    canonical text is deparseable, with no volatile functions (the
+    matview exclusion list — nextval/now/random/...), no FOR UPDATE,
+    no admin/builtin function calls, and no reference to a system
+    view, coordinator-local scratch table, or foreign table."""
+    if not isinstance(stmt, A.Select):
+        return None
+    if stmt.for_update is not None or stmt.values_rows:
+        return None
+    if stmt.ctes or stmt.ctes_recursive:
+        # the canonical deparse has no WITH clause: a CTE shadowing a
+        # same-named relation would alias the plain query's key
+        return None
+    if stmt.distinct_on is not None or stmt.grouping_sets is not None:
+        return None
+    from opentenbase_tpu.matview.defs import _has_volatile
+
+    if _has_volatile(stmt):
+        return None
+    c = session.cluster
+    refs: set = set()
+    try:
+        session._referenced_tables(stmt, refs)
+    except Exception:
+        return None
+    from opentenbase_tpu.engine import _SYSTEM_VIEWS
+
+    if refs & set(_SYSTEM_VIEWS):
+        return None
+    if c.local_tables and refs & c.local_tables:
+        return None
+    for tb in refs:
+        if c.catalog.has(tb) and (
+            getattr(c.catalog.get(tb), "foreign", None) is not None
+        ):
+            return None
+    # Never key on: admin/sequence builtins (they dispatch before the
+    # planner and mutate state or read per-call state), or any
+    # user-defined function — a PL body can execute nested statements
+    # mid-query, so neither the fingerprint nor the scanned-table set
+    # describes what the statement actually read. A referenced VIEW can
+    # wrap such a call, so the check runs over the view-expanded tree.
+    funcs = (
+        set(session._ADMIN_FUNCS)
+        | set(session._READONLY_ADMIN_FUNCS)
+        | set(session._SEQ_FUNCS)
+        | set(c.functions)
+    )
+    probe = stmt
+    if c.views and refs & set(c.views):
+        import copy
+
+        from opentenbase_tpu.plan.views import rewrite_views
+
+        probe = copy.deepcopy(stmt)
+        try:
+            rewrite_views(probe, c.views)
+        except Exception:
+            return None
+        if _has_volatile(probe):
+            # a view body may hide now()/random()/nextval() the outer
+            # statement's volatile check could not see
+            return None
+        # ... and re-run the relation exclusions over the EXPANDED
+        # refs: a user view over pg_stat_* would otherwise cache
+        # monitoring data that refreshes without version bumps
+        exp_refs: set = set()
+        try:
+            session._referenced_tables(probe, exp_refs)
+        except Exception:
+            return None
+        if exp_refs & set(_SYSTEM_VIEWS):
+            return None
+        if c.local_tables and exp_refs & c.local_tables:
+            return None
+        for tb in exp_refs:
+            if c.catalog.has(tb) and (
+                getattr(c.catalog.get(tb), "foreign", None) is not None
+            ):
+                return None
+    for node in _walk_exprs(probe):
+        if isinstance(node, A.FuncCall) and node.name in funcs:
+            return None
+    lifted, consts = _lift_constants(stmt)
+    from opentenbase_tpu.sql.deparse import DeparseError, deparse_select
+
+    try:
+        fp = deparse_select(lifted)
+    except (DeparseError, RecursionError):
+        return None
+    try:
+        hash(consts)
+    except TypeError:
+        return None
+    return fp, consts
+
+
+# ---------------------------------------------------------------------------
+# plan cache
+# ---------------------------------------------------------------------------
+
+
+class _PlanEntry:
+    __slots__ = ("dplan", "tables", "epoch", "hits", "created")
+
+    def __init__(self, dplan, tables, epoch):
+        self.dplan = dplan
+        self.tables = tables
+        self.epoch = epoch
+        self.hits = 0
+        self.created = time.time()
+
+
+class PlanCache:
+    """LRU over (generic_fp, consts) → planned artifact."""
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = int(capacity)
+        self._entries: "OrderedDict[tuple, _PlanEntry]" = OrderedDict()
+        self._mu = threading.Lock()
+        self.stats = {
+            "hits": 0, "misses": 0, "inserts": 0, "evictions": 0,
+            "invalidations": 0, "forced_misses": 0, "flushes": 0,
+        }
+
+    def lookup(self, key, epoch: int) -> Optional[_PlanEntry]:
+        try:
+            # chaos hook: an armed 'error' here is a forced miss, never
+            # a query failure — the cache is an optimization
+            FAULT("serving/plan_cache_lookup")
+        except FaultError:
+            with self._mu:
+                self.stats["forced_misses"] += 1
+                self.stats["misses"] += 1
+            return None
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None:
+                self.stats["misses"] += 1
+                return None
+            if e.epoch != epoch:
+                # planned under an older catalog: DDL/redistribute/
+                # ANALYZE landed since — discard, count it
+                del self._entries[key]
+                self.stats["invalidations"] += 1
+                self.stats["misses"] += 1
+                return None
+            self._entries.move_to_end(key)
+            e.hits += 1
+            self.stats["hits"] += 1
+            return e
+
+    def insert(self, key, dplan, tables, epoch: int) -> None:
+        with self._mu:
+            self._entries[key] = _PlanEntry(dplan, tables, epoch)
+            self._entries.move_to_end(key)
+            self.stats["inserts"] += 1
+            while len(self._entries) > max(self.capacity, 0):
+                self._entries.popitem(last=False)
+                self.stats["evictions"] += 1
+
+    def flush(self) -> None:
+        with self._mu:
+            self._entries.clear()
+            self.stats["flushes"] += 1
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def stat_rows(self) -> list[tuple]:
+        with self._mu:
+            rows = [(k, int(v)) for k, v in sorted(self.stats.items())]
+            rows.append(("entries", len(self._entries)))
+            rows.append(("capacity", int(self.capacity)))
+            rows.append(("generic_queries", len(
+                {fp for fp, _consts in self._entries}
+            )))
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# result cache
+# ---------------------------------------------------------------------------
+
+
+def _est_bytes(rows, columns) -> int:
+    """Cheap size estimate: fixed per-row/cell overhead + string
+    payload, extrapolated from a bounded sample."""
+    n = len(rows)
+    if n == 0:
+        return 64
+    sample = rows[:32]
+    per = 0
+    for r in sample:
+        per += 48 + 16 * len(r)
+        for v in r:
+            if isinstance(v, str):
+                per += len(v)
+    return 64 + (per * n) // len(sample)
+
+
+class _ResultEntry:
+    __slots__ = (
+        "rows", "columns", "rowcount", "versions", "epoch", "nbytes",
+        "hits", "created",
+    )
+
+    def __init__(self, rows, columns, rowcount, versions, epoch, nbytes):
+        self.rows = rows
+        self.columns = columns
+        self.rowcount = rowcount
+        self.versions = versions
+        self.epoch = epoch
+        self.nbytes = nbytes
+        self.hits = 0
+        self.created = time.time()
+
+
+class ResultCache:
+    """Byte-bounded LRU over (generic_fp, consts) → result set,
+    validity judged against the live per-table version counters."""
+
+    def __init__(self, size_bytes: int = 64 << 20):
+        self.size_bytes = int(size_bytes)
+        self._entries: "OrderedDict[tuple, _ResultEntry]" = OrderedDict()
+        self._bytes = 0
+        self._mu = threading.Lock()
+        self.stats = {
+            "hits": 0, "misses": 0, "inserts": 0, "evictions": 0,
+            "invalidations": 0, "forced_misses": 0, "flushes": 0,
+        }
+
+    def lookup(self, key, cluster) -> Optional[_ResultEntry]:
+        try:
+            FAULT("serving/result_cache_lookup")
+        except FaultError:
+            with self._mu:
+                self.stats["forced_misses"] += 1
+                self.stats["misses"] += 1
+            return None
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None:
+                self.stats["misses"] += 1
+                return None
+            tv = cluster.table_version
+            stale = e.epoch != cluster.catalog_epoch or any(
+                tv.get(tb, 0) != ver for tb, ver in e.versions.items()
+            )
+            if stale:
+                del self._entries[key]
+                self._bytes -= e.nbytes
+                self.stats["invalidations"] += 1
+                self.stats["misses"] += 1
+                return None
+            self._entries.move_to_end(key)
+            e.hits += 1
+            self.stats["hits"] += 1
+            return e
+
+    def insert(
+        self, key, rows, columns, rowcount, versions, epoch: int
+    ) -> None:
+        nbytes = _est_bytes(rows, columns)
+        if nbytes > max(self.size_bytes // _MAX_ENTRY_FRACTION, 1):
+            return
+        with self._mu:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = _ResultEntry(
+                rows, columns, rowcount, versions, epoch, nbytes
+            )
+            self._bytes += nbytes
+            self.stats["inserts"] += 1
+            while self._bytes > self.size_bytes and self._entries:
+                _k, ev = self._entries.popitem(last=False)
+                self._bytes -= ev.nbytes
+                self.stats["evictions"] += 1
+
+    def flush(self) -> None:
+        with self._mu:
+            self._entries.clear()
+            self._bytes = 0
+            self.stats["flushes"] += 1
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def stat_rows(self) -> list[tuple]:
+        with self._mu:
+            rows = [(k, int(v)) for k, v in sorted(self.stats.items())]
+            rows.append(("entries", len(self._entries)))
+            rows.append(("bytes", int(self._bytes)))
+            rows.append(("size_limit", int(self.size_bytes)))
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# per-cluster facade + cluster-scoped cache GUCs
+# ---------------------------------------------------------------------------
+
+CACHE_GUCS = (
+    "enable_plan_cache", "enable_result_cache",
+    "result_cache_size", "plan_cache_size",
+)
+
+
+class ServingPlane:
+    """One per Cluster: both caches plus the effective (cluster-scoped)
+    cache GUCs. ``SET`` of a cache GUC in ANY session routes through
+    ``set_guc`` — the new value applies to every live session
+    immediately and the affected cache is flushed (a stale entry must
+    never outlive the knob that disowned it)."""
+
+    def __init__(self, conf: Optional[dict] = None):
+        from opentenbase_tpu import config as _config
+
+        eff = {name: _config.GUCS[name][1] for name in CACHE_GUCS}
+        for name in CACHE_GUCS:
+            if conf and conf.get(name) is not None:
+                eff[name] = conf[name]
+        self.plan_enabled = bool(eff["enable_plan_cache"])
+        self.result_enabled = bool(eff["enable_result_cache"])
+        self.plan_cache = PlanCache(int(eff["plan_cache_size"]))
+        self.result_cache = ResultCache(int(eff["result_cache_size"]))
+
+    def get_guc(self, name: str):
+        """The effective cluster-wide value (SHOW's source of truth)."""
+        return {
+            "enable_plan_cache": self.plan_enabled,
+            "plan_cache_size": self.plan_cache.capacity,
+            "enable_result_cache": self.result_enabled,
+            "result_cache_size": self.result_cache.size_bytes,
+        }[name]
+
+    def set_guc(self, name: str, value) -> None:
+        if name == "enable_plan_cache":
+            self.plan_enabled = bool(value)
+            self.plan_cache.flush()
+        elif name == "plan_cache_size":
+            self.plan_cache.capacity = int(value)
+            self.plan_cache.flush()
+        elif name == "enable_result_cache":
+            self.result_enabled = bool(value)
+            self.result_cache.flush()
+        elif name == "result_cache_size":
+            self.result_cache.size_bytes = int(value)
+            self.result_cache.flush()

@@ -312,6 +312,15 @@ class Parser:
             return A.AnalyzeStmt(name)
         if kw == "set":
             return self.parse_set()
+        if kw == "reset":
+            # RESET name == SET name TO DEFAULT (guc.c): value None is
+            # the reset sentinel (_x_setstmt restores the registry /
+            # conf-file default)
+            self.advance()
+            name = self.ident("setting name")
+            while self.eat_op("."):
+                name += "." + self.ident("setting name")
+            return A.SetStmt(name, None)
         if kw == "show":
             self.advance()
             name = self.ident("setting name")
@@ -1779,6 +1788,10 @@ class Parser:
             value = self._literal_value()
         else:
             value = self.ident("value")
+            if (
+                isinstance(value, str) and value.lower() == "default"
+            ):
+                value = None  # SET x TO DEFAULT == RESET x
         return A.SetStmt(name, value)
 
     def parse_move_data(self) -> A.MoveData:

@@ -859,6 +859,10 @@ class ClusterPersistence:
 
         c = self.cluster
         if tag == "D":
+            # D-records are the DDL class: advance the serving plane's
+            # catalog epoch so a standby (or post-recovery session)
+            # never serves a plan cached against the pre-DDL catalog
+            c.bump_catalog_epoch()
             op = header["op"]
             if op == "create_table":
                 if c.catalog.has(header["name"]):

@@ -1,0 +1,695 @@
+"""High-QPS serving plane (serving/ + net/concentrator.py): the
+cross-session plan cache, the versioned result cache, and the pgwire
+session concentrator — correctness under concurrency, cluster-scoped
+cache GUCs, chaos-forced misses, and pgbouncer-style session pinning.
+"""
+
+import threading
+import time
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.net.client import connect_tcp
+from opentenbase_tpu.net.concentrator import PgConcentrator
+from opentenbase_tpu.net.server import ClusterServer
+from test_pgwire import V3Client
+
+Q = "select g, count(*) as n, sum(v) as s from st where g < 4 group by g order by g"
+
+
+def _mkcluster(**kw):
+    c = Cluster(num_datanodes=2, shard_groups=16, **kw)
+    s = c.session()
+    s.execute("set enable_fused_execution = off")
+    s.execute(
+        "create table st (k bigint, g bigint, v bigint) "
+        "distribute by shard(k)"
+    )
+    s.execute(
+        "insert into st values "
+        + ",".join(f"({i},{i % 5},{i * 2})" for i in range(100))
+    )
+    return c, s
+
+
+def _pc(s):
+    return dict(s.query("select stat, value from pg_stat_plan_cache"))
+
+
+def _rc(s):
+    return dict(s.query("select stat, value from pg_stat_result_cache"))
+
+
+# ---------------------------------------------------------------------------
+# plan cache
+# ---------------------------------------------------------------------------
+
+
+def test_plan_cache_hit_and_cross_session():
+    c, s = _mkcluster()
+    r1 = s.query(Q)
+    before = _pc(s)
+    assert s.query(Q) == r1
+    after = _pc(s)
+    assert after["hits"] == before["hits"] + 1
+    # another session, same canonical text (different whitespace/case):
+    # the cache is CROSS-session and keys on the canonical deparse
+    s2 = c.session()
+    s2.execute("set enable_fused_execution = off")
+    assert s2.query(
+        "SELECT g, COUNT(*) AS n, SUM(v) AS s FROM st "
+        "WHERE g < 4 GROUP BY g ORDER BY g"
+    ) == r1
+    assert _pc(s2)["hits"] == after["hits"] + 1
+    c.close()
+
+
+def test_plan_cache_generic_key_per_constants():
+    """Constant variants share one generic fingerprint but never share
+    a planned artifact (constants drive pruning/costing)."""
+    c, s = _mkcluster()
+    a = s.query("select count(*) from st where g < 2")
+    b = s.query("select count(*) from st where g < 3")
+    assert a != b
+    pc = _pc(s)
+    assert pc["entries"] == 2 and pc["generic_queries"] == 1, pc
+    # same constants again: a hit, still correct
+    assert s.query("select count(*) from st where g < 2") == a
+    assert _pc(s)["hits"] >= 1
+    c.close()
+
+
+def test_plan_cache_invalidation_on_ddl_from_second_session():
+    c, s = _mkcluster()
+    star = "select * from st order by k limit 3"
+    r1 = s.query(star)
+    assert len(r1[0]) == 3
+    s2 = c.session()
+    s2.execute("alter table st add column w bigint")
+    # the cached plan predates the ALTER: it must be discarded, and the
+    # re-planned query must see the new column
+    r2 = s.query(star)
+    assert len(r2[0]) == 4, r2
+    assert _pc(s)["invalidations"] >= 1
+    c.close()
+
+
+def test_plan_cache_prepare_consults_shared_cache():
+    """Satellite: a per-session PREPARE's first EXECUTE reuses the
+    generic plan another session already paid to build."""
+    c, s = _mkcluster()
+    r1 = s.query(Q)  # populates the shared cache
+    s2 = c.session()
+    s2.execute("set enable_fused_execution = off")
+    s2.execute(
+        "prepare hot as select g, count(*) as n, sum(v) as s from st "
+        "where g < $1 group by g order by g"
+    )
+    before = _pc(s2)
+    assert s2.query("execute hot(4)") == r1
+    assert _pc(s2)["hits"] == before["hits"] + 1
+    # different constant: a fresh variant, planned once, then shared
+    s2.query("execute hot(3)")
+    s3 = c.session()
+    s3.execute("set enable_fused_execution = off")
+    before = _pc(s3)
+    s3.query(
+        "select g, count(*) as n, sum(v) as s from st where g < 3 "
+        "group by g order by g"
+    )
+    assert _pc(s3)["hits"] == before["hits"] + 1
+    c.close()
+
+
+def test_plan_cache_explain_analyze_prelude():
+    c, s = _mkcluster()
+    q = "select count(*) from st where g = 1"
+    lines = [r[0] for r in s.query(f"explain analyze {q}")]
+    assert any("plan_cache=miss" in ln for ln in lines), lines[:3]
+    lines = [r[0] for r in s.query(f"explain analyze {q}")]
+    assert any("plan_cache=hit" in ln for ln in lines), lines[:3]
+    # plain EXPLAIN stays cache-blind (stable plan text)
+    lines = [r[0] for r in s.query(f"explain {q}")]
+    assert not any("plan_cache" in ln for ln in lines), lines[:3]
+    # EXPLAIN ANALYZE keys the PRE-expansion tree like execution: a
+    # partitioned-parent query executed first must read back as a hit
+    # (keying the expanded child union would never match)
+    s.execute(
+        "create table pt (ts bigint, v bigint) distribute by shard(ts)"
+        " partition by range (ts) begin (0) step (100) partitions (3)"
+    )
+    s.execute("insert into pt values (5, 1), (105, 2), (205, 3)")
+    pq = "select count(*), sum(v) from pt where ts < 250"
+    s.query(pq)
+    lines = [r[0] for r in s.query(f"explain analyze {pq}")]
+    assert any("plan_cache=hit" in ln for ln in lines), lines[:3]
+    c.close()
+
+
+def test_plan_cache_cte_never_aliases_view():
+    """A CTE shadowing a same-named view must not collide with the
+    plain query's fingerprint (the deparse has no WITH clause)."""
+    c, s = _mkcluster()
+    s.execute("create view vv as select k from st where g = 0")
+    n_view = s.query("select count(*) from vv")
+    n_cte = s.query("with vv as (select k from st) select count(*) from vv")
+    assert n_view == [(20,)] and n_cte == [(100,)]
+    # and again, with the plain query cached first
+    assert s.query("select count(*) from vv") == n_view
+    assert s.query(
+        "with vv as (select k from st) select count(*) from vv"
+    ) == n_cte
+    c.close()
+
+
+def test_plan_cache_excludes_volatile_and_system_views():
+    c, s = _mkcluster()
+    s.execute("create sequence seq1")
+    e0 = _pc(s)["entries"]
+    s.query("select nextval('seq1')")
+    s.query("select * from pg_stat_wlm")
+    assert _pc(s)["entries"] == e0
+    c.close()
+
+
+def test_cache_gucs_are_cluster_scoped_and_flush():
+    """Satellite: SET/RESET of a cache GUC takes effect immediately on
+    live sessions and flushes the affected cache."""
+    c, s = _mkcluster()
+    s.query(Q)
+    assert _pc(s)["entries"] == 1
+    s2 = c.session()
+    s2.execute("set enable_plan_cache = off")  # from ANOTHER session
+    assert not c.serving.plan_enabled
+    assert _pc(s)["entries"] == 0  # flushed
+    before = _pc(s)
+    s.query(Q)
+    after = _pc(s)
+    assert after["misses"] == before["misses"]  # not even consulted
+    assert after["entries"] == 0
+    s2.execute("reset enable_plan_cache")
+    assert c.serving.plan_enabled  # registry default restored
+    # result_cache_size SET resizes AND flushes
+    s.execute("set enable_result_cache = on")
+    s.query(Q)
+    assert _rc(s)["entries"] == 1
+    s2.execute("set result_cache_size = 1048576")
+    rc = _rc(s)
+    assert rc["entries"] == 0 and rc["size_limit"] == 1048576
+    # new sessions inherit the runtime override; RESET restores default
+    s3 = c.session()
+    assert s3.gucs["result_cache_size"] == 1048576
+    s.execute("reset result_cache_size")
+    from opentenbase_tpu import config
+
+    assert c.serving.result_cache.size_bytes == (
+        config.GUCS["result_cache_size"][1]
+    )
+    c.close()
+
+
+def test_cache_lookup_fault_sites_force_misses():
+    """Satellite: a FAULT at each cache-lookup boundary forces a miss,
+    never a query error."""
+    c, s = _mkcluster()
+    s.execute("set enable_result_cache = on")
+    r1 = s.query(Q)
+    assert s.query(Q) == r1  # result hit
+    s.execute("set fault_injection = on")
+    s.execute(
+        "select pg_fault_inject('serving/result_cache_lookup', "
+        "'error', 'every(1)')"
+    )
+    s.execute(
+        "select pg_fault_inject('serving/plan_cache_lookup', "
+        "'error', 'every(1)')"
+    )
+    assert s.query(Q) == r1  # correct, but both caches forced to miss
+    s.execute("select pg_fault_clear()")
+    assert _pc(s)["forced_misses"] >= 1
+    assert _rc(s)["forced_misses"] >= 1
+    assert s.query(Q) == r1  # hits again once cleared
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# result cache
+# ---------------------------------------------------------------------------
+
+
+def test_result_cache_hit_then_invalidation_on_write():
+    c, s = _mkcluster()
+    s.execute("set enable_result_cache = on")
+    a = s.query(Q)
+    assert s.query(Q) == a
+    rc = _rc(s)
+    assert rc["hits"] >= 1 and rc["entries"] == 1
+    s2 = c.session()
+    s2.execute("insert into st values (500, 1, 7)")
+    b = s.query(Q)
+    assert b != a  # the committed write is visible, not the cache
+    assert _rc(s)["invalidations"] >= 1
+    c.close()
+
+
+def test_result_cache_differential_byte_identical():
+    """Cached results must be byte-identical to uncached execution
+    across randomized DML rounds."""
+    import random
+
+    rnd = random.Random(7)
+    c, s = _mkcluster()
+    cached = c.session()
+    cached.execute("set enable_fused_execution = off")
+    cached.execute("set enable_result_cache = on")
+    queries = [
+        Q,
+        "select count(*) from st",
+        "select g, min(v), max(v) from st group by g order by g",
+        "select k, v from st where g = 2 order by k limit 5",
+    ]
+    for round_no in range(6):
+        op = rnd.choice(["ins", "del", "upd"])
+        if op == "ins":
+            k = 1000 + round_no
+            s.execute(f"insert into st values ({k}, {k % 5}, {k})")
+        elif op == "del":
+            s.execute(f"delete from st where k = {rnd.randrange(100)}")
+        else:
+            s.execute(
+                f"update st set v = v + 1 where k = {rnd.randrange(100)}"
+            )
+        for q in queries:
+            hot = cached.query(q)   # may serve from cache
+            hot2 = cached.query(q)  # definitely serves from cache
+            s.execute("set enable_result_cache = off")
+            cold = s.query(q)
+            s.execute("set enable_result_cache = on")
+            assert hot == hot2 == cold, (round_no, q, hot, cold)
+    c.close()
+
+
+def test_result_cache_never_time_travels_under_racing_writes():
+    """Satellite: staleness window under racing committed writes — a
+    reader alternating cached and uncached reads of max(k) must never
+    observe the maximum move backwards (a stale serve after a write
+    became visible would do exactly that)."""
+    c, s = _mkcluster()
+    s.execute("set enable_result_cache = on")
+    srv = ClusterServer(c).start()
+    stop = threading.Event()
+    errs: list = []
+
+    def writer():
+        try:
+            with connect_tcp(srv.host, srv.port) as w:
+                k = 10_000
+                while not stop.is_set():
+                    w.execute(f"insert into st values ({k}, 1, 1)")
+                    k += 1
+        except Exception as e:  # noqa: BLE001 - surfaced via errs
+            errs.append(repr(e))
+
+    def reader():
+        try:
+            with connect_tcp(srv.host, srv.port) as r:
+                floor = 0
+                for i in range(40):
+                    # cached read (may serve a version-validated entry)
+                    hot = r.query("select max(k) from st")[0][0]
+                    assert hot >= floor, (hot, floor)
+                    floor = max(floor, hot)
+                    # uncached read advances the floor
+                    r.execute("set enable_result_cache = off")
+                    cold = r.query("select max(k) from st")[0][0]
+                    r.execute("set enable_result_cache = on")
+                    assert cold >= floor, (cold, floor)
+                    floor = max(floor, cold)
+        except Exception as e:
+            errs.append(repr(e))
+
+    wt = threading.Thread(target=writer)
+    rt = threading.Thread(target=reader)
+    wt.start()
+    rt.start()
+    rt.join(timeout=180)
+    stop.set()
+    wt.join(timeout=30)
+    srv.stop()
+    c.close()
+    assert not errs, errs
+
+
+def test_result_cache_exclusions():
+    c, s = _mkcluster()
+    s.execute("create sequence seq2")
+    s.execute("set enable_result_cache = on")
+    e0 = _rc(s)["entries"]
+    # volatile functions never cache (nextval must re-evaluate)
+    a = s.query("select nextval('seq2')")
+    b = s.query("select nextval('seq2')")
+    assert a != b  # the sequence advanced: not served from cache
+    assert _rc(s)["entries"] == e0
+    # explicit transaction blocks never cache or serve
+    s.query(Q)
+    entries = _rc(s)["entries"]
+    hits0 = _rc(s)["hits"]
+    s.execute("begin")
+    s.query(Q)
+    s.execute("commit")
+    rc = _rc(s)
+    assert rc["entries"] == entries and rc["hits"] == hits0
+    c.close()
+
+
+def test_result_cache_excludes_system_view_behind_user_view():
+    """System-view backing stores refresh without version bumps — a
+    user view wrapping one must never get a cache key (it would serve
+    permanently frozen monitoring rows)."""
+    c, s = _mkcluster()
+    # a direct read materializes the backing table (CREATE VIEW
+    # validates its body against the catalog); later direct reads
+    # refresh it, and the view-wrapped read must never be served from
+    # the result cache across those refreshes
+    s.query("select stat, value from pg_stat_plan_cache")
+    s.execute("create view vstats as select stat, value from pg_stat_plan_cache")
+    s.execute("set enable_result_cache = on")
+    e0 = _rc(s)["entries"]
+    a = dict(s.query("select stat, value from vstats"))
+    s.query(Q)  # moves plan-cache counters
+    s.query("select stat, value from pg_stat_plan_cache")  # refresh
+    b = dict(s.query("select stat, value from vstats"))
+    assert b["misses"] > a["misses"], (a, b)  # not frozen
+    assert _rc(s)["entries"] == e0 + 1  # only Q's entry, never vstats
+    c.close()
+
+
+def test_statement_key_sees_volatile_hidden_in_view():
+    """A view body may hide a volatile function the outer statement's
+    text never shows — the eligibility check expands views and must
+    refuse a key. (No volatile function is executable inside a view
+    today — nextval is FROM-less-only — so this drives statement_key
+    directly against a registered view body.)"""
+    from opentenbase_tpu.serving import statement_key
+    from opentenbase_tpu.sql.parser import parse
+
+    c, s = _mkcluster()
+    # a plain view IS key-eligible
+    s.execute("create view vplain as select k, v from st where g = 1")
+    sel = parse("select k from vplain")[0]
+    assert statement_key(s, sel) is not None
+    # register a volatile body the way CREATE VIEW stores it
+    c.views["vvol"] = (parse("select now() as t")[0], "select now() as t")
+    sel = parse("select t from vvol")[0]
+    assert statement_key(s, sel) is None
+    c.close()
+
+
+def test_result_cache_lru_eviction_by_bytes():
+    c, s = _mkcluster()
+    s.execute("set result_cache_size = 2048")
+    s.execute("set enable_result_cache = on")
+    # 30 distinct scalar results (~130 est. bytes each) overflow the
+    # 2 KiB budget: the LRU must evict and stay under it. A result
+    # bigger than size/8 is refused outright (never evicts the hot set)
+    for i in range(30):
+        s.query(f"select count(*) + {i} from st")
+    rc = _rc(s)
+    assert rc["inserts"] >= 20, rc
+    assert rc["bytes"] <= 2048, rc
+    assert rc["evictions"] >= 1, rc
+    s.query("select k, g, v from st order by k")  # over the entry cap
+    assert _rc(s)["inserts"] == rc["inserts"]
+    c.close()
+
+
+def test_serving_views_and_exporter_render():
+    c, s = _mkcluster()
+    s.execute("set enable_result_cache = on")
+    s.query(Q)
+    s.query(Q)
+    from opentenbase_tpu.obs.exporter import render_cluster_metrics
+
+    text = render_cluster_metrics(c)
+    assert 'otb_plan_cache_total{outcome="hits"}' in text
+    assert 'otb_result_cache_total{outcome="hits"}' in text
+    assert "otb_result_cache_bytes" in text
+    conc = PgConcentrator(c, backends=2).start()
+    try:
+        text = render_cluster_metrics(c)
+        assert "otb_concentrator_clients" in text
+        assert 'otb_concentrator_backends{state="backends_free"}' in text
+        rows = dict(s.query("select stat, value from pg_stat_concentrator"))
+        assert rows["backends"] == 2
+    finally:
+        conc.stop()
+    assert s.query("select stat, value from pg_stat_concentrator") == []
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# session concentrator
+# ---------------------------------------------------------------------------
+
+
+def test_concentrator_more_clients_than_backends():
+    c, s = _mkcluster()
+    conc = PgConcentrator(c, backends=2, queue_depth=64).start()
+    clients = [
+        V3Client(conc.host, conc.port, user=f"u{i}") for i in range(8)
+    ]
+    try:
+        for cl in clients:
+            _cols, rows, _tag = cl.query("select count(*) from st")
+            assert rows == [("100",)]
+        st = dict(conc.stat_rows())
+        assert st["clients"] == 8 and st["backends"] == 2
+        assert st["statements"] >= 8
+    finally:
+        for cl in clients:
+            cl.close()
+        time.sleep(0.2)
+        assert dict(conc.stat_rows())["clients"] == 0
+        conc.stop()
+        c.close()
+
+
+def test_concentrator_session_pinning_set_prepare_begin():
+    """Satellite: SET/PREPARE/BEGIN pin; state never leaks across
+    multiplexed clients."""
+    c, s = _mkcluster()
+    conc = PgConcentrator(c, backends=2).start()
+    c0 = V3Client(conc.host, conc.port, user="a")
+    c1 = V3Client(conc.host, conc.port, user="b")
+    try:
+        # SET pins for the connection's life
+        c0.query("set application_name = pinned_app")
+        _c, rows0, _t = c0.query("show application_name")
+        _c, rows1, _t = c1.query("show application_name")
+        assert rows0 == [("pinned_app",)]
+        assert rows1 != rows0
+        assert dict(conc.stat_rows())["pinned"] == 1
+        # PREPARE stays with its client
+        c0.query("prepare p1 as select count(*) from st where g < $1")
+        _c, rows, _t = c0.query("execute p1(2)")
+        assert rows == [("40",)]
+        with pytest.raises(RuntimeError, match="does not exist"):
+            c1.query("execute p1(2)")
+        # BEGIN pins c1 until COMMIT; isolation across clients holds
+        c1.query("begin")
+        c1.query("insert into st values (900, 0, 1)")
+        _c, rows, _t = c0.query("select count(*) from st")
+        assert rows == [("100",)]  # uncommitted rows invisible
+        assert dict(conc.stat_rows())["pinned"] == 2
+        c1.query("commit")
+        _c, rows, _t = c0.query("select count(*) from st")
+        assert rows == [("101",)]
+        # c1's txn pin lifted at COMMIT (only c0's sticky pin remains)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if dict(conc.stat_rows())["pinned"] == 1:
+                break
+            time.sleep(0.05)
+        assert dict(conc.stat_rows())["pinned"] == 1
+    finally:
+        c0.close()
+        c1.close()
+        time.sleep(0.2)
+        st = dict(conc.stat_rows())
+        conc.stop()
+        c.close()
+    # a state-pinned backend is retired at close, the pool refilled
+    assert st["pinned"] == 0 and st["backends_free"] == 2, st
+
+
+def test_concentrator_shed_sqlstate_when_backends_exhausted():
+    """Satellite: SQLSTATE-preserving shed (53300) when every backend
+    is pinned and the wait budget expires."""
+    c, s = _mkcluster()
+    conc = PgConcentrator(
+        c, backends=2, queue_depth=64, queue_timeout_s=0.5
+    ).start()
+    c0 = V3Client(conc.host, conc.port, user="a")
+    c1 = V3Client(conc.host, conc.port, user="b")
+    c2 = V3Client(conc.host, conc.port, user="c")
+    try:
+        c0.query("begin")
+        c1.query("begin")
+        with pytest.raises(RuntimeError, match="53300"):
+            c2.query("select 1")
+        assert dict(conc.stat_rows())["sheds"] >= 1
+        c0.query("rollback")
+        c1.query("rollback")
+        _c, rows, _t = c2.query("select 1")  # recovers after release
+        assert rows == [("1",)]
+    finally:
+        for cl in (c0, c1, c2):
+            cl.close()
+        conc.stop()
+        c.close()
+
+
+def test_concentrator_wlm_shed_rides_through():
+    """WLM admission still gates concentrated statements: a shed from
+    the resource group arrives as its own 53xxx SQLSTATE."""
+    c, s = _mkcluster()
+    s.execute(
+        "create resource group tiny with (concurrency = 1, "
+        "queue_depth = 0)"
+    )
+    conc = PgConcentrator(c, backends=3, queue_timeout_s=5.0).start()
+    c0 = V3Client(conc.host, conc.port, user="a")
+    c1 = V3Client(conc.host, conc.port, user="b")
+    try:
+        c0.query("set resource_group = tiny")
+        c1.query("set resource_group = tiny")
+        done: list = []
+
+        def slow():
+            done.append(c0.query("select pg_sleep(1.2)"))
+
+        th = threading.Thread(target=slow)
+        th.start()
+        time.sleep(0.4)
+        with pytest.raises(RuntimeError, match="C53"):
+            c1.query("select pg_sleep(0.1)")
+        th.join()
+        assert done
+    finally:
+        c0.close()
+        c1.close()
+        conc.stop()
+        c.close()
+
+
+def test_concentrator_extended_protocol_refused_simple_ok():
+    c, s = _mkcluster()
+    conc = PgConcentrator(c, backends=2).start()
+    cl = V3Client(conc.host, conc.port, user="a")
+    try:
+        with pytest.raises(RuntimeError, match="0A000"):
+            cl.extended("select 1")
+        # the connection survives and simple queries still work
+        _c, rows, _t = cl.query("select 2")
+        assert rows == [("2",)]
+    finally:
+        cl.close()
+        conc.stop()
+        c.close()
+
+
+def test_concentrator_scram_auth():
+    c, s = _mkcluster()
+    s.execute("create user app password 'sekret'")
+    conc = PgConcentrator(c, backends=2).start()
+    try:
+        cl = V3Client(conc.host, conc.port, user="app", password="sekret")
+        _c, rows, _t = cl.query("select count(*) from st")
+        assert rows == [("100",)]
+        cl.close()
+        with pytest.raises(AssertionError, match="auth failed"):
+            V3Client(conc.host, conc.port, user="app", password="wrong")
+        with pytest.raises(AssertionError, match="auth failed"):
+            V3Client(conc.host, conc.port, user="ghost", password="x")
+    finally:
+        conc.stop()
+        c.close()
+
+
+def test_concentrator_survives_malformed_bytes():
+    """Protocol garbage from one client (bad UTF-8, torn SASL fields)
+    must sever THAT client only — never the selector thread every
+    other connection depends on."""
+    import socket
+    import struct
+
+    c, s = _mkcluster()
+    conc = PgConcentrator(c, backends=2).start()
+    good = V3Client(conc.host, conc.port, user="ok")
+    try:
+        # garbage simple-query payload (invalid UTF-8) post-startup
+        bad = socket.create_connection((conc.host, conc.port), timeout=10)
+        body = struct.pack("!I", 196608) + b"user\0evil\0\0"
+        bad.sendall(struct.pack("!I", len(body) + 4) + body)
+        time.sleep(0.2)
+        bad.sendall(b"Q" + struct.pack("!I", 7) + b"\xff\xfe\0")
+        # and a torn startup packet from a second attacker
+        bad2 = socket.create_connection((conc.host, conc.port), timeout=10)
+        bad2.sendall(struct.pack("!I", 9) + b"\x00\x03\x00\x00\xff")
+        time.sleep(0.3)
+        # the well-behaved client still works, and new clients connect
+        _cols, rows, _tag = good.query("select count(*) from st")
+        assert rows == [("100",)]
+        late = V3Client(conc.host, conc.port, user="late")
+        _cols, rows, _tag = late.query("select 1")
+        assert rows == [("1",)]
+        late.close()
+        bad.close()
+        bad2.close()
+    finally:
+        good.close()
+        conc.stop()
+        c.close()
+
+
+def test_reset_role_restores_login_user():
+    c, s = _mkcluster()
+    login = s.user
+    s.execute("set role = impostor")
+    assert s.user == "impostor"
+    s.execute("reset role")
+    assert s.user == login
+    c.close()
+
+
+def test_concentrator_serves_cached_results_across_clients():
+    """The full serving stack: plan + result caches behind the
+    concentrator, hot query served to many multiplexed clients,
+    byte-identical to cold execution."""
+    c, s = _mkcluster()
+    s.execute("set enable_result_cache = on")
+    conc = PgConcentrator(c, backends=2).start()
+    clients = [
+        V3Client(conc.host, conc.port, user=f"u{i}") for i in range(6)
+    ]
+    try:
+        answers = [
+            tuple(clients[i].query(
+                "select g, count(*) from st group by g order by g"
+            )[1])
+            for i in range(6)
+        ]
+        assert len(set(answers)) == 1
+        assert _rc(s)["hits"] >= 4  # most clients were served
+        # a write through the concentrator invalidates for everyone
+        clients[0].query("insert into st values (901, 0, 5)")
+        _c, rows, _t = clients[1].query(
+            "select g, count(*) from st group by g order by g"
+        )
+        assert rows[0] == ("0", "21"), rows
+    finally:
+        for cl in clients:
+            cl.close()
+        conc.stop()
+        c.close()

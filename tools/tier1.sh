@@ -368,6 +368,104 @@ print("join smoke OK: radix == sortmerge (fused+host), EXPLAIN shows "
       "mode, floors validate, gate fails violation+demotion")
 PY
 
+echo "== tier1: serving-plane smoke =="
+timeout -k 10 180 python - <<'PY' || exit 1
+# Serving plane (serving/ + net/concentrator.py): prepared and ad-hoc
+# executions of the same query must answer identically THROUGH the
+# shared plan cache (hit counters prove the path), a result-cache hit
+# must invalidate on the next committed write, a concentrator with
+# more clients than backends must round-trip them all with session
+# pinning intact, and the checked-in serving floors must validate.
+import json, struct, socket, sys
+from opentenbase_tpu import bench_gate
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.net.concentrator import PgConcentrator
+
+c = Cluster(num_datanodes=2, shard_groups=16)
+s = c.session()
+s.execute("set enable_fused_execution = off")
+s.execute("create table sv (k bigint, g bigint, v bigint) "
+          "distribute by shard(k)")
+s.execute("insert into sv values " + ",".join(
+    f"({i},{i%5},{i*3})" for i in range(200)))
+Q = "select g, count(*), sum(v) from sv where g < 4 group by g order by g"
+adhoc = s.query(Q)
+s2 = c.session()
+s2.execute("set enable_fused_execution = off")
+s2.execute("prepare p as select g, count(*), sum(v) from sv "
+           "where g < $1 group by g order by g")
+pc0 = dict(s2.query("select stat, value from pg_stat_plan_cache"))
+prepared = s2.query("execute p(4)")
+pc1 = dict(s2.query("select stat, value from pg_stat_plan_cache"))
+assert prepared == adhoc, (prepared, adhoc)          # parity
+assert pc1["hits"] == pc0["hits"] + 1, (pc0, pc1)    # shared-cache hit
+lines = [r[0] for r in s.query(f"explain analyze {Q}")]
+assert any("plan_cache=hit" in ln for ln in lines), lines[:3]
+s.execute("set enable_result_cache = on")
+a = s.query(Q); b = s.query(Q)
+rc = dict(s.query("select stat, value from pg_stat_result_cache"))
+assert a == b and rc["hits"] >= 1, rc
+s2.execute("insert into sv values (999, 1, 5)")
+a2 = s.query(Q)
+assert a2 != a, "result cache served stale rows after a committed write"
+rc2 = dict(s.query("select stat, value from pg_stat_result_cache"))
+assert rc2["invalidations"] >= 1, rc2
+
+# concentrator: 6 clients over 2 backends, all round-trip; SET pins
+conc = PgConcentrator(c, backends=2, queue_depth=64).start()
+class Cli:
+    def __init__(self):
+        self.sock = socket.create_connection((conc.host, conc.port), timeout=30)
+        body = struct.pack("!I", 196608) + b"user\0smoke\0\0"
+        self.sock.sendall(struct.pack("!I", len(body) + 4) + body)
+        self.drain()
+    def rd(self, k):
+        buf = b""
+        while len(buf) < k:
+            ch = self.sock.recv(k - len(buf)); assert ch; buf += ch
+        return buf
+    def drain(self):
+        rows = []; err = None
+        while True:
+            tag = self.rd(1); (ln,) = struct.unpack("!I", self.rd(4))
+            body = self.rd(ln - 4)
+            if tag == b"D":
+                (ncol,) = struct.unpack("!H", body[:2]); off = 2; row = []
+                for _ in range(ncol):
+                    (l2,) = struct.unpack_from("!i", body, off); off += 4
+                    row.append(None if l2 == -1 else body[off:off+l2].decode())
+                    off += max(l2, 0)
+                rows.append(tuple(row))
+            elif tag == b"E": err = body
+            elif tag == b"Z":
+                if err: raise RuntimeError(err.decode(errors="replace"))
+                return rows
+    def q(self, sql):
+        b = sql.encode() + b"\0"
+        self.sock.sendall(b"Q" + struct.pack("!I", len(b) + 4) + b)
+        return self.drain()
+
+clis = [Cli() for _ in range(6)]
+want = [tuple(str(x) for x in r) for r in s.query(Q)]
+for cl in clis:
+    assert cl.q(Q) == want
+clis[0].q("set application_name = smoketest")
+assert clis[0].q("show application_name") == [("smoketest",)]
+assert clis[1].q("show application_name") != [("smoketest",)]
+st = dict(conc.stat_rows())
+assert st["clients"] == 6 and st["backends"] == 2 and st["pinned"] == 1, st
+for cl in clis: cl.sock.close()
+conc.stop()
+c.close()
+doc = bench_gate.load_floors()  # raises on schema errors
+for m in ("serving_stmts_per_sec", "serving_speedup"):
+    assert m in doc["floors"], f"missing serving floor {m}"
+    assert doc["floors"][m]["platform"] == "any", m
+print(json.dumps({"serving_gate": "ok",
+                  "plan_cache_hits": pc1["hits"],
+                  "result_invalidations": rc2["invalidations"]}))
+PY
+
 echo "== tier1: full suite =="
 rm -f /tmp/_t1.log
 # 870s was calibrated against a 786s run of 664 tests; the suite is now
