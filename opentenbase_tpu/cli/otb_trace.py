@@ -7,8 +7,11 @@ https://ui.perfetto.dev).
 
 The coordinator keeps a bounded in-memory ring of finished query traces
 (``trace_queries = on`` traces every statement; EXPLAIN ANALYZE always
-traces its own). This tool calls the ``pg_export_traces(N)`` admin
-function over the wire and writes the document to ``--out``.
+traces its own) and merges every reachable node's span ring into the
+export: pid = node (cn0/dnN/gtm0), spans joined by trace_id, so one
+statement's true cross-node critical path renders as separate process
+tracks. This tool calls the ``pg_export_traces(N)`` admin function over
+the wire and writes the document to ``--out``.
 
 Exit code 0 on success (even when the ring is empty — an empty trace is
 a valid trace), 1 when the coordinator is unreachable.
@@ -70,10 +73,14 @@ def main(argv=None) -> int:
     with open(args.out, "w") as f:
         json.dump(doc, f)
     events = doc.get("traceEvents", [])
-    queries = len({e["pid"] for e in events}) if events else 0
+    spans = [e for e in events if e.get("ph") == "X"]
+    nodes = {e["pid"] for e in spans}
+    traces = {
+        (e.get("args") or {}).get("trace_id") for e in spans
+    } - {None}
     print(
-        f"wrote {args.out}: {len(events)} events from {queries} "
-        "traced queries"
+        f"wrote {args.out}: {len(spans)} spans from {len(traces)} "
+        f"traced statements across {len(nodes)} nodes"
     )
     return 0
 

@@ -161,10 +161,20 @@ GUCS: dict = {
     # defining query is answered from the matview instead of the fact
     # tables; staleness is checked against per-table write versions
     "enable_matview_rewrite": (_bool, True),
-    # span tracing (obs/trace.py): off = zero-cost (no span allocation
-    # anywhere on the statement path); EXPLAIN ANALYZE always traces
-    # its one statement regardless
+    # span tracing (obs/trace.py + obs/tracectx.py): off = zero-cost
+    # (no span allocation anywhere on the statement path, on any node —
+    # the wire carries no ``_trace`` header and remote span rings stay
+    # untouched); EXPLAIN ANALYZE always traces its one statement
+    # regardless
     "trace_queries": (_bool, False),
+    # device-platform watchdog (executor/fused.py note_run_platform):
+    # the platform every fused run is EXPECTED to execute on. '' =
+    # infer from the environment (a configured TPU tunnel expects
+    # 'tpu'). A run on any other platform bumps
+    # otb_platform_demotions_total, elogs a warning the first time,
+    # and stamps pg_cluster_health.device_platform — the r04/r05
+    # silent-CPU class made continuously observable.
+    "expected_device_platform": (_enum("", "tpu", "cpu", "gpu"), ""),
     # fault injection (fault/): pg_fault_inject() refuses unless the
     # session turned this on — an accidental arm in production SQL must
     # be a two-step mistake. Off adds nothing to any hot path: every

@@ -37,6 +37,7 @@ from opentenbase_tpu.net.protocol import (
     shutdown_and_close,
 )
 from opentenbase_tpu.obs import log as _olog
+from opentenbase_tpu.obs import tracectx as _tctx
 
 
 class FragmentCancelled(RuntimeError):
@@ -68,6 +69,14 @@ class DNServer:
         # cluster's own logging (WAL recovery, replication) is pointed
         # at it below. pg_cluster_logs() fetches it over ``log_fetch``.
         self.log_ring = _olog.LogRing(node="dn")
+        # this process's span ring (obs/tracectx.py): fragment
+        # executions, 2PC verbs, and WAL waits record here when the
+        # request carried a ``_trace`` header; the coordinator fetches
+        # it over the ``trace_fetch`` op and merges by trace_id —
+        # mirroring the log ring's log_fetch path. Node attribution
+        # happens at fetch time (this process does not know its mesh
+        # index, same as the log ring).
+        self.span_ring = _tctx.SpanRing(capacity=4096)
         self.standby = StandbyCluster(data_dir, num_datanodes, shard_groups)
         self.standby.cluster.log = self.log_ring
         # gids resolved by the replication stream (their 'G' frame was
@@ -184,6 +193,10 @@ class DNServer:
         _olog.set_thread_ring(self.log_ring)
         try:
             while not self._stop.is_set():
+                # failpoint at the DN's own frame boundary: a request
+                # torn between recv and dispatch (distinct from the
+                # shared net/protocol sites, which fire for every peer)
+                FAULT("dn/serve")
                 msg = recv_frame(conn)
                 if msg is None:
                     break
@@ -232,6 +245,23 @@ class DNServer:
         return act
 
     def _dispatch(self, msg: dict) -> dict:
+        # cross-node tracing: an optional ``_trace`` header binds the
+        # statement's trace context to THIS service thread for the
+        # request — the same per-thread binding the log ring uses — so
+        # fragment/2PC/WAL-wait spans land in our span ring already
+        # stitched to the coordinator's trace. No header = no binding =
+        # zero tracing cost (the trace_queries=off contract, enforced
+        # cross-process by the SpanRing.allocations test).
+        hdr = msg.get("_trace")
+        if hdr is None:
+            return self._dispatch_inner(msg)
+        prev = _tctx.bind(_tctx.from_header(hdr))
+        try:
+            return self._dispatch_inner(msg)
+        finally:
+            _tctx.bind(prev)
+
+    def _dispatch_inner(self, msg: dict) -> dict:
         op = msg.get("op")
         # fault-control ops answer even on a 'crashed' node: the chaos
         # harness must always be able to clear its own faults (the
@@ -263,6 +293,18 @@ class DNServer:
                 float(msg.get("since_ts") or 0.0),
             )
             return {"ok": True, "rows": [list(r) for r in rows]}
+        if op == "trace_fetch":
+            # ship this node's span ring to the coordinator (the
+            # pg_export_traces merge) — log_fetch's sibling, same
+            # below-the-crashed-gate placement on purpose: a dead node
+            # ships nothing until it is revived
+            return {
+                "ok": True,
+                "rows": self.span_ring.rows(
+                    trace_ids=msg.get("trace_ids"),
+                    since_ts=float(msg.get("since_ts") or 0.0),
+                ),
+            }
         self._failpoint("dn/dispatch", op=op)
         if op == "cancel_fragment":
             tok = str(msg.get("token") or "")
@@ -359,6 +401,22 @@ class DNServer:
             pass
 
     def _twophase_prepare(self, msg: dict) -> dict:
+        # 2PC verbs are trace-visible: the durable-vote fsync and the
+        # decision apply are exactly the commit-path costs an operator
+        # needs attributed when a distributed commit stalls
+        ctx = _tctx.current()
+        if ctx is None:
+            return self._twophase_prepare_inner(msg)
+        t0 = time.time()
+        try:
+            return self._twophase_prepare_inner(msg)
+        finally:
+            self.span_ring.record(
+                ctx, "2pc_prepare", "2pc", t0, time.time(),
+                gid=str(msg.get("gid")),
+            )
+
+    def _twophase_prepare_inner(self, msg: dict) -> dict:
         import json
         import os
 
@@ -400,6 +458,19 @@ class DNServer:
         return {"ok": True}
 
     def _twophase_finish(self, msg: dict, committed: bool) -> dict:
+        ctx = _tctx.current()
+        if ctx is None:
+            return self._twophase_finish_inner(msg, committed)
+        t0 = time.time()
+        try:
+            return self._twophase_finish_inner(msg, committed)
+        finally:
+            self.span_ring.record(
+                ctx, "2pc_commit" if committed else "2pc_abort", "2pc",
+                t0, time.time(), gid=str(msg.get("gid")),
+            )
+
+    def _twophase_finish_inner(self, msg: dict, committed: bool) -> dict:
         import json
         import os
 
@@ -678,15 +749,22 @@ class DNServer:
         return False
 
     def _exec_fragment(self, msg: dict) -> dict:
-        from opentenbase_tpu.executor.local import LocalExecutor
-        from opentenbase_tpu.plan import serde
-
         node = int(msg["node"])
         with self._stats_mu:
             self._inflight += 1
+        ctx = _tctx.current()
+        t0 = time.time() if ctx is not None else 0.0
+        rows = None
         try:
-            return self._exec_fragment_inner(msg, node)
+            out = self._exec_fragment_inner(msg, node)
+            rows = out.get("rows") if isinstance(out, dict) else None
+            return out
         finally:
+            if ctx is not None:
+                self.span_ring.record(
+                    ctx, "exec_fragment", "fragment", t0, time.time(),
+                    node=node, rows=rows,
+                )
             with self._stats_mu:
                 self._inflight -= 1
 
@@ -710,13 +788,31 @@ class DNServer:
                 )
 
         min_lsn = int(msg.get("min_lsn", 0))
-        if min_lsn and not self._wait_applied(
-            min_lsn, cancelled=cancelled
-        ):
-            if cancelled():
-                self._bump("fragments_cancelled")
-                return {"error": "fragment canceled by coordinator"}
-            return {"error": "replication lag: wal position not reached"}
+        if min_lsn:
+            # a real WAL wait (replay behind the coordinator's write
+            # position) is trace-visible: the remote_apply stall shows
+            # on the query's cross-node critical path, not just as
+            # mystery latency. Recorded only when we actually parked —
+            # the caught-up fast path records nothing.
+            ctx = _tctx.current()
+            waited_from = (
+                time.time()
+                if ctx is not None and self.standby.applied < min_lsn
+                else None
+            )
+            ok = self._wait_applied(min_lsn, cancelled=cancelled)
+            if waited_from is not None:
+                self.span_ring.record(
+                    ctx, "wal_wait", "wal", waited_from, time.time(),
+                    min_lsn=min_lsn, applied=self.standby.applied,
+                )
+            if not ok:
+                if cancelled():
+                    self._bump("fragments_cancelled")
+                    return {"error": "fragment canceled by coordinator"}
+                return {
+                    "error": "replication lag: wal position not reached"
+                }
         from opentenbase_tpu import types as t
 
         plan = serde.loads_plan(msg["plan"])

@@ -44,6 +44,7 @@ from opentenbase_tpu.catalog.shardmap import ShardMap
 from opentenbase_tpu.executor.dist import DistExecutor, concat_batches
 from opentenbase_tpu.executor.local import LocalExecutor
 from opentenbase_tpu.gtm import GTSServer
+from opentenbase_tpu.obs import tracectx as _tctx
 from opentenbase_tpu.lmgr import (
     DeadlockError,
     LockManager,
@@ -285,6 +286,14 @@ class Cluster:
         self.tracer = Tracer()
         self.metrics = MetricsRegistry()
         self.waits = WaitEventRegistry()
+        # GTS round-trips are waits too (the gap PR 2 left): the native
+        # client records GTM/GtsWait into this registry so commit-path
+        # stalls attribute to the GTM instead of vanishing
+        if hasattr(self.gts, "wait_registry"):
+            self.gts.wait_registry = self.waits
+        # device-platform watchdog bookkeeping: the platform the last
+        # fused run actually executed on (pg_cluster_health's cn0 row)
+        self._last_device_platform: Optional[str] = None
         # structured server log (obs/log.py): the coordinator writes to
         # the process-default ring (a DN server process rebinds its own);
         # pg_cluster_logs() merges this ring with every DN's and the GTM's
@@ -559,6 +568,7 @@ class Cluster:
                             self.catalog, self.stores
                         )
                         plat = self._fused.platform()
+                        self._last_device_platform = plat
                         import os as _os
 
                         if plat != "tpu" and _os.environ.get(
@@ -667,6 +677,45 @@ class Cluster:
             except Exception:
                 h["ok"] = False
         return self._dn_health
+
+    def collect_remote_spans(self, trace_ids) -> dict:
+        """Per-node span records for ``trace_ids``: every attached DN
+        server process ships its span ring over the ``trace_fetch``
+        protocol op (log_fetch's sibling), and the GTM's ring is read
+        in-process. Rows are labeled with the coordinator's node name
+        for the channel, exactly like the log merge — the DN process
+        does not know its mesh index."""
+        out: dict[str, list] = {}
+        ids = sorted(trace_ids)
+        if not ids:
+            return out
+        for n, ch in sorted(
+            (getattr(self, "dn_channels", None) or {}).items()
+        ):
+            try:
+                resp = ch.rpc({"op": "trace_fetch", "trace_ids": ids})
+            except Exception:
+                continue  # an unreachable DN ships nothing — its
+                # failure is visible in pg_cluster_health instead
+            rows = resp.get("rows") or []
+            if rows:
+                out.setdefault(f"dn{n}", []).extend(rows)
+        ring = getattr(self.gts, "span_ring", None)
+        if ring is not None:
+            rows = ring.rows(trace_ids=ids)
+        else:
+            # wire GTM client (NativeGTS): the spans live in the GTM
+            # server process — fetch them over OP_TRACE_FETCH (a C++
+            # native server records none and yields [])
+            fetch = getattr(self.gts, "fetch_spans", None)
+            try:
+                rows = fetch(ids) if fetch is not None else []
+            except Exception:
+                rows = []  # an unreachable GTM ships nothing — its
+                # failure is visible in pg_cluster_health instead
+        if rows:
+            out.setdefault("gtm0", []).extend(rows)
+        return out
 
     def session(self) -> "Session":
         s = Session(self)
@@ -1352,8 +1401,13 @@ class Session:
                 self.last_query, self.session_id
             )
         prev_trace = self._trace
+        prev_ctx = None
         if trace is not None:
             self._trace = trace
+            # cross-node identity (obs/tracectx.py): bind the trace's
+            # context for the statement so every wire client on this
+            # thread — DN channels, the GTM client — propagates it
+            prev_ctx = _tctx.bind(trace.ctx)
         try:
             results = []
             t_p0 = _time.perf_counter()
@@ -1447,6 +1501,7 @@ class Session:
         finally:
             self._trace = prev_trace
             if trace is not None:
+                _tctx.bind(prev_ctx)
                 self.cluster.tracer.finish(trace)
             self.state = "idle" if self.txn is None else "idle in transaction"
 
@@ -1637,12 +1692,20 @@ class Session:
 
         results: dict[int, dict] = {}
         errors: list = []
+        # cross-node tracing: the fan-out threads inherit no thread-
+        # local binding — carry the statement's context across so the
+        # DN-side 2PC spans stitch to it (executor/dist does the same
+        # per fragment attempt)
+        ctx = _tctx.current()
 
         def send(n, ch):
+            prev = _tctx.bind(ctx)
             try:
                 results[n] = ch.rpc({"op": op, "gid": gid, **extra})
             except Exception as e:  # channel failure = vote failure
                 errors.append((n, e))
+            finally:
+                _tctx.bind(prev)
 
         if len(targets) == 1:
             send(*targets[0])
@@ -3337,14 +3400,16 @@ class Session:
 
     def _pg_export_traces(self, e: A.FuncCall) -> Result:
         """pg_export_traces([last_n]) — the cluster's recent query
-        traces as one Chrome-trace-format JSON document (what the
-        otb_trace CLI fetches over the wire)."""
+        traces merged with every reachable node's span ring into one
+        Chrome-trace-format JSON document: pid = node (cn0/dnN/gtm0),
+        spans joined by trace_id (what the otb_trace CLI fetches over
+        the wire)."""
         import json as _json
 
-        from opentenbase_tpu.obs.export import chrome_trace
+        from opentenbase_tpu.obs.export import export_chrome_trace
 
         n = int(self._const_arg(e.args[0])) if e.args else 20
-        doc = chrome_trace(self.cluster.tracer.last(n))
+        doc = export_chrome_trace(self.cluster, last=n)
         return Result(
             "SELECT", [(_json.dumps(doc),)], ["trace"], 1
         )
@@ -4291,6 +4356,11 @@ class Session:
 
         t0 = _time.perf_counter()
         self._fused_host_ms = 0.0
+        # watchdog bookkeeping: _try_fused_inner records which path
+        # produced the output (the DAG runner stamps its own runs; the
+        # single-fragment path stamps below) — session-local, so
+        # concurrent sessions' runs can't be misattributed
+        self._fused_via_dag = False
         with compile_window() as cw:
             out = self._try_fused_inner(dplan, snapshot)
         if out is None:
@@ -4306,6 +4376,7 @@ class Session:
             "host_ms": host_ms,
         }
         fx = self.cluster._fused
+        run_platform = None
         if fx is not None:
             # shared executor state: concurrent sessions finish fused
             # queries in parallel, so totals accumulate under the
@@ -4323,17 +4394,29 @@ class Session:
                     phases["join_modes"] = ",".join(
                         dag.last_join_modes
                     )
+                # device-platform watchdog: the DAG runner stamped its
+                # own run; the single-fragment path stamps here — one
+                # note per successful fused statement either way
+                run_platform = (
+                    fx.last_run_platform if self._fused_via_dag
+                    else fx.note_run_platform()
+                )
+            self.cluster._last_device_platform = run_platform
         # phase metrics flow through the per-statement accumulator only
         # (folded into the histograms once, at statement end)
         self._note_phase("compile", compile_ms)
         self._note_phase("device", device_ms)
         self._note_phase("host", host_ms)
         if self._trace is not None:
+            # the platform this run ACTUALLY executed on rides the
+            # trace (the r04/r05 forensics that used to need a bench
+            # JSON post-mortem)
             self._trace.record(
                 "fused device execution", "fused", t0, t1,
                 compile_ms=round(compile_ms, 3),
                 device_ms=round(device_ms, 3),
                 host_ms=round(host_ms, 3),
+                platform=run_platform,
             )
         return out, phases
 
@@ -4364,6 +4447,18 @@ class Session:
         except (TypeError, ValueError):
             fx.device_memory_limit = 0
         fx.enable_pallas_join = self.gucs.get("enable_pallas_join")
+        # device-platform watchdog expectation: the GUC overrides the
+        # env-derived default ('tpu' when a TPU tunnel is configured),
+        # so a test box can force the demotion signal deterministically;
+        # '' (the default / RESET) restores the env-inferred value —
+        # the watchdog must be switch-off-able without an executor
+        # recycle
+        exp_plat = str(
+            self.gucs.get("expected_device_platform", "") or ""
+        )
+        fx.expected_platform = (
+            exp_plat or fx.env_expected_platform
+        )
 
         # pallas single-pass kernel: default-on on real TPU backends,
         # opt-in elsewhere (interpret mode is for tests, not speed)
@@ -4388,6 +4483,7 @@ class Session:
                     )
                     if res is not None:
                         final_idx, out = res
+                        self._fused_via_dag = True
                 if out is None and len(dplan.fragments) == 1:
                     out = fx.fragment_output(
                         dplan.fragments[0],
@@ -4406,6 +4502,7 @@ class Session:
                     if res is None:
                         return None
                     final_idx, out = res
+                    self._fused_via_dag = True
                 if out is None:
                     return None
         except FusedUnsupported:
@@ -6769,11 +6866,13 @@ class Session:
 
             # EXPLAIN ANALYZE always traces its statement, GUC or not
             own_trace = None
+            own_prev_ctx = None
             if self._trace is None:
                 own_trace = self.cluster.tracer.start(
                     self.last_query, self.session_id
                 )
                 self._trace = own_trace
+                own_prev_ctx = _tctx.bind(own_trace.ctx)
             try:
                 snapshot = self._snapshot()
                 t0 = _time.perf_counter()
@@ -6784,6 +6883,7 @@ class Session:
             finally:
                 if own_trace is not None:
                     self._trace = None
+                    _tctx.bind(own_prev_ctx)
                     self.cluster.tracer.finish(own_trace)
             lines.append("")
             if info["mode"] == "fused":
@@ -7378,6 +7478,16 @@ def _sv_fused(c: Cluster):
             rows.append(("unsupported", r))
     for d in fx.dag_demotions:
         rows.append(("demoted", d))
+    # device-platform watchdog: what the last run executed on, what the
+    # cluster is configured to expect, and how many runs fell short
+    if getattr(fx, "last_run_platform", None):
+        rows.append(("last_run_platform", str(fx.last_run_platform)))
+    if getattr(fx, "expected_platform", ""):
+        rows.append(("expected_platform", str(fx.expected_platform)))
+    rows.append(
+        ("platform_demotions",
+         str(int(getattr(fx, "platform_demotions", 0))))
+    )
     zs = getattr(fx, "zone_stats", None)
     if zs and zs.get("total_blocks"):
         rows.append(("zone_pruned_blocks", str(zs["pruned_blocks"])))
@@ -7604,11 +7714,15 @@ def _sv_cluster_health(c: Cluster):
     from opentenbase_tpu import fault as _fault
 
     rows = []
-    # coordinator: always this process; its armed faults are local
+    # coordinator: always this process; its armed faults are local.
+    # device_platform is the platform the LAST fused run actually
+    # executed on (the watchdog's stamp) — a tunnel loss shows here in
+    # one view instead of only in a bench JSON post-mortem.
     active = sum(1 for s in c.sessions if s.state == "active")
     rows.append((
         "cn0", "coordinator", True, 0.0, 0, active,
         len(_fault.armed()),
+        getattr(c, "_last_device_platform", None) or "",
     ))
     try:
         gts_ok = (
@@ -7617,7 +7731,7 @@ def _sv_cluster_health(c: Cluster):
         )
     except Exception:
         gts_ok = False
-    rows.append(("gtm0", "gtm", bool(gts_ok), 0.0, 0, 0, 0))
+    rows.append(("gtm0", "gtm", bool(gts_ok), 0.0, 0, 0, 0, ""))
     chans = getattr(c, "dn_channels", None) or {}
     if chans:
         c.probe_datanodes()
@@ -7627,7 +7741,7 @@ def _sv_cluster_health(c: Cluster):
         h = c._dn_health.get(n)
         if n not in chans:
             # in-process data plane: the DN *is* this process
-            rows.append((f"dn{n}", "datanode", True, 0.0, 0, 0, 0))
+            rows.append((f"dn{n}", "datanode", True, 0.0, 0, 0, 0, ""))
             continue
         up = bool(h and h.get("ok"))
         ok_ts = (h or {}).get("ok_ts")
@@ -7638,6 +7752,7 @@ def _sv_cluster_health(c: Cluster):
             lag if up else -1,
             int((h or {}).get("inflight") or 0) if up else 0,
             int((h or {}).get("armed_faults") or 0) if up else 0,
+            "",
         ))
     return rows
 
@@ -8017,6 +8132,9 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
             "replication_lag_bytes": t.INT8,
             "inflight_fragments": t.INT8,
             "armed_faults": t.INT8,
+            # the device-platform watchdog's stamp: what the last fused
+            # run executed on (cn0 row; '' elsewhere / before any run)
+            "device_platform": t.TEXT,
         },
         _sv_cluster_health,
     ),

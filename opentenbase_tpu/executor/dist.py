@@ -377,10 +377,19 @@ class DistExecutor:
             def run_remote(node):
                 from opentenbase_tpu.fault import FAULT
                 from opentenbase_tpu.net.pool import ChannelError
+                from opentenbase_tpu.obs import tracectx as _tctx
 
                 t0 = _time.perf_counter()
                 retries = 0
                 failover = False
+                # cross-node tracing: this dispatch thread has no
+                # inherited binding — each ATTEMPT gets a child context
+                # of the statement's root, bound around the RPC so the
+                # DN-side spans parent to the attempt that carried them
+                base_ctx = (
+                    self.trace.ctx if self.trace is not None else None
+                )
+                actx = None
                 # a fragment whose inputs were peer-exchanged (or that
                 # produces a peer motion) must not re-execute: exchange
                 # parts pop on consumption, so a second attempt would
@@ -392,6 +401,10 @@ class DistExecutor:
                 )
                 try:
                     while True:
+                        t_a0 = _time.perf_counter()
+                        if base_ctx is not None:
+                            actx = base_ctx.child()
+                        prev_ctx = _tctx.bind(actx)
                         try:
                             # coordinator-side failpoint: fails THIS
                             # dispatch attempt the way a dead channel
@@ -413,6 +426,23 @@ class DistExecutor:
                             )
                             break
                         except ChannelError as ce:
+                            if self.trace is not None:
+                                # the failed attempt is its own child
+                                # span, tagged with the attempt number —
+                                # a chaos trace shows WHICH try died and
+                                # what the retry cost
+                                self.trace.record(
+                                    f"fragment {frag.index} attempt "
+                                    f"{retries + 1} @ dn{node}",
+                                    "attempt", t_a0,
+                                    _time.perf_counter(),
+                                    span_id=(
+                                        actx.span_id
+                                        if actx is not None else None
+                                    ),
+                                    attempt=retries + 1, node=node,
+                                    error=str(ce)[:120],
+                                )
                             # bounded-backoff retry (reads only — which
                             # is everything that reaches this loop),
                             # then failover below; never past the
@@ -479,6 +509,8 @@ class DistExecutor:
                                 finally:
                                     if wt is not None:
                                         self.waits.end(wt)
+                        finally:
+                            _tctx.bind(prev_ctx)
                     if batch is not None:
                         outs[node] = batch
                     t1 = _time.perf_counter()
@@ -495,10 +527,18 @@ class DistExecutor:
                         instr["failover"] = "local"
                     self.instrumentation.append(instr)
                     if self.trace is not None:
+                        # the winning attempt's span id is what DN-side
+                        # spans parent to — the cross-node edge
                         self.trace.record(
                             f"fragment {frag.index} @ dn{node}",
                             "fragment", t0, t1, rows=rows,
                             remote=not failover,
+                            span_id=(
+                                actx.span_id if actx is not None
+                                else None
+                            ),
+                            attempt=retries + 1,
+                            failover="local" if failover else None,
                         )
                 except Exception as e:
                     errors.append(e)

@@ -674,6 +674,12 @@ def enable_compile_cache() -> Optional[str]:
 # executors to free device residency.
 PALLAS_DEMOTIONS_TOTAL = [0]
 
+# Process-lifetime platform-demotion count (the r04/r05 class: a cluster
+# configured for TPU silently answering from CPU). Module-level for the
+# same reason as the pallas total — the exporter's counter must stay
+# monotone across executor recycles.
+PLATFORM_DEMOTIONS_TOTAL = [0]
+
 
 class FusedExecutor:
     """Compiles eligible partial-agg fragments to one shard_map program."""
@@ -707,6 +713,26 @@ class FusedExecutor:
         # feeds the exporter (the bounded list clamps at 64).
         self.dag_demotions: list[str] = []
         self.dag_demotion_count = 0
+        # device-platform watchdog (ROADMAP open item 1's prerequisite):
+        # r04/r05 ran platform=cpu for a TPU-configured cluster and the
+        # only warning fired ONCE at executor creation. Every run now
+        # stamps the platform it actually executed on; a mismatch with
+        # the configured expectation bumps a counter and elogs the
+        # FIRST time it happens mid-run, so a tunnel loss is observable
+        # within one statement instead of at bench time. The
+        # expectation defaults from the TPU-tunnel env; the
+        # expected_device_platform GUC overrides per cluster.
+        import os as _os
+
+        # env-inferred default kept separately so the GUC apply site
+        # can RESTORE it when the GUC resets to '' (infer)
+        self.env_expected_platform = (
+            "tpu" if _os.environ.get("PALLAS_AXON_POOL_IPS") else ""
+        )
+        self.expected_platform = self.env_expected_platform
+        self.last_run_platform: Optional[str] = None
+        self.platform_demotions = 0  # monotone counter (exporter)
+        self._platform_warned = False
         # zone-map pruning on the DEVICE path (VERDICT r2 missing-5):
         # blocks excluded from the scanned window per fused query
         self.zone_stats = {"pruned_blocks": 0, "total_blocks": 0}
@@ -761,6 +787,32 @@ class FusedExecutor:
             return str(self.mesh.devices.flat[0].platform)
         except Exception:
             return "unknown"
+
+    def note_run_platform(self) -> str:
+        """Watchdog: stamp the platform THIS run actually executed on.
+        Called once per successful fused run (DagRunner._run for DAG
+        plans, the engine's fused wrapper for single-fragment ones).
+        A run on anything but the configured platform bumps the
+        demotion counters and elogs a warning the first time — the
+        continuous signal the one-shot creation warning never gave."""
+        plat = self.platform()
+        self.last_run_platform = plat
+        expected = self.expected_platform
+        if expected and plat != expected:
+            self.platform_demotions += 1
+            PLATFORM_DEMOTIONS_TOTAL[0] += 1
+            if not self._platform_warned:
+                self._platform_warned = True
+                from opentenbase_tpu.obs.log import elog
+
+                elog(
+                    "warning", "device",
+                    f"device platform demoted: cluster configured for "
+                    f"'{expected}' but this run executed on '{plat}' "
+                    "(tunnel down?)",
+                    demotions=self.platform_demotions,
+                )
+        return plat
 
     # -- eligibility -----------------------------------------------------
     def fragment_output(

@@ -1554,6 +1554,11 @@ class DagRunner:
         self.last_frag_ms = frag_ms
         self.last_join_modes = tuple(sorted(self._mode_notes))
         self.completed += 1
+        # device-platform watchdog: every completed DAG run stamps the
+        # platform it actually executed on (executor/fused.py) — the
+        # r04/r05 silent-CPU class fires a counter + warning here, not
+        # at the next bench read
+        self.fx.note_run_platform()
         return final.index, batch
 
     def note_join_mode(self, ji: int, mode: str) -> None:

@@ -45,6 +45,19 @@ OP_PING = 0x0D
 OP_NODE_REGISTER = 0x0E
 OP_NODE_UNREGISTER = 0x0F
 OP_NODE_LIST = 0x10
+# cross-node tracing envelope: payload = length-prefixed traceparent
+# header + inner op byte + inner payload. The python GTSFrontend
+# (gtm/server.py) unwraps it, binds the context for the request, and
+# dispatches the inner op; the C++ native server predates the envelope
+# and answers status 1 — the client probes once and falls back to bare
+# ops for the rest of the connection (traces then lack GTM-side spans,
+# but every grant still answers).
+OP_TRACED = 0x11
+# fetch the GTM's span ring (dn/server's trace_fetch for the GTM wire):
+# request payload = JSON list of trace ids, reply = JSON list of span
+# records. The C++ native server answers status 1 → the client returns
+# no spans (it records none anyway).
+OP_TRACE_FETCH = 0x12
 
 
 def _lp(s: str) -> bytes:
@@ -53,6 +66,10 @@ def _lp(s: str) -> bytes:
 
 
 def _recv_exact_from(sock: socket.socket, n: int) -> bytes:
+    from opentenbase_tpu.fault import FAULT
+
+    # failpoint: the GTM reply stream stalling/vanishing mid-frame
+    FAULT("gtm/client/recv")
     out = b""
     while len(out) < n:
         chunk = sock.recv(n - len(out))
@@ -134,6 +151,15 @@ class NativeGTS:
         # reachable again
         self._primary: tuple = (self.host, self.port)
         self.failovers = 0
+        # wait-event attribution (obs/waits.py): the engine points this
+        # at its registry so every GTS round-trip — including failover
+        # retries — lands in pg_stat_wait_events as GTM/GtsWait instead
+        # of vanishing from the commit path's accounting
+        self.wait_registry = None
+        # OP_TRACED capability: None = unprobed, True = the server
+        # unwraps trace envelopes (python GTSFrontend), False = bare
+        # ops only (the C++ native server)
+        self._traced_capable: Optional[bool] = None
 
     def set_standby(self, host: str, port: int) -> None:
         """Point failover at a (promoted) standby's wire frontend —
@@ -187,21 +213,86 @@ class NativeGTS:
 
     # -- wire ------------------------------------------------------------
     def _rpc(self, op: int, payload: bytes = b"") -> bytes:
-        msg = struct.pack("<IB", 1 + len(payload), op) + payload
-        with self._lock:
-            try:
-                self._sock.sendall(msg)
-                hdr = self._recv_exact(4)
-                (length,) = struct.unpack("<I", hdr)
-                body = self._recv_exact(length)
-            except (OSError, GTSProtocolError) as e:
-                # primary loss mid-exchange: fail over instead of
-                # erroring the session (gtm.c reconnects the same way)
-                body = self._failover_rpc(msg, e)
+        from opentenbase_tpu.fault import FAULT
+        from opentenbase_tpu.obs import tracectx as _tctx
+
+        ctx = _tctx.current()
+        # bare frame kept for failover: the standby may be a different
+        # implementation (C++ native) that rejects the trace envelope —
+        # the retried request must replay UNWRAPPED so the grant still
+        # answers (that one request just loses its GTM-side span)
+        bare = struct.pack("<IB", 1 + len(payload), op) + payload
+        msg = bare
+        # the round trip is a real wait: the backend is parked on the
+        # GTM until the grant answers (wait_event GTM/GtsWait) — the
+        # token spans failover retries too, so a primary-loss stall
+        # attributes to the GTM rather than vanishing
+        wr = self.wait_registry
+        token = (
+            wr.begin(None, "GTM", "GtsWait") if wr is not None else None
+        )
+        try:
+            with self._lock:
+                if ctx is not None and ctx.sampled:
+                    if self._traced_capable is None:
+                        self._probe_traced_locked()
+                    if self._traced_capable:
+                        msg = self._wrap_traced(ctx, op, payload)
+                try:
+                    # failpoint: the GTM request boundary every grant
+                    # crosses (delay = a slow GTM from one backend's view)
+                    FAULT("gtm/client/rpc", op=op)
+                    self._sock.sendall(msg)
+                    hdr = self._recv_exact(4)
+                    (length,) = struct.unpack("<I", hdr)
+                    body = self._recv_exact(length)
+                except (OSError, GTSProtocolError) as e:
+                    # primary loss mid-exchange: fail over instead of
+                    # erroring the session (gtm.c reconnects the same way)
+                    body = self._failover_rpc(bare, e)
+        finally:
+            if token is not None:
+                wr.end(token)
         status = body[0]
         if status != 0:
             raise GTSProtocolError(f"op {op:#x} failed")
         return body[1:]
+
+    @staticmethod
+    def _wrap_traced(ctx, op: int, payload: bytes) -> bytes:
+        inner = _lp(ctx.to_header()) + bytes([op]) + payload
+        return struct.pack("<IB", 1 + len(inner), OP_TRACED) + inner
+
+    def _probe_traced_locked(self) -> None:
+        """One OP_TRACED(PING) exchange decides whether this server
+        unwraps trace envelopes. Caller holds the lock. A C++ native
+        server answers status 1 (unknown op) without dropping the
+        connection; any I/O failure also resolves to 'no' — the next
+        real RPC takes the ordinary failover path."""
+        from opentenbase_tpu.obs.tracectx import TraceContext
+
+        from opentenbase_tpu.fault import FAULT
+
+        probe = self._wrap_traced(TraceContext.new(), OP_PING, b"")
+        try:
+            # failpoint: the capability probe is its own boundary — a
+            # drop here must resolve to 'bare ops', never hang tracing
+            FAULT("gtm/client/probe")
+            self._sock.sendall(probe)
+            hdr = self._recv_exact(4)
+            (length,) = struct.unpack("<I", hdr)
+            body = self._recv_exact(length)
+            self._traced_capable = body[:1] == b"\x00"
+        except (OSError, GTSProtocolError):
+            self._traced_capable = False
+            # the probe's reply may still be in flight: this socket is
+            # desynced, and the next bare request would read the probe
+            # reply as its own. Kill it — the caller's sendall then
+            # fails into _failover_rpc, which reconnects fresh.
+            try:
+                self._sock.close()
+            except OSError:
+                pass
 
     def _failover_rpc(self, msg: bytes, err: Exception) -> bytes:
         """Reconnect — primary first (covers a fast restart), then the
@@ -210,8 +301,12 @@ class NativeGTS:
         grants are fresh values, commit/abort/forget/prepare are
         idempotent per gxid, and a twice-begun gxid merely burns a
         number (the reference's reconnect-retry accepts the same)."""
+        from opentenbase_tpu.fault import FAULT
         from opentenbase_tpu.net.client import connect_with_retry
 
+        # failpoint: the reconnect-and-retry ladder itself (a standby
+        # that also dies mid-failover)
+        FAULT("gtm/client/failover")
         candidates = [(self.host, self.port)]
         for cand in (self._primary, self._standby):
             if cand is not None and cand not in candidates:
@@ -221,7 +316,18 @@ class NativeGTS:
                 sock = connect_with_retry(
                     host, port, timeout=10, retries=1
                 )
-            except Exception:
+            except Exception as e:
+                # candidate unreachable: try the next one — logged at
+                # debug (dropped by default) so the sweep stays visible
+                # without spamming the ring; the all-candidates-dead
+                # terminal path below elogs at error
+                from opentenbase_tpu.obs.log import elog
+
+                elog(
+                    "debug", "gtm",
+                    f"GTM failover candidate {host}:{port} "
+                    f"unreachable: {e!r:.120}",
+                )
                 continue
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             try:
@@ -253,6 +359,10 @@ class NativeGTS:
                 )
                 self.host, self.port = host, port
                 self.failovers += 1
+                # the new endpoint may be a different implementation
+                # (python frontend vs C++ server): re-probe OP_TRACED
+                # support on the next traced request
+                self._traced_capable = None
             return body
         from opentenbase_tpu.obs.log import elog as _elog
 
@@ -343,6 +453,25 @@ class NativeGTS:
 
     def txn(self, gxid: int) -> Optional[TxnInfo]:
         return self._txns.get(gxid)
+
+    # -- cross-node tracing ----------------------------------------------
+    def fetch_spans(self, trace_ids) -> list:
+        """The GTM's span-ring rows for ``trace_ids`` (the coordinator's
+        trace merge over the wire). A server without the op — the C++
+        native one, which records no spans — yields []."""
+        import json as _json
+
+        try:
+            body = self._rpc(
+                OP_TRACE_FETCH,
+                _json.dumps(sorted(trace_ids)).encode(),
+            )
+        except GTSProtocolError:
+            return []
+        try:
+            return _json.loads(body.decode())
+        except ValueError:
+            return []
 
     # -- node registration (register_gtm.c client side) -------------------
     def register_node(

@@ -24,6 +24,7 @@ wraps the same object in a TCP protocol for multi-host deployments.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import threading
@@ -31,6 +32,33 @@ import time
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, Optional
+
+from opentenbase_tpu.obs import tracectx as _tctx
+
+
+def _traced_grant(op: str):
+    """Record one GTM grant span into the server's span ring when the
+    calling thread carries a trace context (in-process backends bind it
+    for the statement; gtm/server.py's OP_TRACED wrapper binds it for
+    wire backends).  Untraced grants pay one getattr — the per-grant
+    hot path stays allocation-free, like the unlogged grant path."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            ctx = _tctx.current()
+            if ctx is None or not ctx.sampled:
+                return fn(self, *args, **kwargs)
+            t0 = time.time()
+            try:
+                return fn(self, *args, **kwargs)
+            finally:
+                self.span_ring.record(
+                    ctx, op, "gts", t0, time.time(),
+                )
+        return wrapper
+
+    return deco
 
 GlobalTimestamp = int
 
@@ -152,6 +180,12 @@ class GTSServer:
         from opentenbase_tpu.obs.log import LogRing
 
         self.log_ring = LogRing(node="gtm0")
+        # the GTM's span ring (obs/tracectx.py): traced statements'
+        # grants (GTS/begin/commit/prepare) record here so the commit
+        # path's ordering cost shows on the query's cross-node trace —
+        # pg_export_traces() merges it with the coordinator's and every
+        # DN's, the way pg_cluster_logs() merges the log rings
+        self.span_ring = _tctx.SpanRing(capacity=4096)
         # sequence durability (gtm_store.c): state file beside the clock
         # store, written log-ahead (SEQ_LOG_VALS-style: the persisted
         # next_value runs ahead of the issued one, so a crash skips at
@@ -244,16 +278,19 @@ class GTSServer:
         os.replace(tmp, self._seq_path)
 
     # -- timestamps -----------------------------------------------------
+    @_traced_grant("gts_grant")
     def get_gts(self) -> GlobalTimestamp:
         """GetGlobalTimestampGTM (src/backend/access/transam/gtm.c:1477)."""
         return self.clock.next()
 
+    @_traced_grant("gts_snapshot")
     def snapshot_ts(self) -> GlobalTimestamp:
         """Snapshot start timestamp: everything committed with
         commit_ts <= this is visible (snapshot.h:95 start_ts analog)."""
         return self.clock.next()
 
     # -- transactions ---------------------------------------------------
+    @_traced_grant("gts_begin")
     def begin(self) -> TxnInfo:
         with self._lock:
             gxid = self._next_gxid
@@ -265,6 +302,7 @@ class GTSServer:
             self._rep("begin", {"gxid": gxid})
             return info
 
+    @_traced_grant("gts_prepare")
     def prepare(self, gxid: int, gid: str, partnodes: tuple[int, ...]) -> None:
         with self._lock:
             info = self._txns.get(gxid)
@@ -280,6 +318,7 @@ class GTSServer:
             self._prepared[gid] = info
             self._rep("prepare", {"gxid": gxid, "gid": gid, "partnodes": list(partnodes)})
 
+    @_traced_grant("gts_commit")
     def commit(self, gxid: int) -> GlobalTimestamp:
         with self._lock:
             info = self._txns.get(gxid)

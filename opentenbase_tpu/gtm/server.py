@@ -93,6 +93,12 @@ class GTSFrontend:
             _olog.set_thread_ring(ring)
         try:
             while True:
+                from opentenbase_tpu.fault import FAULT
+
+                # failpoint: the GTM's own frame boundary (a backend
+                # severed between frames, distinct from gtm/grant which
+                # fires inside dispatch)
+                FAULT("gtm/server/serve")
                 head = self._recv_exact(conn, 4)
                 if head is None:
                     return
@@ -108,7 +114,7 @@ class GTSFrontend:
                     )
                 except ConnectionError:
                     return  # injected/real drop: sever without a reply
-                except Exception:
+                except Exception:  # otb_lint: ignore[except-swallow] -- not a swallow: the failure is delivered to the backend as a status-1 reply on the next line (the wire's error frame), matching the C++ server's contract
                     conn.sendall(struct.pack("<I", 1) + b"\x01")
         except OSError:
             return
@@ -123,6 +129,32 @@ class GTSFrontend:
     def _dispatch(self, op: int, p: bytes) -> bytes:
         from opentenbase_tpu.fault import FAULT
 
+        if op == C.OP_TRACED:
+            # cross-node tracing envelope: bind the carried context for
+            # the inner op (the grant loop's per-request binding, like
+            # the log-ring one in _serve) so GTSServer's traced grants
+            # record into the GTM span ring stitched to the statement.
+            # Unwrapped BEFORE the failpoint: the inner dispatch fires
+            # gtm/grant exactly once per grant, traced or not.
+            from opentenbase_tpu.obs import tracectx as _tctx
+
+            (hl,) = struct.unpack_from("<H", p, 0)
+            header = p[2 : 2 + hl].decode()
+            inner_op = p[2 + hl]
+            prev = _tctx.bind(_tctx.from_header(header))
+            try:
+                return self._dispatch(inner_op, p[3 + hl:])
+            finally:
+                _tctx.bind(prev)
+        if op == C.OP_TRACE_FETCH:
+            # ship the GTM's span ring to the coordinator (the DN's
+            # trace_fetch op, on the GTM wire): JSON in, JSON out
+            import json as _json
+
+            ring = getattr(self.gts, "span_ring", None)
+            ids = _json.loads(p.decode()) if p else None
+            rows = ring.rows(trace_ids=ids) if ring is not None else []
+            return _json.dumps(rows).encode()
         # failpoint: GTS grants and every other GTM verb. error = a
         # failed grant (the backend sees a protocol error and can fail
         # over, gtm/client.py); delay = a slow GTM; drop_conn tears this
@@ -224,6 +256,10 @@ class GTSFrontend:
 
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        from opentenbase_tpu.fault import FAULT
+
+        # failpoint: a backend vanishing mid-frame (torn reads)
+        FAULT("gtm/server/recv")
         out = b""
         while len(out) < n:
             chunk = conn.recv(n - len(out))

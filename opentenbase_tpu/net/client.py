@@ -172,8 +172,13 @@ class ClientSession:
             raise AuthError("server failed to prove identity")
 
     def execute(self, sql: str) -> WireResult:
+        from opentenbase_tpu.obs import tracectx as _tctx
+
         FAULT("net/client/send")
-        send_frame(self._sock, {"q": sql})
+        # a bound trace context follows the statement to the server
+        # (e.g. a coordinator driving a promoted-DN coordinator), so
+        # multi-hop statements still stitch into one trace
+        send_frame(self._sock, _tctx.inject({"q": sql}))
         FAULT("net/client/recv")
         resp = recv_frame(self._sock)
         if resp is None:

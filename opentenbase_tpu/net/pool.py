@@ -20,6 +20,7 @@ from opentenbase_tpu.net.protocol import (
     recv_frame,
     shutdown_and_close,
 )
+from opentenbase_tpu.obs import tracectx as _tctx
 
 
 class Channel:
@@ -51,6 +52,11 @@ class Channel:
         channel broken: a request with no response consumed leaves the
         stream desynced, and releasing it clean would hand the NEXT
         caller this call's stale response."""
+        # cross-node tracing (obs/tracectx.py): a thread-bound sampled
+        # context rides every frame as the optional ``_trace`` header,
+        # so DN-side spans stitch to the statement that caused them;
+        # untraced callers pay one getattr, no copy
+        msg = _tctx.inject(msg)
         frame = encode_frame(msg)  # may raise: channel untouched
         try:
             if timeout_s is not None:

@@ -209,6 +209,16 @@ class ClusterServer:
                 if sql is None:
                     send_frame(conn, {"error": "malformed request"})
                     continue
+                # cross-node tracing: a ``_trace`` header from the
+                # client binds for the statement (obs/tracectx.py), so
+                # work this server fans out parents to the caller's span
+                from opentenbase_tpu.obs import tracectx as _tctx
+
+                _hdr = msg.get("_trace")
+                _prev_ctx = (
+                    _tctx.bind(_tctx.from_header(_hdr))
+                    if _hdr else None
+                )
                 try:
                     # failpoint: statement dispatch. drop_conn tears the
                     # backend down mid-protocol (client sees a vanished
@@ -251,6 +261,9 @@ class ClusterServer:
                     if sqlstate:  # 53xxx sheds, 57014 timeouts, ...
                         frame["sqlstate"] = sqlstate
                     send_frame(conn, frame)
+                finally:
+                    if _hdr:
+                        _tctx.bind(_prev_ctx)
         except OSError:
             # the socket died under us — client vanished mid-frame, or
             # stop() force-disconnected this backend while a statement

@@ -9,6 +9,12 @@ distributed EXPLAIN ANALYZE) and the wait-event columns of
 - :mod:`opentenbase_tpu.obs.trace`   — nested spans over the query path
   (query → parse/plan/queue/execute → fragment → operator → motion),
   bounded in-memory ring, near-zero-cost when ``trace_queries = off``;
+- :mod:`opentenbase_tpu.obs.tracectx` — cross-node trace identity: a
+  W3C-traceparent-style context minted per statement, carried as an
+  optional ``_trace`` wire header, bound thread-locally on receiving
+  nodes, with a bounded per-node ``SpanRing`` (DN server processes and
+  the GTM) shipped back over the ``trace_fetch`` op and merged by
+  trace_id into one cross-node Chrome trace;
 - :mod:`opentenbase_tpu.obs.waits`   — cumulative + current wait events
   (locks, pool channels, WLM admission queues, remote-fragment RPCs);
 - :mod:`opentenbase_tpu.obs.metrics` — allocation-free fixed-bucket
@@ -33,12 +39,15 @@ from opentenbase_tpu.obs.log import LogRing, elog
 from opentenbase_tpu.obs.metrics import MetricsRegistry
 from opentenbase_tpu.obs.progress import ProgressRegistry
 from opentenbase_tpu.obs.trace import Tracer
+from opentenbase_tpu.obs.tracectx import SpanRing, TraceContext
 from opentenbase_tpu.obs.waits import WaitEventRegistry
 
 __all__ = [
     "LogRing",
     "MetricsRegistry",
     "ProgressRegistry",
+    "SpanRing",
+    "TraceContext",
     "Tracer",
     "WaitEventRegistry",
     "elog",

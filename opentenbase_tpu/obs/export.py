@@ -1,10 +1,20 @@
 """Chrome-trace-format export (chrome://tracing / Perfetto JSON).
 
-Spans already carry (ts, dur) in microseconds on one monotonic clock,
-so export is a flat dump of "X" (complete) events: one pid per query
-trace (Perfetto then lays queries out as separate process tracks), tid
-= the recording thread.  ``otb_trace`` and the ``pg_export_traces()``
-admin function both funnel through here.
+One merged cross-node document: pid = node (cn0/dnN/gtm0, named by
+``process_name`` metadata events so each node renders as its own
+process track), tid = the recording thread, and every span carries its
+``trace_id`` (plus ``span_id``/``parent_span_id`` where the producer
+recorded edges) in ``args`` — a query's true critical path reads as one
+causal story across the coordinator, the DN server processes that ran
+its fragments, and the GTM that ordered it.
+
+Clocks: coordinator spans record on ``time.perf_counter`` and shift by
+the trace's captured epoch offset; remote span rings
+(obs/tracectx.SpanRing) record epoch microseconds directly — so the
+merged timeline is the one epoch clock all localhost processes share.
+
+``otb_trace`` and the ``pg_export_traces()`` admin function both funnel
+through here.
 """
 
 from __future__ import annotations
@@ -12,43 +22,110 @@ from __future__ import annotations
 import json
 from typing import Optional
 
+# stable per-node pids: the coordinator and GTM get fixed small ids,
+# datanodes derive from their mesh index, anything else enumerates
+_FIXED_PIDS = {"cn0": 1, "gtm0": 2}
 
-def chrome_trace(traces) -> dict:
-    """The Chrome trace document for an iterable of QueryTraces."""
+
+def _node_pid(node: str, extra: dict) -> int:
+    pid = _FIXED_PIDS.get(node)
+    if pid is not None:
+        return pid
+    if node.startswith("dn"):
+        try:
+            return 10 + int(node[2:])
+        except ValueError:
+            pass
+    return extra.setdefault(node, 100 + len(extra))
+
+
+def chrome_trace(traces, remote_spans=None) -> dict:
+    """The Chrome trace document for an iterable of QueryTraces plus
+    optional per-node remote span rows (``remote_spans`` maps node name
+    -> list of obs/tracectx.SpanRing records, the ``trace_fetch``
+    payload)."""
     events: list[dict] = []
+    extra_pids: dict = {}
+    named: set = set()
+
+    def node_pid(node: str) -> int:
+        pid = _node_pid(node, extra_pids)
+        if node not in named:
+            named.add(node)
+            events.append({
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": node},
+            })
+        return pid
+
     for tr in traces:
-        pid = tr.qid
-        events.append({
-            "name": "process_name",
-            "ph": "M",
-            "pid": pid,
-            "tid": 0,
-            "args": {"name": f"q{tr.qid}: {tr.query[:120]}"},
-        })
+        pid = node_pid("cn0")
+        off = getattr(tr, "epoch_offset_us", 0.0)
+        trace_id = getattr(tr, "trace_id", None)
         with tr._mu:
             spans = list(tr.spans)
         for sp in spans:
+            args = dict(sp.args) if sp.args else {}
+            if trace_id is not None:
+                args["trace_id"] = trace_id
+            if sp.span_id:
+                args["span_id"] = sp.span_id
+            if sp.parent_id:
+                args["parent_span_id"] = sp.parent_id
             ev = {
                 "name": sp.name,
                 "cat": sp.cat,
                 "ph": "X",
-                "ts": round(sp.ts_us, 3),
+                "ts": round(sp.ts_us + off, 3),
                 "dur": round(sp.dur_us, 3),
                 "pid": pid,
                 "tid": sp.tid,
             }
-            if sp.args:
-                ev["args"] = sp.args
+            if args:
+                ev["args"] = args
             events.append(ev)
+    for node, rows in sorted((remote_spans or {}).items()):
+        pid = node_pid(node)
+        for r in rows:
+            trace_id, span_id, parent_id, name, cat = r[0], r[1], r[2], r[3], r[4]
+            ts_us, dur_us = float(r[5]), float(r[6])
+            tid = int(r[7]) if len(r) > 7 and r[7] is not None else 0
+            args = dict(r[8]) if len(r) > 8 and r[8] else {}
+            args["trace_id"] = trace_id
+            if span_id:
+                args["span_id"] = span_id
+            if parent_id:
+                args["parent_span_id"] = parent_id
+            events.append({
+                "name": str(name),
+                "cat": str(cat),
+                "ph": "X",
+                "ts": round(ts_us, 3),
+                "dur": round(dur_us, 3),
+                "pid": pid,
+                "tid": tid,
+                "args": args,
+            })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def export_chrome_trace(
     cluster, path: Optional[str] = None, last: Optional[int] = None
 ) -> dict:
-    """Export the cluster's most recent ``last`` traces (all when None);
-    writes JSON to ``path`` when given, returns the document."""
-    doc = chrome_trace(cluster.tracer.last(last))
+    """Export the cluster's most recent ``last`` traces (all when None)
+    merged with every reachable node's span ring; writes JSON to
+    ``path`` when given, returns the document."""
+    traces = cluster.tracer.last(last)
+    ids = {
+        tr.trace_id for tr in traces
+        if getattr(tr, "trace_id", None)
+    }
+    collect = getattr(cluster, "collect_remote_spans", None)
+    remote = collect(ids) if (collect is not None and ids) else None
+    doc = chrome_trace(traces, remote)
     if path is not None:
         with open(path, "w") as f:
             json.dump(doc, f)

@@ -222,6 +222,32 @@ def render_cluster_metrics(cluster) -> str:
             "otb_dag_demotions_total", {},
             int(getattr(fx, "dag_demotion_count", 0)),
         ))
+        if getattr(fx, "last_run_platform", None):
+            _head(out, "otb_device_last_run_platform", "gauge",
+                  "Platform the last fused run actually executed on "
+                  "(1 = active)")
+            out.append(_line(
+                "otb_device_last_run_platform",
+                {"platform": fx.last_run_platform}, 1,
+            ))
+
+    # device-platform watchdog counter: runs that executed on a platform
+    # other than the configured expectation (the r04/r05 tunnel_down
+    # class). Rendered from the process-lifetime total so the series
+    # stays monotone across executor recycles — and rendered whenever
+    # the fused module is loaded, even after cluster._fused was torn
+    # down, so the counter never vanishes from a scrape.
+    import sys as _sys
+
+    _fused_mod = _sys.modules.get("opentenbase_tpu.executor.fused")
+    if _fused_mod is not None:
+        _head(out, "otb_platform_demotions_total", "counter",
+              "Fused runs that executed on a platform other than the "
+              "configured one (tunnel_down watchdog)")
+        out.append(_line(
+            "otb_platform_demotions_total", {},
+            int(_fused_mod.PLATFORM_DEMOTIONS_TOTAL[0]),
+        ))
 
     # serving plane (serving/ + net/concentrator.py): cache counters
     # as counters, occupancy as gauges, concentrator live gauges

@@ -32,13 +32,23 @@ from typing import Optional
 
 class Span:
     """One finished span. ``allocations`` counts every construction —
-    the trace-off zero-overhead test asserts it stays flat."""
+    the trace-off zero-overhead test asserts it stays flat.
 
-    __slots__ = ("name", "cat", "ts_us", "dur_us", "tid", "args")
+    ``span_id``/``parent_id`` are the cross-node edge identity
+    (obs/tracectx.py): only spans that parent remote work carry an
+    explicit span_id; leaf phase spans default to parenting the root."""
+
+    __slots__ = (
+        "name", "cat", "ts_us", "dur_us", "tid", "args",
+        "span_id", "parent_id",
+    )
 
     allocations = 0
 
-    def __init__(self, name, cat, ts_us, dur_us, tid, args):
+    def __init__(
+        self, name, cat, ts_us, dur_us, tid, args,
+        span_id=None, parent_id=None,
+    ):
         Span.allocations += 1
         self.name = name
         self.cat = cat
@@ -46,6 +56,8 @@ class Span:
         self.dur_us = dur_us
         self.tid = tid
         self.args = args
+        self.span_id = span_id
+        self.parent_id = parent_id
 
 
 class QueryTrace:
@@ -54,25 +66,48 @@ class QueryTrace:
 
     __slots__ = (
         "qid", "query", "session_id", "started_s", "finished_s",
-        "spans", "_mu",
+        "spans", "_mu", "ctx", "epoch_offset_us",
     )
 
     def __init__(self, qid: int, query: str, session_id: int = 0):
+        from opentenbase_tpu.obs import tracectx as _tctx
+
         self.qid = qid
         self.query = query
         self.session_id = session_id
         self.started_s = time.perf_counter()
+        # cross-node identity (obs/tracectx.py): the wire header minted
+        # once per traced statement; ctx.span_id is the root span's id
+        self.ctx = _tctx.TraceContext.new()
+        # epoch offset: spans record on the perf_counter clock, remote
+        # rings on the epoch clock — the export shifts CN spans by this
+        # so one merged timeline needs no cross-process negotiation
+        self.epoch_offset_us = time.time() * 1e6 - self.started_s * 1e6
         self.finished_s: Optional[float] = None
         self.spans: list[Span] = []
         self._mu = threading.Lock()
 
+    @property
+    def trace_id(self) -> str:
+        return self.ctx.trace_id
+
     def record(
-        self, name: str, cat: str, t0_s: float, t1_s: float, **args
+        self, name: str, cat: str, t0_s: float, t1_s: float,
+        span_id=None, parent_id=None, **args,
     ) -> None:
-        """Append a finished span timed on the perf_counter clock."""
+        """Append a finished span timed on the perf_counter clock.
+        Spans default to parenting the statement's root span; callers
+        that fan out remote work pass an explicit ``span_id`` so
+        wire-propagated children attach to the right attempt.  None-
+        valued args are elided (the elog contract) so call sites can
+        pass conditionals unconditionally."""
+        if args:
+            args = {k: v for k, v in args.items() if v is not None}
         span = Span(
             name, cat, t0_s * 1e6, max(t1_s - t0_s, 0.0) * 1e6,
             threading.get_ident(), args or None,
+            span_id=span_id,
+            parent_id=parent_id or self.ctx.span_id,
         )
         with self._mu:
             self.spans.append(span)
@@ -98,6 +133,7 @@ class Tracer:
             "query", "query", trace.started_s * 1e6,
             (trace.finished_s - trace.started_s) * 1e6,
             threading.get_ident(), {"query": trace.query[:200]},
+            span_id=trace.ctx.span_id,
         )
         with trace._mu:
             trace.spans.insert(0, root)

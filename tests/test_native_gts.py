@@ -173,3 +173,91 @@ def test_node_registry_survives_native_restart(tmp_path):
         assert nodes["dn3"]["host"] == ""
     finally:
         client2.close()
+
+
+def test_gts_wait_events_recorded(gts):
+    """Every NativeGTS round-trip is a real wait: with a registry
+    attached, grants land in the cumulative table as GTM/GtsWait —
+    the commit-path attribution PR 2's wait model missed."""
+    from opentenbase_tpu.obs.waits import WaitEventRegistry
+
+    wr = WaitEventRegistry()
+    gts.wait_registry = wr
+    gts.get_gts()
+    info = gts.begin()
+    gts.commit(info.gxid)
+    rows = {(r[0], r[1]): r for r in wr.rows()}
+    ent = rows.get(("GTM", "GtsWait"))
+    assert ent is not None and ent[2] >= 3 and ent[3] >= 0
+
+
+def test_traced_envelope_capability_fallback(gts):
+    """The C++ native server predates the OP_TRACED envelope: a traced
+    request probes once, falls back to bare ops, and every grant still
+    answers (the capability handshake must never error a session)."""
+    from opentenbase_tpu.obs import tracectx as _tctx
+
+    prev = _tctx.bind(_tctx.TraceContext.new())
+    try:
+        assert gts.get_gts() > 0
+        assert gts._traced_capable is False  # probed, fell back
+        assert gts.get_gts() > 0             # and stays on bare ops
+    finally:
+        _tctx.bind(prev)
+
+
+def test_traced_envelope_python_frontend(tmp_path):
+    """The python GTSFrontend DOES unwrap OP_TRACED: traced grants
+    record into the GTM's span ring stitched to the caller's
+    trace_id."""
+    from opentenbase_tpu.gtm.gts import GTSServer
+    from opentenbase_tpu.gtm.server import GTSFrontend
+    from opentenbase_tpu.obs import tracectx as _tctx
+
+    srv = GTSServer()
+    fe = GTSFrontend(srv).start()
+    client = NativeGTS(fe.host, fe.port)
+    ctx = _tctx.TraceContext.new()
+    prev = _tctx.bind(ctx)
+    try:
+        assert client.get_gts() > 0
+        assert client._traced_capable is True
+        info = client.begin()
+        client.commit(info.gxid)
+    finally:
+        _tctx.bind(prev)
+        client.close()
+        fe.stop()
+    rows = srv.span_ring.rows(trace_ids=[ctx.trace_id])
+    names = {r[3] for r in rows}
+    assert "gts_grant" in names and "gts_begin" in names, names
+    assert "gts_commit" in names
+    # wire-carried parent: every span parents the caller's span id
+    assert all(r[2] == ctx.span_id for r in rows)
+
+
+def test_trace_fetch_over_gtm_wire(tmp_path):
+    """A coordinator whose GTM is REMOTE still exports gtm0 spans:
+    OP_TRACE_FETCH ships the frontend's span ring to the client (the
+    GTM wire's trace_fetch); the C++ server answers status 1 and the
+    client degrades to no spans."""
+    from opentenbase_tpu.gtm.gts import GTSServer
+    from opentenbase_tpu.gtm.server import GTSFrontend
+    from opentenbase_tpu.obs import tracectx as _tctx
+
+    srv = GTSServer()
+    fe = GTSFrontend(srv).start()
+    client = NativeGTS(fe.host, fe.port)
+    ctx = _tctx.TraceContext.new()
+    prev = _tctx.bind(ctx)
+    try:
+        client.get_gts()
+    finally:
+        _tctx.bind(prev)
+    try:
+        rows = client.fetch_spans([ctx.trace_id])
+        assert rows and all(r[0] == ctx.trace_id for r in rows), rows
+        assert client.fetch_spans(["0" * 32]) == []  # filtered
+    finally:
+        client.close()
+        fe.stop()

@@ -298,8 +298,9 @@ def test_query_phases_view(sess):
 
 def test_chrome_trace_export(join_sess, tmp_path):
     """trace_queries=on traces a query end to end; the export round-
-    trips through json.load with well-nested span timestamps; the
-    pg_export_traces() admin function serves the same document over
+    trips through json.load with well-nested span timestamps grouped by
+    trace_id (per-node pids mean one pid now carries many statements);
+    the pg_export_traces() admin function serves the same document over
     SQL (what the otb_trace CLI fetches)."""
     from opentenbase_tpu.obs.export import export_chrome_trace
 
@@ -317,12 +318,27 @@ def test_chrome_trace_export(join_sess, tmp_path):
         doc = json.load(f)
     events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
     assert events, "no spans exported"
-    by_pid: dict = {}
+    # per-node pids: every coordinator span sits on the cn0 track
+    # (the in-process GTM's grants render as a gtm0 track beside it),
+    # and process_name metadata events name the tracks
+    meta_names = {
+        e["args"]["name"]: e["pid"]
+        for e in doc["traceEvents"] if e.get("ph") == "M"
+    }
+    assert "cn0" in meta_names
+    assert all(
+        e["pid"] == meta_names["cn0"] for e in events
+        if e["name"] == "query"
+    )
+    by_trace: dict = {}
     for e in events:
-        by_pid.setdefault(e["pid"], []).append(e)
-    # the traced query carries a root 'query' span enclosing the rest
+        tid = (e.get("args") or {}).get("trace_id")
+        assert tid, e  # every exported span carries its trace identity
+        by_trace.setdefault(tid, []).append(e)
+    # each traced statement carries a root 'query' span enclosing the
+    # rest of ITS trace
     traced = [
-        evs for evs in by_pid.values()
+        evs for evs in by_trace.values()
         if any(e["name"] == "query" for e in evs)
     ]
     assert traced
@@ -400,3 +416,213 @@ def test_pg_stat_pallas_view():
     rows = s.query("select program, state from pg_stat_pallas")
     assert any(st == "compiled" for _p, st in rows)
     assert not any(st == "demoted" for _p, st in rows)
+
+
+# ---------------------------------------------------------------------------
+# Cross-node distributed tracing (obs/tracectx.py): wire-propagated
+# context, per-node span rings, trace_fetch merge, and the
+# device-platform watchdog.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def dn_topology(tmp_path):
+    """1 CN + 2 in-process DN servers over real sockets (the chaos-smoke
+    topology): fragments ship over channels, so traces must stitch
+    across a genuine wire."""
+    from opentenbase_tpu.dn.server import DNServer
+    from opentenbase_tpu.storage.replication import WalSender
+
+    c = Cluster(num_datanodes=2, shard_groups=16,
+                data_dir=str(tmp_path / "cn"))
+    s = c.session()
+    s.execute("set enable_fused_execution = off")
+    s.execute("create table tt (k bigint, v bigint) distribute by shard(k)")
+    s.execute("insert into tt values "
+              + ",".join(f"({i},{i * 3})" for i in range(120)))
+    sender = WalSender(c.persistence)
+    dns = [
+        DNServer(str(tmp_path / f"dn{n}"), sender.host, sender.port,
+                 2, 16).start()
+        for n in (0, 1)
+    ]
+    for n, dn in enumerate(dns):
+        c.attach_datanode(n, "127.0.0.1", dn.port, pool_size=2,
+                          rpc_timeout=60)
+    try:
+        yield c, s, dns
+    finally:
+        for n in (0, 1):
+            try:
+                c.detach_datanode(n)
+            except Exception:
+                pass
+        for dn in dns:
+            try:
+                dn.stop()
+            except Exception:
+                pass
+        sender.stop()
+        c.close()
+
+
+def _export(s, last=5):
+    return json.loads(s.query(f"select pg_export_traces({last})")[0][0])
+
+
+def _spans_by_trace(doc):
+    by_trace: dict = {}
+    for e in doc["traceEvents"]:
+        if e.get("ph") != "X":
+            continue
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    return by_trace
+
+
+def test_cross_node_trace_stitch(dn_topology):
+    """One traced statement produces ONE merged Chrome trace holding
+    spans from the CN, both DN server processes, and the GTM — all
+    under one trace_id with parent/child edges intact across the
+    wire (the acceptance shape)."""
+    c, s, _dns = dn_topology
+    s.execute("set trace_queries = on")
+    s.query("select count(*), sum(v) from tt")
+    s.execute("set trace_queries = off")
+    doc = _export(s)
+    names = {
+        e["args"]["name"]: e["pid"]
+        for e in doc["traceEvents"] if e.get("ph") == "M"
+    }
+    by_trace = _spans_by_trace(doc)
+    stitched = [
+        evs for evs in by_trace.values()
+        if any(e["name"] == "query" and "count" in (
+            (e.get("args") or {}).get("query") or "")
+            for e in evs)
+    ]
+    assert stitched, "traced statement missing from the export"
+    evs = stitched[0]
+    pid_of = {v: k for k, v in names.items()}
+    nodes = {pid_of[e["pid"]] for e in evs}
+    assert {"cn0", "dn0", "dn1", "gtm0"} <= nodes, nodes
+    # DN-side span content: fragment execution attributed per node
+    dn_spans = [e for e in evs if pid_of[e["pid"]].startswith("dn")]
+    assert any(e["name"] == "exec_fragment" for e in dn_spans)
+    # GTM-side: the statement's snapshot grant
+    gtm_spans = [e for e in evs if pid_of[e["pid"]] == "gtm0"]
+    assert any(e["cat"] == "gts" for e in gtm_spans)
+    # parent/child edges: every parent_span_id resolves to a span_id
+    # present in the SAME trace (the root has none)
+    span_ids = {
+        e["args"].get("span_id") for e in evs
+    } - {None}
+    for e in evs:
+        parent = e["args"].get("parent_span_id")
+        if parent is not None:
+            assert parent in span_ids, (e["name"], parent)
+
+
+def test_trace_chaos_retry_failover(dn_topology):
+    """crash_node -> retry -> failover under tracing: the merged trace
+    carries the CN root, the failed attempt span (attempt=1), the
+    retry child span (attempt=2), and the failover-tagged fragment
+    span — the satellite's chaos shape."""
+    from opentenbase_tpu import fault
+
+    c, s, dns = dn_topology
+    want = s.query("select count(*), sum(v) from tt")
+    s.execute("set fault_injection = on")
+    s.execute("set fragment_retries = 1")
+    s.execute("set fragment_retry_backoff_ms = 5")
+    s.execute("select pg_fault_inject('dn/exec_fragment', 'crash_node',"
+              " 'node=1, once')")
+    s.execute("set trace_queries = on")
+    assert s.query("select count(*), sum(v) from tt") == want
+    s.execute("set trace_queries = off")
+    s.execute("select pg_fault_clear()")
+    dns[1]._revive()
+    fault.reset_stats()
+    doc = _export(s)
+    by_trace = _spans_by_trace(doc)
+    chaos = [
+        evs for evs in by_trace.values()
+        if any(e["name"].startswith("fragment") and
+               e["cat"] == "attempt" for e in evs)
+    ]
+    assert chaos, "no attempt spans in any trace"
+    evs = chaos[0]
+    assert any(e["name"] == "query" for e in evs)  # CN root
+    attempts = {
+        e["args"]["attempt"] for e in evs if e["cat"] == "attempt"
+    }
+    assert 1 in attempts and 2 in attempts, attempts  # fail + retry
+    finals = [
+        e for e in evs
+        if e["cat"] == "fragment" and e["args"].get("failover")
+    ]
+    assert finals and finals[0]["args"]["failover"] == "local"
+    assert finals[0]["args"]["attempt"] >= 2
+
+
+def test_trace_off_zero_allocations_cross_process(dn_topology):
+    """trace_queries=off allocates ZERO spans on EVERY node: the CN's
+    Span counter stays flat, no ``_trace`` header crosses the wire,
+    and the DN/GTM span rings stay empty (SpanRing.allocations is the
+    remote half of the zero-overhead contract)."""
+    from opentenbase_tpu.obs.trace import Span
+    from opentenbase_tpu.obs.tracectx import SpanRing
+
+    c, s, dns = dn_topology
+    s.query("select count(*) from tt")  # warm everything up
+    span_before = Span.allocations
+    ring_before = SpanRing.allocations
+    dn_rings = [len(dn.span_ring) for dn in dns]
+    s.query("select count(*), sum(v) from tt")
+    s.query("select count(*) from tt where k > 5")
+    assert Span.allocations == span_before
+    assert SpanRing.allocations == ring_before
+    assert [len(dn.span_ring) for dn in dns] == dn_rings
+    gtm_ring = c.gts.span_ring
+    assert gtm_ring.rows() == gtm_ring.rows()  # ring readable, and...
+    assert SpanRing.allocations == ring_before  # ...reads allocate 0
+
+
+def test_device_platform_watchdog(tmp_path):
+    """A cluster told to expect TPU that answers a fused run from CPU
+    is observable within ONE statement: the demotion counter moves,
+    pg_cluster_logs carries the elog(warning, device, ...), and
+    pg_cluster_health's cn0 row shows the actually-used platform."""
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute("create table wd (k bigint, v bigint) distribute by shard(k)")
+    s.execute("insert into wd values (1,10),(2,20),(3,30)")
+    s.execute("set expected_device_platform = tpu")
+    assert s.query("select count(*) from wd")[0][0] == 3  # fused on CPU
+    fx = s.cluster._fused
+    assert fx is not None and fx.platform_demotions >= 1
+    st = dict(s.query("select event, detail from pg_stat_fused"))
+    assert st.get("last_run_platform") == "cpu"
+    assert int(st.get("platform_demotions", 0)) >= 1
+    h = {r[0]: r for r in s.query("select * from pg_cluster_health")}
+    assert h["cn0"][7] == "cpu"          # device_platform column
+    logs = s.query("select pg_cluster_logs('warning')")
+    assert any(
+        r[3] == "device" and "demoted" in r[4] for r in logs
+    ), logs
+    # the exporter renders the monotone counter
+    from opentenbase_tpu.obs.exporter import render_cluster_metrics
+
+    text = render_cluster_metrics(s.cluster)
+    assert "otb_platform_demotions_total" in text
+    line = [
+        ln for ln in text.splitlines()
+        if ln.startswith("otb_platform_demotions_total")
+    ][0]
+    assert float(line.rpartition(" ")[2]) >= 1
+    # RESET must switch the watchdog off (restore the env-inferred
+    # expectation) without recycling the executor
+    s.execute("reset expected_device_platform")
+    before = fx.platform_demotions
+    s.query("select count(*) from wd where k > 1")
+    assert fx.platform_demotions == before

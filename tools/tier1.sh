@@ -310,11 +310,47 @@ assert [r[0] for r in logs] == sorted(r[0] for r in logs)  # time-ordered
 b3 = scrape("127.0.0.1", mport)
 assert "otb_fault_hits_total" in b3                       # fault counters render
 assert "otb_dn_up" in b3 and "otb_replication_lag_bytes" in b3
+
+# cross-node trace stitch: ONE traced statement must export spans from
+# >= 3 distinct nodes (CN + DN server processes + GTM) under one
+# trace_id, with the per-node process_name tracks in place
+import json as _json
+s.execute("set trace_queries = on")
+s.query("select count(*), sum(v) from t")
+s.execute("set trace_queries = off")
+doc = _json.loads(s.query("select pg_export_traces(5)")[0][0])
+meta = {e["args"]["name"]: e["pid"]
+        for e in doc["traceEvents"] if e.get("ph") == "M"}
+assert "cn0" in meta and "gtm0" in meta and "dn0" in meta, meta
+by_trace = {}
+for e in doc["traceEvents"]:
+    if e.get("ph") != "X": continue
+    tid = (e.get("args") or {}).get("trace_id")
+    if tid: by_trace.setdefault(tid, set()).add(e["pid"])
+assert any(len(pids) >= 3 for pids in by_trace.values()), \
+    {t: len(p) for t, p in by_trace.items()}
+
+# device-platform watchdog: a forced demotion (expect TPU, run on this
+# CPU box) is observable within one statement — counter on a scrape,
+# platform in pg_cluster_health, elog(warning) in pg_cluster_logs
+s.execute("set enable_fused_execution = on")
+s.execute("set expected_device_platform = tpu")
+s.query("select count(*) from t")
+h = {r[0]: r for r in s.query("select * from pg_cluster_health")}
+assert h["cn0"][7] == "cpu", h["cn0"]
+b4 = scrape("127.0.0.1", mport)
+demo = [ln for ln in b4.splitlines()
+        if ln.startswith("otb_platform_demotions_total")]
+assert demo and float(demo[0].rpartition(" ")[2]) >= 1, demo
+wlogs = s.query("select pg_cluster_logs('warning')")
+assert any(r[3] == "device" and "demoted" in r[4] for r in wlogs), wlogs
+
 for n in (0, 1): c.detach_datanode(n)
 for dn in dns: dn.stop()
 sender.stop(); c.close(); fault.reset_stats()
 print("telemetry smoke OK: scrape moved, chaos run reconstructed "
-      "from logs + health")
+      "from logs + health, cross-node trace stitched, platform "
+      "watchdog fired")
 PY
 
 echo "== tier1: join-mode + perf-gate smoke =="
