@@ -1,0 +1,85 @@
+"""Seeded chaos-schedule runner — the self-healing HA acceptance gate.
+
+    python -m opentenbase_tpu.cli.otb_chaos [--seed N] [--schedules K]
+        [--duration S] [--datanodes D] [--detect-ms MS] [--beats B]
+        [--keep] [--workdir DIR]
+
+Each schedule (seeds N, N+1, ... N+K-1) builds a fresh topology
+(coordinator + WAL-streaming datanode standbys + HAMonitor), runs a
+randomized fault timeline — drop_conn, delays, wal_torn stream tears,
+a datanode crash/revive, a primary crash, and a kill inside the
+promotion window — under live read-write traffic, then checks the
+invariants (fault/schedule.py docstring). One JSON verdict line per
+schedule plus a final ``chaos_gate`` summary line, bench_gate style;
+exit code 4 on any violated invariant.
+
+A failing run replays from its printed seed alone: the schedule, the
+prob-fault draws, the reconnect jitter, and the wal_torn tear
+positions all derive from it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=1107,
+                    help="base seed (schedules use seed..seed+K-1)")
+    ap.add_argument("--schedules", type=int, default=5)
+    ap.add_argument("--duration", type=float, default=6.0,
+                    help="seconds of live traffic per schedule")
+    ap.add_argument("--datanodes", type=int, default=2)
+    ap.add_argument("--detect-ms", type=int, default=1200,
+                    help="failover_detect_ms for the HA monitor")
+    ap.add_argument("--beats", type=int, default=3,
+                    help="consecutive missed beats before promotion")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep each schedule's data dirs")
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+
+    from opentenbase_tpu.fault.schedule import (
+        ChaosSchedule,
+        run_schedule,
+    )
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="otb_chaos_")
+    verdicts = []
+    for k in range(args.schedules):
+        seed = args.seed + k
+        sched = ChaosSchedule.generate(
+            seed, duration_s=args.duration,
+            num_datanodes=args.datanodes,
+        )
+        v = run_schedule(
+            sched, f"{workdir}/seed{seed}",
+            detect_ms=args.detect_ms, beats=args.beats,
+            keep=args.keep,
+        )
+        verdicts.append(v)
+        print(json.dumps(v, default=str), flush=True)
+    failed = [v["seed"] for v in verdicts if v["chaos_gate"] != "ok"]
+    summary = {
+        "chaos_gate": "ok" if not failed else "fail",
+        "schedules": len(verdicts),
+        "failed_seeds": failed,
+        "acked_writes": sum(
+            v.get("acked_writes", 0) for v in verdicts
+        ),
+        "promotions": sum(v.get("promotions", 0) for v in verdicts),
+        "replay_hint": (
+            f"python -m opentenbase_tpu.cli.otb_chaos --seed "
+            f"{failed[0]} --schedules 1" if failed else ""
+        ),
+    }
+    print(json.dumps(summary, default=str), flush=True)
+    return 4 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -190,6 +190,21 @@ GUCS: dict = {
     # standby's wire frontend; on primary loss the client reconnects
     # there instead of erroring the session
     "gtm_standby_addr": (_str, ""),
+    # self-healing HA (ha.py HAMonitor): total detection budget for
+    # declaring the primary dead — the monitor probes every
+    # failover_detect_ms / failover_beats and promotes after
+    # failover_beats CONSECUTIVE missed beats, so a single dropped
+    # probe never triggers a failover
+    "failover_detect_ms": (_duration, 3000),
+    "failover_beats": (_int, 3),
+    # commit durability vs the hot standbys (the synchronous_commit
+    # ladder; ROADMAP item 4 adds remote_write/quorum modes): 'on' =
+    # a commit acks only after every reachable attached DN standby has
+    # APPLIED the commit's WAL position (remote_apply semantics) — the
+    # guarantee the HA failover invariant "zero lost committed writes"
+    # is built on; 'off' = ack after the local WAL fsync (today's
+    # default behavior, replication asynchronous)
+    "synchronous_commit": (_enum("off", "on"), "off"),
     "autovacuum": (_bool, False),
     "autovacuum_naptime_s": (_int, 60),
     "autovacuum_scale_factor_pct": (_int, 20),

@@ -116,7 +116,13 @@ class DNServer:
         self.standby.stream_txn_hook = self._on_stream_txn
         self.standby.start_replication(wal_host, wal_port)
         self._promoted_srv = None
+        self._promoted_walsender = None
         self._promote_mu = threading.Lock()
+        # fencing epoch learned from wire ops (monotone max). The
+        # stream-learned half lives on the standby cluster
+        # (node_generation, set by replayed ha_generation records);
+        # effective_generation() is the max of both.
+        self._hgen = 0
         # DN-side fragment cancel (the reference's real cancel message):
         # tokens the coordinator abandoned; running fragments poll the
         # set at operator boundaries. Insertion-ordered for bounded
@@ -173,6 +179,11 @@ class DNServer:
                 self._promoted_srv.stop()
             except Exception:
                 pass
+        if self._promoted_walsender is not None:
+            try:
+                self._promoted_walsender.stop()
+            except Exception:
+                pass
         self.standby.stop()
 
     def _accept_loop(self) -> None:
@@ -181,6 +192,24 @@ class DNServer:
                 conn, _ = self._lsock.accept()
             except OSError:
                 return
+            try:
+                # failpoint: the DN refusing/dropping a just-accepted
+                # coordinator connection. Its OWN try block: drop_conn
+                # raises a ConnectionResetError (an OSError), and the
+                # accept handler above would read that as a closed
+                # listener and kill the loop — the loop must survive
+                # any injected action.
+                FAULT("dn/accept")
+            except Exception as e:
+                self.log_ring.emit(
+                    "warning", "dn",
+                    f"connection refused at accept: {e!r:.120}",
+                )
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(
                 target=self._serve, args=(conn,), daemon=True
@@ -305,6 +334,37 @@ class DNServer:
                     since_ts=float(msg.get("since_ts") or 0.0),
                 ),
             }
+        # fencing-epoch gate (self-healing HA): data-plane ops carry the
+        # caller's node_generation. A caller BEHIND this node's known
+        # generation is a stale ex-primary partitioned through a
+        # promotion — refuse with the fenced error (SQLSTATE 72000) and
+        # tell it to demote; split-brain becomes a refused RPC instead
+        # of silent divergence. A caller AHEAD advances our known
+        # generation (the coordinator is the authority).
+        hg = msg.get("hgen")
+        if hg is not None:
+            hg = int(hg)
+            cur = self.effective_generation()
+            if hg < cur:
+                self._bump("fenced_refusals")
+                self.log_ring.emit(
+                    "warning", "ha",
+                    f"fenced stale-generation op {op!r} "
+                    f"(caller {hg} < node {cur})",
+                    op=op, caller_generation=hg, generation=cur,
+                )
+                return {
+                    "error": (
+                        f"stale generation: {op} carries generation "
+                        f"{hg} but this node follows generation {cur};"
+                        " caller must demote and resync"
+                    ),
+                    "fenced": True,
+                    "gen": cur,
+                    "sqlstate": "72000",
+                }
+            if hg > self._hgen:
+                self._hgen = hg
         self._failpoint("dn/dispatch", op=op)
         if op == "cancel_fragment":
             tok = str(msg.get("token") or "")
@@ -325,6 +385,13 @@ class DNServer:
                 # pg_cluster_health's per-node gauges ride the heartbeat
                 "inflight": inflight,
                 "armed_faults": len(_fault.armed()),
+                # self-healing HA: fencing generation + live role so a
+                # failover is visible on the next heartbeat
+                "generation": self.effective_generation(),
+                "role": (
+                    "coordinator" if self._promoted_srv is not None
+                    else "datanode"
+                ),
             }
             if self._promoted_srv is not None:
                 out["promoted"] = True
@@ -332,6 +399,8 @@ class DNServer:
             return out
         if op == "promote":
             return self._promote(msg)
+        if op == "repl_repoint":
+            return self._repoint(msg)
         if self._promoted_srv is not None:
             # a promoted node owns its data read-write; replication-
             # role ops from a partitioned old coordinator must be
@@ -339,8 +408,12 @@ class DNServer:
             # primary's back (the split-brain fence a promoted PG
             # standby applies by rejecting the WAL stream)
             return {
-                "error": "datanode has been promoted to coordinator; "
-                "replication-role ops refused",
+                "error": "stale generation: datanode has been promoted "
+                "to coordinator; replication-role ops refused — caller "
+                "must demote and resync",
+                "fenced": True,
+                "gen": self.effective_generation(),
+                "sqlstate": "72000",
             }
         if op == "exec_fragment":
             return self._exec_fragment(msg)
@@ -535,6 +608,12 @@ class DNServer:
                 arrays,
             )
             self.standby.direct_applied.add(gid)
+            # promotion safety: until the stream's 'G' frame lands,
+            # this txn exists in our stores but in no WAL we could be
+            # promoted on — keep the payload so promote() can re-log it
+            self.standby.note_direct_apply(
+                gid, int(commit_ts), entry["writes"]
+            )
             self._bump("dml_direct_applied")
         return True
 
@@ -699,22 +778,83 @@ class DNServer:
             raise errors[0]
 
     # -- coordinator failover ---------------------------------------------
+    def effective_generation(self) -> int:
+        """The highest fencing generation this node knows: learned from
+        wire ops (_hgen), from replayed ha_generation WAL records (the
+        standby cluster's node_generation), or from its own promotion."""
+        return max(
+            self._hgen,
+            int(getattr(self.standby.cluster, "node_generation", 0)),
+        )
+
     def _promote(self, msg: dict) -> dict:
         """Promote this datanode process to a full COORDINATOR: its
         StandbyCluster holds the complete replicated state (WAL copy,
         catalog, 2PC journals), so any DN can take over when the
         coordinator dies — pg_ctl promote pointed at a datanode.
         Stops WAL replication, finishes recovery (re-parks in-doubt
-        2PC), and opens a read-write SQL front end; returns its port.
-        Idempotent."""
+        2PC, truncates the torn stream tail, re-logs unstreamed
+        direct-applied 2PC commits, WAL-logs the bumped fencing
+        generation), opens a read-write SQL front end AND a walsender
+        so the surviving standbys / rejoining ex-primary can follow
+        the new timeline. Idempotent."""
         from opentenbase_tpu.net.server import ClusterServer
+        from opentenbase_tpu.storage.replication import WalSender
 
         with self._promote_mu:  # idempotent under concurrent RPCs
             if self._promoted_srv is None:
-                c = self.standby.promote()
+                # failpoint INSIDE the promotion window: a chaos
+                # schedule killing the candidate mid-promote
+                # (crash_node) forces the HA monitor onto its
+                # next-best candidate
+                self._failpoint("dn/promote")
+                gen = msg.get("generation")
+                c = self.standby.promote(
+                    generation=int(gen) if gen is not None else None,
+                )
+                self._hgen = max(self._hgen, c.node_generation)
                 self._promoted_srv = ClusterServer(c).start()
+                if msg.get("walsender", True):
+                    self._promoted_walsender = WalSender(c.persistence)
                 self._bump("promoted")
-            return {"ok": True, "port": self._promoted_srv.port}
+            c = self.standby.cluster
+            out = {
+                "ok": True,
+                "port": self._promoted_srv.port,
+                "generation": int(c.node_generation),
+                "promote_lsn": int(getattr(c, "ha_promote_lsn", 0)),
+            }
+            if self._promoted_walsender is not None:
+                out["wal_port"] = self._promoted_walsender.port
+            return out
+
+    def _repoint(self, msg: dict) -> dict:
+        """Post-failover resync: re-point this standby's walreceiver at
+        the promoted node's walsender and re-stream from our own
+        offset (truncating any torn tail first — the restart/resync
+        walreceiver contract). The ha_generation record arrives over
+        the new stream and advances our WAL-learned generation."""
+        self._failpoint("dn/repoint")
+        if self._promoted_srv is not None:
+            return {"error": "node is a promoted coordinator; "
+                             "it does not follow anyone"}
+        host = str(msg.get("wal_host") or "127.0.0.1")
+        port = int(msg["wal_port"])
+        try:
+            self.standby.restart_replication(host, port)
+        except Exception as e:
+            self.log_ring.emit(
+                "error", "ha",
+                f"repoint to {host}:{port} failed: {e}",
+            )
+            return {"error": f"repoint failed: {type(e).__name__}: {e}"}
+        self._bump("repoints")
+        self.log_ring.emit(
+            "warning", "ha",
+            f"walreceiver re-pointed at {host}:{port} "
+            f"(resumed from {self.standby.applied})",
+        )
+        return {"ok": True, "applied": self.standby.applied}
 
     def _revive(self) -> None:
         """Undo an injected crash: reopen the listener on the same port

@@ -358,6 +358,25 @@ class Cluster:
         # renders these (a sum over LIVE sessions would drop when a
         # session closes — a Prometheus counter must never go backwards)
         self.frag_heal_stats: dict = {"retries": 0, "failovers": 0}
+        # -- self-healing HA (ha.py + storage/replication.py) ---------
+        # fencing epoch of this node's timeline: bumped (and WAL-logged
+        # as a durable ha_generation record) by every standby
+        # promotion; wire ops to DN processes carry it, and a peer at a
+        # newer generation refuses ours with SQLSTATE 72000
+        self.node_generation = 0
+        # the WAL offset where this timeline stopped being a byte
+        # prefix of its predecessor's (0 = original primary, whole
+        # history ours) — walsender hands it to rejoining standbys as
+        # the rewind point
+        self.ha_promote_lsn = 0
+        # True once a peer at a newer generation fenced us out: this
+        # node is a stale ex-primary and must refuse EVERY statement
+        # (a read served here could be arbitrarily stale — split-brain
+        # reads are exactly what the fence exists to kill) until an
+        # operator resyncs it via rejoin_standby
+        self.ha_demoted = False
+        # cluster-lifetime failover counters (otb_promotions_total)
+        self.ha_stats: dict = {"promotions": 0, "fenced_refusals": 0}
         # in-doubt 2PC resolver counters (pg_stat_2pc): bumped from the
         # admin fn, the background loop, and concurrent sessions
         self.twophase_stats: dict = {
@@ -674,9 +693,80 @@ class Cluster:
                 h["applied"] = int(resp.get("applied") or 0)
                 h["inflight"] = int(resp.get("inflight") or 0)
                 h["armed_faults"] = int(resp.get("armed_faults") or 0)
+                # self-healing HA: fencing generation + live role (a
+                # promoted DN answers role='coordinator') ride the
+                # heartbeat so pg_cluster_health shows the transition
+                h["generation"] = int(resp.get("generation") or 0)
+                h["role"] = str(resp.get("role") or "datanode")
             except Exception:
                 h["ok"] = False
         return self._dn_health
+
+    def wait_standbys_applied(
+        self, lsn: int, timeout_s: float = 10.0
+    ) -> bool:
+        """remote_apply wait (synchronous_commit = on): block until
+        every REACHABLE attached DN standby reports ``applied`` >= lsn.
+        A standby that stays unreachable for the whole window is
+        skipped — a dead node is the HA monitor's problem and must not
+        wedge every commit — but at least ONE standby must confirm or
+        the wait fails (an unreplicated "synchronous" ack would be a
+        lie the next failover exposes).
+
+        Durability boundary (the PG sync-standby contract, stated
+        honestly): an ack given while standby A was dead-skipped is
+        only as durable as the standbys that confirmed it. If ALL of
+        those are down at failover time and A is promoted, the write
+        is lost — a double fault outside the single-failure tolerance
+        this mode provides (the degraded ack is elog'd below). Closing
+        that window takes quorum acknowledgement across N standbys —
+        ROADMAP item 4's synchronous_commit ladder, which extends this
+        exact seam."""
+        import time as _time
+
+        chans = dict(getattr(self, "dn_channels", None) or {})
+        if not chans:
+            return True
+        deadline = _time.monotonic() + timeout_s
+        confirmed: set = set()
+        fails: dict[int, int] = {}
+        dead: set = set()
+        while True:
+            for n, ch in chans.items():
+                if n in confirmed or n in dead:
+                    continue
+                try:
+                    resp = ch.rpc({"op": "ping"}, timeout_s=2.0)
+                    if int(resp.get("applied") or 0) >= lsn:
+                        confirmed.add(n)
+                    fails.pop(n, None)
+                except Exception:
+                    # two consecutive failed probes = dead for THIS
+                    # wait (a dead standby is the HA monitor's problem
+                    # and must not tax every commit with the full
+                    # timeout); a reachable-but-lagging standby keeps
+                    # being waited on
+                    fails[n] = fails.get(n, 0) + 1
+                    if fails[n] >= 2:
+                        dead.add(n)
+            if len(confirmed) + len(dead) == len(chans):
+                ok = bool(confirmed)
+            elif _time.monotonic() >= deadline:
+                ok = False  # someone reachable never caught up
+            else:
+                _time.sleep(0.005)
+                continue
+            if not ok or dead:
+                self.log.emit(
+                    "warning" if not ok else "log",
+                    "replication",
+                    "synchronous commit wait "
+                    + ("failed" if not ok else "degraded"),
+                    lsn=int(lsn),
+                    confirmed=len(confirmed),
+                    dead=len(dead),
+                )
+            return ok
 
     def collect_remote_spans(self, trace_ids) -> dict:
         """Per-node span records for ``trace_ids``: every attached DN
@@ -920,7 +1010,7 @@ class Cluster:
                 if info.gid:
                     still_open.add(info.gid)
             for n, ch in (getattr(self, "dn_channels", None) or {}).items():
-                resp = ch.rpc({"op": "2pc_list"})
+                resp = ch.rpc({"op": "2pc_list", "hgen": self.node_generation})
                 entries = resp.get("entries") or [
                     {"gid": g, "age_s": None} for g in resp.get("gids", [])
                 ]
@@ -935,7 +1025,8 @@ class Cluster:
                     age = e.get("age_s")
                     if age is not None and age < max_age_s:
                         continue
-                    ch.rpc({"op": "2pc_abort", "gid": gid})
+                    ch.rpc({"op": "2pc_abort", "gid": gid,
+                             "hgen": self.node_generation})
                     resolved.append(f"dn{n}:{gid}")
         except Exception:
             pass
@@ -1046,7 +1137,7 @@ class Cluster:
         vote_age: dict[str, float] = {}
         for n, ch in chans.items():
             try:
-                resp = ch.rpc({"op": "2pc_list"})
+                resp = ch.rpc({"op": "2pc_list", "hgen": self.node_generation})
             except Exception:
                 with self._2pc_stats_mu:
                     st["unreachable_datanodes"] += 1
@@ -1093,6 +1184,7 @@ class Cluster:
                         chans[n].rpc({
                             "op": "2pc_commit", "gid": gid,
                             "commit_ts": decision[1],
+                            "hgen": self.node_generation,
                         })
                     except Exception:
                         ok = False
@@ -1105,7 +1197,8 @@ class Cluster:
                 # no reader can ever have observed this txn
                 for n in dn_votes.get(gid, []):
                     try:
-                        chans[n].rpc({"op": "2pc_abort", "gid": gid})
+                        chans[n].rpc({"op": "2pc_abort", "gid": gid,
+                                      "hgen": self.node_generation})
                     except Exception:
                         ok = False
                 outcome = "aborted" if ok else "abort_retry"
@@ -1677,6 +1770,25 @@ class Session:
                         "40001",
                     )
 
+    def _ha_demote(self, exc) -> None:
+        """A newer-generation peer fenced this node out: flip the
+        cluster into the demoted state (every further statement refuses
+        with 72000 until rejoin_standby resyncs it) and log loudly —
+        this IS the split-brain the fencing epoch exists to catch."""
+        c = self.cluster
+        c.ha_stats["fenced_refusals"] = (
+            c.ha_stats.get("fenced_refusals", 0) + 1
+        )
+        if not c.ha_demoted:
+            c.ha_demoted = True
+            c.log.emit(
+                "error", "ha",
+                "node fenced by a newer generation: demoting — this "
+                "ex-primary must resync before serving again",
+                our_generation=int(c.node_generation),
+                peer_generation=getattr(exc, "peer_generation", None),
+            )
+
     def _dn_2pc(self, op: str, gid: str, nodes, **extra) -> list[int]:
         """Send a 2PC control message to every participating DN process
         over its channel pool (the reference's 2PC control messages,
@@ -1697,11 +1809,18 @@ class Session:
         # DN-side 2PC spans stitch to it (executor/dist does the same
         # per fragment attempt)
         ctx = _tctx.current()
+        # fencing epoch rides every 2PC wire op: a DN that followed a
+        # promotion we missed refuses our stale generation instead of
+        # letting a partitioned ex-primary write behind the new
+        # primary's back
+        hgen = int(self.cluster.node_generation)
 
         def send(n, ch):
             prev = _tctx.bind(ctx)
             try:
-                results[n] = ch.rpc({"op": op, "gid": gid, **extra})
+                results[n] = ch.rpc(
+                    {"op": op, "gid": gid, "hgen": hgen, **extra}
+                )
             except Exception as e:  # channel failure = vote failure
                 errors.append((n, e))
             finally:
@@ -1718,6 +1837,20 @@ class Session:
             for th in ths:
                 th.join()
         if errors:
+            from opentenbase_tpu.net.pool import ChannelFenced
+
+            for n, e in errors:
+                if isinstance(e, ChannelFenced):
+                    # the DN carries a NEWER generation: a promotion
+                    # happened behind our back and this node is the
+                    # stale ex-primary. Demote NOW — not 08006: a
+                    # retry "when the network heals" would be the
+                    # split-brain write the fence exists to refuse.
+                    self._ha_demote(e)
+                    raise SQLError(
+                        f"datanode {n} fenced {op} for {gid!r}: {e}",
+                        "72000",
+                    )
             # a channel-level failure is retryable from the client's
             # side: the statement aborts whole (write paths never
             # blind-retry) and 08006 (connection_failure) tells the
@@ -1819,9 +1952,10 @@ class Session:
 
             _FAULT("coord/2pc_after_prepare", gid=implicit_gid)
         commit_ts = self.cluster.commit_ts_begin_stamping(txn.gxid)
+        commit_lsn = None
         try:
             try:
-                self._stamp_commit(
+                commit_lsn = self._stamp_commit(
                     txn, commit_ts,
                     gid=implicit_gid if shipped else None,
                     frame=frame if shipped else None,
@@ -1856,14 +1990,54 @@ class Session:
                     "2pc_commit", implicit_gid, nodes,
                     commit_ts=commit_ts,
                 )
+            except SQLError as e:
+                if e.sqlstate == "72000":
+                    # fenced at phase 2: a promotion happened mid-
+                    # commit. The commit is durable on OUR timeline —
+                    # which just died; acking it would promise a write
+                    # the promoted timeline may not have. Error out
+                    # (client treats it as indeterminate), locks first.
+                    self.cluster.locks.release_all(self.session_id)
+                    raise
             except Exception:
                 pass
         self.cluster.locks.release_all(self.session_id)
+        # synchronous_commit = on (remote_apply): the ack is withheld
+        # until every reachable attached DN standby has APPLIED this
+        # commit's OWN WAL frame — the replication guarantee the HA
+        # failover's "zero lost committed writes" invariant stands on.
+        # 2PC-shipped writes already applied on their participant DNs
+        # in phase 2; this covers the stream path (single-node txns,
+        # non-participant standbys). A write-free transaction logged
+        # nothing (commit_lsn None) and pays no wait at all; the LSN
+        # is the offset just past OUR 'G' frame, so this commit never
+        # waits on a concurrent session's replication lag.
+        if (
+            commit_lsn is not None
+            and getattr(self.cluster, "dn_channels", None)
+            and str(self.gucs.get("synchronous_commit") or "off") == "on"
+        ):
+            if not self.cluster.wait_standbys_applied(commit_lsn):
+                # the PG sync-rep cancel analog: the transaction IS
+                # committed locally, only the replication guarantee is
+                # unmet — the client must treat the outcome as
+                # indeterminate (verify before re-issuing; a blind
+                # retry would double-apply once replication heals)
+                raise SQLError(
+                    "synchronous commit: no standby confirmed apply of "
+                    f"WAL position {commit_lsn}; the transaction is "
+                    "committed locally but unreplicated — outcome "
+                    "indeterminate, verify before re-issuing",
+                    "08006",
+                )
 
     def _stamp_commit(
         self, txn: Transaction, commit_ts: int, wal_log: bool = True,
         gid=None, frame=None,
-    ) -> None:
+    ):
+        """Returns the WAL offset just past this commit's 'G' frame
+        (None when nothing was logged) — the LSN the synchronous-
+        commit wait targets."""
         # wal_log=False for explicitly-prepared txns: their writes are
         # already durable as a 'T' record, so the decision is logged as a
         # compact 'C' record instead of re-logging the rows
@@ -1876,10 +2050,11 @@ class Session:
                 if tw.del_idx:
                     idx = np.asarray(tw.del_idx, dtype=np.int64)
                     store.stamp_xmax(idx, commit_ts)
+        commit_lsn = None
         if p is not None:
             # the whole commit goes out as ONE WAL frame so a crash can
             # never replay a half-applied multi-table transaction
-            p.log_commit_group(
+            commit_lsn = p.log_commit_group(
                 [
                     (node, table, tw.ins_ranges, tw.del_idx)
                     for node, tabs in txn.writes.items()
@@ -1894,6 +2069,7 @@ class Session:
             {tb for tabs in txn.writes.values() for tb in tabs}
         )
         txn.unpin_all()
+        return commit_lsn
 
     def _abort_txn(
         self, txn: Transaction, failed_commit_ts: Optional[int] = None
@@ -2025,6 +2201,24 @@ class Session:
     def _execute_one_inner(self, stmt: A.Statement) -> Result:
         if self.cluster.paused and not isinstance(stmt, A.UnpauseCluster):
             raise SQLError("cluster is paused")
+        if self.cluster.ha_demoted:
+            # fenced ex-primary (self-healing HA): a newer-generation
+            # peer refused us, so a promotion happened behind our back.
+            # EVERY statement is refused — reads included: our stores
+            # stopped at the failover and a read served here is the
+            # split-brain stale read the fencing epoch exists to kill.
+            # Each refusal counts (otb_fenced_refusals_total): a
+            # dashboard must see clients still hammering a fenced node.
+            self.cluster.ha_stats["fenced_refusals"] = (
+                self.cluster.ha_stats.get("fenced_refusals", 0) + 1
+            )
+            raise SQLError(
+                "node is fenced: a newer generation "
+                f"({self.cluster.node_generation}+) was promoted; "
+                "demoted ex-primary must resync (rejoin_standby) "
+                "before serving",
+                "72000",
+            )
         if self.cluster.read_only and not self._is_readonly_stmt(stmt):
             # hot standby: queries yes, writes no (errcode 25006)
             raise SQLError(
@@ -4322,9 +4516,23 @@ class Session:
                     self.gucs.get("fragment_retry_backoff_ms", 25),
                     "fragment_retry_backoff_ms",
                 ),
+                node_generation=self.cluster.node_generation,
             )
             try:
-                batch = ex.run(dplan)
+                from opentenbase_tpu.net.pool import ChannelFenced
+
+                try:
+                    batch = ex.run(dplan)
+                except ChannelFenced as cf:
+                    # a DN at a newer generation refused this fragment:
+                    # we are the fenced ex-primary. The executor never
+                    # retried or failed over locally (local stores ARE
+                    # the stale copy) — demote and refuse the statement.
+                    self._ha_demote(cf)
+                    raise SQLError(
+                        f"fragment refused by fenced datanode: {cf}",
+                        "72000",
+                    ) from cf
             finally:
                 # retry accounting survives errors too: a statement
                 # that exhausted its retries should still show them
@@ -7719,10 +7927,21 @@ def _sv_cluster_health(c: Cluster):
     # executed on (the watchdog's stamp) — a tunnel loss shows here in
     # one view instead of only in a bench JSON post-mortem.
     active = sum(1 for s in c.sessions if s.state == "active")
+    # live role transitions (self-healing HA): a hot standby shows
+    # 'standby' until promotion flips it read-write ('coordinator'),
+    # and a fenced ex-primary shows 'fenced' until it resyncs
+    if getattr(c, "ha_demoted", False):
+        cn_role = "fenced"
+    elif c.read_only:
+        cn_role = "standby"
+    else:
+        cn_role = "coordinator"
+    gen = int(getattr(c, "node_generation", 0))
     rows.append((
-        "cn0", "coordinator", True, 0.0, 0, active,
+        "cn0", cn_role, True, 0.0, 0, active,
         len(_fault.armed()),
         getattr(c, "_last_device_platform", None) or "",
+        gen,
     ))
     try:
         gts_ok = (
@@ -7731,7 +7950,7 @@ def _sv_cluster_health(c: Cluster):
         )
     except Exception:
         gts_ok = False
-    rows.append(("gtm0", "gtm", bool(gts_ok), 0.0, 0, 0, 0, ""))
+    rows.append(("gtm0", "gtm", bool(gts_ok), 0.0, 0, 0, 0, "", gen))
     chans = getattr(c, "dn_channels", None) or {}
     if chans:
         c.probe_datanodes()
@@ -7741,18 +7960,23 @@ def _sv_cluster_health(c: Cluster):
         h = c._dn_health.get(n)
         if n not in chans:
             # in-process data plane: the DN *is* this process
-            rows.append((f"dn{n}", "datanode", True, 0.0, 0, 0, 0, ""))
+            rows.append((
+                f"dn{n}", "datanode", True, 0.0, 0, 0, 0, "", gen,
+            ))
             continue
         up = bool(h and h.get("ok"))
         ok_ts = (h or {}).get("ok_ts")
         age = round(now - ok_ts, 3) if ok_ts else -1.0
         lag = max(wal_pos - int((h or {}).get("applied") or 0), 0)
         rows.append((
-            f"dn{n}", "datanode", up, age,
+            f"dn{n}",
+            (h or {}).get("role") or "datanode" if up else "datanode",
+            up, age,
             lag if up else -1,
             int((h or {}).get("inflight") or 0) if up else 0,
             int((h or {}).get("armed_faults") or 0) if up else 0,
             "",
+            int((h or {}).get("generation") or 0) if up else -1,
         ))
     return rows
 
@@ -8135,6 +8359,9 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
             # the device-platform watchdog's stamp: what the last fused
             # run executed on (cn0 row; '' elsewhere / before any run)
             "device_platform": t.TEXT,
+            # fencing epoch of the node's timeline (self-healing HA):
+            # bumps on every promotion; -1 on an unreachable DN
+            "generation": t.INT8,
         },
         _sv_cluster_health,
     ),

@@ -63,6 +63,11 @@ ERRCODES: dict[str, str] = {
     # class 57 — operator intervention
     "57014": "query_canceled",
     "57P01": "admin_shutdown",
+    # class 72 — fencing (no PG class; OpenTenBase-style extension).
+    # Raised when a wire op carries a node_generation older than the
+    # receiver's: the caller is a fenced ex-primary that missed a
+    # promotion and must demote + resync instead of retrying.
+    "72000": "stale_node_generation",
     # class XX — internal error
     "XX000": "internal_error",
 }

@@ -192,6 +192,7 @@ class DistExecutor:
         session_id: int = 0,
         fragment_retries: int = 2,  # extra remote attempts per fragment
         retry_backoff_ms: float = 25.0,  # base backoff (doubles per try)
+        node_generation: int = 0,  # fencing epoch carried on wire ops
     ):
         self.catalog = catalog
         self.node_stores = node_stores
@@ -242,6 +243,12 @@ class DistExecutor:
         # 2PC/WAL path), so a re-execution can never double-apply.
         self.fragment_retries = max(int(fragment_retries or 0), 0)
         self.retry_backoff_ms = float(retry_backoff_ms or 0.0)
+        # fencing epoch (self-healing HA): every exec_fragment carries
+        # it; a DN that followed a promotion we missed refuses with a
+        # ChannelFenced, which deliberately does NOT enter the retry/
+        # failover ladder below — failing over to our own stores would
+        # serve exactly the stale read the fence forbids
+        self.node_generation = int(node_generation or 0)
         self.retry_stats = {"retries": 0, "failovers": 0, "cancels": 0}
         # monotonic per-attempt suffix for cancel tokens (see
         # _exec_remote): itertools.count is atomic under the GIL, so
@@ -376,7 +383,10 @@ class DistExecutor:
 
             def run_remote(node):
                 from opentenbase_tpu.fault import FAULT
-                from opentenbase_tpu.net.pool import ChannelError
+                from opentenbase_tpu.net.pool import (
+                    ChannelError,
+                    ChannelFenced,
+                )
                 from opentenbase_tpu.obs import tracectx as _tctx
 
                 t0 = _time.perf_counter()
@@ -425,6 +435,12 @@ class DistExecutor:
                                 qxid=qxid,
                             )
                             break
+                        except ChannelFenced:
+                            # stale-generation refusal: NOT a transient
+                            # channel failure — no retry, and above all
+                            # no failover to our own (stale) stores.
+                            # The session demotes this node on catch.
+                            raise
                         except ChannelError as ce:
                             if self.trace is not None:
                                 # the failed attempt is its own child
@@ -767,6 +783,7 @@ class DistExecutor:
             "inputs": inputs,
             "subquery_values": sq,
             "min_lsn": self.min_lsn,
+            "hgen": self.node_generation,
         }
         if self.parallel_workers > 1:
             msg["parallel"] = self.parallel_workers

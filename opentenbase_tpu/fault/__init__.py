@@ -67,6 +67,9 @@ __all__ = [
     "armed",
     "site_rng",
     "wait_rows",
+    "set_chaos_seed",
+    "chaos_seed",
+    "chaos_rng",
 ]
 
 
@@ -136,8 +139,12 @@ class _Fault:
         if self.trigger == "after":
             return self.hits > self.n
         # prob(p, seed): one deterministic draw per hit — replaying the
-        # same seed replays the same fire/skip pattern exactly
-        return self._rng.random() < self.p
+        # same seed replays the same fire/skip pattern exactly. Inside
+        # an active chaos schedule the draw comes from the schedule's
+        # own per-site stream instead, so the WHOLE run replays from
+        # the one schedule seed (fault/schedule.py).
+        rng = chaos_rng(f"fault/{self.site}") or self._rng
+        return rng.random() < self.p
 
     def _matches(self, ctx: dict) -> bool:
         if not self.filters:
@@ -222,6 +229,55 @@ class _Fault:
         if self.action in ("delay", "hang"):
             return f"{self.action}({self.ms})"
         return self.action
+
+
+# -- chaos-schedule RNG (fault/schedule.py) -----------------------------
+# While a seeded chaos run is active, EVERY source of randomness the
+# fault plane touches — prob(p) fault draws armed without an explicit
+# seed, connect_with_retry's backoff jitter (net/client.py), the
+# schedule's own event/traffic choices — derives from ONE schedule seed
+# so a failing run replays from that seed alone. Per-NAME child streams
+# (not one shared stream) keep the replay honest under threads: each
+# named consumer draws its own deterministic sequence regardless of how
+# the OS interleaves them.
+_CHAOS_SEED: Optional[int] = None
+_chaos_rngs: dict = {}
+# own lock, NOT _mu: chaos_rng is consulted from inside
+# _Fault._should_fire, which already runs under _mu
+_chaos_mu = threading.Lock()
+
+
+def set_chaos_seed(seed: Optional[int]) -> None:
+    """Arm (or, with None, disarm) the schedule-owned RNG plane. Also
+    resets the derived per-name streams so a re-run of the same seed
+    replays the same draw sequences."""
+    global _CHAOS_SEED
+    with _chaos_mu:
+        _CHAOS_SEED = seed
+        _chaos_rngs.clear()
+
+
+def chaos_seed() -> Optional[int]:
+    return _CHAOS_SEED
+
+
+def chaos_rng(name: str) -> Optional[random.Random]:
+    """The deterministic child stream for ``name`` (None when no chaos
+    run is active). The child seed mixes the schedule seed with the
+    name through a stable hash — Python's builtin hash() is salted per
+    process and would break replay."""
+    if _CHAOS_SEED is None:
+        return None
+    with _chaos_mu:
+        if _CHAOS_SEED is None:
+            return None
+        rng = _chaos_rngs.get(name)
+        if rng is None:
+            import zlib
+
+            child = (_CHAOS_SEED << 32) ^ zlib.crc32(name.encode())
+            rng = _chaos_rngs[name] = random.Random(child)
+        return rng
 
 
 # site -> _Fault. THE hot-path gate: empty and untouched unless an
@@ -396,8 +452,12 @@ def stats() -> list:
 def site_rng(site: str) -> random.Random:
     """The armed fault's deterministic RNG (site-handled actions like
     wal_torn use it to pick byte-arbitrary tear positions so a seeded
-    chaos run replays identically); a fresh seeded RNG if the fault has
-    none."""
+    chaos run replays identically); inside an active chaos schedule,
+    the schedule's per-site stream; else a fresh seeded RNG if the
+    fault has none."""
+    rng = chaos_rng(f"fault/{site}")
+    if rng is not None:
+        return rng
     f = _ARMED.get(site)
     if f is not None and f._rng is not None:
         return f._rng

@@ -166,6 +166,24 @@ class NativeGTS:
         gtm_ctl reconfigure, or the gtm_standby_addr GUC at startup."""
         self._standby = (str(host), int(port))
 
+    def repoint(self, host: str, port: int) -> None:
+        """Re-point the client at a NEW primary GTM (the ha.py
+        controller's GTM-routing half of a failover: the promoted
+        GTM's frontend becomes THE primary, not merely a failover
+        candidate). The next RPC reconnects there; the old primary is
+        forgotten so a later retry ladder cannot wander back to the
+        fenced node. Capability is re-probed on the new endpoint."""
+        self.host, self.port = str(host), int(port)
+        self._primary = (self.host, self.port)
+        self._traced_capable = None
+        # leave the DEAD socket in place (not None): the next RPC's
+        # sendall raises OSError into _failover_rpc, which reconnects
+        # against the new primary address set above
+        try:
+            shutdown_and_close(self._sock)
+        except OSError:
+            pass
+
     # -- lifecycle -------------------------------------------------------
     @staticmethod
     def spawn(state_dir: str, port: int = 0) -> "NativeGTS":

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, Optional
 
+from opentenbase_tpu.fault import FAULT
 from opentenbase_tpu.obs import tracectx as _tctx
 
 
@@ -91,6 +92,10 @@ class GTSClock:
         self._advance_watermark()
 
     def _advance_watermark(self) -> None:
+        # failpoint: the reserve-ahead durability write — an error here
+        # is a GTM whose clock store fsync failed (a promoted standby's
+        # clock must still resume above the watermark)
+        FAULT("gtm/watermark")
         self._watermark = self._last + self.RESERVE
         if self._store_path:
             tmp = self._store_path + ".tmp"
@@ -217,6 +222,9 @@ class GTSServer:
 
     # -- node registration (recovery/register_gtm.c) --------------------
     def _persist_nodes(self) -> None:
+        # failpoint: node-registry durability (the re-registration a
+        # promotion performs crosses this on its GTM re-point path)
+        FAULT("gtm/persist_nodes")
         if self._nodes_path is None:
             return
         tmp = self._nodes_path + ".tmp"

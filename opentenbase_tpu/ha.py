@@ -1,0 +1,574 @@
+"""Self-healing HA: failure detector, automatic standby promotion,
+fencing epochs, and post-failover resync — the brain that wires the
+existing ingredients together.
+
+The reference survives node loss with a constellation of mechanisms:
+GTM standby promotion (``gtm_standby.c``), DN/CN HA via streaming
+replication + ``pg_rewind``, and ``clean2pc`` for in-doubt
+transactions. This module is the missing controller (the pgxc_ctl /
+Patroni role): it watches the primary's heartbeats, declares it dead
+after a configurable budget, drives ``StandbyCluster.promote()`` on
+the most-caught-up standby, re-points client routing and the WAL
+stream of every surviving standby at the promoted node, re-runs the
+in-doubt 2PC resolver against the promoted WAL, and later rewinds the
+ex-primary back in as a standby (``storage/replication.rejoin_standby``).
+
+Topology (the shape tests and the chaos harness build):
+
+    primary Cluster ──ClusterServer── clients (RoutingClient)
+        │ WalSender
+        ├──────────────► DNServer 0 (StandbyCluster; candidate)
+        └──────────────► DNServer 1 (StandbyCluster; candidate)
+
+Every DN server is simultaneously the executor for its mesh node AND a
+full hot standby of the coordinator's WAL — so ANY of them can take
+over. Promotion bumps a WAL-durable fencing generation
+(``node_generation``); wire ops carry it, a stale peer is refused with
+SQLSTATE 72000 and demotes itself (engine.Session._ha_demote), and the
+walsender handshake refuses cross-timeline follows. Split-brain is a
+refused RPC, not silent divergence.
+
+Correctness notes the invariants stand on:
+
+- **Zero lost committed writes** requires ``synchronous_commit = on``
+  in the topology's conf: a commit acks only after every reachable DN
+  standby APPLIED its WAL position, so whichever standby the monitor
+  promotes (it picks the max-``applied`` reachable one) contains every
+  acked write.
+- The promoted WAL is complete w.r.t. the promoted stores: promote()
+  truncates the torn stream tail and re-logs direct-applied 2PC
+  commits whose 'G' frame never streamed.
+- In-doubt 2PC reaches its recorded decision: the resolver runs
+  against the promoted WAL's ``gid_decision`` map — commit records
+  replay phase 2, absence is presumed abort.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+from opentenbase_tpu.fault import FAULT
+from opentenbase_tpu.net.protocol import (
+    recv_frame,
+    send_frame,
+    shutdown_and_close,
+)
+
+
+def _probe_ping(host: str, port: int, timeout_s: float = 0.5):
+    """One liveness probe against a ClusterServer: fresh socket, no
+    retries (a dead primary must answer 'down' in one refused connect,
+    exactly like probe_datanodes), tiny deadline."""
+    # failpoint: the failure detector's own probe path — delay models a
+    # slow network making a live primary look dead (false-positive
+    # pressure), drop_conn a probe eaten by the partition
+    FAULT("ha/probe", host=host, port=port)
+    sock = socket.create_connection((host, port), timeout=timeout_s)
+    try:
+        sock.settimeout(timeout_s)
+        send_frame(sock, {"op": "ping"})
+        resp = recv_frame(sock)
+        if resp is None or not resp.get("ok"):
+            return None
+        return resp
+    finally:
+        shutdown_and_close(sock)
+
+
+class HATopology:
+    """One self-healing deployment: primary coordinator + N datanode
+    server processes that double as promotion candidates, plus the
+    bookkeeping failover needs (active address, generation, the
+    ex-primary's data_dir for the eventual rewind).
+
+    ``conf_gucs`` is written to EVERY node's opentenbase.conf before
+    construction, so the primary's sessions and any promoted
+    standby's sessions run under the same settings (synchronous_commit
+    in particular must survive a failover)."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        num_datanodes: int = 2,
+        shard_groups: int = 32,
+        conf_gucs: Optional[dict] = None,
+        rpc_timeout: float = 30.0,
+        wal_poll_s: float = 0.01,
+    ):
+        from opentenbase_tpu.dn.server import DNServer
+        from opentenbase_tpu.engine import Cluster
+        from opentenbase_tpu.net.server import ClusterServer
+        from opentenbase_tpu.storage.replication import WalSender
+
+        self.data_dir = data_dir
+        self.num_datanodes = num_datanodes
+        self.shard_groups = shard_groups
+        self.conf_gucs = dict(conf_gucs or {})
+        self._mu = threading.Lock()
+        self.events: list[dict] = []
+        dirs = [os.path.join(data_dir, "cn")] + [
+            os.path.join(data_dir, f"dn{i}") for i in range(num_datanodes)
+        ]
+        for d in dirs:
+            os.makedirs(d, exist_ok=True)
+            if self.conf_gucs:
+                with open(os.path.join(d, "opentenbase.conf"), "w") as f:
+                    for k, v in sorted(self.conf_gucs.items()):
+                        if isinstance(v, bool):
+                            v = "on" if v else "off"
+                        f.write(f"{k} = {v}\n")
+        self.primary_data_dir = dirs[0]
+        self.primary = Cluster(
+            num_datanodes, shard_groups, self.primary_data_dir
+        )
+        self.server = ClusterServer(self.primary).start()
+        self.sender = WalSender(self.primary.persistence, poll_s=wal_poll_s)
+        self.dns: list = []
+        for i in range(num_datanodes):
+            dn = DNServer(
+                dirs[1 + i], self.sender.host, self.sender.port,
+                num_datanodes, shard_groups,
+            ).start()
+            self.dns.append(dn)
+            self.primary.attach_datanode(
+                i, "127.0.0.1", dn.port, pool_size=2,
+                rpc_timeout=rpc_timeout,
+            )
+        self.generation = 0
+        self.primary_dead = False
+        self._active_cluster = self.primary
+        self._active_addr = (self.server.host, self.server.port)
+        self._active_wal = (self.sender.host, self.sender.port)
+        self.promoted_index: Optional[int] = None
+        self.ex_primary_server = None  # fencing-probe revival
+        self.ex_primary_standby = None  # post-rejoin StandbyCluster
+
+    # -- addresses --------------------------------------------------------
+    def active_address(self) -> tuple[str, int]:
+        with self._mu:
+            return self._active_addr
+
+    def active_wal_address(self) -> tuple[str, int]:
+        with self._mu:
+            return self._active_wal
+
+    @property
+    def active_cluster(self):
+        with self._mu:
+            return self._active_cluster
+
+    def _note(self, kind: str, **fields) -> dict:
+        rec = {"kind": kind, "t": time.time(), **fields}
+        self.events.append(rec)
+        return rec
+
+    # -- probing ----------------------------------------------------------
+    def probe_primary(self, timeout_s: float = 0.5):
+        host, port = self.active_address()
+        try:
+            return _probe_ping(host, port, timeout_s)
+        except Exception:
+            return None
+
+    def dn_ping(self, i: int, timeout_s: float = 2.0):
+        from opentenbase_tpu.net.pool import Channel
+
+        try:
+            ch = Channel(
+                "127.0.0.1", self.dns[i].port, timeout=timeout_s,
+                connect_retries=0,
+            )
+            try:
+                return ch.rpc({"op": "ping"}, timeout_s=timeout_s)
+            finally:
+                ch.close()
+        except Exception:
+            return None
+
+    def _dn_rpc(self, i: int, msg: dict, timeout_s: float = 15.0):
+        from opentenbase_tpu.net.pool import Channel
+
+        ch = Channel(
+            "127.0.0.1", self.dns[i].port, timeout=timeout_s,
+            connect_retries=1,
+        )
+        try:
+            return ch.rpc(msg, timeout_s=timeout_s)
+        finally:
+            ch.close()
+
+    # -- chaos: primary death --------------------------------------------
+    def crash_primary(self) -> None:
+        """Kill the coordinator the way a chaos harness can inside one
+        process: sever every client, cut the WAL stream mid-chunk, and
+        close its DN channel pools. The Cluster object itself stays
+        open — it is the 'disk + frozen process' the fencing probe
+        revives and rejoin_ex_primary later rewinds."""
+        with self._mu:
+            if self.primary_dead:
+                return
+            self.primary_dead = True
+        self._note("crash_primary")
+        try:
+            self.sender.stop()
+        except Exception:
+            pass
+        try:
+            self.server.stop()
+        except Exception:
+            pass
+        for pool in list(self.primary.dn_channels.values()):
+            try:
+                pool.close()
+            except Exception:
+                pass
+
+    # -- failover ---------------------------------------------------------
+    def failover(self, reason: str = "") -> dict:
+        """Drive the promotion sequence. Idempotent-ish: once a
+        candidate promoted, later calls return the recorded state.
+        Steps (each one auditable in ``events``):
+
+        1. pick the reachable candidate with the highest applied LSN;
+        2. ``promote`` it with the bumped fencing generation (a kill
+           inside this window — the dn/promote failpoint — moves the
+           loop to the next-best candidate);
+        3. ``repl_repoint`` every surviving standby at the promoted
+           node's walsender (truncate-torn-tail + re-stream from own
+           offset);
+        4. attach the survivors to the promoted cluster as datanode
+           channels and re-run the in-doubt 2PC resolver against the
+           promoted WAL;
+        5. flip client routing to the promoted SQL port.
+        """
+        # failpoint: the controller's own failover path (error = a
+        # controller crash mid-failover; the next monitor beat retries)
+        FAULT("ha/failover")
+        with self._mu:
+            if self.promoted_index is not None:
+                return {"ok": True, "already": True,
+                        "promoted": self.promoted_index}
+            gen = self.generation + 1
+        rec = self._note("failover_start", reason=reason, generation=gen)
+        cands = []
+        for i in range(len(self.dns)):
+            p = self.dn_ping(i)
+            if p and p.get("ok"):
+                cands.append((int(p.get("applied") or 0), i))
+        cands.sort(reverse=True)
+        rec["candidates"] = [i for _a, i in cands]
+        promoted = None
+        for _applied, i in cands:
+            try:
+                resp = self._dn_rpc(
+                    i, {"op": "promote", "generation": gen, "hgen": gen},
+                )
+                if resp.get("ok"):
+                    promoted = (i, resp)
+                    break
+            except Exception as e:
+                # the promotion-window kill: candidate died (or errored)
+                # mid-promote — fall through to the next-best candidate
+                self._note(
+                    "promote_failed", candidate=i, error=str(e)[:200],
+                )
+        if promoted is None:
+            self._note("failover_failed", reason="no candidate promoted")
+            return {"ok": False, "error": "no candidate promoted"}
+        i, resp = promoted
+        dn = self.dns[i]
+        newc = dn.standby.cluster
+        wal_port = int(resp.get("wal_port") or 0)
+        self._note(
+            "promoted", node=i, generation=int(resp["generation"]),
+            promote_lsn=int(resp.get("promote_lsn") or 0),
+            sql_port=int(resp["port"]), wal_port=wal_port,
+        )
+        # resync survivors onto the new timeline, then attach them as
+        # the promoted coordinator's datanode channels
+        for j in range(len(self.dns)):
+            if j == i:
+                continue
+            try:
+                rp = self._dn_rpc(j, {
+                    "op": "repl_repoint", "wal_host": "127.0.0.1",
+                    "wal_port": wal_port, "hgen": int(resp["generation"]),
+                })
+                if rp.get("ok"):
+                    self._note(
+                        "repointed", node=j,
+                        applied=int(rp.get("applied") or 0),
+                    )
+                else:
+                    self._note("repoint_failed", node=j,
+                               error=str(rp.get("error"))[:200])
+            except Exception as e:
+                self._note("repoint_failed", node=j, error=str(e)[:200])
+            try:
+                newc.attach_datanode(
+                    j, "127.0.0.1", self.dns[j].port, pool_size=2,
+                )
+            except Exception as e:
+                self._note("attach_failed", node=j, error=str(e)[:200])
+        # in-doubt 2PC: the promoted node's OWN vote journals first
+        # (they are not reachable over its channels — it IS the node),
+        # then the wire resolver for the survivors. Decisions come
+        # from the promoted WAL: present = commit, absent = presumed
+        # abort — in-flight commits reach their recorded decision.
+        own = 0
+        try:
+            for e in dn._twophase_list():
+                gid = e["gid"]
+                d = newc.persistence.gid_decision(gid)
+                if d is not None and d[0] == "commit":
+                    dn._twophase_finish(
+                        {"gid": gid, "commit_ts": d[1]}, committed=True,
+                    )
+                else:
+                    dn._twophase_finish({"gid": gid}, committed=False)
+                own += 1
+        except Exception as e:
+            self._note("own_indoubt_failed", error=str(e)[:200])
+        resolved = []
+        try:
+            resolved = newc.resolve_indoubt()
+        except Exception as e:
+            self._note("resolve_indoubt_failed", error=str(e)[:200])
+        self._note(
+            "indoubt_resolved", own_journals=own,
+            resolved=[list(r) for r in resolved],
+        )
+        with self._mu:
+            self.generation = int(resp["generation"])
+            self.promoted_index = i
+            self._active_cluster = newc
+            self._active_addr = ("127.0.0.1", int(resp["port"]))
+            if wal_port:
+                self._active_wal = ("127.0.0.1", wal_port)
+        self._note("failover_done", node=i)
+        return {"ok": True, "promoted": i, "port": int(resp["port"]),
+                "generation": int(resp["generation"])}
+
+    # -- ex-primary: fencing probe + rejoin ------------------------------
+    def revive_ex_primary(self):
+        """Bring the dead coordinator 'process' back up WITHOUT
+        resyncing it — the split-brain scenario the fencing epochs
+        exist for. It reconnects to its configured datanodes and
+        reopens its SQL port; the first op it sends carries its stale
+        generation and gets refused (72000), demoting it."""
+        from opentenbase_tpu.net.server import ClusterServer
+
+        for i, dn in enumerate(self.dns):
+            self.primary.attach_datanode(
+                i, "127.0.0.1", dn.port, pool_size=2,
+            )
+        self.ex_primary_server = ClusterServer(self.primary).start()
+        self._note("ex_primary_revived",
+                   port=self.ex_primary_server.port)
+        return self.ex_primary_server
+
+    def rejoin_ex_primary(self):
+        """Post-failover resync: rewind the ex-primary's data_dir
+        against the promoted node's timeline and re-stream — it comes
+        back as the new standby (role transition primary -> standby)."""
+        from opentenbase_tpu.storage.replication import rejoin_standby
+
+        if self.ex_primary_server is not None:
+            try:
+                self.ex_primary_server.stop()
+            except Exception:
+                pass
+            self.ex_primary_server = None
+        for pool in list(self.primary.dn_channels.values()):
+            try:
+                pool.close()
+            except Exception:
+                pass
+        self.primary.dn_channels.clear()
+        # release the dead process's file handles before the rewind
+        # truncates its WAL (two writers on one log never end well)
+        try:
+            self.primary.close()
+        except Exception:
+            pass
+        host, port = self.active_wal_address()
+        sb = rejoin_standby(
+            self.primary_data_dir, host, port,
+            self.num_datanodes, self.shard_groups,
+        )
+        self.ex_primary_standby = sb
+        self._note("ex_primary_rejoined", applied=sb.applied)
+        return sb
+
+    # -- teardown ---------------------------------------------------------
+    def stop(self) -> None:
+        if self.ex_primary_server is not None:
+            try:
+                self.ex_primary_server.stop()
+            except Exception:
+                pass
+        if self.ex_primary_standby is not None:
+            try:
+                self.ex_primary_standby.stop()
+            except Exception:
+                pass
+            try:
+                self.ex_primary_standby.cluster.close()
+            except Exception:
+                pass
+        if not self.primary_dead:
+            try:
+                self.server.stop()
+            except Exception:
+                pass
+            try:
+                self.sender.stop()
+            except Exception:
+                pass
+        for c in ({self.active_cluster, self.primary}):
+            for pool in list(getattr(c, "dn_channels", {}).values()):
+                try:
+                    pool.close()
+                except Exception:
+                    pass
+        for dn in self.dns:
+            try:
+                dn.stop()
+            except Exception:
+                pass
+        for c in ({self.active_cluster, self.primary}):
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+class HAMonitor:
+    """The failure detector + auto-promotion loop (clustermon's probe
+    cadence, Patroni's decision rule). Probes the active coordinator
+    every ``failover_detect_ms / failover_beats`` milliseconds; after
+    ``failover_beats`` CONSECUTIVE missed beats it declares the
+    primary dead and drives ``HATopology.failover()``. A single missed
+    beat (GC pause, dropped packet) never promotes."""
+
+    def __init__(
+        self,
+        topology: HATopology,
+        detect_ms: Optional[int] = None,
+        beats: Optional[int] = None,
+    ):
+        conf = topology.conf_gucs
+        if detect_ms is None:
+            detect_ms = int(conf.get("failover_detect_ms") or 3000)
+        if beats is None:
+            beats = int(conf.get("failover_beats") or 3)
+        self.topology = topology
+        self.detect_ms = int(detect_ms)
+        self.beats = max(int(beats), 1)
+        self.interval_s = self.detect_ms / self.beats / 1000.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.misses = 0
+        self.declared_dead_at: Optional[float] = None
+        self.promotions = 0
+        self.last_failover: Optional[dict] = None
+
+    def start(self) -> "HAMonitor":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._beat()
+            except Exception as e:
+                self.topology._note("monitor_error", error=str(e)[:200])
+
+    def _beat(self) -> None:
+        topo = self.topology
+        if topo.promoted_index is not None:
+            return  # already failed over; this monitor's job is done
+        probe = topo.probe_primary(timeout_s=min(self.interval_s, 0.5))
+        if probe is not None:
+            self.misses = 0
+            return
+        self.misses += 1
+        if self.misses < self.beats:
+            return
+        if self.declared_dead_at is None:
+            self.declared_dead_at = time.time()
+            topo._note(
+                "declared_dead", misses=self.misses,
+                detect_ms=self.detect_ms, beats=self.beats,
+            )
+        # drive the failover; on a failed attempt (e.g. every candidate
+        # currently crashed) keep retrying each beat until one succeeds
+        res = topo.failover(
+            reason=f"{self.misses} consecutive missed beats"
+        )
+        self.last_failover = res
+        if res.get("ok") and not res.get("already"):
+            self.promotions += 1
+
+
+class RoutingClient:
+    """Client routing that follows the active coordinator: a thin
+    ClientSession wrapper that re-resolves ``HATopology.active_address``
+    whenever its connection dies or the server answers with the fenced
+    SQLSTATE (72000 — it connected to a stale ex-primary). Statement
+    errors are NOT retried here: the caller decides (a chaos writer
+    records them as indeterminate; a reader just skips a beat)."""
+
+    def __init__(self, topology: HATopology, timeout: float = 15.0):
+        self.topology = topology
+        self.timeout = timeout
+        self._sess = None
+
+    def _drop(self) -> None:
+        if self._sess is not None:
+            try:
+                self._sess.close()
+            except Exception:
+                pass
+            self._sess = None
+
+    def _ensure(self):
+        from opentenbase_tpu.net.client import ClientSession
+
+        if self._sess is None:
+            host, port = self.topology.active_address()
+            self._sess = ClientSession(
+                host, port, timeout=self.timeout, connect_retries=1,
+            )
+        return self._sess
+
+    def execute(self, sql: str):
+        from opentenbase_tpu.net.client import WireError
+
+        try:
+            return self._ensure().execute(sql)
+        except WireError as e:
+            if getattr(e, "sqlstate", None) == "72000":
+                self._drop()  # stale node: re-resolve on next call
+            elif "connection closed" in str(e):
+                self._drop()
+            raise
+        except OSError:
+            self._drop()
+            raise
+
+    def query(self, sql: str):
+        return self.execute(sql).rows
+
+    def close(self) -> None:
+        self._drop()

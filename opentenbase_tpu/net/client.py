@@ -80,9 +80,17 @@ def connect_with_retry(
             if i == attempts - 1:
                 break
             # full jitter on an exponential base: concurrent clients
-            # hammering a restarting node must not reconnect in lockstep
+            # hammering a restarting node must not reconnect in lockstep.
+            # Under an active chaos schedule the jitter draw comes from
+            # the schedule's per-destination stream, so a failing run
+            # replays its reconnect timing from the one seed
+            # (fault/schedule.py satellite).
+            from opentenbase_tpu.fault import chaos_rng
+
+            rng = chaos_rng(f"net/client/backoff:{host}:{port}")
             delay = min(backoff_s * (2 ** i), backoff_max_s)
-            time.sleep(delay * (0.5 + random.random() * 0.5))
+            draw = (rng.random() if rng is not None else random.random())
+            time.sleep(delay * (0.5 + draw * 0.5))
     raise RetryExhausted(
         f"connect to {host}:{port} failed after {made} "
         f"attempt(s): {last}"

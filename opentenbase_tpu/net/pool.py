@@ -78,6 +78,16 @@ class Channel:
             self.broken = True
             raise ChannelError("channel closed by peer")
         if "error" in resp:
+            if resp.get("fenced"):
+                # fencing-epoch refusal (self-healing HA): the peer
+                # carries a NEWER node_generation than this caller —
+                # we are a stale ex-primary. This must never look like
+                # a transient channel failure: retry/failover would
+                # serve stale data, so it gets its own type the
+                # executor and 2PC fan-out treat as "demote now".
+                raise ChannelFenced(
+                    resp["error"], peer_generation=resp.get("gen"),
+                )
             raise ChannelError(resp["error"])
         return resp
 
@@ -89,6 +99,20 @@ class Channel:
 
 class ChannelError(RuntimeError):
     pass
+
+
+class ChannelFenced(ChannelError):
+    """The peer refused the op because our node_generation is stale
+    (we are an ex-primary that missed a promotion). Carries the peer's
+    generation so the caller can record how far behind it is. NOT a
+    retryable failure: the only legal reaction is to demote and
+    resync (SQLSTATE 72000, errcodes.py stale_node_generation)."""
+
+    sqlstate = "72000"
+
+    def __init__(self, msg: str, peer_generation=None):
+        super().__init__(msg)
+        self.peer_generation = peer_generation
 
 
 class ChannelPool:

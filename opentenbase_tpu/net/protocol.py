@@ -72,6 +72,38 @@ def shutdown_and_close(sock: socket.socket) -> None:
         pass
 
 
+# -- streaming-replication handshake (storage/replication.py) ----------
+# The walreceiver opens with 16 bytes (start offset, its cluster's
+# node_generation); the walsender answers 16 bytes (ITS generation, its
+# timeline base a.k.a. promote_lsn) before any WAL byte flows. A probe
+# (offset = REPL_PROBE) gets the header and an immediate close — the
+# rejoin path uses it to learn how far to truncate a diverged WAL.
+# Shared here so sender and receiver can never drift apart on layout.
+
+REPL_PROBE = -1
+_REPL_HELLO = "<qq"
+REPL_HELLO_LEN = struct.calcsize(_REPL_HELLO)
+
+
+def pack_repl_hello(a: int, b: int) -> bytes:
+    return struct.pack(_REPL_HELLO, a, b)
+
+
+def unpack_repl_hello(data: bytes) -> tuple[int, int]:
+    return struct.unpack(_REPL_HELLO, data)
+
+
+def recv_repl_hello(sock: socket.socket) -> tuple[int, int]:
+    """Read one complete hello off the wire (short TCP reads handled);
+    raises ConnectionError when the peer closes mid-handshake. THE one
+    receive path for both hello directions — walsender, walreceiver,
+    and the rejoin probe all sit on it."""
+    data = _recv_exact(sock, REPL_HELLO_LEN)
+    if data is None:
+        raise ConnectionError("peer closed during replication handshake")
+    return unpack_repl_hello(data)
+
+
 def encode_frame(obj: dict) -> bytes:
     """Serialize a frame WITHOUT touching the socket. Callers that must
     stay exception-safe around pooled channels (net/pool.py) encode

@@ -192,6 +192,26 @@ class ClusterServer:
                 if msg.get("op") == "close":
                     send_frame(conn, {"ok": True})
                     break
+                if msg.get("op") == "ping":
+                    # liveness probe (ha.py failure detector): answered
+                    # before auth — a heartbeat must not need
+                    # credentials — and carries the fencing generation
+                    # + live role so a probe doubles as a health row
+                    c = self.cluster
+                    if getattr(c, "ha_demoted", False):
+                        role = "fenced"
+                    elif c.read_only:
+                        role = "standby"
+                    else:
+                        role = "coordinator"
+                    send_frame(conn, {
+                        "ok": True,
+                        "role": role,
+                        "generation": int(
+                            getattr(c, "node_generation", 0)
+                        ),
+                    })
+                    continue
                 if msg.get("op") == "auth":
                     authed = self._scram_exchange(conn, msg)
                     if authed:

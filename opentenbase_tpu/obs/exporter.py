@@ -173,6 +173,28 @@ def render_cluster_metrics(cluster) -> str:
         int(heal.get("failovers", 0)),
     ))
 
+    # self-healing HA: the fencing epoch + failover counters — a
+    # promotion is visible on the very next scrape (the generation
+    # gauge steps, the promotions counter bumps on the promoted node)
+    _head(out, "otb_node_generation", "gauge",
+          "Fencing generation of this node's timeline")
+    out.append(_line(
+        "otb_node_generation", {},
+        int(getattr(cluster, "node_generation", 0)),
+    ))
+    ha = dict(getattr(cluster, "ha_stats", None) or {})
+    _head(out, "otb_promotions_total", "counter",
+          "Standby promotions performed by this node")
+    out.append(_line(
+        "otb_promotions_total", {}, int(ha.get("promotions", 0)),
+    ))
+    _head(out, "otb_fenced_refusals_total", "counter",
+          "Statements refused after this node was fenced out")
+    out.append(_line(
+        "otb_fenced_refusals_total", {},
+        int(ha.get("fenced_refusals", 0)),
+    ))
+
     # matview counters
     if cluster.matviews:
         _head(out, "otb_matview_refreshes_total", "counter",

@@ -135,6 +135,12 @@ def rewind(target_dir: str, source_dir: str) -> dict:
     WAL at the divergence point, append the source's tail, and adopt the
     source's checkpoint state when the divergence predates the target's
     checkpoint (whose snapshot could contain diverged rows)."""
+    from opentenbase_tpu.fault import FAULT
+
+    # failpoint: the divergence repair itself (an error mid-rewind
+    # must leave the target recoverable — truncate+append is ordered
+    # so a partial tail copy is re-runnable)
+    FAULT("storage/rewind")
     twal = os.path.join(target_dir, "wal.log")
     swal = os.path.join(source_dir, "wal.log")
     div = find_divergence(twal, swal)

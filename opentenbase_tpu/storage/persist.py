@@ -281,7 +281,7 @@ class ClusterPersistence:
 
     def log_commit_group(
         self, writes, stores, commit_ts: int, gid=None, frame=None
-    ) -> None:
+    ) -> Optional[int]:
         """Log one committed transaction as ONE frame ('G'): a commit that
         touches many tables/nodes must be atomic under the torn-tail rule,
         which holds per frame — per-table records would replay a torn,
@@ -297,7 +297,11 @@ class ClusterPersistence:
         standby that direct-applied the prepared data skip this frame
         (exactly-once across the two delivery paths). ``frame``: the
         (sub, arrays) encoding when the caller already built it for the
-        shipped payload — avoids encoding the write set twice."""
+        shipped payload — avoids encoding the write set twice.
+
+        Returns the WAL offset just past this commit's 'G' frame (None
+        when the transaction wrote nothing) — the exact LSN a
+        synchronous_commit=on ack must see applied on the standbys."""
         sub, arrays = (
             frame if frame is not None
             else encode_commit_group(writes, stores)
@@ -308,9 +312,11 @@ class ClusterPersistence:
             header = {"commit_ts": commit_ts, "writes": sub}
             if gid is not None:
                 header["gid"] = gid
-            self.wal.append(b"G", header, arrays or None)
+            end = self.wal.append(b"G", header, arrays or None)
             if gid is not None:
                 self._record_decision(gid, "commit", commit_ts)
+            return end
+        return None
 
     def log_barrier(self, name: str, ts: int) -> None:
         self.wal.append(b"B", {"name": name, "ts": ts})
@@ -429,6 +435,13 @@ class ClusterPersistence:
             )
 
     def _checkpoint_inner(self, c, gen: int, prog) -> None:
+        from opentenbase_tpu.fault import FAULT
+
+        # failpoint distinct from storage/checkpoint (the entry gate):
+        # this one sits where the snapshot files + meta fsyncs happen,
+        # so an injected I/O failure mid-checkpoint leaves the previous
+        # generation's json untouched — recovery must still work
+        FAULT("storage/checkpoint_write", gen=gen)
         prep_ranges: dict[tuple[int, str], list[tuple[int, int]]] = {}
         for txn in getattr(c, "_prepared", {}).values():
             for node, tabs in txn.writes.items():
@@ -475,6 +488,10 @@ class ClusterPersistence:
             },
             "users": c.users,
             "wlm": c.wlm.dump_state(),
+            # fencing epoch: a checkpoint at wal_position P covers every
+            # ha_generation record below P, so recovery-from-checkpoint
+            # must restore the generation the replayed tail won't
+            "node_generation": int(getattr(c, "node_generation", 0)),
         }
         done = 0
         for name in c.catalog.table_names():
@@ -719,6 +736,9 @@ class ClusterPersistence:
 
     def _restore_checkpoint(self, meta: dict) -> None:
         self.cluster.users.update(meta.get("users", {}))
+        g = int(meta.get("node_generation", 0))
+        if g > int(getattr(self.cluster, "node_generation", 0)):
+            self.cluster.node_generation = g
         if meta.get("wlm"):
             self.cluster.wlm.load_state(meta["wlm"])
         import numpy as np
@@ -1070,6 +1090,13 @@ class ClusterPersistence:
                     node = c.nodes.get(header["name"])
                     c.nodes.drop_node(header["name"], force=True)
                     c.stores.pop(getattr(node, "mesh_index", -1), None)
+            elif op == "ha_generation":
+                # fencing epoch (self-healing HA): a promotion bumped
+                # the timeline's generation. Monotone max — replay
+                # must never regress a generation learned elsewhere.
+                g = int(header.get("generation", 0))
+                if g > int(getattr(c, "node_generation", 0)):
+                    c.node_generation = g
             elif op == "audit_state":
                 c.audit.load_state(header["payload"])
             elif op == "wlm_state":

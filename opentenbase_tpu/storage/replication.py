@@ -19,19 +19,40 @@ is one ordered file of self-framed records, so the analog is direct:
 
 The standby requests from ITS OWN offset, so restart/resync is just
 reconnecting (the streaming-replication restart_lsn contract).
+
+Self-healing HA additions (ha.py drives these):
+
+- The handshake carries **fencing generations** both ways: the receiver
+  announces its cluster's ``node_generation``, the sender answers with
+  its own plus its timeline base (``promote_lsn``). A standby refuses to
+  follow a sender with an OLDER generation — the revived ex-primary's
+  walsender cannot re-capture its former standbys (split-brain becomes
+  a refused handshake).
+- ``promote(generation=...)`` additionally truncates the torn stream
+  tail back to the last complete record, re-logs direct-applied 2PC
+  transactions whose 'G' frame never streamed (so the promoted WAL is
+  complete w.r.t. the promoted stores), and WAL-logs the bumped
+  generation as a durable ``ha_generation`` record.
+- ``rejoin_standby()`` is the pg_rewind analog: probe the new primary's
+  timeline base, truncate the diverged local WAL past it, rebuild, and
+  re-stream from the (now shared-history) offset.
 """
 
 from __future__ import annotations
 
 import os
 import socket
-import struct
 import threading
 import time
 from typing import Optional
 
 from opentenbase_tpu.fault import FAULT, site_rng
-from opentenbase_tpu.net.protocol import shutdown_and_close
+from opentenbase_tpu.net.protocol import (
+    REPL_PROBE,
+    pack_repl_hello,
+    recv_repl_hello,
+    shutdown_and_close,
+)
 from opentenbase_tpu.storage.persist import WAL
 
 
@@ -73,12 +94,38 @@ class WalSender:
                 (addr, int(sent)) for addr, sent in self._peers.values()
             ]
 
+    def _generation(self) -> int:
+        """This timeline's fencing generation (bumped by every
+        promotion, WAL-durable via the ha_generation record)."""
+        return int(getattr(self.persistence.cluster, "node_generation", 0))
+
+    def _promote_lsn(self) -> int:
+        """Timeline base: the WAL offset where this primary's history
+        stopped being a byte-prefix of its predecessor's (0 for a
+        never-promoted original primary — the whole history is ours)."""
+        return int(getattr(self.persistence.cluster, "ha_promote_lsn", 0))
+
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
             try:
                 conn, _ = self._lsock.accept()
             except OSError:
                 return
+            try:
+                # failpoint: the walsender refusing/dropping a
+                # just-accepted standby attach. Its OWN try block:
+                # drop_conn raises a ConnectionResetError (an OSError),
+                # and the accept handler above would read that as a
+                # closed listener and kill the loop — the loop must
+                # survive any injected action.
+                FAULT("repl/accept")
+            except Exception as e:
+                self.persistence.cluster.log.emit(
+                    "warning", "replication",
+                    f"standby attach refused: {e!r:.120}",
+                )
+                shutdown_and_close(conn)
+                continue
             threading.Thread(
                 target=self._stream, args=(conn,), daemon=True
             ).start()
@@ -92,13 +139,30 @@ class WalSender:
                 peer = f"{a[0]}:{a[1]}"
             except OSError:
                 pass
-            head = b""
-            while len(head) < 8:  # short TCP reads are normal
-                chunk = conn.recv(8 - len(head))
-                if not chunk:
-                    return
-                head += chunk
-            (offset,) = struct.unpack("<q", head)
+            try:
+                offset, peer_gen = recv_repl_hello(conn)
+            except ConnectionError:
+                return
+            # answer with OUR generation + timeline base before any WAL
+            # byte: the receiver fences a stale sender from the header
+            # alone, and the rejoin path probes it with REPL_PROBE
+            conn.sendall(
+                pack_repl_hello(self._generation(), self._promote_lsn())
+            )
+            if offset == REPL_PROBE:
+                return  # timeline probe: header only, no stream
+            if peer_gen > self._generation():
+                # a standby from a NEWER timeline must not follow us —
+                # we are the fenced ex-primary; close before one byte
+                # of divergent WAL crosses the wire
+                self.persistence.cluster.log.emit(
+                    "warning", "replication",
+                    "refusing standby with newer generation "
+                    f"({peer_gen} > {self._generation()}): this node "
+                    "is a fenced ex-primary",
+                    peer=peer,
+                )
+                return
             with self._peers_mu:
                 self._peers[id(conn)] = [peer, int(offset)]
             with open(path, "rb") as f:
@@ -162,6 +226,9 @@ class StandbyCluster:
         # restart, and _apply_one consults both attributes
         self.direct_applied: set = set()
         self.stream_txn_hook = None
+        # see the full comment further down; must also predate the
+        # replay loop below (_apply_one pops retired gids from it)
+        self.pending_relog: dict = {}
         # replay whatever WAL already exists locally (crash-restart of the
         # standby itself), but keep in-doubt txns pending until promote
         self.applied = 0
@@ -172,6 +239,20 @@ class StandbyCluster:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.promoted = False
+        # generation + timeline base learned from the sender's hello
+        # (the cluster's own node_generation advances only through
+        # replayed ha_generation records — WAL stays the one truth)
+        self.source_generation = 0
+        self.source_promote_lsn = 0
+        # pending_relog (set above): direct-applied 2PC transactions
+        # whose 'G' frame has NOT yet arrived over the stream:
+        # gid -> (commit_ts, wire_writes). promote() re-logs these into
+        # the promoted WAL so the new timeline is complete w.r.t. the
+        # promoted stores (without this, a commit that was
+        # phase-2-applied here but never streamed before the primary
+        # died would exist in the stores and in NO standby-reachable
+        # WAL). Entries retire when the stream's frame lands
+        # (_apply_one) — normally milliseconds.
         # direct_applied (set above): gids whose writes THIS process
         # already applied directly from a shipped-DML 2PC journal
         # (dn/server.py) — the stream's matching 'G' frame must be
@@ -185,8 +266,48 @@ class StandbyCluster:
 
     # -- walreceiver ------------------------------------------------------
     def start_replication(self, host: str, port: int) -> "StandbyCluster":
+        # failpoint: the standby attach itself (resync path) — an error
+        # here is a standby that could not (re)join its primary
+        FAULT("repl/start_replication", host=host, port=port)
+        my_gen = int(getattr(self.cluster, "node_generation", 0))
         self._sock = socket.create_connection((host, port), timeout=10)
-        self._sock.sendall(struct.pack("<q", self.applied))
+        try:
+            self._sock.sendall(pack_repl_hello(self.applied, my_gen))
+            self._sock.settimeout(10)
+            sender_gen, promote_lsn = recv_repl_hello(self._sock)
+            self._sock.settimeout(None)
+        except Exception:
+            shutdown_and_close(self._sock)
+            self._sock = None
+            raise
+        if sender_gen < my_gen:
+            # fencing: never follow an OLDER timeline (the revived
+            # ex-primary's walsender trying to re-capture us)
+            shutdown_and_close(self._sock)
+            self._sock = None
+            self.cluster.log.emit(
+                "warning", "replication",
+                f"refusing stale walsender (generation {sender_gen} "
+                f"< ours {my_gen})",
+            )
+            raise RuntimeError(
+                f"stale generation: walsender at {host}:{port} serves "
+                f"generation {sender_gen}, we are at {my_gen}"
+            )
+        if sender_gen > my_gen and self.applied > promote_lsn:
+            # our tail extends past the new timeline's base: records
+            # beyond promote_lsn came from the OLD timeline and are
+            # already applied to our stores — streaming cannot fix
+            # that; the caller must rewind (rejoin_standby)
+            shutdown_and_close(self._sock)
+            self._sock = None
+            raise RuntimeError(
+                f"diverged: applied {self.applied} is past the new "
+                f"timeline base {promote_lsn}; rewind required "
+                "(storage.replication.rejoin_standby)"
+            )
+        self.source_generation = sender_gen
+        self.source_promote_lsn = promote_lsn
         self._thread = threading.Thread(target=self._recv_loop, daemon=True)
         self._thread.start()
         return self
@@ -254,11 +375,22 @@ class StandbyCluster:
             if gid:
                 if self.stream_txn_hook is not None:
                     self.stream_txn_hook(gid)
+                # the stream delivered the frame: nothing left to
+                # re-log at promote time for this gid
+                self.pending_relog.pop(gid, None)
                 if gid in self.direct_applied:
                     # the shipped-DML journal already applied this txn
                     self.direct_applied.discard(gid)
                     return
         p._apply(tag, header, arrays)
+
+    def note_direct_apply(self, gid: str, commit_ts: int, wire_writes):
+        """A 2PC phase-2 decision applied ``gid``'s journaled write set
+        directly (dn/server.py) — its 'G' frame is still in flight on
+        the stream. Keep the wire payload until the frame lands so a
+        promotion BEFORE it lands can re-log the transaction into the
+        promoted WAL (zero lost committed writes across failover)."""
+        self.pending_relog[gid] = (int(commit_ts), wire_writes)
 
     # -- client surface ---------------------------------------------------
     def session(self):
@@ -279,6 +411,29 @@ class StandbyCluster:
 
         return _LockedSession()
 
+    def restart_replication(self, host: str, port: int) -> "StandbyCluster":
+        """Re-point the walreceiver at a (possibly different) primary:
+        stop the current stream, drop any torn tail past the last
+        complete record (a dying sender — or a wal_torn tear — leaves
+        partial frame bytes the new stream must not append after), and
+        re-stream from our own offset. The post-failover resync path
+        for surviving standbys: their WAL is a byte prefix of the
+        promoted node's, so offset-based streaming carries straight
+        over to the new timeline."""
+        self.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._stop = threading.Event()
+        p = self.cluster.persistence
+        try:
+            end = os.path.getsize(p.wal.path)
+            if end > self.applied:
+                p.wal.truncate_to(self.applied)
+        except OSError:
+            pass
+        return self.start_replication(host, port)
+
     def lag_bytes(self, primary_persistence) -> int:
         return primary_persistence.wal.position - self.applied
 
@@ -291,22 +446,91 @@ class StandbyCluster:
         return False
 
     # -- failover ---------------------------------------------------------
-    def promote(self):
-        """pg_ctl promote: finish recovery and go read-write."""
+    def promote(self, generation: Optional[int] = None):
+        """pg_ctl promote: finish recovery and go read-write.
+
+        HA extensions (each one a failover-correctness invariant):
+
+        - the local WAL is truncated back to ``applied`` — a wal_torn
+          tear (or a sender dying mid-frame) leaves partial record
+          bytes past the last complete record, and the promoted WAL
+          must end on a record boundary or the new timeline's first
+          append corrupts the log;
+        - direct-applied 2PC transactions whose 'G' frame never
+          streamed are re-logged (see note_direct_apply) so every row
+          in the promoted stores is reachable from the promoted WAL;
+        - the fencing ``generation`` bump is WAL-logged as a durable
+          ``ha_generation`` record — it survives a crash of the new
+          primary and streams to every standby that follows it.
+        """
         self._stop.set()
         if self._sock is not None:
             shutdown_and_close(self._sock)
         if self._thread is not None:
             self._thread.join(timeout=5)
-        p = self.cluster.persistence
+        c = self.cluster
+        p = c.persistence
+        # drop the torn stream tail: bytes past the last complete
+        # record are an unfinished frame the dead primary never
+        # completed (mid-chunk death, or a wal_torn tear landing right
+        # in the promotion window)
+        torn = 0
+        try:
+            end = os.path.getsize(p.wal.path)
+            if end > self.applied:
+                torn = end - self.applied
+                p.wal.truncate_to(self.applied)
+        except OSError:
+            pass
+        # the new timeline's base: everything at or below this offset
+        # is shared byte-for-byte with the old primary's history
+        c.ha_promote_lsn = self.applied
+        if generation is None:
+            generation = int(getattr(c, "node_generation", 0)) + 1
         p._finish_recovery()  # re-park in-doubt 2PC txns, prime dict sync
         p._in_recovery = False
-        self.cluster.read_only = False
+        # re-log direct-applied commits the stream never confirmed, in
+        # commit order, BEFORE the generation record (they belong to
+        # the shared history; the generation bump starts the new one)
+        relogged = 0
+        if self.pending_relog:
+            from opentenbase_tpu.plan import serde as _serde
+
+            for gid, (cts, wire) in sorted(
+                self.pending_relog.items(), key=lambda kv: kv[1][0]
+            ):
+                sub, arrays = _serde.frame_from_wire(wire)
+                p.wal.append(
+                    b"G",
+                    {"commit_ts": cts, "writes": sub, "gid": gid},
+                    arrays or None,
+                )
+                p._record_decision(gid, "commit", cts)
+                relogged += 1
+            self.pending_relog.clear()
+        # durable fencing epoch: the promotion IS this record
+        p.log_ddl({"op": "ha_generation", "generation": int(generation)})
+        c.node_generation = int(generation)
+        ha = getattr(c, "ha_stats", None)
+        if ha is not None:
+            ha["promotions"] = ha.get("promotions", 0) + 1
+        c.read_only = False
         self.promoted = True
-        self.cluster.log.emit(
+        # re-announce the topology to the GTM with the promoted role —
+        # the "re-point GTM routing" half of failover (register_gtm.c
+        # re-registration after gtm_standby promote)
+        try:
+            c._gtm_register_all()
+        except Exception as e:
+            c.log.emit(
+                "warning", "replication",
+                f"GTM re-registration after promote failed: {e!r:.120}",
+            )
+        c.log.emit(
             "warning", "replication",
             "standby promoted to read-write primary",
-            applied=self.applied,
+            applied=self.applied, generation=int(generation),
+            relogged_2pc=relogged, torn_tail_bytes=torn,
         )
         return self.cluster
 
@@ -314,5 +538,95 @@ class StandbyCluster:
         self._stop.set()
         if self._sock is not None:
             shutdown_and_close(self._sock)
+
+
+def probe_timeline(host: str, port: int, timeout: float = 10.0):
+    """(generation, promote_lsn) of the walsender at host:port — the
+    REPL_PROBE handshake, header only, no stream."""
+    # failpoint: the rejoin path's first contact with the new primary
+    FAULT("repl/probe", host=host, port=port)
+    sock = socket.create_connection((host, port), timeout=timeout)
+    try:
+        sock.sendall(pack_repl_hello(REPL_PROBE, 0))
+        return recv_repl_hello(sock)
+    finally:
+        shutdown_and_close(sock)
+
+
+def local_generation(wal_path: str) -> int:
+    """Highest ha_generation recorded in a WAL file (0 when none) —
+    header-only scan, no array decode."""
+    gen = 0
+    try:
+        for tag, header, _a, _off in WAL.read_records(
+            wal_path, decode_arrays=False
+        ):
+            if tag == "D" and header.get("op") == "ha_generation":
+                gen = max(gen, int(header.get("generation", 0)))
+    except OSError:
+        pass
+    return gen
+
+
+def rejoin_standby(
+    data_dir: str,
+    host: str,
+    port: int,
+    num_datanodes: int = 2,
+    shard_groups: int = 256,
+) -> StandbyCluster:
+    """The pg_rewind analog: make a demoted ex-primary's data_dir
+    follow the NEW primary's walsender at host:port, then return the
+    re-joined (read-only, streaming) StandbyCluster.
+
+    The contract that makes byte-level truncation sound: a standby's
+    WAL copy is always a verbatim prefix of its primary's, so the new
+    primary's WAL and the ex-primary's agree byte-for-byte up to the
+    promotion point (the sender's ``promote_lsn``). Everything the
+    ex-primary logged past that offset belongs to the dead timeline —
+    commits that never streamed before the failover, i.e. writes no
+    client ever got an acknowledgment the promoted cluster honors.
+    Truncate there, rebuild from the truncated log, re-stream from our
+    own (now shared-history) offset."""
+    import json as _json
+
+    gen, promote_lsn = probe_timeline(host, port)
+    wal_path = os.path.join(data_dir, "wal.log")
+    my_gen = local_generation(wal_path)
+    if my_gen > gen:
+        raise RuntimeError(
+            f"refusing rejoin: local generation {my_gen} is NEWER than "
+            f"the target's {gen} — the target is the stale node"
+        )
+    truncated = 0
+    try:
+        end = WAL.scan_end(wal_path)
+    except OSError:
+        end = 0
+    if my_gen < gen and end > promote_lsn >= 0:
+        truncated = end - promote_lsn
+        with open(wal_path, "r+b") as f:
+            f.truncate(promote_lsn)
+    # a checkpoint taken past the divergence point snapshots rows of
+    # the dead timeline — drop it (rewind's rule; the standby replays
+    # the truncated WAL from zero either way, this keeps the data_dir
+    # honest for any later Cluster.recover)
+    ckpt = os.path.join(data_dir, "checkpoint.json")
+    if truncated and os.path.exists(ckpt):
+        try:
+            with open(ckpt) as f:
+                if int(_json.load(f).get("wal_position", 0)) > promote_lsn:
+                    os.unlink(ckpt)
+        except (OSError, ValueError):
+            pass
+    sb = StandbyCluster(data_dir, num_datanodes, shard_groups)
+    sb.start_replication(host, port)
+    sb.cluster.log.emit(
+        "warning", "replication",
+        "ex-primary rejoined as standby",
+        truncated_bytes=truncated, generation=gen,
+        resumed_from=sb.applied,
+    )
+    return sb
 
 

@@ -1,0 +1,535 @@
+"""Self-healing HA: failure-detector-driven standby promotion, fencing
+epochs, post-failover resync, and the seeded chaos-schedule harness.
+
+Covers the PR 12 acceptance surface:
+- the HAMonitor declares a dead primary within the configured budget
+  and drives StandbyCluster.promote() automatically;
+- every promotion bumps a WAL-durable node_generation that survives
+  crash recovery;
+- a stale-generation peer (the revived ex-primary) is refused with
+  SQLSTATE 72000 for reads AND writes — split-brain is a refused RPC;
+- the walreceiver restart/resync contract: reconnect after a primary
+  restart resumes from the standby's own offset, and a torn tail in
+  the promotion window neither corrupts the promoted WAL nor loses a
+  pre-crash committed row;
+- the demoted ex-primary rejoins as the new standby with its
+  divergent WAL truncated (the pg_rewind analog);
+- chaos schedules are byte-replayable from one seed, and a full
+  schedule run ends with every invariant green.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from opentenbase_tpu import fault
+from opentenbase_tpu.engine import Cluster, SQLError
+from opentenbase_tpu.ha import HAMonitor, HATopology, RoutingClient
+from opentenbase_tpu.net.client import WireError, connect_tcp
+from opentenbase_tpu.storage.persist import WAL
+from opentenbase_tpu.storage.replication import (
+    StandbyCluster,
+    WalSender,
+    rejoin_standby,
+)
+
+
+HA_CONF = {
+    "enable_fused_execution": "off",
+    "synchronous_commit": "on",
+    "failover_detect_ms": 1000,
+    "failover_beats": 3,
+    "fragment_retries": 1,
+    "fragment_retry_backoff_ms": 5,
+    "statement_timeout": 8000,
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.clear()
+    fault.set_chaos_seed(None)
+    yield
+    fault.clear()
+    fault.reset_stats()
+    fault.set_chaos_seed(None)
+
+
+def _topology(tmp_path, **conf):
+    gucs = dict(HA_CONF)
+    gucs.update(conf)
+    return HATopology(
+        str(tmp_path / "ha"), num_datanodes=2, shard_groups=16,
+        conf_gucs=gucs,
+    )
+
+
+def test_failure_detector_auto_promotes_within_budget(tmp_path):
+    """Acceptance: crash the primary under a running monitor — a
+    standby is promoted automatically within the detection budget,
+    writes resume through re-pointed client routing, and no acked
+    write is lost."""
+    topo = _topology(tmp_path)
+    mon = None
+    rc = RoutingClient(topo)
+    try:
+        rc.execute(
+            "create table t (k bigint, v bigint) distribute by shard(k)"
+        )
+        acked = []
+        for i in range(8):
+            rc.execute(f"insert into t values ({i}, {i * 10})")
+            acked.append(i)
+        mon = HAMonitor(topo).start()
+        assert mon.detect_ms == 1000 and mon.beats == 3  # conf-driven
+        t_crash = time.time()
+        topo.crash_primary()
+        # writes resume once the monitor heals the cluster
+        resumed = None
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                rc.execute("insert into t values (100, 1000)")
+                resumed = time.time()
+                break
+            except Exception:
+                time.sleep(0.05)
+        assert resumed is not None, "writes never resumed"
+        assert mon.promotions == 1
+        assert topo.promoted_index is not None
+        # detection within budget: detect_ms + one beat + probe slack
+        assert mon.declared_dead_at is not None
+        latency_ms = (mon.declared_dead_at - t_crash) * 1000
+        assert latency_ms <= 1000 + 1000 / 3 + 600, latency_ms
+        # zero lost committed writes: every acked row present
+        rows = {r[0] for r in rc.query("select k from t")}
+        assert set(acked) <= rows and 100 in rows
+        # the promoted node's health view: role flipped
+        # standby -> coordinator, generation bumped, and the promotion
+        # is visible on a scrape
+        s = topo.active_cluster.session()
+        h = {r[0]: r for r in s.query("select * from pg_cluster_health")}
+        assert h["cn0"][1] == "coordinator"
+        assert h["cn0"][8] == 1  # generation column
+        from opentenbase_tpu.obs.exporter import render_cluster_metrics
+
+        text = render_cluster_metrics(topo.active_cluster)
+        assert "otb_node_generation 1" in text
+        assert "otb_promotions_total 1" in text
+        # the failover is auditable from the event log
+        kinds = [e["kind"] for e in topo.events]
+        assert "declared_dead" in kinds and "promoted" in kinds
+        assert "repointed" in kinds and "failover_done" in kinds
+    finally:
+        rc.close()
+        if mon is not None:
+            mon.stop()
+        topo.stop()
+
+
+def test_fencing_refuses_stale_ex_primary(tmp_path):
+    """Acceptance: after a promotion, the revived ex-primary is fenced
+    out — a READ is refused (never silently served from its stale
+    stores via local failover) and a WRITE is refused, both with
+    SQLSTATE 72000 — and the node demotes itself."""
+    topo = _topology(tmp_path)
+    try:
+        rc = RoutingClient(topo)
+        rc.execute(
+            "create table t (k bigint, v bigint) distribute by shard(k)"
+        )
+        rc.execute("insert into t values (1, 10), (2, 20)")
+        topo.crash_primary()
+        assert topo.failover(reason="test")["ok"]
+        rc.close()
+        srv = topo.revive_ex_primary()
+        stale = connect_tcp(srv.host, srv.port)
+        try:
+            # read first: it must hit the fence at the DN, not fail
+            # over to the ex-primary's own (stale) stores
+            with pytest.raises(WireError) as ei:
+                stale.execute("select count(*) from t")
+            assert ei.value.sqlstate == "72000"
+            with pytest.raises(WireError) as ei:
+                stale.execute("insert into t values (99, 990)")
+            assert ei.value.sqlstate == "72000"
+        finally:
+            stale.close()
+        # the fence demoted the node: flag set, health role 'fenced',
+        # refusals counted
+        assert topo.primary.ha_demoted
+        s_old = topo.primary.session()
+        with pytest.raises(SQLError) as se:
+            s_old.execute("select 1")
+        assert se.value.sqlstate == "72000"
+        assert topo.primary.ha_stats["fenced_refusals"] >= 1
+        # the promoted node never saw the refused write
+        s = topo.active_cluster.session()
+        assert s.query("select count(*) from t where k = 99") == [(0,)]
+        # DN-side telemetry: the heartbeat now reports the new
+        # generation, and the fenced refusal was counted
+        pings = [topo.dn_ping(i) for i in range(2)]
+        gens = [p.get("generation") for p in pings if p]
+        assert 1 in gens
+        assert any(
+            (p.get("dml_stats") or {}).get("fenced_refusals", 0) >= 1
+            for p in pings if p
+        )
+    finally:
+        topo.stop()
+
+
+def test_generation_survives_crash_recovery(tmp_path):
+    """Fencing epochs are WAL-durable: a promoted node that crashes
+    and recovers still knows its generation (ha_generation D-record
+    replay + checkpoint round-trip)."""
+    pri = Cluster(num_datanodes=2, shard_groups=16,
+                  data_dir=str(tmp_path / "pri"))
+    s = pri.session()
+    s.execute("set enable_fused_execution = off")
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("insert into t values (1), (2), (3)")
+    sender = WalSender(pri.persistence)
+    sb = StandbyCluster(str(tmp_path / "sb"), 2, 16)
+    sb.start_replication(sender.host, sender.port)
+    assert sb.wait_caught_up(pri.persistence)
+    sender.stop()
+    promoted = sb.promote(generation=7)
+    assert promoted.node_generation == 7
+    s2 = promoted.session()
+    s2.execute("insert into t values (4)")
+    promoted.close()
+    # WAL-replay path
+    rec = Cluster.recover(str(tmp_path / "sb"), 2, 16)
+    assert rec.node_generation == 7
+    assert rec.session().query("select count(*) from t") == [(4,)]
+    # checkpoint path: generation must survive a checkpoint+recover
+    # even though the replayed tail no longer contains the record
+    rec.persistence.checkpoint()
+    rec.close()
+    rec2 = Cluster.recover(str(tmp_path / "sb"), 2, 16)
+    assert rec2.node_generation == 7
+    rec2.close()
+    pri.close()
+
+
+def test_walreceiver_resumes_from_own_offset_after_restart(tmp_path):
+    """Resync contract: when the primary's walsender restarts, the
+    standby reconnects FROM ITS OWN OFFSET — no re-apply, no gap."""
+    pri = Cluster(num_datanodes=2, shard_groups=16,
+                  data_dir=str(tmp_path / "pri"))
+    s = pri.session()
+    s.execute("set enable_fused_execution = off")
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("insert into t values (1), (2)")
+    sender = WalSender(pri.persistence)
+    sb = StandbyCluster(str(tmp_path / "sb"), 2, 16)
+    sb.start_replication(sender.host, sender.port)
+    assert sb.wait_caught_up(pri.persistence)
+    applied_before = sb.applied
+    # primary restart: the sender dies, writes continue, a new sender
+    # comes up on a fresh port
+    sender.stop()
+    s.execute("insert into t values (3), (4)")
+    sender2 = WalSender(pri.persistence)
+    sb.restart_replication(sender2.host, sender2.port)
+    assert sb.wait_caught_up(pri.persistence)
+    assert sb.applied > applied_before
+    # exactly-once: 4 rows, not 6 (a from-zero re-stream would have
+    # re-applied the first two)
+    assert sb.session().query("select count(*) from t") == [(4,)]
+    sender2.stop()
+    sb.stop()
+    sb.cluster.close()
+    pri.close()
+
+
+def test_wal_torn_in_promotion_window(tmp_path):
+    """Resync contract: a wal_torn tear landing inside the promotion
+    window neither corrupts the promoted WAL nor loses a pre-crash
+    committed row — and a fresh standby can follow the promoted node."""
+    pri = Cluster(num_datanodes=2, shard_groups=16,
+                  data_dir=str(tmp_path / "pri"))
+    s = pri.session()
+    s.execute("set enable_fused_execution = off")
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    # tear EVERY chunk at byte-arbitrary positions while streaming
+    fault.inject("repl/wal_stream", "wal_torn", "prob(1.0, 42)")
+    sender = WalSender(pri.persistence, poll_s=0.01)
+    sb = StandbyCluster(str(tmp_path / "sb"), 2, 16)
+    sb.start_replication(sender.host, sender.port)
+    for i in range(30):
+        s.execute(f"insert into t values ({i})")
+    assert sb.wait_caught_up(pri.persistence)  # reassembly survived
+    # the primary dies mid-frame: simulate the torn tail its death
+    # leaves on the standby (partial record bytes past the last
+    # complete record — exactly what a tear + crash produces)
+    sender.stop()
+    p = sb.cluster.persistence
+    p.wal._f.write(b"\x55" * 17)
+    p.wal._f.flush()
+    assert os.path.getsize(p.wal.path) > sb.applied
+    promoted = sb.promote(generation=1)
+    # the promoted WAL ends on a record boundary (no corruption)
+    assert WAL.scan_end(p.wal.path) == p.wal.position
+    # zero lost pre-crash committed rows, and the timeline serves writes
+    s2 = promoted.session()
+    assert s2.query("select count(*) from t") == [(30,)]
+    s2.execute("insert into t values (1000)")
+    # a fresh standby follows the promoted timeline cleanly
+    sender2 = WalSender(promoted.persistence)
+    sb2 = StandbyCluster(str(tmp_path / "sb2"), 2, 16)
+    sb2.start_replication(sender2.host, sender2.port)
+    assert sb2.wait_caught_up(promoted.persistence)
+    assert sb2.source_generation == 1
+    assert sb2.session().query("select count(*) from t") == [(31,)]
+    assert sb2.cluster.node_generation == 1  # streamed ha_generation
+    sender2.stop()
+    sb2.stop()
+    sb2.cluster.close()
+    promoted.close()
+    pri.close()
+
+
+def test_rejoin_standby_truncates_divergence(tmp_path):
+    """The pg_rewind analog: the ex-primary's unstreamed tail (commits
+    that never reached any standby) is truncated at the promotion
+    point; it rejoins read-only and converges on the new timeline."""
+    pri = Cluster(num_datanodes=2, shard_groups=16,
+                  data_dir=str(tmp_path / "pri"))
+    s = pri.session()
+    s.execute("set enable_fused_execution = off")
+    s.execute("create table t (k bigint) distribute by shard(k)")
+    s.execute("insert into t values (1), (2)")
+    sender = WalSender(pri.persistence)
+    sb = StandbyCluster(str(tmp_path / "sb"), 2, 16)
+    sb.start_replication(sender.host, sender.port)
+    assert sb.wait_caught_up(pri.persistence)
+    # the stream dies; the doomed primary commits MORE rows that never
+    # replicate — the divergent tail
+    sender.stop()
+    s.execute("insert into t values (3), (4)")
+    div_end = pri.persistence.wal.position
+    pri.close()
+    promoted = sb.promote()
+    s2 = promoted.session()
+    s2.execute("insert into t values (100)")
+    sender2 = WalSender(promoted.persistence)
+    # rewind + rejoin: stale local gen (0) + WAL past the promote
+    # point -> truncate, replay, re-stream
+    old = rejoin_standby(str(tmp_path / "pri"), sender2.host,
+                         sender2.port, 2, 16)
+    assert old.cluster.read_only
+    assert old.wait_caught_up(promoted.persistence)
+    # the divergent rows are GONE, the new timeline's rows are there
+    assert old.session().query("select count(*) from t") == [(3,)]
+    ks = {r[0] for r in old.session().query("select k from t")}
+    assert ks == {1, 2, 100}
+    assert old.cluster.node_generation == 1
+    # byte-prefix restored: the rejoined WAL converges on the promoted
+    # timeline's exact position (the truncated divergent tail — which
+    # once reached div_end — was replaced by streamed bytes)
+    assert old.applied == promoted.persistence.wal.position
+    assert old.applied != div_end
+    # role transition ex-primary -> standby, visible in health
+    h = {r[0]: r for r in old.session().query(
+        "select * from pg_cluster_health"
+    )}
+    assert h["cn0"][1] == "standby"
+    # a newer-generation node refuses to rejoin a STALE target
+    stale_c = Cluster(num_datanodes=2, shard_groups=16,
+                      data_dir=str(tmp_path / "stale"))
+    stale_sender = WalSender(stale_c.persistence)
+    with pytest.raises(RuntimeError, match="refusing rejoin"):
+        rejoin_standby(str(tmp_path / "sb"), stale_sender.host,
+                       stale_sender.port, 2, 16)
+    stale_sender.stop()
+    stale_c.close()
+    sender2.stop()
+    old.stop()
+    old.cluster.close()
+    promoted.close()
+
+
+def test_sync_commit_withholds_unreplicated_acks(tmp_path):
+    """synchronous_commit = on: with every standby dead, a commit is
+    NOT acknowledged (08006 — locally durable, unreplicated); once a
+    standby revives, acks resume."""
+    topo = _topology(tmp_path)
+    try:
+        rc = RoutingClient(topo)
+        rc.execute(
+            "create table t (k bigint, v bigint) distribute by shard(k)"
+        )
+        rc.execute("insert into t values (1, 10)")
+        for dn in topo.dns:
+            dn._simulate_crash()
+        with pytest.raises(WireError) as ei:
+            rc.execute("insert into t values (2, 20)")
+        assert ei.value.sqlstate == "08006"
+        assert "unreplicated" in str(ei.value)
+        for dn in topo.dns:
+            dn._revive()
+        rc.execute("insert into t values (3, 30)")
+        rc.close()
+    finally:
+        topo.stop()
+
+
+def test_indoubt_commit_reaches_recorded_decision_across_failover(
+    tmp_path,
+):
+    """Tentpole: an in-flight 2PC commit whose phase-2 messages were
+    ALL lost (and whose 'G' frame never reached one lagging standby)
+    is driven to its WAL-recorded COMMIT decision by the post-failover
+    resolver — the acked write survives the primary's death even on
+    the standby that held only a prepare journal."""
+    topo = _topology(tmp_path, synchronous_commit="off")
+    try:
+        rc = RoutingClient(topo)
+        rc.execute(
+            "create table t (k bigint, v bigint) distribute by shard(k)"
+        )
+        rc.execute("insert into t values (1, 10), (2, 20)")
+        # wait for both standbys to fully apply the baseline
+        for i in range(2):
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                p = topo.dn_ping(i)
+                if p and p["applied"] >= \
+                        topo.primary.persistence.wal.position:
+                    break
+                time.sleep(0.02)
+        # sever dn1's WAL stream only: it will vote (journal) but never
+        # see the commit frame; dn0 keeps streaming
+        topo.dns[1].standby.stop()
+        # drop EVERY phase-2 2pc_commit RPC: the decision is durable in
+        # the primary WAL but no DN is told
+        fault.inject(
+            "net/pool/rpc_send", "drop_conn", "op=2pc_commit, every(1)"
+        )
+        # a multi-node txn: rows for both shards -> implicit 2PC
+        rc.execute(
+            "insert into t values (3, 30), (4, 40), (5, 50), (6, 60)"
+        )
+        fault.clear()
+        # dn0's live stream must deliver the commit frame BEFORE the
+        # crash: that is what makes dn0 the max-applied candidate AND
+        # puts the recorded decision into the promoted WAL (with
+        # synchronous_commit=off an ack is only as durable as what
+        # actually streamed — the on-mode guarantee is tested above)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            p = topo.dn_ping(0)
+            if p and p["applied"] >= \
+                    topo.primary.persistence.wal.position:
+                break
+            time.sleep(0.02)
+        # dn1 holds the prepare journal (its stream is dead and phase 2
+        # was dropped); dn0's journal resolved via its live stream
+        assert topo.dns[1]._twophase_list(), "dn1 should be in doubt"
+        # the primary dies; the monitor's failover must promote dn0
+        # (max applied — dn1's stream is severed) and drive dn1's
+        # in-doubt gid to the RECORDED commit decision
+        topo.crash_primary()
+        res = topo.failover(reason="test")
+        assert res["ok"] and res["promoted"] == 0
+        assert topo.dns[1]._twophase_list() == []
+        kinds = [e["kind"] for e in topo.events]
+        assert "indoubt_resolved" in kinds
+        # the acked write is whole on the new primary...
+        s = topo.active_cluster.session()
+        assert s.query("select count(*), sum(v) from t") == [(6, 210)]
+        # ...and dn1's own stores converged through the decision apply
+        # + repoint (exactly-once: journal apply dedups the re-stream)
+        sb1 = topo.dns[1].standby
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if sb1.applied >= topo.active_cluster.persistence.wal.position:
+                break
+            time.sleep(0.05)
+        assert sb1.session().query(
+            "select count(*), sum(v) from t"
+        ) == [(6, 210)]
+    finally:
+        topo.stop()
+
+
+def test_chaos_schedule_replay_determinism():
+    """Satellite: a schedule regenerates byte-identically from its
+    seed — events, times, targets — and the chaos RNG plane hands out
+    per-name deterministic streams."""
+    from opentenbase_tpu.fault.schedule import ChaosSchedule
+
+    a = ChaosSchedule.generate(1234, duration_s=6.0, num_datanodes=2)
+    b = ChaosSchedule.generate(1234, duration_s=6.0, num_datanodes=2)
+    assert [e.describe() for e in a.events] == [
+        e.describe() for e in b.events
+    ]
+    c = ChaosSchedule.generate(1235, duration_s=6.0, num_datanodes=2)
+    assert [e.describe() for e in a.events] != [
+        e.describe() for e in c.events
+    ]
+    # every schedule mixes the full menagerie (the acceptance contract)
+    kinds = {e.kind for e in a.events}
+    assert kinds == {
+        "arm_fault", "crash_node", "revive_node", "crash_primary",
+    }
+    sites = {
+        e.spec.get("site") for e in a.events if e.kind == "arm_fault"
+    }
+    assert {"net/pool/rpc_send", "repl/wal_stream",
+            "dn/promote"} <= sites
+    # per-name chaos streams: deterministic across re-arms of the
+    # same seed, independent across names
+    fault.set_chaos_seed(99)
+    s1 = [fault.chaos_rng("fault/x").random() for _ in range(5)]
+    s2 = [fault.chaos_rng("fault/y").random() for _ in range(5)]
+    fault.set_chaos_seed(99)
+    assert [fault.chaos_rng("fault/x").random() for _ in range(5)] == s1
+    assert [fault.chaos_rng("fault/y").random() for _ in range(5)] == s2
+    assert s1 != s2
+    fault.set_chaos_seed(None)
+    assert fault.chaos_rng("fault/x") is None
+    # prob-fault draws route through the schedule stream when active
+    fault.set_chaos_seed(7)
+    f = fault.inject("test/site", "error", "prob(0.5)")
+    fired = []
+    for _ in range(20):
+        try:
+            fault.FAULT("test/site")
+            fired.append(0)
+        except fault.FaultError:
+            fired.append(1)
+    fault.clear()
+    fault.set_chaos_seed(7)
+    fault.inject("test/site", "error", "prob(0.5)")
+    fired2 = []
+    for _ in range(20):
+        try:
+            fault.FAULT("test/site")
+            fired2.append(0)
+        except fault.FaultError:
+            fired2.append(1)
+    assert fired == fired2 and 1 in fired and 0 in fired
+
+
+def test_chaos_schedule_end_to_end(tmp_path):
+    """Acceptance: one full seeded schedule — background drop_conn +
+    delays + wal_torn, a DN crash/revive, a promotion-window kill, and
+    a primary crash under live read-write traffic — ends with every
+    invariant green and the run replayable from its seed."""
+    from opentenbase_tpu.fault.schedule import ChaosSchedule, run_schedule
+
+    sched = ChaosSchedule.generate(4242, duration_s=4.0,
+                                   num_datanodes=2)
+    v = run_schedule(sched, str(tmp_path / "chaos"), detect_ms=900,
+                     beats=3)
+    assert v["chaos_gate"] == "ok", v["violations"]
+    assert v["acked_writes"] > 0
+    assert v["promotions"] == 1
+    assert v["fenced_probe"] == "refused"
+    assert v["resync"]["rows"] == v["final_rows"]

@@ -13,7 +13,8 @@
 #   5. an observability smoke (obs/): EXPLAIN (ANALYZE, VERBOSE) of a
 #      2-DN sharded join must print per-node rows, and a traced query
 #      must export parseable Chrome-trace JSON;
-#   6. matview / chaos / telemetry / join-mode+perf-gate smokes;
+#   6. matview / chaos / HA-chaos-schedule / telemetry /
+#      join-mode+perf-gate smokes;
 #   7. the full ROADMAP tier-1 pytest command, verbatim (1500 s cap).
 #
 # Usage: tools/tier1.sh   (from anywhere; cd's to the repo root)
@@ -246,6 +247,46 @@ sender.stop()
 c.close()
 print("chaos smoke OK: crash_node -> retry+failover, counters moved, "
       "clean rerun")
+PY
+
+echo "== tier1: self-healing HA chaos-schedule smoke =="
+timeout -k 10 240 python - <<'PY' || exit 1
+# One fixed-seed chaos schedule end to end (fault/schedule.py + ha.py):
+# background drop_conn / delay / wal_torn faults armed, a DN crashed
+# and revived, a kill inside the promotion window, then the primary
+# crashed under live read-write traffic -> the HA monitor must declare
+# it dead within the detection budget and auto-promote the most
+# caught-up standby; afterwards the invariant checker must be green:
+# zero lost committed writes, zero stale-generation reads or accepted
+# writes (the revived ex-primary refuses with SQLSTATE 72000), every
+# in-doubt gid resolved to its WAL decision, and the ex-primary
+# rewound + resynced as the new standby serving identical rows.
+# Replay any failure: python -m opentenbase_tpu.cli.otb_chaos
+#   --seed 1107 --schedules 1
+import json, sys, tempfile
+from opentenbase_tpu.fault.schedule import ChaosSchedule, run_schedule
+
+sched = ChaosSchedule.generate(1107, duration_s=5.0, num_datanodes=2)
+v = run_schedule(sched, tempfile.mkdtemp(prefix="otbha_"),
+                 detect_ms=1100, beats=3)
+ok = (
+    v["chaos_gate"] == "ok"
+    and v.get("promotions") == 1
+    and v.get("acked_writes", 0) > 0
+    and v.get("fenced_probe") == "refused"
+    and v.get("resync", {}).get("rows") == v.get("final_rows")
+)
+print(json.dumps({
+    "ha_chaos_gate": "ok" if ok else "fail",
+    "seed": v["seed"],
+    "acked_writes": v.get("acked_writes"),
+    "detect_latency_ms": v.get("detect_latency_ms"),
+    "promotions": v.get("promotions"),
+    "generation": v.get("generation"),
+    "violations": v.get("violations"),
+}))
+if not ok:
+    sys.exit(1)
 PY
 
 echo "== tier1: telemetry smoke =="
