@@ -24,9 +24,19 @@ Layout:
                   are burned down over time, NEW ones fail tier-1;
 - ``lockwatch`` — the runtime half: an opt-in (``OTB_LOCKWATCH=1``)
                   lock-acquisition-order watchdog that reports cycles
-                  (potential deadlocks) at process exit.
+                  (potential deadlocks) at process exit;
+- ``racewatch`` — otb_race's runtime half: an opt-in
+                  (``OTB_RACEWATCH=1``) TSan-lite sanitizer — classes
+                  annotated ``@shared_state("_mu")`` record every
+                  (thread, lockset, access) tuple, and disjoint-lockset
+                  pairs with a write are reported with both stacks.
 
-CLI: ``python -m opentenbase_tpu.cli.otb_lint [--check|--update-baseline]``.
+The race family (``checkers/races.py`` static lockset inference +
+``racewatch``) shares this framework but ratchets against its own
+``tools/race_baseline.json`` via ``cli/otb_race.py``.
+
+CLIs: ``python -m opentenbase_tpu.cli.otb_lint [--check|--update-baseline]``,
+``python -m opentenbase_tpu.cli.otb_race [--check|--update-baseline]``.
 """
 
 from opentenbase_tpu.analysis.core import (  # noqa: F401
@@ -34,4 +44,7 @@ from opentenbase_tpu.analysis.core import (  # noqa: F401
     Project,
     run_checkers,
 )
-from opentenbase_tpu.analysis.checkers import all_checkers  # noqa: F401
+from opentenbase_tpu.analysis.checkers import (  # noqa: F401
+    all_checkers,
+    race_checkers,
+)

@@ -1,6 +1,11 @@
 """Checker registry — one module per invariant family, each encoding a
 bug class this repo has already paid to learn (the motivating incident
-is named in each module's docstring)."""
+is named in each module's docstring).
+
+Two registries, two ratchets: ``all_checkers()`` is otb_lint's set
+(``tools/lint_baseline.json``); ``race_checkers()`` is otb_race's
+lockset family (``tools/race_baseline.json``, shared with the dynamic
+``racewatch`` sanitizer)."""
 
 from __future__ import annotations
 
@@ -9,17 +14,30 @@ from opentenbase_tpu.analysis.checkers import (
     exceptions,
     faults,
     guc,
+    hostleak,
     numeric,
+    races,
     sockets,
     wire,
 )
 
-_MODULES = (guc, deprecated, sockets, faults, exceptions, numeric, wire)
+_MODULES = (
+    guc, deprecated, sockets, faults, exceptions, numeric, wire,
+    hostleak,
+)
+_RACE_MODULES = (races,)
 
 
 def all_checkers() -> list:
     out = []
     for mod in _MODULES:
+        out.extend(mod.checkers())
+    return out
+
+
+def race_checkers() -> list:
+    out = []
+    for mod in _RACE_MODULES:
         out.extend(mod.checkers())
     return out
 
@@ -32,4 +50,20 @@ def all_rules() -> list[tuple[str, str]]:
     for c in all_checkers():
         for rule, desc in c.rules:
             out.append((rule, desc))
+    return sorted(out)
+
+
+def race_rules() -> list[tuple[str, str]]:
+    """(rule, one-line description) for otb_race --list-rules; the
+    dynamic half's rule rides along so the listing names both."""
+    from opentenbase_tpu.analysis.core import FRAMEWORK_RULES
+
+    out = list(FRAMEWORK_RULES)
+    for c in race_checkers():
+        for rule, desc in c.rules:
+            out.append((rule, desc))
+    out.append((
+        "race-dynamic",
+        "racewatch: disjoint-lockset access pair seen at runtime",
+    ))
     return sorted(out)

@@ -1,0 +1,508 @@
+"""Lockset-based static race detection — the guarded/unguarded mix.
+
+The repo's worst recent bugs were all the same shape: shared state
+touched both with and without its lock (the concentrator's leaked
+pinned backend, PR 11's lost 2PC spans, PR 12's accept-loop fault
+race).  This family infers, per class, which lock guards each shared
+attribute — a write inside ``with self._mu:`` (or an
+``acquire()..release()`` bracket) ESTABLISHES the guard; ``__init__``-
+only attributes are construction-private and exempt — then flags:
+
+- ``race-guard-mismatch``: the attribute is also accessed (read or
+  written, container mutation included — ``self.stats["x"] += 1`` is a
+  write to ``stats``) with a lockset DISJOINT from the inferred guard,
+  from any method reachable by a thread entry point
+  (``Thread(target=...)`` / ``Timer`` targets anywhere in the tree,
+  plus the public surface — any caller thread can enter a public
+  method);
+- ``race-check-then-act``: the narrower, nastier variant — a guarded
+  attribute read in an ``if``/``while`` TEST outside the guard, in a
+  method that then takes the guard to act on it.  The check and the
+  act are individually safe; the invariant between them is not;
+- ``lock-release-path``: an ``acquire()`` whose same-function
+  ``release()`` is not in a ``try/finally`` — an exception between
+  them leaks the lock held forever (every caller after that deadlocks,
+  which is how this class of bug actually presents).
+
+Condition objects alias their lock (``Condition(self._lock)`` and
+``self._lock`` are ONE guard); ``threading.Event`` / ``Queue`` /
+semaphores are internally synchronized and exempt.  Findings ride the
+shared ``analysis.core`` machinery — stable ``rule::path::ident``
+keys, ``# otb_race: ignore[rule] -- reason`` pragmas — and diff
+against ``tools/race_baseline.json`` (the otb_lint ratchet, second
+instance).  The dynamic half (``analysis/racewatch.py``) shares the
+finding format and the baseline file.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from opentenbase_tpu.analysis.core import (
+    Finding,
+    Project,
+    dotted_name,
+)
+
+# factory call names (last dotted part) that make an attribute a LOCK
+_LOCK_FACTORIES = {"Lock", "RLock"}
+# internally-synchronized primitives: attributes holding one are not
+# shared *data*, they are the synchronization itself
+_EXEMPT_FACTORIES = {
+    "Event", "Semaphore", "BoundedSemaphore", "Barrier", "local",
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "Thread",
+    "Timer",
+}
+# calling one of these on ``self.X`` mutates the container behind X —
+# a WRITE to X for lockset purposes (the stats-dict / ring-deque shape
+# this codebase actually uses for shared state)
+_MUTATORS = {
+    "append", "appendleft", "add", "remove", "discard", "pop",
+    "popleft", "popitem", "clear", "update", "setdefault", "extend",
+    "insert", "move_to_end", "sort", "reverse",
+}
+
+
+@dataclass
+class _Access:
+    attr: str
+    method: str      # qualname within the class ('' level: method name)
+    line: int
+    write: bool
+    locks: frozenset  # canonical lock names held
+    in_init: bool
+    test_pos: bool    # inside an if/while TEST expression
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    node: ast.ClassDef
+    methods: dict = field(default_factory=dict)   # name -> FunctionDef
+    locks: dict = field(default_factory=dict)     # attr -> canonical
+    exempt: set = field(default_factory=set)
+    accesses: list = field(default_factory=list)  # [_Access]
+    calls: dict = field(default_factory=dict)     # method -> {methods}
+    # methods documented as running under the caller's lock: a
+    # ``_locked`` suffix or a docstring saying "caller holds" — their
+    # unguarded accesses are the CALLER's obligation, not theirs
+    lock_held: set = field(default_factory=set)
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _factory_kind(value: ast.AST) -> Optional[str]:
+    """'lock' / 'cond' / 'exempt' for ``self.X = <factory>()``."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last in _LOCK_FACTORIES:
+        return "lock"
+    if last == "Condition":
+        return "cond"
+    if last in _EXEMPT_FACTORIES:
+        return "exempt"
+    return None
+
+
+def _collect_class(cls: ast.ClassDef) -> _ClassInfo:
+    """Pass 1: methods, lock attributes (with Condition aliasing), and
+    exempt attributes, from every assignment in every method."""
+    info = _ClassInfo(name=cls.name, node=cls)
+    for child in cls.body:
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[child.name] = child
+            doc = ast.get_docstring(child) or ""
+            if child.name.endswith("_locked") or (
+                "caller holds" in doc[:200].lower()
+            ):
+                info.lock_held.add(child.name)
+    raw_alias: dict = {}
+    for fn in info.methods.values():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                attr = _is_self_attr(tgt)
+                if attr is None:
+                    continue
+                kind = _factory_kind(node.value)
+                if kind == "lock":
+                    info.locks[attr] = attr
+                elif kind == "cond":
+                    # Condition(self._lock) shares _lock's mutex: one
+                    # guard, two spellings
+                    arg = (
+                        _is_self_attr(node.value.args[0])
+                        if node.value.args else None
+                    )
+                    info.locks[attr] = attr
+                    if arg is not None:
+                        raw_alias[attr] = arg
+                elif kind == "exempt":
+                    info.exempt.add(attr)
+    for attr, target in raw_alias.items():
+        info.locks[attr] = info.locks.get(target, target)
+    return info
+
+
+def _locks_in_expr(expr: ast.AST, info: _ClassInfo) -> set:
+    """Canonical lock names referenced by ``expr`` (a with-item)."""
+    out = set()
+    for node in ast.walk(expr):
+        attr = _is_self_attr(node)
+        if attr in info.locks:
+            out.add(info.locks[attr])
+    return out
+
+
+def _lock_calls(stmt: ast.AST, info: _ClassInfo, verb: str) -> set:
+    """Canonical locks with a ``self.X.<verb>()`` call in ``stmt``."""
+    out = set()
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr == verb:
+            attr = _is_self_attr(node.func.value)
+            if attr in info.locks:
+                out.add(info.locks[attr])
+    return out
+
+
+def _write_roots(stmt: ast.AST) -> set:
+    """ids of Attribute nodes that are WRITE targets in ``stmt``:
+    direct stores/deletes, subscript stores through them, and
+    container-mutator calls on them."""
+    roots: set = set()
+
+    def chase(node):
+        # self.a[i][j] -> the underlying self.a Attribute
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        return node
+
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            roots.add(id(node))
+        elif isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            base = chase(node.value)
+            if isinstance(base, ast.Attribute):
+                roots.add(id(base))
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr in _MUTATORS:
+            base = chase(node.func.value)
+            if isinstance(base, ast.Attribute):
+                roots.add(id(base))
+    return roots
+
+
+class _MethodScanner:
+    """Pass 2: walk one method's statements with the running lockset,
+    recording every ``self.<attr>`` access."""
+
+    def __init__(self, info: _ClassInfo, method: str):
+        self.info = info
+        self.method = method
+        self.in_init = method.split(".")[0] == "__init__"
+
+    def scan(self, fn: ast.FunctionDef) -> None:
+        self._block(fn.body, frozenset())
+
+    def _block(self, stmts: list, held: frozenset) -> None:
+        info = self.info
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def runs LATER, usually on another thread
+                # (worker closures, dispatch lambdas): its body holds
+                # nothing the enclosing scope held
+                nested = _MethodScanner(info, f"{self.method}.{stmt.name}")
+                nested.in_init = False
+                nested.scan(stmt)
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                continue
+            acquired = _lock_calls(stmt, info, "acquire")
+            if isinstance(stmt, ast.With):
+                added = set()
+                for item in stmt.items:
+                    self._expr(item.context_expr, held, False)
+                    added |= _locks_in_expr(item.context_expr, info)
+                self._block(stmt.body, held | added)
+            elif isinstance(stmt, ast.Try):
+                self._block(stmt.body, held)
+                for h in stmt.handlers:
+                    self._block(h.body, held)
+                self._block(stmt.orelse, held)
+                self._block(stmt.finalbody, held)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                self._expr(stmt.test, held, True)
+                self._block(stmt.body, held)
+                self._block(stmt.orelse, held)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._expr(stmt.iter, held, False)
+                self._expr(stmt.target, held, False)
+                self._block(stmt.body, held)
+                self._block(stmt.orelse, held)
+            else:
+                self._expr(stmt, held, False)
+            # linear acquire()/release() bracketing: later statements
+            # in THIS block run with the lock; a release anywhere
+            # inside a compound statement conservatively drops it
+            released = _lock_calls(stmt, info, "release")
+            held = (held | acquired) - released
+
+    def _expr(self, node: ast.AST, held: frozenset, test_pos: bool):
+        info = self.info
+        roots = _write_roots(node)
+        for sub in ast.walk(node):
+            attr = _is_self_attr(sub)
+            if attr is None:
+                continue
+            if (
+                attr in info.locks
+                or attr in info.exempt
+                or attr in info.methods
+                or attr.startswith("__")
+            ):
+                continue
+            info.accesses.append(_Access(
+                attr=attr,
+                method=self.method,
+                line=sub.lineno,
+                write=(
+                    id(sub) in roots
+                    or isinstance(sub.ctx, (ast.Store, ast.Del))
+                ),
+                locks=held,
+                in_init=self.in_init,
+                test_pos=test_pos,
+            ))
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute
+            ):
+                callee = _is_self_attr(sub.func)
+                if callee in info.methods:
+                    info.calls.setdefault(
+                        self.method.split(".")[0], set()
+                    ).add(callee)
+
+
+def _thread_entry_names(project: Project) -> set:
+    """Method names used as Thread/Timer targets anywhere in the tree
+    — the entry points concurrency flows in through."""
+    names: set = set()
+    for sf in project.files.values():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname is None or fname.rsplit(".", 1)[-1] not in (
+                "Thread", "Timer",
+            ):
+                continue
+            cands = [kw.value for kw in node.keywords
+                     if kw.arg in ("target", "function")]
+            if fname.rsplit(".", 1)[-1] == "Timer" and len(node.args) > 1:
+                cands.append(node.args[1])
+            for cand in cands:
+                if isinstance(cand, ast.Attribute):
+                    names.add(cand.attr)
+                elif isinstance(cand, ast.Name):
+                    names.add(cand.id)
+    return names
+
+
+def _reachable(info: _ClassInfo, entries: set) -> set:
+    """Methods reachable from a thread entry: explicit Thread/Timer
+    targets plus the public surface (dunder protocol methods included
+    — any caller thread can enter either), closed over self-calls."""
+    seeds = {
+        m for m in info.methods
+        if m in entries
+        or not m.startswith("_")
+        or (m.startswith("__") and m != "__init__")
+    }
+    seen = set(seeds)
+    work = list(seeds)
+    while work:
+        m = work.pop()
+        for callee in info.calls.get(m, ()):
+            if callee not in seen:
+                seen.add(callee)
+                work.append(callee)
+    return seen
+
+
+class LocksetChecker:
+    rules = (
+        ("race-guard-mismatch",
+         "attribute accessed both with and without its inferred guard"),
+        ("race-check-then-act",
+         "guarded field read in a test outside the guard it acts under"),
+    )
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        entries = _thread_entry_names(project)
+        for rel, sf in sorted(project.files.items()):
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                info = _collect_class(node)
+                if not info.locks:
+                    continue  # no lock, no lockset discipline to check
+                for mname, fn in info.methods.items():
+                    _MethodScanner(info, mname).scan(fn)
+                reach = _reachable(info, entries)
+                yield from self._judge(rel, info, reach)
+
+    def _judge(self, rel: str, info: _ClassInfo, reach: set):
+        by_attr: dict = {}
+        for a in info.accesses:
+            by_attr.setdefault(a.attr, []).append(a)
+        for attr, accs in sorted(by_attr.items()):
+            live = [a for a in accs if not a.in_init]
+            guarded_writes = [a for a in live if a.write and a.locks]
+            if not guarded_writes:
+                continue  # nothing establishes a guard
+            guard = frozenset.intersection(
+                *[a.locks for a in guarded_writes]
+            )
+            if not guard:
+                continue  # writes disagree on the lock: no one guard
+            offenders = [
+                a for a in live
+                if not (a.locks & guard)
+                and a.method.split(".")[0] in reach
+                and a.method.split(".")[0] not in info.lock_held
+            ]
+            if not offenders:
+                continue
+            # which methods ALSO touch the attr under guard — the
+            # check-then-act classifier needs the "act" half
+            acts_under_guard = {
+                a.method.split(".")[0] for a in live if a.locks & guard
+            }
+            emitted: set = set()
+            for a in offenders:
+                base = a.method.split(".")[0]
+                cta = (
+                    a.test_pos and not a.write
+                    and base in acts_under_guard
+                )
+                rule = (
+                    "race-check-then-act" if cta
+                    else "race-guard-mismatch"
+                )
+                key = (rule, a.attr, a.method)
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                gname = "/".join(sorted(guard))
+                what = "written" if a.write else "read"
+                if cta:
+                    msg = (
+                        f"{info.name}.{a.method} tests self.{attr} "
+                        f"OUTSIDE {gname} and then acts on it under "
+                        f"the guard — the checked invariant can change "
+                        f"between check and act; move the test inside "
+                        f"the guarded region"
+                    )
+                else:
+                    msg = (
+                        f"{info.name}.{a.method}: self.{attr} {what} "
+                        f"without {gname}, but writes elsewhere "
+                        f"establish {gname} as its guard — a thread "
+                        f"entering {a.method} races the guarded "
+                        f"writers; take the guard (or pragma with why "
+                        f"the unguarded access is safe)"
+                    )
+                yield Finding(
+                    rule=rule,
+                    path=rel,
+                    line=a.line,
+                    message=msg,
+                    ident=f"{info.name}.{attr}:{a.method}",
+                )
+
+
+class ReleasePathChecker:
+    rules = (
+        ("lock-release-path",
+         "acquire() whose release() is not in a try/finally"),
+    )
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        from opentenbase_tpu.analysis.core import iter_functions
+
+        for rel, sf in sorted(project.files.items()):
+            for qualname, fn in iter_functions(sf.tree):
+                yield from self._check_fn(rel, qualname, fn)
+
+    def _check_fn(self, rel: str, qualname: str, fn: ast.AST):
+        from opentenbase_tpu.analysis.core import walk_shallow
+
+        acquires: dict = {}
+        releases: dict = {}
+        protected: set = set()  # targets released in a finally
+        # shallow walk: iter_functions yields nested defs under their
+        # own qualnames — descending here would report each closure's
+        # pair twice, once misattributed to the enclosing scope
+        for node in walk_shallow(fn):
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for sub in walk_shallow(stmt):
+                        t = self._verb_target(sub, "release")
+                        if t is not None:
+                            protected.add(t)
+            t = self._verb_target(node, "acquire")
+            if t is not None:
+                acquires.setdefault(t, node.lineno)
+            t = self._verb_target(node, "release")
+            if t is not None:
+                releases.setdefault(t, node.lineno)
+        for target, line in sorted(acquires.items()):
+            if target not in releases or target in protected:
+                # released elsewhere (a handoff) or properly finally'd
+                continue
+            yield Finding(
+                rule="lock-release-path",
+                path=rel,
+                line=line,
+                message=(
+                    f"{qualname}: {target}.acquire() is released on "
+                    f"line {releases[target]} outside any try/finally "
+                    f"— an exception in between leaks the lock held "
+                    f"forever; wrap the span in try/finally (or use "
+                    f"`with`)"
+                ),
+                ident=f"{qualname}:{target}",
+            )
+
+    @staticmethod
+    def _verb_target(node: ast.AST, verb: str) -> Optional[str]:
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr == verb:
+            return dotted_name(node.func.value)
+        return None
+
+
+def checkers() -> list:
+    return [LocksetChecker(), ReleasePathChecker()]

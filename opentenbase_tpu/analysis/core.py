@@ -30,10 +30,13 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
-# pragma grammar, after a comment hash: the marker `otb_lint:` then
-# `ignore[...]` with rule names, then a mandatory reason behind `--`
+# pragma grammar, after a comment hash: the tool marker (`otb_lint:`
+# for the lint families, `otb_race:` for the race families — each tool
+# sees only its own pragmas, so a race suppression never reads as lint
+# rot) then `ignore[...]` with rule names, then a mandatory reason
+# behind `--`
 _PRAGMA_RE = re.compile(
-    r"#\s*otb_lint:\s*ignore\[([A-Za-z0-9_,\- ]*)\]"
+    r"#\s*otb_(lint|race):\s*ignore\[([A-Za-z0-9_,\- ]*)\]"
     r"(?:\s*--\s*(.*\S))?\s*$"
 )
 
@@ -72,6 +75,7 @@ class Pragma:
     line: int
     rules: frozenset  # rule names, or {"*"}
     reason: Optional[str]
+    tool: str = "lint"  # which tool's run may consume it
     used: bool = False
 
     def covers(self, rule: str) -> bool:
@@ -108,9 +112,11 @@ class SourceFile:
                     continue
                 lineno = tok.start[0]
                 rules = frozenset(
-                    r.strip() for r in m.group(1).split(",") if r.strip()
+                    r.strip() for r in m.group(2).split(",") if r.strip()
                 ) or frozenset({"*"})
-                sf.pragmas[lineno] = Pragma(lineno, rules, m.group(2))
+                sf.pragmas[lineno] = Pragma(
+                    lineno, rules, m.group(3), tool=m.group(1)
+                )
         except tokenize.TokenError:
             pass  # compileall owns malformed files
         for node in ast.walk(tree):
@@ -118,12 +124,15 @@ class SourceFile:
                 sf.str_constants.setdefault(node.value, node.lineno)
         return sf
 
-    def suppression_for(self, finding: Finding) -> Optional[Pragma]:
-        """The pragma covering ``finding``, if any: same line or the
-        line above (for statements too long to share a line)."""
+    def suppression_for(
+        self, finding: Finding, tool: str = "lint",
+    ) -> Optional[Pragma]:
+        """The ``tool``'s pragma covering ``finding``, if any: same
+        line or the line above (for statements too long to share a
+        line)."""
         for lineno in (finding.line, finding.line - 1):
             p = self.pragmas.get(lineno)
-            if p is not None and p.covers(finding.rule):
+            if p is not None and p.tool == tool and p.covers(finding.rule):
                 return p
         return None
 
@@ -212,11 +221,12 @@ def dotted_name(node: ast.AST) -> Optional[str]:
 
 
 def run_checkers(
-    project: Project, checkers: Iterable,
+    project: Project, checkers: Iterable, tool: str = "lint",
 ) -> tuple[list[Finding], list[Finding]]:
-    """Run every checker; apply pragmas. Returns (active, suppressed)
-    findings, both sorted. Reasonless pragmas that matched a finding
-    surface as ``pragma-missing-reason`` findings of their own."""
+    """Run every checker; apply the ``tool``'s pragmas. Returns
+    (active, suppressed) findings, both sorted. Reasonless pragmas
+    that matched a finding surface as ``pragma-missing-reason``
+    findings of their own."""
     raw: list[Finding] = []
     for checker in checkers:
         raw.extend(checker.run(project))
@@ -224,7 +234,7 @@ def run_checkers(
     suppressed: list[Finding] = []
     for f in raw:
         sf = project.files.get(f.path)
-        pragma = sf.suppression_for(f) if sf is not None else None
+        pragma = sf.suppression_for(f, tool) if sf is not None else None
         if pragma is None:
             active.append(f)
             continue
@@ -239,7 +249,7 @@ def run_checkers(
                 line=pragma.line,
                 message=(
                     f"suppression of {f.rule} has no reason; write "
-                    f"`# otb_lint: ignore[{f.rule}] -- <why>`"
+                    f"`# otb_{tool}: ignore[{f.rule}] -- <why>`"
                 ),
                 ident=f"{pragma.line}:{f.rule}",
             ))
@@ -249,7 +259,7 @@ def run_checkers(
         seq: dict = {}
         for lineno in sorted(sf.pragmas):
             p = sf.pragmas[lineno]
-            if p.used:
+            if p.used or p.tool != tool:
                 continue
             rules = ",".join(sorted(p.rules))
             n = seq[rules] = seq.get(rules, 0) + 1

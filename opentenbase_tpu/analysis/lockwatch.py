@@ -132,6 +132,7 @@ class _WatchedLock:
         if hasattr(self._lk, "_is_owned"):
             return self._lk._is_owned()
         # Lock fallback, same trick Condition uses
+        # otb_race: ignore[lock-release-path] -- nonblocking ownership probe: acquire(False)/release back-to-back, nothing between them can raise
         if self._lk.acquire(False):
             self._lk.release()
             return False

@@ -174,14 +174,20 @@ class DNServer:
                 except Exception:
                     pass
             self._peer_pools.clear()
-        if self._promoted_srv is not None:
+        # snapshot under the promote lock: stop() racing a concurrent
+        # promotion RPC could read a half-published (_promoted_srv,
+        # _promoted_walsender) pair and leak the one it missed
+        with self._promote_mu:
+            promoted_srv = self._promoted_srv
+            promoted_walsender = self._promoted_walsender
+        if promoted_srv is not None:
             try:
-                self._promoted_srv.stop()
+                promoted_srv.stop()
             except Exception:
                 pass
-        if self._promoted_walsender is not None:
+        if promoted_walsender is not None:
             try:
-                self._promoted_walsender.stop()
+                promoted_walsender.stop()
             except Exception:
                 pass
         self.standby.stop()
@@ -363,8 +369,13 @@ class DNServer:
                     "gen": cur,
                     "sqlstate": "72000",
                 }
-            if hg > self._hgen:
-                self._hgen = hg
+            # advance the learned generation under the promote lock:
+            # two dispatch threads doing an unguarded read-max-write
+            # could finish in the wrong order and REGRESS _hgen,
+            # quietly re-opening the fence for a stale ex-primary
+            with self._promote_mu:
+                if hg > self._hgen:
+                    self._hgen = hg
         self._failpoint("dn/dispatch", op=op)
         if op == "cancel_fragment":
             tok = str(msg.get("token") or "")
@@ -389,6 +400,7 @@ class DNServer:
                 # failover is visible on the next heartbeat
                 "generation": self.effective_generation(),
                 "role": (
+                    # otb_race: ignore[race-guard-mismatch] -- heartbeat snapshot; a ping racing the promotion RPC reports the pre-promote role for one beat, the next beat corrects it
                     "coordinator" if self._promoted_srv is not None
                     else "datanode"
                 ),
@@ -783,6 +795,7 @@ class DNServer:
         wire ops (_hgen), from replayed ha_generation WAL records (the
         standby cluster's node_generation), or from its own promotion."""
         return max(
+            # otb_race: ignore[race-guard-mismatch] -- lock-free monotonic read on the per-op fencing hot path; a stale int defers the refusal to the caller's next op, it never unfences
             self._hgen,
             int(getattr(self.standby.cluster, "node_generation", 0)),
         )
@@ -835,7 +848,11 @@ class DNServer:
         walreceiver contract). The ha_generation record arrives over
         the new stream and advances our WAL-learned generation."""
         self._failpoint("dn/repoint")
-        if self._promoted_srv is not None:
+        with self._promote_mu:
+            # guarded: a repoint racing this node's own promotion RPC
+            # must see the published role, not a half-built one
+            promoted = self._promoted_srv
+        if promoted is not None:
             return {"error": "node is a promoted coordinator; "
                              "it does not follow anyone"}
         host = str(msg.get("wal_host") or "127.0.0.1")
@@ -919,6 +936,7 @@ class DNServer:
         token = msg.get("cancel_token")
 
         def cancelled() -> bool:
+            # otb_race: ignore[race-guard-mismatch] -- lock-free poll at every operator boundary; dict membership is GIL-atomic and a missed-by-one-poll cancel lands at the next boundary
             return token is not None and token in self._cancelled
 
         def cancel_check() -> None:

@@ -575,6 +575,7 @@ class Cluster:
         real TPU under axon; virtual CPU devices elsewhere). Constructed
         under the fused lock: concurrent readers must share ONE
         program/device cache."""
+        # otb_race: ignore[race-check-then-act] -- double-checked lazy init: the cheap unguarded probe is re-verified under _fused_lock before anything is built
         if self._fused is None and not self._fused_failed:
             with self._fused_lock:
                 if self._fused is None and not self._fused_failed:
@@ -609,6 +610,7 @@ class Cluster:
                             )
                     except Exception:
                         self._fused_failed = True
+        # otb_race: ignore[race-guard-mismatch] -- publish-once read: _fused only ever transitions None -> built (under _fused_lock), and a stale None just re-enters the guarded branch
         return self._fused
 
     # -- table lifecycle -------------------------------------------------

@@ -385,10 +385,11 @@ def _verify(schedule, topo, mon, traffic, crash_wall,
         ):
             break
         time.sleep(0.1)
+    mon_stats = mon.stats()  # guarded snapshot of the beat counters
     verdict["acked_writes"] = len(traffic.acked_set)
     verdict["indeterminate_writes"] = len(traffic.indeterminate)
     verdict["reads_ok"] = traffic.reads_ok
-    verdict["promotions"] = mon.promotions
+    verdict["promotions"] = mon_stats["promotions"]
     verdict["generation"] = topo.generation
 
     # -- invariant 4: auto-promotion within the detection budget ------
@@ -396,8 +397,8 @@ def _verify(schedule, topo, mon, traffic, crash_wall,
         if topo.promoted_index is None:
             bad.append({"invariant": "auto_promotion",
                         "error": "primary crashed but nothing promoted"})
-        elif mon.declared_dead_at is not None:
-            latency_ms = (mon.declared_dead_at - crash_wall) * 1000.0
+        elif mon_stats["declared_dead_at"] is not None:
+            latency_ms = (mon_stats["declared_dead_at"] - crash_wall) * 1000.0
             budget_ms = detect_ms + detect_ms / beats + 600
             verdict["detect_latency_ms"] = round(latency_ms, 1)
             verdict["detect_budget_ms"] = round(budget_ms, 1)

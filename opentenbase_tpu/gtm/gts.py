@@ -92,6 +92,7 @@ class GTSClock:
         self._advance_watermark()
 
     def _advance_watermark(self) -> None:
+        """Caller holds ``_lock`` (or is ``__init__``, pre-publication)."""
         # failpoint: the reserve-ahead durability write — an error here
         # is a GTM whose clock store fsync failed (a promoted standby's
         # clock must still resume above the watermark)
@@ -222,6 +223,7 @@ class GTSServer:
 
     # -- node registration (recovery/register_gtm.c) --------------------
     def _persist_nodes(self) -> None:
+        """Caller holds ``_lock`` (register/unregister take it)."""
         # failpoint: node-registry durability (the re-registration a
         # promotion performs crosses this on its GTM re-point path)
         FAULT("gtm/persist_nodes")
@@ -267,6 +269,10 @@ class GTSServer:
             return {k: dict(v) for k, v in self._nodes.items()}
 
     def _persist_seqs(self) -> None:
+        """Caller holds ``_lock`` (every sequence verb takes it)."""
+        # failpoint: sequence durability — an error here is a GTM whose
+        # seq store fsync failed (nextval must not over-promise ranges)
+        FAULT("gtm/persist_seqs")
         if self._seq_path is None:
             return
         state = {}

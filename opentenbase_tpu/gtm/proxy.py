@@ -21,6 +21,7 @@ from typing import Optional
 
 from opentenbase_tpu.gtm.client import NativeGTS
 from opentenbase_tpu.net.protocol import shutdown_and_close
+from opentenbase_tpu.obs.log import elog
 
 
 class GTSProxy:
@@ -36,6 +37,10 @@ class GTSProxy:
         self._lsock.listen(128)
         self.host, self.port = self._lsock.getsockname()
         self.stats: Counter = Counter()
+        # guards the frontend counter + stats: every accepted frontend
+        # runs its own _serve thread, and an unguarded += there is the
+        # lost-update class otb_race exists to catch
+        self._fr_mu = threading.Lock()
         self.frontends = 0
         self._stop = threading.Event()
         self._accept: Optional[threading.Thread] = None
@@ -51,20 +56,41 @@ class GTSProxy:
         self.upstream.close()
 
     def _accept_loop(self) -> None:
+        from opentenbase_tpu.fault import FAULT
+
         while not self._stop.is_set():
             try:
                 conn, _ = self._lsock.accept()
             except OSError:
                 return
+            try:
+                # failpoint in its OWN try block (the PR 12 accept-loop
+                # lesson): drop_conn is a ConnectionResetError, and the
+                # accept handler above would read it as a closed
+                # listener and kill the loop — any injected action must
+                # cost one frontend, never the proxy
+                FAULT("gtm/proxy/accept")
+            except Exception as e:
+                elog("warning", "gtm",
+                     f"proxy frontend attach refused: {e!r:.120}")
+                shutdown_and_close(conn)
+                continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(
                 target=self._serve, args=(conn,), daemon=True
             ).start()
 
     def _serve(self, conn: socket.socket) -> None:
-        self.frontends += 1
+        from opentenbase_tpu.fault import FAULT
+
+        with self._fr_mu:
+            self.frontends += 1
         try:
             while not self._stop.is_set():
+                # failpoint: one frontend's request loop — error/
+                # drop_conn sever THIS frontend (caught below), delay
+                # models a slow proxy hop
+                FAULT("gtm/proxy/serve")
                 head = _recv_exact(conn, 4)
                 if head is None:
                     return
@@ -74,7 +100,8 @@ class GTSProxy:
                 body = _recv_exact(conn, length)
                 if body is None:
                     return
-                self.stats[body[0]] += 1
+                with self._fr_mu:
+                    self.stats[body[0]] += 1
                 reply = self._exchange(head + body)
                 if reply is None:
                     return  # upstream failed mid-exchange: see _exchange
@@ -82,7 +109,8 @@ class GTSProxy:
         except (OSError, RuntimeError):
             return
         finally:
-            self.frontends -= 1
+            with self._fr_mu:
+                self.frontends -= 1
             try:
                 conn.close()
             except OSError:
@@ -125,9 +153,14 @@ class GTSProxy:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    from opentenbase_tpu.fault import FAULT
+
     out = b""
     while len(out) < n:
         try:
+            # failpoint: the proxy-side frame read — drop_conn is an
+            # OSError here, i.e. exactly a torn frontend connection
+            FAULT("gtm/proxy/recv")
             chunk = sock.recv(n - len(out))
         except OSError:
             return None

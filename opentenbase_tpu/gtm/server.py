@@ -18,6 +18,7 @@ from typing import Optional
 from opentenbase_tpu.gtm import client as C
 from opentenbase_tpu.gtm.gts import GTSServer
 from opentenbase_tpu.net.protocol import shutdown_and_close
+from opentenbase_tpu.obs.log import elog
 
 
 class GTSFrontend:
@@ -65,11 +66,23 @@ class GTSFrontend:
             shutdown_and_close(conn)
 
     def _accept_loop(self) -> None:
+        from opentenbase_tpu.fault import FAULT
+
         while True:
             try:
                 conn, _ = self._lsock.accept()
             except OSError:
                 return
+            try:
+                # failpoint in its OWN try block (the PR 12 accept-loop
+                # lesson): an injected drop severs one backend, never
+                # the frontend's accept thread
+                FAULT("gtm/frontend/accept")
+            except Exception as e:
+                elog("warning", "gtm",
+                     f"backend attach refused: {e!r:.120}")
+                shutdown_and_close(conn)
+                continue
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conns_mu:
                 self._conns.add(conn)

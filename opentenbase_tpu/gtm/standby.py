@@ -245,11 +245,25 @@ def serve_feed(link: ReplicationLink, host: str = "127.0.0.1",
             link.detach(qsb)
 
     def accept_loop() -> None:
+        from opentenbase_tpu.fault import FAULT
+        from opentenbase_tpu.net.protocol import shutdown_and_close
+        from opentenbase_tpu.obs.log import elog
+
         while True:
             try:
                 conn, _ = lsock.accept()
             except OSError:
                 return
+            try:
+                # failpoint in its OWN try block (the PR 12 accept-loop
+                # lesson): an injected drop refuses one standby attach,
+                # never kills the feed listener
+                FAULT("gtm/standby/accept")
+            except Exception as e:
+                elog("warning", "gtm",
+                     f"standby feed attach refused: {e!r:.120}")
+                shutdown_and_close(conn)
+                continue
             threading.Thread(target=pump, args=(conn,), daemon=True).start()
 
     t = threading.Thread(target=accept_loop, daemon=True)
@@ -281,11 +295,20 @@ def connect_feed(host: str, port: int) -> tuple["GTSStandby", threading.Thread]:
 
 
 def _send(sock: socket.socket, obj: dict) -> None:
+    from opentenbase_tpu.fault import FAULT
+
+    # failpoint: the feed-frame send — drop_conn is the primary dying
+    # mid-frame, the torn-feed case the standby must survive
+    FAULT("gtm/standby/send")
     data = json.dumps(obj).encode()
     sock.sendall(struct.pack("<I", len(data)) + data)
 
 
 def _recv(sock: socket.socket):
+    from opentenbase_tpu.fault import FAULT
+
+    # failpoint: the standby-side frame read (walreceiver analog)
+    FAULT("gtm/standby/recv")
     head = b""
     while len(head) < 4:
         chunk = sock.recv(4 - len(head))

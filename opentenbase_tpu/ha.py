@@ -51,6 +51,7 @@ import threading
 import time
 from typing import Optional
 
+from opentenbase_tpu.analysis.racewatch import shared_state
 from opentenbase_tpu.fault import FAULT
 from opentenbase_tpu.net.protocol import (
     recv_frame,
@@ -420,7 +421,12 @@ class HATopology:
                 self.ex_primary_standby.cluster.close()
             except Exception:
                 pass
-        if not self.primary_dead:
+        with self._mu:
+            # guarded read: stop() can race a crash_primary event still
+            # in flight on the schedule thread, and a stale False here
+            # would stop() the already-crashed primary's server twice
+            primary_dead = self.primary_dead
+        if not primary_dead:
             try:
                 self.server.stop()
             except Exception:
@@ -447,6 +453,7 @@ class HATopology:
                 pass
 
 
+@shared_state("_mu")
 class HAMonitor:
     """The failure detector + auto-promotion loop (clustermon's probe
     cadence, Patroni's decision rule). Probes the active coordinator
@@ -472,6 +479,9 @@ class HAMonitor:
         self.interval_s = self.detect_ms / self.beats / 1000.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # guards the beat counters: the monitor thread writes them,
+        # the chaos verifier reads them while the loop may still beat
+        self._mu = threading.Lock()
         self.misses = 0
         self.declared_dead_at: Optional[float] = None
         self.promotions = 0
@@ -500,25 +510,42 @@ class HAMonitor:
             return  # already failed over; this monitor's job is done
         probe = topo.probe_primary(timeout_s=min(self.interval_s, 0.5))
         if probe is not None:
-            self.misses = 0
+            with self._mu:
+                self.misses = 0
             return
-        self.misses += 1
-        if self.misses < self.beats:
+        with self._mu:
+            self.misses += 1
+            misses = self.misses
+            declare = misses >= self.beats and self.declared_dead_at is None
+            if declare:
+                self.declared_dead_at = time.time()
+        if misses < self.beats:
             return
-        if self.declared_dead_at is None:
-            self.declared_dead_at = time.time()
+        if declare:
             topo._note(
-                "declared_dead", misses=self.misses,
+                "declared_dead", misses=misses,
                 detect_ms=self.detect_ms, beats=self.beats,
             )
         # drive the failover; on a failed attempt (e.g. every candidate
         # currently crashed) keep retrying each beat until one succeeds
         res = topo.failover(
-            reason=f"{self.misses} consecutive missed beats"
+            reason=f"{misses} consecutive missed beats"
         )
-        self.last_failover = res
-        if res.get("ok") and not res.get("already"):
-            self.promotions += 1
+        with self._mu:
+            self.last_failover = res
+            if res.get("ok") and not res.get("already"):
+                self.promotions += 1
+
+    def stats(self) -> dict:
+        """Beat counters under the monitor lock — what the chaos
+        verifier (and anything else off the monitor thread) reads."""
+        with self._mu:
+            return {
+                "misses": self.misses,
+                "declared_dead_at": self.declared_dead_at,
+                "promotions": self.promotions,
+                "last_failover": self.last_failover,
+            }
 
 
 class RoutingClient:

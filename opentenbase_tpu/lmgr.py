@@ -110,7 +110,8 @@ class LockManager:
 
     def _blockers(self, keys, mode, session_id) -> list[_Holder]:
         """Holders that prevent this acquisition (self-held locks never
-        conflict — lock re-entrancy within a transaction)."""
+        conflict — lock re-entrancy within a transaction).
+        Caller holds ``_cv``."""
         out = []
         for key in keys:
             for h in self._held.get(key, ()):
@@ -239,9 +240,11 @@ class LockManager:
                 if hasattr(engine_lock, "park_reacquire"):
                     engine_lock.park_reacquire(park_token)
                 else:
+                    # otb_race: ignore[lock-release-path] -- the park/reacquire handoff: this acquire RESTORES the caller-owned lock released at park time; the bracketing try/finally is the caller's
                     engine_lock.acquire()
 
     def _grant(self, session_id, gxid, keys, mode) -> None:
+        """Caller holds ``_cv`` (acquire's admission loop)."""
         for key in keys:
             hs = self._held.setdefault(key, [])
             if not any(
@@ -266,7 +269,8 @@ class LockManager:
     def _edges(self) -> list[tuple]:
         """(waiter_session, waiter_gxid, holder_session, holder_gxid,
         node, table) — the merged cross-node dependency list
-        (pg_unlock_check_dependency's output shape)."""
+        (pg_unlock_check_dependency's output shape).
+        Caller holds ``_cv``."""
         out = []
         for w in self._waiters.values():
             for h in self._blockers(w.keys, w.mode, w.session_id):
@@ -284,7 +288,7 @@ class LockManager:
 
     def _cycle_through(self, session_id: int) -> Optional[list[int]]:
         """Cycle containing session_id, as a list of gxids (for the error
-        message), else None."""
+        message), else None.  Caller holds ``_cv``."""
         g = self._graph()
         path: list[int] = []
         seen: set[int] = set()

@@ -47,6 +47,7 @@ import threading
 import queue as _queue
 from typing import Optional
 
+from opentenbase_tpu.analysis.racewatch import shared_state
 from opentenbase_tpu.fault import FAULT, FaultDropConnection, FaultError
 from opentenbase_tpu.net.pgwire import (
     _Conn,
@@ -90,6 +91,7 @@ class _Client:
         self.closed = False
 
 
+@shared_state("_mu")
 class PgConcentrator:
     """Event-driven pgwire front end over a bounded Session pool."""
 
@@ -151,14 +153,20 @@ class PgConcentrator:
             self._jobs.put(None)  # worker sentinels
         for t in self._threads:
             t.join(timeout=5)
-        for cl in list(self._clients):
+        # snapshot-and-clear under the lock: a timed-out join above
+        # means the selector/worker threads may still be mid-_teardown,
+        # and iterating the live set while they discard from it races
+        # (set-changed-during-iteration, or a client severed twice)
+        with self._mu:
+            clients = list(self._clients)
+            self._clients.clear()
+        for cl in clients:
             cl.closed = True
             shutdown_and_close(cl.sock)
             sess = cl.pinned
             cl.pinned = None
             if sess is not None:
                 self._recycle(sess, retire=True)
-        self._clients.clear()
         try:
             self._sel.close()
         except OSError:

@@ -14,6 +14,7 @@ import socket
 import threading
 from typing import Optional
 
+from opentenbase_tpu.analysis.racewatch import shared_state
 from opentenbase_tpu.fault import FAULT
 from opentenbase_tpu.net.protocol import (
     encode_frame,
@@ -115,6 +116,7 @@ class ChannelFenced(ChannelError):
         self.peer_generation = peer_generation
 
 
+@shared_state("_lock")
 class ChannelPool:
     """Bounded pool of channels to ONE datanode."""
 
@@ -170,8 +172,12 @@ class ChannelPool:
                 self._total -= 1
                 self._cv.notify()
             raise ChannelError(f"connect failed: {e}") from e
-        self.stats["opened"] += 1
-        self.stats["acquired"] += 1
+        # under the lock like every other stats update: two threads
+        # opening channels at once were losing += increments (the first
+        # race otb_race confirmed — the counters drifted low under load)
+        with self._cv:
+            self.stats["opened"] += 1
+            self.stats["acquired"] += 1
         return ch
 
     def release(self, ch: Channel) -> None:

@@ -32,6 +32,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from opentenbase_tpu.analysis.racewatch import shared_state
+
 # severity order the reference's elog.c enforces via enum comparison;
 # the repo's historical bug was accepting the names without any order
 LEVELS: dict[str, int] = {
@@ -62,11 +64,14 @@ def format_record(rec: tuple) -> str:
     return line
 
 
+@shared_state("_mu")
 class LogRing:
     """Bounded in-memory server log for one node process.
 
-    Thread-safe; emit below the threshold is one dict lookup + compare
-    (no allocation), so debug-level call sites stay ~free in production.
+    Thread-safe; emit below the threshold is one uncontended lock hop +
+    dict compare (no allocation), so debug-level call sites stay cheap
+    in production — and the (threshold, dropped) pair stays consistent
+    under a concurrent ``SET log_min_messages``.
     """
 
     def __init__(
@@ -83,8 +88,12 @@ class LogRing:
 
     # -- configuration ---------------------------------------------------
     def set_min_level(self, name: str) -> None:
-        self.min_level = str(name).lower()
-        self._min_no = level_no(name)
+        # under the ring lock: a SET racing concurrent emitters was a
+        # torn (min_level, _min_no) pair — one emitter could filter by
+        # the old number while reporting the new name
+        with self._mu:
+            self.min_level = str(name).lower()
+            self._min_no = level_no(name)
 
     def attach_file(self, path: str) -> None:
         """Open ``path`` as the file sink (log_destination = file). Every
@@ -117,9 +126,15 @@ class LogRing:
         kwargs with None values are elided so call sites can pass ids
         unconditionally; the record's node label is always the ring's
         (a ``node=`` kwarg is ordinary context, e.g. a datanode index)."""
-        if level_no(level) < self._min_no:
-            self.dropped += 1
-            return None
+        # threshold check + drop count in ONE short critical section:
+        # the filtered path allocates nothing and the counter is a
+        # read-modify-write, so a consistent (threshold, dropped) view
+        # costs exactly the lock hop the old racy fast path pretended
+        # to avoid (it took _mu for the increment anyway)
+        with self._mu:
+            if level_no(level) < self._min_no:
+                self.dropped += 1
+                return None
         ctx_s = ""
         if ctx:
             kept = {k: v for k, v in ctx.items() if v is not None}

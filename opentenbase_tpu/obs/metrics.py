@@ -71,6 +71,7 @@ class MetricsRegistry:
         self.histograms: dict[str, Histogram] = {}
 
     def histogram(self, name: str) -> Histogram:
+        # otb_race: ignore[race-guard-mismatch] -- double-checked create-on-first-use: the unguarded .get is re-done as a guarded setdefault on miss, so both threads converge on one Histogram
         h = self.histograms.get(name)
         if h is None:
             with self._mu:

@@ -35,6 +35,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from opentenbase_tpu.analysis.racewatch import shared_state
+
 _tls = threading.local()
 
 
@@ -114,6 +116,7 @@ def inject(msg: dict) -> dict:
     return out
 
 
+@shared_state("_mu")
 class SpanRing:
     """Bounded per-node ring of finished remote spans (the DN/GTM side
     of a distributed trace).  Thread-safe; ``allocations`` counts every
@@ -121,6 +124,10 @@ class SpanRing:
     untraced path never touches it."""
 
     allocations = 0
+    # class-level counter, class-level lock: the += is a read-modify-
+    # write shared by every ring in the process, and guarding it with
+    # an instance _mu would still lose increments across instances
+    _alloc_mu = threading.Lock()
 
     def __init__(self, capacity: int = 4096):
         self._mu = threading.Lock()
@@ -137,7 +144,8 @@ class SpanRing:
         valued args are elided (the elog contract)."""
         if args:
             args = {k: v for k, v in args.items() if v is not None}
-        SpanRing.allocations += 1
+        with SpanRing._alloc_mu:
+            SpanRing.allocations += 1
         span_id = new_span_id()
         rec = [
             ctx.trace_id, span_id, parent_id or ctx.span_id,

@@ -51,6 +51,7 @@ import time
 from collections import OrderedDict
 from typing import Optional
 
+from opentenbase_tpu.analysis.racewatch import shared_state
 from opentenbase_tpu.fault import FAULT, FaultError
 from opentenbase_tpu.sql import ast as A
 
@@ -222,6 +223,7 @@ class _PlanEntry:
         self.created = time.time()
 
 
+@shared_state("_mu")
 class PlanCache:
     """LRU over (generic_fp, consts) → planned artifact."""
 
@@ -328,6 +330,7 @@ class _ResultEntry:
         self.created = time.time()
 
 
+@shared_state("_mu")
 class ResultCache:
     """Byte-bounded LRU over (generic_fp, consts) → result set,
     validity judged against the live per-table version counters."""

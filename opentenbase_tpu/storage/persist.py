@@ -382,6 +382,7 @@ class ClusterPersistence:
     def gid_decision(self, gid: str):
         """("commit", commit_ts) / ("abort", None) / None (no durable
         decision — presumed abort under the 2PC protocol)."""
+        # otb_race: ignore[race-guard-mismatch] -- deliberate lock-free .get on the resolver hot path (see _record_decision: only the evict loop needs the lock); a racing insert is invisible, never torn
         return self._gid_decisions.get(gid)
 
     # -- checkpoint -------------------------------------------------------

@@ -46,6 +46,7 @@ import threading
 import time
 from typing import Optional
 
+from opentenbase_tpu.analysis.racewatch import shared_state
 from opentenbase_tpu.fault import FAULT, site_rng
 from opentenbase_tpu.net.protocol import (
     REPL_PROBE,
@@ -56,6 +57,7 @@ from opentenbase_tpu.net.protocol import (
 from opentenbase_tpu.storage.persist import WAL
 
 
+@shared_state("_peers_mu")
 class WalSender:
     """Primary-side WAL streamer (walsender.c)."""
 

@@ -29,6 +29,7 @@ class ShardBarrier:
         self._active: set[int] = set()
 
     def active(self) -> bool:
+        # otb_race: ignore[race-guard-mismatch] -- advisory lock-free peek (plan-cache hit gating): bool(set) is GIL-atomic, and callers that need the real answer block in wait_readable
         return bool(self._active)
 
     @contextmanager
@@ -47,7 +48,8 @@ class ShardBarrier:
         """Block while any of ``shard_ids`` is being moved. ``None``
         means the caller couldn't prove which shards it touches —
         conservatively wait for EVERY active move."""
-        if not self._active:  # fast path: no barrier, no lock
+        # otb_race: ignore[race-check-then-act] -- fast path: no barrier, no lock; a move starting between check and return is indistinguishable from the move starting right after return (the barrier orders statements, not instants)
+        if not self._active:
             return
         ids = None if shard_ids is None else {int(s) for s in shard_ids}
         deadline = time.monotonic() + timeout_s

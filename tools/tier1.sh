@@ -5,17 +5,23 @@
 #      the 1500 s pytest budget is spent;
 #   2. static analysis: otb_lint --check against tools/lint_baseline.json
 #      (the ratchet — NEW invariant violations fail here in seconds);
-#   3. lockwatch smoke: a wire-driven concurrent workload under
+#   3. race analysis: otb_race --check against tools/race_baseline.json
+#      (the lockset ratchet — a NEW guarded/unguarded mix, check-then-
+#      act, or finally-less release fails here in seconds), then the
+#      racewatch chaos smoke: one fixed-seed chaos schedule under
+#      OTB_RACEWATCH=1 with every @shared_state class instrumented —
+#      any non-baselined disjoint-lockset race fails;
+#   4. lockwatch smoke: a wire-driven concurrent workload under
 #      OTB_LOCKWATCH=1 — any non-allowlisted lock-order cycle fails;
-#   4. the fast WLM smoke subset (tests/test_wlm.py, ~15 s) — the
+#   5. the fast WLM smoke subset (tests/test_wlm.py, ~15 s) — the
 #      admission-control layer sits in front of every statement, so a
 #      regression there poisons everything downstream;
-#   5. an observability smoke (obs/): EXPLAIN (ANALYZE, VERBOSE) of a
+#   6. an observability smoke (obs/): EXPLAIN (ANALYZE, VERBOSE) of a
 #      2-DN sharded join must print per-node rows, and a traced query
 #      must export parseable Chrome-trace JSON;
-#   6. matview / chaos / HA-chaos-schedule / telemetry /
+#   7. matview / chaos / HA-chaos-schedule / telemetry /
 #      join-mode+perf-gate smokes;
-#   7. the full ROADMAP tier-1 pytest command, verbatim (1500 s cap).
+#   8. the full ROADMAP tier-1 pytest command, verbatim (1500 s cap).
 #
 # Usage: tools/tier1.sh   (from anywhere; cd's to the repo root)
 
@@ -34,6 +40,55 @@ echo "== tier1: static analysis (otb_lint ratchet) =="
 # removed jax API, shutdown-less close, FAULTless boundary, int32
 # cumsum, unhandled wire op, bogus SQLSTATE) surfaces in seconds.
 timeout -k 10 120 python -m opentenbase_tpu.cli.otb_lint --check || exit 1
+
+echo "== tier1: race analysis (otb_race lockset ratchet) =="
+# the static half of otb_race: lockset inference over every class in
+# the tree — a NEW attribute accessed both with and without its
+# inferred guard (or a check-then-act read, or an acquire whose
+# release isn't in a try/finally) fails here in seconds, against
+# tools/race_baseline.json (same ratchet semantics as otb_lint)
+timeout -k 10 120 python -m opentenbase_tpu.cli.otb_race --check || exit 1
+
+echo "== tier1: racewatch chaos smoke (TSan-lite sanitizer) =="
+timeout -k 10 420 env OTB_RACEWATCH=1 python - <<'PY' || exit 1
+# The dynamic half: one fixed-seed chaos schedule (the PR 12 harness —
+# deterministic concurrency stress with a promotion, fencing, resync)
+# run with every @shared_state class instrumented. Two threads touching
+# the same instance field with disjoint locksets and at least one write
+# is a race; any race whose race-dynamic:: key is not in
+# tools/race_baseline.json fails the stage (blessing one requires
+# otb_race --bless-dynamic KEY --reason WHY). The schedule itself must
+# also stay green: a sanitizer run that breaks the invariants it
+# watches under proves nothing.
+# Replay any failure: OTB_RACEWATCH=1 python -m opentenbase_tpu.cli.otb_chaos --seed 1107 --schedules 1
+import json, sys, tempfile
+from opentenbase_tpu.analysis import baseline as bl
+from opentenbase_tpu.analysis import racewatch
+from opentenbase_tpu.fault.schedule import ChaosSchedule, run_schedule
+
+sched = ChaosSchedule.generate(1107, duration_s=4.0, num_datanodes=2)
+v = run_schedule(sched, tempfile.mkdtemp(prefix="otbracewatch_"),
+                 detect_ms=1100, beats=3)
+doc = bl.load("tools/race_baseline.json")
+new, baselined = racewatch.check_baseline(doc)
+ok = (
+    v["chaos_gate"] == "ok"
+    and v.get("acked_writes", 0) > 0
+    and not new
+)
+print(json.dumps({
+    "racewatch_gate": "ok" if ok else "fail",
+    "seed": v["seed"],
+    "chaos_gate": v["chaos_gate"],
+    "acked_writes": v.get("acked_writes"),
+    "races_new": [f.key for f in new],
+    "races_baselined": [f.key for f in baselined],
+    "violations": v.get("violations"),
+}))
+if not ok:
+    racewatch.report()
+    sys.exit(1)
+PY
 
 echo "== tier1: lockwatch smoke (lock-order watchdog) =="
 timeout -k 10 180 env OTB_LOCKWATCH=1 python - <<'PY' || exit 1
