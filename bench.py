@@ -613,6 +613,15 @@ def main():
     except Exception as e:
         _phase(f"serving leg failed: {e!r:.200}", t_start)
 
+    # write-path leg (ROADMAP item 4): TPC-B-style mixed tps, the
+    # prepared-insert burst, and bulk multi-row ingest — each against
+    # the seed configuration on the same binary. No TPU needed.
+    try:
+        if os.environ.get("BENCH_WRITE", "1") == "1":
+            write_leg(record, t_start)
+    except Exception as e:
+        _phase(f"write leg failed: {e!r:.200}", t_start)
+
     # Device health check before the next device leg batch: a tunnel
     # that wedged since startup would hang the leg; skip the remaining
     # device legs with an explicit marker instead. IN-PROCESS (a tiny
@@ -1004,6 +1013,187 @@ for cli in clients:
     except OSError:
         pass
 """
+
+
+def write_leg(record, t_start) -> None:
+    """Write path (ROADMAP item 4): the three-legged differential vs
+    the seed configuration (fsync-per-commit inside the WAL mutex,
+    GTS grant per commit, plan-pipeline row inserts) on the SAME
+    binary — ``enable_group_commit=off`` + ``enable_bulk_insert_rewrite
+    =off`` reproduces the seed behavior byte-for-byte.
+
+    Three measurements, all at ``BENCH_WRITE_SESSIONS`` concurrent
+    sessions / single-statement commits, synchronous_commit=local:
+
+    - ``write_tps``: TPC-B-style 1:1 mixed prepared UPDATE accounts /
+      INSERT history autocommit statements;
+    - ``write_burst_tps``: the PREPAREd-insert burst (the tentpole's
+      named workload — every statement one durable commit);
+    - ``ingest_rows_per_sec``: bulk multi-row INSERT ... VALUES
+      (BENCH_INGEST_BATCH rows/statement) through the INSERT->COPY
+      rewrite, vs the seed shape for the same rows: row-at-a-time
+      single-row INSERT statements (the "dozens of times" v2.5.0
+      claim's own baseline)."""
+    import shutil
+    import tempfile
+
+    secs = float(os.environ.get("BENCH_WRITE_SECS", 4))
+    sessions = int(os.environ.get("BENCH_WRITE_SESSIONS", 8))
+    batch_rows = int(os.environ.get("BENCH_INGEST_BATCH", 2000))
+    ingest_total = int(os.environ.get("BENCH_INGEST_ROWS", 20000))
+    rowwise_n = int(os.environ.get("BENCH_INGEST_ROWWISE", 400))
+
+    def make_cluster(optimized, d):
+        c = Cluster(num_datanodes=NUM_DN, shard_groups=64, data_dir=d)
+        c.conf_gucs["enable_fused_execution"] = False
+        c.conf_gucs["synchronous_commit"] = "local"
+        if not optimized:
+            c.conf_gucs["enable_group_commit"] = False
+            c.conf_gucs["enable_bulk_insert_rewrite"] = False
+        s = c.session()
+        s.execute(
+            "create table accounts (aid bigint, bal bigint) "
+            "distribute by shard(aid)"
+        )
+        s.execute(
+            "create table history (hid bigint, aid bigint, delta bigint)"
+            " distribute by shard(hid)"
+        )
+        s.execute(
+            "insert into accounts values "
+            + ",".join(f"({i},1000)" for i in range(256))
+        )
+        return c
+
+    def drive(c, mixed) -> float:
+        stop_at = time.monotonic() + secs
+        counts = [0] * sessions
+        errs: list[str] = []
+
+        def worker(w):
+            try:
+                x = c.session()
+                x.execute(
+                    "prepare hins as insert into history values "
+                    "($1, $2, $3)"
+                )
+                x.execute(
+                    "prepare aupd as update accounts set bal = bal + $1"
+                    " where aid = $2"
+                )
+                i = 0
+                while time.monotonic() < stop_at:
+                    i += 1
+                    try:
+                        if mixed and i % 2 == 0:
+                            x.execute(
+                                f"execute aupd({i % 13 - 6}, "
+                                f"{(w * 37 + i) % 256})"
+                            )
+                        else:
+                            x.execute(
+                                f"execute hins({w * 10_000_000 + i}, "
+                                f"{i % 256}, 1)"
+                            )
+                        counts[w] += 1
+                    except Exception as e:
+                        # write-write conflicts on a hot account are
+                        # the workload's own serialization failures,
+                        # not harness errors — retry the next txn
+                        if "serialize" not in str(e):
+                            raise
+            except Exception as e:
+                errs.append(f"{e!r:.200}")
+
+        ths = [
+            threading.Thread(target=worker, args=(w,))
+            for w in range(sessions)
+        ]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        if errs:
+            raise RuntimeError(f"write driver errors: {errs}")
+        return sum(counts) / secs
+
+    def ingest_bulk(c) -> float:
+        s = c.session()
+        t0 = time.perf_counter()
+        done = 0
+        while done < ingest_total:
+            n = min(batch_rows, ingest_total - done)
+            vals = ",".join(
+                f"({5_000_000 + done + i}, {i % 256}, 1)"
+                for i in range(n)
+            )
+            s.execute(f"insert into history values {vals}")
+            done += n
+        return ingest_total / (time.perf_counter() - t0)
+
+    def ingest_rowwise(c) -> float:
+        s = c.session()
+        t0 = time.perf_counter()
+        for i in range(rowwise_n):
+            s.execute(
+                f"insert into history values ({8_000_000 + i}, "
+                f"{i % 256}, 1)"
+            )
+        return rowwise_n / (time.perf_counter() - t0)
+
+    work = tempfile.mkdtemp(prefix="otb_write_bench_")
+    try:
+        base = make_cluster(False, f"{work}/base")
+        try:
+            base_tps = drive(base, mixed=True)
+            base_burst = drive(base, mixed=False)
+            base_ingest = ingest_rowwise(base)
+        finally:
+            base.close()
+        _phase(
+            f"write baseline: {base_tps:.0f} mixed tps, "
+            f"{base_burst:.0f} burst tps, "
+            f"{base_ingest:.0f} row-at-a-time rows/s",
+            t_start,
+        )
+        opt = make_cluster(True, f"{work}/opt")
+        try:
+            tps = drive(opt, mixed=True)
+            burst = drive(opt, mixed=False)
+            ingest = ingest_bulk(opt)
+            s = opt.session()
+            wal_stats = dict(
+                s.query("select stat, value from pg_stat_wal")
+            )
+        finally:
+            opt.close()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    record["write_sessions"] = sessions
+    record["write_tps"] = round(tps, 1)
+    record["write_tps_baseline"] = round(base_tps, 1)
+    record["write_speedup"] = round(tps / max(base_tps, 1e-9), 2)
+    record["write_burst_tps"] = round(burst, 1)
+    record["write_burst_baseline"] = round(base_burst, 1)
+    record["write_burst_speedup"] = round(
+        burst / max(base_burst, 1e-9), 2
+    )
+    record["ingest_rows_per_sec"] = round(ingest)
+    record["ingest_baseline_rows_per_sec"] = round(base_ingest)
+    record["ingest_speedup"] = round(ingest / max(base_ingest, 1e-9), 1)
+    record["ingest_batch_rows"] = batch_rows
+    record["group_commit_fsyncs_saved"] = wal_stats.get(
+        "fsyncs_saved", 0
+    )
+    record["insert_rewrites"] = wal_stats.get("insert_rewrites", 0)
+    _phase(
+        f"write leg: {tps:.0f} mixed tps ({record['write_speedup']}x), "
+        f"{burst:.0f} burst tps ({record['write_burst_speedup']}x), "
+        f"ingest {ingest:.0f} rows/s "
+        f"({record['ingest_speedup']}x row-at-a-time)",
+        t_start,
+    )
+    print(json.dumps(record), flush=True)
 
 
 def serving_leg(record, t_start) -> None:

@@ -41,6 +41,12 @@ def main(argv=None) -> int:
     ap.add_argument("--keep", action="store_true",
                     help="keep each schedule's data dirs")
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--sync-mode", default="on",
+                    choices=("off", "local", "remote_write", "on"),
+                    help="synchronous_commit rung to prove: the "
+                    "invariants adapt to what the mode promises "
+                    "(remote rungs: zero lost acked writes; off/local: "
+                    "contiguous-tail loss only)")
     args = ap.parse_args(argv)
 
     from opentenbase_tpu.fault.schedule import (
@@ -59,7 +65,7 @@ def main(argv=None) -> int:
         v = run_schedule(
             sched, f"{workdir}/seed{seed}",
             detect_ms=args.detect_ms, beats=args.beats,
-            keep=args.keep,
+            keep=args.keep, sync_mode=args.sync_mode,
         )
         verdicts.append(v)
         print(json.dumps(v, default=str), flush=True)

@@ -70,6 +70,11 @@ def _duration(v):
 
 def _enum(*allowed):
     def f(v):
+        # SET x = on/off/true/false arrives as a python bool (the SQL
+        # boolean keywords); PG's enum GUCs accept those spellings when
+        # the enum has on/off rungs (guc.c config_enum_lookup_by_name)
+        if isinstance(v, bool):
+            v = "on" if v else "off"
         s = str(v).lower()
         if s not in allowed:
             raise GucError(f"must be one of {allowed}, got {v!r}")
@@ -197,14 +202,48 @@ GUCS: dict = {
     # probe never triggers a failover
     "failover_detect_ms": (_duration, 3000),
     "failover_beats": (_int, 3),
-    # commit durability vs the hot standbys (the synchronous_commit
-    # ladder; ROADMAP item 4 adds remote_write/quorum modes): 'on' =
-    # a commit acks only after every reachable attached DN standby has
-    # APPLIED the commit's WAL position (remote_apply semantics) — the
-    # guarantee the HA failover invariant "zero lost committed writes"
-    # is built on; 'off' = ack after the local WAL fsync (today's
-    # default behavior, replication asynchronous)
-    "synchronous_commit": (_enum("off", "on"), "off"),
+    # commit durability ladder (the full PG synchronous_commit shape,
+    # ROADMAP item 4b): 'off' = ack once the commit record is written +
+    # OS-flushed, no fsync wait (an OS crash may lose the acked tail —
+    # never duplicates or reorders it; a process crash loses nothing);
+    # 'local' = ack after the group fsync (one leader fsync covers
+    # every concurrent committer); 'remote_write' = additionally wait
+    # until a QUORUM of attached standbys acked receipt of the commit's
+    # WAL position over the pipelined replication ack channel (no
+    # per-commit RPC — the walsender's in-memory ack table answers);
+    # 'on' = remote_apply: every reachable attached DN standby has
+    # APPLIED the position (the HA failover zero-lost-writes guarantee)
+    # default 'local', NOT 'off': before the ladder existed every commit
+    # record fsynced, so the conf-file default must keep that durability
+    # (an unconfigured deployment silently losing acked commits on an OS
+    # crash would be a downgrade, not a default)
+    "synchronous_commit": (
+        _enum("off", "local", "remote_write", "on"), "local",
+    ),
+    # group commit (ROADMAP item 4a): concurrent committers share one
+    # WAL fsync (leader election in storage/persist.WAL.flush_to) and
+    # one batched GTS grant (engine.GtsCommitBatcher). Off = the seed's
+    # fsync-per-commit + RPC-per-commit path (the bench differential's
+    # baseline and an operator escape hatch).
+    "enable_group_commit": (_bool, True),
+    # PG's commit_delay/commit_siblings: the flush leader naps
+    # commit_delay_us before its fsync — only when at least
+    # commit_siblings OTHER sessions are mid-commit — so their records
+    # join the batch. 0 (default) = never nap.
+    "commit_delay_us": (_int, 0),
+    "commit_siblings": (_int, 5),
+    # vectorized ingest (ROADMAP item 4c): multi-row INSERT ... VALUES
+    # of plain literals (and PREPAREd-insert EXECUTEs) bypass the
+    # general parse->analyze->plan pipeline and build per-shard
+    # columnar delta batches directly — the reference's multi-row
+    # INSERT -> COPY rewrite ("dozens of times" faster, v2.5.0 note).
+    # Off = the seed row-at-a-time path (differential baseline).
+    "enable_bulk_insert_rewrite": (_bool, True),
+    # background delta compaction (storage/compaction.py): fold pending
+    # ingest delta batches into base arrays every this-many ms so the
+    # first scan after a burst pays no fold latency. 0 = lazy-only
+    # (reads and VACUUM still fold).
+    "delta_compaction_naptime_ms": (_duration, 0),
     "autovacuum": (_bool, False),
     "autovacuum_naptime_s": (_int, 60),
     "autovacuum_scale_factor_pct": (_int, 20),

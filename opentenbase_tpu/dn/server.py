@@ -604,6 +604,12 @@ class DNServer:
             if commit_ts is None:
                 return False
             sub, arrays = serde.frame_from_wire(entry["writes"])
+            # failpoint: the batch-apply boundary (error = the DN dying
+            # between the decision and the store apply — direct_applied
+            # stays unset, so the stream's gid-tagged 'G' frame applies
+            # it exactly once on the ordinary path; delay = a DN whose
+            # ingest apply lags the coordinator's ack wait)
+            self._failpoint("dn/batch_apply", gid=gid, frames=len(sub))
             if c.persistence.frame_apply_gap(sub):
                 # our replica is BEHIND this frame: a touched table's
                 # DDL hasn't streamed yet, or our dictionaries are

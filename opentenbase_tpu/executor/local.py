@@ -330,7 +330,22 @@ class LocalExecutor:
                     if v is None:
                         continue
                 elif not isinstance(e, E.Const):
-                    raise ExecError("VALUES rows must be constants")
+                    # VALUES exprs are closed (the analyzer binds them
+                    # in an empty scope, so no Col refs): evaluate
+                    # through the ordinary compiler over a no-column
+                    # row, landing text straight in the target dict
+                    want = [oc.dict_id] if oc.type.is_text else None
+                    fns, params = self._bind(
+                        [e], (), self._subq(), want_dids=want
+                    )
+                    dv, vv = fns[0]([], params)
+                    if vv is not None and not bool(
+                        np.asarray(vv).reshape(-1)[0]
+                    ):
+                        continue
+                    data[ri] = np.asarray(dv).reshape(-1)[0]
+                    valid[ri] = True
+                    continue
                 elif e.value is None:
                     continue
                 else:
@@ -1282,7 +1297,45 @@ class LocalExecutor:
     # -- DML helper --------------------------------------------------------
     def predicate_rows(self, table: str, predicate: Optional[E.TExpr]) -> np.ndarray:
         """Row indices in this node's shard store matching the predicate
-        under the current snapshot (UPDATE/DELETE target selection)."""
+        under the current snapshot (UPDATE/DELETE target selection).
+
+        Row location is a WRITE-path cost (every TPC-B-style UPDATE pays
+        it), and the device round trip — upload all columns, run the
+        compiled predicate, download a mask — is pure overhead for the
+        point/range predicates DML overwhelmingly uses. Simple
+        predicates over non-text columns therefore evaluate HOST-side
+        in numpy (``_np_pred_eval``); anything it can't prove identical
+        (text, CASE, subqueries, decimals) takes the device path, which
+        alone defines the semantics."""
+        store = self.stores.get(table)
+        if (
+            store is not None
+            and self._foreign_store(table) is None
+            and self.scan_block is None
+        ):
+            n0 = store.nrows
+            cols = list(self.catalog.get(table).schema)
+            res = (
+                (np.ones(n0, np.bool_), None) if predicate is None
+                else _np_pred_eval(predicate, store, cols, n0)
+            )
+            if res is not None:
+                d, v = res
+                keep = d if v is None else (d & v)
+                live = np.ones(n0, dtype=np.bool_)
+                if self.snapshot_ts is not None:
+                    snap = np.int64(self.snapshot_ts)
+                    live &= (store.xmin_ts[:n0] <= snap) & (
+                        snap < store.xmax_ts[:n0]
+                    )
+                own = self.own_writes.get(table)
+                if own is not None:
+                    ins_ranges, del_idx = own
+                    for s, e in ins_ranges:
+                        live[s:min(e, n0)] = True
+                    if len(del_idx):
+                        live[np.asarray(del_idx)] = False
+                return np.nonzero(keep & live)[0]
         meta = self.catalog.get(table)
         schema = tuple(
             L.OutCol(
@@ -1304,6 +1357,123 @@ class LocalExecutor:
             mask = batch.mask
         m = np.asarray(mask)[: store.nrows]
         return np.nonzero(m)[0]
+
+
+_NP_CMP = {
+    "=": np.equal, "<>": np.not_equal, "<": np.less,
+    "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal,
+}
+
+
+def _np_and_valid(lv, rv):
+    if lv is None:
+        return rv
+    if rv is None:
+        return lv
+    return lv & rv
+
+
+def _np_pred_eval(e, store, cols, n):
+    """(data, validity) for a SIMPLE predicate over the store's host
+    arrays in numpy, or None when the expression needs the compiled
+    device path (see ``np_expr_eval``)."""
+    def getcol(idx):
+        if idx >= len(cols):
+            return None
+        name = cols[idx]
+        data = store._cols.get(name)
+        if data is None:
+            return None
+        vm = store._validity.get(name)
+        return (data[:n], None if vm is None else vm[:n])
+
+    return np_expr_eval(e, getcol)
+
+
+def np_expr_eval(e, getcol):
+    """(data, validity) for a SIMPLE expression evaluated in numpy, or
+    None when it needs the compiled device path. ``getcol(index)``
+    resolves a column reference to (data, validity) host arrays (or
+    None = unsupported column). Supported: Col/Const of non-text
+    non-decimal types, comparisons, and/or (three-valued NULL semantics
+    mirroring ops/expr.py run_and/run_or exactly), + - * arithmetic,
+    unary -/not. Everything else — text (dictionary codes), CASE,
+    casts, IN lists, subqueries, decimal scaling, / and % (div-by-zero
+    semantics) — returns None; the ExprCompiler alone defines those.
+    Shared by DML row location (predicate_rows) and UPDATE SET
+    evaluation (engine._apply_assignments) — the write path's two
+    per-statement expression costs."""
+    from opentenbase_tpu.ops.expr import _np_cast_const
+
+    if isinstance(e, E.Col):
+        if e.type.is_text or e.type.id == t.TypeId.DECIMAL:
+            return None
+        return getcol(e.index)
+    if isinstance(e, E.Const):
+        if e.type.is_text or e.type.id == t.TypeId.DECIMAL:
+            return None
+        if e.value is None:
+            return (
+                np.zeros((), dtype=e.type.np_dtype),
+                np.zeros((), dtype=np.bool_),
+            )
+        try:
+            return (_np_cast_const(e.value, e.type), None)
+        except (TypeError, ValueError, OverflowError):
+            return None
+    if isinstance(e, E.UnaryE):
+        r = np_expr_eval(e.operand, getcol)
+        if r is None:
+            return None
+        d, v = r
+        if e.op == "-":
+            return (-d, v)
+        if e.op == "not":
+            return (~d, v)
+        return None
+    if isinstance(e, E.BinE):
+        if e.left.type.is_text or e.right.type.is_text:
+            return None
+        if (
+            e.type.id == t.TypeId.DECIMAL
+            or e.left.type.id == t.TypeId.DECIMAL
+            or e.right.type.id == t.TypeId.DECIMAL
+        ):
+            return None
+        lr = np_expr_eval(e.left, getcol)
+        rr = np_expr_eval(e.right, getcol)
+        if lr is None or rr is None:
+            return None
+        ld, lv = lr
+        rd, rv = rr
+        if e.op == "and":
+            if lv is None and rv is None:
+                return (ld & rd, None)
+            lF = (ld == False) if lv is None else (lv & ~ld)  # noqa: E712
+            rF = (rd == False) if rv is None else (rv & ~rd)  # noqa: E712
+            valid = _np_and_valid(lv, rv)
+            defl = lF | rF
+            valid = defl if valid is None else (valid | defl)
+            return (np.where(defl, False, ld & rd), valid)
+        if e.op == "or":
+            if lv is None and rv is None:
+                return (ld | rd, None)
+            lT = ld if lv is None else (lv & ld)
+            rT = rd if rv is None else (rv & rd)
+            valid = _np_and_valid(lv, rv)
+            deft = lT | rT
+            valid = deft if valid is None else (valid | deft)
+            return (np.where(deft, True, ld | rd), valid)
+        if e.op in _NP_CMP:
+            return (_NP_CMP[e.op](ld, rd), _np_and_valid(lv, rv))
+        if e.op == "+":
+            return (ld + rd, _np_and_valid(lv, rv))
+        if e.op == "-":
+            return (ld - rd, _np_and_valid(lv, rv))
+        if e.op == "*":
+            return (ld * rd, _np_and_valid(lv, rv))
+        return None  # / and % have div-by-zero semantics: device path
+    return None
 
 
 def _op_detail(plan) -> Optional[str]:

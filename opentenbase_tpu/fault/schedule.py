@@ -247,14 +247,29 @@ def run_schedule(
     detect_ms: int = 1200,
     beats: int = 3,
     keep: bool = False,
+    sync_mode: str = "on",
 ) -> dict:
     """Execute one seeded schedule end to end and return the verdict
-    dict (chaos_gate ok/fail + every invariant's evidence)."""
+    dict (chaos_gate ok/fail + every invariant's evidence).
+
+    ``sync_mode`` is the cluster-wide ``synchronous_commit`` rung the
+    run proves (ROADMAP item 4b — every mode must keep exactly what it
+    promises, under the same crash schedule):
+
+    - ``on`` / ``remote_write``: ZERO lost acked writes after the
+      failover (remote-apply on every standby / quorum-acked receipt),
+      and reads never regress below a client's acked watermark;
+    - ``local`` / ``off``: the acked TAIL may be lost to the failover
+      (replication is asynchronous), but the per-client lost run must
+      be CONTIGUOUS — a survivor inside it is a replay hole, i.e.
+      reordering, and fails; duplicates and phantoms fail in every
+      mode."""
     from opentenbase_tpu.ha import HAMonitor, HATopology
 
     os.makedirs(workdir, exist_ok=True)
     verdict: dict = {
         "seed": schedule.seed,
+        "sync_mode": sync_mode,
         "events": [e.describe() for e in schedule.events],
         "violations": [],
     }
@@ -266,7 +281,7 @@ def run_schedule(
         topo = HATopology(
             workdir, schedule.num_datanodes, 32, conf_gucs={
                 "enable_fused_execution": "off",
-                "synchronous_commit": "on",
+                "synchronous_commit": sync_mode,
                 "failover_detect_ms": detect_ms,
                 "failover_beats": beats,
                 "fragment_retries": 1,
@@ -338,7 +353,7 @@ def run_schedule(
                 except Exception:
                     pass  # already on the new timeline, or truly gone
         _verify(schedule, topo, mon, traffic, crash_wall,
-                detect_ms, beats, verdict)
+                detect_ms, beats, verdict, sync_mode)
     except Exception as e:  # harness failure IS a failed run
         verdict["violations"].append(
             {"invariant": "harness", "error": f"{type(e).__name__}: {e}"}
@@ -362,7 +377,7 @@ def run_schedule(
 
 
 def _verify(schedule, topo, mon, traffic, crash_wall,
-            detect_ms, beats, verdict) -> None:
+            detect_ms, beats, verdict, sync_mode="on") -> None:
     from opentenbase_tpu.net.client import WireError, connect_tcp
 
     bad = verdict["violations"]
@@ -472,9 +487,41 @@ def _verify(schedule, topo, mon, traffic, crash_wall,
         bad.append({"invariant": "no_duplicates",
                     "rows": dups[:10], "count": len(dups)})
     lost = [k for k in traffic.acked_set if k not in seen]
-    if lost:
-        bad.append({"invariant": "zero_lost_committed_writes",
-                    "rows": sorted(lost)[:10], "count": len(lost)})
+    verdict["lost_acked_writes"] = len(lost)
+    if sync_mode in ("on", "remote_write"):
+        # the remote rungs promise ZERO lost acked writes across the
+        # failover (remote-apply / quorum-acked receipt)
+        if lost:
+            bad.append({"invariant": "zero_lost_committed_writes",
+                        "rows": sorted(lost)[:10], "count": len(lost)})
+    elif lost:
+        # off/local: replication is asynchronous, so the acked TAIL
+        # may die with the primary — ONE contiguous per-client run of
+        # acked seqs ending at the failover cut (the writer keeps
+        # writing on the promoted timeline afterwards, so LATER acked
+        # survivors are expected and fine). What must never happen is
+        # a SCATTERED loss — lost 41, survived 45, lost 47 — because
+        # the WAL is ordered and promotion takes a standby's applied
+        # prefix: a hole inside the lost run means a frame was
+        # replayed out of order or dropped mid-stream.
+        lost_by_client: dict = {}
+        for cid, s in lost:
+            lost_by_client.setdefault(cid, []).append(s)
+        holes = []
+        for cid, lseqs in lost_by_client.items():
+            lo, hi = min(lseqs), max(lseqs)
+            acked_in_run = [
+                s for (c2, s) in traffic.acked_set
+                if c2 == cid and lo <= s <= hi
+            ]
+            if len(lseqs) != len(acked_in_run):
+                holes.append({
+                    "client": cid, "lost": sorted(lseqs)[:10],
+                    "acked_in_run": len(acked_in_run),
+                })
+        if holes:
+            bad.append({"invariant": "lost_tail_contiguous",
+                        "holes": holes[:10], "count": len(holes)})
     attempted = traffic.acked_set | traffic.indeterminate
     phantom = [k for k in seen if k not in attempted and k[0] != 999]
     if phantom:
@@ -484,7 +531,11 @@ def _verify(schedule, topo, mon, traffic, crash_wall,
     verdict["final_rows"] = len(rows)
 
     # -- invariant 3a: monotone / non-stale reads ----------------------
-    if traffic.stale_reads:
+    verdict["stale_reads"] = len(traffic.stale_reads)
+    if traffic.stale_reads and sync_mode in ("on", "remote_write"):
+        # under off/local an acked write may legitimately be invisible
+        # on the promoted standby, so the acked-watermark floor only
+        # binds on the remote rungs (recorded above either way)
         bad.append({"invariant": "zero_stale_reads",
                     "cases": traffic.stale_reads[:10],
                     "count": len(traffic.stale_reads)})
@@ -530,6 +581,7 @@ def run_schedules(
     detect_ms: int = 1200,
     beats: int = 3,
     keep: bool = False,
+    sync_mode: str = "on",
 ) -> list[dict]:
     """Run ``count`` distinct seeded schedules (seeds base..base+n-1);
     one verdict per schedule."""
@@ -542,5 +594,6 @@ def run_schedules(
         out.append(run_schedule(
             sched, os.path.join(workdir, f"seed{seed}"),
             detect_ms=detect_ms, beats=beats, keep=keep,
+            sync_mode=sync_mode,
         ))
     return out

@@ -58,6 +58,12 @@ OP_TRACED = 0x11
 # records. The C++ native server answers status 1 → the client returns
 # no spans (it records none anyway).
 OP_TRACE_FETCH = 0x12
+# batched commit grant (group commit, ROADMAP item 4): payload =
+# <H count> + count x <q gxid>, reply = count x <q commit_ts> in
+# request order. The C++ native server predates the op and answers
+# status 1 — the client degrades to per-gxid OP_COMMIT for the rest of
+# the connection (grants still answer, just unbatched).
+OP_COMMIT_MANY = 0x13
 
 
 def _lp(s: str) -> bytes:
@@ -273,7 +279,13 @@ class NativeGTS:
                 wr.end(token)
         status = body[0]
         if status != 0:
-            raise GTSProtocolError(f"op {op:#x} failed")
+            # a COMPLETED exchange the server refused (e.g. unknown op,
+            # status 1) — carry the status so capability probes can
+            # tell this apart from transport failures, which raise
+            # without a status
+            err = GTSProtocolError(f"op {op:#x} failed")
+            err.status = status
+            raise err
         return body[1:]
 
     @staticmethod
@@ -424,6 +436,68 @@ class NativeGTS:
             info.state = TxnState.COMMITTED
             info.commit_ts = ts
         return ts
+
+    # OP_COMMIT_MANY capability: None = unprobed, False = the server
+    # answered status 1 once (C++ native build without the op) — stop
+    # re-asking and commit per gxid
+    _commit_many_capable: Optional[bool] = None
+
+    def commit_many(self, gxids) -> dict:
+        """Batched commit grant: ONE wire round-trip stamps every
+        queued committer (the group-commit GTS leg). Degrades to
+        per-gxid commits against a server without the op; in that
+        degraded loop a failing grant maps to an Exception VALUE for
+        its own gxid (the batcher re-raises it in the owning session)
+        instead of aborting the whole batch."""
+        gxids = list(gxids)
+        if not gxids:
+            return {}
+        if self._commit_many_capable is not False and len(gxids) > 1:
+            payload = struct.pack("<H", len(gxids))
+            for g in gxids:
+                payload += struct.pack("<q", g)
+            try:
+                body = self._rpc(OP_COMMIT_MANY, payload)
+            except GTSProtocolError as e:
+                if getattr(e, "status", None) is None:
+                    # transport failure (reset/failover exhaustion):
+                    # NOT a capability verdict — re-raise so the grants
+                    # fail like any lost commit reply would, instead of
+                    # re-committing gxids the lost batch may have
+                    # already stamped (a second commit_ts) and
+                    # permanently disabling batching
+                    raise
+                # unknown op on this server (a COMPLETED status-1
+                # reply): remember and fall through to the per-gxid
+                # path below
+                self._commit_many_capable = False
+            else:
+                self._commit_many_capable = True
+                tss = struct.unpack(f"<{len(gxids)}q", body)
+                for gxid, ts in zip(gxids, tss):
+                    info = self._txns.get(gxid)
+                    if info is not None:
+                        info.state = TxnState.COMMITTED
+                        info.commit_ts = ts
+                return dict(zip(gxids, tss))
+        out: dict = {}
+        for g in gxids:
+            try:
+                out[g] = self.commit(g)
+            except Exception as e:
+                # not swallowed: the exception travels by VALUE and the
+                # batcher re-raises it in the owning session; log here
+                # so the degraded-loop failure is visible server-side
+                from opentenbase_tpu.obs.log import elog
+
+                elog(
+                    "warning", "gtm",
+                    "per-gxid commit grant failed in the degraded "
+                    "commit_many loop",
+                    gxid=g, error=str(e),
+                )
+                out[g] = e
+        return out
 
     def abort(self, gxid: int) -> None:
         self._rpc(OP_ABORT, struct.pack("<q", gxid))

@@ -185,6 +185,13 @@ class GTSFrontend:
         if op == C.OP_COMMIT:
             (gxid,) = struct.unpack_from("<q", p, 0)
             return struct.pack("<q", g.commit(gxid))
+        if op == C.OP_COMMIT_MANY:
+            (m,) = struct.unpack_from("<H", p, 0)
+            gxids = struct.unpack_from(f"<{m}q", p, 2) if m else ()
+            tsmap = g.commit_many(gxids)
+            return b"".join(
+                struct.pack("<q", tsmap[gx]) for gx in gxids
+            )
         if op == C.OP_ABORT:
             (gxid,) = struct.unpack_from("<q", p, 0)
             g.abort(gxid)

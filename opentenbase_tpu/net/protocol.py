@@ -104,6 +104,29 @@ def recv_repl_hello(sock: socket.socket) -> tuple[int, int]:
     return unpack_repl_hello(data)
 
 
+# Replication ack frame (receiver -> sender, after the hellos): one
+# little-endian int64 = the receiver's applied offset, i.e. bytes it
+# has durably written to its own wal.log AND replayed. The walsender's
+# per-connection ack reader folds these into its peer table — the
+# in-memory evidence synchronous_commit=remote_write consults without
+# any per-commit RPC (the pipelined-quorum half of ROADMAP item 4b).
+
+_REPL_ACK = "<q"
+REPL_ACK_LEN = struct.calcsize(_REPL_ACK)
+
+
+def pack_repl_ack(offset: int) -> bytes:
+    return struct.pack(_REPL_ACK, offset)
+
+
+def recv_repl_ack(sock: socket.socket) -> int:
+    """One complete ack frame; raises ConnectionError on peer close."""
+    data = _recv_exact(sock, REPL_ACK_LEN)
+    if data is None:
+        raise ConnectionError("peer closed the replication ack channel")
+    return struct.unpack(_REPL_ACK, data)[0]
+
+
 def encode_frame(obj: dict) -> bytes:
     """Serialize a frame WITHOUT touching the socket. Callers that must
     stay exception-safe around pooled channels (net/pool.py) encode

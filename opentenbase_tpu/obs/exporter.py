@@ -342,6 +342,8 @@ def render_cluster_metrics(cluster) -> str:
         _head(out, "otb_wal_position_bytes", "gauge",
               "Current WAL end position")
         out.append(_line("otb_wal_position_bytes", {}, int(p.wal.position)))
+        wal = p.wal.stat_snapshot()
+        wal_pos = int(wal["position"])
         peers = []
         for sender in list(getattr(p, "wal_senders", ())):
             peers.extend(sender.peer_positions())
@@ -351,8 +353,62 @@ def render_cluster_metrics(cluster) -> str:
             for addr, sent in peers:
                 out.append(_line(
                     "otb_replication_lag_bytes", {"peer": addr},
-                    max(int(p.wal.position) - int(sent), 0),
+                    max(wal_pos - int(sent), 0),
                 ))
+        acks = []
+        for sender in list(getattr(p, "wal_senders", ())):
+            acks.extend(sender.peer_acks())
+        if acks:
+            _head(out, "otb_wal_ack_lag_bytes", "gauge",
+                  "WAL bytes each standby has not yet acknowledged "
+                  "applying (the synchronous_commit=remote_write "
+                  "evidence)")
+            for addr, acked in acks:
+                out.append(_line(
+                    "otb_wal_ack_lag_bytes", {"peer": addr},
+                    max(wal_pos - int(acked), 0),
+                ))
+        # group commit (ROADMAP item 4a): fsyncs paid vs commits that
+        # asked for durability, and the per-flush batch-size histogram
+        _head(out, "otb_wal_fsyncs_total", "counter",
+              "WAL fsync syscalls (group flush pays one per batch)")
+        out.append(_line("otb_wal_fsyncs_total", {}, int(wal["fsyncs"])))
+        _head(out, "otb_group_commit_saved_total", "counter",
+              "Commit fsyncs amortized away by group commit "
+              "(commit flushes minus leader fsyncs)")
+        out.append(_line(
+            "otb_group_commit_saved_total", {},
+            max(int(wal["commit_flushes"]) - int(wal["group_fsyncs"]), 0),
+        ))
+        hist = wal["batch_hist"]
+        if hist:
+            _head(out, "otb_group_commit_batch_size", "counter",
+                  "Group-flush batches by size bucket (le = commits "
+                  "covered by that one fsync, power-of-two buckets)")
+            for b in sorted(hist):
+                out.append(_line(
+                    "otb_group_commit_batch_size", {"le": str(b)},
+                    int(hist[b]),
+                ))
+    ist = getattr(cluster, "ingest_stats", None)
+    if ist is not None:
+        with cluster._ingest_stats_mu:
+            ist = dict(ist)
+        _head(out, "otb_ingest_batches_total", "counter",
+              "Columnar delta batches appended by the vectorized "
+              "ingest plane (multi-row INSERT -> COPY rewrite)")
+        out.append(_line(
+            "otb_ingest_batches_total", {}, int(ist["batches"]),
+        ))
+        _head(out, "otb_ingest_rows_total", "counter",
+              "Rows ingested through columnar delta batches")
+        out.append(_line("otb_ingest_rows_total", {}, int(ist["rows"])))
+        _head(out, "otb_ingest_compactions_total", "counter",
+              "Background/lazy delta-compaction passes that folded "
+              "batches into base tables")
+        out.append(_line(
+            "otb_ingest_compactions_total", {}, int(ist["compactions"]),
+        ))
     pools = getattr(cluster, "dn_channels", None) or {}
     if pools:
         _head(out, "otb_dn_pool_channels", "gauge",

@@ -1077,6 +1077,20 @@ class Analyzer:
                     raise AnalyzeError("INSERT has a different number of columns than values")
                 trow = []
                 for v, ty in zip(row, target_types):
+                    if (
+                        isinstance(v, A.Literal)
+                        and type(v.value) is float
+                        and ty.id in (t.TypeId.FLOAT4, t.TypeId.FLOAT8)
+                    ):
+                        # a float literal bound for a float column must
+                        # keep ALL its bits: the general expr path types
+                        # it DECIMAL first (scaled int64), which
+                        # quantizes the low mantissa bits away — and
+                        # the bulk INSERT->COPY rewrite (engine.py),
+                        # which stores the literal exactly, would then
+                        # diverge from this pipeline
+                        trow.append(E.Const(float(v.value), ty))
+                        continue
                     te = self.expr(v, ExprContext(Scope([]), self))
                     trow.append(_cast(te, ty))
                 rows.append(tuple(trow))

@@ -130,8 +130,18 @@ class Column:
         vm = self.valid_mask
         ty = self.type
         if ty.id == t.TypeId.TEXT and self.dictionary is not None:
-            dec = self.dictionary.decode_array(np.clip(self.data, 0, None))
-            return [dec[i] if vm[i] else None for i in range(len(self.data))]
+            # decode only the valid slots: a NULL slot's code-0 fill may
+            # not exist in the dictionary (an all-NULL column never
+            # minted an entry), and must never be dereferenced
+            out: list = [None] * len(self.data)
+            idx = np.nonzero(vm)[0]
+            if len(idx):
+                dec = self.dictionary.decode_array(
+                    np.clip(self.data[idx], 0, None)
+                )
+                for j, i in enumerate(idx):
+                    out[i] = dec[j]
+            return out
         if ty.id == t.TypeId.DECIMAL:
             f = ty.decimal_factor
             return [
@@ -160,13 +170,22 @@ def column_from_python(values: list, ty: t.SqlType, dictionary: Dictionary | Non
     all_valid = bool(validity.all())
     filled = values
     if not all_valid:
-        zero: object = 0
-        if ty.id == t.TypeId.TEXT:
-            zero = ""
-        filled = [zero if v is None else v for v in values]
+        filled = [0 if v is None else v for v in values]
     if ty.id == t.TypeId.TEXT:
         dictionary = dictionary if dictionary is not None else Dictionary()
-        data = dictionary.encode([str(v) for v in filled])
+        if all_valid:
+            data = dictionary.encode([str(v) for v in values])
+        else:
+            # NULL slots stay code 0 and never enter the dictionary —
+            # the general pipeline's convention (a '' entry minted into
+            # a TABLE's shared dict would shift code assignment and
+            # diverge union-branch dictionary merges downstream)
+            data = np.zeros(n, dtype=np.int32)
+            idx = np.nonzero(validity)[0]
+            if len(idx):
+                data[idx] = dictionary.encode(
+                    [str(values[i]) for i in idx]
+                )
     elif ty.id == t.TypeId.DECIMAL:
         f = ty.decimal_factor
         data = np.asarray([round(float(v) * f) for v in filled], dtype=np.int64)

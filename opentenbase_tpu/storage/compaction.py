@@ -1,0 +1,82 @@
+"""Background delta compaction — the vacuum half of the delta + base
+split (SURVEY §7 hard part #3: delta-batches + compaction ≙ heap +
+vacuum).
+
+Ingest appends park as write-optimized :class:`~.table.DeltaBatch`
+objects in front of each shard store's base arrays; any base read folds
+them lazily. This job folds them PROACTIVELY — one concatenate per
+column per store — so the first analytical scan after an ingest burst
+pays no fold latency, and long write-only bursts don't accumulate
+unbounded delta lists. Folding is position-preserving and in-memory
+only: the rows are already durable in their WAL 'G' frames, so a crash
+mid-compaction loses nothing — recovery replays the frames and the
+store reaches the same logical contents (the scan-parity contract
+tests/test_write_path.py asserts).
+
+Enabled per cluster via the ``delta_compaction_naptime_ms`` conf GUC
+(0 = lazy-only folding); ``Cluster.compact_deltas()`` is the one-shot
+verb the job and callers share.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from opentenbase_tpu.fault import FAULT
+
+
+def compact_cluster(cluster) -> int:
+    """Fold pending deltas on every shard store; returns batches folded.
+    THE one compaction verb — the background job, the vacuum statement's
+    implicit fold, and tests all sit on it."""
+    folded = 0
+    # failpoint: compaction start — an injected error models the job
+    # dying before any fold (nothing folded, deltas intact; the lazy
+    # read path still serves every row)
+    FAULT("storage/compaction_start")
+    for stores in list(cluster.stores.values()):
+        for name, store in list(stores.items()):
+            compact = getattr(store, "compact", None)
+            if compact is None:
+                continue  # planner stubs (bench external tables)
+            if store.pending_delta_rows:
+                folded += compact()
+    # failpoint: compaction end — the fold happened but the job dies
+    # before accounting; the stores are already consistent (each
+    # per-store fold is atomic under its delta lock)
+    FAULT("storage/compaction_end", folded=folded)
+    if folded:
+        with cluster._ingest_stats_mu:
+            cluster.ingest_stats["compactions"] += 1
+            cluster.ingest_stats["batches_folded"] += folded
+    return folded
+
+
+def start_compaction(cluster, interval_s: float = 0.5):
+    """Background compaction daemon; returns a stop() callable (the
+    autovacuum-launcher shape, src/backend/postmaster/autovacuum.c)."""
+    stop = threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval_s):
+            try:
+                compact_cluster(cluster)
+            except Exception as e:
+                # honest swallow: the daemon must survive an injected
+                # fold failure, but silently eating it would hide a
+                # broken compactor forever
+                log = getattr(cluster, "log", None)
+                if log is not None:
+                    log.emit(
+                        "warning", "compaction",
+                        f"delta compaction pass failed: {e!r:.120}",
+                    )
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+
+    def stopper() -> None:
+        stop.set()
+        t.join(timeout=5)
+
+    return stopper

@@ -50,7 +50,9 @@ from opentenbase_tpu.analysis.racewatch import shared_state
 from opentenbase_tpu.fault import FAULT, site_rng
 from opentenbase_tpu.net.protocol import (
     REPL_PROBE,
+    pack_repl_ack,
     pack_repl_hello,
+    recv_repl_ack,
     recv_repl_hello,
     shutdown_and_close,
 )
@@ -59,7 +61,17 @@ from opentenbase_tpu.storage.persist import WAL
 
 @shared_state("_peers_mu")
 class WalSender:
-    """Primary-side WAL streamer (walsender.c)."""
+    """Primary-side WAL streamer (walsender.c), pipelined: frames
+    stream ahead within a sliding window while the receiver's applied
+    acks flow back on the same socket (a dedicated per-connection ack
+    reader) — per-peer acked offsets are the in-memory evidence
+    synchronous_commit=remote_write consults, with no per-commit RPC."""
+
+    # sliding window: bytes in flight (sent - acked) before the stream
+    # pauses for acks. Only enforced once the peer's FIRST ack arrives
+    # (capability detection: a receiver that never acks — none in-tree —
+    # streams with the old unbounded behavior instead of wedging).
+    WINDOW_BYTES = 16 << 20
 
     def __init__(self, persistence, host: str = "127.0.0.1", port: int = 0,
                  poll_s: float = 0.05):
@@ -71,11 +83,15 @@ class WalSender:
         self._lsock.listen(8)
         self.host, self.port = self._lsock.getsockname()
         self._stop = threading.Event()
-        # per-connection sent offsets (pg_stat_replication's sent_lsn):
-        # conn id -> [peer_addr, sent_offset]; the exporter renders
-        # wal.position - sent as the replication-lag gauge per standby
+        # per-connection offsets (pg_stat_replication's sent_lsn +
+        # flush/apply_lsn): conn id -> [peer_addr, sent_offset,
+        # acked_offset] (acked = -1 until the peer's first ack frame);
+        # the exporter renders wal.position - sent as the replication-
+        # lag gauge and wal.position - acked as the ack-lag gauge
         self._peers: dict = {}
         self._peers_mu = threading.Lock()
+        # remote_write waiters park here; every ack wakes them
+        self._ack_cv = threading.Condition(self._peers_mu)
         # register with the persistence so the coordinator's exporter
         # can find every live sender without new plumbing
         getattr(persistence, "wal_senders", []).append(self)
@@ -93,8 +109,74 @@ class WalSender:
         """[(peer_addr, sent_offset)] of live standby connections."""
         with self._peers_mu:
             return [
-                (addr, int(sent)) for addr, sent in self._peers.values()
+                (ent[0], int(ent[1])) for ent in self._peers.values()
             ]
+
+    def peer_acks(self) -> list:
+        """[(peer_addr, acked_offset)] of live standby connections that
+        have acked at least once (pg_stat_replication's flush_lsn)."""
+        with self._peers_mu:
+            return [
+                (ent[0], int(ent[2]))
+                for ent in self._peers.values() if ent[2] >= 0
+            ]
+
+    def wait_quorum_acked(
+        self, lsn: int, quorum: int, deadline: float
+    ) -> bool:
+        """Park until >= ``quorum`` peers have acked receipt of ``lsn``
+        (woken per ack frame — the remote_write wait, RPC-free)."""
+        import time as _time
+
+        with self._ack_cv:
+            while True:
+                acks = sorted(
+                    (int(e[2]) for e in self._peers.values() if e[2] >= 0),
+                    reverse=True,
+                )
+                if len(acks) >= quorum and acks[quorum - 1] >= lsn:
+                    return True
+                left = deadline - _time.monotonic()
+                if left <= 0 or self._stop.is_set():
+                    return False
+                self._ack_cv.wait(timeout=min(left, 0.25))
+
+    def _ack_loop(self, conn: socket.socket) -> None:
+        """Per-connection ack reader: folds the receiver's applied-
+        offset frames into the peer table and wakes remote_write
+        waiters. On peer death it retires the entry ITSELF (and wakes
+        waiters) — a stale entry left for the stream thread to notice
+        on its next send error would inflate remote_write's quorum
+        denominator across a standby reconnect, wedging every commit
+        for the full wait timeout."""
+        try:
+            while not self._stop.is_set():
+                try:
+                    # failpoint: the ack-receive boundary — delay
+                    # models an ack-lagging standby (the stream
+                    # pipelines ahead up to the window); drop_conn
+                    # severs the standby whose acks a remote_write
+                    # quorum may be waiting on
+                    FAULT("repl/ack_recv")
+                    off = recv_repl_ack(conn)
+                except (OSError, ConnectionError) as e:
+                    if not self._stop.is_set() and not isinstance(
+                        e, ConnectionError
+                    ):
+                        self.persistence.cluster.log.emit(
+                            "warning", "replication",
+                            f"replication ack channel lost: {e!r:.120}",
+                        )
+                    return
+                with self._ack_cv:
+                    ent = self._peers.get(id(conn))
+                    if ent is not None and off > ent[2]:
+                        ent[2] = off
+                    self._ack_cv.notify_all()
+        finally:
+            with self._ack_cv:
+                self._peers.pop(id(conn), None)
+                self._ack_cv.notify_all()
 
     def _generation(self) -> int:
         """This timeline's fencing generation (bumped by every
@@ -166,10 +248,27 @@ class WalSender:
                 )
                 return
             with self._peers_mu:
-                self._peers[id(conn)] = [peer, int(offset)]
+                self._peers[id(conn)] = [peer, int(offset), -1]
+            # pipelined acks: the receiver reports applied offsets on
+            # the same socket; a dedicated reader folds them in so the
+            # stream below never blocks on anything but the window
+            threading.Thread(
+                target=self._ack_loop, args=(conn,), daemon=True
+            ).start()
             with open(path, "rb") as f:
                 f.seek(offset)
                 while not self._stop.is_set():
+                    # sliding window: once the peer acks at all, cap
+                    # bytes-in-flight so a stalled standby backpressures
+                    # the stream instead of ballooning socket buffers
+                    with self._ack_cv:
+                        ent = self._peers.get(id(conn))
+                        if (
+                            ent is not None and ent[2] >= 0
+                            and ent[1] - ent[2] > self.WINDOW_BYTES
+                        ):
+                            self._ack_cv.wait(timeout=0.25)
+                            continue
                     chunk = f.read(1 << 20)
                     if chunk:
                         # failpoint: wal_torn tears the outgoing chunk at
@@ -322,6 +421,7 @@ class StandbyCluster:
         _olog.set_thread_ring(self.cluster.log)
         p = self.cluster.persistence
         buf = b""
+        acked = -1
         while not self._stop.is_set():
             try:
                 # failpoint: walreceiver-side stall/death (delay models a
@@ -341,6 +441,17 @@ class StandbyCluster:
             p.wal._f.flush()
             buf += chunk
             buf = self._drain(buf)
+            if self.applied > acked:
+                # pipelined ack: report the applied offset back on the
+                # same socket — the sender's per-peer ack table is what
+                # synchronous_commit=remote_write quorum-checks. Best
+                # effort: a send failure means the stream is dying too,
+                # and the NEXT recv surfaces it on the ordinary path.
+                try:
+                    self._sock.sendall(pack_repl_ack(self.applied))
+                    acked = self.applied
+                except OSError:
+                    pass
 
     def _log_stream_end(self, msg: str) -> None:
         """A severed WAL stream is only log-worthy when it wasn't our
