@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import subprocess
 import sys
 import threading
@@ -622,6 +623,16 @@ def main():
     except Exception as e:
         _phase(f"write leg failed: {e!r:.200}", t_start)
 
+    # HTAP read-after-write leg (ISSUE-15): interleaved ingest + point
+    # updates + top-k scans, scannable delta plane vs the fold-on-read
+    # baseline on the same binary. Runs the fused path (device cache
+    # delta tails) but needs no real TPU.
+    try:
+        if os.environ.get("BENCH_HTAP", "1") == "1":
+            htap_leg(record, t_start)
+    except Exception as e:
+        _phase(f"htap leg failed: {e!r:.200}", t_start)
+
     # Device health check before the next device leg batch: a tunnel
     # that wedged since startup would hang the leg; skip the remaining
     # device legs with an explicit marker instead. IN-PROCESS (a tiny
@@ -1191,6 +1202,134 @@ def write_leg(record, t_start) -> None:
         f"{burst:.0f} burst tps ({record['write_burst_speedup']}x), "
         f"ingest {ingest:.0f} rows/s "
         f"({record['ingest_speedup']}x row-at-a-time)",
+        t_start,
+    )
+    print(json.dumps(record), flush=True)
+
+
+def htap_leg(record, t_start) -> None:
+    """HTAP read-after-write (ISSUE-15): interleaved ingest + point
+    UPDATEs + top-k scans on ONE growing table, the scannable delta
+    plane vs the fold-on-read baseline (``enable_delta_scan=off``
+    reproduces the legacy read path — host scans fold, the device
+    cache compacts before refresh and keeps the flat >8-entry MVCC
+    full-plane cutoff) on the SAME binary.
+
+    Per iteration: one multi-row INSERT (fresh rows park as delta
+    batches), a burst of point UPDATEs (commit stamps on both old and
+    delta-resident rows — more log entries than the legacy cutoff
+    tolerates), then a top-k scan that must see every write. The
+    baseline pays a host fold + a full MVCC-plane rebuild per scan;
+    the delta plane serves the same scan with a tail upload + one
+    coalesced scatter sized by rows touched.
+
+    - ``htap_rows_per_sec``: rows written (ingest + update) per second
+      of the mixed loop, scans included in the wall clock;
+    - ``htap_fold_avoided``: fold-on-read events the optimized run
+      avoided (pg_stat_fused counter — proof the fold is GONE);
+    - ``htap_speedup``: optimized / baseline mixed throughput."""
+    secs = float(os.environ.get("BENCH_HTAP_SECS", 4))
+    preload = int(os.environ.get("BENCH_HTAP_PRELOAD", 100_000))
+    ins_rows = int(os.environ.get("BENCH_HTAP_INS_ROWS", 500))
+    upd_stmts = int(os.environ.get("BENCH_HTAP_UPDATES", 8))
+
+    def run_side(delta_scan: bool):
+        # no data_dir: WAL/fsync cost is identical on both sides and
+        # not what this leg measures — the read-after-write refresh is
+        c = Cluster(num_datanodes=NUM_DN, shard_groups=64)
+        if not delta_scan:
+            c.conf_gucs["enable_delta_scan"] = False
+        s = c.session()
+        s.execute(
+            "create table ht (k bigint, g bigint, v bigint) "
+            "distribute by shard(k)"
+        )
+        done = 0
+        while done < preload:
+            n = min(8000, preload - done)
+            s.execute("insert into ht values " + ",".join(
+                f"({done + i}, {(done + i) % 64}, {(done + i) % 9973})"
+                for i in range(n)
+            ))
+            done += n
+        c.compact_deltas()
+        # top-k leaderboard over live groups: the fresh rows written
+        # the iteration BEFORE this scan must already count
+        topk = (
+            "select g, count(*), sum(v) from ht "
+            "group by g order by 3 desc, g limit 5"
+        )
+        warm = s.query(topk)  # compile the fused program once
+        assert len(warm) == 5
+        fu0 = dict(s.query("select event, detail from pg_stat_fused"))
+        abs0 = dict(
+            s.query("select stat, value from pg_stat_wal")
+        )["deltas_absorbed"]
+        rng = random.Random(11)
+        stop_at = time.monotonic() + secs
+        written = 0
+        scans = 0
+        k_next = preload
+        t0 = time.perf_counter()
+        while time.monotonic() < stop_at:
+            s.execute("insert into ht values " + ",".join(
+                f"({k_next + i}, {(k_next + i) % 64}, "
+                f"{(k_next + i) % 9973})"
+                for i in range(ins_rows)
+            ))
+            k_next += ins_rows
+            written += ins_rows
+            for _ in range(upd_stmts):
+                lo = rng.randrange(0, k_next - 10)
+                s.execute(
+                    f"update ht set v = v + 1 "
+                    f"where k >= {lo} and k < {lo + 10}"
+                )
+                written += 10
+            rows = s.query(topk)
+            assert len(rows) == 5
+            scans += 1
+        elapsed = time.perf_counter() - t0
+        fu1 = dict(s.query("select event, detail from pg_stat_fused"))
+        wal = dict(s.query("select stat, value from pg_stat_wal"))
+        stats = {
+            "rows_per_sec": written / elapsed,
+            "scans": scans,
+            "fold_avoided": (
+                int(fu1.get("fold_on_read_avoided", 0))
+                - int(fu0.get("fold_on_read_avoided", 0))
+            ),
+            "deltas_absorbed": int(wal["deltas_absorbed"]) - abs0,
+            "pending_delta_rows": int(wal.get("pending_delta_rows", 0)),
+        }
+        c.close()
+        return stats
+
+    base = run_side(False)
+    _phase(
+        f"htap baseline (fold-on-read): "
+        f"{base['rows_per_sec']:.0f} rows/s, "
+        f"{base['scans']} scans, "
+        f"{base['deltas_absorbed']} folds",
+        t_start,
+    )
+    opt = run_side(True)
+    record["htap_rows_per_sec"] = round(opt["rows_per_sec"], 1)
+    record["htap_baseline_rows_per_sec"] = round(
+        base["rows_per_sec"], 1
+    )
+    record["htap_speedup"] = round(
+        opt["rows_per_sec"] / max(base["rows_per_sec"], 1e-9), 2
+    )
+    record["htap_scans"] = opt["scans"]
+    record["htap_fold_avoided"] = opt["fold_avoided"]
+    record["htap_deltas_absorbed"] = opt["deltas_absorbed"]
+    record["htap_platform"] = _leg_platform()
+    _phase(
+        f"htap leg: {opt['rows_per_sec']:.0f} rows/s "
+        f"({record['htap_speedup']}x fold-on-read), "
+        f"{opt['scans']} scans, {opt['fold_avoided']} folds avoided, "
+        f"{opt['deltas_absorbed']} absorbed",
         t_start,
     )
     print(json.dumps(record), flush=True)

@@ -240,10 +240,18 @@ GUCS: dict = {
     # Off = the seed row-at-a-time path (differential baseline).
     "enable_bulk_insert_rewrite": (_bool, True),
     # background delta compaction (storage/compaction.py): fold pending
-    # ingest delta batches into base arrays every this-many ms so the
-    # first scan after a burst pays no fold latency. 0 = lazy-only
-    # (reads and VACUUM still fold).
+    # ingest delta batches into base arrays every this-many ms. Scans
+    # never fold (see enable_delta_scan) — 0 leaves folding to VACUUM,
+    # the MAX_DELTAS write-side backpressure, and explicit compaction.
     "delta_compaction_naptime_ms": (_duration, 0),
+    # scannable delta plane (ISSUE-15): scans iterate base + pending
+    # delta batches without absorbing, on both executors — reads never
+    # mutate storage, compaction is a background amortizer. Off
+    # restores the legacy fold-on-read read path (host scans fold
+    # first; the device cache compacts before refresh and keeps the
+    # flat >8-entry MVCC full-plane cutoff) — the HTAP bench baseline
+    # on the same binary, and an operator escape hatch.
+    "enable_delta_scan": (_bool, True),
     "autovacuum": (_bool, False),
     "autovacuum_naptime_s": (_int, 60),
     "autovacuum_scale_factor_pct": (_int, 20),

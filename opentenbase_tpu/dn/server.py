@@ -1031,6 +1031,7 @@ class DNServer:
                         cancel_check=(
                             cancel_check if token is not None else None
                         ),
+                        fold_on_read=not msg.get("delta_scan", True),
                     )
                     if out is not None:
                         self._bump("parallel_fragments")
@@ -1044,6 +1045,7 @@ class DNServer:
                         cancel_check=(
                             cancel_check if token is not None else None
                         ),
+                        fold_on_read=not msg.get("delta_scan", True),
                     )
                     out = ex.run_plan(plan)
             mo = msg.get("motion")

@@ -1138,12 +1138,9 @@ class Cluster:
                 store = self.stores.get(node, {}).get(target)
                 if store is None or store.nrows == 0:
                     continue
-                live = (store.xmin_ts[: store.nrows] <= snapshot) & (
-                    snapshot < store.xmax_ts[: store.nrows]
-                )
-                idx = np.nonzero(live)[0]
+                idx = store.live_index(snapshot)
                 if len(idx):
-                    batches.append(store.to_batch().take(idx))
+                    batches.append(store.take_batch(idx))
             meta.dist = dist
             meta.locator = Locator(
                 dist,
@@ -1296,7 +1293,7 @@ class Cluster:
                 # pending (uncommitted) inserts must not look dead or a
                 # bulk load would trigger vacuum storms
                 dead += int(
-                    (store.xmax_ts[: store.nrows] <= snap).sum()
+                    (store.peek_xmax() <= snap).sum()
                 )
             return dead / total if total else 0.0
 
@@ -1981,7 +1978,8 @@ class Session:
 
         store = self.cluster.stores[node][table]
         keys = [
-            (node, table, int(rid)) for rid in store.row_id[np.asarray(idx)]
+            (node, table, int(rid))
+            for rid in store.peek_row_id_at(np.asarray(idx))
         ]
         # pin BEFORE parking: the pin is the vacuum fence, and the wait
         # window (engine lock dropped) is exactly when a concurrent VACUUM
@@ -2001,7 +1999,7 @@ class Session:
         # recheck for a committed concurrent update — the wait may have
         # ended precisely because a conflicting writer committed; PG
         # raises for FOR SHARE as well (heap_lock_tuple/HeapTupleUpdated)
-        if (store.xmax_ts[np.asarray(idx)] != INF_TS).any():
+        if (store.peek_xmax_at(idx) != INF_TS).any():
             raise SQLError(
                 "could not serialize access due to concurrent update",
                 "40001",
@@ -2021,7 +2019,7 @@ class Session:
                     continue
                 store = self.cluster.stores[node][table]
                 idx = np.asarray(tw.del_idx, dtype=np.int64)
-                if (store.xmax_ts[idx] != INF_TS).any():
+                if (store.peek_xmax_at(idx) != INF_TS).any():
                     self._abort_txn(txn)
                     raise SQLError(
                         "could not serialize access due to concurrent "
@@ -2406,7 +2404,7 @@ class Session:
                     # (RESERVED_TS) or a half-applied failed commit. Rows
                     # another txn deleted meanwhile must stay deleted.
                     idx = np.asarray(tw.del_idx, dtype=np.int64)
-                    cur = store.xmax_ts[idx]
+                    cur = store.peek_xmax_at(idx)
                     mask = cur == RESERVED_TS
                     if failed_commit_ts is not None:
                         mask |= cur == failed_commit_ts
@@ -4242,14 +4240,10 @@ class Session:
                     store = self.cluster.stores.get(node, {}).get(tb)
                     if store is None or store.nrows == 0:
                         continue
-                    n = store.nrows
-                    live = (store.xmin_ts[:n] <= snap) & (
-                        snap < store.xmax_ts[:n]
-                    )
-                    idx = np.nonzero(live)[0]
+                    idx = store.live_index(snap)
                     if not len(idx):
                         continue
-                    data = store.to_batch().take(idx).to_pydict()
+                    data = store.take_batch(idx).to_pydict()
                     for r in range(len(idx)):
                         out.append(
                             (tb, _json.dumps(
@@ -4783,6 +4777,12 @@ class Session:
             self._auto_explain_last = (dplan, info)
         return batch
 
+    def _delta_scan(self) -> bool:
+        """enable_delta_scan GUC: scans iterate base + pending deltas
+        without absorbing (on = default); off restores the legacy
+        fold-on-read path — the HTAP bench baseline."""
+        return self.gucs.get("enable_delta_scan", True) is not False
+
     def _execute_dplan(
         self, dplan, snapshot, instrument: bool = False
     ) -> tuple[ColumnBatch, dict]:
@@ -4841,6 +4841,7 @@ class Session:
                     "fragment_retry_backoff_ms",
                 ),
                 node_generation=self.cluster.node_generation,
+                delta_scan=self._delta_scan(),
             )
             try:
                 from opentenbase_tpu.net.pool import ChannelFenced
@@ -4893,6 +4894,12 @@ class Session:
         # single-fragment path stamps below) — session-local, so
         # concurrent sessions' runs can't be misattributed
         self._fused_via_dag = False
+        # delta-plane attribution: how many delta-resident rows THIS
+        # statement's cache refresh tail-uploaded (EXPLAIN ANALYZE
+        # shows it alongside the phase split). The before-counter is
+        # captured by _try_fused_inner UNDER the fused gate, so a
+        # concurrent session's refresh can't be misattributed.
+        self._fused_tail0 = None
         with compile_window() as cw:
             out = self._try_fused_inner(dplan, snapshot)
         if out is None:
@@ -4926,6 +4933,14 @@ class Session:
                     phases["join_modes"] = ",".join(
                         dag.last_join_modes
                     )
+                # added AFTER the phase_totals accumulation above:
+                # attribution metadata, not a timing phase
+                tail0 = self._fused_tail0
+                tail1 = int(
+                    fx.cache.stats.get("delta_tail_rows", 0)
+                )
+                if tail0 is not None and tail1 > tail0:
+                    phases["delta_tail_rows"] = tail1 - tail0
                 # device-platform watchdog: the DAG runner stamped its
                 # own run; the single-fragment path stamps here — one
                 # note per successful fused statement either way
@@ -4991,6 +5006,10 @@ class Session:
         fx.expected_platform = (
             exp_plat or fx.env_expected_platform
         )
+        # scannable delta plane: off = the device cache compacts before
+        # refresh + legacy MVCC replay cutoff (the fold-on-read
+        # baseline the HTAP bench differentials against)
+        fx.cache.legacy_fold = not self._delta_scan()
 
         # pallas single-pass kernel: default-on on real TPU backends,
         # opt-in elsewhere (interpret mode is for tests, not speed)
@@ -5009,6 +5028,12 @@ class Session:
         )
         try:
             with fused_gate:
+                # before-counter for the EXPLAIN delta-tail attribution
+                # — under the gate, so only THIS statement's refresh
+                # lands in the delta
+                self._fused_tail0 = int(
+                    fx.cache.stats.get("delta_tail_rows", 0)
+                )
                 if has_topk:
                     res = fx.dag_output(
                         dplan, snapshot, self._dicts_view(), []
@@ -5387,7 +5412,7 @@ class Session:
             if store is None or store.nrows == 0:
                 continue
             n0 = store.nrows
-            live = store.xmax_ts[:n0] == INF_TS
+            live = store.peek_xmax(n0) == INF_TS
             tw = txn.writes.get(node, {}).get(meta.name)
             if tw is not None and tw.del_idx:
                 live[np.asarray(tw.del_idx, dtype=np.int64)] = False
@@ -5399,7 +5424,7 @@ class Session:
                 pos_live = np.nonzero(live)[0]
                 sel = np.isin(keycol[pos_live], vals[hit])
                 idx = pos_live[sel]
-                old = store.to_batch().take(idx)
+                old = store.take_batch(idx)
                 okeys = np.asarray(old.columns[pk].data)
                 prop_pos = {k: i for i, k in enumerate(vals.tolist())}
                 align = np.asarray(
@@ -5599,7 +5624,7 @@ class Session:
         if store is None or store.nrows == 0:
             return
         n = store.nrows
-        live = store.xmax_ts[:n] == INF_TS  # incl. our pending inserts
+        live = store.peek_xmax(n) == INF_TS  # incl. our pending inserts
         # rows this txn already marked for deletion don't conflict
         tw = txn.writes.get(node, {}).get(meta.name)
         if tw is not None and tw.del_idx:
@@ -5656,6 +5681,7 @@ class Session:
                     txn.snapshot_ts,
                     subquery_values=subq,
                     own_writes=txn.own_writes_view().get(node),
+                    fold_on_read=not self._delta_scan(),
                 )
                 idx = ex.predicate_rows(dplan.table, dplan.predicate)
                 if len(idx):
@@ -5667,7 +5693,7 @@ class Session:
                     ):
                         # old values, captured before the delete marks
                         # (one replica's copy is the truth)
-                        old_batches.append(store.to_batch().take(idx))
+                        old_batches.append(store.take_batch(idx))
                     txn.pin(store)
                     txn.w(node, dplan.table).del_idx.extend(idx.tolist())
                     total += len(idx)
@@ -5768,6 +5794,7 @@ class Session:
                     txn.snapshot_ts,
                     subquery_values=subq,
                     own_writes=txn.own_writes_view().get(node),
+                    fold_on_read=not self._delta_scan(),
                 )
                 idx = ex.predicate_rows(uplan.table, uplan.predicate)
                 if not len(idx):
@@ -5775,7 +5802,7 @@ class Session:
                 self._acquire_row_locks(
                     txn, uplan.table, node, idx, ROW_UPDATE
                 )
-                old = store.to_batch().take(idx)
+                old = store.take_batch(idx)
                 new_batches.append(self._apply_assignments(meta, old, assigned, subq))
                 txn.pin(store)
                 txn.w(node, uplan.table).del_idx.extend(idx.tolist())
@@ -6017,11 +6044,11 @@ class Session:
         try:
             for node in meta.node_indices:
                 store = self.cluster.stores[node][stmt.table]
-                n0 = store.nrows
+                view = store.scan_view(fold=not self._delta_scan())
+                store.note_delta_read(view.delta_rows())
+                n0 = view.nrows
                 snap = np.int64(txn.snapshot_ts)
-                live = (store.xmin_ts[:n0] <= snap) & (
-                    snap < store.xmax_ts[:n0]
-                )
+                live = (view.xmin() <= snap) & (snap < view.xmax())
                 ow = txn.own_writes_view().get(node, {}).get(
                     stmt.table
                 )
@@ -6033,7 +6060,7 @@ class Session:
                 pos = np.nonzero(live)[0]
                 if not len(pos):
                     continue
-                tb = store.to_batch().take(pos)
+                tb = store.take_batch(pos)
                 tb_cols = dict(tb.columns)
                 tb_cols["__pos"] = Column(
                     t.INT8, pos.astype(np.int64)
@@ -6061,7 +6088,7 @@ class Session:
                 txn.w(node, stmt.table).del_idx.extend(opos.tolist())
                 total += len(opos)
                 if update:
-                    old = store.to_batch().take(opos)
+                    old = store.take_batch(opos)
                     newc = dict(old.columns)
                     outcols = list(out.columns.values())
                     for i, col in enumerate(set_info):
@@ -6081,7 +6108,7 @@ class Session:
                 elif ret is not None and (
                     not meta.dist.is_replicated or not ret_old
                 ):
-                    ret_old.append(store.to_batch().take(opos))
+                    ret_old.append(store.take_batch(opos))
             for nb in new_batches:
                 self._route_and_append(meta, nb, txn)
         except Exception:
@@ -7267,14 +7294,15 @@ class Session:
                 }
                 h = meta.locator.key_hash(key_cols)
                 sid = sm.shard_ids(h)
-                live = (src.xmin_ts[: src.nrows] <= snapshot) & (
-                    snapshot < src.xmax_ts[: src.nrows]
+                sv = src.scan_view()
+                live = (sv.xmin() <= snapshot) & (
+                    snapshot < sv.xmax()
                 )
                 mask = np.isin(sid, list(moved_set)) & live
                 idx = np.nonzero(mask)[0]
                 if not len(idx):
                     continue
-                batch = src.to_batch().take(idx)
+                batch = src.take_batch(idx)
                 dst = self.cluster.stores.setdefault(
                     to_node, {}
                 ).setdefault(
@@ -7286,7 +7314,7 @@ class Session:
                 # rows between the live mask and here; capture those
                 # stamps BEFORE ours overwrites them so the dst copies
                 # don't resurrect deleted rows
-                pre_xmax = src.xmax_ts[idx].copy()
+                pre_xmax = src.peek_xmax_at(idx)
                 ds, de = dst.append_batch(batch, commit_ts)
                 src.stamp_xmax(idx, commit_ts)
                 for pos in np.nonzero(pre_xmax < INF_TS)[0]:
@@ -7331,7 +7359,7 @@ class Session:
                     # the row doesn't resurrect post-flip (durable via
                     # the checkpoint below)
                     for meta, src, dst, idx, ds, cts in copied:
-                        cur = src.xmax_ts[idx]
+                        cur = src.peek_xmax_at(idx)
                         for pos in np.nonzero(cur != cts)[0]:
                             dst.stamp_xmax(
                                 np.array([ds + int(pos)]),
@@ -7356,17 +7384,21 @@ class Session:
                         }
                         h = meta.locator.key_hash(key_cols)
                         sid = sm.shard_ids(h)
-                        nr = src.nrows
+                        # data plane quiesced under the exclusive lock:
+                        # sid (from the column capture above) and this
+                        # view cover the same rows
+                        sv2 = src.scan_view(nrows=len(sid))
+                        xm2, xx2 = sv2.xmin(), sv2.xmax()
                         late = (
-                            (src.xmin_ts[:nr] > snapshot)
-                            & (src.xmin_ts[:nr] <= snap2)
-                            & (src.xmax_ts[:nr] > snap2)
+                            (xm2 > snapshot)
+                            & (xm2 <= snap2)
+                            & (xx2 > snap2)
                             & np.isin(sid, list(moved_set))
                         )
                         idx = np.nonzero(late)[0]
                         if not len(idx):
                             continue
-                        batch = src.to_batch().take(idx)
+                        batch = src.take_batch(idx)
                         dst = self.cluster.stores.setdefault(
                             to_node, {}
                         ).setdefault(
@@ -7610,6 +7642,16 @@ class Session:
                     lines.append(
                         f"Fused join modes: {ph['join_modes']}"
                     )
+                if ph.get("delta_tail_rows"):
+                    # the scannable delta plane at work: the cache
+                    # refresh uploaded this statement's fresh rows as
+                    # an append tail straight from delta batches — no
+                    # fold, no full re-upload
+                    lines.append(
+                        "Fused delta plane: "
+                        f"{ph['delta_tail_rows']} delta-resident rows "
+                        "tail-uploaded"
+                    )
                 frag_ms = ph.get("frag_ms")
                 if stmt.verbose and frag_ms:
                     for k in sorted(frag_ms, key=str):
@@ -7782,16 +7824,14 @@ class Session:
                 store = self.cluster.stores[n].get(name)
                 if store is None:
                     continue
-                live = (
-                    (store.xmin_ts[: store.nrows] <= snap)
-                    & (snap < store.xmax_ts[: store.nrows])
-                )
+                sv = store.scan_view()
+                live = (sv.xmin() <= snap) & (snap < sv.xmax())
                 idx = _np.nonzero(live)[0]
                 rows += len(idx)
                 if len(idx) > SAMPLE:
                     idx = idx[:: max(len(idx) // SAMPLE, 1)][:SAMPLE]
                 for c in meta.schema:
-                    samples[c].append(store._cols[c][: store.nrows][idx])
+                    samples[c].append(sv.col(c)[idx])
             ndv: dict[str, int] = {}
             sampled = 0
             for c, parts in samples.items():
@@ -8097,12 +8137,7 @@ def _sv_stat_tables(c: Cluster):
             store = c.stores.get(n, {}).get(name)
             if store is None:
                 continue
-            live = int(
-                (
-                    (store.xmin_ts[: store.nrows] <= snap)
-                    & (snap < store.xmax_ts[: store.nrows])
-                ).sum()
-            )
+            live = len(store.live_index(snap))
             rows.append((name, n, live, store.nrows))
     return rows
 
@@ -8170,10 +8205,25 @@ def _sv_fused(c: Cluster):
     final-fragment mode, every host-path fallback reason (unsupported
     plan shapes), and every unexpected-exception demotion. The r2 judge
     called the silent blanket-except out; this view is the fix."""
+    rows = []
+    # scannable-delta-plane counters (ISSUE-15): host scans that served
+    # pending delta rows without a fold, and device refreshes whose
+    # appended tail uploaded straight from delta batches — reported
+    # even on host-only clusters (the host half needs no device)
+    folds_avoided, delta_rows_read, _abs = _delta_plane_totals(c)
+    rows.append(("fold_on_read_avoided", str(folds_avoided)))
+    rows.append(("delta_rows_read", str(delta_rows_read)))
     fx = c._fused
     if fx is None:
-        return []
-    rows = []
+        return rows
+    rows.append(
+        ("delta_tail_uploads",
+         str(int(fx.cache.stats.get("delta_tail_uploads", 0))))
+    )
+    rows.append(
+        ("delta_tail_rows",
+         str(int(fx.cache.stats.get("delta_tail_rows", 0))))
+    )
     dag = fx._dag
     if dag is not None:
         rows.append(("completed", str(dag.completed)))
@@ -8226,12 +8276,7 @@ def _sv_partitions(c: Cluster):
                 store = c.stores.get(n, {}).get(child)
                 if store is None:
                     continue
-                live += int(
-                    (
-                        (store.xmin_ts[: store.nrows] <= snap)
-                        & (snap < store.xmax_ts[: store.nrows])
-                    ).sum()
-                )
+                live += len(store.live_index(snap))
             rows.append(
                 (name, child, i, int(ps.boundaries[i]),
                  int(ps.boundaries[i + 1]), live)
@@ -8247,14 +8292,9 @@ def _sv_memory(c: Cluster):
         for name, store in tabs.items():
             if name in _SYSTEM_VIEWS:
                 continue
-            col_bytes = sum(a.nbytes for a in store._cols.values())
-            vm_bytes = sum(
-                v.nbytes for v in store._validity.values() if v is not None
-            )
-            mvcc_bytes = (
-                store.xmin_ts.nbytes + store.xmax_ts.nbytes
-                + store.row_id.nbytes
-            )
+            # non-folding accounting: base arrays + pending delta
+            # segments (a memory view must never compact the store)
+            col_bytes, vm_bytes, mvcc_bytes = store.memory_stats()
             # dictionaries are SHARED across a table's node stores (and a
             # partitioned table's children): attribute each object once
             dict_bytes = 0
@@ -8534,6 +8574,7 @@ def _sv_stat_wal(c: Cluster):
         rows.append((f"gts_batch_le_{b}", int(gb["batch_hist"][b])))
     with c._ingest_stats_mu:
         st = dict(c.ingest_stats)
+    folds_avoided, delta_rows_read, absorbed = _delta_plane_totals(c)
     rows += [
         ("ingest_batches", int(st["batches"])),
         ("ingest_rows", int(st["rows"])),
@@ -8541,6 +8582,9 @@ def _sv_stat_wal(c: Cluster):
         ("insert_rewrite_rows", int(st["rewrite_rows"])),
         ("compactions", int(st["compactions"])),
         ("delta_batches_folded", int(st["batches_folded"])),
+        # lifetime per-store folds: the read-after-write smoke asserts
+        # this does NOT move across an ingest burst -> immediate scan
+        ("deltas_absorbed", absorbed),
         ("pending_delta_rows", sum(
             int(store.pending_delta_rows)
             for stores in c.stores.values() for store in stores.values()
@@ -8548,6 +8592,19 @@ def _sv_stat_wal(c: Cluster):
         )),
     ]
     return rows
+
+
+def _delta_plane_totals(c: Cluster) -> tuple[int, int, int]:
+    """(fold_on_read_avoided, delta_rows_read, deltas_absorbed) summed
+    over every shard store — the scannable-delta-plane evidence shared
+    by pg_stat_wal, pg_stat_fused, and the exporter."""
+    folds_avoided = rows_read = absorbed = 0
+    for stores in c.stores.values():
+        for store in stores.values():
+            folds_avoided += int(getattr(store, "fold_reads_avoided", 0))
+            rows_read += int(getattr(store, "delta_rows_read", 0))
+            absorbed += int(getattr(store, "deltas_absorbed", 0))
+    return folds_avoided, rows_read, absorbed
 
 
 def _sv_concentrator(c: Cluster):

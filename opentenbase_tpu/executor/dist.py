@@ -193,6 +193,7 @@ class DistExecutor:
         fragment_retries: int = 2,  # extra remote attempts per fragment
         retry_backoff_ms: float = 25.0,  # base backoff (doubles per try)
         node_generation: int = 0,  # fencing epoch carried on wire ops
+        delta_scan: bool = True,  # enable_delta_scan GUC (off = fold-on-read)
     ):
         self.catalog = catalog
         self.node_stores = node_stores
@@ -249,6 +250,10 @@ class DistExecutor:
         # failover ladder below — failing over to our own stores would
         # serve exactly the stale read the fence forbids
         self.node_generation = int(node_generation or 0)
+        # scannable delta plane (storage/table.ScanView): scans iterate
+        # base + pending deltas without absorbing; off restores the
+        # legacy fold-on-read path (the HTAP bench baseline)
+        self.delta_scan = bool(delta_scan)
         self.retry_stats = {"retries": 0, "failovers": 0, "cancels": 0}
         # monotonic per-attempt suffix for cancel tokens (see
         # _exec_remote): itertools.count is atomic under the GIL, so
@@ -315,8 +320,14 @@ class DistExecutor:
                 self.wlm_ticket.note_bytes(
                     sum(c.data.nbytes for c in out.columns.values())
                 )
-            except Exception:
-                pass  # stats only — never fail a finished query
+            except Exception as e:
+                # stats only — never fail a finished query, but never
+                # eat the accounting failure silently either
+                if self.log is not None:
+                    self.log.emit(
+                        "log", "executor",
+                        f"wlm result-bytes accounting failed: {e!r:.120}",
+                    )
         return out
 
     def _run_one(
@@ -557,6 +568,15 @@ class DistExecutor:
                             failover="local" if failover else None,
                         )
                 except Exception as e:
+                    # first error re-raises after the join below; the
+                    # REST would vanish — log each so a multi-node
+                    # failure isn't reconstructed from one symptom
+                    if self.log is not None:
+                        self.log.emit(
+                            "log", "executor",
+                            f"remote fragment {frag.index} @ dn{node} "
+                            f"failed: {e!r:.120}",
+                        )
                     errors.append(e)
 
             import threading as _threading
@@ -603,6 +623,14 @@ class DistExecutor:
                             "fragment", t0, t1, rows=outs[node].nrows,
                         )
                 except Exception as e:
+                    # same contract as run_remote: only the first error
+                    # surfaces — elog the rest
+                    if self.log is not None:
+                        self.log.emit(
+                            "log", "executor",
+                            f"local fragment {frag.index} @ dn{node} "
+                            f"failed: {e!r:.120}",
+                        )
                     errors.append(e)
 
             # local fragments execute concurrently across datanodes too
@@ -722,6 +750,7 @@ class DistExecutor:
             subquery_values=subquery_values,
             own_writes=self.own_writes.get(node),
             instrument=self.instrument_ops,
+            fold_on_read=not self.delta_scan,
         )
         batch = ex.run_plan(frag.root)
         return batch.nrows, batch, ex
@@ -785,6 +814,12 @@ class DistExecutor:
             "min_lsn": self.min_lsn,
             "hgen": self.node_generation,
         }
+        if not self.delta_scan:
+            # enable_delta_scan=off must restore fold-on-read on the
+            # DN processes too, or the escape hatch / HTAP baseline
+            # silently stops at the coordinator (absent on the wire =
+            # on, so old servers keep their default)
+            msg["delta_scan"] = False
         if self.parallel_workers > 1:
             msg["parallel"] = self.parallel_workers
         if exchanges:
@@ -861,8 +896,15 @@ class DistExecutor:
                                 timeout_s=2.0,
                             )
                             self.retry_stats["cancels"] += 1
-                        except Exception:
-                            pass  # the DN may be gone entirely
+                        except Exception as ce:
+                            # the DN may be gone entirely — the cancel
+                            # is best-effort, but say so
+                            if self.log is not None:
+                                self.log.emit(
+                                    "log", "executor",
+                                    f"cancel_fragment to dn{node} "
+                                    f"failed: {ce!r:.120}",
+                                )
                     raise
         finally:
             if wait_token is not None:

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from opentenbase_tpu.fault import FAULT
 from opentenbase_tpu.ops import agg as agg_ops
 from opentenbase_tpu.ops import filter as filt_ops
 from opentenbase_tpu.ops.expr import ExprCompiler, resolve_param
@@ -110,7 +111,17 @@ class DeviceCache:
             "delta_uploads": 0,
             "delta_rows": 0,
             "mvcc_replays": 0,
+            # scannable delta plane: refreshes whose appended tail was
+            # served straight from pending DeltaBatch segments (no
+            # fold), and the delta-resident rows those tails carried
+            "delta_tail_uploads": 0,
+            "delta_tail_rows": 0,
         }
+        # enable_delta_scan = off (HTAP bench baseline): refreshes fold
+        # stores before reading and keep the legacy per-entry MVCC
+        # replay with its flat >8 full-plane cutoff — the pre-delta-
+        # plane behavior on the same binary
+        self.legacy_fold = False
 
     def get(
         self, name: str, meta, node_stores: dict[int, dict], nodes=None,
@@ -148,14 +159,20 @@ class DeviceCache:
                 return updated
         self.stats["full_uploads"] += 1
         S = _pad_shards(len(stores), self.mesh.shape["dn"])
-        # ONE capture per store of nrows AND mvcc_seq/structure, taken
-        # BEFORE any plane/column read (concurrent appends advance nrows
-        # after writing rows; every plane must slice the same prefix,
-        # and the sync record must not claim stamps newer than what was
-        # read — an early seq only causes harmless idempotent re-replay)
-        totals = [s.nrows for s in stores]
-        seqs = [s.mvcc_seq for s in stores]
-        structs = [s.structure_version for s in stores]
+        # ONE coherent capture per store (ScanView): nrows, planes,
+        # mvcc_seq and structure are one moment — concurrent appends
+        # advance nrows after writing rows, so every plane slices the
+        # same prefix, and the sync record can't claim stamps newer
+        # than what was read. Reads never fold: delta-resident rows
+        # assemble from their batches (the scannable delta plane).
+        views = self._store_views(stores)
+        for s, v in zip(stores, views):
+            s.note_delta_read(v.delta_rows())  # whole-store upload
+        totals = [v.nrows for v in views]
+        seqs = [v.mvcc_seq for v in views]
+        structs = [v.structure_version for v in views]
+        xmins = [v.xmin() for v in views]
+        xmaxs = [v.xmax() for v in views]
         rmax = filt_ops.bucket_size(max(max(totals, default=0), 1))
         sharding = NamedSharding(self.mesh, P("dn"))
         # COMPACT visibility: after a bulk load every row of a shard
@@ -166,11 +183,9 @@ class DeviceCache:
         # reference pays this with per-tuple xmin/xmax in the heap
         # header, src/include/access/htup_details.h.)
         uniform = True
-        for s, nr in zip(stores, totals):
+        for xm, xx, nr in zip(xmins, xmaxs, totals):
             if nr == 0:
                 continue
-            xm = s.xmin_ts[:nr]
-            xx = s.xmax_ts[:nr]
             if xm[0] != xm[-1] or xx[0] != xx[-1] or not (
                 np.all(xm == xm[0]) and np.all(xx == xx[0])
             ):
@@ -180,19 +195,19 @@ class DeviceCache:
             xmin = np.full((S, 1), 2**62, dtype=np.int64)
             xmax = np.zeros((S, 1), dtype=np.int64)
             nrows = np.zeros(S, dtype=np.int64)
-            for i, s in enumerate(stores):
+            for i in range(len(stores)):
                 if totals[i]:
-                    xmin[i, 0] = s.xmin_ts[0]
-                    xmax[i, 0] = s.xmax_ts[0]
+                    xmin[i, 0] = xmins[i][0]
+                    xmax[i, 0] = xmaxs[i][0]
                 nrows[i] = totals[i]
         else:
             xmin = np.full((S, rmax), 2**62, dtype=np.int64)
             xmax = np.zeros((S, rmax), dtype=np.int64)
             nrows = np.zeros(S, dtype=np.int64)
-            for i, s in enumerate(stores):
+            for i in range(len(stores)):
                 nr = totals[i]
-                xmin[i, :nr] = s.xmin_ts[:nr]
-                xmax[i, :nr] = s.xmax_ts[:nr]
+                xmin[i, :nr] = xmins[i]
+                xmax[i, :nr] = xmaxs[i]
                 nrows[i] = nr
         dt = DeviceTable(
             {},
@@ -214,9 +229,22 @@ class DeviceCache:
                 for i in range(len(stores))
             ],
         )
-        self._ensure_columns(dt, stores, meta, want, totals)
+        self._ensure_columns(dt, stores, meta, want, totals, views)
         self._tables[(name, nodes)] = dt
         return dt
+
+    def _store_views(self, stores):
+        """One coherent non-folding ScanView per store. Under
+        ``legacy_fold`` (enable_delta_scan = off) pending deltas are
+        compacted FIRST — reproducing the fold-on-read read path the
+        HTAP bench baselines against, on the same binary."""
+        if self.legacy_fold:
+            for s in stores:
+                if getattr(s, "pending_delta_rows", 0):
+                    s.compact()
+        # fold-avoided accounting happens at the USE sites (tail
+        # upload / full upload / window) with the rows actually read
+        return [s.scan_view() for s in stores]
 
     def register_external(
         self, name: str, meta, nodes, columns: dict, nrows,
@@ -341,18 +369,22 @@ class DeviceCache:
         xmin = np.full((S, W), 2**62, dtype=np.int64)
         xmax = np.zeros((S, W), dtype=np.int64)
         nrows = np.zeros(S, dtype=np.int64)
-        # ONE capture per store of nrows AND mvcc_seq/structure, BEFORE
-        # any plane/column read: appends may run concurrently and every
-        # column must slice the same consistent prefix; the sync record
-        # must not claim stamps newer than the planes just read
-        totals = [s.nrows for s in stores]
-        seqs = [s.mvcc_seq for s in stores]
-        structs = [s.structure_version for s in stores]
-        for i, s in enumerate(stores):
+        # ONE coherent capture per store (non-folding ScanView): every
+        # plane and column slices the same consistent prefix even under
+        # concurrent appends, and the sync record can't claim stamps
+        # newer than the planes just read
+        views = self._store_views(stores)
+        totals = [v.nrows for v in views]
+        seqs = [v.mvcc_seq for v in views]
+        structs = [v.structure_version for v in views]
+        for i, v in enumerate(views):
             n = max(min(totals[i] - start, length), 0)
             if n:
-                xmin[i, :n] = s.xmin_ts[start:start + n]
-                xmax[i, :n] = s.xmax_ts[start:start + n]
+                xmin[i, :n] = v.xmin(start, start + n)
+                xmax[i, :n] = v.xmax(start, start + n)
+                stores[i].note_delta_read(
+                    v.delta_rows(start, start + n)
+                )
             nrows[i] = n
         cols: dict = {}
         valids: dict = {}
@@ -360,16 +392,16 @@ class DeviceCache:
             ty = meta.schema[cname]
             stack = np.zeros((S, W), dtype=ty.np_dtype)
             vstack = None
-            for i, s in enumerate(stores):
+            for i, v in enumerate(views):
                 n = int(nrows[i])
                 if not n:
                     continue
-                stack[i, :n] = s.column_array(cname, start + n)[start:]
-                vm = s._validity.get(cname)
+                stack[i, :n] = v.col(cname, start, start + n)
+                vm = v.validity(cname, start, start + n)
                 if vm is not None:
                     if vstack is None:
                         vstack = np.ones((S, W), dtype=np.bool_)
-                    vstack[i, :n] = vm[start:start + n]
+                    vstack[i, :n] = vm
             cols[cname] = jax.device_put(stack, sharding)
             valids[cname] = (
                 None if vstack is None
@@ -399,20 +431,28 @@ class DeviceCache:
         return dt
 
     def _ensure_columns(
-        self, dt: DeviceTable, stores, meta, want, totals=None
+        self, dt: DeviceTable, stores, meta, want, totals=None,
+        views=None,
     ) -> None:
         """Upload any of ``want`` not yet device-resident. Row bounds
-        come from ``totals`` (the caller's one-shot nrows capture) or,
-        absent that, from dt.sync — NEVER from a fresh s.nrows read,
-        which a concurrent append could have advanced past the MVCC
-        planes already on device."""
+        come from ``totals`` (the caller's one-shot capture) or, absent
+        that, from dt.sync — NEVER from a fresh nrows read, which a
+        concurrent append could have advanced past the MVCC planes
+        already on device. Store reads go through non-folding
+        ScanViews, built lazily: the all-resident fast path (incl.
+        register_external stub stores) never touches a store."""
+        if all(cname in dt.columns for cname in want):
+            return
         S = _pad_shards(len(stores), self.mesh.shape["dn"])
         sharding = NamedSharding(self.mesh, P("dn"))
+        if views is None:
+            views = self._store_views(stores)
         bounds = [
             min(
                 totals[i] if totals is not None
                 else dt.sync[i]["nrows"],
                 dt.rmax,
+                views[i].nrows,
             )
             for i in range(len(stores))
         ]
@@ -422,21 +462,23 @@ class DeviceCache:
             ty = meta.schema[cname]
             stack = np.zeros((S, dt.rmax), dtype=ty.np_dtype)
             vstack = None
-            for i, s in enumerate(stores):
+            reals = []
+            for i, v in enumerate(views):
                 n0 = bounds[i]
-                stack[i, :n0] = s.column_array(cname, n0)
-                vm = s._validity.get(cname)
+                real = v.col(cname, 0, n0)
+                reals.append(real)
+                stack[i, :n0] = real
+                vm = v.validity(cname, 0, n0)
                 if vm is not None:
                     if vstack is None:
                         vstack = np.ones((S, dt.rmax), dtype=np.bool_)
-                    vstack[i, :n0] = vm[:n0]
+                    vstack[i, :n0] = vm
             if np.issubdtype(stack.dtype, np.integer):
                 # stats over REAL rows only: the zero padding would
                 # inflate the range (e.g. year keys 1992..1998 -> domain
                 # 1999) and disqualify small-domain group keys
                 lo = hi = ma = None
-                for i, s in enumerate(stores):
-                    real = s.column_array(cname, bounds[i])
+                for real in reals:
                     if real.size == 0:
                         continue
                     rlo, rhi = int(real.min()), int(real.max())
@@ -462,7 +504,20 @@ class DeviceCache:
         """Refresh ``dt`` in place with append-tail uploads + MVCC stamp
         replay (device-RESIDENT columns only; absent columns upload lazily
         with current data). Returns None when only a full rebuild is
-        sound."""
+        sound.
+
+        The tail read goes through non-folding ScanViews, so a fresh
+        INSERT burst becomes a tail ``.at[].set()`` served STRAIGHT
+        from pending DeltaBatch segments — no host fold, no
+        ``full_uploads`` rebuild (delta batches are device-appendable;
+        global positions map 1:1 onto the [S, rmax] planes). MVCC
+        stamps on delta rows ride the existing ``mvcc_seq`` replay
+        log; stamps that landed inside the freshly-read tail are
+        already reflected in the tail planes and are skipped, and the
+        remainder coalesces into ONE de-duplicated device scatter per
+        plane sized against the rows actually touched — a 10-row stamp
+        burst on a million-row shard never pays a full-plane refresh
+        (the old flat >8-entry cutoff did exactly that)."""
         present = list(dt.columns)
         if not set(present) <= set(meta.schema):
             return None
@@ -473,46 +528,67 @@ class DeviceCache:
             S = dt.xmin.shape[0]
             dt.xmin = jnp.broadcast_to(dt.xmin, (S, dt.rmax))
             dt.xmax = jnp.broadcast_to(dt.xmax, (S, dt.rmax))
-        # ONE capture per store of nrows AND mvcc_seq/structure, BEFORE
-        # any plane/column read: a concurrent append between the
-        # validation below and the tail upload could cross dt.rmax and
-        # write past the device buffer, and a commit stamping between
-        # the plane read and the sync update would be recorded as
-        # synced without having landed on device. An early seq capture
-        # only costs an idempotent re-replay next refresh.
-        totals = [s.nrows for s in stores]
-        seqs = [s.mvcc_seq for s in stores]
-        structs = [s.structure_version for s in stores]
-        for s, sy, st in zip(stores, dt.sync, structs):
+        # ONE coherent capture per store (ScanView): a concurrent
+        # append between the validation below and the tail upload
+        # could cross dt.rmax and write past the device buffer, and a
+        # commit stamping between the plane read and the sync update
+        # would be recorded as synced without having landed on device.
+        # The view pins (nrows, planes, mvcc_seq, log) to one moment.
+        legacy = self.legacy_fold
+        views = self._store_views(stores)
+        totals = [v.nrows for v in views]
+        seqs = [v.mvcc_seq for v in views]
+        structs = [v.structure_version for v in views]
+        for sy, st in zip(dt.sync, structs):
             if st != sy["structure"]:
                 return None
-        for s, sy, nr in zip(stores, dt.sync, totals):
+        for v, sy, nr in zip(views, dt.sync, totals):
             if nr > dt.rmax or nr < sy["nrows"]:
                 return None
             for cname in present:
                 has_dev = dt.validity[cname] is not None
-                if s._validity.get(cname) is not None and not has_dev:
+                if v.has_validity(cname) and not has_dev:
                     return None  # first NULL appeared: mask must materialize
+        if any(
+            totals[i] > dt.sync[i]["nrows"] for i in range(len(views))
+        ):
+            # failpoint: device delta-tail upload boundary — an
+            # injected error models the refresh dying before any tail
+            # lands (dt untouched beyond the pure plane expansion; the
+            # next statement retries the same refresh)
+            FAULT("fused/delta_tail_upload")
         delta_rows = 0
+        tail_delta_rows = 0
         replays = 0
-        for i, (s, sy) in enumerate(zip(stores, dt.sync)):
+        for i, (v, sy) in enumerate(zip(views, dt.sync)):
             old_n, new_n = sy["nrows"], totals[i]
             if new_n > old_n:
                 delta_rows += new_n - old_n
+                tail_served = v.delta_rows(old_n, new_n)
+                tail_delta_rows += tail_served
+                stores[i].note_delta_read(tail_served)
+
+                def tset(buf, tail):
+                    if legacy:
+                        # historical eager write (whole-plane copy per
+                        # call) — the fold-on-read baseline keeps it
+                        return buf.at[i, old_n:new_n].set(tail)
+                    return _tail_write(buf, i, old_n, tail, dt.rmax)
+
                 for cname in present:
-                    tail = np.ascontiguousarray(s._cols[cname][old_n:new_n])
-                    dt.columns[cname] = (
-                        dt.columns[cname].at[i, old_n:new_n].set(tail)
+                    tail = np.ascontiguousarray(
+                        v.col(cname, old_n, new_n)
                     )
+                    dt.columns[cname] = tset(dt.columns[cname], tail)
                     vdev = dt.validity[cname]
                     if vdev is not None:
-                        vm = s._validity.get(cname)
+                        vm = v.validity(cname, old_n, new_n)
                         vt = (
                             np.ones(new_n - old_n, dtype=np.bool_)
                             if vm is None
-                            else np.ascontiguousarray(vm[old_n:new_n])
+                            else np.ascontiguousarray(vm)
                         )
-                        dt.validity[cname] = vdev.at[i, old_n:new_n].set(vt)
+                        dt.validity[cname] = tset(vdev, vt)
                     if tail.size and np.issubdtype(tail.dtype, np.integer):
                         tlo, thi = int(tail.min()), int(tail.max())
                         rng = dt.col_range.get(cname)
@@ -525,45 +601,22 @@ class DeviceCache:
                             dt.col_maxabs[cname] or 0.0,
                             float(max(abs(tlo), abs(thi))),
                         )
-                dt.xmin = dt.xmin.at[i, old_n:new_n].set(
-                    np.ascontiguousarray(s.xmin_ts[old_n:new_n])
+                dt.xmin = tset(
+                    dt.xmin,
+                    np.ascontiguousarray(v.xmin(old_n, new_n)),
                 )
-                dt.xmax = dt.xmax.at[i, old_n:new_n].set(
-                    np.ascontiguousarray(s.xmax_ts[old_n:new_n])
+                dt.xmax = tset(
+                    dt.xmax,
+                    np.ascontiguousarray(v.xmax(old_n, new_n)),
                 )
                 dt.nrows[i] = new_n
             # MVCC stamp replay (idempotent absolute writes, in order)
             # — bounded by the seqs[i] capture: entries stamped after
             # it replay on the NEXT refresh, never silently skip
             if seqs[i] != sy["mvcc_seq"]:
-                log = s._mvcc_log
-                pending = [
-                    e for e in log
-                    if sy["mvcc_seq"] < e[0] <= seqs[i]
-                ]
-                expect = seqs[i] - sy["mvcc_seq"]
-                if len(pending) != expect or len(pending) > 8:
-                    # log trimmed past our sync point — or enough entries
-                    # that per-entry device scatters (each a full-array
-                    # copy) would cost more than re-uploading the two
-                    # MVCC columns for this shard
-                    dt.xmin = dt.xmin.at[i, :new_n].set(
-                        np.ascontiguousarray(s.xmin_ts[:new_n])
-                    )
-                    dt.xmax = dt.xmax.at[i, :new_n].set(
-                        np.ascontiguousarray(s.xmax_ts[:new_n])
-                    )
-                    replays += 1
-                else:
-                    for _seq, kind, a, b, ts in pending:
-                        if kind == "xmin":
-                            dt.xmin = dt.xmin.at[i, a:b].set(ts)
-                        elif kind == "xmax_range":
-                            dt.xmax = dt.xmax.at[i, a:b].set(ts)
-                        else:  # "xmax": a is an index array
-                            if len(a):
-                                dt.xmax = dt.xmax.at[i, a].set(ts)
-                        replays += 1
+                replays += self._replay_mvcc(
+                    dt, i, v, sy, seqs[i], old_n, new_n, legacy
+                )
             dt.sync[i] = {
                 "nrows": new_n,
                 "structure": structs[i],
@@ -572,13 +625,201 @@ class DeviceCache:
         dt.versions = versions
         self.stats["delta_uploads"] += 1
         self.stats["delta_rows"] += delta_rows
+        if tail_delta_rows:
+            self.stats["delta_tail_uploads"] += 1
+            self.stats["delta_tail_rows"] += tail_delta_rows
         self.stats["mvcc_replays"] += replays
         return dt
+
+    def _replay_mvcc(
+        self, dt, i, view, sy, seq, old_n, new_n, legacy
+    ) -> int:
+        """Bring shard ``i``'s device MVCC planes up to ``seq``.
+        Returns replay operations performed.
+
+        Non-legacy sizing (ISSUE-15 satellite): entries are position-
+        filtered against the freshly-uploaded tail (rows >= old_n
+        already carry their current stamps), then coalesced into ONE
+        last-write-wins scatter per plane — transfer cost scales with
+        ROWS TOUCHED, never with the plane width. A full refresh runs
+        only when the log was trimmed past the sync point or the
+        touched rows rival the synced prefix itself (at which point
+        the contiguous upload is the cheaper device op)."""
+        pending = [
+            e for e in view.mvcc_log if sy["mvcc_seq"] < e[0] <= seq
+        ]
+        expect = seq - sy["mvcc_seq"]
+        trimmed = len(pending) != expect
+        if legacy and (trimmed or len(pending) > 8):
+            # the pre-delta-plane heuristic, kept verbatim for the
+            # enable_delta_scan=off baseline: whole-plane refresh
+            dt.xmin = dt.xmin.at[i, :new_n].set(
+                np.ascontiguousarray(view.xmin(0, new_n))
+            )
+            dt.xmax = dt.xmax.at[i, :new_n].set(
+                np.ascontiguousarray(view.xmax(0, new_n))
+            )
+            return 1
+        if legacy:
+            n = 0
+            for _seq, kind, a, b, ts in pending:
+                if kind == "xmin":
+                    dt.xmin = dt.xmin.at[i, a:b].set(ts)
+                elif kind == "xmax_range":
+                    dt.xmax = dt.xmax.at[i, a:b].set(ts)
+                elif len(a):
+                    dt.xmax = dt.xmax.at[i, a].set(ts)
+                n += 1
+            return n
+        # stamps inside [old_n, new_n) are already device-current (the
+        # tail planes above were read at the same view moment as the
+        # log), so only positions below old_n need scatters
+        synced = old_n
+        if trimmed:
+            # log trimmed past the sync point: unknown stamps may touch
+            # the synced prefix — refresh it; the tail stays as
+            # uploaded (an ingest burst longer than the log cap pays
+            # O(synced prefix), never O(burst))
+            if synced:
+                dt.xmin = _tail_write(
+                    dt.xmin, i, 0,
+                    np.ascontiguousarray(view.xmin(0, synced)),
+                    dt.rmax, exact=True,
+                )
+                dt.xmax = _tail_write(
+                    dt.xmax, i, 0,
+                    np.ascontiguousarray(view.xmax(0, synced)),
+                    dt.rmax, exact=True,
+                )
+            return 1
+        spans = 0
+        for _seq, kind, a, b, ts in pending:
+            if kind == "xmax" and not isinstance(a, int):
+                spans += int((np.asarray(a) < synced).sum())
+            else:
+                spans += max(0, min(b, synced) - a)
+        if spans == 0:
+            return 0
+        if spans >= max(synced, 1):
+            # touched rows rival the synced prefix: one contiguous
+            # upload beats an equally-sized scatter
+            dt.xmin = _tail_write(
+                dt.xmin, i, 0,
+                np.ascontiguousarray(view.xmin(0, synced)),
+                dt.rmax, exact=True,
+            )
+            dt.xmax = _tail_write(
+                dt.xmax, i, 0,
+                np.ascontiguousarray(view.xmax(0, synced)),
+                dt.rmax, exact=True,
+            )
+            return 1
+        planes = {"xmin": ([], []), "xmax": ([], [])}
+        for _seq, kind, a, b, ts in pending:
+            if kind == "xmax" and not isinstance(a, int):
+                pos = np.asarray(a, dtype=np.int64)
+                pos = pos[pos < synced]
+                plane = "xmax"
+            else:
+                plane = "xmin" if kind == "xmin" else "xmax"
+                hi = min(b, synced)
+                if hi <= a:
+                    continue
+                pos = np.arange(a, hi, dtype=np.int64)
+            if not len(pos):
+                continue
+            planes[plane][0].append(pos)
+            planes[plane][1].append(
+                np.full(len(pos), ts, dtype=np.int64)
+            )
+        n = 0
+        for plane, (poss, valss) in planes.items():
+            if not poss:
+                continue
+            pos = np.concatenate(poss)
+            vals = np.concatenate(valss)
+            # last-write-wins de-dup: XLA scatter order is undefined
+            # for duplicate indices, the log's order is the law
+            uniq, first_in_rev = np.unique(
+                pos[::-1], return_index=True
+            )
+            vals = vals[::-1][first_in_rev]
+            # bucket-pad the scatter so its XLA program caches across
+            # refreshes (varying index counts would recompile per
+            # statement); the pad repeats the last (index, value) pair
+            # — duplicate indices with EQUAL values are order-immune
+            padn = filt_ops.bucket_size(len(uniq))
+            if padn != len(uniq):
+                uniq = np.concatenate(
+                    [uniq, np.full(padn - len(uniq), uniq[-1])]
+                )
+                vals = np.concatenate(
+                    [vals, np.full(padn - len(vals), vals[-1])]
+                )
+            # donated in-place scatter: O(rows touched), never an
+            # O(plane) eager copy — the heart of the satellite fix
+            if plane == "xmin":
+                dt.xmin = _donated_row_scatter(
+                    dt.xmin, jnp.int32(i), jnp.asarray(uniq),
+                    jnp.asarray(vals),
+                )
+            else:
+                dt.xmax = _donated_row_scatter(
+                    dt.xmax, jnp.int32(i), jnp.asarray(uniq),
+                    jnp.asarray(vals),
+                )
+            n += 1
+        return n
 
 
 def _pad_shards(s: int, d: int) -> int:
     """Shard count padded up to a multiple of the mesh axis size."""
     return ((s + d - 1) // d) * d
+
+
+# -- donated (in-place) device refresh primitives ---------------------------
+# Eager ``.at[].set`` copies the WHOLE [S, rmax] buffer on every call —
+# fine for a one-off, ruinous for the per-statement refresh cadence the
+# scannable delta plane runs at (a 2k-row tail would pay an O(plane)
+# copy per column per statement). Donating the input buffer lets XLA
+# alias it in place, so a refresh costs O(rows touched) on EVERY
+# backend. Tail lengths and scatter widths are bucket-padded by the
+# callers so these compile once per (dtype, width) and then cache.
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _donated_update_slice(buf, tail2d, row, start):
+    return jax.lax.dynamic_update_slice(buf, tail2d, (row, start))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _donated_row_scatter(buf, row, idx, vals):
+    return buf.at[row, idx].set(vals)
+
+
+def _tail_write(
+    buf, i: int, start: int, tail: np.ndarray, rmax: int,
+    exact: bool = False,
+):
+    """Donated write of ``tail`` into ``buf[i, start:start+len]``,
+    bucket-padded into the dead lanes past the live prefix (rows >=
+    nrows are masked dead by every consumer, and later tails overwrite
+    them) so the compiled update is shape-stable across refreshes.
+    ``exact=True`` skips the padding — for writes whose following rows
+    are LIVE (the synced-prefix plane refresh) and must not be
+    clobbered."""
+    span = len(tail)
+    L = span if exact else filt_ops.bucket_size(max(span, 1))
+    if start + L > rmax:
+        L = span  # exact-width fallback at the buffer edge
+    if L != span:
+        padded = np.empty(L, dtype=tail.dtype)
+        padded[:span] = tail
+        padded[span:] = tail[-1] if span else 0
+        tail = padded
+    return _donated_update_slice(
+        buf, jnp.asarray(tail)[None, :], jnp.int32(i), jnp.int32(start)
+    )
 
 
 def build_mesh(devices=None) -> Mesh:

@@ -135,7 +135,11 @@ def _walk_leaves(node: L.LogicalPlan):
 def _leaf_arrays(fx, node, exchanged: dict, D: int):
     """Device arrays for one leaf — the ONE definition of each leaf's
     block tuple layout. Called fresh every run so cached programs see
-    current data."""
+    current data: a read-after-write scan picks up an ingest burst as
+    a delta-tail refresh (DeviceCache._try_delta serves the appended
+    rows straight from pending DeltaBatch segments — no host fold, no
+    full re-upload), and the in-program visibility compare below is
+    the ONLY filter those fresh rows ever pass through."""
     if isinstance(node, L.Scan):
         meta = fx.catalog.get(node.table)
         nodes = _scan_nodes(meta)
@@ -1101,7 +1105,12 @@ class _Builder:
 
         def run(blocks, params, snap):
             # visibility planes are full [k, Rmax] or compact [k, 1]
-            # (uniform per shard) — 2-D compares broadcast either form
+            # (uniform per shard) — 2-D compares broadcast either form.
+            # This vectorized xmin<=snap<xmax compare is the device
+            # MVCC filter (tqual.c:2274 analog, SURVEY §7): it covers
+            # delta-resident rows too, because the cache keeps the
+            # planes append-current via tail uploads + stamp replay —
+            # the delta plane needs no separate visibility pass.
             if win is not None:
                 cols, valids, xmin, xmax, nrows, wstart = blocks[idx]
                 k = xmin.shape[0]

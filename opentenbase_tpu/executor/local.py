@@ -76,6 +76,7 @@ class LocalExecutor:
         own_writes: Optional[dict] = None,
         instrument: bool = False,
         cancel_check=None,
+        fold_on_read: bool = False,
     ):
         self.catalog = catalog
         self.stores = stores
@@ -106,6 +107,13 @@ class LocalExecutor:
         # every operator boundary. None (the overwhelmingly common case)
         # costs one attribute test per operator.
         self._cancel_check = cancel_check
+        # enable_delta_scan = off (the HTAP bench baseline / escape
+        # hatch): scans fold pending deltas before reading, restoring
+        # the pre-delta-plane read path on the same binary
+        self._fold_on_read = fold_on_read
+        # delta-resident rows the last _eval_scan served (EXPLAIN
+        # ANALYZE evidence that the scan read the delta plane directly)
+        self.last_scan_delta_rows = 0
 
     # -- dictionary access ----------------------------------------------
     def _dict(self, dict_id: str) -> Dictionary:
@@ -229,6 +237,14 @@ class LocalExecutor:
             jm = getattr(self, "last_join_mode", None)
             if jm:
                 rec["detail"] = f"{rec.get('detail') or ''} ({jm})".strip()
+        elif rec["op"] == "Scan" and self.last_scan_delta_rows:
+            # how much of the scan answered from the delta plane
+            # without a fold — the read-after-write evidence the tier-1
+            # smoke asserts on
+            rec["detail"] = (
+                f"{rec.get('detail') or ''} (delta-resident: "
+                f"{self.last_scan_delta_rows} rows)"
+            ).strip()
         return out
 
     def _eval_remotesource(self, plan) -> DevBatch:
@@ -263,12 +279,15 @@ class LocalExecutor:
             store = self.stores.get(plan.table)
         if store is None:
             raise ExecError(f"no shard for table {plan.table} on this node")
-        # capture the row count ONCE: a concurrent append (readers and
-        # table-granular writers now overlap) advances store.nrows
-        # AFTER the new rows are fully written, so any single captured
-        # n is a consistent fully-written prefix — but re-reading
-        # nrows per column would tear the scan across columns
-        n0 = store.nrows
+        # ONE coherent capture (scan_view): a concurrent append
+        # advances store.nrows AFTER the new rows are fully written, so
+        # the captured view is a consistent fully-written prefix across
+        # every column AND the MVCC planes. The view assembles base +
+        # pending delta segments straight into the padded batch — the
+        # same one copy the batch build always paid, with NO fold:
+        # reads never mutate storage (the scannable delta plane).
+        view = store.scan_view(fold=self._fold_on_read)
+        n0 = view.nrows
         blk = self.scan_block
         if blk is not None:
             assert row_idx is None and not self.own_writes
@@ -279,18 +298,21 @@ class LocalExecutor:
         nrows = (e0 - s0) if row_idx is None else len(row_idx)
         padded = filt_ops.bucket_size(max(nrows, 1))
 
-        def subset(arr):
-            a = arr[s0:e0]
-            return a if row_idx is None else arr[:n0][row_idx]
-
         cols = []
         for name, oc in zip(plan.columns, plan.schema):
-            d = _pad_to(subset(store._cols[name]), padded)
-            vm = store._validity.get(name)
-            v = (
-                None if vm is None
-                else _pad_to(subset(vm), padded, fill=False)
-            )
+            if row_idx is None:
+                d = view.col(name, s0, e0, pad=padded)
+                v = view.validity(name, s0, e0, pad=padded)
+            else:
+                # zone-pruned subset: positional gathers, O(rows
+                # taken) — never materialize the whole column while a
+                # burst is delta-resident
+                d = _pad_to(view.col_at(name, row_idx), padded)
+                vm = view.validity_at(name, row_idx)
+                v = (
+                    None if vm is None
+                    else _pad_to(vm, padded, fill=False)
+                )
             cols.append(
                 (jnp.asarray(d), None if v is None else jnp.asarray(v))
             )
@@ -298,9 +320,19 @@ class LocalExecutor:
         live[:nrows] = True
         if self.snapshot_ts is not None:
             snap = np.int64(self.snapshot_ts)
-            live[:nrows] &= (subset(store.xmin_ts) <= snap) & (
-                snap < subset(store.xmax_ts)
-            )
+            if row_idx is None:
+                xm, xx = view.xmin(s0, e0), view.xmax(s0, e0)
+            else:
+                xm = view.xmin_at(row_idx)
+                xx = view.xmax_at(row_idx)
+            live[:nrows] &= (xm <= snap) & (snap < xx)
+        self.last_scan_delta_rows = (
+            view.delta_rows(s0, e0) if row_idx is None
+            else int((np.asarray(row_idx) >= view.base_rows).sum())
+        )
+        # fold-avoided evidence covers the rows THIS scan served — a
+        # block worker its block, a pruned scan its subset
+        store.note_delta_read(self.last_scan_delta_rows)
         own = self.own_writes.get(plan.table)
         if own is not None:
             assert row_idx is None, "own-writes are positional"
@@ -1011,7 +1043,9 @@ class LocalExecutor:
             if child.mask is not None
             else jnp.ones(child.n, jnp.bool_)
         )
-        rank = jnp.cumsum(mask.astype(jnp.int32))  # 1-based among live rows
+        # int64 running rank: an int32 cumsum wraps past 2^31 live rows
+        # (the emit_pairs overflow class, PR 6)
+        rank = jnp.cumsum(mask.astype(jnp.int64))  # 1-based among live rows
         keep = mask & (rank > plan.offset)
         if plan.limit is not None:
             keep = keep & (rank <= plan.offset + plan.limit)
@@ -1313,29 +1347,66 @@ class LocalExecutor:
             and self._foreign_store(table) is None
             and self.scan_block is None
         ):
-            n0 = store.nrows
+            # non-folding capture: UPDATE/DELETE target selection
+            # addresses delta rows by the same global positions the
+            # stamp paths use, so DML on fresh rows never forces a
+            # fold. Evaluation runs PER SEGMENT — the base portion on
+            # zero-copy views, the delta tail on its (small) assembled
+            # slices — so a point UPDATE during an ingest burst never
+            # pays a whole-column materialization.
+            view = store.scan_view(fold=self._fold_on_read)
+            store.note_delta_read(view.delta_rows())  # whole-table read
+            n0 = view.nrows
             cols = list(self.catalog.get(table).schema)
-            res = (
-                (np.ones(n0, np.bool_), None) if predicate is None
-                else _np_pred_eval(predicate, store, cols, n0)
-            )
-            if res is not None:
+            b = min(view.base_rows, n0)
+            keep_live = np.empty(n0, dtype=np.bool_)
+            ok = True
+            for seg in ((0, b), (b, n0)):
+                s0, e0 = seg
+                if s0 >= e0:
+                    continue
+                res = (
+                    (np.ones(e0 - s0, np.bool_), None)
+                    if predicate is None
+                    else _np_pred_eval(predicate, view, cols, s0, e0)
+                )
+                if res is None:
+                    ok = False  # device path defines the semantics
+                    break
                 d, v = res
                 keep = d if v is None else (d & v)
-                live = np.ones(n0, dtype=np.bool_)
+                keep = np.broadcast_to(keep, (e0 - s0,)).copy()
                 if self.snapshot_ts is not None:
                     snap = np.int64(self.snapshot_ts)
-                    live &= (store.xmin_ts[:n0] <= snap) & (
-                        snap < store.xmax_ts[:n0]
+                    keep &= (view.xmin(s0, e0) <= snap) & (
+                        snap < view.xmax(s0, e0)
                     )
+                keep_live[s0:e0] = keep
+            if ok:
                 own = self.own_writes.get(table)
                 if own is not None:
                     ins_ranges, del_idx = own
-                    for s, e in ins_ranges:
-                        live[s:min(e, n0)] = True
+                    if self.snapshot_ts is not None:
+                        # own writes override visibility only; the
+                        # predicate verdict must still hold, so re-AND
+                        # the overlay with the predicate mask
+                        for s, e in ins_ranges:
+                            e = min(e, n0)
+                            res = (
+                                (np.ones(e - s, np.bool_), None)
+                                if predicate is None
+                                else _np_pred_eval(
+                                    predicate, view, cols, s, e
+                                )
+                            )
+                            d, v = res
+                            kp = d if v is None else (d & v)
+                            keep_live[s:e] = np.broadcast_to(
+                                kp, (e - s,)
+                            )
                     if len(del_idx):
-                        live[np.asarray(del_idx)] = False
-                return np.nonzero(keep & live)[0]
+                        keep_live[np.asarray(del_idx)] = False
+                return np.nonzero(keep_live)[0]
         meta = self.catalog.get(table)
         schema = tuple(
             L.OutCol(
@@ -1373,19 +1444,21 @@ def _np_and_valid(lv, rv):
     return lv & rv
 
 
-def _np_pred_eval(e, store, cols, n):
-    """(data, validity) for a SIMPLE predicate over the store's host
-    arrays in numpy, or None when the expression needs the compiled
-    device path (see ``np_expr_eval``)."""
+def _np_pred_eval(e, view, cols, s, n):
+    """(data, validity) for a SIMPLE predicate over rows [s, n) of a
+    store's :class:`~opentenbase_tpu.storage.table.ScanView` in numpy,
+    or None when the expression needs the compiled device path (see
+    ``np_expr_eval``). Range-based so callers evaluate per SEGMENT:
+    the base portion reads zero-copy views, the delta tail its small
+    assembled slices — DML row location stays fold-free AND
+    allocation-light while a burst is delta-resident."""
     def getcol(idx):
         if idx >= len(cols):
             return None
         name = cols[idx]
-        data = store._cols.get(name)
-        if data is None:
+        if name not in view.schema:
             return None
-        vm = store._validity.get(name)
-        return (data[:n], None if vm is None else vm[:n])
+        return (view.col(name, s, n), view.validity(name, s, n))
 
     return np_expr_eval(e, getcol)
 
@@ -1651,6 +1724,7 @@ def _parallel_shape(plan):
 def run_fragment_parallel(
     catalog, stores, snapshot_ts, plan, remote_inputs,
     subquery_values, nworkers: int, cancel_check=None,
+    fold_on_read: bool = False,
 ):
     """Run ``plan`` split across ``nworkers`` scan-block threads, or
     return None when the shape/size doesn't qualify (caller falls back
@@ -1717,6 +1791,7 @@ def run_fragment_parallel(
                 remote_inputs=remote_inputs,
                 subquery_values=subquery_values,
                 cancel_check=cancel_check,
+                fold_on_read=fold_on_read,
             )
             ex.scan_block = bounds[i]
             parts[i] = ex.run_plan(plan)

@@ -404,7 +404,7 @@ def _read_state_rows(cluster) -> dict:
         idx = store.live_index(snap)
         if not len(idx):
             continue
-        data = store.to_batch().take(idx).to_pydict()
+        data = store.take_batch(idx).to_pydict()
         for r in range(len(idx)):
             out[data["mv"][r]] = (
                 data["lsn"][r], data["ts"][r], data["incr"][r],

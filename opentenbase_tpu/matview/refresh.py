@@ -555,7 +555,7 @@ def _read_aux_rows(session, aux_meta, want: set, nkeys: int) -> dict:
         idx = store.live_index(snap)
         if not len(idx):
             continue
-        data = store.to_batch().take(idx).to_pydict()
+        data = store.take_batch(idx).to_pydict()
         for r in range(len(idx)):
             row = tuple(data[col][r] for col in cols)
             if row[:nkeys] in want:

@@ -726,7 +726,11 @@ class PgConcentrator:
             # via a worker (never roll back on the selector thread —
             # rollback takes the exec lock). A busy client's cleanup
             # happens in _release when its statement finishes.
-            self._jobs.put((cl, _CLOSE_JOB, None))
+            # 4-tuple like every other job: a 3-tuple here crashed the
+            # unpacking worker with ValueError and silently shrank the
+            # worker pool (caught as a stray traceback in the tier-1
+            # serving smoke)
+            self._jobs.put((cl, _CLOSE_JOB, None, None))
 
     def _finish_close(self, cl: _Client) -> None:
         """Worker half of teardown: roll back any open transaction and

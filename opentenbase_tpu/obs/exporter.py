@@ -409,6 +409,36 @@ def render_cluster_metrics(cluster) -> str:
         out.append(_line(
             "otb_ingest_compactions_total", {}, int(ist["compactions"]),
         ))
+    stores = getattr(cluster, "stores", None)
+    if stores:
+        # scannable delta plane (ISSUE-15): scans serving pending delta
+        # rows without a fold, and device tail-uploads of delta rows —
+        # summed by the ONE helper pg_stat_wal/pg_stat_fused also use
+        # (local import: engine imports this module's server half)
+        from opentenbase_tpu.engine import _delta_plane_totals
+
+        folds_avoided, rows_read, _absorbed = _delta_plane_totals(
+            cluster
+        )
+        _head(out, "otb_delta_fold_avoided_total", "counter",
+              "Scans that served pending delta rows without forcing "
+              "a fold (the scannable delta plane)")
+        out.append(_line(
+            "otb_delta_fold_avoided_total", {}, folds_avoided,
+        ))
+        _head(out, "otb_delta_rows_read_total", "counter",
+              "Delta-resident rows served to scans without a fold")
+        out.append(_line("otb_delta_rows_read_total", {}, rows_read))
+        fx = getattr(cluster, "_fused", None)
+        if fx is not None:
+            _head(out, "otb_delta_tail_uploads_total", "counter",
+                  "Device-cache refreshes whose appended tail "
+                  "uploaded straight from delta batches (no fold, "
+                  "no full re-upload)")
+            out.append(_line(
+                "otb_delta_tail_uploads_total", {},
+                int(fx.cache.stats.get("delta_tail_uploads", 0)),
+            ))
     pools = getattr(cluster, "dn_channels", None) or {}
     if pools:
         _head(out, "otb_dn_pool_channels", "gauge",

@@ -173,12 +173,12 @@ def _resolve_deleted_rows(cluster, tm, node: int, rowids) -> list[dict]:
     if not len(rowids):
         return []
     pos = np.nonzero(
-        np.isin(store.row_id[: store.nrows],
+        np.isin(store.scan_view().row_id(),
                 np.asarray(rowids, dtype=np.int64))
     )[0]
     if not len(pos):
         return []  # vacuumed away: the change is unrecoverable, skip
-    batch = store.to_batch().take(pos)
+    batch = store.take_batch(pos)
     data = batch.to_pydict()
     return [
         {c: data[c][r] for c in data} for r in range(len(pos))
@@ -423,13 +423,14 @@ def _apply_delete(session, txn, meta, row: dict) -> int:
         if not len(idx):
             continue
         mask = np.ones(len(idx), dtype=bool)
+        sv = store.scan_view()
         for c in ident_cols:
-            col = store.column_array(c)[idx]
+            col = sv.col_at(c, idx)
             want = row[c]
             if want is None:  # NULL identity (checked before TEXT decode)
-                vm = store._validity.get(c)
+                vm = sv.validity_at(c, idx)
                 mask &= (
-                    ~vm[idx] if vm is not None
+                    ~vm if vm is not None
                     else np.zeros(len(idx), bool)
                 )
             elif meta.schema[c].id.name == "TEXT":

@@ -110,7 +110,7 @@ def encode_commit_group(writes, stores, catalog=None, dict_synced=None):
         if len(del_idx):
             i = len(sub)
             idx = np.asarray(del_idx, dtype=np.int64)
-            arrays[f"w{i}_del"] = store.row_id[idx]
+            arrays[f"w{i}_del"] = store.peek_row_id_at(idx)
             sub.append({"node": node, "table": table, "kind": "del"})
     return sub, arrays
 
@@ -575,7 +575,7 @@ class ClusterPersistence:
                 if tw.del_idx:
                     i = len(writes)
                     idx = np.asarray(tw.del_idx, dtype=np.int64)
-                    arrays[f"w{i}_del"] = store.row_id[idx]
+                    arrays[f"w{i}_del"] = store.peek_row_id_at(idx)
                     writes.append(
                         {"node": node, "table": table, "kind": "del"}
                     )
@@ -746,18 +746,23 @@ class ClusterPersistence:
                     continue
                 from opentenbase_tpu.storage.table import PENDING_TS
 
-                n = store.nrows
-                keep = store.xmin_ts[:n] != PENDING_TS
+                # non-folding capture: a checkpoint must never compact
+                # the store it snapshots (delta-resident rows write out
+                # straight from their batches)
+                sv = store.scan_view()
+                n = sv.nrows
+                xmin = sv.xmin()
+                keep = xmin != PENDING_TS
                 for s, e in prep_ranges.get((node, name), []):
                     keep[s:e] = True  # prepared rows are decidable: keep
-                arrays = {"__xmin": store.xmin_ts[:n][keep],
-                          "__xmax": store.xmax_ts[:n][keep],
-                          "__rowid": store.row_id[:n][keep]}
+                arrays = {"__xmin": xmin[keep],
+                          "__xmax": sv.xmax()[keep],
+                          "__rowid": sv.row_id()[keep]}
                 for col in store.schema:
-                    arrays[col] = store.column_array(col)[keep]
-                    vm = store._validity.get(col)
+                    arrays[col] = sv.col(col, 0, n)[keep]
+                    vm = sv.validity(col, 0, n)
                     if vm is not None:
-                        arrays[f"__v_{col}"] = vm[:n][keep]
+                        arrays[f"__v_{col}"] = vm[keep]
                 path = os.path.join(
                     self.dir, f"ckpt{gen}_dn{node}_{name}.npz"
                 )
@@ -826,7 +831,7 @@ class ClusterPersistence:
                     idx = np.asarray(tw.del_idx, dtype=np.int64)
                     ws.append(
                         {"node": node, "table": table, "kind": "del",
-                         "rowids": store.row_id[idx].tolist()}
+                         "rowids": store.peek_row_id_at(idx).tolist()}
                     )
         return ws
 
@@ -944,7 +949,7 @@ class ClusterPersistence:
                     tw.ins_ranges.append(tuple(wm["range"]))
                 else:
                     pos = np.nonzero(
-                        np.isin(store.row_id[: store.nrows], wm["rowids"])
+                        np.isin(store.scan_view().row_id(), wm["rowids"])
                     )[0]
                     tw.del_idx.extend(int(i) for i in pos)
                     # re-assert the PREPARE reservation so new writers
@@ -1099,7 +1104,7 @@ class ClusterPersistence:
             ws = []
             for wm in p["writes"]:
                 store = c.stores[wm["node"]][wm["table"]]
-                rid = store.row_id[: store.nrows]
+                rid = store.scan_view().row_id()
                 if wm["kind"] == "ins":
                     rid0, n = wm["row_id_start"], wm["nrows"]
                     pos = np.nonzero((rid >= rid0) & (rid < rid0 + n))[0]
@@ -1411,7 +1416,7 @@ class ClusterPersistence:
                 if wm["kind"] == "del":
                     store = c.stores[wm["node"]][wm["table"]]
                     pos = np.nonzero(
-                        np.isin(store.row_id[: store.nrows], wm["rowids"])
+                        np.isin(store.scan_view().row_id(), wm["rowids"])
                     )[0]
                     store.stamp_xmax(pos, header["commit_ts"])
             c.bump_table_versions({wm["table"] for wm in writes})
@@ -1447,14 +1452,14 @@ class ClusterPersistence:
                         store.truncate_range(s, e)
                 else:
                     pos = np.nonzero(
-                        np.isin(store.row_id[: store.nrows], wm["rowids"])
+                        np.isin(store.scan_view().row_id(), wm["rowids"])
                     )[0]
                     if tag == "C":
                         store.stamp_xmax(pos, header["commit_ts"])
                     else:
                         # release a checkpoint-persisted PREPARE
                         # reservation on rollback
-                        res = pos[store.xmax_ts[pos] == RESERVED_TS]
+                        res = pos[store.peek_xmax_at(pos) == RESERVED_TS]
                         if len(res):
                             store.unstamp_xmax(res)
             if tag == "C":

@@ -102,6 +102,185 @@ class DeltaBatch:
         return s >= self.start and e <= self.start + self.nrows
 
 
+class ScanView:
+    """One coherent READ-ONLY capture of a store: base-array references
+    plus the pending delta segments, taken in one moment under the
+    store lock and assembled lazily, LOCK-FREE, per plane — the
+    scannable delta plane (a scan ≙ a heap scan over unvacuumed pages;
+    the fold is compaction's job alone, never a reader's).
+
+    Why lock-free assembly is sound: the fold writes delta contents
+    INTO the base arrays only at positions >= the captured
+    ``base_rows`` (positions are global and the fold is position-
+    preserving), growth and vacuum REPLACE arrays rather than mutating
+    captured ones, and MVCC stamps are idempotent absolute writes a
+    concurrent reader may see either side of — exactly the torn-stamp
+    tolerance the folding read path already had. So a view reads
+    ``base[:base_rows]`` + its captured DeltaBatch segments and never
+    needs the lock again."""
+
+    __slots__ = (
+        "schema", "nrows", "base_rows", "version", "structure_version",
+        "mvcc_seq", "mvcc_log", "deltas", "_bcols", "_bvalidity",
+        "_bxmin", "_bxmax", "_brow_id",
+    )
+
+    def __init__(self, store: "ShardStore", nrows: int):
+        # caller holds store._delta_mu
+        self.schema = dict(store.schema)
+        self.nrows = nrows
+        self.base_rows = min(store._base_rows, nrows)
+        self.version = store.version
+        self.structure_version = store.structure_version
+        self.mvcc_seq = store.mvcc_seq
+        self.mvcc_log = tuple(store._mvcc_log)
+        self.deltas = list(store._deltas)
+        self._bcols = dict(store._base_cols)
+        self._bvalidity = dict(store._base_validity)
+        self._bxmin = store._base_xmin
+        self._bxmax = store._base_xmax
+        self._brow_id = store._base_row_id
+
+    # -- assembly ---------------------------------------------------------
+    def delta_rows(self, s: int = 0, e: int | None = None) -> int:
+        """Rows of [s, e) served from pending deltas (0 = base-only)."""
+        e = self.nrows if e is None else min(e, self.nrows)
+        return max(0, e - max(s, self.base_rows))
+
+    def _plane(self, base, seg, s, e, pad=None, fill=0):
+        """Assemble plane rows [s, e): a zero-copy base VIEW when the
+        range is base-resident and unpadded, else one allocation filled
+        from base + overlapping delta segments. ``pad`` sizes the
+        output (scan batches assemble straight into their padded
+        width — never pay a second copy on top of the assembly)."""
+        n = e - s
+        if e <= self.base_rows and pad is None:
+            return base[s:e]
+        out_n = n if pad is None else pad
+        out = np.full(out_n, fill, dtype=base.dtype)
+        b = min(self.base_rows, e)
+        if s < b:
+            out[: b - s] = base[s:b]
+        if e > self.base_rows:
+            for d in self.deltas:
+                ds = d.start
+                lo = max(ds, s)
+                hi = min(ds + d.nrows, e)
+                if lo < hi:
+                    out[lo - s : hi - s] = seg(d)[lo - ds : hi - ds]
+        return out
+
+    def col(self, name: str, s: int = 0, e: int | None = None,
+            pad=None, fill=0):
+        e = self.nrows if e is None else e
+        return self._plane(
+            self._bcols[name], lambda d: d.cols[name], s, e, pad, fill
+        )
+
+    def validity(self, name: str, s: int = 0, e: int | None = None,
+                 pad=None):
+        """Assembled validity for [s, e), or None when every covered
+        row is valid-by-construction (no mask anywhere in range).
+        Padded lanes are False (dead), data lanes default True."""
+        e = self.nrows if e is None else e
+        vm = self._bvalidity.get(name)
+        if not self.has_validity(name):
+            return None
+        n = e - s
+        out_n = n if pad is None else pad
+        out = np.zeros(out_n, dtype=np.bool_)
+        out[:n] = True
+        b = min(self.base_rows, e)
+        if vm is not None and s < b:
+            out[: b - s] = vm[s:b]
+        if e > self.base_rows:
+            for d in self.deltas:
+                ds = d.start
+                lo = max(ds, s)
+                hi = min(ds + d.nrows, e)
+                if lo < hi:
+                    dv = d.validity.get(name)
+                    if dv is not None:
+                        out[lo - s : hi - s] = dv[lo - ds : hi - ds]
+        return out
+
+    def has_validity(self, name: str) -> bool:
+        return self._bvalidity.get(name) is not None or any(
+            d.validity.get(name) is not None for d in self.deltas
+        )
+
+    def col_at(self, name: str, idx: np.ndarray) -> np.ndarray:
+        """Column values at global positions — a positional gather that
+        touches ONLY the requested rows (never materializes the whole
+        column), base rows from the base view, delta rows from their
+        batches."""
+        return self._plane_at(
+            self._bcols[name], lambda d: d.cols[name], idx
+        )
+
+    def validity_at(self, name: str, idx: np.ndarray):
+        """Validity at global positions, or None when no mask exists
+        anywhere (all-valid)."""
+        if not self.has_validity(name):
+            return None
+        idx = np.asarray(idx, dtype=np.int64)
+        out = np.ones(len(idx), dtype=np.bool_)
+        vm = self._bvalidity.get(name)
+        bm = idx < self.base_rows
+        if vm is not None and bm.any():
+            out[bm] = vm[idx[bm]]
+        rest = ~bm
+        if rest.any():
+            for d in self.deltas:
+                sel = rest & (idx >= d.start) & (idx < d.start + d.nrows)
+                if sel.any():
+                    dv = d.validity.get(name)
+                    if dv is not None:
+                        out[sel] = dv[idx[sel] - d.start]
+                    rest &= ~sel
+        return out
+
+    def xmin(self, s: int = 0, e: int | None = None, pad=None):
+        e = self.nrows if e is None else e
+        return self._plane(
+            self._bxmin, lambda d: d.xmin, s, e, pad, np.int64(INF_TS)
+        )
+
+    def xmax(self, s: int = 0, e: int | None = None, pad=None):
+        e = self.nrows if e is None else e
+        return self._plane(self._bxmax, lambda d: d.xmax, s, e, pad, 0)
+
+    def xmin_at(self, idx: np.ndarray) -> np.ndarray:
+        return self._plane_at(self._bxmin, lambda d: d.xmin, idx)
+
+    def xmax_at(self, idx: np.ndarray) -> np.ndarray:
+        return self._plane_at(self._bxmax, lambda d: d.xmax, idx)
+
+    def row_id_at(self, idx: np.ndarray) -> np.ndarray:
+        return self._plane_at(self._brow_id, lambda d: d.row_id, idx)
+
+    def _plane_at(self, base, seg, idx: np.ndarray) -> np.ndarray:
+        """Positional gather over an MVCC plane — O(rows taken), like
+        ``col_at`` (the zone-pruned scan's visibility read)."""
+        idx = np.asarray(idx, dtype=np.int64)
+        out = np.empty(len(idx), dtype=base.dtype)
+        bm = idx < self.base_rows
+        if bm.any():
+            out[bm] = base[idx[bm]]
+        rest = ~bm
+        if rest.any():
+            for d in self.deltas:
+                sel = rest & (idx >= d.start) & (idx < d.start + d.nrows)
+                if sel.any():
+                    out[sel] = seg(d)[idx[sel] - d.start]
+                    rest &= ~sel
+        return out
+
+    def row_id(self, s: int = 0, e: int | None = None):
+        e = self.nrows if e is None else e
+        return self._plane(self._brow_id, lambda d: d.row_id, s, e)
+
+
 class ShardStore:
     """Mutable storage for one shard of one table on one datanode.
 
@@ -113,25 +292,34 @@ class ShardStore:
     Write-optimized ingest (the INSERT→COPY plane): ``append_delta``
     parks a batch as an immutable :class:`DeltaBatch` instead of copying
     it into the base arrays — O(1) per batch, no capacity-doubling
-    copies, no base-array churn during a burst. Readers see ONE store:
-    every base-array accessor (``_cols``/``xmin_ts``/… are properties)
-    folds pending deltas first, so all existing read paths stay correct
-    unchanged; the hot ingest loop (append → commit-stamp → WAL frame
-    encode) runs entirely delta-side via ``stamp_xmin``'s in-delta fast
-    path and ``slice_insert_arrays``. Folding also runs from the
-    background compaction job (storage/compaction.py) so read latency
-    doesn't spike after a burst — the vacuum analog of the split.
+    copies, no base-array churn during a burst. Readers see ONE store
+    through :meth:`scan_view`, which assembles base + pending delta
+    segments WITHOUT folding (the scannable delta plane: a delta batch
+    ≙ unvacuumed heap pages a seq scan simply reads); the hot ingest
+    loop (append → commit-stamp → WAL frame encode) runs entirely
+    delta-side via ``stamp_xmin``'s in-delta fast path and
+    ``slice_insert_arrays``, and UPDATE/DELETE stamps address delta
+    rows in place by their global positions. Folding is compaction's
+    job alone (storage/compaction.py background naptime job, vacuum,
+    MAX_DELTAS write-side backpressure) — a background amortizer, never
+    a synchronous read-side tax. The legacy fold-on-read base-array
+    accessors (``_cols``/``xmin_ts``/… properties) remain for WRITERS
+    and recovery, which need the real base arrays.
 
     Concurrency: read statements overlap table-granular writers (the
-    engine's RWStatementLock), and with the delta plane a READ mutates
-    store state (the fold). ``_delta_mu`` — reentrant, so the property
-    accessors compose with the mutators — therefore brackets EVERY
-    public accessor: the fold, the delta append, the in-delta stamp,
-    vacuum, and schema changes all serialize on it, while the array
-    VIEWS handed out stay valid across a concurrent fold/vacuum
-    because those replace arrays, never mutate absorbed ones. Methods
-    return views, not the lock: scans run lock-free on the snapshot
-    they captured.
+    engine's RWStatementLock). READS NEVER FOLD: every scan path goes
+    through :meth:`scan_view`, which captures one coherent snapshot
+    under ``_delta_mu`` (microseconds — reference capture only) and
+    assembles base + delta segments lock-free afterwards, so a
+    read-after-write scan costs the same one copy the padded batch
+    build always paid, never a store mutation. The folding property
+    accessors below remain for WRITERS and legacy direct readers
+    (persist recovery writes through them); ``_delta_mu`` — reentrant,
+    so the property accessors compose with the mutators — brackets the
+    fold, the delta append, the in-delta stamp, vacuum, and schema
+    changes, while arrays handed out stay valid across a concurrent
+    fold/vacuum because those replace or extend arrays, never mutate
+    absorbed positions.
     """
 
     # a burst longer than this folds at append time: bounds the linear
@@ -165,6 +353,11 @@ class ShardStore:
 
         self._delta_mu = _threading.RLock()
         self.deltas_absorbed = 0  # lifetime folds (pg_stat_wal evidence)
+        # scannable-delta-plane evidence (pg_stat_fused): scans that
+        # served pending delta rows WITHOUT forcing a fold, and how
+        # many delta-resident rows they served
+        self.fold_reads_avoided = 0
+        self.delta_rows_read = 0
         self._capacity = 0
         self.version = 0
         # Incremental device-cache support (executor/fused.DeviceCache):
@@ -187,11 +380,12 @@ class ShardStore:
         self._pins = 0
 
     # -- delta <-> base publication --------------------------------------
-    # Every base-array accessor folds pending deltas first, so code that
-    # touches store internals directly (persist, matview, executors,
-    # system views) reads one coherent store without knowing the delta
-    # plane exists. The fold is position-preserving: delta rows were
-    # assigned their global positions at append time.
+    # WRITER-side accessors: the property getters fold pending deltas
+    # first because they hand out the real base arrays for in-place
+    # mutation (recovery rebuild, base-tail appends). READ paths must
+    # use scan_view()/peek_* instead — reads never fold. The fold is
+    # position-preserving: delta rows were assigned their global
+    # positions at append time.
     @property
     def _cols(self) -> dict:
         with self._delta_mu:
@@ -256,6 +450,121 @@ class ShardStore:
     def pending_delta_rows(self) -> int:
         with self._delta_mu:
             return self.nrows - self._base_rows
+
+    # -- non-folding reads (the scannable delta plane) -------------------
+    def scan_view(
+        self, nrows: int | None = None, fold: bool = False,
+    ) -> ScanView:
+        """One coherent :class:`ScanView` of this store — THE read
+        entry for every scan/materialization path. Never mutates the
+        store. ``fold=True`` restores the legacy fold-on-read capture
+        (``enable_delta_scan = off`` — the HTAP bench baseline and an
+        escape hatch, reproducing the pre-delta-scan read path on the
+        same binary). Fold-avoided evidence is recorded by the READERS
+        via :meth:`note_delta_read` with the rows they actually served
+        — a capture alone proves nothing about what was scanned."""
+        with self._delta_mu:
+            if fold and self._deltas:
+                self._absorb_locked()
+            n = self.nrows if nrows is None else nrows
+            v = ScanView(self, n)
+            served = v.delta_rows()
+        if served:
+            # failpoint: delta-scan assembly boundary — an injected
+            # error models a reader dying mid-assembly (store state
+            # untouched; deltas intact, nothing half-folded)
+            from opentenbase_tpu.fault import FAULT
+
+            FAULT("storage/delta_scan", rows=served)
+        return v
+
+    def note_delta_read(self, rows: int) -> None:
+        """Record that a scan served ``rows`` delta-resident rows
+        without forcing a fold (pg_stat_fused evidence). Called by the
+        read paths with the rows THEY actually covered — a parallel
+        block worker counts only its block, a zone-pruned scan only
+        its row subset, a device refresh only its tail — so the
+        published counters never overstate delta-plane reads."""
+        if rows:
+            with self._delta_mu:
+                self.fold_reads_avoided += 1
+                self.delta_rows_read += int(rows)
+
+    def peek_xmax(self, nrows: int | None = None) -> np.ndarray:
+        """xmax plane [0, nrows) WITHOUT folding (read-only)."""
+        return self.scan_view(nrows).xmax()
+
+    def peek_xmax_at(self, idx) -> np.ndarray:
+        """xmax values at global positions WITHOUT folding — the
+        write-conflict / abort-path probe (positions may live in base
+        or in pending deltas)."""
+        return self.scan_view().xmax_at(idx)
+
+    def peek_row_id_at(self, idx) -> np.ndarray:
+        """Stable row ids at global positions WITHOUT folding — the
+        WAL delete-frame encoder's read (a DELETE targeting
+        delta-resident rows must not fold the store at commit)."""
+        return self.scan_view().row_id_at(idx)
+
+    def memory_stats(self) -> tuple[int, int, int]:
+        """(column_bytes, validity_bytes, mvcc_bytes) over base arrays
+        + pending deltas, WITHOUT folding (pg_shard_memory)."""
+        with self._delta_mu:
+            col_b = sum(a.nbytes for a in self._base_cols.values())
+            vm_b = sum(
+                v.nbytes for v in self._base_validity.values()
+                if v is not None
+            )
+            mvcc_b = (
+                self._base_xmin.nbytes + self._base_xmax.nbytes
+                + self._base_row_id.nbytes
+            )
+            for d in self._deltas:
+                col_b += sum(a.nbytes for a in d.cols.values())
+                vm_b += sum(
+                    v.nbytes for v in d.validity.values()
+                    if v is not None
+                )
+                mvcc_b += (
+                    d.xmin.nbytes + d.xmax.nbytes + d.row_id.nbytes
+                )
+            return col_b, vm_b, mvcc_b
+
+    # -- delta-aware plane writes (caller holds ``_delta_mu``) -----------
+    def _plane_write_range(self, plane: str, s: int, e: int, val) -> None:
+        """Caller holds ``_delta_mu``. Absolute-write ``val`` into
+        [s, e) of an MVCC plane without folding: base portion in
+        place, delta portions into their batches (positions are global
+        on both sides of the split)."""
+        base = self._base_xmin if plane == "xmin" else self._base_xmax
+        b = min(self._base_rows, e)
+        if s < b:
+            base[s:b] = val
+        if e > self._base_rows:
+            for d in self._deltas:
+                lo = max(d.start, s)
+                hi = min(d.start + d.nrows, e)
+                if lo < hi:
+                    arr = d.xmin if plane == "xmin" else d.xmax
+                    arr[lo - d.start : hi - d.start] = val
+
+    def _plane_write_at(self, plane: str, idx: np.ndarray, val) -> None:
+        """Caller holds ``_delta_mu``. Absolute-write ``val`` at global
+        positions without folding — UPDATE/DELETE target stamps
+        address delta rows in place."""
+        idx = np.asarray(idx, dtype=np.int64)
+        base = self._base_xmin if plane == "xmin" else self._base_xmax
+        bm = idx < self._base_rows
+        if bm.any():
+            base[idx[bm]] = val
+        rest = ~bm
+        if rest.any():
+            for d in self._deltas:
+                sel = rest & (idx >= d.start) & (idx < d.start + d.nrows)
+                if sel.any():
+                    arr = d.xmin if plane == "xmin" else d.xmax
+                    arr[idx[sel] - d.start] = val
+                    rest &= ~sel
 
     def _absorb_locked(self) -> None:
         """Caller holds ``_delta_mu``. Fold every pending delta batch
@@ -462,34 +771,32 @@ class ShardStore:
     def stamp_xmin(self, start: int, end: int, commit_ts: int) -> None:
         with self._delta_mu:
             # in-delta fast path: a fold must see either the stamped
-            # delta or hand us the base path — never copy the delta out
-            # from under a landing stamp (hence one lock for both)
+            # delta or hand us the split write — never copy the delta
+            # out from under a landing stamp (hence one lock for both)
             d = self._delta_range(start, end)
             if d is not None:
                 d.xmin[start - d.start : end - d.start] = commit_ts
             else:
-                self.xmin_ts[start:end] = commit_ts
+                self._plane_write_range("xmin", start, end, commit_ts)
             self.version += 1
             self._log_mvcc("xmin", start, end, commit_ts)
 
     def truncate_range(self, start: int, end: int) -> None:
         """Abort path for a prepared insert: mark the range dead forever."""
         with self._delta_mu:
-            d = self._delta_range(start, end)
-            if d is not None:
-                d.xmin[start - d.start : end - d.start] = INF_TS
-                d.xmax[start - d.start : end - d.start] = 0
-            else:
-                self.xmin_ts[start:end] = INF_TS
-                self.xmax_ts[start:end] = 0  # dead: xmax <= every snapshot
+            self._plane_write_range("xmin", start, end, INF_TS)
+            # dead: xmax <= every snapshot
+            self._plane_write_range("xmax", start, end, 0)
             self.version += 1
             self._log_mvcc("xmin", start, end, INF_TS)
             self._log_mvcc("xmax_range", start, end, 0)
 
     def stamp_xmax(self, idx: np.ndarray, commit_ts: int) -> None:
         with self._delta_mu:
-            # deletes address arbitrary positions: fold first (property)
-            self.xmax_ts[idx] = commit_ts
+            # deletes address arbitrary positions — base rows in place,
+            # delta rows inside their batches (no fold: UPDATE/DELETE
+            # targeting fresh rows keeps them delta-resident)
+            self._plane_write_at("xmax", idx, commit_ts)
             self.version += 1
             self._log_mvcc(
                 "xmax", np.array(idx, dtype=np.int64), None, commit_ts
@@ -497,7 +804,7 @@ class ShardStore:
 
     def unstamp_xmax(self, idx: np.ndarray) -> None:
         with self._delta_mu:
-            self.xmax_ts[idx] = INF_TS
+            self._plane_write_at("xmax", idx, INF_TS)
             self.version += 1
             self._log_mvcc(
                 "xmax", np.array(idx, dtype=np.int64), None, INF_TS
@@ -539,9 +846,9 @@ class ShardStore:
         pruned block provably contains no matching value. Returns None
         for non-integer columns or empty stores."""
         with self._delta_mu:
-            arr = self._cols.get(name)
-            if arr is None or self.nrows == 0 or not np.issubdtype(
-                arr.dtype, np.integer
+            ty = self.schema.get(name)
+            if ty is None or self.nrows == 0 or not np.issubdtype(
+                np.dtype(ty.np_dtype), np.integer
             ):
                 return None
             # keyed on DATA shape only (appends + structural rewrites):
@@ -556,7 +863,9 @@ class ShardStore:
             b = self.ZONE_BLOCK
             nblocks = -(-n // b)
             padded = nblocks * b
-            data = arr[:n]
+            # assembled WITHOUT folding: zone maps over base + pending
+            # delta rows — block pruning works mid-burst too
+            data = self.scan_view(n).col(name, 0, n)
             if padded != n:
                 # pad with the last value: never widens any block's range
                 data = np.concatenate(
@@ -572,52 +881,66 @@ class ShardStore:
             return zm
 
     # -- reads ----------------------------------------------------------
-    # Read accessors capture ``nrows`` and the column arrays under the
-    # store lock (one coherent snapshot — the fold may run inside), then
-    # hand out VIEWS: scans run lock-free on the snapshot, and a
-    # concurrent vacuum/fold replaces arrays rather than mutating
-    # absorbed ones, so captured views stay valid (the columnar answer
-    # to MVCC readers-never-block, tqual.c).
+    # Read accessors capture one coherent ScanView (reference capture
+    # under the store lock — the fold NEVER runs inside a read) and
+    # assemble base + pending delta segments lock-free: scans run on
+    # the snapshot they captured, and a concurrent vacuum/fold replaces
+    # or extends arrays rather than mutating absorbed positions, so
+    # captured views stay valid (the columnar answer to MVCC
+    # readers-never-block, tqual.c).
     def column_array(self, name: str, nrows=None) -> np.ndarray:
-        with self._delta_mu:
-            n = self.nrows if nrows is None else nrows
-            return self._cols[name][:n]
+        return self.scan_view(nrows).col(name)
 
     def column(self, name: str) -> Column:
-        with self._delta_mu:
-            n = self.nrows
-            vm = self._validity[name]
-            return Column(
-                self.schema[name],
-                self._cols[name][:n],
-                None if vm is None else vm[:n],
-                self.dictionaries.get(name),
-            )
+        v = self.scan_view()
+        return Column(
+            v.schema[name],
+            v.col(name),
+            v.validity(name),
+            self.dictionaries.get(name),
+        )
 
     def snapshot_arrays(self) -> dict[str, np.ndarray]:
         """All columns + MVCC columns as contiguous arrays (for device upload)."""
-        with self._delta_mu:
-            n = self.nrows
-            out = {name: self._cols[name][:n] for name in self.schema}
-            out["__xmin_ts"] = self.xmin_ts[:n]
-            out["__xmax_ts"] = self.xmax_ts[:n]
-            return out
+        v = self.scan_view()
+        out = {name: v.col(name) for name in v.schema}
+        out["__xmin_ts"] = v.xmin()
+        out["__xmax_ts"] = v.xmax()
+        return out
 
     def to_batch(self) -> ColumnBatch:
-        with self._delta_mu:
-            # capture-once: column lengths and batch.nrows must agree
-            # (ADVICE r4) — the lock makes the whole capture one moment
-            n = self.nrows
-            cols = {}
-            for name in self.schema:
-                vm = self._validity[name]
-                cols[name] = Column(
-                    self.schema[name],
-                    self._cols[name][:n],
-                    None if vm is None else vm[:n],
-                    self.dictionaries.get(name),
-                )
-            return ColumnBatch(cols, n)
+        # capture-once: the ScanView is one moment (schema included),
+        # so column lengths and batch.nrows agree (ADVICE r4) even
+        # under concurrent appends — and materializing never folds
+        v = self.scan_view()
+        n = v.nrows
+        cols = {}
+        for name in v.schema:
+            cols[name] = Column(
+                v.schema[name],
+                v.col(name),
+                v.validity(name),
+                self.dictionaries.get(name),
+            )
+        return ColumnBatch(cols, n)
+
+    def take_batch(self, idx) -> ColumnBatch:
+        """``to_batch().take(idx)`` without materializing whole
+        columns: a positional gather over base + delta segments — THE
+        old-row-image read for UPDATE/DELETE RETURNING and matview
+        decode, O(rows taken) even while a burst is delta-resident."""
+        v = self.scan_view()
+        idx = np.asarray(idx, dtype=np.int64)
+        cols = {
+            name: Column(
+                v.schema[name],
+                v.col_at(name, idx),
+                v.validity_at(name, idx),
+                self.dictionaries.get(name),
+            )
+            for name in v.schema
+        }
+        return ColumnBatch(cols, len(idx))
 
     # -- pinning --------------------------------------------------------
     def pin(self) -> None:
@@ -633,13 +956,12 @@ class ShardStore:
     def live_index(self, snapshot_ts: int) -> np.ndarray:
         """Positions of rows visible at ``snapshot_ts`` (the MVCC
         visibility predicate xmin <= snap < xmax) — the ONE helper for
-        host-side direct store reads (system views, matview state)."""
-        with self._delta_mu:
-            n = self.nrows
-            return np.nonzero(
-                (self.xmin_ts[:n] <= snapshot_ts)
-                & (snapshot_ts < self.xmax_ts[:n])
-            )[0]
+        host-side direct store reads (system views, matview state).
+        Non-folding: delta-resident rows answer from their batches."""
+        v = self.scan_view()
+        return np.nonzero(
+            (v.xmin() <= snapshot_ts) & (snapshot_ts < v.xmax())
+        )[0]
 
     def vacuum(self, oldest_ts: int) -> int:
         """Reclaim rows deleted before every live snapshot (shard_vacuum.c

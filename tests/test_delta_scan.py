@@ -1,0 +1,356 @@
+"""Scannable delta plane (ISSUE-15): scans iterate base + pending
+delta batches WITHOUT absorbing, on both executors — reads never
+mutate storage, compaction is a background amortizer, and the device
+cache serves ingest bursts as delta-tail uploads with coalesced MVCC
+stamp replay instead of fold + full re-upload."""
+
+import numpy as np
+import pytest
+
+from opentenbase_tpu import types as t
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.storage.column import Dictionary
+from opentenbase_tpu.storage.table import (
+    INF_TS,
+    PENDING_TS,
+    ColumnBatch,
+    ShardStore,
+)
+
+
+def _store():
+    d = Dictionary()
+    schema = {"k": t.INT8, "v": t.INT8, "w": t.TEXT}
+    st = ShardStore(schema, {"w": d})
+
+    def mk(ks, vs, ws):
+        return ColumnBatch.from_pydict(
+            {"k": ks, "v": vs, "w": ws}, schema, {"w": d}
+        )
+
+    return st, mk
+
+
+# ---------------------------------------------------------------------------
+# ScanView unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_scan_view_assembles_base_plus_deltas_without_fold():
+    st, mk = _store()
+    st.append_batch(mk([1, 2, 3], [10, 20, 30], ["a", "b", None]), 5)
+    s2, e2 = st.append_delta(mk([4, 5], [40, None], ["c", "a"]), PENDING_TS)
+    st.stamp_xmin(s2, e2, 7)
+    v = st.scan_view()
+    assert (v.nrows, v.base_rows, v.delta_rows()) == (5, 3, 2)
+    assert v.col("k").tolist() == [1, 2, 3, 4, 5]
+    assert v.col("v", 1, 5).tolist() == [20, 30, 40, 0]
+    assert v.validity("v").tolist() == [True] * 4 + [False]
+    assert v.validity("k") is None  # no mask anywhere -> None
+    assert v.xmin().tolist() == [5, 5, 5, 7, 7]
+    # padded assembly goes straight into the batch width (one copy)
+    assert v.col("k", 0, 5, pad=8).tolist() == [1, 2, 3, 4, 5, 0, 0, 0]
+    assert v.validity("w", pad=8).tolist() == (
+        [True, True, False, True, True, False, False, False]
+    )
+    # NOTHING folded; the capture alone records no evidence — readers
+    # note the rows they actually served (use-site attribution, so
+    # parallel workers / pruned subsets never over-count)
+    assert st.deltas_absorbed == 0
+    assert st.fold_reads_avoided == 0
+    st.note_delta_read(v.delta_rows())
+    st.note_delta_read(0)  # a delta-free read records nothing
+    assert st.fold_reads_avoided == 1 and st.delta_rows_read == 2
+    # fold=True (enable_delta_scan=off baseline) restores the legacy
+    # read: absorbs first
+    v2 = st.scan_view(fold=True)
+    assert st.deltas_absorbed == 1 and v2.delta_rows() == 0
+    assert v2.col("k").tolist() == [1, 2, 3, 4, 5]
+
+
+def test_stamps_address_delta_rows_in_place_and_peeks_never_fold():
+    st, mk = _store()
+    st.append_batch(mk([1, 2, 3], [1, 2, 3], ["a", "a", "a"]), 5)
+    st.append_delta(mk([4, 5], [4, 5], ["b", "b"]), 7)
+    st.stamp_xmax(np.array([1, 4]), 9)  # base row + delta row
+    assert st.deltas_absorbed == 0
+    assert st.peek_xmax_at([0, 1, 4]).tolist() == [INF_TS, 9, 9]
+    assert st.live_index(8).tolist() == [0, 1, 2, 3, 4]
+    assert st.live_index(9).tolist() == [0, 2, 3]
+    st.unstamp_xmax(np.array([4]))
+    assert st.peek_xmax_at([4]).tolist() == [INF_TS]
+    st.truncate_range(3, 5)  # abort a delta-resident prepared insert
+    assert st.live_index(100).tolist() == [0, 2]
+    assert st.peek_xmax_at([3, 4]).tolist() == [0, 0]  # dead forever
+    assert st.peek_row_id_at([3, 4]).tolist() == [3, 4]
+    assert st.deltas_absorbed == 0
+    # materialization helpers stay fold-free too
+    assert st.to_batch().nrows == 5
+    assert st.column_array("k").tolist() == [1, 2, 3, 4, 5]
+    assert len(st.snapshot_arrays()["__xmin_ts"]) == 5
+    assert st.memory_stats()[0] > 0
+    assert st.deltas_absorbed == 0
+    # fold parity: compacting afterwards changes nothing logically
+    st.compact()
+    assert st.deltas_absorbed == 1
+    assert st.live_index(100).tolist() == [0, 2]
+    assert st.peek_xmax_at([3, 4]).tolist() == [0, 0]
+
+
+def test_scan_view_is_coherent_across_concurrent_fold():
+    """A view captured before a fold stays valid: the fold writes delta
+    contents into base positions >= the captured base_rows and never
+    mutates the captured segments."""
+    st, mk = _store()
+    st.append_batch(mk([1, 2], [1, 2], ["a", "a"]), 5)
+    st.append_delta(mk([3, 4], [3, 4], ["b", "b"]), 7)
+    v = st.scan_view()
+    st.compact()  # concurrent fold
+    st.append_delta(mk([5], [5], ["c"]), 7)  # and a later append
+    assert v.col("k").tolist() == [1, 2, 3, 4]
+    assert v.xmin().tolist() == [5, 5, 7, 7]
+    assert v.nrows == 4
+
+
+# ---------------------------------------------------------------------------
+# engine-level: the read-after-write acceptance
+# ---------------------------------------------------------------------------
+
+
+def _wal(s):
+    return dict(s.query("select stat, value from pg_stat_wal"))
+
+
+def _dc(s):
+    return dict(s.query("select stat, value from pg_stat_device_cache"))
+
+
+def _fu(s):
+    return dict(s.query("select event, detail from pg_stat_fused"))
+
+
+def test_read_after_write_scan_no_fold_no_full_upload():
+    """ISSUE-15 acceptance: ingest burst -> immediate SELECT completes
+    with deltas_absorbed unchanged and no full_uploads bump; the device
+    cache tail-uploads the delta-resident rows instead."""
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table t (k bigint, v bigint) distribute by shard(k)"
+    )
+    s.execute("insert into t values " + ",".join(
+        f"({i},{i * 2})" for i in range(1100)
+    ))
+    assert s.query("select count(*) from t") == [(1100,)]  # warm cache
+    absorbed0 = _wal(s)["deltas_absorbed"]
+    full0 = _dc(s)["full_uploads"]
+    s.execute("insert into t values " + ",".join(
+        f"({2000 + i},{i})" for i in range(400)
+    ))
+    assert s.query("select count(*), sum(v) from t") == [
+        (1500, 2 * sum(range(1100)) + sum(range(400)))
+    ]
+    wal = _wal(s)
+    assert wal["deltas_absorbed"] == absorbed0  # the fold is GONE
+    assert wal["pending_delta_rows"] > 0  # rows are delta-resident
+    assert _dc(s)["full_uploads"] == full0  # no rebuild either
+    fu = _fu(s)
+    assert int(fu["delta_tail_uploads"]) >= 1
+    assert int(fu["delta_tail_rows"]) >= 400
+    assert int(fu["fold_on_read_avoided"]) >= 1
+    c.close()
+
+
+def test_update_delete_target_delta_rows_and_device_replays_stamps():
+    """UPDATE/DELETE address delta rows by global positions; the commit
+    stamps ride the mvcc_seq replay log onto the device planes — no
+    fold, no full re-upload, host == device."""
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table t (k bigint, v bigint) distribute by shard(k)"
+    )
+    s.execute("insert into t values " + ",".join(
+        f"({i},{i})" for i in range(1000)
+    ))
+    s.query("select count(*) from t")  # warm
+    absorbed0 = _wal(s)["deltas_absorbed"]
+    full0 = _dc(s)["full_uploads"]
+    s.execute("insert into t values " + ",".join(
+        f"({2000 + i},{i})" for i in range(200)
+    ))
+    s.execute("update t set v = v + 1000 where k >= 2000 and k < 2010")
+    s.execute("delete from t where k >= 2190")
+    s.execute("set enable_fused_execution = on")
+    fused_rows = sorted(s.query("select k, v from t where k >= 2000"))
+    s.execute("set enable_fused_execution = off")
+    host_rows = sorted(s.query("select k, v from t where k >= 2000"))
+    assert fused_rows == host_rows and len(fused_rows) == 190
+    assert fused_rows[5] == (2005, 1005)
+    wal = _wal(s)
+    assert wal["deltas_absorbed"] == absorbed0
+    assert wal["pending_delta_rows"] > 0
+    assert _dc(s)["full_uploads"] == full0
+    c.close()
+
+
+def test_stamp_burst_replays_coalesced_not_full_plane():
+    """Satellite fix: a >8-entry stamp burst between scans used to
+    re-upload whole MVCC planes; it now coalesces into per-plane
+    scatters sized by rows touched. Observable: correctness + the
+    mvcc_replays counter moves while full_uploads stays flat."""
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table t (k bigint, v bigint) distribute by shard(k)"
+    )
+    s.execute("insert into t values " + ",".join(
+        f"({i},{i})" for i in range(2000)
+    ))
+    c.compact_deltas()
+    s.query("select count(*) from t")  # warm, all-base
+    full0 = _dc(s)["full_uploads"]
+    replays0 = _dc(s)["mvcc_replays"]
+    # 12 single-row DELETEs = 12+ log entries per touched shard
+    for k in range(0, 24, 2):
+        s.execute(f"delete from t where k = {k}")
+    assert s.query("select count(*) from t") == [(1988,)]
+    s.execute("set enable_fused_execution = off")
+    assert s.query("select count(*) from t") == [(1988,)]
+    s.execute("set enable_fused_execution = on")
+    dc = _dc(s)
+    assert dc["full_uploads"] == full0
+    assert dc["mvcc_replays"] > replays0
+    c.close()
+
+
+def test_ingest_burst_longer_than_log_cap_stays_tail_only():
+    """An ingest burst of more statements than the MVCC log cap trims
+    the log — but every trimmed stamp landed in the freshly-uploaded
+    tail, so the refresh stays O(tail), full_uploads flat, and the
+    synced-prefix refresh covers the rest soundly."""
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table t (k bigint, v bigint) distribute by shard(k)"
+    )
+    s.execute("insert into t values " + ",".join(
+        f"({i},{i})" for i in range(1000)
+    ))
+    s.query("select count(*) from t")  # warm
+    full0 = _dc(s)["full_uploads"]
+    for i in range(80):  # > _MVCC_LOG_CAP (64) statements
+        s.execute(f"insert into t values ({3000 + i}, {i})")
+    assert s.query("select count(*), sum(v) from t") == [
+        (1080, sum(range(1000)) + sum(range(80)))
+    ]
+    assert _dc(s)["full_uploads"] == full0
+    assert _wal(s)["pending_delta_rows"] > 0
+    c.close()
+
+
+def test_explain_analyze_shows_delta_resident_rows():
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table t (k bigint, v bigint) distribute by shard(k)"
+    )
+    s.execute("insert into t values " + ",".join(
+        f"({i},{i})" for i in range(50)
+    ))
+    s.execute("set enable_fused_execution = off")
+    lines = [r[0] for r in s.query(
+        "explain analyze select count(*) from t where v >= 0"
+    )]
+    scan = [ln for ln in lines if "delta-resident:" in ln]
+    assert scan, lines
+    assert "Scan t" in scan[0]
+    # after compaction the annotation disappears (nothing delta-resident)
+    c.compact_deltas()
+    lines = [r[0] for r in s.query(
+        "explain analyze select count(*) from t where v >= 0"
+    )]
+    assert not any("delta-resident:" in ln for ln in lines), lines
+    c.close()
+
+
+def test_enable_delta_scan_off_restores_fold_on_read():
+    """The GUC baseline: scans fold again (host + device cache), so
+    the bench differential runs both behaviors on one binary."""
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    c.conf_gucs["enable_delta_scan"] = False
+    s = c.session()
+    s.execute(
+        "create table t (k bigint, v bigint) distribute by shard(k)"
+    )
+    s.execute("insert into t values " + ",".join(
+        f"({i},{i})" for i in range(300)
+    ))
+    assert s.query("select count(*) from t") == [(300,)]
+    wal = _wal(s)
+    assert wal["pending_delta_rows"] == 0  # the read folded
+    assert wal["deltas_absorbed"] > 0
+    c.close()
+
+
+def test_delta_scan_faults_fire_and_self_heal():
+    """The two new FAULT sites: storage/delta_scan errors a host scan
+    honestly; fused/delta_tail_upload errors the refresh and the
+    statement demotes to the host path (fused is an optimization) —
+    both leave the store/cache coherent for the clean rerun."""
+    from opentenbase_tpu import fault
+
+    c = Cluster(num_datanodes=2, shard_groups=16)
+    s = c.session()
+    s.execute(
+        "create table t (k bigint, v bigint) distribute by shard(k)"
+    )
+    s.execute("insert into t values (1, 1), (2, 2)")
+    s.query("select count(*) from t")  # warm
+    s.execute("insert into t values (3, 3)")
+    try:
+        fault.inject("fused/delta_tail_upload", "error", "once")
+        # refresh dies -> demoted to host, answer still right
+        assert s.query("select count(*) from t") == [(3,)]
+        fired = {
+            row[0]: row[5] for row in fault.stats()
+        }
+        assert fired.get("fused/delta_tail_upload", 0) >= 1, fired
+        s.execute("set enable_fused_execution = off")
+        fault.inject("storage/delta_scan", "error", "once")
+        s.execute("insert into t values (4, 4)")
+        with pytest.raises(Exception):
+            s.query("select count(*) from t")
+    finally:
+        fault.clear()
+    assert s.query("select count(*) from t") == [(4,)]  # clean rerun
+    s.execute("set enable_fused_execution = on")
+    assert s.query("select count(*) from t") == [(4,)]
+    c.close()
+
+
+def test_crash_with_unfolded_deltas_recovers_identically(tmp_path):
+    """Checkpoint + recovery with rows STILL delta-resident: the
+    checkpoint snapshots through the view (no fold), recovery rebuilds
+    the same logical table."""
+    import shutil
+
+    d = str(tmp_path / "cn")
+    c = Cluster(num_datanodes=2, shard_groups=16, data_dir=d)
+    s = c.session()
+    s.execute(
+        "create table t (k bigint, v bigint) distribute by shard(k)"
+    )
+    s.execute("insert into t values " + ",".join(
+        f"({i},{i * 3})" for i in range(500)
+    ))
+    s.execute("delete from t where k % 50 = 0")
+    c.persistence.checkpoint()
+    want = sorted(s.query("select k, v from t"))
+    assert _wal(s)["pending_delta_rows"] > 0  # checkpoint didn't fold
+    crash = str(tmp_path / "crash")
+    shutil.copytree(d, crash)
+    c.close()
+    r = Cluster.recover(crash, num_datanodes=2, shard_groups=16)
+    assert sorted(r.session().query("select k, v from t")) == want
+    r.close()

@@ -20,7 +20,7 @@
 #      2-DN sharded join must print per-node rows, and a traced query
 #      must export parseable Chrome-trace JSON;
 #   7. matview / chaos / HA-chaos-schedule / telemetry /
-#      join-mode+perf-gate smokes;
+#      join-mode+perf-gate / delta-plane-HTAP / serving smokes;
 #   8. the full ROADMAP tier-1 pytest command, verbatim (1500 s cap).
 #
 # Usage: tools/tier1.sh   (from anywhere; cd's to the repo root)
@@ -498,6 +498,67 @@ dem = dict(green); dem["tunnel_down"] = True
 assert any("demotion" in v for v in bench_gate.check_record(dem, doc))
 print("join smoke OK: radix == sortmerge (fused+host), EXPLAIN shows "
       "mode, floors validate, gate fails violation+demotion")
+PY
+
+echo "== tier1: delta-plane HTAP smoke =="
+timeout -k 10 180 python - <<'PY' || exit 1
+# Scannable delta plane (ISSUE-15): an ingest burst followed by an
+# immediate SELECT must complete WITHOUT folding (pg_stat_wal
+# deltas_absorbed unchanged), without a device-cache rebuild
+# (full_uploads flat), with the appended rows tail-uploaded straight
+# from delta batches (pg_stat_fused delta_tail_uploads moved), EXPLAIN
+# ANALYZE must show the delta-resident rows on the host path, and the
+# checked-in HTAP floors must schema-validate with platform any.
+from opentenbase_tpu import bench_gate
+from opentenbase_tpu.engine import Cluster
+
+c = Cluster(num_datanodes=2, shard_groups=16)
+s = c.session()
+s.execute("create table dp (k bigint, v bigint) distribute by shard(k)")
+s.execute("insert into dp values " + ",".join(
+    f"({i},{i * 2})" for i in range(1100)))
+assert s.query("select count(*) from dp") == [(1100,)]  # warm the cache
+wal0 = dict(s.query("select stat, value from pg_stat_wal"))
+dc0 = dict(s.query("select stat, value from pg_stat_device_cache"))
+# the burst -> immediate scan (read-after-write)
+s.execute("insert into dp values " + ",".join(
+    f"({2000 + i},{i})" for i in range(400)))
+assert s.query("select count(*), sum(v) from dp") == [
+    (1500, 2 * sum(range(1100)) + sum(range(400)))
+]
+wal = dict(s.query("select stat, value from pg_stat_wal"))
+dc = dict(s.query("select stat, value from pg_stat_device_cache"))
+fu = dict(s.query("select event, detail from pg_stat_fused"))
+assert wal["deltas_absorbed"] == wal0["deltas_absorbed"], \
+    (wal["deltas_absorbed"], wal0["deltas_absorbed"])  # fold is GONE
+assert wal["pending_delta_rows"] > 0, wal
+assert dc["full_uploads"] == dc0["full_uploads"], (dc0, dc)
+assert int(fu["delta_tail_uploads"]) >= 1, fu
+assert int(fu["fold_on_read_avoided"]) >= 1, fu
+# EXPLAIN ANALYZE scan rows show the delta-resident count (host path)
+s.execute("set enable_fused_execution = off")
+lines = [r[0] for r in s.query(
+    "explain analyze select count(*) from dp where v >= 0")]
+assert any("delta-resident:" in ln for ln in lines), lines[:6]
+# UPDATE/DELETE target delta rows without folding; fused == host
+s.execute("set enable_fused_execution = on")
+s.execute("update dp set v = v + 1 where k >= 2000 and k < 2010")
+s.execute("delete from dp where k = 2399")
+fused = sorted(s.query("select k, v from dp where k >= 2000"))
+s.execute("set enable_fused_execution = off")
+host = sorted(s.query("select k, v from dp where k >= 2000"))
+assert fused == host and len(fused) == 399
+wal2 = dict(s.query("select stat, value from pg_stat_wal"))
+assert wal2["deltas_absorbed"] == wal0["deltas_absorbed"], wal2
+# HTAP floors: present, platform any, schema-valid (load_floors raises)
+doc = bench_gate.load_floors()
+for m in ("htap_rows_per_sec", "htap_fold_avoided", "htap_speedup"):
+    assert m in doc["floors"], m
+    assert doc["floors"][m]["platform"] == "any", m
+c.close()
+print("delta-plane smoke OK: burst -> scan with zero folds, tail "
+      f"uploads={fu['delta_tail_uploads']}, EXPLAIN shows "
+      "delta-resident rows, htap floors validate")
 PY
 
 echo "== tier1: serving-plane smoke =="
