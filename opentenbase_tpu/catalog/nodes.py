@@ -38,10 +38,13 @@ class NodeDef:
 @dataclass
 class NodeGroup:
     """A named subset of datanodes (pgxc_group). Default group holds all
-    datanodes; cold/hot routing uses two groups."""
+    datanodes; cold/hot routing uses two groups: tables placed in a
+    ``cold`` group resolve their node set to the group's members only,
+    so cold scans never land a fragment on hot-set nodes."""
 
     name: str
     members: list[str] = field(default_factory=list)
+    kind: str = "hot"  # hot | cold (pgxc_group's dual-group routing)
 
 
 class NodeManager:
@@ -99,11 +102,15 @@ class NodeManager:
         for k, v in kwargs.items():
             setattr(node, k, v)
 
-    def create_group(self, name: str, members: list[str]) -> None:
+    def create_group(
+        self, name: str, members: list[str], kind: str = "hot"
+    ) -> None:
+        if kind not in ("hot", "cold"):
+            raise ValueError(f"unknown node group kind {kind!r}")
         for m in members:
             if self.get(m).role != NodeRole.DATANODE:
                 raise ValueError(f"group member {m!r} is not a datanode")
-        self._groups[name] = NodeGroup(name, list(members))
+        self._groups[name] = NodeGroup(name, list(members), kind)
 
     def drop_group(self, name: str) -> None:
         if name not in self._groups:
@@ -137,10 +144,25 @@ class NodeManager:
         return len(self._dn_order)
 
     def datanode_indices(self, group: str | None = None) -> list[int]:
-        """Mesh indices of datanodes in a group (default: all)."""
+        """Mesh indices of datanodes in a group (default: all). Mesh
+        indices, not positions: after a REMOVE NODE the index space has
+        holes, and a table created then must bind the live indices."""
         if group is None:
-            return list(range(len(self._dn_order)))
+            return [self._nodes[n].mesh_index for n in self._dn_order]
         return [self.get(m).mesh_index for m in self.group(group).members]
+
+    def all_groups(self) -> list[NodeGroup]:
+        return list(self._groups.values())
+
+    def group_of_index(self, mesh_index: int) -> NodeGroup | None:
+        """First group containing the datanode at ``mesh_index`` (the
+        EXPLAIN routing label; None = only implicit default group)."""
+        for g in self._groups.values():
+            for m in g.members:
+                nd = self._nodes.get(m)
+                if nd is not None and nd.mesh_index == mesh_index:
+                    return g
+        return None
 
     def all_nodes(self) -> list[NodeDef]:
         return list(self._nodes.values())

@@ -52,12 +52,23 @@ class ShardMap:
 
     def move_shard(self, shard_id: int, to_node: int) -> int:
         """Repoint one shard group; returns the previous owner. The actual
-        data movement is driven by the rebalancer (ddl MOVE DATA), which
-        copies rows then calls this to flip ownership."""
+        data movement is driven by the rebalancer (rebalance/), which
+        copies rows then calls this to flip ownership. In-memory only:
+        durability is the caller's job — the rebalancer's flip journal
+        record carries the post-flip map, so recovery and standbys
+        rebuild it (WAL redo lands in ``apply_replayed_map``)."""
         prev = int(self.map[shard_id])
         self.map[shard_id] = to_node
         self.version += 1
         return prev
+
+    def apply_replayed_map(self, map_list) -> None:
+        """WAL-redo entry for a durable shard-map mutation ('shardmap' /
+        'rebalance_flip' D-records): install the logged map and advance
+        ``version`` so standbys invalidate routing caches exactly like
+        the primary did at flip time."""
+        self.map = np.asarray(map_list, dtype=np.int32)
+        self.version += 1
 
     def add_node_rebalance_plan(self, new_node: int, node_indices: list[int]) -> list[int]:
         """Pick shard groups to hand to a new datanode so groups are level.
@@ -80,6 +91,25 @@ class ShardMap:
     # -- stats ----------------------------------------------------------
     def record_rows(self, shard_ids: np.ndarray) -> None:
         np.add.at(self.row_stats, shard_ids, 1)
+
+    def bytes_per_shard(self, avg_row_bytes: float) -> np.ndarray:
+        """Per-shard byte weights from ``row_stats`` — the rebalance
+        planner's load signal (balance bytes, not shard counts). Shards
+        with no recorded rows weigh one row so an empty cluster still
+        levels by count."""
+        rows = self.row_stats.astype(np.float64)
+        rows = np.maximum(rows, 1.0)
+        return rows * max(float(avg_row_bytes), 1.0)
+
+    def node_bytes(self, avg_row_bytes: float) -> dict[int, float]:
+        """Total byte weight per owning datanode (pg_stat_rebalance's
+        balance verdict + the planner's donor ordering)."""
+        w = self.bytes_per_shard(avg_row_bytes)
+        out: dict[int, float] = {}
+        for n in np.unique(self.map):
+            if int(n) >= 0:
+                out[int(n)] = float(w[self.map == n].sum())
+        return out
 
 
 def shard_hash_for_column(data: np.ndarray) -> np.ndarray:

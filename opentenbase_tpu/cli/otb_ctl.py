@@ -195,6 +195,57 @@ def cmd_promote(cfg: dict, name: str) -> None:
     raise SystemExit(f"no standby named {name!r} in config")
 
 
+def _sql(cfg: dict):
+    """SQL session to the running coordinator (elastic-cluster verbs
+    are online DDL, so they go through the front door, not the pid)."""
+    from opentenbase_tpu.net.client import connect_tcp
+
+    co = cfg["coordinator"]
+    return connect_tcp(port=int(co["port"]))
+
+
+def cmd_add_node(cfg: dict, name: str) -> None:
+    with _sql(cfg) as s:
+        s.execute(f"ALTER CLUSTER ADD NODE {name} WAIT")
+        state, moves, rows = s.query("SELECT pg_rebalance_wait()")[0]
+        print(
+            f"{name}: joined ({state}; {moves} moves, "
+            f"{rows} rows rebalanced)"
+        )
+
+
+def cmd_remove_node(cfg: dict, name: str) -> None:
+    with _sql(cfg) as s:
+        s.execute(f"ALTER CLUSTER REMOVE NODE {name} WAIT")
+        state, moves, rows = s.query("SELECT pg_rebalance_wait()")[0]
+        print(
+            f"{name}: drained and detached ({state}; {moves} moves, "
+            f"{rows} rows rebalanced)"
+        )
+
+
+def cmd_rebalance_status(cfg: dict) -> None:
+    with _sql(cfg) as s:
+        rows = s.query(
+            "SELECT rbid, kind, src, dst, phase, rows_copied, "
+            "bytes_per_sec, barrier_wait_ms, error "
+            "FROM pg_stat_rebalance"
+        )
+        if not rows:
+            print("no rebalance activity")
+            return
+        for r in rows:
+            rbid, kind, src, dst, phase, nrows, bps, bar, err = r
+            line = (
+                f"{rbid} {kind} dn{src}->dn{dst} {phase}: "
+                f"{nrows} rows, {float(bps):.0f} B/s, "
+                f"barrier {float(bar):.1f} ms"
+            )
+            if err:
+                line += f" ERROR: {err}"
+            print(line)
+
+
 def cmd_stop(cfg: dict) -> None:
     targets = [("coordinator", cfg["coordinator"])] + [
         (sb["name"], sb) for sb in cfg.get("standbys", [])
@@ -220,7 +271,10 @@ def cmd_stop(cfg: dict) -> None:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("verb", choices=["init", "start", "stop", "status", "promote"])
+    ap.add_argument("verb", choices=[
+        "init", "start", "stop", "status", "promote",
+        "add-node", "remove-node", "rebalance-status",
+    ])
     ap.add_argument("config")
     ap.add_argument("target", nargs="?")
     args = ap.parse_args(argv)
@@ -236,6 +290,16 @@ def main(argv=None) -> int:
         if not args.target:
             ap.error("promote needs a standby name")
         cmd_promote(cfg, args.target)
+    elif args.verb == "add-node":
+        if not args.target:
+            ap.error("add-node needs a node name")
+        cmd_add_node(cfg, args.target)
+    elif args.verb == "remove-node":
+        if not args.target:
+            ap.error("remove-node needs a node name")
+        cmd_remove_node(cfg, args.target)
+    elif args.verb == "rebalance-status":
+        cmd_rebalance_status(cfg)
     elif args.verb == "stop":
         cmd_stop(cfg)
     return 0

@@ -252,6 +252,11 @@ GUCS: dict = {
     # flat >8-entry MVCC full-plane cutoff) — the HTAP bench baseline
     # on the same binary, and an operator escape hatch.
     "enable_delta_scan": (_bool, True),
+    # Elastic rebalance copy throttle (bytes/s of shard-move traffic a
+    # background ADD/REMOVE NODE may stream; <= 0 = unthrottled). Read
+    # by rebalance/service.py between copy chunks so a rebalance never
+    # starves foreground traffic of ingest bandwidth.
+    "rebalance_rate_limit": (_int, 64 << 20),
     "autovacuum": (_bool, False),
     "autovacuum_naptime_s": (_int, 60),
     "autovacuum_scale_factor_pct": (_int, 20),

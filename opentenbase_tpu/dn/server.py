@@ -429,6 +429,10 @@ class DNServer:
             }
         if op == "exec_fragment":
             return self._exec_fragment(msg)
+        if op == "rebalance_apply":
+            return self._rebalance_apply(msg)
+        if op == "rebalance_finalize":
+            return self._rebalance_finalize(msg)
         if op == "2pc_prepare":
             return self._twophase_prepare(msg)
         if op == "2pc_commit":
@@ -447,6 +451,50 @@ class DNServer:
                 "entries": entries,
             }
         return {"error": f"unknown op {op}"}
+
+    # -- shard-rebalance participant (rebalance/ real-topology path) ------
+    # The coordinator-local rebalancer copies between in-process stores;
+    # with attached DNs the same two steps ship over the channel instead:
+    # rebalance_apply lands a copy chunk's rows with xmin = PENDING_TS
+    # (invisible — the PgxcMoveData bulk-load half), rebalance_finalize
+    # stamps a landed range visible at the flip timestamp. Both are
+    # idempotent against the WAL stream: the stream's 'T'/flip records
+    # re-derive the same state, and direct-applied ranges are reported
+    # back so the coordinator journals exactly what landed here.
+
+    def _rebalance_apply(self, msg: dict) -> dict:
+        from opentenbase_tpu.plan import serde
+        from opentenbase_tpu.storage.table import PENDING_TS, ShardStore
+
+        c = self.standby.cluster
+        with c._exec_lock:
+            node = int(msg["node"])
+            tname = str(msg["table"])
+            try:
+                meta = c.catalog.get(tname)
+            except ValueError as e:
+                return {"error": str(e)}
+            batch = serde.batch_from_wire(msg["batch"], c.catalog)
+            store = c.stores.setdefault(node, {}).setdefault(
+                tname, ShardStore(meta.schema, meta.dictionaries)
+            )
+            s, e = store.append_delta(batch, PENDING_TS)
+            self._bump("rebalance_chunks")
+        return {"ok": True, "start": int(s), "end": int(e)}
+
+    def _rebalance_finalize(self, msg: dict) -> dict:
+        c = self.standby.cluster
+        with c._exec_lock:
+            node = int(msg["node"])
+            tname = str(msg["table"])
+            store = c.stores.get(node, {}).get(tname)
+            if store is None:
+                return {"error": f"no store for dn{node}.{tname}"}
+            store.stamp_xmin(
+                int(msg["start"]), int(msg["end"]),
+                int(msg["commit_ts"]),
+            )
+        return {"ok": True}
 
     # -- two-phase commit participant -------------------------------------
     # The reference's datanodes vote in the coordinator's implicit 2PC

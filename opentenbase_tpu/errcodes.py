@@ -68,6 +68,10 @@ ERRCODES: dict[str, str] = {
     # receiver's: the caller is a fenced ex-primary that missed a
     # promotion and must demote + resync instead of retrying.
     "72000": "stale_node_generation",
+    # Raised when a cached/in-flight plan targets a datanode that
+    # REMOVE NODE dropped: the catalog epoch has already advanced, so
+    # a plain retry replans on the live topology.
+    "72001": "stale_topology",
     # class XX — internal error
     "XX000": "internal_error",
 }

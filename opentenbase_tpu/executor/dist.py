@@ -44,6 +44,18 @@ class StatementTimeout(RuntimeError):
     sqlstate = "57014"
 
 
+class StaleTopology(RuntimeError):
+    """A fragment targets a node index that no longer exists — a plan
+    built (or cached) before ALTER CLUSTER REMOVE NODE detached it.
+    Deliberately NOT an empty scan: serving zero rows for a node that
+    held data would be silent wrong answers. The engine converts it to
+    a retryable SQLError; a replan resolves against the new topology
+    (the catalog epoch already advanced, so the cache won't re-serve
+    the stale plan)."""
+
+    sqlstate = "72001"
+
+
 def _scan_tables(plan) -> set:
     """Base tables a plan fragment reads (recursive over all children)."""
     out: set = set()
@@ -280,6 +292,11 @@ class DistExecutor:
     def _stores(self, node: int) -> dict:
         if node == COORDINATOR:
             return {}
+        if node not in self.node_stores:
+            raise StaleTopology(
+                f"plan targets datanode index {node}, which has been "
+                "removed from the cluster; retry the statement"
+            )
         return self.node_stores.get(node, {})
 
     def run(self, dplan: DistributedPlan) -> ColumnBatch:

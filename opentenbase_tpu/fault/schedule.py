@@ -572,6 +572,282 @@ def _verify(schedule, topo, mon, traffic, crash_wall,
             }
 
 
+# ---------------------------------------------------------------------------
+# Elastic-rebalance chaos (rebalance/): kill the coordinator mid-move
+# ---------------------------------------------------------------------------
+
+def _moving_snapshot(cluster) -> set:
+    """Lock-free copy of the barrier's in-move shard set; retried
+    because the mover can mutate the set mid-iteration."""
+    for _ in range(8):
+        try:
+            return set(cluster.shard_barrier._active)
+        except RuntimeError:
+            continue
+    return set(cluster.shard_barrier._active)
+
+
+class _RebalanceTraffic:
+    """Embedded-session read/write traffic against one coordinator while
+    a rebalance runs. Every write is a unique (client, seq) row; every
+    failure is recorded WITH the barrier state and the statement's shard
+    id at failure time, so the verdict can tell an excused wait-timeout
+    on a moving shard from a forbidden failure on a non-moving one."""
+
+    def __init__(self, cluster, seed: int, writers: int = 2,
+                 readers: int = 1):
+        self.cluster = cluster
+        self.seed = seed
+        self.writers = writers
+        self.readers = readers
+        self.stop_evt = threading.Event()
+        self.acked: set = set()            # (client, seq)
+        self.failures: list = []           # {client, seq, shard, moving,
+        #                                     error}
+        self.reads_ok = 0
+        self._mu = threading.Lock()
+        self.threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        for w in range(self.writers):
+            t = threading.Thread(
+                target=self._writer, args=(w,), daemon=True
+            )
+            t.start()
+            self.threads.append(t)
+        for r in range(self.readers):
+            t = threading.Thread(
+                target=self._reader, args=(r,), daemon=True
+            )
+            t.start()
+            self.threads.append(t)
+
+    def stop(self) -> None:
+        self.stop_evt.set()
+        for t in self.threads:
+            t.join(timeout=30)
+
+    def _shard_of(self, k: int):
+        try:
+            loc = self.cluster.catalog.get("rb_t").locator
+            return loc.shard_id_by_key_equal({"k": k})
+        except Exception:
+            return None
+
+    def _writer(self, cid: int) -> None:
+        rng = random.Random(self.seed * 1000 + cid)
+        s = self.cluster.session()
+        seq = 0
+        while not self.stop_evt.is_set():
+            seq += 1
+            k = cid * 1_000_000 + seq
+            moving = _moving_snapshot(self.cluster)
+            try:
+                s.execute(
+                    f"insert into rb_t values ({k}, {cid}, {seq})"
+                )
+                with self._mu:
+                    self.acked.add((cid, seq))
+            except Exception as e:
+                # union of the barrier set before and after the
+                # statement: a barrier-induced failure is excusable
+                # whenever the barrier was up at either edge
+                moving |= _moving_snapshot(self.cluster)
+                with self._mu:
+                    self.failures.append({
+                        "client": cid, "seq": seq,
+                        "shard": self._shard_of(k),
+                        "moving": sorted(moving),
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+            self.stop_evt.wait(0.002 + rng.random() * 0.004)
+
+    def _reader(self, rid: int) -> None:
+        rng = random.Random(self.seed * 2000 + rid)
+        s = self.cluster.session()
+        while not self.stop_evt.is_set():
+            cid = rng.randrange(self.writers)
+            moving = _moving_snapshot(self.cluster)
+            try:
+                s.query(
+                    f"select max(seq) from rb_t where client = {cid}"
+                )
+                with self._mu:
+                    self.reads_ok += 1
+            except Exception as e:
+                moving |= _moving_snapshot(self.cluster)
+                with self._mu:
+                    self.failures.append({
+                        "client": -1, "seq": -1, "shard": None,
+                        "moving": sorted(moving),
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+            self.stop_evt.wait(0.005 + rng.random() * 0.01)
+
+
+def run_rebalance_schedule(
+    seed: int,
+    workdir: str,
+    kill_phase: str = "copying",
+    keep: bool = False,
+) -> dict:
+    """One seeded elastic-rebalance crash schedule: seeded traffic over
+    a 2-node cluster, ``ALTER CLUSTER ADD NODE`` in the background, the
+    coordinator "killed" mid-move (``kill_phase``: ``copying`` arms
+    ``rebalance/copy``, ``flip`` arms ``rebalance/flip``, ``journal``
+    arms ``rebalance/journal`` — each FaultError leaves the journal
+    exactly as a dead coordinator would), then ``Cluster.recover`` +
+    resume. Invariants:
+
+    1. zero lost acked writes across the crash + resume;
+    2. zero duplicate rows (a re-copied chunk must not double-land);
+    3. zero failed statements on NON-moving shards (a failure is
+       excused only if the barrier was up and the statement's shard was
+       in — or unprovably outside — the moving set);
+    4. the resumed map completes the journaled plan exactly
+       (``map[sid] == dst`` for every journaled move);
+    5. fused == host result parity after resume.
+    """
+    from opentenbase_tpu.engine import Cluster
+
+    os.makedirs(workdir, exist_ok=True)
+    site = {
+        "copying": "rebalance/copy",
+        "flip": "rebalance/flip",
+        "journal": "rebalance/journal",
+    }[kill_phase]
+    verdict: dict = {
+        "seed": seed, "kill_phase": kill_phase, "violations": [],
+    }
+    bad = verdict["violations"]
+    rng = random.Random(seed)
+    traffic = None
+    try:
+        c = Cluster(num_datanodes=2, shard_groups=32, data_dir=workdir)
+        boot = c.session()
+        boot.execute(
+            "create table rb_t (k bigint, client bigint, seq bigint)"
+            " distribute by shard(k)"
+        )
+        # seed data so the planner has bytes to move
+        vals = ",".join(
+            f"({9_000_000 + i}, 99, {i})" for i in range(2000)
+        )
+        boot.execute(f"insert into rb_t values {vals}")
+        pre_seed = {(99, i) for i in range(2000)}
+        traffic = _RebalanceTraffic(c, seed)
+        traffic.start()
+        time.sleep(0.3)  # let traffic establish before the move
+        # the kill: fires on the n-th copy chunk (copying/journal) or
+        # the first flip; the service treats FaultError as a simulated
+        # coordinator crash — no cleanup, journal left mid-move. Chunk
+        # count per run is small (each wave's initial copy is one
+        # sub-CHUNK_ROWS chunk), so n is capped at 1: both waves'
+        # initial copies are guaranteed hits, deeper skips may starve.
+        spec = (
+            "once" if kill_phase == "flip"
+            else f"after({rng.randint(0, 1)})"
+        )
+        _fault.inject(site, "error", spec)
+        boot.execute("alter cluster add node dn_new")
+        if not c.rebalance.wait(60):
+            bad.append({"invariant": "harness",
+                        "error": "rebalance never stopped"})
+        _fault.clear(site)
+        crashed = any(
+            st.phase == "crashed" for st in c.rebalance.status_rows()
+        )
+        verdict["crashed_mid_move"] = crashed
+        if not crashed:
+            bad.append({
+                "invariant": "harness",
+                "error": f"fault at {site} never fired "
+                "(move completed uninterrupted)",
+            })
+        time.sleep(0.2)  # post-crash traffic against the dead move
+        traffic.stop()
+        journaled = {
+            rbid: dict(rec)
+            for rbid, rec in c.rebalance._journaled.items()
+        }
+        # abandon `c` (the simulated dead coordinator) and recover
+        r = Cluster.recover(workdir, num_datanodes=2, shard_groups=32)
+        rs = r.session()
+        state = rs.query("select pg_rebalance_wait()")[0][0]
+        verdict["resume_state"] = state
+        if state != "idle":
+            bad.append({"invariant": "resume",
+                        "error": f"resume finished {state!r}"})
+        # 1+2: every acked write present exactly once
+        rows = rs.query("select client, seq from rb_t")
+        seen: dict = {}
+        for cid, sq in rows:
+            seen[(cid, sq)] = seen.get((cid, sq), 0) + 1
+        expected = traffic.acked | pre_seed
+        lost = [key for key in expected if key not in seen]
+        dups = [key for key, n in seen.items() if n > 1]
+        verdict["acked_writes"] = len(traffic.acked)
+        verdict["lost_acked_writes"] = len(lost)
+        if lost:
+            bad.append({"invariant": "zero_lost_acked_writes",
+                        "rows": sorted(lost)[:10], "count": len(lost)})
+        if dups:
+            bad.append({"invariant": "no_duplicates",
+                        "rows": sorted(dups)[:10], "count": len(dups)})
+        # 3: failures only excusable on moving shards under the barrier
+        unexcused = [
+            f for f in traffic.failures
+            if not (f["moving"] and (
+                f["shard"] is None or f["shard"] in f["moving"]
+            ))
+        ]
+        verdict["failed_statements"] = len(traffic.failures)
+        if unexcused:
+            bad.append({
+                "invariant": "zero_failed_on_nonmoving_shards",
+                "cases": unexcused[:10], "count": len(unexcused),
+            })
+        if traffic.reads_ok == 0 or not traffic.acked:
+            bad.append({"invariant": "liveness",
+                        "error": "traffic never made progress"})
+        # 4: the journaled plan completed exactly
+        for rbid, rec in journaled.items():
+            for sid, (_src, dst) in rec["moves"].items():
+                if int(r.shardmap.map[int(sid)]) != int(dst):
+                    bad.append({
+                        "invariant": "plan_completed",
+                        "rbid": rbid, "shard": int(sid),
+                        "owner": int(r.shardmap.map[int(sid)]),
+                        "planned_dst": int(dst),
+                    })
+        # 5: fused == host parity on the resumed cluster
+        q = ("select client, count(*), sum(seq), max(seq) from rb_t "
+             "group by client order by client")
+        rs.execute("set enable_fused_execution = off")
+        host_rows = rs.query(q)
+        rs.execute("set enable_fused_execution = on")
+        fused_rows = rs.query(q)
+        if host_rows != fused_rows:
+            bad.append({"invariant": "fused_host_parity",
+                        "host": host_rows[:5], "fused": fused_rows[:5]})
+        verdict["final_rows"] = len(rows)
+    except Exception as e:  # harness failure IS a failed run
+        bad.append({
+            "invariant": "harness",
+            "error": f"{type(e).__name__}: {e}",
+        })
+    finally:
+        _fault.clear()
+        if traffic is not None and not traffic.stop_evt.is_set():
+            traffic.stop()
+        if not keep:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+    verdict["chaos_gate"] = "ok" if not verdict["violations"] else "fail"
+    return verdict
+
+
 def run_schedules(
     base_seed: int,
     count: int,

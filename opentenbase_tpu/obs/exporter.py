@@ -156,6 +156,29 @@ def render_cluster_metrics(cluster) -> str:
     for k, v in dml:
         out.append(_line("otb_dml_commits_total", {"mode": k}, int(v)))
 
+    # elastic-cluster rebalancer (rebalance/): move/row counters plus a
+    # liveness gauge — an operator watches ADD NODE progress from a
+    # scrape, not a SQL session
+    rb = getattr(cluster, "rebalance", None)
+    if rb is not None:
+        _head(out, "otb_rebalance_moves_total", "counter",
+              "Shard-group move waves completed by the rebalancer")
+        out.append(_line(
+            "otb_rebalance_moves_total", {},
+            int(rb.counters.get("moves_total", 0)),
+        ))
+        _head(out, "otb_rebalance_rows_copied_total", "counter",
+              "Rows copied between nodes by the rebalancer")
+        out.append(_line(
+            "otb_rebalance_rows_copied_total", {},
+            int(rb.counters.get("rows_copied_total", 0)),
+        ))
+        _head(out, "otb_rebalance_active", "gauge",
+              "1 while a rebalance operation is in flight")
+        out.append(_line(
+            "otb_rebalance_active", {}, 1 if rb.active else 0,
+        ))
+
     # fragment self-healing counters (cluster-lifetime accumulators:
     # per-session counts die with the session, and a counter that drops
     # on disconnect would read as a reset to Prometheus)

@@ -1,0 +1,42 @@
+"""Elastic cluster rebalancing (MOVE DATA / PgxcMoveData_* equivalent).
+
+Coordinator-owned background subsystem that makes ``ALTER CLUSTER ADD
+NODE`` / ``REMOVE NODE`` online: plan minimal-motion shard reassignment
+from ``row_stats`` (balance bytes, not shard counts), drive per-shard-
+group moves through a crash-safe WAL-journaled state machine
+
+    PLANNED -> COPYING -> CATCHUP -> FLIPPING -> DONE
+
+where COPYING streams snapshot rows into the destination as *pending*
+(xmin = PENDING_TS, journaled like prepared transactions), CATCHUP
+re-copies rows committed since the snapshot, and the BARRIER-FLIP drains
+in-flight statements via the shard barrier, stamps the copies visible at
+one commit timestamp, repoints the shard map, and logs a single atomic
+``rebalance_flip`` D-record so recovery and standbys replay the flip (or
+none of it) exactly.
+
+The reference engine's MOVE DATA (PgxcMoveData_* in pgxcnode.c /
+shardmap.c) is the same copy-then-flip shape; the journaled pending
+mechanism here reuses the 2PC prepare plumbing so a coordinator crash at
+any point resumes — or rolls back — without losing acked writes.
+"""
+
+from opentenbase_tpu.rebalance.journal import GID_PREFIX, is_rebalance_gid
+from opentenbase_tpu.rebalance.planner import (
+    MovePlan,
+    plan_add_node,
+    plan_rebalance,
+    plan_remove_node,
+)
+from opentenbase_tpu.rebalance.service import MoveState, RebalanceService
+
+__all__ = [
+    "GID_PREFIX",
+    "MovePlan",
+    "MoveState",
+    "RebalanceService",
+    "is_rebalance_gid",
+    "plan_add_node",
+    "plan_rebalance",
+    "plan_remove_node",
+]

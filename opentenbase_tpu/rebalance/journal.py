@@ -1,0 +1,196 @@
+"""Crash-safe journaling for shard moves.
+
+A move's durability rides the existing WAL machinery instead of a
+private sidecar file, so the torn-tail rule, checkpoint interaction and
+standby streaming all come for free:
+
+- ``rebalance_begin`` (D-record): the planned move set. Replayed into
+  the service so a post-crash ``resume()`` knows which moves were in
+  flight (the un-flipped remainder: ``map[sid] != dst``).
+- copy chunks: ordinary 'T' PREPARE records with a reserved gid prefix
+  (``_rb:``). The destination rows land with xmin = PENDING_TS — bulk
+  data that is journaled, checkpointable, and invisible until the flip
+  decides it, exactly like an in-doubt 2PC transaction. Crucially these
+  gids never register with the GTS or the in-doubt resolver: their
+  outcome is decided by the flip record (or aborted by resume), never
+  by an operator.
+- ``rebalance_flip`` (D-record): THE atomic commit point of a move
+  wave. One record carries the commit timestamp, every copy gid it
+  decides, the xmax fixups for rows deleted mid-copy, and the complete
+  post-flip shard map. Replay applies all of it or none of it.
+- ``rebalance_done`` (D-record): the move set completed; resume has
+  nothing to do.
+
+Abort of an unfinished copy chunk is an ordinary 'R' record — its
+replay truncates the pending destination rows and touches nothing else
+(rebalance dels are never RESERVED-stamped, so the conditional unstamp
+in persist.py is a no-op for them).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+GID_PREFIX = "_rb:"
+
+
+def is_rebalance_gid(gid) -> bool:
+    return isinstance(gid, str) and gid.startswith(GID_PREFIX)
+
+
+class _CopyWrite:
+    __slots__ = ("ins_ranges", "del_idx")
+
+    def __init__(self):
+        self.ins_ranges: list[tuple[int, int]] = []
+        self.del_idx: list[int] = []
+
+
+class CopyTxn:
+    """Duck-typed stand-in for engine.Transaction accepted by
+    ClusterPersistence.log_prepare: one copy chunk's pending writes
+    (destination insert range + source row positions)."""
+
+    def __init__(self, gid: str, gxid: int):
+        self.prepared_gid = gid
+        self.gxid = gxid
+        self.writes: dict = {}
+
+    def w(self, node: int, table: str) -> _CopyWrite:
+        return self.writes.setdefault(node, {}).setdefault(
+            table, _CopyWrite()
+        )
+
+
+def log_begin(
+    persistence, rbid: str, kind: str, moves: dict,
+    remove: str | None = None,
+) -> None:
+    """Journal the planned move set (shard id -> (src, dst)); for
+    REMOVE NODE the victim's name rides along so resume can redo the
+    detach tail after the shard drain."""
+    if persistence is None:
+        return
+    persistence.log_ddl({
+        "op": "rebalance_begin", "rbid": rbid, "kind": kind,
+        "remove": remove,
+        "moves": {str(s): [int(a), int(b)] for s, (a, b) in moves.items()},
+    })
+
+
+def log_copy(persistence, cluster, txn: CopyTxn) -> None:
+    """Journal one copy chunk as a 'T' PREPARE record."""
+    if persistence is None:
+        return
+    from opentenbase_tpu.fault import FAULT
+
+    # failpoint: the copy-chunk journal write (error = the prepare
+    # record failing to land — the chunk must be rolled back; crash
+    # here leaves an orphan pending that resume() aborts)
+    FAULT("rebalance/journal", gid=txn.prepared_gid)
+    persistence.log_prepare(txn, cluster.stores)
+
+
+def log_flip(
+    persistence, rbid: str, commit_ts: int, shards: list[int],
+    map_list: list[int], gids: list[str], fixups: list,
+) -> None:
+    """Journal the atomic ownership flip: decides every copy gid at
+    ``commit_ts``, carries the xmax fixups for mid-copy deletes, and
+    the complete post-flip shard map."""
+    if persistence is None:
+        return
+    persistence.log_ddl({
+        "op": "rebalance_flip", "rbid": rbid,
+        "commit_ts": int(commit_ts),
+        "shards": [int(s) for s in shards],
+        "map": map_list,
+        "gids": list(gids),
+        "fixups": [
+            [int(n), tb, int(rid), int(ts)] for n, tb, rid, ts in fixups
+        ],
+    })
+    for gid in gids:
+        persistence._record_decision(gid, "commit", int(commit_ts))
+
+
+def log_done(persistence, rbid: str) -> None:
+    if persistence is None:
+        return
+    persistence.log_ddl({"op": "rebalance_done", "rbid": rbid})
+
+
+def log_abort_copy(persistence, gid: str) -> None:
+    """Abort an orphaned copy chunk (resume after crash): an ordinary
+    'R' record — replay truncates the pending destination rows."""
+    if persistence is None:
+        return
+    persistence.log_rollback_prepared(gid)
+
+
+# -- WAL redo --------------------------------------------------------------
+
+def replay(cluster, persistence, header: dict) -> None:
+    """Dispatch a rebalance D-record during WAL redo (called from
+    ClusterPersistence._apply)."""
+    op = header["op"]
+    svc = getattr(cluster, "rebalance", None)
+    if op == "rebalance_begin":
+        if svc is not None:
+            svc.replay_begin(header)
+    elif op == "rebalance_flip":
+        replay_flip(cluster, persistence, header)
+        if svc is not None:
+            svc.replay_flip(header)
+    elif op == "rebalance_done":
+        if svc is not None:
+            svc.replay_done(header["rbid"])
+
+
+def replay_flip(cluster, persistence, header: dict) -> None:
+    """Redo of the atomic flip: stamp every decided copy gid's pending
+    rows visible / source rows dead at the flip timestamp, apply the
+    mid-copy delete fixups, and install the post-flip shard map.
+
+    Source-side stamps are CONDITIONAL (only rows still undeleted):
+    'G' frames of transactions that deleted source rows during the
+    copy replay BEFORE this record and their stamps must survive —
+    the matching destination-side outcome is carried by ``fixups``."""
+    from opentenbase_tpu.storage.table import INF_TS
+
+    c = cluster
+    cts = int(header["commit_ts"])
+    tables: set[str] = set()
+    for gid in header.get("gids", ()):
+        pend = persistence._pending.pop(gid, None)
+        persistence._record_decision(gid, "commit", cts)
+        if pend is None:
+            continue
+        for wm in pend["writes"]:
+            store = c.stores.get(wm["node"], {}).get(wm["table"])
+            if store is None:
+                continue
+            tables.add(wm["table"])
+            if wm["kind"] == "ins":
+                s, e = wm["range"]
+                store.stamp_xmin(s, e, cts)
+            else:
+                rowids = np.asarray(wm["rowids"], dtype=np.int64)
+                pos = np.nonzero(
+                    np.isin(store.scan_view().row_id(), rowids)
+                )[0]
+                if len(pos):
+                    live = pos[store.peek_xmax_at(pos) == INF_TS]
+                    if len(live):
+                        store.stamp_xmax(live, cts)
+    for node, table, rid, ts in header.get("fixups", ()):
+        store = c.stores.get(node, {}).get(table)
+        if store is None:
+            continue
+        pos = np.nonzero(store.scan_view().row_id() == rid)[0]
+        if len(pos):
+            store.stamp_xmax(pos, int(ts))
+        tables.add(table)
+    c.shardmap.apply_replayed_map(header["map"])
+    if tables:
+        c.bump_table_versions(tables)

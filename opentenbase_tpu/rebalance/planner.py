@@ -1,0 +1,152 @@
+"""Minimal-motion shard rebalance planning.
+
+Plans balance *bytes*, not shard counts: the load signal is
+``ShardMap.row_stats`` (rows routed per shard group since startup /
+recovery) scaled by a measured average row width. A cluster where one
+shard group holds a hot table's skewed key range should shed that group,
+not an arbitrary one — counting groups would call such a cluster
+"balanced" while one node does all the work.
+
+Minimal motion: only shards that must move, move. ADD NODE steals from
+the most-loaded donors until the new node is within one shard weight of
+the byte-even target; REMOVE NODE drains exactly the victim's shards to
+the least-loaded survivors; full REBALANCE iteratively moves the largest
+shard of the most-overloaded node onto the most-underloaded node while
+the imbalance exceeds the largest single shard's weight (past that point
+moves just oscillate).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class MovePlan:
+    """One planned shard-group reassignment set for a single destination
+    pass. ``moves`` maps shard id -> (from_node, to_node)."""
+
+    kind: str  # add_node | remove_node | rebalance
+    moves: dict[int, tuple[int, int]] = field(default_factory=dict)
+    # Byte weight per node BEFORE the plan (for pg_stat_rebalance's
+    # before/after verdict) and the per-shard weights used.
+    node_bytes_before: dict[int, float] = field(default_factory=dict)
+    shard_bytes: np.ndarray | None = None
+
+    @property
+    def total_bytes(self) -> float:
+        if self.shard_bytes is None:
+            return 0.0
+        return float(sum(self.shard_bytes[s] for s in self.moves))
+
+    def node_bytes_after(self) -> dict[int, float]:
+        out = dict(self.node_bytes_before)
+        if self.shard_bytes is None:
+            return out
+        for sid, (src, dst) in self.moves.items():
+            w = float(self.shard_bytes[sid])
+            out[src] = out.get(src, 0.0) - w
+            out[dst] = out.get(dst, 0.0) + w
+        return {n: b for n, b in out.items() if b > 0.0 or n in out}
+
+
+def _weights(shardmap, avg_row_bytes: float) -> np.ndarray:
+    return shardmap.bytes_per_shard(avg_row_bytes)
+
+
+def _load(shardmap, weights: np.ndarray, nodes: list[int]) -> dict[int, float]:
+    out = {n: 0.0 for n in nodes}
+    for n in nodes:
+        mask = shardmap.map == n
+        if mask.any():
+            out[n] = float(weights[mask].sum())
+    return out
+
+
+def _shards_desc(shardmap, weights: np.ndarray, node: int) -> list[int]:
+    """Shard ids owned by ``node``, largest weight first — greedy
+    largest-first packing gets closest to even with fewest moves."""
+    sids = shardmap.shards_on_node(node)
+    order = np.argsort(-weights[sids], kind="stable")
+    return [int(s) for s in sids[order]]
+
+
+def plan_add_node(shardmap, avg_row_bytes: float, new_node: int, existing: list[int]) -> MovePlan:
+    """Steal shards from the most-loaded donors so the newcomer lands
+    within one shard weight of the byte-even share."""
+    w = _weights(shardmap, avg_row_bytes)
+    donors = [n for n in existing if n != new_node]
+    load = _load(shardmap, w, donors)
+    plan = MovePlan("add_node", node_bytes_before=dict(load), shard_bytes=w)
+    if not donors:
+        return plan
+    total = sum(load.values())
+    target = total / (len(donors) + 1)
+    gained = 0.0
+    # Donor shard lists, refreshed lazily as donors shed weight.
+    pools = {n: _shards_desc(shardmap, w, n) for n in donors}
+    while gained < target:
+        donor = max(load, key=load.get)
+        if load[donor] <= target or not pools[donor]:
+            break
+        sid = None
+        # Largest shard that doesn't overshoot; fall back to the donor's
+        # smallest so tiny clusters still converge.
+        for cand in pools[donor]:
+            if gained + float(w[cand]) <= target + float(w[cand]) * 0.5:
+                sid = cand
+                break
+        if sid is None:
+            sid = pools[donor][-1]
+        pools[donor].remove(sid)
+        plan.moves[sid] = (donor, new_node)
+        load[donor] -= float(w[sid])
+        gained += float(w[sid])
+    return plan
+
+
+def plan_remove_node(shardmap, avg_row_bytes: float, victim: int, survivors: list[int]) -> MovePlan:
+    """Drain every shard the victim owns onto the least-loaded survivors
+    (largest-first so the big groups land before receivers fill up)."""
+    if not survivors:
+        raise ValueError("cannot remove the last datanode")
+    w = _weights(shardmap, avg_row_bytes)
+    load = _load(shardmap, w, survivors)
+    load[victim] = float(w[shardmap.map == victim].sum()) if (shardmap.map == victim).any() else 0.0
+    plan = MovePlan("remove_node", node_bytes_before=dict(load), shard_bytes=w)
+    for sid in _shards_desc(shardmap, w, victim):
+        dst = min(survivors, key=lambda n: load[n])
+        plan.moves[sid] = (victim, dst)
+        load[dst] += float(w[sid])
+    return plan
+
+
+def plan_rebalance(shardmap, avg_row_bytes: float, nodes: list[int]) -> MovePlan:
+    """Level existing nodes: repeatedly move the most-overloaded node's
+    largest shard to the most-underloaded node until the spread is within
+    one largest-shard weight (finer moves would oscillate)."""
+    w = _weights(shardmap, avg_row_bytes)
+    load = _load(shardmap, w, nodes)
+    plan = MovePlan("rebalance", node_bytes_before=dict(load), shard_bytes=w)
+    if len(nodes) < 2:
+        return plan
+    pools = {n: _shards_desc(shardmap, w, n) for n in nodes}
+    moved: set[int] = set()
+    for _ in range(shardmap.num_shards):  # hard bound; converges long before
+        hi = max(load, key=load.get)
+        lo = min(load, key=load.get)
+        candidates = [s for s in pools[hi] if s not in moved]
+        if not candidates:
+            break
+        top = float(w[candidates[0]])
+        if load[hi] - load[lo] <= top:
+            break
+        sid = candidates[0]
+        moved.add(sid)
+        pools[hi].remove(sid)
+        plan.moves[sid] = (hi, lo)
+        load[hi] -= float(w[sid])
+        load[lo] += float(w[sid])
+    return plan

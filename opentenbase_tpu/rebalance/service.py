@@ -1,0 +1,801 @@
+"""RebalanceService: the coordinator-owned background rebalancer.
+
+One service instance hangs off the Cluster. It owns every online shard
+movement — ``ALTER CLUSTER ADD NODE / REMOVE NODE / REBALANCE`` and the
+legacy ``MOVE DATA`` statement — and drives each through the journaled
+state machine (see rebalance/__init__ for the phase diagram).
+
+Concurrency contract
+- One operation at a time (``_idle`` event); overlapping moves would
+  double-copy rows and tear each other's barrier accounting down.
+- COPYING and CATCHUP run with traffic flowing EVERYWHERE, including
+  the moving shards: the copies land invisible (xmin = PENDING_TS) and
+  late commits are picked up by catch-up passes. The shard barrier is
+  held only across the final catch-up + flip — the only window where a
+  statement touching a moving shard waits.
+- ``copy_gate`` serializes copy-chunk journaling against checkpoints:
+  a chunk is (append pending rows, log 'T', register) atomically, so a
+  checkpoint sees either all three or none and the restored state never
+  double-materializes a chunk.
+- Crash at ANY point resumes from the WAL: orphaned copy chunks are
+  aborted ('R'), and the un-flipped remainder of the journaled plan
+  (``map[sid] != dst`` — an un-flipped shard's owner never changed)
+  re-runs in the background.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from opentenbase_tpu.catalog.distribution import DistStrategy
+from opentenbase_tpu.rebalance import journal, planner
+from opentenbase_tpu.rebalance.journal import GID_PREFIX, CopyTxn
+from opentenbase_tpu.storage.table import INF_TS, PENDING_TS, ShardStore
+
+
+@dataclass
+class MoveState:
+    """One wave's observable state — a pg_stat_rebalance row. A wave is
+    the (src, dst) grouping of a plan's shard moves; its flip is one
+    atomic journal record."""
+
+    rbid: str
+    kind: str
+    src: int
+    dst: int
+    shards: int
+    phase: str = "planned"  # planned|copying|catchup|flipping|done|crashed|failed
+    rows_copied: int = 0
+    bytes_copied: float = 0.0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    barrier_wait_ms: float = 0.0
+    error: str = ""
+
+    def bytes_per_sec(self) -> float:
+        end = self.finished_at or time.time()
+        dt = max(end - self.started_at, 1e-9) if self.started_at else 0.0
+        return self.bytes_copied / dt if dt else 0.0
+
+
+@dataclass
+class _PendingCopy:
+    """One journaled copy chunk awaiting its flip decision."""
+
+    gid: str
+    gxid: int
+    table: str
+    src: int
+    dst: int
+    src_pos: np.ndarray
+    dst_range: tuple
+    wal_pos: int = 0
+
+
+@dataclass
+class _Wave:
+    rbid: str
+    src: int
+    dst: int
+    sids: list
+    state: MoveState = None
+    pendings: list = field(default_factory=list)
+
+
+class RebalanceService:
+    CHUNK_ROWS = 16384
+    CATCHUP_MAX_PASSES = 4
+    # a catch-up pass that nets fewer rows than this stops iterating —
+    # the final pass under the drained barrier mops up the remainder
+    CATCHUP_SETTLE_ROWS = 256
+    HISTORY_CAP = 64
+
+    def __init__(self, cluster):
+        self.c = cluster
+        self._mu = threading.Lock()
+        # chunk-vs-checkpoint atomicity (module docstring); RLock: the
+        # flip's final catch-up copies chunks while already inside it
+        self.copy_gate = threading.RLock()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._seq = 0
+        self._gid_seq = 0
+        # rbid -> {"kind", "moves": {sid: (src, dst)}, "remove": name,
+        #          "done": bool} — journaled plans (runtime + WAL redo)
+        self._journaled: dict[str, dict] = {}
+        # rb-prefixed pendings surviving recovery (persist.py
+        # _finish_recovery routes them here, NOT into c._prepared):
+        # resume() aborts them — an un-flipped chunk is garbage
+        self._adopted: dict[str, dict] = {}
+        # live pendings of the in-flight operation (checkpoint source)
+        self._live: dict[str, _PendingCopy] = {}
+        self.history: list[MoveState] = []
+        self.counters = {
+            "moves_total": 0, "rows_copied_total": 0,
+            "bytes_copied_total": 0.0, "errors_total": 0,
+        }
+        self.last_error = ""
+
+    # -- public surface (engine DDL handlers + admin fns) ----------------
+    @property
+    def active(self) -> bool:
+        return not self._idle.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the in-flight operation (if any) finishes."""
+        return self._idle.wait(timeout)
+
+    def start_add_node(self, new_index: int, wait: bool) -> str:
+        sm = self.c.shardmap
+        existing = [
+            i for i in self.c.nodes.datanode_indices() if i != new_index
+        ]
+        plan = planner.plan_add_node(
+            sm, self._avg_row_bytes(), new_index, existing
+        )
+        return self._launch("add_node", plan.moves, wait)
+
+    def start_remove_node(self, name: str, wait: bool) -> str:
+        c = self.c
+        victim = c.nodes.get(name).mesh_index
+        survivors = [i for i in c.nodes.datanode_indices() if i != victim]
+        if not survivors:
+            raise ValueError("cannot remove the last datanode")
+        plan = planner.plan_remove_node(
+            c.shardmap, self._avg_row_bytes(), victim, survivors
+        )
+        return self._launch(
+            "remove_node", plan.moves, wait, remove_name=name
+        )
+
+    def start_rebalance(self, wait: bool) -> str:
+        c = self.c
+        plan = planner.plan_rebalance(
+            c.shardmap, self._avg_row_bytes(), c.nodes.datanode_indices()
+        )
+        return self._launch("rebalance", plan.moves, wait)
+
+    def run_move_data(self, from_node: int, to_node: int, sids) -> int:
+        """The MOVE DATA statement, through the journaled machine
+        (synchronous — the statement returns when the flip lands)."""
+        moves = {int(s): (from_node, to_node) for s in sids}
+        rbid = self._launch("move_data", moves, wait=True)
+        with self._mu:
+            return sum(
+                m.rows_copied for m in self.history if m.rbid == rbid
+            )
+
+    def status_rows(self) -> list[MoveState]:
+        with self._mu:
+            return list(self.history)
+
+    def balance_verdict(self) -> tuple[str, float]:
+        """('balanced'|'skewed', spread_pct): worst node's byte weight
+        deviation from the mean, from row_stats (the acceptance gate's
+        'within 10% of byte-even')."""
+        nb = self.c.shardmap.node_bytes(self._avg_row_bytes())
+        if len(nb) < 2:
+            return "balanced", 0.0
+        vals = list(nb.values())
+        mean = sum(vals) / len(vals)
+        if mean <= 0:
+            return "balanced", 0.0
+        spread = max(abs(v - mean) for v in vals) / mean * 100.0
+        return ("balanced" if spread <= 10.0 else "skewed"), spread
+
+    # -- recovery hooks (persist.py / Cluster.recover) -------------------
+    def adopt_pending(self, gid: str, pend: dict) -> None:
+        self._adopted[gid] = pend
+
+    def replay_begin(self, header: dict) -> None:
+        with self._mu:
+            self._journaled[header["rbid"]] = {
+                "kind": header["kind"],
+                "moves": {
+                    int(s): (int(a), int(b))
+                    for s, (a, b) in header["moves"].items()
+                },
+                "remove": header.get("remove") or None,
+                "done": False,
+            }
+            # keep runtime-assigned ids ahead of every replayed one
+            try:
+                n = int(header["rbid"].lstrip("rb"))
+                self._seq = max(self._seq, n + 1)
+            except ValueError:
+                pass
+
+    def replay_flip(self, header: dict) -> None:
+        # flipped: the replayed map already points at dst, so resume's
+        # map[sid] != dst check skips these shards — nothing to track
+        # beyond the record itself
+        pass
+
+    def replay_done(self, rbid: str) -> None:
+        with self._mu:
+            rec = self._journaled.get(rbid)
+            if rec is not None:
+                rec["done"] = True
+
+    def checkpoint_prepared(self) -> tuple[dict, dict]:
+        """(prepared-meta, prep-ranges) of live copy chunks, merged into
+        the checkpoint by persist._checkpoint_inner so a checkpoint
+        taken mid-COPYING keeps the pending destination rows decidable.
+        Caller holds ``copy_gate`` (the checkpoint wraps itself in it)."""
+        c = self.c
+        prepared: dict = {}
+        ranges: dict = {}
+        with self._mu:
+            live = list(self._live.values())
+        for pc in live:
+            s, e = pc.dst_range
+            dst_store = c.stores[pc.dst][pc.table]
+            src_store = c.stores[pc.src][pc.table]
+            rid0 = int(dst_store.peek_row_id_at(np.array([s]))[0])
+            prepared[pc.gid] = {
+                "gxid": pc.gxid,
+                "writes": [
+                    {"node": pc.dst, "table": pc.table, "kind": "ins",
+                     "nrows": e - s, "row_id_start": rid0},
+                    {"node": pc.src, "table": pc.table, "kind": "del",
+                     "rowids":
+                         src_store.peek_row_id_at(pc.src_pos).tolist()},
+                ],
+            }
+            ranges.setdefault((pc.dst, pc.table), []).append((s, e))
+        return prepared, ranges
+
+    def checkpoint_journal(self) -> list:
+        """Un-done journaled plans, for the checkpoint meta: a
+        checkpoint truncates the WAL the ``rebalance_begin`` D-record
+        lives in, so the plan must ride the snapshot or a crash after
+        the checkpoint would have nothing to resume."""
+        with self._mu:
+            return [
+                {
+                    "rbid": rbid, "kind": rec["kind"],
+                    "moves": {
+                        int(s): [int(a), int(b)]
+                        for s, (a, b) in rec["moves"].items()
+                    },
+                    "remove": rec["remove"],
+                }
+                for rbid, rec in self._journaled.items()
+                if not rec["done"]
+            ]
+
+    def resume(self) -> None:
+        """Post-recovery restart (Cluster.recover): abort orphaned copy
+        chunks, then re-run the un-flipped remainder of any journaled
+        plan in the background."""
+        c = self.c
+        for gid, pend in self._adopted.items():
+            for wm in pend["writes"]:
+                store = c.stores.get(wm["node"], {}).get(wm["table"])
+                if store is None or wm["kind"] != "ins":
+                    continue  # dels were never stamped: nothing to undo
+                s, e = wm["range"]
+                store.truncate_range(s, e)
+            journal.log_abort_copy(c.persistence, gid)
+        self._adopted = {}
+        with self._mu:
+            pending = [
+                (rbid, rec) for rbid, rec in self._journaled.items()
+                if not rec["done"]
+            ]
+        for rbid, rec in pending:
+            remaining = {
+                sid: (int(c.shardmap.map[sid]), dst)
+                for sid, (_src, dst) in rec["moves"].items()
+                if int(c.shardmap.map[sid]) != dst
+            }
+            remove = rec["remove"]
+            if remove is not None and not c.nodes.has(remove):
+                remove = None  # crashed between drop and done: finished
+            if not remaining and remove is None:
+                journal.log_done(c.persistence, rbid)
+                with self._mu:
+                    rec["done"] = True
+                continue
+            self._launch(
+                rec["kind"], remaining, wait=False, remove_name=remove,
+                rbid=rbid, journal_begin=False,
+            )
+            return  # only one can have been in flight at the crash
+
+    # -- internals -------------------------------------------------------
+    def _gucs(self) -> dict:
+        return {**self.c.conf_gucs, **getattr(self.c, "runtime_gucs", {})}
+
+    def _rate_limit(self) -> int:
+        from opentenbase_tpu import config
+
+        v = self._gucs().get("rebalance_rate_limit")
+        if v is None:
+            v = config.GUCS["rebalance_rate_limit"][1]
+        return int(v)
+
+    def _row_bytes(self, meta) -> float:
+        return float(sum(
+            ty.np_dtype.itemsize for ty in meta.schema.values()
+        )) or 8.0
+
+    def _avg_row_bytes(self) -> float:
+        c = self.c
+        total_rows, total_bytes = 0, 0.0
+        for name in c.catalog.table_names():
+            tm = c.catalog.get(name)
+            if tm.dist.strategy != DistStrategy.SHARD:
+                continue
+            w = self._row_bytes(tm)
+            for node in tm.node_indices:
+                st = c.stores.get(node, {}).get(name)
+                if st is not None and st.nrows:
+                    total_rows += st.nrows
+                    total_bytes += st.nrows * w
+        return (total_bytes / total_rows) if total_rows else 64.0
+
+    def _shard_tables(self):
+        c = self.c
+        return [
+            c.catalog.get(n)
+            for n in c.catalog.table_names()
+            if c.catalog.get(n).dist.strategy == DistStrategy.SHARD
+        ]
+
+    def _launch(
+        self, kind: str, moves: dict, wait: bool,
+        remove_name: str | None = None, rbid: str | None = None,
+        journal_begin: bool = True,
+    ) -> str:
+        c = self.c
+        with self._mu:
+            if not self._idle.is_set():
+                raise ValueError(
+                    "a rebalance operation is already in progress "
+                    "(see pg_stat_rebalance)"
+                )
+            self._idle.clear()
+            if rbid is None:
+                rbid = f"rb{self._seq}"
+                self._seq += 1
+            self._journaled[rbid] = {
+                "kind": kind, "moves": dict(moves),
+                "remove": remove_name, "done": False,
+            }
+        if journal_begin:
+            journal.log_begin(
+                c.persistence, rbid, kind, moves, remove_name
+            )
+        if wait:
+            self._run(rbid, kind, moves, remove_name)
+        else:
+            th = threading.Thread(
+                target=self._run, args=(rbid, kind, moves, remove_name),
+                name="otb-rebalance", daemon=True,
+            )
+            th.start()
+        return rbid
+
+    def _run(self, rbid, kind, moves, remove_name) -> None:
+        from opentenbase_tpu.fault import FaultError
+
+        log = getattr(self.c, "log", None)
+        try:
+            self._execute(rbid, kind, moves, remove_name)
+            with self._mu:
+                self.counters["moves_total"] += len(moves)
+        except FaultError as e:
+            # injected crash: leave the journal and pendings exactly as
+            # a dead coordinator would — no cleanup, no abort records;
+            # recovery's resume() owns the aftermath
+            with self._mu:
+                self.last_error = str(e)
+                for m in self.history:
+                    if m.rbid == rbid and m.phase not in ("done",):
+                        m.phase = "crashed"
+                        m.error = str(e)
+            if threading.current_thread().name != "otb-rebalance":
+                raise  # inline (WAIT): surface to the statement
+        except Exception as e:
+            self._fail_cleanup(rbid, e)
+            if log is not None:
+                log.emit(
+                    "error", "rebalance",
+                    f"rebalance {rbid} failed: {e}",
+                )
+            if threading.current_thread().name != "otb-rebalance":
+                raise
+        finally:
+            self._idle.set()
+
+    def _fail_cleanup(self, rbid: str, err: Exception) -> None:
+        """Abort the failed operation's live pendings: truncate the
+        invisible destination rows and journal 'R' records so replay
+        does the same."""
+        c = self.c
+        with self._mu:
+            live = {
+                g: pc for g, pc in self._live.items()
+                if g.startswith(f"{GID_PREFIX}{rbid}:")
+            }
+            for g in live:
+                self._live.pop(g, None)
+            self.counters["errors_total"] += 1
+            self.last_error = str(err)
+            for m in self.history:
+                if m.rbid == rbid and m.phase != "done":
+                    m.phase = "failed"
+                    m.error = str(err)
+                    m.finished_at = time.time()
+        for pc in live.values():
+            store = c.stores.get(pc.dst, {}).get(pc.table)
+            if store is not None:
+                s, e = pc.dst_range
+                store.truncate_range(s, e)
+            journal.log_abort_copy(c.persistence, pc.gid)
+
+    def _execute(self, rbid, kind, moves, remove_name) -> None:
+        waves: dict[tuple[int, int], list[int]] = {}
+        for sid, (src, dst) in sorted(moves.items()):
+            waves.setdefault((int(src), int(dst)), []).append(int(sid))
+        log = getattr(self.c, "log", None)
+        for (src, dst), sids in waves.items():
+            st = MoveState(
+                rbid, kind, src, dst, len(sids), started_at=time.time()
+            )
+            with self._mu:
+                self.history.append(st)
+                del self.history[: -self.HISTORY_CAP]
+            if log is not None:
+                log.emit(
+                    "log", "rebalance",
+                    f"{rbid}: moving {len(sids)} shard groups "
+                    f"dn{src} -> dn{dst}",
+                )
+            self._move_wave(_Wave(rbid, src, dst, sids, st))
+        if remove_name is not None:
+            self._detach_node(remove_name)
+        journal.log_done(self.c.persistence, rbid)
+        with self._mu:
+            rec = self._journaled.get(rbid)
+            if rec is not None:
+                rec["done"] = True
+        if log is not None:
+            log.emit("log", "rebalance", f"{rbid}: complete")
+
+    # -- the per-wave state machine --------------------------------------
+    def _select(self, meta, store, sid_arr, lo, hi) -> np.ndarray:
+        """Positions of rows in the moving shards committed in
+        (lo, hi] and still live at hi — one predicate for the initial
+        copy (lo=-1, hi=snapshot) and every catch-up window."""
+        sv = store.scan_view()
+        n = sv.nrows
+        if n == 0:
+            return np.empty(0, dtype=np.int64)
+        from opentenbase_tpu.storage.column import Column
+
+        key_cols = {
+            k: Column(
+                sv.schema[k], sv.col(k, 0, n), sv.validity(k, 0, n),
+                store.dictionaries.get(k),
+            )
+            for k in meta.dist.key_columns
+        }
+        h = meta.locator.key_hash(key_cols)
+        sid = self.c.shardmap.shard_ids(h)
+        xmin, xmax = sv.xmin(0, n), sv.xmax(0, n)
+        mask = (
+            np.isin(sid, sid_arr)
+            & (xmin > lo) & (xmin <= hi) & (xmax > hi)
+        )
+        return np.nonzero(mask)[0]
+
+    def _copy_chunks(
+        self, wave: _Wave, meta, src_store, dst_store, idx, throttle: bool
+    ) -> int:
+        """Stream ``idx`` rows into the destination as journaled pending
+        chunks. Returns rows copied."""
+        from opentenbase_tpu.fault import FAULT
+
+        c = self.c
+        row_bytes = self._row_bytes(meta)
+        limit = self._rate_limit() if throttle else 0
+        copied = 0
+        for off in range(0, len(idx), self.CHUNK_ROWS):
+            chunk = np.asarray(idx[off: off + self.CHUNK_ROWS])
+            # failpoint: a copy chunk about to stream (crash here =
+            # coordinator death mid-COPYING; the journaled pendings
+            # are aborted by resume and the plan re-runs)
+            FAULT(
+                "rebalance/copy", table=meta.name, rows=len(chunk),
+                rbid=wave.rbid,
+            )
+            with self.copy_gate:
+                batch = src_store.take_batch(chunk)
+                ds, de = dst_store.append_delta(batch, PENDING_TS)
+                with self._mu:
+                    gid = f"{GID_PREFIX}{wave.rbid}:{self._gid_seq}"
+                    self._gid_seq += 1
+                gxid = int(c.gts.get_gts())
+                txn = CopyTxn(gid, gxid)
+                txn.w(wave.dst, meta.name).ins_ranges.append((ds, de))
+                txn.w(wave.src, meta.name).del_idx.extend(
+                    int(i) for i in chunk
+                )
+                journal.log_copy(c.persistence, c, txn)
+                pc = _PendingCopy(
+                    gid, gxid, meta.name, wave.src, wave.dst,
+                    chunk, (ds, de),
+                )
+                with self._mu:
+                    self._live[gid] = pc
+                wave.pendings.append(pc)
+            copied += len(chunk)
+            nbytes = len(chunk) * row_bytes
+            with self._mu:
+                wave.state.rows_copied += len(chunk)
+                wave.state.bytes_copied += nbytes
+                self.counters["rows_copied_total"] += len(chunk)
+                self.counters["bytes_copied_total"] += nbytes
+            if limit > 0:
+                time.sleep(nbytes / float(limit))
+        return copied
+
+    def _move_wave(self, wave: _Wave) -> None:
+        c = self.c
+        sid_arr = np.asarray(wave.sids, dtype=np.int32)
+        pinned: list = []
+        tables: list = []  # (meta, src_store, dst_store)
+        st = wave.state
+        try:
+            with c._move_data_mu:
+                # materialize (or create) both sides' stores and pin
+                # them: pendings hold row POSITIONS, and a vacuum
+                # renumbering positions mid-move would repoint every
+                # stamp at the wrong rows (vacuum no-ops while pinned)
+                for meta in self._shard_tables():
+                    dst_store = c.stores.setdefault(
+                        wave.dst, {}
+                    ).setdefault(
+                        meta.name,
+                        ShardStore(meta.schema, meta.dictionaries),
+                    )
+                    # list the destination in the table's placement
+                    # BEFORE any rows land there: a checkpoint taken
+                    # mid-copy walks node_indices to snapshot stores,
+                    # and the pending rows it journals in "prepared"
+                    # must have a snapshotted store to resolve against
+                    # (pending rows stay invisible; SHARD scans route
+                    # by shardmap, so listing early is harmless)
+                    if wave.dst not in meta.node_indices:
+                        meta.node_indices.append(wave.dst)
+                        meta.locator.node_indices.append(wave.dst)
+                    src_store = c.stores.get(wave.src, {}).get(meta.name)
+                    if src_store is None or src_store.nrows == 0:
+                        continue
+                    src_store.pin()
+                    dst_store.pin()
+                    pinned += [src_store, dst_store]
+                    tables.append((meta, src_store, dst_store))
+                # COPYING: stream a consistent snapshot, traffic flowing
+                st.phase = "copying"
+                snapshot = c.gts.snapshot_ts()
+                for meta, src_store, dst_store in tables:
+                    idx = self._select(
+                        meta, src_store, sid_arr, -1, snapshot
+                    )
+                    self._copy_chunks(
+                        wave, meta, src_store, dst_store, idx,
+                        throttle=True,
+                    )
+                # CATCHUP: iterate the late-commit window down
+                st.phase = "catchup"
+                last = snapshot
+                for _ in range(self.CATCHUP_MAX_PASSES):
+                    now = c.gts.snapshot_ts()
+                    got = 0
+                    for meta, src_store, dst_store in tables:
+                        idx = self._select(
+                            meta, src_store, sid_arr, last, now
+                        )
+                        got += self._copy_chunks(
+                            wave, meta, src_store, dst_store, idx,
+                            throttle=True,
+                        )
+                    last = now
+                    if got <= self.CATCHUP_SETTLE_ROWS:
+                        break
+                # BARRIER-FLIP: drain the moving shards, mop up the
+                # final window, decide every chunk at one timestamp
+                st.phase = "flipping"
+                self._flip(wave, tables, sid_arr, last)
+                st.phase = "done"
+                st.finished_at = time.time()
+        finally:
+            for s in pinned:
+                s.unpin()
+
+    def _flip(self, wave: _Wave, tables, sid_arr, last_snap) -> None:
+        from opentenbase_tpu.fault import FAULT
+        from opentenbase_tpu.utils.rwlock import parked
+
+        c = self.c
+        sm = c.shardmap
+        st = wave.state
+        lock = c._exec_lock
+        t0 = time.monotonic()
+        with c.shard_barrier.moving(set(int(s) for s in wave.sids)):
+            # park our own slot first (the front end may have classed
+            # this statement shared), then drain the data plane: after
+            # the exclusive acquire nothing is mid-statement on the
+            # moving shards and every commit is visible
+            with parked(lock):
+                with lock:
+                    st.barrier_wait_ms = (time.monotonic() - t0) * 1e3
+                    # failpoint: coordinator death inside the flip
+                    # window, BEFORE the flip record — recovery must
+                    # find an un-flipped plan and redo the whole wave
+                    FAULT("rebalance/flip", rbid=wave.rbid)
+                    with self.copy_gate:
+                        # final catch-up: the drained plane can commit
+                        # nothing more — this window is complete
+                        now = c.gts.get_gts()
+                        for meta, src_store, dst_store in tables:
+                            idx = self._select(
+                                meta, src_store, sid_arr, last_snap, now
+                            )
+                            self._copy_chunks(
+                                wave, meta, src_store, dst_store, idx,
+                                throttle=False,
+                            )
+                        cts = int(c.gts.get_gts())
+                        fixups: list = []
+                        touched: set = set()
+                        for pc in wave.pendings:
+                            src_store = c.stores[pc.src][pc.table]
+                            dst_store = c.stores[pc.dst][pc.table]
+                            touched.add(pc.table)
+                            ds, de = pc.dst_range
+                            cur = src_store.peek_xmax_at(pc.src_pos)
+                            live = cur == INF_TS
+                            if live.any():
+                                src_store.stamp_xmax(
+                                    pc.src_pos[live], cts
+                                )
+                            # rows deleted DURING the copy: the deleter
+                            # stamped the source — propagate to the
+                            # destination copy so it doesn't resurrect
+                            for o in np.nonzero(~live)[0]:
+                                dpos = np.array([ds + int(o)])
+                                rid = int(
+                                    dst_store.peek_row_id_at(dpos)[0]
+                                )
+                                ts_ = int(cur[o])
+                                dst_store.stamp_xmax(dpos, ts_)
+                                fixups.append(
+                                    (pc.dst, pc.table, rid, ts_)
+                                )
+                            dst_store.stamp_xmin(ds, de, cts)
+                        for sid in wave.sids:
+                            sm.move_shard(int(sid), wave.dst)
+                        journal.log_flip(
+                            c.persistence, wave.rbid, cts,
+                            wave.sids, sm.map.tolist(),
+                            [pc.gid for pc in wave.pendings], fixups,
+                        )
+                        with self._mu:
+                            for pc in wave.pendings:
+                                self._live.pop(pc.gid, None)
+                    if touched:
+                        c.bump_table_versions(touched)
+                    c.bump_catalog_epoch()
+                    # reclaim the dead source copies while the plane is
+                    # still quiesced (pins released first: vacuum
+                    # no-ops under pin, and positions may renumber now
+                    # that no pending references them)
+                    for meta, src_store, dst_store in tables:
+                        src_store.unpin()
+                        dst_store.unpin()
+                    horizon = c.gts.get_gts()
+                    for meta, src_store, _d in tables:
+                        src_store.vacuum(horizon)
+                    for meta, src_store, dst_store in tables:
+                        src_store.pin()
+                        dst_store.pin()  # rebalanced in _move_wave's finally
+
+    # -- REMOVE NODE tail -------------------------------------------------
+    def _detach_node(self, name: str) -> None:
+        """After the SHARD drain: strip the victim from replicated
+        tables, physically re-route the rows of locator-placed tables
+        (one atomic 'G' frame per movement), then drop the node. Runs
+        under the drained statement lock — routing changes and the
+        catalog strip must be invisible to in-flight statements."""
+        from opentenbase_tpu.utils.rwlock import parked
+
+        c = self.c
+        if not c.nodes.has(name):
+            return
+        victim = c.nodes.get(name).mesh_index
+        if bool((c.shardmap.map == victim).any()):
+            raise ValueError(
+                f'node "{name}" still owns shard groups after drain'
+            )
+        lock = c._exec_lock
+        with parked(lock):
+            with lock:
+                cts = int(c.gts.get_gts())
+                for tname in list(c.catalog.table_names()):
+                    tm = c.catalog.get(tname)
+                    if victim not in tm.node_indices:
+                        c.stores.get(victim, {}).pop(tname, None)
+                        continue
+                    store = c.stores.get(victim, {}).get(tname)
+                    live = (
+                        store.live_index(cts)
+                        if store is not None and store.nrows
+                        else np.empty(0, dtype=np.int64)
+                    )
+                    strat = tm.dist.strategy
+                    needs_move = (
+                        len(live) > 0
+                        and strat not in (
+                            DistStrategy.REPLICATED, DistStrategy.SHARD
+                        )
+                    )
+                    # strip FIRST so the locator routes over survivors
+                    tm.node_indices = [
+                        n for n in tm.node_indices if n != victim
+                    ]
+                    tm.locator.node_indices = [
+                        n for n in tm.locator.node_indices if n != victim
+                    ]
+                    if needs_move:
+                        batch = store.take_batch(live)
+                        key_cols = {
+                            k: batch.columns[k]
+                            for k in tm.dist.key_columns
+                        }
+                        routes = tm.locator.route_insert(
+                            key_cols, batch.nrows
+                        )
+                        store.stamp_xmax(live, cts)
+                        for node in np.unique(routes):
+                            sub_idx = np.nonzero(routes == node)[0]
+                            sub = batch.take(sub_idx)
+                            tgt = c.stores.setdefault(
+                                int(node), {}
+                            ).setdefault(
+                                tname,
+                                ShardStore(tm.schema, tm.dictionaries),
+                            )
+                            s, e = tgt.append_batch(sub, cts)
+                            if c.persistence is not None:
+                                c.persistence.log_commit_group(
+                                    [(victim, tname, [],
+                                      live[sub_idx]),
+                                     (int(node), tname, [(s, e)], [])],
+                                    c.stores, cts,
+                                )
+                        c.bump_table_versions({tname})
+                    c.stores.get(victim, {}).pop(tname, None)
+                for g in c.nodes.all_groups():
+                    if name in g.members:
+                        g.members.remove(name)
+                c.nodes.drop_node(name, force=True)
+                c.stores.pop(victim, None)
+                unreg = getattr(c.gts, "unregister_node", None)
+                if unreg is not None:
+                    try:
+                        unreg(name)
+                    except Exception:
+                        pass
+                if c.persistence is not None:
+                    c.persistence.log_ddl(
+                        {"op": "drop_node", "name": name}
+                    )
+                c.bump_catalog_epoch()

@@ -565,6 +565,7 @@ class DropNode(Statement):
 class CreateNodeGroup(Statement):
     name: str
     members: list[str] = field(default_factory=list)
+    kind: str = "hot"  # CREATE NODE GROUP ... WITH (...) [COLD|HOT]
 
 
 @dataclass
@@ -588,6 +589,17 @@ class MoveData(Statement):
     from_node: str = ""
     to_node: str = ""
     shard_ids: list[int] = field(default_factory=list)
+
+
+@dataclass
+class AlterCluster(Statement):
+    # ALTER CLUSTER ADD NODE n [WITH (...)] [WAIT]
+    # ALTER CLUSTER REMOVE NODE n [WAIT]
+    # ALTER CLUSTER REBALANCE [WAIT]
+    action: str  # add_node | remove_node | rebalance
+    name: str = ""
+    options: dict = field(default_factory=dict)
+    wait: bool = False  # block until the background rebalance finishes
 
 
 @dataclass

@@ -1133,7 +1133,15 @@ class Parser:
                 while self.eat_op(","):
                     members.append(self.ident("node name"))
                 self.expect_op(")")
-                return A.CreateNodeGroup(name, members)
+                # cold/hot dual-group routing (pgxc_group): a COLD
+                # group hosts archive tables whose scans must never
+                # contend with the hot serving set
+                kind = "hot"
+                if self.eat_kw("cold"):
+                    kind = "cold"
+                elif self.eat_kw("hot"):
+                    kind = "hot"
+                return A.CreateNodeGroup(name, members, kind)
             return self._create_node()
         if self.eat_kw("publication"):
             name = self.ident("publication name")
@@ -1440,6 +1448,45 @@ class Parser:
 
     def _create_node(self) -> A.CreateNode:
         name = self.ident("node name")
+        return self._create_node_options(name)
+
+    def _alter_cluster(self) -> A.AlterCluster:
+        """Elastic-cluster DDL (rebalance/): ADD NODE joins a datanode
+        and backfills its byte-even share of shard groups online;
+        REMOVE NODE drains a node to zero owned shards then detaches
+        it; REBALANCE re-levels the existing nodes. All three return
+        immediately and rebalance in the background unless WAIT."""
+        if self.eat_kw("add"):
+            self.expect_kw("node")
+            name = self.ident("node name")
+            options: dict = {}
+            if self.at_kw("with"):
+                node = self._create_node_options(name)
+                options = {
+                    "type": node.node_type, "host": node.host,
+                    "port": node.port, "primary": node.is_primary,
+                    "preferred": node.is_preferred,
+                }
+            return A.AlterCluster(
+                "add_node", name, options, wait=self.eat_kw("wait")
+            )
+        if self.eat_kw("remove") or self.eat_kw("drop"):
+            self.expect_kw("node")
+            name = self.ident("node name")
+            return A.AlterCluster(
+                "remove_node", name, wait=self.eat_kw("wait")
+            )
+        if self.eat_kw("rebalance"):
+            return A.AlterCluster("rebalance", wait=self.eat_kw("wait"))
+        self.error(
+            "unsupported ALTER CLUSTER (expected ADD NODE, "
+            "REMOVE NODE, or REBALANCE)"
+        )
+
+    def _create_node_options(self, name: str) -> A.CreateNode:
+        """Parse ``WITH (type=..., host=..., port=..., ...)`` into a
+        CreateNode — shared by CREATE NODE and ALTER CLUSTER ADD NODE
+        so both accept the identical option surface."""
         self.expect_kw("with")
         self.expect_op("(")
         node_type, host, port = "datanode", "localhost", 0
@@ -1449,11 +1496,15 @@ class Parser:
             if opt == "type":
                 self.eat_op("=")
                 node_type = (
-                    self._string_lit() if self.cur.kind == Tok.STRING else self.ident("type")
+                    self._string_lit() if self.cur.kind == Tok.STRING
+                    else self.ident("type")
                 )
             elif opt == "host":
                 self.eat_op("=")
-                host = self._string_lit() if self.cur.kind == Tok.STRING else self.ident("host")
+                host = (
+                    self._string_lit() if self.cur.kind == Tok.STRING
+                    else self.ident("host")
+                )
             elif opt == "port":
                 self.eat_op("=")
                 port = self._int_lit()
@@ -1469,6 +1520,8 @@ class Parser:
 
     def parse_alter(self) -> A.Statement:
         self.expect_kw("alter")
+        if self.eat_kw("cluster"):
+            return self._alter_cluster()
         if self.eat_kw("node"):
             name = self.ident("node name")
             self.expect_kw("with")

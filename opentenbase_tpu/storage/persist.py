@@ -22,6 +22,7 @@ replay the WAL tail (optionally stopping at a named barrier — PITR).
 
 from __future__ import annotations
 
+import contextlib
 import io
 import json
 import os
@@ -650,8 +651,17 @@ class ClusterPersistence:
                 f"checkpoint starting (gen {gen}, "
                 f"{names_total} tables)",
             )
+        # serialize against rebalance copy chunks: a chunk is (append
+        # pending rows, log 'T', register) under the service's gate, so
+        # holding it here means every chunk is either fully inside this
+        # checkpoint (rows + prepared-meta, 'T' below wal_position) or
+        # fully after it (nothing in the snapshot, 'T' replays) — never
+        # half of each, which would double- or zero-materialize the rows
+        svc = getattr(c, "rebalance", None)
+        gate = svc.copy_gate if svc is not None else contextlib.nullcontext()
         try:
-            self._checkpoint_inner(c, gen, prog)
+            with gate:
+                self._checkpoint_inner(c, gen, prog)
         finally:
             if prog is not None:
                 prog.finish(phase="done")
@@ -677,6 +687,15 @@ class ClusterPersistence:
                     prep_ranges.setdefault((node, table), []).extend(
                         tw.ins_ranges
                     )
+        # in-flight rebalance copy chunks are pending writes too: their
+        # invisible destination rows must survive the snapshot exactly
+        # like in-doubt 2PC rows (caller holds the service's copy_gate)
+        rb_prepared: dict = {}
+        svc = getattr(c, "rebalance", None)
+        if svc is not None:
+            rb_prepared, rb_ranges = svc.checkpoint_prepared()
+            for key, rngs in rb_ranges.items():
+                prep_ranges.setdefault(key, []).extend(rngs)
         meta = {
             "gen": gen,
             "wal_position": self.wal.position,
@@ -693,12 +712,25 @@ class ClusterPersistence:
             # snapshots (xmin=PENDING); record which rows belong to which
             # gid so recovery can still decide them (twophase.c state files)
             "prepared": {
-                gid: {
-                    "gxid": txn.gxid,
-                    "writes": self._prepared_writes_meta(txn),
-                }
-                for gid, txn in getattr(c, "_prepared", {}).items()
+                **{
+                    gid: {
+                        "gxid": txn.gxid,
+                        "writes": self._prepared_writes_meta(txn),
+                    }
+                    for gid, txn in getattr(c, "_prepared", {}).items()
+                },
+                **rb_prepared,
             },
+            "groups": [
+                {"name": g.name, "members": list(g.members),
+                 "kind": g.kind}
+                for g in c.nodes.all_groups()
+            ],
+            # un-done rebalance plans: their begin D-records sit below
+            # wal_position, so the snapshot must carry them for resume
+            "rebalance": (
+                svc.checkpoint_journal() if svc is not None else []
+            ),
             "partitions": {
                 name: ps.spec for name, ps in c.partitions.items()
             },
@@ -728,6 +760,7 @@ class ClusterPersistence:
                 "schema": {k: _type_to_str(v) for k, v in tm.schema.items()},
                 "strategy": tm.dist.strategy.value,
                 "key_columns": list(tm.dist.key_columns),
+                "group": tm.dist.group,
                 "nodes": list(tm.node_indices),
                 "dictionaries": {
                     col: d.values for col, d in tm.dictionaries.items()
@@ -935,6 +968,18 @@ class ClusterPersistence:
 
         import time as _time
 
+        # rebalance copy chunks are NOT in-doubt 2PC transactions: their
+        # outcome is decided by the flip record (or aborted by resume),
+        # never by an operator, so they must not reach c._prepared, the
+        # GTS, or the RESERVED re-stamp below (which would resurrect the
+        # source-row deletes on a later operator ROLLBACK PREPARED)
+        from opentenbase_tpu.rebalance.journal import is_rebalance_gid
+
+        svc = getattr(c, "rebalance", None)
+        for gid in [g for g in self._pending if is_rebalance_gid(g)]:
+            pend = self._pending.pop(gid)
+            if svc is not None:
+                svc.adopt_pending(gid, pend)
         for gid, pend in self._pending.items():
             txn = Transaction(pend["gxid"], 0)
             txn.prepared_gid = gid
@@ -1009,6 +1054,16 @@ class ClusterPersistence:
             if not c.nodes.has(nd["name"]):
                 c.nodes.restore_datanode(nd["name"], nd["mesh_index"])
             c.stores.setdefault(nd["mesh_index"], {})
+        for grec in meta.get("groups", []):
+            if not c.nodes.has_group(grec["name"]):
+                members = [
+                    m for m in grec["members"] if c.nodes.has(m)
+                ]
+                c.nodes.create_group(
+                    grec["name"], members, grec.get("kind", "hot")
+                )
+        for rrec in meta.get("rebalance", []):
+            c.rebalance.replay_begin(rrec)
         c.barriers = [tuple(b) for b in meta["barriers"]]
         c.catalog.literals = Dictionary(meta.get("literals", []))
         for name, tmeta in meta["tables"].items():
@@ -1017,7 +1072,8 @@ class ClusterPersistence:
             }
             strategy = DistStrategy(tmeta["strategy"])
             spec = DistributionSpec(
-                strategy, tuple(tmeta["key_columns"])
+                strategy, tuple(tmeta["key_columns"]),
+                group=tmeta.get("group"),
             )
             if not c.catalog.has(name):
                 c.catalog.create_table(name, schema, spec)
@@ -1029,6 +1085,10 @@ class ClusterPersistence:
                 tm.node_indices = tm.node_indices[:1]
                 continue  # no shard stores: scans materialize via fdw
             tm.node_indices = list(tmeta["nodes"])
+            # the locator binds its OWN node list (Locator copies at
+            # construction) — restore it too, or group-placed / post-
+            # rebalance tables would hash-route on the fresh-create set
+            tm.locator.node_indices = list(tmeta["nodes"])
             for col, values in tmeta["dictionaries"].items():
                 tm.dictionaries[col] = Dictionary(values)
             tm.locator.key_types = {
@@ -1141,6 +1201,7 @@ class ClusterPersistence:
                 spec = DistributionSpec(
                     DistStrategy(header["strategy"]),
                     tuple(header["key_columns"]),
+                    group=header.get("group"),
                 )
                 meta = c.catalog.create_table(header["name"], schema, spec)
                 _apply_constraints_meta(meta, header.get("constraints", {}))
@@ -1320,7 +1381,9 @@ class ClusterPersistence:
                 if c.catalog.has(header["name"]):
                     c.catalog.drop_table(header["name"])
             elif op == "shardmap":
-                c.shardmap.map = np.asarray(header["map"], dtype=np.int32)
+                # version-bumping install: standbys / post-recovery
+                # sessions must drop plans cached against the old map
+                c.shardmap.apply_replayed_map(header["map"])
             elif op == "create_node":
                 from opentenbase_tpu.catalog.nodes import NodeDef, NodeRole
 
@@ -1336,8 +1399,43 @@ class ClusterPersistence:
             elif op == "drop_node":
                 if c.nodes.has(header["name"]):
                     node = c.nodes.get(header["name"])
+                    mi = getattr(node, "mesh_index", -1)
+                    for grp in c.nodes.all_groups():
+                        if header["name"] in grp.members:
+                            grp.members.remove(header["name"])
                     c.nodes.drop_node(header["name"], force=True)
-                    c.stores.pop(getattr(node, "mesh_index", -1), None)
+                    c.stores.pop(mi, None)
+                    # REMOVE NODE stripped the victim from every
+                    # table's placement before dropping it — replay
+                    # must agree or routing diverges after recovery
+                    for tname in c.catalog.table_names():
+                        tm = c.catalog.get(tname)
+                        if mi in tm.node_indices:
+                            tm.node_indices = [
+                                n for n in tm.node_indices if n != mi
+                            ]
+                            tm.locator.node_indices = [
+                                n for n in tm.locator.node_indices
+                                if n != mi
+                            ]
+            elif op == "create_group":
+                if not c.nodes.has_group(header["name"]):
+                    members = [
+                        m for m in header["members"] if c.nodes.has(m)
+                    ]
+                    c.nodes.create_group(
+                        header["name"], members,
+                        header.get("kind", "hot"),
+                    )
+            elif op == "drop_group":
+                if c.nodes.has_group(header["name"]):
+                    c.nodes.drop_group(header["name"])
+            elif op in (
+                "rebalance_begin", "rebalance_flip", "rebalance_done"
+            ):
+                from opentenbase_tpu.rebalance import journal as _rbj
+
+                _rbj.replay(c, self, header)
             elif op == "ha_generation":
                 # fencing epoch (self-healing HA): a promotion bumped
                 # the timeline's generation. Monotone max — replay

@@ -27,6 +27,11 @@ class ShardBarrier:
     def __init__(self):
         self._cv = threading.Condition()
         self._active: set[int] = set()
+        # cumulative accounting, surfaced by pg_stat_rebalance's
+        # barrier columns: how many statements ever waited here and for
+        # how long in total (the operator-visible cost of a flip)
+        self.waiters_total = 0
+        self.wait_ms_total = 0.0
 
     def active(self) -> bool:
         # otb_race: ignore[race-guard-mismatch] -- advisory lock-free peek (plan-cache hit gating): bool(set) is GIL-atomic, and callers that need the real answer block in wait_readable
@@ -53,14 +58,25 @@ class ShardBarrier:
             return
         ids = None if shard_ids is None else {int(s) for s in shard_ids}
         deadline = time.monotonic() + timeout_s
-        with self._cv:
-            while self._active and (
-                ids is None or (self._active & ids)
-            ):
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    raise ShardBarrierTimeout(
-                        "timed out waiting for shard move to finish: "
-                        f"shards {sorted(self._active)} still moving"
+        t0 = time.monotonic()
+        waited = False
+        try:
+            with self._cv:
+                while self._active and (
+                    ids is None or (self._active & ids)
+                ):
+                    waited = True
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise ShardBarrierTimeout(
+                            "timed out waiting for shard move to finish: "
+                            f"shards {sorted(self._active)} still moving"
+                        )
+                    self._cv.wait(min(left, 1.0))
+        finally:
+            if waited:
+                with self._cv:
+                    self.waiters_total += 1
+                    self.wait_ms_total += (
+                        (time.monotonic() - t0) * 1000.0
                     )
-                self._cv.wait(min(left, 1.0))
