@@ -1,0 +1,134 @@
+"""Live top-queries monitor — the pg_top / pg_activity analog.
+
+Polls ``pg_stat_statements`` over the coordinator wire and renders the
+top fingerprints by total / device / transfer / calls / mean, one
+screen per interval — the workload observatory's interactive face:
+"which fingerprint is host-bound" is a glance, not a bench rerun.
+
+    python -m opentenbase_tpu.cli.otb_top --cn HOST:PORT \
+        [--sort total|device|transfer|calls|mean] [--limit 10] \
+        [--interval 2] [-n ITERATIONS]
+
+``-n 1`` prints one snapshot and exits (scripting / CI); the default
+loops until interrupted. Exit code 0 on a clean exit, 1 when the
+coordinator is unreachable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+#: sort key -> pg_stat_statements column(s) the ranking reads
+SORT_COLUMNS = {
+    "total": "total_ms",
+    "device": "device_ms",
+    "transfer": "transfer_bytes",
+    "calls": "calls",
+    "mean": "mean_ms",
+}
+
+_QUERY = (
+    "select queryid, calls, total_ms, mean_ms, device_ms, host_ms, "
+    "transfer_bytes, wal_bytes, wait_ms, rows, platform, query "
+    "from pg_stat_statements"
+)
+
+
+def _fmt_bytes(n) -> str:
+    n = int(n)
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f}M"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}K"
+    return str(n)
+
+
+def render_top(rows, sort: str = "total", limit: int = 10) -> str:
+    """Pure renderer: pg_stat_statements rows (the _QUERY column
+    order) -> one screenful of text, ranked by ``sort``."""
+    idx = {
+        "total": 2, "mean": 3, "device": 4,
+        "transfer": 6, "calls": 1,
+    }[sort]
+    ranked = sorted(rows, key=lambda r: (r[idx] or 0), reverse=True)
+    out = [
+        f"{'QUERYID':>20} {'CALLS':>7} {'TOTAL_MS':>10} {'MEAN_MS':>9} "
+        f"{'DEVICE_MS':>10} {'HOST_MS':>9} {'XFER':>7} {'WAL':>7} "
+        f"{'WAIT_MS':>8} {'ROWS':>8} {'PLAT':>4}  QUERY"
+    ]
+    for r in ranked[:limit]:
+        (qid, calls, total, mean, dev, host,
+         xfer, wal, wait, rows_n, plat, query) = r
+        q = " ".join(str(query).split())
+        if len(q) > 48:
+            q = q[:45] + "..."
+        out.append(
+            f"{qid:>20} {calls:>7} {total:>10.1f} {mean:>9.2f} "
+            f"{dev:>10.1f} {host:>9.1f} {_fmt_bytes(xfer):>7} "
+            f"{_fmt_bytes(wal):>7} {wait:>8.1f} {rows_n:>8} "
+            f"{plat or '-':>4}  {q}"
+        )
+    return "\n".join(out)
+
+
+def _hostport(s: str) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="otb_top", description="live top queries (pg_top analog)"
+    )
+    ap.add_argument("--cn", required=True, metavar="HOST:PORT")
+    ap.add_argument("--sort", choices=sorted(SORT_COLUMNS),
+                    default="total")
+    ap.add_argument("--limit", type=int, default=10)
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("-n", "--iterations", type=int, default=0,
+                    help="snapshots to print (0 = until interrupted)")
+    ap.add_argument("--user", default=None)
+    ap.add_argument("--password", default=None)
+    args = ap.parse_args(argv)
+
+    from opentenbase_tpu.net.client import ClientSession
+
+    host, port = _hostport(args.cn)
+    try:
+        cs = ClientSession(host, port, timeout=10, user=args.user,
+                           password=args.password)
+    except Exception as e:
+        print(f"otb_top: cannot reach coordinator {args.cn}: {e}",
+              file=sys.stderr)
+        return 1
+    shown = 0
+    try:
+        while True:
+            try:
+                rows = cs.query(_QUERY)
+            except Exception as e:
+                print(f"otb_top: query failed: {e}", file=sys.stderr)
+                return 1
+            if shown and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            stamp = time.strftime("%H:%M:%S")
+            print(f"otb_top  {stamp}  sort={args.sort}  "
+                  f"{len(rows)} fingerprints")
+            print(render_top(rows, args.sort, args.limit))
+            shown += 1
+            if args.iterations and shown >= args.iterations:
+                return 0
+            try:
+                time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        cs.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

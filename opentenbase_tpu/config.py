@@ -146,6 +146,17 @@ GUCS: dict = {
     # this many ms get their instrumented plan logged at level 'log';
     # -1 = off (PG's auto_explain.log_min_duration contract), 0 = all
     "auto_explain_min_duration_ms": (_duration, -1),
+    # pg_stat_statements v2 (obs/statements.py): fingerprint-keyed
+    # per-statement resource ledger. enable_stat_statements=off skips
+    # accumulation entirely (results are byte-identical either way);
+    # stat_statements_max bounds the entry table (CLUSTER-scoped,
+    # amortized least-calls eviction — pg_stat_statements.max analog)
+    "enable_stat_statements": (_bool, True),
+    "stat_statements_max": (_int, 1000),
+    # one structured JSON slow-query log line (full resource ledger +
+    # trace_id) for statements running at least this many ms; -1 = off,
+    # 0 = every statement (PG's log_min_duration_statement contract)
+    "log_min_duration_statement": (_duration, -1),
     # serving plane (serving/plancache.py) — these four are CLUSTER-
     # scoped: SET in any live session applies to every session
     # immediately and flushes the affected cache (engine._x_setstmt

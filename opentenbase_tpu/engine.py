@@ -44,6 +44,7 @@ from opentenbase_tpu.catalog.shardmap import ShardMap
 from opentenbase_tpu.executor.dist import DistExecutor, concat_batches
 from opentenbase_tpu.executor.local import LocalExecutor
 from opentenbase_tpu.gtm import GTSServer
+from opentenbase_tpu.obs import statements as _stmtobs
 from opentenbase_tpu.obs import tracectx as _tctx
 from opentenbase_tpu.lmgr import (
     DeadlockError,
@@ -650,10 +651,14 @@ class Cluster:
         import weakref
 
         self.sessions: "weakref.WeakSet[Session]" = weakref.WeakSet()
-        # text -> [calls, total_ms, rows, plan_ms, exec_ms, min_ms,
-        #          max_ms, sum(ms^2)]  (stormstats accumulation; the
-        #          derived mean/stddev come out in _sv_stat_statements)
-        self.stat_statements: dict[str, list] = {}
+        # fingerprint-keyed pg_stat_statements v2 (obs/statements.py):
+        # queryid -> accumulated resource ledger, lock-guarded, with
+        # amortized least-calls eviction bounded by stat_statements_max
+        try:
+            _ss_max = int(self.conf_gucs.get("stat_statements_max", 1000))
+        except (TypeError, ValueError):
+            _ss_max = 1000
+        self.stmt_stats = _stmtobs.StatementStats(max_entries=_ss_max)
         self._fused = None
         self._fused_failed = False
         # durability: WAL + checkpoints when a data_dir is given
@@ -1786,6 +1791,7 @@ class Session:
                 self._note_phase("parse", parse_ms)
             if self._trace is not None:
                 self._trace.record("parse", "phase", t_p0, t_p1)
+            parse_share = parse_ms / len(stmts) if stmts else 0.0
             for i, s in enumerate(stmts):
                 t0 = _time.perf_counter()
                 # FGA probes for destructive statements must see the data
@@ -1793,10 +1799,23 @@ class Session:
                 fga_pre = self._fga_prehits(s)
                 # a stale stash from an errored statement must never be
                 # rendered under the NEXT statement's query text
+                ledger = None
                 if self._phase_acc is None:
                     self._auto_explain_last = None
+                    # a DML statement must not inherit the previous
+                    # select's plan-cache verdict in its ledger
+                    self._last_plan_cache = ""
+                    # per-statement resource ledger (obs/statements.py):
+                    # top-level statements only — nested internal
+                    # execute() calls bill the outer statement's ledger
+                    # through the thread-local stack
+                    ledger = _stmtobs.ResourceLedger()
                 try:
-                    r = self._execute_one(s)
+                    if ledger is not None:
+                        with _stmtobs.active(ledger):
+                            r = self._execute_one(s)
+                    else:
+                        r = self._execute_one(s)
                 except Exception as exc:
                     self._audit_statement(s, success=False,
                                           fga_pre=fga_pre)
@@ -1816,45 +1835,29 @@ class Session:
                 self._audit_statement(s, success=True, fga_pre=fga_pre)
                 ms = (_time.perf_counter() - t0) * 1000
                 self._maybe_auto_explain(s, ms)
-                if isinstance(
-                    s, (A.Select, A.Insert, A.Update, A.Delete, A.ExecuteStmt)
-                ):
-                    # pg_stat_statements analog (contrib/stormstats);
-                    # statements of a multi-statement string are bucketed
-                    # by their position so they don't share one entry
-                    pos = "" if len(stmts) == 1 else f"[{i}] "
-                    key = type(s).__name__ + ":" + pos + self.last_query[:200]
-                    # entry: [calls, total_ms, rows, plan_ms, exec_ms,
-                    #         min_ms, max_ms, sum(ms^2)] — min/max/mean/
-                    #         stddev come out in _sv_stat_statements
-                    ent = self.cluster.stat_statements.setdefault(
-                        key, [0, 0.0, 0, 0.0, 0.0, None, 0.0, 0.0]
-                    )
-                    lp = self._last_phases or {}
-                    plan_ms = lp.get("plan", 0.0)
-                    exec_ms = lp.get("execute")
-                    if exec_ms is None:
-                        # no instrumented executor ran (DML write paths):
-                        # everything outside plan/queue was execution
-                        exec_ms = max(
-                            ms - plan_ms - lp.get("queue", 0.0), 0.0
+                if ledger is not None:
+                    ledger.rows_returned = r.rowcount
+                    if not ledger.plan_cache:
+                        ledger.plan_cache = self._last_plan_cache or ""
+                    ledger.finalize(ms, self._last_phases or {},
+                                    parse_share)
+                    qid = None
+                    if isinstance(
+                        s,
+                        (A.Select, A.Insert, A.Update, A.Delete,
+                         A.ExecuteStmt),
+                    ) and self.gucs.get("enable_stat_statements", True):
+                        # pg_stat_statements v2 (contrib/stormstats):
+                        # fingerprint-keyed, lock-guarded accumulation;
+                        # statements of a multi-statement string keep
+                        # per-position entries
+                        pos = None if len(stmts) == 1 else i
+                        qid = self.cluster.stmt_stats.record(
+                            s, self.last_query, pos, ms, r.rowcount,
+                            ledger,
                         )
-                    ent[0] += 1
-                    ent[1] += ms
-                    ent[2] += r.rowcount
-                    ent[3] += plan_ms
-                    ent[4] += exec_ms
-                    ent[5] = ms if ent[5] is None else min(ent[5], ms)
-                    ent[6] = max(ent[6], ms)
-                    ent[7] += ms * ms
-                    # bounded like pg_stat_statements.max: evict the
-                    # least-called entries when the table overflows
-                    ss = self.cluster.stat_statements
-                    if len(ss) > 1000:
-                        for k, _ in sorted(
-                            ss.items(), key=lambda kv: kv[1][0]
-                        )[: len(ss) - 900]:
-                            del ss[k]
+                    self._maybe_log_slow(s, ms, ledger, qid,
+                                         len(stmts), i)
                 results.append(r)
             return results[-1] if results else Result("EMPTY")
         finally:
@@ -1946,6 +1949,41 @@ class Session:
             f"duration: {ms:.3f} ms  statement: {self.last_query[:200]}",
             session=self.session_id, duration_ms=round(ms, 3),
             plan="\n".join(lines) if lines else None,
+        )
+
+    def _maybe_log_slow(self, stmt: A.Statement, ms: float,
+                        ledger, qid, nstmts: int, i: int) -> None:
+        """log_min_duration_statement: one structured JSON line per
+        slow statement carrying the full resource ledger + trace_id,
+        joining the trace ring to the log ring.  Same exemptions as
+        auto_explain (EXPLAIN/SET/SHOW and internal matview reads)."""
+        if self._matview_internal:
+            return
+        if isinstance(stmt, (A.ExplainStmt, A.SetStmt, A.ShowStmt)):
+            return
+        threshold = self._duration_ms(
+            self.gucs.get("log_min_duration_statement", -1),
+            "log_min_duration_statement",
+        )
+        if threshold < 0 or ms < threshold:
+            return
+        if qid is None:
+            try:
+                qid, _ = self.cluster.stmt_stats.fingerprint(
+                    stmt, self.last_query,
+                    None if nstmts == 1 else i,
+                )
+            except Exception:
+                qid = None
+        trace = self._trace
+        self.cluster.log.emit(
+            "log", "slow_query",
+            f"duration: {ms:.3f} ms  statement: {self.last_query[:200]}",
+            session=self.session_id,
+            duration_ms=round(ms, 3),
+            queryid=qid,
+            trace_id=trace.trace_id if trace is not None else None,
+            ledger=ledger.to_ctx(),
         )
 
     # -- row/table locking (lmgr.py) -------------------------------------
@@ -3805,6 +3843,9 @@ class Session:
         versions = None
         if key is not None and sv.result_enabled:
             e = sv.result_cache.lookup(key, c)
+            led = _stmtobs.current()
+            if led is not None:
+                led.result_cache = "hit" if e is not None else "miss"
             if e is not None:
                 return Result(
                     "SELECT", list(e.rows), list(e.columns), e.rowcount
@@ -3867,6 +3908,7 @@ class Session:
         "pg_rebalance_wait",
         # telemetry plane (obs/): counter reset
         "pg_stat_reset",
+        "pg_stat_statements_reset",
     }
     # FROM-less builtins that mutate nothing: the wire front ends may
     # class them as plain reads (pg_sleep is the WLM/timeout test probe)
@@ -4085,7 +4127,7 @@ class Session:
             import time as _time
 
             c = self.cluster
-            c.stat_statements.clear()
+            c.stmt_stats.reset()
             c.metrics.reset()
             c.waits.reset()
             with c._dml_stats_mu:
@@ -4099,6 +4141,17 @@ class Session:
             )
             return Result(
                 "SELECT", [("",)], ["pg_stat_reset"], 1
+            )
+        if e.name == "pg_stat_statements_reset":
+            # the narrow reset (contrib's own function): statement
+            # entries only — phase/wait/DML counters keep accumulating
+            self.cluster.stmt_stats.reset()
+            self.cluster.log.emit(
+                "notice", "stats", "statement statistics reset",
+                session=self.session_id,
+            )
+            return Result(
+                "SELECT", [("",)], ["pg_stat_statements_reset"], 1
             )
         locks = self.cluster.locks
         if e.name == "pg_unlock_execute":
@@ -4905,6 +4958,21 @@ class Session:
                     hs = self.cluster.frag_heal_stats
                     hs["retries"] += ex.retry_stats["retries"]
                     hs["failovers"] += ex.retry_stats["failovers"]
+                led = _stmtobs.current()
+                if led is not None:
+                    led.frag_retries += ex.retry_stats["retries"]
+                    led.frag_failovers += ex.retry_stats["failovers"]
+            led = _stmtobs.current()
+            if led is not None:
+                # host-path attribution from the gathered per-fragment
+                # instrumentation (the recv_instr_htbl merge): summary
+                # entries (ms None) are rollups of real ones — skip
+                for instr in ex.instrumentation:
+                    if instr.get("ms") is None:
+                        continue
+                    led.rows_read += int(instr.get("rows", 0) or 0)
+                    if instr.get("remote"):
+                        led.dn_rpc_ms += float(instr["ms"])
             motion_ms = sum(
                 m["ms"] for m in ex.motion_stats.values()
                 if m.get("ms") is not None
@@ -4938,6 +5006,9 @@ class Session:
         # captured by _try_fused_inner UNDER the fused gate, so a
         # concurrent session's refresh can't be misattributed.
         self._fused_tail0 = None
+        self._fused_tail1 = None
+        self._fused_h2d0 = None
+        self._fused_h2d1 = None
         with compile_window() as cw:
             out = self._try_fused_inner(dplan, snapshot)
         if out is None:
@@ -4973,12 +5044,16 @@ class Session:
                     )
                 # added AFTER the phase_totals accumulation above:
                 # attribution metadata, not a timing phase
-                tail0 = self._fused_tail0
-                tail1 = int(
-                    fx.cache.stats.get("delta_tail_rows", 0)
-                )
-                if tail0 is not None and tail1 > tail0:
+                tail0, tail1 = self._fused_tail0, self._fused_tail1
+                if (tail0 is not None and tail1 is not None
+                        and tail1 > tail0):
                     phases["delta_tail_rows"] = tail1 - tail0
+                # h2d transfer attribution, same before/after-counter
+                # scheme: only THIS statement's uploads land here
+                h2d0, h2d1 = self._fused_h2d0, self._fused_h2d1
+                if (h2d0 is not None and h2d1 is not None
+                        and h2d1 > h2d0):
+                    phases["h2d_bytes"] = h2d1 - h2d0
                 # device-platform watchdog: the DAG runner stamped its
                 # own run; the single-fragment path stamps here — one
                 # note per successful fused statement either way
@@ -4992,6 +5067,18 @@ class Session:
         self._note_phase("compile", compile_ms)
         self._note_phase("device", device_ms)
         self._note_phase("host", host_ms)
+        led = _stmtobs.current()
+        if led is not None:
+            # ledger device/compile come from here, NOT the phase fold
+            # — finalize() derives host_ms as the execute remainder so
+            # a platform demotion reads as device_ms -> host_ms
+            led.device_ms += device_ms
+            led.compile_ms += compile_ms
+            led.h2d_bytes += int(phases.get("h2d_bytes", 0))
+            led.delta_tail_rows += int(phases.get("delta_tail_rows", 0))
+            led.d2h_bytes += _stmtobs.batch_nbytes(out)
+            if run_platform:
+                led.run_platform = str(run_platform)
         if self._trace is not None:
             # the platform this run ACTUALLY executed on rides the
             # trace (the r04/r05 forensics that used to need a bench
@@ -5072,6 +5159,9 @@ class Session:
                 self._fused_tail0 = int(
                     fx.cache.stats.get("delta_tail_rows", 0)
                 )
+                self._fused_h2d0 = int(
+                    fx.cache.stats.get("h2d_bytes", 0)
+                )
                 if has_topk:
                     res = fx.dag_output(
                         dplan, snapshot, self._dicts_view(), []
@@ -5100,6 +5190,15 @@ class Session:
                     self._fused_via_dag = True
                 if out is None:
                     return None
+                # after-counters captured under the SAME gate hold: a
+                # concurrent session's upload between here and the
+                # accounting block in _try_fused must not bill us
+                self._fused_tail1 = int(
+                    fx.cache.stats.get("delta_tail_rows", 0)
+                )
+                self._fused_h2d1 = int(
+                    fx.cache.stats.get("h2d_bytes", 0)
+                )
         except FusedUnsupported:
             return None
         except Exception as e:
@@ -7582,12 +7681,18 @@ class Session:
                 )
                 self._trace = own_trace
                 own_prev_ctx = _tctx.bind(own_trace.ctx)
+            # child ledger around the instrumented run: the Resources
+            # footer is the same bill a real execution of this statement
+            # accrues in pg_stat_statements, itemized for one run; it is
+            # merged up so the EXPLAIN's own entry keeps the costs
+            run_ledger = _stmtobs.ResourceLedger()
             try:
                 snapshot = self._snapshot()
                 t0 = _time.perf_counter()
-                out, info = self._execute_dplan(
-                    dplan, snapshot, instrument=True
-                )
+                with _stmtobs.active(run_ledger):
+                    out, info = self._execute_dplan(
+                        dplan, snapshot, instrument=True
+                    )
                 total_ms = (_time.perf_counter() - t0) * 1000
             finally:
                 if own_trace is not None:
@@ -7640,6 +7745,12 @@ class Session:
             lines.append(
                 f"Total: rows={out.nrows} time={total_ms:.3f} ms"
             )
+            if pc_status is not None and not run_ledger.plan_cache:
+                run_ledger.plan_cache = pc_status
+            lines += _stmtobs.resource_footer(run_ledger, total_ms)
+            outer = _stmtobs.current()
+            if outer is not None:
+                outer.merge(run_ledger)
         for internal, public in unrename.items():
             lines = [ln.replace(internal, public) for ln in lines]
         rows = [(line,) for line in lines]
@@ -7693,6 +7804,19 @@ class Session:
             # time, so the threshold lives on the ring (server-wide, as
             # the reference's postmaster-level GUC is)
             self.cluster.log.set_min_level(str(v))
+        if stmt.name == "stat_statements_max":
+            # cluster-scoped bound on the statement table: applies (and
+            # evicts down) immediately, inherited by later sessions
+            try:
+                self.cluster.stmt_stats.set_max_entries(int(v))
+            except (TypeError, ValueError):
+                raise SQLError(
+                    f'invalid value for "stat_statements_max": {v!r}'
+                ) from None
+            if stmt.value is None:
+                self.cluster.runtime_gucs.pop(stmt.name, None)
+            else:
+                self.cluster.runtime_gucs[stmt.name] = v
         from opentenbase_tpu.serving.plancache import CACHE_GUCS
 
         if stmt.name in CACHE_GUCS:
@@ -8035,19 +8159,46 @@ def _sv_cluster_activity(c: Cluster):
 
 
 def _sv_stat_statements(c: Cluster):
-    """Enriched per-statement stats (stormstats + pg_stat_statements):
-    plan vs exec split and min/max/mean/stddev over calls."""
+    """pg_stat_statements v2 (stormstats + the resource ledger):
+    fingerprint-keyed, with the full per-statement resource bill —
+    plan/exec split, latency distribution (p50/p95/p99 from the
+    per-entry histogram), device vs host ms, transfer bytes, WAL,
+    GTS, waits, DN RPC and cache verdicts."""
     rows = []
-    reset = float(c.stats_reset_at)
-    for q, ent in c.stat_statements.items():
-        calls = ent[0]
-        mean = ent[1] / calls if calls else 0.0
-        var = max(ent[7] / calls - mean * mean, 0.0) if calls else 0.0
+    ss = c.stmt_stats
+    reset = max(float(c.stats_reset_at), float(ss.reset_at))
+    for ent in ss.snapshot():
+        calls = ent.calls
+        mean = ent.total_ms / calls if calls else 0.0
+        var = (
+            max(ent.sumsq_ms / calls - mean * mean, 0.0) if calls else 0.0
+        )
         rows.append((
-            q, calls, round(ent[1], 3), ent[2],
-            round(ent[3], 3), round(ent[4], 3),
-            round(ent[5] or 0.0, 3), round(ent[6], 3),
+            int(ent.queryid), ent.query, calls,
+            round(ent.total_ms, 3), ent.rows,
+            round(float(ent.parse_ms), 3),
+            round(float(ent.plan_ms), 3),
+            round(float(ent.queue_ms), 3),
+            round(float(ent.exec_ms), 3),
+            round(ent.min_ms or 0.0, 3), round(ent.max_ms, 3),
             round(mean, 3), round(var ** 0.5, 3),
+            round(ent.hist.percentile(0.5), 3),
+            round(ent.hist.percentile(0.95), 3),
+            round(ent.hist.percentile(0.99), 3),
+            round(float(ent.device_ms), 3),
+            round(float(ent.host_ms), 3),
+            round(float(ent.compile_ms), 3),
+            int(ent.rows_read),
+            round(float(ent.dn_rpc_ms), 3),
+            int(ent.frag_retries), int(ent.frag_failovers),
+            int(ent.h2d_bytes), int(ent.d2h_bytes),
+            int(ent.h2d_bytes) + int(ent.d2h_bytes),
+            int(ent.delta_tail_rows),
+            int(ent.wal_bytes), int(ent.wal_flushes),
+            int(ent.gts_rpcs), round(float(ent.gts_ms), 3),
+            round(ent.wait_ms_total, 3),
+            int(ent.plan_cache_hits), int(ent.result_cache_hits),
+            ent.platform,
             reset,
         ))
     return rows
@@ -8806,16 +8957,41 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
     ),
     "pg_stat_statements": (
         {
+            "queryid": t.INT8,
             "query": t.TEXT,
             "calls": t.INT8,
             "total_ms": t.FLOAT8,
             "rows": t.INT8,
+            "parse_ms": t.FLOAT8,
             "plan_ms": t.FLOAT8,
+            "queue_ms": t.FLOAT8,
             "exec_ms": t.FLOAT8,
             "min_ms": t.FLOAT8,
             "max_ms": t.FLOAT8,
             "mean_ms": t.FLOAT8,
             "stddev_ms": t.FLOAT8,
+            "p50_ms": t.FLOAT8,
+            "p95_ms": t.FLOAT8,
+            "p99_ms": t.FLOAT8,
+            "device_ms": t.FLOAT8,
+            "host_ms": t.FLOAT8,
+            "compile_ms": t.FLOAT8,
+            "rows_read": t.INT8,
+            "dn_rpc_ms": t.FLOAT8,
+            "frag_retries": t.INT8,
+            "frag_failovers": t.INT8,
+            "h2d_bytes": t.INT8,
+            "d2h_bytes": t.INT8,
+            "transfer_bytes": t.INT8,
+            "delta_tail_rows": t.INT8,
+            "wal_bytes": t.INT8,
+            "wal_flushes": t.INT8,
+            "gts_rpcs": t.INT8,
+            "gts_ms": t.FLOAT8,
+            "wait_ms": t.FLOAT8,
+            "plan_cache_hits": t.INT8,
+            "result_cache_hits": t.INT8,
+            "platform": t.TEXT,
             "stats_reset": t.FLOAT8,
         },
         _sv_stat_statements,

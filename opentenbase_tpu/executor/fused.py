@@ -116,12 +116,25 @@ class DeviceCache:
             # fold), and the delta-resident rows those tails carried
             "delta_tail_uploads": 0,
             "delta_tail_rows": 0,
+            # host->device transfer volume (every device_put this cache
+            # issued, data + validity + MVCC planes + delta tails): the
+            # per-statement ledger snapshots before/after deltas of this
+            # under the fused gate (engine._try_fused)
+            "h2d_bytes": 0,
         }
         # enable_delta_scan = off (HTAP bench baseline): refreshes fold
         # stores before reading and keep the legacy per-entry MVCC
         # replay with its flat >8 full-plane cutoff — the pre-delta-
         # plane behavior on the same binary
         self.legacy_fold = False
+
+    def _put(self, arr, sharding):
+        """jax.device_put with transfer accounting: every byte this
+        cache ships host->device lands in ``stats["h2d_bytes"]`` (the
+        per-statement ledger reads before/after deltas of it under the
+        fused gate)."""
+        self.stats["h2d_bytes"] += int(getattr(arr, "nbytes", 0) or 0)
+        return jax.device_put(arr, sharding)
 
     def get(
         self, name: str, meta, node_stores: dict[int, dict], nodes=None,
@@ -212,8 +225,8 @@ class DeviceCache:
         dt = DeviceTable(
             {},
             {},
-            jax.device_put(xmin, sharding),
-            jax.device_put(xmax, sharding),
+            self._put(xmin, sharding),
+            self._put(xmax, sharding),
             nrows,
             rmax,
             versions,
@@ -276,7 +289,7 @@ class DeviceCache:
         maxs = {}
         nr_dev = jnp.asarray(nr)
         for cname, arr in columns.items():
-            cols[cname] = jax.device_put(arr, sharding)
+            cols[cname] = self._put(arr, sharding)
             if jnp.issubdtype(arr.dtype, jnp.integer):
                 # stats over LIVE rows only — padding garbage would
                 # widen the range and disable narrow-operand paths
@@ -305,8 +318,8 @@ class DeviceCache:
         dt = DeviceTable(
             cols,
             {c: None for c in cols},
-            jax.device_put(xmin, sharding),
-            jax.device_put(xmax, sharding),
+            self._put(xmin, sharding),
+            self._put(xmax, sharding),
             nr,
             rmax,
             tuple(versions),
@@ -402,16 +415,16 @@ class DeviceCache:
                     if vstack is None:
                         vstack = np.ones((S, W), dtype=np.bool_)
                     vstack[i, :n] = vm
-            cols[cname] = jax.device_put(stack, sharding)
+            cols[cname] = self._put(stack, sharding)
             valids[cname] = (
                 None if vstack is None
-                else jax.device_put(vstack, sharding)
+                else self._put(vstack, sharding)
             )
         dt = DeviceTable(
             cols,
             valids,
-            jax.device_put(xmin, sharding),
-            jax.device_put(xmax, sharding),
+            self._put(xmin, sharding),
+            self._put(xmax, sharding),
             nrows,
             W,
             versions,
@@ -490,9 +503,9 @@ class DeviceCache:
             else:
                 dt.col_maxabs[cname] = None
                 dt.col_range[cname] = None
-            dt.columns[cname] = jax.device_put(stack, sharding)
+            dt.columns[cname] = self._put(stack, sharding)
             dt.validity[cname] = (
-                None if vstack is None else jax.device_put(vstack, sharding)
+                None if vstack is None else self._put(vstack, sharding)
             )
             self.stats["column_uploads"] = (
                 self.stats.get("column_uploads", 0) + 1
@@ -559,6 +572,7 @@ class DeviceCache:
             FAULT("fused/delta_tail_upload")
         delta_rows = 0
         tail_delta_rows = 0
+        delta_h2d = 0
         replays = 0
         for i, (v, sy) in enumerate(zip(views, dt.sync)):
             old_n, new_n = sy["nrows"], totals[i]
@@ -569,6 +583,8 @@ class DeviceCache:
                 stores[i].note_delta_read(tail_served)
 
                 def tset(buf, tail):
+                    nonlocal delta_h2d
+                    delta_h2d += int(getattr(tail, "nbytes", 0) or 0)
                     if legacy:
                         # historical eager write (whole-plane copy per
                         # call) — the fold-on-read baseline keeps it
@@ -628,6 +644,7 @@ class DeviceCache:
         if tail_delta_rows:
             self.stats["delta_tail_uploads"] += 1
             self.stats["delta_tail_rows"] += tail_delta_rows
+        self.stats["h2d_bytes"] += delta_h2d
         self.stats["mvcc_replays"] += replays
         return dt
 

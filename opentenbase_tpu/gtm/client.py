@@ -255,6 +255,12 @@ class NativeGTS:
         token = (
             wr.begin(None, "GTM", "GtsWait") if wr is not None else None
         )
+        # per-statement GTS attribution: every timestamp grant this
+        # statement pays for, counted on the session thread
+        import opentenbase_tpu.obs.statements as _stmtobs
+
+        led = _stmtobs.current()
+        t_rpc0 = time.perf_counter() if led is not None else 0.0
         try:
             with self._lock:
                 if ctx is not None and ctx.sampled:
@@ -277,6 +283,9 @@ class NativeGTS:
         finally:
             if token is not None:
                 wr.end(token)
+            if led is not None:
+                led.gts_rpcs += 1
+                led.gts_ms += (time.perf_counter() - t_rpc0) * 1000.0
         status = body[0]
         if status != 0:
             # a COMPLETED exchange the server refused (e.g. unknown op,

@@ -20,6 +20,13 @@ distributed EXPLAIN ANALYZE) and the wait-event columns of
 - :mod:`opentenbase_tpu.obs.metrics` — allocation-free fixed-bucket
   histograms/counters backing ``pg_stat_query_phases`` and the enriched
   ``pg_stat_statements``;
+- :mod:`opentenbase_tpu.obs.statements` — the workload observatory:
+  per-statement :class:`ResourceLedger` (phase/device/host ms, h2d/d2h
+  transfer bytes, WAL, GTS round-trips, waits by class) attributed via
+  a thread-local stack, accumulated into the fingerprint-keyed
+  :class:`StatementStats` behind ``pg_stat_statements`` v2, the
+  ``Resources:`` EXPLAIN ANALYZE footer, the slow-query log line and
+  the ``otb_top`` CLI;
 - :mod:`opentenbase_tpu.obs.export`  — Chrome-trace-format (Perfetto /
   chrome://tracing) JSON export, also reachable through the
   ``otb_trace`` CLI and the ``pg_export_traces()`` admin function;
@@ -38,6 +45,7 @@ distributed EXPLAIN ANALYZE) and the wait-event columns of
 from opentenbase_tpu.obs.log import LogRing, elog
 from opentenbase_tpu.obs.metrics import MetricsRegistry
 from opentenbase_tpu.obs.progress import ProgressRegistry
+from opentenbase_tpu.obs.statements import ResourceLedger, StatementStats
 from opentenbase_tpu.obs.trace import Tracer
 from opentenbase_tpu.obs.tracectx import SpanRing, TraceContext
 from opentenbase_tpu.obs.waits import WaitEventRegistry
@@ -46,7 +54,9 @@ __all__ = [
     "LogRing",
     "MetricsRegistry",
     "ProgressRegistry",
+    "ResourceLedger",
     "SpanRing",
+    "StatementStats",
     "TraceContext",
     "Tracer",
     "WaitEventRegistry",

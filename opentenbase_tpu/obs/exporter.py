@@ -490,6 +490,43 @@ def render_cluster_metrics(cluster) -> str:
             out.append(_line(
                 "otb_dn_heartbeat_age_seconds", {"node": f"dn{n}"}, age,
             ))
+    # workload observatory (obs/statements.py): top statements by
+    # accumulated wall time, labeled by queryid. Counters are monotone
+    # per queryid; an evicted fingerprint's series simply disappears
+    # (absent keys are legal in the exposition format).
+    ss = getattr(cluster, "stmt_stats", None)
+    if ss is not None:
+        top = ss.top(10, "total_ms")
+        if top:
+            _head(out, "otb_stmt_calls", "counter",
+                  "Statement executions per query fingerprint")
+            for e in top:
+                out.append(_line(
+                    "otb_stmt_calls", {"queryid": str(e.queryid)},
+                    int(e.calls),
+                ))
+            _head(out, "otb_stmt_total_ms", "counter",
+                  "Total statement wall ms per query fingerprint")
+            for e in top:
+                out.append(_line(
+                    "otb_stmt_total_ms", {"queryid": str(e.queryid)},
+                    round(e.total_ms, 3),
+                ))
+            _head(out, "otb_stmt_device_ms", "counter",
+                  "Device execute ms per query fingerprint")
+            for e in top:
+                out.append(_line(
+                    "otb_stmt_device_ms", {"queryid": str(e.queryid)},
+                    round(float(e.device_ms), 3),
+                ))
+            _head(out, "otb_stmt_transfer_bytes", "counter",
+                  "h2d+d2h transfer bytes per query fingerprint")
+            for e in top:
+                out.append(_line(
+                    "otb_stmt_transfer_bytes",
+                    {"queryid": str(e.queryid)},
+                    int(e.h2d_bytes) + int(e.d2h_bytes),
+                ))
     return "\n".join(out) + "\n"
 
 

@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Optional
 
+import opentenbase_tpu.obs.statements as _stmtobs
+
 WAIT_LOCK = "Lock"
 WAIT_IPC = "IPC"
 WAIT_RESGROUP = "ResourceGroup"
@@ -53,6 +55,12 @@ class WaitEventRegistry:
     def end(self, token) -> None:
         session_id, wtype, event, t0 = token
         ms = (time.monotonic() - t0) * 1000.0
+        # per-statement attribution (obs/statements.py): ``end`` runs
+        # on the thread that waited, so the thread-local ledger — when
+        # the wait happened under a statement — gets the bill by class
+        led = _stmtobs.current()
+        if led is not None:
+            led.add_wait(wtype, ms)
         with self._mu:
             if session_id is not None:
                 stack = self._current.get(session_id)

@@ -33,6 +33,7 @@ import numpy as np
 
 from opentenbase_tpu import types as t
 from opentenbase_tpu.analysis.racewatch import shared_state
+import opentenbase_tpu.obs.statements as _stmtobs
 from opentenbase_tpu.storage.table import ShardStore
 
 
@@ -241,6 +242,14 @@ class WAL:
         if arrays is not None:
             payload += pack_arrays(arrays)
         rec = struct.pack("<IB", 1 + len(payload), tag[0]) + payload
+        # per-statement attribution (obs/statements.py): WAL bytes this
+        # statement generated, billed on the appending thread; a sync
+        # append is its own flush, group-commit flushes bill in flush_to
+        led = _stmtobs.current()
+        if led is not None:
+            led.wal_bytes += len(rec)
+            if sync:
+                led.wal_flushes += 1
         with self._mu:
             self._f.write(rec)
             self._f.flush()
@@ -271,6 +280,13 @@ class WAL:
         # failing — every waiter in the batch must see it and abort;
         # delay = a saturated log device stretching the whole batch)
         FAULT("storage/group_flush")
+        # fsyncs-shared: every waiter in the batch pays one flush in
+        # its ledger even when a single leader fsync covers the group —
+        # the per-statement bill reflects what the statement REQUIRED,
+        # pg_stat_wal's fsyncs/group_fsyncs keep the savings headline
+        led = _stmtobs.current()
+        if led is not None:
+            led.wal_flushes += 1
         with self._flush_cv:
             self.commit_flushes += 1
         while True:

@@ -1,0 +1,485 @@
+"""pg_stat_statements v2 + the per-statement resource ledger
+(obs/statements.py): fingerprint collapsing, ledger attribution across
+CN -> DN -> device, slow-query logging, reset/eviction semantics, and
+the racewatch proof that accumulation is now lock-guarded."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from opentenbase_tpu.engine import Cluster
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def sess():
+    s = Cluster(num_datanodes=2, shard_groups=16).session()
+    s.execute("create table t (k bigint, v bigint) distribute by shard(k)")
+    s.execute("insert into t values (1,10),(2,20),(3,30),(4,40)")
+    return s
+
+
+def _entry(sess, like, cols="calls"):
+    rows = sess.query(
+        f"select {cols} from pg_stat_statements "
+        f"where query like '{like}'"
+    )
+    assert len(rows) == 1, rows
+    return rows[0]
+
+
+# ---------------------------------------------------------------------------
+# fingerprinting
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_collapses_literals(sess):
+    """Same shape, different literals -> ONE entry keyed by the
+    generic $n text (the queryid model); raw-text keys exploded one
+    entry per literal."""
+    sess.query("select v from t where k = 1")
+    sess.query("select v from t where k = 2")
+    sess.query("select v from t where k = 3")
+    qid, query, calls = _entry(
+        sess, "%where (k = $1)%", "queryid, query, calls"
+    )
+    assert calls == 3
+    assert "$1" in query and "1" not in query.replace("$1", "")
+    assert qid > 0
+
+
+def test_fingerprint_distinct_shapes_stay_distinct(sess):
+    sess.query("select v from t where k = 1")
+    sess.query("select k from t where v = 10")
+    rows = sess.query(
+        "select queryid from pg_stat_statements "
+        "where query like '%where%'"
+    )
+    assert len(rows) == 2 and rows[0] != rows[1]
+
+
+def test_multi_statement_positions(sess):
+    """Statements of one multi-statement string keep per-position
+    entries even when their shapes collapse."""
+    sess.execute("select 1; select 1")
+    rows = sess.query(
+        "select query, calls from pg_stat_statements "
+        "where query like '%stmt #%' order by query"
+    )
+    assert len(rows) == 2
+    assert all(c == 1 for _q, c in rows)
+    assert "#0" in rows[0][0] and "#1" in rows[1][0]
+
+
+def test_prepared_statement_fingerprint(sess):
+    sess.execute("prepare getv (bigint) as select v from t where k = $1")
+    sess.query("execute getv(1)")
+    sess.query("execute getv(2)")
+    assert _entry(sess, "execute getv($1)")[0] == 2
+
+
+# ---------------------------------------------------------------------------
+# ledger differential: off = byte-identical results, no accumulation
+# ---------------------------------------------------------------------------
+
+
+def test_enable_stat_statements_off_differential(sess):
+    queries = [
+        "select sum(v) from t",
+        "select v from t where k = 2",
+        "select count(*), max(v) from t",
+    ]
+    on_results = [sess.query(q) for q in queries]
+    sess.execute("set enable_stat_statements = off")
+    sess.execute("select pg_stat_statements_reset()")
+    off_results = [sess.query(q) for q in queries]
+    assert on_results == off_results
+    assert sess.query("select count(*) from pg_stat_statements") == [(0,)]
+    sess.execute("set enable_stat_statements = on")
+    sess.query(queries[0])
+    assert sess.query("select count(*) from pg_stat_statements")[0][0] >= 1
+
+
+def test_fingerprint_cache_amortizes(sess):
+    """Repeat executions of the same raw text skip the lift+deparse
+    walk entirely (the serving plane's steady state)."""
+    c = sess.cluster
+    for _ in range(5):
+        sess.query("select v from t where k = 1")
+    assert c.stmt_stats.stats["fp_cache_hits"] >= 4
+
+
+# ---------------------------------------------------------------------------
+# resource ledger attribution
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_phase_and_row_attribution(sess):
+    sess.execute("select pg_stat_statements_reset()")
+    for _ in range(3):
+        sess.query("select v from t where k = 2")
+    (calls, total, plan, ex, rows_ret, parse) = _entry(
+        sess, "%where (k = $1)%",
+        "calls, total_ms, plan_ms, exec_ms, rows, parse_ms",
+    )
+    assert calls == 3 and rows_ret == 3
+    assert plan > 0 and ex > 0 and parse > 0
+    assert plan + ex <= total + 0.001
+
+
+def test_ledger_wal_bytes_on_dml(tmp_path):
+    s = Cluster(num_datanodes=2, data_dir=str(tmp_path)).session()
+    s.execute("create table w (k int, v int) distribute by hash(k)")
+    s.execute("select pg_stat_statements_reset()")
+    s.execute("insert into w values (1, 1), (2, 2)")
+    wal_bytes, flushes = _entry(
+        s, "insert into w values%", "wal_bytes, wal_flushes"
+    )
+    assert wal_bytes > 0 and flushes > 0
+    # reads ship no WAL
+    s.query("select count(*) from w")
+    assert _entry(s, "%count(*) from w%", "wal_bytes") == (0,)
+
+
+def test_ledger_device_columns_on_fused_run(sess):
+    sess.execute("select pg_stat_statements_reset()")
+    sess.query("select sum(v) from t")
+    (dev, host, comp, h2d, d2h, plat) = _entry(
+        sess, "select sum(v) from t",
+        "device_ms, host_ms, compile_ms, h2d_bytes, d2h_bytes, platform",
+    )
+    # platform-any contract: a fused run moves the device columns and
+    # stamps the run platform; a host-only environment moves host_ms
+    if plat and plat != "host":
+        assert dev + comp > 0
+        assert h2d > 0 and d2h > 0
+    else:
+        assert host > 0
+
+
+def test_histogram_percentile_columns(sess):
+    for _ in range(4):
+        sess.query("select sum(v) from t")
+    p50, p95, p99, mx = _entry(
+        sess, "select sum(v) from t", "p50_ms, p95_ms, p99_ms, max_ms"
+    )
+    assert 0 < p50 <= p95 <= p99 <= mx + 0.001
+
+
+def test_no_cross_attribution_two_sessions(sess):
+    """Two concurrent sessions, one repeatedly writing+reading table a,
+    one only reading table b: b's fingerprint must show ZERO transfer
+    — the device-counter deltas are captured under the fused gate, so
+    the writer's uploads can never bill the reader."""
+    c = sess.cluster
+    sess.execute("create table a (k bigint, v bigint) distribute by shard(k)")
+    sess.execute("create table b (k bigint, w bigint) distribute by shard(k)")
+    sess.execute("insert into a values (1,1),(2,2)")
+    sess.execute("insert into b values (1,5),(2,6)")
+    sa, sb = c.session(), c.session()
+    # warm both device tables so steady-state h2d is zero
+    sa.query("select sum(v) from a")
+    sb.query("select sum(w) from b")
+    sess.execute("select pg_stat_statements_reset()")
+    errs = []
+
+    def writer():
+        try:
+            for i in range(5):
+                sa.execute(f"insert into a values ({10 + i}, {i})")
+                sa.query("select sum(v) from a")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    def reader():
+        try:
+            for _ in range(5):
+                sb.query("select sum(w) from b")
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=writer), threading.Thread(target=reader)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errs == []
+    a_h2d, a_tail = _entry(
+        sess, "select sum(v) from a", "h2d_bytes, delta_tail_rows"
+    )
+    b_h2d, b_tail, b_calls = _entry(
+        sess, "select sum(w) from b", "h2d_bytes, delta_tail_rows, calls"
+    )
+    assert b_calls == 5
+    # the reader's fingerprint never pays the writer's uploads
+    assert b_h2d == 0 and b_tail == 0
+    # the writer's refreshes DID upload its fresh rows (platform-any:
+    # the fused path may be unavailable; then nothing uploads at all)
+    if sess.query("select platform from pg_stat_statements "
+                  "where query = 'select sum(v) from a'")[0][0]:
+        assert a_h2d > 0
+
+
+# ---------------------------------------------------------------------------
+# slow-query log line + trace join
+# ---------------------------------------------------------------------------
+
+
+def test_slow_query_line_carries_trace_id(sess):
+    sess.execute("set trace_queries = on")
+    sess.execute("set log_min_duration_statement = 0")
+    sess.query("select sum(v) from t")
+    sess.execute("set log_min_duration_statement = -1")
+    logs = [
+        r for r in sess.query("select pg_cluster_logs('log')")
+        if r[3] == "slow_query" and "sum(v)" in r[4]
+    ]
+    assert logs, "no slow_query line emitted"
+    ctx = json.loads(logs[-1][5])
+    assert ctx["queryid"] > 0
+    led = ctx["ledger"]
+    for field in ("exec_ms", "device_ms", "host_ms", "h2d_bytes",
+                  "wal_bytes", "gts_ms", "wait_ms", "rows_returned"):
+        assert field in led
+    trace_id = ctx["trace_id"]
+    assert trace_id
+    (doc,) = sess.query("select pg_export_traces()")[0]
+    assert trace_id in doc, "trace_id does not resolve in pg_export_traces"
+
+
+def test_slow_query_threshold_filters(sess):
+    sess.execute("set log_min_duration_statement = '100s'")
+    sess.query("select count(*) from t")
+    # the log ring is process-global, so scope to THIS statement
+    logs = [
+        r for r in sess.query("select pg_cluster_logs('log')")
+        if r[3] == "slow_query" and "count(*) from t" in r[4]
+    ]
+    assert logs == []
+
+
+# ---------------------------------------------------------------------------
+# reset + eviction
+# ---------------------------------------------------------------------------
+
+
+def test_pg_stat_statements_reset(sess):
+    sess.query("select count(*) from t")
+    assert sess.query("select count(*) from pg_stat_statements")[0][0] >= 1
+    sess.execute("select pg_stat_statements_reset()")
+    # the reset call itself may land one fresh entry afterwards
+    assert sess.query("select count(*) from pg_stat_statements")[0][0] <= 1
+    # stats_reset advances
+    sess.query("select count(*) from t")
+    reset_at = sess.query(
+        "select stats_reset from pg_stat_statements"
+    )[0][0]
+    assert reset_at > 0
+
+
+def test_pg_stat_reset_clears_statements_too(sess):
+    sess.query("select count(*) from t")
+    sess.execute("select pg_stat_reset()")
+    assert sess.query("select count(*) from pg_stat_statements")[0][0] <= 1
+
+
+def test_eviction_bound_and_amortization(sess):
+    """stat_statements_max bounds the table; eviction sheds the
+    least-called entries and a hot fingerprint survives."""
+    c = sess.cluster
+    for _ in range(6):
+        sess.query("select sum(v) from t")  # the hot entry
+    sess.execute("set stat_statements_max = 6")
+    for i in range(1, 21):
+        cols = ", ".join(["k"] * i)
+        sess.query(f"select {cols} from t")
+    assert c.stmt_stats.entry_count() <= 6
+    assert c.stmt_stats.stats["evictions"] > 0
+    # least-calls policy: the 6-call entry outlives the 1-call churn
+    assert _entry(sess, "select sum(v) from t")[0] >= 6
+    # the GUC is cluster-scoped state, SHOW reports it
+    assert sess.query("show stat_statements_max") == [(6,)]
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE Resources footer reconciliation
+# ---------------------------------------------------------------------------
+
+
+def _footer(lines):
+    txt = [ln for (ln,) in lines]
+    i = txt.index("Resources:")
+    return txt[i:]
+
+
+def test_explain_analyze_resources_footer(sess):
+    sess.query("select sum(v) from t")  # warm device cache + plan cache
+    sess.execute("select pg_stat_statements_reset()")
+    sess.query("select sum(v) from t")
+    d2h, dev_ms, plat = _entry(
+        sess, "select sum(v) from t", "d2h_bytes, device_ms, platform"
+    )
+    foot = _footer(
+        sess.execute("explain analyze select sum(v) from t").rows
+    )
+    joined = "\n".join(foot)
+    assert foot[0] == "Resources:"
+    assert "time: total=" in joined and "device=" in joined
+    assert "transfer: h2d=" in joined and "d2h=" in joined
+    assert "rows_read=" in joined and "gts_rpcs=" in joined
+    if plat and plat != "host":
+        # the footer's per-run d2h equals the entry's per-call d2h:
+        # the result batch is deterministic, so the view reconciles
+        # with the footer exactly on the transfer axis
+        import re
+
+        m = re.search(r"d2h=([\d.]+) (B|KiB|MiB)", joined)
+        assert m
+        unit = {"B": 1, "KiB": 1024, "MiB": 1024 * 1024}[m.group(2)]
+        assert int(float(m.group(1)) * unit) == d2h
+        assert dev_ms > 0 and "platform=" in joined
+
+
+def test_platform_demotion_shifts_device_to_host(sess):
+    """The acceptance criterion: forcing the fused path off is visible
+    on the SAME fingerprint as a device_ms -> host_ms shift within one
+    statement."""
+    sess.query("select sum(v) from t")
+    before = _entry(
+        sess, "select sum(v) from t", "device_ms, host_ms, compile_ms"
+    )
+    sess.execute("set enable_fused_execution = off")
+    sess.query("select sum(v) from t")
+    after = _entry(
+        sess, "select sum(v) from t", "device_ms, host_ms, compile_ms"
+    )
+    # no device/compile time was added; the whole run landed on host
+    assert after[0] == before[0] and after[2] == before[2]
+    assert after[1] > before[1]
+
+
+# ---------------------------------------------------------------------------
+# exporter + CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_exporter_stmt_series(sess):
+    from opentenbase_tpu.obs.exporter import render_cluster_metrics
+
+    sess.query("select sum(v) from t")
+    qid = sess.query(
+        "select queryid from pg_stat_statements "
+        "where query = 'select sum(v) from t'"
+    )[0][0]
+    body = render_cluster_metrics(sess.cluster)
+    for series in ("otb_stmt_calls", "otb_stmt_total_ms",
+                   "otb_stmt_device_ms", "otb_stmt_transfer_bytes"):
+        assert f'{series}{{queryid="{qid}"}}' in body
+
+
+def test_otb_top_render(sess):
+    from opentenbase_tpu.cli.otb_top import _QUERY, render_top
+
+    sess.query("select sum(v) from t")
+    sess.query("select count(*) from t")
+    rows = sess.query(_QUERY)
+    out = render_top(rows, sort="total", limit=5)
+    assert "QUERYID" in out and "DEVICE_MS" in out
+    assert "select sum(v) from t" in out
+    # ranking respects the sort key
+    top_line = out.splitlines()[1]
+    top_qid = int(top_line.split()[0])
+    best = max(rows, key=lambda r: r[2])
+    assert top_qid == best[0]
+
+
+# ---------------------------------------------------------------------------
+# racewatch: the v1 unguarded += RMW is gone
+# ---------------------------------------------------------------------------
+
+
+def _run_racewatch_subprocess(script: str) -> str:
+    env = dict(os.environ)
+    env["OTB_RACEWATCH"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(script)],
+        capture_output=True, text=True, timeout=180,
+        cwd=REPO_ROOT, env=env,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    return out.stdout
+
+
+def test_stat_statements_race_fixed():
+    """The v1 scheme mutated cluster.stat_statements entries with bare
+    += RMWs from concurrent sessions.  Re-provoke that shape (an
+    unguarded accumulator hammered by two threads -> racewatch reports
+    it) and prove StatementStats under the same load is silent with
+    EXACT counts."""
+    out = _run_racewatch_subprocess("""
+        import threading
+        from opentenbase_tpu.analysis import racewatch
+        from opentenbase_tpu.sql import parse
+
+        # the OLD pattern, reconstructed: shared dict entries bumped
+        # with no guard — the sanitizer must still catch this class
+        @racewatch.shared_state()
+        class OldStats:
+            def __init__(self):
+                self.entries = {}
+
+            def bump(self, key):
+                ent = self.entries.setdefault(key, [0, 0.0])
+                ent[0] += 1
+                ent[1] += 1.0
+
+        old = OldStats()
+        N = 200
+        barrier = threading.Barrier(2)
+        def old_worker():
+            barrier.wait()
+            for _ in range(N):
+                old.bump("q")
+        ts = [threading.Thread(target=old_worker) for _ in range(2)]
+        for t in ts: t.start()
+        for t in ts: t.join()
+        old_races = [r for r in racewatch.races()
+                     if r["class"] == "OldStats"]
+        assert old_races, "old unguarded pattern no longer provokes"
+
+        # the NEW path: same load, lock-guarded — silent and exact
+        from opentenbase_tpu.obs.statements import (
+            ResourceLedger, StatementStats,
+        )
+        ss = StatementStats(max_entries=100)
+        stmt = parse("select v from t where k = 1")[0]
+        barrier2 = threading.Barrier(3)
+        def new_worker():
+            barrier2.wait()
+            for _ in range(N):
+                led = ResourceLedger()
+                led.finalize(1.0, {"plan": 0.2, "execute": 0.7})
+                ss.record(stmt, "select v from t where k = 1",
+                          None, 1.0, 1, led)
+        ts = [threading.Thread(target=new_worker) for _ in range(3)]
+        for t in ts: t.start()
+        for t in ts: t.join()
+        with ss._mu:
+            ents = list(ss._entries.values())
+        assert len(ents) == 1, len(ents)
+        assert ents[0].calls == 3 * N, ents[0].calls
+        assert abs(ents[0].total_ms - 3 * N * 1.0) < 1e-6
+        assert abs(ents[0].exec_ms - 3 * N * 0.7) < 1e-6
+        new_races = [r for r in racewatch.races()
+                     if r["class"] == "StatementStats"]
+        assert new_races == [], racewatch.findings()
+        print("STMT_OK")
+    """)
+    assert "STMT_OK" in out

@@ -209,6 +209,58 @@ assert doc["traceEvents"], "empty trace export"
 print(f"observability smoke OK: {len(doc['traceEvents'])} trace events")
 PY
 
+echo "== tier1: workload observatory smoke =="
+timeout -k 10 180 python - <<'PY' || exit 1
+# Workload observatory (obs/statements.py): a mixed workload must land
+# ONE fingerprint-keyed pg_stat_statements row per statement shape
+# (literals collapsed to $n), the device columns must move on fused
+# runs (host columns on a host-only platform), the slow-query line
+# must be parseable JSON carrying the full resource ledger + trace_id,
+# and the exporter must render queryid-labeled per-statement series.
+import json
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.obs.exporter import render_cluster_metrics
+
+c = Cluster(num_datanodes=2, shard_groups=16)
+s = c.session()
+s.execute("create table ws (k bigint, v bigint) distribute by shard(k)")
+s.execute("insert into ws values "
+          + ",".join(f"({i},{i*2})" for i in range(50)))
+s.execute("set trace_queries = on")
+s.execute("set log_min_duration_statement = 0")
+for i in range(1, 6):                      # 5 literals, ONE shape
+    s.query(f"select v from ws where k = {i}")
+for _ in range(3):                         # fused-eligible aggregate
+    s.query("select sum(v) from ws")
+s.execute("set log_min_duration_statement = -1")
+ent = {r[1]: r for r in s.query(
+    "select queryid, query, calls, device_ms, compile_ms, host_ms, "
+    "h2d_bytes, platform from pg_stat_statements")}
+point = ent["select v from ws where (k = $1)"]
+assert point[2] == 5, point                # literals collapsed
+agg = ent["select sum(v) from ws"]
+assert agg[2] == 3, agg
+plat = agg[7]
+if plat and plat != "host":                # fused ran: device columns move
+    assert agg[3] + agg[4] > 0 and agg[6] > 0, agg
+else:                                      # platform-any: host columns move
+    assert agg[5] > 0, agg
+slow = [r for r in s.query("select pg_cluster_logs('log')")
+        if r[3] == "slow_query" and "sum(v) from ws" in r[4]]
+assert slow, "no slow-query line emitted"
+ctx = json.loads(slow[-1][5])              # structured, parseable
+assert ctx["queryid"] == agg[0] and ctx["trace_id"], ctx
+for f in ("exec_ms", "device_ms", "host_ms", "wal_bytes", "wait_ms"):
+    assert f in ctx["ledger"], (f, ctx["ledger"])
+body = render_cluster_metrics(c)
+for series in ("otb_stmt_calls", "otb_stmt_total_ms",
+               "otb_stmt_device_ms", "otb_stmt_transfer_bytes"):
+    assert f'{series}{{queryid="{agg[0]}"}}' in body, series
+c.close()
+print(f"workload observatory smoke OK: {len(ent)} fingerprints, "
+      f"platform={plat or 'host'}")
+PY
+
 echo "== tier1: matview smoke =="
 timeout -k 10 180 python - <<'PY' || exit 1
 import tempfile
