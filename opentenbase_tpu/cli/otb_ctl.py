@@ -7,6 +7,9 @@ real OS process) from one JSON config:
     python -m opentenbase_tpu.cli.otb_ctl start CONFIG.json
     python -m opentenbase_tpu.cli.otb_ctl status CONFIG.json
     python -m opentenbase_tpu.cli.otb_ctl promote CONFIG.json sb1
+    python -m opentenbase_tpu.cli.otb_ctl add-coordinator CONFIG.json cn1
+    python -m opentenbase_tpu.cli.otb_ctl list-coordinators CONFIG.json
+    python -m opentenbase_tpu.cli.otb_ctl replica-status CONFIG.json
     python -m opentenbase_tpu.cli.otb_ctl stop CONFIG.json
 
 Config shape:
@@ -14,8 +17,15 @@ Config shape:
     {"coordinator": {"port": 5433, "wal_port": 5444,
                      "data_dir": "data/pri", "datanodes": 2,
                      "gts": "python"},
+     "coordinators": [{"name": "cn1", "data_dir": "data/cn1",
+                       "serve_port": 5534, "control_port": 5634}],
      "standbys": [{"name": "sb1", "data_dir": "data/sb1",
                    "serve_port": 5533, "control_port": 5633}]}
+
+``coordinators`` are PEER CNs (otb_peer processes): each streams the
+primary's catalog+WAL, serves reads locally, forwards writes to the
+primary, and is registered there with pg_add_coordinator so the
+multi-CN health rows appear in pg_cluster_health.
 
 PID files live beside each data_dir (postmaster.pid convention).
 """
@@ -36,6 +46,10 @@ TEMPLATE = {
         "port": 5433, "wal_port": 5444, "data_dir": "data/pri",
         "datanodes": 2, "shard_groups": 256, "gts": "python",
     },
+    "coordinators": [
+        {"name": "cn1", "data_dir": "data/cn1",
+         "serve_port": 5534, "control_port": 5634}
+    ],
     "standbys": [
         {"name": "sb1", "data_dir": "data/sb1",
          "serve_port": 5533, "control_port": 5633}
@@ -169,6 +183,20 @@ def cmd_status(cfg: dict) -> None:
     co = cfg["coordinator"]
     pid = _read_pid(co["data_dir"])
     print(f"coordinator: {'up (pid %d)' % pid if pid else 'down'}")
+    for cn in cfg.get("coordinators", []):
+        pid = _read_pid(cn["data_dir"])
+        if not pid:
+            print(f"{cn['name']}: down")
+            continue
+        try:
+            st = _control(cn, "status")
+            print(
+                f"{cn['name']}: up (pid {pid}) role={st['role']}"
+                f" applied={st['applied']}"
+                f" catalog_epoch={st['catalog_epoch']}"
+            )
+        except (OSError, ValueError, KeyError):
+            print(f"{cn['name']}: up (pid {pid}) control unreachable")
     for sb in cfg.get("standbys", []):
         pid = _read_pid(sb["data_dir"])
         if not pid:
@@ -202,6 +230,84 @@ def _sql(cfg: dict):
 
     co = cfg["coordinator"]
     return connect_tcp(port=int(co["port"]))
+
+
+def _peer_cfg(cfg: dict, name: str) -> dict:
+    for cn in cfg.get("coordinators", []):
+        if cn.get("name") == name:
+            for field in ("data_dir", "serve_port", "control_port"):
+                if not cn.get(field):
+                    raise SystemExit(
+                        f"coordinator config for {name!r} needs "
+                        f"explicit {field!r}"
+                    )
+            return cn
+    raise SystemExit(f"no coordinator named {name!r} in config")
+
+
+def cmd_add_coordinator(cfg: dict, name: str) -> None:
+    """Spawn a peer CN process and register it on the primary — the
+    pgxc_ctl add-coordinator two-step (spawn, then CREATE NODE)."""
+    co = cfg["coordinator"]
+    if not co.get("wal_port"):
+        raise SystemExit(
+            "peer coordinators need coordinator.wal_port "
+            "(the catalog/WAL stream source)"
+        )
+    cn = _peer_cfg(cfg, name)
+    if _read_pid(cn["data_dir"]):
+        print(f"{name}: already running")
+    else:
+        cmd = [
+            sys.executable, "-m", "opentenbase_tpu.cli.otb_peer",
+            "--name", name,
+            "--primary-wal-port", str(co["wal_port"]),
+            "--primary-sql-port", str(co["port"]),
+            "--data-dir", cn["data_dir"],
+            "--datanodes", str(co.get("datanodes", 2)),
+            "--shard-groups", str(co.get("shard_groups", 256)),
+            "--serve-port", str(cn["serve_port"]),
+            "--control-port", str(cn["control_port"]),
+        ]
+        pid = _spawn(cmd, cn["data_dir"], "peer ready")
+        print(f"{name}: started (pid {pid}, sql port {cn['serve_port']})")
+    with _sql(cfg) as s:
+        s.query(
+            f"SELECT pg_add_coordinator('{name}', '127.0.0.1', "
+            f"{int(cn['serve_port'])})"
+        )
+    print(f"{name}: registered on primary coordinator")
+
+
+def cmd_list_coordinators(cfg: dict) -> None:
+    with _sql(cfg) as s:
+        rows = s.query("SELECT pg_coordinators()")
+    for name, host, port, role, up, epoch, lag in rows:
+        state = "up" if up else "DOWN"
+        line = (
+            f"{name} {role} {host}:{port} {state} "
+            f"catalog_epoch={epoch}"
+        )
+        if int(lag) >= 0:
+            line += f" stream_lag={lag}B"
+        print(line)
+
+
+def cmd_replica_status(cfg: dict) -> None:
+    with _sql(cfg) as s:
+        rows = s.query("SELECT pg_replica_status()")
+    for name, addr, acked, stale, reads, refused in rows:
+        if name == "-":
+            print("no replica targets registered")
+            continue
+        stale_s = (
+            f"{float(stale) * 1000:.1f}ms" if float(stale) >= 0
+            else "unknown"
+        )
+        print(
+            f"{name} {addr or '?'} acked={acked} staleness={stale_s} "
+            f"reads={reads} refused={refused}"
+        )
 
 
 def cmd_add_node(cfg: dict, name: str) -> None:
@@ -248,6 +354,8 @@ def cmd_rebalance_status(cfg: dict) -> None:
 
 def cmd_stop(cfg: dict) -> None:
     targets = [("coordinator", cfg["coordinator"])] + [
+        (cn["name"], cn) for cn in cfg.get("coordinators", [])
+    ] + [
         (sb["name"], sb) for sb in cfg.get("standbys", [])
     ]
     for label, node in targets:
@@ -274,6 +382,7 @@ def main(argv=None) -> int:
     ap.add_argument("verb", choices=[
         "init", "start", "stop", "status", "promote",
         "add-node", "remove-node", "rebalance-status",
+        "add-coordinator", "list-coordinators", "replica-status",
     ])
     ap.add_argument("config")
     ap.add_argument("target", nargs="?")
@@ -300,6 +409,14 @@ def main(argv=None) -> int:
         cmd_remove_node(cfg, args.target)
     elif args.verb == "rebalance-status":
         cmd_rebalance_status(cfg)
+    elif args.verb == "add-coordinator":
+        if not args.target:
+            ap.error("add-coordinator needs a coordinator name")
+        cmd_add_coordinator(cfg, args.target)
+    elif args.verb == "list-coordinators":
+        cmd_list_coordinators(cfg)
+    elif args.verb == "replica-status":
+        cmd_replica_status(cfg)
     elif args.verb == "stop":
         cmd_stop(cfg)
     return 0

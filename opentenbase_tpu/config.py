@@ -268,6 +268,25 @@ GUCS: dict = {
     # by rebalance/service.py between copy chunks so a rebalance never
     # starves foreground traffic of ingest bandwidth.
     "rebalance_rate_limit": (_int, 64 << 20),
+    # Multi-coordinator serving plane (coord/): read routing for
+    # read-only statements outside a transaction. 'primary' = the
+    # classic path (every read runs on the CN that parsed it);
+    # 'replica' = eligible SELECTs are served from hot standbys whose
+    # staleness — proved by the walsender's per-peer applied-ack table,
+    # not by an RPC — is within max_staleness AND whose applied
+    # position covers the session's own last commit (read-your-writes)
+    "read_routing": (_enum("primary", "replica"), "primary"),
+    # staleness budget for replica-routed reads: a standby qualifies
+    # only if it was provably caught up with the primary's WAL within
+    # this window (hot_standby's max_standby_streaming_delay lineage,
+    # inverted into an eligibility bound the ROUTER enforces)
+    "max_staleness": (_duration, 500),
+    # what a replica-routed read does when NO standby is in bound:
+    # 'primary' serves it locally (counting stale_read_refused);
+    # 'wait' parks until a standby proves freshness, up to
+    # replica_read_wait_ms, then falls back to the primary
+    "replica_read_fallback": (_enum("primary", "wait"), "primary"),
+    "replica_read_wait_ms": (_duration, 2000),
     "autovacuum": (_bool, False),
     "autovacuum_naptime_s": (_int, 60),
     "autovacuum_scale_factor_pct": (_int, 20),

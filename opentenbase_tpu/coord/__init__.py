@@ -1,0 +1,42 @@
+"""Multi-coordinator serving plane (ISSUE-18).
+
+The reference runs N coordinators that hold ONLY metadata (SURVEY §1):
+any CN can plan any statement because the catalog is replicated to all
+of them, while data lives on the DNs. This package composes the
+machinery the repo already has into that shape:
+
+- ``catalog.CatalogService`` — the catalog-service half of engine.py's
+  former session/catalog tangle: the DDL epoch clock, the coordinator
+  registry (who the peers are, how fresh each one is), and the catalog
+  stream's health surface. SHARED state, streamed to peers.
+- ``session.SessionService`` — the session-service half: per-CN
+  statement routing policy. On a peer CN it decides local-read vs
+  forward-to-primary; on any CN it decides primary-read vs
+  bounded-staleness replica read.
+- ``peer.PeerCoordinator`` — a peer CN: a coordinator process that
+  subscribes to the primary CN's WAL stream (D-records bump its
+  ``catalog_epoch`` through the same ``persist._apply`` redo hook the
+  primary uses, so a plan/result-cache hit after remote DDL is
+  impossible), serves read-only statements locally, forwards writes and
+  DDL to the primary with read-your-writes, and can promote to primary
+  — at which point in-doubt 2PC resolves from its streamed
+  gid_decision journal via the existing resolver.
+- ``replica.ReplicaRouter`` — bounded-staleness standby reads: routes
+  eligible SELECTs to hot standbys using the walsender's per-peer
+  applied-ack table + position/time ring as the staleness proof (no
+  per-read RPC), honoring the session's last commit offset
+  (read-your-writes).
+"""
+
+from opentenbase_tpu.coord.catalog import CatalogService
+from opentenbase_tpu.coord.peer import PeerCoordinator
+from opentenbase_tpu.coord.replica import ReplicaRouter, StandbyTarget
+from opentenbase_tpu.coord.session import SessionService
+
+__all__ = [
+    "CatalogService",
+    "PeerCoordinator",
+    "ReplicaRouter",
+    "SessionService",
+    "StandbyTarget",
+]

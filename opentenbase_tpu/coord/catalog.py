@@ -1,0 +1,131 @@
+"""Catalog service: the shared, streamed half of the coordinator.
+
+One instance lives on every coordinator (``Cluster.catalog_service``).
+It owns what the reference keeps identical across all CNs — the DDL
+epoch clock and the topology of coordinators — and the evidence needed
+to watch the catalog stream: which peers follow this CN, how far
+behind each one is, and (on a peer) how far behind WE are.
+
+The catalog itself travels as WAL 'D' records over the ordinary
+walsender/walreceiver stream; ``persist._apply`` bumps
+``catalog_epoch`` FIRST on every replayed D-record, which is the whole
+cache-coherence story — this class only has to count, register, and
+report.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class CatalogService:
+    """Per-cluster catalog-service state (coordinator registry + DDL
+    epoch delegation + catalog-stream health)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._mu = threading.Lock()
+        # name -> {"host": sql_host, "port": sql_port} of every peer
+        # coordinator registered against THIS (primary) CN — the rows
+        # pg_cluster_health / otb_ctl list-coordinators render
+        self.peers: dict = {}
+        # peer side: the PeerCoordinator streaming the primary's WAL
+        # into this cluster (None on the primary and on plain standbys)
+        self.receiver = None
+
+    # -- DDL epoch ---------------------------------------------------------
+    def bump_epoch(self) -> int:
+        """Advance the serving plane's DDL clock. The single mutation
+        point for ``catalog_epoch``: statements bump through
+        Cluster.bump_catalog_epoch, WAL redo bumps through
+        persist._apply, both land here."""
+        self.cluster.catalog_epoch += 1
+        return self.cluster.catalog_epoch
+
+    # -- coordinator registry ----------------------------------------------
+    def register_peer(self, name: str, host: str, port: int) -> None:
+        with self._mu:
+            self.peers[str(name)] = {"host": str(host), "port": int(port)}
+        self.cluster.log.emit(
+            "notice", "coord",
+            f"peer coordinator registered: {name} at {host}:{port}",
+        )
+
+    def unregister_peer(self, name: str) -> bool:
+        with self._mu:
+            gone = self.peers.pop(str(name), None)
+        return gone is not None
+
+    def peer_list(self) -> list:
+        """[(name, host, port)] sorted by name."""
+        with self._mu:
+            return sorted(
+                (n, p["host"], p["port"]) for n, p in self.peers.items()
+            )
+
+    # -- health surface ----------------------------------------------------
+    def role(self) -> str:
+        c = self.cluster
+        if getattr(c, "ha_demoted", False):
+            return "fenced"
+        return getattr(c, "coordinator_role", "") or (
+            "standby" if c.read_only else "coordinator"
+        )
+
+    def stream_lag(self) -> int:
+        """Peer side: bytes of primary WAL not yet applied locally
+        (-1 when unknown — stream down or never started); 0 on the
+        primary (it IS the stream head)."""
+        rec = self.receiver
+        if rec is None:
+            return 0
+        lag = getattr(rec, "last_known_lag", None)
+        return int(lag) if lag is not None else -1
+
+    def peer_rows(self, probe_timeout_s: float = 0.3) -> list:
+        """One pg_cluster_health row per REGISTERED peer coordinator:
+        (name, role, up, heartbeat_age, stream_lag, active, armed,
+        device_platform, generation, catalog_epoch). Probes each peer's
+        SQL port with the pre-auth ping (the ha.py liveness probe);
+        stream lag is primary-WAL-end minus the peer's applied offset."""
+        from opentenbase_tpu.ha import _probe_ping
+
+        c = self.cluster
+        wal_pos = int(c.persistence.wal.position) if c.persistence else 0
+        rows = []
+        for name, host, port in self.peer_list():
+            resp = None
+            try:
+                resp = _probe_ping(host, port, timeout_s=probe_timeout_s)
+            except OSError:
+                resp = None
+            if resp is None:
+                rows.append((
+                    name, "coordinator-peer", False, -1.0, -1, 0, 0, "",
+                    -1, -1,
+                ))
+                continue
+            applied = int(resp.get("applied", 0))
+            rows.append((
+                name,
+                str(resp.get("role", "coordinator-peer")),
+                True,
+                0.0,
+                max(wal_pos - applied, 0),
+                0,
+                0,
+                "",
+                int(resp.get("generation", 0)),
+                int(resp.get("catalog_epoch", -1)),
+            ))
+        return rows
+
+    def active_coordinators(self) -> int:
+        """Coordinators currently serving: this one (unless fenced) plus
+        every registered peer that answers its ping — the exporter's
+        otb_cn_active gauge."""
+        n = 0 if getattr(self.cluster, "ha_demoted", False) else 1
+        for row in self.peer_rows(probe_timeout_s=0.2):
+            if row[2]:
+                n += 1
+        return n

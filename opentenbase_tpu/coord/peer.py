@@ -1,0 +1,117 @@
+"""Peer coordinator: a CN that follows the primary CN's WAL stream.
+
+The reference's multi-CN topology works because every CN holds the
+same catalog and no data; here a peer CN streams the primary's WHOLE
+WAL (catalog D-records AND committed write frames) through the
+existing walsender/walreceiver machinery, so:
+
+- every replayed D-record bumps the peer's ``catalog_epoch`` inside
+  ``persist._apply`` — the exact invalidation hook the primary's own
+  DDL uses, which makes a plan/result-cache hit after remote DDL
+  impossible by construction;
+- reads planned on the peer execute against the peer's own replicated
+  stores (the reproduction's DN plane is in-process, so "holds only
+  metadata" degenerates to "holds a replica" — the routing contract is
+  identical: any CN can serve any read);
+- the streamed 'G'/'T'/'C'/'R' frames keep the peer's gid_decision
+  journal and in-doubt table current, so a 2PC begun on a crashed
+  primary resolves from THIS node via the unchanged
+  ``Cluster.resolve_indoubt`` after promotion;
+- writes and DDL forward to the primary over the ordinary wire client
+  (coord/session.py), with the returned ``wal_pos`` as the
+  read-your-writes token local reads wait on.
+
+``promote()`` turns the peer into the primary: the inherited
+StandbyCluster promotion (torn-tail truncation, 2PC re-log, durable
+generation bump) plus dropping the forward address and flipping the
+advertised role.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from opentenbase_tpu.storage.replication import StandbyCluster
+
+
+class PeerCoordinator(StandbyCluster):
+    """A coordinator peer: hot-standby replication plus the coordinator
+    serving contract (local reads, forwarded writes, promotable)."""
+
+    def __init__(self, data_dir: str, num_datanodes: int = 2,
+                 shard_groups: int = 256, name: str = "cn1"):
+        super().__init__(data_dir, num_datanodes, shard_groups)
+        self.name = str(name)
+        c = self.cluster
+        c.coordinator_role = "coordinator-peer"
+        c.coordinator_name = self.name
+        c.catalog_receiver = self
+        c.catalog_service.receiver = self
+        # SQL address of the primary CN writes forward to; None until
+        # follow() learns it (and again after promote())
+        self.primary_sql_addr: Optional[tuple] = None
+
+    # -- wiring ------------------------------------------------------------
+    def follow(self, wal_host: str, wal_port: int,
+               sql_host: str, sql_port: int) -> "PeerCoordinator":
+        """Attach to the primary: stream its WAL from our own offset
+        and point the session service's write forwarding at its SQL
+        front end."""
+        self.start_replication(wal_host, wal_port)
+        self.primary_sql_addr = (str(sql_host), int(sql_port))
+        self.cluster.write_forward_addr = self.primary_sql_addr
+        self.cluster.log.emit(
+            "notice", "coord",
+            f"peer coordinator {self.name} following "
+            f"wal={wal_host}:{wal_port} sql={sql_host}:{sql_port}",
+        )
+        return self
+
+    # -- freshness ---------------------------------------------------------
+    def wait_applied(self, lsn: int, timeout_s: float = 10.0) -> bool:
+        """Block until the local replay reaches ``lsn`` (the
+        read-your-writes wait after a forwarded write)."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while self.applied < lsn:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
+    @property
+    def last_known_lag(self) -> Optional[int]:
+        """Bytes of primary WAL not yet applied here, learned from one
+        pre-auth ping of the primary's SQL port (its reply carries the
+        primary WAL end); None when the primary is unreachable."""
+        if self.primary_sql_addr is None:
+            return None
+        from opentenbase_tpu.ha import _probe_ping
+
+        try:
+            resp = _probe_ping(*self.primary_sql_addr, timeout_s=0.3)
+        except OSError:
+            return None
+        if not resp:
+            return None
+        return max(int(resp.get("applied", 0)) - self.applied, 0)
+
+    # -- failover ----------------------------------------------------------
+    def promote(self, generation: Optional[int] = None):
+        """Take over as primary CN: the full StandbyCluster promotion
+        (finish recovery, truncate torn tail, re-log unstreamed 2PC,
+        durable generation bump) plus the coordinator-plane flip —
+        writes stop forwarding and the advertised role becomes
+        'coordinator'. In-doubt 2PC then resolves HERE through the
+        ordinary resolver: the streamed WAL carried every gid decision
+        and 'T' journal the dead primary ever made durable."""
+        c = super().promote(generation)
+        c.write_forward_addr = None
+        c.coordinator_role = "coordinator"
+        self.primary_sql_addr = None
+        c.log.emit(
+            "warning", "coord",
+            f"peer coordinator {self.name} promoted to primary",
+            generation=int(getattr(c, "node_generation", 0)),
+        )
+        return c

@@ -1,0 +1,218 @@
+"""Session service: per-CN statement routing policy.
+
+The per-coordinator half of the engine.py split: nothing here is
+shared state — it is the POLICY a single CN applies to one session's
+statements, consulting the shared catalog service for topology and
+freshness evidence.
+
+Two decisions live here:
+
+- ``maybe_forward`` (peer CNs): a statement string that could write —
+  DML, DDL, txn control, or anything inside a forwarded transaction —
+  ships verbatim to the primary CN over the ordinary wire client and
+  the reply maps 1:1 back to an engine Result. Pure-read strings stay
+  local, after a read-your-writes wait against the session's last
+  forwarded commit position. SET applies on BOTH sides (the forwarded
+  session must mirror the local one's GUCs).
+- ``maybe_route_read`` (any CN with replica targets): delegates an
+  eligible SELECT to the bounded-staleness replica router
+  (coord/replica.py).
+"""
+
+from __future__ import annotations
+
+from opentenbase_tpu.sql import ast as A
+
+# statement classes a peer CN executes locally (session-local or pure
+# read); everything else — DML, DDL, txn control, admin — forwards.
+# ExecuteStmt is handled separately: it is local only when the bound
+# statement is itself local-class.
+_LOCAL_CLASSES = (
+    A.Select, A.ShowStmt, A.ExplainStmt, A.SetStmt,
+    A.PrepareStmt, A.DeallocateStmt,
+)
+# classes that cannot advance the primary's WAL: a forwarded string
+# made only of these never updates the read-your-writes token
+_NO_WAL_CLASSES = (
+    A.Select, A.ShowStmt, A.ExplainStmt, A.SetStmt,
+    A.PrepareStmt, A.DeallocateStmt, A.ExecuteStmt,
+)
+
+
+def _sql_literal(v) -> str:
+    if isinstance(v, bool):
+        return "on" if v else "off"
+    if isinstance(v, (int, float)):
+        return str(v)
+    s = str(v).replace("'", "''")
+    return f"'{s}'"
+
+
+class SessionService:
+    """Routing policy for one CN's sessions (``Cluster.session_service``)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    # -- peer-side write forwarding ---------------------------------------
+    def _local_class(self, session, s) -> bool:
+        if isinstance(s, A.ExecuteStmt):
+            bound = session.prepared_statements.get(s.name)
+            return bound is not None and isinstance(bound, _LOCAL_CLASSES)
+        return isinstance(s, _LOCAL_CLASSES)
+
+    def maybe_forward(self, session, sql: str, stmts):
+        """Peer CN entry: forward ``sql`` to the primary when any of
+        its statements could write (or a forwarded transaction is
+        open), returning the primary's Result; return None to run the
+        string locally. Called from Session.execute right after parse,
+        BEFORE any local dispatch — so a write never trips the peer's
+        read-only fence, it just goes where writes live."""
+        c = self.cluster
+        fa = getattr(c, "write_forward_addr", None)
+        if fa is None or not stmts:
+            return None
+        if (
+            getattr(session, "_fwd_in_txn", False)
+            or session.txn is not None
+            or any(not self._local_class(session, s) for s in stmts)
+        ):
+            return self._forward(session, sql, stmts)
+        # all-local string: queue SETs for forwarded-session parity
+        # (the primary-side session must see the same GUCs when a later
+        # write forwards)
+        for s in stmts:
+            if isinstance(s, A.SetStmt):
+                session._fwd_pending_sets.append(
+                    f"SET {s.name} TO {_sql_literal(s.value)}"
+                )
+        # read-your-writes: our own forwarded commits must be visible
+        # to our local reads; when the replay cannot catch up in the
+        # budget, serve the read from the primary — fresh by definition
+        rec = c.catalog_service.receiver
+        lsn = int(getattr(session, "last_commit_lsn", 0))
+        if rec is not None and lsn > rec.applied:
+            wait_ms = session._duration_ms(
+                session.gucs.get("replica_read_wait_ms", 2000),
+                "replica_read_wait_ms",
+            )
+            if not rec.wait_applied(lsn, timeout_s=wait_ms / 1000.0):
+                return self._forward(session, sql, stmts)
+            self._bump("ryw_waits")
+        return None
+
+    def _forward(self, session, sql: str, stmts):
+        from opentenbase_tpu.engine import Result, SQLError
+        from opentenbase_tpu.net.client import WireError
+
+        cs = self._fwd_conn(session)
+        try:
+            wr = cs.execute(sql)
+        except WireError as e:
+            if "connection closed" in str(e):
+                self._fwd_reset(session)
+                raise SQLError(
+                    f"primary coordinator connection lost: {e}", "08006"
+                ) from None
+            raise SQLError(
+                str(e), getattr(e, "sqlstate", None) or "XX000"
+            ) from None
+        except OSError as e:
+            self._fwd_reset(session)
+            raise SQLError(
+                f"primary coordinator unreachable: {e}", "08006"
+            ) from None
+        # forwarded-transaction tracking: while the PRIMARY-side
+        # session has an open transaction, every statement (reads
+        # included) must forward — a local read inside it would see a
+        # snapshot the transaction's own writes are missing
+        for s in stmts:
+            if isinstance(s, A.BeginStmt):
+                session._fwd_in_txn = True
+            elif isinstance(s, (A.CommitStmt, A.RollbackStmt)):
+                session._fwd_in_txn = False
+        # causal token: a statement that could write advanced the
+        # primary WAL to (at most) wal_pos — local reads wait for it
+        if wr.wal_pos and any(
+            not isinstance(s, _NO_WAL_CLASSES) for s in stmts
+        ):
+            session.last_commit_lsn = max(
+                int(getattr(session, "last_commit_lsn", 0)), wr.wal_pos
+            )
+        # SET parity: what the primary-side session now has, the local
+        # session applies too (routing GUCs, timeouts — both planes)
+        for s in stmts:
+            if isinstance(s, A.SetStmt):
+                try:
+                    session._execute_one(s)
+                except Exception as e:
+                    self.cluster.log.emit(
+                        "warning", "coord",
+                        f"local apply of forwarded SET failed: {e!r:.120}",
+                    )
+        self._bump("forwarded")
+        return Result(
+            wr.command,
+            [tuple(r) for r in wr.rows],
+            list(wr.columns),
+            wr.rowcount,
+        )
+
+    def _fwd_conn(self, session):
+        cs = getattr(session, "_fwd", None)
+        if cs is None:
+            from opentenbase_tpu.net.client import connect_tcp
+
+            host, port = self.cluster.write_forward_addr
+            cs = connect_tcp(host=host, port=port)
+            session._fwd = cs
+            pending, session._fwd_pending_sets = (
+                session._fwd_pending_sets, []
+            )
+            for set_sql in pending:
+                cs.execute(set_sql)
+        return cs
+
+    def _fwd_reset(self, session) -> None:
+        cs = getattr(session, "_fwd", None)
+        session._fwd = None
+        session._fwd_in_txn = False
+        if cs is not None:
+            try:
+                cs.close()
+            except OSError:
+                pass
+
+    # -- replica read routing ---------------------------------------------
+    def maybe_route_read(self, session, stmt):
+        """Any-CN entry: serve an eligible SELECT from a bounded-
+        staleness standby. Returns the routed Result or None (run
+        locally). Called from _execute_one_inner after the fencing and
+        read-only checks, before plan-key computation — a routed read
+        never touches the local plan/result caches."""
+        c = self.cluster
+        if not getattr(c, "replica_targets", None):
+            return None
+        if str(session.gucs.get("read_routing") or "primary") != "replica":
+            return None
+        if (
+            not isinstance(stmt, A.Select)
+            # FROM-less selects stay local: admin functions
+            # (pg_replica_status, pg_fault_inject...) introspect or
+            # mutate THIS node, sequence funcs allocate state, and
+            # constant selects aren't worth a hop
+            or stmt.from_clause is None
+            or session.txn is not None
+            or session._matview_internal
+            # nested internal stmt (EXPLAIN ANALYZE body, PL statement):
+            # last_query is the OUTER string — never ship it
+            or getattr(session, "_exec_depth", 1) > 1
+            or getattr(session, "_stmt_count", 1) != 1
+        ):
+            return None
+        return c.replica_router.route(session, session.last_query)
+
+    def _bump(self, key: str) -> None:
+        c = self.cluster
+        with c._replica_stats_mu:
+            c.replica_stats[key] = c.replica_stats.get(key, 0) + 1

@@ -246,6 +246,16 @@ class DNServer:
                 except FaultDropConnection:
                     break  # drop without a reply, like a dying process
                 except Exception as e:
+                    # the error DOES travel — as a reply frame to the
+                    # caller — but the server log must carry it too: a
+                    # dispatch crash diagnosed only from the client side
+                    # is invisible to pg_cluster_logs' merged view
+                    self.log_ring.emit(
+                        "warning", "dn",
+                        f"dispatch error for op "
+                        f"{msg.get('op')!r}: {type(e).__name__}: "
+                        f"{e!s:.200}",
+                    )
                     send_frame(
                         conn, {"error": f"{type(e).__name__}: {e}"}
                     )
@@ -396,6 +406,15 @@ class DNServer:
                 # pg_cluster_health's per-node gauges ride the heartbeat
                 "inflight": inflight,
                 "armed_faults": len(_fault.armed()),
+                # replica-read plane: the walreceiver's local socket
+                # address keys this node into the primary walsender's
+                # per-peer ack table (coord/replica.py staleness proof),
+                # and the replayed DDL clock rides the heartbeat so
+                # pg_cluster_health can show catalog coherence per node
+                "repl_addr": getattr(self.standby, "repl_addr", ""),
+                "catalog_epoch": int(
+                    getattr(self.standby.cluster, "catalog_epoch", 0)
+                ),
                 # self-healing HA: fencing generation + live role so a
                 # failover is visible on the next heartbeat
                 "generation": self.effective_generation(),
@@ -409,6 +428,13 @@ class DNServer:
                 out["promoted"] = True
                 out["coordinator_port"] = self._promoted_srv.port
             return out
+        if op == "query":
+            # replica read (coord/replica.py ChannelTarget): read-only
+            # SQL against this node's hot standby. Sits ABOVE the
+            # promoted fence on purpose — after this node takes over as
+            # coordinator its data is still the freshest copy there is,
+            # so routed reads keep working across the failover.
+            return self._query(msg)
         if op == "promote":
             return self._promote(msg)
         if op == "repl_repoint":
@@ -833,6 +859,14 @@ class DNServer:
                     self._peer(h, p).rpc(pl)
                     self._bump("exch_parts_out")
                 except Exception as e:
+                    # collected and re-raised on the pushing thread
+                    # below, but ALSO logged here with the destination:
+                    # the re-raise loses which peer failed, and a
+                    # motion stall is diagnosed per-edge
+                    self.log_ring.emit(
+                        "warning", "dn",
+                        f"motion push to {h}:{p} failed: {e!r:.160}",
+                    )
                     errors.append(e)
 
             th = threading.Thread(target=push, daemon=True)
@@ -958,6 +992,39 @@ class DNServer:
                 return False
             time.sleep(0.002)
         return False
+
+    def _query(self, msg: dict) -> dict:
+        """Serve one read-only statement from this node's hot standby
+        (the replica-read plane's wire shape). ``min_lsn`` is the
+        caller's read-your-writes floor: replay must reach it before
+        the snapshot is taken — the same wait exec_fragment applies for
+        remote_apply, re-checked here against the LIVE replay position
+        rather than the router's possibly stale ack table."""
+        from opentenbase_tpu.engine import SQLError
+
+        min_lsn = int(msg.get("min_lsn", 0))
+        if min_lsn and not self._wait_applied(min_lsn, timeout_s=10.0):
+            return {
+                "error": (
+                    f"replication lag: replica read floor {min_lsn} not "
+                    f"reached (applied {self.standby.applied})"
+                ),
+                "sqlstate": "72001",
+            }
+        self._failpoint("dn/query")
+        try:
+            res = self.standby.session().execute(str(msg.get("sql", "")))
+        except SQLError as e:
+            return {"error": str(e), "sqlstate": e.sqlstate}
+        self._bump("replica_reads")
+        return {
+            "ok": True,
+            "tag": res.command,
+            "columns": list(res.columns),
+            "rows": [list(r) for r in res.rows],
+            "rowcount": res.rowcount,
+            "applied": self.standby.applied,
+        }
 
     def _exec_fragment(self, msg: dict) -> dict:
         node = int(msg["node"])

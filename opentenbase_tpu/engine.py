@@ -634,6 +634,37 @@ class Cluster:
 
         self.serving = ServingPlane(self.conf_gucs)
         self.catalog_epoch = 0
+        # multi-coordinator serving plane (coord/): the catalog-service
+        # half (shared; epoch clock + coordinator registry + stream
+        # health) and the session-service half (per-CN routing policy —
+        # peer-side write forwarding, replica read routing). The split
+        # ISSUE-18 names: what streams to peers vs what stays local.
+        from opentenbase_tpu.coord.catalog import CatalogService
+        from opentenbase_tpu.coord.replica import ReplicaRouter
+        from opentenbase_tpu.coord.session import SessionService
+
+        self.catalog_service = CatalogService(self)
+        self.session_service = SessionService(self)
+        self.replica_router = ReplicaRouter(self)
+        # "" = ordinary single-CN role derivation; coord/peer.py sets
+        # "coordinator-peer" (and promote flips it to "coordinator")
+        self.coordinator_role = ""
+        self.coordinator_name = "cn0"
+        # peer CN: (host, port) of the primary's SQL front end writes
+        # forward to; None on a primary
+        self.write_forward_addr = None
+        # peer CN: the PeerCoordinator replaying the primary's WAL here
+        self.catalog_receiver = None
+        # bounded-staleness read plane: registered replica targets
+        # (coord/replica.py Standby/ChannelTarget) + its counters
+        self.replica_targets: list = []
+        self.replica_stats: dict = {
+            "replica_reads": 0, "stale_read_refused": 0,
+            "ryw_waits": 0, "wait_served": 0, "forwarded": 0,
+        }
+        import threading as _threading
+
+        self._replica_stats_mu = _threading.Lock()
         # runtime cluster-wide GUC overrides (today: the cache GUCs,
         # which are cluster-scoped by design): sessions created later
         # inherit these ON TOP of the conf file; RESET restores the
@@ -747,8 +778,10 @@ class Cluster:
         """Advance the serving plane's DDL clock (plan/result cache
         invalidation): called for every statement outside the
         epoch-neutral read/write/txn classes, from WAL redo of
-        D-records, and from the direct ALTER/redistribute APIs."""
-        self.catalog_epoch += 1
+        D-records, and from the direct ALTER/redistribute APIs.
+        Delegates to the catalog service (coord/catalog.py) — the one
+        mutation point, on primaries and streaming peers alike."""
+        self.catalog_service.bump_epoch()
 
     def fused_executor(self):
         """Lazily built FusedExecutor over the default device mesh (the
@@ -1733,6 +1766,23 @@ class Session:
         # tables (recursive-CTE materialization): those fingerprints
         # embed per-call temp names and must never enter the caches
         self._no_cache_depth = 0
+        # multi-coordinator plane (coord/): the session's causal token
+        # — the WAL offset of its last commit (local or forwarded); a
+        # replica-routed or peer-local read only serves from a copy
+        # that has applied at least this much (read-your-writes)
+        self.last_commit_lsn = 0
+        # statements in the current top-level string (replica routing
+        # needs last_query to BE the statement, so multi-statement
+        # strings never route)
+        self._stmt_count = 1
+        # live _execute_one nesting depth (see _execute_one)
+        self._exec_depth = 0
+        # peer-CN write forwarding (coord/session.py): the lazy wire
+        # session to the primary, whether IT has an open transaction,
+        # and SETs applied locally before the connection existed
+        self._fwd = None
+        self._fwd_in_txn = False
+        self._fwd_pending_sets: list[str] = []
 
     def close(self) -> None:
         """Backend-exit cleanup (the tcop loop's on-exit path): release
@@ -1744,6 +1794,13 @@ class Session:
         if ticket is not None:
             self._wlm_ticket = None
             ticket.release()
+        fwd = self._fwd
+        if fwd is not None:
+            self._fwd = None
+            try:
+                fwd.close()
+            except OSError:
+                pass
         self.state = "closed"
         self.cluster.sessions.discard(self)
 
@@ -1778,6 +1835,16 @@ class Session:
             stmts = parse(sql)
             t_p1 = _time.perf_counter()
             parse_ms = (t_p1 - t_p0) * 1000
+            self._stmt_count = len(stmts)
+            # peer CN (coord/session.py): statements that could write
+            # ship to the primary verbatim; the primary does the
+            # bookkeeping (stats, audit, ledger) for forwarded work
+            if self.cluster.write_forward_addr is not None:
+                fwd = self.cluster.session_service.maybe_forward(
+                    self, sql, stmts
+                )
+                if fwd is not None:
+                    return fwd
             if self._phase_acc is None:
                 # top-level statement string: one histogram sample
                 self.cluster.metrics.histogram("phase.parse").record(
@@ -2301,6 +2368,11 @@ class Session:
                 raise
         finally:
             self.cluster.stamping_done(commit_ts)
+        if commit_lsn is not None:
+            # the session's causal token (coord/): replica-routed reads
+            # only serve from standbys whose acked offset covers the
+            # session's own last commit (read-your-writes)
+            self.last_commit_lsn = max(self.last_commit_lsn, commit_lsn)
         if implicit_gid is not None:
             # failpoint: the coordinator dying AFTER the durable commit
             # record but BEFORE phase 2 — the in-doubt shape the
@@ -2526,6 +2598,11 @@ class Session:
         phases_top = self._phase_acc is None
         if phases_top:
             self._phase_acc = {}
+        # statement nesting depth: replica routing only fires at depth 1
+        # (a nested internal SELECT — an EXPLAIN ANALYZE body, a PL
+        # statement — must not ship last_query, the OUTER string, to a
+        # standby)
+        self._exec_depth += 1
         try:
             rec = self._materialize_recursive_ctes(stmt)
             if rec is None:
@@ -2543,6 +2620,7 @@ class Session:
                 self._explain_prelude = []
                 self._explain_rename = {}
         finally:
+            self._exec_depth -= 1
             if top:
                 self._stmt_deadline = None
             if phases_top:
@@ -2591,6 +2669,20 @@ class Session:
                 f"cannot execute {type(stmt).__name__} in a read-only "
                 "(hot standby) cluster"
             )
+        # bounded-staleness replica routing (coord/replica.py): an
+        # eligible SELECT under read_routing=replica serves from a hot
+        # standby instead of the local executor — before plan-key
+        # computation, so routed reads never touch the local caches
+        if (
+            isinstance(stmt, A.Select)
+            and self.txn is None
+            and not self._matview_internal
+        ):
+            routed = self.cluster.session_service.maybe_route_read(
+                self, stmt
+            )
+            if routed is not None:
+                return routed
         if not self._matview_internal:
             self._matview_write_guard(stmt)
             stmt = self._maybe_matview_rewrite(stmt)
@@ -3906,6 +3998,12 @@ class Session:
         "pg_resolve_indoubt",
         # elastic rebalance (rebalance/): block on the in-flight move
         "pg_rebalance_wait",
+        # multi-coordinator plane (coord/): peer registry + replica
+        # read-plane status
+        "pg_add_coordinator",
+        "pg_remove_coordinator",
+        "pg_coordinators",
+        "pg_replica_status",
         # telemetry plane (obs/): counter reset
         "pg_stat_reset",
         "pg_stat_statements_reset",
@@ -4092,6 +4190,73 @@ class Session:
             rows = self.cluster.resolve_indoubt(min_age_s=age)
             return Result(
                 "SELECT", rows, ["gid", "outcome"], len(rows)
+            )
+        if e.name == "pg_add_coordinator":
+            # pg_add_coordinator(name, host, port): register a peer CN
+            # against THIS (primary) coordinator — pg_cluster_health
+            # grows a probed row for it and otb_cn_active counts it
+            if len(e.args) != 3:
+                raise SQLError(
+                    "pg_add_coordinator(name, host, port) takes "
+                    "exactly 3 arguments"
+                )
+            name = str(self._const_arg(e.args[0]))
+            host = str(self._const_arg(e.args[1]))
+            port = int(self._const_arg(e.args[2]))
+            self.cluster.catalog_service.register_peer(name, host, port)
+            return Result("SELECT", [(name,)], ["registered"], 1)
+        if e.name == "pg_remove_coordinator":
+            if len(e.args) != 1:
+                raise SQLError(
+                    "pg_remove_coordinator(name) takes exactly 1 argument"
+                )
+            name = str(self._const_arg(e.args[0]))
+            gone = self.cluster.catalog_service.unregister_peer(name)
+            return Result("SELECT", [(bool(gone),)], ["removed"], 1)
+        if e.name == "pg_coordinators":
+            # registry + live probe: one row per coordinator this CN
+            # knows about, itself included
+            c = self.cluster
+            rows = [(
+                getattr(c, "coordinator_name", "cn0") or "cn0",
+                "-", -1,
+                c.catalog_service.role(),
+                True,
+                int(c.catalog_epoch),
+                c.catalog_service.stream_lag(),
+            )]
+            probed = {row[0]: row for row in c.catalog_service.peer_rows()}
+            for name, host, port in c.catalog_service.peer_list():
+                pr = probed.get(name)
+                rows.append((
+                    name, host, port,
+                    pr[1] if pr else "coordinator-peer",
+                    bool(pr[2]) if pr else False,
+                    int(pr[9]) if pr else -1,
+                    int(pr[4]) if pr else -1,
+                ))
+            return Result(
+                "SELECT", rows,
+                ["name", "host", "port", "role", "up", "catalog_epoch",
+                 "stream_lag_bytes"],
+                len(rows),
+            )
+        if e.name == "pg_replica_status":
+            rows = self.cluster.replica_router.status_rows()
+            with self.cluster._replica_stats_mu:
+                stats = dict(self.cluster.replica_stats)
+            rows = [
+                r + (stats["replica_reads"], stats["stale_read_refused"])
+                for r in rows
+            ] or [(
+                "-", "-", -1, -1.0,
+                stats["replica_reads"], stats["stale_read_refused"],
+            )]
+            return Result(
+                "SELECT", rows,
+                ["target", "repl_addr", "acked", "staleness_s",
+                 "replica_reads", "stale_read_refused"],
+                len(rows),
             )
         if e.name == "pg_rebalance_wait":
             # block until the in-flight rebalance (if any) finishes;
@@ -4908,10 +5073,16 @@ class Session:
                     self.txn.own_writes_view() if self.txn else None
                 ),
                 dn_channels=self.cluster.dn_channels,
-                min_lsn=(
-                    self.cluster.persistence.wal.position
-                    if self.cluster.persistence is not None
-                    else 0
+                min_lsn=max(
+                    (
+                        self.cluster.persistence.wal.position
+                        if self.cluster.persistence is not None
+                        else 0
+                    ),
+                    # peer CN: the read-your-writes floor from the last
+                    # FORWARDED commit (the primary's wal_pos) — local
+                    # WAL position alone would miss it while replay lags
+                    self.last_commit_lsn,
                 ),
                 local_only_tables=(
                     set(_SYSTEM_VIEWS) | self.cluster.local_tables
@@ -4933,6 +5104,12 @@ class Session:
                 ),
                 node_generation=self.cluster.node_generation,
                 delta_scan=self._delta_scan(),
+                local_applied=(
+                    (lambda rec=self.cluster.catalog_receiver:
+                     rec.applied)
+                    if self.cluster.catalog_receiver is not None
+                    else None
+                ),
             )
             try:
                 from opentenbase_tpu.net.pool import ChannelFenced
@@ -8615,22 +8792,27 @@ def _sv_cluster_health(c: Cluster):
     # executed on (the watchdog's stamp) — a tunnel loss shows here in
     # one view instead of only in a bench JSON post-mortem.
     active = sum(1 for s in c.sessions if s.state == "active")
-    # live role transitions (self-healing HA): a hot standby shows
-    # 'standby' until promotion flips it read-write ('coordinator'),
-    # and a fenced ex-primary shows 'fenced' until it resyncs
-    if getattr(c, "ha_demoted", False):
-        cn_role = "fenced"
-    elif c.read_only:
-        cn_role = "standby"
-    else:
-        cn_role = "coordinator"
+    # live role transitions (self-healing HA + multi-CN): a hot standby
+    # shows 'standby' until promotion flips it read-write
+    # ('coordinator'), a fenced ex-primary shows 'fenced' until it
+    # resyncs, and a streaming peer CN shows 'coordinator-peer'
+    cn_role = c.catalog_service.role()
     gen = int(getattr(c, "node_generation", 0))
+    # peer side: catalog stream lag behind the primary (0 on a primary,
+    # -1 when the stream is down / primary unreachable)
+    own_lag = c.catalog_service.stream_lag()
     rows.append((
-        "cn0", cn_role, True, 0.0, 0, active,
+        getattr(c, "coordinator_name", "cn0") or "cn0",
+        cn_role, True, 0.0, own_lag, active,
         len(_fault.armed()),
         getattr(c, "_last_device_platform", None) or "",
         gen,
+        int(c.catalog_epoch),
     ))
+    # one row per REGISTERED peer coordinator (primary side): probed
+    # live, with catalog stream lag from the primary's own WAL end
+    for prow in c.catalog_service.peer_rows():
+        rows.append(prow)
     try:
         gts_ok = (
             c.gts.ping() if hasattr(c.gts, "ping")
@@ -8638,7 +8820,7 @@ def _sv_cluster_health(c: Cluster):
         )
     except Exception:
         gts_ok = False
-    rows.append(("gtm0", "gtm", bool(gts_ok), 0.0, 0, 0, 0, "", gen))
+    rows.append(("gtm0", "gtm", bool(gts_ok), 0.0, 0, 0, 0, "", gen, -1))
     chans = getattr(c, "dn_channels", None) or {}
     if chans:
         c.probe_datanodes()
@@ -8650,6 +8832,7 @@ def _sv_cluster_health(c: Cluster):
             # in-process data plane: the DN *is* this process
             rows.append((
                 f"dn{n}", "datanode", True, 0.0, 0, 0, 0, "", gen,
+                int(c.catalog_epoch),
             ))
             continue
         up = bool(h and h.get("ok"))
@@ -8665,6 +8848,7 @@ def _sv_cluster_health(c: Cluster):
             int((h or {}).get("armed_faults") or 0) if up else 0,
             "",
             int((h or {}).get("generation") or 0) if up else -1,
+            int((h or {}).get("catalog_epoch") or -1) if up else -1,
         ))
     return rows
 
@@ -9175,6 +9359,10 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
             # fencing epoch of the node's timeline (self-healing HA):
             # bumps on every promotion; -1 on an unreachable DN
             "generation": t.INT8,
+            # the node's catalog/DDL epoch (coord/): identical across
+            # CNs once the catalog stream is caught up; -1 when the
+            # node does not carry one (GTM) or is unreachable
+            "catalog_epoch": t.INT8,
         },
         _sv_cluster_health,
     ),

@@ -206,6 +206,7 @@ class DistExecutor:
         retry_backoff_ms: float = 25.0,  # base backoff (doubles per try)
         node_generation: int = 0,  # fencing epoch carried on wire ops
         delta_scan: bool = True,  # enable_delta_scan GUC (off = fold-on-read)
+        local_applied=None,  # callable -> local replay LSN (replica CN)
     ):
         self.catalog = catalog
         self.node_stores = node_stores
@@ -266,6 +267,12 @@ class DistExecutor:
         # base + pending deltas without absorbing; off restores the
         # legacy fold-on-read path (the HTAP bench baseline)
         self.delta_scan = bool(delta_scan)
+        # multi-coordinator serving: on a PEER CN the local stores are a
+        # REPLICA, not the authoritative copy — a fragment failover to
+        # them is only sound once local replay has reached min_lsn (the
+        # session's read-your-writes floor). None = primary-side read,
+        # local stores are the caught-up copy by definition.
+        self.local_applied = local_applied
         self.retry_stats = {"retries": 0, "failovers": 0, "cancels": 0}
         # monotonic per-attempt suffix for cancel tokens (see
         # _exec_remote): itertools.count is atomic under the GIL, so
@@ -495,6 +502,18 @@ class DistExecutor:
                                 raise
                             self._check_deadline()
                             if retries >= self.fragment_retries:
+                                if (
+                                    self.local_applied is not None
+                                    and self.min_lsn
+                                    and self.local_applied()
+                                    < self.min_lsn
+                                ):
+                                    # replica-side guard: OUR stores
+                                    # have not replayed up to the
+                                    # session's floor — a failover here
+                                    # would serve the stale read the
+                                    # floor exists to forbid
+                                    raise
                                 # failover: the coordinator's own
                                 # stores ARE the caught-up copy the DN
                                 # was replicating (primary-side read)

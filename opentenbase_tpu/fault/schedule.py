@@ -848,6 +848,379 @@ def run_rebalance_schedule(
     return verdict
 
 
+# ---------------------------------------------------------------------------
+# Multi-coordinator chaos (coord/): kill the primary CN mid-DDL-stream
+# ---------------------------------------------------------------------------
+
+class _MultiCNTraffic:
+    """Seeded traffic against a two-coordinator cluster: one writer on
+    the primary (over the wire, so the kill severs it like a real
+    client), one writer on the peer CN (exercising write forwarding +
+    read-your-writes), and a reader on the peer probing the one
+    invariant a streamed catalog must keep under a DDL storm — the
+    column shape of a CACHED statement never regresses. A stale plan
+    served after the peer replayed an ``ADD COLUMN`` would show fewer
+    columns than an earlier read already proved exist."""
+
+    def __init__(self, primary_addr, peer, seed: int):
+        self.primary_addr = primary_addr
+        self.peer = peer
+        self.seed = seed
+        self.stop_evt = threading.Event()
+        self.killed_evt = threading.Event()  # failures after this: excused
+        self.acked: set = set()              # (client, seq)
+        self.failures: list = []
+        self.ryw_violations: list = []
+        self.shape_violations: list = []
+        self.reads_ok = 0
+        self._max_cols = 0
+        self._mu = threading.Lock()
+        self.threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        for target, cid in (
+            (self._primary_writer, 0), (self._peer_writer, 1),
+        ):
+            t = threading.Thread(target=target, args=(cid,), daemon=True)
+            t.start()
+            self.threads.append(t)
+        t = threading.Thread(target=self._peer_reader, daemon=True)
+        t.start()
+        self.threads.append(t)
+
+    def stop(self) -> None:
+        self.stop_evt.set()
+        for t in self.threads:
+            t.join(timeout=30)
+
+    def _note_failure(self, cid: int, seq: int, e: Exception) -> None:
+        if self.killed_evt.is_set():
+            return  # the primary is dead — failing is the correct outcome
+        with self._mu:
+            self.failures.append({
+                "client": cid, "seq": seq,
+                "error": f"{type(e).__name__}: {e}",
+            })
+
+    def _primary_writer(self, cid: int) -> None:
+        from opentenbase_tpu.net.client import connect_tcp
+
+        rng = random.Random(self.seed * 1000 + cid)
+        cl = None
+        seq = 0
+        while not self.stop_evt.is_set():
+            seq += 1
+            k = cid * 1_000_000 + seq
+            try:
+                if cl is None:
+                    cl = connect_tcp(host=self.primary_addr[0],
+                                     port=self.primary_addr[1])
+                cl.execute(
+                    f"insert into mc_t (k, client, seq)"
+                    f" values ({k}, {cid}, {seq})"
+                )
+                with self._mu:
+                    self.acked.add((cid, seq))
+            except Exception as e:
+                cl = None
+                self._note_failure(cid, seq, e)
+                if self.killed_evt.is_set():
+                    return
+            self.stop_evt.wait(0.002 + rng.random() * 0.006)
+
+    def _peer_writer(self, cid: int) -> None:
+        rng = random.Random(self.seed * 1000 + cid)
+        s = self.peer.cluster.session()
+        seq = 0
+        while not self.stop_evt.is_set():
+            seq += 1
+            k = cid * 1_000_000 + seq
+            try:
+                # forwards to the primary through the session service;
+                # the reply's wal_pos becomes the session's
+                # read-your-writes floor
+                s.execute(
+                    f"insert into mc_t (k, client, seq)"
+                    f" values ({k}, {cid}, {seq})"
+                )
+                with self._mu:
+                    self.acked.add((cid, seq))
+                if seq % 8 == 0:
+                    # read-your-writes: the row this session just got
+                    # acked must be visible to its own LOCAL read
+                    got = s.query(
+                        f"select client, seq from mc_t where k = {k}"
+                    )
+                    if got != [(cid, seq)]:
+                        with self._mu:
+                            self.ryw_violations.append({
+                                "client": cid, "seq": seq, "got": got,
+                            })
+            except Exception as e:
+                self._note_failure(cid, seq, e)
+                if self.killed_evt.is_set():
+                    return
+            self.stop_evt.wait(0.002 + rng.random() * 0.006)
+
+    def _peer_reader(self) -> None:
+        rng = random.Random(self.seed * 2000)
+        s = self.peer.cluster.session()
+        # both strings are CONSTANT so the peer's plan cache can hit:
+        # a hit served across a replayed DDL is exactly the staleness
+        # this schedule exists to rule out
+        q_shape = "select * from mc_t where k = -1"
+        q_agg = "select max(seq) from mc_t where client = 0"
+        while not self.stop_evt.is_set():
+            try:
+                res = s.execute(q_shape)
+                ncols = len(res.columns)
+                with self._mu:
+                    if ncols < self._max_cols:
+                        self.shape_violations.append({
+                            "cols": ncols, "seen_max": self._max_cols,
+                        })
+                    self._max_cols = max(self._max_cols, ncols)
+                    self.reads_ok += 1
+                if rng.random() < 0.5:
+                    s.query(q_agg)
+            except Exception as e:
+                self._note_failure(-1, -1, e)
+            self.stop_evt.wait(0.004 + rng.random() * 0.008)
+
+
+def run_multicn_schedule(
+    seed: int,
+    workdir: str,
+    duration_s: float = 4.0,
+    keep: bool = False,
+) -> dict:
+    """One seeded multi-coordinator crash schedule: a primary CN
+    serving wire clients, a peer CN (coord/) streaming its WHOLE WAL
+    and forwarding writes, seeded traffic on both, a DDL storm adding
+    columns on the primary, the replication stream TORN at seeded
+    positions early in the run, and the primary killed mid-DDL-stream
+    at a seeded time. The peer then promotes and the verdict checks:
+
+    1. **zero lost acked writes** — ``synchronous_commit =
+       remote_write`` with the peer as the sole walsender standby makes
+       every ack wait for the peer's applied position, so every
+       client-acked (client, seq) row must exist on the promoted peer
+       exactly once (torn-window acks are covered by a post-tear
+       barrier write the harness waits on);
+    2. **zero stale cache hits** — the peer reader's column shape never
+       regresses (a cached plan surviving a replayed ADD COLUMN would
+       show fewer columns than an earlier read proved), AND the peer's
+       plan cache records a real epoch invalidation;
+    3. **zero lost acked DDL** — the promoted catalog shows at least
+       3 + acked-DDL columns on mc_t;
+    4. **read-your-writes** — a peer session's own forwarded commit is
+       always visible to its next local read;
+    5. **liveness** — both writers, the reader, and the storm made
+       progress before the kill.
+    """
+    from opentenbase_tpu.coord.peer import PeerCoordinator
+    from opentenbase_tpu.engine import Cluster
+    from opentenbase_tpu.net.client import connect_tcp
+    from opentenbase_tpu.net.server import ClusterServer
+    from opentenbase_tpu.storage.replication import WalSender
+
+    os.makedirs(workdir, exist_ok=True)
+    verdict: dict = {"seed": seed, "violations": []}
+    bad = verdict["violations"]
+    rng = random.Random(seed)
+    traffic = None
+    sender = server = peer = promoted = None
+    ddl_acked = [0]
+    try:
+        _fault.set_chaos_seed(seed)
+        c = Cluster(
+            num_datanodes=2, shard_groups=32,
+            data_dir=os.path.join(workdir, "cn0"),
+        )
+        boot = c.session()
+        boot.execute(
+            "create table mc_t (k bigint, client bigint, seq bigint)"
+            " distribute by shard(k)"
+        )
+        vals = ",".join(f"({9_000_000 + i}, 99, {i})" for i in range(500))
+        boot.execute(f"insert into mc_t values {vals}")
+        pre_seed = {(99, i) for i in range(500)}
+        sender = WalSender(c.persistence, poll_s=0.005)
+        server = ClusterServer(c).start()
+        peer = PeerCoordinator(
+            os.path.join(workdir, "cn1"), num_datanodes=2,
+            shard_groups=32, name="cn1",
+        ).follow(sender.host, sender.port, "127.0.0.1", server.port)
+        if not peer.wait_applied(c.persistence.wal.position, 15.0):
+            bad.append({"invariant": "harness",
+                        "error": "peer never caught up at boot"})
+            raise RuntimeError("boot catch-up failed")
+        # from here every ack waits on the peer's applied position
+        c.conf_gucs["synchronous_commit"] = "remote_write"
+        # chaos: seeded ack-path delays for the whole run, plus a torn
+        # replication stream during the early window
+        _fault.inject("repl/ack_recv", "delay(40)", "prob(0.05)")
+        _fault.inject("repl/wal_stream", "wal_torn", "prob(0.03)")
+        traffic = _MultiCNTraffic(
+            ("127.0.0.1", server.port), peer, seed
+        )
+        traffic.start()
+        # DDL storm on the primary over the wire (dies with the kill)
+        storm_stop = threading.Event()
+
+        def _storm():
+            srng = random.Random(seed * 3000)
+            cl = None
+            i = 0
+            while not storm_stop.is_set():
+                i += 1
+                try:
+                    if cl is None:
+                        cl = connect_tcp(host="127.0.0.1",
+                                         port=server.port)
+                    cl.execute(f"alter table mc_t add column c{i} bigint")
+                    ddl_acked[0] += 1
+                except Exception as e:
+                    cl = None
+                    if traffic.killed_evt.is_set():
+                        return
+                    bad.append({"invariant": "harness",
+                                "error": f"DDL storm failed pre-kill: "
+                                f"{type(e).__name__}: {e}"})
+                    return
+                storm_stop.wait(0.05 + srng.random() * 0.05)
+
+        storm = threading.Thread(target=_storm, daemon=True)
+        storm.start()
+        # torn window ends at 35%: clear the tear, then a barrier write
+        # whose applied-wait proves the stream reconnected and caught
+        # up — every ack before this point is covered by the barrier,
+        # every ack after it by the remote_write quorum wait
+        time.sleep(max(duration_s * 0.35, 0.3))
+        _fault.clear("repl/wal_stream")
+        mk = connect_tcp(host="127.0.0.1", port=server.port)
+        wr = mk.execute("insert into mc_t (k, client, seq)"
+                        " values (-777, 98, 1)")
+        mk.close()
+        if not peer.wait_applied(wr.wal_pos, 15.0):
+            bad.append({"invariant": "harness",
+                        "error": "post-tear barrier never applied"})
+            raise RuntimeError("barrier failed")
+        verdict["barrier_wal"] = wr.wal_pos
+        # run on, then kill the primary mid-DDL-stream at a seeded time
+        time.sleep(max(duration_s * (0.2 + rng.random() * 0.25), 0.2))
+        verdict["killed_at_wal"] = c.persistence.wal.position
+        traffic.killed_evt.set()
+        server.stop()
+        sender.stop()
+        storm_stop.set()
+        time.sleep(0.2)  # post-kill traffic against the dead primary
+        traffic.stop()
+        storm.join(timeout=10)
+        verdict["ddl_acked"] = ddl_acked[0]
+        verdict["acked_writes"] = len(traffic.acked)
+        # positive cache-coherence witness BEFORE promote flips roles:
+        # the peer's plan cache must have recorded a replayed-DDL epoch
+        # invalidation (otherwise the shape check proved nothing)
+        inval_epoch = int(
+            peer.cluster.serving.plan_cache.last_invalidation_epoch
+        )
+        verdict["peer_invalidation_epoch"] = inval_epoch
+        # the peer takes over; streamed WAL carried every acked write,
+        # every DDL, and every gid decision the primary made durable
+        c2 = peer.promote()
+        promoted = c2
+        s2 = c2.session()
+        rows = s2.query("select client, seq from mc_t")
+        seen: dict = {}
+        for cid, sq in rows:
+            seen[(cid, sq)] = seen.get((cid, sq), 0) + 1
+        expected = traffic.acked | pre_seed | {(98, 1)}
+        lost = [key for key in expected if key not in seen]
+        dups = [key for key, n in seen.items() if n > 1]
+        verdict["lost_acked_writes"] = len(lost)
+        if lost:
+            bad.append({"invariant": "zero_lost_acked_writes",
+                        "rows": sorted(lost)[:10], "count": len(lost)})
+        if dups:
+            bad.append({"invariant": "no_duplicates",
+                        "rows": sorted(dups)[:10], "count": len(dups)})
+        ncols = len(s2.execute("select * from mc_t where k = -1").columns)
+        verdict["final_columns"] = ncols
+        if ncols < 3 + ddl_acked[0]:
+            bad.append({
+                "invariant": "zero_lost_acked_ddl",
+                "columns": ncols, "acked_ddl": ddl_acked[0],
+            })
+        if traffic.shape_violations:
+            bad.append({
+                "invariant": "zero_stale_cache_hits",
+                "cases": traffic.shape_violations[:10],
+                "count": len(traffic.shape_violations),
+            })
+        if ddl_acked[0] > 0 and traffic.reads_ok > 10 and inval_epoch < 0:
+            bad.append({
+                "invariant": "zero_stale_cache_hits",
+                "error": "peer plan cache never recorded a streamed-DDL "
+                "invalidation — the shape probe proved nothing",
+            })
+        if traffic.ryw_violations:
+            bad.append({
+                "invariant": "read_your_writes",
+                "cases": traffic.ryw_violations[:10],
+                "count": len(traffic.ryw_violations),
+            })
+        if traffic.failures:
+            bad.append({
+                "invariant": "zero_failed_pre_kill",
+                "cases": traffic.failures[:10],
+                "count": len(traffic.failures),
+            })
+        acked_by = {cid for cid, _ in traffic.acked}
+        if (
+            acked_by != {0, 1} or traffic.reads_ok == 0
+            or ddl_acked[0] < 1
+        ):
+            bad.append({
+                "invariant": "liveness",
+                "error": "a writer, the reader, or the DDL storm never "
+                "made progress",
+                "acked_by": sorted(acked_by),
+                "reads_ok": traffic.reads_ok,
+                "ddl_acked": ddl_acked[0],
+            })
+        verdict["reads_ok"] = traffic.reads_ok
+    except Exception as e:  # harness failure IS a failed run
+        bad.append({
+            "invariant": "harness",
+            "error": f"{type(e).__name__}: {e}",
+        })
+    finally:
+        _fault.clear()
+        _fault.set_chaos_seed(None)
+        if traffic is not None and not traffic.stop_evt.is_set():
+            traffic.killed_evt.set()
+            traffic.stop()
+        for closer in (
+            (server.stop if server is not None else None),
+            (sender.stop if sender is not None else None),
+            (promoted.close if promoted is not None else None),
+            (peer.stop if peer is not None and promoted is None else None),
+        ):
+            if closer is None:
+                continue
+            try:
+                closer()
+            except Exception:
+                pass
+        if not keep:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+    verdict["chaos_gate"] = "ok" if not verdict["violations"] else "fail"
+    return verdict
+
+
 def run_schedules(
     base_seed: int,
     count: int,

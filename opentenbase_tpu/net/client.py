@@ -105,6 +105,10 @@ class WireResult:
     rows: list = field(default_factory=list)
     columns: list = field(default_factory=list)
     rowcount: int = 0
+    # the server's WAL end just after this statement (0 when the server
+    # predates the field): the causal token a peer coordinator's
+    # read-your-writes wait targets after forwarding a write here
+    wal_pos: int = 0
 
 
 class AuthError(WireError):
@@ -200,6 +204,7 @@ class ClientSession:
             [tuple(r) for r in resp["rows"]],
             resp["columns"],
             resp["rowcount"],
+            int(resp.get("wal_pos", 0)),
         )
 
     def query(self, sql: str) -> list[tuple]:
@@ -228,3 +233,117 @@ class ClientSession:
 
 def connect_tcp(host: str = "127.0.0.1", port: int = 5433, **kw) -> ClientSession:
     return ClientSession(host, port, **kw)
+
+
+class RoutingClient:
+    """Multi-coordinator client — libpq's multi-host conninfo
+    (``host=cn0,cn1 target_session_attrs=any``) for the serving plane.
+
+    Takes every CN's SQL endpoint and keeps ONE live session, chosen
+    round-robin across instances so a fleet of clients spreads over the
+    fleet of CNs (any CN serves any statement: peers execute reads
+    locally and forward writes to the primary themselves). When the
+    current CN dies mid-statement the client fails over to the next
+    endpoint and retries ONCE — but only outside an open transaction
+    and only for connection-class errors; an in-transaction failure
+    surfaces to the caller, who alone knows what to replay.
+    """
+
+    _next_start = 0  # instance-level round-robin seed, wraps harmlessly
+
+    def __init__(self, endpoints: list, **kw):
+        if not endpoints:
+            raise ValueError("RoutingClient needs at least one endpoint")
+        self._endpoints = [(str(h), int(p)) for h, p in endpoints]
+        self._kw = kw
+        self._idx = RoutingClient._next_start % len(self._endpoints)
+        RoutingClient._next_start += 1
+        self._conn: ClientSession | None = None
+        self._in_txn = False
+        # session state replayed onto the next CN after a failover
+        # (the pgbouncer server_reset_query inverse: we RESTORE state)
+        self._session_state: list[str] = []
+
+    @property
+    def endpoint(self) -> tuple:
+        """The (host, port) currently serving this client."""
+        return self._endpoints[self._idx]
+
+    def _connect(self) -> ClientSession:
+        if self._conn is None:
+            last: Exception | None = None
+            for _ in range(len(self._endpoints)):
+                host, port = self._endpoints[self._idx]
+                try:
+                    self._conn = ClientSession(host, port, **self._kw)
+                    break
+                except (OSError, WireError) as e:
+                    last = e
+                    self._idx = (self._idx + 1) % len(self._endpoints)
+            if self._conn is None:
+                raise RetryExhausted(
+                    f"no coordinator reachable among "
+                    f"{self._endpoints}: {last}"
+                ) from last
+            for state_sql in self._session_state:
+                self._conn.execute(state_sql)
+        return self._conn
+
+    def _note(self, sql: str) -> None:
+        s = sql.strip().lower()
+        if s.startswith("begin") or s.startswith("start transaction"):
+            self._in_txn = True
+        elif s.startswith("commit") or s.startswith("rollback"):
+            self._in_txn = False
+        elif s.startswith("set ") and not s.startswith("set transaction"):
+            self._session_state.append(sql)
+
+    def execute(self, sql: str) -> WireResult:
+        try:
+            res = self._connect().execute(sql)
+        except (OSError, WireError) as e:
+            if isinstance(e, WireError) and not (
+                "connection closed" in str(e)
+                or (e.sqlstate or "").startswith("08")
+            ):
+                raise  # statement error, not a dead CN
+            self._drop()
+            if self._in_txn:
+                self._in_txn = False
+                raise WireError(
+                    f"coordinator lost mid-transaction: {e}"
+                ) from e
+            self._idx = (self._idx + 1) % len(self._endpoints)
+            res = self._connect().execute(sql)
+        self._note(sql)
+        return res
+
+    def query(self, sql: str) -> list[tuple]:
+        return self.execute(sql).rows
+
+    def _drop(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            try:
+                shutdown_and_close(conn._sock)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            # graceful goodbye; ClientSession.close already ends with
+            # shutdown_and_close on its socket
+            conn.close()  # otb_lint: ignore[socket-shutdown] -- delegate's close() does shutdown_and_close
+
+    def __enter__(self) -> "RoutingClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def connect_any(endpoints: list, **kw) -> RoutingClient:
+    """Open a routed session against a multi-coordinator cluster;
+    ``endpoints`` is [(host, port), ...] of every CN's SQL front end."""
+    return RoutingClient(endpoints, **kw)

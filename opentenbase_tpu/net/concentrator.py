@@ -479,10 +479,19 @@ class PgConcentrator:
         )
         cl.conn.flush()
 
+    @staticmethod
+    def _in_txn(sess) -> bool:
+        """An open transaction on this backend — local, or FORWARDED to
+        the primary CN (peer-coordinator serving: a forwarded BEGIN
+        leaves sess.txn None while the primary-side transaction is
+        open; the pin must hold for either kind or another client's
+        statements would ride a foreign transaction)."""
+        return sess.txn is not None or getattr(sess, "_fwd_in_txn", False)
+
     def _txn_status(self, cl: _Client) -> bytes:
         sess = cl.pinned
         return b"T" if (
-            sess is not None and sess.txn is not None
+            sess is not None and self._in_txn(sess)
         ) else b"I"
 
     # -- dispatch + shed ---------------------------------------------------
@@ -645,8 +654,9 @@ class PgConcentrator:
                 self.stats["statements"] += 1
             # a statement may have opened a transaction the classifier
             # did not see (multi-statement strings): a backend with an
-            # open txn can never return to the pool
-            if cl.pinned is None and sess.txn is not None:
+            # open txn — local or forwarded — can never return to the
+            # pool
+            if cl.pinned is None and self._in_txn(sess):
                 cl.pinned = sess
                 with self._mu:
                     self.stats["pinned"] += 1
@@ -666,7 +676,7 @@ class PgConcentrator:
                             PgWireServer._sqlstate_of(err),
                         )
                     cl.conn.ready(
-                        b"T" if sess.txn is not None else b"I"
+                        b"T" if self._in_txn(sess) else b"I"
                     )
                 except (OSError, FaultDropConnection):
                     self._teardown(cl)
@@ -696,7 +706,7 @@ class PgConcentrator:
             if cl.closed:
                 self._finish_close(cl)
                 return
-            if sess.txn is not None or cl.state_pinned:
+            if self._in_txn(sess) or cl.state_pinned:
                 return  # stays pinned
             cl.pinned = None
             with self._mu:
@@ -749,7 +759,9 @@ class PgConcentrator:
 
     def _recycle(self, sess, retire: bool) -> None:
         try:
-            if sess.txn is not None:
+            if self._in_txn(sess):
+                # a forwarded transaction rolls back on the PRIMARY —
+                # Session.execute routes the rollback there itself
                 with self._exec_lock:
                     sess.execute("rollback")
         except Exception as e:

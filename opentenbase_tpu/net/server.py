@@ -201,14 +201,36 @@ class ClusterServer:
                     if getattr(c, "ha_demoted", False):
                         role = "fenced"
                     elif c.read_only:
-                        role = "standby"
+                        # a streaming peer coordinator (coord/peer.py)
+                        # is read_only like a hot standby but serves a
+                        # different contract (local reads + forwarded
+                        # writes) — the probe must say which it is
+                        role = (
+                            getattr(c, "coordinator_role", "")
+                            or "standby"
+                        )
+                        if role == "coordinator":
+                            role = "standby"
                     else:
                         role = "coordinator"
+                    rec = getattr(c, "catalog_receiver", None)
                     send_frame(conn, {
                         "ok": True,
                         "role": role,
                         "generation": int(
                             getattr(c, "node_generation", 0)
+                        ),
+                        # multi-CN health surface: the probed node's
+                        # catalog epoch + stream-applied offset let the
+                        # primary render per-coordinator rows (and lag)
+                        # from one probe, no extra protocol
+                        "catalog_epoch": int(c.catalog_epoch),
+                        "applied": int(
+                            rec.applied if rec is not None
+                            else (
+                                c.persistence.wal.position
+                                if c.persistence else 0
+                            )
                         ),
                     })
                     continue
@@ -271,6 +293,13 @@ class ClusterServer:
                             "columns": res.columns,
                             "rows": [list(r) for r in res.rows],
                             "rowcount": res.rowcount,
+                            # WAL end after the statement: the causal
+                            # token a forwarding peer CN waits on so a
+                            # read after its own (forwarded) write is
+                            # never stale (read-your-writes across CNs)
+                            "wal_pos": int(
+                                self.cluster.persistence.wal.position
+                            ) if self.cluster.persistence else 0,
                         },
                     )
                 except FaultDropConnection:

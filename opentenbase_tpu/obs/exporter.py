@@ -218,6 +218,49 @@ def render_cluster_metrics(cluster) -> str:
         int(ha.get("fenced_refusals", 0)),
     ))
 
+    # multi-coordinator serving plane (coord/): CN liveness, catalog
+    # stream health, and the replica-read outcome counters — the
+    # ISSUE-18 coherence evidence, scrapeable per node
+    cs = getattr(cluster, "catalog_service", None)
+    if cs is not None:
+        _head(out, "otb_cn_active", "gauge",
+              "Coordinators currently serving (this node plus every "
+              "registered peer that answers its ping)")
+        try:
+            active = int(cs.active_coordinators())
+        except Exception:
+            active = -1
+        out.append(_line("otb_cn_active", {}, active))
+        _head(out, "otb_catalog_stream_lag_bytes", "gauge",
+              "Primary-CN WAL bytes not yet applied by this peer's "
+              "catalog stream (0 on the primary, -1 unknown)")
+        out.append(_line(
+            "otb_catalog_stream_lag_bytes", {}, int(cs.stream_lag()),
+        ))
+    rstats = getattr(cluster, "replica_stats", None)
+    if rstats is not None:
+        with cluster._replica_stats_mu:
+            rstats = dict(rstats)
+        _head(out, "otb_replica_read_total", "counter",
+              "Reads served from bounded-staleness standbys")
+        out.append(_line(
+            "otb_replica_read_total", {},
+            int(rstats.get("replica_reads", 0)),
+        ))
+        _head(out, "otb_stale_read_refused_total", "counter",
+              "Replica-routed reads refused back to the primary "
+              "because no standby proved max_staleness")
+        out.append(_line(
+            "otb_stale_read_refused_total", {},
+            int(rstats.get("stale_read_refused", 0)),
+        ))
+        _head(out, "otb_forwarded_statements_total", "counter",
+              "Statements this peer CN forwarded to the primary")
+        out.append(_line(
+            "otb_forwarded_statements_total", {},
+            int(rstats.get("forwarded", 0)),
+        ))
+
     # matview counters
     if cluster.matviews:
         _head(out, "otb_matview_refreshes_total", "counter",

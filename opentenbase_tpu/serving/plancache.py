@@ -235,6 +235,13 @@ class PlanCache:
             "hits": 0, "misses": 0, "inserts": 0, "evictions": 0,
             "invalidations": 0, "forced_misses": 0, "flushes": 0,
         }
+        # the catalog epoch current when the last stale entry was
+        # discarded. On a peer coordinator the epoch advances from
+        # REPLAYED D-records (persist._apply), so this is the multi-CN
+        # coherence proof's witness: after remote DDL, a re-plan on
+        # this CN shows an invalidation stamped with the NEW epoch —
+        # a hit under the old plan is impossible, and visibly so.
+        self.last_invalidation_epoch = -1
 
     def lookup(self, key, epoch: int) -> Optional[_PlanEntry]:
         try:
@@ -253,10 +260,12 @@ class PlanCache:
                 return None
             if e.epoch != epoch:
                 # planned under an older catalog: DDL/redistribute/
-                # ANALYZE landed since — discard, count it
+                # ANALYZE landed since (locally, or replayed off the
+                # primary CN's catalog stream) — discard, count it
                 del self._entries[key]
                 self.stats["invalidations"] += 1
                 self.stats["misses"] += 1
+                self.last_invalidation_epoch = int(epoch)
                 return None
             self._entries.move_to_end(key)
             e.hits += 1
@@ -289,6 +298,10 @@ class PlanCache:
             rows.append(("generic_queries", len(
                 {fp for fp, _consts in self._entries}
             )))
+            rows.append((
+                "last_invalidation_epoch",
+                int(self.last_invalidation_epoch),
+            ))
         return rows
 
 

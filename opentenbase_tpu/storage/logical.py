@@ -636,16 +636,35 @@ class SubscriptionWorker:
                 self.last_error = ""
             except Exception as e:  # connection drop, publisher restart
                 self.last_error = str(e)
+                self.cluster.log.emit(
+                    "warning", "logical",
+                    f"subscription {self.name!r} poll failed "
+                    f"(reconnecting next cycle): {e!r:.200}",
+                )
                 try:
                     if client is not None:
                         client.close()
-                except Exception:
-                    pass
+                except Exception as ce:
+                    # close on an already-broken publisher socket; the
+                    # reconnect below replaces it either way, but the
+                    # double fault is worth a log line
+                    self.cluster.log.emit(
+                        "log", "logical",
+                        f"subscription {self.name!r}: close of broken "
+                        f"publisher connection failed: {ce!r:.120}",
+                    )
                 client = None
             self._stop.wait(self.poll_s)
         if client is not None:
             try:
                 client.close()
-            except Exception:
-                pass
+            except Exception as e:
+                # teardown path: the worker is exiting and the socket
+                # dies with the process, but a failed close still marks
+                # the channel broken in the log
+                self.cluster.log.emit(
+                    "log", "logical",
+                    f"subscription {self.name!r}: close at shutdown "
+                    f"failed: {e!r:.120}",
+                )
 

@@ -92,6 +92,14 @@ class WalSender:
         self._peers_mu = threading.Lock()
         # remote_write waiters park here; every ack wakes them
         self._ack_cv = threading.Condition(self._peers_mu)
+        # staleness evidence ring: (wal_end_offset, monotonic_time)
+        # pairs noted by the stream loops. An entry (off, t) means "at
+        # time t the primary WAL ended at off" — so a peer whose acked
+        # offset covers off was provably CURRENT at t, and its
+        # staleness bound is now - t. This is what read_replica routing
+        # consults: a duration proof with no per-read RPC (the ack
+        # table supplies the offsets, this ring supplies the clock).
+        self._pos_ring: list = []
         # register with the persistence so the coordinator's exporter
         # can find every live sender without new plumbing
         getattr(persistence, "wal_senders", []).append(self)
@@ -120,6 +128,56 @@ class WalSender:
                 (ent[0], int(ent[2]))
                 for ent in self._peers.values() if ent[2] >= 0
             ]
+
+    _RING_CAP = 1024
+
+    def _note_position(self) -> None:
+        """Record (wal_end, now) in the staleness ring. Called from the
+        stream loops (>= poll_s cadence while any peer is attached); a
+        repeated offset refreshes the existing entry's time — the WAL
+        end being unchanged since t means a peer caught up to it at t
+        is still current."""
+        off = int(self.persistence.wal.position)
+        t = time.monotonic()
+        with self._peers_mu:
+            ring = self._pos_ring
+            if ring and ring[-1][0] == off:
+                ring[-1] = (off, t)
+                return
+            ring.append((off, t))
+            if len(ring) > self._RING_CAP:
+                del ring[: len(ring) - self._RING_CAP]
+
+    def peer_staleness(self) -> list:
+        """[(peer_addr, acked_offset, staleness_seconds)] for every
+        peer that has acked at least once. Staleness is the time since
+        the peer was PROVABLY caught up with the primary WAL end:
+        0.0 when its ack covers the current position, now - t of the
+        newest ring entry its ack covers otherwise, and +inf when the
+        ring holds no evidence (peer behind all recorded history)."""
+        now = time.monotonic()
+        pos = int(self.persistence.wal.position)
+        with self._peers_mu:
+            ring = list(self._pos_ring)
+            acks = [
+                (ent[0], int(ent[2]))
+                for ent in self._peers.values() if ent[2] >= 0
+            ]
+        out = []
+        for addr, acked in acks:
+            if acked >= pos:
+                out.append((addr, acked, 0.0))
+                continue
+            proof = None
+            for off, t in reversed(ring):
+                if off <= acked:
+                    proof = t
+                    break
+            out.append((
+                addr, acked,
+                (now - proof) if proof is not None else float("inf"),
+            ))
+        return out
 
     def wait_quorum_acked(
         self, lsn: int, quorum: int, deadline: float
@@ -258,6 +316,7 @@ class WalSender:
             with open(path, "rb") as f:
                 f.seek(offset)
                 while not self._stop.is_set():
+                    self._note_position()
                     # sliding window: once the peer acks at all, cap
                     # bytes-in-flight so a stalled standby backpressures
                     # the stream instead of ballooning socket buffers
@@ -337,6 +396,7 @@ class StandbyCluster:
             self._apply_one(tag, header, arrays)
             self.applied = off
         self._sock: Optional[socket.socket] = None
+        self.repl_addr = ""  # set by start_replication
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.promoted = False
@@ -409,6 +469,14 @@ class StandbyCluster:
             )
         self.source_generation = sender_gen
         self.source_promote_lsn = promote_lsn
+        # our end of the stream socket, as the sender's peer table keys
+        # it ("ip:port") — the handle replica routing uses to find THIS
+        # standby's row in the walsender's ack/staleness tables
+        try:
+            a = self._sock.getsockname()
+            self.repl_addr = f"{a[0]}:{a[1]}"
+        except OSError:
+            self.repl_addr = ""
         self._thread = threading.Thread(target=self._recv_loop, daemon=True)
         self._thread.start()
         return self

@@ -1,0 +1,405 @@
+"""Multi-coordinator serving plane (coord/): peer CNs streaming the
+primary's catalog, write forwarding with read-your-writes, bounded-
+staleness replica reads, and crash-resolution from a surviving peer.
+
+The coherence proofs ISSUE-18 names:
+
+1. DDL on CN-A is visible on CN-B with a plan-cache hit after the
+   remote DDL IMPOSSIBLE (the streamed D-record bumps the peer's
+   catalog epoch before the peer can serve another statement);
+2. a 2PC begun on a killed CN resolves from a surviving peer (the
+   streamed gid decisions make the peer's resolver authoritative);
+3. ``max_staleness`` is enforced both ways — a lagging standby is
+   SKIPPED under fallback 'primary', and the read WAITS under
+   fallback 'wait';
+4. read-your-writes: a peer session's own forwarded commit is always
+   visible to its next local read;
+5. randomized-DML differential: rows read through the peer (and
+   through the multi-host RoutingClient) match the primary
+   byte-identically;
+6. the seeded multi-CN chaos schedule (fault/schedule.py) passes:
+   primary killed mid-DDL-stream, zero lost acked writes, zero stale
+   cache hits.
+"""
+
+import random
+import time
+
+import pytest
+
+from opentenbase_tpu import fault
+from opentenbase_tpu.coord.peer import PeerCoordinator
+from opentenbase_tpu.coord.replica import StandbyTarget
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.fault import FaultError
+from opentenbase_tpu.net.server import ClusterServer
+from opentenbase_tpu.storage.replication import StandbyCluster, WalSender
+
+
+# ---------------------------------------------------------------------------
+# harness: primary CN + wire server + one peer CN on the same WAL
+# ---------------------------------------------------------------------------
+
+
+def _two_cn(tmp_path, shard_groups=16):
+    c = Cluster(
+        num_datanodes=2, shard_groups=shard_groups,
+        data_dir=str(tmp_path / "cn0"),
+    )
+    sender = WalSender(c.persistence, poll_s=0.005)
+    server = ClusterServer(c).start()
+    peer = PeerCoordinator(
+        str(tmp_path / "cn1"), num_datanodes=2,
+        shard_groups=shard_groups, name="cn1",
+    ).follow(sender.host, sender.port, "127.0.0.1", server.port)
+    return c, sender, server, peer
+
+
+def _teardown(c, sender, server, peer, promoted=None):
+    for closer in (
+        server.stop, sender.stop,
+        (promoted.close if promoted is not None else peer.stop),
+        (c.close if promoted is None else (lambda: None)),
+    ):
+        try:
+            closer()
+        except Exception:
+            pass
+
+
+def _caught_up(c, peer, timeout_s=10.0):
+    assert peer.wait_applied(c.persistence.wal.position, timeout_s), (
+        f"peer stuck at {peer.applied} < {c.persistence.wal.position}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1. streamed catalog: remote DDL invalidates the peer's plan cache
+# ---------------------------------------------------------------------------
+
+
+def test_ddl_on_primary_invalidates_peer_plan_cache(tmp_path):
+    """After DDL on CN-A, CN-B must re-plan: the replayed D-record bumps
+    the peer's catalog epoch, so the peer's cached plan (provably HIT
+    just before) is discarded at lookup — witnessed by the
+    pg_stat_plan_cache counters and last_invalidation_epoch."""
+    c, sender, server, peer = _two_cn(tmp_path)
+    try:
+        s = c.session()
+        s.execute(
+            "create table st (k bigint, v bigint) distribute by shard(k)"
+        )
+        s.execute("insert into st values (1, 10), (2, 20)")
+        _caught_up(c, peer)
+        ps = peer.cluster.session()
+        ps.execute("set enable_plan_cache = on")
+        q = "select v from st where k = 1"
+        assert ps.query(q) == [(10,)]
+        assert ps.query(q) == [(10,)]
+        assert ps._last_plan_cache == "hit"  # the cache is provably live
+        before = dict(ps.query("select stat, value from pg_stat_plan_cache"))
+        epoch_before = int(peer.cluster.catalog_epoch)
+        # remote DDL on the primary, replayed through the stream
+        s.execute("alter table st add column w bigint")
+        _caught_up(c, peer)
+        assert int(peer.cluster.catalog_epoch) > epoch_before
+        # the peer CANNOT hit its stale plan: the replayed epoch bump
+        # invalidates at lookup, the statement re-plans, and the new
+        # plan sees the new column
+        assert ps.query(q) == [(10,)]
+        assert ps._last_plan_cache == "miss"
+        after = dict(ps.query("select stat, value from pg_stat_plan_cache"))
+        assert after["invalidations"] > before["invalidations"]
+        assert after["last_invalidation_epoch"] >= epoch_before
+        res = ps.execute("select * from st where k = 1")
+        assert res.columns == ["k", "v", "w"]
+        assert ps.query("select w from st where k = 1") == [(None,)]
+    finally:
+        _teardown(c, sender, server, peer)
+
+
+# ---------------------------------------------------------------------------
+# 2. 2PC begun on a killed CN resolves from the surviving peer
+# ---------------------------------------------------------------------------
+
+
+def test_indoubt_2pc_resolves_from_promoted_peer(tmp_path):
+    """A coordinator that dies between the durable commit record and
+    phase 2 leaves vote journals on the DNs; the streamed WAL carried
+    the gid decision, so the PROMOTED PEER's resolver — the unchanged
+    resolve_indoubt — drives the gid to commit."""
+    from opentenbase_tpu.dn.server import DNServer
+
+    c, sender, server, peer = _two_cn(tmp_path, shard_groups=32)
+    # the DNs stream from their OWN sender so their stream can be
+    # severed (freezing the in-doubt window the way a real partition
+    # would) while the peer CN keeps streaming the decision
+    dn_sender = WalSender(c.persistence, poll_s=0.005)
+    dns = []
+    promoted = None
+    try:
+        s = c.session()
+        s.execute("set enable_fused_execution = off")
+        s.execute(
+            "create table t (k bigint, v bigint) distribute by shard(k)"
+        )
+        s.execute(
+            "insert into t values "
+            + ",".join(f"({i}, {i * 10})" for i in range(8))
+        )
+        for node in (0, 1):
+            dn = DNServer(
+                str(tmp_path / f"dn{node}"), dn_sender.host,
+                dn_sender.port, num_datanodes=2, shard_groups=32,
+            ).start()
+            dns.append(dn)
+            c.attach_datanode(
+                node, "127.0.0.1", dn.port, pool_size=2, rpc_timeout=30,
+            )
+        deadline = time.time() + 20
+        while time.time() < deadline and any(
+            dn.standby.applied < c.persistence.wal.position for dn in dns
+        ):
+            time.sleep(0.02)
+        base = s.query("select count(*) from t")[0][0]
+        # sever the DN stream FIRST: otherwise the commit record
+        # reaches the DNs within milliseconds and their replay retires
+        # the vote journals itself before the peer can prove anything
+        dn_sender.stop()
+        time.sleep(0.1)
+        # the crash: commit record durable, phase 2 never delivered
+        fault.inject("coord/2pc_before_phase2", "error", "once")
+        batch = ",".join(f"({k}, 1)" for k in range(3001, 3009))
+        with pytest.raises(FaultError):
+            s.execute(f"insert into t values {batch}")
+        assert any(dn._twophase_list() for dn in dns)  # votes journaled
+        # the decision IS in the WAL the peer streams — wait for it,
+        # then kill the primary plane entirely
+        _caught_up(c, peer)
+        server.stop()
+        sender.stop()
+        promoted = peer.promote()
+        for node, dn in enumerate(dns):
+            promoted.attach_datanode(
+                node, "127.0.0.1", dn.port, pool_size=2, rpc_timeout=30,
+            )
+        s2 = promoted.session()
+        resolved = s2.query("select pg_resolve_indoubt()")
+        assert resolved and all(o == "committed" for _g, o in resolved)
+        assert all(dn._twophase_list() == [] for dn in dns)
+        assert s2.query("select count(*) from t")[0][0] == base + 8
+    finally:
+        fault.clear()
+        try:
+            dn_sender.stop()
+        except Exception:
+            pass
+        for node in range(len(dns)):
+            for cl in (promoted, c):
+                if cl is None:
+                    continue
+                try:
+                    cl.detach_datanode(node)
+                except Exception:
+                    pass
+        for dn in dns:
+            try:
+                dn.stop()
+            except Exception:
+                pass
+        _teardown(c, sender, server, peer, promoted=promoted)
+
+
+# ---------------------------------------------------------------------------
+# 3. max_staleness: lagging standby skipped AND waited for
+# ---------------------------------------------------------------------------
+
+
+def test_max_staleness_skips_lagging_standby_and_wait_mode_waits(tmp_path):
+    """Both edges of the bound: under fallback 'primary' a standby
+    whose PROVEN staleness exceeds max_staleness is refused (the read
+    serves from the primary, counted stale_read_refused); under
+    fallback 'wait' the same read parks until the standby catches up
+    and then serves from it (counted wait_served)."""
+    c = Cluster(
+        num_datanodes=2, shard_groups=16, data_dir=str(tmp_path / "cn"),
+    )
+    sender = WalSender(c.persistence, poll_s=0.005)
+    sb = StandbyCluster(
+        str(tmp_path / "sb"), num_datanodes=2, shard_groups=16,
+    ).start_replication(sender.host, sender.port)
+    try:
+        s = c.session()
+        s.execute(
+            "create table t (k bigint, v bigint) distribute by shard(k)"
+        )
+        s.execute("insert into t values (1, 10), (2, 20)")
+        assert sb.wait_caught_up(c.persistence, 10.0)
+        c.replica_targets.append(StandbyTarget("sb0", sb))
+        s.execute("set read_routing = replica")
+        s.execute("set max_staleness = '10s'")
+        # fresh standby within bound: the read routes to it
+        assert s.query("select v from t order by k") == [(10,), (20,)]
+        assert s._last_plan_cache == "routed"
+        assert c.replica_stats["replica_reads"] == 1
+        # make the standby lag: every walreceiver loop stalls 400ms.
+        # The receiver is parked in recv() when the fault arms, so the
+        # FIRST frame slips through and lands it in the delay; the
+        # second frame then sits unapplied while the staleness clock
+        # runs on its WAL position.
+        fault.inject("repl/wal_recv", "delay(400)", "prob(1.0)")
+        s.execute("insert into t values (3, 30)")
+        time.sleep(0.05)
+        s.execute("insert into t values (4, 40)")
+        time.sleep(0.15)  # proven staleness now exceeds the bound below
+        # a FRESH session: no last_commit_lsn floor, so what's enforced
+        # here is the staleness bound alone
+        s2 = c.session()
+        s2.execute("set read_routing = replica")
+        s2.execute("set max_staleness = '100ms'")
+        refused_before = c.replica_stats["stale_read_refused"]
+        got = s2.query("select v from t order by k")
+        assert got == [(10,), (20,), (30,), (40,)]  # primary, correctly
+        assert s2._last_plan_cache != "routed"
+        assert c.replica_stats["stale_read_refused"] == refused_before + 1
+        # wait mode: same bound, but the read PARKS until the standby's
+        # replay covers the WAL end again, then serves from it
+        fault.clear("repl/wal_recv")
+        s2.execute("set replica_read_fallback = wait")
+        s2.execute("set replica_read_wait_ms = '5s'")
+        assert s2.query("select v from t order by k") == [
+            (10,), (20,), (30,), (40,)
+        ]
+        assert s2._last_plan_cache == "routed"
+        assert c.replica_stats["wait_served"] >= 1
+        # observability: the health function shows the target
+        rows = s.query("select pg_replica_status()")
+        assert rows and rows[0][0] == "sb0"
+    finally:
+        fault.clear()
+        try:
+            sb.stop()
+        except Exception:
+            pass
+        sender.stop()
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# 4. read-your-writes across the forwarding seam
+# ---------------------------------------------------------------------------
+
+
+def test_peer_read_your_writes_after_forwarded_commit(tmp_path):
+    """A write on the peer forwards to the primary; the SAME session's
+    next local read must see it — the reply's wal_pos is the session's
+    floor and the local read waits for replay to cover it."""
+    c, sender, server, peer = _two_cn(tmp_path)
+    try:
+        s = c.session()
+        s.execute(
+            "create table t (k bigint, v bigint) distribute by shard(k)"
+        )
+        _caught_up(c, peer)
+        ps = peer.cluster.session()
+        for i in range(20):
+            ps.execute(f"insert into t values ({i}, {i * 7})")
+            # immediately readable locally — no sleep, no luck: the
+            # session's last_commit_lsn forces the replay wait
+            assert ps.query(f"select v from t where k = {i}") == [(i * 7,)]
+        assert peer.cluster.replica_stats["forwarded"] >= 20
+        # the writes really live on the primary too
+        assert c.session().query("select count(*) from t") == [(20,)]
+        # and a forwarded transaction block round-trips
+        ps.execute("begin")
+        ps.execute("insert into t values (100, 1)")
+        ps.execute("rollback")
+        assert ps.query("select count(*) from t where k = 100") == [(0,)]
+    finally:
+        _teardown(c, sender, server, peer)
+
+
+# ---------------------------------------------------------------------------
+# 5. randomized-DML differential: peer == primary, byte-identical
+# ---------------------------------------------------------------------------
+
+
+def test_randomized_dml_differential_peer_vs_primary(tmp_path):
+    """Seeded random DML issued THROUGH THE PEER (every write
+    forwarded) must leave both CNs with byte-identical table contents,
+    read three ways: primary session, peer local read, and the
+    multi-host RoutingClient over both CNs' wire servers."""
+    from opentenbase_tpu.net.client import connect_any
+
+    c, sender, server, peer = _two_cn(tmp_path)
+    peer_server = ClusterServer(peer.cluster).start()
+    rng = random.Random(0xD1FF)
+    try:
+        s = c.session()
+        s.execute(
+            "create table dt (k bigint, a bigint, b bigint)"
+            " distribute by shard(k)"
+        )
+        _caught_up(c, peer)
+        ps = peer.cluster.session()
+        live = set()
+        for step in range(120):
+            op = rng.random()
+            k = rng.randrange(40)
+            if op < 0.5 or not live:
+                if k in live:
+                    continue
+                ps.execute(
+                    f"insert into dt values ({k}, {rng.randrange(1000)},"
+                    f" {rng.randrange(1000)})"
+                )
+                live.add(k)
+            elif op < 0.8:
+                k = rng.choice(sorted(live))
+                ps.execute(
+                    f"update dt set a = {rng.randrange(1000)}"
+                    f" where k = {k}"
+                )
+            else:
+                k = rng.choice(sorted(live))
+                ps.execute(f"delete from dt where k = {k}")
+                live.discard(k)
+        _caught_up(c, peer)
+        q = "select k, a, b from dt order by k"
+        want = s.query(q)
+        assert {r[0] for r in want} == live
+        assert ps.query(q) == want  # peer-local replay, byte-identical
+        # multi-host client: sticky CN per instance; two instances to
+        # exercise both starting points of the round-robin
+        endpoints = [
+            ("127.0.0.1", server.port), ("127.0.0.1", peer_server.port),
+        ]
+        for _ in range(2):
+            cl = connect_any(endpoints)
+            assert cl.query(q) == want
+            cl.close()
+    finally:
+        try:
+            peer_server.stop()
+        except Exception:
+            pass
+        _teardown(c, sender, server, peer)
+
+
+# ---------------------------------------------------------------------------
+# 6. the seeded chaos schedule: kill the primary mid-DDL-stream
+# ---------------------------------------------------------------------------
+
+
+def test_multicn_chaos_schedule_seeded(tmp_path):
+    """The acceptance gate: seeded two-CN chaos — torn stream, ack
+    delays, DDL storm, primary killed mid-stream at a seeded time —
+    ends with zero lost acked writes and zero stale cache hits."""
+    from opentenbase_tpu.fault.schedule import run_multicn_schedule
+
+    v = run_multicn_schedule(11, str(tmp_path / "mc"), duration_s=2.5)
+    assert v["chaos_gate"] == "ok", v["violations"]
+    assert v["lost_acked_writes"] == 0
+    assert v["acked_writes"] > 0 and v["ddl_acked"] >= 1
+    assert v["peer_invalidation_epoch"] >= 0
+    assert v["final_columns"] >= 3 + v["ddl_acked"]

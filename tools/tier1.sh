@@ -20,7 +20,8 @@
 #      2-DN sharded join must print per-node rows, and a traced query
 #      must export parseable Chrome-trace JSON;
 #   7. matview / chaos / HA-chaos-schedule / telemetry /
-#      join-mode+perf-gate / delta-plane-HTAP / serving smokes;
+#      join-mode+perf-gate / delta-plane-HTAP / serving /
+#      multi-CN-serving smokes;
 #   8. the full ROADMAP tier-1 pytest command, verbatim (1500 s cap).
 #
 # Usage: tools/tier1.sh   (from anywhere; cd's to the repo root)
@@ -709,6 +710,82 @@ for m in ("serving_stmts_per_sec", "serving_speedup"):
 print(json.dumps({"serving_gate": "ok",
                   "plan_cache_hits": pc1["hits"],
                   "result_invalidations": rc2["invalidations"]}))
+PY
+
+echo "== tier1: multi-CN serving smoke =="
+timeout -k 10 240 python - <<'PY' || exit 1
+# Multi-coordinator serving plane (coord/): boot 2 CNs + 1 hot standby.
+# DDL on CN-A must force CN-B to RE-PLAN (the streamed D-record bumps
+# the peer's catalog epoch -> plan-cache miss, then hit again), a write
+# forwarded from CN-B must be readable by its own next local read, a
+# replica read must route under max_staleness with the staleness proof
+# in-bound, and one seeded chaos schedule (primary CN killed
+# mid-DDL-stream) must end green: zero lost acked writes, zero stale
+# cache hits.
+import json, tempfile
+from opentenbase_tpu.coord.peer import PeerCoordinator
+from opentenbase_tpu.coord.replica import StandbyTarget
+from opentenbase_tpu.engine import Cluster
+from opentenbase_tpu.fault.schedule import run_multicn_schedule
+from opentenbase_tpu.net.server import ClusterServer
+from opentenbase_tpu.storage.replication import StandbyCluster, WalSender
+
+d = tempfile.mkdtemp(prefix="otbmcn_")
+c = Cluster(num_datanodes=2, shard_groups=16, data_dir=f"{d}/cn0")
+s = c.session()
+s.execute("create table mt (k bigint, v bigint) distribute by shard(k)")
+s.execute("insert into mt values " + ",".join(
+    f"({i},{i*3})" for i in range(100)))
+sender = WalSender(c.persistence, poll_s=0.005)
+server = ClusterServer(c).start()
+peer = PeerCoordinator(f"{d}/cn1", num_datanodes=2, shard_groups=16,
+                       name="cn1").follow(sender.host, sender.port,
+                                          "127.0.0.1", server.port)
+sb = StandbyCluster(f"{d}/sb", 2, 16).start_replication(
+    sender.host, sender.port)
+assert peer.wait_applied(c.persistence.wal.position, 10.0)
+assert sb.wait_caught_up(c.persistence, 10.0)
+c.replica_targets.append(StandbyTarget("sb0", sb))
+# DDL on CN-A -> CN-B re-plans (miss), then caches again (hit)
+ps = peer.cluster.session()
+ps.execute("set enable_plan_cache = on")
+Q = "select v from mt where k = 7"
+assert ps.query(Q) == [(21,)] and ps.query(Q) == [(21,)]
+assert ps._last_plan_cache == "hit"
+s.execute("alter table mt add column w bigint")
+assert peer.wait_applied(c.persistence.wal.position, 10.0)
+assert ps.query(Q) == [(21,)]
+assert ps._last_plan_cache == "miss", "stale plan survived remote DDL"
+assert ps.query(Q) == [(21,)]
+assert ps._last_plan_cache == "hit"
+pc = dict(ps.query("select stat, value from pg_stat_plan_cache"))
+assert pc["last_invalidation_epoch"] >= 0 and pc["invalidations"] >= 1
+# a write forwarded from CN-B is readable by its own next local read
+ps.execute("insert into mt (k, v) values (555, 777)")
+assert ps.query("select v from mt where k = 555") == [(777,)]
+# replica read under max_staleness, staleness proof in-bound
+assert sb.wait_caught_up(c.persistence, 10.0)
+s.execute("set read_routing = replica")
+s.execute("set max_staleness = '30s'")
+assert s.query("select count(*) from mt") == [(101,)]
+assert s._last_plan_cache == "routed", "read did not route to standby"
+st = s.query("select pg_replica_status()")
+assert st[0][0] == "sb0" and 0 <= st[0][3] < 30.0, st
+server.stop(); sender.stop()
+for closer in (sb.stop, peer.stop, c.close):
+    try: closer()
+    except Exception: pass
+# seeded chaos: the primary CN killed mid-DDL-stream
+v = run_multicn_schedule(1111, f"{d}/chaos", duration_s=2.5)
+assert v["chaos_gate"] == "ok", v["violations"]
+assert v["lost_acked_writes"] == 0 and v["ddl_acked"] >= 1
+print(json.dumps({
+    "multicn_gate": "ok",
+    "peer_invalidations": pc["invalidations"],
+    "chaos_acked_writes": v["acked_writes"],
+    "chaos_ddl_acked": v["ddl_acked"],
+    "chaos_lost_acked": v["lost_acked_writes"],
+}))
 PY
 
 echo "== tier1: elastic rebalance smoke =="
