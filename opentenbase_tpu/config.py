@@ -213,6 +213,24 @@ GUCS: dict = {
     # probe never triggers a failover
     "failover_detect_ms": (_duration, 3000),
     "failover_beats": (_int, 3),
+    # serving lease (ha.ServingLease): the CN must prove DN-quorum
+    # contact within this window before serving ANY statement —
+    # including plan/result-cache hits, which issue no DN RPC and so
+    # never trip the fencing epochs on their own. 0 (default) = leases
+    # off, the pre-lease behavior. When on, load_conf refuses configs
+    # whose detection budget does not exceed TTL + skew: the
+    # no-dual-primary construction (failover waits out the lease) only
+    # holds when a partitioned primary's lease must lapse BEFORE the
+    # monitor can promote a successor.
+    "lease_ttl_ms": (_duration, 0),
+    "lease_skew_ms": (_duration, 100),
+    # failed-failover retry ladder (ha.HAMonitor): exponential backoff
+    # cap for re-driving failover() when no candidate promoted
+    "failover_retry_max_ms": (_duration, 10000),
+    # flap hysteresis (ha.HATopology.note_heal): a primary that healed
+    # after being declared dead cannot be deposed again inside this
+    # window — bounds promotions under a flapping link
+    "failover_cooldown_ms": (_duration, 2000),
     # commit durability ladder (the full PG synchronous_commit shape,
     # ROADMAP item 4b): 'off' = ack once the commit record is written +
     # OS-flushed, no fsync wait (an OS crash may lose the acked tail —
@@ -335,4 +353,30 @@ def load_conf(data_dir: Optional[str]) -> dict:
             name = name.strip()
             value = value.strip().strip("'\"")
             out[name] = validate(name, value)
+    _check_lease_budget(out, path)
     return out
+
+
+def _check_lease_budget(conf: dict, path: str) -> None:
+    """Cross-GUC invariant (checked only when leases are on): the
+    failure-detection budget must EXCEED lease TTL + skew. Failover
+    waits out the old lease before flipping routing; if detection could
+    finish while a partitioned primary's lease is still valid, a window
+    opens where both generations serve — the dual-primary the lease
+    exists to make impossible. Misconfiguration is refused at load, not
+    discovered during a partition."""
+    ttl = int(conf.get("lease_ttl_ms", GUCS["lease_ttl_ms"][1]) or 0)
+    if ttl <= 0:
+        return
+    detect = int(
+        conf.get("failover_detect_ms", GUCS["failover_detect_ms"][1])
+    )
+    beats = int(conf.get("failover_beats", GUCS["failover_beats"][1]))
+    skew = int(conf.get("lease_skew_ms", GUCS["lease_skew_ms"][1]))
+    if detect * beats <= ttl + skew:
+        raise GucError(
+            f"{path}: failover_detect_ms ({detect}) x failover_beats "
+            f"({beats}) must exceed lease_ttl_ms ({ttl}) + "
+            f"lease_skew_ms ({skew}) — a primary's lease must lapse "
+            f"before a successor can be promoted"
+        )

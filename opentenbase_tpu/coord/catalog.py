@@ -85,9 +85,13 @@ class CatalogService:
     def peer_rows(self, probe_timeout_s: float = 0.3) -> list:
         """One pg_cluster_health row per REGISTERED peer coordinator:
         (name, role, up, heartbeat_age, stream_lag, active, armed,
-        device_platform, generation, catalog_epoch). Probes each peer's
+        device_platform, generation, catalog_epoch, lease_valid,
+        lease_expires_ms, partitioned_peers). Probes each peer's
         SQL port with the pre-auth ping (the ha.py liveness probe);
-        stream lag is primary-WAL-end minus the peer's applied offset."""
+        stream lag is primary-WAL-end minus the peer's applied offset;
+        lease columns ride the ping reply (each peer CN gates its local
+        replica reads on its own serving lease)."""
+        from opentenbase_tpu.fault import partitioned_peers as _pp
         from opentenbase_tpu.ha import _probe_ping
 
         c = self.cluster
@@ -102,7 +106,7 @@ class CatalogService:
             if resp is None:
                 rows.append((
                     name, "coordinator-peer", False, -1.0, -1, 0, 0, "",
-                    -1, -1,
+                    -1, -1, False, -1, ",".join(_pp(name)),
                 ))
                 continue
             applied = int(resp.get("applied", 0))
@@ -117,6 +121,9 @@ class CatalogService:
                 "",
                 int(resp.get("generation", 0)),
                 int(resp.get("catalog_epoch", -1)),
+                bool(resp.get("lease_valid", True)),
+                int(resp.get("lease_remaining_ms", -1)),
+                ",".join(_pp(name)),
             ))
         return rows
 

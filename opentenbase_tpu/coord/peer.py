@@ -51,6 +51,27 @@ class PeerCoordinator(StandbyCluster):
         # SQL address of the primary CN writes forward to; None until
         # follow() learns it (and again after promote())
         self.primary_sql_addr: Optional[tuple] = None
+        # serving lease (ha.ServingLease): a peer CN serves local reads
+        # from its own replica, so it needs the same DN-quorum proof of
+        # liveness the primary does — start_lease() arms it
+        self.lease = None
+
+    def start_lease(
+        self, dn_endpoints: list, ttl_ms: int, skew_ms: int = 100,
+    ) -> "PeerCoordinator":
+        """Gate this peer's local reads on a serving lease against the
+        DN quorum. A partitioned peer CN otherwise keeps serving
+        plan/result-cache hits and replica reads with no staleness
+        bound at all — the same hole the primary's lease closes."""
+        from opentenbase_tpu.ha import ServingLease
+
+        if self.lease is None and int(ttl_ms) > 0:
+            self.lease = ServingLease(
+                self.cluster, dn_endpoints, int(ttl_ms), int(skew_ms),
+                name=self.name,
+            ).start()
+            self.cluster.serving_lease = self.lease
+        return self
 
     # -- wiring ------------------------------------------------------------
     def follow(self, wal_host: str, wal_port: int,
@@ -95,6 +116,15 @@ class PeerCoordinator(StandbyCluster):
         if not resp:
             return None
         return max(int(resp.get("applied", 0)) - self.applied, 0)
+
+    def stop(self) -> None:
+        if self.lease is not None:
+            try:
+                self.lease.stop()
+            except Exception:
+                pass
+            self.lease = None
+        super().stop()
 
     # -- failover ----------------------------------------------------------
     def promote(self, generation: Optional[int] = None):

@@ -140,6 +140,20 @@ class ReplicaRouter:
         """Serve ``sql`` (a single SELECT) from an eligible standby, or
         return None for the primary path. Enforces max_staleness and
         read-your-writes; fallback behavior per replica_read_fallback."""
+        from opentenbase_tpu.engine import SQLError
+
+        # serving lease (ha.ServingLease): belt to the statement gate's
+        # suspenders — a routed read on a CN whose lease lapsed is the
+        # same unbounded-staleness hole as a cache hit, so the router
+        # refuses it even if a caller reaches it outside the gate
+        lease = getattr(self.cluster, "serving_lease", None)
+        if lease is not None and not lease.valid():
+            raise SQLError(
+                "replica read refused: this coordinator's serving "
+                "lease is not valid (no datanode-quorum contact within "
+                f"lease_ttl_ms ({lease.ttl_ms}ms))",
+                "72000",
+            )
         gucs = session.gucs
         max_stale_s = session._duration_ms(
             gucs.get("max_staleness", 500), "max_staleness"

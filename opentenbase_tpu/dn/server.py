@@ -77,6 +77,11 @@ class DNServer:
         # happens at fetch time (this process does not know its mesh
         # index, same as the log ring).
         self.span_ring = _tctx.SpanRing(capacity=4096)
+        # kept for the repoint-rewind path: a diverged survivor
+        # rebuilds its standby over the same data_dir
+        self._data_dir = data_dir
+        self._num_datanodes = num_datanodes
+        self._shard_groups = shard_groups
         self.standby = StandbyCluster(data_dir, num_datanodes, shard_groups)
         self.standby.cluster.log = self.log_ring
         # gids resolved by the replication stream (their 'G' frame was
@@ -123,6 +128,12 @@ class DNServer:
         # (node_generation, set by replayed ha_generation records);
         # effective_generation() is the max of both.
         self._hgen = 0
+        # serving-lease grant table (ha.ServingLease): holder name ->
+        # (generation, monotonic deadline). Consulted by promote/ping
+        # replies so a failover can wait out every grant the OLD
+        # generation might still be serving under.
+        self._leases: dict = {}
+        self._lease_mu = threading.Lock()
         # DN-side fragment cancel (the reference's real cancel message):
         # tokens the coordinator abandoned; running fragments poll the
         # set at operator boundaries. Insertion-ordered for bounded
@@ -387,6 +398,20 @@ class DNServer:
                 if hg > self._hgen:
                     self._hgen = hg
         self._failpoint("dn/dispatch", op=op)
+        if op == "lease_grant":
+            # serving lease (ha.ServingLease): record the grant. Sits
+            # BELOW the hgen gate on purpose — a renewal from a stale
+            # generation is refused fenced above, which is exactly how
+            # a partitioned ex-primary learns it must demote forever.
+            holder = str(msg.get("holder") or "cn0")
+            ttl_ms = int(msg.get("ttl_ms") or 0)
+            with self._lease_mu:
+                self._leases[holder] = (
+                    int(msg.get("hgen") or 0),
+                    time.monotonic() + ttl_ms / 1000.0,
+                )
+            self._bump("lease_grants")
+            return {"ok": True}
         if op == "cancel_fragment":
             tok = str(msg.get("token") or "")
             with self._cancel_mu:
@@ -418,6 +443,11 @@ class DNServer:
                 # self-healing HA: fencing generation + live role so a
                 # failover is visible on the next heartbeat
                 "generation": self.effective_generation(),
+                # serving lease: worst outstanding stale-generation
+                # grant, for observability and failover planning
+                "lease_remaining_ms": self._stale_lease_remaining_ms(
+                    self.effective_generation()
+                ),
                 "role": (
                     # otb_race: ignore[race-guard-mismatch] -- heartbeat snapshot; a ping racing the promotion RPC reports the pre-promote role for one beat, the next beat corrects it
                     "coordinator" if self._promoted_srv is not None
@@ -669,6 +699,15 @@ class DNServer:
 
         c = self.standby.cluster
         with c._exec_lock:
+            # re-check the fence UNDER the lock: the dispatch gate ran
+            # before we queued on it, and promote() drains+bumps
+            # atomically under this same lock — a phase-2 from the
+            # deposed generation that lost the race must not write a
+            # row the promoted WAL will never carry
+            hg = msg.get("hgen")
+            if hg is not None and int(hg) < self.effective_generation():
+                self._bump("fenced_refusals")
+                return False
             if (
                 gid in self._stream_resolved
                 or gid in self.standby.direct_applied
@@ -699,13 +738,31 @@ class DNServer:
                 {"commit_ts": int(commit_ts), "writes": sub, "gid": gid},
                 arrays,
             )
-            self.standby.direct_applied.add(gid)
-            # promotion safety: until the stream's 'G' frame lands,
-            # this txn exists in our stores but in no WAL we could be
-            # promoted on — keep the payload so promote() can re-log it
-            self.standby.note_direct_apply(
-                gid, int(commit_ts), entry["writes"]
-            )
+            if self.standby.relog_closed:
+                # this node IS the promoted primary (the in-doubt
+                # resolver lands here after promote() drained
+                # pending_relog): no stream will ever carry this
+                # frame, so WAL-log it NOW — otherwise the row lives
+                # in a read-write primary's stores with no WAL record
+                # any standby or rejoiner could ever replay
+                c.persistence.wal.append(
+                    b"G",
+                    {"commit_ts": int(commit_ts), "writes": sub,
+                     "gid": gid},
+                    arrays or None,
+                )
+                c.persistence._record_decision(
+                    gid, "commit", int(commit_ts)
+                )
+            else:
+                self.standby.direct_applied.add(gid)
+                # promotion safety: until the stream's 'G' frame
+                # lands, this txn exists in our stores but in no WAL
+                # we could be promoted on — keep the payload so
+                # promote() can re-log it
+                self.standby.note_direct_apply(
+                    gid, int(commit_ts), entry["writes"]
+                )
             self._bump("dml_direct_applied")
         return True
 
@@ -877,6 +934,19 @@ class DNServer:
         if errors:
             raise errors[0]
 
+    def _stale_lease_remaining_ms(self, new_gen: int) -> int:
+        """Worst-case milliseconds a holder on a generation BELOW
+        ``new_gen`` could still believe it holds a serving lease this
+        node granted — what failover() must wait out before flipping
+        client routing."""
+        now = time.monotonic()
+        worst = 0.0
+        with self._lease_mu:
+            for _holder, (gen, deadline) in self._leases.items():
+                if gen < new_gen and deadline > now:
+                    worst = max(worst, deadline - now)
+        return int(worst * 1000.0)
+
     # -- coordinator failover ---------------------------------------------
     def effective_generation(self) -> int:
         """The highest fencing generation this node knows: learned from
@@ -924,6 +994,12 @@ class DNServer:
                 "port": self._promoted_srv.port,
                 "generation": int(c.node_generation),
                 "promote_lsn": int(getattr(c, "ha_promote_lsn", 0)),
+                # serving lease: the worst grant an OLD generation could
+                # still be serving under — failover sits this out (plus
+                # skew) before flipping client routing
+                "lease_remaining_ms": self._stale_lease_remaining_ms(
+                    int(c.node_generation)
+                ),
             }
             if self._promoted_walsender is not None:
                 out["wal_port"] = self._promoted_walsender.port
@@ -946,6 +1022,21 @@ class DNServer:
         host = str(msg.get("wal_host") or "127.0.0.1")
         port = int(msg["wal_port"])
         try:
+            from opentenbase_tpu.storage.replication import (
+                probe_timeline,
+            )
+
+            _gen, promote_lsn = probe_timeline(host, port)
+            if 0 <= promote_lsn < int(self.standby.applied):
+                # diverged survivor: a still-live deposed primary
+                # streamed frames here AFTER the promotion point, so
+                # our WAL holds bytes the new timeline does not —
+                # offset-based streaming would silently fork (and the
+                # ha_generation record would never arrive). Rewind:
+                # truncate to the promotion point, rebuild the stores
+                # from the truncated log, re-stream (pg_rewind for a
+                # surviving standby, not just the ex-primary).
+                return self._repoint_rewind(host, port, promote_lsn)
             self.standby.restart_replication(host, port)
         except Exception as e:
             self.log_ring.emit(
@@ -960,6 +1051,63 @@ class DNServer:
             f"(resumed from {self.standby.applied})",
         )
         return {"ok": True, "applied": self.standby.applied}
+
+    def _repoint_rewind(self, host: str, port: int,
+                        promote_lsn: int) -> dict:
+        """Rewind a diverged survivor onto the promoted timeline:
+        stop the old stream, release the old cluster's file handles,
+        and rebuild through rejoin_standby — which truncates the WAL
+        at the promotion point, drops any checkpoint taken past it,
+        replays the truncated log into fresh stores (discarding the
+        dead timeline's applied rows), and re-streams."""
+        from opentenbase_tpu.storage.replication import rejoin_standby
+
+        old = self.standby
+        rewound = int(old.applied) - int(promote_lsn)
+        try:
+            old.stop()
+            if old._thread is not None:
+                old._thread.join(timeout=5)
+        except Exception as e:
+            # best-effort: a receiver thread that will not die cleanly
+            # must not block the rewind — the rebuild below replaces it
+            self.log_ring.emit(
+                "warning", "ha",
+                f"rewind: old walreceiver stop failed: {e}",
+            )
+        try:
+            old.cluster.close()
+        except Exception as e:
+            # best-effort: the truncate reopens the WAL file anyway
+            self.log_ring.emit(
+                "warning", "ha",
+                f"rewind: old cluster close failed: {e}",
+            )
+        try:
+            sb = rejoin_standby(
+                self._data_dir, host, port,
+                self._num_datanodes, self._shard_groups,
+            )
+        except Exception as e:
+            self.log_ring.emit(
+                "error", "ha",
+                f"repoint rewind to {host}:{port} failed: {e}",
+            )
+            return {
+                "error": f"repoint rewind failed: "
+                         f"{type(e).__name__}: {e}",
+            }
+        sb.cluster.log = self.log_ring
+        sb.stream_txn_hook = self._on_stream_txn
+        self.standby = sb
+        self._bump("repoints")
+        self._bump("repoint_rewinds")
+        self.log_ring.emit(
+            "warning", "ha",
+            f"diverged survivor rewound {rewound} bytes to promotion "
+            f"point {promote_lsn} and re-pointed at {host}:{port}",
+        )
+        return {"ok": True, "applied": sb.applied, "rewound": rewound}
 
     def _revive(self) -> None:
         """Undo an injected crash: reopen the listener on the same port

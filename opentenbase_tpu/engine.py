@@ -913,6 +913,11 @@ class Cluster:
                 # heartbeat so pg_cluster_health shows the transition
                 h["generation"] = int(resp.get("generation") or 0)
                 h["role"] = str(resp.get("role") or "datanode")
+                # worst outstanding stale-generation serving-lease
+                # grant this DN issued (ha.ServingLease observability)
+                h["lease_remaining_ms"] = int(
+                    resp.get("lease_remaining_ms", -1)
+                )
             except Exception:
                 h["ok"] = False
         return self._dn_health
@@ -952,6 +957,22 @@ class Cluster:
                     continue
                 try:
                     resp = ch.rpc({"op": "ping"}, timeout_s=2.0)
+                    if resp.get("promoted") or (
+                        int(resp.get("generation") or 0)
+                        > int(getattr(self, "node_generation", 0) or 0)
+                    ):
+                        # gray-failure seam: a standby that PROMOTED
+                        # AWAY — or was REPOINTED onto a newer fencing
+                        # generation's timeline — applies a diverged
+                        # WAL, so its applied offset can numerically
+                        # pass this comparison while our record never
+                        # replayed there at all. It answers pings (not
+                        # dead) but can never confirm — hold until the
+                        # deadline fails the wait, so a deposed primary
+                        # cannot keep acking writes that exist on no
+                        # surviving timeline.
+                        fails.pop(n, None)
+                        continue
                     if int(resp.get("applied") or 0) >= lsn:
                         confirmed.add(n)
                     fails.pop(n, None)
@@ -2661,6 +2682,26 @@ class Session:
                 f"({self.cluster.node_generation}+) was promoted; "
                 "demoted ex-primary must resync (rejoin_standby) "
                 "before serving",
+                "72000",
+            )
+        lease = getattr(self.cluster, "serving_lease", None)
+        if lease is not None and not lease.valid():
+            # serving lease (ha.ServingLease): self-fencing BEFORE any
+            # statement is served. This gate sits ahead of replica
+            # routing and the plan/result-cache lookups on purpose — a
+            # cache hit issues no DN RPC, so the fencing epochs alone
+            # would let a partitioned ex-primary serve stale cached
+            # reads forever; the lease is the proof of recent DN-quorum
+            # contact those statements otherwise never produce.
+            self.cluster.ha_stats["fenced_refusals"] = (
+                self.cluster.ha_stats.get("fenced_refusals", 0) + 1
+            )
+            raise SQLError(
+                "node's serving lease is not valid: no datanode-quorum "
+                f"contact within lease_ttl_ms ({lease.ttl_ms}ms) — "
+                "self-demoted until the lease renews (a partitioned or "
+                "fenced coordinator must not serve, cached reads "
+                "included)",
                 "72000",
             )
         if self.cluster.read_only and not self._is_readonly_stmt(stmt):
@@ -8801,13 +8842,28 @@ def _sv_cluster_health(c: Cluster):
     # peer side: catalog stream lag behind the primary (0 on a primary,
     # -1 when the stream is down / primary unreachable)
     own_lag = c.catalog_service.stream_lag()
+    # serving lease (ha.ServingLease): validity + remaining window for
+    # THIS coordinator; a node with no lease configured shows valid
+    # with -1 remaining (the pre-lease contract)
+    cn_name = getattr(c, "coordinator_name", "cn0") or "cn0"
+    lease = getattr(c, "serving_lease", None)
+    if lease is None:
+        lease_valid, lease_ms = True, -1
+    else:
+        lease_ms = lease.remaining_ms()
+        lease_valid = lease_ms > 0
+    # connectivity matrix (fault/partition.py): peers THIS node's
+    # outbound legs currently cannot reach — empty outside a partition
+    # schedule
+    part_peers = ",".join(_fault.partitioned_peers(cn_name))
     rows.append((
-        getattr(c, "coordinator_name", "cn0") or "cn0",
+        cn_name,
         cn_role, True, 0.0, own_lag, active,
         len(_fault.armed()),
         getattr(c, "_last_device_platform", None) or "",
         gen,
         int(c.catalog_epoch),
+        lease_valid, lease_ms, part_peers,
     ))
     # one row per REGISTERED peer coordinator (primary side): probed
     # live, with catalog stream lag from the primary's own WAL end
@@ -8820,7 +8876,10 @@ def _sv_cluster_health(c: Cluster):
         )
     except Exception:
         gts_ok = False
-    rows.append(("gtm0", "gtm", bool(gts_ok), 0.0, 0, 0, 0, "", gen, -1))
+    rows.append((
+        "gtm0", "gtm", bool(gts_ok), 0.0, 0, 0, 0, "", gen, -1,
+        True, -1, "",
+    ))
     chans = getattr(c, "dn_channels", None) or {}
     if chans:
         c.probe_datanodes()
@@ -8828,11 +8887,17 @@ def _sv_cluster_health(c: Cluster):
     wal_pos = int(c.persistence.wal.position) if c.persistence else 0
     for n in c.nodes.datanode_indices():
         h = c._dn_health.get(n)
+        if f"dn{n}" == cn_name:
+            # a promoted standby serves as coordinator under its own
+            # node name — its coordinator row above IS this node;
+            # emitting a second "dn{n}" row would shadow it
+            continue
         if n not in chans:
             # in-process data plane: the DN *is* this process
             rows.append((
                 f"dn{n}", "datanode", True, 0.0, 0, 0, 0, "", gen,
                 int(c.catalog_epoch),
+                True, -1, "",
             ))
             continue
         up = bool(h and h.get("ok"))
@@ -8849,6 +8914,11 @@ def _sv_cluster_health(c: Cluster):
             "",
             int((h or {}).get("generation") or 0) if up else -1,
             int((h or {}).get("catalog_epoch") or -1) if up else -1,
+            # a DN holds no serving lease; its lease_expires_ms reports
+            # the worst OUTSTANDING stale-generation grant it issued
+            True,
+            int((h or {}).get("lease_remaining_ms", -1)) if up else -1,
+            ",".join(_fault.partitioned_peers(f"dn{n}")),
         ))
     return rows
 
@@ -9363,6 +9433,16 @@ _SYSTEM_VIEWS: dict[str, tuple] = {
             # CNs once the catalog stream is caught up; -1 when the
             # node does not carry one (GTM) or is unreachable
             "catalog_epoch": t.INT8,
+            # serving lease (ha.ServingLease): whether the node may
+            # serve statements right now; remaining window in ms (-1 =
+            # no lease configured). On DN rows, lease_expires_ms is the
+            # worst outstanding stale-generation grant that DN issued.
+            "lease_valid": t.BOOL,
+            "lease_expires_ms": t.INT8,
+            # connectivity matrix (fault/partition.py): peers this
+            # node's outbound legs cannot currently reach ('' outside a
+            # partition schedule)
+            "partitioned_peers": t.TEXT,
         },
         _sv_cluster_health,
     ),

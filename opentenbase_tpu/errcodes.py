@@ -21,6 +21,7 @@ ERRCODES: dict[str, str] = {
     "08000": "connection_exception",
     "08003": "connection_does_not_exist",
     "08006": "connection_failure",
+    "08007": "transaction_resolution_unknown",
     "08P01": "protocol_violation",
     # class 22 — data exception
     "22003": "numeric_value_out_of_range",

@@ -70,6 +70,16 @@ __all__ = [
     "set_chaos_seed",
     "chaos_seed",
     "chaos_rng",
+    # connectivity matrix (fault/partition.py) — re-exported so wire
+    # boundaries import one module for both failure planes
+    "NET_CHECK",
+    "NetMatrix",
+    "install_matrix",
+    "active_matrix",
+    "partitioned_peers",
+    "net_actor",
+    "set_thread_actor",
+    "current_actor",
 ]
 
 
@@ -462,3 +472,17 @@ def site_rng(site: str) -> random.Random:
     if f is not None and f._rng is not None:
         return f._rng
     return random.Random(f.seed if f is not None else 0)
+
+
+# bottom import: partition.py needs FAULT/FaultDropConnection from this
+# module, so the re-export has to come after they exist
+from opentenbase_tpu.fault.partition import (  # noqa: E402
+    NET_CHECK,
+    NetMatrix,
+    active_matrix,
+    current_actor,
+    install_matrix,
+    net_actor,
+    partitioned_peers,
+    set_thread_actor,
+)

@@ -561,11 +561,15 @@ def _verify(schedule, topo, mon, traffic, crash_wall,
                 "select client, seq from chaos_t"
             )
             if sorted(sb_rows) != sorted(rows):
+                p_set = {tuple(r) for r in rows}
+                s_set = {tuple(r) for r in sb_rows}
                 bad.append({
                     "invariant": "resync",
                     "error": "rejoined standby diverges from primary",
                     "standby_rows": len(sb_rows),
                     "primary_rows": len(rows),
+                    "missing_on_standby": sorted(p_set - s_set)[:10],
+                    "extra_on_standby": sorted(s_set - p_set)[:10],
                 })
             verdict["resync"] = {
                 "applied": sb.applied, "rows": len(sb_rows),
@@ -1219,6 +1223,458 @@ def run_multicn_schedule(
             shutil.rmtree(workdir, ignore_errors=True)
     verdict["chaos_gate"] = "ok" if not verdict["violations"] else "fail"
     return verdict
+
+
+# ---------------------------------------------------------------------------
+# Partition chaos (fault/partition.py): asymmetric + gray failures
+# ---------------------------------------------------------------------------
+
+PARTITION_SCENARIOS = ("asymmetric", "full", "gray_slow", "flapping")
+
+# the cached probe: a constant SELECT over a table NO traffic writes,
+# warmed into the primary's result cache before the partition — the one
+# read a fenced CN could serve with zero datanode RPCs, i.e. the exact
+# staleness hole the serving lease exists to close
+_PART_PROBE_SQL = "select v from lease_probe_t"
+
+
+def _until(pred, timeout_s: float, step_s: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step_s)
+    return bool(pred())
+
+
+def run_partition_schedule(
+    seed: int,
+    workdir: str,
+    scenario: str = "asymmetric",
+    duration_s: float = 6.0,
+    num_datanodes: int = 2,
+    detect_ms: int = 900,
+    beats: int = 3,
+    lease_ttl_ms: int = 600,
+    lease_skew_ms: int = 100,
+    keep: bool = False,
+) -> dict:
+    """One seeded network-partition schedule over live traffic: the
+    connectivity matrix (fault/partition.py) severs or degrades
+    specific DIRECTED legs of a live HA topology while the serving
+    lease, the flap hysteresis, and the failover backoff must keep the
+    cluster linearizable. Scenarios:
+
+    - ``asymmetric`` — the monitor cannot see cn0 and cn0 cannot reach
+      any datanode, but CLIENTS still reach cn0. Without the lease,
+      cn0 would keep serving result-cache hits and replica reads with
+      no staleness bound while a promoted peer accepts writes; with it,
+      cn0 self-demotes (72000) before serving ANY statement once its
+      DN-quorum renewals stop landing.
+    - ``full`` — cn0 cut off in both directions (the classic dead
+      primary, reached via the matrix rather than a process kill).
+    - ``gray_slow`` — the monitor→cn0 leg is SLOW (every probe times
+      out) while every other leg is healthy: the monitor promotes a
+      standby out from under a perfectly live primary. The promote's
+      generation bump fences cn0's lease renewals (a stale-generation
+      grant is refused below the DN hgen gate), its sync-commit waits
+      stop confirming (a promoted standby never counts), and the
+      lease wait-out keeps the new primary from serving until every
+      grant the old generation could still hold has run out.
+    - ``flapping`` — seeded cut/heal cycles of the probe leg: the
+      first dip (with the monitor also cut from the DNs) drives
+      declared-dead into FAILED failovers that must back off
+      exponentially; the heal arms the cooldown; the second dip's
+      failover must be SUPPRESSED by that cooldown. Bounded verdict:
+      zero promotions, >=2 failed-failover retries, >=2 heals, >=1
+      cooldown suppression, traffic never stops.
+
+    Invariants on every scenario: zero lost acked writes, zero
+    duplicate/phantom rows, zero stale reads (the acked-watermark
+    floor), and — after the matrix heals — the deposed primary still
+    REFUSES the warmed result-cache probe and a write with SQLSTATE
+    72000 (lease fenced), then rejoins as a standby and serves the
+    same rows. Fully replayable: one seed drives the matrix, the
+    backoff jitter, and the traffic mix."""
+    from opentenbase_tpu.ha import HAMonitor, HATopology
+    from opentenbase_tpu.net.client import WireError, connect_tcp
+
+    if scenario not in PARTITION_SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {scenario!r}; one of {PARTITION_SCENARIOS}"
+        )
+    os.makedirs(workdir, exist_ok=True)
+    verdict: dict = {
+        "seed": seed, "scenario": scenario, "violations": [],
+        "timeline": [],
+    }
+    bad = verdict["violations"]
+    tl = verdict["timeline"]
+    _fault.set_chaos_seed(seed)
+    matrix = _fault.NetMatrix()
+    prev_matrix = _fault.install_matrix(matrix)
+    topo = mon = traffic = None
+    try:
+        topo = HATopology(
+            workdir, num_datanodes, 32, conf_gucs={
+                "enable_fused_execution": "off",
+                "synchronous_commit": "on",
+                "failover_detect_ms": detect_ms,
+                "failover_beats": beats,
+                "lease_ttl_ms": lease_ttl_ms,
+                "lease_skew_ms": lease_skew_ms,
+                "failover_retry_max_ms": 2000,
+                "failover_cooldown_ms": 1500,
+                "enable_result_cache": "on",
+                "fragment_retries": 1,
+                "fragment_retry_backoff_ms": 5,
+                "statement_timeout": 5000,
+            },
+        )
+        matrix.register_endpoint(
+            "cn0", topo.server.port, topo.sender.port,
+        )
+        for i, dn in enumerate(topo.dns):
+            matrix.register_endpoint(f"dn{i}", dn.port)
+        # boot + warm the cache probe OVER THE WIRE (the same path the
+        # fenced probe takes later); the second execute must be a real
+        # result-cache hit or the fenced probe proves nothing
+        boot = connect_tcp(*topo.active_address())
+        boot.execute(
+            "create table chaos_t (client bigint, seq bigint, v bigint)"
+            " distribute by shard(seq)"
+        )
+        boot.execute(
+            "create table lease_probe_t (v bigint) distribute by shard(v)"
+        )
+        boot.execute("insert into lease_probe_t values (72)")
+        rc_stats = topo.primary.serving.result_cache.stats
+        boot.execute(_PART_PROBE_SQL)
+        hits0 = rc_stats["hits"]
+        warm = boot.execute(_PART_PROBE_SQL).rows
+        boot.close()
+        verdict["probe_cache_hit_warm"] = rc_stats["hits"] > hits0
+        if warm != [(72,)] or not verdict["probe_cache_hit_warm"]:
+            bad.append({
+                "invariant": "harness",
+                "error": "cache probe never warmed into the result "
+                f"cache (rows={warm}, hit={verdict['probe_cache_hit_warm']})",
+            })
+        mon = HAMonitor(topo).start()  # detect/beats from conf_gucs
+        sched = ChaosSchedule(
+            seed=seed, duration_s=duration_s,
+            num_datanodes=num_datanodes, events=[],
+        )
+        traffic = _Traffic(topo, sched)
+        traffic.start()
+        time.sleep(0.8)  # healthy baseline under traffic
+        cut_wall = time.time()
+        if scenario == "flapping":
+            _run_flap_phase(topo, mon, matrix, num_datanodes, verdict)
+        else:
+            if scenario == "asymmetric":
+                matrix.cut("monitor", "cn0")
+                matrix.cut("cn0", "*")
+            elif scenario == "full":
+                matrix.cut("*", "cn0")
+                matrix.cut("cn0", "*")
+            else:  # gray_slow: probes time out, every other leg is fine
+                matrix.slow_link("monitor", "cn0", detect_ms)
+            tl.append(f"cut[{scenario}] {sorted(matrix.describe()['cuts'])}"
+                      f" slow={matrix.describe()['slow']}")
+            if not _until(
+                lambda: topo.promoted_index is not None,
+                max(duration_s, 12.0), step_s=0.05,
+            ):
+                bad.append({
+                    "invariant": "auto_promotion",
+                    "error": f"{scenario}: primary partitioned but "
+                    "nothing promoted",
+                })
+            tl.append(f"promoted={topo.promoted_index}")
+            time.sleep(1.2)  # traffic window on the promoted primary
+        healed = matrix.heal_all()
+        tl.append(f"heal_all removed {healed} rules")
+        verdict["matrix"] = matrix.describe()["stats"]
+        # post-heal settle: the deposed CN's lease thread must get one
+        # renewal attempt THROUGH the healed matrix so the hgen gate can
+        # permanently fence it (<= ttl/3 between attempts)
+        time.sleep(max(lease_ttl_ms / 1000.0, 0.3))
+        if scenario != "flapping":
+            _part_fenced_probe(topo, verdict, bad)
+        traffic.stop()
+        mon.stop()
+        _fault.clear()
+        lease_stats = dict(topo.primary.ha_stats)
+        verdict["lease"] = {
+            k: lease_stats.get(k, 0)
+            for k in ("lease_expirations", "self_demotions",
+                      "fenced_refusals", "failover_retries",
+                      "partition_heals")
+        }
+        if scenario == "flapping":
+            _verify_flap(topo, mon, traffic, verdict, bad)
+        else:
+            if lease_stats.get("self_demotions", 0) < 1:
+                bad.append({
+                    "invariant": "lease_self_demotion",
+                    "error": "partitioned primary never self-demoted",
+                    "lease": verdict["lease"],
+                })
+            # converge to the crash shape: retire the deposed CN
+            # "process" (operator demotion), then the shared verifier
+            # re-probes the revived process and rejoins it as a standby
+            topo.crash_primary()
+            if topo.promoted_index is not None:
+                host, wport = topo.active_wal_address()
+                for j in range(len(topo.dns)):
+                    if j == topo.promoted_index:
+                        continue
+                    try:
+                        topo._dn_rpc(j, {
+                            "op": "repl_repoint", "wal_host": host,
+                            "wal_port": wport, "hgen": topo.generation,
+                        })
+                    except Exception:
+                        pass
+            # gray_slow: every missed probe burns interval + the FULL
+            # probe timeout (the link is slow, not dead), so the
+            # declare-latency budget carries that tax explicitly
+            eff_detect_ms = detect_ms + (
+                beats * 300 if scenario == "gray_slow" else 0
+            )
+            _verify(sched, topo, mon, traffic, cut_wall,
+                    eff_detect_ms, beats, verdict, "on")
+    except Exception as e:  # harness failure IS a failed run
+        bad.append({
+            "invariant": "harness",
+            "error": f"{type(e).__name__}: {e}",
+        })
+    finally:
+        try:
+            matrix.heal_all()
+        except Exception:
+            pass
+        _fault.install_matrix(prev_matrix)
+        _fault.clear()
+        _fault.reset_stats()
+        _fault.set_chaos_seed(None)
+        if traffic is not None and not traffic.stop_evt.is_set():
+            traffic.stop()
+        if mon is not None:
+            mon.stop()
+        if topo is not None:
+            topo.stop()
+        if not keep:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+    verdict["chaos_gate"] = "ok" if not verdict["violations"] else "fail"
+    return verdict
+
+
+def _run_flap_phase(topo, mon, matrix, num_datanodes, verdict) -> None:
+    """The deterministic two-dip flap: dip 1 proves the failed-failover
+    backoff (monitor cut from cn0 AND every DN, so no candidate can be
+    pinged), the heal arms the cooldown, dip 2 proves the cooldown
+    suppresses the next promotion attempt. Both dips also keep the
+    monitor cut from the DNs so a timing slip can never promote — the
+    bounded-promotions verdict stays deterministic."""
+    tl = verdict["timeline"]
+
+    def _dip():
+        matrix.cut("monitor", "cn0")
+        for i in range(num_datanodes):
+            matrix.cut("monitor", f"dn{i}")
+
+    _dip()
+    tl.append("flap dip 1 (monitor cut from cn0 + all DNs)")
+    if not _until(
+        lambda: mon.stats()["declared_dead_at"] is not None, 8.0,
+    ):
+        verdict["violations"].append({
+            "invariant": "flap",
+            "error": "dip 1 never reached declared-dead",
+        })
+    if not _until(lambda: mon.stats()["failover_retries"] >= 1, 8.0):
+        verdict["violations"].append({
+            "invariant": "failover_backoff",
+            "error": "failed failover never retried/backed off",
+        })
+    retries_after_dip1 = mon.stats()["failover_retries"]
+    matrix.heal_all()
+    tl.append("flap heal 1")
+    if not _until(
+        lambda: any(
+            e["kind"] == "primary_healed" for e in topo.events
+        ), 8.0,
+    ):
+        verdict["violations"].append({
+            "invariant": "flap",
+            "error": "heal 1 never noted (cooldown never armed)",
+        })
+    _dip()
+    tl.append("flap dip 2 (inside the cooldown window)")
+    _until(
+        lambda: any(
+            e["kind"] == "failover_suppressed" for e in topo.events
+        ) or mon.stats()["failover_retries"] > retries_after_dip1,
+        8.0,
+    )
+    matrix.heal_all()
+    tl.append("flap heal 2")
+    _until(
+        lambda: sum(
+            1 for e in topo.events if e["kind"] == "primary_healed"
+        ) >= 2, 8.0,
+    )
+    time.sleep(1.0)  # traffic window after the flap settles
+
+
+def _part_fenced_probe(topo, verdict, bad) -> None:
+    """The ISSUE's stale-read witness, sharpened: the matrix has
+    HEALED, the deposed primary is running and reachable, its result
+    cache still holds the warmed probe row — and it must refuse both
+    the cached read and a write with SQLSTATE 72000, because its lease
+    is permanently fenced (renewals carry the old generation)."""
+    from opentenbase_tpu.net.client import WireError, connect_tcp
+
+    probe_outcome = "refused"
+    try:
+        stale = connect_tcp(topo.server.host, topo.server.port)
+    except OSError as e:
+        verdict["fenced_probe"] = "unreachable"
+        bad.append({
+            "invariant": "lease_fencing",
+            "error": "deposed primary unreachable after heal "
+            f"(the probe must SEE the refusal): {e}",
+        })
+        return
+    try:
+        for sql, what in (
+            (_PART_PROBE_SQL, "cached_read"),
+            ("insert into chaos_t values (999, 1, 1)", "write"),
+        ):
+            try:
+                res = stale.execute(sql)
+                probe_outcome = f"accepted_{what}"
+                bad.append({
+                    "invariant": "lease_fencing",
+                    "error": f"healed-but-deposed primary ACCEPTED a "
+                    f"{what} (rows={getattr(res, 'rows', None)})",
+                })
+            except WireError as e:
+                if getattr(e, "sqlstate", None) != "72000":
+                    probe_outcome = "wrong_sqlstate"
+                    bad.append({
+                        "invariant": "lease_fencing",
+                        "error": f"{what} refused without the fenced "
+                        f"SQLSTATE: {e.sqlstate} {e}",
+                    })
+    finally:
+        stale.close()
+    verdict["fenced_probe"] = probe_outcome
+
+
+def _verify_flap(topo, mon, traffic, verdict, bad) -> None:
+    """Flap verdict: the primary survived, promotions are bounded at
+    ZERO, the backoff and the cooldown both fired, and the row-level
+    invariants hold on the never-deposed primary."""
+    st = mon.stats()
+    verdict["promotions"] = st["promotions"]
+    verdict["failover_retries"] = st["failover_retries"]
+    heals = sum(
+        1 for e in topo.events if e["kind"] == "primary_healed"
+    )
+    suppressed = sum(
+        1 for e in topo.events if e["kind"] == "failover_suppressed"
+    )
+    verdict["partition_heals"] = heals
+    verdict["cooldown_suppressed"] = suppressed
+    if st["promotions"] != 0 or topo.promoted_index is not None:
+        bad.append({
+            "invariant": "bounded_promotions",
+            "error": "a flap deposed a healthy primary",
+            "promotions": st["promotions"],
+        })
+    if st["failover_retries"] < 2:
+        bad.append({
+            "invariant": "failover_backoff",
+            "retries": st["failover_retries"],
+            "error": "expected >=2 failed-failover retries across dips",
+        })
+    if heals < 2:
+        bad.append({"invariant": "flap_heals", "heals": heals})
+    if suppressed < 1:
+        bad.append({
+            "invariant": "cooldown_hysteresis",
+            "error": "dip 2's failover was never suppressed by the "
+            "heal cooldown",
+        })
+    # row invariants on the surviving primary
+    s = topo.active_cluster.session()
+    s.execute("set statement_timeout = 0")
+    rows = s.query("select client, seq from chaos_t")
+    seen: dict = {}
+    for cid, sq in rows:
+        seen[(cid, sq)] = seen.get((cid, sq), 0) + 1
+    lost = [k for k in traffic.acked_set if k not in seen]
+    dups = [k for k, n in seen.items() if n > 1]
+    verdict["acked_writes"] = len(traffic.acked_set)
+    verdict["lost_acked_writes"] = len(lost)
+    verdict["final_rows"] = len(rows)
+    verdict["reads_ok"] = traffic.reads_ok
+    verdict["stale_reads"] = len(traffic.stale_reads)
+    if lost:
+        bad.append({"invariant": "zero_lost_committed_writes",
+                    "rows": sorted(lost)[:10], "count": len(lost)})
+    if dups:
+        bad.append({"invariant": "no_duplicates",
+                    "rows": dups[:10], "count": len(dups)})
+    if traffic.stale_reads:
+        bad.append({"invariant": "zero_stale_reads",
+                    "cases": traffic.stale_reads[:10],
+                    "count": len(traffic.stale_reads)})
+    attempted = traffic.acked_set | traffic.indeterminate
+    phantom = [k for k in seen if k not in attempted and k[0] != 999]
+    if phantom:
+        bad.append({"invariant": "no_phantom_rows",
+                    "rows": sorted(phantom)[:10],
+                    "count": len(phantom)})
+    if traffic.reads_ok == 0 or not traffic.acked_set:
+        bad.append({"invariant": "liveness",
+                    "error": "traffic never made progress under flap"})
+    # the lease must still be VALID: a flap of the PROBE leg must not
+    # cost the primary its serving lease (cn0->DN legs stayed up)
+    lease = getattr(topo.active_cluster, "serving_lease", None)
+    if lease is not None and not lease.valid():
+        bad.append({
+            "invariant": "lease_liveness",
+            "error": "probe-leg flap invalidated the primary's lease",
+        })
+
+
+def run_partition_schedules(
+    base_seed: int,
+    count: int,
+    workdir: str,
+    scenarios=PARTITION_SCENARIOS,
+    duration_s: float = 6.0,
+    num_datanodes: int = 2,
+    keep: bool = False,
+) -> list[dict]:
+    """``count`` seeds x every scenario (the acceptance matrix); one
+    verdict per (seed, scenario) run."""
+    out = []
+    for k in range(count):
+        seed = base_seed + k
+        for scenario in scenarios:
+            out.append(run_partition_schedule(
+                seed, os.path.join(workdir, f"s{seed}_{scenario}"),
+                scenario=scenario, duration_s=duration_s,
+                num_datanodes=num_datanodes, keep=keep,
+            ))
+    return out
 
 
 def run_schedules(

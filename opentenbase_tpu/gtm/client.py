@@ -272,6 +272,11 @@ class NativeGTS:
                     # failpoint: the GTM request boundary every grant
                     # crosses (delay = a slow GTM from one backend's view)
                     FAULT("gtm/client/rpc", op=op)
+                    # partition matrix (fault/partition.py): a cut
+                    # CN->GTM leg fails the grant like a peer reset
+                    from opentenbase_tpu.fault import NET_CHECK
+
+                    NET_CHECK(self.host, self.port, timeout_s=10)
                     self._sock.sendall(msg)
                     hdr = self._recv_exact(4)
                     (length,) = struct.unpack("<I", hdr)

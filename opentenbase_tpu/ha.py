@@ -46,13 +46,14 @@ Correctness notes the invariants stand on:
 from __future__ import annotations
 
 import os
+import random
 import socket
 import threading
 import time
 from typing import Optional
 
 from opentenbase_tpu.analysis.racewatch import shared_state
-from opentenbase_tpu.fault import FAULT
+from opentenbase_tpu.fault import FAULT, NET_CHECK
 from opentenbase_tpu.net.protocol import (
     recv_frame,
     send_frame,
@@ -68,6 +69,9 @@ def _probe_ping(host: str, port: int, timeout_s: float = 0.5):
     # slow network making a live primary look dead (false-positive
     # pressure), drop_conn a probe eaten by the partition
     FAULT("ha/probe", host=host, port=port)
+    # partition matrix: the monitor's probe leg is exactly the one an
+    # asymmetric partition cuts (monitor⊘primary, clients↔primary)
+    NET_CHECK(host, port, timeout_s=timeout_s)
     sock = socket.create_connection((host, port), timeout=timeout_s)
     try:
         sock.settimeout(timeout_s)
@@ -78,6 +82,172 @@ def _probe_ping(host: str, port: int, timeout_s: float = 0.5):
         return resp
     finally:
         shutdown_and_close(sock)
+
+
+class ServingLease:
+    """WAL-generation-scoped serving lease (the Patroni/DCS TTL role
+    this module's header names).
+
+    The fencing epochs stop a stale ex-primary the moment it issues a
+    DN RPC — but a plan/result-cache hit issues NONE, so a partitioned
+    ex-primary could keep answering cached reads forever. The lease
+    closes that hole by inverting the direction: the CN must *prove*
+    recent DN-quorum contact before serving ANY statement. A renewal
+    thread (net actor = the CN's own name, so the partition matrix can
+    cut exactly this leg) sends ``lease_grant`` carrying the CN's
+    generation to every DN each ``ttl/3``; a majority of grants extends
+    the expiry, computed from a timestamp taken BEFORE the fan-out so
+    clock reads on the far side never inflate the window.
+
+    Expiry is RECOVERABLE: statements are refused (SQLSTATE 72000)
+    while the lease is invalid and resume when renewal succeeds again
+    — a transient quorum hiccup is not a demotion. A **fenced** grant
+    reply (a DN that moved to a newer generation) is permanent: the
+    cluster demotes exactly like a fenced RPC would have demoted it.
+
+    ``HATopology.failover()`` reads the surviving DNs' view of
+    outstanding old-generation leases (``lease_remaining_ms`` in the
+    promote reply) and waits that out plus ``skew_ms`` before flipping
+    client routing — no-dual-primary by construction, provided the
+    detection budget exceeds the TTL (asserted at config load)."""
+
+    def __init__(
+        self,
+        cluster,
+        endpoints: list,
+        ttl_ms: int,
+        skew_ms: int = 100,
+        name: str = "cn0",
+    ):
+        self.cluster = cluster
+        self.endpoints = [(str(h), int(p)) for h, p in endpoints]
+        self.ttl_ms = int(ttl_ms)
+        self.skew_ms = int(skew_ms)
+        self.name = name
+        self._mu = threading.Lock()
+        self._expires = 0.0          # monotonic deadline; 0 = never held
+        self._fenced = False
+        self._was_valid = False      # edge detector for expiry counting
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # dedicated channels (NOT the statement pools): a renewal stuck
+        # on a cut link must never starve executor slots
+        self._chans: dict = {}
+
+    # -- wire -------------------------------------------------------------
+    def _grant_one(self, i: int, timeout_s: float) -> bool:
+        from opentenbase_tpu.net.pool import Channel, ChannelFenced
+
+        host, port = self.endpoints[i]
+        ch = self._chans.get(i)
+        try:
+            if ch is None or ch.broken:
+                ch = self._chans[i] = Channel(
+                    host, port, timeout=timeout_s, connect_retries=0,
+                )
+            resp = ch.rpc({
+                "op": "lease_grant",
+                "holder": self.name,
+                "hgen": int(getattr(self.cluster, "node_generation", 0)),
+                "ttl_ms": self.ttl_ms,
+            }, timeout_s=timeout_s)
+            return bool(resp.get("ok"))
+        except ChannelFenced:
+            # a DN on a NEWER generation refused us: we are a stale
+            # ex-primary and must never serve again on this timeline
+            with self._mu:
+                self._fenced = True
+            self._bump("self_demotions")
+            self.cluster.ha_demoted = True
+            return False
+        except Exception:
+            return False
+
+    def renew(self) -> bool:
+        """One renewal round; True when a DN majority granted."""
+        FAULT("ha/lease_renew", holder=self.name)
+        with self._mu:
+            if self._fenced:
+                return False
+        base = time.monotonic()  # BEFORE the fan-out: conservative
+        timeout_s = max(self.ttl_ms / 3000.0, 0.05)
+        grants = sum(
+            1 for i in range(len(self.endpoints))
+            if self._grant_one(i, timeout_s)
+        )
+        quorum = len(self.endpoints) // 2 + 1
+        if grants >= quorum:
+            with self._mu:
+                if not self._fenced:
+                    self._expires = base + self.ttl_ms / 1000.0
+                    self._was_valid = True
+            return True
+        return False
+
+    def valid(self) -> bool:
+        """The statement gate: every statement (crucially including
+        plan/result-cache hits, which touch no DN) checks this before
+        being served."""
+        FAULT("ha/lease_check", holder=self.name)
+        with self._mu:
+            if self._fenced:
+                return False
+            ok = time.monotonic() < self._expires
+            if not ok and self._was_valid:
+                # count the valid->expired EDGE once, not every refusal
+                self._was_valid = False
+                expired = True
+            else:
+                expired = False
+        if expired:
+            self._bump("lease_expirations")
+            self._bump("self_demotions")
+        return ok
+
+    def remaining_ms(self) -> int:
+        with self._mu:
+            if self._fenced:
+                return 0
+            return max(
+                int((self._expires - time.monotonic()) * 1000.0), 0
+            )
+
+    def _bump(self, key: str) -> None:
+        st = getattr(self.cluster, "ha_stats", None)
+        if st is not None:
+            st[key] = st.get(key, 0) + 1
+
+    # -- renewal loop -----------------------------------------------------
+    def start(self) -> "ServingLease":
+        self.renew()  # hold a lease before the first statement
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        from opentenbase_tpu.fault import set_thread_actor
+
+        # the renewal leg carries the CN's own name so a partition
+        # schedule can cut cn->DN (forcing self-demotion) while client
+        # traffic still reaches the CN
+        set_thread_actor(self.name)
+        interval = max(self.ttl_ms / 3000.0, 0.02)
+        while not self._stop.wait(interval):
+            try:
+                self.renew()
+            except Exception:
+                pass  # an unrenewed lease simply runs out
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for ch in self._chans.values():
+            try:
+                ch.close()
+            except Exception:
+                pass
+        self._chans.clear()
 
 
 class HATopology:
@@ -147,6 +317,24 @@ class HATopology:
         self.promoted_index: Optional[int] = None
         self.ex_primary_server = None  # fencing-probe revival
         self.ex_primary_standby = None  # post-rejoin StandbyCluster
+        # -- serving lease + flap hysteresis ------------------------------
+        self.lease_ttl_ms = int(self.conf_gucs.get("lease_ttl_ms") or 0)
+        self.lease_skew_ms = int(self.conf_gucs.get("lease_skew_ms") or 100)
+        self.failover_cooldown_ms = int(
+            self.conf_gucs.get("failover_cooldown_ms") or 2000
+        )
+        self.cooldown_until = 0.0  # monotonic; heal hysteresis window
+        self.lease: Optional[ServingLease] = None
+        self.promoted_lease: Optional[ServingLease] = None
+        self._dn_endpoints = [
+            ("127.0.0.1", dn.port) for dn in self.dns
+        ]
+        if self.lease_ttl_ms > 0:
+            self.lease = ServingLease(
+                self.primary, self._dn_endpoints,
+                self.lease_ttl_ms, self.lease_skew_ms, name="cn0",
+            ).start()
+            self.primary.serving_lease = self.lease
 
     # -- addresses --------------------------------------------------------
     def active_address(self) -> tuple[str, int]:
@@ -253,6 +441,18 @@ class HATopology:
             if self.promoted_index is not None:
                 return {"ok": True, "already": True,
                         "promoted": self.promoted_index}
+            # flap hysteresis: a primary that healed moments ago must
+            # not be deposed by the tail of the same flap — the monitor
+            # arms this window in note_heal()
+            if time.monotonic() < self.cooldown_until:
+                self._note(
+                    "failover_suppressed",
+                    cooldown_ms_left=int(
+                        (self.cooldown_until - time.monotonic()) * 1000
+                    ),
+                )
+                return {"ok": False, "cooldown": True,
+                        "error": "failover suppressed by heal cooldown"}
             gen = self.generation + 1
         rec = self._note("failover_start", reason=reason, generation=gen)
         cands = []
@@ -289,8 +489,30 @@ class HATopology:
             promote_lsn=int(resp.get("promote_lsn") or 0),
             sql_port=int(resp["port"]), wal_port=wal_port,
         )
-        # resync survivors onto the new timeline, then attach them as
-        # the promoted coordinator's datanode channels
+        # fence every survivor IMMEDIATELY — a bare ping carrying the
+        # new generation advances each survivor's hgen gate within one
+        # RPC round-trip, so a gray-failed ex-primary that is still
+        # live cannot land late 2PC phase-2 commits in a survivor's
+        # stores (rows on no surviving timeline: the repoint below
+        # truncates WAL, not applied store state). The heavier repoint
+        # handshake repeats the hgen, but it streams WAL per node and
+        # leaves the later survivors unfenced for tens of ms — exactly
+        # the window a live deposed primary needs.
+        for j in range(len(self.dns)):
+            if j == i:
+                continue
+            try:
+                self._dn_rpc(
+                    j,
+                    {"op": "ping", "hgen": int(resp["generation"])},
+                    timeout_s=2.0,
+                )
+            except Exception as e:
+                self._note("fence_failed", node=j, error=str(e)[:200])
+        # resync survivors onto the new timeline — the repoint repeats
+        # the fencing generation, truncates any torn tail, and
+        # re-streams from the promoted node's walsender — then attach
+        # them as the promoted coordinator's datanode channels
         for j in range(len(self.dns)):
             if j == i:
                 continue
@@ -343,6 +565,35 @@ class HATopology:
             "indoubt_resolved", own_journals=own,
             resolved=[list(r) for r in resolved],
         )
+        # serving-lease wait-out: before any client routes to the new
+        # primary, every lease the OLD generation could still hold must
+        # have run out — the promoted DN reports the worst-case
+        # remaining grant it handed out (measured AT the generation
+        # bump, so a still-renewing gray-failed primary cannot extend
+        # it), and we sit out that plus the skew margin. Usually ~0 for
+        # a dead primary: it could not renew during the detection
+        # window (detect budget > TTL, asserted at config load).
+        if self.lease_ttl_ms > 0:
+            wait_ms = (
+                int(resp.get("lease_remaining_ms") or 0)
+                + self.lease_skew_ms
+            )
+            if wait_ms > 0:
+                self._note("lease_wait", wait_ms=wait_ms)
+                time.sleep(wait_ms / 1000.0)
+        # the promoted coordinator's backends (and its partition-matrix
+        # actor) carry ITS name, not the deposed primary's — rules
+        # aimed at cn0 must not sever the new primary
+        newc.coordinator_name = f"dn{i}"
+        # the promoted coordinator serves under its OWN lease, renewed
+        # with the new generation (every DN port, its own included —
+        # the promoted DN server keeps answering its RPC port)
+        if self.lease_ttl_ms > 0 and self.promoted_lease is None:
+            self.promoted_lease = ServingLease(
+                newc, self._dn_endpoints,
+                self.lease_ttl_ms, self.lease_skew_ms, name=f"dn{i}",
+            ).start()
+            newc.serving_lease = self.promoted_lease
         with self._mu:
             self.generation = int(resp["generation"])
             self.promoted_index = i
@@ -353,6 +604,24 @@ class HATopology:
         self._note("failover_done", node=i)
         return {"ok": True, "promoted": i, "port": int(resp["port"]),
                 "generation": int(resp["generation"])}
+
+    # -- heal hysteresis --------------------------------------------------
+    def note_heal(self) -> None:
+        """A declared-dead primary answered a probe again (the
+        partition healed before failover finished). Arms the cooldown
+        window failover() honors, so a flapping link cannot promote on
+        every dip."""
+        with self._mu:
+            self.cooldown_until = (
+                time.monotonic() + self.failover_cooldown_ms / 1000.0
+            )
+            c = self._active_cluster
+        st = getattr(c, "ha_stats", None)
+        if st is not None:
+            st["partition_heals"] = st.get("partition_heals", 0) + 1
+        self._note(
+            "primary_healed", cooldown_ms=self.failover_cooldown_ms,
+        )
 
     # -- ex-primary: fencing probe + rejoin ------------------------------
     def revive_ex_primary(self):
@@ -407,6 +676,12 @@ class HATopology:
 
     # -- teardown ---------------------------------------------------------
     def stop(self) -> None:
+        for lease in (self.lease, self.promoted_lease):
+            if lease is not None:
+                try:
+                    lease.stop()
+                except Exception:
+                    pass
         if self.ex_primary_server is not None:
             try:
                 self.ex_primary_server.stop()
@@ -486,6 +761,15 @@ class HAMonitor:
         self.declared_dead_at: Optional[float] = None
         self.promotions = 0
         self.last_failover: Optional[dict] = None
+        # failed-failover backoff (exponential + seeded jitter, the
+        # connect_with_retry ladder applied to promote attempts): a
+        # no-candidate cluster must not hammer promote RPCs every beat
+        self.failover_retry_max_ms = int(
+            conf.get("failover_retry_max_ms") or 10000
+        )
+        self._fo_attempts = 0
+        self._next_fo_at = 0.0  # monotonic
+        self.failover_retries = 0
 
     def start(self) -> "HAMonitor":
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -498,6 +782,12 @@ class HAMonitor:
             self._thread.join(timeout=5)
 
     def _loop(self) -> None:
+        from opentenbase_tpu.fault import set_thread_actor
+
+        # the monitor's probes travel as "monitor" in the partition
+        # matrix — the leg an asymmetric partition severs while client
+        # legs stay up
+        set_thread_actor("monitor")
         while not self._stop.wait(self.interval_s):
             try:
                 self._beat()
@@ -511,7 +801,16 @@ class HAMonitor:
         probe = topo.probe_primary(timeout_s=min(self.interval_s, 0.5))
         if probe is not None:
             with self._mu:
+                healed = self.declared_dead_at is not None
                 self.misses = 0
+                self.declared_dead_at = None
+                self._fo_attempts = 0
+                self._next_fo_at = 0.0
+            if healed:
+                # declared dead, answered again before a failover won:
+                # the partition healed — arm the topology's cooldown so
+                # the tail of the flap cannot depose it
+                topo.note_heal()
             return
         with self._mu:
             self.misses += 1
@@ -526,8 +825,12 @@ class HAMonitor:
                 "declared_dead", misses=misses,
                 detect_ms=self.detect_ms, beats=self.beats,
             )
-        # drive the failover; on a failed attempt (e.g. every candidate
-        # currently crashed) keep retrying each beat until one succeeds
+        # drive the failover; failed attempts (every candidate crashed,
+        # heal-cooldown refusal) back off exponentially with seeded
+        # jitter instead of hammering promote RPCs every beat
+        with self._mu:
+            if time.monotonic() < self._next_fo_at:
+                return
         res = topo.failover(
             reason=f"{misses} consecutive missed beats"
         )
@@ -535,6 +838,28 @@ class HAMonitor:
             self.last_failover = res
             if res.get("ok") and not res.get("already"):
                 self.promotions += 1
+                self._fo_attempts = 0
+                self._next_fo_at = 0.0
+            elif not res.get("ok"):
+                self._fo_attempts += 1
+                self.failover_retries += 1
+                delay = min(
+                    self.interval_s * (2 ** self._fo_attempts),
+                    self.failover_retry_max_ms / 1000.0,
+                )
+                # full jitter, replayable from the chaos seed (same
+                # pattern as connect_with_retry's ladder)
+                from opentenbase_tpu.fault import chaos_rng
+
+                rng = chaos_rng("ha/failover_backoff")
+                draw = rng.random() if rng is not None else random.random()
+                self._next_fo_at = (
+                    time.monotonic() + delay * (0.5 + draw * 0.5)
+                )
+        if not res.get("ok"):
+            st = getattr(topo.active_cluster, "ha_stats", None)
+            if st is not None:
+                st["failover_retries"] = st.get("failover_retries", 0) + 1
 
     def stats(self) -> dict:
         """Beat counters under the monitor lock — what the chaos
@@ -545,6 +870,7 @@ class HAMonitor:
                 "declared_dead_at": self.declared_dead_at,
                 "promotions": self.promotions,
                 "last_failover": self.last_failover,
+                "failover_retries": self.failover_retries,
             }
 
 

@@ -13,7 +13,7 @@ import socket
 import time
 from dataclasses import dataclass, field
 
-from opentenbase_tpu.fault import FAULT
+from opentenbase_tpu.fault import FAULT, NET_CHECK
 from opentenbase_tpu.net.protocol import (
     recv_frame,
     send_frame,
@@ -61,6 +61,10 @@ def connect_with_retry(
             # channels, GTM): drop_conn here simulates a node that is
             # down/refusing, exercising the retry ladder deterministically
             FAULT("net/client/connect", host=host, port=port)
+            # connectivity matrix (fault/partition.py): a cut link
+            # refuses here like a dead host; a gray link eats the
+            # connect deadline
+            NET_CHECK(host, port, timeout_s=timeout)
             return socket.create_connection((host, port), timeout=timeout)
         except OSError as e:
             last = e
@@ -128,6 +132,8 @@ class ClientSession:
         ssl_ca: str | None = None,
         connect_retries: int = 3,
     ):
+        self._host, self._port = host, port
+        self._timeout = timeout
         self._sock = connect_with_retry(
             host, port, timeout=timeout, retries=connect_retries
         )
@@ -187,6 +193,9 @@ class ClientSession:
         from opentenbase_tpu.obs import tracectx as _tctx
 
         FAULT("net/client/send")
+        # partition matrix: an established session dies mid-statement
+        # when its link is cut (the asymmetric-partition probe path)
+        NET_CHECK(self._host, self._port, timeout_s=self._timeout)
         # a bound trace context follows the statement to the server
         # (e.g. a coordinator driving a promoted-DN coordinator), so
         # multi-hop statements still stitch into one trace
@@ -298,9 +307,28 @@ class RoutingClient:
         elif s.startswith("set ") and not s.startswith("set transaction"):
             self._session_state.append(sql)
 
+    # statement prefixes whose replay is harmless: pure reads and
+    # session-state changes. Everything else (INSERT/UPDATE/DELETE/DDL,
+    # COMMIT above all) may have been APPLIED before the link died —
+    # retrying it on another CN double-writes. The 2PC layer learned
+    # this as the 08006 in-doubt rule; the client layer gets the
+    # matching 08007 "transaction resolution unknown".
+    _RETRY_SAFE = (
+        "select", "show", "explain", "with", "values",
+        "set", "reset", "begin", "start", "rollback",
+    )
+
+    @classmethod
+    def _retry_safe(cls, sql: str) -> bool:
+        head = sql.lstrip().split(None, 1)
+        return bool(head) and head[0].lower().rstrip(";") in cls._RETRY_SAFE
+
     def execute(self, sql: str) -> WireResult:
+        # connect phase is its own loop (and safe to rotate endpoints:
+        # nothing has been sent) — keep it out of the retry decision
+        conn = self._connect()
         try:
-            res = self._connect().execute(sql)
+            res = conn.execute(sql)
         except (OSError, WireError) as e:
             if isinstance(e, WireError) and not (
                 "connection closed" in str(e)
@@ -314,6 +342,16 @@ class RoutingClient:
                     f"coordinator lost mid-transaction: {e}"
                 ) from e
             self._idx = (self._idx + 1) % len(self._endpoints)
+            if not self._retry_safe(sql):
+                # the statement may have committed before the reply was
+                # lost: the outcome is INDETERMINATE and only the caller
+                # can decide whether to replay (after reading back)
+                err = WireError(
+                    f"statement outcome unknown (connection lost after "
+                    f"send, not retried): {e}"
+                )
+                err.sqlstate = "08007"
+                raise err from e
             res = self._connect().execute(sql)
         self._note(sql)
         return res

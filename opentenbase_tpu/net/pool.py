@@ -15,7 +15,7 @@ import threading
 from typing import Optional
 
 from opentenbase_tpu.analysis.racewatch import shared_state
-from opentenbase_tpu.fault import FAULT
+from opentenbase_tpu.fault import FAULT, NET_CHECK
 from opentenbase_tpu.net.protocol import (
     encode_frame,
     recv_frame,
@@ -33,6 +33,7 @@ class Channel:
     ):
         from opentenbase_tpu.net.client import connect_with_retry
 
+        self.host, self.port = host, port
         self.sock = connect_with_retry(
             host, port, timeout=timeout, retries=connect_retries
         )
@@ -63,6 +64,14 @@ class Channel:
             if timeout_s is not None:
                 self.sock.settimeout(timeout_s)
             FAULT("net/pool/rpc_send", op=msg.get("op"))
+            # partition matrix: an established DN channel on a cut link
+            # fails here like a peer reset (→ broken → pool discard)
+            NET_CHECK(
+                self.host, self.port,
+                timeout_s=(
+                    timeout_s if timeout_s is not None else self._timeout
+                ),
+            )
             self.sock.sendall(frame)
             FAULT("net/pool/rpc_recv", op=msg.get("op"))
             resp = recv_frame(self.sock)

@@ -154,6 +154,16 @@ class ClusterServer:
             self._conn_threads.append(t)
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        from opentenbase_tpu.fault import set_thread_actor
+
+        # every wire op this backend performs on the client's behalf
+        # (fragment ships, sync-commit pings, lease-era DN RPCs) must
+        # travel under the COORDINATOR'S name in the partition matrix —
+        # a cut of cn0's egress has to sever work done FOR a client,
+        # not just the CN's own background threads
+        set_thread_actor(
+            getattr(self.cluster, "coordinator_name", "cn0") or "cn0"
+        )
         raw = conn  # the accepted socket registered in _conns
         if self._ssl_ctx is not None:
             # the handshake runs HERE, in the per-connection thread,
@@ -214,12 +224,23 @@ class ClusterServer:
                     else:
                         role = "coordinator"
                     rec = getattr(c, "catalog_receiver", None)
+                    # serving lease (ha.ServingLease): validity rides
+                    # the probe so pg_cluster_health peer rows show a
+                    # self-demoted CN without extra protocol
+                    lease = getattr(c, "serving_lease", None)
+                    lease_ms = (
+                        lease.remaining_ms() if lease is not None else -1
+                    )
                     send_frame(conn, {
                         "ok": True,
                         "role": role,
                         "generation": int(
                             getattr(c, "node_generation", 0)
                         ),
+                        "lease_valid": (
+                            lease is None or lease_ms > 0
+                        ),
+                        "lease_remaining_ms": lease_ms,
                         # multi-CN health surface: the probed node's
                         # catalog epoch + stream-applied offset let the
                         # primary render per-coordinator rows (and lag)

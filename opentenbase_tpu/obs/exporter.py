@@ -217,6 +217,35 @@ def render_cluster_metrics(cluster) -> str:
         "otb_fenced_refusals_total", {},
         int(ha.get("fenced_refusals", 0)),
     ))
+    # partition tolerance (ISSUE-19): serving-lease + partition-chaos
+    # counters — a gray-failure run is reconstructable from a scrape
+    _head(out, "otb_lease_expirations_total", "counter",
+          "Serving-lease valid->expired transitions on this node")
+    out.append(_line(
+        "otb_lease_expirations_total", {},
+        int(ha.get("lease_expirations", 0)),
+    ))
+    _head(out, "otb_self_demotions_total", "counter",
+          "Times this node self-demoted (lease lapse or fenced grant) "
+          "before serving a statement")
+    out.append(_line(
+        "otb_self_demotions_total", {},
+        int(ha.get("self_demotions", 0)),
+    ))
+    _head(out, "otb_failover_retries_total", "counter",
+          "Failed failover attempts re-driven by the HA monitor's "
+          "backoff ladder")
+    out.append(_line(
+        "otb_failover_retries_total", {},
+        int(ha.get("failover_retries", 0)),
+    ))
+    _head(out, "otb_partition_heals_total", "counter",
+          "Partition heal events observed (matrix heals + re-detected "
+          "primaries)")
+    out.append(_line(
+        "otb_partition_heals_total", {},
+        int(ha.get("partition_heals", 0)),
+    ))
 
     # multi-coordinator serving plane (coord/): CN liveness, catalog
     # stream health, and the replica-read outcome counters — the

@@ -26,7 +26,7 @@ def bucket_size(n: int, floor: int = 16) -> int:
 
 @partial(jax.jit)
 def mask_count(mask) -> jax.Array:
-    return jnp.sum(mask, dtype=jnp.int32)
+    return jnp.sum(mask, dtype=jnp.int64)
 
 
 @partial(jax.jit, static_argnames=("out_size",))
@@ -37,7 +37,7 @@ def compact_indices(mask, out_size: int):
     stay in-bounds without branching.
     """
     (idx,) = jnp.nonzero(mask, size=out_size, fill_value=0)
-    valid = jnp.arange(out_size, dtype=jnp.int32) < jnp.sum(mask, dtype=jnp.int32)
+    valid = jnp.arange(out_size, dtype=jnp.int64) < jnp.sum(mask, dtype=jnp.int64)
     return idx, valid
 
 

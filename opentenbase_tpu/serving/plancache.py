@@ -366,6 +366,17 @@ class ResultCache:
                 self.stats["forced_misses"] += 1
                 self.stats["misses"] += 1
             return None
+        # serving lease (ha.ServingLease): a result-cache hit issues NO
+        # datanode RPC, so it is the one read the fencing epochs can
+        # never refuse — on a CN whose lease lapsed the lookup is a
+        # forced miss (the statement gate upstream already raises 72000;
+        # this belt keeps the hole closed for any caller outside it)
+        lease = getattr(cluster, "serving_lease", None)
+        if lease is not None and not lease.valid():
+            with self._mu:
+                self.stats["forced_misses"] += 1
+                self.stats["misses"] += 1
+            return None
         with self._mu:
             e = self._entries.get(key)
             if e is None:

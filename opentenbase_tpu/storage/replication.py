@@ -47,7 +47,7 @@ import time
 from typing import Optional
 
 from opentenbase_tpu.analysis.racewatch import shared_state
-from opentenbase_tpu.fault import FAULT, site_rng
+from opentenbase_tpu.fault import FAULT, NET_CHECK, site_rng
 from opentenbase_tpu.net.protocol import (
     REPL_PROBE,
     pack_repl_ack,
@@ -389,6 +389,11 @@ class StandbyCluster:
         # see the full comment further down; must also predate the
         # replay loop below (_apply_one pops retired gids from it)
         self.pending_relog: dict = {}
+        # True once promote() drained pending_relog (under the exec
+        # lock): from that point on no stream will ever deliver a 'G'
+        # frame here, so a direct 2PC apply must WAL-log the writes
+        # itself — note_direct_apply would park them forever
+        self.relog_closed = False
         # replay whatever WAL already exists locally (crash-restart of the
         # standby itself), but keep in-doubt txns pending until promote
         self.applied = 0
@@ -430,6 +435,8 @@ class StandbyCluster:
         # failpoint: the standby attach itself (resync path) — an error
         # here is a standby that could not (re)join its primary
         FAULT("repl/start_replication", host=host, port=port)
+        # partition matrix: a standby on a cut link cannot (re)attach
+        NET_CHECK(host, port, timeout_s=10)
         my_gen = int(getattr(self.cluster, "node_generation", 0))
         self._sock = socket.create_connection((host, port), timeout=10)
         try:
@@ -496,6 +503,10 @@ class StandbyCluster:
                 # lagging standby; drop_conn kills the receiver thread the
                 # way a real network partition would)
                 FAULT("repl/wal_recv")
+                # partition matrix: a mid-stream cut severs the
+                # receiver exactly like a peer reset
+                peer = self._sock.getpeername()
+                NET_CHECK(peer[0], peer[1])
                 chunk = self._sock.recv(1 << 20)
             except OSError:
                 self._log_stream_end("walreceiver connection lost")
@@ -672,26 +683,38 @@ class StandbyCluster:
         p._in_recovery = False
         # re-log direct-applied commits the stream never confirmed, in
         # commit order, BEFORE the generation record (they belong to
-        # the shared history; the generation bump starts the new one)
+        # the shared history; the generation bump starts the new one).
+        # The drain and the bump are ATOMIC under the exec lock: a 2PC
+        # phase-2 from the doomed primary that passed the fencing gate
+        # before the bump direct-applies under this same lock — either
+        # it lands before the drain (and is re-logged here) or it
+        # re-checks the generation after us and refuses. Without the
+        # lock it can slip between drain and bump: a row in the
+        # promoted stores reachable from no WAL.
         relogged = 0
-        if self.pending_relog:
-            from opentenbase_tpu.plan import serde as _serde
+        with c._exec_lock:
+            if self.pending_relog:
+                from opentenbase_tpu.plan import serde as _serde
 
-            for gid, (cts, wire) in sorted(
-                self.pending_relog.items(), key=lambda kv: kv[1][0]
-            ):
-                sub, arrays = _serde.frame_from_wire(wire)
-                p.wal.append(
-                    b"G",
-                    {"commit_ts": cts, "writes": sub, "gid": gid},
-                    arrays or None,
-                )
-                p._record_decision(gid, "commit", cts)
-                relogged += 1
-            self.pending_relog.clear()
-        # durable fencing epoch: the promotion IS this record
-        p.log_ddl({"op": "ha_generation", "generation": int(generation)})
-        c.node_generation = int(generation)
+                for gid, (cts, wire) in sorted(
+                    self.pending_relog.items(), key=lambda kv: kv[1][0]
+                ):
+                    sub, arrays = _serde.frame_from_wire(wire)
+                    p.wal.append(
+                        b"G",
+                        {"commit_ts": cts, "writes": sub, "gid": gid},
+                        arrays or None,
+                    )
+                    p._record_decision(gid, "commit", cts)
+                    relogged += 1
+                self.pending_relog.clear()
+            # durable fencing epoch: the promotion IS this record
+            p.log_ddl({"op": "ha_generation",
+                       "generation": int(generation)})
+            c.node_generation = int(generation)
+            # any later direct 2PC apply (the failover in-doubt
+            # resolver) must WAL-log its own frame — see relog_closed
+            self.relog_closed = True
         ha = getattr(c, "ha_stats", None)
         if ha is not None:
             ha["promotions"] = ha.get("promotions", 0) + 1
@@ -726,6 +749,8 @@ def probe_timeline(host: str, port: int, timeout: float = 10.0):
     REPL_PROBE handshake, header only, no stream."""
     # failpoint: the rejoin path's first contact with the new primary
     FAULT("repl/probe", host=host, port=port)
+    # partition matrix: the rejoin probe is a wire boundary too
+    NET_CHECK(host, port, timeout_s=timeout)
     sock = socket.create_connection((host, port), timeout=timeout)
     try:
         sock.sendall(pack_repl_hello(REPL_PROBE, 0))

@@ -112,8 +112,11 @@ def test_failure_detector_auto_promotes_within_budget(tmp_path):
         # is visible on a scrape
         s = topo.active_cluster.session()
         h = {r[0]: r for r in s.query("select * from pg_cluster_health")}
-        assert h["cn0"][1] == "coordinator"
-        assert h["cn0"][8] == 1  # generation column
+        # the promoted node serves under its OWN name (partition-matrix
+        # rules aimed at the deposed cn0 must not sever the new primary)
+        promoted = f"dn{topo.promoted_index}"
+        assert h[promoted][1] == "coordinator"
+        assert h[promoted][8] == 1  # generation column
         from opentenbase_tpu.obs.exporter import render_cluster_metrics
 
         text = render_cluster_metrics(topo.active_cluster)

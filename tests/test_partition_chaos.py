@@ -1,0 +1,362 @@
+"""Partition-tolerant serving plane: the connectivity matrix
+(fault/partition.py), the WAL-generation-scoped serving lease, and the
+partition chaos schedules (fault/schedule.py run_partition_schedule).
+
+Covers the PR 19 acceptance surface:
+- the NetMatrix cuts/degrades DIRECTED legs by (src, dst) actor name
+  with wildcard fallback, and NET_CHECK enforces it at wire
+  boundaries;
+- cross-GUC config assertion: failover_detect_ms x failover_beats
+  must exceed lease_ttl_ms + lease_skew_ms or the conf refuses to
+  load (a successor must never be promotable while the deposed
+  primary's lease could still be valid);
+- the lease-expired result-cache hole, red/green: WITHOUT a lease a
+  partitioned primary keeps serving warmed result-cache hits with no
+  staleness bound; WITH one it refuses the same probe with SQLSTATE
+  72000 before serving any statement, and resumes after the heal;
+- a RoutingClient never blind-retries an indeterminate write: a
+  connection lost AFTER the send surfaces SQLSTATE 08007 and the row
+  exists exactly once (the duplicate-key witness);
+- one full partition schedule per scenario ends with every invariant
+  green (asymmetric in tier 1; the full scenario sweep is slow).
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from opentenbase_tpu import fault
+from opentenbase_tpu.config import GucError, load_conf
+from opentenbase_tpu.fault import (
+    NET_CHECK,
+    FaultDropConnection,
+    NetMatrix,
+    install_matrix,
+    net_actor,
+)
+from opentenbase_tpu.fault.schedule import (
+    PARTITION_SCENARIOS,
+    run_partition_schedule,
+)
+from opentenbase_tpu.ha import HATopology
+from opentenbase_tpu.net.client import WireError, connect_any, connect_tcp
+
+
+LEASE_CONF = {
+    "enable_fused_execution": "off",
+    "synchronous_commit": "on",
+    "failover_detect_ms": 900,
+    "failover_beats": 3,
+    "lease_ttl_ms": 600,
+    "lease_skew_ms": 100,
+    "enable_result_cache": "on",
+    "fragment_retries": 1,
+    "fragment_retry_backoff_ms": 5,
+    "statement_timeout": 5000,
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_matrix():
+    fault.clear()
+    fault.set_chaos_seed(None)
+    install_matrix(None)
+    yield
+    fault.clear()
+    fault.reset_stats()
+    fault.set_chaos_seed(None)
+    install_matrix(None)
+
+
+def _topology(tmp_path, **conf):
+    gucs = dict(LEASE_CONF)
+    gucs.update(conf)
+    return HATopology(
+        str(tmp_path / "part"), num_datanodes=2, shard_groups=16,
+        conf_gucs=gucs,
+    )
+
+
+def _until(pred, timeout_s: float, step_s: float = 0.02) -> bool:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step_s)
+    return bool(pred())
+
+
+# ---------------------------------------------------------------------------
+# The connectivity matrix itself
+# ---------------------------------------------------------------------------
+
+def test_netmatrix_directed_cuts_and_wildcards():
+    """Rules are DIRECTED (an asymmetric partition is two different
+    states) and match (src,dst) > (src,*) > (*,dst) > (*,*)."""
+    m = NetMatrix()
+    m.register_endpoint("cn0", 7001, 7002)
+    m.register_endpoint("dn0", 7003)
+    m.register_endpoint("dn1", 7004)
+    m.cut("monitor", "cn0")
+    assert m.is_cut("monitor", "cn0")
+    assert not m.is_cut("cn0", "monitor")      # directed, not mutual
+    assert not m.is_cut("client", "cn0")       # only the probe leg
+    m.cut("cn0", "*")
+    assert m.is_cut("cn0", "dn0") and m.is_cut("cn0", "dn1")
+    assert not m.is_cut("dn0", "dn1")          # bystanders untouched
+    assert set(m.partitioned_peers("cn0")) >= {"dn0", "dn1"}
+    # heal one leg, the wildcard remains
+    assert m.heal("monitor", "cn0") == 1
+    assert not m.is_cut("monitor", "cn0")
+    assert m.is_cut("cn0", "dn0")
+    assert m.heal_all() >= 1
+    assert not m.is_cut("cn0", "dn0")
+
+    # NET_CHECK consults the installed matrix under the caller's actor
+    m2 = NetMatrix()
+    m2.register_endpoint("dn0", 7003)
+    m2.cut("cn0", "dn0")
+    install_matrix(m2)
+    with net_actor("cn0"):
+        with pytest.raises(FaultDropConnection):
+            NET_CHECK("127.0.0.1", 7003)
+    with net_actor("client"):
+        NET_CHECK("127.0.0.1", 7003)  # other sources unaffected
+    assert m2.describe()["stats"]["drops"] == 1
+
+
+def test_netmatrix_slow_link_times_out_bounded_calls():
+    """A gray link delays below the caller's timeout and raises
+    socket.timeout at or past it — the probe-leg degradation."""
+    m = NetMatrix()
+    m.register_endpoint("cn0", 7001)
+    m.slow_link("monitor", "cn0", 30)
+    install_matrix(m)
+    with net_actor("monitor"):
+        t0 = time.monotonic()
+        NET_CHECK("127.0.0.1", 7001, timeout_s=10.0)  # 30ms < 10s
+        assert time.monotonic() - t0 >= 0.025
+        with pytest.raises(socket.timeout):
+            NET_CHECK("127.0.0.1", 7001, timeout_s=0.02)
+    assert m.slow_ms("monitor", "cn0") == 30
+    assert m.slow_ms("client", "cn0") == 0
+
+
+# ---------------------------------------------------------------------------
+# Config assertion: detection budget vs lease budget
+# ---------------------------------------------------------------------------
+
+def test_lease_budget_config_assertion(tmp_path):
+    """failover_detect_ms x failover_beats <= lease_ttl_ms +
+    lease_skew_ms is refused AT LOAD: if detection could finish while
+    a partitioned primary's lease is still valid, both generations
+    could serve at once."""
+    d = tmp_path / "conf"
+    d.mkdir()
+    conf = d / "opentenbase.conf"
+    conf.write_text(
+        "failover_detect_ms = 200\n"
+        "failover_beats = 2\n"
+        "lease_ttl_ms = 600\n"
+        "lease_skew_ms = 100\n"
+    )
+    with pytest.raises(GucError, match="must exceed lease_ttl_ms"):
+        load_conf(str(d))
+    # the partition-schedule conf passes: 900 x 3 > 600 + 100
+    conf.write_text(
+        "failover_detect_ms = 900\n"
+        "failover_beats = 3\n"
+        "lease_ttl_ms = 600\n"
+        "lease_skew_ms = 100\n"
+    )
+    out = load_conf(str(d))
+    assert out["lease_ttl_ms"] == 600
+    # leases off: no budget to assert
+    conf.write_text(
+        "failover_detect_ms = 200\n"
+        "failover_beats = 2\n"
+        "lease_ttl_ms = 0\n"
+    )
+    assert load_conf(str(d))["lease_ttl_ms"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The lease-expired result-cache hit, red then green
+# ---------------------------------------------------------------------------
+
+def _warm_probe(topo):
+    """Create + warm a result-cache probe over the wire; returns the
+    probe SQL after asserting the second execution was a real hit."""
+    s = connect_tcp(*topo.active_address())
+    s.execute(
+        "create table lease_probe_t (v bigint) distribute by shard(v)"
+    )
+    s.execute("insert into lease_probe_t values (72)")
+    rc_stats = topo.primary.serving.result_cache.stats
+    s.execute("select v from lease_probe_t")
+    hits0 = rc_stats["hits"]
+    rows = s.execute("select v from lease_probe_t").rows
+    s.close()
+    assert rows == [(72,)] and rc_stats["hits"] > hits0
+    return "select v from lease_probe_t"
+
+
+def test_partitioned_primary_without_lease_serves_stale_cache_hit(tmp_path):
+    """RED (the hole the lease closes): lease_ttl_ms=0 — a primary cut
+    off from every datanode keeps serving its warmed result-cache hit
+    with no staleness bound, because a cache hit touches no DN."""
+    topo = _topology(tmp_path, lease_ttl_ms=0)
+    try:
+        probe = _warm_probe(topo)
+        m = NetMatrix()
+        m.register_endpoint("cn0", topo.server.port, topo.sender.port)
+        for i, dn in enumerate(topo.dns):
+            m.register_endpoint(f"dn{i}", dn.port)
+        install_matrix(m)
+        m.cut("cn0", "*")
+        time.sleep(0.5)  # would cover several renew intervals
+        s = connect_tcp(*topo.active_address())
+        try:
+            assert s.execute(probe).rows == [(72,)]  # served, unbounded
+        finally:
+            s.close()
+    finally:
+        install_matrix(None)
+        topo.stop()
+
+
+def test_partitioned_primary_lease_refuses_cache_hit_72000(tmp_path):
+    """GREEN: with the serving lease on, the same partition makes the
+    primary self-demote BEFORE serving any statement — the warmed
+    cache hit and a write are both refused with SQLSTATE 72000 — and
+    serving resumes once the matrix heals (expiry is recoverable;
+    only a fencing refusal is permanent)."""
+    topo = _topology(tmp_path)
+    try:
+        probe = _warm_probe(topo)
+        m = NetMatrix()
+        m.register_endpoint("cn0", topo.server.port, topo.sender.port)
+        for i, dn in enumerate(topo.dns):
+            m.register_endpoint(f"dn{i}", dn.port)
+        install_matrix(m)
+        m.cut("cn0", "*")
+        assert _until(
+            lambda: not topo.lease.valid(), 5.0,
+        ), "lease never expired under a full cn0->DN cut"
+        for sql, kind in ((probe, "cached read"),
+                          ("insert into lease_probe_t values (1)",
+                           "write")):
+            s = connect_tcp(*topo.active_address())
+            try:
+                with pytest.raises(WireError) as ei:
+                    s.execute(sql)
+                assert ei.value.sqlstate == "72000", kind
+            finally:
+                s.close()
+        assert topo.primary.ha_stats.get("self_demotions", 0) >= 1
+        # heal: renewals land again within ttl/3 and serving resumes
+        m.heal_all()
+        assert _until(lambda: topo.lease.valid(), 5.0)
+
+        def _served():
+            s2 = connect_tcp(*topo.active_address())
+            try:
+                return s2.execute(probe).rows == [(72,)]
+            except WireError:
+                return False
+            finally:
+                s2.close()
+
+        assert _until(_served, 5.0), "serving never resumed after heal"
+    finally:
+        install_matrix(None)
+        topo.stop()
+
+
+# ---------------------------------------------------------------------------
+# Indeterminate writes are never blind-retried (08007)
+# ---------------------------------------------------------------------------
+
+def test_indeterminate_write_gets_08007_and_no_duplicate(tmp_path):
+    """A connection that dies AFTER the INSERT was sent leaves the
+    outcome indeterminate: the routed client must surface SQLSTATE
+    08007 WITHOUT replaying the statement on the next endpoint — the
+    row the server already committed must exist exactly once."""
+    topo = _topology(tmp_path, lease_ttl_ms=0)
+    rc = None
+    try:
+        rc = connect_any([("127.0.0.1", topo.server.port)])
+        rc.execute(
+            "create table w (k bigint, v bigint) distribute by shard(k)"
+        )
+        rc.execute("insert into w values (1, 10)")
+        # the reply to the NEXT statement is lost (fires in the client
+        # after send_frame, so the server still executes the INSERT)
+        fault.inject("net/client/recv", "drop_conn", "once")
+        with pytest.raises(WireError) as ei:
+            rc.execute("insert into w values (2, 20)")
+        assert ei.value.sqlstate == "08007"
+        assert "not retried" in str(ei.value)
+        # duplicate-key witness: indeterminate means the server may or
+        # may not have finished applying the frame we sent — but a
+        # blind retry is the only way to get it TWICE. Give the
+        # backend a settle window, then count.
+        _until(
+            lambda: (2,) in rc.query("select k from w"), 2.0,
+        )
+        rows = rc.query("select k, v from w order by k")
+        assert rows.count((1, 10)) == 1
+        assert rows.count((2, 20)) <= 1
+        assert len(rows) == len(set(rows))
+        # a retry-safe statement on the same client IS retried: the
+        # dropped reply triggers a silent reconnect + replay
+        n_before = rc.query("select count(*) from w")[0][0]
+        fault.inject("net/client/recv", "drop_conn", "once")
+        assert rc.query("select count(*) from w") == [(n_before,)]
+    finally:
+        if rc is not None:
+            rc.close()
+        topo.stop()
+
+
+# ---------------------------------------------------------------------------
+# Partition schedules end-to-end
+# ---------------------------------------------------------------------------
+
+def test_partition_schedule_asymmetric_smoke(tmp_path):
+    """One seeded asymmetric-partition schedule: clients reach cn0,
+    cn0 reaches no DN — the verdict must be green, which includes the
+    warmed-cache fenced probe (72000), zero lost acked writes, zero
+    stale reads, and the ex-primary's rejoin."""
+    v = run_partition_schedule(
+        1201, str(tmp_path / "sched"), scenario="asymmetric",
+        duration_s=4.0,
+    )
+    assert v["chaos_gate"] == "ok", v["violations"]
+    assert v["probe_cache_hit_warm"] is True
+    assert v["fenced_probe"] == "refused"
+    assert v["lost_acked_writes"] == 0 and v["stale_reads"] == 0
+    assert v["promotions"] == 1
+    assert v["lease"]["self_demotions"] >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", PARTITION_SCENARIOS)
+def test_partition_schedule_every_scenario(tmp_path, scenario):
+    """The full scenario sweep on one seed (the acceptance matrix runs
+    more seeds through otb_chaos --schedule partition)."""
+    v = run_partition_schedule(
+        1202, str(tmp_path / scenario), scenario=scenario,
+        duration_s=4.0,
+    )
+    assert v["chaos_gate"] == "ok", v["violations"]
+    if scenario == "flapping":
+        assert v["promotions"] == 0
+        assert v["cooldown_suppressed"] >= 1
+        assert v["failover_retries"] >= 2
+    else:
+        assert v["promotions"] == 1
+        assert v["fenced_probe"] == "refused"

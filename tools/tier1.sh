@@ -397,6 +397,49 @@ if not ok:
     sys.exit(1)
 PY
 
+echo "== tier1: partition chaos smoke (connectivity matrix + lease) =="
+timeout -k 10 240 python - <<'PY' || exit 1
+# One fixed-seed asymmetric-partition schedule (fault/partition.py +
+# fault/schedule.py): the connectivity matrix cuts monitor->cn0 and
+# cn0->every-DN while CLIENTS still reach cn0, under live traffic.
+# The serving lease must make the reachable-but-partitioned primary
+# self-demote BEFORE serving any statement: the invariant checker is
+# green only if zero acked writes were lost, zero reads were stale,
+# the deposed primary refused its own warmed result-cache probe with
+# SQLSTATE 72000 after the heal, and the ex-primary rejoined as a
+# standby serving identical rows.
+# Replay any failure: python -m opentenbase_tpu.cli.otb_chaos
+#   --schedule partition --seed 1201 --schedules 1 --scenarios asymmetric
+import json, sys, tempfile
+from opentenbase_tpu.fault.schedule import run_partition_schedule
+
+v = run_partition_schedule(
+    1201, tempfile.mkdtemp(prefix="otbpart_"),
+    scenario="asymmetric", duration_s=4.0,
+)
+ok = (
+    v["chaos_gate"] == "ok"
+    and v.get("promotions") == 1
+    and v.get("acked_writes", 0) > 0
+    and v.get("probe_cache_hit_warm") is True
+    and v.get("fenced_probe") == "refused"
+    and v.get("lease", {}).get("self_demotions", 0) >= 1
+    and v.get("lost_acked_writes") == 0
+    and v.get("stale_reads") == 0
+)
+print(json.dumps({
+    "partition_chaos_gate": "ok" if ok else "fail",
+    "seed": v["seed"],
+    "scenario": v["scenario"],
+    "acked_writes": v.get("acked_writes"),
+    "detect_latency_ms": v.get("detect_latency_ms"),
+    "lease": v.get("lease"),
+    "violations": v.get("violations"),
+}))
+if not ok:
+    sys.exit(1)
+PY
+
 echo "== tier1: telemetry smoke =="
 timeout -k 10 180 python - <<'PY' || exit 1
 # Telemetry plane (obs/log.py + exporter + health): start a cluster with
